@@ -1,0 +1,32 @@
+"""Spec system: typed tensor contracts and the flat/hierarchical container."""
+
+from tensor2robot_tpu.specs.spec import (
+    ExtendedTensorSpec,
+    TensorSpec,
+    canonical_dtype,
+    is_leaf,
+)
+from tensor2robot_tpu.specs.struct import TensorSpecStruct
+from tensor2robot_tpu.specs.utils import (
+    add_sequence_length_specs,
+    assert_equal,
+    assert_equal_spec_or_tensor,
+    assert_required,
+    cast_bfloat16_to_float32,
+    cast_float32_to_bfloat16,
+    cast_tensors,
+    copy_tensorspec,
+    dataset_keys,
+    filter_required_flat_tensor_spec,
+    filter_spec_structure_by_dataset,
+    flatten_spec_structure,
+    make_constant_numpy,
+    make_example_args,
+    make_placeholders,
+    make_random_numpy,
+    map_feed_dict,
+    pad_or_clip_tensor_to_spec_shape,
+    replace_dtype,
+    validate_and_flatten,
+    validate_and_pack,
+)

@@ -1,0 +1,199 @@
+"""Typed tensor specifications — the contract core of the framework.
+
+`ExtendedTensorSpec` declares the shape/dtype/name of a tensor a model
+consumes or produces, plus data-sourcing metadata (optionality, sequence-ness,
+on-disk image encoding, multi-dataset routing, varlen padding).  Every other
+layer — parsing, preprocessing, serving signatures, placeholder/fixture
+generation — is derived from structures of these specs.
+
+Behavioral reference: tensor2robot/utils/tensorspec_utils.py:41-279
+(ExtendedTensorSpec).  This implementation is JAX-native: dtypes are numpy
+dtypes (including ml_dtypes.bfloat16), and a spec lowers directly to a
+`jax.ShapeDtypeStruct` for tracing/export.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Image encodings we can decode from serialized byte features.
+_VALID_DATA_FORMATS = frozenset(["jpeg", "png", "JPEG", "PNG"])
+
+
+def canonical_dtype(dtype: Any) -> np.dtype:
+    """Normalizes any dtype-like (str, np.dtype, jnp dtype) to np.dtype.
+
+    bfloat16 is represented via ml_dtypes (what `jnp.bfloat16` aliases), so
+    `canonical_dtype('bfloat16') == jnp.bfloat16` holds.
+    """
+    if isinstance(dtype, str) and dtype == "bfloat16":
+        return np.dtype(jnp.bfloat16)
+    return np.dtype(dtype)
+
+
+def is_floating(dtype: Any) -> bool:
+    return jnp.issubdtype(canonical_dtype(dtype), np.floating)
+
+
+@dataclasses.dataclass(frozen=True)
+class ExtendedTensorSpec:
+    """A tensor contract: shape (without batch dim), dtype, and metadata.
+
+    Attributes:
+      shape: Tensor shape *excluding* the batch dimension. Entries may be
+        ``None`` for dimensions only known at runtime (e.g. sequence length).
+      dtype: Element dtype (numpy dtype; bfloat16 supported).
+      name: The feature key used to look the tensor up in serialized examples
+        and feed dicts. Distinct from the *path* a spec occupies inside a
+        TensorSpecStruct (see README "name vs path" duality).
+      is_optional: Optional tensors may be absent from inputs; validation
+        drops them rather than failing, and the TPU dtype-policy wrapper
+        strips them from infeed.
+      is_sequence: If True the feature is parsed from the feature_lists of a
+        SequenceExample (variable leading time dimension).
+      is_extracted: Marks a spec as already extracted from raw data (internal
+        bookkeeping used by preprocessors operating on parsed tensors).
+      data_format: 'jpeg'/'png' if the on-disk representation is an encoded
+        image string that must be decoded to this spec's shape.
+      dataset_key: Routes the feature to a named dataset when reading from
+        multiple zipped datasets at once ('' = the default dataset).
+      varlen_default_value: If set, the feature is parsed as a variable-length
+        list and padded (with this value) or clipped to the spec shape.
+    """
+
+    shape: Tuple[Optional[int], ...]
+    dtype: np.dtype
+    name: Optional[str] = None
+    is_optional: bool = False
+    is_sequence: bool = False
+    is_extracted: bool = False
+    data_format: Optional[str] = None
+    dataset_key: str = ""
+    varlen_default_value: Optional[float] = None
+
+    def __post_init__(self):
+        # Normalize shape: allow ints, np ints, None; scalars via () or int.
+        raw = self.shape
+        if raw is None:
+            raw = ()
+        if isinstance(raw, (int, np.integer)):
+            raw = (int(raw),)
+        shape = tuple(None if d is None else int(d) for d in raw)
+        object.__setattr__(self, "shape", shape)
+        object.__setattr__(self, "dtype", canonical_dtype(self.dtype))
+        if self.data_format is not None and self.data_format not in _VALID_DATA_FORMATS:
+            raise ValueError(
+                f"data_format must be one of {sorted(_VALID_DATA_FORMATS)}, "
+                f"got {self.data_format!r}"
+            )
+        if self.varlen_default_value is not None:
+            # Varlen features are flat lists on disk; we require rank-1 spec
+            # shapes with a concrete length so pad-or-clip semantics are
+            # unambiguous (the reference additionally allowed images; images
+            # are routed via data_format).
+            if self.data_format is None and (
+                len(shape) != 1 or shape[0] is None
+            ):
+                raise ValueError(
+                    "varlen_default_value requires a rank-1 shape with a "
+                    f"concrete length (or an image data_format); got {shape}"
+                )
+
+    # -- constructors ---------------------------------------------------------
+
+    @classmethod
+    def from_spec(cls, spec: "ExtendedTensorSpec", **overrides) -> "ExtendedTensorSpec":
+        """Copy `spec`, overriding any subset of fields.
+
+        Accepts plain specs from other systems as long as they expose
+        shape/dtype (duck-typed), mirroring tensorspec_utils.from_spec.
+        """
+        base = dict(
+            shape=tuple(spec.shape) if spec.shape is not None else (),
+            dtype=spec.dtype,
+            name=getattr(spec, "name", None),
+            is_optional=getattr(spec, "is_optional", False),
+            is_sequence=getattr(spec, "is_sequence", False),
+            is_extracted=getattr(spec, "is_extracted", False),
+            data_format=getattr(spec, "data_format", None),
+            dataset_key=getattr(spec, "dataset_key", ""),
+            varlen_default_value=getattr(spec, "varlen_default_value", None),
+        )
+        base.update(overrides)
+        return cls(**base)
+
+    @classmethod
+    def from_tensor(cls, tensor: Any, name: Optional[str] = None) -> "ExtendedTensorSpec":
+        """Builds a spec describing an ndarray/jax.Array (batch dim excluded).
+
+        The first dimension of `tensor` is treated as the batch dimension and
+        dropped, matching how specs are declared batch-free everywhere else.
+        """
+        arr = np.asarray(tensor) if not isinstance(tensor, jax.Array) else tensor
+        if arr.ndim == 0:
+            raise ValueError("Cannot infer a batched spec from a scalar tensor.")
+        return cls(shape=tuple(arr.shape[1:]), dtype=arr.dtype, name=name)
+
+    # -- conversions ----------------------------------------------------------
+
+    def to_shape_dtype_struct(
+        self, batch_size: Optional[int] = None
+    ) -> jax.ShapeDtypeStruct:
+        """Lowers to jax.ShapeDtypeStruct, optionally prepending a batch dim.
+
+        Unknown (None) dims are not representable in XLA static shapes; they
+        must be resolved (via batch_size or spec rewriting) before tracing.
+        """
+        shape = self.shape
+        if any(d is None for d in shape):
+            raise ValueError(
+                f"Spec {self.name!r} has unknown dims {shape}; resolve them "
+                "before lowering to a static ShapeDtypeStruct."
+            )
+        if batch_size is not None:
+            shape = (batch_size,) + shape
+        return jax.ShapeDtypeStruct(shape, self.dtype)
+
+    # -- equality: shape + dtype only (reference tensorspec_utils.py:262-264) --
+
+    def __eq__(self, other: Any) -> bool:
+        if not hasattr(other, "shape") or not hasattr(other, "dtype"):
+            return NotImplemented
+        return tuple(self.shape) == tuple(other.shape) and canonical_dtype(
+            self.dtype
+        ) == canonical_dtype(other.dtype)
+
+    def __hash__(self) -> int:
+        return hash((tuple(self.shape), str(self.dtype)))
+
+    def __repr__(self) -> str:  # compact, test-friendly
+        fields = [f"shape={self.shape}", f"dtype={np.dtype(self.dtype).name}"]
+        if self.name is not None:
+            fields.append(f"name={self.name!r}")
+        for flag in ("is_optional", "is_sequence", "is_extracted"):
+            if getattr(self, flag):
+                fields.append(f"{flag}=True")
+        if self.data_format:
+            fields.append(f"data_format={self.data_format!r}")
+        if self.dataset_key:
+            fields.append(f"dataset_key={self.dataset_key!r}")
+        if self.varlen_default_value is not None:
+            fields.append(f"varlen_default_value={self.varlen_default_value}")
+        return f"ExtendedTensorSpec({', '.join(fields)})"
+
+
+TensorSpec = ExtendedTensorSpec  # Convenience alias.
+
+SpecOrTensor = Union[ExtendedTensorSpec, np.ndarray, jax.Array]
+
+
+def is_leaf(value: Any) -> bool:
+    """True for values that terminate a spec/tensor structure."""
+    return isinstance(
+        value, (ExtendedTensorSpec, np.ndarray, jax.Array, np.number, bytes, str)
+    ) or np.isscalar(value)

@@ -1,0 +1,488 @@
+"""Spec algebra: flatten / validate / pack / cast / fixture generation.
+
+These are the operations every layer of the framework composes:
+  * `flatten_spec_structure` normalizes any hierarchical structure (dicts,
+    (named)tuples, lists, TensorSpecStruct) into a flat TensorSpecStruct.
+  * `validate_and_flatten` / `validate_and_pack` check that a structure of
+    tensors conforms to a structure of specs and return the flat / packed
+    form — the gate at every model and preprocessor boundary.
+  * dtype-policy casts (float32 <-> bfloat16) implement the TPU infeed policy.
+  * random/constant numpy makers generate spec-conforming fixtures, the basis
+    of serving example-args and all unit tests.
+
+Behavioral reference: tensor2robot/utils/tensorspec_utils.py:685-1682.
+"""
+
+from __future__ import annotations
+
+import collections
+from collections import abc as cabc
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensor2robot_tpu.specs.spec import ExtendedTensorSpec, canonical_dtype, is_leaf
+from tensor2robot_tpu.specs.struct import TensorSpecStruct
+
+SpecStructure = Union[TensorSpecStruct, cabc.Mapping, tuple, list]
+
+
+# -- flattening ---------------------------------------------------------------
+
+
+def _is_namedtuple(value: Any) -> bool:
+    return isinstance(value, tuple) and hasattr(value, "_fields")
+
+
+def flatten_spec_structure(structure: Any) -> TensorSpecStruct:
+    """Flattens any hierarchical spec/tensor structure to path-keyed form.
+
+    Supports dict, OrderedDict, TensorSpecStruct, namedtuple, tuple and list
+    containers (tuples/lists use their index as the path component).  Leaf
+    name collisions — two leaves whose specs share a `name` but disagree on
+    shape/dtype — are rejected (reference :1463-1529).
+    """
+    flat = TensorSpecStruct()
+    _flatten_into(flat, "", structure)
+    _check_name_collisions(flat)
+    return flat
+
+
+def _flatten_into(flat: TensorSpecStruct, prefix: str, value: Any) -> None:
+    if value is None:
+        return
+    if is_leaf(value):
+        if not prefix:
+            raise ValueError("Cannot flatten a bare leaf; wrap it in a container.")
+        flat[prefix] = value
+        return
+    if _is_namedtuple(value):
+        items = [(f, getattr(value, f)) for f in value._fields]
+    elif isinstance(value, cabc.Mapping):
+        items = list(value.items())
+    elif isinstance(value, (tuple, list)):
+        items = [(str(i), v) for i, v in enumerate(value)]
+    else:
+        raise ValueError(
+            f"Unsupported structure element of type {type(value)!r} at "
+            f"{prefix or '<root>'!r}"
+        )
+    for key, sub_value in items:
+        if sub_value is None:
+            continue
+        sub_prefix = f"{prefix}/{key}" if prefix else str(key)
+        _flatten_into(flat, sub_prefix, sub_value)
+
+
+def _check_name_collisions(flat: TensorSpecStruct) -> None:
+    by_name: Dict[str, ExtendedTensorSpec] = {}
+    for _, spec in flat.items():
+        if not isinstance(spec, ExtendedTensorSpec) or spec.name is None:
+            continue
+        ref = by_name.get(spec.name)
+        if ref is None:
+            by_name[spec.name] = spec
+        elif ref != spec:  # spec equality = shape + dtype
+            raise ValueError(
+                f"Name collision: two specs named {spec.name!r} disagree on "
+                f"shape/dtype ({ref} vs {spec})."
+            )
+
+
+# -- validation ---------------------------------------------------------------
+
+
+def _shapes_compatible(
+    spec_shape: Tuple[Optional[int], ...],
+    tensor_shape: Tuple[Optional[int], ...],
+    ignore_batch: bool,
+) -> bool:
+    if ignore_batch:
+        # The tensor carries a leading batch dim absent from the spec.
+        if len(tensor_shape) != len(spec_shape) + 1:
+            return False
+        tensor_shape = tensor_shape[1:]
+    elif len(tensor_shape) != len(spec_shape):
+        return False
+    # None on either side is a wildcard (unknown dim).
+    return all(
+        s is None or t is None or s == t for s, t in zip(spec_shape, tensor_shape)
+    )
+
+
+def assert_equal_spec_or_tensor(spec: ExtendedTensorSpec, tensor: Any, ignore_batch: bool = False) -> None:
+    """Raises ValueError unless `tensor` (or a second spec) conforms to `spec`.
+
+    When comparing spec-to-spec, neither side carries a batch dim, so exact
+    (wildcard-aware) shape match is required regardless of ignore_batch.
+    """
+    if not isinstance(tensor, ExtendedTensorSpec) and not hasattr(tensor, "shape"):
+        # Python scalars / bytes / str are admissible structure leaves; view
+        # them through numpy so conformance is reported as a ValueError, not
+        # an AttributeError.
+        tensor = np.asarray(tensor)
+    tensor_shape = tuple(
+        None if d is None else int(d) for d in tuple(tensor.shape)
+    )
+    spec_shape = tuple(spec.shape)
+    if isinstance(tensor, ExtendedTensorSpec):
+        ok = _shapes_compatible(spec_shape, tensor_shape, ignore_batch=False)
+    else:
+        if spec.is_sequence:
+            # Parsed sequence tensors carry a leading time dim in addition to
+            # the (optional) batch dim: (b, T, *spec.shape).
+            spec_shape = (None,) + spec_shape
+        ok = _shapes_compatible(spec_shape, tensor_shape, ignore_batch)
+    if not ok:
+        raise ValueError(
+            f"Shape mismatch for {spec.name!r}: spec {spec_shape} vs tensor "
+            f"{tensor_shape} (ignore_batch={ignore_batch})."
+        )
+    if canonical_dtype(tensor.dtype) != canonical_dtype(spec.dtype):
+        raise ValueError(
+            f"Dtype mismatch for {spec.name!r}: spec {np.dtype(spec.dtype)} "
+            f"vs tensor {np.dtype(tensor.dtype)}."
+        )
+
+
+def assert_equal(
+    expected: SpecStructure, actual: SpecStructure, ignore_batch: bool = False
+) -> None:
+    """Structural + per-leaf equality of two spec/tensor structures."""
+    flat_expected = flatten_spec_structure(expected)
+    flat_actual = flatten_spec_structure(actual)
+    if set(flat_expected.keys()) != set(flat_actual.keys()):
+        raise ValueError(
+            "Structures differ: expected keys "
+            f"{sorted(flat_expected.keys())} vs {sorted(flat_actual.keys())}"
+        )
+    for key, spec in flat_expected.items():
+        if isinstance(spec, ExtendedTensorSpec):
+            assert_equal_spec_or_tensor(spec, flat_actual[key], ignore_batch)
+
+
+def assert_required(
+    expected_specs: SpecStructure,
+    actual: SpecStructure,
+    ignore_batch: bool = False,
+) -> None:
+    """Like assert_equal but tolerates absence of optional specs
+    (reference :1169)."""
+    flat_specs = flatten_spec_structure(expected_specs)
+    flat_actual = flatten_spec_structure(actual)
+    for key, spec in flat_specs.items():
+        if key not in flat_actual:
+            if isinstance(spec, ExtendedTensorSpec) and spec.is_optional:
+                continue
+            raise ValueError(f"Required tensor {key!r} missing from structure.")
+        assert_equal_spec_or_tensor(spec, flat_actual[key], ignore_batch)
+    # Tensors beyond the declared specs are tolerated (and dropped by the
+    # pack/flatten callers), matching the reference's assert_required
+    # semantics: pipelines may carry auxiliary tensors past a narrower spec.
+
+
+def validate_and_flatten(
+    expected_spec: SpecStructure,
+    actual_tensors_or_spec: SpecStructure,
+    ignore_batch: bool = False,
+) -> TensorSpecStruct:
+    """Validates then returns the flat view of `actual_tensors_or_spec`,
+    restricted to the keys the spec declares (extras are dropped)."""
+    flat_spec = flatten_spec_structure(expected_spec)
+    flat_actual = flatten_spec_structure(actual_tensors_or_spec)
+    assert_required(flat_spec, flat_actual, ignore_batch)
+    out = TensorSpecStruct()
+    for key in flat_spec.keys():
+        if key in flat_actual:
+            out[key] = flat_actual[key]
+    return out
+
+
+def validate_and_pack(
+    expected_spec: SpecStructure,
+    actual_tensors_or_spec: SpecStructure,
+    ignore_batch: bool = False,
+) -> TensorSpecStruct:
+    """Validates `actual` against the spec and packs it into the spec's
+    hierarchy (a TensorSpecStruct mirroring the expected paths)."""
+    flat_spec = flatten_spec_structure(expected_spec)
+    flat_actual = flatten_spec_structure(actual_tensors_or_spec)
+    assert_required(flat_spec, flat_actual, ignore_batch)
+    packed = TensorSpecStruct()
+    for key in flat_spec.keys():
+        if key in flat_actual:
+            packed[key] = flat_actual[key]
+    return packed
+
+
+# -- copying / filtering / rewriting -----------------------------------------
+
+
+def copy_tensorspec(
+    structure: SpecStructure,
+    batch_size: Optional[int] = None,
+    prefix: str = "",
+) -> TensorSpecStruct:
+    """Deep-copies a spec structure, optionally prefixing every spec *name*.
+
+    Note the name-vs-path duality: `prefix` lands on the feature `name`
+    (used for serialized-data lookup), while the returned struct keeps the
+    original relative paths; callers attach it at whatever path they choose.
+    batch_size, if given, is prepended to every spec's shape (used when
+    episode/task structure makes the per-element batch explicit).
+    """
+    flat = flatten_spec_structure(structure)
+    out = TensorSpecStruct()
+    for key, spec in flat.items():
+        if not isinstance(spec, ExtendedTensorSpec):
+            out[key] = spec
+            continue
+        name = spec.name if spec.name is not None else key
+        if prefix:
+            name = f"{prefix}/{name}"
+        shape = spec.shape
+        if batch_size is not None:
+            shape = (batch_size,) + tuple(shape)
+        out[key] = ExtendedTensorSpec.from_spec(spec, name=name, shape=shape)
+    return out
+
+
+def filter_required_flat_tensor_spec(structure: SpecStructure) -> TensorSpecStruct:
+    """Drops optional specs (reference :1532)."""
+    flat = flatten_spec_structure(structure)
+    out = TensorSpecStruct()
+    for key, spec in flat.items():
+        if isinstance(spec, ExtendedTensorSpec) and spec.is_optional:
+            continue
+        out[key] = spec
+    return out
+
+
+def filter_spec_structure_by_dataset(
+    structure: SpecStructure, dataset_key: str
+) -> TensorSpecStruct:
+    """Keeps only specs routed to `dataset_key` (reference :1291)."""
+    flat = flatten_spec_structure(structure)
+    out = TensorSpecStruct()
+    for key, spec in flat.items():
+        if isinstance(spec, ExtendedTensorSpec) and spec.dataset_key == dataset_key:
+            out[key] = spec
+    return out
+
+
+def dataset_keys(structure: SpecStructure) -> Tuple[str, ...]:
+    """All distinct dataset keys present, in first-appearance order."""
+    seen = collections.OrderedDict()
+    for _, spec in flatten_spec_structure(structure).items():
+        if isinstance(spec, ExtendedTensorSpec):
+            seen.setdefault(spec.dataset_key, None)
+    return tuple(seen.keys())
+
+
+def add_sequence_length_specs(structure: SpecStructure) -> TensorSpecStruct:
+    """For every sequence spec 'x', appends an int64 scalar spec 'x_length'
+    carrying the true (pre-padding) sequence length (reference :1280)."""
+    flat = flatten_spec_structure(structure).copy()
+    for key, spec in list(flat.items()):
+        if isinstance(spec, ExtendedTensorSpec) and spec.is_sequence:
+            length_key = f"{key}_length"
+            name = (spec.name or key) + "_length"
+            flat[length_key] = ExtendedTensorSpec(
+                shape=(), dtype=np.int64, name=name, dataset_key=spec.dataset_key
+            )
+    return flat
+
+
+def replace_dtype(
+    structure: SpecStructure,
+    from_dtype: Any,
+    to_dtype: Any,
+) -> TensorSpecStruct:
+    """Returns a copy with every spec of `from_dtype` re-declared as
+    `to_dtype` — the basis of the bfloat16 infeed policy."""
+    src, dst = canonical_dtype(from_dtype), canonical_dtype(to_dtype)
+    flat = flatten_spec_structure(structure)
+    out = TensorSpecStruct()
+    for key, spec in flat.items():
+        if isinstance(spec, ExtendedTensorSpec) and canonical_dtype(spec.dtype) == src:
+            out[key] = ExtendedTensorSpec.from_spec(spec, dtype=dst)
+        else:
+            out[key] = spec
+    return out
+
+
+def cast_float32_to_bfloat16(structure: SpecStructure) -> TensorSpecStruct:
+    return replace_dtype(structure, np.float32, jnp.bfloat16)
+
+
+def cast_bfloat16_to_float32(structure: SpecStructure) -> TensorSpecStruct:
+    return replace_dtype(structure, jnp.bfloat16, np.float32)
+
+
+def cast_tensors(tensors: SpecStructure, from_dtype: Any, to_dtype: Any) -> TensorSpecStruct:
+    """Casts every tensor leaf of `from_dtype` to `to_dtype`."""
+    src = canonical_dtype(from_dtype)
+    dst = canonical_dtype(to_dtype)
+    flat = flatten_spec_structure(tensors)
+    out = TensorSpecStruct()
+    for key, value in flat.items():
+        if hasattr(value, "dtype") and canonical_dtype(value.dtype) == src:
+            if isinstance(value, np.ndarray):
+                out[key] = value.astype(dst)
+            else:
+                out[key] = jnp.asarray(value, dtype=dst)
+        else:
+            out[key] = value
+    return out
+
+
+# -- pad/clip -----------------------------------------------------------------
+
+
+def pad_or_clip_tensor_to_spec_shape(tensor: np.ndarray, spec: ExtendedTensorSpec) -> np.ndarray:
+    """Pads (with varlen_default_value) or clips a parsed varlen tensor to the
+    spec's static shape along the first axis (reference :1631-1682)."""
+    target = int(spec.shape[0])
+    value = spec.varlen_default_value
+    if value is None:
+        value = 0
+    tensor = np.asarray(tensor)
+    n = tensor.shape[0]
+    if n > target:
+        return tensor[:target]
+    if n < target:
+        pad = np.full((target - n,) + tensor.shape[1:], value, dtype=tensor.dtype)
+        return np.concatenate([tensor, pad], axis=0)
+    return tensor
+
+
+# -- fixture / example-args generation ---------------------------------------
+
+
+def _resolve_shape(
+    spec: ExtendedTensorSpec, batch_size: Optional[int], sequence_length: int
+) -> Tuple[int, ...]:
+    shape = tuple(sequence_length if d is None else d for d in spec.shape)
+    if spec.is_sequence:
+        shape = (sequence_length,) + shape
+    if batch_size is not None:
+        shape = (batch_size,) + shape
+    return shape
+
+
+def make_random_numpy(
+    structure: SpecStructure,
+    batch_size: Optional[int] = 2,
+    sequence_length: int = 3,
+    seed: int = 0,
+) -> TensorSpecStruct:
+    """Spec-conforming random numpy tensors (reference :847-920).
+
+    Images get uint8-ish ranges; floats U[0,1); ints U[0,10).
+    """
+    rng = np.random.RandomState(seed)
+    flat = flatten_spec_structure(structure)
+    out = TensorSpecStruct()
+    for key, spec in flat.items():
+        if not isinstance(spec, ExtendedTensorSpec):
+            continue
+        shape = _resolve_shape(spec, batch_size, sequence_length)
+        dtype = canonical_dtype(spec.dtype)
+        if jnp.issubdtype(dtype, np.floating):
+            value = rng.rand(*shape).astype(dtype)
+        elif dtype == np.dtype(np.uint8):
+            value = rng.randint(0, 256, size=shape, dtype=np.uint8)
+        elif jnp.issubdtype(dtype, np.integer):
+            value = rng.randint(0, 10, size=shape).astype(dtype)
+        elif dtype == np.dtype(bool):
+            value = rng.rand(*shape) > 0.5
+        else:
+            raise ValueError(f"Unsupported random dtype {dtype} for {key!r}")
+        out[key] = value
+    return out
+
+
+def make_constant_numpy(
+    structure: SpecStructure,
+    constant_value: float = 0.0,
+    batch_size: Optional[int] = 2,
+    sequence_length: int = 3,
+) -> TensorSpecStruct:
+    """Spec-conforming constant numpy tensors (reference :847-886)."""
+    flat = flatten_spec_structure(structure)
+    out = TensorSpecStruct()
+    for key, spec in flat.items():
+        if not isinstance(spec, ExtendedTensorSpec):
+            continue
+        shape = _resolve_shape(spec, batch_size, sequence_length)
+        out[key] = np.full(shape, constant_value, dtype=canonical_dtype(spec.dtype))
+    return out
+
+
+def make_example_args(
+    structure: SpecStructure,
+    batch_size: Optional[int] = 1,
+    sequence_length: int = 3,
+) -> TensorSpecStruct:
+    """jax.ShapeDtypeStruct leaves for tracing/export — the JAX-native
+    equivalent of the reference's `make_placeholders` (:783-814)."""
+    flat = flatten_spec_structure(structure)
+    out = TensorSpecStruct()
+    for key, spec in flat.items():
+        if not isinstance(spec, ExtendedTensorSpec):
+            continue
+        shape = _resolve_shape(spec, batch_size, sequence_length)
+        out[key] = jax.ShapeDtypeStruct(shape, canonical_dtype(spec.dtype))
+    return out
+
+
+make_placeholders = make_example_args  # API-parity alias.
+
+
+# -- feed mapping -------------------------------------------------------------
+
+
+def map_feed_dict(
+    spec_structure: SpecStructure,
+    numpy_inputs: cabc.Mapping,
+    ignore_batch: bool = True,
+) -> Dict[str, np.ndarray]:
+    """Validated spec-name -> numpy mapping for feeding serving functions.
+
+    Looks each required spec's *name* up in `numpy_inputs` (falling back to
+    the path key), validates shape/dtype, and returns {name: array}
+    (reference map_feed_dict :923-1010).
+    """
+    flat = flatten_spec_structure(spec_structure)
+    feed: Dict[str, np.ndarray] = {}
+    for key, spec in flat.items():
+        if not isinstance(spec, ExtendedTensorSpec):
+            continue
+        name = spec.name or key
+        if name in numpy_inputs:
+            value = numpy_inputs[name]
+        elif key in numpy_inputs:
+            value = numpy_inputs[key]
+        elif spec.is_optional:
+            continue
+        else:
+            raise ValueError(
+                f"Missing input for required spec {name!r} (path {key!r}); "
+                f"got keys {sorted(numpy_inputs.keys())}"
+            )
+        value = np.asarray(value)
+        target = canonical_dtype(spec.dtype)
+        if value.dtype != target:
+            # Feeds are host-side: permit only value-preserving casts (safe
+            # per numpy) plus float64->float32 narrowing, the common case for
+            # Python-float feeds. Anything lossy (float->int, int64->uint8)
+            # must fail validation rather than silently truncate.
+            if np.can_cast(value.dtype, target, casting="safe") or (
+                value.dtype == np.float64 and jnp.issubdtype(target, np.floating)
+            ):
+                value = value.astype(target)
+        assert_equal_spec_or_tensor(spec, value, ignore_batch=ignore_batch)
+        feed[name] = value
+    return feed

@@ -1,0 +1,407 @@
+"""Spec-core contract tests.
+
+Mirrors the observable behavior documented in the reference README ("Working
+with Tensor Specifications") and the semantics of
+tensor2robot/utils/tensorspec_utils_test.py — reimplemented for the JAX spec
+system, not copied.
+"""
+
+import collections
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensor2robot_tpu import specs
+from tensor2robot_tpu.specs import ExtendedTensorSpec, TensorSpecStruct
+
+
+def simple_spec():
+    s = TensorSpecStruct()
+    s["state"] = ExtendedTensorSpec(shape=(8, 128), dtype=np.float32, name="s")
+    s["action"] = ExtendedTensorSpec(shape=(8,), dtype=np.float32, name="a")
+    return s
+
+
+class TestExtendedTensorSpec:
+    def test_basic_fields_and_normalization(self):
+        spec = ExtendedTensorSpec(shape=8, dtype="float32", name="x")
+        assert spec.shape == (8,)
+        assert spec.dtype == np.float32
+
+    def test_bfloat16_dtype(self):
+        spec = ExtendedTensorSpec(shape=(4,), dtype="bfloat16")
+        assert spec.dtype == jnp.bfloat16
+
+    def test_equality_is_shape_dtype_only(self):
+        a = ExtendedTensorSpec(shape=(4,), dtype=np.float32, name="a")
+        b = ExtendedTensorSpec(shape=(4,), dtype=np.float32, name="b", is_optional=True)
+        c = ExtendedTensorSpec(shape=(4,), dtype=np.int32, name="a")
+        assert a == b
+        assert a != c
+
+    def test_from_spec_overrides(self):
+        a = ExtendedTensorSpec(shape=(4,), dtype=np.float32, name="a", is_sequence=True)
+        b = ExtendedTensorSpec.from_spec(a, name="b")
+        assert b.name == "b"
+        assert b.is_sequence
+        assert b.shape == (4,)
+
+    def test_from_tensor_drops_batch(self):
+        t = np.zeros((5, 3, 2), np.float32)
+        spec = ExtendedTensorSpec.from_tensor(t, name="t")
+        assert spec.shape == (3, 2)
+        assert spec.dtype == np.float32
+
+    def test_invalid_data_format(self):
+        with pytest.raises(ValueError):
+            ExtendedTensorSpec(shape=(4, 4, 3), dtype=np.uint8, data_format="bmp")
+
+    def test_varlen_requires_rank1(self):
+        with pytest.raises(ValueError):
+            ExtendedTensorSpec(shape=(4, 4), dtype=np.float32, varlen_default_value=0.0)
+        ExtendedTensorSpec(shape=(4,), dtype=np.float32, varlen_default_value=0.0)
+
+    def test_to_shape_dtype_struct(self):
+        spec = ExtendedTensorSpec(shape=(4, 2), dtype=np.float32)
+        sds = spec.to_shape_dtype_struct(batch_size=8)
+        assert sds.shape == (8, 4, 2)
+        with pytest.raises(ValueError):
+            ExtendedTensorSpec(shape=(None, 2), dtype=np.float32).to_shape_dtype_struct()
+
+
+class TestTensorSpecStruct:
+    def test_flat_and_hierarchical_views(self):
+        h = TensorSpecStruct()
+        h.train = specs.copy_tensorspec(simple_spec(), prefix="train")
+        assert list(h.keys()) == ["train/state", "train/action"]
+        assert list(h.train.keys()) == ["state", "action"]
+        assert h.train.state == simple_spec()["state"]
+        assert h.train.state.name == "train/s"
+
+    def test_two_subtrees(self):
+        h = TensorSpecStruct()
+        h.train = specs.copy_tensorspec(simple_spec(), prefix="train")
+        h.val = specs.copy_tensorspec(simple_spec(), prefix="val")
+        assert list(h.keys()) == [
+            "train/state",
+            "train/action",
+            "val/state",
+            "val/action",
+        ]
+        assert h.val.state.name == "val/s"
+
+    def test_views_are_live(self):
+        h = TensorSpecStruct()
+        h["train/state"] = ExtendedTensorSpec(shape=(4,), dtype=np.float32)
+        view = h.train
+        view.action = ExtendedTensorSpec(shape=(2,), dtype=np.float32)
+        assert "train/action" in h
+        h["train/extra"] = ExtendedTensorSpec(shape=(1,), dtype=np.float32)
+        assert "extra" in view
+
+    def test_empty_struct_assignment_forbidden(self):
+        h = TensorSpecStruct()
+        with pytest.raises(ValueError):
+            h.train = TensorSpecStruct()
+
+    def test_item_prefix_assignment(self):
+        h = TensorSpecStruct()
+        for key, value in simple_spec().items():
+            h["test/" + key] = ExtendedTensorSpec.from_spec(
+                value, name="something_random/" + value.name
+            )
+        assert list(h.test.keys()) == ["state", "action"]
+        assert h.test.state.name == "something_random/s"
+
+    def test_missing_attribute_raises(self):
+        h = TensorSpecStruct()
+        h["a"] = ExtendedTensorSpec(shape=(1,), dtype=np.float32)
+        with pytest.raises(AttributeError):
+            _ = h.nope
+
+    def test_collision_leaf_vs_subtree(self):
+        h = TensorSpecStruct()
+        h["train/state"] = ExtendedTensorSpec(shape=(1,), dtype=np.float32)
+        with pytest.raises(ValueError):
+            h["train"] = ExtendedTensorSpec(shape=(1,), dtype=np.float32)
+        h2 = TensorSpecStruct()
+        h2["train"] = ExtendedTensorSpec(shape=(1,), dtype=np.float32)
+        with pytest.raises(ValueError):
+            h2["train/state"] = ExtendedTensorSpec(shape=(1,), dtype=np.float32)
+
+    def test_delete_subtree(self):
+        h = TensorSpecStruct()
+        h.train = specs.copy_tensorspec(simple_spec())
+        del h["train"]
+        assert len(h) == 0
+
+    def test_holds_tensors(self):
+        h = TensorSpecStruct()
+        h["x"] = np.ones((2, 3), np.float32)
+        h["sub/y"] = np.zeros((2,), np.int32)
+        assert h.sub.y.shape == (2,)
+
+    def test_pytree_roundtrip(self):
+        h = TensorSpecStruct()
+        h["a/x"] = np.ones((2,), np.float32)
+        h["b"] = np.zeros((3,), np.float32)
+        leaves, treedef = jax.tree_util.tree_flatten(h)
+        assert len(leaves) == 2
+        rebuilt = jax.tree_util.tree_unflatten(treedef, leaves)
+        assert list(rebuilt.keys()) == list(h.keys())
+
+    def test_jit_through_struct(self):
+        h = TensorSpecStruct()
+        h["x"] = jnp.ones((4,))
+        h["sub/y"] = jnp.full((4,), 2.0)
+
+        @jax.jit
+        def f(s):
+            out = TensorSpecStruct()
+            out["z"] = s.x * s.sub.y
+            return out
+
+        out = f(h)
+        np.testing.assert_allclose(np.asarray(out.z), 2.0 * np.ones(4))
+
+    def test_to_hierarchical_dict(self):
+        h = TensorSpecStruct()
+        h["train/state"] = 1
+        h["train/action"] = 2
+        h["val/state"] = 3
+        d = h.to_hierarchical_dict()
+        assert d == {"train": {"state": 1, "action": 2}, "val": {"state": 3}}
+
+
+class TestFlattenSpecStructure:
+    def test_namedtuple(self):
+        Hierarchy = collections.namedtuple("Hierarchy", ["train", "val"])
+        Sample = collections.namedtuple("Sample", ["state", "action"])
+        h = Hierarchy(
+            train=Sample(
+                state=ExtendedTensorSpec(shape=(8, 128), dtype=np.float32, name="train/s"),
+                action=ExtendedTensorSpec(shape=(8,), dtype=np.float32, name="train/a"),
+            ),
+            val=Sample(
+                state=ExtendedTensorSpec(shape=(8, 128), dtype=np.float32, name="val/s"),
+                action=ExtendedTensorSpec(shape=(8,), dtype=np.float32, name="val/a"),
+            ),
+        )
+        flat = specs.flatten_spec_structure(h)
+        assert list(flat.keys()) == [
+            "train/state",
+            "train/action",
+            "val/state",
+            "val/action",
+        ]
+        assert flat["train/state"].name == "train/s"
+
+    def test_nested_dicts_and_lists(self):
+        h = {"a": [ExtendedTensorSpec(shape=(1,), dtype=np.float32)] * 2,
+             "b": {"c": ExtendedTensorSpec(shape=(2,), dtype=np.int32)}}
+        flat = specs.flatten_spec_structure(h)
+        assert set(flat.keys()) == {"a/0", "a/1", "b/c"}
+
+    def test_name_collision_detection(self):
+        h = {
+            "x": ExtendedTensorSpec(shape=(1,), dtype=np.float32, name="n"),
+            "y": ExtendedTensorSpec(shape=(2,), dtype=np.float32, name="n"),
+        }
+        with pytest.raises(ValueError):
+            specs.flatten_spec_structure(h)
+
+    def test_none_leaves_skipped(self):
+        flat = specs.flatten_spec_structure(
+            {"a": None, "b": ExtendedTensorSpec(shape=(1,), dtype=np.float32)}
+        )
+        assert list(flat.keys()) == ["b"]
+
+
+class TestValidation:
+    def test_validate_and_pack(self):
+        spec = {"in": simple_spec().to_dict()}
+        tensors = {
+            "in/state": np.zeros((4, 8, 128), np.float32),
+            "in/action": np.zeros((4, 8), np.float32),
+        }
+        packed = specs.validate_and_pack(spec, tensors, ignore_batch=True)
+        assert packed["in"].state.shape == (4, 8, 128)
+
+    def test_validate_rejects_shape_mismatch(self):
+        spec = simple_spec()
+        tensors = {"state": np.zeros((4, 8, 64), np.float32),
+                   "action": np.zeros((4, 8), np.float32)}
+        with pytest.raises(ValueError):
+            specs.validate_and_flatten(spec, tensors, ignore_batch=True)
+
+    def test_validate_rejects_dtype_mismatch(self):
+        spec = simple_spec()
+        tensors = {"state": np.zeros((4, 8, 128), np.float64),
+                   "action": np.zeros((4, 8), np.float32)}
+        with pytest.raises(ValueError):
+            specs.validate_and_flatten(spec, tensors, ignore_batch=True)
+
+    def test_optional_specs_may_be_absent(self):
+        spec = TensorSpecStruct()
+        spec["req"] = ExtendedTensorSpec(shape=(2,), dtype=np.float32)
+        spec["opt"] = ExtendedTensorSpec(shape=(2,), dtype=np.float32, is_optional=True)
+        flat = specs.validate_and_flatten(
+            spec, {"req": np.zeros((3, 2), np.float32)}, ignore_batch=True
+        )
+        assert list(flat.keys()) == ["req"]
+
+    def test_required_missing_raises(self):
+        spec = simple_spec()
+        with pytest.raises(ValueError):
+            specs.validate_and_flatten(
+                spec, {"state": np.zeros((3, 8, 128), np.float32)}, ignore_batch=True
+            )
+
+    def test_extra_tensors_dropped(self):
+        spec = TensorSpecStruct()
+        spec["a"] = ExtendedTensorSpec(shape=(1,), dtype=np.float32)
+        flat = specs.validate_and_flatten(
+            spec,
+            {"a": np.zeros((2, 1), np.float32), "b": np.zeros((2, 1), np.float32)},
+            ignore_batch=True,
+        )
+        assert list(flat.keys()) == ["a"]
+
+    def test_scalar_leaf_validated_not_crashed(self):
+        spec = {"a": ExtendedTensorSpec(shape=(), dtype=np.int64)}
+        with pytest.raises(ValueError):
+            specs.assert_required(spec, {"a": 5}, ignore_batch=True)
+
+    def test_sequence_spec_allows_time_dim(self):
+        spec = TensorSpecStruct()
+        spec["s"] = ExtendedTensorSpec(shape=(3,), dtype=np.float32, is_sequence=True)
+        specs.validate_and_flatten(
+            spec, {"s": np.zeros((2, 7, 3), np.float32)}, ignore_batch=True
+        )
+
+
+class TestSpecRewriting:
+    def test_replace_dtype_and_casts(self):
+        spec = simple_spec()
+        bf16 = specs.cast_float32_to_bfloat16(spec)
+        assert all(s.dtype == jnp.bfloat16 for s in bf16.values())
+        back = specs.cast_bfloat16_to_float32(bf16)
+        assert all(s.dtype == np.float32 for s in back.values())
+
+    def test_cast_tensors(self):
+        t = {"x": np.ones((2, 2), np.float32), "y": np.ones((2,), np.int32)}
+        out = specs.cast_tensors(t, np.float32, jnp.bfloat16)
+        assert out["x"].dtype == jnp.bfloat16
+        assert out["y"].dtype == np.int32
+
+    def test_filter_required(self):
+        spec = TensorSpecStruct()
+        spec["a"] = ExtendedTensorSpec(shape=(1,), dtype=np.float32)
+        spec["b"] = ExtendedTensorSpec(shape=(1,), dtype=np.float32, is_optional=True)
+        out = specs.filter_required_flat_tensor_spec(spec)
+        assert list(out.keys()) == ["a"]
+
+    def test_filter_by_dataset(self):
+        spec = TensorSpecStruct()
+        spec["a"] = ExtendedTensorSpec(shape=(1,), dtype=np.float32, dataset_key="d1")
+        spec["b"] = ExtendedTensorSpec(shape=(1,), dtype=np.float32)
+        assert list(specs.filter_spec_structure_by_dataset(spec, "d1").keys()) == ["a"]
+        assert list(specs.filter_spec_structure_by_dataset(spec, "").keys()) == ["b"]
+        assert specs.dataset_keys(spec) == ("d1", "")
+
+    def test_add_sequence_length_specs(self):
+        spec = TensorSpecStruct()
+        spec["s"] = ExtendedTensorSpec(shape=(3,), dtype=np.float32, is_sequence=True, name="s")
+        spec["x"] = ExtendedTensorSpec(shape=(2,), dtype=np.float32)
+        out = specs.add_sequence_length_specs(spec)
+        assert "s_length" in out
+        assert out["s_length"].dtype == np.int64
+        assert out["s_length"].shape == ()
+
+    def test_copy_tensorspec_batch_size(self):
+        out = specs.copy_tensorspec(simple_spec(), batch_size=5)
+        assert out["state"].shape == (5, 8, 128)
+
+
+class TestPadOrClip:
+    def test_pad(self):
+        spec = ExtendedTensorSpec(shape=(5,), dtype=np.float32, varlen_default_value=-1.0)
+        out = specs.pad_or_clip_tensor_to_spec_shape(np.array([1.0, 2.0], np.float32), spec)
+        np.testing.assert_array_equal(out, [1.0, 2.0, -1.0, -1.0, -1.0])
+
+    def test_clip(self):
+        spec = ExtendedTensorSpec(shape=(2,), dtype=np.float32, varlen_default_value=0.0)
+        out = specs.pad_or_clip_tensor_to_spec_shape(
+            np.array([1.0, 2.0, 3.0], np.float32), spec
+        )
+        np.testing.assert_array_equal(out, [1.0, 2.0])
+
+
+class TestFixtures:
+    def test_make_random_numpy(self):
+        spec = TensorSpecStruct()
+        spec["img"] = ExtendedTensorSpec(shape=(4, 4, 3), dtype=np.uint8)
+        spec["vec"] = ExtendedTensorSpec(shape=(7,), dtype=np.float32)
+        spec["seq"] = ExtendedTensorSpec(shape=(2,), dtype=np.float32, is_sequence=True)
+        out = specs.make_random_numpy(spec, batch_size=3, sequence_length=5)
+        assert out["img"].shape == (3, 4, 4, 3)
+        assert out["img"].dtype == np.uint8
+        assert out["vec"].shape == (3, 7)
+        assert out["seq"].shape == (3, 5, 2)
+
+    def test_make_constant_numpy(self):
+        spec = {"x": ExtendedTensorSpec(shape=(2,), dtype=np.float32)}
+        out = specs.make_constant_numpy(spec, constant_value=3.5, batch_size=2)
+        np.testing.assert_array_equal(out["x"], np.full((2, 2), 3.5, np.float32))
+
+    def test_make_example_args(self):
+        spec = {"x": ExtendedTensorSpec(shape=(2,), dtype="bfloat16")}
+        out = specs.make_example_args(spec, batch_size=4)
+        assert out["x"].shape == (4, 2)
+        assert out["x"].dtype == jnp.bfloat16
+
+    def test_validate_random_against_spec(self):
+        spec = simple_spec()
+        data = specs.make_random_numpy(spec, batch_size=2)
+        specs.validate_and_flatten(spec, data, ignore_batch=True)
+
+
+class TestMapFeedDict:
+    def test_lookup_by_name_and_path(self):
+        spec = TensorSpecStruct()
+        spec["state"] = ExtendedTensorSpec(shape=(2,), dtype=np.float32, name="s")
+        spec["action"] = ExtendedTensorSpec(shape=(1,), dtype=np.float32)
+        feed = specs.map_feed_dict(
+            spec,
+            {"s": np.zeros((4, 2)), "action": np.zeros((4, 1), np.float32)},
+        )
+        assert set(feed.keys()) == {"s", "action"}
+        assert feed["s"].dtype == np.float32
+
+    def test_missing_required_raises(self):
+        spec = {"x": ExtendedTensorSpec(shape=(2,), dtype=np.float32)}
+        with pytest.raises(ValueError):
+            specs.map_feed_dict(spec, {})
+
+    def test_lossy_cast_rejected(self):
+        spec = {"x": ExtendedTensorSpec(shape=(2,), dtype=np.int32)}
+        with pytest.raises(ValueError):
+            specs.map_feed_dict(spec, {"x": np.array([[0.9, 0.4]])})
+
+    def test_python_float_feed_narrowed(self):
+        spec = {"x": ExtendedTensorSpec(shape=(2,), dtype=np.float32)}
+        feed = specs.map_feed_dict(spec, {"x": np.array([[0.5, 1.5]])})
+        assert feed["x"].dtype == np.float32
+
+    def test_all_slash_key_rejected(self):
+        h = TensorSpecStruct()
+        with pytest.raises(KeyError):
+            h["/"] = ExtendedTensorSpec(shape=(1,), dtype=np.float32)
+
+    def test_varlen_none_dim_rejected(self):
+        with pytest.raises(ValueError):
+            ExtendedTensorSpec(
+                shape=(None,), dtype=np.float32, varlen_default_value=0.0
+            )
