@@ -1,0 +1,324 @@
+"""Dataset assembly: files -> interleave -> shuffle -> batch -> prefetch.
+
+Host-side record pipeline feeding the device. Design point (TPU-first): the
+host does only IO + proto parse + image decode; *all* numeric preprocessing
+(crops, distortions, casts) runs on-device inside the jitted train step where
+XLA fuses it with the model — so the infeed stays small (uint8 images) and
+the host CPU stays out of the hot path. This replaces the reference's
+tf.data assembly (utils/tfdata.py:630-689 default_input_fn_tmpl) where
+preprocessing ran in tf.data on the host.
+
+Pipeline semantics preserved from the reference:
+  * file-pattern listing + per-epoch file shuffling when training
+  * cyclic interleave across files (non-deterministic reads OK in training)
+  * record-level shuffle buffer
+  * batch with drop_remainder (static shapes for XLA)
+  * multi-dataset zip keyed by dataset_key
+  * background prefetch (the AUTOTUNE analogue: a bounded queue + thread)
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import random
+import threading
+from typing import Callable, Dict, Iterator, List, Mapping, Optional, Sequence, Union
+
+import numpy as np
+
+from tensor2robot_tpu.data import tfrecord
+from tensor2robot_tpu.data.parser import SpecParser
+from tensor2robot_tpu.specs import TensorSpecStruct
+
+
+def _interleave_files(
+    files: Sequence[str],
+    cycle_length: int,
+    shuffle_files: bool,
+    rng: Optional[random.Random],
+    repeat: bool,
+) -> Iterator[bytes]:
+    """Round-robin record interleave across up to `cycle_length` open files."""
+    while True:
+        order = list(files)
+        if shuffle_files and rng is not None:
+            rng.shuffle(order)
+        pending = iter(order)
+        active: List[Iterator[bytes]] = []
+        for path in itertools.islice(pending, cycle_length):
+            active.append(tfrecord.read_tfrecords(path))
+        while active:
+            next_active: List[Iterator[bytes]] = []
+            for reader in active:
+                try:
+                    yield next(reader)
+                    next_active.append(reader)
+                except StopIteration:
+                    try:
+                        next_active.append(tfrecord.read_tfrecords(next(pending)))
+                    except StopIteration:
+                        pass
+            active = next_active
+        if not repeat:
+            return
+
+
+def _shuffle_records(
+    records: Iterator, buffer_size: int, rng: random.Random
+) -> Iterator:
+    buf: List = []
+    for record in records:
+        buf.append(record)
+        if len(buf) >= buffer_size:
+            idx = rng.randrange(len(buf))
+            buf[idx], buf[-1] = buf[-1], buf[idx]
+            yield buf.pop()
+    rng.shuffle(buf)
+    yield from buf
+
+
+class _Prefetcher:
+    """Bounded background-thread prefetch queue.
+
+    The producer re-checks a stop flag between bounded put attempts, so an
+    abandoned iterator (consumer breaks early, common in eval loops) releases
+    its thread and buffers instead of parking forever on a full queue.
+    """
+
+    _SENTINEL = object()
+
+    def __init__(self, source: Iterator, depth: int):
+        self._queue: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._error: Optional[BaseException] = None
+        self._stopped = threading.Event()
+        self._thread = threading.Thread(
+            target=self._fill, args=(source,), daemon=True
+        )
+        self._thread.start()
+
+    def _put(self, item) -> bool:
+        while not self._stopped.is_set():
+            try:
+                self._queue.put(item, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _fill(self, source: Iterator) -> None:
+        try:
+            for item in source:
+                if not self._put(item):
+                    return
+        except BaseException as e:  # propagated to the consumer
+            self._error = e
+        finally:
+            self._put(self._SENTINEL)
+
+    def close(self) -> None:
+        self._stopped.set()
+        # Drain so a producer blocked in put() can observe the stop flag.
+        try:
+            while True:
+                self._queue.get_nowait()
+        except queue.Empty:
+            pass
+
+    def __del__(self):
+        self.close()
+
+    def __iter__(self) -> "_Prefetcher":
+        return self
+
+    def __next__(self):
+        item = self._queue.get()
+        if item is self._SENTINEL:
+            if self._error is not None:
+                raise self._error
+            raise StopIteration
+        return item
+
+
+class RecordDataset:
+    """Iterable of parsed, batched TensorSpecStruct numpy batches.
+
+    Args:
+      specs: feature(+label) spec structure driving the generated parser.
+      file_patterns: glob pattern(s), or a {dataset_key: patterns} map for
+        multi-dataset specs (zipped element-wise, reference
+        utils/tfdata.py:395-422).
+      batch_size: per-host batch size; with drop_remainder shapes are static.
+      mode: 'train' enables shuffling + infinite repeat by default.
+      shuffle_buffer_size: record-level shuffle window.
+      repeat: None -> infinite for train, single epoch otherwise.
+      seed: deterministic shuffling when set.
+      prefetch_depth: parsed batches buffered ahead by a background thread.
+      file_fraction: use only the first fraction of files (data-ablation,
+        reference FractionalRecordInputGenerator).
+    """
+
+    def __init__(
+        self,
+        specs,
+        file_patterns: Union[str, Sequence[str], Mapping[str, Union[str, Sequence[str]]]],
+        batch_size: int,
+        mode: str = "train",
+        shuffle_buffer_size: int = 512,
+        repeat: Optional[bool] = None,
+        seed: Optional[int] = None,
+        prefetch_depth: int = 2,
+        cycle_length: int = 4,
+        drop_remainder: bool = True,
+        file_fraction: float = 1.0,
+    ):
+        self._parser = SpecParser(specs)
+        self._batch_size = batch_size
+        self._train = mode == "train"
+        self._shuffle_buffer_size = shuffle_buffer_size if self._train else 0
+        self._repeat = self._train if repeat is None else repeat
+        self._seed = seed
+        self._prefetch_depth = prefetch_depth
+        self._cycle_length = cycle_length
+        self._drop_remainder = drop_remainder
+
+        if isinstance(file_patterns, Mapping):
+            self._files: Dict[str, List[str]] = {
+                k: tfrecord.list_files(v) for k, v in file_patterns.items()
+            }
+        else:
+            self._files = {"": tfrecord.list_files(file_patterns)}
+        if file_fraction < 1.0:
+            for k, files in self._files.items():
+                n = max(1, int(len(files) * file_fraction))
+                self._files[k] = files[:n]
+        missing = set(self._parser.dataset_keys) - set(self._files.keys())
+        if missing:
+            raise ValueError(
+                f"Specs reference dataset keys {sorted(missing)} with no file "
+                f"patterns (got {sorted(self._files.keys())})"
+            )
+
+    def _record_stream(self) -> Iterator:
+        rng = random.Random(self._seed)
+        dataset_keys = list(self._files.keys())
+        if dataset_keys == [""]:
+            records: Iterator = _interleave_files(
+                self._files[""],
+                self._cycle_length,
+                shuffle_files=self._train,
+                rng=rng,
+                repeat=self._repeat,
+            )
+        else:
+            # Multi-dataset zip: streams must stay aligned, so files are read
+            # in identical (sorted) order per key, interleave is disabled, and
+            # epochs are zipped jointly — unequal record counts are an error,
+            # not a silent drift (the pairs ARE the training signal).
+            def zipped():
+                while True:
+                    epoch = {
+                        k: _interleave_files(
+                            self._files[k], 1, shuffle_files=False, rng=None,
+                            repeat=False,
+                        )
+                        for k in dataset_keys
+                    }
+                    while True:
+                        row = {}
+                        done = []
+                        for k, stream in epoch.items():
+                            try:
+                                row[k] = next(stream)
+                            except StopIteration:
+                                done.append(k)
+                        if done:
+                            if len(done) != len(epoch):
+                                raise ValueError(
+                                    "Multi-dataset zip misalignment: datasets "
+                                    f"{sorted(done)} exhausted before "
+                                    f"{sorted(set(epoch) - set(done))}; record "
+                                    "counts must match across dataset keys."
+                                )
+                            break
+                        yield row
+                    if not self._repeat:
+                        return
+            records = zipped()
+        if self._shuffle_buffer_size > 1:
+            records = _shuffle_records(records, self._shuffle_buffer_size, rng)
+        return records
+
+    def __iter__(self) -> Iterator[TensorSpecStruct]:
+        def batches() -> Iterator[TensorSpecStruct]:
+            stream = self._record_stream()
+            while True:
+                chunk = list(itertools.islice(stream, self._batch_size))
+                if not chunk:
+                    return
+                if len(chunk) < self._batch_size and self._drop_remainder:
+                    return
+                if isinstance(chunk[0], dict):
+                    by_key = {
+                        k: [row[k] for row in chunk] for k in chunk[0].keys()
+                    }
+                    yield self._parser.parse_batch(by_key)
+                else:
+                    yield self._parser.parse_batch(chunk)
+
+        if self._prefetch_depth > 0:
+            return iter(_Prefetcher(batches(), self._prefetch_depth))
+        return batches()
+
+
+class GeneratorDataset:
+    """Batches from a python generator of per-example numpy dicts
+    (reference GeneratorInputGenerator)."""
+
+    def __init__(
+        self,
+        generator_fn: Callable[[], Iterator[Mapping[str, np.ndarray]]],
+        batch_size: int,
+        prefetch_depth: int = 1,
+    ):
+        self._generator_fn = generator_fn
+        self._batch_size = batch_size
+        self._prefetch_depth = prefetch_depth
+
+    def __iter__(self) -> Iterator[TensorSpecStruct]:
+        def batches():
+            source = self._generator_fn()
+            while True:
+                rows = list(itertools.islice(source, self._batch_size))
+                if len(rows) < self._batch_size:
+                    return
+                out = TensorSpecStruct()
+                for key in rows[0].keys():
+                    out[key] = np.stack([np.asarray(r[key]) for r in rows])
+                yield out
+
+        if self._prefetch_depth > 0:
+            return iter(_Prefetcher(batches(), self._prefetch_depth))
+        return batches()
+
+
+def weighted_interleave(
+    datasets: Sequence[RecordDataset],
+    weights: Sequence[float],
+    seed: Optional[int] = None,
+) -> Iterator[TensorSpecStruct]:
+    """Samples batches from datasets proportionally to weights (reference
+    WeightedRecordInputGenerator / sample_from_datasets)."""
+    rng = random.Random(seed)
+    iterators = [iter(d) for d in datasets]
+    total = float(sum(weights))
+    probs = [w / total for w in weights]
+    while iterators:
+        idx = rng.choices(range(len(iterators)), weights=probs, k=1)[0]
+        try:
+            yield next(iterators[idx])
+        except StopIteration:
+            del iterators[idx], probs[idx]
+            if probs:
+                s = sum(probs)
+                probs = [p / s for p in probs]

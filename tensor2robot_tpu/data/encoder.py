@@ -1,0 +1,106 @@
+"""Spec-driven Example/SequenceExample encoding — the write side.
+
+Used by the replay writer (episode sinks), golden-value fixtures, and tests.
+Inverse of data/parser.py: numpy structures conforming to a spec are
+serialized so that the generated parser round-trips them exactly.
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Any, Dict, Mapping, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from tensor2robot_tpu.proto import example_pb2
+from tensor2robot_tpu.specs import (
+    ExtendedTensorSpec,
+    TensorSpecStruct,
+    canonical_dtype,
+    flatten_spec_structure,
+)
+
+
+def encode_image(array: np.ndarray, data_format: str) -> bytes:
+    from PIL import Image
+
+    arr = np.asarray(array)
+    if arr.ndim == 3 and arr.shape[-1] == 1:
+        arr = arr[..., 0]
+    img = Image.fromarray(arr)
+    buf = io.BytesIO()
+    img.save(buf, format="JPEG" if data_format.lower() == "jpeg" else "PNG")
+    return buf.getvalue()
+
+
+def _fill_feature(feature: example_pb2.Feature, spec: ExtendedTensorSpec, value: Any) -> None:
+    if spec.data_format is not None:
+        feature.bytes_list.value.append(encode_image(value, spec.data_format))
+        return
+    arr = np.asarray(value)
+    dtype = canonical_dtype(spec.dtype)
+    if jnp.issubdtype(dtype, np.floating):
+        feature.float_list.value.extend(
+            np.asarray(arr, dtype=np.float32).ravel().tolist()
+        )
+    elif jnp.issubdtype(dtype, np.integer) or dtype == np.dtype(bool):
+        feature.int64_list.value.extend(
+            np.asarray(arr, dtype=np.int64).ravel().tolist()
+        )
+    else:
+        raise ValueError(f"Cannot encode dtype {dtype} for {spec.name!r}")
+
+
+def encode_example(
+    specs: Union[TensorSpecStruct, Mapping], values: Union[TensorSpecStruct, Mapping]
+) -> bytes:
+    """Serializes one (unbatched) spec-conforming structure.
+
+    Sequence specs expect a leading time dimension and are written to the
+    feature_lists of a SequenceExample (one Feature per step); everything
+    else lands in Example.features / SequenceExample.context.
+    """
+    flat_specs = flatten_spec_structure(specs)
+    flat_values = flatten_spec_structure(values)
+    has_sequence = any(
+        isinstance(s, ExtendedTensorSpec) and s.is_sequence
+        for s in flat_specs.values()
+    )
+    if has_sequence:
+        proto = example_pb2.SequenceExample()
+        context = proto.context
+    else:
+        proto = example_pb2.Example()
+        context = proto.features
+    for key, spec in flat_specs.items():
+        if not isinstance(spec, ExtendedTensorSpec):
+            continue
+        if key not in flat_values:
+            if spec.is_optional:
+                continue
+            raise ValueError(f"Missing value for required spec {key!r}")
+        value = flat_values[key]
+        name = spec.name or key
+        if spec.is_sequence:
+            flist = proto.feature_lists.feature_list[name]
+            for step in np.asarray(value):
+                _fill_feature(flist.feature.add(), spec, step)
+        else:
+            _fill_feature(context.feature[name], spec, value)
+    return proto.SerializeToString()
+
+
+def encode_examples_by_dataset(
+    specs: Union[TensorSpecStruct, Mapping], values: Union[TensorSpecStruct, Mapping]
+) -> Dict[str, bytes]:
+    """Multi-dataset encoding: one serialized record per dataset_key."""
+    flat_specs = flatten_spec_structure(specs)
+    groups: Dict[str, TensorSpecStruct] = {}
+    for key, spec in flat_specs.items():
+        if isinstance(spec, ExtendedTensorSpec):
+            groups.setdefault(spec.dataset_key, TensorSpecStruct())[key] = spec
+    return {
+        dataset_key: encode_example(group, values)
+        for dataset_key, group in groups.items()
+    }

@@ -1,0 +1,268 @@
+"""Input generators: the bridge from models' specs to batched data streams.
+
+An input generator holds a batch size and (after `set_specification_from_model`)
+the feature/label specs pulled from the model's preprocessor; `create_dataset`
+then yields parsed numpy batches packed as {features, labels}.
+
+Behavioral parity: tensor2robot/input_generators/abstract_input_generator.py
+and default_input_generator.py. The JAX-native difference: generators yield
+host numpy batches; device placement + on-device preprocessing happen in the
+trainer under jit (see data/dataset.py docstring for the rationale).
+"""
+
+from __future__ import annotations
+
+import abc
+import os
+from typing import Any, Callable, Iterator, Mapping, Optional, Sequence, Union
+
+import numpy as np
+
+from tensor2robot_tpu.data.dataset import (
+    GeneratorDataset,
+    RecordDataset,
+    weighted_interleave,
+)
+from tensor2robot_tpu.specs import (
+    TensorSpecStruct,
+    make_constant_numpy,
+    make_random_numpy,
+    validate_and_pack,
+)
+
+MODE_TRAIN = "train"
+MODE_EVAL = "eval"
+MODE_PREDICT = "predict"
+ALL_MODES = (MODE_TRAIN, MODE_EVAL, MODE_PREDICT)
+
+
+class AbstractInputGenerator(abc.ABC):
+    """Holds batch size + specs; produces mode-bound batch iterators."""
+
+    def __init__(self, batch_size: int = 32):
+        self._batch_size = batch_size
+        self._feature_spec: Optional[TensorSpecStruct] = None
+        self._label_spec: Optional[TensorSpecStruct] = None
+
+    @property
+    def batch_size(self) -> int:
+        return self._batch_size
+
+    @batch_size.setter
+    def batch_size(self, value: int) -> None:
+        self._batch_size = value
+
+    @property
+    def feature_spec(self) -> TensorSpecStruct:
+        if self._feature_spec is None:
+            raise ValueError(
+                "Specs not set; call set_specification_from_model first."
+            )
+        return self._feature_spec
+
+    @property
+    def label_spec(self) -> TensorSpecStruct:
+        if self._label_spec is None:
+            raise ValueError(
+                "Specs not set; call set_specification_from_model first."
+            )
+        return self._label_spec
+
+    def set_specification_from_model(self, model: Any, mode: str) -> None:
+        """Pulls the *in* specs off the model's preprocessor — the data on
+        disk must match what the preprocessor consumes (reference
+        abstract_input_generator.py:76-98)."""
+        preprocessor = model.preprocessor
+        self._feature_spec = preprocessor.get_in_feature_specification(mode)
+        self._label_spec = preprocessor.get_in_label_specification(mode)
+
+    def set_specification(
+        self, feature_spec: TensorSpecStruct, label_spec: Optional[TensorSpecStruct]
+    ) -> None:
+        self._feature_spec = feature_spec
+        self._label_spec = label_spec
+
+    def combined_spec(self) -> TensorSpecStruct:
+        spec = TensorSpecStruct()
+        for key, value in self.feature_spec.items():
+            spec[f"features/{key}"] = value
+        if self._label_spec is not None:
+            for key, value in self._label_spec.items():
+                spec[f"labels/{key}"] = value
+        return spec
+
+    def create_dataset(self, mode: str) -> Iterator[TensorSpecStruct]:
+        """Yields batches packed as struct with 'features/...' and
+        'labels/...' subtrees."""
+        if mode not in ALL_MODES:
+            raise ValueError(f"mode must be one of {ALL_MODES}, got {mode!r}")
+        return self._create_dataset(mode)
+
+    # Estimator-compatible alias (reference create_dataset_input_fn).
+    def create_dataset_input_fn(self, mode: str) -> Callable[[], Iterator[TensorSpecStruct]]:
+        return lambda: self.create_dataset(mode)
+
+    @abc.abstractmethod
+    def _create_dataset(self, mode: str) -> Iterator[TensorSpecStruct]:
+        ...
+
+
+class DefaultRecordInputGenerator(AbstractInputGenerator):
+    """Reads TFRecord shards by glob patterns or a dataset_map
+    (reference default_input_generator.py:48-101)."""
+
+    def __init__(
+        self,
+        file_patterns: Optional[Union[str, Sequence[str]]] = None,
+        dataset_map: Optional[Mapping[str, Union[str, Sequence[str]]]] = None,
+        batch_size: int = 32,
+        shuffle_buffer_size: int = 512,
+        seed: Optional[int] = None,
+        file_fraction: float = 1.0,
+        prefetch_depth: int = 2,
+    ):
+        super().__init__(batch_size=batch_size)
+        if (file_patterns is None) == (dataset_map is None):
+            raise ValueError("Provide exactly one of file_patterns or dataset_map.")
+        self._file_patterns = dataset_map if dataset_map is not None else file_patterns
+        self._shuffle_buffer_size = shuffle_buffer_size
+        self._seed = seed
+        self._file_fraction = file_fraction
+        self._prefetch_depth = prefetch_depth
+
+    def _create_dataset(self, mode: str) -> Iterator[TensorSpecStruct]:
+        dataset = RecordDataset(
+            specs=self.combined_spec(),
+            file_patterns=self._file_patterns,
+            batch_size=self._batch_size,
+            mode=mode,
+            shuffle_buffer_size=self._shuffle_buffer_size,
+            seed=self._seed,
+            file_fraction=self._file_fraction,
+            prefetch_depth=self._prefetch_depth,
+        )
+        return iter(dataset)
+
+
+class FractionalRecordInputGenerator(DefaultRecordInputGenerator):
+    """Data-ablation by file fraction (reference :105)."""
+
+    def __init__(self, file_fraction: float, **kwargs):
+        kwargs["file_fraction"] = file_fraction
+        super().__init__(**kwargs)
+
+
+class MultiEvalRecordInputGenerator(DefaultRecordInputGenerator):
+    """Picks the eval dataset by eval name from a map of datasets
+    (reference :128-140; env plumbing via T2R_MULTI_EVAL_NAME)."""
+
+    def __init__(
+        self,
+        eval_dataset_map: Mapping[str, Union[str, Sequence[str]]],
+        eval_name: Optional[str] = None,
+        **kwargs,
+    ):
+        eval_name = eval_name or os.environ.get("T2R_MULTI_EVAL_NAME")
+        if not eval_name:
+            raise ValueError(
+                "MultiEvalRecordInputGenerator requires eval_name (arg or "
+                "T2R_MULTI_EVAL_NAME env)."
+            )
+        if eval_name not in eval_dataset_map:
+            raise ValueError(
+                f"eval_name {eval_name!r} not in {sorted(eval_dataset_map)}"
+            )
+        super().__init__(file_patterns=eval_dataset_map[eval_name], **kwargs)
+        self.eval_name = eval_name
+
+
+class WeightedRecordInputGenerator(AbstractInputGenerator):
+    """Samples batches from several record sources with given weights
+    (reference :229-314)."""
+
+    def __init__(
+        self,
+        file_patterns: Sequence[Union[str, Sequence[str]]],
+        weights: Optional[Sequence[float]] = None,
+        batch_size: int = 32,
+        seed: Optional[int] = None,
+        **kwargs,
+    ):
+        super().__init__(batch_size=batch_size)
+        self._sources = list(file_patterns)
+        self._weights = list(weights) if weights else [1.0] * len(self._sources)
+        if len(self._weights) != len(self._sources):
+            raise ValueError("weights and file_patterns must align")
+        self._seed = seed
+        self._kwargs = kwargs
+
+    def _create_dataset(self, mode: str) -> Iterator[TensorSpecStruct]:
+        datasets = [
+            RecordDataset(
+                specs=self.combined_spec(),
+                file_patterns=patterns,
+                batch_size=self._batch_size,
+                mode=mode,
+                seed=self._seed,
+                **self._kwargs,
+            )
+            for patterns in self._sources
+        ]
+        return weighted_interleave(datasets, self._weights, seed=self._seed)
+
+
+class GeneratorInputGenerator(AbstractInputGenerator):
+    """Batches from a user python generator producing per-example dicts
+    keyed like the combined spec (reference :143-193)."""
+
+    def __init__(
+        self,
+        generator_fn: Callable[[], Iterator[Mapping[str, np.ndarray]]],
+        batch_size: int = 32,
+    ):
+        super().__init__(batch_size=batch_size)
+        self._generator_fn = generator_fn
+
+    def _create_dataset(self, mode: str) -> Iterator[TensorSpecStruct]:
+        dataset = GeneratorDataset(self._generator_fn, self._batch_size)
+        for batch in dataset:
+            yield validate_and_pack(self.combined_spec(), batch, ignore_batch=True)
+
+
+class DefaultRandomInputGenerator(AbstractInputGenerator):
+    """Spec-conforming random batches — test/data-free debugging source
+    (reference :197)."""
+
+    def __init__(self, batch_size: int = 32, sequence_length: int = 3, seed: int = 0):
+        super().__init__(batch_size=batch_size)
+        self._sequence_length = sequence_length
+        self._seed = seed
+
+    def _create_dataset(self, mode: str) -> Iterator[TensorSpecStruct]:
+        step = 0
+        while True:
+            yield make_random_numpy(
+                self.combined_spec(),
+                batch_size=self._batch_size,
+                sequence_length=self._sequence_length,
+                seed=self._seed + step,
+            )
+            step += 1
+
+
+class DefaultConstantInputGenerator(AbstractInputGenerator):
+    """Spec-conforming constant batches (reference :210)."""
+
+    def __init__(self, constant_value: float, batch_size: int = 32, sequence_length: int = 3):
+        super().__init__(batch_size=batch_size)
+        self._constant_value = constant_value
+        self._sequence_length = sequence_length
+
+    def _create_dataset(self, mode: str) -> Iterator[TensorSpecStruct]:
+        while True:
+            yield make_constant_numpy(
+                self.combined_spec(),
+                constant_value=self._constant_value,
+                batch_size=self._batch_size,
+                sequence_length=self._sequence_length,
+            )

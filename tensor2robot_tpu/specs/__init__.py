@@ -7,6 +7,14 @@ from tensor2robot_tpu.specs.spec import (
     is_leaf,
 )
 from tensor2robot_tpu.specs.struct import TensorSpecStruct
+from tensor2robot_tpu.specs.proto_io import (
+    read_t2r_assets,
+    spec_from_proto,
+    spec_to_proto,
+    struct_from_proto,
+    struct_to_proto,
+    write_t2r_assets,
+)
 from tensor2robot_tpu.specs.utils import (
     add_sequence_length_specs,
     assert_equal,

@@ -1,0 +1,115 @@
+"""Spec <-> proto (de)serialization and the T2RAssets sidecar.
+
+Every exported model ships `assets.extra/t2r_assets.pbtxt` holding its
+feature/label specs + global step, so predictors reconstruct the input
+contract without model code (reference utils/tensorspec_utils.py:178-216,
+411-436, 1685-1733 and proto/t2r.proto).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+from google.protobuf import text_format
+
+from tensor2robot_tpu.proto import t2r_pb2
+from tensor2robot_tpu.specs.spec import ExtendedTensorSpec, canonical_dtype
+from tensor2robot_tpu.specs.struct import TensorSpecStruct
+from tensor2robot_tpu.specs.utils import flatten_spec_structure
+
+T2R_ASSETS_FILENAME = "t2r_assets.pbtxt"
+ASSETS_EXTRA_DIR = "assets.extra"
+
+
+def spec_to_proto(spec: ExtendedTensorSpec) -> t2r_pb2.ExtendedTensorSpecProto:
+    proto = t2r_pb2.ExtendedTensorSpecProto()
+    proto.shape.extend(-1 if d is None else int(d) for d in spec.shape)
+    proto.dtype = np.dtype(spec.dtype).name
+    if spec.name:
+        proto.name = spec.name
+    proto.is_optional = spec.is_optional
+    proto.is_extracted = spec.is_extracted
+    proto.is_sequence = spec.is_sequence
+    if spec.data_format:
+        proto.data_format = spec.data_format
+    if spec.dataset_key:
+        proto.dataset_key = spec.dataset_key
+    if spec.varlen_default_value is not None:
+        proto.has_varlen_default_value = True
+        proto.varlen_default_value = float(spec.varlen_default_value)
+    return proto
+
+
+def spec_from_proto(proto: t2r_pb2.ExtendedTensorSpecProto) -> ExtendedTensorSpec:
+    return ExtendedTensorSpec(
+        shape=tuple(None if d == -1 else int(d) for d in proto.shape),
+        dtype=canonical_dtype(proto.dtype),
+        name=proto.name or None,
+        is_optional=proto.is_optional,
+        is_extracted=proto.is_extracted,
+        is_sequence=proto.is_sequence,
+        data_format=proto.data_format or None,
+        dataset_key=proto.dataset_key,
+        varlen_default_value=(
+            proto.varlen_default_value if proto.has_varlen_default_value else None
+        ),
+    )
+
+
+def struct_to_proto(structure) -> t2r_pb2.TensorSpecStructProto:
+    flat = flatten_spec_structure(structure)
+    proto = t2r_pb2.TensorSpecStructProto()
+    for key, spec in flat.items():
+        if not isinstance(spec, ExtendedTensorSpec):
+            raise ValueError(f"Only spec structures serialize; {key!r} is not a spec.")
+        proto.keys.append(key)
+        proto.key_value[key].CopyFrom(spec_to_proto(spec))
+    return proto
+
+
+def struct_from_proto(proto: t2r_pb2.TensorSpecStructProto) -> TensorSpecStruct:
+    out = TensorSpecStruct()
+    keys = list(proto.keys) or sorted(proto.key_value.keys())
+    for key in keys:
+        out[key] = spec_from_proto(proto.key_value[key])
+    return out
+
+
+def write_t2r_assets(
+    export_dir: str,
+    feature_spec,
+    label_spec=None,
+    global_step: int = 0,
+) -> str:
+    """Writes assets.extra/t2r_assets.pbtxt under `export_dir`; returns path."""
+    assets = t2r_pb2.T2RAssets()
+    assets.feature_spec.CopyFrom(struct_to_proto(feature_spec))
+    if label_spec is not None:
+        assets.label_spec.CopyFrom(struct_to_proto(label_spec))
+    assets.global_step = int(global_step)
+    assets_dir = os.path.join(export_dir, ASSETS_EXTRA_DIR)
+    os.makedirs(assets_dir, exist_ok=True)
+    path = os.path.join(assets_dir, T2R_ASSETS_FILENAME)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(text_format.MessageToString(assets))
+    os.replace(tmp, path)
+    return path
+
+
+def read_t2r_assets(
+    export_dir: str,
+) -> Tuple[TensorSpecStruct, Optional[TensorSpecStruct], int]:
+    """Reads the sidecar; returns (feature_spec, label_spec, global_step)."""
+    path = os.path.join(export_dir, ASSETS_EXTRA_DIR, T2R_ASSETS_FILENAME)
+    with open(path) as f:
+        assets = text_format.Parse(f.read(), t2r_pb2.T2RAssets())
+    feature_spec = struct_from_proto(assets.feature_spec)
+    label_spec = (
+        struct_from_proto(assets.label_spec)
+        if assets.HasField("label_spec") and len(assets.label_spec.key_value)
+        else None
+    )
+    return feature_spec, label_spec, int(assets.global_step)
