@@ -36,7 +36,17 @@ def encode_image(array: np.ndarray, data_format: str) -> bytes:
 
 def _fill_feature(feature: example_pb2.Feature, spec: ExtendedTensorSpec, value: Any) -> None:
     if spec.data_format is not None:
-        feature.bytes_list.value.append(encode_image(value, spec.data_format))
+        arr = np.asarray(value)
+        if arr.ndim >= 4:
+            # Image stacks (camera arrays / varlen image lists): one encoded
+            # bytes entry per leading-dim image, the layout the parser's
+            # multi-image path consumes.
+            for image in arr:
+                feature.bytes_list.value.append(
+                    encode_image(image, spec.data_format)
+                )
+        else:
+            feature.bytes_list.value.append(encode_image(arr, spec.data_format))
         return
     arr = np.asarray(value)
     dtype = canonical_dtype(spec.dtype)

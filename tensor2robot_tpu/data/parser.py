@@ -132,8 +132,19 @@ class _FieldParser:
                 )
                 images = images + [zero] * (target - len(images))
                 return np.stack(images)
-            if len(images) == 1 and len(spec.shape) <= 3:
+            if len(spec.shape) <= 3:
+                if len(images) != 1:
+                    raise ValueError(
+                        f"Feature {self.lookup_name!r} holds {len(images)} "
+                        "images but the spec declares a single image "
+                        f"{tuple(spec.shape)}"
+                    )
                 return images[0]
+            if spec.shape[0] is not None and len(images) != spec.shape[0]:
+                raise ValueError(
+                    f"Feature {self.lookup_name!r} holds {len(images)} images "
+                    f"but the spec stack requires {spec.shape[0]}"
+                )
             return np.stack(images)
         if kind != self.kind:
             raise ValueError(

@@ -203,19 +203,41 @@ def index_tfrecord_buffer(
 
 
 def read_tfrecords(path: str, verify_crc: bool = True) -> Iterator[bytes]:
-    """Yields record payloads from a TFRecord file."""
+    """Streams record payloads from a TFRecord file with bounded memory.
+
+    Reads header-then-payload per record (multi-GB episode shards must not be
+    slurped whole — the interleaver holds several of these open at once).
+    """
     with open(path, "rb") as f:
-        buf = f.read()
-    offsets, lengths = index_tfrecord_buffer(buf, verify_crc=verify_crc)
-    for off, length in zip(offsets.tolist(), lengths.tolist()):
-        yield buf[int(off) : int(off) + int(length)]
+        pos = 0
+        while True:
+            header = f.read(12)
+            if not header:
+                return
+            if len(header) < 12:
+                raise TFRecordCorruptionError(f"Truncated record header at {pos}")
+            (length,) = struct.unpack_from("<Q", header, 0)
+            (header_crc,) = struct.unpack_from("<I", header, 8)
+            if masked_crc32c(header[:8]) != header_crc:
+                raise TFRecordCorruptionError(f"Bad header CRC at {pos}")
+            if length > (1 << 40):
+                # Guard absurd lengths before allocating (corrupt length
+                # fields otherwise turn into OOM instead of a clean error).
+                raise TFRecordCorruptionError(f"Implausible record length at {pos}")
+            payload = f.read(length + 4)
+            if len(payload) < length + 4:
+                raise TFRecordCorruptionError(f"Truncated record payload at {pos}")
+            record = payload[:length]
+            if verify_crc:
+                (payload_crc,) = struct.unpack_from("<I", payload, length)
+                if masked_crc32c(record) != payload_crc:
+                    raise TFRecordCorruptionError(f"Bad payload CRC at {pos}")
+            yield record
+            pos += 12 + length + 4
 
 
 def count_tfrecords(path: str) -> int:
-    with open(path, "rb") as f:
-        buf = f.read()
-    offsets, _ = index_tfrecord_buffer(buf, verify_crc=False)
-    return len(offsets)
+    return sum(1 for _ in read_tfrecords(path, verify_crc=False))
 
 
 def list_files(file_patterns: Sequence[str] | str) -> List[str]:

@@ -117,7 +117,10 @@ int64_t t2r_index_records(const uint8_t* buf, size_t n, uint64_t* offsets,
     if (Mask(Crc32cUpdate(0, buf + pos, 8)) != len_crc) {
       return -(int64_t)(pos + 1);
     }
-    if (pos + 12 + len + 4 > n) return -(int64_t)(pos + 1);
+    // Overflow-safe bounds check: a corrupt length near 2^64 must report
+    // corruption, not wrap around and read out of bounds.
+    size_t remaining = n - (pos + 12);
+    if (remaining < 4 || len > remaining - 4) return -(int64_t)(pos + 1);
     if (verify_crc) {
       uint32_t data_crc = ReadU32(buf + pos + 12 + len);
       if (Mask(Crc32cUpdate(0, buf + pos + 12, len)) != data_crc) {

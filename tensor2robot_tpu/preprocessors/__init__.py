@@ -1,0 +1,10 @@
+"""Preprocessors: validated per-batch transforms, jittable and device-placed."""
+
+from tensor2robot_tpu.preprocessors.abstract_preprocessor import (
+    AbstractPreprocessor,
+    NoOpPreprocessor,
+    SpecTransformationPreprocessor,
+)
+from tensor2robot_tpu.preprocessors.dtype_policy import TPUPreprocessorWrapper
+from tensor2robot_tpu.preprocessors import image_transformations
+from tensor2robot_tpu.preprocessors import distortion

@@ -1,0 +1,173 @@
+"""Preprocessor abstraction: per-batch transforms between parsed data and the
+model.
+
+A preprocessor declares four specs — what it consumes (`in`) and what it
+produces (`out`), for features and labels — and a pure `_preprocess_fn`.
+The public `preprocess` validates+packs its inputs, applies the transform,
+and validates+flattens the outputs, so models always see exactly their
+declared contract (reference preprocessors/abstract_preprocessor.py:29-218).
+
+TPU-first design: `_preprocess_fn` is a *pure jittable function* taking an
+explicit `jax.random` key. The trainer composes it with the model step under
+one jit, so crops/distortions/casts fuse into the device program and the
+host feeds raw (small, uint8) tensors — the opposite placement from the
+reference's host-side tf.data maps, chosen for infeed bandwidth.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Optional, Tuple
+
+import jax
+
+from tensor2robot_tpu.specs import (
+    TensorSpecStruct,
+    validate_and_flatten,
+    validate_and_pack,
+)
+
+MODE_TRAIN = "train"
+MODE_EVAL = "eval"
+MODE_PREDICT = "predict"
+ALL_MODES = (MODE_TRAIN, MODE_EVAL, MODE_PREDICT)
+
+
+class AbstractPreprocessor(abc.ABC):
+    """Base preprocessor; subclasses override the 4 spec getters and
+    `_preprocess_fn`."""
+
+    def __init__(self, model_spec_provider: Optional[Any] = None):
+        # When constructed against a model, validate that the model exposes
+        # specs for all modes up front (reference :60-66 does the same).
+        if model_spec_provider is not None:
+            for mode in (MODE_TRAIN, MODE_EVAL):
+                model_spec_provider.get_feature_specification(mode)
+                model_spec_provider.get_label_specification(mode)
+        self._model = model_spec_provider
+
+    # -- spec contract --------------------------------------------------------
+
+    @abc.abstractmethod
+    def get_in_feature_specification(self, mode: str) -> TensorSpecStruct:
+        """Spec of the features this preprocessor consumes (what's on disk)."""
+
+    @abc.abstractmethod
+    def get_in_label_specification(self, mode: str) -> TensorSpecStruct:
+        """Spec of the labels this preprocessor consumes."""
+
+    @abc.abstractmethod
+    def get_out_feature_specification(self, mode: str) -> TensorSpecStruct:
+        """Spec of the features this preprocessor produces (= model in-spec)."""
+
+    @abc.abstractmethod
+    def get_out_label_specification(self, mode: str) -> TensorSpecStruct:
+        """Spec of the labels this preprocessor produces."""
+
+    # -- transform ------------------------------------------------------------
+
+    @abc.abstractmethod
+    def _preprocess_fn(
+        self,
+        features: TensorSpecStruct,
+        labels: Optional[TensorSpecStruct],
+        mode: str,
+        rng: Optional[jax.Array],
+    ) -> Tuple[TensorSpecStruct, Optional[TensorSpecStruct]]:
+        """The pure transform. Must be jit-traceable (no python branching on
+        tensor values; randomness via the explicit `rng` key)."""
+
+    def preprocess(
+        self,
+        features,
+        labels=None,
+        mode: str = MODE_TRAIN,
+        rng: Optional[jax.Array] = None,
+    ) -> Tuple[TensorSpecStruct, Optional[TensorSpecStruct]]:
+        """Validated transform: pack(in-spec) -> _preprocess_fn ->
+        flatten(out-spec) (reference :172-218)."""
+        if mode not in ALL_MODES:
+            raise ValueError(f"mode must be one of {ALL_MODES}, got {mode!r}")
+        packed_features = validate_and_pack(
+            self.get_in_feature_specification(mode), features, ignore_batch=True
+        )
+        packed_labels = None
+        if labels is not None:
+            packed_labels = validate_and_pack(
+                self.get_in_label_specification(mode), labels, ignore_batch=True
+            )
+        out_features, out_labels = self._preprocess_fn(
+            packed_features, packed_labels, mode, rng
+        )
+        out_features = validate_and_flatten(
+            self.get_out_feature_specification(mode), out_features,
+            ignore_batch=True,
+        )
+        if out_labels is not None:
+            out_labels = validate_and_flatten(
+                self.get_out_label_specification(mode), out_labels,
+                ignore_batch=True,
+            )
+        return out_features, out_labels
+
+
+class NoOpPreprocessor(AbstractPreprocessor):
+    """Identity: in == out == the model's specs
+    (reference noop_preprocessor.py:27)."""
+
+    def __init__(self, model_spec_provider: Any):
+        super().__init__(model_spec_provider)
+
+    def get_in_feature_specification(self, mode: str) -> TensorSpecStruct:
+        return self._model.get_feature_specification(mode)
+
+    def get_in_label_specification(self, mode: str) -> TensorSpecStruct:
+        return self._model.get_label_specification(mode)
+
+    def get_out_feature_specification(self, mode: str) -> TensorSpecStruct:
+        return self._model.get_feature_specification(mode)
+
+    def get_out_label_specification(self, mode: str) -> TensorSpecStruct:
+        return self._model.get_label_specification(mode)
+
+    def _preprocess_fn(self, features, labels, mode, rng):
+        return features, labels
+
+
+class SpecTransformationPreprocessor(NoOpPreprocessor):
+    """Convenience base: identity transform with rewritten *in* specs.
+
+    Override `_transform_in_feature_specification` (and/or label variant) to
+    declare a different on-disk representation — e.g. a uint8 jpeg source for
+    a float32 model input — then implement `_preprocess_fn` for the value
+    conversion (reference spec_transformation_preprocessor.py:25-174).
+    """
+
+    def get_in_feature_specification(self, mode: str) -> TensorSpecStruct:
+        return self._transform_in_feature_specification(
+            self._model.get_feature_specification(mode).copy(), mode
+        )
+
+    def get_in_label_specification(self, mode: str) -> TensorSpecStruct:
+        return self._transform_in_label_specification(
+            self._model.get_label_specification(mode).copy(), mode
+        )
+
+    def _transform_in_feature_specification(
+        self, spec: TensorSpecStruct, mode: str
+    ) -> TensorSpecStruct:
+        return spec
+
+    def _transform_in_label_specification(
+        self, spec: TensorSpecStruct, mode: str
+    ) -> TensorSpecStruct:
+        return spec
+
+    @staticmethod
+    def update_spec(spec_struct: TensorSpecStruct, key: str, **overrides) -> None:
+        """In-place spec rewrite helper (reference update_spec :46-63)."""
+        from tensor2robot_tpu.specs import ExtendedTensorSpec
+
+        spec_struct[key] = ExtendedTensorSpec.from_spec(
+            spec_struct[key], **overrides
+        )

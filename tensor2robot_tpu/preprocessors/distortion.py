@@ -1,0 +1,18 @@
+"""Train-time image distortion helpers (reference preprocessors/distortion.py).
+
+Thin aliases over image_transformations for call-site parity.
+"""
+
+from tensor2robot_tpu.preprocessors.image_transformations import (
+    crop_image_batch as crop_image,
+    maybe_distort_image_batch,
+    preprocess_image,
+    resize_image_batch,
+)
+
+__all__ = [
+    "crop_image",
+    "maybe_distort_image_batch",
+    "preprocess_image",
+    "resize_image_batch",
+]

@@ -1,0 +1,102 @@
+"""TPU dtype-policy preprocessor wrapper: the bfloat16 infeed contract.
+
+Wraps any preprocessor so that, on TPU:
+  * its *in* specs re-declare bfloat16 features as float32 — the host pipeline
+    always produces float32 (bf16 has no on-disk form),
+  * its *out* specs re-declare float32 as bfloat16 and *drop optional
+    tensors* — halving infeed bandwidth and stripping anything the model
+    doesn't strictly need,
+  * `_preprocess_fn` delegates to the wrapped preprocessor then filters +
+    casts the results.
+
+Behavioral parity: tensor2robot/preprocessors/tpu_preprocessor_wrapper.py:33-156.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+
+from tensor2robot_tpu.preprocessors.abstract_preprocessor import (
+    AbstractPreprocessor,
+)
+from tensor2robot_tpu.specs import (
+    TensorSpecStruct,
+    cast_bfloat16_to_float32,
+    cast_float32_to_bfloat16,
+    cast_tensors,
+    filter_required_flat_tensor_spec,
+    flatten_spec_structure,
+)
+import jax.numpy as jnp
+import numpy as np
+
+
+class TPUPreprocessorWrapper(AbstractPreprocessor):
+    """Decorates `preprocessor` with the TPU bf16 + strip-optional policy."""
+
+    def __init__(self, preprocessor: AbstractPreprocessor):
+        super().__init__(model_spec_provider=None)
+        self._preprocessor = preprocessor
+
+    @property
+    def wrapped(self) -> AbstractPreprocessor:
+        return self._preprocessor
+
+    # In-specs: bf16 -> f32 (host side produces f32; reference :74-102).
+    def get_in_feature_specification(self, mode: str) -> TensorSpecStruct:
+        return cast_bfloat16_to_float32(
+            self._preprocessor.get_in_feature_specification(mode)
+        )
+
+    def get_in_label_specification(self, mode: str) -> TensorSpecStruct:
+        return cast_bfloat16_to_float32(
+            self._preprocessor.get_in_label_specification(mode)
+        )
+
+    # Out-specs: f32 -> bf16 AND optional stripped (reference :104-140).
+    def get_out_feature_specification(self, mode: str) -> TensorSpecStruct:
+        return cast_float32_to_bfloat16(
+            filter_required_flat_tensor_spec(
+                self._preprocessor.get_out_feature_specification(mode)
+            )
+        )
+
+    def get_out_label_specification(self, mode: str) -> TensorSpecStruct:
+        return cast_float32_to_bfloat16(
+            filter_required_flat_tensor_spec(
+                self._preprocessor.get_out_label_specification(mode)
+            )
+        )
+
+    def _preprocess_fn(
+        self,
+        features: TensorSpecStruct,
+        labels: Optional[TensorSpecStruct],
+        mode: str,
+        rng: Optional[jax.Array],
+    ) -> Tuple[TensorSpecStruct, Optional[TensorSpecStruct]]:
+        # The wrapped preprocessor runs at its own (f32-in) contract: its in
+        # specs may declare bf16, but values arriving here are f32, which the
+        # wrapped _preprocess_fn consumes directly (casts are egress-side).
+        out_features, out_labels = self._preprocessor._preprocess_fn(
+            features, labels, mode, rng
+        )
+        out_features = self._filter_and_cast(
+            out_features, self.get_out_feature_specification(mode)
+        )
+        if out_labels is not None:
+            out_labels = self._filter_and_cast(
+                out_labels, self.get_out_label_specification(mode)
+            )
+        return out_features, out_labels
+
+    @staticmethod
+    def _filter_and_cast(tensors, out_spec: TensorSpecStruct) -> TensorSpecStruct:
+        flat = flatten_spec_structure(tensors)
+        filtered = TensorSpecStruct()
+        for key in out_spec.keys():
+            if key in flat:
+                filtered[key] = flat[key]
+        return cast_tensors(filtered, np.float32, jnp.bfloat16)

@@ -1,0 +1,287 @@
+"""Image transformations as pure jittable JAX functions.
+
+Crops and photometric distortions used by the robotic-vision preprocessors
+(behavioral parity: tensor2robot/preprocessors/image_transformations.py).
+Everything takes explicit `jax.random` keys and runs on-device under jit,
+where XLA fuses the elementwise work into adjacent ops; batches distort
+per-image with vmapped independent keys.
+
+Images are float32 in [0, 1] unless stated otherwise.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _check_crop(image_shape, target_shape) -> None:
+    h, w = int(image_shape[-3]), int(image_shape[-2])
+    th, tw = int(target_shape[0]), int(target_shape[1])
+    if th > h or tw > w:
+        raise ValueError(
+            f"Crop {target_shape} larger than image {(h, w)}."
+        )
+
+
+def random_crop_image_batch(
+    rng: jax.Array, images: jax.Array, target_shape: Sequence[int]
+) -> jax.Array:
+    """Randomly crops a [B, H, W, C] batch to [B, th, tw, C].
+
+    One random offset per batch element (reference RandomCropImages :26).
+    Uses dynamic_slice so the offsets can be traced values.
+    """
+    _check_crop(images.shape, target_shape)
+    th, tw = int(target_shape[0]), int(target_shape[1])
+    b, h, w = images.shape[0], images.shape[1], images.shape[2]
+    key_y, key_x = jax.random.split(rng)
+    ys = jax.random.randint(key_y, (b,), 0, h - th + 1)
+    xs = jax.random.randint(key_x, (b,), 0, w - tw + 1)
+
+    def crop_one(image, y, x):
+        return jax.lax.dynamic_slice(
+            image, (y, x, 0), (th, tw, image.shape[-1])
+        )
+
+    return jax.vmap(crop_one)(images, ys, xs)
+
+
+def center_crop_image_batch(
+    images: jax.Array, target_shape: Sequence[int]
+) -> jax.Array:
+    """Deterministic center crop (reference CenterCropImages :63)."""
+    _check_crop(images.shape, target_shape)
+    th, tw = int(target_shape[0]), int(target_shape[1])
+    h, w = images.shape[-3], images.shape[-2]
+    y = (h - th) // 2
+    x = (w - tw) // 2
+    return images[..., y : y + th, x : x + tw, :]
+
+
+def custom_crop_image_batch(
+    images: jax.Array, y: int, x: int, target_shape: Sequence[int]
+) -> jax.Array:
+    """Fixed-offset crop (reference CustomCropImages :105)."""
+    _check_crop(images.shape, target_shape)
+    th, tw = int(target_shape[0]), int(target_shape[1])
+    return images[..., y : y + th, x : x + tw, :]
+
+
+# -- photometric distortions --------------------------------------------------
+
+
+def _rgb_to_hsv(rgb: jax.Array) -> jax.Array:
+    r, g, b = rgb[..., 0], rgb[..., 1], rgb[..., 2]
+    maxc = jnp.maximum(jnp.maximum(r, g), b)
+    minc = jnp.minimum(jnp.minimum(r, g), b)
+    v = maxc
+    delta = maxc - minc
+    s = jnp.where(maxc > 0, delta / jnp.maximum(maxc, 1e-12), 0.0)
+    safe_delta = jnp.maximum(delta, 1e-12)
+    rc = (maxc - r) / safe_delta
+    gc = (maxc - g) / safe_delta
+    bc = (maxc - b) / safe_delta
+    h = jnp.where(
+        maxc == r, bc - gc, jnp.where(maxc == g, 2.0 + rc - bc, 4.0 + gc - rc)
+    )
+    h = jnp.where(delta == 0.0, 0.0, (h / 6.0) % 1.0)
+    return jnp.stack([h, s, v], axis=-1)
+
+
+def _hsv_to_rgb(hsv: jax.Array) -> jax.Array:
+    h, s, v = hsv[..., 0], hsv[..., 1], hsv[..., 2]
+    i = jnp.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1.0 - s)
+    q = v * (1.0 - s * f)
+    t = v * (1.0 - s * (1.0 - f))
+    i = i.astype(jnp.int32) % 6
+    r = jnp.choose(i, [v, q, p, p, t, v], mode="clip")
+    g = jnp.choose(i, [t, v, v, q, p, p], mode="clip")
+    b = jnp.choose(i, [p, p, t, v, v, q], mode="clip")
+    return jnp.stack([r, g, b], axis=-1)
+
+
+def adjust_brightness(image: jax.Array, delta: jax.Array) -> jax.Array:
+    return image + delta
+
+
+def adjust_contrast(image: jax.Array, factor: jax.Array) -> jax.Array:
+    mean = jnp.mean(image, axis=(-3, -2), keepdims=True)
+    return (image - mean) * factor + mean
+
+
+def adjust_saturation(image: jax.Array, factor: jax.Array) -> jax.Array:
+    gray = jnp.mean(image, axis=-1, keepdims=True)
+    return gray + (image - gray) * factor
+
+
+def adjust_hue(image: jax.Array, delta: jax.Array) -> jax.Array:
+    hsv = _rgb_to_hsv(jnp.clip(image, 0.0, 1.0))
+    h = (hsv[..., 0] + delta) % 1.0
+    return _hsv_to_rgb(jnp.stack([h, hsv[..., 1], hsv[..., 2]], axis=-1))
+
+
+def apply_photometric_image_distortions(
+    rng: jax.Array,
+    images: jax.Array,
+    max_delta_brightness: float = 32.0 / 255.0,
+    lower_saturation: float = 0.5,
+    upper_saturation: float = 1.5,
+    max_delta_hue: float = 0.2,
+    lower_contrast: float = 0.5,
+    upper_contrast: float = 1.5,
+    noise_stddev: float = 0.0,
+    random_order: bool = False,
+) -> jax.Array:
+    """Random brightness/saturation/hue/contrast + optional pixel noise,
+    independently per batch element (reference
+    ApplyPhotometricImageDistortions :177).
+
+    `random_order` shuffles the op order per image via a branch over the
+    4! permutations (small lax.switch — XLA-friendly).
+    """
+
+    def distort_one(rng, image):
+        k_b, k_s, k_h, k_c, k_n, k_o = jax.random.split(rng, 6)
+        ops = [
+            lambda im: adjust_brightness(
+                im,
+                jax.random.uniform(
+                    k_b, (), minval=-max_delta_brightness,
+                    maxval=max_delta_brightness,
+                ),
+            ),
+            lambda im: adjust_saturation(
+                im,
+                jax.random.uniform(
+                    k_s, (), minval=lower_saturation, maxval=upper_saturation
+                ),
+            ),
+            lambda im: adjust_hue(
+                im,
+                jax.random.uniform(
+                    k_h, (), minval=-max_delta_hue, maxval=max_delta_hue
+                ),
+            ),
+            lambda im: adjust_contrast(
+                im,
+                jax.random.uniform(
+                    k_c, (), minval=lower_contrast, maxval=upper_contrast
+                ),
+            ),
+        ]
+        if random_order:
+            # Cyclic rotations of the op order: 4 branches instead of 4! = 24,
+            # keeping lax.switch compile time bounded while still decorrelating
+            # op-order artifacts across images (the point of the reference's
+            # shuffled order).
+            perms = [tuple((i + s) % 4 for i in range(4)) for s in range(4)]
+
+            def apply_perm(perm):
+                def fn(im):
+                    for idx in perm:
+                        im = ops[idx](im)
+                    return jnp.clip(im, 0.0, 1.0)
+
+                return fn
+
+            branch = jax.random.randint(k_o, (), 0, len(perms))
+            image = jax.lax.switch(branch, [apply_perm(p) for p in perms], image)
+        else:
+            for op in ops:
+                image = op(image)
+            image = jnp.clip(image, 0.0, 1.0)
+        if noise_stddev > 0.0:
+            image = image + noise_stddev * jax.random.normal(k_n, image.shape)
+            image = jnp.clip(image, 0.0, 1.0)
+        return image
+
+    keys = jax.random.split(rng, images.shape[0])
+    return jax.vmap(distort_one)(keys, images)
+
+
+def apply_depth_image_distortions(
+    rng: jax.Array,
+    depth_images: jax.Array,
+    noise_stddev: float = 0.02,
+    clip_min: float = 0.0,
+    clip_max: float = 1.0,
+) -> jax.Array:
+    """Per-pixel gaussian noise on depth maps (reference
+    ApplyDepthImageDistortions :389)."""
+    noise = noise_stddev * jax.random.normal(rng, depth_images.shape)
+    return jnp.clip(depth_images + noise, clip_min, clip_max)
+
+
+# -- composite helpers (reference preprocessors/distortion.py) ---------------
+
+
+def maybe_distort_image_batch(
+    rng: Optional[jax.Array], images: jax.Array, mode: str, **distortion_kwargs
+) -> jax.Array:
+    """Distorts only in train mode (reference distortion.py:22)."""
+    if mode != "train" or rng is None:
+        return images
+    return apply_photometric_image_distortions(rng, images, **distortion_kwargs)
+
+
+def crop_image_batch(
+    rng: Optional[jax.Array],
+    images: jax.Array,
+    target_shape: Sequence[int],
+    mode: str,
+) -> jax.Array:
+    """Random crop when training, center crop otherwise
+    (reference distortion.py:92)."""
+    if mode == "train" and rng is not None:
+        return random_crop_image_batch(rng, images, target_shape)
+    return center_crop_image_batch(images, target_shape)
+
+
+def resize_image_batch(images: jax.Array, target_shape: Sequence[int]) -> jax.Array:
+    """Bilinear resize of [B, H, W, C] (or [..., H, W, C]) images."""
+    th, tw = int(target_shape[0]), int(target_shape[1])
+    out_shape = images.shape[:-3] + (th, tw, images.shape[-1])
+    return jax.image.resize(images, out_shape, method="bilinear")
+
+
+def preprocess_image(
+    images: jax.Array,
+    mode: str,
+    rng: Optional[jax.Array] = None,
+    is_training: Optional[bool] = None,
+    crop_size: Optional[Sequence[int]] = None,
+    target_size: Optional[Sequence[int]] = None,
+    distort: bool = False,
+    **distortion_kwargs,
+) -> jax.Array:
+    """uint8 -> float[0,1] -> crop -> distort(train) -> resize — the standard
+    vision-model ingest (reference distortion.py:38 preprocess_image).
+
+    Handles 4D [B,H,W,C] and 5D [B,T,H,W,C] batches: 5D folds time into the
+    batch for spatially-uniform treatment, then restores it.
+    """
+    del is_training  # mode is authoritative; kept for call-site parity
+    original_shape = images.shape
+    if images.ndim == 5:
+        images = images.reshape((-1,) + images.shape[2:])
+    if images.dtype == jnp.uint8:
+        images = images.astype(jnp.float32) / 255.0
+    rng_crop = rng_distort = None
+    if rng is not None:
+        rng_crop, rng_distort = jax.random.split(rng)
+    if crop_size is not None:
+        images = crop_image_batch(rng_crop, images, crop_size, mode)
+    if distort and mode == "train" and rng_distort is not None:
+        images = apply_photometric_image_distortions(
+            rng_distort, images, **distortion_kwargs
+        )
+    if target_size is not None:
+        images = resize_image_batch(images, target_size)
+    if len(original_shape) == 5:
+        images = images.reshape(original_shape[:2] + images.shape[1:])
+    return images

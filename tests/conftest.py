@@ -3,15 +3,29 @@
 Multi-chip TPU hardware is not available in CI; sharding semantics are
 validated on XLA's host platform with 8 virtual devices, which exercises the
 same GSPMD partitioner and collective lowering paths as a real TPU slice.
+
+Note: this image's sitecustomize imports jax at interpreter startup, so env
+vars set here are too late for jax's config — we must go through
+jax.config.update (safe as long as no backend has been initialized yet,
+which holds at conftest-import time).
 """
 
 import os
 
-# Must be set before jax is imported anywhere.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_sessionstart(session):
+    devices = jax.devices()
+    assert devices[0].platform == "cpu", (
+        f"Tests must run on the virtual CPU mesh, got {devices[0]}"
+    )
+    assert len(devices) == 8, f"Expected 8 virtual devices, got {len(devices)}"

@@ -370,3 +370,38 @@ class TestMultiDatasetZip:
         np.testing.assert_array_equal(
             batches[0]["b"] - batches[0]["a"], np.full((2, 1), 10.0)
         )
+
+
+class TestHardening:
+    def test_huge_length_field_reports_corruption(self, tmp_path):
+        """A crafted length of ~2^64 must raise, not crash (overflow guard)."""
+        import struct as structlib
+
+        from tensor2robot_tpu.data.tfrecord import (
+            index_tfrecord_buffer, masked_crc32c,
+        )
+        header = structlib.pack("<Q", (1 << 64) - 16)
+        buf = header + structlib.pack("<I", masked_crc32c(header)) + b"x" * 32
+        with pytest.raises(tfrecord.TFRecordCorruptionError):
+            index_tfrecord_buffer(buf)
+        with pytest.raises(tfrecord.TFRecordCorruptionError):
+            list(tfrecord.read_tfrecords(bytes_path(tmp_path, buf)))
+
+    def test_image_stack_roundtrip(self):
+        spec = {"imgs": ExtendedTensorSpec(shape=(2, 4, 4, 3), dtype=np.uint8,
+                                           name="imgs", data_format="png")}
+        values = {"imgs": np.random.RandomState(0).randint(
+            0, 255, (2, 4, 4, 3), np.uint8)}
+        parsed = SpecParser(spec).parse_single(encode_example(spec, values))
+        np.testing.assert_array_equal(parsed["imgs"], values["imgs"])
+
+    def test_image_count_mismatch_raises(self):
+        one_spec = {"imgs": ExtendedTensorSpec(shape=(4, 4, 3), dtype=np.uint8,
+                                               name="imgs", data_format="png")}
+        two = {"imgs": ExtendedTensorSpec(shape=(2, 4, 4, 3), dtype=np.uint8,
+                                          name="imgs", data_format="png")}
+        serialized = encode_example(
+            two, {"imgs": np.zeros((2, 4, 4, 3), np.uint8)}
+        )
+        with pytest.raises(ValueError, match="images"):
+            SpecParser(one_spec).parse_single(serialized)

@@ -1,0 +1,195 @@
+"""Preprocessor layer tests: spec contracts, dtype policy, image transforms."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensor2robot_tpu.preprocessors import (
+    NoOpPreprocessor,
+    SpecTransformationPreprocessor,
+    TPUPreprocessorWrapper,
+    image_transformations as it,
+)
+from tensor2robot_tpu.specs import ExtendedTensorSpec, TensorSpecStruct
+
+
+class SpecProvider:
+    """Minimal model-like spec provider."""
+
+    def __init__(self, features=None, labels=None):
+        self._features = features or self.default_features()
+        self._labels = labels or self.default_labels()
+
+    @staticmethod
+    def default_features():
+        s = TensorSpecStruct()
+        s["x"] = ExtendedTensorSpec(shape=(4,), dtype=np.float32, name="x")
+        s["opt"] = ExtendedTensorSpec(
+            shape=(2,), dtype=np.float32, name="opt", is_optional=True
+        )
+        return s
+
+    @staticmethod
+    def default_labels():
+        s = TensorSpecStruct()
+        s["y"] = ExtendedTensorSpec(shape=(1,), dtype=np.float32, name="y")
+        return s
+
+    def get_feature_specification(self, mode):
+        return self._features.copy()
+
+    def get_label_specification(self, mode):
+        return self._labels.copy()
+
+
+class TestNoOpPreprocessor:
+    def test_identity(self):
+        p = NoOpPreprocessor(SpecProvider())
+        features = {"x": np.ones((3, 4), np.float32)}
+        labels = {"y": np.zeros((3, 1), np.float32)}
+        out_f, out_l = p.preprocess(features, labels, mode="train")
+        np.testing.assert_array_equal(out_f["x"], features["x"])
+        np.testing.assert_array_equal(out_l["y"], labels["y"])
+
+    def test_rejects_nonconforming(self):
+        p = NoOpPreprocessor(SpecProvider())
+        with pytest.raises(ValueError):
+            p.preprocess({"x": np.ones((3, 5), np.float32)}, None, mode="train")
+
+
+class TestSpecTransformation:
+    def test_uint8_source_for_float_model(self):
+        class Uint8Ingest(SpecTransformationPreprocessor):
+            def _transform_in_feature_specification(self, spec, mode):
+                self.update_spec(spec, "x", dtype=np.uint8)
+                return spec
+
+            def _preprocess_fn(self, features, labels, mode, rng):
+                features["x"] = features["x"].astype(np.float32) / 255.0
+                return features, labels
+
+        features = TensorSpecStruct()
+        features["x"] = ExtendedTensorSpec(shape=(4,), dtype=np.float32, name="x")
+        p = Uint8Ingest(SpecProvider(features=features))
+        assert p.get_in_feature_specification("train")["x"].dtype == np.uint8
+        out_f, _ = p.preprocess(
+            {"x": np.full((2, 4), 255, np.uint8)}, None, mode="train"
+        )
+        np.testing.assert_allclose(np.asarray(out_f["x"]), 1.0)
+
+
+class TestTPUPreprocessorWrapper:
+    def test_spec_policy(self):
+        wrapped = TPUPreprocessorWrapper(NoOpPreprocessor(SpecProvider()))
+        in_spec = wrapped.get_in_feature_specification("train")
+        assert in_spec["x"].dtype == np.float32
+        out_spec = wrapped.get_out_feature_specification("train")
+        assert out_spec["x"].dtype == jnp.bfloat16
+        assert "opt" not in out_spec  # optional stripped
+
+    def test_value_policy(self):
+        wrapped = TPUPreprocessorWrapper(NoOpPreprocessor(SpecProvider()))
+        features = {"x": np.ones((2, 4), np.float32),
+                    "opt": np.ones((2, 2), np.float32)}
+        labels = {"y": np.zeros((2, 1), np.float32)}
+        out_f, out_l = wrapped.preprocess(features, labels, mode="train")
+        assert out_f["x"].dtype == jnp.bfloat16
+        assert "opt" not in out_f
+        assert out_l["y"].dtype == jnp.bfloat16
+
+
+class TestCrops:
+    def test_center_crop(self):
+        images = jnp.arange(2 * 6 * 8 * 1, dtype=jnp.float32).reshape(2, 6, 8, 1)
+        out = it.center_crop_image_batch(images, (4, 4))
+        assert out.shape == (2, 4, 4, 1)
+        np.testing.assert_array_equal(out[0, 0, 0], images[0, 1, 2])
+
+    def test_random_crop_within_bounds(self):
+        rng = jax.random.PRNGKey(0)
+        images = jnp.ones((3, 10, 10, 3))
+        out = it.random_crop_image_batch(rng, images, (5, 7))
+        assert out.shape == (3, 5, 7, 3)
+
+    def test_crop_too_large_raises(self):
+        with pytest.raises(ValueError):
+            it.center_crop_image_batch(jnp.ones((1, 4, 4, 1)), (8, 8))
+
+    def test_crop_by_mode(self):
+        rng = jax.random.PRNGKey(0)
+        images = jnp.ones((2, 8, 8, 1))
+        train = it.crop_image_batch(rng, images, (4, 4), "train")
+        eval_ = it.crop_image_batch(None, images, (4, 4), "eval")
+        assert train.shape == eval_.shape == (2, 4, 4, 1)
+
+
+class TestPhotometric:
+    def test_hsv_roundtrip(self):
+        rgb = jax.random.uniform(jax.random.PRNGKey(1), (16, 16, 3))
+        back = it._hsv_to_rgb(it._rgb_to_hsv(rgb))
+        np.testing.assert_allclose(np.asarray(back), np.asarray(rgb), atol=1e-4)
+
+    def test_distortions_bounded_and_random(self):
+        rng = jax.random.PRNGKey(0)
+        images = jax.random.uniform(jax.random.PRNGKey(2), (4, 8, 8, 3))
+        out = it.apply_photometric_image_distortions(rng, images, noise_stddev=0.05)
+        assert out.shape == images.shape
+        assert float(out.min()) >= 0.0 and float(out.max()) <= 1.0
+        assert not np.allclose(np.asarray(out), np.asarray(images))
+        # Per-image independence: distinct images distorted differently.
+        same = jnp.stack([images[0]] * 4)
+        out_same = it.apply_photometric_image_distortions(rng, same)
+        assert not np.allclose(np.asarray(out_same[0]), np.asarray(out_same[1]))
+
+    def test_random_order_jits(self):
+        rng = jax.random.PRNGKey(0)
+        images = jax.random.uniform(jax.random.PRNGKey(2), (2, 4, 4, 3))
+        fn = jax.jit(
+            lambda r, im: it.apply_photometric_image_distortions(
+                r, im, random_order=True
+            )
+        )
+        out = fn(rng, images)
+        assert out.shape == images.shape
+
+    def test_eval_mode_no_distortion(self):
+        images = jnp.full((2, 4, 4, 3), 0.5)
+        out = it.maybe_distort_image_batch(jax.random.PRNGKey(0), images, "eval")
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(images))
+
+    def test_depth_distortions(self):
+        rng = jax.random.PRNGKey(0)
+        depth = jnp.full((2, 4, 4, 1), 0.5)
+        out = it.apply_depth_image_distortions(rng, depth, noise_stddev=0.1)
+        assert float(out.min()) >= 0.0 and float(out.max()) <= 1.0
+        assert not np.allclose(np.asarray(out), 0.5)
+
+
+class TestPreprocessImage:
+    def test_uint8_pipeline_4d(self):
+        rng = jax.random.PRNGKey(0)
+        images = np.random.RandomState(0).randint(0, 255, (2, 12, 12, 3), np.uint8)
+        out = it.preprocess_image(
+            jnp.asarray(images), "train", rng=rng, crop_size=(8, 8),
+            target_size=(4, 4), distort=True,
+        )
+        assert out.shape == (2, 4, 4, 3)
+        assert out.dtype == jnp.float32
+
+    def test_uint8_pipeline_5d(self):
+        images = np.random.RandomState(0).randint(0, 255, (2, 3, 12, 12, 3), np.uint8)
+        out = it.preprocess_image(
+            jnp.asarray(images), "eval", crop_size=(8, 8)
+        )
+        assert out.shape == (2, 3, 8, 8, 3)
+
+    def test_jit_composes(self):
+        @jax.jit
+        def fn(rng, images):
+            return it.preprocess_image(
+                images, "train", rng=rng, crop_size=(6, 6), distort=True
+            )
+
+        out = fn(jax.random.PRNGKey(0), jnp.ones((2, 8, 8, 3), jnp.uint8) * 128)
+        assert out.shape == (2, 6, 6, 3)
