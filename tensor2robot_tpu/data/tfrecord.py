@@ -237,7 +237,23 @@ def read_tfrecords(path: str, verify_crc: bool = True) -> Iterator[bytes]:
 
 
 def count_tfrecords(path: str) -> int:
-    return sum(1 for _ in read_tfrecords(path, verify_crc=False))
+    """Counts records by header hopping (seeks past payloads, no copying)."""
+    count = 0
+    pos = 0
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(12)
+            if not header:
+                return count
+            if len(header) < 12:
+                raise TFRecordCorruptionError(f"Truncated record header at {pos}")
+            (length,) = struct.unpack_from("<Q", header, 0)
+            (header_crc,) = struct.unpack_from("<I", header, 8)
+            if masked_crc32c(header[:8]) != header_crc:
+                raise TFRecordCorruptionError(f"Bad header CRC at {pos}")
+            f.seek(length + 4, 1)
+            pos += 12 + length + 4
+            count += 1
 
 
 def list_files(file_patterns: Sequence[str] | str) -> List[str]:

@@ -1,0 +1,65 @@
+"""Hook protocol: side-channel behaviors on the host training loop.
+
+The JAX trainer is an explicit host loop, so hooks are plain callbacks —
+the reimagining of tf SessionRunHooks (reference hooks/hook_builder.py:27-43
+and the hook plumbing in utils/train_eval.py:515-554):
+
+  on_train_begin(ctx)            once, after state creation/restore
+  before_step(ctx)               each host loop iteration
+  after_step(ctx)                each iteration; ctx.metrics set on log steps
+  after_checkpoint_saved(ctx)    after every checkpoint write
+  after_eval(ctx)                after each evaluation (ctx.eval_metrics)
+  on_train_end(ctx)              once
+
+A HookBuilder creates hooks given the model + trainer context, mirroring the
+reference's builder indirection so configs can inject hook sets.
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+
+@dataclasses.dataclass
+class HookContext:
+    """Mutable view of the training loop passed to every hook call."""
+
+    model: Any = None
+    model_dir: Optional[str] = None
+    step: int = 0
+    state: Any = None  # TrainState (device arrays; fetch lazily!)
+    metrics: Optional[Dict[str, float]] = None
+    eval_metrics: Optional[Dict[str, float]] = None
+    checkpoint_path: Optional[str] = None
+    eval_name: Optional[str] = None
+
+
+class Hook:
+    def on_train_begin(self, ctx: HookContext) -> None:
+        pass
+
+    def before_step(self, ctx: HookContext) -> None:
+        pass
+
+    def after_step(self, ctx: HookContext) -> None:
+        pass
+
+    def after_checkpoint_saved(self, ctx: HookContext) -> None:
+        pass
+
+    def after_eval(self, ctx: HookContext) -> None:
+        pass
+
+    def on_train_end(self, ctx: HookContext) -> None:
+        pass
+
+
+class HookBuilder(abc.ABC):
+    """Creates hooks for a (model, trainer) pair
+    (reference hook_builder.py:27-43)."""
+
+    @abc.abstractmethod
+    def create_hooks(self, t2r_model, trainer=None) -> List[Hook]:
+        ...

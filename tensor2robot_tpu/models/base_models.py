@@ -1,0 +1,169 @@
+"""Concrete model-family bases: Classification, Regression, Critic.
+
+These encode the subclass contracts of the reference's model zoo:
+  * ClassificationModel: `a_func` producing `a_predicted` logits; sigmoid
+    cross-entropy; accuracy/precision/recall/mse eval metrics
+    (reference models/classification_model.py:43-237).
+  * RegressionModel: `a_func` producing `inference_output`; MSE against
+    labels.target (reference models/regression_model.py:45-167).
+  * CriticModel: Q(state, action) with split state/action specs, action
+    tiling for CEM batched evaluation, `q_func` producing `q_predicted`,
+    loss against labels.reward (reference models/critic_model.py:43-238).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from tensor2robot_tpu.models.abstract_model import (
+    MODE_PREDICT,
+    AbstractT2RModel,
+    FlaxT2RModel,
+)
+from tensor2robot_tpu.specs import (
+    ExtendedTensorSpec,
+    TensorSpecStruct,
+    copy_tensorspec,
+)
+
+
+class ClassificationModel(FlaxT2RModel):
+    """Binary/multi-label classifier contract. The network must emit
+    `a_predicted` logits; labels must contain `a_target`."""
+
+    def model_train_fn(self, features, labels, inference_outputs, mode):
+        logits = inference_outputs["a_predicted"]
+        targets = labels["a_target"]
+        loss = jnp.mean(
+            optax.sigmoid_binary_cross_entropy(logits, targets)
+        )
+        return loss, {"loss/sigmoid_ce": loss}
+
+    def model_eval_fn(self, features, labels, inference_outputs):
+        logits = inference_outputs["a_predicted"]
+        targets = labels["a_target"]
+        probabilities = jax.nn.sigmoid(logits)
+        predictions = (probabilities > 0.5).astype(jnp.float32)
+        targets_f = targets.astype(jnp.float32)
+        accuracy = jnp.mean((predictions == targets_f).astype(jnp.float32))
+        true_positives = jnp.sum(predictions * targets_f)
+        precision = true_positives / jnp.maximum(jnp.sum(predictions), 1.0)
+        recall = true_positives / jnp.maximum(jnp.sum(targets_f), 1.0)
+        mse = jnp.mean(jnp.square(probabilities - targets_f))
+        loss = jnp.mean(optax.sigmoid_binary_cross_entropy(logits, targets))
+        return {
+            "loss": loss,
+            "accuracy": accuracy,
+            "precision": precision,
+            "recall": recall,
+            "mean_squared_error": mse,
+        }
+
+
+class RegressionModel(FlaxT2RModel):
+    """Regressor contract: network emits `inference_output`; labels carry
+    `target`."""
+
+    def model_train_fn(self, features, labels, inference_outputs, mode):
+        prediction = inference_outputs["inference_output"]
+        loss = jnp.mean(jnp.square(prediction - labels["target"]))
+        return loss, {"loss/mse": loss}
+
+
+class CriticModel(FlaxT2RModel):
+    """Q(s, a) critic with CEM-friendly action tiling.
+
+    Subclasses provide `get_state_specification` / `get_action_specification`;
+    the combined feature spec nests them under state/ and action/. For
+    PREDICT, the action spec gains a leading `action_batch_size` dim so one
+    forward pass scores a whole CEM population per state
+    (reference critic_model.py:123-136; megabatch reshape networks.py:412-421).
+    """
+
+    def __init__(self, action_batch_size: Optional[int] = None, **kwargs):
+        super().__init__(**kwargs)
+        self._action_batch_size = action_batch_size
+
+    @abc.abstractmethod
+    def get_state_specification(self) -> TensorSpecStruct:
+        ...
+
+    @abc.abstractmethod
+    def get_action_specification(self) -> TensorSpecStruct:
+        ...
+
+    @property
+    def action_batch_size(self) -> Optional[int]:
+        return self._action_batch_size
+
+    def get_feature_specification(self, mode: str) -> TensorSpecStruct:
+        spec = TensorSpecStruct()
+        spec.state = self.get_state_specification()
+        if mode == MODE_PREDICT and self._action_batch_size is not None:
+            spec.action = copy_tensorspec(
+                self.get_action_specification(),
+                batch_size=self._action_batch_size,
+            )
+        else:
+            spec.action = self.get_action_specification()
+        return spec
+
+    def get_feature_specification_for_packing(self, mode: str) -> TensorSpecStruct:
+        # Policies pack raw observations only; the CEM layer supplies actions.
+        spec = TensorSpecStruct()
+        spec.state = self.get_state_specification()
+        return spec
+
+    def get_label_specification(self, mode: str) -> TensorSpecStruct:
+        spec = TensorSpecStruct()
+        spec["reward"] = ExtendedTensorSpec(
+            shape=(1,), dtype=np.float32, name="reward"
+        )
+        return spec
+
+    def model_train_fn(self, features, labels, inference_outputs, mode):
+        q = inference_outputs["q_predicted"]
+        reward = labels["reward"]
+        if reward.ndim == q.ndim + 1:
+            reward = jnp.squeeze(reward, axis=-1)
+        loss = jnp.mean(
+            optax.sigmoid_binary_cross_entropy(q, reward)
+        )
+        return loss, {"loss/bellman_supervised": loss}
+
+    def model_eval_fn(self, features, labels, inference_outputs):
+        q = inference_outputs["q_predicted"]
+        reward = labels["reward"]
+        if reward.ndim == q.ndim + 1:
+            reward = jnp.squeeze(reward, axis=-1)
+        probabilities = jax.nn.sigmoid(q)
+        loss = jnp.mean(optax.sigmoid_binary_cross_entropy(q, reward))
+        predictions = (probabilities > 0.5).astype(jnp.float32)
+        accuracy = jnp.mean((predictions == reward).astype(jnp.float32))
+        return {
+            "loss": loss,
+            "accuracy": accuracy,
+            "q_mean": jnp.mean(probabilities),
+        }
+
+
+def tile_actions_for_cem(
+    state_features: TensorSpecStruct,
+    actions: jax.Array,
+) -> Tuple[TensorSpecStruct, jax.Array]:
+    """Expands [B, N, A] CEM action populations + [B, ...] states into the
+    megabatch layout [B*N, ...]: states are repeated N times so the critic
+    scores every (state, candidate) pair in one MXU-friendly batched pass
+    (reference networks.py:412-421 action tiling)."""
+    b, n = actions.shape[0], actions.shape[1]
+    flat_actions = actions.reshape((b * n,) + actions.shape[2:])
+    tiled = TensorSpecStruct()
+    for key, value in state_features.items():
+        tiled[key] = jnp.repeat(value, n, axis=0)
+    return tiled, flat_actions

@@ -1,0 +1,85 @@
+"""Optimizer and learning-rate factories over optax.
+
+Parity with the reference's gin factories (tensor2robot/models/optimizers.py:
+27-159): constant / exponential-decay learning rates; Adam / SGD / Momentum /
+RMSProp creators; moving-average ("swapping saver") semantics are provided by
+the trainer keeping an EMA param tree (see train/state.py) — in optax terms
+an `optax.ema` over params, checkpointed alongside the raw params, with
+export selecting the EMA copy.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+import optax
+
+ScalarOrSchedule = Union[float, optax.Schedule]
+
+
+def create_constant_learning_rate(learning_rate: float = 1e-3) -> optax.Schedule:
+    return optax.constant_schedule(learning_rate)
+
+
+def create_exponential_decay_learning_rate(
+    initial_learning_rate: float = 1e-3,
+    decay_steps: int = 10000,
+    decay_rate: float = 0.9,
+    staircase: bool = True,
+) -> optax.Schedule:
+    return optax.exponential_decay(
+        init_value=initial_learning_rate,
+        transition_steps=decay_steps,
+        decay_rate=decay_rate,
+        staircase=staircase,
+    )
+
+
+def create_adam_optimizer(
+    learning_rate: ScalarOrSchedule = 1e-3,
+    beta1: float = 0.9,
+    beta2: float = 0.999,
+    epsilon: float = 1e-8,
+) -> optax.GradientTransformation:
+    return optax.adam(learning_rate, b1=beta1, b2=beta2, eps=epsilon)
+
+
+def create_sgd_optimizer(
+    learning_rate: ScalarOrSchedule = 1e-2,
+) -> optax.GradientTransformation:
+    return optax.sgd(learning_rate)
+
+
+def create_momentum_optimizer(
+    learning_rate: ScalarOrSchedule = 1e-2,
+    momentum: float = 0.9,
+    nesterov: bool = False,
+) -> optax.GradientTransformation:
+    return optax.sgd(learning_rate, momentum=momentum, nesterov=nesterov)
+
+
+def create_rms_prop_optimizer(
+    learning_rate: ScalarOrSchedule = 1e-3,
+    decay: float = 0.9,
+    momentum: float = 0.0,
+    epsilon: float = 1e-10,
+) -> optax.GradientTransformation:
+    return optax.rmsprop(
+        learning_rate, decay=decay, momentum=momentum, eps=epsilon
+    )
+
+
+def with_gradient_clipping(
+    optimizer: optax.GradientTransformation,
+    max_global_norm: Optional[float] = None,
+    max_abs_value: Optional[float] = None,
+) -> optax.GradientTransformation:
+    """Composes clipping in front of an optimizer (the reference exposed
+    clipping via contrib_training.create_train_op kwargs)."""
+    chain = []
+    if max_abs_value is not None:
+        chain.append(optax.clip(max_abs_value))
+    if max_global_norm is not None:
+        chain.append(optax.clip_by_global_norm(max_global_norm))
+    chain.append(optimizer)
+    return optax.chain(*chain)

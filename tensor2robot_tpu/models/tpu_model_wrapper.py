@@ -1,0 +1,124 @@
+"""TPU dtype-policy model wrapper.
+
+Decorates a T2RModel for TPU execution:
+  * feature/label specs re-declare float32 as bfloat16 (the infeed contract),
+  * the preprocessor is auto-wrapped with TPUPreprocessorWrapper,
+  * at the network boundary bf16 inputs are upcast to float32 unless
+    `train_in_bfloat16`, in which case the forward pass runs bf16 (params
+    stay float32; XLA keeps MXU matmuls in bf16 either way).
+
+What the reference additionally did here — CrossShardOptimizer wrapping and
+scaffold-deferred init (models/tpu_model_wrapper.py:45-49,236-278) — has no
+JAX analogue: gradient cross-replica reduction is implicit in pjit's sharded
+autodiff (psum inserted by GSPMD), and init is an explicit jitted function.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensor2robot_tpu.models.abstract_model import (
+    MODE_TRAIN,
+    AbstractT2RModel,
+)
+from tensor2robot_tpu.preprocessors import TPUPreprocessorWrapper
+from tensor2robot_tpu.specs import (
+    TensorSpecStruct,
+    cast_float32_to_bfloat16,
+    cast_tensors,
+)
+
+
+class TPUT2RModelWrapper(AbstractT2RModel):
+    """Wraps `model` with the TPU bf16 spec + activation policy."""
+
+    def __init__(self, model: AbstractT2RModel, train_in_bfloat16: bool = False):
+        super().__init__(device_type="tpu")
+        self._model = model
+        self._train_in_bfloat16 = train_in_bfloat16
+        self.use_avg_model_params = model.use_avg_model_params
+        self.avg_model_params_decay = model.avg_model_params_decay
+
+    @property
+    def wrapped(self) -> AbstractT2RModel:
+        return self._model
+
+    # -- specs: f32 -> bf16 (reference :107-120) ------------------------------
+
+    def get_feature_specification(self, mode: str) -> TensorSpecStruct:
+        return cast_float32_to_bfloat16(
+            self._model.get_feature_specification(mode)
+        )
+
+    def get_label_specification(self, mode: str) -> TensorSpecStruct:
+        return cast_float32_to_bfloat16(self._model.get_label_specification(mode))
+
+    def get_feature_specification_for_packing(self, mode: str) -> TensorSpecStruct:
+        return self._model.get_feature_specification_for_packing(mode)
+
+    def get_label_specification_for_packing(self, mode: str) -> TensorSpecStruct:
+        return self._model.get_label_specification_for_packing(mode)
+
+    @property
+    def preprocessor(self):
+        return TPUPreprocessorWrapper(self._model.preprocessor)
+
+    # -- parameter lifecycle delegates ---------------------------------------
+
+    def init_variables(self, rng, features, mode=MODE_TRAIN):
+        # Params initialize at the wrapped model's (f32) contract.
+        f32_features = jax.tree_util.tree_map(self._to_f32_struct, features)
+        return self._model.init_variables(rng, f32_features, mode)
+
+    @staticmethod
+    def _to_f32_struct(leaf):
+        if hasattr(leaf, "dtype") and leaf.dtype == jnp.bfloat16:
+            if isinstance(leaf, jax.ShapeDtypeStruct):
+                return jax.ShapeDtypeStruct(leaf.shape, np.float32)
+            return jnp.asarray(leaf, jnp.float32)
+        return leaf
+
+    def maybe_init_from_checkpoint(self, variables):
+        return self._model.maybe_init_from_checkpoint(variables)
+
+    def create_optimizer(self):
+        return self._model.create_optimizer()
+
+    # -- hooks: cast at the boundary (reference :174-191) --------------------
+
+    def inference_network_fn(self, variables, features, mode, rng=None):
+        if not self._train_in_bfloat16:
+            features = cast_tensors(features, jnp.bfloat16, np.float32)
+        return self._model.inference_network_fn(variables, features, mode, rng)
+
+    def model_train_fn(self, features, labels, inference_outputs, mode):
+        # Losses accumulate in float32 regardless of the forward dtype.
+        features = cast_tensors(features, jnp.bfloat16, np.float32)
+        labels = cast_tensors(labels, jnp.bfloat16, np.float32)
+        inference_outputs = cast_tensors(
+            inference_outputs, jnp.bfloat16, np.float32
+        )
+        return self._model.model_train_fn(
+            features, labels, inference_outputs, mode
+        )
+
+    def model_eval_fn(self, features, labels, inference_outputs):
+        features = cast_tensors(features, jnp.bfloat16, np.float32)
+        labels = cast_tensors(labels, jnp.bfloat16, np.float32)
+        inference_outputs = cast_tensors(
+            inference_outputs, jnp.bfloat16, np.float32
+        )
+        return self._model.model_eval_fn(features, labels, inference_outputs)
+
+    def create_export_outputs_fn(self, features, inference_outputs):
+        # Exports serve float32 so CPU/GPU clients consume them unchanged
+        # (reference kept graphs CPU/GPU-servable via no-op casts, :174-183).
+        return cast_tensors(
+            self._model.create_export_outputs_fn(features, inference_outputs),
+            jnp.bfloat16,
+            np.float32,
+        )

@@ -67,6 +67,12 @@ def custom_crop_image_batch(
     """Fixed-offset crop (reference CustomCropImages :105)."""
     _check_crop(images.shape, target_shape)
     th, tw = int(target_shape[0]), int(target_shape[1])
+    h, w = int(images.shape[-3]), int(images.shape[-2])
+    if y < 0 or x < 0 or y + th > h or x + tw > w:
+        raise ValueError(
+            f"Crop offset ({y}, {x}) + size ({th}, {tw}) exceeds image "
+            f"bounds ({h}, {w})."
+        )
     return images[..., y : y + th, x : x + tw, :]
 
 

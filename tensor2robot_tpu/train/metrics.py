@@ -1,0 +1,56 @@
+"""Metrics/event writing: JSONL always, TensorBoard events optionally.
+
+The observability channel replacing TF summaries (reference gated summaries
+off on TPU, models/abstract_model.py:873-893; here metrics are scalars
+returned from the jitted step — no host transfer happens except on log
+steps, so they are TPU-safe by construction).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, Optional
+
+
+class MetricsWriter:
+    """Writes {step, wall_time, metrics...} JSONL; optional TB events."""
+
+    def __init__(self, log_dir: str, filename: str = "metrics.jsonl",
+                 use_tensorboard: bool = False):
+        os.makedirs(log_dir, exist_ok=True)
+        self._path = os.path.join(log_dir, filename)
+        self._file = open(self._path, "a")
+        self._tb = None
+        if use_tensorboard:
+            try:
+                from flax.metrics import tensorboard  # requires tf
+
+                self._tb = tensorboard.SummaryWriter(log_dir)
+            except Exception:
+                self._tb = None
+
+    def write(self, step: int, metrics: Dict[str, float]) -> None:
+        record = {"step": int(step), "wall_time": time.time()}
+        for key, value in metrics.items():
+            record[key] = float(value)
+        self._file.write(json.dumps(record) + "\n")
+        self._file.flush()
+        if self._tb is not None:
+            for key, value in metrics.items():
+                self._tb.scalar(key, float(value), step)
+            self._tb.flush()
+
+    def close(self) -> None:
+        self._file.close()
+        if self._tb is not None:
+            self._tb.close()
+
+
+def read_metrics(log_dir: str, filename: str = "metrics.jsonl"):
+    path = os.path.join(log_dir, filename)
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]

@@ -1,0 +1,68 @@
+"""TrainState: the complete training snapshot as one pytree.
+
+Holds model variables (params + mutable collections), optimizer state, step,
+and — when the model requests moving-average params — an EMA copy. The EMA
+replaces the reference's MovingAverageOptimizer + swapping-saver machinery
+(models/optimizers.py:133-159): checkpoints persist both raw and averaged
+params; export selects the EMA (see export/).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+
+
+@flax.struct.dataclass
+class TrainState:
+    step: jax.Array
+    variables: Dict[str, Any]  # {'params': ..., 'batch_stats': ...}
+    opt_state: Any
+    ema_params: Optional[Any] = None
+
+    @property
+    def params(self):
+        return self.variables["params"]
+
+    def export_variables(self, use_ema: bool = False) -> Dict[str, Any]:
+        """Variables to serve/export: EMA params when present and requested."""
+        if use_ema and self.ema_params is not None:
+            out = dict(self.variables)
+            out["params"] = self.ema_params
+            return out
+        return dict(self.variables)
+
+
+def create_train_state(
+    model,
+    rng: jax.Array,
+    example_features,
+    optimizer: optax.GradientTransformation,
+) -> TrainState:
+    """Initializes variables (with warm-start hook) + optimizer state."""
+    variables = model.init_variables(rng, example_features)
+    variables = model.maybe_init_from_checkpoint(variables)
+    opt_state = optimizer.init(variables["params"])
+    ema = (
+        jax.tree_util.tree_map(jnp.copy, variables["params"])
+        if getattr(model, "use_avg_model_params", False)
+        else None
+    )
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        variables=variables,
+        opt_state=opt_state,
+        ema_params=ema,
+    )
+
+
+def update_ema(ema_params, new_params, decay: float):
+    return jax.tree_util.tree_map(
+        lambda e, p: e * decay + p.astype(e.dtype) * (1.0 - decay),
+        ema_params,
+        new_params,
+    )
