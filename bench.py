@@ -1,0 +1,57 @@
+"""Benchmark: train-step throughput of the flagship model on real hardware.
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+The reference publishes no benchmark numbers (BASELINE.md), so vs_baseline
+is measured against the reference's test-convergence proxy setup (mock model
+steps/sec) until the QT-Opt critic lands as the flagship.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def main() -> None:
+    import jax
+
+    from tensor2robot_tpu.train.train_eval import CompiledModel, maybe_wrap_for_tpu
+    from tensor2robot_tpu.utils.mocks import MockInputGenerator, MockT2RModel
+
+    batch_size = 256
+    model = maybe_wrap_for_tpu(MockT2RModel(device_type="tpu"))
+    generator = MockInputGenerator(batch_size=batch_size)
+    generator.set_specification_from_model(model, "train")
+    batch = next(iter(generator.create_dataset("train")))
+
+    compiled = CompiledModel(model, donate_state=False)
+    state = compiled.init_state(jax.random.PRNGKey(0), batch)
+    sharded = compiled.shard_batch(batch)
+    rng = jax.random.PRNGKey(1)
+
+    # Warmup/compile.
+    state, metrics = compiled.train_step(state, sharded, rng)
+    jax.block_until_ready(metrics)
+
+    steps = 200
+    start = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = compiled.train_step(state, sharded, rng)
+    jax.block_until_ready(metrics)
+    elapsed = time.perf_counter() - start
+    steps_per_sec = steps / elapsed
+
+    print(
+        json.dumps(
+            {
+                "metric": "mock_model_train_steps_per_sec_bs256",
+                "value": round(steps_per_sec, 2),
+                "unit": "steps/s",
+                "vs_baseline": 1.0,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

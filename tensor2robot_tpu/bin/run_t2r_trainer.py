@@ -1,0 +1,34 @@
+"""Trainer CLI: parse configs/bindings, run train_eval_model.
+
+Usage (reference bin/run_t2r_trainer.py:29-37 parity):
+  python -m tensor2robot_tpu.bin.run_t2r_trainer \
+      --gin_configs=path/to/config.gin \
+      --gin_bindings="train_eval_model.max_train_steps = 1000"
+"""
+
+from __future__ import annotations
+
+from absl import app, flags
+
+FLAGS = flags.FLAGS
+flags.DEFINE_multi_string(
+    "gin_configs", [], "Paths to config files applied in order."
+)
+flags.DEFINE_multi_string(
+    "gin_bindings", [], "Individual bindings applied after config files."
+)
+
+
+def main(argv):
+    del argv
+    import tensor2robot_tpu.config.defaults  # registers the surface
+
+    from tensor2robot_tpu import config as cfg
+
+    cfg.parse_config_files_and_bindings(FLAGS.gin_configs, FLAGS.gin_bindings)
+    train_eval_model = cfg.get_configurable("train_eval_model")
+    train_eval_model()
+
+
+if __name__ == "__main__":
+    app.run(main)

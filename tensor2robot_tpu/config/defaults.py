@@ -1,0 +1,57 @@
+"""Registers the framework surface as configurables.
+
+Importing this module (or `import tensor2robot_tpu.config.defaults` inside a
+.gin file) exposes the standard classes/functions for binding — the analogue
+of the reference registering ~100 symbols via @gin.configurable /
+gin.external_configurable (models/abstract_model.py:66-83,
+utils/train_eval.py:56-57).
+"""
+
+from tensor2robot_tpu.config.registry import external_configurable
+
+# -- trainer ------------------------------------------------------------------
+from tensor2robot_tpu.train import train_eval as _train_eval
+
+train_eval_model = external_configurable(
+    _train_eval.train_eval_model, "train_eval_model"
+)
+predict_from_model = external_configurable(
+    _train_eval.predict_from_model, "predict_from_model"
+)
+
+# -- input generators ---------------------------------------------------------
+from tensor2robot_tpu.data import input_generators as _ig
+
+for _cls_name in (
+    "DefaultRecordInputGenerator",
+    "FractionalRecordInputGenerator",
+    "MultiEvalRecordInputGenerator",
+    "WeightedRecordInputGenerator",
+    "GeneratorInputGenerator",
+    "DefaultRandomInputGenerator",
+    "DefaultConstantInputGenerator",
+):
+    globals()[_cls_name] = external_configurable(
+        getattr(_ig, _cls_name), _cls_name
+    )
+
+# -- optimizers ---------------------------------------------------------------
+from tensor2robot_tpu.models import optimizers as _opt
+
+for _fn_name in (
+    "create_constant_learning_rate",
+    "create_exponential_decay_learning_rate",
+    "create_adam_optimizer",
+    "create_sgd_optimizer",
+    "create_momentum_optimizer",
+    "create_rms_prop_optimizer",
+):
+    globals()[_fn_name] = external_configurable(getattr(_opt, _fn_name), _fn_name)
+
+# -- mocks (used by smoke configs/tests) -------------------------------------
+from tensor2robot_tpu.utils import mocks as _mocks
+
+MockT2RModel = external_configurable(_mocks.MockT2RModel, "MockT2RModel")
+MockInputGenerator = external_configurable(
+    _mocks.MockInputGenerator, "MockInputGenerator"
+)

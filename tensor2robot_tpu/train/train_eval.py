@@ -259,6 +259,7 @@ def train_eval_model(
     model = maybe_wrap_for_tpu(t2r_model)
     print_specification(model)
     os.makedirs(model_dir, exist_ok=True)
+    _save_operative_config(model_dir)
 
     compiled = CompiledModel(model, mesh=mesh)
     if use_ema_for_eval is None:
@@ -387,7 +388,22 @@ def train_eval_model(
         eval_writer.close()
         manager.wait_until_finished()
         manager.close()
+        _save_operative_config(model_dir)
     return final_eval
+
+
+def _save_operative_config(model_dir: str) -> None:
+    """Persists the operative config artifact (gin parity: the reference's
+    GinConfigSaverHook wrote the operative config on the chief,
+    models/abstract_model.py:772-775)."""
+    from tensor2robot_tpu import config as cfg_mod
+
+    try:
+        cfg_mod.save_operative_config(model_dir)
+    except OSError as e:
+        import logging
+
+        logging.warning("Could not write operative config to %s: %s", model_dir, e)
 
 
 def predict_from_model(
