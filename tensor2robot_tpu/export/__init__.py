@@ -1,0 +1,23 @@
+"""Export layer: SavedModel-equivalent artifacts, serving interfaces,
+train-time export policies."""
+
+from tensor2robot_tpu.export.export_generators import (
+    AbstractExportGenerator,
+    DefaultExportGenerator,
+)
+from tensor2robot_tpu.export.exporters import (
+    BestExporter,
+    DirectoryVersionGC,
+    Exporter,
+    LatestExporter,
+    create_default_exporters,
+    create_valid_result_larger,
+    create_valid_result_smaller,
+)
+from tensor2robot_tpu.export.saved_model import (
+    ExportedModel,
+    is_valid_export_dir,
+    latest_export_dir,
+    list_export_dirs,
+    save_exported_model,
+)

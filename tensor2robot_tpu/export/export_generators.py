@@ -1,0 +1,150 @@
+"""Export generators: build the serving interfaces for an exported model.
+
+Parity with the reference's export_generators/ (abstract_export_generator.py:
+38-142, default_export_generator.py:42-133), re-architected for JAX:
+
+  * numpy interface — the exported predict function consumes raw
+    spec-conforming arrays; the preprocessor (predict mode) runs *inside* the
+    exported XLA program exactly as the reference embedded it in the serving
+    graph (default_export_generator.py:76-77). `export_raw_receivers` skips
+    the embedded preprocessing for clients that preprocess themselves.
+  * tf.Example interface — protobuf parsing cannot run under XLA, so the
+    generator emits a host-side parse function generated from the assets
+    specs (the same spec->parser generation as training, data/parser.py);
+    serialized bytes -> numpy -> the numpy interface. Same wire contract,
+    explicit host/device split.
+  * warmup requests — spec-conforming random batches written as a TFRecord
+    of serialized tf.Example protos (reference create_warmup_requests_numpy,
+    abstract_export_generator.py:109-142) so servers can pre-compile each
+    batch size.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence
+
+import numpy as np
+
+from tensor2robot_tpu.config import configurable
+from tensor2robot_tpu.data import encoder as encoder_lib
+from tensor2robot_tpu.data import tfrecord
+from tensor2robot_tpu.data.parser import SpecParser
+from tensor2robot_tpu.specs import (
+    TensorSpecStruct,
+    filter_required_flat_tensor_spec,
+    flatten_spec_structure,
+    make_example_args,
+    make_random_numpy,
+    validate_and_pack,
+)
+
+WARMUP_DIR = "warmup"
+WARMUP_FILENAME = "warmup_requests.tfrecord"
+
+
+class AbstractExportGenerator:
+    """Holds the model's serving specs and derives serving callables
+    (reference abstract_export_generator.py:38-67)."""
+
+    def __init__(self, export_raw_receivers: bool = False):
+        self._export_raw_receivers = export_raw_receivers
+        self._feature_spec: Optional[TensorSpecStruct] = None
+        self._label_spec: Optional[TensorSpecStruct] = None
+        self._model_feature_spec: Optional[TensorSpecStruct] = None
+        self._preprocessor = None
+
+    def set_specification_from_model(self, model) -> None:
+        """Pulls the predict-mode raw in-specs off the model's preprocessor."""
+        preprocessor = model.preprocessor
+        self._preprocessor = preprocessor
+        self._feature_spec = preprocessor.get_in_feature_specification("predict")
+        self._label_spec = preprocessor.get_in_label_specification("predict")
+        self._model_feature_spec = preprocessor.get_out_feature_specification(
+            "predict"
+        )
+
+    @property
+    def feature_spec(self) -> TensorSpecStruct:
+        if self._feature_spec is None:
+            raise ValueError(
+                "set_specification_from_model must be called before use."
+            )
+        return self._feature_spec
+
+    @property
+    def label_spec(self) -> Optional[TensorSpecStruct]:
+        return self._label_spec
+
+    def serving_input_spec(self) -> TensorSpecStruct:
+        """The flat, required-only raw input contract (optional tensors are
+        never part of the serving interface; reference
+        default_export_generator.py:66-69)."""
+        spec = (
+            self._model_feature_spec
+            if self._export_raw_receivers
+            else self.feature_spec
+        )
+        return filter_required_flat_tensor_spec(spec)
+
+    def create_serving_fn(
+        self, compiled, variables
+    ) -> Callable[[Dict[str, Any]], Dict[str, Any]]:
+        """flat raw features -> flat export outputs, pure jax (exportable)."""
+        preprocessor = self._preprocessor
+        raw = self._export_raw_receivers
+
+        def serving_fn(flat_features: Dict[str, Any]) -> Dict[str, Any]:
+            features = TensorSpecStruct(dict(flat_features))
+            if not raw:
+                features, _ = preprocessor.preprocess(
+                    features, None, mode="predict", rng=None
+                )
+            outputs = compiled.predict_step(variables, features)
+            return dict(flatten_spec_structure(outputs).items())
+
+        return serving_fn
+
+    def create_example_features(self, batch_size: int = 1) -> Dict[str, Any]:
+        """ShapeDtypeStruct exemplars of the serving inputs for tracing."""
+        flat = make_example_args(self.serving_input_spec(), batch_size=batch_size)
+        return dict(flat.items())
+
+    def create_tf_example_parse_fn(self) -> Callable[[Sequence[bytes]], Dict[str, np.ndarray]]:
+        """Host-side parser: serialized tf.Example bytes -> flat numpy batch
+        (the tf.Example serving signature, default_export_generator.py:84-133)."""
+        spec = self.serving_input_spec()
+        parser = SpecParser(spec)
+
+        def parse_fn(serialized: Sequence[bytes]) -> Dict[str, np.ndarray]:
+            if isinstance(serialized, bytes):
+                serialized = [serialized]
+            batch = parser.parse_batch(list(serialized))
+            return dict(flatten_spec_structure(batch).items())
+
+        return parse_fn
+
+    def create_warmup_requests_numpy(
+        self, batch_sizes: Sequence[int], export_dir: str
+    ) -> str:
+        """Writes spec-conforming random request batches; returns the path
+        (reference abstract_export_generator.py:109-142)."""
+        spec = self.serving_input_spec()
+        warmup_dir = os.path.join(export_dir, WARMUP_DIR)
+        os.makedirs(warmup_dir, exist_ok=True)
+        path = os.path.join(warmup_dir, WARMUP_FILENAME)
+        records: List[bytes] = []
+        for batch_size in batch_sizes:
+            batch = make_random_numpy(spec, batch_size=batch_size)
+            for i in range(batch_size):
+                row = TensorSpecStruct()
+                for key, value in batch.items():
+                    row[key] = value[i]
+                records.append(encoder_lib.encode_example(spec, row))
+        tfrecord.write_tfrecords(path, records)
+        return path
+
+
+@configurable("DefaultExportGenerator")
+class DefaultExportGenerator(AbstractExportGenerator):
+    """The stock generator: numpy + tf.Example interfaces over one artifact."""

@@ -1,0 +1,219 @@
+"""Train-time exporters: Latest/Best export policies + version GC.
+
+Parity with the reference's exporter factory (utils/train_eval.py:295-385):
+LatestExporter writes every eval's weights; BestExporter gates on a metric
+compare fn (`create_valid_result_smaller/larger`, train_eval.py:206-291) and
+persists its best-seen value so resume keeps the gate. Old versions are
+garbage-collected deque-style (hooks/checkpoint_hooks.py:31-48).
+
+The trainer calls `exporter.maybe_export(step=, state=, eval_metrics=,
+compiled=)` after each evaluation (train/train_eval.py run_eval_and_export).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from tensor2robot_tpu.config import configurable
+from tensor2robot_tpu.export.export_generators import (
+    AbstractExportGenerator,
+    DefaultExportGenerator,
+)
+from tensor2robot_tpu.export.saved_model import (
+    list_export_dirs,
+    save_exported_model,
+)
+
+DEFAULT_METRIC = "loss"
+
+
+def create_valid_result_smaller(metric_key: str = DEFAULT_METRIC):
+    """Best = strictly smaller metric (reference train_eval.py:206-248)."""
+
+    def compare_fn(best: Optional[Dict[str, float]], current: Dict[str, float]) -> bool:
+        if metric_key not in current:
+            return False
+        if best is None or metric_key not in best:
+            return True
+        return current[metric_key] < best[metric_key]
+
+    return compare_fn
+
+
+def create_valid_result_larger(metric_key: str = DEFAULT_METRIC):
+    """Best = strictly larger metric (reference train_eval.py:251-291)."""
+
+    def compare_fn(best: Optional[Dict[str, float]], current: Dict[str, float]) -> bool:
+        if metric_key not in current:
+            return False
+        if best is None or metric_key not in best:
+            return True
+        return current[metric_key] > best[metric_key]
+
+    return compare_fn
+
+
+class DirectoryVersionGC:
+    """Keeps the newest `keep` timestamped versions under a root
+    (reference _DirectoryVersionGC, hooks/checkpoint_hooks.py:31-48)."""
+
+    def __init__(self, keep: int):
+        self._keep = keep
+
+    def collect(self, export_root: str) -> List[str]:
+        removed = []
+        if self._keep <= 0:
+            return removed
+        dirs = list_export_dirs(export_root)
+        while len(dirs) > self._keep:
+            victim = dirs.pop(0)
+            shutil.rmtree(victim, ignore_errors=True)
+            removed.append(victim)
+        return removed
+
+
+class Exporter:
+    """Base exporter: owns an export generator + destination + GC."""
+
+    def __init__(
+        self,
+        name: str,
+        export_generator: Optional[AbstractExportGenerator] = None,
+        exports_to_keep: int = 5,
+        serialize_stablehlo: bool = True,
+        warmup_batch_sizes: Sequence[int] = (),
+    ):
+        self.name = name
+        self._export_generator = export_generator or DefaultExportGenerator()
+        self._gc = DirectoryVersionGC(exports_to_keep)
+        self._serialize_stablehlo = serialize_stablehlo
+        self._warmup_batch_sizes = tuple(warmup_batch_sizes)
+
+    def export_root(self, model_dir: str) -> str:
+        return os.path.join(model_dir, "export", self.name)
+
+    def _should_export(self, step, eval_metrics, export_root) -> bool:
+        return True
+
+    def maybe_export(
+        self,
+        step: int,
+        state,
+        eval_metrics: Dict[str, float],
+        compiled,
+        model_dir: Optional[str] = None,
+    ) -> Optional[str]:
+        """Exports the current weights if the policy approves; returns the
+        export path (or None)."""
+        model = compiled.model
+        if model_dir is None:
+            model_dir = getattr(compiled, "model_dir", None)
+        if model_dir is None:
+            raise ValueError("maybe_export requires model_dir (pass it explicitly).")
+        root = self.export_root(model_dir)
+        if not self._should_export(step, eval_metrics, root):
+            return None
+        generator = self._export_generator
+        generator.set_specification_from_model(model)
+        use_ema = getattr(model, "use_avg_model_params", False)
+        variables = state.export_variables(use_ema=use_ema)
+        serving_fn = generator.create_serving_fn(compiled, variables)
+        path = save_exported_model(
+            root,
+            variables=variables,
+            feature_spec=generator.serving_input_spec(),
+            label_spec=generator.label_spec,
+            global_step=step,
+            predict_fn=serving_fn,
+            example_features=generator.create_example_features(),
+            serialize_stablehlo=self._serialize_stablehlo,
+            metadata={"exporter": self.name, "eval_metrics": eval_metrics},
+        )
+        if self._warmup_batch_sizes:
+            generator.create_warmup_requests_numpy(self._warmup_batch_sizes, path)
+        self._after_export(step, eval_metrics, root, path)
+        self._gc.collect(root)
+        return path
+
+    def _after_export(self, step, eval_metrics, export_root, path) -> None:
+        pass
+
+
+@configurable("LatestExporter")
+class LatestExporter(Exporter):
+    """Exports after every eval (reference LatestExporter wiring,
+    train_eval.py:347-366)."""
+
+
+@configurable("BestExporter")
+class BestExporter(Exporter):
+    """Exports only when `compare_fn(best, current)` approves; best-seen
+    metrics persist in best_metrics.json so resume keeps the gate
+    (reference BestExporter + compare fns, train_eval.py:330-346)."""
+
+    def __init__(
+        self,
+        name: str = "best",
+        compare_fn: Optional[Callable] = None,
+        **kwargs,
+    ):
+        super().__init__(name=name, **kwargs)
+        self._compare_fn = compare_fn or create_valid_result_smaller()
+
+    def _best_path(self, export_root: str) -> str:
+        return os.path.join(export_root, "best_metrics.json")
+
+    def _read_best(self, export_root: str) -> Optional[Dict[str, float]]:
+        try:
+            with open(self._best_path(export_root)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def _should_export(self, step, eval_metrics, export_root) -> bool:
+        if not eval_metrics:
+            return False
+        return self._compare_fn(self._read_best(export_root), eval_metrics)
+
+    def _after_export(self, step, eval_metrics, export_root, path) -> None:
+        os.makedirs(export_root, exist_ok=True)
+        tmp = self._best_path(export_root) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(dict(eval_metrics), f)
+        os.replace(tmp, self._best_path(export_root))
+
+
+@configurable("create_default_exporters")
+def create_default_exporters(
+    t2r_model,
+    export_generator: Optional[AbstractExportGenerator] = None,
+    compare_fn: Optional[Callable] = None,
+    exports_to_keep: int = 5,
+    serialize_stablehlo: bool = True,
+    warmup_batch_sizes: Sequence[int] = (),
+) -> List[Exporter]:
+    """latest + best exporter pair (reference create_default_exporters,
+    train_eval.py:295-385; one artifact serves both the numpy and tf.Example
+    interfaces here, so the four receiver variants collapse to two dirs)."""
+    del t2r_model  # Specs are bound at export time from the trained model.
+    make_gen = (lambda: export_generator) if export_generator else DefaultExportGenerator
+    return [
+        LatestExporter(
+            name="latest",
+            export_generator=make_gen(),
+            exports_to_keep=exports_to_keep,
+            serialize_stablehlo=serialize_stablehlo,
+            warmup_batch_sizes=warmup_batch_sizes,
+        ),
+        BestExporter(
+            name="best",
+            export_generator=make_gen(),
+            compare_fn=compare_fn,
+            exports_to_keep=exports_to_keep,
+            serialize_stablehlo=serialize_stablehlo,
+            warmup_batch_sizes=warmup_batch_sizes,
+        ),
+    ]

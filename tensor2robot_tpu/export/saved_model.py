@@ -1,0 +1,244 @@
+"""Exported-model directory format: the SavedModel equivalent.
+
+An export is a timestamped directory (lexicographic max = latest, matching
+the reference's SavedModel version dirs,
+predictors/exported_savedmodel_predictor.py:313-349):
+
+    <export_root>/<unix_seconds>/
+        t2r_metadata.json              global step, flags, flat output keys
+        variables.msgpack              flax-serialized serving variables
+        assets.extra/t2r_assets.pbtxt  feature/label spec contract sidecar
+        stablehlo/predict_fn.bin       (optional) jax.export artifact with the
+                                       weights baked in as constants — serving
+                                       without model code, batch-polymorphic
+
+Directories are written under a `temp-` prefix then atomically renamed, so
+pollers never observe partial exports (the reference filters temp dirs and
+retries, exported_savedmodel_predictor.py:330-345).
+
+The StableHLO artifact is the TPU-native replacement for a TF SavedModel
+GraphDef: a single serialized XLA program `flat_features -> flat_outputs`
+with preprocessing fused in (the reference embedded the preprocessor in the
+serving graph the same way, default_export_generator.py:76-77).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence
+
+import jax
+import numpy as np
+from flax import serialization
+
+from tensor2robot_tpu.specs import (
+    TensorSpecStruct,
+    flatten_spec_structure,
+    read_t2r_assets,
+    write_t2r_assets,
+)
+
+TMP_DIR_PREFIX = "temp-"
+METADATA_FILENAME = "t2r_metadata.json"
+VARIABLES_FILENAME = "variables.msgpack"
+STABLEHLO_DIR = "stablehlo"
+STABLEHLO_FILENAME = "predict_fn.bin"
+
+
+def is_valid_export_dir(path: str) -> bool:
+    """A completed, timestamp-named export directory (reference
+    exported_savedmodel_predictor.py:330-345 validity check)."""
+    base = os.path.basename(path.rstrip("/"))
+    if not base.isdigit():
+        return False
+    return os.path.exists(os.path.join(path, METADATA_FILENAME)) and os.path.exists(
+        os.path.join(path, VARIABLES_FILENAME)
+    )
+
+
+def list_export_dirs(export_root: str) -> List[str]:
+    """All valid export dirs under root, oldest -> newest."""
+    if not os.path.isdir(export_root):
+        return []
+    dirs = [
+        os.path.join(export_root, d)
+        for d in os.listdir(export_root)
+        if d.isdigit()
+    ]
+    return sorted([d for d in dirs if is_valid_export_dir(d)], key=lambda d: int(os.path.basename(d)))
+
+
+def latest_export_dir(export_root: str) -> Optional[str]:
+    dirs = list_export_dirs(export_root)
+    return dirs[-1] if dirs else None
+
+
+def _unique_timestamp_dir(export_root: str) -> str:
+    ts = int(time.time())
+    while os.path.exists(os.path.join(export_root, str(ts))):
+        ts += 1
+    return str(ts)
+
+
+def save_exported_model(
+    export_root: str,
+    variables: Mapping[str, Any],
+    feature_spec: TensorSpecStruct,
+    label_spec: Optional[TensorSpecStruct] = None,
+    global_step: int = 0,
+    predict_fn: Optional[Callable[[Dict[str, Any]], Dict[str, Any]]] = None,
+    example_features: Optional[Mapping[str, Any]] = None,
+    serialize_stablehlo: bool = True,
+    metadata: Optional[Dict[str, Any]] = None,
+) -> str:
+    """Writes one export version; returns its final path.
+
+    Args:
+      export_root: parent directory for timestamped versions.
+      variables: serving variables ({'params': ..., 'batch_stats': ...}).
+      feature_spec: the *raw* input contract robots pack against (stored in
+        t2r_assets so predictors need no model code).
+      label_spec: optional label contract, for parity with the reference
+        sidecar (proto/t2r.proto:39-43).
+      global_step: training step of the exported weights.
+      predict_fn: `flat_features_dict -> flat_outputs_dict`, pure jax, with
+        variables already bound. Required for the StableHLO artifact.
+      example_features: flat {key: np/ShapeDtypeStruct} exemplars used to
+        derive the export signature; leading dim is made batch-polymorphic.
+      serialize_stablehlo: disable to skip the code-free serving artifact
+        (predictors then need model code, like the CheckpointPredictor path).
+      metadata: extra JSON-serializable entries for t2r_metadata.json.
+    """
+    os.makedirs(export_root, exist_ok=True)
+    final_name = _unique_timestamp_dir(export_root)
+    tmp_path = os.path.join(export_root, TMP_DIR_PREFIX + final_name)
+    final_path = os.path.join(export_root, final_name)
+    if os.path.exists(tmp_path):
+        shutil.rmtree(tmp_path)
+    os.makedirs(tmp_path)
+
+    write_t2r_assets(
+        tmp_path, feature_spec, label_spec=label_spec, global_step=global_step
+    )
+
+    with open(os.path.join(tmp_path, VARIABLES_FILENAME), "wb") as f:
+        f.write(serialization.to_bytes(_to_plain(variables)))
+
+    stablehlo_ok = False
+    stablehlo_error = None
+    if serialize_stablehlo and predict_fn is not None and example_features is not None:
+        try:
+            artifact = _export_stablehlo(predict_fn, example_features)
+            hlo_dir = os.path.join(tmp_path, STABLEHLO_DIR)
+            os.makedirs(hlo_dir, exist_ok=True)
+            with open(os.path.join(hlo_dir, STABLEHLO_FILENAME), "wb") as f:
+                f.write(artifact)
+            stablehlo_ok = True
+        except Exception as e:  # noqa: BLE001 — export is best-effort; the
+            # variables + assets path below always works, so record and move on.
+            stablehlo_error = f"{type(e).__name__}: {e}"
+
+    meta = {
+        "global_step": int(global_step),
+        "timestamp": int(os.path.basename(final_path)),
+        "stablehlo": stablehlo_ok,
+        "stablehlo_error": stablehlo_error,
+        "format_version": 1,
+    }
+    if metadata:
+        meta.update(metadata)
+    with open(os.path.join(tmp_path, METADATA_FILENAME), "w") as f:
+        json.dump(meta, f, indent=2, sort_keys=True)
+
+    os.replace(tmp_path, final_path)
+    return final_path
+
+
+def _to_plain(tree):
+    """Device arrays -> numpy host arrays, frozen dicts -> dicts, so the
+    msgpack payload is portable."""
+    return jax.tree_util.tree_map(np.asarray, jax.device_get(dict(tree)))
+
+
+def _export_stablehlo(predict_fn, example_features) -> bytes:
+    """Serializes predict_fn over batch-polymorphic input shapes.
+
+    The leading dim of every input becomes the same symbolic 'b', mirroring
+    the reference's batch_size=None serving placeholders
+    (utils/tensorspec_utils.py:783-814). Lowered for both cpu and tpu so the
+    artifact serves on robot workstations and accelerators alike.
+    """
+    from jax import export as jax_export
+
+    (b,) = jax_export.symbolic_shape("b")
+    args = {}
+    for key, value in dict(example_features).items():
+        if isinstance(value, jax.ShapeDtypeStruct):
+            shape, dtype = value.shape, value.dtype
+        else:
+            value = np.asarray(value)
+            shape, dtype = value.shape, value.dtype
+        if len(shape) < 1:
+            raise ValueError(
+                f"Serving input {key!r} must have a leading batch dim, got {shape}."
+            )
+        args[key] = jax.ShapeDtypeStruct((b,) + tuple(shape[1:]), dtype)
+    try:
+        exported = jax_export.export(
+            jax.jit(predict_fn), platforms=("cpu", "tpu")
+        )(args)
+    except Exception:  # noqa: BLE001 — multi-platform lowering can fail for
+        # platform-specific ops; a single-platform artifact is still useful.
+        exported = jax_export.export(jax.jit(predict_fn))(args)
+    return exported.serialize()
+
+
+class ExportedModel:
+    """A loaded export version: specs + variables (+ StableHLO callable)."""
+
+    def __init__(self, export_dir: str):
+        self.export_dir = export_dir
+        with open(os.path.join(export_dir, METADATA_FILENAME)) as f:
+            self.metadata = json.load(f)
+        self.feature_spec, self.label_spec, self.global_step = read_t2r_assets(
+            export_dir
+        )
+        self._stablehlo_call = None
+        if self.metadata.get("stablehlo"):
+            self._stablehlo_call = self._load_stablehlo()
+
+    def _load_stablehlo(self):
+        from jax import export as jax_export
+
+        path = os.path.join(self.export_dir, STABLEHLO_DIR, STABLEHLO_FILENAME)
+        with open(path, "rb") as f:
+            rehydrated = jax_export.deserialize(f.read())
+        return rehydrated.call
+
+    @property
+    def has_stablehlo(self) -> bool:
+        return self._stablehlo_call is not None
+
+    def predict(self, flat_features: Dict[str, Any]) -> Dict[str, Any]:
+        """Code-free serving via the StableHLO artifact."""
+        if self._stablehlo_call is None:
+            raise RuntimeError(
+                f"Export {self.export_dir} has no StableHLO artifact; "
+                "serve it with a model-code predictor instead "
+                f"({self.metadata.get('stablehlo_error')})."
+            )
+        arrays = {k: np.asarray(v) for k, v in flat_features.items()}
+        out = self._stablehlo_call(arrays)
+        return {k: np.asarray(v) for k, v in dict(out).items()}
+
+    def load_variables(self, target: Optional[Mapping[str, Any]] = None):
+        """Deserializes variables.msgpack; with `target`, restores into that
+        pytree structure (exact dtypes/shapes), else returns raw nested dicts."""
+        with open(os.path.join(self.export_dir, VARIABLES_FILENAME), "rb") as f:
+            data = f.read()
+        if target is not None:
+            return serialization.from_bytes(_to_plain(target), data)
+        return serialization.msgpack_restore(data)

@@ -94,6 +94,17 @@ def _check_name_collisions(flat: TensorSpecStruct) -> None:
 # -- validation ---------------------------------------------------------------
 
 
+def _static_dim(d):
+    """Concrete dims -> int; None and symbolic dims (jax.export shape
+    polymorphism) -> None wildcard, so batch-polymorphic tracing validates."""
+    if d is None or isinstance(d, int):
+        return d
+    try:
+        return int(d)
+    except Exception:  # noqa: BLE001 — symbolic dims raise jax-internal types
+        return None
+
+
 def _shapes_compatible(
     spec_shape: Tuple[Optional[int], ...],
     tensor_shape: Tuple[Optional[int], ...],
@@ -123,9 +134,7 @@ def assert_equal_spec_or_tensor(spec: ExtendedTensorSpec, tensor: Any, ignore_ba
         # them through numpy so conformance is reported as a ValueError, not
         # an AttributeError.
         tensor = np.asarray(tensor)
-    tensor_shape = tuple(
-        None if d is None else int(d) for d in tuple(tensor.shape)
-    )
+    tensor_shape = tuple(_static_dim(d) for d in tuple(tensor.shape))
     spec_shape = tuple(spec.shape)
     if isinstance(tensor, ExtendedTensorSpec):
         ok = _shapes_compatible(spec_shape, tensor_shape, ignore_batch=False)

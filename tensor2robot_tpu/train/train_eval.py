@@ -329,6 +329,7 @@ def train_eval_model(
                 state=state,
                 eval_metrics=eval_metrics,
                 compiled=compiled,
+                model_dir=model_dir,
             )
         ctx.step = step
         ctx.state = state

@@ -1,0 +1,229 @@
+"""Export layer tests: artifact roundtrip, StableHLO serving, exporters + GC.
+
+Mirrors the export coverage of the reference's train_eval_test.py (export
+dirs appear, exported model loads, numpy vs tf.Example interfaces agree)
+and checkpoint_hooks_test.py (version GC).
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from tensor2robot_tpu.export import (
+    BestExporter,
+    DefaultExportGenerator,
+    DirectoryVersionGC,
+    ExportedModel,
+    LatestExporter,
+    create_default_exporters,
+    create_valid_result_larger,
+    create_valid_result_smaller,
+    latest_export_dir,
+    list_export_dirs,
+    save_exported_model,
+)
+from tensor2robot_tpu.train.train_eval import CompiledModel
+from tensor2robot_tpu.utils.mocks import MockInputGenerator, MockT2RModel
+
+
+@pytest.fixture(scope="module")
+def trained():
+    """A briefly-trained compiled mock model + its state."""
+    model = MockT2RModel(device_type="cpu")
+    generator = MockInputGenerator(batch_size=8)
+    generator.set_specification_from_model(model, "train")
+    batches = iter(generator.create_dataset("train"))
+    compiled = CompiledModel(model, donate_state=False)
+    state = compiled.init_state(jax.random.PRNGKey(0), next(batches))
+    for _ in range(3):
+        batch = compiled.shard_batch(next(batches))
+        state, _ = compiled.train_step(state, batch, jax.random.PRNGKey(1))
+    return compiled, state
+
+
+def _export_once(trained, root, **kwargs):
+    compiled, state = trained
+    generator = DefaultExportGenerator()
+    generator.set_specification_from_model(compiled.model)
+    variables = state.export_variables()
+    serving_fn = generator.create_serving_fn(compiled, variables)
+    return save_exported_model(
+        root,
+        variables=variables,
+        feature_spec=generator.serving_input_spec(),
+        label_spec=generator.label_spec,
+        global_step=int(jax.device_get(state.step)),
+        predict_fn=serving_fn,
+        example_features=generator.create_example_features(),
+        **kwargs,
+    )
+
+
+class TestSavedModelArtifact:
+    def test_export_creates_valid_timestamped_dir(self, trained, tmp_path):
+        root = str(tmp_path / "export")
+        path = _export_once(trained, root)
+        assert os.path.basename(path).isdigit()
+        assert latest_export_dir(root) == path
+        assert os.path.exists(os.path.join(path, "variables.msgpack"))
+        assert os.path.exists(
+            os.path.join(path, "assets.extra", "t2r_assets.pbtxt")
+        )
+
+    def test_stablehlo_predict_matches_model(self, trained, tmp_path):
+        compiled, state = trained
+        path = _export_once(trained, str(tmp_path / "export"))
+        exported = ExportedModel(path)
+        assert exported.has_stablehlo, exported.metadata.get("stablehlo_error")
+        x = np.random.RandomState(0).uniform(-1, 1, (4, 3)).astype(np.float32)
+        out = exported.predict({"x": x})
+        assert out["a_predicted"].shape == (4, 1)
+        # Must match the in-process model bit-for-bit structure-wise.
+        variables = state.export_variables()
+        direct = compiled.predict_step(variables, {"x": x})
+        np.testing.assert_allclose(
+            out["a_predicted"], np.asarray(direct["a_predicted"]), rtol=1e-5
+        )
+
+    def test_stablehlo_is_batch_polymorphic(self, trained, tmp_path):
+        path = _export_once(trained, str(tmp_path / "export"))
+        exported = ExportedModel(path)
+        for batch in (1, 7):
+            x = np.zeros((batch, 3), np.float32)
+            assert exported.predict({"x": x})["a_predicted"].shape == (batch, 1)
+
+    def test_assets_spec_roundtrip(self, trained, tmp_path):
+        path = _export_once(trained, str(tmp_path / "export"))
+        exported = ExportedModel(path)
+        assert "x" in exported.feature_spec
+        assert exported.feature_spec["x"].shape == (3,)
+        assert exported.global_step >= 3
+
+    def test_variables_roundtrip(self, trained, tmp_path):
+        compiled, state = trained
+        path = _export_once(trained, str(tmp_path / "export"))
+        exported = ExportedModel(path)
+        variables = exported.load_variables(target=state.export_variables())
+        leaves_a = jax.tree_util.tree_leaves(variables)
+        leaves_b = jax.tree_util.tree_leaves(state.export_variables())
+        assert len(leaves_a) == len(leaves_b)
+        for a, b in zip(leaves_a, leaves_b):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_temp_dirs_invisible_to_pollers(self, trained, tmp_path):
+        root = str(tmp_path / "export")
+        path = _export_once(trained, root)
+        os.makedirs(os.path.join(root, "temp-99999999999"))
+        os.makedirs(os.path.join(root, "99999999998"))  # no metadata: partial
+        assert latest_export_dir(root) == path
+
+
+class TestTfExampleInterface:
+    def test_parse_fn_matches_numpy_interface(self, trained, tmp_path):
+        from tensor2robot_tpu.data.encoder import encode_example
+
+        compiled, state = trained
+        generator = DefaultExportGenerator()
+        generator.set_specification_from_model(compiled.model)
+        spec = generator.serving_input_spec()
+        parse_fn = generator.create_tf_example_parse_fn()
+        x = np.random.RandomState(1).uniform(-1, 1, (2, 3)).astype(np.float32)
+        serialized = [encode_example(spec, {"x": x[i]}) for i in range(2)]
+        parsed = parse_fn(serialized)
+        np.testing.assert_allclose(parsed["x"], x, rtol=1e-6)
+
+    def test_warmup_requests_written_and_parseable(self, trained, tmp_path):
+        from tensor2robot_tpu.data.tfrecord import read_tfrecords
+
+        compiled, _ = trained
+        generator = DefaultExportGenerator()
+        generator.set_specification_from_model(compiled.model)
+        path = generator.create_warmup_requests_numpy(
+            batch_sizes=(1, 2), export_dir=str(tmp_path)
+        )
+        records = list(read_tfrecords(path))
+        assert len(records) == 3
+        parse_fn = generator.create_tf_example_parse_fn()
+        parsed = parse_fn(records)
+        assert parsed["x"].shape == (3, 3)
+
+
+class TestExporters:
+    def test_latest_exporter_exports_every_eval(self, trained, tmp_path):
+        compiled, state = trained
+        exporter = LatestExporter(name="latest", exports_to_keep=2,
+                                  serialize_stablehlo=False)
+        model_dir = str(tmp_path)
+        for step in (1, 2, 3):
+            exporter.maybe_export(
+                step=step, state=state, eval_metrics={"loss": 1.0},
+                compiled=compiled, model_dir=model_dir,
+            )
+        root = exporter.export_root(model_dir)
+        dirs = list_export_dirs(root)
+        assert len(dirs) == 2  # GC kept the newest two
+
+    def test_best_exporter_gates_on_metric(self, trained, tmp_path):
+        compiled, state = trained
+        exporter = BestExporter(
+            name="best", compare_fn=create_valid_result_smaller("loss"),
+            serialize_stablehlo=False,
+        )
+        model_dir = str(tmp_path)
+        p1 = exporter.maybe_export(step=1, state=state,
+                                   eval_metrics={"loss": 1.0},
+                                   compiled=compiled, model_dir=model_dir)
+        p2 = exporter.maybe_export(step=2, state=state,
+                                   eval_metrics={"loss": 2.0},
+                                   compiled=compiled, model_dir=model_dir)
+        p3 = exporter.maybe_export(step=3, state=state,
+                                   eval_metrics={"loss": 0.5},
+                                   compiled=compiled, model_dir=model_dir)
+        assert p1 is not None and p2 is None and p3 is not None
+
+    def test_best_exporter_persists_gate_across_instances(self, trained, tmp_path):
+        compiled, state = trained
+        model_dir = str(tmp_path)
+        make = lambda: BestExporter(  # noqa: E731
+            name="best", compare_fn=create_valid_result_smaller("loss"),
+            serialize_stablehlo=False,
+        )
+        assert make().maybe_export(step=1, state=state,
+                                   eval_metrics={"loss": 1.0},
+                                   compiled=compiled, model_dir=model_dir)
+        # Fresh instance (resume): worse metric must still be rejected.
+        assert make().maybe_export(step=2, state=state,
+                                   eval_metrics={"loss": 1.5},
+                                   compiled=compiled, model_dir=model_dir) is None
+
+    def test_compare_fns(self):
+        smaller = create_valid_result_smaller("m")
+        larger = create_valid_result_larger("m")
+        assert smaller(None, {"m": 1.0})
+        assert smaller({"m": 1.0}, {"m": 0.5})
+        assert not smaller({"m": 1.0}, {"m": 1.0})
+        assert larger({"m": 1.0}, {"m": 2.0})
+        assert not larger({"m": 1.0}, {"m": 0.5})
+        assert not smaller({"m": 1.0}, {})
+
+    def test_create_default_exporters(self, trained):
+        compiled, _ = trained
+        exporters = create_default_exporters(compiled.model)
+        names = [e.name for e in exporters]
+        assert names == ["latest", "best"]
+
+    def test_version_gc(self, tmp_path):
+        import json
+
+        root = str(tmp_path)
+        for ts in (100, 200, 300, 400):
+            d = os.path.join(root, str(ts))
+            os.makedirs(d)
+            with open(os.path.join(d, "t2r_metadata.json"), "w") as f:
+                json.dump({}, f)
+            open(os.path.join(d, "variables.msgpack"), "wb").close()
+        removed = DirectoryVersionGC(keep=2).collect(root)
+        assert [os.path.basename(r) for r in removed] == ["100", "200"]
+        assert [os.path.basename(d) for d in list_export_dirs(root)] == ["300", "400"]
