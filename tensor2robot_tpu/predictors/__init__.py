@@ -1,0 +1,7 @@
+"""Predictors: weight loading + predict(features) for robot processes."""
+
+from tensor2robot_tpu.predictors.abstract_predictor import AbstractPredictor
+from tensor2robot_tpu.predictors.checkpoint_predictor import CheckpointPredictor
+from tensor2robot_tpu.predictors.exported_savedmodel_predictor import (
+    ExportedSavedModelPredictor,
+)

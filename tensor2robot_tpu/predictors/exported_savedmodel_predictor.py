@@ -1,0 +1,242 @@
+"""Predictor over exported model dirs (the SavedModel-equivalent artifact).
+
+Loads the latest timestamped export under a root, reconstructing the input
+contract from assets.extra/t2r_assets.pbtxt — no model code needed when the
+export carries a StableHLO artifact. Supports the reference's operational
+behaviors (predictors/exported_savedmodel_predictor.py:54-355):
+
+  * busy-wait restore with timeout for fleets that boot before the learner
+    has exported anything (:192-215);
+  * async restore: a background thread loads the new version while predict()
+    keeps serving the old one, swap on completion (:137-163,351-355);
+  * action-tile-aware input expansion: a critic exported with an
+    `action_batch_size` population dim accepts un-tiled inputs, which are
+    broadcast up (:106-118).
+
+When the export has no StableHLO payload, pass `t2r_model` and the predictor
+rebuilds the serving fn from model code + the exported variables (the same
+fallback relationship the reference had between SavedModel loading and
+graph-rebuild predictors).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Mapping, Optional
+
+import numpy as np
+
+from tensor2robot_tpu.config import configurable
+from tensor2robot_tpu.export.saved_model import ExportedModel, latest_export_dir
+from tensor2robot_tpu.predictors.abstract_predictor import AbstractPredictor
+from tensor2robot_tpu.specs import (
+    ExtendedTensorSpec,
+    TensorSpecStruct,
+    flatten_spec_structure,
+)
+
+
+@configurable("ExportedSavedModelPredictor")
+class ExportedSavedModelPredictor(AbstractPredictor):
+    """Serves the newest export under `export_dir`."""
+
+    def __init__(
+        self,
+        export_dir: str,
+        t2r_model=None,
+        timeout: int = 600,
+        tile_batch_for_action: bool = True,
+    ):
+        """Args:
+        export_dir: root containing timestamped export versions.
+        t2r_model: optional model for the code-rebuild fallback when an
+          export has no StableHLO artifact.
+        timeout: seconds restore() busy-waits for a first export.
+        tile_batch_for_action: expand inputs whose leading dims miss the
+          exported action-population dim (CEM critics).
+        """
+        self._export_dir = export_dir
+        self._t2r_model = t2r_model
+        self._timeout = timeout
+        self._tile = tile_batch_for_action
+        self._loaded: Optional[ExportedModel] = None
+        self._predict_fn: Optional[Callable] = None
+        self._lock = threading.Lock()
+        self._restore_thread: Optional[threading.Thread] = None
+
+    # -- restore --------------------------------------------------------------
+
+    def restore(self, is_async: bool = False) -> bool:
+        if is_async:
+            with self._lock:
+                if self._restore_thread is not None and self._restore_thread.is_alive():
+                    return True
+                thread = threading.Thread(
+                    target=self._restore_sync, name="t2r-async-restore", daemon=True
+                )
+                self._restore_thread = thread
+            thread.start()
+            return True
+        return self._restore_sync()
+
+    def _restore_sync(self) -> bool:
+        start = time.time()
+        while True:
+            path = latest_export_dir(self._export_dir)
+            if path is not None:
+                current = self._loaded
+                if current is not None and current.export_dir == path:
+                    return True
+                try:
+                    loaded = ExportedModel(path)
+                except OSError:
+                    # Raced the version GC deleting this dir between listing
+                    # and reading; treat as not-yet-available and re-poll
+                    # (reference retry behavior :330-345).
+                    loaded = None
+                if loaded is not None:
+                    # Configuration errors (no StableHLO and no model code)
+                    # are permanent: propagate instead of burning the timeout.
+                    predict_fn = self._build_predict_fn(loaded)
+                    with self._lock:
+                        self._loaded = loaded
+                        self._predict_fn = predict_fn
+                    return True
+            if time.time() - start > self._timeout:
+                return False
+            time.sleep(2.0)
+
+    def _build_predict_fn(self, loaded: ExportedModel) -> Callable:
+        if loaded.has_stablehlo:
+            return loaded.predict
+        if self._t2r_model is None:
+            raise ValueError(
+                f"Export {loaded.export_dir} has no StableHLO artifact "
+                f"({loaded.metadata.get('stablehlo_error')}); construct the "
+                "predictor with t2r_model= to rebuild the serving fn from code."
+            )
+        from tensor2robot_tpu.export.export_generators import DefaultExportGenerator
+        from tensor2robot_tpu.train.train_eval import CompiledModel, maybe_wrap_for_tpu
+
+        model = maybe_wrap_for_tpu(self._t2r_model)
+        compiled = CompiledModel(model, donate_state=False)
+        generator = DefaultExportGenerator()
+        generator.set_specification_from_model(model)
+        import jax
+
+        example = {
+            k: np.zeros(v.shape, v.dtype)
+            for k, v in generator.create_example_features(batch_size=1).items()
+        }
+        features, _ = compiled.preprocessor.preprocess(
+            TensorSpecStruct(example), None, mode="predict", rng=None
+        )
+        target = model.init_variables(jax.random.PRNGKey(0), features)
+        variables = loaded.load_variables(target=target)
+        serving_fn = generator.create_serving_fn(compiled, variables)
+
+        def predict_fn(flat_features: Dict[str, Any]) -> Dict[str, Any]:
+            out = serving_fn(flat_features)
+            return {k: np.asarray(v) for k, v in out.items()}
+
+        return predict_fn
+
+    def init_randomly(self) -> None:
+        """Serves random weights from model code — for tests and robot
+        bring-up before any export exists."""
+        if self._t2r_model is None:
+            raise ValueError("init_randomly requires t2r_model.")
+        from tensor2robot_tpu.export.export_generators import DefaultExportGenerator
+        from tensor2robot_tpu.train.train_eval import CompiledModel, maybe_wrap_for_tpu
+        import jax
+
+        model = maybe_wrap_for_tpu(self._t2r_model)
+        compiled = CompiledModel(model, donate_state=False)
+        generator = DefaultExportGenerator()
+        generator.set_specification_from_model(model)
+        example = {
+            k: np.zeros(v.shape, v.dtype)
+            for k, v in generator.create_example_features(batch_size=1).items()
+        }
+        features, _ = compiled.preprocessor.preprocess(
+            TensorSpecStruct(example), None, mode="predict", rng=None
+        )
+        variables = model.init_variables(jax.random.PRNGKey(0), features)
+        serving_fn = generator.create_serving_fn(compiled, variables)
+
+        class _RandomLoaded:
+            export_dir = "<random-init>"
+            global_step = 0
+            feature_spec = generator.serving_input_spec()
+            label_spec = generator.label_spec
+            metadata: Dict[str, Any] = {}
+
+        with self._lock:
+            self._loaded = _RandomLoaded()  # type: ignore[assignment]
+            self._predict_fn = lambda flat: {
+                k: np.asarray(v) for k, v in serving_fn(flat).items()
+            }
+
+    # -- predict --------------------------------------------------------------
+
+    def predict(self, features: Mapping[str, Any]) -> Dict[str, Any]:
+        self.assert_is_loaded()
+        with self._lock:
+            loaded, predict_fn = self._loaded, self._predict_fn
+        flat = dict(flatten_spec_structure(features).items())
+        if self._tile:
+            flat = self._maybe_expand_dims(loaded.feature_spec, flat)
+        return dict(predict_fn(flat))
+
+    def _maybe_expand_dims(
+        self, spec: TensorSpecStruct, flat: Dict[str, Any]
+    ) -> Dict[str, Any]:
+        """Aligns input ranks with the exported spec: a missing leading dim
+        (e.g. the CEM action-population dim baked into predict-mode specs)
+        is broadcast in (reference _maybe_expand_dim :106-118)."""
+        out = {}
+        flat_spec = flatten_spec_structure(spec)
+        for key, value in flat.items():
+            value = np.asarray(value)
+            leaf = flat_spec.get(key)
+            if isinstance(leaf, ExtendedTensorSpec):
+                want = len(leaf.shape) + 1  # + batch dim
+                while value.ndim < want:
+                    value = np.expand_dims(value, axis=1 if value.ndim >= 1 else 0)
+                if value.ndim == want and leaf.shape and leaf.shape[0] is not None:
+                    # Broadcast a singleton population dim up to the spec's.
+                    if value.shape[1] == 1 and leaf.shape[0] > 1:
+                        value = np.repeat(value, leaf.shape[0], axis=1)
+            out[key] = value
+        return out
+
+    # -- introspection --------------------------------------------------------
+
+    def get_feature_specification(self) -> TensorSpecStruct:
+        self.assert_is_loaded()
+        return self._loaded.feature_spec
+
+    def get_label_specification(self) -> Optional[TensorSpecStruct]:
+        self.assert_is_loaded()
+        return self._loaded.label_spec
+
+    @property
+    def model_version(self) -> int:
+        if self._loaded is None:
+            return -1
+        base = self._loaded.export_dir.rstrip("/").rsplit("/", 1)[-1]
+        return int(base) if base.isdigit() else 0
+
+    @property
+    def global_step(self) -> int:
+        return -1 if self._loaded is None else int(self._loaded.global_step)
+
+    @property
+    def model_path(self) -> Optional[str]:
+        return None if self._loaded is None else self._loaded.export_dir
+
+    def close(self) -> None:
+        thread = self._restore_thread
+        if thread is not None and thread.is_alive():
+            thread.join(timeout=30)

@@ -1,0 +1,168 @@
+"""Predictor tests: exported-dir serving, checkpoint serving, polling/async
+restore, random init — mirroring the reference's predictor test coverage
+(checkpoint_predictor + exported_savedmodel_predictor tests against the mock
+model / mock SavedModel fixture).
+"""
+
+import os
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from tensor2robot_tpu.export import DefaultExportGenerator, save_exported_model
+from tensor2robot_tpu.predictors import (
+    CheckpointPredictor,
+    ExportedSavedModelPredictor,
+)
+from tensor2robot_tpu.train.train_eval import CompiledModel
+from tensor2robot_tpu.utils.mocks import MockInputGenerator, MockT2RModel
+
+
+@pytest.fixture(scope="module")
+def trained():
+    model = MockT2RModel(device_type="cpu")
+    generator = MockInputGenerator(batch_size=8)
+    generator.set_specification_from_model(model, "train")
+    batches = iter(generator.create_dataset("train"))
+    compiled = CompiledModel(model, donate_state=False)
+    state = compiled.init_state(jax.random.PRNGKey(0), next(batches))
+    for _ in range(3):
+        batch = compiled.shard_batch(next(batches))
+        state, _ = compiled.train_step(state, batch, jax.random.PRNGKey(1))
+    return compiled, state
+
+
+def _export(trained, root, serialize_stablehlo=True):
+    compiled, state = trained
+    generator = DefaultExportGenerator()
+    generator.set_specification_from_model(compiled.model)
+    variables = state.export_variables()
+    return save_exported_model(
+        root,
+        variables=variables,
+        feature_spec=generator.serving_input_spec(),
+        label_spec=generator.label_spec,
+        global_step=int(jax.device_get(state.step)),
+        predict_fn=generator.create_serving_fn(compiled, variables),
+        example_features=generator.create_example_features(),
+        serialize_stablehlo=serialize_stablehlo,
+    )
+
+
+class TestExportedSavedModelPredictor:
+    def test_restore_and_predict_stablehlo(self, trained, tmp_path):
+        root = str(tmp_path)
+        _export(trained, root)
+        predictor = ExportedSavedModelPredictor(export_dir=root)
+        assert predictor.restore()
+        x = np.zeros((2, 3), np.float32)
+        out = predictor.predict({"x": x})
+        assert out["a_predicted"].shape == (2, 1)
+        assert predictor.global_step == 3
+        assert predictor.model_version > 0
+        assert "x" in predictor.get_feature_specification()
+
+    def test_restore_without_stablehlo_needs_model(self, trained, tmp_path):
+        root = str(tmp_path)
+        _export(trained, root, serialize_stablehlo=False)
+        predictor = ExportedSavedModelPredictor(export_dir=root)
+        with pytest.raises(ValueError, match="StableHLO"):
+            predictor.restore()
+
+    def test_restore_without_stablehlo_model_fallback(self, trained, tmp_path):
+        compiled, state = trained
+        root = str(tmp_path)
+        _export(trained, root, serialize_stablehlo=False)
+        predictor = ExportedSavedModelPredictor(
+            export_dir=root, t2r_model=MockT2RModel(device_type="cpu")
+        )
+        assert predictor.restore()
+        x = np.random.RandomState(0).uniform(-1, 1, (2, 3)).astype(np.float32)
+        out = predictor.predict({"x": x})
+        direct = compiled.predict_step(state.export_variables(), {"x": x})
+        np.testing.assert_allclose(
+            out["a_predicted"], np.asarray(direct["a_predicted"]), rtol=1e-5
+        )
+
+    def test_restore_times_out_on_empty_dir(self, tmp_path):
+        predictor = ExportedSavedModelPredictor(
+            export_dir=str(tmp_path / "nothing"), timeout=0
+        )
+        assert not predictor.restore()
+
+    def test_restore_picks_up_new_version(self, trained, tmp_path):
+        root = str(tmp_path)
+        _export(trained, root)
+        predictor = ExportedSavedModelPredictor(export_dir=root)
+        assert predictor.restore()
+        v1 = predictor.model_version
+        time.sleep(1.1)  # new unix-second timestamp
+        _export(trained, root)
+        assert predictor.restore()
+        assert predictor.model_version > v1
+
+    def test_async_restore(self, trained, tmp_path):
+        root = str(tmp_path)
+        _export(trained, root)
+        predictor = ExportedSavedModelPredictor(export_dir=root)
+        assert predictor.restore(is_async=True)
+        deadline = time.time() + 60
+        while predictor.model_version < 0 and time.time() < deadline:
+            time.sleep(0.1)
+        assert predictor.model_version > 0
+        predictor.close()
+
+    def test_init_randomly(self):
+        predictor = ExportedSavedModelPredictor(
+            export_dir="/nonexistent", t2r_model=MockT2RModel(device_type="cpu")
+        )
+        predictor.init_randomly()
+        out = predictor.predict({"x": np.zeros((2, 3), np.float32)})
+        assert out["a_predicted"].shape == (2, 1)
+
+    def test_predict_before_restore_raises(self, tmp_path):
+        predictor = ExportedSavedModelPredictor(export_dir=str(tmp_path))
+        with pytest.raises(ValueError, match="no model loaded"):
+            predictor.predict({"x": np.zeros((1, 3), np.float32)})
+
+
+class TestCheckpointPredictor:
+    def test_init_randomly_and_predict(self):
+        predictor = CheckpointPredictor(t2r_model=MockT2RModel(device_type="cpu"))
+        predictor.init_randomly()
+        out = predictor.predict({"x": np.zeros((4, 3), np.float32)})
+        assert out["a_predicted"].shape == (4, 1)
+
+    def test_restore_from_trainer_checkpoint(self, tmp_path):
+        from tensor2robot_tpu.train.train_eval import train_eval_model
+
+        model_dir = str(tmp_path / "run")
+        train_eval_model(
+            MockT2RModel(device_type="cpu"),
+            input_generator_train=MockInputGenerator(batch_size=8),
+            model_dir=model_dir,
+            max_train_steps=4,
+            save_checkpoints_steps=2,
+            log_every_steps=2,
+        )
+        predictor = CheckpointPredictor(
+            t2r_model=MockT2RModel(device_type="cpu"),
+            checkpoint_dir=model_dir,
+            timeout=5,
+        )
+        assert predictor.restore()
+        assert predictor.global_step == 4
+        out = predictor.predict({"x": np.zeros((2, 3), np.float32)})
+        assert out["a_predicted"].shape == (2, 1)
+        assert predictor.model_path.endswith("4")
+
+    def test_restore_times_out(self, tmp_path):
+        predictor = CheckpointPredictor(
+            t2r_model=MockT2RModel(device_type="cpu"),
+            checkpoint_dir=str(tmp_path / "empty"),
+            timeout=0,
+        )
+        assert not predictor.restore()
