@@ -1,0 +1,13 @@
+"""Policies: predictor -> action glue for robot control loops."""
+
+from tensor2robot_tpu.policies.policies import (
+    CEMPolicy,
+    LSTMCEMPolicy,
+    OUExploreRegressionPolicy,
+    PerEpisodeSwitchPolicy,
+    Policy,
+    RegressionPolicy,
+    ScheduledExplorationRegressionPolicy,
+    SequentialRegressionPolicy,
+    default_pack_fn,
+)

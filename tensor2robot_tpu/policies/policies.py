@@ -1,0 +1,370 @@
+"""Policy zoo: the on-robot glue between predictors and environments.
+
+A Policy wraps a predictor (exported model or checkpoint) and turns
+observations into actions at control rates. Parity with the reference
+policies/policies.py:34-365:
+
+  Policy                      restore/init delegation + sample_action
+  CEMPolicy                   CEM argmax over a critic's q_predicted
+  LSTMCEMPolicy               + recurrent hidden-state carry
+  RegressionPolicy            regression model's inference_output as action
+  SequentialRegressionPolicy  + observation-history stacking
+  OUExploreRegressionPolicy   + Ornstein-Uhlenbeck exploration noise
+  ScheduledExplorationRegressionPolicy  + linearly-decayed Gaussian noise
+  PerEpisodeSwitchPolicy      explore-vs-greedy choice per episode
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Callable, Dict, Mapping, Optional
+
+import numpy as np
+
+from tensor2robot_tpu.config import configurable
+from tensor2robot_tpu.predictors.abstract_predictor import AbstractPredictor
+from tensor2robot_tpu.specs import TensorSpecStruct, flatten_spec_structure
+from tensor2robot_tpu.utils.cross_entropy import CrossEntropyMethod
+
+
+def default_pack_fn(state, context, timestep) -> Dict[str, Any]:
+    """Maps an observation onto predictor features: mappings pass through
+    flattened; a bare array binds to the spec's single feature key."""
+    del context, timestep
+    if isinstance(state, (Mapping, TensorSpecStruct)):
+        return {k: np.asarray(v) for k, v in flatten_spec_structure(state).items()}
+    return {"__single__": np.asarray(state)}
+
+
+class Policy(abc.ABC):
+    """Base policy over a predictor (reference policies.py:34-103)."""
+
+    def __init__(
+        self,
+        predictor: AbstractPredictor,
+        pack_fn: Optional[Callable] = None,
+    ):
+        self._predictor = predictor
+        self._pack_fn = pack_fn or default_pack_fn
+        self._rng = np.random.RandomState()
+
+    def seed(self, seed: int) -> None:
+        self._rng = np.random.RandomState(seed)
+
+    @property
+    def predictor(self) -> AbstractPredictor:
+        return self._predictor
+
+    @property
+    def global_step(self) -> int:
+        return self._predictor.global_step
+
+    def restore(self, is_async: bool = False) -> bool:
+        return self._predictor.restore(is_async=is_async)
+
+    def init_randomly(self) -> None:
+        self._predictor.init_randomly()
+
+    def close(self) -> None:
+        self._predictor.close()
+
+    def reset(self) -> None:
+        """Per-episode reset hook (hidden state, noise processes, ...)."""
+
+    def _pack(self, state, context, timestep) -> Dict[str, Any]:
+        features = self._pack_fn(state, context, timestep)
+        if "__single__" in features:
+            spec = flatten_spec_structure(
+                self._predictor.get_feature_specification()
+            )
+            keys = list(spec.keys())
+            if len(keys) != 1:
+                raise ValueError(
+                    "A bare-array observation needs a single-feature spec or "
+                    f"a custom pack_fn; spec has keys {keys}."
+                )
+            features = {keys[0]: features["__single__"]}
+        return features
+
+    @abc.abstractmethod
+    def SelectAction(self, state, context=None, timestep: int = 0) -> np.ndarray:
+        """Returns the action for one (unbatched) observation."""
+
+    def sample_action(self, obs, explore_prob: float = 0.0):
+        """dql-compat interface: (action, debug_dict) with optional uniform
+        exploration (reference sample_action :88-103)."""
+        del explore_prob  # Greedy by default; exploration variants override.
+        return self.SelectAction(obs), {}
+
+
+@configurable("CEMPolicy")
+class CEMPolicy(Policy):
+    """CEM argmax over a critic predictor's `q_predicted`
+    (reference policies.py:107-185).
+
+    The predictor was exported with an action-population dim
+    (`action_batch_size`), so each CEM iteration is ONE batched forward
+    pass over the whole population — the tiling contract of
+    CriticModel.get_feature_specification(PREDICT).
+    """
+
+    def __init__(
+        self,
+        predictor: AbstractPredictor,
+        action_size: int,
+        cem_iterations: int = 3,
+        cem_samples: int = 64,
+        elite_fraction: float = 0.1,
+        action_low: float = -1.0,
+        action_high: float = 1.0,
+        action_key: str = "action",
+        q_key: str = "q_predicted",
+        pack_fn: Optional[Callable] = None,
+        seed: Optional[int] = None,
+    ):
+        super().__init__(predictor, pack_fn)
+        self._action_size = action_size
+        self._low, self._high = action_low, action_high
+        self._action_key = action_key
+        self._q_key = q_key
+        self._cem = CrossEntropyMethod(
+            num_samples=cem_samples,
+            num_iterations=cem_iterations,
+            elite_fraction=elite_fraction,
+            seed=seed,
+        )
+
+    def _resolve_action_key(self) -> str:
+        """The exported spec may nest the action (CriticModel packs it under
+        'action/<leaf>'); resolve the concrete leaf key once."""
+        spec = flatten_spec_structure(self._predictor.get_feature_specification())
+        if self._action_key in list(spec.keys()):  # leaf keys only: `in spec`
+            return self._action_key              # also matches path prefixes
+        prefix = self._action_key + "/"
+        leaves = [k for k in spec.keys() if k.startswith(prefix)]
+        if len(leaves) == 1:
+            return leaves[0]
+        raise ValueError(
+            f"Cannot resolve action key {self._action_key!r} in spec keys "
+            f"{sorted(spec.keys())}; multi-leaf action specs need a custom "
+            "pack_fn/action_key."
+        )
+
+    def _objective_fn(self, features: Dict[str, Any]) -> Callable:
+        action_key = self._resolve_action_key()
+
+        def objective(samples: np.ndarray) -> np.ndarray:
+            n = samples.shape[0]
+            actions = np.clip(samples, self._low, self._high).astype(np.float32)
+            batch = {
+                key: np.asarray(value)[None, ...]
+                for key, value in features.items()
+            }
+            batch[action_key] = actions[None, ...]  # [1, n, action_size]
+            out = self._predictor.predict(batch)
+            q = np.asarray(out[self._q_key]).reshape(-1)
+            if q.shape[0] != n:
+                raise ValueError(
+                    f"Critic returned {q.shape[0]} Q values for population {n}; "
+                    "was the model exported with action_batch_size "
+                    f"= {n}?"
+                )
+            return q
+
+        return objective
+
+    def get_cem_action(self, features: Dict[str, Any]) -> np.ndarray:
+        mean = np.zeros((self._action_size,), np.float64)
+        stddev = np.full((self._action_size,), (self._high - self._low) / 2.0)
+        _, _, best, _ = self._cem.run(self._objective_fn(features), mean, stddev)
+        return np.clip(best, self._low, self._high).astype(np.float32)
+
+    def SelectAction(self, state, context=None, timestep: int = 0) -> np.ndarray:
+        features = self._pack(state, context, timestep)
+        return self.get_cem_action(features)
+
+
+@configurable("LSTMCEMPolicy")
+class LSTMCEMPolicy(CEMPolicy):
+    """CEM over a recurrent critic: carries hidden state between steps via
+    the predictor's `state_output` -> `state_input` keys
+    (reference policies.py:189-219)."""
+
+    def __init__(self, *args, state_input_key: str = "state_input",
+                 state_output_key: str = "state_output", **kwargs):
+        super().__init__(*args, **kwargs)
+        self._state_input_key = state_input_key
+        self._state_output_key = state_output_key
+        self._hidden = None
+
+    def reset(self) -> None:
+        self._hidden = None
+
+    def SelectAction(self, state, context=None, timestep: int = 0) -> np.ndarray:
+        features = self._pack(state, context, timestep)
+        if self._hidden is not None:
+            features[self._state_input_key] = self._hidden
+        action = self.get_cem_action(features)
+        # One more pass to advance the recurrent state with the chosen action.
+        batch = {k: np.asarray(v)[None, ...] for k, v in features.items()}
+        batch[self._action_key] = action[None, None, ...]
+        out = self._predictor.predict(batch)
+        if self._state_output_key in out:
+            self._hidden = np.asarray(out[self._state_output_key])[0]
+        return action
+
+
+@configurable("RegressionPolicy")
+class RegressionPolicy(Policy):
+    """Action = regression model's `inference_output`
+    (reference policies.py:223-238)."""
+
+    def __init__(
+        self,
+        predictor: AbstractPredictor,
+        action_key: str = "inference_output",
+        pack_fn: Optional[Callable] = None,
+    ):
+        super().__init__(predictor, pack_fn)
+        self._action_key = action_key
+
+    def _predict_action(self, features: Dict[str, Any]) -> np.ndarray:
+        batch = {k: np.asarray(v)[None, ...] for k, v in features.items()}
+        out = self._predictor.predict(batch)
+        action = np.asarray(out[self._action_key])[0]
+        return action
+
+    def SelectAction(self, state, context=None, timestep: int = 0) -> np.ndarray:
+        return self._predict_action(self._pack(state, context, timestep))
+
+
+@configurable("SequentialRegressionPolicy")
+class SequentialRegressionPolicy(RegressionPolicy):
+    """Stacks the last `history_length` observations into a leading time dim
+    before prediction (reference policies.py:241-256)."""
+
+    def __init__(self, *args, history_length: int = 4, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._history_length = history_length
+        self._history: list = []
+
+    def reset(self) -> None:
+        self._history = []
+
+    def SelectAction(self, state, context=None, timestep: int = 0) -> np.ndarray:
+        features = self._pack(state, context, timestep)
+        self._history.append(features)
+        if len(self._history) > self._history_length:
+            self._history.pop(0)
+        padded = [self._history[0]] * (
+            self._history_length - len(self._history)
+        ) + self._history
+        stacked = {
+            key: np.stack([f[key] for f in padded], axis=0)
+            for key in padded[0]
+        }
+        return self._predict_action(stacked)
+
+
+@configurable("OUExploreRegressionPolicy")
+class OUExploreRegressionPolicy(RegressionPolicy):
+    """Adds Ornstein-Uhlenbeck temporally-correlated exploration noise
+    (reference policies.py:259-292)."""
+
+    def __init__(self, *args, theta: float = 0.15, sigma: float = 0.2,
+                 action_low: float = -1.0, action_high: float = 1.0, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._theta, self._sigma = theta, sigma
+        self._low, self._high = action_low, action_high
+        self._noise: Optional[np.ndarray] = None
+
+    def reset(self) -> None:
+        self._noise = None
+
+    def _ou_step(self, shape) -> np.ndarray:
+        if self._noise is None:
+            self._noise = np.zeros(shape)
+        self._noise = (
+            self._noise
+            - self._theta * self._noise
+            + self._sigma * self._rng.normal(size=shape)
+        )
+        return self._noise
+
+    def sample_action(self, obs, explore_prob: float = 0.0):
+        action = self.SelectAction(obs)
+        if self._rng.uniform() < explore_prob:
+            action = np.clip(
+                action + self._ou_step(action.shape), self._low, self._high
+            ).astype(action.dtype)
+        return action, {"ou_noise": self._noise}
+
+
+@configurable("ScheduledExplorationRegressionPolicy")
+class ScheduledExplorationRegressionPolicy(RegressionPolicy):
+    """Gaussian exploration with stddev decayed linearly over global_step
+    (reference policies.py:296-321)."""
+
+    def __init__(self, *args, initial_stddev: float = 0.2,
+                 final_stddev: float = 0.0, decay_steps: int = 10000,
+                 action_low: float = -1.0, action_high: float = 1.0, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._initial, self._final = initial_stddev, final_stddev
+        self._decay_steps = decay_steps
+        self._low, self._high = action_low, action_high
+
+    def current_stddev(self) -> float:
+        step = max(self.global_step, 0)
+        frac = min(step / max(self._decay_steps, 1), 1.0)
+        return self._initial + (self._final - self._initial) * frac
+
+    def sample_action(self, obs, explore_prob: float = 0.0):
+        del explore_prob  # The schedule, not the caller, owns exploration.
+        action = self.SelectAction(obs)
+        stddev = self.current_stddev()
+        noisy = np.clip(
+            action + self._rng.normal(scale=stddev, size=action.shape),
+            self._low,
+            self._high,
+        ).astype(action.dtype)
+        return noisy, {"stddev": stddev}
+
+
+@configurable("PerEpisodeSwitchPolicy")
+class PerEpisodeSwitchPolicy(Policy):
+    """Chooses the explore or the greedy policy once per episode
+    (reference policies.py:325-365)."""
+
+    def __init__(self, explore_policy: Policy, greedy_policy: Policy):
+        # Delegates predictor ops to the greedy policy's predictor.
+        super().__init__(greedy_policy.predictor)
+        self._explore_policy = explore_policy
+        self._greedy_policy = greedy_policy
+        self._active = greedy_policy
+
+    def restore(self, is_async: bool = False) -> bool:
+        ok = self._explore_policy.restore(is_async=is_async)
+        return self._greedy_policy.restore(is_async=is_async) and ok
+
+    def init_randomly(self) -> None:
+        self._explore_policy.init_randomly()
+        self._greedy_policy.init_randomly()
+
+    def reset(self, explore_prob: float = 0.0) -> None:
+        self._explore_policy.reset()
+        self._greedy_policy.reset()
+        self._active = (
+            self._explore_policy
+            if self._rng.uniform() < explore_prob
+            else self._greedy_policy
+        )
+
+    @property
+    def active_policy(self) -> Policy:
+        return self._active
+
+    def SelectAction(self, state, context=None, timestep: int = 0) -> np.ndarray:
+        return self._active.SelectAction(state, context, timestep)
+
+    def sample_action(self, obs, explore_prob: float = 0.0):
+        return self._active.sample_action(obs, explore_prob)

@@ -1,0 +1,120 @@
+"""Episode runner: drives a gym-style env with a policy, writes transitions.
+
+The collect/eval workhorse (reference research/dql_grasping_lib/run_env.py:
+78-236): explore-probability schedule, episode -> transitions conversion,
+replay-writer sink, per-episode reward accounting. Environments are any
+object with `reset() -> obs` and `step(action) -> (obs, reward, done, info)`
+(old-gym protocol; 5-tuple new-gym returns are also accepted).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class Transition:
+    obs: Any
+    action: np.ndarray
+    reward: float
+    new_obs: Any
+    done: bool
+
+
+def episode_to_transitions_identity(episode: List[Transition]) -> List[Transition]:
+    return episode
+
+
+def _step_env(env, action) -> Tuple[Any, float, bool, dict]:
+    result = env.step(action)
+    if len(result) == 5:  # new-gym: obs, reward, terminated, truncated, info
+        obs, reward, terminated, truncated, info = result
+        return obs, float(reward), bool(terminated or truncated), info
+    obs, reward, done, info = result
+    return obs, float(reward), bool(done), info
+
+
+def run_env(
+    env,
+    policy,
+    num_episodes: int = 1,
+    max_episode_steps: Optional[int] = None,
+    explore_schedule: Optional[Callable[[int], float]] = None,
+    global_step: int = 0,
+    episode_to_transitions_fn: Optional[Callable] = None,
+    transition_to_record_fn: Optional[Callable] = None,
+    replay_writer=None,
+    replay_path: Optional[str] = None,
+    on_episode_end: Optional[Callable[[int, List[Transition]], None]] = None,
+) -> List[float]:
+    """Runs episodes; returns per-episode total rewards
+    (reference _run_env, run_env.py:133-236).
+
+    Args:
+      env: gym-style environment.
+      policy: a policies.Policy (sample_action interface).
+      num_episodes: episodes to run.
+      max_episode_steps: per-episode step cap (None = env decides).
+      explore_schedule: global_step -> explore probability fed to
+        policy.sample_action (None = greedy).
+      global_step: the learner step these episodes are attributed to.
+      episode_to_transitions_fn: [Transition] -> [Transition] converter
+        (n-step returns, reward relabeling, ...).
+      transition_to_record_fn: Transition -> serialized bytes for the
+        replay writer; required when replay_writer is set.
+      replay_writer: utils.writer.ReplayWriter episode sink.
+      replay_path: shard path prefix passed to replay_writer.open.
+      on_episode_end: callback(episode_index, transitions).
+    """
+    explore_prob = (
+        explore_schedule(global_step) if explore_schedule is not None else 0.0
+    )
+    if replay_writer is not None:
+        if transition_to_record_fn is None:
+            raise ValueError("replay_writer requires transition_to_record_fn.")
+        if replay_path is None:
+            raise ValueError("replay_writer requires replay_path.")
+        replay_writer.open(replay_path)
+    episode_rewards: List[float] = []
+    try:
+        for episode_index in range(num_episodes):
+            obs = env.reset()
+            if isinstance(obs, tuple) and len(obs) == 2:  # new-gym (obs, info)
+                obs = obs[0]
+            if hasattr(policy, "reset"):
+                policy.reset()
+            episode: List[Transition] = []
+            total_reward, step, done = 0.0, 0, False
+            while not done:
+                action, _ = policy.sample_action(obs, explore_prob)
+                new_obs, reward, done, _ = _step_env(env, action)
+                episode.append(Transition(obs, action, reward, new_obs, done))
+                total_reward += reward
+                obs = new_obs
+                step += 1
+                if max_episode_steps is not None and step >= max_episode_steps:
+                    break
+            transitions = (
+                episode_to_transitions_fn(episode)
+                if episode_to_transitions_fn is not None
+                else episode
+            )
+            if replay_writer is not None:
+                replay_writer.write(
+                    [transition_to_record_fn(t) for t in transitions]
+                )
+            if on_episode_end is not None:
+                on_episode_end(episode_index, transitions)
+            episode_rewards.append(total_reward)
+            logging.info(
+                "episode %d/%d: reward=%.3f steps=%d explore=%.3f",
+                episode_index + 1, num_episodes, total_reward, step, explore_prob,
+            )
+    finally:
+        if replay_writer is not None:
+            replay_writer.close()
+    return episode_rewards

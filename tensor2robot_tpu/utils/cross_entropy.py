@@ -1,0 +1,116 @@
+"""Cross-entropy method (CEM) optimizer for action selection.
+
+Generic sample/objective/update loop with elite selection and optional
+early termination — the action-optimization engine behind CEMPolicy
+(reference utils/cross_entropy.py:31-155). Runs in numpy on the robot host:
+at 1-10 Hz control rates the accelerator-bound piece is the batched critic
+evaluation inside `objective_fn`, which scores a whole population in one
+forward pass (the action-tiling path, models/base_models.py
+tile_actions_for_cem).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import numpy as np
+
+
+class CrossEntropyMethod:
+    """Iterative elite-refit optimizer over a diagonal-Gaussian proposal."""
+
+    def __init__(
+        self,
+        sample_fn: Optional[Callable] = None,
+        update_fn: Optional[Callable] = None,
+        elite_fraction: float = 0.1,
+        num_samples: int = 64,
+        num_iterations: int = 3,
+        early_termination_stddev: Optional[float] = None,
+        seed: Optional[int] = None,
+    ):
+        """Args:
+        sample_fn: (mean, stddev, n, rng) -> [n, ...] candidate batch;
+          defaults to a clipped Gaussian.
+        update_fn: (elites) -> (mean, stddev); defaults to moment matching.
+        elite_fraction: top fraction refit each iteration.
+        num_samples: population size per iteration.
+        num_iterations: refit rounds.
+        early_termination_stddev: stop once max(stddev) falls below this
+          (reference early-terminate threshold, cross_entropy.py:120-130).
+        seed: rng seed (None = nondeterministic).
+        """
+        self._sample_fn = sample_fn or self._default_sample
+        self._update_fn = update_fn or self._default_update
+        self._elite_fraction = elite_fraction
+        self._num_samples = num_samples
+        self._num_iterations = num_iterations
+        self._early_stddev = early_termination_stddev
+        self._rng = np.random.RandomState(seed)
+
+    @staticmethod
+    def _default_sample(mean, stddev, n, rng):
+        samples = rng.normal(
+            loc=mean[None, ...], scale=stddev[None, ...], size=(n,) + mean.shape
+        )
+        return samples.astype(mean.dtype, copy=False)
+
+    @staticmethod
+    def _default_update(elites):
+        return elites.mean(axis=0), elites.std(axis=0) + 1e-6
+
+    def run(
+        self,
+        objective_fn: Callable[[np.ndarray], np.ndarray],
+        initial_mean: np.ndarray,
+        initial_stddev: np.ndarray,
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, float]:
+        """Maximizes objective_fn.
+
+        Args:
+          objective_fn: [n, ...] candidates -> [n] scores (bigger = better).
+          initial_mean / initial_stddev: proposal distribution seeds.
+
+        Returns:
+          (mean, stddev, best_sample, best_score) after the final iteration.
+        """
+        mean = np.asarray(initial_mean, dtype=np.float64).copy()
+        stddev = np.asarray(initial_stddev, dtype=np.float64).copy()
+        num_elites = max(1, int(self._num_samples * self._elite_fraction))
+        best_sample, best_score = mean, -np.inf
+        for _ in range(self._num_iterations):
+            samples = self._sample_fn(mean, stddev, self._num_samples, self._rng)
+            scores = np.asarray(objective_fn(samples), dtype=np.float64)
+            if scores.shape != (len(samples),):
+                raise ValueError(
+                    f"objective_fn must return [{len(samples)}] scores, got "
+                    f"{scores.shape}."
+                )
+            elite_idx = np.argsort(scores)[-num_elites:]
+            if scores[elite_idx[-1]] > best_score:
+                best_score = float(scores[elite_idx[-1]])
+                best_sample = samples[elite_idx[-1]].copy()
+            mean, stddev = self._update_fn(samples[elite_idx])
+            if self._early_stddev is not None and np.max(stddev) < self._early_stddev:
+                break
+        return mean, stddev, best_sample, best_score
+
+
+def cem_maximize(
+    objective_fn: Callable[[np.ndarray], np.ndarray],
+    initial_mean: np.ndarray,
+    initial_stddev: np.ndarray,
+    num_samples: int = 64,
+    num_iterations: int = 3,
+    elite_fraction: float = 0.1,
+    seed: Optional[int] = None,
+) -> Tuple[np.ndarray, float]:
+    """One-call CEM: returns (best_sample, best_score)."""
+    cem = CrossEntropyMethod(
+        num_samples=num_samples,
+        num_iterations=num_iterations,
+        elite_fraction=elite_fraction,
+        seed=seed,
+    )
+    _, _, best, score = cem.run(objective_fn, initial_mean, initial_stddev)
+    return best, score

@@ -1,0 +1,68 @@
+"""Replay writers: episode sinks for the collect/eval loop.
+
+TFRecordReplayWriter appends serialized tf.Example transitions to sharded
+TFRecord files — the robot-side half of the filesystem data bus the learner
+reads (reference utils/writer.py:27-61). Uses the framework's native
+TFRecord codec (data/tfrecord.py), no TF dependency.
+"""
+
+from __future__ import annotations
+
+import abc
+import os
+import time
+from typing import Iterable, Optional, Sequence, Union
+
+from tensor2robot_tpu.config import configurable
+from tensor2robot_tpu.data.tfrecord import TFRecordWriter
+
+
+class ReplayWriter(abc.ABC):
+    """open/write/close episode-sink contract."""
+
+    @abc.abstractmethod
+    def open(self, path: str) -> None:
+        ...
+
+    @abc.abstractmethod
+    def write(self, serialized_records: Union[bytes, Sequence[bytes]]) -> None:
+        ...
+
+    @abc.abstractmethod
+    def close(self) -> None:
+        ...
+
+
+@configurable("TFRecordReplayWriter")
+class TFRecordReplayWriter(ReplayWriter):
+    """Writes transition records to <path>-<timestamp>.tfrecord shards."""
+
+    def __init__(self):
+        self._writer: Optional[TFRecordWriter] = None
+        self._path: Optional[str] = None
+
+    def open(self, path: str) -> None:
+        """Starts a new shard; `path` is a prefix, the shard gets a unique
+        timestamp suffix so concurrent collectors never collide."""
+        self.close()
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        shard = f"{path}-{int(time.time() * 1e6)}.tfrecord"
+        self._writer = TFRecordWriter(shard)
+        self._path = shard
+
+    @property
+    def current_shard(self) -> Optional[str]:
+        return self._path
+
+    def write(self, serialized_records: Union[bytes, Sequence[bytes]]) -> None:
+        if self._writer is None:
+            raise ValueError("TFRecordReplayWriter.write before open().")
+        if isinstance(serialized_records, (bytes, bytearray)):
+            serialized_records = [serialized_records]
+        for record in serialized_records:
+            self._writer.write(bytes(record))
+
+    def close(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None

@@ -1,0 +1,338 @@
+"""Policy / CEM / env-loop / collect-eval tests.
+
+Numeric CEM convergence mirrors the reference's cross_entropy tests; the
+CEM-over-critic path is driven end-to-end through a real exported critic
+(action tiling contract); run_env + collect_eval_loop run against a toy env.
+"""
+
+import os
+
+import flax.linen as nn
+import jax
+import numpy as np
+import pytest
+
+from tensor2robot_tpu.data.tfrecord import read_tfrecords
+from tensor2robot_tpu.export import DefaultExportGenerator, save_exported_model
+from tensor2robot_tpu.models.base_models import CriticModel, tile_actions_for_cem
+from tensor2robot_tpu.policies import (
+    CEMPolicy,
+    OUExploreRegressionPolicy,
+    PerEpisodeSwitchPolicy,
+    Policy,
+    RegressionPolicy,
+    ScheduledExplorationRegressionPolicy,
+    SequentialRegressionPolicy,
+)
+from tensor2robot_tpu.predictors import ExportedSavedModelPredictor
+from tensor2robot_tpu.predictors.abstract_predictor import AbstractPredictor
+from tensor2robot_tpu.research.run_env import Transition, run_env
+from tensor2robot_tpu.specs import ExtendedTensorSpec, TensorSpecStruct
+from tensor2robot_tpu.train.train_eval import CompiledModel
+from tensor2robot_tpu.utils.continuous_collect_eval import collect_eval_loop
+from tensor2robot_tpu.utils.cross_entropy import CrossEntropyMethod, cem_maximize
+from tensor2robot_tpu.utils.writer import TFRecordReplayWriter
+
+
+class TestCrossEntropyMethod:
+    def test_converges_to_quadratic_max(self):
+        target = np.array([0.3, -0.6])
+
+        def objective(samples):
+            return -np.sum((samples - target) ** 2, axis=-1)
+
+        best, score = cem_maximize(
+            objective,
+            initial_mean=np.zeros(2),
+            initial_stddev=np.ones(2),
+            num_samples=256,
+            num_iterations=10,
+            seed=0,
+        )
+        np.testing.assert_allclose(best, target, atol=0.05)
+        assert score > -0.01
+
+    def test_early_termination(self):
+        calls = []
+
+        def objective(samples):
+            calls.append(1)
+            return -np.sum(samples**2, axis=-1)
+
+        cem = CrossEntropyMethod(
+            num_samples=64, num_iterations=50,
+            early_termination_stddev=0.5, seed=0,
+        )
+        cem.run(objective, np.zeros(2), np.ones(2) * 0.1)
+        assert len(calls) < 50
+
+    def test_rejects_bad_objective_shape(self):
+        cem = CrossEntropyMethod(num_samples=8, seed=0)
+        with pytest.raises(ValueError, match="scores"):
+            cem.run(lambda s: np.zeros((3,)), np.zeros(1), np.ones(1))
+
+
+# -- a tiny critic whose q is computable in closed form -----------------------
+
+_POP = 32  # CEM population == exported action_batch_size
+
+
+class _QuadraticCriticNetwork(nn.Module):
+    """q = -(action - mean(state))^2 with a dummy param so init works."""
+
+    @nn.compact
+    def __call__(self, features, mode: str):
+        bias = self.param("bias", nn.initializers.zeros, (1,))
+        state = features["state"]["obs"]
+        action = features["action"]["a"]
+        if action.ndim == 3:  # predict-mode population [b, n, 1] -> megabatch
+            state, action = tile_actions_for_cem(
+                TensorSpecStruct({"obs": state}), action
+            )
+            state = state["obs"]
+        target = state.mean(axis=-1, keepdims=True)
+        q = -((action - target) ** 2).sum(axis=-1) + bias[0]
+        out = TensorSpecStruct()
+        out["q_predicted"] = q
+        return out
+
+
+class _QuadraticCritic(CriticModel):
+    def create_network(self):
+        return _QuadraticCriticNetwork()
+
+    def get_state_specification(self):
+        spec = TensorSpecStruct()
+        spec["obs"] = ExtendedTensorSpec(shape=(2,), dtype=np.float32, name="obs")
+        return spec
+
+    def get_action_specification(self):
+        spec = TensorSpecStruct()
+        spec["a"] = ExtendedTensorSpec(shape=(1,), dtype=np.float32, name="a")
+        return spec
+
+
+@pytest.fixture(scope="module")
+def critic_predictor(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("critic_export"))
+    model = _QuadraticCritic(device_type="cpu", action_batch_size=_POP)
+    compiled = CompiledModel(model, donate_state=False)
+    generator = DefaultExportGenerator()
+    generator.set_specification_from_model(model)
+    example = generator.create_example_features()
+    variables = model.init_variables(
+        jax.random.PRNGKey(0),
+        TensorSpecStruct({k: np.zeros(v.shape, v.dtype) for k, v in example.items()}),
+    )
+    save_exported_model(
+        root,
+        variables=variables,
+        feature_spec=generator.serving_input_spec(),
+        global_step=1,
+        predict_fn=generator.create_serving_fn(compiled, variables),
+        example_features=example,
+    )
+    predictor = ExportedSavedModelPredictor(export_dir=root)
+    assert predictor.restore()
+    return predictor
+
+
+class TestCEMPolicy:
+    def test_cem_finds_argmax_action(self, critic_predictor):
+        policy = CEMPolicy(
+            critic_predictor,
+            action_size=1,
+            cem_samples=_POP,
+            cem_iterations=5,
+            seed=0,
+        )
+        # Optimal action = mean(state) = 0.5.
+        state = {"state/obs": np.array([0.2, 0.8], np.float32)}
+        action = policy.SelectAction(state)
+        np.testing.assert_allclose(action, [0.5], atol=0.1)
+
+    def test_sample_action_interface(self, critic_predictor):
+        policy = CEMPolicy(
+            critic_predictor, action_size=1, cem_samples=_POP, seed=0
+        )
+        action, debug = policy.sample_action(
+            {"state/obs": np.zeros(2, np.float32)}, explore_prob=1.0
+        )
+        assert action.shape == (1,)
+        assert isinstance(debug, dict)
+
+
+# -- regression policies over a fake predictor --------------------------------
+
+
+class _FakeRegressionPredictor(AbstractPredictor):
+    """Action = obs[:1] * 2, counts restores."""
+
+    def __init__(self):
+        self.restores = 0
+        self._step = 0
+
+    def predict(self, features):
+        x = np.asarray(features["x"])
+        if x.ndim == 3:  # [b, time, d] sequential variant: use newest frame
+            x = x[:, -1]
+        return {"inference_output": x[:, :1] * 2.0}
+
+    def get_feature_specification(self):
+        spec = TensorSpecStruct()
+        spec["x"] = ExtendedTensorSpec(shape=(3,), dtype=np.float32, name="x")
+        return spec
+
+    def restore(self, is_async: bool = False):
+        self.restores += 1
+        self._step += 10
+        return True
+
+    def init_randomly(self):
+        self._step = 0
+
+    @property
+    def model_version(self):
+        return self._step
+
+    @property
+    def global_step(self):
+        return self._step
+
+    @property
+    def model_path(self):
+        return None
+
+
+class TestRegressionPolicies:
+    def test_regression_policy_bare_array_obs(self):
+        policy = RegressionPolicy(_FakeRegressionPredictor())
+        action = policy.SelectAction(np.array([1.5, 0.0, 0.0], np.float32))
+        np.testing.assert_allclose(action, [3.0])
+
+    def test_sequential_policy_stacks_history(self):
+        policy = SequentialRegressionPolicy(
+            _FakeRegressionPredictor(), history_length=3
+        )
+        policy.reset()
+        for value in (1.0, 2.0, 3.0):
+            action = policy.SelectAction(np.array([value, 0, 0], np.float32))
+        np.testing.assert_allclose(action, [6.0])  # newest frame * 2
+
+    def test_ou_explore_adds_noise_only_when_exploring(self):
+        policy = OUExploreRegressionPolicy(_FakeRegressionPredictor())
+        policy.seed(0)
+        obs = np.array([1.0, 0, 0], np.float32)
+        greedy, _ = policy.sample_action(obs, explore_prob=0.0)
+        np.testing.assert_allclose(greedy, [2.0])
+        noisy, debug = policy.sample_action(obs, explore_prob=1.0)
+        assert not np.allclose(noisy, [2.0])
+        assert "ou_noise" in debug
+
+    def test_scheduled_exploration_decays(self):
+        predictor = _FakeRegressionPredictor()
+        policy = ScheduledExplorationRegressionPolicy(
+            predictor, initial_stddev=0.5, final_stddev=0.0, decay_steps=20
+        )
+        assert policy.current_stddev() == pytest.approx(0.5)
+        predictor.restore()  # step 10
+        assert policy.current_stddev() == pytest.approx(0.25)
+        predictor.restore()  # step 20
+        assert policy.current_stddev() == pytest.approx(0.0)
+        predictor.restore()  # step 30: clamped
+        assert policy.current_stddev() == pytest.approx(0.0)
+
+    def test_per_episode_switch(self):
+        greedy = RegressionPolicy(_FakeRegressionPredictor())
+        explore = OUExploreRegressionPolicy(_FakeRegressionPredictor())
+        switch = PerEpisodeSwitchPolicy(explore, greedy)
+        switch.seed(0)
+        switch.reset(explore_prob=0.0)
+        assert switch.active_policy is greedy
+        switch.reset(explore_prob=1.0)
+        assert switch.active_policy is explore
+
+
+# -- env loop + collect/eval --------------------------------------------------
+
+
+class _ToyEnv:
+    """1-D chase: obs = [pos, target, 0]; reward = -|pos - target|."""
+
+    def __init__(self, horizon=5):
+        self._horizon = horizon
+        self._t = 0
+        self._pos = 0.0
+
+    def reset(self):
+        self._t, self._pos = 0, 0.0
+        return np.array([self._pos, 1.0, 0.0], np.float32)
+
+    def step(self, action):
+        self._pos += float(np.asarray(action).reshape(-1)[0]) * 0.1
+        self._t += 1
+        obs = np.array([self._pos, 1.0, 0.0], np.float32)
+        reward = -abs(self._pos - 1.0)
+        return obs, reward, self._t >= self._horizon, {}
+
+
+def _transition_record(t: Transition) -> bytes:
+    from tensor2robot_tpu.data.encoder import encode_example
+
+    spec = TensorSpecStruct()
+    spec["obs"] = ExtendedTensorSpec(shape=(3,), dtype=np.float32, name="obs")
+    spec["reward"] = ExtendedTensorSpec(shape=(1,), dtype=np.float32, name="reward")
+    return encode_example(
+        spec, {"obs": t.obs, "reward": np.array([t.reward], np.float32)}
+    )
+
+
+class TestRunEnv:
+    def test_episodes_and_replay_shards(self, tmp_path):
+        policy = RegressionPolicy(_FakeRegressionPredictor())
+        writer = TFRecordReplayWriter()
+        rewards = run_env(
+            _ToyEnv(),
+            policy,
+            num_episodes=2,
+            replay_writer=writer,
+            replay_path=str(tmp_path / "shard"),
+            transition_to_record_fn=_transition_record,
+        )
+        assert len(rewards) == 2
+        shards = [f for f in os.listdir(tmp_path) if f.endswith(".tfrecord")]
+        assert len(shards) == 1
+        records = list(read_tfrecords(str(tmp_path / shards[0])))
+        assert len(records) == 10  # 2 episodes x 5 steps
+
+    def test_max_episode_steps(self):
+        policy = RegressionPolicy(_FakeRegressionPredictor())
+        rewards = run_env(
+            _ToyEnv(horizon=100), policy, num_episodes=1, max_episode_steps=3
+        )
+        assert len(rewards) == 1
+
+
+class TestCollectEvalLoop:
+    def test_loop_runs_and_stops_at_max_steps(self, tmp_path):
+        policy = RegressionPolicy(_FakeRegressionPredictor())
+        calls = []
+
+        def run_agent_fn(env, pol, episodes, output_dir, global_step):
+            calls.append((os.path.basename(output_dir), episodes, global_step))
+            run_env(env, pol, num_episodes=episodes)
+
+        final = collect_eval_loop(
+            root_dir=str(tmp_path),
+            policy=policy,
+            run_agent_fn=run_agent_fn,
+            collect_env=_ToyEnv(),
+            eval_env=_ToyEnv(),
+            num_collect=1,
+            num_eval=1,
+            max_steps=10,  # fake predictor hits step 10 on first restore
+            idle_sleep_secs=0.0,
+        )
+        assert final == 10
+        assert ("policy_collect", 1, 10) in calls
+        assert ("policy_eval", 1, 10) in calls
