@@ -1,0 +1,38 @@
+"""Neural layer library (reference tensor2robot/layers/)."""
+
+from tensor2robot_tpu.layers.mdn import (
+    GaussianMixture,
+    MDNDecoder,
+    MDNParams,
+    get_mixture_distribution,
+    mdn_loss,
+)
+from tensor2robot_tpu.layers.resnet import (
+    LinearFilmGenerator,
+    ResNet,
+    get_block_sizes,
+    get_resnet50_spatial,
+)
+from tensor2robot_tpu.layers.snail import (
+    AttentionBlock,
+    CausalConv,
+    DenseBlock,
+    TCBlock,
+    causally_masked_softmax,
+)
+from tensor2robot_tpu.layers.spatial_softmax import spatial_softmax
+from tensor2robot_tpu.layers.tec import (
+    EmbedConditionImages,
+    EmbedFullstate,
+    ReduceTemporalEmbeddings,
+    compute_embedding_contrastive_loss,
+    contrastive_loss,
+    triplet_semihard_loss,
+)
+from tensor2robot_tpu.layers.vision_layers import (
+    FilmParams,
+    ImageFeaturesToPoseNet,
+    ImagesToFeaturesHighResNet,
+    ImagesToFeaturesNet,
+    apply_film,
+)

@@ -1,0 +1,170 @@
+"""Mixture-density network head: isotropic Gaussian mixtures in pure jnp.
+
+Behavioral reference: tensor2robot/layers/mdn.py:30-167. The reference builds
+a tfp MixtureSameFamily; here the mixture is an explicit pytree
+(`GaussianMixture`) with log_prob / approximate-mode / sample methods —
+jit/vmap-friendly and free of any distribution-library dependency.
+
+Parameter layout matches the reference: a params vector of size
+num_alphas + 2 * num_alphas * sample_size packed as
+[alphas | mus | pre-softplus sigmas].
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+MIN_SIGMA = 1e-4
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass(frozen=True)
+class GaussianMixture:
+    """Mixture of isotropic Gaussians.
+
+    Attributes:
+      logits: [..., K] mixture logits.
+      mus: [..., K, D] component means.
+      sigmas: [..., K, D] component stddevs (already softplus'd + floored).
+    """
+
+    logits: jax.Array
+    mus: jax.Array
+    sigmas: jax.Array
+
+    def tree_flatten(self):
+        return (self.logits, self.mus, self.sigmas), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        del aux
+        return cls(*children)
+
+    def log_prob(self, x: jax.Array) -> jax.Array:
+        """log p(x) for x of shape [..., D] (batch dims matching logits)."""
+        x = x[..., None, :]  # [..., 1, D]
+        component_logp = jnp.sum(
+            -0.5 * jnp.square((x - self.mus) / self.sigmas)
+            - jnp.log(self.sigmas)
+            - 0.5 * np.log(2.0 * np.pi),
+            axis=-1,
+        )  # [..., K]
+        mix_logp = jax.nn.log_softmax(self.logits, axis=-1)
+        return jax.scipy.special.logsumexp(mix_logp + component_logp, axis=-1)
+
+    def approximate_mode(self) -> jax.Array:
+        """Mean of the most probable mixture component
+        (reference gaussian_mixture_approximate_mode, mdn.py:117-125)."""
+        mode_alpha = jnp.argmax(self.logits, axis=-1)
+        return jnp.take_along_axis(
+            self.mus, mode_alpha[..., None, None], axis=-2
+        ).squeeze(-2)
+
+    def mean(self) -> jax.Array:
+        weights = jax.nn.softmax(self.logits, axis=-1)
+        return jnp.sum(weights[..., None] * self.mus, axis=-2)
+
+    def sample(self, rng: jax.Array) -> jax.Array:
+        rng_k, rng_eps = jax.random.split(rng)
+        component = jax.random.categorical(rng_k, self.logits, axis=-1)
+        mu = jnp.take_along_axis(
+            self.mus, component[..., None, None], axis=-2
+        ).squeeze(-2)
+        sigma = jnp.take_along_axis(
+            self.sigmas, component[..., None, None], axis=-2
+        ).squeeze(-2)
+        eps = jax.random.normal(rng_eps, mu.shape, dtype=mu.dtype)
+        return mu + sigma * eps
+
+
+def get_mixture_distribution(
+    params: jax.Array,
+    num_alphas: int,
+    sample_size: int,
+    output_mean: Optional[jax.Array] = None,
+    min_sigma: float = MIN_SIGMA,
+) -> GaussianMixture:
+    """Unpacks a params tensor into a GaussianMixture
+    (reference mdn.py:30-73)."""
+    num_mus = num_alphas * sample_size
+    if params.shape[-1] != num_alphas + 2 * num_mus:
+        raise ValueError(f"Params has unexpected size {params.shape[-1]}.")
+    alphas = params[..., :num_alphas]
+    batch_dims = params.shape[:-1]
+    mus = params[..., num_alphas : num_alphas + num_mus].reshape(
+        batch_dims + (num_alphas, sample_size)
+    )
+    pre_sigmas = params[..., num_alphas + num_mus :].reshape(
+        batch_dims + (num_alphas, sample_size)
+    )
+    if output_mean is not None:
+        mus = mus + output_mean
+    sigmas = jax.nn.softplus(pre_sigmas) + min_sigma
+    return GaussianMixture(logits=alphas, mus=mus, sigmas=sigmas)
+
+
+class MDNParams(nn.Module):
+    """Projects features to MDN parameters (reference predict_mdn_params,
+    mdn.py:76-115). Works over arbitrary leading batch dims.
+
+    Attributes:
+      num_alphas: Number of mixture components.
+      sample_size: Dimensionality of one sample.
+      condition_sigmas: If True sigmas are input-conditioned; otherwise they
+        are a learned per-dimension variable (initialized so that
+        softplus(sigma) == 1).
+    """
+
+    num_alphas: int
+    sample_size: int
+    condition_sigmas: bool = False
+
+    @nn.compact
+    def __call__(self, inputs: jax.Array) -> jax.Array:
+        num_mus = self.num_alphas * self.sample_size
+        num_outputs = self.num_alphas + num_mus
+        if self.condition_sigmas:
+            num_outputs += num_mus
+        dist_params = nn.Dense(num_outputs, name="mdn_params")(inputs)
+        if not self.condition_sigmas:
+            sigmas = self.param(
+                "mdn_stddev_inputs",
+                nn.initializers.constant(np.log(np.e - 1.0)),
+                (num_mus,),
+            )
+            tiled = jnp.broadcast_to(
+                sigmas, dist_params.shape[:-1] + (num_mus,)
+            ).astype(dist_params.dtype)
+            dist_params = jnp.concatenate([dist_params, tiled], axis=-1)
+        return dist_params
+
+
+class MDNDecoder(nn.Module):
+    """Action decoder emitting the approximate mode of a Gaussian mixture
+    (reference MDNDecoder, mdn.py:128-167). Returns (action, mixture); the
+    caller computes `-mixture.log_prob(labels).mean()` as the loss — stateless,
+    unlike the reference's cached `self._gm`."""
+
+    num_mixture_components: int = 1
+
+    @nn.compact
+    def __call__(self, params: jax.Array, output_size: int):
+        dist_params = MDNParams(
+            num_alphas=self.num_mixture_components,
+            sample_size=output_size,
+        )(params)
+        gm = get_mixture_distribution(
+            dist_params, self.num_mixture_components, output_size
+        )
+        return gm.approximate_mode(), gm
+
+
+def mdn_loss(gm: GaussianMixture, targets: jax.Array) -> jax.Array:
+    """Mean negative log-likelihood across all batch/sequence dims."""
+    return -jnp.mean(gm.log_prob(targets))

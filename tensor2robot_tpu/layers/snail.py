@@ -1,0 +1,97 @@
+"""SNAIL attention meta-learner blocks (arXiv:1707.03141), flax-native.
+
+Behavioral reference: tensor2robot/layers/snail.py:30-147 (CausalConv,
+DenseBlock, TCBlock, CausallyMaskedSoftmax, AttentionBlock).
+
+TPU notes: causal conv1d is a left-pad + VALID conv (static shapes, MXU
+friendly); the causal mask is additive -inf on the upper triangle so the
+attention matmul stays one fused softmax(QK^T)V.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class CausalConv(nn.Module):
+    """Causal dilated 1D convolution over [batch, time, channels]
+    (reference snail.py:30-53)."""
+
+    filters: int
+    dilation_rate: int = 1
+    kernel_size: int = 2
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        causal_pad = (self.kernel_size - 1) * self.dilation_rate
+        x = jnp.pad(x, ((0, 0), (causal_pad, 0), (0, 0)))
+        return nn.Conv(
+            self.filters,
+            (self.kernel_size,),
+            padding="VALID",
+            kernel_dilation=(self.dilation_rate,),
+        )(x)
+
+
+class DenseBlock(nn.Module):
+    """Gated causal-conv activations concatenated onto the input
+    (reference snail.py:55-71)."""
+
+    filters: int
+    dilation_rate: int = 1
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        xf = CausalConv(self.filters, self.dilation_rate, name="xf")(x)
+        xg = CausalConv(self.filters, self.dilation_rate, name="xg")(x)
+        activations = jnp.tanh(xf) * jax.nn.sigmoid(xg)
+        return jnp.concatenate([x, activations], axis=2)
+
+
+class TCBlock(nn.Module):
+    """Stack of DenseBlocks with dilations 2^1..2^ceil(log2(T))
+    (reference snail.py:73-88). sequence_length must be static."""
+
+    sequence_length: int
+    filters: int
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        for i in range(1, int(np.ceil(np.log2(self.sequence_length))) + 1):
+            x = DenseBlock(
+                self.filters, 2**i, name=f"DenseBlock_{i}"
+            )(x)
+        return x
+
+
+def causally_masked_softmax(logits: jax.Array) -> jax.Array:
+    """Softmax over the last axis with positions j > i masked out
+    (reference snail.py:90-112)."""
+    t = logits.shape[-1]
+    mask = jnp.tril(jnp.ones((t, t), dtype=bool))
+    masked = jnp.where(mask, logits, -jnp.inf)
+    return jax.nn.softmax(masked, axis=-1)
+
+
+class AttentionBlock(nn.Module):
+    """Single-head causal self-attention whose read is concatenated onto the
+    input (reference snail.py:114-147). Returns (result, end_points)."""
+
+    key_size: int
+    value_size: int
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+        key = nn.Dense(self.key_size, name="key")(x)
+        query = nn.Dense(self.key_size, name="query")(x)
+        logits = jnp.einsum("btk,bsk->bts", query, key)
+        probs = causally_masked_softmax(logits / np.sqrt(self.key_size))
+        values = nn.Dense(self.value_size, name="value")(x)
+        read = jnp.einsum("bts,bsv->btv", probs, values)
+        result = jnp.concatenate([x, read], axis=2)
+        return result, {"attn_prob": probs}

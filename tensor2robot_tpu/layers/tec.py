@@ -1,0 +1,237 @@
+"""Task-embedded control (TEC) embedding layers + contrastive losses.
+
+Behavioral reference: tensor2robot/layers/tec.py:30-257 (embed_fullstate,
+embed_condition_images, reduce_temporal_embeddings,
+compute_embedding_contrastive_loss). The slim metric-learning losses the
+reference calls (contrastive_loss, triplet_semihard_loss) are reimplemented
+in jnp below with the same semantics.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tensor2robot_tpu.layers.vision_layers import ImagesToFeaturesNet
+
+
+class EmbedFullstate(nn.Module):
+    """MLP embedding of non-image state observations
+    (reference tec.py:30-57)."""
+
+    embed_size: int
+    fc_layers: Sequence[int] = (100,)
+
+    @nn.compact
+    def __call__(self, fullstate: jax.Array) -> jax.Array:
+        net = fullstate
+        for i, width in enumerate(self.fc_layers):
+            net = nn.Dense(width, name=f"fc{i}")(net)
+            net = nn.relu(nn.LayerNorm(name=f"ln{i}")(net))
+        return nn.Dense(self.embed_size, name="fc_out")(net)
+
+
+class EmbedConditionImages(nn.Module):
+    """Embeds a batch of images via the conv tower, optionally followed by
+    fc (or 1x1-conv) layers (reference tec.py:61-110)."""
+
+    fc_layers: Optional[Sequence[int]] = None
+    use_spatial_softmax: bool = True
+
+    @nn.compact
+    def __call__(self, condition_image: jax.Array, train: bool = False) -> jax.Array:
+        if condition_image.ndim != 4:
+            raise ValueError(
+                f"Image has unexpected shape {condition_image.shape}."
+            )
+        embedding, _ = ImagesToFeaturesNet(
+            use_spatial_softmax=self.use_spatial_softmax, name="tower"
+        )(condition_image, train)
+        if self.fc_layers is not None:
+            hidden, final = self.fc_layers[:-1], self.fc_layers[-1]
+            if embedding.ndim == 2:
+                for i, width in enumerate(hidden):
+                    embedding = nn.Dense(width, name=f"fc{i}")(embedding)
+                    embedding = nn.relu(
+                        nn.LayerNorm(name=f"ln{i}")(embedding)
+                    )
+                embedding = nn.Dense(final, name="fc_out")(embedding)
+            else:
+                for i, width in enumerate(hidden):
+                    embedding = nn.Conv(width, (1, 1), name=f"conv1x1_{i}")(
+                        embedding
+                    )
+                    embedding = nn.relu(
+                        nn.LayerNorm(name=f"ln{i}")(embedding)
+                    )
+                embedding = nn.Conv(final, (1, 1), name="conv1x1_out")(
+                    embedding
+                )
+        return embedding
+
+
+class ReduceTemporalEmbeddings(nn.Module):
+    """Reduces [N, T, F] per-frame embeddings to one [N, output_size] vector
+    via temporal convs (reference tec.py:114-170)."""
+
+    output_size: int
+    conv1d_layers: Optional[Sequence[int]] = (64,)
+    fc_hidden_layers: Sequence[int] = (100,)
+    combine_mode: str = "temporal_conv"
+
+    @nn.compact
+    def __call__(self, temporal_embedding: jax.Array) -> jax.Array:
+        if temporal_embedding.ndim == 5:
+            temporal_embedding = jnp.mean(temporal_embedding, axis=(2, 3))
+        if temporal_embedding.ndim != 3:
+            raise ValueError(
+                "Temporal embedding has unexpected shape"
+                f" {temporal_embedding.shape}."
+            )
+        embedding = temporal_embedding
+        if "temporal_conv" not in self.combine_mode:
+            embedding = jnp.mean(embedding, axis=1)
+        else:
+            if self.conv1d_layers is not None:
+                for i, num_filters in enumerate(self.conv1d_layers):
+                    embedding = nn.Conv(
+                        num_filters,
+                        (10,),
+                        padding="VALID",
+                        use_bias=False,
+                        name=f"conv1d_{i}",
+                    )(embedding)
+                    embedding = nn.relu(
+                        nn.LayerNorm(name=f"conv_ln_{i}")(embedding)
+                    )
+            if self.combine_mode == "temporal_conv_avg_after":
+                embedding = jnp.mean(embedding, axis=1)
+            else:
+                embedding = embedding.reshape(embedding.shape[0], -1)
+
+        for i, width in enumerate(self.fc_hidden_layers):
+            embedding = nn.Dense(width, name=f"fc{i}")(embedding)
+            embedding = nn.relu(nn.LayerNorm(name=f"ln{i}")(embedding))
+        return nn.Dense(self.output_size, name="fc_out")(embedding)
+
+
+def contrastive_loss(
+    labels: jax.Array,
+    anchor: jax.Array,
+    embeddings: jax.Array,
+    margin: float = 1.0,
+) -> jax.Array:
+    """Hadsell et al. contrastive loss between one anchor and N embeddings
+    (semantics of tf_slim metric_learning.contrastive_loss): positives pull
+    to distance 0, negatives push beyond `margin`."""
+    d = jnp.sqrt(
+        jnp.maximum(jnp.sum(jnp.square(anchor - embeddings), axis=-1), 1e-12)
+    )
+    labels_f = labels.astype(d.dtype)
+    loss = labels_f * jnp.square(d) + (1.0 - labels_f) * jnp.square(
+        jnp.maximum(margin - d, 0.0)
+    )
+    return jnp.mean(loss)
+
+
+def triplet_semihard_loss(
+    labels: jax.Array, embeddings: jax.Array, margin: float = 1.0
+) -> jax.Array:
+    """Semi-hard triplet mining loss (semantics of tf_slim
+    metric_learning.triplet_semihard_loss): for each anchor-positive pair,
+    pick the semi-hard negative (further than the positive but within the
+    margin) when one exists, else the largest negative distance."""
+    pdist = jnp.sum(jnp.square(embeddings), axis=1, keepdims=True)
+    dist_sq = pdist - 2.0 * embeddings @ embeddings.T + pdist.T
+    dist = jnp.sqrt(jnp.maximum(dist_sq, 1e-12))
+    n = embeddings.shape[0]
+    adjacency = labels[:, None] == labels[None, :]
+    adjacency_not = ~adjacency
+    eye = jnp.eye(n, dtype=bool)
+    pos_mask = adjacency & ~eye
+
+    # For anchor i and positive j: semi-hard negatives k satisfy
+    # dist[i, k] > dist[i, j]; among them take the min; fall back to the max
+    # negative distance.
+    d_an = dist[:, None, :]  # [anchor, 1, neg]
+    d_ap = dist[:, :, None]  # [anchor, pos, 1]
+    neg_mask = adjacency_not[:, None, :]
+    semihard_mask = neg_mask & (d_an > d_ap)
+    inf = jnp.asarray(jnp.inf, dist.dtype)
+    min_semihard = jnp.min(
+        jnp.where(semihard_mask, d_an, inf), axis=2
+    )  # [anchor, pos]
+    max_neg = jnp.max(
+        jnp.where(adjacency_not, dist, -inf), axis=1
+    )  # [anchor]
+    has_semihard = jnp.any(semihard_mask, axis=2)
+    neg_dist = jnp.where(has_semihard, min_semihard, max_neg[:, None])
+    loss_mat = jnp.maximum(dist[:, :, None].squeeze(-1) - neg_dist + margin, 0.0)
+    num_pos = jnp.maximum(jnp.sum(pos_mask), 1)
+    return jnp.sum(jnp.where(pos_mask, loss_mat, 0.0)) / num_pos
+
+
+def compute_embedding_contrastive_loss(
+    inf_embedding: jax.Array,
+    con_embedding: jax.Array,
+    positives: Optional[jax.Array] = None,
+    contrastive_loss_mode: str = "both_directions",
+) -> jax.Array:
+    """Contrastive loss between inference and condition embeddings
+    (reference tec.py:173-257). Embeddings are expected L2-normalized.
+
+    Args:
+      inf_embedding: [num_tasks, num_inf_episodes, K].
+      con_embedding: [num_tasks, num_con_episodes, K].
+      positives: optional [num_tasks] bool positives mask w.r.t. task 0.
+      contrastive_loss_mode: default | both_directions | reverse_direction |
+        cross_entropy | triplet.
+    """
+    if inf_embedding.ndim != 3:
+        raise ValueError(f"Unexpected inf_embedding shape: {inf_embedding.shape}.")
+    if con_embedding.ndim != 3:
+        raise ValueError(f"Unexpected con_embedding shape: {con_embedding.shape}.")
+    avg_inf = jnp.mean(inf_embedding, axis=1)
+    avg_con = jnp.mean(con_embedding, axis=1)
+    anchor = avg_inf[0:1]
+    num_tasks = avg_con.shape[0]
+    if positives is not None:
+        labels = positives
+    else:
+        labels = jnp.arange(num_tasks) == 0
+
+    if contrastive_loss_mode == "default":
+        return contrastive_loss(labels, anchor, avg_con)
+    if contrastive_loss_mode == "both_directions":
+        anchor_con = avg_con[0:1]
+        return contrastive_loss(labels, anchor, avg_con) + contrastive_loss(
+            labels, anchor_con, avg_inf
+        )
+    if contrastive_loss_mode == "reverse_direction":
+        anchor_con = avg_con[0:1]
+        return contrastive_loss(labels, anchor_con, avg_inf)
+    if contrastive_loss_mode == "cross_entropy":
+        temperature = 2.0
+        labels_f = labels.astype(avg_con.dtype)
+        anchor_con = avg_con[0:1]
+        sim1 = jnp.sum(anchor * avg_con, axis=1)
+        sim2 = jnp.sum(anchor_con * avg_inf, axis=1)
+        import optax
+
+        loss1 = jnp.mean(
+            optax.sigmoid_binary_cross_entropy(temperature * sim1, labels_f)
+        )
+        loss2 = jnp.mean(
+            optax.sigmoid_binary_cross_entropy(temperature * sim2, labels_f)
+        )
+        return loss1 + loss2
+    if contrastive_loss_mode == "triplet":
+        if positives is None:
+            positives = jnp.arange(num_tasks, dtype=jnp.int32)
+        tiled = jnp.tile(positives, (2,))
+        embeds = jnp.concatenate([avg_inf, avg_con], axis=0)
+        return triplet_semihard_loss(tiled, embeds, margin=3.0)
+    raise ValueError("Did not understand contrastive_loss_mode")

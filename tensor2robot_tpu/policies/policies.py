@@ -91,9 +91,10 @@ class Policy(abc.ABC):
         """Returns the action for one (unbatched) observation."""
 
     def sample_action(self, obs, explore_prob: float = 0.0):
-        """dql-compat interface: (action, debug_dict) with optional uniform
-        exploration (reference sample_action :88-103)."""
-        del explore_prob  # Greedy by default; exploration variants override.
+        """dql-compat interface returning (action, debug_dict). explore_prob
+        is ignored here exactly as in the reference base policy
+        (policies.py:88-103); exploration variants override."""
+        del explore_prob
         return self.SelectAction(obs), {}
 
 
@@ -126,8 +127,22 @@ class CEMPolicy(Policy):
         self._action_size = action_size
         self._low, self._high = action_low, action_high
         self._action_key = action_key
+        self._resolved_action_key: Optional[str] = None
         self._q_key = q_key
+
+        def sample_clipped(mean, stddev, n, rng):
+            samples = rng.normal(
+                loc=mean[None, ...],
+                scale=stddev[None, ...],
+                size=(n,) + mean.shape,
+            )
+            # Clip BEFORE scoring so elites are refit on the same actions the
+            # critic scored; otherwise the proposal mean can drift outside
+            # [low, high] and never recover.
+            return np.clip(samples, action_low, action_high)
+
         self._cem = CrossEntropyMethod(
+            sample_fn=sample_clipped,
             num_samples=cem_samples,
             num_iterations=cem_iterations,
             elite_fraction=elite_fraction,
@@ -136,13 +151,18 @@ class CEMPolicy(Policy):
 
     def _resolve_action_key(self) -> str:
         """The exported spec may nest the action (CriticModel packs it under
-        'action/<leaf>'); resolve the concrete leaf key once."""
+        'action/<leaf>'); resolve the concrete leaf key once and cache it
+        (the spec is only available after the predictor has restored)."""
+        if self._resolved_action_key is not None:
+            return self._resolved_action_key
         spec = flatten_spec_structure(self._predictor.get_feature_specification())
         if self._action_key in list(spec.keys()):  # leaf keys only: `in spec`
-            return self._action_key              # also matches path prefixes
+            self._resolved_action_key = self._action_key  # matches prefixes too
+            return self._action_key
         prefix = self._action_key + "/"
         leaves = [k for k in spec.keys() if k.startswith(prefix)]
         if len(leaves) == 1:
+            self._resolved_action_key = leaves[0]
             return leaves[0]
         raise ValueError(
             f"Cannot resolve action key {self._action_key!r} in spec keys "
@@ -174,7 +194,11 @@ class CEMPolicy(Policy):
         return objective
 
     def get_cem_action(self, features: Dict[str, Any]) -> np.ndarray:
-        mean = np.zeros((self._action_size,), np.float64)
+        # Seed the proposal at the center of the valid action box; mean=0 is
+        # wrong for asymmetric [low, high] bounds.
+        mean = np.full(
+            (self._action_size,), (self._low + self._high) / 2.0, np.float64
+        )
         stddev = np.full((self._action_size,), (self._high - self._low) / 2.0)
         _, _, best, _ = self._cem.run(self._objective_fn(features), mean, stddev)
         return np.clip(best, self._low, self._high).astype(np.float32)
@@ -205,9 +229,10 @@ class LSTMCEMPolicy(CEMPolicy):
         if self._hidden is not None:
             features[self._state_input_key] = self._hidden
         action = self.get_cem_action(features)
-        # One more pass to advance the recurrent state with the chosen action.
+        # One more pass to advance the recurrent state with the chosen action,
+        # fed under the same resolved leaf key the CEM objective used.
         batch = {k: np.asarray(v)[None, ...] for k, v in features.items()}
-        batch[self._action_key] = action[None, None, ...]
+        batch[self._resolve_action_key()] = action[None, None, ...]
         out = self._predictor.predict(batch)
         if self._state_output_key in out:
             self._hidden = np.asarray(out[self._state_output_key])[0]
@@ -335,11 +360,19 @@ class PerEpisodeSwitchPolicy(Policy):
     """Chooses the explore or the greedy policy once per episode
     (reference policies.py:325-365)."""
 
-    def __init__(self, explore_policy: Policy, greedy_policy: Policy):
-        # Delegates predictor ops to the greedy policy's predictor.
+    def __init__(
+        self,
+        explore_policy: Policy,
+        greedy_policy: Policy,
+        explore_prob: float = 0.0,
+    ):
+        # Delegates predictor ops to the greedy policy's predictor. The
+        # explore probability is owned by the policy (reference
+        # policies.py:335-346) because run_env calls reset() with no args.
         super().__init__(greedy_policy.predictor)
         self._explore_policy = explore_policy
         self._greedy_policy = greedy_policy
+        self._explore_prob = explore_prob
         self._active = greedy_policy
 
     def restore(self, is_async: bool = False) -> bool:
@@ -350,12 +383,14 @@ class PerEpisodeSwitchPolicy(Policy):
         self._explore_policy.init_randomly()
         self._greedy_policy.init_randomly()
 
-    def reset(self, explore_prob: float = 0.0) -> None:
+    def reset(self, explore_prob: Optional[float] = None) -> None:
+        if explore_prob is not None:
+            self._explore_prob = explore_prob
         self._explore_policy.reset()
         self._greedy_policy.reset()
         self._active = (
             self._explore_policy
-            if self._rng.uniform() < explore_prob
+            if self._rng.uniform() < self._explore_prob
             else self._greedy_policy
         )
 

@@ -1,0 +1,205 @@
+"""Grasp2Vec model + preprocessor.
+
+Behavioral reference: tensor2robot/research/grasp2vec/grasp2vec_model.py.
+Learning signal: embedding arithmetic pre - post ≈ goal via bidirectional
+n-pairs (or triplet) loss over per-image ResNet embeddings. Unsupervised —
+the label spec is empty.
+
+TPU notes: pre/post scene images are concatenated into one megabatch so the
+scene tower runs a single large MXU-friendly forward pass (reference
+:190-197); crops/flips happen in the preprocessor with explicit rng.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensor2robot_tpu.models.abstract_model import MODE_TRAIN, FlaxT2RModel
+from tensor2robot_tpu.research.grasp2vec import losses
+from tensor2robot_tpu.research.grasp2vec.networks import Embedding
+from tensor2robot_tpu.preprocessors.abstract_preprocessor import (
+    SpecTransformationPreprocessor,
+)
+from tensor2robot_tpu.specs import ExtendedTensorSpec, TensorSpecStruct
+
+# (min_offset_height, max_offset_height, target_height,
+#  min_offset_width, max_offset_width, target_width)
+CropParams = Tuple[int, int, int, int, int, int]
+_DEFAULT_CROP: CropParams = (0, 40, 472, 0, 168, 472)
+
+_IMAGE_KEYS = ("pregrasp_image", "postgrasp_image", "goal_image")
+
+
+def maybe_crop_images(
+    images, params: CropParams, mode: str, rng: Optional[jax.Array]
+):
+    """Crops a list of images with one shared offset: random within
+    [min, max) for train, centered otherwise (reference
+    grasp2vec_model.py:45-74)."""
+    (min_oh, max_oh, target_h, min_ow, max_ow, target_w) = params
+    if mode == MODE_TRAIN and rng is not None:
+        rng_h, rng_w = jax.random.split(rng)
+        offset_h = jax.random.randint(rng_h, (), min_oh, max(max_oh, min_oh + 1))
+        offset_w = jax.random.randint(rng_w, (), min_ow, max(max_ow, min_ow + 1))
+    else:
+        offset_h = jnp.asarray((min_oh + max_oh) // 2)
+        offset_w = jnp.asarray((min_ow + max_ow) // 2)
+    out = [
+        jax.lax.dynamic_slice(
+            img,
+            (0, offset_h, offset_w, 0),
+            (img.shape[0], target_h, target_w, img.shape[3]),
+        )
+        for img in images
+    ]
+    return out, offset_h, offset_w
+
+
+def _random_flips(image: jax.Array, rng: jax.Array) -> jax.Array:
+    """Independent per-image left-right and up-down flips."""
+    rng_lr, rng_ud = jax.random.split(rng)
+    batch = image.shape[0]
+    flip_lr = jax.random.bernoulli(rng_lr, shape=(batch,))
+    flip_ud = jax.random.bernoulli(rng_ud, shape=(batch,))
+    image = jnp.where(flip_lr[:, None, None, None], image[:, :, ::-1, :], image)
+    return jnp.where(flip_ud[:, None, None, None], image[:, ::-1, :, :], image)
+
+
+class Grasp2VecPreprocessor(SpecTransformationPreprocessor):
+    """512x640 jpeg uint8 source -> crop -> float [0,1] -> random flips
+    (reference Grasp2VecPreprocessor, grasp2vec_model.py:77-135)."""
+
+    def __init__(
+        self,
+        model_spec_provider=None,
+        scene_crop: CropParams = _DEFAULT_CROP,
+        goal_crop: CropParams = _DEFAULT_CROP,
+    ):
+        super().__init__(model_spec_provider)
+        self._scene_crop = scene_crop
+        self._goal_crop = goal_crop
+
+    def _transform_in_feature_specification(self, spec, mode):
+        for name in _IMAGE_KEYS:
+            self.update_spec(
+                spec,
+                name,
+                shape=(512, 640, 3),
+                dtype=np.uint8,
+                data_format="jpeg",
+            )
+        return spec
+
+    def _preprocess_fn(self, features, labels, mode, rng):
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        rng_scene, rng_goal, rng_flip = jax.random.split(rng, 3)
+        scene, _, _ = maybe_crop_images(
+            [features["pregrasp_image"], features["postgrasp_image"]],
+            self._scene_crop,
+            mode,
+            rng_scene,
+        )
+        features["pregrasp_image"] = scene[0]
+        features["postgrasp_image"] = scene[1]
+        features["goal_image"] = maybe_crop_images(
+            [features["goal_image"]], self._goal_crop, mode, rng_goal
+        )[0][0]
+        for i, name in enumerate(_IMAGE_KEYS):
+            image = features[name].astype(jnp.float32) / 255.0
+            if mode == MODE_TRAIN:
+                image = _random_flips(image, jax.random.fold_in(rng_flip, i))
+            features[name] = image
+        return features, labels
+
+
+class _Grasp2VecNetwork(nn.Module):
+    resnet_size: int = 50
+
+    @nn.compact
+    def __call__(self, features, mode: str):
+        train = mode == MODE_TRAIN
+        # One megabatch through the scene tower for pre+post.
+        scene_images = jnp.concatenate(
+            [features["pregrasp_image"], features["postgrasp_image"]], axis=0
+        )
+        v, s = Embedding(self.resnet_size, name="scene")(scene_images, train)
+        pre_v, post_v = jnp.split(v, 2, axis=0)
+        pre_s, post_s = jnp.split(s, 2, axis=0)
+        goal_v, goal_s = Embedding(self.resnet_size, name="goal")(
+            features["goal_image"], train
+        )
+        out = TensorSpecStruct()
+        out["pre_vector"] = pre_v
+        out["post_vector"] = post_v
+        out["pre_spatial"] = pre_s
+        out["post_spatial"] = post_s
+        out["goal_vector"] = goal_v
+        out["goal_spatial"] = goal_s
+        return out
+
+
+class Grasp2VecModel(FlaxT2RModel):
+    """Grasp2Vec T2R model (reference grasp2vec_model.py:138-240)."""
+
+    def __init__(
+        self,
+        scene_size: Tuple[int, int] = (472, 472),
+        goal_size: Tuple[int, int] = (472, 472),
+        embedding_loss_fn: Callable = losses.npairs_embedding_loss,
+        resnet_size: int = 50,
+        preprocessor_cls=None,
+        **kwargs,
+    ):
+        super().__init__(
+            preprocessor_cls=preprocessor_cls or Grasp2VecPreprocessor,
+            **kwargs,
+        )
+        self._scene_size = tuple(scene_size)
+        self._goal_size = tuple(goal_size)
+        self._embedding_loss_fn = embedding_loss_fn
+        self._resnet_size = resnet_size
+
+    def get_feature_specification(self, mode):
+        spec = TensorSpecStruct()
+        spec["pregrasp_image"] = ExtendedTensorSpec(
+            shape=self._scene_size + (3,),
+            dtype=np.float32,
+            name="image",
+            data_format="jpeg",
+        )
+        spec["postgrasp_image"] = ExtendedTensorSpec(
+            shape=self._scene_size + (3,),
+            dtype=np.float32,
+            name="postgrasp_image",
+            data_format="jpeg",
+        )
+        spec["goal_image"] = ExtendedTensorSpec(
+            shape=self._goal_size + (3,),
+            dtype=np.float32,
+            name="present_image",
+            data_format="jpeg",
+        )
+        return spec
+
+    def get_label_specification(self, mode):
+        # Unsupervised: no labels.
+        return TensorSpecStruct()
+
+    def create_network(self):
+        return _Grasp2VecNetwork(resnet_size=self._resnet_size)
+
+    def model_train_fn(self, features, labels, inference_outputs, mode):
+        embed_loss = self._embedding_loss_fn(
+            inference_outputs["pre_vector"],
+            inference_outputs["goal_vector"],
+            inference_outputs["post_vector"],
+        )
+        if isinstance(embed_loss, tuple):  # triplet returns (loss, pairs, labels)
+            embed_loss = embed_loss[0]
+        return embed_loss, {"embed_loss": embed_loss}

@@ -1,0 +1,137 @@
+"""Grasp2Vec embedding losses, jnp-native.
+
+Behavioral reference: tensor2robot/research/grasp2vec/losses.py:20-200.
+The tf_slim metric-learning primitives the reference calls (npairs_loss,
+triplet_semihard_loss) are reimplemented here / in layers.tec with matching
+semantics. Masked variants replace tf.dynamic_partition + tf.cond with
+where-masked means, which XLA prefers (no data-dependent shapes).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from tensor2robot_tpu.layers.tec import triplet_semihard_loss
+
+
+def npairs_loss(
+    labels: jax.Array,
+    embeddings_anchor: jax.Array,
+    embeddings_positive: jax.Array,
+    reg_lambda: float = 0.002,
+) -> jax.Array:
+    """N-pairs loss (tf_slim metric_learning.npairs_loss semantics):
+    softmax cross-entropy over the anchor-positive similarity matrix with
+    same-label targets, plus an L2 activation regularizer."""
+    reg_anchor = jnp.mean(jnp.sum(jnp.square(embeddings_anchor), 1))
+    reg_positive = jnp.mean(jnp.sum(jnp.square(embeddings_positive), 1))
+    l2loss = 0.25 * reg_lambda * (reg_anchor + reg_positive)
+
+    similarity = embeddings_anchor @ embeddings_positive.T
+    same_label = (labels[:, None] == labels[None, :]).astype(similarity.dtype)
+    targets = same_label / jnp.sum(same_label, axis=1, keepdims=True)
+    xent = jnp.mean(optax.softmax_cross_entropy(similarity, targets))
+    return xent + l2loss
+
+
+def _masked_mean(values: jax.Array, mask: jax.Array) -> jax.Array:
+    """Mean over mask==1 entries; 0 when the mask is empty (replaces the
+    reference's dynamic_partition + cond)."""
+    mask = mask.reshape(-1).astype(values.dtype)
+    total = jnp.sum(mask)
+    return jnp.where(
+        total > 0, jnp.sum(values * mask) / jnp.maximum(total, 1.0), 0.0
+    )
+
+
+def l2_arithmetic_loss(
+    pregrasp_embedding: jax.Array,
+    goal_embedding: jax.Array,
+    postgrasp_embedding: jax.Array,
+    mask: jax.Array,
+) -> jax.Array:
+    """||pre - goal - post||^2 averaged over masked examples
+    (reference losses.py:31-54)."""
+    raw = pregrasp_embedding - goal_embedding - postgrasp_embedding
+    distances = jnp.sum(jnp.square(raw), axis=1)
+    return _masked_mean(distances, mask)
+
+
+def cosine_arithmetic_loss(
+    pregrasp_embedding: jax.Array,
+    goal_embedding: jax.Array,
+    postgrasp_embedding: jax.Array,
+    mask: jax.Array,
+) -> jax.Array:
+    """Cosine distance between normalized (pre - post) and goal
+    (reference losses.py:83-113)."""
+    pair_a = _l2_normalize(pregrasp_embedding - postgrasp_embedding)
+    pair_b = _l2_normalize(goal_embedding)
+    distances = 1.0 - jnp.sum(pair_a * pair_b, axis=1)
+    return _masked_mean(distances, mask)
+
+
+def _l2_normalize(x: jax.Array, axis: int = 1) -> jax.Array:
+    return x / jnp.maximum(jnp.linalg.norm(x, axis=axis, keepdims=True), 1e-12)
+
+
+def triplet_embedding_loss(
+    pregrasp_embedding: jax.Array,
+    goal_embedding: jax.Array,
+    postgrasp_embedding: jax.Array,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Semi-hard triplet loss over normalized (pre-post, goal) pairs
+    (reference TripletLoss, losses.py:57-80). Returns (loss, pairs, labels)."""
+    pair_a = _l2_normalize(pregrasp_embedding - postgrasp_embedding)
+    pair_b = _l2_normalize(goal_embedding)
+    n = pregrasp_embedding.shape[0]
+    labels = jnp.tile(jnp.arange(n, dtype=jnp.int32), (2,))
+    pairs = jnp.concatenate([pair_a, pair_b], axis=0)
+    loss = triplet_semihard_loss(labels, pairs, margin=3.0)
+    return loss, pairs, labels
+
+
+def npairs_embedding_loss(
+    pregrasp_embedding: jax.Array,
+    goal_embedding: jax.Array,
+    postgrasp_embedding: jax.Array,
+    non_negativity_constraint: bool = False,
+) -> jax.Array:
+    """Bidirectional n-pairs loss over (pre - post, goal)
+    (reference NPairsLoss, losses.py:161-196)."""
+    pair_a = pregrasp_embedding - postgrasp_embedding
+    if non_negativity_constraint:
+        pair_a = jax.nn.relu(pair_a)
+    pair_b = goal_embedding
+    labels = jnp.arange(pregrasp_embedding.shape[0], dtype=jnp.int32)
+    return npairs_loss(labels, pair_a, pair_b) + npairs_loss(
+        labels, pair_b, pair_a
+    )
+
+
+def keypoint_accuracy(
+    keypoints: jax.Array, labels: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    """Quadrant accuracy of spatial-softmax keypoints (Shapes dataset;
+    reference losses.py:116-141). Returns (accuracy, loss)."""
+    keypoints = keypoints.reshape(-1, 2)
+    quadrant_centers = jnp.asarray(
+        [[0.5, -0.5], [-0.5, -0.5], [0.5, 0.5], [-0.5, 0.5]],
+        dtype=jnp.float32,
+    )
+    logits = keypoints @ quadrant_centers.T
+    predictions = jnp.argmax(logits, axis=1)
+    correct = (labels == predictions).astype(jnp.float32)
+    labels_onehot = jax.nn.one_hot(labels, 4)
+    loss = jnp.mean(optax.sigmoid_binary_cross_entropy(logits, labels_onehot))
+    return jnp.mean(correct), loss
+
+
+def send_to_zero_loss(tensor: jax.Array, mask: jax.Array) -> jax.Array:
+    """Mean L2 norm of masked rows (reference losses.py:144-158)."""
+    distances = jnp.linalg.norm(tensor, axis=1)
+    return _masked_mean(distances, mask)

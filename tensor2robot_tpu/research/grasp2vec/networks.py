@@ -1,0 +1,40 @@
+"""Grasp2Vec embedding towers.
+
+Behavioral reference: tensor2robot/research/grasp2vec/networks.py:24-42
+(Embedding): ResNet spatial features -> relu -> mean-pooled vector.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tensor2robot_tpu.layers.resnet import ResNet
+
+
+class Embedding(nn.Module):
+    """Scene/goal embedding tower. Returns (summed embedding [B, C],
+    spatial embedding map [B, h, w, C]).
+
+    resnet_size is configurable (the reference pins ResNet50,
+    grasp2vec/resnet.py:538); smaller sizes keep unit tests cheap.
+    """
+
+    resnet_size: int = 50
+
+    @nn.compact
+    def __call__(
+        self, image: jax.Array, train: bool = False
+    ) -> Tuple[jax.Array, jax.Array]:
+        resnet = ResNet(
+            num_classes=1, resnet_size=self.resnet_size, name="resnet"
+        )
+        _, endpoints = resnet(
+            image, train, return_intermediate_values=True
+        )
+        spatial = nn.relu(endpoints["block_layer4"])
+        summed = jnp.mean(spatial, axis=(1, 2))
+        return summed, spatial

@@ -1,0 +1,123 @@
+"""Grasp2Vec heatmap / keypoint visualizations.
+
+Behavioral reference: tensor2robot/research/grasp2vec/visualization.py:78-260.
+The reference writes TF summaries; here the functions return image arrays —
+callers hand them to the metrics writer (train.metrics) or dump them to disk.
+Heatmap math is jnp (device-side); rasterization is numpy (host-side, viz
+only).
+"""
+
+from __future__ import annotations
+
+import colorsys
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def compute_heatmap(
+    feature_query: jax.Array, feature_map: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    """Dot product of a query embedding over a spatial feature map
+    (reference add_heatmap_summary :78-98).
+
+    Args:
+      feature_query: [B, D] goal embeddings.
+      feature_map: [B, h, w, D] scene embeddings.
+
+    Returns:
+      (heatmaps [B, h, w, 1], softmaxed heatmaps [B, h, w, 1]).
+    """
+    batch, dim = feature_query.shape
+    query = feature_query.reshape(batch, 1, 1, dim)
+    heatmaps = jnp.sum(feature_map * query, axis=3, keepdims=True)
+    flat = heatmaps.reshape(batch, -1)
+    softmaxed = jax.nn.softmax(flat, axis=-1).reshape(heatmaps.shape)
+    return heatmaps, softmaxed
+
+
+def heatmap_soft_argmax(heatmaps: jax.Array, temperature: float = 0.1) -> jax.Array:
+    """Expected (x, y) location of a [B, h, w, 1] heatmap
+    (reference add_spatial_softmax :101-111). Returns [B, 1, 2] xy in [-1, 1]."""
+    from tensor2robot_tpu.layers.spatial_softmax import spatial_softmax
+
+    points, _ = spatial_softmax(heatmaps, temperature=temperature)
+    x, y = jnp.split(points, 2, axis=-1)
+    return jnp.concatenate([x, y], axis=-1)[:, None, :]
+
+
+def np_render_keypoints(
+    image: np.ndarray,
+    locations: np.ndarray,
+    num_images: int = 3,
+    dot_radius: int = 3,
+) -> np.ndarray:
+    """Rasterizes soft-argmax locations as colored dots on greyed images
+    (reference np_render_keypoints :112-152)."""
+    num_images = min(num_images, image.shape[0])
+    _, h, w, _ = image.shape
+    mx, my = np.meshgrid(np.arange(w), np.arange(h))
+    num_points = locations.shape[1]
+    images = []
+    for i in range(num_images):
+        img = np.tile(np.mean(image[i], axis=2, keepdims=True), [1, 1, 3])
+        img = img / 2.0 + 0.4
+        hues = np.linspace(0, 1, num_points + 1)[:-1]
+        colors = [np.array(colorsys.hsv_to_rgb(h_, 1.0, 0.9)) for h_ in hues]
+        xs = np.round((locations[i, :, 0] + 1.0) * w / 2.0).astype(int)
+        ys = np.round((locations[i, :, 1] + 1.0) * h / 2.0).astype(int)
+        for x, y, color in zip(xs, ys, colors):
+            dist = np.sqrt((x - mx) ** 2 + (y - my) ** 2)
+            weight = np.clip(dot_radius - dist, 0.0, 1.0)
+            weight = np.tile(weight[:, :, None], [1, 1, 3])
+            img = img * (1 - weight) + weight * color.reshape(1, 1, 3)
+        images.append((img * 255).astype(np.uint8))
+    return np.stack(images, 0)
+
+
+def get_softmax_viz(
+    image: np.ndarray, softmax: np.ndarray, nrows: Optional[int] = None
+) -> np.ndarray:
+    """Arranges softmax maps in a grid superimposed on the (greyscale) image
+    via HSV encoding (reference get_softmax_viz :208-247)."""
+    batch, sh, sw, num_points = softmax.shape
+    th, tw = sh * 2, sw * 2
+    if nrows is None:
+        divs = [d for d in range(1, int(np.sqrt(num_points)) + 1)
+                if num_points % d == 0]
+        nrows = max(divs) if divs else 1
+    ncols = num_points // nrows
+
+    img = softmax / np.maximum(
+        softmax.max(axis=(1, 2), keepdims=True), 1e-12
+    )
+    grey = np.mean(image, axis=3, keepdims=True)
+    grey = np.asarray(
+        jax.image.resize(jnp.asarray(grey), (batch, th, tw, 1), "nearest")
+    )
+    grey = np.tile(grey, [1, 1, 1, num_points])[..., None]
+    img = np.asarray(
+        jax.image.resize(jnp.asarray(img), (batch, th, tw, num_points), "nearest")
+    )[..., None]
+    hsv = np.concatenate([img / 2.0 + 0.5, img, grey * 0.7 + 0.3], axis=4)
+    hsv = hsv.reshape(batch, th, tw, nrows, ncols, 3)
+    hsv = hsv.transpose(0, 3, 1, 4, 2, 5).reshape(
+        batch, th * nrows, tw * ncols, 3
+    )
+    # HSV -> RGB, vectorized.
+    h_, s, v = hsv[..., 0], hsv[..., 1], hsv[..., 2]
+    i = np.floor(h_ * 6.0) % 6
+    f = h_ * 6.0 - np.floor(h_ * 6.0)
+    p = v * (1 - s)
+    q = v * (1 - f * s)
+    t = v * (1 - (1 - f) * s)
+    rgb = np.select(
+        [i[..., None] == k for k in range(6)],
+        [
+            np.stack(c, axis=-1)
+            for c in [(v, t, p), (q, v, p), (p, v, t), (p, q, v), (t, p, v), (v, p, q)]
+        ],
+    )
+    return rgb

@@ -31,7 +31,8 @@ class CrossEntropyMethod:
     ):
         """Args:
         sample_fn: (mean, stddev, n, rng) -> [n, ...] candidate batch;
-          defaults to a clipped Gaussian.
+          defaults to an (unclipped) diagonal Gaussian — callers with box
+          bounds pass a clipping sample_fn (see CEMPolicy).
         update_fn: (elites) -> (mean, stddev); defaults to moment matching.
         elite_fraction: top fraction refit each iteration.
         num_samples: population size per iteration.

@@ -1,0 +1,163 @@
+"""Grasp2Vec workload tests (reference research/grasp2vec/*_test.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensor2robot_tpu.research import grasp2vec
+from tensor2robot_tpu.research.grasp2vec import visualization
+from tensor2robot_tpu.specs import make_random_numpy
+
+
+def small_model(**kwargs):
+    return grasp2vec.Grasp2VecModel(
+        scene_size=(32, 32),
+        goal_size=(32, 32),
+        resnet_size=18,
+        device_type="cpu",
+        **kwargs,
+    )
+
+
+class TestLosses:
+    def test_npairs_loss_prefers_matched_pairs(self):
+        rng = np.random.RandomState(0)
+        emb = jnp.asarray(rng.randn(8, 16).astype(np.float32))
+        labels = jnp.arange(8, dtype=jnp.int32)
+        matched = grasp2vec.npairs_loss(labels, emb, emb)
+        shuffled = grasp2vec.npairs_loss(labels, emb, jnp.roll(emb, 1, axis=0))
+        assert float(matched) < float(shuffled)
+
+    def test_l2_arithmetic_loss_zero_when_consistent(self):
+        pre = jnp.asarray(np.random.RandomState(0).randn(4, 8), jnp.float32)
+        post = jnp.zeros((4, 8))
+        goal = pre  # pre - goal - post == 0
+        mask = jnp.ones((4,), jnp.int32)
+        loss = grasp2vec.l2_arithmetic_loss(pre, goal, post, mask)
+        np.testing.assert_allclose(float(loss), 0.0, atol=1e-6)
+
+    def test_masked_losses_empty_mask_is_zero(self):
+        x = jnp.ones((4, 8))
+        mask = jnp.zeros((4,), jnp.int32)
+        assert float(grasp2vec.l2_arithmetic_loss(x, x, x, mask)) == 0.0
+        assert float(grasp2vec.send_to_zero_loss(x, mask)) == 0.0
+        assert np.isfinite(
+            float(grasp2vec.cosine_arithmetic_loss(x, x, x, mask))
+        )
+
+    def test_triplet_loss_finite(self):
+        rng = np.random.RandomState(0)
+        pre = jnp.asarray(rng.randn(4, 8), jnp.float32)
+        goal = jnp.asarray(rng.randn(4, 8), jnp.float32)
+        post = jnp.asarray(rng.randn(4, 8), jnp.float32)
+        loss, pairs, labels = grasp2vec.triplet_embedding_loss(pre, goal, post)
+        assert np.isfinite(float(loss))
+        assert pairs.shape == (8, 8)
+        assert labels.shape == (8,)
+
+    def test_keypoint_accuracy_perfect(self):
+        # Keypoints exactly at quadrant centers.
+        keypoints = jnp.asarray(
+            [[0.5, -0.5], [-0.5, -0.5], [0.5, 0.5], [-0.5, 0.5]]
+        )
+        labels = jnp.arange(4)
+        accuracy, loss = grasp2vec.keypoint_accuracy(keypoints, labels)
+        assert float(accuracy) == 1.0
+        assert np.isfinite(float(loss))
+
+
+class TestGrasp2VecModel:
+    def test_specs(self):
+        model = small_model()
+        spec = model.get_feature_specification("train")
+        assert spec["pregrasp_image"].shape == (32, 32, 3)
+        assert spec["goal_image"].name == "present_image"
+        assert len(model.get_label_specification("train").keys()) == 0
+
+    def test_preprocessor_specs_declare_jpeg_source(self):
+        model = small_model()
+        in_spec = model.preprocessor.get_in_feature_specification("train")
+        assert in_spec["pregrasp_image"].shape == (512, 640, 3)
+        assert in_spec["pregrasp_image"].dtype == np.uint8
+        assert in_spec["pregrasp_image"].data_format == "jpeg"
+
+    def test_preprocess_crops_and_normalizes(self):
+        model = grasp2vec.Grasp2VecModel(
+            scene_size=(472, 472), goal_size=(472, 472),
+            resnet_size=18, device_type="cpu",
+        )
+        pre = model.preprocessor
+        features = make_random_numpy(
+            pre.get_in_feature_specification("train"), batch_size=2
+        )
+        out, _ = pre.preprocess(
+            features, None, mode="train", rng=jax.random.PRNGKey(0)
+        )
+        assert out["pregrasp_image"].shape == (2, 472, 472, 3)
+        assert out["pregrasp_image"].dtype == jnp.float32
+        assert float(jnp.max(out["pregrasp_image"])) <= 1.0
+
+    def test_forward_and_loss(self):
+        model = small_model()
+        features = {
+            "pregrasp_image": jnp.asarray(
+                np.random.RandomState(0).rand(2, 32, 32, 3), jnp.float32
+            ),
+            "postgrasp_image": jnp.asarray(
+                np.random.RandomState(1).rand(2, 32, 32, 3), jnp.float32
+            ),
+            "goal_image": jnp.asarray(
+                np.random.RandomState(2).rand(2, 32, 32, 3), jnp.float32
+            ),
+        }
+        variables = model.init_variables(jax.random.PRNGKey(0), features)
+        outputs, _ = model.inference_network_fn(variables, features, "eval")
+        assert outputs["pre_vector"].shape == (2, 512)
+        assert outputs["goal_spatial"].shape[0] == 2
+        loss, metrics = model.model_train_fn(features, {}, outputs, "train")
+        assert np.isfinite(float(loss))
+        assert "embed_loss" in metrics
+
+    def test_triplet_loss_variant(self):
+        model = small_model(
+            embedding_loss_fn=grasp2vec.triplet_embedding_loss
+        )
+        features = {
+            k: jnp.zeros((2, 32, 32, 3))
+            for k in ["pregrasp_image", "postgrasp_image", "goal_image"]
+        }
+        variables = model.init_variables(jax.random.PRNGKey(0), features)
+        outputs, _ = model.inference_network_fn(variables, features, "eval")
+        loss, _ = model.model_train_fn(features, {}, outputs, "train")
+        assert np.isfinite(float(loss))
+
+
+class TestVisualization:
+    def test_heatmap_shapes(self):
+        query = jnp.ones((2, 16))
+        fmap = jnp.ones((2, 5, 7, 16))
+        heatmaps, softmaxed = visualization.compute_heatmap(query, fmap)
+        assert heatmaps.shape == (2, 5, 7, 1)
+        np.testing.assert_allclose(
+            np.asarray(softmaxed.sum(axis=(1, 2, 3))), 1.0, atol=1e-5
+        )
+
+    def test_soft_argmax_peak(self):
+        heatmap = np.full((1, 9, 9, 1), -1e9, np.float32)
+        heatmap[0, 4, 8, 0] = 0.0  # right edge center -> x=1, y=0
+        xy = visualization.heatmap_soft_argmax(jnp.asarray(heatmap))
+        np.testing.assert_allclose(np.asarray(xy[0, 0]), [1.0, 0.0], atol=1e-4)
+
+    def test_render_keypoints(self):
+        image = np.random.RandomState(0).rand(2, 32, 32, 3)
+        locations = np.zeros((2, 4, 2))
+        out = visualization.np_render_keypoints(image, locations, num_images=2)
+        assert out.shape == (2, 32, 32, 3)
+        assert out.dtype == np.uint8
+
+    def test_softmax_viz_grid(self):
+        image = np.random.RandomState(0).rand(1, 16, 16, 3)
+        softmax = np.random.RandomState(1).rand(1, 8, 8, 4)
+        out = visualization.get_softmax_viz(image, softmax)
+        assert out.shape == (1, 16 * 2, 16 * 2, 3)

@@ -1,0 +1,288 @@
+"""Layer library tests: shapes + numerics (reference layers/*_test.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensor2robot_tpu import layers
+
+
+class TestSpatialSoftmax:
+    def test_delta_feature_map_recovers_location(self):
+        # A single hot pixel per feature map -> expected point at its coords.
+        batch, h, w, c = 2, 9, 9, 3
+        features = np.full((batch, h, w, c), -1e9, np.float32)
+        # Feature 0 peak at (row 0, col 8) -> x=+1, y=-1.
+        features[:, 0, 8, 0] = 0.0
+        # Feature 1 peak at center -> (0, 0).
+        features[:, 4, 4, 1] = 0.0
+        # Feature 2 peak at (row 8, col 0) -> x=-1, y=+1.
+        features[:, 8, 0, 2] = 0.0
+        points, softmax = layers.spatial_softmax(jnp.asarray(features))
+        assert points.shape == (batch, 2 * c)
+        assert softmax.shape == (batch, h, w, c)
+        np.testing.assert_allclose(
+            points[0], [1.0, 0.0, -1.0, -1.0, 0.0, 1.0], atol=1e-5
+        )
+        np.testing.assert_allclose(np.sum(softmax, axis=(1, 2)), 1.0, atol=1e-5)
+
+    def test_gumbel_mode_runs(self):
+        features = jnp.zeros((1, 4, 4, 2))
+        points, _ = layers.spatial_softmax(
+            features, gumbel_rng=jax.random.PRNGKey(0)
+        )
+        assert points.shape == (1, 4)
+
+
+class TestVisionLayers:
+    def test_images_to_features_shapes(self):
+        model = layers.ImagesToFeaturesNet()
+        images = jnp.zeros((2, 64, 64, 3))
+        variables = model.init(jax.random.PRNGKey(0), images)
+        points, extra = model.apply(variables, images)
+        assert points.shape == (2, 64)  # 2 * num_output_maps
+        assert "softmax" in extra
+
+    def test_film_changes_output(self):
+        model = layers.ImagesToFeaturesNet(num_blocks=2)
+        images = jax.random.uniform(jax.random.PRNGKey(1), (2, 32, 32, 3))
+        film = jnp.ones((2, 2 * 2 * 32))
+        variables = model.init(jax.random.PRNGKey(0), images, False, film)
+        with_film, _ = model.apply(variables, images, False, film)
+        without, _ = model.apply(variables, images, False, jnp.zeros_like(film))
+        assert not np.allclose(np.asarray(with_film), np.asarray(without))
+
+    def test_film_wrong_size_raises(self):
+        model = layers.ImagesToFeaturesNet(num_blocks=2)
+        images = jnp.zeros((2, 32, 32, 3))
+        with pytest.raises(ValueError):
+            model.init(jax.random.PRNGKey(0), images, False, jnp.ones((2, 7)))
+
+    def test_high_res_net(self):
+        model = layers.ImagesToFeaturesHighResNet(num_blocks=3)
+        images = jnp.zeros((1, 128, 128, 3))
+        variables = model.init(jax.random.PRNGKey(0), images)
+        points, extra = model.apply(variables, images)
+        assert points.shape == (1, 64)
+        assert extra["softmax"].ndim == 4
+
+    def test_pose_head_with_aux(self):
+        model = layers.ImageFeaturesToPoseNet(num_outputs=7, aux_output_dim=3)
+        feats = jnp.zeros((4, 64))
+        aux = jnp.zeros((4, 5))
+        variables = model.init(jax.random.PRNGKey(0), feats, aux)
+        pose, aux_out = model.apply(variables, feats, aux)
+        assert pose.shape == (4, 7)
+        assert aux_out.shape == (4, 3)
+
+    def test_film_params_layer(self):
+        model = layers.FilmParams(film_output_size=320)
+        emb = jnp.zeros((2, 16))
+        variables = model.init(jax.random.PRNGKey(0), emb)
+        assert model.apply(variables, emb).shape == (2, 320)
+
+
+class TestResNet:
+    @pytest.mark.parametrize("size,version", [(18, 1), (18, 2), (50, 2)])
+    def test_shapes_and_endpoints(self, size, version):
+        model = layers.ResNet(num_classes=10, resnet_size=size, version=version)
+        images = jnp.zeros((2, 64, 64, 3))
+        variables = model.init(jax.random.PRNGKey(0), images)
+        logits, endpoints = model.apply(
+            variables, images, False, None, True
+        )
+        assert logits.shape == (2, 10)
+        expected_c = 512 * (4 if size >= 50 else 1)
+        assert endpoints["block_layer4"].shape[-1] == expected_c
+        assert endpoints["final_dense"].shape == (2, 10)
+
+    def test_film_conditioning_changes_output(self):
+        model = layers.ResNet(num_classes=4, resnet_size=18)
+        images = jax.random.uniform(jax.random.PRNGKey(1), (2, 32, 32, 3))
+        emb = jnp.ones((2, 8))
+        variables = model.init(jax.random.PRNGKey(0), images, False, emb)
+        out1 = model.apply(variables, images, False, emb)
+        out2 = model.apply(variables, images, False, jnp.zeros_like(emb))
+        assert not np.allclose(np.asarray(out1), np.asarray(out2))
+
+    def test_batch_stats_update_in_train(self):
+        model = layers.ResNet(num_classes=2, resnet_size=18)
+        images = jax.random.uniform(jax.random.PRNGKey(1), (2, 32, 32, 3))
+        variables = model.init(jax.random.PRNGKey(0), images)
+        _, mutated = model.apply(
+            variables, images, True, mutable=["batch_stats"]
+        )
+        assert "batch_stats" in mutated
+
+    def test_invalid_size_raises(self):
+        with pytest.raises(ValueError):
+            layers.get_block_sizes(42)
+
+
+class TestSnail:
+    def test_causal_conv_shape_preserved(self):
+        model = layers.CausalConv(filters=8, dilation_rate=2)
+        x = jnp.zeros((3, 16, 4))
+        variables = model.init(jax.random.PRNGKey(0), x)
+        assert model.apply(variables, x).shape == (3, 16, 8)
+
+    def test_causality(self):
+        # Changing a later timestep must not change earlier outputs.
+        model = layers.TCBlock(sequence_length=8, filters=4)
+        x = jax.random.normal(jax.random.PRNGKey(0), (1, 8, 3))
+        variables = model.init(jax.random.PRNGKey(1), x)
+        y1 = model.apply(variables, x)
+        x2 = x.at[0, 5, :].set(100.0)
+        y2 = model.apply(variables, x2)
+        np.testing.assert_allclose(
+            np.asarray(y1[0, :5]), np.asarray(y2[0, :5]), atol=1e-5
+        )
+        assert y1.shape == (1, 8, 3 + 3 * 4)  # log2(8)=3 dense blocks
+
+    def test_attention_block_causal(self):
+        model = layers.AttentionBlock(key_size=8, value_size=6)
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 10, 5))
+        variables = model.init(jax.random.PRNGKey(1), x)
+        out, end_points = model.apply(variables, x)
+        assert out.shape == (2, 10, 5 + 6)
+        probs = np.asarray(end_points["attn_prob"])
+        # Upper triangle must be exactly zero.
+        for i in range(10):
+            np.testing.assert_allclose(probs[:, i, i + 1 :], 0.0, atol=1e-7)
+            np.testing.assert_allclose(
+                probs[:, i, : i + 1].sum(-1), 1.0, atol=1e-5
+            )
+
+    def test_masked_softmax_rows_sum_to_one(self):
+        logits = jax.random.normal(jax.random.PRNGKey(0), (2, 6, 6))
+        probs = layers.causally_masked_softmax(logits)
+        np.testing.assert_allclose(
+            np.asarray(probs.sum(-1)), 1.0, atol=1e-5
+        )
+
+
+class TestMDN:
+    def test_param_packing_and_log_prob(self):
+        num_alphas, d = 3, 2
+        rng = np.random.RandomState(0)
+        params = rng.randn(4, num_alphas + 2 * num_alphas * d).astype(np.float32)
+        gm = layers.get_mixture_distribution(jnp.asarray(params), num_alphas, d)
+        x = jnp.asarray(rng.randn(4, d).astype(np.float32))
+        logp = gm.log_prob(x)
+        assert logp.shape == (4,)
+        # Manual reference computation.
+        alphas = params[:, :num_alphas]
+        mus = params[:, num_alphas : num_alphas + num_alphas * d].reshape(
+            4, num_alphas, d
+        )
+        sigmas = (
+            np.log1p(np.exp(params[:, num_alphas + num_alphas * d :]))
+            .reshape(4, num_alphas, d)
+            + 1e-4
+        )
+        log_mix = alphas - np.log(np.sum(np.exp(alphas), -1, keepdims=True))
+        comp = -0.5 * np.sum(
+            ((np.asarray(x)[:, None] - mus) / sigmas) ** 2, -1
+        ) - np.sum(np.log(sigmas), -1) - 0.5 * d * np.log(2 * np.pi)
+        expected = np.log(np.sum(np.exp(log_mix + comp), -1))
+        np.testing.assert_allclose(np.asarray(logp), expected, rtol=1e-4)
+
+    def test_wrong_param_size_raises(self):
+        with pytest.raises(ValueError):
+            layers.get_mixture_distribution(jnp.zeros((2, 5)), 3, 2)
+
+    def test_approximate_mode_picks_top_component(self):
+        logits = jnp.asarray([[10.0, -10.0]])
+        mus = jnp.asarray([[[1.0, 2.0], [3.0, 4.0]]])
+        sigmas = jnp.ones((1, 2, 2))
+        gm = layers.GaussianMixture(logits, mus, sigmas)
+        np.testing.assert_allclose(
+            np.asarray(gm.approximate_mode()), [[1.0, 2.0]]
+        )
+
+    def test_decoder_end_to_end(self):
+        model = layers.MDNDecoder(num_mixture_components=2)
+        inputs = jnp.zeros((4, 6, 8))  # works over extra batch dims
+        variables = model.init(jax.random.PRNGKey(0), inputs, 3)
+        action, gm = model.apply(variables, inputs, 3)
+        assert action.shape == (4, 6, 3)
+        targets = jnp.zeros((4, 6, 3))
+        loss = layers.mdn_loss(gm, targets)
+        assert np.isfinite(float(loss))
+
+    def test_sample_shape(self):
+        gm = layers.GaussianMixture(
+            jnp.zeros((5, 3)), jnp.zeros((5, 3, 2)), jnp.ones((5, 3, 2))
+        )
+        assert gm.sample(jax.random.PRNGKey(0)).shape == (5, 2)
+
+
+class TestTEC:
+    def test_embed_fullstate(self):
+        model = layers.EmbedFullstate(embed_size=16)
+        x = jnp.zeros((4, 10))
+        variables = model.init(jax.random.PRNGKey(0), x)
+        assert model.apply(variables, x).shape == (4, 16)
+
+    def test_embed_condition_images_fc(self):
+        model = layers.EmbedConditionImages(fc_layers=(32, 8))
+        images = jnp.zeros((2, 64, 64, 3))
+        variables = model.init(jax.random.PRNGKey(0), images)
+        assert model.apply(variables, images).shape == (2, 8)
+
+    def test_embed_condition_images_rank_check(self):
+        model = layers.EmbedConditionImages()
+        with pytest.raises(ValueError):
+            model.init(jax.random.PRNGKey(0), jnp.zeros((2, 64, 64)))
+
+    def test_reduce_temporal_embeddings(self):
+        model = layers.ReduceTemporalEmbeddings(output_size=12)
+        x = jnp.zeros((3, 40, 20))
+        variables = model.init(jax.random.PRNGKey(0), x)
+        assert model.apply(variables, x).shape == (3, 12)
+
+    def test_reduce_temporal_avg_mode(self):
+        model = layers.ReduceTemporalEmbeddings(
+            output_size=12, combine_mode="avg"
+        )
+        x = jnp.zeros((3, 40, 20))
+        variables = model.init(jax.random.PRNGKey(0), x)
+        assert model.apply(variables, x).shape == (3, 12)
+
+    def test_contrastive_loss_zero_for_perfect(self):
+        # Positive at distance 0, negative beyond margin -> zero loss.
+        anchor = jnp.asarray([[1.0, 0.0]])
+        emb = jnp.asarray([[1.0, 0.0], [-5.0, 0.0]])
+        labels = jnp.asarray([True, False])
+        loss = layers.contrastive_loss(labels, anchor, emb)
+        np.testing.assert_allclose(float(loss), 0.0, atol=1e-6)
+
+    @pytest.mark.parametrize(
+        "mode",
+        [
+            "default",
+            "both_directions",
+            "reverse_direction",
+            "cross_entropy",
+            "triplet",
+        ],
+    )
+    def test_embedding_contrastive_modes(self, mode):
+        rng = jax.random.PRNGKey(0)
+        inf_e = jax.random.normal(rng, (4, 2, 8))
+        con_e = jax.random.normal(jax.random.PRNGKey(1), (4, 3, 8))
+        inf_e = inf_e / jnp.linalg.norm(inf_e, axis=-1, keepdims=True)
+        con_e = con_e / jnp.linalg.norm(con_e, axis=-1, keepdims=True)
+        loss = layers.compute_embedding_contrastive_loss(
+            inf_e, con_e, contrastive_loss_mode=mode
+        )
+        assert np.isfinite(float(loss))
+
+    def test_embedding_contrastive_bad_mode(self):
+        with pytest.raises(ValueError):
+            layers.compute_embedding_contrastive_loss(
+                jnp.zeros((2, 1, 4)),
+                jnp.zeros((2, 1, 4)),
+                contrastive_loss_mode="nope",
+            )

@@ -165,6 +165,27 @@ class TestCEMPolicy:
 # -- regression policies over a fake predictor --------------------------------
 
 
+class TestCEMBounds:
+    def test_cem_respects_asymmetric_bounds(self):
+        # Objective favors the upper edge of [0, 1]; with mean seeded at the
+        # box center and clipped sampling, CEM must find it.
+        from tensor2robot_tpu.utils.cross_entropy import CrossEntropyMethod
+
+        def sample_clipped(mean, stddev, n, rng):
+            s = rng.normal(mean[None], stddev[None], (n,) + mean.shape)
+            return np.clip(s, 0.0, 1.0)
+
+        cem = CrossEntropyMethod(
+            sample_fn=sample_clipped, num_samples=128, num_iterations=5, seed=0
+        )
+        objective = lambda a: -np.sum((a - 0.9) ** 2, axis=-1)
+        mean, _, best, _ = cem.run(
+            objective, np.full((3,), 0.5), np.full((3,), 0.5)
+        )
+        np.testing.assert_allclose(best, 0.9, atol=0.1)
+        assert np.all(mean >= 0.0) and np.all(mean <= 1.0)
+
+
 class _FakeRegressionPredictor(AbstractPredictor):
     """Action = obs[:1] * 2, counts restores."""
 
@@ -250,6 +271,16 @@ class TestRegressionPolicies:
         switch.reset(explore_prob=0.0)
         assert switch.active_policy is greedy
         switch.reset(explore_prob=1.0)
+        assert switch.active_policy is explore
+
+    def test_per_episode_switch_constructor_prob_survives_bare_reset(self):
+        # run_env calls reset() with no args; the constructor-owned
+        # explore_prob must drive the switch (reference policies.py:335-346).
+        greedy = RegressionPolicy(_FakeRegressionPredictor())
+        explore = OUExploreRegressionPolicy(_FakeRegressionPredictor())
+        switch = PerEpisodeSwitchPolicy(explore, greedy, explore_prob=1.0)
+        switch.seed(0)
+        switch.reset()
         assert switch.active_policy is explore
 
 
