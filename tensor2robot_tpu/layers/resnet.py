@@ -118,10 +118,15 @@ class _Block(nn.Module):
             x = _ConvFixedPadding(self.filters, 3, self.strides, name="conv2")(x)
             x = _BatchNorm(name="bn2")(x, train)
             if self.version == 1:
+                # FiLM at the filters-wide bn2 point for both versions. (The
+                # reference nominally modulates v1-bottleneck after bn3, but
+                # validates generator outputs at 2*filters —
+                # film_resnet_model.py:600 — so that path could never run;
+                # we keep the generator contract uniform instead.)
+                x = apply_film(x, film_gamma_beta)
                 x = nn.relu(x)
                 x = _ConvFixedPadding(out_filters, 1, 1, name="conv3")(x)
                 x = _BatchNorm(name="bn3")(x, train)
-                x = apply_film(x, film_gamma_beta)
                 return nn.relu(x + shortcut)
             x = apply_film(x, film_gamma_beta)
             x = nn.relu(x)

@@ -169,7 +169,7 @@ def triplet_semihard_loss(
     )  # [anchor]
     has_semihard = jnp.any(semihard_mask, axis=2)
     neg_dist = jnp.where(has_semihard, min_semihard, max_neg[:, None])
-    loss_mat = jnp.maximum(dist[:, :, None].squeeze(-1) - neg_dist + margin, 0.0)
+    loss_mat = jnp.maximum(dist - neg_dist + margin, 0.0)
     num_pos = jnp.maximum(jnp.sum(pos_mask), 1)
     return jnp.sum(jnp.where(pos_mask, loss_mat, 0.0)) / num_pos
 

@@ -110,10 +110,20 @@ class Grasp2VecPreprocessor(SpecTransformationPreprocessor):
         features["goal_image"] = maybe_crop_images(
             [features["goal_image"]], self._goal_crop, mode, rng_goal
         )[0][0]
-        for i, name in enumerate(_IMAGE_KEYS):
+        # The scene pair shares one flip decision so pre/post stay spatially
+        # aligned (the shared-crop invariant); the goal image flips
+        # independently. (The reference flips every key independently,
+        # grasp2vec_model.py:128-131 — a weaker choice we deliberately
+        # tighten, since `pre - post ≈ goal` compares the scene pair.)
+        flip_rngs = {
+            "pregrasp_image": rng_flip,
+            "postgrasp_image": rng_flip,
+            "goal_image": jax.random.fold_in(rng_flip, 1),
+        }
+        for name in _IMAGE_KEYS:
             image = features[name].astype(jnp.float32) / 255.0
             if mode == MODE_TRAIN:
-                image = _random_flips(image, jax.random.fold_in(rng_flip, i))
+                image = _random_flips(image, flip_rngs[name])
             features[name] = image
         return features, labels
 
@@ -156,10 +166,28 @@ class Grasp2VecModel(FlaxT2RModel):
         preprocessor_cls=None,
         **kwargs,
     ):
-        super().__init__(
-            preprocessor_cls=preprocessor_cls or Grasp2VecPreprocessor,
-            **kwargs,
-        )
+        if preprocessor_cls is None:
+            # Derive crop windows from the requested output sizes so the
+            # default preprocessor honors scene_size/goal_size (offsets span
+            # the full 512x640 source slack, like the reference default
+            # (0, 40, 472, 0, 168, 472) does for 472x472).
+            def _crop_for(size: Tuple[int, int]) -> CropParams:
+                th, tw = int(size[0]), int(size[1])
+                if th > 512 or tw > 640:
+                    raise ValueError(
+                        f"Crop size {size} exceeds the 512x640 source."
+                    )
+                return (0, 512 - th, th, 0, 640 - tw, tw)
+
+            scene_crop = _crop_for(scene_size)
+            goal_crop = _crop_for(goal_size)
+
+            def preprocessor_cls(model):
+                return Grasp2VecPreprocessor(
+                    model, scene_crop=scene_crop, goal_crop=goal_crop
+                )
+
+        super().__init__(preprocessor_cls=preprocessor_cls, **kwargs)
         self._scene_size = tuple(scene_size)
         self._goal_size = tuple(goal_size)
         self._embedding_loss_fn = embedding_loss_fn

@@ -1,0 +1,6 @@
+from tensor2robot_tpu.research.qtopt import networks, optimizer_builder, pcgrad
+from tensor2robot_tpu.research.qtopt.t2r_models import (
+    DefaultGrasping44ImagePreprocessor,
+    Grasping44E2EOpenCloseTerminateGripperStatusHeightToBottom,
+    GraspingModelWrapper,
+)

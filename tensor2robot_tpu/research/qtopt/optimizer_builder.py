@@ -1,0 +1,76 @@
+"""QT-Opt optimizer construction over optax.
+
+Behavioral reference: tensor2robot/research/qtopt/optimizer_builder.py:25-96
+(`BuildOpt`): exponential-decay LR derived from examples_per_epoch /
+num_epochs_per_decay, then momentum | rmsprop | adam. The reference's
+MovingAverageOptimizer wrap is expressed TPU-natively as the trainer's EMA
+param tree (`use_avg_model_params` on the model; see train/state.py) — optax
+keeps the optimizer a pure gradient transformation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import optax
+
+
+@dataclasses.dataclass
+class QtOptHParams:
+    """The hyperparameter bundle `BuildOpt` consumed as tf.HParams."""
+
+    batch_size: int = 32
+    examples_per_epoch: int = 3_000_000
+    learning_rate: float = 1e-4
+    learning_rate_decay_factor: float = 0.999
+    model_weights_averaging: float = 0.9999
+    momentum: float = 0.9
+    num_epochs_per_decay: float = 2.0
+    optimizer: str = "momentum"
+    rmsprop_decay: float = 0.9
+    rmsprop_epsilon: float = 1.0
+    adam_beta2: float = 0.999
+    adam_epsilon: float = 1e-8
+    use_avg_model_params: bool = True
+
+
+def build_learning_rate(hparams: QtOptHParams) -> optax.Schedule:
+    """Staircase exponential decay stepped every
+    examples_per_epoch / batch_size * num_epochs_per_decay steps
+    (reference optimizer_builder.py:61-70)."""
+    decay_steps = int(
+        hparams.examples_per_epoch / hparams.batch_size
+        * hparams.num_epochs_per_decay
+    )
+    return optax.exponential_decay(
+        init_value=hparams.learning_rate,
+        transition_steps=max(decay_steps, 1),
+        decay_rate=hparams.learning_rate_decay_factor,
+        staircase=True,
+    )
+
+
+def build_opt(hparams: Optional[QtOptHParams] = None) -> optax.GradientTransformation:
+    """Constructs the QT-Opt optimizer (reference BuildOpt :25-96).
+
+    The caller (GraspingModelWrapper) owns EMA/"swapping saver" semantics via
+    `use_avg_model_params`; this function returns only the descent rule.
+    """
+    hparams = hparams or QtOptHParams()
+    learning_rate = build_learning_rate(hparams)
+    if hparams.optimizer == "momentum":
+        return optax.sgd(learning_rate, momentum=hparams.momentum)
+    if hparams.optimizer == "rmsprop":
+        return optax.rmsprop(
+            learning_rate,
+            decay=hparams.rmsprop_decay,
+            momentum=hparams.momentum,
+            eps=hparams.rmsprop_epsilon,
+        )
+    return optax.adam(
+        learning_rate,
+        b1=hparams.momentum,
+        b2=hparams.adam_beta2,
+        eps=hparams.adam_epsilon,
+    )

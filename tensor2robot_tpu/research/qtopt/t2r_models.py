@@ -1,0 +1,198 @@
+"""QT-Opt T2R models: the Grasping44 critic family + its preprocessor.
+
+Behavioral reference: tensor2robot/research/qtopt/t2r_models.py
+(`LegacyGraspingModelWrapper` :60-238, `DefaultGrasping44ImagePreprocessor`
+:241-307, `Grasping44E2EOpenCloseTerminateGripperStatusHeightToBottom`
+:310-420). The wrapper adapts the Grasping44 Q-tower to the CriticModel
+contract: split state/action specs, `q_predicted` output, log-loss against
+`grasp_success` rewards, CEM action tiling in PREDICT, momentum optimizer
+with staircase LR decay and EMA ("moving average + swapping saver") params.
+
+TPU-first notes: the 512x640 jpeg decode stays on the host (data layer); the
+crop + photometric distortion run *on device* inside the jitted step with
+explicit rng so the infeed carries uint8; training math is bf16-friendly
+via the trainer dtype policy; EMA params are a first-class part of
+TrainState, exports select them (reference swapping-saver semantics).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Dict, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensor2robot_tpu.models.abstract_model import MODE_PREDICT, MODE_TRAIN
+from tensor2robot_tpu.models.base_models import CriticModel
+from tensor2robot_tpu.preprocessors import image_transformations
+from tensor2robot_tpu.preprocessors.abstract_preprocessor import (
+    SpecTransformationPreprocessor,
+)
+from tensor2robot_tpu.research.qtopt import optimizer_builder
+from tensor2robot_tpu.research.qtopt.networks import (
+    E2E_GRASP_PARAM_BLOCKS,
+    Grasping44,
+    concat_e2e_grasp_params,
+)
+from tensor2robot_tpu.specs import ExtendedTensorSpec, TensorSpecStruct
+
+INPUT_SHAPE = (512, 640, 3)
+TARGET_SHAPE = (472, 472)
+
+
+class DefaultGrasping44ImagePreprocessor(SpecTransformationPreprocessor):
+    """512x640x3 uint8 jpeg source -> 472x472 crop (random for train, center
+    otherwise) -> float [0,1] -> train-only photometric distortion
+    (reference t2r_models.py:241-307)."""
+
+    def _transform_in_feature_specification(self, spec, mode):
+        self.update_spec(
+            spec,
+            "state/image",
+            shape=INPUT_SHAPE,
+            dtype=np.uint8,
+            data_format="jpeg",
+        )
+        return spec
+
+    def _preprocess_fn(self, features, labels, mode, rng):
+        image = features.state.image
+        if mode == MODE_TRAIN:
+            rng = rng if rng is not None else jax.random.PRNGKey(0)
+            rng_crop, rng_distort = jax.random.split(rng)
+            image = image_transformations.random_crop_image_batch(
+                rng_crop, image, TARGET_SHAPE
+            )
+            image = image.astype(jnp.float32) / 255.0
+            image = image_transformations.apply_photometric_image_distortions(
+                rng_distort, image
+            )
+        else:
+            image = image_transformations.center_crop_image_batch(
+                image, TARGET_SHAPE
+            )
+            image = image.astype(jnp.float32) / 255.0
+        features.state.image = image
+        return features, labels
+
+
+class _Grasping44Net(nn.Module):
+    """Adapts the Grasping44 tower to the T2R network calling convention
+    `__call__(packed_features, mode) -> outputs struct`."""
+
+    grasp_param_blocks: Optional[Dict[str, Tuple[int, int]]] = None
+
+    @nn.compact
+    def __call__(self, features, mode):
+        action = {
+            key: jnp.asarray(value) for key, value in features.action.items()
+        }
+        grasp_params = concat_e2e_grasp_params(action)
+        logits, end_points = Grasping44(
+            grasp_param_blocks=self.grasp_param_blocks, name="grasping44"
+        )(
+            features.state.image,
+            grasp_params,
+            is_training=mode == MODE_TRAIN,
+        )
+        # q_predicted carries logits (loss-stable); predictions carries the
+        # sigmoid the reference exposed as q_predicted — CEM argmax is
+        # invariant to the monotone map, training uses the logits.
+        tiled = grasp_params.ndim == 3
+        q_logits = (
+            logits.reshape(end_points["predictions"].shape)
+            if tiled
+            else logits.reshape(-1)
+        )
+        return {
+            "q_predicted": q_logits,
+            "q_probability": end_points["predictions"],
+        }
+
+
+class GraspingModelWrapper(CriticModel):
+    """CriticModel over the Grasping44 tower (reference
+    LegacyGraspingModelWrapper :60-238). Momentum/rmsprop/adam optimizer
+    with staircase exponential decay; EMA params when use_avg_model_params."""
+
+    def __init__(
+        self,
+        learning_rate: float = 1e-4,
+        model_weights_averaging: float = 0.9999,
+        momentum: float = 0.9,
+        export_batch_size: int = 1,
+        use_avg_model_params: bool = True,
+        learning_rate_decay_factor: float = 0.999,
+        optimizer: str = "momentum",
+        batch_size: int = 32,
+        examples_per_epoch: int = 3_000_000,
+        action_batch_size: Optional[int] = None,
+        **kwargs,
+    ):
+        self.hparams = optimizer_builder.QtOptHParams(
+            batch_size=batch_size,
+            examples_per_epoch=examples_per_epoch,
+            learning_rate=learning_rate,
+            learning_rate_decay_factor=learning_rate_decay_factor,
+            model_weights_averaging=model_weights_averaging,
+            momentum=momentum,
+            optimizer=optimizer,
+            use_avg_model_params=use_avg_model_params,
+        )
+        self._export_batch_size = export_batch_size
+        kwargs.setdefault(
+            "preprocessor_cls", DefaultGrasping44ImagePreprocessor
+        )
+        super().__init__(
+            action_batch_size=action_batch_size,
+            create_optimizer_fn=lambda: optimizer_builder.build_opt(
+                self.hparams
+            ),
+            use_avg_model_params=use_avg_model_params,
+            avg_model_params_decay=model_weights_averaging,
+            **kwargs,
+        )
+
+    def get_label_specification(self, mode: str) -> TensorSpecStruct:
+        spec = TensorSpecStruct()
+        spec["reward"] = ExtendedTensorSpec(
+            shape=(1,), dtype=np.float32, name="grasp_success"
+        )
+        return spec
+
+
+class Grasping44E2EOpenCloseTerminateGripperStatusHeightToBottom(
+    GraspingModelWrapper
+):
+    """The e2e open/close/terminate/gripper-status/height-to-bottom critic
+    (reference t2r_models.py:310-420): 472x472 image state + 10-dim action
+    in 7 named blocks."""
+
+    def get_state_specification(self) -> TensorSpecStruct:
+        return TensorSpecStruct(
+            image=ExtendedTensorSpec(
+                shape=(472, 472, 3), dtype=np.float32, name="image_1"
+            )
+        )
+
+    def get_action_specification(self) -> TensorSpecStruct:
+        def action_spec(name, size=1):
+            return ExtendedTensorSpec(
+                shape=(size,), dtype=np.float32, name=name
+            )
+
+        return TensorSpecStruct(
+            world_vector=action_spec("world_vector", 3),
+            vertical_rotation=action_spec("vertical_rotation", 2),
+            close_gripper=action_spec("close_gripper"),
+            open_gripper=action_spec("open_gripper"),
+            terminate_episode=action_spec("terminate_episode"),
+            gripper_closed=action_spec("gripper_closed"),
+            height_to_bottom=action_spec("height_to_bottom"),
+        )
+
+    def create_network(self) -> nn.Module:
+        return _Grasping44Net(grasp_param_blocks=E2E_GRASP_PARAM_BLOCKS)

@@ -23,6 +23,12 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: full-resolution / multi-step integration tests"
+    )
+
+
 def pytest_sessionstart(session):
     devices = jax.devices()
     assert devices[0].platform == "cpu", (

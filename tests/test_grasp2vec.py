@@ -98,6 +98,20 @@ class TestGrasp2VecModel:
         assert out["pregrasp_image"].dtype == jnp.float32
         assert float(jnp.max(out["pregrasp_image"])) <= 1.0
 
+    def test_default_preprocessor_honors_model_sizes(self):
+        # Regression: scene_size/goal_size must reach the default
+        # preprocessor's crop windows, not stay pinned at 472x472.
+        model = small_model()
+        pre = model.preprocessor
+        features = make_random_numpy(
+            pre.get_in_feature_specification("train"), batch_size=1
+        )
+        out, _ = pre.preprocess(
+            features, None, mode="train", rng=jax.random.PRNGKey(0)
+        )
+        assert out["pregrasp_image"].shape == (1, 32, 32, 3)
+        assert out["goal_image"].shape == (1, 32, 32, 3)
+
     def test_forward_and_loss(self):
         model = small_model()
         features = {

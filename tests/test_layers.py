@@ -106,6 +106,17 @@ class TestResNet:
         out2 = model.apply(variables, images, False, jnp.zeros_like(emb))
         assert not np.allclose(np.asarray(out1), np.asarray(out2))
 
+    def test_film_v1_bottleneck_runs(self):
+        # Regression: FiLM must be applied at the filters-wide point in v1
+        # bottleneck blocks (2*filters generator outputs vs 4*filters bn3).
+        model = layers.ResNet(num_classes=2, resnet_size=50, version=1)
+        images = jax.random.uniform(jax.random.PRNGKey(1), (1, 32, 32, 3))
+        emb = jnp.ones((1, 8))
+        variables = model.init(jax.random.PRNGKey(0), images, False, emb)
+        out1 = model.apply(variables, images, False, emb)
+        out2 = model.apply(variables, images, False, jnp.zeros_like(emb))
+        assert not np.allclose(np.asarray(out1), np.asarray(out2))
+
     def test_batch_stats_update_in_train(self):
         model = layers.ResNet(num_classes=2, resnet_size=18)
         images = jax.random.uniform(jax.random.PRNGKey(1), (2, 32, 32, 3))
