@@ -1,0 +1,158 @@
+"""MAML inner-loop gradient descent, functional JAX form.
+
+Behavioral reference: tensor2robot/meta_learning/maml_inner_loop.py:28-328.
+The reference needed a variable-intercepting custom getter to swap
+`var - lr*grad` tensors into a TF graph; with explicit parameter pytrees the
+same algorithm is just `jax.grad` + tree arithmetic:
+
+  for each condition step:  params' = params - lr * grad(inner_loss)
+  final monitored step      (forward only, tracks adaptation progress)
+  conditioned val pass      (adapted params)   — the MAML objective
+  unconditioned val pass    (original params)  — for diagnostics
+
+Second-order gradients come for free by differentiating through the update;
+`use_second_order=False` stops the gradient on the inner grads (FOMAML,
+reference :143-188). Per-variable learned inner learning rates are scalar
+leaves in a pytree mirroring the adapted params (reference :83-95), carried
+as ordinary meta-parameters so the outer optimizer trains them.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Mapping, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tensor2robot_tpu.utils.keypath import path_string
+
+PyTree = Any
+
+
+class MAMLInnerLoopGradientDescent:
+    """Configurable inner-loop SGD (reference class :28-328).
+
+    Args:
+      learning_rate: inner-loop step size (initial value when learned).
+      use_second_order: backprop through the inner gradients; False = FOMAML.
+      var_scope: '/'-joined path prefix selecting which params adapt; others
+        stay frozen in the inner loop (outer loop still trains everything).
+      learn_inner_lr: per-variable learned LRs initialized at learning_rate.
+    """
+
+    def __init__(
+        self,
+        learning_rate: float = 0.001,
+        use_second_order: bool = True,
+        var_scope: Optional[str] = None,
+        learn_inner_lr: bool = False,
+    ):
+        self._learning_rate = learning_rate
+        self._use_second_order = use_second_order
+        self._var_scope = var_scope
+        self._learn_inner_lr = learn_inner_lr
+
+    @property
+    def learn_inner_lr(self) -> bool:
+        return self._learn_inner_lr
+
+    def create_inner_lr_params(self, base_params: PyTree) -> PyTree:
+        """Per-variable scalar LRs (empty dict when not learned) — meta-params
+        the outer optimizer trains (reference _get_learning_rate :83-95)."""
+        if not self._learn_inner_lr:
+            return {}
+        return jax.tree_util.tree_map(
+            lambda _: jnp.asarray(self._learning_rate, jnp.float32),
+            base_params,
+        )
+
+    def _adapts(self, path) -> bool:
+        if self._var_scope is None:
+            return True
+        return path_string(path).startswith(self._var_scope)
+
+    def _apply_update(
+        self, params: PyTree, grads: PyTree, inner_lrs: PyTree
+    ) -> PyTree:
+        def update(path, p, g, *lr):
+            if not self._adapts(path):
+                return p
+            rate = lr[0] if lr else self._learning_rate
+            return p - rate * g
+
+        if self._learn_inner_lr and inner_lrs:
+            return jax.tree_util.tree_map_with_path(
+                update, params, grads, inner_lrs
+            )
+        return jax.tree_util.tree_map_with_path(update, params, grads)
+
+    def inner_loop(
+        self,
+        base_variables: Mapping[str, Any],
+        inputs_list: Sequence[Tuple[Any, Any]],
+        inference_network_fn: Callable,
+        model_train_fn: Callable,
+        mode: str,
+        inner_lrs: Optional[PyTree] = None,
+    ):
+        """Runs len(inputs_list)-1 adaptation steps (reference :213-328).
+
+        Args:
+          base_variables: base-model variable collections; ['params'] adapts.
+          inputs_list: ((cond_f, cond_l),)*k + ((val_f, val_l),); the last
+            entry is validation data never used for inner gradients.
+          inference_network_fn: base model forward,
+            (variables, features, mode) -> (outputs, mutable_updates).
+            Mutable updates (batch-stats) are discarded inside the loop —
+            the reference's while_loop had the same batch-norm caveat
+            (maml_model.py:300-304).
+          model_train_fn: (features, labels, outputs, mode) -> loss or
+            (loss, metrics).
+          mode: train/eval/predict.
+          inner_lrs: learned per-variable LR pytree (when learn_inner_lr).
+
+        Returns:
+          ([unconditioned_val_outputs, conditioned_val_outputs],
+           inner_outputs (k+1 entries), inner_losses (k+1 entries)).
+        """
+        base_variables = dict(base_variables)
+        original_params = base_variables["params"]
+
+        def forward(params, features):
+            variables = dict(base_variables)
+            variables["params"] = params
+            outputs, _ = inference_network_fn(variables, features, mode)
+            return outputs
+
+        def step_loss(params, features, labels):
+            outputs = forward(params, features)
+            result = model_train_fn(features, labels, outputs, mode)
+            loss = result[0] if isinstance(result, tuple) else result
+            return loss, outputs
+
+        adapted = original_params
+        inner_outputs: List[Any] = []
+        inner_losses: List[jax.Array] = []
+        for features, labels in inputs_list[:-1]:
+            (loss, outputs), grads = jax.value_and_grad(
+                step_loss, has_aux=True
+            )(adapted, features, labels)
+            inner_outputs.append(outputs)
+            inner_losses.append(loss)
+            if not self._use_second_order:
+                grads = jax.lax.stop_gradient(grads)
+            adapted = self._apply_update(adapted, grads, inner_lrs)
+
+        # Final monitored pass on the last condition data: did adaptation
+        # help? (reference :291-306). Forward-only, no gradient step.
+        final_features, final_labels = inputs_list[-2]
+        final_loss, final_outputs = step_loss(
+            adapted, final_features, final_labels
+        )
+        inner_outputs.append(final_outputs)
+        inner_losses.append(final_loss)
+
+        val_features, _ = inputs_list[-1]
+        conditioned = forward(adapted, val_features)
+        unconditioned = forward(original_params, val_features)
+        return [unconditioned, conditioned], inner_outputs, inner_losses

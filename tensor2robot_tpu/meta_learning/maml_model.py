@@ -1,0 +1,239 @@
+"""MAML as model composition: wraps any base T2RModel.
+
+Behavioral reference: tensor2robot/meta_learning/maml_model.py:71-549.
+The reference mapped a graph-building `task_learn` over the task batch with
+tf.map_fn + dtype inference in a throwaway graph; here the same structure is
+`jax.vmap` of a functional inner loop — no dtype inference, no custom
+getters, and second-order gradients flow through the vmap for free
+(SURVEY.md §3.5 mapping).
+
+Meta variables are structured {'params': {'base': ..., 'inner_lrs': ...}},
+so learned inner learning rates are ordinary meta-parameters trained by the
+outer optimizer alongside the base model weights.
+
+TPU notes: vmap turns the per-task inner loops into one batched XLA program
+(k+2 forward passes + k backward passes, all MXU-batched across tasks); the
+[tasks, samples] dims flatten into single large batches for the outer loss.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Callable, Dict, Mapping, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tensor2robot_tpu.meta_learning import meta_tfdata, preprocessors
+from tensor2robot_tpu.meta_learning.maml_inner_loop import (
+    MAMLInnerLoopGradientDescent,
+)
+from tensor2robot_tpu.models.abstract_model import (
+    MODE_TRAIN,
+    AbstractT2RModel,
+)
+from tensor2robot_tpu.specs import TensorSpecStruct
+
+
+class MAMLModel(AbstractT2RModel):
+    """Base class for MAML-style meta models (reference MAMLModel :71-549).
+
+    Subclasses implement `_select_inference_output` to pick the
+    `condition_output` / `inference_output` keys meta policies consume.
+    """
+
+    def __init__(
+        self,
+        base_model: AbstractT2RModel,
+        preprocessor_cls=None,
+        num_inner_loop_steps: int = 1,
+        var_scope: Optional[str] = None,
+        inner_learning_rate: float = 0.001,
+        use_second_order: bool = True,
+        learn_inner_lr: bool = False,
+        **kwargs,
+    ):
+        kwargs.setdefault("device_type", base_model.device_type)
+        super().__init__(**kwargs)
+        self._base_model = base_model
+        self._maml_preprocessor_cls = preprocessor_cls
+        self._num_inner_loop_steps = max(1, num_inner_loop_steps)
+        self._inner_loop = MAMLInnerLoopGradientDescent(
+            learning_rate=inner_learning_rate,
+            use_second_order=use_second_order,
+            var_scope=var_scope,
+            learn_inner_lr=learn_inner_lr,
+        )
+
+    @property
+    def base_model(self) -> AbstractT2RModel:
+        return self._base_model
+
+    @property
+    def num_inner_loop_steps(self) -> int:
+        return self._num_inner_loop_steps
+
+    # -- specs ----------------------------------------------------------------
+
+    @property
+    def preprocessor(self):
+        cls = self._maml_preprocessor_cls or preprocessors.MAMLPreprocessorV2
+        preprocessor = cls(self._base_model.preprocessor)
+        if not isinstance(preprocessor, preprocessors.MAMLPreprocessorV2):
+            raise ValueError(
+                "Only MAMLPreprocessorV2 subclasses are supported."
+            )
+        return preprocessor
+
+    def get_feature_specification(self, mode: str) -> TensorSpecStruct:
+        return preprocessors.create_maml_feature_spec(
+            self._base_model.get_feature_specification(mode),
+            self._base_model.get_label_specification(mode),
+        )
+
+    def get_label_specification(self, mode: str) -> TensorSpecStruct:
+        return preprocessors.create_maml_label_spec(
+            self._base_model.get_label_specification(mode)
+        )
+
+    def get_feature_specification_for_packing(self, mode: str):
+        return self._base_model.preprocessor.get_in_feature_specification(mode)
+
+    def get_label_specification_for_packing(self, mode: str):
+        return self._base_model.preprocessor.get_in_label_specification(mode)
+
+    # -- variables ------------------------------------------------------------
+
+    def init_variables(self, rng, features, mode: str = MODE_TRAIN):
+        """Initializes the base model on one task's condition batch and adds
+        the learned inner-LR meta-params."""
+
+        def concrete(leaf):
+            if isinstance(leaf, jax.ShapeDtypeStruct):
+                return jnp.zeros(leaf.shape, leaf.dtype)
+            return jnp.asarray(leaf)
+
+        cond = jax.tree_util.tree_map(
+            lambda x: concrete(x)[0], features.condition.features
+        )
+        base_variables = dict(
+            self._base_model.init_variables(rng, cond, mode)
+        )
+        base_params = base_variables.pop("params")
+        variables = dict(base_variables)
+        variables["params"] = {
+            "base": base_params,
+            "inner_lrs": self._inner_loop.create_inner_lr_params(base_params),
+        }
+        return variables
+
+    def _base_variables(self, variables: Mapping[str, Any]) -> Dict[str, Any]:
+        base = {
+            k: v for k, v in variables.items() if k != "params"
+        }
+        base["params"] = variables["params"]["base"]
+        return base
+
+    # -- forward --------------------------------------------------------------
+
+    def inference_network_fn(self, variables, features, mode, rng=None):
+        base_variables = self._base_variables(variables)
+        inner_lrs = variables["params"].get("inner_lrs") or None
+        k = self._num_inner_loop_steps
+
+        def base_inference(vars_, task_features, mode_):
+            return self._base_model.inference_network_fn(
+                vars_, task_features, mode_
+            )
+
+        def task_learn(cond_features, cond_labels, inf_features):
+            inputs_list = ((cond_features, cond_labels),) * k + (
+                (inf_features, cond_labels),
+            )
+            (uncond, cond), inner_outputs, inner_losses = (
+                self._inner_loop.inner_loop(
+                    base_variables,
+                    inputs_list,
+                    base_inference,
+                    self._base_model.model_train_fn,
+                    mode,
+                    inner_lrs=inner_lrs,
+                )
+            )
+            return uncond, cond, tuple(inner_outputs), tuple(inner_losses)
+
+        uncond, cond, inner_outputs, inner_losses = jax.vmap(task_learn)(
+            features.condition.features,
+            features.condition.labels,
+            features.inference.features,
+        )
+
+        predictions = TensorSpecStruct()
+        for key, value in inner_outputs[0].items():
+            predictions[f"full_condition_output/{key}"] = value
+        for pos, step_output in enumerate(inner_outputs):
+            for key, value in step_output.items():
+                predictions[f"full_condition_outputs/output_{pos}/{key}"] = value
+        for key, value in uncond.items():
+            predictions[f"full_inference_output_unconditioned/{key}"] = value
+        for key, value in cond.items():
+            predictions[f"full_inference_output/{key}"] = value
+        for pos, loss in enumerate(inner_losses):
+            predictions[f"inner_losses/step_{pos}"] = loss
+
+        predictions = self._select_inference_output(predictions)
+        if "condition_output" not in predictions:
+            raise ValueError(
+                "The required condition_output is not in predictions "
+                f"{list(predictions.keys())}."
+            )
+        if "inference_output" not in predictions:
+            raise ValueError(
+                "The required inference_output is not in predictions "
+                f"{list(predictions.keys())}."
+            )
+        return predictions, {}
+
+    @abc.abstractmethod
+    def _select_inference_output(
+        self, predictions: TensorSpecStruct
+    ) -> TensorSpecStruct:
+        """Assigns `condition_output` and `inference_output` from the full
+        outputs (reference :356-371)."""
+
+    # -- losses ---------------------------------------------------------------
+
+    def model_train_fn(self, features, labels, inference_outputs, mode):
+        """Outer loss: the base loss on conditioned inference outputs over
+        the flattened [task, samples] batch (reference :415-496)."""
+        inference_flat = meta_tfdata.flatten_batch_examples(
+            inference_outputs.full_inference_output
+        )
+        features_flat = meta_tfdata.flatten_batch_examples(
+            features.inference.features
+        )
+        labels_flat = meta_tfdata.flatten_batch_examples(labels)
+        loss, metrics = self._base_model.model_train_fn(
+            features_flat, labels_flat, inference_flat, mode
+        )
+        out_metrics = dict(metrics)
+        for pos in range(self._num_inner_loop_steps + 1):
+            out_metrics[f"inner_loss_{pos}"] = jnp.mean(
+                inference_outputs[f"inner_losses/step_{pos}"]
+            )
+        return loss, out_metrics
+
+    def model_eval_fn(self, features, labels, inference_outputs):
+        inference_flat = meta_tfdata.flatten_batch_examples(
+            inference_outputs.full_inference_output
+        )
+        features_flat = meta_tfdata.flatten_batch_examples(
+            features.inference.features
+        )
+        labels_flat = meta_tfdata.flatten_batch_examples(labels)
+        return self._base_model.model_eval_fn(
+            features_flat, labels_flat, inference_flat
+        )
+
+    def create_optimizer(self):
+        return self._base_model.create_optimizer()

@@ -1,0 +1,53 @@
+"""MetaExample assembly: one proto holding a task's episodes as
+prefixed feature columns.
+
+Behavioral reference: tensor2robot/meta_learning/meta_example.py:28-66.
+Episode i of the condition (inference) set contributes all its features
+under `condition_ep<i>/<name>` (`inference_ep<i>/<name>`) — the layout
+`create_metaexample_spec` parses back (preprocessors.py:287-312).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+from tensor2robot_tpu.proto import example_pb2
+
+Example = Union["example_pb2.Example", "example_pb2.SequenceExample"]
+
+
+def append_example(meta_example, ep_example, prefix: str) -> None:
+    """Copies every feature of `ep_example` into `meta_example` with
+    `<prefix>/` prepended to the key (reference :47-53)."""
+    target = meta_example.features.feature
+    for key, feature in ep_example.features.feature.items():
+        target[f"{prefix}/{key}"].CopyFrom(feature)
+
+
+def append_sequence_example(meta_example, ep_example, prefix: str) -> None:
+    """SequenceExample variant: prefixes both context features and
+    feature_lists (reference :56-66)."""
+    context = meta_example.context.feature
+    for key, feature in ep_example.context.feature.items():
+        context[f"{prefix}/{key}"].CopyFrom(feature)
+    lists = meta_example.feature_lists.feature_list
+    for key, feature_list in ep_example.feature_lists.feature_list.items():
+        lists[f"{prefix}/{key}"].CopyFrom(feature_list)
+
+
+def make_meta_example(
+    condition_examples: Sequence[Example],
+    inference_examples: Sequence[Example],
+) -> Example:
+    """Builds one MetaExample from per-episode examples (reference :28-45)."""
+    if isinstance(condition_examples[0], example_pb2.Example):
+        meta_example = example_pb2.Example()
+        append_fn = append_example
+    else:
+        meta_example = example_pb2.SequenceExample()
+        append_fn = append_sequence_example
+    for i, example in enumerate(condition_examples):
+        append_fn(meta_example, example, f"condition_ep{i}")
+    for i, example in enumerate(inference_examples):
+        append_fn(meta_example, example, f"inference_ep{i}")
+    return meta_example

@@ -1,0 +1,126 @@
+"""Task-batched data utilities for meta-learning.
+
+Behavioral reference: tensor2robot/meta_learning/meta_tfdata.py. Meta
+batches carry two leading dims — [num_tasks, num_samples_per_task, ...] —
+and these helpers move structures between that layout and the flat
+[num_tasks * num_samples, ...] layout base models expect. All are pure
+jnp reshapes, so they fuse into surrounding jitted programs; `multi_batch_apply`
+is the workhorse models use to run image ops over [task, time] dims
+(reference :222-281).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+PyTree = Any
+
+
+def _is_array(x) -> bool:
+    return hasattr(x, "shape") and hasattr(x, "dtype")
+
+
+def flatten_batch_examples(structure: PyTree) -> PyTree:
+    """[num_tasks, num_samples, ...] -> [num_tasks * num_samples, ...]
+    (reference flatten_batch_examples :174-199; rank-1 tensors pass
+    through untouched, matching the reference's per-task scalars)."""
+
+    def reshape(x):
+        if not _is_array(x) or x.ndim <= 1:
+            return x
+        return jnp.reshape(x, (-1,) + tuple(x.shape[2:]))
+
+    return jax.tree_util.tree_map(reshape, structure)
+
+
+def unflatten_batch_examples(structure: PyTree, num_samples_per_task: int) -> PyTree:
+    """[num_tasks * num_samples, ...] -> [num_tasks, num_samples, ...]
+    (reference :201-219)."""
+
+    def reshape(x):
+        if not _is_array(x):
+            return x
+        return jnp.reshape(
+            x, (-1, num_samples_per_task) + tuple(x.shape[1:])
+        )
+
+    return jax.tree_util.tree_map(reshape, structure)
+
+
+def merge_first_n_dims(structure: PyTree, n: int) -> PyTree:
+    """Collapses the first n dims of every array (reference :222-238)."""
+
+    def reshape(x):
+        if not _is_array(x):
+            return x
+        return jnp.reshape(x, (-1,) + tuple(x.shape[n:]))
+
+    return jax.tree_util.tree_map(reshape, structure)
+
+
+def expand_batch_dims(structure: PyTree, batch_sizes: Sequence[int]) -> PyTree:
+    """Re-expands the first dim of every array to `batch_sizes`
+    (reference :241-257)."""
+    batch_sizes = tuple(int(b) for b in batch_sizes)
+
+    def reshape(x):
+        if not _is_array(x):
+            return x
+        return jnp.reshape(x, batch_sizes + tuple(x.shape[1:]))
+
+    return jax.tree_util.tree_map(reshape, structure)
+
+
+def multi_batch_apply(
+    f: Callable, num_batch_dims: int, *args, **kwargs
+) -> PyTree:
+    """Runs `f` (which expects one batch dim) over inputs with
+    `num_batch_dims` leading batch dims, restoring them on the outputs
+    (reference :260-281). Unlike vmap this is a single reshaped call, so
+    batch-norm and other cross-batch ops see the full flattened batch."""
+    leaves = [
+        leaf
+        for leaf in jax.tree_util.tree_leaves((args, kwargs))
+        if _is_array(leaf)
+    ]
+    if not leaves:
+        raise ValueError("multi_batch_apply needs at least one array input.")
+    batch_sizes = leaves[0].shape[:num_batch_dims]
+    merged_args = merge_first_n_dims(args, num_batch_dims)
+    merged_kwargs = merge_first_n_dims(kwargs, num_batch_dims)
+    outputs = f(*merged_args, **merged_kwargs)
+    return expand_batch_dims(outputs, batch_sizes)
+
+
+def split_train_val(
+    structure: PyTree, num_train_samples_per_task: int
+) -> Tuple[PyTree, PyTree]:
+    """Splits the per-task samples dim into (train, val) structures
+    (reference split_train_val :130-151)."""
+
+    def train_part(x):
+        return x[:, :num_train_samples_per_task] if _is_array(x) else x
+
+    def val_part(x):
+        return x[:, num_train_samples_per_task:] if _is_array(x) else x
+
+    return (
+        jax.tree_util.tree_map(train_part, structure),
+        jax.tree_util.tree_map(val_part, structure),
+    )
+
+
+def tile_val_mode(structure: PyTree, num_tiles: int) -> PyTree:
+    """Tiles val samples along the per-task samples dim (reference
+    tile_val_mode :154-171)."""
+
+    def tile(x):
+        if not _is_array(x):
+            return x
+        reps = (1, num_tiles) + (1,) * (x.ndim - 2)
+        return jnp.tile(x, reps)
+
+    return jax.tree_util.tree_map(tile, structure)

@@ -1,0 +1,250 @@
+"""Meta-learning spec builders and preprocessors.
+
+Behavioral reference: tensor2robot/meta_learning/preprocessors.py.
+Meta specs nest a base model's contract into:
+
+  features.condition.features / features.condition.labels   (adaptation data)
+  features.inference.features                               (evaluation data)
+  labels (meta_labels prefix)                               (outer-loss labels)
+
+with an explicit per-task samples dim prepended to every spec. The
+MetaExample layout stores each episode of a task as `<prefix>_ep<i>/<name>`
+feature columns of one example (reference create_metaexample_spec :287-312).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tensor2robot_tpu.meta_learning import meta_tfdata
+from tensor2robot_tpu.preprocessors.abstract_preprocessor import (
+    AbstractPreprocessor,
+)
+from tensor2robot_tpu.specs import (
+    ExtendedTensorSpec,
+    TensorSpecStruct,
+    copy_tensorspec,
+    flatten_spec_structure,
+)
+
+
+def create_maml_feature_spec(feature_spec, label_spec) -> TensorSpecStruct:
+    """Meta feature spec from base specs: condition carries features+labels,
+    inference carries features; every spec gains a per-task samples dim and
+    a routing prefix (reference create_maml_feature_spec :34-66)."""
+    condition_spec = TensorSpecStruct()
+    condition_spec.features = flatten_spec_structure(
+        copy_tensorspec(feature_spec, batch_size=-1, prefix="condition_features")
+    )
+    condition_spec.labels = flatten_spec_structure(
+        copy_tensorspec(label_spec, batch_size=-1, prefix="condition_labels")
+    )
+    inference_spec = TensorSpecStruct()
+    inference_spec.features = flatten_spec_structure(
+        copy_tensorspec(feature_spec, batch_size=-1, prefix="inference_features")
+    )
+    meta_feature_spec = TensorSpecStruct()
+    meta_feature_spec.condition = condition_spec
+    meta_feature_spec.inference = inference_spec
+    return meta_feature_spec
+
+
+def create_maml_label_spec(label_spec) -> TensorSpecStruct:
+    """Outer-loss label spec (reference :69-81)."""
+    return flatten_spec_structure(
+        copy_tensorspec(label_spec, batch_size=-1, prefix="meta_labels")
+    )
+
+
+class MAMLPreprocessorV2(AbstractPreprocessor):
+    """Wraps a base preprocessor's contract into meta shape; the transform
+    flattens [task, samples] to a flat batch, applies the base preprocessor,
+    and restores the task structure (reference MAMLPreprocessorV2 :84-285).
+    """
+
+    def __init__(self, base_preprocessor: AbstractPreprocessor):
+        super().__init__()
+        self._base_preprocessor = base_preprocessor
+
+    @property
+    def base_preprocessor(self) -> AbstractPreprocessor:
+        return self._base_preprocessor
+
+    def get_in_feature_specification(self, mode):
+        return create_maml_feature_spec(
+            self._base_preprocessor.get_in_feature_specification(mode),
+            self._base_preprocessor.get_in_label_specification(mode),
+        )
+
+    def get_in_label_specification(self, mode):
+        return create_maml_label_spec(
+            self._base_preprocessor.get_in_label_specification(mode)
+        )
+
+    def get_out_feature_specification(self, mode):
+        return create_maml_feature_spec(
+            self._base_preprocessor.get_out_feature_specification(mode),
+            self._base_preprocessor.get_out_label_specification(mode),
+        )
+
+    def get_out_label_specification(self, mode):
+        return create_maml_label_spec(
+            self._base_preprocessor.get_out_label_specification(mode)
+        )
+
+    def _preprocess_fn(self, features, labels, mode, rng):
+        cond_feature = list(features.condition.features.values())[0]
+        inf_feature = list(features.inference.features.values())[0]
+        num_condition = cond_feature.shape[1]
+        num_inference = inf_feature.shape[1]
+
+        rng_cond, rng_inf = (
+            jax.random.split(rng) if rng is not None else (None, None)
+        )
+        flat_cond_features = meta_tfdata.flatten_batch_examples(
+            features.condition.features
+        )
+        flat_cond_labels = meta_tfdata.flatten_batch_examples(
+            features.condition.labels
+        )
+        flat_inf_features = meta_tfdata.flatten_batch_examples(
+            features.inference.features
+        )
+        flat_labels = (
+            meta_tfdata.flatten_batch_examples(labels)
+            if labels is not None
+            else None
+        )
+
+        cond_features_out, cond_labels_out = self._base_preprocessor.preprocess(
+            flat_cond_features, flat_cond_labels, mode=mode, rng=rng_cond
+        )
+        inf_features_out, labels_out = self._base_preprocessor.preprocess(
+            flat_inf_features, flat_labels, mode=mode, rng=rng_inf
+        )
+
+        out = TensorSpecStruct()
+        condition = TensorSpecStruct()
+        condition.features = meta_tfdata.unflatten_batch_examples(
+            cond_features_out, num_condition
+        )
+        condition.labels = meta_tfdata.unflatten_batch_examples(
+            cond_labels_out, num_condition
+        )
+        inference = TensorSpecStruct()
+        inference.features = meta_tfdata.unflatten_batch_examples(
+            inf_features_out, num_inference
+        )
+        out.condition = condition
+        out.inference = inference
+        out_labels = None
+        if labels_out is not None:
+            out_labels = meta_tfdata.unflatten_batch_examples(
+                labels_out, num_inference
+            )
+        return out, out_labels
+
+
+def create_metaexample_spec(
+    model_spec, num_samples_per_task: int, prefix: str
+) -> TensorSpecStruct:
+    """Expands each spec into per-episode columns `<key>/<i>` named
+    `<prefix>_ep<i>/<name>` (reference :287-312)."""
+    model_spec = flatten_spec_structure(model_spec)
+    meta_example_spec = TensorSpecStruct()
+    for key in model_spec.keys():
+        for i in range(num_samples_per_task):
+            spec = model_spec[key]
+            name = spec.name if spec.name is not None else key
+            meta_example_spec[f"{key}/{i}"] = ExtendedTensorSpec.from_spec(
+                spec, name=f"{prefix}_ep{i}/{name}"
+            )
+    return meta_example_spec
+
+
+def stack_intra_task_episodes(
+    in_tensors, num_samples_per_task: int
+) -> TensorSpecStruct:
+    """Stacks `<key>/<i>` episode columns into one [batch, samples, ...]
+    tensor per key (reference :315-338)."""
+    out_tensors = TensorSpecStruct()
+    key_set = sorted(
+        {"/".join(key.split("/")[:-1]) for key in in_tensors.keys()}
+    )
+    for key in key_set:
+        data = [
+            in_tensors[f"{key}/{i}"] for i in range(num_samples_per_task)
+        ]
+        out_tensors[key] = jnp.stack(data, axis=1)
+    return out_tensors
+
+
+class FixedLenMetaExamplePreprocessor(MAMLPreprocessorV2):
+    """Parses per-episode MetaExample columns, stacks them into the task
+    layout, then applies the MAML preprocessing (reference :341-413)."""
+
+    def __init__(
+        self,
+        base_preprocessor: AbstractPreprocessor,
+        num_condition_samples_per_task: int = 1,
+        num_inference_samples_per_task: int = 1,
+    ):
+        self._num_condition_samples_per_task = num_condition_samples_per_task
+        self._num_inference_samples_per_task = num_inference_samples_per_task
+        super().__init__(base_preprocessor)
+
+    @property
+    def num_condition_samples_per_task(self) -> int:
+        return self._num_condition_samples_per_task
+
+    @property
+    def num_inference_samples_per_task(self) -> int:
+        return self._num_inference_samples_per_task
+
+    def get_in_feature_specification(self, mode):
+        condition_spec = TensorSpecStruct()
+        condition_spec.features = (
+            self._base_preprocessor.get_in_feature_specification(mode)
+        )
+        condition_spec.labels = (
+            self._base_preprocessor.get_in_label_specification(mode)
+        )
+        inference_spec = TensorSpecStruct()
+        inference_spec.features = (
+            self._base_preprocessor.get_in_feature_specification(mode)
+        )
+        feature_spec = TensorSpecStruct()
+        feature_spec.condition = create_metaexample_spec(
+            condition_spec, self._num_condition_samples_per_task, "condition"
+        )
+        feature_spec.inference = create_metaexample_spec(
+            inference_spec, self._num_inference_samples_per_task, "inference"
+        )
+        return flatten_spec_structure(feature_spec)
+
+    def get_in_label_specification(self, mode):
+        return flatten_spec_structure(
+            create_metaexample_spec(
+                self._base_preprocessor.get_in_label_specification(mode),
+                self._num_inference_samples_per_task,
+                "inference",
+            )
+        )
+
+    def _preprocess_fn(self, features, labels, mode, rng):
+        stacked = TensorSpecStruct()
+        stacked.condition = stack_intra_task_episodes(
+            features.condition, self._num_condition_samples_per_task
+        )
+        stacked.inference = stack_intra_task_episodes(
+            features.inference, self._num_inference_samples_per_task
+        )
+        stacked_labels = None
+        if labels is not None:
+            stacked_labels = stack_intra_task_episodes(
+                labels, self._num_inference_samples_per_task
+            )
+        return super()._preprocess_fn(stacked, stacked_labels, mode, rng)

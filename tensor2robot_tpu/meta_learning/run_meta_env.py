@@ -1,0 +1,183 @@
+"""Task-structured environment loop for meta-learning collect/eval.
+
+Behavioral reference: tensor2robot/meta_learning/run_meta_env.py:33-258.
+Per task: gather conditioning demos (via a demo policy or env-provided task
+data), adapt the policy, run episodes, re-adapt on everything collected so
+far, and track reward as a function of adaptation step — the curve that
+shows whether fast adaptation works. Episodes stream to a replay writer as
+transition protos; per-step reward/improvement statistics land in the
+metrics stream (this framework's summary channel).
+"""
+
+from __future__ import annotations
+
+import collections
+import copy
+import datetime
+import os
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from tensor2robot_tpu.config import configurable
+from tensor2robot_tpu.train.metrics import MetricsWriter
+
+
+def _run_demo_episode(env, demo_policy) -> List[tuple]:
+    """Rolls out a demonstration; the demo policy signals the end by
+    returning action None (reference :127-139)."""
+    obs = env.reset()
+    episode_data = []
+    while True:
+        action, debug = demo_policy.sample_action(obs, 0)
+        if action is None:
+            break
+        next_obs, reward, done, env_debug = env.step(action)
+        debug = dict(debug or {})
+        debug.update(env_debug or {})
+        debug["is_demo"] = True
+        episode_data.append((obs, action, reward, next_obs, done, debug))
+        obs = next_obs
+        if done:
+            break
+    return episode_data
+
+
+@configurable("run_meta_env")
+def run_meta_env(
+    env,
+    policy=None,
+    demo_policy_cls: Optional[Callable] = None,
+    explore_schedule=None,
+    episode_to_transitions_fn: Optional[Callable] = None,
+    replay_writer=None,
+    root_dir: Optional[str] = None,
+    task: int = 0,
+    global_step: int = 0,
+    num_tasks: int = 10,
+    num_adaptations_per_task: int = 2,
+    num_episodes_per_adaptation: int = 1,
+    num_demos: int = 1,
+    break_after_one_task: bool = False,
+    tag: str = "collect",
+    write_summaries: bool = False,
+) -> Dict[str, float]:
+    """Runs the meta agent/env loop; returns the summary statistics dict
+    (reference run_meta_env :33-258 — summaries land in metrics.jsonl
+    instead of tf events)."""
+    task_step_rewards: Dict[int, Dict[int, List[float]]] = (
+        collections.defaultdict(lambda: collections.defaultdict(list))
+    )
+    episode_q_values: Dict[int, List[float]] = collections.defaultdict(list)
+
+    for task_idx in range(num_tasks):
+        if hasattr(policy, "reset_task"):
+            policy.reset_task()
+        if hasattr(env, "reset_task"):
+            env.reset_task()
+
+        if replay_writer and root_dir:
+            timestamp = datetime.datetime.now().strftime("%Y-%m-%d-%H-%M-%S")
+            record_name = os.path.join(
+                root_dir, f"gs{global_step}_t{task}_{timestamp}_{task_idx}"
+            )
+            replay_writer.open(record_name)
+
+        # Conditioning data: demos from a demo policy, or task data the env
+        # provides directly (reference :125-167).
+        condition_data: List[Any] = []
+        if (
+            demo_policy_cls is not None
+            and hasattr(env, "get_demonstration")
+            and hasattr(policy, "adapt")
+        ):
+            for _ in range(num_demos):
+                episode_data = _run_demo_episode(env, demo_policy_cls(env))
+                condition_data.append(episode_data)
+                if replay_writer and episode_to_transitions_fn:
+                    replay_writer.write(
+                        episode_to_transitions_fn(episode_data, is_demo=True)
+                    )
+            policy.adapt(copy.copy(condition_data))
+        elif hasattr(env, "task_data") and hasattr(policy, "adapt"):
+            for episode_name, episode_data in env.task_data.items():
+                if str(episode_name).startswith("condition_ep"):
+                    condition_data.append(episode_data)
+            policy.adapt(copy.copy(condition_data))
+
+        for step_num in range(num_adaptations_per_task):
+            if step_num != 0 and hasattr(policy, "adapt"):
+                policy.adapt(copy.copy(condition_data))
+            for _ in range(num_episodes_per_adaptation):
+                done, env_step, episode_reward = False, 0, 0.0
+                episode_data = []
+                policy.reset()
+                obs = env.reset()
+                explore_prob = (
+                    explore_schedule.value(global_step)
+                    if explore_schedule
+                    else 0
+                )
+                while not done:
+                    action, policy_debug = policy.sample_action(
+                        obs, explore_prob
+                    )
+                    debug = dict(policy_debug or {})
+                    if policy_debug and "q_predicted" in policy_debug:
+                        episode_q_values[env_step].append(
+                            float(np.mean(policy_debug["q_predicted"]))
+                        )
+                    new_obs, reward, done, env_debug = env.step(action)
+                    debug.update(env_debug or {})
+                    env_step += 1
+                    episode_reward += reward
+                    episode_data.append(
+                        (obs, action, reward, new_obs, done, debug)
+                    )
+                    obs = new_obs
+                task_step_rewards[task_idx][step_num].append(episode_reward)
+                if replay_writer and episode_to_transitions_fn:
+                    replay_writer.write(
+                        episode_to_transitions_fn(episode_data)
+                    )
+                condition_data.append(episode_data)
+
+        if replay_writer:
+            replay_writer.close()
+        if break_after_one_task:
+            break
+
+    # Aggregate: per-adaptation-step mean reward + improvement deltas
+    # (reference :232-258).
+    stats: Dict[str, float] = {}
+    ran_tasks = sorted(task_step_rewards.keys())
+    for step_num in range(num_adaptations_per_task):
+        step_rewards = [
+            np.mean(task_step_rewards[t][step_num])
+            for t in ran_tasks
+            if task_step_rewards[t][step_num]
+        ]
+        if step_rewards:
+            stats[f"{tag}/step_{step_num}_reward"] = float(
+                np.mean(step_rewards)
+            )
+        if step_num > 0:
+            deltas = [
+                np.mean(task_step_rewards[t][step_num])
+                - np.mean(task_step_rewards[t][step_num - 1])
+                for t in ran_tasks
+                if task_step_rewards[t][step_num]
+                and task_step_rewards[t][step_num - 1]
+            ]
+            if deltas:
+                stats[f"{tag}/step_{step_num}_improvement"] = float(
+                    np.mean(deltas)
+                )
+    for step, q_values in episode_q_values.items():
+        stats[f"{tag}/Q/{step}"] = float(np.mean(q_values))
+
+    if write_summaries and root_dir:
+        writer = MetricsWriter(os.path.join(root_dir, f"live_eval_{task}"))
+        writer.write(global_step, stats)
+        writer.close()
+    return stats

@@ -96,9 +96,12 @@ class Grasp2VecPreprocessor(SpecTransformationPreprocessor):
         return spec
 
     def _preprocess_fn(self, features, labels, mode, rng):
+        # No rng = no stochastic augmentation (center crops, no flips) —
+        # the framework-wide None-rng convention.
         if rng is None:
-            rng = jax.random.PRNGKey(0)
-        rng_scene, rng_goal, rng_flip = jax.random.split(rng, 3)
+            rng_scene = rng_goal = rng_flip = None
+        else:
+            rng_scene, rng_goal, rng_flip = jax.random.split(rng, 3)
         scene, _, _ = maybe_crop_images(
             [features["pregrasp_image"], features["postgrasp_image"]],
             self._scene_crop,
@@ -115,14 +118,16 @@ class Grasp2VecPreprocessor(SpecTransformationPreprocessor):
         # independently. (The reference flips every key independently,
         # grasp2vec_model.py:128-131 — a weaker choice we deliberately
         # tighten, since `pre - post ≈ goal` compares the scene pair.)
-        flip_rngs = {
-            "pregrasp_image": rng_flip,
-            "postgrasp_image": rng_flip,
-            "goal_image": jax.random.fold_in(rng_flip, 1),
-        }
+        flip = mode == MODE_TRAIN and rng_flip is not None
+        if flip:
+            flip_rngs = {
+                "pregrasp_image": rng_flip,
+                "postgrasp_image": rng_flip,
+                "goal_image": jax.random.fold_in(rng_flip, 1),
+            }
         for name in _IMAGE_KEYS:
             image = features[name].astype(jnp.float32) / 255.0
-            if mode == MODE_TRAIN:
+            if flip:
                 image = _random_flips(image, flip_rngs[name])
             features[name] = image
         return features, labels

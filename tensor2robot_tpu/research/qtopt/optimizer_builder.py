@@ -68,9 +68,14 @@ def build_opt(hparams: Optional[QtOptHParams] = None) -> optax.GradientTransform
             momentum=hparams.momentum,
             eps=hparams.rmsprop_epsilon,
         )
-    return optax.adam(
-        learning_rate,
-        b1=hparams.momentum,
-        b2=hparams.adam_beta2,
-        eps=hparams.adam_epsilon,
+    if hparams.optimizer == "adam":
+        return optax.adam(
+            learning_rate,
+            b1=hparams.momentum,
+            b2=hparams.adam_beta2,
+            eps=hparams.adam_epsilon,
+        )
+    raise ValueError(
+        f"Unknown optimizer {hparams.optimizer!r}; expected one of "
+        "'momentum', 'rmsprop', 'adam'."
     )

@@ -24,23 +24,11 @@ from typing import Any, Callable, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
+from tensor2robot_tpu.utils.keypath import path_string
+
 PyTree = Any
 
 _EPS = 1e-5
-
-
-def _path_string(path) -> str:
-    parts = []
-    for entry in path:
-        if hasattr(entry, "key"):
-            parts.append(str(entry.key))
-        elif hasattr(entry, "idx"):
-            parts.append(str(entry.idx))
-        elif hasattr(entry, "name"):
-            parts.append(str(entry.name))
-        else:
-            parts.append(str(entry))
-    return "/".join(parts)
 
 
 def make_surgery_mask(
@@ -55,7 +43,7 @@ def make_surgery_mask(
     deny = list(denylist) if denylist is not None else []
 
     def decide(path, _leaf):
-        name = _path_string(path)
+        name = path_string(path)
         return any(fnmatch.fnmatchcase(name, w) for w in allow) and not any(
             fnmatch.fnmatchcase(name, w) for w in deny
         )

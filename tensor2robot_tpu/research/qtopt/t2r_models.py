@@ -60,8 +60,10 @@ class DefaultGrasping44ImagePreprocessor(SpecTransformationPreprocessor):
 
     def _preprocess_fn(self, features, labels, mode, rng):
         image = features.state.image
-        if mode == MODE_TRAIN:
-            rng = rng if rng is not None else jax.random.PRNGKey(0)
+        # No rng = no stochastic augmentation (deterministic center crop),
+        # matching the framework-wide None-rng convention; silently reusing
+        # a fixed key would repeat identical distortions every batch.
+        if mode == MODE_TRAIN and rng is not None:
             rng_crop, rng_distort = jax.random.split(rng)
             image = image_transformations.random_crop_image_batch(
                 rng_crop, image, TARGET_SHAPE

@@ -253,7 +253,10 @@ def copy_tensorspec(
             name = f"{prefix}/{name}"
         shape = spec.shape
         if batch_size is not None:
-            shape = (batch_size,) + tuple(shape)
+            # batch_size=-1 prepends a wildcard dim (the reference's
+            # make_placeholders(batch_size=-1) "unknown batch" semantics).
+            leading = None if batch_size == -1 else batch_size
+            shape = (leading,) + tuple(shape)
         out[key] = ExtendedTensorSpec.from_spec(spec, name=name, shape=shape)
     return out
 

@@ -1,0 +1,20 @@
+"""Keypath helpers shared by pytree-path-addressed features (PCGrad
+allow/deny masks, MAML var_scope adaptation filters)."""
+
+from __future__ import annotations
+
+
+def path_string(path) -> str:
+    """'/'-joins a jax.tree_util keypath into the familiar variable-name
+    form, e.g. ('params', 'dense', 'kernel') -> 'params/dense/kernel'."""
+    parts = []
+    for entry in path:
+        if hasattr(entry, "key"):
+            parts.append(str(entry.key))
+        elif hasattr(entry, "name"):
+            parts.append(str(entry.name))
+        elif hasattr(entry, "idx"):
+            parts.append(str(entry.idx))
+        else:
+            parts.append(str(entry))
+    return "/".join(parts)

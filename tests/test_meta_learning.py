@@ -1,0 +1,450 @@
+"""Meta-learning subsystem tests (reference meta_learning/*_test.py,
+especially maml_inner_loop_test.py numerics)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensor2robot_tpu.data.encoder import encode_example
+from tensor2robot_tpu.data.parser import SpecParser
+from tensor2robot_tpu.meta_learning import (
+    FixedLenMetaExamplePreprocessor,
+    MAMLInnerLoopGradientDescent,
+    MAMLModel,
+    MAMLPreprocessorV2,
+    create_maml_feature_spec,
+    create_maml_label_spec,
+    create_metaexample_spec,
+    meta_example,
+    meta_tfdata,
+    stack_intra_task_episodes,
+)
+from tensor2robot_tpu.specs import (
+    ExtendedTensorSpec,
+    TensorSpecStruct,
+    flatten_spec_structure,
+)
+from tensor2robot_tpu.utils.mocks import MockT2RModel
+
+LEARNING_RATE = 0.001
+COEFF_A_VALUE = 0.25
+X_INIT = 2.0
+
+
+class TestMetaTfdata:
+    def test_flatten_unflatten_roundtrip(self):
+        x = jnp.arange(24.0).reshape(2, 3, 4)
+        flat = meta_tfdata.flatten_batch_examples({"x": x})
+        assert flat["x"].shape == (6, 4)
+        back = meta_tfdata.unflatten_batch_examples(flat, 3)
+        np.testing.assert_array_equal(back["x"], x)
+
+    def test_rank1_passes_through(self):
+        x = jnp.arange(4.0)
+        flat = meta_tfdata.flatten_batch_examples({"x": x})
+        assert flat["x"].shape == (4,)
+
+    def test_merge_expand(self):
+        x = jnp.zeros((2, 3, 4, 5))
+        merged = meta_tfdata.merge_first_n_dims({"x": x}, 3)
+        assert merged["x"].shape == (24, 5)
+        expanded = meta_tfdata.expand_batch_dims(merged, (2, 3, 4))
+        assert expanded["x"].shape == (2, 3, 4, 5)
+
+    def test_multi_batch_apply(self):
+        def fn(d):
+            return {"y": d["x"] * 2.0}
+
+        out = meta_tfdata.multi_batch_apply(fn, 2, {"x": jnp.ones((2, 3, 5))})
+        assert out["y"].shape == (2, 3, 5)
+        np.testing.assert_allclose(out["y"], 2.0)
+
+    def test_split_train_val_and_tile(self):
+        x = jnp.arange(12.0).reshape(2, 6)
+        train, val = meta_tfdata.split_train_val({"x": x}, 4)
+        assert train["x"].shape == (2, 4)
+        assert val["x"].shape == (2, 2)
+        tiled = meta_tfdata.tile_val_mode(val, 3)
+        assert tiled["x"].shape == (2, 6)
+
+
+def _quadratic_setup(**inner_kwargs):
+    """The reference fixture: minimize (x * coeff_a - 0)^2 with x init 2.0
+    (maml_inner_loop_test.py:25-62)."""
+    inner = MAMLInnerLoopGradientDescent(
+        learning_rate=LEARNING_RATE, **inner_kwargs
+    )
+    params = {"x": jnp.asarray([X_INIT])}
+    variables = {"params": params}
+    features = {"coeff_a": jnp.asarray([COEFF_A_VALUE])}
+    labels = {"target": jnp.asarray([0.0])}
+
+    def inference_network_fn(variables, feats, mode):
+        return {"prediction": variables["params"]["x"] * feats["coeff_a"]}, {}
+
+    def model_train_fn(feats, labs, outputs, mode):
+        return jnp.mean(jnp.square(outputs["prediction"] - labs["target"]))
+
+    return inner, variables, features, labels, inference_network_fn, model_train_fn
+
+
+class TestMAMLInnerLoop:
+    @pytest.mark.parametrize("learn_inner_lr", [False, True])
+    @pytest.mark.parametrize("use_second_order", [False, True])
+    def test_inner_losses_decrease(self, learn_inner_lr, use_second_order):
+        inner, variables, features, labels, net_fn, train_fn = (
+            _quadratic_setup(
+                use_second_order=use_second_order,
+                learn_inner_lr=learn_inner_lr,
+            )
+        )
+        inner_lrs = inner.create_inner_lr_params(variables["params"])
+        inputs = [(features, labels)] * 3
+        outputs, inner_outputs, inner_losses = inner.inner_loop(
+            variables, inputs, net_fn, train_fn, "train",
+            inner_lrs=inner_lrs or None,
+        )
+        # Progress with every adaptation step (reference :188-195).
+        values = [float(l) for l in inner_losses]
+        for previous, current in zip(values, values[1:]):
+            assert current < previous
+        # 3 entries: 2 gradient steps + final monitored pass.
+        assert len(inner_losses) == 3
+        assert len(inner_outputs) == 3
+        # Conditioned val output differs from unconditioned.
+        uncond, cond = outputs
+        assert not np.allclose(
+            np.asarray(uncond["prediction"]), np.asarray(cond["prediction"])
+        )
+
+    def test_outer_optimization_converges(self):
+        inner, variables, features, labels, net_fn, train_fn = (
+            _quadratic_setup(use_second_order=True)
+        )
+
+        def outer_loss(params):
+            outputs, _, _ = inner.inner_loop(
+                {"params": params},
+                [(features, labels)] * 3,
+                net_fn,
+                train_fn,
+                "train",
+            )
+            conditioned = outputs[1]
+            return train_fn(features, labels, conditioned, "train")
+
+        params = variables["params"]
+        x_previous = float(params["x"][0])
+        grad_fn = jax.jit(jax.grad(outer_loss))
+        for _ in range(10):
+            grads = grad_fn(params)
+            params = jax.tree_util.tree_map(
+                lambda p, g: p - LEARNING_RATE * g, params, grads
+            )
+            x_new = float(params["x"][0])
+            assert x_new < x_previous  # reference :209-216
+            x_previous = x_new
+
+    def test_second_order_changes_meta_gradient(self):
+        # The JAX analogue of "the second-order graph is larger": the meta
+        # gradients must differ numerically between FOMAML and full MAML.
+        metas = {}
+        for use_second_order in (False, True):
+            inner, variables, features, labels, net_fn, train_fn = (
+                _quadratic_setup(use_second_order=use_second_order)
+            )
+
+            def outer_loss(params):
+                outputs, _, _ = inner.inner_loop(
+                    {"params": params},
+                    [(features, labels)] * 3,
+                    net_fn,
+                    train_fn,
+                    "train",
+                )
+                return train_fn(features, labels, outputs[1], "train")
+
+            metas[use_second_order] = float(
+                jax.grad(outer_loss)(variables["params"])["x"][0]
+            )
+        assert metas[False] != metas[True]
+
+    def test_learned_inner_lr_receives_gradient(self):
+        inner, variables, features, labels, net_fn, train_fn = (
+            _quadratic_setup(learn_inner_lr=True)
+        )
+        inner_lrs = inner.create_inner_lr_params(variables["params"])
+        assert float(inner_lrs["x"]) == pytest.approx(LEARNING_RATE)
+
+        def outer_loss(params, lrs):
+            outputs, _, _ = inner.inner_loop(
+                {"params": params},
+                [(features, labels)] * 3,
+                net_fn,
+                train_fn,
+                "train",
+                inner_lrs=lrs,
+            )
+            return train_fn(features, labels, outputs[1], "train")
+
+        lr_grads = jax.grad(outer_loss, argnums=1)(
+            variables["params"], inner_lrs
+        )
+        assert float(jnp.abs(lr_grads["x"])) > 0.0
+
+    def test_var_scope_freezes_other_params(self):
+        inner = MAMLInnerLoopGradientDescent(
+            learning_rate=0.1, var_scope="adapt"
+        )
+        params = {"adapt": jnp.ones((2,)), "frozen": jnp.ones((2,))}
+        features = {"coeff_a": jnp.ones((2,))}
+        labels = {"target": jnp.zeros((2,))}
+
+        def net_fn(variables, feats, mode):
+            p = variables["params"]
+            return {"prediction": (p["adapt"] + p["frozen"]) * feats["coeff_a"]}, {}
+
+        def train_fn(feats, labs, outputs, mode):
+            return jnp.mean(jnp.square(outputs["prediction"] - labs["target"]))
+
+        _, _, losses = inner.inner_loop(
+            {"params": params}, [(features, labels)] * 3, net_fn, train_fn,
+            "train",
+        )
+        assert float(losses[-1]) < float(losses[0])
+
+
+class TestMAMLSpecs:
+    def test_create_maml_feature_spec_structure(self):
+        model = MockT2RModel()
+        spec = create_maml_feature_spec(
+            model.get_feature_specification("train"),
+            model.get_label_specification("train"),
+        )
+        flat = flatten_spec_structure(spec)
+        assert "condition/features/x" in flat.keys()
+        assert "condition/labels/a_target" in flat.keys()
+        assert "inference/features/x" in flat.keys()
+        # Per-task samples dim is a wildcard; names gain routing prefixes.
+        assert flat["condition/features/x"].shape == (None, 3)
+        assert flat["condition/features/x"].name.startswith(
+            "condition_features/"
+        )
+
+    def test_create_maml_label_spec(self):
+        model = MockT2RModel()
+        spec = create_maml_label_spec(model.get_label_specification("train"))
+        flat = flatten_spec_structure(spec)
+        assert flat["a_target"].shape == (None, 1)
+        assert flat["a_target"].name.startswith("meta_labels/")
+
+    def test_metaexample_spec_and_stacking(self):
+        model = MockT2RModel()
+        spec = create_metaexample_spec(
+            model.get_feature_specification("train"), 2, "condition"
+        )
+        assert spec["x/0"].name == "condition_ep0/measured_position"
+        assert spec["x/1"].name == "condition_ep1/measured_position"
+        tensors = TensorSpecStruct()
+        tensors["x/0"] = jnp.zeros((4, 3))
+        tensors["x/1"] = jnp.ones((4, 3))
+        stacked = stack_intra_task_episodes(tensors, 2)
+        assert stacked["x"].shape == (4, 2, 3)
+        np.testing.assert_allclose(stacked["x"][:, 1], 1.0)
+
+
+class TestMAMLPreprocessor:
+    def test_preprocess_roundtrip(self):
+        model = MockT2RModel()
+        preprocessor = MAMLPreprocessorV2(model.preprocessor)
+        tasks, num_condition, num_inference = 2, 4, 3
+        features = TensorSpecStruct()
+        features["condition/features/x"] = np.zeros(
+            (tasks, num_condition, 3), np.float32
+        )
+        features["condition/labels/a_target"] = np.zeros(
+            (tasks, num_condition, 1), np.float32
+        )
+        features["inference/features/x"] = np.zeros(
+            (tasks, num_inference, 3), np.float32
+        )
+        labels = TensorSpecStruct()
+        labels["a_target"] = np.zeros((tasks, num_inference, 1), np.float32)
+        out_features, out_labels = preprocessor.preprocess(
+            features, labels, mode="train", rng=jax.random.PRNGKey(0)
+        )
+        assert out_features["condition/features/x"].shape == (
+            tasks, num_condition, 3,
+        )
+        assert out_features["inference/features/x"].shape == (
+            tasks, num_inference, 3,
+        )
+        assert out_labels["a_target"].shape == (tasks, num_inference, 1)
+
+
+class _MockMAMLModel(MAMLModel):
+    """Concrete MAML model: selects the classifier logit as both outputs."""
+
+    def _select_inference_output(self, predictions):
+        predictions["condition_output"] = predictions[
+            "full_condition_output/a_predicted"
+        ]
+        predictions["inference_output"] = predictions[
+            "full_inference_output/a_predicted"
+        ]
+        return predictions
+
+
+def _meta_batch(tasks=4, num_condition=8, num_inference=8, seed=0):
+    """Linearly separable per-task data with task-dependent label flips so
+    adaptation has something to learn."""
+    rng = np.random.RandomState(seed)
+    x = rng.uniform(-1, 1, size=(tasks, num_condition + num_inference, 3))
+    y = (x.sum(axis=-1, keepdims=True) > 0).astype(np.float32)
+    features = TensorSpecStruct()
+    features["condition/features/x"] = x[:, :num_condition].astype(np.float32)
+    features["condition/labels/a_target"] = y[:, :num_condition]
+    features["inference/features/x"] = x[:, num_condition:].astype(np.float32)
+    labels = TensorSpecStruct()
+    labels["a_target"] = y[:, num_condition:]
+    return features, labels
+
+
+class TestMAMLModel:
+    def make_model(self, **kwargs):
+        base = MockT2RModel(device_type="cpu", use_batch_norm=False)
+        return _MockMAMLModel(base_model=base, **kwargs)
+
+    def test_specs_match_reference_layout(self):
+        model = self.make_model()
+        feature_spec = flatten_spec_structure(
+            model.get_feature_specification("train")
+        )
+        assert "condition/features/x" in feature_spec.keys()
+        packing = model.get_feature_specification_for_packing("train")
+        assert "x" in flatten_spec_structure(packing).keys()
+
+    def test_init_and_forward(self):
+        model = self.make_model(num_inner_loop_steps=2)
+        features, labels = _meta_batch()
+        variables = model.init_variables(jax.random.PRNGKey(0), features)
+        assert "base" in variables["params"]
+        outputs, mutable = model.inference_network_fn(
+            variables, features, "train"
+        )
+        assert mutable == {}
+        assert outputs["inference_output"].shape == (4, 8, 1)
+        assert outputs["condition_output"].shape == (4, 8, 1)
+        # k+1 = 3 condition step outputs recorded.
+        assert "full_condition_outputs/output_2/a_predicted" in outputs.keys()
+        loss, metrics = model.model_train_fn(
+            features, labels, outputs, "train"
+        )
+        assert np.isfinite(float(loss))
+        assert "inner_loss_0" in metrics and "inner_loss_2" in metrics
+
+    def test_missing_selection_keys_raises(self):
+        class BadModel(MAMLModel):
+            def _select_inference_output(self, predictions):
+                return predictions
+
+        base = MockT2RModel(device_type="cpu", use_batch_norm=False)
+        model = BadModel(base_model=base)
+        features, _ = _meta_batch()
+        variables = model.init_variables(jax.random.PRNGKey(0), features)
+        with pytest.raises(ValueError, match="condition_output"):
+            model.inference_network_fn(variables, features, "train")
+
+    def test_meta_training_reduces_loss(self):
+        model = self.make_model(
+            num_inner_loop_steps=1, inner_learning_rate=0.1,
+        )
+        features, labels = _meta_batch()
+        variables = model.init_variables(jax.random.PRNGKey(0), features)
+        optimizer = model.create_optimizer()
+
+        @jax.jit
+        def train_step(params, opt_state):
+            def loss_fn(p):
+                outputs, _ = model.inference_network_fn(
+                    {"params": p}, features, "train"
+                )
+                loss, _ = model.model_train_fn(
+                    features, labels, outputs, "train"
+                )
+                return loss
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            import optax
+
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        params = variables["params"]
+        opt_state = optimizer.init(params)
+        first_loss = None
+        for _ in range(30):
+            params, opt_state, loss = train_step(params, opt_state)
+            if first_loss is None:
+                first_loss = float(loss)
+        assert float(loss) < first_loss
+
+    def test_learned_inner_lr_is_meta_param(self):
+        model = self.make_model(learn_inner_lr=True)
+        features, _ = _meta_batch()
+        variables = model.init_variables(jax.random.PRNGKey(0), features)
+        lr_leaves = jax.tree_util.tree_leaves(
+            variables["params"]["inner_lrs"]
+        )
+        assert lr_leaves and all(leaf.shape == () for leaf in lr_leaves)
+
+
+class TestMetaExample:
+    def test_make_meta_example_and_parse(self):
+        model = MockT2RModel()
+        base_pre = model.preprocessor
+        meta_pre = FixedLenMetaExamplePreprocessor(
+            base_pre,
+            num_condition_samples_per_task=2,
+            num_inference_samples_per_task=1,
+        )
+        feature_spec = model.get_feature_specification("train")
+        label_spec = model.get_label_specification("train")
+
+        def episode(seed):
+            rng = np.random.RandomState(seed)
+            values = TensorSpecStruct()
+            values["x"] = rng.rand(3).astype(np.float32)
+            values["a_target"] = rng.rand(1).astype(np.float32)
+            spec = TensorSpecStruct()
+            spec["x"] = feature_spec["x"]
+            spec["a_target"] = label_spec["a_target"]
+            from tensor2robot_tpu.proto import example_pb2
+
+            proto = example_pb2.Example()
+            proto.ParseFromString(encode_example(spec, values))
+            return proto
+
+        meta = meta_example.make_meta_example(
+            [episode(0), episode(1)], [episode(2)]
+        )
+        serialized = meta.SerializeToString()
+
+        # Parse through the FixedLen MetaExample spec: names must line up.
+        parser = SpecParser(meta_pre.get_in_feature_specification("train"))
+        parsed = parser.parse_batch([serialized, serialized])
+        assert parsed["condition/features/x/0"].shape == (2, 3)
+        assert parsed["condition/features/x/1"].shape == (2, 3)
+        assert parsed["inference/features/x/0"].shape == (2, 3)
+
+        # And the full preprocess produces task-structured tensors.
+        label_parser = SpecParser(meta_pre.get_in_label_specification("train"))
+        parsed_labels = label_parser.parse_batch([serialized, serialized])
+        out_features, out_labels = meta_pre.preprocess(
+            parsed, parsed_labels, mode="train", rng=jax.random.PRNGKey(0)
+        )
+        assert out_features["condition/features/x"].shape == (2, 2, 3)
+        assert out_features["inference/features/x"].shape == (2, 1, 3)
+        assert out_labels["a_target"].shape == (2, 1, 1)
