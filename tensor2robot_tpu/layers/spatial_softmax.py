@@ -20,8 +20,17 @@ def _coordinate_grids(num_rows: int, num_cols: int, dtype) -> Tuple[jax.Array, j
     """Flattened x/y position grids in [-1, 1], row-major."""
     cols = jnp.arange(num_cols, dtype=dtype)
     rows = jnp.arange(num_rows, dtype=dtype)
-    x = 2.0 * cols / (num_cols - 1.0) - 1.0  # varies along width
-    y = 2.0 * rows / (num_rows - 1.0) - 1.0  # varies along height
+    # Singleton dims sit at the center (0): avoids 0/0 for 1-wide maps.
+    x = (
+        2.0 * cols / (num_cols - 1.0) - 1.0  # varies along width
+        if num_cols > 1
+        else jnp.zeros_like(cols)
+    )
+    y = (
+        2.0 * rows / (num_rows - 1.0) - 1.0  # varies along height
+        if num_rows > 1
+        else jnp.zeros_like(rows)
+    )
     x_pos = jnp.tile(x[None, :], (num_rows, 1)).reshape(-1)
     y_pos = jnp.tile(y[:, None], (1, num_cols)).reshape(-1)
     return x_pos, y_pos

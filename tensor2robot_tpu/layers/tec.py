@@ -81,6 +81,7 @@ class ReduceTemporalEmbeddings(nn.Module):
     conv1d_layers: Optional[Sequence[int]] = (64,)
     fc_hidden_layers: Sequence[int] = (100,)
     combine_mode: str = "temporal_conv"
+    conv1d_kernel: int = 10
 
     @nn.compact
     def __call__(self, temporal_embedding: jax.Array) -> jax.Array:
@@ -97,9 +98,20 @@ class ReduceTemporalEmbeddings(nn.Module):
         else:
             if self.conv1d_layers is not None:
                 for i, num_filters in enumerate(self.conv1d_layers):
+                    # The kernel is a static config choice (conv1d_kernel),
+                    # NOT clamped to the runtime length — parameter shapes
+                    # must not depend on T or checkpoints stop restoring
+                    # across sequence lengths. Callers with short episodes
+                    # configure a smaller kernel.
+                    if embedding.shape[1] < self.conv1d_kernel:
+                        raise ValueError(
+                            f"Temporal length {embedding.shape[1]} is shorter "
+                            f"than conv1d_kernel={self.conv1d_kernel}; "
+                            "configure a smaller conv1d_kernel."
+                        )
                     embedding = nn.Conv(
                         num_filters,
-                        (10,),
+                        (self.conv1d_kernel,),
                         padding="VALID",
                         use_bias=False,
                         name=f"conv1d_{i}",

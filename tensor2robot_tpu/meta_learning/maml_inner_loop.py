@@ -94,6 +94,8 @@ class MAMLInnerLoopGradientDescent:
         model_train_fn: Callable,
         mode: str,
         inner_lrs: Optional[PyTree] = None,
+        inner_inference_network_fn: Optional[Callable] = None,
+        inner_model_train_fn: Optional[Callable] = None,
     ):
         """Runs len(inputs_list)-1 adaptation steps (reference :213-328).
 
@@ -102,7 +104,8 @@ class MAMLInnerLoopGradientDescent:
           inputs_list: ((cond_f, cond_l),)*k + ((val_f, val_l),); the last
             entry is validation data never used for inner gradients.
           inference_network_fn: base model forward,
-            (variables, features, mode) -> (outputs, mutable_updates).
+            (variables, features, mode, labels=...) -> (outputs,
+            mutable_updates).
             Mutable updates (batch-stats) are discarded inside the loop —
             the reference's while_loop had the same batch-norm caveat
             (maml_model.py:300-304).
@@ -110,6 +113,13 @@ class MAMLInnerLoopGradientDescent:
             (loss, metrics).
           mode: train/eval/predict.
           inner_lrs: learned per-variable LR pytree (when learn_inner_lr).
+          inner_inference_network_fn: optional distinct forward for the
+            adaptation steps and the unconditioned val pass (the reference's
+            params['is_inner_loop'] switch, e.g. domain-adaptive models
+            withholding inputs in the inner loop); the conditioned val pass
+            always uses `inference_network_fn`.
+          inner_model_train_fn: optional distinct inner-step loss (the
+            reference's learned-loss models keyed off params flags).
 
         Returns:
           ([unconditioned_val_outputs, conditioned_val_outputs],
@@ -117,16 +127,20 @@ class MAMLInnerLoopGradientDescent:
         """
         base_variables = dict(base_variables)
         original_params = base_variables["params"]
+        inner_forward_fn = inner_inference_network_fn or inference_network_fn
+        inner_train_fn = inner_model_train_fn or model_train_fn
 
-        def forward(params, features):
+        def forward(params, features, labels=None, fn=None):
             variables = dict(base_variables)
             variables["params"] = params
-            outputs, _ = inference_network_fn(variables, features, mode)
+            outputs, _ = (fn or inference_network_fn)(
+                variables, features, mode, labels=labels
+            )
             return outputs
 
         def step_loss(params, features, labels):
-            outputs = forward(params, features)
-            result = model_train_fn(features, labels, outputs, mode)
+            outputs = forward(params, features, labels, fn=inner_forward_fn)
+            result = inner_train_fn(features, labels, outputs, mode)
             loss = result[0] if isinstance(result, tuple) else result
             return loss, outputs
 
@@ -152,7 +166,9 @@ class MAMLInnerLoopGradientDescent:
         inner_outputs.append(final_outputs)
         inner_losses.append(final_loss)
 
-        val_features, _ = inputs_list[-1]
-        conditioned = forward(adapted, val_features)
-        unconditioned = forward(original_params, val_features)
+        val_features, val_labels = inputs_list[-1]
+        conditioned = forward(adapted, val_features, val_labels)
+        unconditioned = forward(
+            original_params, val_features, val_labels, fn=inner_forward_fn
+        )
         return [unconditioned, conditioned], inner_outputs, inner_losses

@@ -136,19 +136,38 @@ class MAMLModel(AbstractT2RModel):
 
     # -- forward --------------------------------------------------------------
 
-    def inference_network_fn(self, variables, features, mode, rng=None):
+    def inference_network_fn(
+        self, variables, features, mode, rng=None, labels=None
+    ):
         base_variables = self._base_variables(variables)
         inner_lrs = variables["params"].get("inner_lrs") or None
         k = self._num_inner_loop_steps
 
-        def base_inference(vars_, task_features, mode_):
+        def base_inference(vars_, task_features, mode_, labels=None):
+            # rng is shared across tasks/steps (flax folds in module paths;
+            # per-task decorrelation would need per-task keys plumbed
+            # through vmap — not needed for the current model zoo).
             return self._base_model.inference_network_fn(
-                vars_, task_features, mode_
+                vars_, task_features, mode_, rng, labels=labels
             )
 
-        def task_learn(cond_features, cond_labels, inf_features):
+        # Optional inner-loop-specific hooks on the base model (the
+        # reference's params['is_inner_loop'] switch): a distinct forward
+        # and/or a distinct adaptation loss (learned-loss models).
+        inner_forward = getattr(
+            self._base_model, "inner_inference_network_fn", None
+        )
+        inner_train = getattr(self._base_model, "model_inner_loop_fn", None)
+
+        def task_learn(cond_features, cond_labels, inf_features, inf_labels):
+            # The val entry carries the real meta labels (per-task inference
+            # labels) when available so label-consuming base networks (NLL
+            # decoder heads) see targets of the right shape; condition
+            # labels are only a structural placeholder in PREDICT
+            # (reference's unused_inference_labels, maml_model.py:292-296).
+            val_labels = inf_labels if inf_labels is not None else cond_labels
             inputs_list = ((cond_features, cond_labels),) * k + (
-                (inf_features, cond_labels),
+                (inf_features, val_labels),
             )
             (uncond, cond), inner_outputs, inner_losses = (
                 self._inner_loop.inner_loop(
@@ -158,6 +177,8 @@ class MAMLModel(AbstractT2RModel):
                     self._base_model.model_train_fn,
                     mode,
                     inner_lrs=inner_lrs,
+                    inner_inference_network_fn=inner_forward,
+                    inner_model_train_fn=inner_train,
                 )
             )
             return uncond, cond, tuple(inner_outputs), tuple(inner_losses)
@@ -166,6 +187,7 @@ class MAMLModel(AbstractT2RModel):
             features.condition.features,
             features.condition.labels,
             features.inference.features,
+            labels,
         )
 
         predictions = TensorSpecStruct()

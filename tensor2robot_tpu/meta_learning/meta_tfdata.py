@@ -51,10 +51,11 @@ def unflatten_batch_examples(structure: PyTree, num_samples_per_task: int) -> Py
 
 
 def merge_first_n_dims(structure: PyTree, n: int) -> PyTree:
-    """Collapses the first n dims of every array (reference :222-238)."""
+    """Collapses the first n dims of every array (reference :222-238).
+    Scalars (0-d) pass through — they carry no batch dims to merge."""
 
     def reshape(x):
-        if not _is_array(x):
+        if not _is_array(x) or x.ndim == 0:
             return x
         return jnp.reshape(x, (-1,) + tuple(x.shape[n:]))
 
@@ -63,11 +64,11 @@ def merge_first_n_dims(structure: PyTree, n: int) -> PyTree:
 
 def expand_batch_dims(structure: PyTree, batch_sizes: Sequence[int]) -> PyTree:
     """Re-expands the first dim of every array to `batch_sizes`
-    (reference :241-257)."""
+    (reference :241-257). Scalars (0-d, e.g. reduced losses) pass through."""
     batch_sizes = tuple(int(b) for b in batch_sizes)
 
     def reshape(x):
-        if not _is_array(x):
+        if not _is_array(x) or x.ndim == 0:
             return x
         return jnp.reshape(x, batch_sizes + tuple(x.shape[1:]))
 

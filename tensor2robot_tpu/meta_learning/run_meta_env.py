@@ -76,7 +76,10 @@ def run_meta_env(
         if hasattr(env, "reset_task"):
             env.reset_task()
 
-        if replay_writer and root_dir:
+        # Writing needs the writer, a converter, AND a destination; gate all
+        # three together so write() is never reachable without open().
+        writing = bool(replay_writer and episode_to_transitions_fn and root_dir)
+        if writing:
             timestamp = datetime.datetime.now().strftime("%Y-%m-%d-%H-%M-%S")
             record_name = os.path.join(
                 root_dir, f"gs{global_step}_t{task}_{timestamp}_{task_idx}"
@@ -94,7 +97,7 @@ def run_meta_env(
             for _ in range(num_demos):
                 episode_data = _run_demo_episode(env, demo_policy_cls(env))
                 condition_data.append(episode_data)
-                if replay_writer and episode_to_transitions_fn:
+                if writing:
                     replay_writer.write(
                         episode_to_transitions_fn(episode_data, is_demo=True)
                     )
@@ -113,11 +116,15 @@ def run_meta_env(
                 episode_data = []
                 policy.reset()
                 obs = env.reset()
-                explore_prob = (
-                    explore_schedule.value(global_step)
-                    if explore_schedule
-                    else 0
-                )
+                # Schedules are plain callables framework-wide (run_env.py
+                # convention); .value objects are accepted for parity with
+                # reference gin configs.
+                if explore_schedule is None:
+                    explore_prob = 0
+                elif hasattr(explore_schedule, "value"):
+                    explore_prob = explore_schedule.value(global_step)
+                else:
+                    explore_prob = explore_schedule(global_step)
                 while not done:
                     action, policy_debug = policy.sample_action(
                         obs, explore_prob
@@ -136,13 +143,13 @@ def run_meta_env(
                     )
                     obs = new_obs
                 task_step_rewards[task_idx][step_num].append(episode_reward)
-                if replay_writer and episode_to_transitions_fn:
+                if writing:
                     replay_writer.write(
                         episode_to_transitions_fn(episode_data)
                     )
                 condition_data.append(episode_data)
 
-        if replay_writer:
+        if writing:
             replay_writer.close()
         if break_after_one_task:
             break

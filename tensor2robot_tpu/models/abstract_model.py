@@ -153,11 +153,17 @@ class AbstractT2RModel(ModelInterface):
         features: TensorSpecStruct,
         mode: str,
         rng: Optional[jax.Array] = None,
+        labels: Optional[TensorSpecStruct] = None,
     ) -> Tuple[TensorSpecStruct, ModelVariables]:
         """Pure forward pass. Returns (outputs, updated_mutable_collections);
         the second element carries e.g. new batch_stats in train mode and is
         {} when the model has no mutable state (reference
-        inference_network_fn's optional update_ops tuple, :703-712)."""
+        inference_network_fn's optional update_ops tuple, :703-712).
+
+        `labels` mirrors the reference's inference_network_fn(features,
+        labels, ...) signature (:703): density-style heads (MDN/MAF decoders)
+        emit their negative log-likelihood as an output tensor when labels
+        are available, since the loss depends on network-internal params."""
 
     @abc.abstractmethod
     def model_train_fn(
@@ -223,7 +229,7 @@ class AbstractT2RModel(ModelInterface):
                 self.get_label_specification(mode), labels, ignore_batch=True
             )
         outputs, mutable = self.inference_network_fn(
-            variables, packed_features, mode, rng
+            variables, packed_features, mode, rng, labels=packed_labels
         )
         return packed_features, packed_labels, outputs, mutable
 
@@ -237,6 +243,9 @@ class FlaxT2RModel(AbstractT2RModel):
     """
 
     _MUTABLE_COLLECTIONS = ("batch_stats",)
+    # Networks whose __call__ accepts (features, mode, labels) — e.g. models
+    # with density-decoder heads — set this True to receive packed labels.
+    _NETWORK_TAKES_LABELS = False
 
     @abc.abstractmethod
     def create_network(self) -> "flax.linen.Module":
@@ -258,13 +267,22 @@ class FlaxT2RModel(AbstractT2RModel):
         variables = self.network.init(rng, example, mode)
         return flax.core.unfreeze(variables)
 
-    def inference_network_fn(self, variables, features, mode, rng=None):
+    def inference_network_fn(
+        self, variables, features, mode, rng=None, labels=None
+    ):
         mutable = [c for c in self._MUTABLE_COLLECTIONS if c in variables]
-        rngs = {"dropout": rng} if rng is not None else {}
+        if rng is not None:
+            rng_dropout, rng_sample = jax.random.split(rng)
+            rngs = {"dropout": rng_dropout, "sample": rng_sample}
+        else:
+            rngs = {}
+        args = (features, mode)
+        if self._NETWORK_TAKES_LABELS:
+            args = (features, mode, labels)
         if mode == MODE_TRAIN and mutable:
             outputs, updates = self.network.apply(
-                variables, features, mode, mutable=mutable, rngs=rngs
+                variables, *args, mutable=mutable, rngs=rngs
             )
             return outputs, flax.core.unfreeze(updates)
-        outputs = self.network.apply(variables, features, mode, rngs=rngs)
+        outputs = self.network.apply(variables, *args, rngs=rngs)
         return outputs, {}

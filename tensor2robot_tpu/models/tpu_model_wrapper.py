@@ -90,10 +90,14 @@ class TPUT2RModelWrapper(AbstractT2RModel):
 
     # -- hooks: cast at the boundary (reference :174-191) --------------------
 
-    def inference_network_fn(self, variables, features, mode, rng=None):
+    def inference_network_fn(
+        self, variables, features, mode, rng=None, labels=None
+    ):
         if not self._train_in_bfloat16:
             features = cast_tensors(features, jnp.bfloat16, np.float32)
-        return self._model.inference_network_fn(variables, features, mode, rng)
+        return self._model.inference_network_fn(
+            variables, features, mode, rng, labels=labels
+        )
 
     def model_train_fn(self, features, labels, inference_outputs, mode):
         # Losses accumulate in float32 regardless of the forward dtype.

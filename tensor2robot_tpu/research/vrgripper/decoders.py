@@ -1,0 +1,307 @@
+"""Action decoders for VRGripper behavioral cloning.
+
+Behavioral references: tensor2robot/research/vrgripper/mse_decoder.py:26,
+maf.py:68, discrete.py:31-138, plus layers/mdn.py for the MDN head.
+
+Decoder contract (stateless, unlike the reference's cached `self._maf`):
+`decoder(params, output_size, labels=None) -> (action, aux)` where `aux`
+carries 'nll' (the decoder's negative log-likelihood / loss on `labels`)
+when labels are provided — models surface it as an output tensor so
+`model_train_fn` can consume it without re-entering the network.
+
+The MAF decoder is a from-scratch masked autoregressive flow (MADE
+conditioners) — there is no TFP on the TPU path. Density evaluation is the
+single-pass direction (one MADE call per flow); sampling inverts the flow
+autoregressively, unrolled over the (small) action dimension — all static
+shapes, XLA-friendly.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensor2robot_tpu.layers import mdn as mdn_lib
+
+
+class MSEDecoder(nn.Module):
+    """Plain linear head + mean-squared-error loss (reference
+    mse_decoder.py:26-36)."""
+
+    @nn.compact
+    def __call__(
+        self,
+        params: jax.Array,
+        output_size: int,
+        labels: Optional[jax.Array] = None,
+    ) -> Tuple[jax.Array, dict]:
+        action = nn.Dense(output_size, name="pose")(params)
+        aux = {}
+        if labels is not None:
+            aux["nll"] = jnp.mean(jnp.square(action - labels))
+        return action, aux
+
+
+class MDNDecoder(nn.Module):
+    """Gaussian-mixture head; action = approximate mode, loss = mixture NLL
+    (reference layers/mdn.py MDNDecoder :128-167)."""
+
+    num_mixture_components: int = 1
+    condition_sigmas: bool = False
+
+    @nn.compact
+    def __call__(
+        self,
+        params: jax.Array,
+        output_size: int,
+        labels: Optional[jax.Array] = None,
+    ) -> Tuple[jax.Array, dict]:
+        dist_params = mdn_lib.MDNParams(
+            num_alphas=self.num_mixture_components,
+            sample_size=output_size,
+            condition_sigmas=self.condition_sigmas,
+        )(params)
+        gm = mdn_lib.get_mixture_distribution(
+            dist_params, self.num_mixture_components, output_size
+        )
+        aux = {"dist_params": dist_params}
+        if labels is not None:
+            aux["nll"] = mdn_lib.mdn_loss(gm, labels)
+        return gm.approximate_mode(), aux
+
+
+class MaskedDense(nn.Module):
+    """Dense layer with a fixed binary connectivity mask (the MADE
+    building block, Germain et al. arXiv:1502.03509)."""
+
+    features: int
+    mask: np.ndarray  # [in_features, features], 0/1
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        kernel = self.param(
+            "kernel",
+            nn.initializers.glorot_uniform(),
+            (x.shape[-1], self.features),
+        )
+        bias = self.param("bias", nn.initializers.zeros, (self.features,))
+        mask = jnp.asarray(self.mask, kernel.dtype)
+        return x @ (kernel * mask) + bias
+
+
+def _made_masks(
+    event_size: int, hidden_layers: Sequence[int]
+) -> Tuple[list, np.ndarray]:
+    """Builds MADE degree masks: hidden unit degrees cycle 1..D-1; the
+    output mask enforces strict autoregressive order (output i depends on
+    inputs < i)."""
+    degrees = [np.arange(1, event_size + 1)]
+    for width in hidden_layers:
+        degrees.append((np.arange(width) % max(1, event_size - 1)) + 1)
+    masks = []
+    for previous, current in zip(degrees[:-1], degrees[1:]):
+        masks.append((previous[:, None] <= current[None, :]).astype(np.float32))
+    out_mask = (degrees[-1][:, None] < degrees[0][None, :]).astype(np.float32)
+    return masks, out_mask
+
+
+class MADE(nn.Module):
+    """Masked autoregressive conditioner: x -> (shift, log_scale), each
+    output dim depending only on strictly-preceding input dims."""
+
+    event_size: int
+    hidden_layers: Sequence[int] = (64, 64)
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        masks, out_mask = _made_masks(self.event_size, self.hidden_layers)
+        net = x
+        for i, (width, mask) in enumerate(zip(self.hidden_layers, masks)):
+            net = MaskedDense(width, mask, name=f"masked{i}")(net)
+            net = nn.relu(net)
+        # Two heads off the shared trunk, both strictly autoregressive.
+        double_mask = np.concatenate([out_mask, out_mask], axis=1)
+        out = MaskedDense(
+            2 * self.event_size, double_mask, name="masked_out"
+        )(net)
+        shift, log_scale = jnp.split(out, 2, axis=-1)
+        # Bound the scale for stability (tanh soft clamp to [-5, 5]).
+        log_scale = 5.0 * jnp.tanh(log_scale / 5.0)
+        return shift, log_scale
+
+
+class MAFDecoder(nn.Module):
+    """Masked autoregressive flow over a conditioned isotropic base
+    (reference maf.py:68-99): base = N(mu(params), 1), flows chained with
+    fixed permutations between them. Loss = mean NLL of labels; the action
+    output inverts the flow from the base mean (deterministic) or from a
+    base sample when a 'sample' rng stream is available."""
+
+    num_flows: int = 1
+    hidden_layers: Sequence[int] = (64, 64)
+    permutation_seed: int = 42
+
+    def _permutations(self, event_size: int) -> list:
+        rng = np.random.RandomState(self.permutation_seed)
+        return [
+            rng.permutation(event_size) for _ in range(self.num_flows - 1)
+        ]
+
+    def _flows(self, event_size: int) -> list:
+        return [
+            MADE(event_size, self.hidden_layers, name=f"made{i}")
+            for i in range(self.num_flows)
+        ]
+
+    def _log_prob(self, flows, perms, x, mus):
+        """Density direction: one MADE pass per flow (fast)."""
+        event_size = x.shape[-1]
+        log_det = jnp.zeros(x.shape[:-1])
+        for i in reversed(range(self.num_flows)):
+            shift, log_scale = flows[i](x)
+            x = (x - shift) * jnp.exp(-log_scale)
+            log_det = log_det - jnp.sum(log_scale, axis=-1)
+            if i > 0:
+                inverse_perm = np.argsort(perms[i - 1])
+                x = x[..., inverse_perm]
+        base_log_prob = -0.5 * jnp.sum(
+            jnp.square(x - mus) + np.log(2.0 * np.pi), axis=-1
+        )
+        return base_log_prob + log_det
+
+    def _forward(self, flows, perms, u):
+        """Sampling direction: autoregressive inversion, unrolled over the
+        event dim (small for actions)."""
+        event_size = u.shape[-1]
+        x = u
+        for i in range(self.num_flows):
+            if i > 0:
+                x = x[..., perms[i - 1]]
+            y = jnp.zeros_like(x)
+            for d in range(event_size):
+                shift, log_scale = flows[i](y)
+                y = y.at[..., d].set(
+                    x[..., d] * jnp.exp(log_scale[..., d]) + shift[..., d]
+                )
+            x = y
+        return x
+
+    @nn.compact
+    def __call__(
+        self,
+        params: jax.Array,
+        output_size: int,
+        labels: Optional[jax.Array] = None,
+    ) -> Tuple[jax.Array, dict]:
+        if any(output_size > width for width in self.hidden_layers):
+            raise ValueError(
+                "MAF hidden layers have to be at least as wide as event size."
+            )
+        mus = nn.Dense(output_size, name="maf_mus")(params)
+        flows = self._flows(output_size)
+        perms = self._permutations(output_size)
+
+        if self.has_rng("sample"):
+            base = mus + jax.random.normal(
+                self.make_rng("sample"), mus.shape, mus.dtype
+            )
+        else:
+            base = mus
+        action = self._forward(flows, perms, base)
+
+        aux = {}
+        if labels is not None:
+            aux["nll"] = -jnp.mean(self._log_prob(flows, perms, labels, mus))
+        return action, aux
+
+
+def get_discrete_bins(
+    num_bins: int, output_min: np.ndarray, output_max: np.ndarray
+) -> np.ndarray:
+    """Bin centers discretizing [output_min, output_max] per action dim ->
+    [num_bins, action_dim] (reference discrete.py:31-47)."""
+    action_range = np.asarray(output_max) - np.asarray(output_min)
+    bin_sizes = action_range / float(num_bins)
+    return np.array(
+        [
+            np.asarray(output_min) + bin_sizes * (bin_i + 0.5)
+            for bin_i in range(num_bins)
+        ]
+    )
+
+
+def get_discrete_actions(
+    logits: jax.Array,
+    action_size: int,
+    num_bins: int,
+    bin_centers: np.ndarray,
+) -> jax.Array:
+    """Mode of the per-dim categorical -> continuous bin-center actions
+    (reference discrete.py:50-78)."""
+    probabilities = jax.nn.softmax(
+        logits.reshape(-1, action_size, num_bins), axis=-1
+    )
+    one_hot = jax.nn.one_hot(jnp.argmax(probabilities, axis=-1), num_bins)
+    centers = jnp.asarray(bin_centers.T, jnp.float32)  # [action, bins]
+    actions = jnp.sum(one_hot * centers, axis=-1)
+    return actions.reshape(logits.shape[:-1] + (action_size,))
+
+
+def get_discrete_action_loss(
+    logits: jax.Array,
+    action_labels: jax.Array,
+    bin_centers: np.ndarray,
+    num_bins: int,
+) -> jax.Array:
+    """Nearest-bin one-hot labels -> softmax cross entropy
+    (reference discrete.py:81-110)."""
+    centers = jnp.asarray(bin_centers, jnp.float32)  # [bins, action]
+    distance = jnp.square(
+        action_labels[..., None, :] - centers
+    )  # [..., bins, action]
+    discrete_labels = jnp.argmin(distance, axis=-2)  # [..., action]
+    one_hot = jax.nn.one_hot(discrete_labels, num_bins).reshape(-1, num_bins)
+    flat_logits = logits.reshape(-1, num_bins)
+    log_probs = jax.nn.log_softmax(flat_logits, axis=-1)
+    return -jnp.mean(jnp.sum(one_hot * log_probs, axis=-1))
+
+
+class DiscreteDecoder(nn.Module):
+    """Per-dim categorical head over discretized action bins
+    (reference discrete.py:108-138)."""
+
+    num_bins: int = 11
+    action_low: float = -1.0
+    action_high: float = 1.0
+
+    @nn.compact
+    def __call__(
+        self,
+        params: jax.Array,
+        output_size: int,
+        labels: Optional[jax.Array] = None,
+    ) -> Tuple[jax.Array, dict]:
+        logits = nn.Dense(output_size * self.num_bins, name="bin_logits")(
+            params
+        )
+        bin_centers = get_discrete_bins(
+            self.num_bins,
+            np.full((output_size,), self.action_low),
+            np.full((output_size,), self.action_high),
+        )
+        action = get_discrete_actions(
+            logits, output_size, self.num_bins, bin_centers
+        )
+        aux = {"bin_logits": logits}
+        if labels is not None:
+            aux["nll"] = get_discrete_action_loss(
+                logits.reshape(labels.shape[:-1] + (output_size * self.num_bins,)),
+                labels,
+                bin_centers,
+                self.num_bins,
+            )
+        return action, aux

@@ -1,0 +1,101 @@
+"""Episode -> transition-proto converters for replay writing.
+
+Behavioral reference:
+tensor2robot/research/vrgripper/episode_to_transitions.py:41-132.
+Transitions are (obs, action, reward, next_obs, done, debug) tuples; the
+converters emit Example / SequenceExample protos in the layouts the
+corresponding input pipelines parse.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from tensor2robot_tpu.config import configurable
+from tensor2robot_tpu.proto import example_pb2
+
+
+def _float_feature(feature, values) -> None:
+    feature.float_list.value.extend(
+        np.asarray(values, np.float32).reshape(-1).tolist()
+    )
+
+
+def _int64_feature(feature, values) -> None:
+    feature.int64_list.value.extend(
+        np.asarray(values, np.int64).reshape(-1).tolist()
+    )
+
+
+@configurable("make_fixed_length")
+def make_fixed_length(
+    input_list: Sequence,
+    fixed_length: int,
+    always_include_endpoints: bool = True,
+    randomized: bool = True,
+    rng: Optional[np.random.RandomState] = None,
+) -> Optional[List]:
+    """Fixed-length subsample of a list; keeps endpoints by default
+    (reference make_fixed_length :41-80). Returns None for lists of
+    length <= 2, like the reference."""
+    original_length = len(input_list)
+    if original_length <= 2:
+        return None
+    if not randomized:
+        indices = np.sort(np.mod(np.arange(fixed_length), original_length))
+        return [input_list[i] for i in indices]
+    rng = rng or np.random
+    if always_include_endpoints:
+        endpoint_indices = np.array([0, original_length - 1])
+        other_indices = 1 + rng.choice(
+            original_length - 2, fixed_length - 2, replace=True
+        )
+        indices = np.concatenate((endpoint_indices, other_indices), axis=0)
+    else:
+        indices = rng.choice(original_length, fixed_length, replace=True)
+    indices = np.sort(indices)
+    return [input_list[i] for i in indices]
+
+
+@configurable("episode_to_transitions_reacher")
+def episode_to_transitions_reacher(episode_data, is_demo: bool = False):
+    """One Example per transition: pose_t/pose_tp1/action/reward/done/is_demo
+    (reference :84-103)."""
+    transitions = []
+    for transition in episode_data:
+        obs_t, action, reward, obs_tp1, done, _ = transition
+        example = example_pb2.Example()
+        feature = example.features.feature
+        _float_feature(feature["pose_t"], obs_t)
+        _float_feature(feature["pose_tp1"], obs_tp1)
+        _float_feature(feature["action"], action)
+        _float_feature(feature["reward"], [reward])
+        _int64_feature(feature["done"], [int(done)])
+        _int64_feature(feature["is_demo"], [int(is_demo)])
+        transitions.append(example)
+    return transitions
+
+
+@configurable("episode_to_transitions_metareacher")
+def episode_to_transitions_metareacher(episode_data):
+    """One SequenceExample per episode: is_demo/target_idx context +
+    per-step feature lists (reference :106-132)."""
+    example = example_pb2.SequenceExample()
+    context = example.context.feature
+    _int64_feature(
+        context["is_demo"], [int(episode_data[0][-1]["is_demo"])]
+    )
+    _int64_feature(
+        context["target_idx"], [episode_data[0][-1]["target_idx"]]
+    )
+    lists = example.feature_lists.feature_list
+    for transition in episode_data:
+        obs_t, action, reward, obs_tp1, done, _ = transition
+        _float_feature(lists["pose_t"].feature.add(), obs_t)
+        _float_feature(lists["pose_tp1"].feature.add(), obs_tp1)
+        _float_feature(lists["action"].feature.add(), action)
+        _float_feature(lists["reward"].feature.add(), [reward])
+        _int64_feature(lists["done"].feature.add(), [int(done)])
+    return [example]

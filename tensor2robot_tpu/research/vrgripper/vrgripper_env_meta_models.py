@@ -1,0 +1,330 @@
+"""VRGripper meta-learning models: MAML variant and Task-Embedded Control.
+
+Behavioral reference:
+tensor2robot/research/vrgripper/vrgripper_env_meta_models.py
+(`VRGripperEnvRegressionModelMAML` :118-134, `VRGripperEnvTecModel`
+:138-415). TEC (arXiv:1810.03237): embed the condition episode(s) into a
+task vector, concatenate it (tiled over time) with per-step state features,
+decode actions with a pluggable density head; train with BC NLL + optional
+contrastive embedding loss between condition and inference embeddings.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple, Type
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensor2robot_tpu.layers import tec as tec_lib
+from tensor2robot_tpu.layers.vision_layers import (
+    FilmParams,
+    ImageFeaturesToPoseNet,
+    ImagesToFeaturesNet,
+)
+from tensor2robot_tpu.meta_learning import meta_tfdata, preprocessors
+from tensor2robot_tpu.meta_learning.maml_model import MAMLModel
+from tensor2robot_tpu.models.abstract_model import (
+    MODE_PREDICT,
+    MODE_TRAIN,
+    FlaxT2RModel,
+)
+from tensor2robot_tpu.research.vrgripper import decoders
+from tensor2robot_tpu.research.vrgripper.vrgripper_env_models import (
+    DefaultVRGripperPreprocessor,
+)
+from tensor2robot_tpu.specs import (
+    ExtendedTensorSpec,
+    TensorSpecStruct,
+    copy_tensorspec,
+)
+
+
+class VRGripperEnvRegressionModelMAML(MAMLModel):
+    """MAML-wrapped VRGripperRegressionModel (reference :118-134)."""
+
+    def _select_inference_output(self, predictions: TensorSpecStruct):
+        predictions["condition_output"] = predictions[
+            "full_condition_output/inference_output"
+        ]
+        predictions["inference_output"] = predictions[
+            "full_inference_output/inference_output"
+        ]
+        return predictions
+
+
+class _TecNet(nn.Module):
+    """TEC forward (reference VRGripperEnvTecModel.inference_network_fn
+    :245-311). Features are meta-shaped: condition/inference subtrees with
+    [B, num_episodes, T, ...] leaves."""
+
+    action_size: int
+    num_waypoints: int
+    episode_length: int
+    fc_embed_size: int
+    ignore_embedding: bool
+    use_film: bool
+    predict_end_weight: float
+    action_decoder: Callable[[], nn.Module]
+
+    @staticmethod
+    def _embed_episode(embedder, reducer, episode_features, train: bool):
+        """[B, E, T, H, W, C] images -> l2-normalized [B, E, embed]
+        (reference _embed_episode :235-245). `embedder`/`reducer` are
+        created once by the caller so condition and inference episodes
+        share weights (the reference's AUTO_REUSE scopes)."""
+        image = episode_features["features/image"]
+        image_embedding = meta_tfdata.multi_batch_apply(
+            lambda im: embedder(im, train), 3, image
+        )
+        embedding = meta_tfdata.multi_batch_apply(reducer, 2, image_embedding)
+        return embedding / jnp.maximum(
+            jnp.linalg.norm(embedding, axis=-1, keepdims=True), 1e-12
+        )
+
+    @nn.compact
+    def __call__(self, features, mode, labels=None):
+        train = mode == MODE_TRAIN
+        embedder = tec_lib.EmbedConditionImages(name="image_embedding")
+        reducer = tec_lib.ReduceTemporalEmbeddings(
+            self.fc_embed_size,
+            # Static kernel from the episode-length config (checkpoint-safe);
+            # reference fixes 10 for T=40 episodes.
+            conv1d_kernel=min(10, self.episode_length),
+            name="fc_reduce",
+        )
+        condition_embedding = self._embed_episode(
+            embedder, reducer, features.condition, train
+        )
+
+        film_params = None
+        if self.use_film:
+            film_generator = FilmParams(
+                film_output_size=2 * 5 * 32, name="film_params"
+            )
+            film_params = meta_tfdata.multi_batch_apply(
+                film_generator, 2, condition_embedding
+            )
+            # Stretch to [B, E, T, film]: identical across time.
+            film_params = jnp.tile(
+                film_params[:, :, None, :], (1, 1, self.episode_length, 1)
+            )
+
+        gripper_pose = features.inference.features["gripper_pose"]
+        fc_embedding = jnp.tile(
+            condition_embedding[..., : self.fc_embed_size][:, :, None, :],
+            (1, 1, self.episode_length, 1),
+        )
+        tower = ImagesToFeaturesNet(
+            normalizer="layer_norm", name="state_features"
+        )
+        if film_params is not None:
+            state_features, _ = meta_tfdata.multi_batch_apply(
+                lambda im, fp: tower(im, train, film_output_params=fp),
+                3,
+                features.inference.features["image"],
+                film_params,
+            )
+        else:
+            state_features, _ = meta_tfdata.multi_batch_apply(
+                lambda im: tower(im, train),
+                3,
+                features.inference.features["image"],
+            )
+        if self.ignore_embedding:
+            fc_inputs = jnp.concatenate([state_features, gripper_pose], -1)
+        else:
+            fc_inputs = jnp.concatenate(
+                [state_features, gripper_pose, fc_embedding], -1
+            )
+
+        outputs = TensorSpecStruct()
+        aux_output_dim = 1 if self.predict_end_weight > 0 else 0
+        action_params, end_token = meta_tfdata.multi_batch_apply(
+            lambda x: ImageFeaturesToPoseNet(
+                num_outputs=None,
+                aux_output_dim=aux_output_dim,
+                name="a_func",
+            )(x),
+            3,
+            fc_inputs,
+        )
+        action_labels = None
+        if labels is not None and "action" in labels.keys():
+            action_labels = labels["action"]
+        action, decoder_aux = self.action_decoder(
+            action_params,
+            self.num_waypoints * self.action_size,
+            labels=action_labels,
+        )
+
+        outputs["inference_output"] = action
+        outputs["condition_embedding"] = condition_embedding
+        for key, value in decoder_aux.items():
+            outputs[f"decoder/{key}"] = value
+
+        if self.predict_end_weight > 0:
+            outputs["end_token_logits"] = end_token
+            outputs["end_token"] = jax.nn.sigmoid(end_token)
+            outputs["inference_output"] = jnp.concatenate(
+                [outputs["inference_output"], outputs["end_token"]], -1
+            )
+
+        if mode != MODE_PREDICT:
+            outputs["inference_embedding"] = self._embed_episode(
+                embedder, reducer, features.inference, train
+            )
+        return outputs
+
+
+class VRGripperEnvTecModel(FlaxT2RModel):
+    """Task-Embedded Control Network (reference :138-415)."""
+
+    _NETWORK_TAKES_LABELS = True
+
+    def __init__(
+        self,
+        action_size: int = 7,
+        gripper_pose_size: int = 14,
+        num_waypoints: int = 1,
+        episode_length: int = 40,
+        embed_loss_weight: float = 0.0,
+        fc_embed_size: int = 32,
+        ignore_embedding: bool = False,
+        action_decoder_cls: Type[nn.Module] = decoders.MDNDecoder,
+        predict_end_weight: float = 0.0,
+        use_film: bool = False,
+        num_condition_samples_per_task: int = 1,
+        image_size: Tuple[int, int] = (100, 100),
+        **kwargs,
+    ):
+        kwargs.setdefault("preprocessor_cls", None)
+        super().__init__(**kwargs)
+        self._action_size = action_size
+        self._gripper_pose_size = gripper_pose_size
+        self._num_waypoints = num_waypoints
+        self._episode_length = episode_length
+        self._embed_loss_weight = embed_loss_weight
+        self._fc_embed_size = fc_embed_size
+        self._ignore_embedding = ignore_embedding
+        self._action_decoder_cls = action_decoder_cls
+        self._predict_end_weight = predict_end_weight
+        self._use_film = use_film
+        self._num_condition_samples_per_task = num_condition_samples_per_task
+        self._image_size = tuple(image_size)
+
+    def _episode_feature_specification(self, mode: str) -> TensorSpecStruct:
+        """Per-episode feature spec (reference :86-100)."""
+        del mode
+        spec = TensorSpecStruct(
+            image=ExtendedTensorSpec(
+                shape=self._image_size + (3,),
+                dtype=np.float32,
+                name="image0",
+                data_format="jpeg",
+            ),
+            gripper_pose=ExtendedTensorSpec(
+                shape=(self._gripper_pose_size,),
+                dtype=np.float32,
+                name="world_pose_gripper",
+            ),
+        )
+        return copy_tensorspec(spec, batch_size=self._episode_length)
+
+    def _episode_label_specification(self, mode: str) -> TensorSpecStruct:
+        del mode
+        spec = TensorSpecStruct(
+            action=ExtendedTensorSpec(
+                shape=(self._action_size,),
+                dtype=np.float32,
+                name="action_world",
+            )
+        )
+        return copy_tensorspec(spec, batch_size=self._episode_length)
+
+    @property
+    def preprocessor(self):
+        base = DefaultVRGripperPreprocessor(
+            _EpisodeSpecAdapter(self)
+        )
+        return preprocessors.FixedLenMetaExamplePreprocessor(
+            base_preprocessor=base,
+            num_condition_samples_per_task=(
+                self._num_condition_samples_per_task
+            ),
+        )
+
+    def get_feature_specification(self, mode: str) -> TensorSpecStruct:
+        return preprocessors.create_maml_feature_spec(
+            self._episode_feature_specification(mode),
+            self._episode_label_specification(mode),
+        )
+
+    def get_label_specification(self, mode: str) -> TensorSpecStruct:
+        return preprocessors.create_maml_label_spec(
+            self._episode_label_specification(mode)
+        )
+
+    def create_network(self) -> nn.Module:
+        return _TecNet(
+            action_size=self._action_size,
+            num_waypoints=self._num_waypoints,
+            episode_length=self._episode_length,
+            fc_embed_size=self._fc_embed_size,
+            ignore_embedding=self._ignore_embedding,
+            use_film=self._use_film,
+            predict_end_weight=self._predict_end_weight,
+            action_decoder=self._action_decoder_cls(),
+        )
+
+    def model_train_fn(self, features, labels, inference_outputs, mode):
+        """BC NLL + optional end-token loss + optional contrastive embedding
+        loss (reference model_train_fn :330-376)."""
+        bc_loss = inference_outputs["decoder/nll"]
+        metrics = {"loss/bc_nll": bc_loss}
+        loss = bc_loss
+
+        if self._predict_end_weight > 0:
+            logits = inference_outputs["end_token_logits"]
+            # Last two steps are end states (reference _compute_end_loss).
+            end_labels = jnp.concatenate(
+                [
+                    jnp.zeros_like(logits[:, :, :-2, :]),
+                    jnp.ones_like(logits[:, :, -2:, :]),
+                ],
+                axis=2,
+            )
+            import optax
+
+            end_loss = jnp.mean(
+                optax.sigmoid_binary_cross_entropy(logits, end_labels)
+            )
+            metrics["loss/end_token"] = end_loss
+            loss = loss + self._predict_end_weight * end_loss
+
+        if self._embed_loss_weight > 0:
+            embed_loss = tec_lib.compute_embedding_contrastive_loss(
+                inference_outputs["inference_embedding"],
+                inference_outputs["condition_embedding"],
+            )
+            metrics["loss/embed"] = embed_loss
+            loss = loss + self._embed_loss_weight * embed_loss
+        metrics["loss/total"] = loss
+        return loss, metrics
+
+
+class _EpisodeSpecAdapter:
+    """Presents a TEC model's per-episode specs as a model contract for the
+    base preprocessor (the reference passed spec fns directly,
+    :190-199)."""
+
+    def __init__(self, model: VRGripperEnvTecModel):
+        self._model = model
+
+    def get_feature_specification(self, mode: str) -> TensorSpecStruct:
+        return self._model._episode_feature_specification(mode)
+
+    def get_label_specification(self, mode: str) -> TensorSpecStruct:
+        return self._model._episode_label_specification(mode)

@@ -1,0 +1,476 @@
+"""VRGripper behavioral-cloning models (Watch-Try-Learn lineage).
+
+Behavioral reference: tensor2robot/research/vrgripper/vrgripper_env_models.py
+(`DefaultVRGripperPreprocessor` :40-135, `VRGripperRegressionModel` :139-324,
+`VRGripperDomainAdaptiveModel` :326-442). Episode-batched BC: every feature
+carries an explicit [episode_length] dim inside the per-example spec, so
+batches are [B, T, ...]; image towers run over the merged [B*T] batch
+(meta_tfdata.multi_batch_apply) — one large MXU-friendly conv batch instead
+of a scan over time.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensor2robot_tpu.layers import mdn as mdn_lib
+from tensor2robot_tpu.layers.vision_layers import (
+    ImageFeaturesToPoseNet,
+    ImagesToFeaturesNet,
+)
+from tensor2robot_tpu.meta_learning import meta_tfdata
+from tensor2robot_tpu.models.abstract_model import (
+    MODE_PREDICT,
+    MODE_TRAIN,
+    FlaxT2RModel,
+)
+from tensor2robot_tpu.preprocessors.abstract_preprocessor import (
+    AbstractPreprocessor,
+)
+from tensor2robot_tpu.preprocessors import image_transformations
+from tensor2robot_tpu.specs import (
+    ExtendedTensorSpec,
+    TensorSpecStruct,
+    copy_tensorspec,
+    flatten_spec_structure,
+)
+
+FLOAT_DTYPES = (jnp.bfloat16, jnp.float32, jnp.float64)
+
+
+class DefaultVRGripperPreprocessor(AbstractPreprocessor):
+    """Crop/resize/convert uint8 episode images; optional Mixup
+    (reference :40-135).
+
+    The on-disk image is `src_img_res` uint8; preprocessing takes a
+    `crop_size` crop (random at train, center otherwise), converts to
+    float [0, 1], and resizes to the model's declared image shape. With
+    `mixup_alpha > 0`, features and labels are Mixup-blended along the
+    batch dim at train time.
+    """
+
+    def __init__(
+        self,
+        model_spec_provider,
+        src_img_res: Tuple[int, int] = (220, 300),
+        crop_size: Tuple[int, int] = (200, 280),
+        mixup_alpha: float = 0.0,
+    ):
+        super().__init__(model_spec_provider)
+        self._src_img_res = tuple(src_img_res)
+        self._crop_size = tuple(crop_size)
+        self._mixup_alpha = mixup_alpha
+
+    def get_in_feature_specification(self, mode) -> TensorSpecStruct:
+        feature_spec = self._model.get_feature_specification(mode).copy()
+        if mode != MODE_PREDICT and "original_image" in feature_spec.keys():
+            del feature_spec["original_image"]
+        if "image" in feature_spec.keys():
+            true_shape = list(feature_spec["image"].shape)
+            true_shape[-3:-1] = self._src_img_res
+            feature_spec["image"] = ExtendedTensorSpec.from_spec(
+                feature_spec["image"], shape=tuple(true_shape), dtype=np.uint8
+            )
+        return flatten_spec_structure(feature_spec)
+
+    def get_in_label_specification(self, mode) -> TensorSpecStruct:
+        return flatten_spec_structure(
+            self._model.get_label_specification(mode)
+        )
+
+    def get_out_feature_specification(self, mode) -> TensorSpecStruct:
+        return flatten_spec_structure(
+            self._model.get_feature_specification(mode)
+        )
+
+    def get_out_label_specification(self, mode) -> TensorSpecStruct:
+        return flatten_spec_structure(
+            self._model.get_label_specification(mode)
+        )
+
+    def _preprocess_fn(self, features, labels, mode, rng):
+        if "image" in features.keys():
+            image = features["image"]
+            leading = image.shape[:-3]  # [B] or [B, T]
+            flat = image.reshape((-1,) + image.shape[-3:])
+            if mode == MODE_TRAIN and rng is not None:
+                rng, rng_crop = jax.random.split(rng)
+                flat = image_transformations.random_crop_image_batch(
+                    rng_crop, flat, self._crop_size
+                )
+            else:
+                flat = image_transformations.center_crop_image_batch(
+                    flat, self._crop_size
+                )
+            flat = flat.astype(jnp.float32) / 255.0
+            out_spec = self.get_out_feature_specification(mode)
+            target_hw = tuple(out_spec["image"].shape[-3:-1])
+            if target_hw != self._crop_size:
+                flat = jax.image.resize(
+                    flat,
+                    (flat.shape[0],) + target_hw + (flat.shape[-1],),
+                    method="bilinear",
+                )
+            features["original_image"] = features["image"]
+            features["image"] = flat.reshape(
+                leading + flat.shape[1:]
+            )
+
+        if (
+            self._mixup_alpha > 0.0
+            and labels is not None
+            and mode == MODE_TRAIN
+            and rng is not None
+        ):
+            # Beta(a, a) sample via two gammas.
+            rng, rng_g1, rng_g2 = jax.random.split(rng, 3)
+            g1 = jax.random.gamma(rng_g1, self._mixup_alpha)
+            g2 = jax.random.gamma(rng_g2, self._mixup_alpha)
+            lmbda = g1 / (g1 + g2)
+
+            def mix(struct):
+                for key, x in struct.items():
+                    if hasattr(x, "dtype") and x.dtype in FLOAT_DTYPES:
+                        struct[key] = lmbda * x + (1 - lmbda) * jnp.flip(
+                            x, axis=0
+                        )
+
+            mix(features)
+            mix(labels)
+        return features, labels
+
+
+class _VRGripperRegressionNet(nn.Module):
+    """State -> action over [B, T] batches (reference _single_batch_a_func
+    :229-270 under multi_batch_apply :272-307)."""
+
+    action_size: int
+    use_gripper_input: bool
+    num_mixture_components: int
+    condition_mixture_stddev: bool
+    output_mixture_sample: bool
+    normalize_outputs: bool
+    output_mean: Optional[np.ndarray]
+    output_stddev: Optional[np.ndarray]
+
+    @nn.compact
+    def __call__(self, features, mode, labels=None):
+        train = mode == MODE_TRAIN
+
+        def single_batch(image, gripper_pose, action_label):
+            feature_points, end_points = ImagesToFeaturesNet(
+                normalizer="layer_norm", name="state_features"
+            )(image, train)
+            if self.use_gripper_input:
+                fc_input = jnp.concatenate(
+                    [feature_points, gripper_pose], axis=-1
+                )
+            else:
+                fc_input = feature_points
+            outputs = {}
+            if self.num_mixture_components > 1:
+                dist_params = mdn_lib.MDNParams(
+                    num_alphas=self.num_mixture_components,
+                    sample_size=self.action_size,
+                    condition_sigmas=self.condition_mixture_stddev,
+                    name="mdn",
+                )(fc_input)
+                gm = mdn_lib.get_mixture_distribution(
+                    dist_params,
+                    self.num_mixture_components,
+                    self.action_size,
+                    jnp.asarray(self.output_mean)
+                    if (self.normalize_outputs and self.output_mean is not None)
+                    else None,
+                )
+                if self.output_mixture_sample and self.has_rng("sample"):
+                    action = gm.sample(self.make_rng("sample"))
+                else:
+                    action = gm.approximate_mode()
+                outputs["dist_params"] = dist_params
+                if action_label is not None:
+                    outputs["nll"] = mdn_lib.mdn_loss(gm, action_label)
+            else:
+                action, _ = ImageFeaturesToPoseNet(
+                    num_outputs=self.action_size, name="pose_net"
+                )(fc_input)
+                if self.output_mean is not None:
+                    action = (
+                        jnp.asarray(self.output_mean)
+                        + jnp.asarray(self.output_stddev) * action
+                    )
+            outputs.update(
+                {
+                    "inference_output": action,
+                    "feature_points": feature_points,
+                    "softmax": end_points.get("softmax"),
+                }
+            )
+            return outputs
+
+        action_label = labels["action"] if labels is not None else None
+        # Merge [B, T] into one conv megabatch (reference a_func's
+        # multi_batch_apply over 2 batch dims).
+        outputs = meta_tfdata.multi_batch_apply(
+            single_batch,
+            2,
+            features["image"],
+            features["gripper_pose"],
+            action_label,
+        )
+        out = TensorSpecStruct()
+        for key, value in outputs.items():
+            if value is not None:
+                out[key] = value
+        return out
+
+
+class VRGripperRegressionModel(FlaxT2RModel):
+    """Continuous-action BC regression for the VRGripper env
+    (reference :139-324)."""
+
+    _NETWORK_TAKES_LABELS = True
+
+    def __init__(
+        self,
+        action_size: int = 7,
+        use_gripper_input: bool = True,
+        normalize_outputs: bool = False,
+        output_mean: Optional[Sequence[float]] = None,
+        output_stddev: Optional[Sequence[float]] = None,
+        outer_loss_multiplier: float = 1.0,
+        num_mixture_components: int = 1,
+        output_mixture_sample: bool = False,
+        condition_mixture_stddev: bool = False,
+        episode_length: int = 40,
+        image_size: Tuple[int, int] = (100, 100),
+        **kwargs,
+    ):
+        kwargs.setdefault("preprocessor_cls", DefaultVRGripperPreprocessor)
+        super().__init__(**kwargs)
+        self._action_size = action_size
+        self._use_gripper_input = use_gripper_input
+        self._normalize_outputs = normalize_outputs
+        self._outer_loss_multiplier = outer_loss_multiplier
+        self._num_mixture_components = num_mixture_components
+        self._output_mixture_sample = output_mixture_sample
+        self._condition_mixture_stddev = condition_mixture_stddev
+        self._episode_length = episode_length
+        self._image_size = tuple(image_size)
+        self._output_mean = None
+        self._output_stddev = None
+        if output_mean and output_stddev:
+            if not len(output_mean) == len(output_stddev) == action_size:
+                raise ValueError(
+                    f"Output mean and stddev have lengths {len(output_mean)} "
+                    f"and {len(output_stddev)}."
+                )
+            self._output_mean = np.array([output_mean], np.float32)
+            self._output_stddev = np.array([output_stddev], np.float32)
+
+    @property
+    def action_size(self) -> int:
+        return self._action_size
+
+    @property
+    def episode_length(self) -> int:
+        return self._episode_length
+
+    def get_feature_specification(self, mode: str) -> TensorSpecStruct:
+        del mode
+        spec = TensorSpecStruct(
+            image=ExtendedTensorSpec(
+                shape=self._image_size + (3,),
+                dtype=np.float32,
+                name="image0",
+                data_format="jpeg",
+            ),
+            gripper_pose=ExtendedTensorSpec(
+                shape=(14,), dtype=np.float32, name="world_pose_gripper"
+            ),
+        )
+        return copy_tensorspec(spec, batch_size=self._episode_length)
+
+    def get_label_specification(self, mode: str) -> TensorSpecStruct:
+        del mode
+        spec = TensorSpecStruct(
+            action=ExtendedTensorSpec(
+                shape=(self._action_size,),
+                dtype=np.float32,
+                name="action_world",
+            )
+        )
+        return copy_tensorspec(spec, batch_size=self._episode_length)
+
+    def create_network(self) -> nn.Module:
+        return _VRGripperRegressionNet(
+            action_size=self._action_size,
+            use_gripper_input=self._use_gripper_input,
+            num_mixture_components=self._num_mixture_components,
+            condition_mixture_stddev=self._condition_mixture_stddev,
+            output_mixture_sample=self._output_mixture_sample,
+            normalize_outputs=self._normalize_outputs,
+            output_mean=self._output_mean,
+            output_stddev=self._output_stddev,
+        )
+
+    def model_train_fn(self, features, labels, inference_outputs, mode):
+        if self._num_mixture_components > 1:
+            loss = inference_outputs["nll"]
+            return loss, {"loss/mdn_nll": loss}
+        loss = self._outer_loss_multiplier * jnp.mean(
+            jnp.square(
+                inference_outputs["inference_output"] - labels["action"]
+            )
+        )
+        return loss, {"loss/mse": loss}
+
+
+class _DomainAdaptiveNet(nn.Module):
+    """Video-only inner loop with a learned loss (reference
+    VRGripperDomainAdaptiveModel :326-442). In the inner loop the gripper
+    pose is withheld (zeros or predicted from image features)."""
+
+    action_size: int
+    predict_con_gripper_pose: bool
+    output_mean: Optional[np.ndarray]
+    output_stddev: Optional[np.ndarray]
+    learned_loss_conv1d_layers: Optional[Tuple[int, ...]] = (10, 10, 6)
+
+    @nn.compact
+    def __call__(self, features, mode, labels=None, is_inner_loop=False):
+        train = mode == MODE_TRAIN
+
+        def single_batch(image, gripper_pose):
+            feature_points, end_points = ImagesToFeaturesNet(
+                normalizer="layer_norm", name="state_features"
+            )(image, train)
+            if is_inner_loop:
+                if self.predict_con_gripper_pose:
+                    out = nn.Dense(40, use_bias=False, name="pose_pred_fc")(
+                        feature_points
+                    )
+                    out = nn.relu(nn.LayerNorm(name="pose_pred_ln")(out))
+                    pose = nn.Dense(14, name="pose_pred_out")(out)
+                else:
+                    pose = jnp.zeros_like(gripper_pose)
+            else:
+                pose = gripper_pose
+            action, _ = ImageFeaturesToPoseNet(
+                num_outputs=self.action_size, name="pose_net"
+            )(feature_points, aux_input=pose)
+            if self.output_mean is not None:
+                action = (
+                    jnp.asarray(self.output_mean)
+                    + jnp.asarray(self.output_stddev) * action
+                )
+            return {
+                "inference_output": action,
+                "feature_points": feature_points,
+                "softmax": end_points.get("softmax"),
+            }
+
+        outputs = meta_tfdata.multi_batch_apply(
+            single_batch, 2, features["image"], features["gripper_pose"]
+        )
+
+        # Learned loss head (reference model_train_fn :404-442): a conv1d
+        # critic over [predicted_action, feature_points, inference_output].
+        feature_points = outputs["feature_points"]
+        predicted_action, _ = meta_tfdata.multi_batch_apply(
+            lambda fp: ImageFeaturesToPoseNet(
+                num_outputs=self.action_size, name="learned_loss_pose"
+            )(fp),
+            2,
+            feature_points,
+        )
+        if self.learned_loss_conv1d_layers is None:
+            learned_loss = jnp.mean(
+                jnp.square(predicted_action - outputs["inference_output"])
+            )
+        else:
+            net = jnp.concatenate(
+                [
+                    predicted_action,
+                    feature_points,
+                    outputs["inference_output"],
+                ],
+                axis=-1,
+            )
+            for i, num_filters in enumerate(
+                self.learned_loss_conv1d_layers[:-1]
+            ):
+                net = nn.Conv(
+                    num_filters, (10,), use_bias=False, padding="SAME",
+                    name=f"ll_conv{i}",
+                )(net)
+                net = nn.relu(nn.LayerNorm(name=f"ll_ln{i}")(net))
+            net = nn.Conv(
+                self.learned_loss_conv1d_layers[-1], (1,), name="ll_conv_out"
+            )(net)
+            learned_loss = jnp.mean(jnp.sum(jnp.square(net), axis=(1, 2)))
+        outputs["learned_loss"] = learned_loss
+
+        out = TensorSpecStruct()
+        for key, value in outputs.items():
+            if value is not None:
+                out[key] = value
+        return out
+
+
+class VRGripperDomainAdaptiveModel(VRGripperRegressionModel):
+    """Domain-adaptive imitation with a learned inner loss
+    (reference :326-442). Intended as the base model of a MAMLModel: the
+    inner loop minimizes the learned loss (no labels needed — adapts from
+    video alone); the outer loop behavior-clones."""
+
+    def __init__(
+        self,
+        predict_con_gripper_pose: bool = False,
+        learned_loss_conv1d_layers: Tuple[int, ...] = (10, 10, 6),
+        **kwargs,
+    ):
+        super().__init__(**kwargs)
+        self._predict_con_gripper_pose = predict_con_gripper_pose
+        self._learned_loss_conv1d_layers = learned_loss_conv1d_layers
+        self._is_inner_loop = False
+
+    def create_network(self) -> nn.Module:
+        return _DomainAdaptiveNet(
+            action_size=self._action_size,
+            predict_con_gripper_pose=self._predict_con_gripper_pose,
+            output_mean=self._output_mean,
+            output_stddev=self._output_stddev,
+            learned_loss_conv1d_layers=self._learned_loss_conv1d_layers,
+        )
+
+    def inner_inference_network_fn(
+        self, variables, features, mode, rng=None, labels=None
+    ):
+        """Inner-loop forward: gripper pose withheld (zeros or predicted
+        from image features) — adaptation from video alone (reference
+        single_batch_a_func's is_inner_loop branch :359-368)."""
+        outputs = self.network.apply(
+            variables, features, mode, labels, is_inner_loop=True
+        )
+        return outputs, {}
+
+    def model_inner_loop_fn(self, features, labels, inference_outputs, mode):
+        """Inner-loop adaptation signal: the learned loss (reference
+        model_train_fn's non-outer branch :404-442)."""
+        loss = inference_outputs["learned_loss"]
+        return loss, {"loss/learned": loss}
+
+    def model_train_fn(self, features, labels, inference_outputs, mode):
+        """Outer loop: behavior cloning (reference :415-419)."""
+        loss = self._outer_loss_multiplier * jnp.mean(
+            jnp.square(
+                inference_outputs["inference_output"] - labels["action"]
+            )
+        )
+        return loss, {"loss/bc_mse": loss}

@@ -1,0 +1,332 @@
+"""Watch-Try-Learn trial/retrial models (arXiv:1906.03352).
+
+Behavioral reference:
+tensor2robot/research/vrgripper/vrgripper_env_wtl_models.py
+(`pack_wtl_meta_features` :43-134, `VRGripperEnvSimpleTrialModel` :136-355).
+The trial model conditions on a demo episode (and, for retrial, on a first
+trial episode plus its success flag) via temporal embeddings of full-state
+observations; the policy head maps [state, embedding(s)] to actions over
+the fixed-length episode. Data arrives as MetaExamples.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensor2robot_tpu.layers import tec as tec_lib
+from tensor2robot_tpu.layers.vision_layers import ImageFeaturesToPoseNet
+from tensor2robot_tpu.layers import mdn as mdn_lib
+from tensor2robot_tpu.meta_learning import meta_tfdata, preprocessors
+from tensor2robot_tpu.models.abstract_model import MODE_TRAIN, FlaxT2RModel
+from tensor2robot_tpu.preprocessors.abstract_preprocessor import (
+    NoOpPreprocessor,
+)
+from tensor2robot_tpu.specs import (
+    ExtendedTensorSpec,
+    TensorSpecStruct,
+    copy_tensorspec,
+)
+
+
+def pack_wtl_meta_features(
+    state: np.ndarray,
+    prev_episode_data,
+    timestep: int,
+    episode_length: int,
+    num_condition_samples_per_task: int,
+) -> dict:
+    """Packs a live observation + conditioning episodes into the trial
+    model's meta feature layout (reference pack_wtl_meta_features :43-134).
+
+    Returns flat numpy features with [1, num_episodes, T, ...] dims.
+    """
+    obs_size = np.asarray(state).shape[-1]
+
+    def episode_to_array(episode_data):
+        observations = [np.asarray(t[0]) for t in episode_data]
+        while len(observations) < episode_length:
+            observations.append(observations[-1])
+        return np.stack(observations[:episode_length], axis=0)
+
+    condition = []
+    success = []
+    for episode_data in (prev_episode_data or [])[
+        :num_condition_samples_per_task
+    ]:
+        condition.append(episode_to_array(episode_data))
+        episode_reward = float(
+            np.sum([t[2] for t in episode_data])
+        )
+        success.append(
+            np.full((episode_length, 1), float(episode_reward > 0), np.float32)
+        )
+    while len(condition) < num_condition_samples_per_task:
+        condition.append(np.zeros((episode_length, obs_size), np.float32))
+        success.append(np.zeros((episode_length, 1), np.float32))
+
+    inference = np.tile(
+        np.asarray(state, np.float32)[None, :], (episode_length, 1)
+    )
+    return {
+        "condition/features/full_state_pose": np.stack(condition)[None, ...],
+        "condition/labels/action": np.zeros(
+            (1, num_condition_samples_per_task, episode_length, 7), np.float32
+        ),
+        "condition/labels/success": np.stack(success)[None, ...],
+        "inference/features/full_state_pose": inference[None, None, ...],
+    }
+
+
+class _WtlTrialNet(nn.Module):
+    """Trial/retrial policy head (reference inference_network_fn
+    :213-291)."""
+
+    action_size: int
+    episode_length: int
+    fc_embed_size: int
+    ignore_embedding: bool
+    num_mixture_components: int
+    retrial: bool
+    embed_type: str  # 'temporal' | 'mean'
+
+    @nn.compact
+    def __call__(self, features, mode, labels=None):
+        inf_pose = features.inference.features["full_state_pose"]
+        con_pose = features.condition.features["full_state_pose"]
+        # Map success labels [0, 1] -> [-1, 1].
+        con_success = 2.0 * features.condition.labels["success"] - 1.0
+
+        conv1d_kernel = min(10, self.episode_length)
+        if self.embed_type == "temporal":
+            fc_embedding = meta_tfdata.multi_batch_apply(
+                tec_lib.ReduceTemporalEmbeddings(
+                    self.fc_embed_size,
+                    conv1d_kernel=conv1d_kernel,
+                    name="demo_embedding",
+                ),
+                2,
+                con_pose[:, 0:1, :, :],
+            )[:, :, None, :]
+        elif self.embed_type == "mean":
+            fc_embedding = con_pose[:, 0:1, -1:, :]
+        else:
+            raise ValueError(f"Invalid embed_type: {self.embed_type}.")
+        fc_embedding = jnp.tile(
+            fc_embedding, (1, 1, self.episode_length, 1)
+        )
+
+        if self.retrial:
+            # Condition episode 1 is the first trial; embed it with its
+            # success channel (reference :240-258).
+            con_input = jnp.concatenate(
+                [
+                    con_pose[:, 1:2, :, :],
+                    con_success[:, 1:2, :, :],
+                    fc_embedding,
+                ],
+                axis=-1,
+            )
+            if self.embed_type == "mean":
+                trial_embedding = meta_tfdata.multi_batch_apply(
+                    tec_lib.EmbedFullstate(
+                        self.fc_embed_size, name="trial_embedding"
+                    ),
+                    3,
+                    con_input,
+                )
+                trial_embedding = jnp.mean(trial_embedding, axis=-2)
+            else:
+                trial_embedding = meta_tfdata.multi_batch_apply(
+                    tec_lib.ReduceTemporalEmbeddings(
+                        self.fc_embed_size,
+                        conv1d_kernel=conv1d_kernel,
+                        name="trial_embedding",
+                    ),
+                    2,
+                    con_input,
+                )
+            trial_embedding = jnp.tile(
+                trial_embedding[:, :, None, :],
+                (1, 1, self.episode_length, 1),
+            )
+            fc_embedding = jnp.concatenate(
+                [fc_embedding, trial_embedding], axis=-1
+            )
+
+        if self.ignore_embedding:
+            fc_inputs = inf_pose
+        else:
+            pieces = [inf_pose, fc_embedding]
+            if self.retrial:
+                pieces.append(con_success[:, 1:2, :, :])
+            fc_inputs = jnp.concatenate(pieces, axis=-1)
+
+        outputs = TensorSpecStruct()
+        action_labels = None
+        if labels is not None and "action" in labels.keys():
+            action_labels = labels["action"]
+        if self.num_mixture_components > 1:
+            hidden, _ = meta_tfdata.multi_batch_apply(
+                lambda x: ImageFeaturesToPoseNet(
+                    num_outputs=None, name="a_func"
+                )(x),
+                3,
+                fc_inputs,
+            )
+            dist_params = meta_tfdata.multi_batch_apply(
+                mdn_lib.MDNParams(
+                    num_alphas=self.num_mixture_components,
+                    sample_size=self.action_size,
+                    name="mdn",
+                ),
+                3,
+                hidden,
+            )
+            gm = mdn_lib.get_mixture_distribution(
+                dist_params, self.num_mixture_components, self.action_size
+            )
+            action = gm.approximate_mode()
+            outputs["dist_params"] = dist_params
+            if action_labels is not None:
+                outputs["nll"] = mdn_lib.mdn_loss(gm, action_labels)
+        else:
+            action, _ = meta_tfdata.multi_batch_apply(
+                lambda x: ImageFeaturesToPoseNet(
+                    num_outputs=self.action_size, name="a_func"
+                )(x),
+                3,
+                fc_inputs,
+            )
+            if action_labels is not None:
+                outputs["nll"] = jnp.mean(
+                    jnp.square(action - action_labels)
+                )
+        outputs["inference_output"] = action
+        return outputs
+
+
+class VRGripperEnvSimpleTrialModel(FlaxT2RModel):
+    """WTL trial model conditioning on the demo's full-state trajectory
+    (reference VRGripperEnvSimpleTrialModel :136-355); `retrial=True` adds
+    the first-trial episode + success flag (the retrial policy)."""
+
+    _NETWORK_TAKES_LABELS = True
+
+    def __init__(
+        self,
+        action_size: int = 7,
+        episode_length: int = 40,
+        fc_embed_size: int = 32,
+        ignore_embedding: bool = False,
+        num_mixture_components: int = 1,
+        num_condition_samples_per_task: int = 1,
+        retrial: bool = False,
+        embed_type: str = "temporal",
+        obs_size: int = 32,
+        **kwargs,
+    ):
+        super().__init__(**kwargs)
+        self._action_size = action_size
+        self._episode_length = episode_length
+        self._fc_embed_size = fc_embed_size
+        self._ignore_embedding = ignore_embedding
+        self._num_mixture_components = num_mixture_components
+        self._num_condition_samples_per_task = num_condition_samples_per_task
+        self._retrial = retrial
+        self._embed_type = embed_type
+        self._obs_size = obs_size
+        if retrial and num_condition_samples_per_task != 2:
+            raise ValueError(
+                "Retrial models need exactly 2 condition episodes "
+                "(demo + first trial)."
+            )
+
+    @property
+    def episode_length(self) -> int:
+        return self._episode_length
+
+    def _episode_feature_specification(self, mode: str) -> TensorSpecStruct:
+        del mode
+        spec = TensorSpecStruct(
+            full_state_pose=ExtendedTensorSpec(
+                shape=(self._obs_size,),
+                dtype=np.float32,
+                name="full_state_pose",
+            )
+        )
+        return copy_tensorspec(spec, batch_size=self._episode_length)
+
+    def _episode_label_specification(self, mode: str) -> TensorSpecStruct:
+        del mode
+        spec = TensorSpecStruct(
+            action=ExtendedTensorSpec(
+                shape=(self._action_size,),
+                dtype=np.float32,
+                name="action_world",
+            ),
+            success=ExtendedTensorSpec(
+                shape=(1,), dtype=np.float32, name="success"
+            ),
+        )
+        return copy_tensorspec(spec, batch_size=self._episode_length)
+
+    @property
+    def preprocessor(self):
+        base = NoOpPreprocessor(_WtlEpisodeSpecAdapter(self))
+        return preprocessors.FixedLenMetaExamplePreprocessor(
+            base_preprocessor=base,
+            num_condition_samples_per_task=(
+                self._num_condition_samples_per_task
+            ),
+        )
+
+    def get_feature_specification(self, mode: str) -> TensorSpecStruct:
+        return preprocessors.create_maml_feature_spec(
+            self._episode_feature_specification(mode),
+            self._episode_label_specification(mode),
+        )
+
+    def get_label_specification(self, mode: str) -> TensorSpecStruct:
+        return preprocessors.create_maml_label_spec(
+            self._episode_label_specification(mode)
+        )
+
+    def create_network(self) -> nn.Module:
+        return _WtlTrialNet(
+            action_size=self._action_size,
+            episode_length=self._episode_length,
+            fc_embed_size=self._fc_embed_size,
+            ignore_embedding=self._ignore_embedding,
+            num_mixture_components=self._num_mixture_components,
+            retrial=self._retrial,
+            embed_type=self._embed_type,
+        )
+
+    def model_train_fn(self, features, labels, inference_outputs, mode):
+        loss = inference_outputs["nll"]
+        return loss, {"loss/bc": loss}
+
+    def pack_features(self, state, prev_episode_data, timestep) -> dict:
+        return pack_wtl_meta_features(
+            state,
+            prev_episode_data,
+            timestep,
+            self._episode_length,
+            self._num_condition_samples_per_task,
+        )
+
+
+class _WtlEpisodeSpecAdapter:
+    def __init__(self, model: VRGripperEnvSimpleTrialModel):
+        self._model = model
+
+    def get_feature_specification(self, mode: str) -> TensorSpecStruct:
+        return self._model._episode_feature_specification(mode)
+
+    def get_label_specification(self, mode: str) -> TensorSpecStruct:
+        return self._model._episode_label_specification(mode)

@@ -80,7 +80,7 @@ def _quadratic_setup(**inner_kwargs):
     features = {"coeff_a": jnp.asarray([COEFF_A_VALUE])}
     labels = {"target": jnp.asarray([0.0])}
 
-    def inference_network_fn(variables, feats, mode):
+    def inference_network_fn(variables, feats, mode, labels=None):
         return {"prediction": variables["params"]["x"] * feats["coeff_a"]}, {}
 
     def model_train_fn(feats, labs, outputs, mode):
@@ -201,7 +201,7 @@ class TestMAMLInnerLoop:
         features = {"coeff_a": jnp.ones((2,))}
         labels = {"target": jnp.zeros((2,))}
 
-        def net_fn(variables, feats, mode):
+        def net_fn(variables, feats, mode, labels=None):
             p = variables["params"]
             return {"prediction": (p["adapt"] + p["frozen"]) * feats["coeff_a"]}, {}
 

@@ -1,0 +1,487 @@
+"""VRGripper/WTL workload tests (reference research/vrgripper/*_test.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensor2robot_tpu.research import vrgripper
+from tensor2robot_tpu.research.vrgripper import decoders
+from tensor2robot_tpu.specs import TensorSpecStruct, make_random_numpy
+
+EPISODE_LENGTH = 4
+IMAGE_SIZE = (32, 32)
+
+
+def small_regression_model(**kwargs):
+    return vrgripper.VRGripperRegressionModel(
+        episode_length=EPISODE_LENGTH,
+        image_size=IMAGE_SIZE,
+        device_type="cpu",
+        **kwargs,
+    )
+
+
+def _regression_batch(model, batch=2):
+    rng = np.random.RandomState(0)
+    features = TensorSpecStruct()
+    features["image"] = rng.rand(
+        batch, EPISODE_LENGTH, *IMAGE_SIZE, 3
+    ).astype(np.float32)
+    features["gripper_pose"] = rng.rand(batch, EPISODE_LENGTH, 14).astype(
+        np.float32
+    )
+    labels = TensorSpecStruct()
+    labels["action"] = rng.rand(batch, EPISODE_LENGTH, 7).astype(np.float32)
+    return features, labels
+
+
+class TestDecoders:
+    def _run(self, decoder, labels=None, rngs=None):
+        params = jnp.asarray(
+            np.random.RandomState(0).rand(6, 16), jnp.float32
+        )
+        variables = decoder.init(
+            jax.random.PRNGKey(0), params, 7, labels
+        )
+        return decoder.apply(
+            variables, params, 7, labels, rngs=rngs or {}
+        )
+
+    def test_mse_decoder(self):
+        labels = jnp.zeros((6, 7))
+        action, aux = self._run(decoders.MSEDecoder(), labels)
+        assert action.shape == (6, 7)
+        assert float(aux["nll"]) >= 0.0
+
+    def test_mdn_decoder(self):
+        labels = jnp.zeros((6, 7))
+        action, aux = self._run(
+            decoders.MDNDecoder(num_mixture_components=3), labels
+        )
+        assert action.shape == (6, 7)
+        assert "dist_params" in aux and np.isfinite(float(aux["nll"]))
+
+    def test_discrete_decoder(self):
+        labels = jnp.zeros((6, 7))
+        action, aux = self._run(decoders.DiscreteDecoder(num_bins=5), labels)
+        assert action.shape == (6, 7)
+        assert np.isfinite(float(aux["nll"]))
+        # Actions are bin centers within the action box.
+        assert float(jnp.max(jnp.abs(action))) <= 1.0
+
+    def test_discrete_bins_layout(self):
+        bins = decoders.get_discrete_bins(
+            4, np.array([-1.0]), np.array([1.0])
+        )
+        np.testing.assert_allclose(bins[:, 0], [-0.75, -0.25, 0.25, 0.75])
+
+    def test_maf_decoder_density_and_sampling(self):
+        labels = jnp.zeros((6, 7))
+        decoder = decoders.MAFDecoder(num_flows=2, hidden_layers=(16, 16))
+        action, aux = self._run(
+            decoder, labels, rngs={"sample": jax.random.PRNGKey(1)}
+        )
+        assert action.shape == (6, 7)
+        assert np.isfinite(float(aux["nll"]))
+
+    def test_maf_log_prob_is_normalized_1d(self):
+        # For event_size 1 the flow density must integrate to ~1 on a grid.
+        decoder = decoders.MAFDecoder(num_flows=2, hidden_layers=(8, 8))
+        params = jnp.zeros((1, 4))
+        variables = decoder.init(jax.random.PRNGKey(0), params, 1, None)
+
+        grid = jnp.linspace(-8.0, 8.0, 2001).reshape(-1, 1)
+
+        # Pointwise log-prob: the NLL of a batch of one point is -log p(x).
+        def pointwise(x):
+            _, aux = decoder.apply(
+                variables, jnp.zeros((1, 4)), 1, x.reshape(1, 1)
+            )
+            return -aux["nll"]
+
+        log_p = jax.vmap(pointwise)(grid.reshape(-1))
+        density = jnp.exp(log_p)
+        integral = float(jnp.trapezoid(density, dx=16.0 / 2000.0))
+        assert abs(integral - 1.0) < 0.02, integral
+
+    def test_maf_wide_enough_check(self):
+        decoder = decoders.MAFDecoder(hidden_layers=(4,))
+        params = jnp.zeros((2, 4))
+        with pytest.raises(ValueError, match="at least as wide"):
+            decoder.init(jax.random.PRNGKey(0), params, 7, None)
+
+    def test_made_autoregressive_property(self):
+        # Output dim d must not depend on input dims >= d.
+        made = decoders.MADE(event_size=4, hidden_layers=(16,))
+        x = jnp.zeros((1, 4))
+        variables = made.init(jax.random.PRNGKey(0), x)
+
+        def shift_d(x_flat, d):
+            shift, _ = made.apply(variables, x_flat.reshape(1, 4))
+            return shift[0, d]
+
+        jacobian = jax.jacobian(
+            lambda x_flat: made.apply(variables, x_flat.reshape(1, 4))[0][0]
+        )(jnp.ones((4,)))
+        # jacobian[d, i] = d shift_d / d x_i; must be 0 for i >= d.
+        for d in range(4):
+            for i in range(d, 4):
+                assert float(jacobian[d, i]) == 0.0
+
+
+class TestVRGripperPreprocessor:
+    def test_spec_rewrite_and_crop_resize(self):
+        model = small_regression_model()
+        pre = model.preprocessor
+        in_spec = pre.get_in_feature_specification("train")
+        # Source spec is uint8 at src_img_res, episode-batched.
+        assert in_spec["image"].dtype == np.uint8
+        assert in_spec["image"].shape == (EPISODE_LENGTH, 220, 300, 3)
+        features = make_random_numpy(in_spec, batch_size=2)
+        out, _ = pre.preprocess(
+            features, None, mode="train", rng=jax.random.PRNGKey(0)
+        )
+        assert out["image"].shape == (2, EPISODE_LENGTH, *IMAGE_SIZE, 3)
+        assert out["image"].dtype == jnp.float32
+
+    def test_mixup_blends_labels(self):
+        model = small_regression_model()
+        pre = vrgripper.DefaultVRGripperPreprocessor(
+            model, mixup_alpha=1.0
+        )
+        features = make_random_numpy(
+            pre.get_in_feature_specification("train"), batch_size=2
+        )
+        labels = make_random_numpy(
+            pre.get_in_label_specification("train"), batch_size=2
+        )
+        original = np.asarray(labels["action"]).copy()
+        _, out_labels = pre.preprocess(
+            features, labels, mode="train", rng=jax.random.PRNGKey(3)
+        )
+        blended = np.asarray(out_labels["action"])
+        # Mixup with lambda in (0,1) moves labels toward the flipped batch.
+        assert not np.allclose(blended, original)
+        np.testing.assert_allclose(
+            blended + blended[::-1], original + original[::-1], atol=1e-5
+        )
+
+
+class TestVRGripperRegressionModel:
+    def test_forward_and_loss_mse(self):
+        model = small_regression_model()
+        features, labels = _regression_batch(model)
+        variables = model.init_variables(jax.random.PRNGKey(0), features)
+        outputs, _ = model.inference_network_fn(
+            variables, features, "train", labels=labels
+        )
+        assert outputs["inference_output"].shape == (2, EPISODE_LENGTH, 7)
+        loss, metrics = model.model_train_fn(
+            features, labels, outputs, "train"
+        )
+        assert np.isfinite(float(loss))
+        assert "loss/mse" in metrics
+
+    def test_forward_and_loss_mdn(self):
+        model = small_regression_model(num_mixture_components=3)
+        features, labels = _regression_batch(model)
+        variables = model.init_variables(jax.random.PRNGKey(0), features)
+        outputs, _ = model.inference_network_fn(
+            variables, features, "train", labels=labels
+        )
+        assert outputs["inference_output"].shape == (2, EPISODE_LENGTH, 7)
+        loss, metrics = model.model_train_fn(
+            features, labels, outputs, "train"
+        )
+        assert np.isfinite(float(loss))
+        assert "loss/mdn_nll" in metrics
+
+    def test_output_normalization_length_check(self):
+        with pytest.raises(ValueError, match="lengths"):
+            small_regression_model(
+                output_mean=[0.0] * 3, output_stddev=[1.0] * 3
+            )
+
+
+class TestDomainAdaptiveModel:
+    def make_model(self):
+        return vrgripper.VRGripperDomainAdaptiveModel(
+            episode_length=EPISODE_LENGTH,
+            image_size=IMAGE_SIZE,
+            device_type="cpu",
+        )
+
+    def test_inner_vs_outer_forward(self):
+        model = self.make_model()
+        features, labels = _regression_batch(model)
+        variables = model.init_variables(jax.random.PRNGKey(0), features)
+        outer_outputs, _ = model.inference_network_fn(
+            variables, features, "train", labels=labels
+        )
+        inner_outputs, _ = model.inner_inference_network_fn(
+            variables, features, "train", labels=labels
+        )
+        # Inner loop withholds the gripper pose -> different actions.
+        assert not np.allclose(
+            np.asarray(outer_outputs["inference_output"]),
+            np.asarray(inner_outputs["inference_output"]),
+        )
+        # Learned loss is available and differentiable-looking.
+        inner_loss, _ = model.model_inner_loop_fn(
+            features, None, inner_outputs, "train"
+        )
+        assert np.isfinite(float(inner_loss))
+        outer_loss, _ = model.model_train_fn(
+            features, labels, outer_outputs, "train"
+        )
+        assert np.isfinite(float(outer_loss))
+
+    def test_maml_wrapping_end_to_end(self):
+        base = self.make_model()
+        model = vrgripper.VRGripperEnvRegressionModelMAML(
+            base_model=base, num_inner_loop_steps=1,
+            inner_learning_rate=0.01,
+        )
+        tasks, num_condition, num_inference = 2, 1, 1
+        rng = np.random.RandomState(0)
+
+        def episode_features():
+            return {
+                "image": rng.rand(
+                    tasks, 1, EPISODE_LENGTH, *IMAGE_SIZE, 3
+                ).astype(np.float32),
+                "gripper_pose": rng.rand(
+                    tasks, 1, EPISODE_LENGTH, 14
+                ).astype(np.float32),
+            }
+
+        features = TensorSpecStruct()
+        for key, value in episode_features().items():
+            features[f"condition/features/{key}"] = value
+        features["condition/labels/action"] = rng.rand(
+            tasks, num_condition, EPISODE_LENGTH, 7
+        ).astype(np.float32)
+        for key, value in episode_features().items():
+            features[f"inference/features/{key}"] = value
+        labels = TensorSpecStruct()
+        labels["action"] = rng.rand(
+            tasks, num_inference, EPISODE_LENGTH, 7
+        ).astype(np.float32)
+
+        variables = model.init_variables(jax.random.PRNGKey(0), features)
+        outputs, _ = model.inference_network_fn(variables, features, "train")
+        assert outputs["inference_output"].shape == (
+            tasks, num_inference, EPISODE_LENGTH, 7,
+        )
+        loss, metrics = model.model_train_fn(
+            features, labels, outputs, "train"
+        )
+        assert np.isfinite(float(loss))
+        assert "inner_loss_0" in metrics
+
+
+class TestTecModel:
+    def make_model(self, **kwargs):
+        return vrgripper.VRGripperEnvTecModel(
+            episode_length=EPISODE_LENGTH,
+            image_size=IMAGE_SIZE,
+            device_type="cpu",
+            **kwargs,
+        )
+
+    def _meta_batch(self, tasks=2):
+        rng = np.random.RandomState(0)
+        features = TensorSpecStruct()
+        for group in ("condition", "inference"):
+            features[f"{group}/features/image"] = rng.rand(
+                tasks, 1, EPISODE_LENGTH, *IMAGE_SIZE, 3
+            ).astype(np.float32)
+            features[f"{group}/features/gripper_pose"] = rng.rand(
+                tasks, 1, EPISODE_LENGTH, 14
+            ).astype(np.float32)
+        features["condition/labels/action"] = rng.rand(
+            tasks, 1, EPISODE_LENGTH, 7
+        ).astype(np.float32)
+        labels = TensorSpecStruct()
+        labels["action"] = rng.rand(tasks, 1, EPISODE_LENGTH, 7).astype(
+            np.float32
+        )
+        return features, labels
+
+    @pytest.mark.parametrize(
+        "decoder_cls",
+        [
+            vrgripper.MSEDecoder,
+            lambda: vrgripper.MDNDecoder(num_mixture_components=2),
+        ],
+    )
+    def test_forward_and_loss(self, decoder_cls):
+        model = self.make_model(
+            action_decoder_cls=decoder_cls,
+            embed_loss_weight=0.1,
+        )
+        features, labels = self._meta_batch()
+        variables = model.init_variables(jax.random.PRNGKey(0), features)
+        outputs, _ = model.inference_network_fn(
+            variables, features, "train", labels=labels
+        )
+        assert outputs["inference_output"].shape == (2, 1, EPISODE_LENGTH, 7)
+        assert outputs["condition_embedding"].shape == (2, 1, 32)
+        loss, metrics = model.model_train_fn(
+            features, labels, outputs, "train"
+        )
+        assert np.isfinite(float(loss))
+        assert "loss/embed" in metrics
+
+    def test_film_conditioning(self):
+        model = self.make_model(use_film=True)
+        features, labels = self._meta_batch()
+        variables = model.init_variables(jax.random.PRNGKey(0), features)
+        outputs, _ = model.inference_network_fn(
+            variables, features, "train", labels=labels
+        )
+        assert np.all(
+            np.isfinite(np.asarray(outputs["inference_output"]))
+        )
+
+    def test_meta_example_preprocessor_integration(self):
+        model = self.make_model()
+        pre = model.preprocessor
+        in_spec = pre.get_in_feature_specification("train")
+        # MetaExample columns for the single condition episode.
+        assert "condition/features/image/0" in in_spec.keys()
+        assert in_spec["condition/features/image/0"].name.startswith(
+            "condition_ep0/"
+        )
+
+
+class TestWtlTrialModel:
+    def make_model(self, **kwargs):
+        return vrgripper.VRGripperEnvSimpleTrialModel(
+            episode_length=EPISODE_LENGTH, device_type="cpu", **kwargs
+        )
+
+    def _meta_batch(self, model, tasks=2, num_condition=1):
+        rng = np.random.RandomState(0)
+        features = TensorSpecStruct()
+        features["condition/features/full_state_pose"] = rng.rand(
+            tasks, num_condition, EPISODE_LENGTH, 32
+        ).astype(np.float32)
+        features["condition/labels/action"] = rng.rand(
+            tasks, num_condition, EPISODE_LENGTH, 7
+        ).astype(np.float32)
+        features["condition/labels/success"] = rng.randint(
+            0, 2, (tasks, num_condition, EPISODE_LENGTH, 1)
+        ).astype(np.float32)
+        features["inference/features/full_state_pose"] = rng.rand(
+            tasks, 1, EPISODE_LENGTH, 32
+        ).astype(np.float32)
+        labels = TensorSpecStruct()
+        labels["action"] = rng.rand(tasks, 1, EPISODE_LENGTH, 7).astype(
+            np.float32
+        )
+        labels["success"] = np.ones((tasks, 1, EPISODE_LENGTH, 1), np.float32)
+        return features, labels
+
+    @pytest.mark.parametrize("embed_type", ["temporal", "mean"])
+    def test_trial_model(self, embed_type):
+        model = self.make_model(embed_type=embed_type)
+        features, labels = self._meta_batch(model)
+        variables = model.init_variables(jax.random.PRNGKey(0), features)
+        outputs, _ = model.inference_network_fn(
+            variables, features, "train", labels=labels
+        )
+        assert outputs["inference_output"].shape == (2, 1, EPISODE_LENGTH, 7)
+        loss, _ = model.model_train_fn(features, labels, outputs, "train")
+        assert np.isfinite(float(loss))
+
+    def test_retrial_model(self):
+        model = self.make_model(
+            retrial=True, num_condition_samples_per_task=2
+        )
+        features, labels = self._meta_batch(model, num_condition=2)
+        variables = model.init_variables(jax.random.PRNGKey(0), features)
+        outputs, _ = model.inference_network_fn(
+            variables, features, "train", labels=labels
+        )
+        assert outputs["inference_output"].shape == (2, 1, EPISODE_LENGTH, 7)
+
+    def test_retrial_requires_two_condition_episodes(self):
+        with pytest.raises(ValueError, match="2 condition"):
+            self.make_model(retrial=True, num_condition_samples_per_task=1)
+
+    def test_pack_features(self):
+        model = self.make_model()
+        state = np.zeros((32,), np.float32)
+        episode = [
+            (state, np.zeros(7), 1.0, state, False, {}) for _ in range(3)
+        ]
+        packed = model.pack_features(state, [episode], 0)
+        assert packed["condition/features/full_state_pose"].shape == (
+            1, 1, EPISODE_LENGTH, 32,
+        )
+        assert packed["inference/features/full_state_pose"].shape == (
+            1, 1, EPISODE_LENGTH, 32,
+        )
+        # Successful episode (reward > 0) -> success flag 1.
+        np.testing.assert_allclose(
+            packed["condition/labels/success"], 1.0
+        )
+
+
+class TestEpisodeToTransitions:
+    def _episode(self, length=5):
+        return [
+            (
+                np.arange(3, dtype=np.float32) + t,
+                np.ones(2, np.float32),
+                float(t),
+                np.arange(3, dtype=np.float32) + t + 1,
+                t == length - 1,
+                {"is_demo": True, "target_idx": 4},
+            )
+            for t in range(length)
+        ]
+
+    def test_make_fixed_length(self):
+        out = vrgripper.episode_to_transitions.make_fixed_length(
+            list(range(10)), 6, rng=np.random.RandomState(0)
+        )
+        assert len(out) == 6
+        assert out[0] == 0 and out[-1] == 9
+        assert out == sorted(out)
+        # Short lists return None.
+        assert (
+            vrgripper.episode_to_transitions.make_fixed_length([1, 2], 6)
+            is None
+        )
+        deterministic = vrgripper.episode_to_transitions.make_fixed_length(
+            list(range(4)), 8, randomized=False
+        )
+        assert deterministic == sorted(deterministic)
+        assert len(deterministic) == 8
+
+    def test_reacher_transitions(self):
+        transitions = (
+            vrgripper.episode_to_transitions.episode_to_transitions_reacher(
+                self._episode(), is_demo=True
+            )
+        )
+        assert len(transitions) == 5
+        feature = transitions[0].features.feature
+        assert list(feature["pose_t"].float_list.value) == [0.0, 1.0, 2.0]
+        assert list(feature["is_demo"].int64_list.value) == [1]
+
+    def test_metareacher_sequence_example(self):
+        out = vrgripper.episode_to_transitions.episode_to_transitions_metareacher(
+            self._episode()
+        )
+        assert len(out) == 1
+        example = out[0]
+        assert list(
+            example.context.feature["target_idx"].int64_list.value
+        ) == [4]
+        assert len(
+            example.feature_lists.feature_list["pose_t"].feature
+        ) == 5
