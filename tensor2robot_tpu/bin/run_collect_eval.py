@@ -1,0 +1,38 @@
+"""Collect/eval CLI: parse configs/bindings, run the continuous loop.
+
+Usage (reference bin/run_collect_eval.py:27-48 parity):
+  python -m tensor2robot_tpu.bin.run_collect_eval \
+      --root_dir=/tmp/run \
+      --gin_configs=path/to/config.gin
+"""
+
+from __future__ import annotations
+
+from absl import app, flags
+
+FLAGS = flags.FLAGS
+flags.DEFINE_string("root_dir", None, "Experiment root directory.")
+flags.DEFINE_multi_string(
+    "gin_configs", [], "Paths to config files applied in order."
+)
+flags.DEFINE_multi_string(
+    "gin_bindings", [], "Individual bindings applied after config files."
+)
+
+
+def main(argv):
+    del argv
+    import tensor2robot_tpu.config.defaults  # registers the surface
+
+    from tensor2robot_tpu import config as cfg
+
+    cfg.parse_config_files_and_bindings(FLAGS.gin_configs, FLAGS.gin_bindings)
+    collect_eval_loop = cfg.get_configurable("collect_eval_loop")
+    kwargs = {}
+    if FLAGS.root_dir:
+        kwargs["root_dir"] = FLAGS.root_dir
+    collect_eval_loop(**kwargs)
+
+
+if __name__ == "__main__":
+    app.run(main)

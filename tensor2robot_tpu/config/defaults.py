@@ -55,3 +55,37 @@ MockT2RModel = external_configurable(_mocks.MockT2RModel, "MockT2RModel")
 MockInputGenerator = external_configurable(
     _mocks.MockInputGenerator, "MockInputGenerator"
 )
+
+# -- policies / collect-eval / writers (register on import) -------------------
+from tensor2robot_tpu.policies import policies as _policies  # noqa: F401
+from tensor2robot_tpu.utils import writer as _writer  # noqa: F401
+from tensor2robot_tpu.utils import (  # noqa: F401
+    continuous_collect_eval as _cce,
+)
+
+# -- episode runners ----------------------------------------------------------
+from tensor2robot_tpu.research import run_env as _run_env
+
+run_env = external_configurable(_run_env.run_env, "run_env")
+from tensor2robot_tpu.meta_learning import run_meta_env as _rme  # noqa: F401
+
+# -- research model zoo -------------------------------------------------------
+from tensor2robot_tpu.research import pose_env as _pose_env  # noqa: F401
+from tensor2robot_tpu.research.grasp2vec import (
+    grasp2vec_model as _g2v_model,
+)
+from tensor2robot_tpu.research.qtopt import t2r_models as _qtopt_models
+from tensor2robot_tpu.research import vrgripper as _vrgripper
+
+Grasp2VecModel = external_configurable(
+    _g2v_model.Grasp2VecModel, "Grasp2VecModel"
+)
+for _cls in (
+    _qtopt_models.Grasping44E2EOpenCloseTerminateGripperStatusHeightToBottom,
+    _vrgripper.VRGripperRegressionModel,
+    _vrgripper.VRGripperDomainAdaptiveModel,
+    _vrgripper.VRGripperEnvTecModel,
+    _vrgripper.VRGripperEnvSimpleTrialModel,
+    _vrgripper.VRGripperEnvRegressionModelMAML,
+):
+    globals()[_cls.__name__] = external_configurable(_cls, _cls.__name__)

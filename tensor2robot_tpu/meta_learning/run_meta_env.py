@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import collections
 import copy
-import datetime
+import inspect
 import os
 from typing import Any, Callable, Dict, List, Optional
 
@@ -21,6 +21,29 @@ import numpy as np
 
 from tensor2robot_tpu.config import configurable
 from tensor2robot_tpu.train.metrics import MetricsWriter
+from tensor2robot_tpu.utils import writer as writer_lib
+
+
+def _convert_episode(episode_to_transitions_fn, episode_data, is_demo=None):
+    """Runs the converter, passing is_demo only to converters that take it
+    (the VRGripper-style fns do; the meta converters read debug['is_demo']
+    themselves), and serializes the outputs for the replay writer."""
+    kwargs = {}
+    if is_demo is not None:
+        try:
+            parameters = inspect.signature(
+                episode_to_transitions_fn
+            ).parameters
+            if "is_demo" in parameters or any(
+                p.kind == inspect.Parameter.VAR_KEYWORD
+                for p in parameters.values()
+            ):
+                kwargs["is_demo"] = is_demo
+        except (TypeError, ValueError):
+            pass
+    return writer_lib.serialize_transition_records(
+        episode_to_transitions_fn(episode_data, **kwargs)
+    )
 
 
 def _run_demo_episode(env, demo_policy) -> List[tuple]:
@@ -52,8 +75,10 @@ def run_meta_env(
     episode_to_transitions_fn: Optional[Callable] = None,
     replay_writer=None,
     root_dir: Optional[str] = None,
+    output_dir: Optional[str] = None,
     task: int = 0,
     global_step: int = 0,
+    num_episodes: Optional[int] = None,
     num_tasks: int = 10,
     num_adaptations_per_task: int = 2,
     num_episodes_per_adaptation: int = 1,
@@ -64,7 +89,12 @@ def run_meta_env(
 ) -> Dict[str, float]:
     """Runs the meta agent/env loop; returns the summary statistics dict
     (reference run_meta_env :33-258 — summaries land in metrics.jsonl
-    instead of tf events)."""
+    instead of tf events). `num_episodes` is accepted-and-ignored and
+    `output_dir` aliases root_dir, for collect_eval_loop's run_agent_fn
+    calling convention (the reference ignores num_episodes too, :85)."""
+    del num_episodes
+    if root_dir is None:
+        root_dir = output_dir
     task_step_rewards: Dict[int, Dict[int, List[float]]] = (
         collections.defaultdict(lambda: collections.defaultdict(list))
     )
@@ -80,11 +110,11 @@ def run_meta_env(
         # three together so write() is never reachable without open().
         writing = bool(replay_writer and episode_to_transitions_fn and root_dir)
         if writing:
-            timestamp = datetime.datetime.now().strftime("%Y-%m-%d-%H-%M-%S")
-            record_name = os.path.join(
-                root_dir, f"gs{global_step}_t{task}_{timestamp}_{task_idx}"
+            replay_writer.open(
+                writer_lib.timestamped_record_path(
+                    root_dir, global_step, suffix=f"t{task}_{task_idx}"
+                )
             )
-            replay_writer.open(record_name)
 
         # Conditioning data: demos from a demo policy, or task data the env
         # provides directly (reference :125-167).
@@ -99,7 +129,11 @@ def run_meta_env(
                 condition_data.append(episode_data)
                 if writing:
                     replay_writer.write(
-                        episode_to_transitions_fn(episode_data, is_demo=True)
+                        _convert_episode(
+                            episode_to_transitions_fn,
+                            episode_data,
+                            is_demo=True,
+                        )
                     )
             policy.adapt(copy.copy(condition_data))
         elif hasattr(env, "task_data") and hasattr(policy, "adapt"):
@@ -145,7 +179,9 @@ def run_meta_env(
                 task_step_rewards[task_idx][step_num].append(episode_reward)
                 if writing:
                     replay_writer.write(
-                        episode_to_transitions_fn(episode_data)
+                        _convert_episode(
+                            episode_to_transitions_fn, episode_data
+                        )
                     )
                 condition_data.append(episode_data)
 

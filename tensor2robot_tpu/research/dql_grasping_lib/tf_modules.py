@@ -1,0 +1,60 @@
+"""Convnet building blocks for grasping-style critics.
+
+Behavioral reference: tensor2robot/research/dql_grasping_lib/tf_modules.py:
+25-90 (`argscope`, `tile_to_match_context`, `add_context`). The slim
+argscope (stride-2 VALID convs, truncated-normal init, relu, layer norm)
+becomes an explicit `conv_block`; the context-merge helpers are pure jnp.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def conv_block(
+    x: jax.Array,
+    channels: int,
+    kernel_size: int = 3,
+    stride: int = 2,
+    name: str = "conv",
+) -> jax.Array:
+    """conv(VALID, stride 2) + layer norm + relu — the reference argscope's
+    per-layer recipe (tf_modules.py:25-44). Must be called inside an
+    nn.compact parent."""
+    x = nn.Conv(
+        channels,
+        (kernel_size, kernel_size),
+        strides=(stride, stride),
+        padding="VALID",
+        kernel_init=nn.initializers.truncated_normal(stddev=0.01),
+        name=name,
+    )(x)
+    x = nn.LayerNorm(name=f"{name}_ln")(x)
+    return nn.relu(x)
+
+
+def tile_to_match_context(net: jax.Array, context: jax.Array) -> jax.Array:
+    """Tiles net along a new axis=1 to match context's per-batch examples
+    (reference :47-69): [B, ...] + [B, M, C] -> [B, M, ...]."""
+    num_samples = context.shape[1]
+    expanded = jnp.expand_dims(net, 1)
+    reps = [1] * expanded.ndim
+    reps[1] = num_samples
+    return jnp.tile(expanded, reps)
+
+
+def add_context(net: jax.Array, context: jax.Array) -> jax.Array:
+    """Broadcast-adds a [B*M, C] context into a [B*M, H, W, C] conv map
+    (reference :72-90). `net` must already be tiled to B*M rows."""
+    if net.shape[0] != context.shape[0]:
+        raise ValueError(
+            f"net rows {net.shape[0]} != context rows {context.shape[0]}; "
+            "tile the conv map to the action megabatch first."
+        )
+    if net.shape[-1] != context.shape[-1]:
+        raise ValueError(
+            f"Channel mismatch: {net.shape[-1]} vs {context.shape[-1]}."
+        )
+    return net + context[:, None, None, :]

@@ -1,0 +1,50 @@
+"""PoseToyEnv episode -> transition Examples.
+
+Behavioral reference:
+tensor2robot/research/pose_env/episode_to_transitions.py:31-50
+(`episode_to_transitions_pose_toy`): the supervised pose-regression layout —
+jpeg state image, attempted pose, reward, true target pose.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tensor2robot_tpu.config import configurable
+from tensor2robot_tpu.proto import example_pb2
+from tensor2robot_tpu.utils import image as image_lib
+
+
+@configurable("episode_to_transitions_pose_toy")
+def episode_to_transitions_pose_toy(
+    episode_data, binary_success_threshold=None
+):
+    """Converts pose toy env episodes to transition Examples
+    (reference :31-50).
+
+    Args:
+      episode_data: (obs, action, reward, new_obs, done, debug) tuples.
+      binary_success_threshold: if set, rewards are relabeled to
+        1.0 when above the threshold else 0.0 — giving the downstream
+        reward-weighted losses proper non-negative sample weights (the
+        env's raw reward is a negative distance).
+    """
+    transitions = []
+    for transition in episode_data:
+        obs_t, action, reward, _, _, debug = transition
+        if binary_success_threshold is not None:
+            reward = float(reward > binary_success_threshold)
+        example = example_pb2.Example()
+        feature = example.features.feature
+        feature["state/image"].bytes_list.value.append(
+            image_lib.numpy_to_image_string(obs_t, "jpeg")
+        )
+        feature["pose"].float_list.value.extend(
+            np.asarray(action, np.float32).reshape(-1).tolist()
+        )
+        feature["reward"].float_list.value.append(float(reward))
+        feature["target_pose"].float_list.value.extend(
+            np.asarray(debug["target_pose"], np.float32).reshape(-1).tolist()
+        )
+        transitions.append(example)
+    return transitions

@@ -1,0 +1,237 @@
+"""PoseEnv models: the minimal end-to-end train/collect/eval testbed.
+
+Behavioral reference: tensor2robot/research/pose_env/pose_env_models.py
+(`DefaultPoseEnvContinuousPreprocessor` :41-88,
+`PoseEnvContinuousMCModel` :91-178, `DefaultPoseEnvRegressionPreprocessor`
+:181-226, `PoseEnvRegressionModel` :229-324).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensor2robot_tpu.layers.vision_layers import (
+    ImageFeaturesToPoseNet,
+    ImagesToFeaturesNet,
+)
+from tensor2robot_tpu.models.abstract_model import MODE_TRAIN
+from tensor2robot_tpu.models.base_models import CriticModel, RegressionModel
+from tensor2robot_tpu.preprocessors.abstract_preprocessor import (
+    SpecTransformationPreprocessor,
+)
+from tensor2robot_tpu.research.dql_grasping_lib import tf_modules
+from tensor2robot_tpu.specs import (
+    ExtendedTensorSpec,
+    TensorSpecStruct,
+)
+
+
+class DefaultPoseEnvContinuousPreprocessor(SpecTransformationPreprocessor):
+    """uint8 jpeg image source -> float32 [0, 1] (reference :41-88)."""
+
+    def _transform_in_feature_specification(self, spec, mode):
+        self.update_spec(spec, "state/image", dtype=np.uint8)
+        return spec
+
+    def _preprocess_fn(self, features, labels, mode, rng):
+        features["state/image"] = (
+            features["state/image"].astype(jnp.float32) / 255.0
+        )
+        return features, labels
+
+
+class _PoseMCNet(nn.Module):
+    """Q(image, pose) tower (reference _q_features + q_func :117-173):
+    3 stride-2 VALID convs with layer norm, action context broadcast-added
+    to the conv map, then an fc stack to one Q logit."""
+
+    channels: int = 32
+
+    @nn.compact
+    def __call__(self, features, mode):
+        image = features.state.image
+        pose = features.action.pose
+        tiled = pose.ndim == 3
+        if tiled:
+            # CEM megabatch: [B, N, 2] actions against [B, H, W, C] states.
+            action_batch = pose.shape[1]
+            pose = pose.reshape(-1, pose.shape[-1])
+
+        net = image
+        for i in range(3):
+            net = tf_modules.conv_block(
+                net, self.channels, name=f"conv{i}"
+            )
+        context = nn.Dense(self.channels, name="action_fc")(pose)
+        context = nn.relu(nn.LayerNorm(name="action_ln")(context))
+        if tiled:
+            net = jnp.repeat(net, action_batch, axis=0)
+        net = tf_modules.add_context(net, context)
+        net = net.reshape(net.shape[0], -1)
+        for i, width in enumerate((100, 100)):
+            net = nn.Dense(width, name=f"fc{i}")(net)
+            net = nn.relu(nn.LayerNorm(name=f"fc_ln{i}")(net))
+        q = nn.Dense(1, name="q")(net)
+        q = jnp.squeeze(q, -1)
+        if tiled:
+            q = q.reshape(-1, action_batch)
+        out = TensorSpecStruct()
+        out["q_predicted"] = q
+        return out
+
+
+class PoseEnvContinuousMCModel(CriticModel):
+    """Monte-Carlo critic Q(image, pose) (reference :91-178)."""
+
+    def __init__(self, **kwargs):
+        kwargs.setdefault(
+            "preprocessor_cls", DefaultPoseEnvContinuousPreprocessor
+        )
+        super().__init__(**kwargs)
+
+    def get_state_specification(self) -> TensorSpecStruct:
+        return TensorSpecStruct(
+            image=ExtendedTensorSpec(
+                shape=(64, 64, 3),
+                dtype=np.float32,
+                name="state/image",
+                data_format="jpeg",
+            )
+        )
+
+    def get_action_specification(self) -> TensorSpecStruct:
+        return TensorSpecStruct(
+            pose=ExtendedTensorSpec(
+                shape=(2,), dtype=np.float32, name="pose"
+            )
+        )
+
+    def get_label_specification(self, mode: str) -> TensorSpecStruct:
+        del mode
+        return TensorSpecStruct(
+            reward=ExtendedTensorSpec(
+                shape=(), dtype=np.float32, name="reward"
+            )
+        )
+
+    def create_network(self) -> nn.Module:
+        return _PoseMCNet()
+
+    def model_train_fn(self, features, labels, inference_outputs, mode):
+        # MC regression of Q toward observed return (the env's reward is
+        # continuous, so MSE rather than the log loss of binary critics).
+        q = inference_outputs["q_predicted"]
+        loss = jnp.mean(jnp.square(q - labels["reward"]))
+        return loss, {"loss/q_mse": loss}
+
+    def model_eval_fn(self, features, labels, inference_outputs):
+        loss, metrics = self.model_train_fn(
+            features, labels, inference_outputs, "eval"
+        )
+        out = {"loss": loss}
+        out.update(metrics)
+        return out
+
+    def pack_features(self, state, context, timestep, actions):
+        """(obs, CEM action population) -> predict features
+        (reference :175-178)."""
+        del context, timestep
+        return {
+            "state/image": np.expand_dims(state, 0),
+            "action/pose": np.asarray(actions),
+        }
+
+
+class DefaultPoseEnvRegressionPreprocessor(SpecTransformationPreprocessor):
+    """uint8 source image -> float32 (reference :181-226)."""
+
+    def _transform_in_feature_specification(self, spec, mode):
+        self.update_spec(spec, "state", dtype=np.uint8)
+        return spec
+
+    def _preprocess_fn(self, features, labels, mode, rng):
+        features["state"] = features["state"].astype(jnp.float32) / 255.0
+        return features, labels
+
+
+class _PoseRegressionNet(nn.Module):
+    action_size: int
+
+    @nn.compact
+    def __call__(self, features, mode):
+        feature_points, _ = ImagesToFeaturesNet(
+            normalizer="layer_norm", name="state_features"
+        )(features["state"], mode == MODE_TRAIN)
+        estimated_pose, _ = ImageFeaturesToPoseNet(
+            num_outputs=self.action_size, name="pose_net"
+        )(feature_points)
+        out = TensorSpecStruct()
+        out["inference_output"] = estimated_pose
+        out["state_features"] = feature_points
+        return out
+
+
+class PoseEnvRegressionModel(RegressionModel):
+    """Image -> pose regression, reward-weighted MSE (reference :229-324)."""
+
+    def __init__(self, action_size: int = 2, **kwargs):
+        kwargs.setdefault(
+            "preprocessor_cls", DefaultPoseEnvRegressionPreprocessor
+        )
+        super().__init__(**kwargs)
+        self._action_size = action_size
+
+    @property
+    def action_size(self) -> int:
+        return self._action_size
+
+    def get_feature_specification(self, mode: str) -> TensorSpecStruct:
+        del mode
+        return TensorSpecStruct(
+            state=ExtendedTensorSpec(
+                shape=(64, 64, 3),
+                dtype=np.float32,
+                name="state/image",
+                data_format="jpeg",
+            )
+        )
+
+    def get_label_specification(self, mode: str) -> TensorSpecStruct:
+        del mode
+        return TensorSpecStruct(
+            target_pose=ExtendedTensorSpec(
+                shape=(self._action_size,),
+                dtype=np.float32,
+                name="target_pose",
+            ),
+            reward=ExtendedTensorSpec(
+                shape=(1,), dtype=np.float32, name="reward"
+            ),
+        )
+
+    def create_network(self) -> nn.Module:
+        return _PoseRegressionNet(action_size=self._action_size)
+
+    def model_train_fn(self, features, labels, inference_outputs, mode):
+        # Reward-weighted MSE (reference loss_fn :317-324). Weights are
+        # clamped to >= 0: the env's raw rewards are negative distances, and
+        # a negative weight would flip the objective into error
+        # *maximization*; zero-weight entries (the MAML dummy-episode
+        # masking trick) still contribute no gradient.
+        weights = jnp.maximum(labels["reward"], 0.0)
+        squared = jnp.square(
+            inference_outputs["inference_output"] - labels["target_pose"]
+        )
+        loss = jnp.sum(weights * squared) / jnp.maximum(
+            jnp.sum(weights) * squared.shape[-1], 1e-6
+        )
+        return loss, {"loss/weighted_mse": loss}
+
+    def pack_features(self, state, context, timestep):
+        del context, timestep
+        return {"state": np.expand_dims(state, 0)}

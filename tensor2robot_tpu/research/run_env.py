@@ -11,9 +11,12 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import os
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+from tensor2robot_tpu.utils import writer as writer_lib
 
 
 @dataclasses.dataclass
@@ -23,6 +26,15 @@ class Transition:
     reward: float
     new_obs: Any
     done: bool
+    debug: Optional[dict] = None
+
+    def __iter__(self):
+        # Tuple-unpacking compatibility with the reference's
+        # (obs, action, rew, new_obs, done, debug) episode tuples.
+        return iter(
+            (self.obs, self.action, self.reward, self.new_obs, self.done,
+             self.debug)
+        )
 
 
 def episode_to_transitions_identity(episode: List[Transition]) -> List[Transition]:
@@ -49,6 +61,7 @@ def run_env(
     transition_to_record_fn: Optional[Callable] = None,
     replay_writer=None,
     replay_path: Optional[str] = None,
+    output_dir: Optional[str] = None,
     on_episode_end: Optional[Callable[[int, List[Transition]], None]] = None,
 ) -> List[float]:
     """Runs episodes; returns per-episode total rewards
@@ -62,22 +75,33 @@ def run_env(
       explore_schedule: global_step -> explore probability fed to
         policy.sample_action (None = greedy).
       global_step: the learner step these episodes are attributed to.
-      episode_to_transitions_fn: [Transition] -> [Transition] converter
-        (n-step returns, reward relabeling, ...).
-      transition_to_record_fn: Transition -> serialized bytes for the
-        replay writer; required when replay_writer is set.
+      episode_to_transitions_fn: [Transition] -> transitions converter
+        (n-step returns, reward relabeling, proto assembly, ...).
+      transition_to_record_fn: transition -> serialized bytes for the
+        replay writer. With a replay_writer, supply either this OR an
+        episode_to_transitions_fn whose outputs are protos/bytes.
       replay_writer: utils.writer.ReplayWriter episode sink.
-      replay_path: shard path prefix passed to replay_writer.open.
+      replay_path: shard path prefix passed to replay_writer.open; derived
+        from `output_dir` + global_step when omitted.
       on_episode_end: callback(episode_index, transitions).
     """
     explore_prob = (
         explore_schedule(global_step) if explore_schedule is not None else 0.0
     )
     if replay_writer is not None:
-        if transition_to_record_fn is None:
-            raise ValueError("replay_writer requires transition_to_record_fn.")
+        if replay_path is None and output_dir is not None:
+            replay_path = writer_lib.timestamped_record_path(
+                output_dir, global_step
+            )
         if replay_path is None:
-            raise ValueError("replay_writer requires replay_path.")
+            raise ValueError(
+                "replay_writer requires replay_path or output_dir."
+            )
+        if transition_to_record_fn is None and episode_to_transitions_fn is None:
+            raise ValueError(
+                "replay_writer requires transition_to_record_fn or an "
+                "episode_to_transitions_fn producing serializable protos."
+            )
         replay_writer.open(replay_path)
     episode_rewards: List[float] = []
     try:
@@ -91,8 +115,10 @@ def run_env(
             total_reward, step, done = 0.0, 0, False
             while not done:
                 action, _ = policy.sample_action(obs, explore_prob)
-                new_obs, reward, done, _ = _step_env(env, action)
-                episode.append(Transition(obs, action, reward, new_obs, done))
+                new_obs, reward, done, env_debug = _step_env(env, action)
+                episode.append(
+                    Transition(obs, action, reward, new_obs, done, env_debug)
+                )
                 total_reward += reward
                 obs = new_obs
                 step += 1
@@ -104,8 +130,12 @@ def run_env(
                 else episode
             )
             if replay_writer is not None:
+                if transition_to_record_fn is not None:
+                    records = [transition_to_record_fn(t) for t in transitions]
+                else:
+                    records = transitions
                 replay_writer.write(
-                    [transition_to_record_fn(t) for t in transitions]
+                    writer_lib.serialize_transition_records(records)
                 )
             if on_episode_end is not None:
                 on_episode_end(episode_index, transitions)

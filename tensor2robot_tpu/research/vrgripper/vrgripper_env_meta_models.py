@@ -98,6 +98,14 @@ class _TecNet(nn.Module):
         condition_embedding = self._embed_episode(
             embedder, reducer, features.condition, train
         )
+        gripper_pose = features.inference.features["gripper_pose"]
+        num_inference_episodes = gripper_pose.shape[1]
+        # Reduce the condition episodes to ONE task embedding (mean over the
+        # episode axis), then broadcast it across inference episodes and
+        # time — supports num_condition_samples_per_task != num inference
+        # episodes; the per-episode embeddings still feed the contrastive
+        # loss untouched.
+        task_embedding = jnp.mean(condition_embedding, axis=1, keepdims=True)
 
         film_params = None
         if self.use_film:
@@ -105,17 +113,17 @@ class _TecNet(nn.Module):
                 film_output_size=2 * 5 * 32, name="film_params"
             )
             film_params = meta_tfdata.multi_batch_apply(
-                film_generator, 2, condition_embedding
+                film_generator, 2, task_embedding
             )
-            # Stretch to [B, E, T, film]: identical across time.
+            # Stretch to [B, E_inf, T, film]: identical across episodes/time.
             film_params = jnp.tile(
-                film_params[:, :, None, :], (1, 1, self.episode_length, 1)
+                film_params[:, :, None, :],
+                (1, num_inference_episodes, self.episode_length, 1),
             )
 
-        gripper_pose = features.inference.features["gripper_pose"]
         fc_embedding = jnp.tile(
-            condition_embedding[..., : self.fc_embed_size][:, :, None, :],
-            (1, 1, self.episode_length, 1),
+            task_embedding[..., : self.fc_embed_size][:, :, None, :],
+            (1, num_inference_episodes, self.episode_length, 1),
         )
         tower = ImagesToFeaturesNet(
             normalizer="layer_norm", name="state_features"

@@ -39,6 +39,7 @@ def pack_wtl_meta_features(
     timestep: int,
     episode_length: int,
     num_condition_samples_per_task: int,
+    action_size: int = 7,
 ) -> dict:
     """Packs a live observation + conditioning episodes into the trial
     model's meta feature layout (reference pack_wtl_meta_features :43-134).
@@ -75,7 +76,8 @@ def pack_wtl_meta_features(
     return {
         "condition/features/full_state_pose": np.stack(condition)[None, ...],
         "condition/labels/action": np.zeros(
-            (1, num_condition_samples_per_task, episode_length, 7), np.float32
+            (1, num_condition_samples_per_task, episode_length, action_size),
+            np.float32,
         ),
         "condition/labels/success": np.stack(success)[None, ...],
         "inference/features/full_state_pose": inference[None, None, ...],
@@ -318,6 +320,7 @@ class VRGripperEnvSimpleTrialModel(FlaxT2RModel):
             timestep,
             self._episode_length,
             self._num_condition_samples_per_task,
+            action_size=self._action_size,
         )
 
 

@@ -77,9 +77,21 @@ def collect_eval_loop(
             continue
         last_global_step = global_step
         if collect_env is not None:
-            run_agent_fn(collect_env, policy, num_collect, collect_dir, global_step)
+            run_agent_fn(
+                collect_env,
+                policy=policy,
+                num_episodes=num_collect,
+                output_dir=collect_dir,
+                global_step=global_step,
+            )
         if eval_env is not None:
-            run_agent_fn(eval_env, policy, num_eval, eval_dir, global_step)
+            run_agent_fn(
+                eval_env,
+                policy=policy,
+                num_episodes=num_eval,
+                output_dir=eval_dir,
+                global_step=global_step,
+            )
         cycles += 1
         if global_step >= max_steps:
             return global_step

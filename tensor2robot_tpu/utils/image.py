@@ -1,0 +1,28 @@
+"""Image encoding helpers (reference tensor2robot/utils/image.py:23-49)."""
+
+from __future__ import annotations
+
+import io
+
+import numpy as np
+
+
+def jpeg_string(image, jpeg_quality: int = 90) -> bytes:
+    """Returns a JPEG-encoded bytestring of a PIL image
+    (reference jpeg_string :23-37)."""
+    output = io.BytesIO()
+    image.save(output, format="JPEG", quality=jpeg_quality)
+    return output.getvalue()
+
+
+def numpy_to_image_string(
+    image_array: np.ndarray, image_format: str = "jpeg", dtype=np.uint8
+) -> bytes:
+    """Encodes a numpy HWC array as an image bytestring
+    (reference numpy_to_image_string :40-49)."""
+    from PIL import Image
+
+    pil_image = Image.fromarray(np.asarray(image_array, dtype=dtype))
+    output = io.BytesIO()
+    pil_image.save(output, format=image_format.upper())
+    return output.getvalue()

@@ -33,6 +33,40 @@ class ReplayWriter(abc.ABC):
         ...
 
 
+def timestamped_record_path(
+    output_dir: str, global_step: int, suffix: str = ""
+) -> str:
+    """The shard-naming convention learners glob for:
+    <output_dir>/gs<step>_<timestamp>[_<suffix>] (reference run_env's
+    root_dir/record_name layout). Shared by run_env and run_meta_env so the
+    layouts cannot drift apart."""
+    import datetime
+
+    timestamp = datetime.datetime.now().strftime("%Y-%m-%d-%H-%M-%S")
+    name = f"gs{global_step}_{timestamp}"
+    if suffix:
+        name = f"{name}_{suffix}"
+    return os.path.join(output_dir, name)
+
+
+def serialize_transition_records(records) -> list:
+    """Protos -> bytes for the replay writer; passes bytes through and
+    rejects unserializable entries with a clear error."""
+    out = []
+    for record in records:
+        if isinstance(record, (bytes, bytearray)):
+            out.append(bytes(record))
+        elif hasattr(record, "SerializeToString"):
+            out.append(record.SerializeToString())
+        else:
+            raise ValueError(
+                "Replay records must be serialized bytes or protos with "
+                f"SerializeToString; got {type(record).__name__}. Supply a "
+                "transition_to_record_fn or a converter producing protos."
+            )
+    return out
+
+
 @configurable("TFRecordReplayWriter")
 class TFRecordReplayWriter(ReplayWriter):
     """Writes transition records to <path>-<timestamp>.tfrecord shards."""

@@ -349,9 +349,11 @@ class TestCollectEvalLoop:
         policy = RegressionPolicy(_FakeRegressionPredictor())
         calls = []
 
-        def run_agent_fn(env, pol, episodes, output_dir, global_step):
-            calls.append((os.path.basename(output_dir), episodes, global_step))
-            run_env(env, pol, num_episodes=episodes)
+        def run_agent_fn(env, policy, num_episodes, output_dir, global_step):
+            calls.append(
+                (os.path.basename(output_dir), num_episodes, global_step)
+            )
+            run_env(env, policy, num_episodes=num_episodes)
 
         final = collect_eval_loop(
             root_dir=str(tmp_path),

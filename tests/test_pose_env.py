@@ -1,0 +1,222 @@
+"""PoseEnv end-to-end testbed tests (reference
+research/pose_env/pose_env_models_test.py + pose_env_test.py) and the
+dql_grasping_lib module helpers."""
+
+import glob
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensor2robot_tpu import config as cfg
+from tensor2robot_tpu.research import pose_env
+from tensor2robot_tpu.research.dql_grasping_lib import tf_modules
+from tensor2robot_tpu.research.run_env import run_env
+from tensor2robot_tpu.specs import TensorSpecStruct, make_random_numpy
+from tensor2robot_tpu.utils.writer import TFRecordReplayWriter
+
+
+class TestPoseToyEnv:
+    def test_episode_contract(self):
+        env = pose_env.PoseToyEnv(seed=0)
+        obs = env.reset()
+        assert obs.shape == (64, 64, 3) and obs.dtype == np.uint8
+        action = np.zeros(2)
+        new_obs, reward, done, debug = env.step(action)
+        assert done is True
+        assert reward <= 0.0
+        assert debug["target_pose"].shape == (2,)
+        # Perfect guess gets ~zero penalty.
+        _, best_reward, _, _ = env.step(debug["target_pose"])
+        assert best_reward == pytest.approx(0.0, abs=1e-5)
+
+    def test_image_depends_on_pose_and_task(self):
+        env = pose_env.PoseToyEnv(seed=0)
+        obs1 = env.reset()
+        env.set_new_pose()
+        obs2 = env.reset()
+        assert not np.array_equal(obs1, obs2)
+        env.reset_task()
+        obs3 = env.reset()
+        assert not np.array_equal(obs2, obs3)
+
+    def test_hidden_drift_offsets_labels(self):
+        env = pose_env.PoseToyEnv(seed=0, hidden_drift=True)
+        env.reset()
+        _, _, _, debug = env.step(np.zeros(2))
+        drift = debug["target_pose"] - env._rendered_pose[:2]
+        np.testing.assert_allclose(drift, env._hidden_drift_xy, atol=1e-6)
+
+    def test_random_policy(self):
+        policy = pose_env.PoseEnvRandomPolicy(seed=0)
+        action, debug = policy.sample_action(None, 0.0)
+        assert action.shape == (2,)
+        assert np.all(np.abs(action) <= 1.0)
+        assert policy.global_step == 0
+
+
+class TestTfModules:
+    def test_tile_to_match_context(self):
+        net = jnp.ones((2, 3))
+        context = jnp.ones((2, 4, 8))
+        tiled = tf_modules.tile_to_match_context(net, context)
+        assert tiled.shape == (2, 4, 3)
+
+    def test_add_context_broadcasts(self):
+        net = jnp.zeros((6, 5, 5, 8))
+        context = jnp.ones((6, 8))
+        out = tf_modules.add_context(net, context)
+        assert out.shape == (6, 5, 5, 8)
+        np.testing.assert_allclose(out[:, 2, 3, :], 1.0)
+
+    def test_add_context_validates(self):
+        with pytest.raises(ValueError, match="rows"):
+            tf_modules.add_context(jnp.zeros((4, 5, 5, 8)), jnp.ones((6, 8)))
+        with pytest.raises(ValueError, match="Channel"):
+            tf_modules.add_context(jnp.zeros((6, 5, 5, 4)), jnp.ones((6, 8)))
+
+
+class TestPoseEnvModels:
+    def test_regression_model_forward_and_loss(self):
+        model = pose_env.PoseEnvRegressionModel(device_type="cpu")
+        features = TensorSpecStruct()
+        features["state"] = np.random.RandomState(0).rand(
+            2, 64, 64, 3
+        ).astype(np.float32)
+        labels = TensorSpecStruct()
+        labels["target_pose"] = np.zeros((2, 2), np.float32)
+        labels["reward"] = np.ones((2, 1), np.float32)
+        variables = model.init_variables(jax.random.PRNGKey(0), features)
+        outputs, _ = model.inference_network_fn(variables, features, "train")
+        assert outputs["inference_output"].shape == (2, 2)
+        loss, _ = model.model_train_fn(features, labels, outputs, "train")
+        assert np.isfinite(float(loss))
+        # Zero reward weight => zero loss (the MAML dummy-episode trick).
+        labels["reward"] = np.zeros((2, 1), np.float32)
+        loss0, _ = model.model_train_fn(features, labels, outputs, "train")
+        assert float(loss0) == pytest.approx(0.0)
+
+    def test_regression_preprocessor_uint8_to_float(self):
+        model = pose_env.PoseEnvRegressionModel(device_type="cpu")
+        pre = model.preprocessor
+        in_spec = pre.get_in_feature_specification("train")
+        assert in_spec["state"].dtype == np.uint8
+        features = make_random_numpy(in_spec, batch_size=2)
+        out, _ = pre.preprocess(features, None, mode="eval")
+        assert out["state"].dtype == jnp.float32
+        assert float(jnp.max(out["state"])) <= 1.0
+
+    def test_mc_model_forward_train_and_tiled_predict(self):
+        model = pose_env.PoseEnvContinuousMCModel(
+            device_type="cpu", action_batch_size=5
+        )
+        features = TensorSpecStruct()
+        features["state/image"] = np.random.RandomState(0).rand(
+            2, 64, 64, 3
+        ).astype(np.float32)
+        features["action/pose"] = np.zeros((2, 2), np.float32)
+        labels = TensorSpecStruct()
+        labels["reward"] = np.zeros((2,), np.float32)
+        variables = model.init_variables(jax.random.PRNGKey(0), features)
+        outputs, _ = model.inference_network_fn(variables, features, "train")
+        assert outputs["q_predicted"].shape == (2,)
+        loss, _ = model.model_train_fn(features, labels, outputs, "train")
+        assert np.isfinite(float(loss))
+
+        # CEM-tiled: [B, N, 2] actions -> [B, N] Q values.
+        tiled = TensorSpecStruct()
+        tiled["state/image"] = features["state/image"]
+        tiled["action/pose"] = np.zeros((2, 5, 2), np.float32)
+        outputs, _ = model.inference_network_fn(variables, tiled, "predict")
+        assert outputs["q_predicted"].shape == (2, 5)
+
+    def test_pack_features(self):
+        model = pose_env.PoseEnvContinuousMCModel(device_type="cpu")
+        packed = model.pack_features(
+            np.zeros((64, 64, 3), np.uint8), None, 0, np.zeros((7, 2))
+        )
+        assert packed["state/image"].shape == (1, 64, 64, 3)
+        assert packed["action/pose"].shape == (7, 2)
+
+
+class TestMamlPackFeatures:
+    def make_model(self):
+        base = pose_env.PoseEnvRegressionModel(device_type="cpu")
+        return pose_env.PoseEnvRegressionModelMAML(
+            base_model=base, num_inner_loop_steps=1
+        )
+
+    def test_pack_with_demo(self):
+        model = self.make_model()
+        state = np.zeros((64, 64, 3), np.uint8)
+        episode = [(state, np.ones(2, np.float32), 1.0, state, True, {})]
+        packed = model.pack_features(state, [episode], 0)
+        assert packed["inference/features/state/0"].shape == (1, 64, 64, 3)
+        assert packed["condition/features/state/0"].shape == (1, 64, 64, 3)
+        # Reward 1 -> mapped to 2r-1 = 1.
+        np.testing.assert_allclose(
+            packed["condition/labels/reward/0"], [[1.0]]
+        )
+
+    def test_pack_without_demo_uses_zero_weight(self):
+        model = self.make_model()
+        state = np.zeros((64, 64, 3), np.uint8)
+        packed = model.pack_features(state, [], 0)
+        np.testing.assert_allclose(
+            packed["condition/labels/reward/0"], [[0.0]]
+        )
+
+
+class TestEndToEnd:
+    """The rebuild of the reference acceptance path: random-collect into
+    TFRecords -> train the regression model from the shipped gin config
+    (reference pose_env_models_test.py + train_eval_test_utils)."""
+
+    def _collect(self, tmp_path, episodes=48):
+        env = pose_env.PoseToyEnv(seed=1)
+        policy = pose_env.PoseEnvRandomPolicy(seed=2)
+        writer = TFRecordReplayWriter()
+        run_env(
+            env,
+            policy,
+            num_episodes=episodes,
+            episode_to_transitions_fn=lambda ep: (
+                pose_env.episode_to_transitions_pose_toy(
+                    ep, binary_success_threshold=-1.5
+                )
+            ),
+            replay_writer=writer,
+            output_dir=str(tmp_path / "collect"),
+        )
+        shards = glob.glob(str(tmp_path / "collect" / "*.tfrecord"))
+        assert shards
+        return shards
+
+    def test_collect_then_train_from_gin_config(self, tmp_path):
+        shards = self._collect(tmp_path)
+        config_dir = os.path.join(
+            os.path.dirname(pose_env.__file__), "configs"
+        )
+        cfg.clear_config()
+        try:
+            cfg.parse_config_files_and_bindings(
+                [os.path.join(config_dir, "run_train_reg.gin")],
+                [
+                    f"TRAIN_DATA = {shards!r}",
+                    f"EVAL_DATA = {shards!r}",
+                    "train_eval_model.max_train_steps = 3",
+                    "train_eval_model.eval_steps = 2",
+                    "train_input_generator/DefaultRecordInputGenerator.batch_size = 4",
+                    "eval_input_generator/DefaultRecordInputGenerator.batch_size = 4",
+                    "PoseEnvRegressionModel.device_type = 'cpu'",
+                    f"train_eval_model.model_dir = {str(tmp_path / 'run')!r}",
+                ],
+            )
+            train_eval_model = cfg.get_configurable("train_eval_model")
+            metrics = train_eval_model()
+            assert np.isfinite(metrics["loss"])
+            assert os.path.isdir(tmp_path / "run" / "checkpoints")
+        finally:
+            cfg.clear_config()

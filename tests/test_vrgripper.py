@@ -334,6 +334,38 @@ class TestTecModel:
         assert np.isfinite(float(loss))
         assert "loss/embed" in metrics
 
+    def test_multiple_condition_episodes(self):
+        # Regression: E_cond != E_inf must work — condition episodes reduce
+        # to one task embedding before joining inference features.
+        model = self.make_model(num_condition_samples_per_task=2)
+        rng = np.random.RandomState(0)
+        features = TensorSpecStruct()
+        features["condition/features/image"] = rng.rand(
+            2, 2, EPISODE_LENGTH, *IMAGE_SIZE, 3
+        ).astype(np.float32)
+        features["condition/features/gripper_pose"] = rng.rand(
+            2, 2, EPISODE_LENGTH, 14
+        ).astype(np.float32)
+        features["condition/labels/action"] = rng.rand(
+            2, 2, EPISODE_LENGTH, 7
+        ).astype(np.float32)
+        features["inference/features/image"] = rng.rand(
+            2, 1, EPISODE_LENGTH, *IMAGE_SIZE, 3
+        ).astype(np.float32)
+        features["inference/features/gripper_pose"] = rng.rand(
+            2, 1, EPISODE_LENGTH, 14
+        ).astype(np.float32)
+        labels = TensorSpecStruct()
+        labels["action"] = rng.rand(2, 1, EPISODE_LENGTH, 7).astype(
+            np.float32
+        )
+        variables = model.init_variables(jax.random.PRNGKey(0), features)
+        outputs, _ = model.inference_network_fn(
+            variables, features, "train", labels=labels
+        )
+        assert outputs["inference_output"].shape == (2, 1, EPISODE_LENGTH, 7)
+        assert outputs["condition_embedding"].shape == (2, 2, 32)
+
     def test_film_conditioning(self):
         model = self.make_model(use_film=True)
         features, labels = self._meta_batch()
