@@ -1,0 +1,37 @@
+"""Operative-config logging hook.
+
+Behavioral reference: tensor2robot/hooks/gin_config_hook_builder.py:29-55
+(`GinConfigLoggerHook` logs the operative config once after session
+creation; the chief-side GinConfigSaverHook equivalent lives in the trainer,
+which persists operative_config.gin — train/train_eval.py).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List
+
+from tensor2robot_tpu import config as cfg
+from tensor2robot_tpu.config import configurable
+from tensor2robot_tpu.hooks.hook_builder import Hook, HookBuilder
+
+
+class ConfigLoggerHook(Hook):
+    """Logs the operative config once at train begin (reference :29-45)."""
+
+    def __init__(self):
+        self._logged = False
+
+    def on_train_begin(self, ctx) -> None:
+        if self._logged:
+            return
+        self._logged = True
+        logging.info(
+            "Operative config:\n%s", cfg.operative_config_str()
+        )
+
+
+@configurable("ConfigLoggerHookBuilder")
+class ConfigLoggerHookBuilder(HookBuilder):
+    def create_hooks(self, t2r_model, trainer=None) -> List[Hook]:
+        return [ConfigLoggerHook()]

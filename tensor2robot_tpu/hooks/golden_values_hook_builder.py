@@ -1,0 +1,78 @@
+"""Golden-value capture: the collection-based regression harness.
+
+Behavioral reference: tensor2robot/hooks/golden_values_hook_builder.py:30-80.
+Models tag tensors by putting them into their train metrics under
+`golden/<name>` (the JAX stand-in for the reference's graph collection +
+`add_golden_tensor`); the hook fetches them every step and dumps
+`golden_values.npy` at train end, enabling data->checkpoint regression
+tests via numpy comparison against stored goldens
+(reference utils/t2r_test_fixture.py:142-195).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Dict, List
+
+import jax
+import numpy as np
+
+from tensor2robot_tpu.config import configurable
+from tensor2robot_tpu.hooks.hook_builder import Hook, HookBuilder
+
+GOLDEN_PREFIX = "golden/"
+GOLDEN_VALUES_FILENAME = "golden_values.npy"
+
+
+def add_golden_tensor(metrics: Dict[str, Any], tensor, name: str) -> None:
+    """Tags `tensor` for golden capture (reference add_golden_tensor :37).
+    Call from model_train_fn on its metrics dict."""
+    metrics[GOLDEN_PREFIX + name] = tensor
+
+
+class GoldenValuesHook(Hook):
+    """Records tagged tensors every step; saves golden_values.npy at end
+    (reference GoldenValuesHook :42-68). Forces a host sync per step — a
+    test/debug harness, not a production hook."""
+
+    def __init__(self, log_directory: str):
+        self._log_directory = log_directory
+        self._measurements: List[Dict[str, np.ndarray]] = []
+
+    def after_step(self, ctx) -> None:
+        if not ctx.device_metrics:
+            return
+        golden = {
+            key[len(GOLDEN_PREFIX):]: np.asarray(jax.device_get(value))
+            for key, value in ctx.device_metrics.items()
+            if key.startswith(GOLDEN_PREFIX)
+        }
+        if golden:
+            self._measurements.append(golden)
+
+    def on_train_end(self, ctx) -> None:
+        os.makedirs(self._log_directory, exist_ok=True)
+        path = os.path.join(self._log_directory, GOLDEN_VALUES_FILENAME)
+        np.save(path, np.asarray(self._measurements, dtype=object))
+        logging.info(
+            "Saved %d golden-value steps to %s", len(self._measurements), path
+        )
+
+
+def load_golden_values(log_directory: str) -> List[Dict[str, np.ndarray]]:
+    """Loads the measurements list written by GoldenValuesHook."""
+    path = os.path.join(log_directory, GOLDEN_VALUES_FILENAME)
+    return list(np.load(path, allow_pickle=True))
+
+
+@configurable("GoldenValuesHookBuilder")
+class GoldenValuesHookBuilder(HookBuilder):
+    """Hook builder for generating golden values (reference :71-80)."""
+
+    def __init__(self, log_directory: str = ""):
+        self._log_directory = log_directory
+
+    def create_hooks(self, t2r_model, trainer=None) -> List[Hook]:
+        log_directory = self._log_directory
+        return [GoldenValuesHook(log_directory)]

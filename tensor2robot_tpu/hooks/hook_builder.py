@@ -30,7 +30,8 @@ class HookContext:
     model_dir: Optional[str] = None
     step: int = 0
     state: Any = None  # TrainState (device arrays; fetch lazily!)
-    metrics: Optional[Dict[str, float]] = None
+    metrics: Optional[Dict[str, float]] = None  # host floats, log steps only
+    device_metrics: Optional[Dict[str, Any]] = None  # every step, on device
     eval_metrics: Optional[Dict[str, float]] = None
     checkpoint_path: Optional[str] = None
     eval_name: Optional[str] = None

@@ -1,0 +1,56 @@
+"""TD3 export hooks: latest + lagged serving directories.
+
+Behavioral reference: tensor2robot/hooks/td3.py:36-131 (`TD3Hooks`): the
+periodic async export additionally maintains a `lagged_export_dir` one
+version behind — the target network of TD3 (arXiv:1802.09477) realized as
+a pair of serving-artifact directories robots poll.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from tensor2robot_tpu.config import configurable
+from tensor2robot_tpu.hooks.async_export_hook_builder import (
+    AsyncExportHook,
+    AsyncExportHookBuilder,
+)
+from tensor2robot_tpu.hooks.checkpoint_hooks import LaggedCheckpointListener
+
+
+@configurable("TD3Hooks")
+class TD3Hooks(AsyncExportHookBuilder):
+    """Periodic export into (latest, lagged) directory pair
+    (reference TD3Hooks :36-131)."""
+
+    def __init__(
+        self,
+        export_dir: str,
+        lagged_export_dir: str,
+        save_secs: float = 90.0,
+        num_versions: Optional[int] = 3,
+        export_generator=None,
+        warmup_batch_sizes: Sequence[int] = (),
+    ):
+        super().__init__(
+            export_dir=export_dir,
+            save_secs=save_secs,
+            num_versions=num_versions,
+            export_generator=export_generator,
+            warmup_batch_sizes=warmup_batch_sizes,
+        )
+        self._lagged_export_dir = lagged_export_dir
+
+    def create_hooks(self, t2r_model, trainer=None):
+        if not self._export_dir and not self._lagged_export_dir:
+            return []
+        state_export_fn = self._make_listener_and_state_fn(t2r_model, trainer)
+        listener = LaggedCheckpointListener(
+            export_fn=state_export_fn,
+            export_dir=self._export_dir,
+            lagged_export_dir=self._lagged_export_dir,
+            num_versions=self._num_versions,
+        )
+        return [
+            AsyncExportHook(listener, state_export_fn, self._save_secs)
+        ]

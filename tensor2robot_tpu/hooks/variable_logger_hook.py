@@ -1,0 +1,61 @@
+"""Variable statistics logging.
+
+Behavioral reference: tensor2robot/hooks/variable_logger_hook.py:28-80
+(`VariableLoggerHook` logs mean/std/values of every variable each run).
+Here the hook walks the TrainState's param pytree on log steps (per-step
+host syncs of every parameter would throttle the device loop).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List
+
+import jax
+import numpy as np
+
+from tensor2robot_tpu.config import configurable
+from tensor2robot_tpu.hooks.hook_builder import Hook, HookBuilder
+from tensor2robot_tpu.utils.keypath import path_string
+
+
+class VariableLoggerHook(Hook):
+    """Logs mean/std (optionally values) of all params
+    (reference :28-80)."""
+
+    def __init__(self, log_values: bool = False, every_steps: int = 100):
+        self._log_values = log_values
+        self._every_steps = max(1, every_steps)
+
+    def after_step(self, ctx) -> None:
+        if ctx.step % self._every_steps != 0 or ctx.state is None:
+            return
+        params = jax.device_get(ctx.state.params)
+
+        def log_leaf(path, leaf):
+            array = np.asarray(leaf)
+            message = (
+                f"step={ctx.step} var={path_string(path)} "
+                f"shape={array.shape} mean={array.mean():.6f} "
+                f"std={array.std():.6f}"
+            )
+            if self._log_values:
+                message += f" values={array!r}"
+            logging.info("%s", message)
+            return leaf
+
+        jax.tree_util.tree_map_with_path(log_leaf, params)
+
+
+@configurable("VariableLoggerHookBuilder")
+class VariableLoggerHookBuilder(HookBuilder):
+    def __init__(self, log_values: bool = False, every_steps: int = 100):
+        self._log_values = log_values
+        self._every_steps = every_steps
+
+    def create_hooks(self, t2r_model, trainer=None) -> List[Hook]:
+        return [
+            VariableLoggerHook(
+                log_values=self._log_values, every_steps=self._every_steps
+            )
+        ]

@@ -37,6 +37,18 @@ class PoseEnvRandomPolicy:
     def reset_task(self):
         pass
 
+    def restore(self, is_async: bool = False) -> bool:
+        """No weights to restore; always ready (collect_eval_loop
+        polls this before each cycle)."""
+        del is_async
+        return True
+
+    def init_randomly(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
     @property
     def global_step(self) -> int:
         return 0

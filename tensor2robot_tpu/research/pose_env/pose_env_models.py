@@ -138,12 +138,16 @@ class PoseEnvContinuousMCModel(CriticModel):
         return out
 
     def pack_features(self, state, context, timestep, actions):
-        """(obs, CEM action population) -> predict features
-        (reference :175-178)."""
+        """(obs, CEM action population) -> predict features in the CEM
+        megabatch layout: [1, ...] state + [1, N, 2] actions
+        (reference :175-178; the net's tiled branch scores all N at once)."""
         del context, timestep
+        actions = np.asarray(actions)
+        if actions.ndim == 2:
+            actions = actions[None, ...]
         return {
             "state/image": np.expand_dims(state, 0),
-            "action/pose": np.asarray(actions),
+            "action/pose": actions,
         }
 
 

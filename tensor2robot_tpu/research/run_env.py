@@ -11,7 +11,6 @@ from __future__ import annotations
 
 import dataclasses
 import logging
-import os
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import numpy as np

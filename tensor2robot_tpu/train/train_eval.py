@@ -301,7 +301,7 @@ def train_eval_model(
 
     hooks: List[Hook] = []
     for builder in hook_builders or []:
-        hooks.extend(builder.create_hooks(model, trainer=None))
+        hooks.extend(builder.create_hooks(model, trainer=compiled))
     ctx = HookContext(model=model, model_dir=model_dir, step=start_step,
                       state=state)
     for hook in hooks:
@@ -354,10 +354,14 @@ def train_eval_model(
             step += 1
             ctx.step = step
             ctx.state = state
+            # Full per-step metric tree as device arrays (hooks fetch
+            # lazily; golden-value capture reads non-scalar entries).
+            ctx.device_metrics = metrics
             if step % log_every_steps == 0 or step == max_train_steps:
                 host_metrics = {
                     key: float(value)
                     for key, value in jax.device_get(metrics).items()
+                    if getattr(value, "ndim", 0) == 0
                 }
                 now = time.time()
                 host_metrics["steps_per_sec"] = (

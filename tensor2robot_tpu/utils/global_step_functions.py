@@ -1,0 +1,61 @@
+"""Schedules of global_step usable as any scalar hyperparameter.
+
+Behavioral reference: tensor2robot/utils/global_step_functions.py:28-123
+(`piecewise_linear`, `exponential_decay`). The reference materialized the
+schedule as a graph tensor reading the global-step variable; here schedules
+are pure functions step -> value (optax-convention), gin-bindable as
+factories.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from tensor2robot_tpu.config import configurable
+
+
+@configurable("piecewise_linear")
+def piecewise_linear(
+    boundaries: Sequence[float], values: Sequence[float]
+) -> Callable:
+    """Linear interpolation through (boundaries, values) knots; clamped to
+    values[0] before the first boundary and values[-1] after the last
+    (reference piecewise_linear :28-96)."""
+    boundaries = np.asarray(boundaries, np.float32)
+    values = np.asarray(values, np.float32)
+    if boundaries.size == 0 or values.size == 0:
+        raise ValueError("Need more than 0 boundaries/values.")
+    if boundaries.size != values.size:
+        raise ValueError("boundaries and values must be of same size.")
+    if np.any(np.diff(boundaries) <= 0):
+        raise ValueError("boundaries must be strictly increasing.")
+
+    def schedule(step):
+        x = jnp.asarray(step, jnp.float32)
+        return jnp.interp(
+            x, jnp.asarray(boundaries), jnp.asarray(values)
+        )
+
+    return schedule
+
+
+@configurable("exponential_decay_value")
+def exponential_decay(
+    initial_value: float = 0.0001,
+    decay_steps: int = 10000,
+    decay_rate: float = 0.9,
+    staircase: bool = True,
+) -> Callable:
+    """initial_value * decay_rate ** (step / decay_steps)
+    (reference exponential_decay :99-123)."""
+
+    def schedule(step):
+        exponent = jnp.asarray(step, jnp.float32) / decay_steps
+        if staircase:
+            exponent = jnp.floor(exponent)
+        return initial_value * jnp.power(decay_rate, exponent)
+
+    return schedule

@@ -1,0 +1,109 @@
+"""Fixed-length random subsampling of padded sequences.
+
+Behavioral reference: tensor2robot/utils/subsample.py:23-191
+(`get_subsample_indices`, `get_subsample_indices_randomized_boundary`).
+Sampling always keeps the first and last valid frame; middle frames sample
+without replacement when the sequence is long enough, with replacement
+otherwise; min_length==1 picks one random frame.
+
+TPU notes: the reference's per-sequence tf.cond/map_fn becomes branchless
+masked sampling under vmap — one fused program with static shapes, no
+dynamic control flow.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _single_sequence_indices(
+    rng: jax.Array,
+    sequence_length: jax.Array,
+    min_length: int,
+    max_sequence_length: int,
+) -> jax.Array:
+    """Indices for one sequence; jit/vmap-safe (static min/max lengths)."""
+    sequence_length = sequence_length.astype(jnp.int32)
+    if min_length == 1:
+        u = jax.random.uniform(rng, (1,))
+        return jnp.floor(u * sequence_length).astype(jnp.int32)
+
+    num_middle = min_length - 2
+    rng_perm, rng_unif = jax.random.split(rng)
+
+    # Without replacement: the num_middle smallest-random-keyed positions of
+    # [1, seq_len-1) — a branchless random shuffle with invalid (padding)
+    # candidates pushed to +inf.
+    positions = jnp.arange(1, max_sequence_length + 1, dtype=jnp.int32)
+    valid = positions < sequence_length - 1
+    keys = jnp.where(
+        valid, jax.random.uniform(rng_perm, positions.shape), jnp.inf
+    )
+    order = jnp.argsort(keys)
+    middle_wo = jnp.sort(positions[order[:num_middle]])
+
+    # With replacement: uniform draws over [0, seq_len).
+    u = jax.random.uniform(rng_unif, (num_middle,))
+    middle_w = jnp.sort(jnp.floor(u * sequence_length).astype(jnp.int32))
+
+    middle = jnp.where(sequence_length >= min_length, middle_wo, middle_w)
+    first = jnp.zeros((1,), jnp.int32)
+    last = jnp.maximum(sequence_length - 1, 0)[None]
+    return jnp.concatenate([first, middle, last])
+
+
+def get_subsample_indices(
+    rng: jax.Array,
+    sequence_lengths: jax.Array,
+    min_length: int,
+    max_sequence_length: int = 512,
+) -> jax.Array:
+    """[B] lengths -> [B, min_length] subsample indices
+    (reference get_subsample_indices :23-79).
+
+    Args:
+      rng: random key.
+      sequence_lengths: [B] valid lengths (tensors are padded beyond them).
+      min_length: output frames per sequence; first/last always kept.
+      max_sequence_length: static bound on sequence length (sets the
+        candidate-buffer width; any padded batch length fits the default).
+    """
+    sequence_lengths = jnp.asarray(sequence_lengths)
+    rngs = jax.random.split(rng, sequence_lengths.shape[0])
+    return jax.vmap(
+        lambda r, n: _single_sequence_indices(
+            r, n, min_length, max_sequence_length
+        )
+    )(rngs, sequence_lengths)
+
+
+def get_subsample_indices_randomized_boundary(
+    rng: jax.Array,
+    sequence_lengths: jax.Array,
+    min_length: int,
+    min_delta_t: int,
+    max_delta_t: int,
+    max_sequence_length: int = 512,
+) -> jax.Array:
+    """Like get_subsample_indices but over a random [start, start+dt) window
+    of each sequence (reference :82-152)."""
+    sequence_lengths = jnp.asarray(sequence_lengths).astype(jnp.int32)
+
+    def one(rng, sequence_length):
+        rng_dt, rng_start, rng_sample = jax.random.split(rng, 3)
+        episode_delta_t = jax.random.randint(
+            rng_dt, (), min_delta_t, max_delta_t + 1
+        )
+        episode_delta_t = jnp.minimum(episode_delta_t, sequence_length)
+        episode_start = jax.random.randint(
+            rng_start, (), 0,
+            jnp.maximum(sequence_length - episode_delta_t + 1, 1),
+        )
+        window_indices = _single_sequence_indices(
+            rng_sample, episode_delta_t, min_length, max_sequence_length
+        )
+        return episode_start + window_indices
+
+    rngs = jax.random.split(rng, sequence_lengths.shape[0])
+    return jax.vmap(one)(rngs, sequence_lengths)

@@ -132,13 +132,42 @@ class TestPoseEnvModels:
         outputs, _ = model.inference_network_fn(variables, tiled, "predict")
         assert outputs["q_predicted"].shape == (2, 5)
 
-    def test_pack_features(self):
+    def test_pack_features_feeds_network(self):
         model = pose_env.PoseEnvContinuousMCModel(device_type="cpu")
         packed = model.pack_features(
             np.zeros((64, 64, 3), np.uint8), None, 0, np.zeros((7, 2))
         )
         assert packed["state/image"].shape == (1, 64, 64, 3)
-        assert packed["action/pose"].shape == (7, 2)
+        assert packed["action/pose"].shape == (1, 7, 2)
+        # The packed layout must run through the model's own network.
+        features = TensorSpecStruct()
+        features["state/image"] = packed["state/image"].astype(np.float32)
+        features["action/pose"] = packed["action/pose"].astype(np.float32)
+        variables = model.init_variables(jax.random.PRNGKey(0), features)
+        outputs, _ = model.inference_network_fn(
+            variables, features, "predict"
+        )
+        assert outputs["q_predicted"].shape == (1, 7)
+
+    def test_random_policy_collect_loop_interface(self, tmp_path):
+        # The shipped run_random_collect config path: collect_eval_loop
+        # calls restore()/init_randomly() on the random policy.
+        from tensor2robot_tpu.utils.continuous_collect_eval import (
+            collect_eval_loop,
+        )
+
+        policy = pose_env.PoseEnvRandomPolicy(seed=0)
+        final = collect_eval_loop(
+            root_dir=str(tmp_path),
+            policy=policy,
+            run_agent_fn=lambda env, policy, num_episodes, output_dir,
+            global_step: None,
+            collect_env=pose_env.PoseToyEnv(seed=0),
+            num_collect=1,
+            max_steps=0,
+            max_cycles=1,
+        )
+        assert final == 0
 
 
 class TestMamlPackFeatures:

@@ -1,0 +1,239 @@
+"""Utility tests: subsample, global-step schedules, image encoding, the
+T2R test fixture, and the gin-config smoke harness (reference
+utils/{subsample,global_step_functions}_test.py + t2r_test_fixture)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensor2robot_tpu.utils import (
+    global_step_functions,
+    image as image_lib,
+    subsample,
+    train_eval_test_utils,
+)
+from tensor2robot_tpu.utils.mocks import MockT2RModel
+from tensor2robot_tpu.utils.t2r_test_fixture import T2RModelFixture
+
+
+class TestSubsample:
+    def test_keeps_endpoints_and_sorted(self):
+        rng = jax.random.PRNGKey(0)
+        lengths = jnp.asarray([10, 7, 20])
+        indices = subsample.get_subsample_indices(rng, lengths, 5)
+        assert indices.shape == (3, 5)
+        for row, length in zip(np.asarray(indices), [10, 7, 20]):
+            assert row[0] == 0
+            assert row[-1] == length - 1
+            assert np.all(np.diff(row) >= 0)
+            assert np.all(row < length)
+
+    def test_without_replacement_when_long_enough(self):
+        rng = jax.random.PRNGKey(1)
+        indices = subsample.get_subsample_indices(
+            rng, jnp.asarray([50]), 10
+        )
+        row = np.asarray(indices[0])
+        # Middle indices unique (sampled without replacement).
+        assert len(set(row.tolist())) == 10
+
+    def test_with_replacement_for_short_sequences(self):
+        rng = jax.random.PRNGKey(2)
+        indices = subsample.get_subsample_indices(
+            rng, jnp.asarray([3]), 8
+        )
+        row = np.asarray(indices[0])
+        assert row[0] == 0 and row[-1] == 2
+        assert np.all(row < 3)
+
+    def test_min_length_one(self):
+        rng = jax.random.PRNGKey(3)
+        indices = subsample.get_subsample_indices(
+            rng, jnp.asarray([5, 9]), 1
+        )
+        assert indices.shape == (2, 1)
+        assert np.all(np.asarray(indices)[:, 0] < np.asarray([5, 9]))
+
+    def test_randomized_boundary_window(self):
+        rng = jax.random.PRNGKey(4)
+        indices = subsample.get_subsample_indices_randomized_boundary(
+            rng, jnp.asarray([30, 30]), 5, min_delta_t=8, max_delta_t=12
+        )
+        for row in np.asarray(indices):
+            assert np.all(np.diff(row) >= 0)
+            assert row[-1] - row[0] <= 12
+            assert np.all(row < 30)
+
+    def test_jittable(self):
+        fn = jax.jit(
+            lambda r, n: subsample.get_subsample_indices(r, n, 4)
+        )
+        out = fn(jax.random.PRNGKey(0), jnp.asarray([9, 12]))
+        assert out.shape == (2, 4)
+
+
+class TestGlobalStepFunctions:
+    def test_piecewise_linear_interpolation(self):
+        schedule = global_step_functions.piecewise_linear(
+            boundaries=[0, 10, 20], values=[1.0, 2.0, 0.0]
+        )
+        assert float(schedule(0)) == pytest.approx(1.0)
+        assert float(schedule(5)) == pytest.approx(1.5)
+        assert float(schedule(10)) == pytest.approx(2.0)
+        assert float(schedule(15)) == pytest.approx(1.0)
+        # Clamped outside the boundary range.
+        assert float(schedule(100)) == pytest.approx(0.0)
+
+    def test_piecewise_linear_validation(self):
+        with pytest.raises(ValueError, match="same size"):
+            global_step_functions.piecewise_linear([0, 1], [1.0])
+        with pytest.raises(ValueError, match="strictly increasing"):
+            global_step_functions.piecewise_linear([0, 0], [1.0, 2.0])
+
+    def test_exponential_decay_staircase(self):
+        schedule = global_step_functions.exponential_decay(
+            initial_value=1.0, decay_steps=10, decay_rate=0.5, staircase=True
+        )
+        assert float(schedule(9)) == pytest.approx(1.0)
+        assert float(schedule(10)) == pytest.approx(0.5)
+        smooth = global_step_functions.exponential_decay(
+            initial_value=1.0, decay_steps=10, decay_rate=0.5, staircase=False
+        )
+        assert 0.5 < float(smooth(5)) < 1.0
+
+
+class TestImage:
+    def test_numpy_to_jpeg_roundtrip(self):
+        array = (np.random.RandomState(0).rand(8, 8, 3) * 255).astype(
+            np.uint8
+        )
+        encoded = image_lib.numpy_to_image_string(array, "jpeg")
+        assert encoded[:2] == b"\xff\xd8"  # JPEG magic
+        png = image_lib.numpy_to_image_string(array, "png")
+        assert png[:4] == b"\x89PNG"
+        from PIL import Image
+        import io
+
+        decoded = np.asarray(Image.open(io.BytesIO(png)))
+        np.testing.assert_array_equal(decoded, array)
+
+
+class TestT2RModelFixture:
+    def test_random_train_and_predict(self, tmp_path):
+        fixture = T2RModelFixture()
+        model_dir = str(tmp_path / "run")
+        metrics = fixture.random_train(
+            MockT2RModel(device_type="cpu"), model_dir
+        )
+        train_eval_test_utils.assert_output_files(model_dir)
+        outputs = fixture.random_predict(
+            MockT2RModel(device_type="cpu"), model_dir
+        )
+        assert outputs["a_predicted"].shape == (2, 1)
+
+    def test_golden_roundtrip_detects_regression(self, tmp_path):
+        from tensor2robot_tpu.data.encoder import encode_example
+        from tensor2robot_tpu.data.tfrecord import write_tfrecords
+        from tensor2robot_tpu.hooks import add_golden_tensor
+        from tensor2robot_tpu.specs import TensorSpecStruct
+
+        class GoldenModel(MockT2RModel):
+            def model_train_fn(self, features, labels, outputs, mode):
+                loss, metrics = super().model_train_fn(
+                    features, labels, outputs, mode
+                )
+                add_golden_tensor(metrics, outputs["a_predicted"], "logits")
+                return loss, metrics
+
+        # One fixed record file.
+        model = GoldenModel(device_type="cpu")
+        spec = TensorSpecStruct()
+        for key, s in model.preprocessor.get_in_feature_specification(
+            "train"
+        ).items():
+            spec[f"features/{key}"] = s
+        for key, s in model.preprocessor.get_in_label_specification(
+            "train"
+        ).items():
+            spec[f"labels/{key}"] = s
+        rng = np.random.RandomState(0)
+        records = []
+        for _ in range(8):
+            values = TensorSpecStruct()
+            values["features/x"] = rng.rand(3).astype(np.float32)
+            values["labels/a_target"] = np.asarray(
+                [float(rng.rand() > 0.5)], np.float32
+            )
+            records.append(encode_example(spec, values))
+        record_path = str(tmp_path / "data.tfrecord")
+        write_tfrecords(record_path, records)
+
+        golden_path = str(tmp_path / "golden" / "golden_values.npy")
+        fixture = T2RModelFixture()
+        # First run writes the golden file; second compares and passes.
+        fixture.train_and_check_golden_predictions(
+            GoldenModel(device_type="cpu"), str(tmp_path / "run1"),
+            [record_path], golden_path,
+        )
+        fixture.train_and_check_golden_predictions(
+            GoldenModel(device_type="cpu"), str(tmp_path / "run2"),
+            [record_path], golden_path,
+        )
+        # A perturbed golden file must be detected.
+        golden = np.load(golden_path, allow_pickle=True)
+        golden[0]["logits"] = golden[0]["logits"] + 1.0
+        np.save(golden_path, golden)
+        with pytest.raises(AssertionError):
+            fixture.train_and_check_golden_predictions(
+                GoldenModel(device_type="cpu"), str(tmp_path / "run3"),
+                [record_path], golden_path,
+            )
+
+
+class TestGinConfigSmoke:
+    def test_pose_env_train_config_runs(self, tmp_path):
+        import glob as globlib
+
+        from tensor2robot_tpu import config as cfg
+        from tensor2robot_tpu.research import pose_env
+        from tensor2robot_tpu.research.run_env import run_env
+        from tensor2robot_tpu.utils.writer import TFRecordReplayWriter
+
+        env = pose_env.PoseToyEnv(seed=0)
+        policy = pose_env.PoseEnvRandomPolicy(seed=0)
+        writer = TFRecordReplayWriter()
+        run_env(
+            env, policy, num_episodes=12,
+            episode_to_transitions_fn=lambda ep: (
+                pose_env.episode_to_transitions_pose_toy(
+                    ep, binary_success_threshold=-2.0
+                )
+            ),
+            replay_writer=writer,
+            output_dir=str(tmp_path / "collect"),
+        )
+        shards = globlib.glob(str(tmp_path / "collect" / "*.tfrecord"))
+        config_path = os.path.join(
+            os.path.dirname(pose_env.__file__), "configs", "run_train_reg.gin"
+        )
+
+        def overwrites():
+            cfg.bind_macro("TRAIN_DATA", shards)
+            cfg.bind_macro("EVAL_DATA", shards)
+            cfg.bind_parameter(
+                "train_input_generator/DefaultRecordInputGenerator.batch_size",
+                4,
+            )
+            cfg.bind_parameter(
+                "eval_input_generator/DefaultRecordInputGenerator.batch_size",
+                4,
+            )
+            cfg.bind_parameter("PoseEnvRegressionModel.device_type", "cpu")
+
+        train_eval_test_utils.test_train_eval_gin(
+            str(tmp_path / "run"), config_path,
+            gin_overwrites_fn=overwrites,
+        )
