@@ -1,0 +1,165 @@
+"""Ring attention: sequence/context parallelism over the device mesh.
+
+Long-context support beyond anything in the reference (SURVEY §5 notes the
+reference's sequences are ~40 steps with no CP): Q/K/V are sharded along
+the sequence axis of the mesh; each device keeps its Q shard resident while
+K/V shards rotate around the ring via `ppermute` over ICI neighbors, and
+attention accumulates with the online-softmax (flash) recurrence — memory
+per device stays O(seq/devices), and the K/V transfer for step i+1 overlaps
+the compute of step i (XLA schedules the ppermute DMA concurrently with the
+einsums). Causal masking is block-structured: whole blocks strictly in the
+future are skipped analytically via masking (Liu et al., arXiv:2310.01889).
+
+Layout: [batch, seq, heads, dim], seq sharded over the mesh's 'sequence'
+axis. With a single-device sequence axis this degrades to plain (flash)
+attention — the sequence length lives in the specs, so a CP mesh axis
+slots in without touching model code (SURVEY §5 long-context row).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tensor2robot_tpu.parallel.mesh import SEQUENCE_AXIS
+
+_NEG_INF = -1e30
+
+
+def reference_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Plain full attention over [B, S, H, D] — the numerics oracle the
+    ring implementation must match."""
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        q_pos = jnp.arange(q.shape[1])
+        k_pos = jnp.arange(k.shape[1])
+        mask = q_pos[:, None] >= k_pos[None, :]
+        logits = jnp.where(mask[None, None], logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _block_attend(q, k_blk, v_blk, q_offset, k_offset, scale, causal):
+    """One (q-shard x k-block) tile: returns (o_partial, row_sum, row_max)
+    in the online-softmax decomposition."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk) * scale
+    if causal:
+        q_pos = q_offset + jnp.arange(q.shape[1])
+        k_pos = k_offset + jnp.arange(k_blk.shape[1])
+        mask = q_pos[:, None] >= k_pos[None, :]
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    m = jnp.max(s, axis=-1)  # [B, H, Sq]
+    p = jnp.exp(s - m[..., None])
+    # Fully-masked tiles: zero contribution, not exp(0)=1 garbage.
+    p = jnp.where((m == _NEG_INF)[..., None], 0.0, p)
+    l = jnp.sum(p, axis=-1)  # [B, H, Sq]
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, v_blk)
+    return o, l, m
+
+
+def _ring_shard_fn(q, k, v, axis_name: str, causal: bool, scale: float):
+    """Per-device body: q is resident; k/v circulate the ring."""
+    axis_size = lax.psum(1, axis_name)
+    my_index = lax.axis_index(axis_name)
+    block = q.shape[1]
+    q_offset = my_index * block
+
+    batch, _, heads, dim = q.shape
+    o_acc = jnp.zeros(q.shape, jnp.float32)
+    l_acc = jnp.zeros((batch, heads, block), jnp.float32)
+    m_acc = jnp.full((batch, heads, block), _NEG_INF, jnp.float32)
+    # Mark the device-local accumulators as varying over the ring axis so
+    # the fori_loop carry types line up with the axis-index-dependent
+    # updates (shard_map's varying-axes tracking).
+    if hasattr(lax, "pvary"):
+        o_acc, l_acc, m_acc = lax.pvary((o_acc, l_acc, m_acc), (axis_name,))
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+
+    def body(i, carry):
+        o_acc, l_acc, m_acc, k_blk, v_blk = carry
+        # Block i arrived from the device i hops ring-upstream.
+        src_index = (my_index - i) % axis_size
+        o_blk, l_blk, m_blk = _block_attend(
+            q, k_blk, v_blk, q_offset, src_index * block, scale, causal
+        )
+        # Online-softmax merge of the new tile into the running state.
+        m_new = jnp.maximum(m_acc, m_blk)
+        alpha = jnp.exp(m_acc - m_new)
+        beta = jnp.exp(m_blk - m_new)
+        l_new = l_acc * alpha + l_blk * beta
+        o_new = (
+            o_acc * jnp.transpose(alpha, (0, 2, 1))[..., None]
+            + o_blk.astype(jnp.float32)
+            * jnp.transpose(beta, (0, 2, 1))[..., None]
+        )
+        # Rotate K/V to the next device; XLA overlaps this DMA with the
+        # next iteration's einsums.
+        k_next = lax.ppermute(k_blk, axis_name, perm)
+        v_next = lax.ppermute(v_blk, axis_name, perm)
+        return o_new, l_new, m_new, k_next, v_next
+
+    o_acc, l_acc, m_acc, _, _ = lax.fori_loop(
+        0, axis_size, body, (o_acc, l_acc, m_acc, k, v)
+    )
+    l_acc = jnp.maximum(l_acc, 1e-30)
+    out = o_acc / jnp.transpose(l_acc, (0, 2, 1))[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    axis_name: str = SEQUENCE_AXIS,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Sequence-parallel attention over `mesh`'s `axis_name`.
+
+    Args:
+      q, k, v: [batch, seq, heads, dim]; seq must divide evenly by the
+        sequence-axis size.
+      mesh: the device mesh (axes from parallel.mesh.make_mesh).
+      axis_name: mesh axis carrying the sequence shards.
+      causal: apply causal masking over *global* positions.
+      scale: logit scale; defaults to dim ** -0.5.
+
+    Returns:
+      [batch, seq, heads, dim] attention output, sequence-sharded like q.
+    """
+    if q.ndim != 4:
+        raise ValueError(f"Expected [B, S, H, D], got {q.shape}")
+    axis_size = mesh.shape[axis_name]
+    if q.shape[1] % axis_size != 0:
+        raise ValueError(
+            f"Sequence length {q.shape[1]} must divide the {axis_name!r} "
+            f"axis size {axis_size}."
+        )
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    spec = P(None, axis_name, None, None)
+    fn = shard_map(
+        functools.partial(
+            _ring_shard_fn, axis_name=axis_name, causal=causal, scale=scale
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)

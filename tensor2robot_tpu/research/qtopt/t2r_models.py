@@ -46,13 +46,25 @@ TARGET_SHAPE = (472, 472)
 class DefaultGrasping44ImagePreprocessor(SpecTransformationPreprocessor):
     """512x640x3 uint8 jpeg source -> 472x472 crop (random for train, center
     otherwise) -> float [0,1] -> train-only photometric distortion
-    (reference t2r_models.py:241-307)."""
+    (reference t2r_models.py:241-307). For models configured with a smaller
+    `image_size`, the source keeps the reference's crop slack (+40 rows,
+    +168 cols)."""
+
+    def _target_shape(self):
+        model_image = self._model.get_feature_specification(
+            MODE_TRAIN
+        )["state/image"]
+        return tuple(model_image.shape[:2])
+
+    def _source_shape(self):
+        target = self._target_shape()
+        return (target[0] + 40, target[1] + 168, 3)
 
     def _transform_in_feature_specification(self, spec, mode):
         self.update_spec(
             spec,
             "state/image",
-            shape=INPUT_SHAPE,
+            shape=self._source_shape(),
             dtype=np.uint8,
             data_format="jpeg",
         )
@@ -60,13 +72,14 @@ class DefaultGrasping44ImagePreprocessor(SpecTransformationPreprocessor):
 
     def _preprocess_fn(self, features, labels, mode, rng):
         image = features.state.image
+        target_shape = self._target_shape()
         # No rng = no stochastic augmentation (deterministic center crop),
         # matching the framework-wide None-rng convention; silently reusing
         # a fixed key would repeat identical distortions every batch.
         if mode == MODE_TRAIN and rng is not None:
             rng_crop, rng_distort = jax.random.split(rng)
             image = image_transformations.random_crop_image_batch(
-                rng_crop, image, TARGET_SHAPE
+                rng_crop, image, target_shape
             )
             image = image.astype(jnp.float32) / 255.0
             image = image_transformations.apply_photometric_image_distortions(
@@ -74,7 +87,7 @@ class DefaultGrasping44ImagePreprocessor(SpecTransformationPreprocessor):
             )
         else:
             image = image_transformations.center_crop_image_batch(
-                image, TARGET_SHAPE
+                image, target_shape
             )
             image = image.astype(jnp.float32) / 255.0
         features.state.image = image
@@ -171,12 +184,19 @@ class Grasping44E2EOpenCloseTerminateGripperStatusHeightToBottom(
 ):
     """The e2e open/close/terminate/gripper-status/height-to-bottom critic
     (reference t2r_models.py:310-420): 472x472 image state + 10-dim action
-    in 7 named blocks."""
+    in 7 named blocks. `image_size` shrinks the state for debugging/dry
+    runs (the Grasping44 tail needs >= ~220px)."""
+
+    def __init__(self, image_size: Tuple[int, int] = (472, 472), **kwargs):
+        self._image_size = tuple(image_size)
+        super().__init__(**kwargs)
 
     def get_state_specification(self) -> TensorSpecStruct:
         return TensorSpecStruct(
             image=ExtendedTensorSpec(
-                shape=(472, 472, 3), dtype=np.float32, name="image_1"
+                shape=self._image_size + (3,),
+                dtype=np.float32,
+                name="image_1",
             )
         )
 
