@@ -7,15 +7,21 @@ vs_baseline reports measured MFU / 0.50.
 
 The flagship workload is the full-fidelity Grasping44 critic: 472x472x3
 images at the reference's default batch 64 (research/qtopt/t2r_models.py:41,
-77), bf16 forward via the TPU model wrapper, crops/distortions fused into
-the device step. FLOPs come from XLA's compiled cost analysis, peak from
-the device kind.
+77), bf16 forward via the TPU model wrapper (train_in_bfloat16 defaults ON),
+crops/distortions fused into the device step. FLOPs come from XLA's compiled
+cost analysis with an analytic conv-tower fallback; peak from the device
+kind.
+
+Hard failures emit a diagnostic JSON line (never a bare traceback) and exit
+nonzero; TPU backend bring-up is retried with backoff before giving up.
 """
 
 from __future__ import annotations
 
 import json
+import sys
 import time
+import traceback
 
 # Per-chip peak dense bf16 FLOPS by device kind.
 _PEAK_FLOPS = {
@@ -36,86 +42,204 @@ def _peak_flops(device) -> float:
     return _PEAK_FLOPS["cpu"]
 
 
+def _emit(payload: dict) -> None:
+    print(json.dumps(payload), flush=True)
+
+
+def _fail(
+    stage: str,
+    err: BaseException,
+    metric: str = "qtopt_critic_train_mfu_bs64_472px",
+) -> None:
+    _emit(
+        {
+            "metric": metric,
+            "value": 0.0,
+            "unit": "fraction_of_peak",
+            "vs_baseline": 0.0,
+            "error": f"{stage}: {type(err).__name__}: {err}",
+            "trace_tail": traceback.format_exc().strip().splitlines()[-3:],
+        }
+    )
+    sys.exit(1)
+
+
+def _probe_backend_subprocess(timeout: float) -> tuple[bool, str]:
+    """Checks backend bring-up in a child process with a hard timeout.
+
+    Round 1 died on its first device query (UNAVAILABLE during backend
+    setup), and bring-up has also been observed to HANG indefinitely —
+    an in-process jax.devices() call can neither be retried cleanly
+    (failures are memoized) nor interrupted, so the liveness check runs
+    out-of-process."""
+    import subprocess
+
+    # The TPU plugin on this image ignores the JAX_PLATFORMS env var (only
+    # jax.config.update bypasses it), so the probe applies it explicitly —
+    # otherwise a CPU-forced run would still touch the TPU tunnel.
+    try:
+        proc = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                "import os, jax\n"
+                "p = os.environ.get('JAX_PLATFORMS')\n"
+                "if p: jax.config.update('jax_platforms', p)\n"
+                "ds = jax.devices()\n"
+                "print(ds[0].platform, len(ds))",
+            ],
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return False, f"probe hung >{timeout:.0f}s"
+    if proc.returncode != 0:
+        tail = (proc.stderr or "").strip().splitlines()[-1:]
+        return False, f"probe rc={proc.returncode}: {' '.join(tail)}"
+    return True, proc.stdout.strip()
+
+
+def _init_devices(max_wait: float = 600.0, probe_timeout: float = 150.0):
+    """jax.devices() surviving slow, flaky, or hanging TPU bring-up."""
+    deadline = time.time() + max_wait
+    delay = 5.0
+    last_err = "no attempt made"
+    while True:
+        ok, detail = _probe_backend_subprocess(
+            min(probe_timeout, max(deadline - time.time(), 30.0))
+        )
+        if ok:
+            import os
+
+            import jax
+
+            platforms = os.environ.get("JAX_PLATFORMS")
+            if platforms:
+                jax.config.update("jax_platforms", platforms)
+            return jax.devices()
+        last_err = detail
+        if time.time() + delay > deadline:
+            break
+        print(
+            f"bench: backend unavailable ({detail}); retrying in {delay:.0f}s",
+            file=sys.stderr,
+        )
+        time.sleep(delay)
+        delay = min(delay * 2, 60.0)
+    raise RuntimeError(f"backend unavailable after {max_wait:.0f}s: {last_err}")
+
+
+def _analytic_train_flops(image_size, batch_size, num_convs=(6, 6, 3)) -> float:
+    """Fallback FLOPs estimate for one Grasping44 train step: summed conv
+    and dense MACs x2, x3 for forward+backward (standard 1:2 fwd:bwd)."""
+    h, w = image_size
+    flops = 0.0
+
+    def conv(h, w, cin, cout, k, stride=1):
+        nonlocal flops
+        h, w = -(-h // stride), -(-w // stride)
+        flops += 2.0 * batch_size * h * w * cout * k * k * cin
+        return h, w
+
+    h, w = conv(h, w, 3, 64, 6, 2)
+    h, w = -(-h // 3), -(-w // 3)
+    for _ in range(num_convs[0]):
+        h, w = conv(h, w, 64, 64, 5)
+    h, w = -(-h // 3), -(-w // 3)
+    for _ in range(num_convs[1]):
+        h, w = conv(h, w, 64, 64, 3)
+    h, w = -(-h // 2), -(-w // 2)
+    for _ in range(num_convs[2]):
+        h, w = h - 2, w - 2
+        flops += 2.0 * batch_size * h * w * 64 * 9 * 64
+    # Dense head (grasp-param blocks + fc tail) is negligible next to the
+    # conv tower but counted for completeness.
+    flops += 2.0 * batch_size * (10 * 256 + 256 * 64 + h * w * 64 * 64 + 64 * 64 + 64)
+    return flops * 3.0
+
+
 def main() -> None:
+    import os
+
+    try:
+        devices = _init_devices(
+            max_wait=float(os.environ.get("BENCH_BACKEND_WAIT", "240"))
+        )
+    except Exception as err:
+        _fail("backend_init", err)
+
     import jax
     import numpy as np
 
-    from tensor2robot_tpu.research.qtopt.t2r_models import (
-        Grasping44E2EOpenCloseTerminateGripperStatusHeightToBottom,
-    )
-    from tensor2robot_tpu.specs import make_random_numpy
-    from tensor2robot_tpu.train.train_eval import (
-        CompiledModel,
-        maybe_wrap_for_tpu,
-    )
-
-    batch_size = 64  # reference default (research/qtopt/t2r_models.py:77)
-    model = maybe_wrap_for_tpu(
-        Grasping44E2EOpenCloseTerminateGripperStatusHeightToBottom(
-            device_type="tpu", batch_size=batch_size
-        )
-    )
-    compiled = CompiledModel(model, donate_state=False)
-    features = make_random_numpy(
-        compiled.preprocessor.get_in_feature_specification("train"),
-        batch_size=batch_size,
-    )
-    batch = {
-        "features": features,
-        "labels": {"reward": np.ones((batch_size, 1), np.float32)},
-    }
-    state = compiled.init_state(jax.random.PRNGKey(0), batch)
-    sharded = compiled.shard_batch(batch)
-    rng = jax.random.PRNGKey(1)
-
-    # Warmup/compile, then read XLA's FLOP estimate for the step.
-    state, metrics = compiled.train_step(state, sharded, rng)
-    jax.block_until_ready((state, metrics))
-    try:
-        cost = compiled.train_step.lower(state, sharded, rng).compile()
-        flops_per_step = float(cost.cost_analysis()["flops"])
-    except Exception:
-        flops_per_step = 0.0
-
-    steps = 50
-    start = time.perf_counter()
-    for _ in range(steps):
-        state, metrics = compiled.train_step(state, sharded, rng)
-    jax.block_until_ready((state, metrics))
-    elapsed = time.perf_counter() - start
-    steps_per_sec = steps / elapsed
-
-    device = jax.devices()[0]
-    peak = _peak_flops(device)
-    if flops_per_step > 0:
-        mfu = flops_per_step * steps_per_sec / peak
-        print(
-            json.dumps(
-                {
-                    "metric": "qtopt_critic_train_mfu_bs64_472px",
-                    "value": round(mfu, 4),
-                    "unit": "fraction_of_peak",
-                    "vs_baseline": round(mfu / 0.50, 4),
-                    "detail": {
-                        "steps_per_sec": round(steps_per_sec, 3),
-                        "flops_per_step": flops_per_step,
-                        "device_kind": getattr(device, "device_kind", "?"),
-                        "peak_flops": peak,
-                    },
-                }
-            )
-        )
+    device = devices[0]
+    on_tpu = device.platform == "tpu"
+    # Full fidelity on the real chip; a reduced proxy keeps the metric
+    # defined (and the script testable) on CPU-only hosts.
+    if on_tpu:
+        image_size, num_convs, batch_size, steps = (472, 472), (6, 6, 3), 64, 50
+        metric = "qtopt_critic_train_mfu_bs64_472px"
     else:
-        print(
-            json.dumps(
-                {
-                    "metric": "qtopt_critic_train_steps_per_sec_bs64_472px",
-                    "value": round(steps_per_sec, 3),
-                    "unit": "steps/s",
-                    "vs_baseline": 1.0,
-                }
-            )
+        image_size, num_convs, batch_size, steps = (96, 96), (2, 2, 1), 8, 5
+        metric = "qtopt_critic_train_mfu_cpu_proxy"
+
+    try:
+        from __graft_entry__ import _flagship
+
+        from tensor2robot_tpu.train.train_eval import CompiledModel
+
+        # Same construction the driver's dryrun exercises — the bench must
+        # measure the workload the compile checks validate.
+        model, batch = _flagship(
+            image_size=image_size, batch_size=batch_size, num_convs=num_convs
         )
+        compiled = CompiledModel(model, donate_state=False)
+        state = compiled.init_state(jax.random.PRNGKey(0), batch)
+        sharded = compiled.shard_batch(batch)
+        rng = jax.random.PRNGKey(1)
+
+        # Warmup/compile, then read XLA's FLOP estimate for the step.
+        state, metrics = compiled.train_step(state, sharded, rng)
+        jax.block_until_ready((state, metrics))
+        flops_source = "xla_cost_analysis"
+        try:
+            cost = compiled.train_step.lower(state, sharded, rng).compile()
+            flops_per_step = float(cost.cost_analysis()["flops"])
+            if not np.isfinite(flops_per_step) or flops_per_step <= 0:
+                raise ValueError(f"bogus flops {flops_per_step}")
+        except Exception:
+            flops_per_step = _analytic_train_flops(
+                image_size, batch_size, num_convs
+            )
+            flops_source = "analytic"
+
+        start = time.perf_counter()
+        for _ in range(steps):
+            state, metrics = compiled.train_step(state, sharded, rng)
+        jax.block_until_ready((state, metrics))
+        elapsed = time.perf_counter() - start
+        steps_per_sec = steps / elapsed
+
+        peak = _peak_flops(device)
+        mfu = flops_per_step * steps_per_sec / peak
+        _emit(
+            {
+                "metric": metric,
+                "value": round(mfu, 4),
+                "unit": "fraction_of_peak",
+                "vs_baseline": round(mfu / 0.50, 4),
+                "detail": {
+                    "steps_per_sec": round(steps_per_sec, 3),
+                    "flops_per_step": flops_per_step,
+                    "flops_source": flops_source,
+                    "device_kind": getattr(device, "device_kind", "?"),
+                    "peak_flops": peak,
+                    "bf16_forward": True,
+                },
+            }
+        )
+    except Exception as err:
+        _fail("bench_run", err, metric=metric)
 
 
 if __name__ == "__main__":

@@ -3,9 +3,12 @@
 Decorates a T2RModel for TPU execution:
   * feature/label specs re-declare float32 as bfloat16 (the infeed contract),
   * the preprocessor is auto-wrapped with TPUPreprocessorWrapper,
-  * at the network boundary bf16 inputs are upcast to float32 unless
-    `train_in_bfloat16`, in which case the forward pass runs bf16 (params
-    stay float32; XLA keeps MXU matmuls in bf16 either way).
+  * `train_in_bfloat16` (default ON — the TPU-native policy, reference
+    models/tpu_model_wrapper.py:185-191 bfloat16_scope) keeps the network
+    inputs bf16 so dtype-following networks compute their matmuls/convs on
+    the MXU in bf16 with float32 master params and float32 losses; with it
+    off, bf16 inputs are upcast to float32 at the network boundary and the
+    whole forward runs full precision.
 
 What the reference additionally did here — CrossShardOptimizer wrapping and
 scaffold-deferred init (models/tpu_model_wrapper.py:45-49,236-278) — has no
@@ -36,7 +39,7 @@ from tensor2robot_tpu.specs import (
 class TPUT2RModelWrapper(AbstractT2RModel):
     """Wraps `model` with the TPU bf16 spec + activation policy."""
 
-    def __init__(self, model: AbstractT2RModel, train_in_bfloat16: bool = False):
+    def __init__(self, model: AbstractT2RModel, train_in_bfloat16: bool = True):
         super().__init__(device_type="tpu")
         self._model = model
         self._train_in_bfloat16 = train_in_bfloat16

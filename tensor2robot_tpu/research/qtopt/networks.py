@@ -51,9 +51,13 @@ class _ConvBNRelu(nn.Module):
     padding: str = "SAME"
     momentum: float = 0.997
     epsilon: float = 0.001
+    dtype: Optional[jnp.dtype] = None
 
     @nn.compact
     def __call__(self, x: jax.Array, is_training: bool) -> jax.Array:
+        # Conv computes in `dtype` (bf16 on the TPU forward path: params are
+        # cast for the MXU matmul, master copies stay f32); BatchNorm is left
+        # to promote to f32 so running statistics never accumulate in bf16.
         x = nn.Conv(
             self.features,
             self.kernel,
@@ -61,6 +65,7 @@ class _ConvBNRelu(nn.Module):
             padding=self.padding,
             use_bias=False,
             kernel_init=_CONV_INIT,
+            dtype=self.dtype,
         )(x)
         x = nn.BatchNorm(
             use_running_average=not is_training,
@@ -68,7 +73,8 @@ class _ConvBNRelu(nn.Module):
             epsilon=self.epsilon,
             use_scale=True,
         )(x)
-        return nn.relu(x)
+        x = nn.relu(x)
+        return x.astype(self.dtype) if self.dtype is not None else x
 
 
 class Grasping44(nn.Module):
@@ -105,6 +111,12 @@ class Grasping44(nn.Module):
             # Collapse [B, N, P] -> [B*N, P] megabatch.
             grasp_params = grasp_params.reshape(-1, grasp_params.shape[-1])
 
+        # Compute dtype follows the infeed: a bf16 image (the TPU wrapper's
+        # train_in_bfloat16 policy) makes every conv/dense MXU op compute in
+        # bf16 with f32 master params; f32 inputs keep the full-precision
+        # path. BatchNorm always promotes to f32 (see _ConvBNRelu).
+        dtype = jnp.bfloat16 if images.dtype == jnp.bfloat16 else None
+
         bn_kwargs = dict(
             use_running_average=not is_training,
             momentum=self.batch_norm_momentum,
@@ -115,7 +127,7 @@ class Grasping44(nn.Module):
         # (reference keeps scale=False on the standalone BNs, :444-458).
         net = nn.Conv(
             64, (6, 6), strides=(2, 2), padding="SAME", use_bias=False,
-            kernel_init=_CONV_INIT, name="conv1_1",
+            kernel_init=_CONV_INIT, name="conv1_1", dtype=dtype,
         )(images)
         net = nn.BatchNorm(use_scale=False, name="bn1", **bn_kwargs)(net)
         net = nn.relu(net)
@@ -127,6 +139,7 @@ class Grasping44(nn.Module):
                 momentum=self.batch_norm_momentum,
                 epsilon=self.batch_norm_epsilon,
                 name=f"conv{2 + i}",
+                dtype=dtype,
             )(net, is_training)
         net = nn.max_pool(net, (3, 3), strides=(3, 3), padding="SAME")
         end_points["pool2"] = net
@@ -140,7 +153,7 @@ class Grasping44(nn.Module):
         fcgrasp = None
         for name in sorted(blocks):
             offset, size = blocks[name]
-            piece = nn.Dense(256, kernel_init=_CONV_INIT, name=name)(
+            piece = nn.Dense(256, kernel_init=_CONV_INIT, name=name, dtype=dtype)(
                 grasp_params[:, offset : offset + size]
             )
             fcgrasp = piece if fcgrasp is None else fcgrasp + piece
@@ -148,11 +161,15 @@ class Grasping44(nn.Module):
             fcgrasp
         )
         fcgrasp = nn.relu(fcgrasp)
-        fcgrasp = nn.Dense(64, kernel_init=_CONV_INIT, name="fcgrasp2")(fcgrasp)
+        fcgrasp = nn.Dense(64, kernel_init=_CONV_INIT, name="fcgrasp2", dtype=dtype)(
+            fcgrasp
+        )
         fcgrasp = nn.BatchNorm(name="bn_fcgrasp2", **bn_kwargs)(fcgrasp)
         fcgrasp = nn.relu(fcgrasp)
         end_points["fcgrasp"] = fcgrasp
         context = fcgrasp.reshape(-1, 1, 1, 64)
+        if dtype is not None:
+            context = context.astype(dtype)
 
         if tile_batch:
             # Tile the *embedding* (not the raw image) to the megabatch:
@@ -167,6 +184,7 @@ class Grasping44(nn.Module):
                 momentum=self.batch_norm_momentum,
                 epsilon=self.batch_norm_epsilon,
                 name=f"conv{2 + self.num_convs[0] + i}",
+                dtype=dtype,
             )(net, is_training)
         net = nn.max_pool(net, (2, 2), strides=(2, 2), padding="SAME")
         for i in range(self.num_convs[2]):
@@ -175,6 +193,7 @@ class Grasping44(nn.Module):
                 momentum=self.batch_norm_momentum,
                 epsilon=self.batch_norm_epsilon,
                 name=f"conv{2 + sum(self.num_convs[:2]) + i}",
+                dtype=dtype,
             )(net, is_training)
         end_points["final_conv"] = net
 
@@ -189,13 +208,19 @@ class Grasping44(nn.Module):
             net = jnp.concatenate([net, jnp.tile(goal_vector, (reps, 1))], axis=1)
 
         for i in range(self.hid_layers):
-            net = nn.Dense(64, kernel_init=_CONV_INIT, name=f"fc{i}")(net)
+            net = nn.Dense(64, kernel_init=_CONV_INIT, name=f"fc{i}", dtype=dtype)(
+                net
+            )
             net = nn.BatchNorm(name=f"bn_fc{i}", **bn_kwargs)(net)
             net = nn.relu(net)
+            if dtype is not None:
+                net = net.astype(dtype)
 
+        # Logit head computes and emits float32: the loss-bearing scalar
+        # (and the sigmoid CEM objective) should not quantize to bf16.
         logits = nn.Dense(
             self.num_classes, kernel_init=_CONV_INIT, name="logit"
-        )(net)
+        )(net.astype(jnp.float32))
         end_points["logits"] = logits
         predictions = (
             jax.nn.softmax(logits) if softmax else jax.nn.sigmoid(logits)

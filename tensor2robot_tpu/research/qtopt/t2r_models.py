@@ -99,6 +99,7 @@ class _Grasping44Net(nn.Module):
     `__call__(packed_features, mode) -> outputs struct`."""
 
     grasp_param_blocks: Optional[Dict[str, Tuple[int, int]]] = None
+    num_convs: Tuple[int, int, int] = (6, 6, 3)
 
     @nn.compact
     def __call__(self, features, mode):
@@ -107,7 +108,9 @@ class _Grasping44Net(nn.Module):
         }
         grasp_params = concat_e2e_grasp_params(action)
         logits, end_points = Grasping44(
-            grasp_param_blocks=self.grasp_param_blocks, name="grasping44"
+            grasp_param_blocks=self.grasp_param_blocks,
+            num_convs=self.num_convs,
+            name="grasping44",
         )(
             features.state.image,
             grasp_params,
@@ -187,8 +190,14 @@ class Grasping44E2EOpenCloseTerminateGripperStatusHeightToBottom(
     in 7 named blocks. `image_size` shrinks the state for debugging/dry
     runs (the Grasping44 tail needs >= ~220px)."""
 
-    def __init__(self, image_size: Tuple[int, int] = (472, 472), **kwargs):
+    def __init__(
+        self,
+        image_size: Tuple[int, int] = (472, 472),
+        num_convs: Tuple[int, int, int] = (6, 6, 3),
+        **kwargs,
+    ):
         self._image_size = tuple(image_size)
+        self._num_convs = tuple(num_convs)
         super().__init__(**kwargs)
 
     def get_state_specification(self) -> TensorSpecStruct:
@@ -217,4 +226,7 @@ class Grasping44E2EOpenCloseTerminateGripperStatusHeightToBottom(
         )
 
     def create_network(self) -> nn.Module:
-        return _Grasping44Net(grasp_param_blocks=E2E_GRASP_PARAM_BLOCKS)
+        return _Grasping44Net(
+            grasp_param_blocks=E2E_GRASP_PARAM_BLOCKS,
+            num_convs=self._num_convs,
+        )

@@ -159,6 +159,15 @@ class CompiledModel:
             rng=jax.random.PRNGKey(0),
         )
         state = create_train_state(self.model, rng, features, self.optimizer)
+        if self.mesh.shape[mesh_lib.FSDP_AXIS] > 1:
+            # FSDP regime: large parameter (and mirrored optimizer/EMA)
+            # leaves sharded over the fsdp axis; small leaves replicated.
+            # GSPMD propagates these shardings through the elementwise
+            # optimizer update, so params stay sharded across steps.
+            rule = mesh_lib.fsdp_param_sharding(self.mesh)
+            return jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, rule(x)), state
+            )
         # Replicate onto the mesh so jitted steps see mesh-placed inputs.
         replicated = mesh_lib.replicated(self.mesh)
         return jax.tree_util.tree_map(

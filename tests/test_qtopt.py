@@ -215,6 +215,67 @@ class TestGrasping44Model:
         out_eval, _ = pre.preprocess(features, None, mode="eval")
         assert out_eval["state/image"].shape == (2, 472, 472, 3)
 
+    def test_bf16_forward_matches_f32(self):
+        """bf16 forward (the TPU wrapper's default policy) stays within
+        bf16 tolerance of the f32 forward on identical params — the
+        numerics gate for train_in_bfloat16=True (reference bfloat16_scope,
+        models/tpu_model_wrapper.py:185-191)."""
+        net = Grasping44(
+            grasp_param_blocks=E2E_GRASP_PARAM_BLOCKS, num_convs=(2, 2, 1)
+        )
+        rng = np.random.RandomState(0)
+        images = jnp.asarray(rng.rand(2, 96, 96, 3), jnp.float32)
+        grasp_params = jnp.asarray(rng.randn(2, 10), jnp.float32)
+        variables = net.init(
+            jax.random.PRNGKey(0), images, grasp_params, is_training=False
+        )
+        _, ep_f32 = net.apply(variables, images, grasp_params, is_training=False)
+        logits_bf16, ep_bf16 = net.apply(
+            variables,
+            images.astype(jnp.bfloat16),
+            grasp_params.astype(jnp.bfloat16),
+            is_training=False,
+        )
+        # The logit head always computes/emits f32 (loss stability).
+        assert logits_bf16.dtype == jnp.float32
+        np.testing.assert_allclose(
+            np.asarray(ep_bf16["predictions"]),
+            np.asarray(ep_f32["predictions"]),
+            atol=0.02,
+        )
+
+    def test_tpu_wrapper_defaults_to_bf16_forward(self):
+        from tensor2robot_tpu.models.tpu_model_wrapper import TPUT2RModelWrapper
+
+        model = Grasping44E2EOpenCloseTerminateGripperStatusHeightToBottom(
+            device_type="tpu", image_size=(96, 96), num_convs=(2, 2, 1)
+        )
+        wrapped = TPUT2RModelWrapper(model)
+        assert wrapped._train_in_bfloat16
+        # The infeed contract is bf16...
+        spec = wrapped.get_feature_specification("train")
+        assert spec["state/image"].dtype == jnp.bfloat16
+        features = make_random_numpy(
+            wrapped.preprocessor.get_in_feature_specification("train"),
+            batch_size=2,
+        )
+        pre_features, _ = wrapped.preprocessor.preprocess(
+            features, None, mode="eval"
+        )
+        assert pre_features["state/image"].dtype == jnp.bfloat16
+        variables = wrapped.init_variables(
+            jax.random.PRNGKey(0),
+            pre_features,
+        )
+        # ...while params stay float32 masters and outputs serve f32.
+        kernel = variables["params"]["grasping44"]["conv1_1"]["kernel"]
+        assert kernel.dtype == jnp.float32
+        _, _, outputs, _ = wrapped.packed_inference(
+            variables, pre_features, "eval"
+        )
+        export = wrapped.create_export_outputs_fn(pre_features, outputs)
+        assert export["q_predicted"].dtype == jnp.float32
+
     @pytest.mark.slow
     def test_train_step_and_tiled_predict(self):
         from tensor2robot_tpu.train.train_eval import CompiledModel
