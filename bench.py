@@ -159,6 +159,99 @@ def _analytic_train_flops(image_size, batch_size, num_convs=(6, 6, 3)) -> float:
     return flops * 3.0
 
 
+def bench_data() -> None:
+    """Input-pipeline throughput: records/sec + images/sec for the QT-Opt
+    spec (512x640 jpeg), batch 64, through the parallel parse pipeline.
+
+    Invoked as `python bench.py data`. Emits one JSON line; vs_baseline
+    compares pipeline images/sec against the batch rate a 50%-MFU TPU step
+    would demand (the pipeline must outrun the chip to keep it fed).
+    """
+    import os
+    import tempfile
+
+    import numpy as np
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    metric = "qtopt_input_pipeline_images_per_sec"
+    try:
+        from tensor2robot_tpu.data import tfrecord
+        from tensor2robot_tpu.data.dataset import (
+            RecordDataset,
+            default_parse_workers,
+        )
+        from tensor2robot_tpu.data.encoder import encode_example
+        from tensor2robot_tpu.research.qtopt.t2r_models import (
+            Grasping44E2EOpenCloseTerminateGripperStatusHeightToBottom,
+        )
+        from tensor2robot_tpu.specs import make_random_numpy
+
+        model = Grasping44E2EOpenCloseTerminateGripperStatusHeightToBottom(
+            device_type="cpu"
+        )
+        specs = {
+            "features": model.preprocessor.get_in_feature_specification("train"),
+            "labels": model.preprocessor.get_in_label_specification("train"),
+        }
+        n_records, batch_size = 256, 64
+        rng_values = make_random_numpy(specs, batch_size=n_records, seed=0)
+        with tempfile.TemporaryDirectory() as tmp:
+            path = os.path.join(tmp, "bench.tfrecord")
+            records = []
+            for i in range(n_records):
+                row = {
+                    key: np.asarray(value[i])
+                    for key, value in rng_values.items()
+                }
+                records.append(encode_example(specs, row))
+            tfrecord.write_tfrecords(path, records)
+
+            dataset = RecordDataset(
+                specs=specs,
+                file_patterns=path,
+                batch_size=batch_size,
+                mode="train",
+                shuffle_buffer_size=128,
+                seed=1,
+            )
+            it = iter(dataset)
+            next(it)  # spin up pool + warm caches
+            n_batches = 24
+            start = time.perf_counter()
+            for _ in range(n_batches):
+                next(it)
+            elapsed = time.perf_counter() - start
+
+        records_per_sec = n_batches * batch_size / elapsed
+        # Count decoded images per record from the spec.
+        flat = model.preprocessor.get_in_feature_specification("train")
+        n_images = sum(
+            1 for s in flat.values() if getattr(s, "data_format", None)
+        )
+        images_per_sec = records_per_sec * max(n_images, 1)
+        # A 50%-MFU step on v5e consumes ~2.3 batches/sec at bs64 (from the
+        # analytic FLOPs of the full tower): the demand the pipeline must meet.
+        step_flops = _analytic_train_flops((472, 472), 64)
+        demand = 0.50 * _PEAK_FLOPS["TPU v5e"] / step_flops * batch_size
+        _emit(
+            {
+                "metric": metric,
+                "value": round(images_per_sec, 2),
+                "unit": "images_per_sec",
+                "vs_baseline": round(images_per_sec / demand, 4),
+                "detail": {
+                    "records_per_sec": round(records_per_sec, 2),
+                    "batch_size": batch_size,
+                    "parse_workers": default_parse_workers(),
+                    "host_cpus": os.cpu_count(),
+                    "demand_images_per_sec_at_50pct_mfu": round(demand, 2),
+                },
+            }
+        )
+    except Exception as err:
+        _fail("bench_data", err, metric=metric)
+
+
 def main() -> None:
     import os
 
@@ -243,4 +336,7 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "data":
+        bench_data()
+    else:
+        main()

@@ -1,0 +1,103 @@
+"""Image (de)compression maps for replay-buffer bandwidth.
+
+Replay buffers and episode shards carry camera images; storing them as raw
+uint8 wastes ~20x the bandwidth of jpeg. These maps convert between decoded
+image tensors and their encoded byte strings inside a batch structure, the
+rebuild of the reference's create_compress_fn / create_decompress_fn
+(tensor2robot/utils/tfdata.py:546-588) — there implemented as tf.data maps
+over tf.image.encode/decode_jpeg, here as numpy/PIL batch maps usable on
+either side of the host pipeline.
+
+The maps are spec-driven like everything else: only specs declaring
+`data_format` in {jpeg, png} participate; all other entries pass through
+untouched.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Union
+
+import numpy as np
+
+from tensor2robot_tpu.data.encoder import encode_image
+from tensor2robot_tpu.data.parser import decode_image
+from tensor2robot_tpu.specs import (
+    ExtendedTensorSpec,
+    TensorSpecStruct,
+    flatten_spec_structure,
+)
+
+
+def _image_specs(specs) -> Dict[str, ExtendedTensorSpec]:
+    out = {}
+    for key, spec in flatten_spec_structure(specs).items():
+        if isinstance(spec, ExtendedTensorSpec) and spec.data_format is not None:
+            out[key] = spec
+    return out
+
+
+def create_compress_fn(specs, quality: int = 95):
+    """Returns a batch map replacing decoded image tensors with encoded bytes.
+
+    The leading dims (batch, optional stack) are preserved: an entry of shape
+    [B, H, W, C] becomes a [B] list of byte strings; [B, S, H, W, C] becomes
+    a [B] list of [S] lists. Mirrors reference create_compress_fn
+    (utils/tfdata.py:546-566).
+    """
+    image_specs = _image_specs(specs)
+
+    def compress(batch) -> TensorSpecStruct:
+        out = TensorSpecStruct()
+        for key, value in batch.items():
+            spec = image_specs.get(key)
+            if spec is None:
+                out[key] = value
+                continue
+            arr = np.asarray(value)
+            if arr.ndim == 5:  # [B, S, H, W, C] image stacks
+                out[key] = [
+                    [encode_image(frame, spec.data_format, quality) for frame in row]
+                    for row in arr
+                ]
+            elif arr.ndim == 4:  # [B, H, W, C]
+                out[key] = [
+                    encode_image(img, spec.data_format, quality) for img in arr
+                ]
+            else:
+                raise ValueError(
+                    f"Cannot compress {key!r} of rank {arr.ndim}; expected a "
+                    "batched image [B,H,W,C] or stack [B,S,H,W,C]"
+                )
+        return out
+
+    return compress
+
+
+def create_decompress_fn(specs):
+    """Returns a batch map decoding byte strings back to the spec's image
+    tensors (reference create_decompress_fn, utils/tfdata.py:568-588)."""
+    image_specs = _image_specs(specs)
+
+    def decompress(batch) -> TensorSpecStruct:
+        out = TensorSpecStruct()
+        for key, value in batch.items():
+            spec = image_specs.get(key)
+            if spec is None:
+                out[key] = value
+                continue
+            if isinstance(value, np.ndarray) and value.dtype != object:
+                out[key] = value  # already decoded
+                continue
+            rows: Union[List[bytes], List[List[bytes]]] = value
+            decoded = []
+            for row in rows:
+                if isinstance(row, (bytes, bytearray)):
+                    decoded.append(decode_image(bytes(row), spec))
+                else:
+                    decoded.append(
+                        np.stack([decode_image(bytes(f), spec) for f in row])
+                    )
+            out[key] = np.stack(decoded)
+        return out
+
+    return decompress

@@ -19,7 +19,9 @@ Pipeline semantics preserved from the reference:
 
 from __future__ import annotations
 
+import concurrent.futures
 import itertools
+import os
 import queue
 import random
 import threading
@@ -140,6 +142,69 @@ class _Prefetcher:
         return item
 
 
+def default_parse_workers() -> int:
+    """Default parse parallelism: one worker per core, capped.
+
+    The AUTOTUNE analogue for the parse/decode stage (reference
+    utils/tfdata.py:630-689 used num_parallel_calls=AUTOTUNE). Overridable
+    via T2R_PARSE_WORKERS; 0 disables the pool (synchronous parse).
+    """
+    env = os.environ.get("T2R_PARSE_WORKERS")
+    if env is not None:
+        return max(0, int(env))
+    return min(8, os.cpu_count() or 1)
+
+
+class _ParallelBatcher:
+    """Ordered parallel parse: N batches in flight across a thread pool.
+
+    Record chunks are submitted to a ThreadPoolExecutor and results are
+    yielded in submission order, keeping up to `max_in_flight` parse jobs
+    running ahead of the consumer. Parsing a batch is dominated by jpeg
+    decode (PIL releases the GIL in its decoder) and numpy copies, so
+    threads scale on multi-core hosts without pickling batches across
+    processes. This is the rebuild of tf.data's parallel parse/decode maps
+    (reference utils/tfdata.py:630-689, num_parallel_calls=AUTOTUNE).
+    """
+
+    def __init__(
+        self,
+        chunks: Iterator,
+        parse_fn: Callable,
+        num_workers: int,
+        max_in_flight: Optional[int] = None,
+    ):
+        self._chunks = chunks
+        self._parse_fn = parse_fn
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=num_workers, thread_name_prefix="t2r-parse"
+        )
+        self._in_flight: "queue.Queue" = queue.Queue()
+        self._max_in_flight = max_in_flight or num_workers + 2
+        self._exhausted = False
+
+    def _submit_one(self) -> bool:
+        try:
+            chunk = next(self._chunks)
+        except StopIteration:
+            self._exhausted = True
+            return False
+        self._in_flight.put(self._pool.submit(self._parse_fn, chunk))
+        return True
+
+    def __iter__(self):
+        try:
+            while not self._exhausted and self._in_flight.qsize() < self._max_in_flight:
+                self._submit_one()
+            while not self._in_flight.empty():
+                future = self._in_flight.get()
+                if not self._exhausted:
+                    self._submit_one()
+                yield future.result()
+        finally:
+            self._pool.shutdown(wait=False, cancel_futures=True)
+
+
 class RecordDataset:
     """Iterable of parsed, batched TensorSpecStruct numpy batches.
 
@@ -156,6 +221,8 @@ class RecordDataset:
       prefetch_depth: parsed batches buffered ahead by a background thread.
       file_fraction: use only the first fraction of files (data-ablation,
         reference FractionalRecordInputGenerator).
+      num_parse_workers: thread-pool size for parallel proto-parse and
+        jpeg decode; None -> default_parse_workers(), 0 -> synchronous.
     """
 
     def __init__(
@@ -171,6 +238,7 @@ class RecordDataset:
         cycle_length: int = 4,
         drop_remainder: bool = True,
         file_fraction: float = 1.0,
+        num_parse_workers: Optional[int] = None,
     ):
         self._parser = SpecParser(specs)
         self._batch_size = batch_size
@@ -181,6 +249,11 @@ class RecordDataset:
         self._prefetch_depth = prefetch_depth
         self._cycle_length = cycle_length
         self._drop_remainder = drop_remainder
+        self._num_parse_workers = (
+            default_parse_workers()
+            if num_parse_workers is None
+            else num_parse_workers
+        )
 
         if isinstance(file_patterns, Mapping):
             self._files: Dict[str, List[str]] = {
@@ -249,26 +322,38 @@ class RecordDataset:
             records = _shuffle_records(records, self._shuffle_buffer_size, rng)
         return records
 
-    def __iter__(self) -> Iterator[TensorSpecStruct]:
-        def batches() -> Iterator[TensorSpecStruct]:
-            stream = self._record_stream()
-            while True:
-                chunk = list(itertools.islice(stream, self._batch_size))
-                if not chunk:
-                    return
-                if len(chunk) < self._batch_size and self._drop_remainder:
-                    return
-                if isinstance(chunk[0], dict):
-                    by_key = {
-                        k: [row[k] for row in chunk] for k in chunk[0].keys()
-                    }
-                    yield self._parser.parse_batch(by_key)
-                else:
-                    yield self._parser.parse_batch(chunk)
+    def _chunks(self) -> Iterator:
+        stream = self._record_stream()
+        while True:
+            chunk = list(itertools.islice(stream, self._batch_size))
+            if not chunk:
+                return
+            if len(chunk) < self._batch_size and self._drop_remainder:
+                return
+            yield chunk
 
+    def _parse_chunk(self, chunk) -> TensorSpecStruct:
+        if isinstance(chunk[0], dict):
+            by_key = {k: [row[k] for row in chunk] for k in chunk[0].keys()}
+            return self._parser.parse_batch(by_key)
+        return self._parser.parse_batch(chunk)
+
+    def __iter__(self) -> Iterator[TensorSpecStruct]:
+        if self._num_parse_workers > 0:
+            batches: Iterator[TensorSpecStruct] = iter(
+                _ParallelBatcher(
+                    self._chunks(),
+                    self._parse_chunk,
+                    num_workers=self._num_parse_workers,
+                    max_in_flight=self._num_parse_workers
+                    + max(self._prefetch_depth, 1),
+                )
+            )
+        else:
+            batches = map(self._parse_chunk, self._chunks())
         if self._prefetch_depth > 0:
-            return iter(_Prefetcher(batches(), self._prefetch_depth))
-        return batches()
+            return iter(_Prefetcher(batches, self._prefetch_depth))
+        return batches
 
 
 class GeneratorDataset:

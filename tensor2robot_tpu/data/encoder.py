@@ -22,7 +22,7 @@ from tensor2robot_tpu.specs import (
 )
 
 
-def encode_image(array: np.ndarray, data_format: str) -> bytes:
+def encode_image(array: np.ndarray, data_format: str, quality: int = 95) -> bytes:
     from PIL import Image
 
     arr = np.asarray(array)
@@ -30,7 +30,10 @@ def encode_image(array: np.ndarray, data_format: str) -> bytes:
         arr = arr[..., 0]
     img = Image.fromarray(arr)
     buf = io.BytesIO()
-    img.save(buf, format="JPEG" if data_format.lower() == "jpeg" else "PNG")
+    if data_format.lower() in ("jpeg", "jpg"):
+        img.save(buf, format="JPEG", quality=quality)
+    else:
+        img.save(buf, format="PNG")
     return buf.getvalue()
 
 

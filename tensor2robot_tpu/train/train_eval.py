@@ -19,6 +19,7 @@ utils/train_eval.py:423-612 (TPUEstimator + train_and_evaluate):
 
 from __future__ import annotations
 
+import itertools
 import os
 import time
 from typing import Any, Callable, Dict, Iterator, List, Optional
@@ -39,6 +40,7 @@ from tensor2robot_tpu.models.abstract_model import (
 from tensor2robot_tpu.models.tpu_model_wrapper import TPUT2RModelWrapper
 from tensor2robot_tpu.parallel import mesh as mesh_lib
 from tensor2robot_tpu.specs import TensorSpecStruct, make_example_args
+from tensor2robot_tpu.train import infeed
 from tensor2robot_tpu.train.metrics import MetricsWriter
 from tensor2robot_tpu.train.state import TrainState, create_train_state, update_ema
 
@@ -142,8 +144,19 @@ class CompiledModel:
             )
             return model.create_export_outputs_fn(f, outputs)
 
+        def train_scan(state: TrainState, stacked_batch, rng):
+            """K train steps under one dispatch: lax.scan over the leading
+            [K, B, ...] axis (the iterations_per_loop equivalent — reference
+            models/abstract_model.py:76-77 TPUConfig.iterations_per_loop)."""
+            return jax.lax.scan(
+                lambda s, b: train_step(s, b, rng), state, stacked_batch
+            )
+
         self.train_step = jax.jit(
             train_step, donate_argnums=(0,) if donate_state else ()
+        )
+        self.train_scan = jax.jit(
+            train_scan, donate_argnums=(0,) if donate_state else ()
         )
         self.eval_step = jax.jit(eval_step, static_argnums=(2,))
         self.predict_step = jax.jit(predict_step)
@@ -259,11 +272,19 @@ def train_eval_model(
     seed: int = 0,
     use_ema_for_eval: Optional[bool] = None,
     use_tensorboard: Optional[bool] = None,
+    iterations_per_loop: int = 1,
+    infeed_depth: int = 2,
 ) -> Dict[str, float]:
     """Trains (and periodically evaluates/exports) the model.
 
     Returns the final eval metrics (empty dict when no eval generator).
     Resumes from the latest checkpoint in model_dir if present.
+
+    iterations_per_loop > 1 runs K device steps per host dispatch via a
+    jitted lax.scan (reference TPUConfig.iterations_per_loop); per-step
+    hooks then observe loop granularity, exactly as reference SessionRunHooks
+    did under TPUEstimator. infeed_depth batches are kept device-resident
+    ahead of the consumer (double-buffered host->device transfer).
     """
     model = maybe_wrap_for_tpu(t2r_model)
     print_specification(model)
@@ -347,53 +368,113 @@ def train_eval_model(
             hook.after_eval(ctx)
         return eval_metrics
 
-    pending_batch = first_batch
     final_eval: Dict[str, float] = {}
     step = start_step
     t_last = time.time()
+    host_batches = itertools.chain([first_batch], train_batches)
+
+    def log_metrics(step: int, metrics, n_steps: int) -> Dict[str, float]:
+        nonlocal t_last
+        host_metrics = {
+            key: float(value)
+            for key, value in jax.device_get(metrics).items()
+            if getattr(value, "ndim", 0) == 0
+        }
+        now = time.time()
+        host_metrics["steps_per_sec"] = n_steps / max(now - t_last, 1e-9)
+        t_last = now
+        writer.write(step, host_metrics)
+        return host_metrics
+
+    def checkpoint_and_eval(state, step: int) -> Dict[str, float]:
+        manager.save(step, args=ocp.args.StandardSave(state), force=True)
+        manager.wait_until_finished()
+        ctx.checkpoint_path = str(
+            os.path.join(model_dir, "checkpoints", str(step))
+        )
+        for hook in hooks:
+            hook.after_checkpoint_saved(ctx)
+        return run_eval_and_export(state, step)
+
     try:
-        while step < max_train_steps:
-            batch = pending_batch if pending_batch is not None else next(train_batches)
-            pending_batch = None
-            batch = compiled.shard_batch(batch)
-            ctx.step = step
-            for hook in hooks:
-                hook.before_step(ctx)
-            state, metrics = compiled.train_step(state, batch, rng_train)
-            step += 1
-            ctx.step = step
-            ctx.state = state
-            # Full per-step metric tree as device arrays (hooks fetch
-            # lazily; golden-value capture reads non-scalar entries).
-            ctx.device_metrics = metrics
-            if step % log_every_steps == 0 or step == max_train_steps:
-                host_metrics = {
-                    key: float(value)
-                    for key, value in jax.device_get(metrics).items()
-                    if getattr(value, "ndim", 0) == 0
-                }
-                now = time.time()
-                host_metrics["steps_per_sec"] = (
-                    log_every_steps / max(now - t_last, 1e-9)
-                    if step % log_every_steps == 0
-                    else 0.0
-                )
-                t_last = now
-                writer.write(step, host_metrics)
-                ctx.metrics = host_metrics
-            else:
-                ctx.metrics = None
-            for hook in hooks:
-                hook.after_step(ctx)
-            if step % save_checkpoints_steps == 0 or step == max_train_steps:
-                manager.save(step, args=ocp.args.StandardSave(state), force=True)
-                manager.wait_until_finished()
-                ctx.checkpoint_path = str(
-                    os.path.join(model_dir, "checkpoints", str(step))
-                )
+        if iterations_per_loop <= 1:
+            device_batches = infeed.device_prefetch(
+                host_batches, compiled.shard_batch, depth=infeed_depth
+            )
+            for batch in device_batches:
+                if step >= max_train_steps:
+                    break
+                ctx.step = step
                 for hook in hooks:
-                    hook.after_checkpoint_saved(ctx)
-                final_eval = run_eval_and_export(state, step)
+                    hook.before_step(ctx)
+                state, metrics = compiled.train_step(state, batch, rng_train)
+                step += 1
+                ctx.step = step
+                ctx.state = state
+                # Full per-step metric tree as device arrays (hooks fetch
+                # lazily; golden-value capture reads non-scalar entries).
+                ctx.device_metrics = metrics
+                if step % log_every_steps == 0 or step == max_train_steps:
+                    ctx.metrics = log_metrics(
+                        step, metrics, step % log_every_steps or log_every_steps
+                    )
+                else:
+                    ctx.metrics = None
+                for hook in hooks:
+                    hook.after_step(ctx)
+                if step % save_checkpoints_steps == 0 or step == max_train_steps:
+                    final_eval = checkpoint_and_eval(state, step)
+        else:
+            # Multi-step regime: chunk sizes clamp at checkpoint boundaries
+            # so every checkpoint still lands on its exact step.
+            def chunk_sizes():
+                s = step
+                while s < max_train_steps:
+                    boundary = min(
+                        max_train_steps,
+                        (s // save_checkpoints_steps + 1) * save_checkpoints_steps,
+                    )
+                    k = min(iterations_per_loop, boundary - s)
+                    yield k
+                    s += k
+
+            def stacked_chunks():
+                for k in chunk_sizes():
+                    chunk = list(itertools.islice(host_batches, k))
+                    if len(chunk) < k:
+                        return  # host data exhausted
+                    yield infeed.stack_batches(chunk)
+
+            device_chunks = infeed.device_prefetch(
+                stacked_chunks(),
+                lambda s: infeed.shard_stacked_batch(s, compiled.mesh),
+                depth=infeed_depth,
+            )
+            for device_chunk in device_chunks:
+                k = int(jax.tree_util.tree_leaves(device_chunk)[0].shape[0])
+                ctx.step = step
+                for hook in hooks:
+                    hook.before_step(ctx)
+                state, stacked_metrics = compiled.train_scan(
+                    state, device_chunk, rng_train
+                )
+                step += k
+                ctx.step = step
+                ctx.state = state
+                # Hooks observe loop granularity: the final step's metrics.
+                ctx.device_metrics = jax.tree_util.tree_map(
+                    lambda leaf: leaf[-1], stacked_metrics
+                )
+                if step % log_every_steps < k or step == max_train_steps:
+                    ctx.metrics = log_metrics(step, ctx.device_metrics, k)
+                else:
+                    ctx.metrics = None
+                for hook in hooks:
+                    hook.after_step(ctx)
+                if step % save_checkpoints_steps == 0 or step == max_train_steps:
+                    final_eval = checkpoint_and_eval(state, step)
+                if step >= max_train_steps:
+                    break
 
     finally:
         for hook in hooks:

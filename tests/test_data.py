@@ -405,3 +405,140 @@ class TestHardening:
         )
         with pytest.raises(ValueError, match="images"):
             SpecParser(one_spec).parse_single(serialized)
+
+
+class TestParallelParse:
+    """The thread-pool parse path must match the synchronous path exactly
+    (same batches, same order) — parallelism is an implementation detail."""
+
+    def make_records(self, tmp_path, n=24):
+        spec = TensorSpecStruct()
+        spec["img"] = ExtendedTensorSpec(
+            shape=(8, 10, 3), dtype=np.uint8, name="img", data_format="jpeg"
+        )
+        spec["y"] = ExtendedTensorSpec(shape=(), dtype=np.int64, name="y")
+        records = []
+        for i in range(n):
+            img = np.full((8, 10, 3), i % 250, np.uint8)
+            records.append(
+                encode_example(spec, {"img": img, "y": np.asarray(i, np.int64)})
+            )
+        tfrecord.write_tfrecords(str(tmp_path / "imgs.tfrecord"), records)
+        return spec
+
+    def _batches(self, tmp_path, spec, workers):
+        dataset = RecordDataset(
+            specs=spec,
+            file_patterns=str(tmp_path / "imgs.tfrecord"),
+            batch_size=4,
+            mode="eval",
+            num_parse_workers=workers,
+        )
+        return list(dataset)
+
+    def test_parallel_matches_synchronous(self, tmp_path):
+        spec = self.make_records(tmp_path)
+        sync = self._batches(tmp_path, spec, workers=0)
+        par = self._batches(tmp_path, spec, workers=4)
+        assert len(sync) == len(par) == 6
+        for a, b in zip(sync, par):
+            np.testing.assert_array_equal(a["y"], b["y"])
+            np.testing.assert_array_equal(a["img"], b["img"])
+
+    def test_parallel_train_stream(self, tmp_path):
+        spec = self.make_records(tmp_path)
+        dataset = RecordDataset(
+            specs=spec,
+            file_patterns=str(tmp_path / "imgs.tfrecord"),
+            batch_size=4,
+            mode="train",
+            seed=1,
+            num_parse_workers=2,
+        )
+        it = iter(dataset)
+        batches = [next(it) for _ in range(10)]  # > one epoch; repeats fine
+        assert all(b["img"].shape == (4, 8, 10, 3) for b in batches)
+
+    def test_parse_error_propagates(self, tmp_path):
+        spec = TensorSpecStruct()
+        spec["x"] = ExtendedTensorSpec(shape=(2,), dtype=np.float32, name="x")
+        # Write records missing the required feature.
+        bad_spec = TensorSpecStruct()
+        bad_spec["z"] = ExtendedTensorSpec(shape=(2,), dtype=np.float32, name="z")
+        records = [
+            encode_example(bad_spec, {"z": np.zeros((2,), np.float32)})
+            for _ in range(4)
+        ]
+        tfrecord.write_tfrecords(str(tmp_path / "bad.tfrecord"), records)
+        dataset = RecordDataset(
+            specs=spec,
+            file_patterns=str(tmp_path / "bad.tfrecord"),
+            batch_size=4,
+            mode="eval",
+            num_parse_workers=2,
+        )
+        with pytest.raises(KeyError):
+            list(dataset)
+
+
+class TestCompression:
+    def test_compress_decompress_roundtrip_png(self):
+        from tensor2robot_tpu.data.compression import (
+            create_compress_fn,
+            create_decompress_fn,
+        )
+
+        spec = TensorSpecStruct()
+        spec["img"] = ExtendedTensorSpec(
+            shape=(6, 7, 3), dtype=np.uint8, name="img", data_format="png"
+        )
+        spec["action"] = ExtendedTensorSpec(
+            shape=(2,), dtype=np.float32, name="action"
+        )
+        batch = TensorSpecStruct()
+        rng = np.random.RandomState(0)
+        batch["img"] = rng.randint(0, 255, (3, 6, 7, 3), np.uint8)
+        batch["action"] = rng.randn(3, 2).astype(np.float32)
+
+        compressed = create_compress_fn(spec)(batch)
+        assert isinstance(compressed["img"][0], bytes)
+        np.testing.assert_array_equal(compressed["action"], batch["action"])
+        restored = create_decompress_fn(spec)(compressed)
+        # PNG is lossless: exact roundtrip.
+        np.testing.assert_array_equal(restored["img"], batch["img"])
+
+    def test_jpeg_compress_is_lossy_but_close(self):
+        from tensor2robot_tpu.data.compression import (
+            create_compress_fn,
+            create_decompress_fn,
+        )
+
+        spec = TensorSpecStruct()
+        spec["img"] = ExtendedTensorSpec(
+            shape=(16, 16, 3), dtype=np.uint8, name="img", data_format="jpeg"
+        )
+        batch = TensorSpecStruct()
+        batch["img"] = np.full((2, 16, 16, 3), 128, np.uint8)
+        restored = create_decompress_fn(spec)(create_compress_fn(spec)(batch))
+        assert restored["img"].shape == (2, 16, 16, 3)
+        assert np.abs(restored["img"].astype(int) - 128).max() <= 4
+
+    def test_image_stack_roundtrip(self):
+        from tensor2robot_tpu.data.compression import (
+            create_compress_fn,
+            create_decompress_fn,
+        )
+
+        spec = TensorSpecStruct()
+        spec["frames"] = ExtendedTensorSpec(
+            shape=(4, 6, 6, 3), dtype=np.uint8, name="frames", data_format="png"
+        )
+        batch = TensorSpecStruct()
+        batch["frames"] = np.random.RandomState(1).randint(
+            0, 255, (2, 4, 6, 6, 3), np.uint8
+        )
+        compressed = create_compress_fn(spec)(batch)
+        assert len(compressed["frames"]) == 2
+        assert len(compressed["frames"][0]) == 4
+        restored = create_decompress_fn(spec)(compressed)
+        np.testing.assert_array_equal(restored["frames"], batch["frames"])
