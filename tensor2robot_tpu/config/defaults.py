@@ -18,6 +18,11 @@ train_eval_model = external_configurable(
 predict_from_model = external_configurable(
     _train_eval.predict_from_model, "predict_from_model"
 )
+from tensor2robot_tpu.train import continuous_eval as _continuous_eval
+
+continuous_eval = external_configurable(
+    _continuous_eval.continuous_eval, "continuous_eval"
+)
 
 # -- input generators ---------------------------------------------------------
 from tensor2robot_tpu.data import input_generators as _ig
@@ -34,6 +39,13 @@ for _cls_name in (
     globals()[_cls_name] = external_configurable(
         getattr(_ig, _cls_name), _cls_name
     )
+
+# -- warm start ---------------------------------------------------------------
+from tensor2robot_tpu.models import checkpoint_init as _ckpt_init
+
+default_init_from_checkpoint_fn = external_configurable(
+    _ckpt_init.default_init_from_checkpoint_fn, "default_init_from_checkpoint_fn"
+)
 
 # -- optimizers ---------------------------------------------------------------
 from tensor2robot_tpu.models import optimizers as _opt

@@ -85,8 +85,11 @@ def create_decompress_fn(specs):
             if spec is None:
                 out[key] = value
                 continue
-            if isinstance(value, np.ndarray) and value.dtype != object:
-                out[key] = value  # already decoded
+            if (
+                isinstance(value, np.ndarray)
+                and value.dtype.kind not in ("O", "S", "U")
+            ):
+                out[key] = value  # already decoded (numeric array)
                 continue
             rows: Union[List[bytes], List[List[bytes]]] = value
             decoded = []

@@ -176,6 +176,21 @@ class MultiEvalRecordInputGenerator(DefaultRecordInputGenerator):
         self.eval_name = eval_name
 
 
+def create_multi_eval_generators(
+    eval_dataset_map: Mapping[str, Union[str, Sequence[str]]],
+    **kwargs,
+) -> "dict[str, MultiEvalRecordInputGenerator]":
+    """One MultiEvalRecordInputGenerator per named eval dataset — the map
+    form train_eval_model/continuous_eval consume for multi-eval (reference
+    multi-eval-name -> EvalSpec override, utils/train_eval.py:541-566)."""
+    return {
+        name: MultiEvalRecordInputGenerator(
+            eval_dataset_map, eval_name=name, **kwargs
+        )
+        for name in eval_dataset_map
+    }
+
+
 class WeightedRecordInputGenerator(AbstractInputGenerator):
     """Samples batches from several record sources with given weights
     (reference :229-314)."""

@@ -78,19 +78,3 @@ def shard_stacked_batch(stacked, mesh):
     return jax.tree_util.tree_map(put, stacked)
 
 
-def chunked(
-    batches: Iterator, chunk_size: int
-) -> Iterator:
-    """Groups an iterator of host batches into stacked [K, B, ...] chunks.
-
-    A final partial chunk (fewer than chunk_size batches) is emitted as its
-    own smaller stack; the scan step recompiles once for that shape.
-    """
-    buf = []
-    for batch in batches:
-        buf.append(batch)
-        if len(buf) == chunk_size:
-            yield stack_batches(buf)
-            buf = []
-    if buf:
-        yield stack_batches(buf)

@@ -229,6 +229,68 @@ def restore_or_init_state(
 # -- evaluation ---------------------------------------------------------------
 
 
+def normalize_eval_generators(input_generator_eval) -> Dict[str, Any]:
+    """Normalizes the eval-generator argument to a {name: generator} map.
+
+    None -> {}; a bare generator -> {"": generator}; a mapping passes
+    through (multi-eval: one named dataset per entry, reference
+    utils/train_eval.py:541-566).
+    """
+    if input_generator_eval is None:
+        return {}
+    if isinstance(input_generator_eval, dict):
+        if "" in input_generator_eval and len(input_generator_eval) > 1:
+            raise ValueError(
+                "Multi-eval maps require every eval to be named (got an "
+                "empty-string name alongside others)."
+            )
+        return dict(input_generator_eval)
+    return {"": input_generator_eval}
+
+
+def eval_dir_name(name: str) -> str:
+    """'eval' for the unnamed eval, 'eval_<name>' per named dataset (the
+    reference's per-eval-name output dirs)."""
+    return "eval" if not name else f"eval_{name}"
+
+
+def run_named_evals(
+    compiled: "CompiledModel",
+    state: "TrainState",
+    eval_generators: Dict[str, Any],
+    eval_steps: Optional[int],
+    use_ema: bool,
+    step: Optional[int] = None,
+    writers: Optional[Dict[str, MetricsWriter]] = None,
+) -> Dict[str, float]:
+    """Evaluates every named dataset; returns merged metrics.
+
+    The primary eval's metrics (first entry with any results) keep
+    unprefixed keys — that is what exporter compare_fns gate on — and every
+    named eval's metrics are also recorded under '<name>/<key>'.
+    """
+    merged: Dict[str, float] = {}
+    primary_done = False
+    for name, generator in eval_generators.items():
+        metrics = evaluate(
+            compiled,
+            state,
+            iter(generator.create_dataset(MODE_EVAL)),
+            eval_steps=eval_steps,
+            use_ema=use_ema,
+        )
+        if not metrics:
+            continue
+        if writers is not None and step is not None and name in writers:
+            writers[name].write(step, metrics)
+        if not primary_done:
+            merged.update(metrics)
+            primary_done = True
+        if name:
+            merged.update({f"{name}/{k}": v for k, v in metrics.items()})
+    return merged
+
+
 def evaluate(
     compiled: CompiledModel,
     state: TrainState,
@@ -301,9 +363,13 @@ def train_eval_model(
         input_generator_train, model, MODE_TRAIN
     )
     train_batches = iter(input_generator_train.create_dataset(MODE_TRAIN))
-    if input_generator_eval is not None:
+    # Multi-eval: a {name: generator} map evaluates every named dataset per
+    # eval round (reference multi-eval-name -> EvalSpec override,
+    # utils/train_eval.py:541-566). A bare generator is the single-eval case.
+    eval_generators = normalize_eval_generators(input_generator_eval)
+    for generator in eval_generators.values():
         provide_input_generator_with_model_information(
-            input_generator_eval, model, MODE_EVAL
+            generator, model, MODE_EVAL
         )
 
     manager = create_checkpoint_manager(
@@ -324,10 +390,13 @@ def train_eval_model(
             else model.use_summaries
         ),
     )
-    eval_writer = MetricsWriter(
-        os.path.join(model_dir, "eval"),
-        use_tensorboard=False,
-    )
+    eval_writers = {
+        name: MetricsWriter(
+            os.path.join(model_dir, eval_dir_name(name)),
+            use_tensorboard=False,
+        )
+        for name in eval_generators
+    }
 
     hooks: List[Hook] = []
     for builder in hook_builders or []:
@@ -342,17 +411,15 @@ def train_eval_model(
     )
 
     def run_eval_and_export(state, step: int) -> Dict[str, float]:
-        eval_metrics: Dict[str, float] = {}
-        if input_generator_eval is not None:
-            eval_metrics = evaluate(
-                compiled,
-                state,
-                iter(input_generator_eval.create_dataset(MODE_EVAL)),
-                eval_steps=eval_steps,
-                use_ema=use_ema_for_eval,
-            )
-            if eval_metrics:
-                eval_writer.write(step, eval_metrics)
+        eval_metrics = run_named_evals(
+            compiled,
+            state,
+            eval_generators,
+            eval_steps=eval_steps,
+            use_ema=use_ema_for_eval,
+            step=step,
+            writers=eval_writers,
+        )
         for exporter in exporters:
             exporter.maybe_export(
                 step=step,
@@ -371,24 +438,31 @@ def train_eval_model(
     final_eval: Dict[str, float] = {}
     step = start_step
     t_last = time.time()
+    last_log_step = start_step
+    last_saved_step = start_step
     host_batches = itertools.chain([first_batch], train_batches)
 
-    def log_metrics(step: int, metrics, n_steps: int) -> Dict[str, float]:
-        nonlocal t_last
+    def log_metrics(step: int, metrics) -> Dict[str, float]:
+        nonlocal t_last, last_log_step
         host_metrics = {
             key: float(value)
             for key, value in jax.device_get(metrics).items()
             if getattr(value, "ndim", 0) == 0
         }
         now = time.time()
-        host_metrics["steps_per_sec"] = n_steps / max(now - t_last, 1e-9)
+        host_metrics["steps_per_sec"] = (
+            (step - last_log_step) / max(now - t_last, 1e-9)
+        )
         t_last = now
+        last_log_step = step
         writer.write(step, host_metrics)
         return host_metrics
 
     def checkpoint_and_eval(state, step: int) -> Dict[str, float]:
+        nonlocal last_saved_step
         manager.save(step, args=ocp.args.StandardSave(state), force=True)
         manager.wait_until_finished()
+        last_saved_step = step
         ctx.checkpoint_path = str(
             os.path.join(model_dir, "checkpoints", str(step))
         )
@@ -415,9 +489,7 @@ def train_eval_model(
                 # lazily; golden-value capture reads non-scalar entries).
                 ctx.device_metrics = metrics
                 if step % log_every_steps == 0 or step == max_train_steps:
-                    ctx.metrics = log_metrics(
-                        step, metrics, step % log_every_steps or log_every_steps
-                    )
+                    ctx.metrics = log_metrics(step, metrics)
                 else:
                     ctx.metrics = None
                 for hook in hooks:
@@ -466,7 +538,7 @@ def train_eval_model(
                     lambda leaf: leaf[-1], stacked_metrics
                 )
                 if step % log_every_steps < k or step == max_train_steps:
-                    ctx.metrics = log_metrics(step, ctx.device_metrics, k)
+                    ctx.metrics = log_metrics(step, ctx.device_metrics)
                 else:
                     ctx.metrics = None
                 for hook in hooks:
@@ -476,11 +548,17 @@ def train_eval_model(
                 if step >= max_train_steps:
                     break
 
+        if step > last_saved_step:
+            # Host data exhausted mid-interval: checkpoint the trained steps
+            # instead of silently dropping them.
+            final_eval = checkpoint_and_eval(state, step)
+
     finally:
         for hook in hooks:
             hook.on_train_end(ctx)
         writer.close()
-        eval_writer.close()
+        for eval_writer in eval_writers.values():
+            eval_writer.close()
         manager.wait_until_finished()
         manager.close()
         _save_operative_config(model_dir)

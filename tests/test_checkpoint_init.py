@@ -1,0 +1,197 @@
+"""Default warm-start from foreign orbax checkpoints.
+
+Rebuild of the reference warm-start contract: assignment maps, partial
+restore, and restorables filtering (models/abstract_model.py:86-126; test at
+utils/train_eval_test.py:204).
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from tensor2robot_tpu.models.checkpoint_init import (
+    default_init_from_checkpoint_fn,
+    flatten_with_paths,
+    load_checkpoint_variables,
+)
+from tensor2robot_tpu.train import train_eval
+from tensor2robot_tpu.utils.mocks import MockInputGenerator, MockT2RModel
+
+BATCH_SIZE = 16
+
+
+@pytest.fixture(scope="module")
+def trained_model_dir(tmp_path_factory):
+    model_dir = str(tmp_path_factory.mktemp("donor") / "run")
+    train_eval.train_eval_model(
+        t2r_model=MockT2RModel(device_type="cpu"),
+        input_generator_train=MockInputGenerator(batch_size=BATCH_SIZE),
+        model_dir=model_dir,
+        max_train_steps=30,
+        save_checkpoints_steps=30,
+        log_every_steps=30,
+    )
+    return model_dir
+
+
+def _init_variables(model):
+    generator = MockInputGenerator(batch_size=BATCH_SIZE)
+    train_eval.provide_input_generator_with_model_information(
+        generator, model, "train"
+    )
+    batch = next(iter(generator.create_dataset("train")))
+    features, _ = model.preprocessor.preprocess(
+        batch["features"], batch["labels"], mode="train",
+        rng=jax.random.PRNGKey(0),
+    )
+    return model.init_variables(jax.random.PRNGKey(1), features), batch
+
+
+class TestDefaultWarmStart:
+    def test_full_restore_matches_checkpoint(self, trained_model_dir):
+        model = MockT2RModel(
+            device_type="cpu",
+            init_from_checkpoint_fn=default_init_from_checkpoint_fn(
+                trained_model_dir
+            ),
+        )
+        variables, _ = _init_variables(model)
+        warm = model.maybe_init_from_checkpoint(variables)
+        source = load_checkpoint_variables(trained_model_dir)
+        flat_warm = flatten_with_paths(warm)
+        flat_src = flatten_with_paths(source)
+        assert set(flat_warm) == set(flat_src)
+        for path, leaf in flat_warm.items():
+            np.testing.assert_allclose(
+                np.asarray(leaf, np.float32),
+                np.asarray(flat_src[path], np.float32),
+                err_msg=path,
+            )
+
+    def test_missing_leaf_raises_without_partial(self, trained_model_dir):
+        init_fn = default_init_from_checkpoint_fn(
+            trained_model_dir,
+            assignment_map={"params/": "params/nonexistent/"},
+        )
+        model = MockT2RModel(device_type="cpu")
+        variables, _ = _init_variables(model)
+        with pytest.raises(KeyError, match="missing from checkpoint"):
+            init_fn(variables)
+
+    def test_partial_restore_keeps_fresh_init(self, trained_model_dir):
+        # A differently-shaped sibling: pretend the donor lacks some leaves
+        # by dropping a subtree via assignment_map -> None, plus a bogus
+        # mapping tolerated by allow_partial_restore.
+        model = MockT2RModel(device_type="cpu")
+        variables, _ = _init_variables(model)
+        flat_before = flatten_with_paths(variables)
+        some_param = sorted(
+            p for p in flat_before if p.startswith("params/")
+        )[0]
+        prefix = some_param.rsplit("/", 1)[0] + "/"
+        init_fn = default_init_from_checkpoint_fn(
+            trained_model_dir,
+            assignment_map={prefix: None},  # keep fresh init for this subtree
+            allow_partial_restore=True,
+        )
+        warm = flatten_with_paths(init_fn(variables))
+        source = flatten_with_paths(
+            load_checkpoint_variables(trained_model_dir)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(warm[some_param]), np.asarray(flat_before[some_param])
+        )
+        restored = [
+            p for p in warm
+            if not p.startswith(prefix) and p.startswith("params/")
+        ]
+        assert restored
+        for path in restored:
+            np.testing.assert_allclose(
+                np.asarray(warm[path], np.float32),
+                np.asarray(source[path], np.float32),
+                err_msg=path,
+            )
+
+    def test_filter_restorables_fn(self, trained_model_dir):
+        model = MockT2RModel(device_type="cpu")
+        variables, _ = _init_variables(model)
+        flat_before = flatten_with_paths(variables)
+        init_fn = default_init_from_checkpoint_fn(
+            trained_model_dir,
+            filter_restorables_fn=lambda path: "kernel" in path,
+        )
+        warm = flatten_with_paths(init_fn(variables))
+        source = flatten_with_paths(
+            load_checkpoint_variables(trained_model_dir)
+        )
+        kernels = [p for p in warm if "kernel" in p]
+        non_kernels = [p for p in warm if "kernel" not in p]
+        assert kernels and non_kernels
+        for path in kernels:
+            np.testing.assert_allclose(
+                np.asarray(warm[path]), np.asarray(source[path]), err_msg=path
+            )
+        for path in non_kernels:
+            np.testing.assert_array_equal(
+                np.asarray(warm[path]), np.asarray(flat_before[path]),
+                err_msg=path,
+            )
+
+    def test_shape_mismatch_raises(self, trained_model_dir):
+        model = MockT2RModel(device_type="cpu")
+        variables, _ = _init_variables(model)
+        flat = flatten_with_paths(variables)
+        kernel_path = sorted(p for p in flat if "kernel" in p)[0]
+        # Grow a leaf so the checkpoint's no longer fits.
+        paths, treedef = jax.tree_util.tree_flatten_with_path(variables)
+        bad_leaves = []
+        for key_path, leaf in paths:
+            path = "/".join(
+                str(getattr(e, "key", getattr(e, "name", e))) for e in key_path
+            )
+            if path == kernel_path:
+                leaf = np.zeros(
+                    tuple(d + 1 for d in np.shape(leaf)), np.float32
+                )
+            bad_leaves.append(leaf)
+        bad_variables = jax.tree_util.tree_unflatten(treedef, bad_leaves)
+        init_fn = default_init_from_checkpoint_fn(trained_model_dir)
+        with pytest.raises(ValueError, match="shape mismatch"):
+            init_fn(bad_variables)
+
+    def test_end_to_end_warm_start_through_trainer(
+        self, trained_model_dir, tmp_path
+    ):
+        """Warm-started training resumes from the donor's loss level."""
+        model_dir = str(tmp_path / "warm")
+        model = MockT2RModel(
+            device_type="cpu",
+            init_from_checkpoint_fn=default_init_from_checkpoint_fn(
+                trained_model_dir
+            ),
+        )
+        train_eval.train_eval_model(
+            t2r_model=model,
+            input_generator_train=MockInputGenerator(batch_size=BATCH_SIZE),
+            model_dir=model_dir,
+            max_train_steps=10,
+            save_checkpoints_steps=10,
+            log_every_steps=1,
+        )
+        from tensor2robot_tpu.train.metrics import read_metrics
+
+        rows = read_metrics(os.path.join(model_dir, "train"))
+        fresh_dir = str(tmp_path / "fresh")
+        train_eval.train_eval_model(
+            t2r_model=MockT2RModel(device_type="cpu"),
+            input_generator_train=MockInputGenerator(batch_size=BATCH_SIZE),
+            model_dir=fresh_dir,
+            max_train_steps=10,
+            save_checkpoints_steps=10,
+            log_every_steps=1,
+        )
+        fresh_rows = read_metrics(os.path.join(fresh_dir, "train"))
+        assert rows[0]["loss"] < fresh_rows[0]["loss"] * 0.8

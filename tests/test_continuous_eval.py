@@ -1,0 +1,136 @@
+"""Continuous-eval job + multi-eval wiring.
+
+Rebuild of the reference's continuous-eval topology tests: the trainer and
+the eval job are separate processes communicating only through model_dir
+(utils/train_eval.py:584-683). Here the trainer runs in a thread while
+continuous_eval tails its checkpoints, asserting per-name eval artifacts.
+"""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from tensor2robot_tpu.export.exporters import LatestExporter
+from tensor2robot_tpu.train import continuous_eval as ce
+from tensor2robot_tpu.train import train_eval
+from tensor2robot_tpu.train.metrics import read_metrics
+from tensor2robot_tpu.utils.mocks import MockInputGenerator, MockT2RModel
+
+BATCH_SIZE = 16
+
+
+class TestMultiEvalInLoop:
+    def test_named_eval_streams_and_merged_metrics(self, tmp_path):
+        model_dir = str(tmp_path / "run")
+        final = train_eval.train_eval_model(
+            t2r_model=MockT2RModel(device_type="cpu"),
+            input_generator_train=MockInputGenerator(batch_size=BATCH_SIZE),
+            input_generator_eval={
+                "seen": MockInputGenerator(batch_size=BATCH_SIZE, seed=3),
+                "unseen": MockInputGenerator(batch_size=BATCH_SIZE, seed=9),
+            },
+            model_dir=model_dir,
+            max_train_steps=40,
+            save_checkpoints_steps=20,
+            eval_steps=4,
+            log_every_steps=20,
+        )
+        # Per-name metric streams on disk.
+        seen = read_metrics(os.path.join(model_dir, "eval_seen"))
+        unseen = read_metrics(os.path.join(model_dir, "eval_unseen"))
+        assert [row["step"] for row in seen] == [20, 40]
+        assert [row["step"] for row in unseen] == [20, 40]
+        # Merged metrics: primary (first) eval unprefixed + per-name copies.
+        assert "loss" in final
+        assert "seen/loss" in final and "unseen/loss" in final
+        assert final["loss"] == final["seen/loss"]
+
+
+class TestCheckpointBackup:
+    def _train(self, model_dir, steps=20):
+        train_eval.train_eval_model(
+            t2r_model=MockT2RModel(device_type="cpu"),
+            input_generator_train=MockInputGenerator(batch_size=BATCH_SIZE),
+            model_dir=model_dir,
+            max_train_steps=steps,
+            save_checkpoints_steps=steps,
+            log_every_steps=steps,
+        )
+
+    def test_backup_survives_source_gc(self, tmp_path):
+        model_dir = str(tmp_path / "run")
+        self._train(model_dir)
+        backup_root = ce.backup_checkpoint_for_eval(model_dir, 20)
+        assert backup_root is not None
+        # Trainer GC deletes the source; the backup must still restore.
+        import shutil
+
+        shutil.rmtree(os.path.join(model_dir, "checkpoints", "20"))
+        model = train_eval.maybe_wrap_for_tpu(MockT2RModel(device_type="cpu"))
+        compiled = train_eval.CompiledModel(model, donate_state=False)
+        generator = MockInputGenerator(batch_size=BATCH_SIZE)
+        train_eval.provide_input_generator_with_model_information(
+            generator, model, "eval"
+        )
+        example = next(iter(generator.create_dataset("eval")))
+        state = ce.restore_state_from_backup(backup_root, 20, compiled, example)
+        assert int(np.asarray(state.step)) == 20
+
+    def test_backup_missing_step_returns_none(self, tmp_path):
+        model_dir = str(tmp_path / "run")
+        os.makedirs(os.path.join(model_dir, "checkpoints"))
+        assert ce.backup_checkpoint_for_eval(model_dir, 999) is None
+
+    def test_wait_timeout_returns_none(self, tmp_path):
+        assert (
+            ce.wait_for_new_checkpoint(
+                str(tmp_path), timeout=0.2, poll_interval=0.05
+            )
+            is None
+        )
+
+
+class TestContinuousEvalTailsTraining:
+    def test_eval_job_follows_trainer(self, tmp_path):
+        model_dir = str(tmp_path / "run")
+        max_steps = 60
+
+        def train():
+            train_eval.train_eval_model(
+                t2r_model=MockT2RModel(device_type="cpu"),
+                input_generator_train=MockInputGenerator(batch_size=BATCH_SIZE),
+                model_dir=model_dir,
+                max_train_steps=max_steps,
+                save_checkpoints_steps=20,
+                log_every_steps=20,
+                keep_checkpoint_max=2,
+            )
+
+        trainer = threading.Thread(target=train, daemon=True)
+        trainer.start()
+        final = ce.continuous_eval(
+            t2r_model=MockT2RModel(device_type="cpu"),
+            model_dir=model_dir,
+            input_generator_eval={
+                "a": MockInputGenerator(batch_size=BATCH_SIZE, seed=3),
+                "b": MockInputGenerator(batch_size=BATCH_SIZE, seed=9),
+            },
+            eval_steps=2,
+            max_train_steps=max_steps,
+            create_exporters_fn=lambda model: [LatestExporter(name="latest")],
+            timeout=120.0,
+            poll_interval=0.2,
+        )
+        trainer.join(timeout=300)
+        assert not trainer.is_alive()
+        # The eval job reached the final checkpoint and wrote per-name streams.
+        assert final and "a/loss" in final and "b/loss" in final
+        for name in ("a", "b"):
+            rows = read_metrics(os.path.join(model_dir, f"eval_{name}"))
+            assert rows, f"no metrics for eval_{name}"
+            assert rows[-1]["step"] == max_steps
+        # Exporter driven by the eval job.
+        export_root = os.path.join(model_dir, "export", "latest")
+        assert os.path.isdir(export_root) and os.listdir(export_root)
