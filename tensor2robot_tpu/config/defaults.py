@@ -79,6 +79,9 @@ from tensor2robot_tpu.utils import (  # noqa: F401
 from tensor2robot_tpu.research import run_env as _run_env
 
 run_env = external_configurable(_run_env.run_env, "run_env")
+run_tfagents_env = external_configurable(
+    _run_env.run_tfagents_env, "run_tfagents_env"
+)
 from tensor2robot_tpu.meta_learning import run_meta_env as _rme  # noqa: F401
 
 # -- research model zoo -------------------------------------------------------

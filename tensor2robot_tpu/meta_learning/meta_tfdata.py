@@ -64,8 +64,12 @@ def merge_first_n_dims(structure: PyTree, n: int) -> PyTree:
 
 def expand_batch_dims(structure: PyTree, batch_sizes: Sequence[int]) -> PyTree:
     """Re-expands the first dim of every array to `batch_sizes`
-    (reference :241-257). Scalars (0-d, e.g. reduced losses) pass through."""
-    batch_sizes = tuple(int(b) for b in batch_sizes)
+    (reference :241-257). Scalars (0-d, e.g. reduced losses) pass through.
+
+    Dims stay as-is (no int() coercion): under jax.export shape polymorphism
+    a batch dim is symbolic and jnp.reshape consumes it directly — coercing
+    would break batch-polymorphic serving of episode-batched models."""
+    batch_sizes = tuple(batch_sizes)
 
     def reshape(x):
         if not _is_array(x) or x.ndim == 0:

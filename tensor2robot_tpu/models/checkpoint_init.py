@@ -29,20 +29,28 @@ import numpy as np
 import orbax.checkpoint as ocp
 
 
+def path_str(key_path) -> str:
+    """'/'-joined string form of a jax tree key path (DictKey/GetAttrKey/
+    SequenceKey all reduce to their key/name/index)."""
+    parts = []
+    for entry in key_path:
+        if hasattr(entry, "key"):
+            parts.append(str(entry.key))
+        elif hasattr(entry, "name"):
+            parts.append(str(entry.name))
+        elif hasattr(entry, "idx"):
+            parts.append(str(entry.idx))
+        else:
+            parts.append(str(entry))
+    return "/".join(parts)
+
+
 def flatten_with_paths(tree) -> Dict[str, Any]:
     """Flattens a pytree to {'/'.joined/path: leaf}."""
-    flat = {}
-    for key_path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
-        parts = []
-        for entry in key_path:
-            if hasattr(entry, "key"):
-                parts.append(str(entry.key))
-            elif hasattr(entry, "name"):
-                parts.append(str(entry.name))
-            else:
-                parts.append(str(entry))
-        flat["/".join(parts)] = leaf
-    return flat
+    return {
+        path_str(key_path): leaf
+        for key_path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]
+    }
 
 
 def _checkpoint_root_and_step(
@@ -54,7 +62,12 @@ def _checkpoint_root_and_step(
     if os.path.isdir(nested):
         path = nested
     base = os.path.basename(path)
-    if base.isdigit() and step is None:
+    if base.isdigit():
+        if step is not None and step != int(base):
+            raise FileNotFoundError(
+                f"Requested step {step} but {checkpoint_path!r} is the "
+                f"step-{base} directory."
+            )
         return os.path.dirname(path), int(base)
     steps = [
         int(entry)
@@ -91,7 +104,12 @@ def load_checkpoint_variables(
     finally:
         manager.close()
     variables = tree.get("variables", tree) if isinstance(tree, dict) else tree
-    if use_ema and isinstance(tree, dict) and tree.get("ema_params") is not None:
+    if use_ema:
+        if not isinstance(tree, dict) or tree.get("ema_params") is None:
+            raise ValueError(
+                f"use_ema=True but checkpoint {checkpoint_path!r} holds no "
+                "ema_params (trained without use_avg_model_params)."
+            )
         variables = dict(variables)
         variables["params"] = tree["ema_params"]
     return variables
@@ -145,9 +163,7 @@ def default_init_from_checkpoint_fn(
         new_leaves = []
         missing = []
         for key_path, leaf in paths_and_leaves:
-            path = "/".join(
-                str(getattr(e, "key", getattr(e, "name", e))) for e in key_path
-            )
+            path = path_str(key_path)
             if filter_restorables_fn is not None and not filter_restorables_fn(path):
                 new_leaves.append(leaf)
                 continue

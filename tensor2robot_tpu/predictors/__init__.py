@@ -5,3 +5,8 @@ from tensor2robot_tpu.predictors.checkpoint_predictor import CheckpointPredictor
 from tensor2robot_tpu.predictors.exported_savedmodel_predictor import (
     ExportedSavedModelPredictor,
 )
+from tensor2robot_tpu.predictors.saved_model_v2_predictor import (
+    SavedModelCodePredictor,
+    SavedModelPredictorBase,
+    SavedModelSignaturePredictor,
+)

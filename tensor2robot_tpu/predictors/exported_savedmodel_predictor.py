@@ -116,30 +116,11 @@ class ExportedSavedModelPredictor(AbstractPredictor):
                 f"({loaded.metadata.get('stablehlo_error')}); construct the "
                 "predictor with t2r_model= to rebuild the serving fn from code."
             )
-        from tensor2robot_tpu.export.export_generators import DefaultExportGenerator
-        from tensor2robot_tpu.train.train_eval import CompiledModel, maybe_wrap_for_tpu
-
-        model = maybe_wrap_for_tpu(self._t2r_model)
-        compiled = CompiledModel(model, donate_state=False)
-        generator = DefaultExportGenerator()
-        generator.set_specification_from_model(model)
-        import jax
-
-        example = {
-            k: np.zeros(v.shape, v.dtype)
-            for k, v in generator.create_example_features(batch_size=1).items()
-        }
-        features, _ = compiled.preprocessor.preprocess(
-            TensorSpecStruct(example), None, mode="predict", rng=None
+        from tensor2robot_tpu.predictors.saved_model_v2_predictor import (
+            build_model_code_serving_fn,
         )
-        target = model.init_variables(jax.random.PRNGKey(0), features)
-        variables = loaded.load_variables(target=target)
-        serving_fn = generator.create_serving_fn(compiled, variables)
 
-        def predict_fn(flat_features: Dict[str, Any]) -> Dict[str, Any]:
-            out = serving_fn(flat_features)
-            return {k: np.asarray(v) for k, v in out.items()}
-
+        predict_fn, _ = build_model_code_serving_fn(self._t2r_model, loaded)
         return predict_fn
 
     def init_randomly(self) -> None:
@@ -147,23 +128,11 @@ class ExportedSavedModelPredictor(AbstractPredictor):
         bring-up before any export exists."""
         if self._t2r_model is None:
             raise ValueError("init_randomly requires t2r_model.")
-        from tensor2robot_tpu.export.export_generators import DefaultExportGenerator
-        from tensor2robot_tpu.train.train_eval import CompiledModel, maybe_wrap_for_tpu
-        import jax
-
-        model = maybe_wrap_for_tpu(self._t2r_model)
-        compiled = CompiledModel(model, donate_state=False)
-        generator = DefaultExportGenerator()
-        generator.set_specification_from_model(model)
-        example = {
-            k: np.zeros(v.shape, v.dtype)
-            for k, v in generator.create_example_features(batch_size=1).items()
-        }
-        features, _ = compiled.preprocessor.preprocess(
-            TensorSpecStruct(example), None, mode="predict", rng=None
+        from tensor2robot_tpu.predictors.saved_model_v2_predictor import (
+            build_model_code_serving_fn,
         )
-        variables = model.init_variables(jax.random.PRNGKey(0), features)
-        serving_fn = generator.create_serving_fn(compiled, variables)
+
+        predict_fn, generator = build_model_code_serving_fn(self._t2r_model)
 
         class _RandomLoaded:
             export_dir = "<random-init>"
@@ -174,9 +143,7 @@ class ExportedSavedModelPredictor(AbstractPredictor):
 
         with self._lock:
             self._loaded = _RandomLoaded()  # type: ignore[assignment]
-            self._predict_fn = lambda flat: {
-                k: np.asarray(v) for k, v in serving_fn(flat).items()
-            }
+            self._predict_fn = predict_fn
 
     # -- predict --------------------------------------------------------------
 

@@ -1,0 +1,183 @@
+"""SavedModel-v2 predictor family: explicit code-path and signature-path
+serving over one exported artifact.
+
+The reference shipped three predictors over SavedModels
+(predictors/saved_model_v2_predictor.py:33-257): SavedModelPredictorBase,
+SavedModelTF2Predictor (restores the model OBJECT and calls model.predict)
+and SavedModelTF1Predictor (drives the serving SIGNATURE in a session). The
+same split exists here over the exported-dir artifact:
+
+  * SavedModelCodePredictor  — the TF2 analogue: model code + exported
+    variables; the model object is in charge, so research models can expose
+    intermediate outputs and dtype policies the frozen signature would hide.
+  * SavedModelSignaturePredictor — the TF1 analogue: strictly the serialized
+    StableHLO program; zero model code, exactly what a robot fleet runs.
+
+Both load one pinned export version (a specific dir or the latest under a
+root at construction). The polling/async-restore fleet behavior lives in
+ExportedSavedModelPredictor; these are the simple, explicit variants.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, Mapping, Optional, Tuple
+
+import numpy as np
+
+from tensor2robot_tpu.config import configurable
+from tensor2robot_tpu.export.saved_model import (
+    ExportedModel,
+    latest_export_dir,
+)
+from tensor2robot_tpu.predictors.abstract_predictor import AbstractPredictor
+from tensor2robot_tpu.specs import TensorSpecStruct, flatten_spec_structure
+
+
+def build_model_code_serving_fn(
+    t2r_model, loaded: Optional[ExportedModel] = None
+) -> Tuple[Callable[[Dict[str, Any]], Dict[str, Any]], Any]:
+    """(serving_fn, generator) from model code, with variables taken from
+    `loaded` when given, else freshly initialized (random-init serving).
+
+    Shared by the v2 family and ExportedSavedModelPredictor's code fallback.
+    """
+    import jax
+
+    from tensor2robot_tpu.export.export_generators import DefaultExportGenerator
+    from tensor2robot_tpu.train.train_eval import (
+        CompiledModel,
+        maybe_wrap_for_tpu,
+    )
+
+    model = maybe_wrap_for_tpu(t2r_model)
+    compiled = CompiledModel(model, donate_state=False)
+    generator = DefaultExportGenerator()
+    generator.set_specification_from_model(model)
+    example = {
+        k: np.zeros(v.shape, v.dtype)
+        for k, v in generator.create_example_features(batch_size=1).items()
+    }
+    features, _ = compiled.preprocessor.preprocess(
+        TensorSpecStruct(example), None, mode="predict", rng=None
+    )
+    target = model.init_variables(jax.random.PRNGKey(0), features)
+    variables = (
+        loaded.load_variables(target=target) if loaded is not None else target
+    )
+    serving_fn = generator.create_serving_fn(compiled, variables)
+
+    def predict_fn(flat_features: Dict[str, Any]) -> Dict[str, Any]:
+        return {k: np.asarray(v) for k, v in serving_fn(flat_features).items()}
+
+    return predict_fn, generator
+
+
+def _resolve_export_dir(saved_model_path: str) -> Optional[str]:
+    """A specific export version dir passes through; a root resolves to its
+    latest version."""
+    from tensor2robot_tpu.export.saved_model import is_valid_export_dir
+
+    if is_valid_export_dir(saved_model_path):
+        return saved_model_path
+    return latest_export_dir(saved_model_path)
+
+
+class SavedModelPredictorBase(AbstractPredictor):
+    """Shared loading/introspection over one export version
+    (reference SavedModelPredictorBase, saved_model_v2_predictor.py:33)."""
+
+    def __init__(self, saved_model_path: str):
+        self._saved_model_path = saved_model_path
+        self._loaded: Optional[ExportedModel] = None
+        self._predict_fn: Optional[Callable] = None
+
+    def _build_predict_fn(self, loaded: ExportedModel) -> Callable:
+        raise NotImplementedError
+
+    def restore(self, is_async: bool = False) -> bool:
+        del is_async  # one-shot load; fleets use ExportedSavedModelPredictor
+        path = _resolve_export_dir(self._saved_model_path)
+        if path is None:
+            return False
+        loaded = ExportedModel(path)
+        self._predict_fn = self._build_predict_fn(loaded)
+        self._loaded = loaded
+        return True
+
+    def init_randomly(self) -> None:
+        raise ValueError(
+            f"{type(self).__name__} serves a fixed artifact; random init is "
+            "only meaningful for model-code predictors (CheckpointPredictor "
+            "or SavedModelCodePredictor)."
+        )
+
+    def predict(self, features: Mapping[str, Any]) -> Dict[str, Any]:
+        self.assert_is_loaded()
+        flat = dict(flatten_spec_structure(features).items())
+        return dict(self._predict_fn(flat))
+
+    def get_feature_specification(self) -> TensorSpecStruct:
+        self.assert_is_loaded()
+        return self._loaded.feature_spec
+
+    def get_label_specification(self) -> Optional[TensorSpecStruct]:
+        self.assert_is_loaded()
+        return self._loaded.label_spec
+
+    @property
+    def model_version(self) -> int:
+        if self._loaded is None:
+            return -1
+        base = os.path.basename(self._loaded.export_dir.rstrip("/"))
+        return int(base) if base.isdigit() else 0
+
+    @property
+    def global_step(self) -> int:
+        return -1 if self._loaded is None else int(self._loaded.global_step)
+
+    @property
+    def model_path(self) -> Optional[str]:
+        return None if self._loaded is None else self._loaded.export_dir
+
+
+@configurable("SavedModelCodePredictor")
+class SavedModelCodePredictor(SavedModelPredictorBase):
+    """Model-object serving: exported variables restored into `t2r_model`
+    (reference SavedModelTF2Predictor, saved_model_v2_predictor.py:179)."""
+
+    def __init__(self, saved_model_path: str, t2r_model):
+        super().__init__(saved_model_path)
+        self._t2r_model = t2r_model
+
+    def _build_predict_fn(self, loaded: ExportedModel) -> Callable:
+        predict_fn, _ = build_model_code_serving_fn(self._t2r_model, loaded)
+        return predict_fn
+
+    def init_randomly(self) -> None:
+        predict_fn, generator = build_model_code_serving_fn(self._t2r_model)
+
+        class _RandomLoaded:
+            export_dir = "<random-init>"
+            global_step = 0
+            feature_spec = generator.serving_input_spec()
+            label_spec = generator.label_spec
+            metadata: Dict[str, Any] = {}
+
+        self._loaded = _RandomLoaded()  # type: ignore[assignment]
+        self._predict_fn = predict_fn
+
+
+@configurable("SavedModelSignaturePredictor")
+class SavedModelSignaturePredictor(SavedModelPredictorBase):
+    """Signature-only serving: the serialized StableHLO program, no model
+    code (reference SavedModelTF1Predictor, saved_model_v2_predictor.py:199)."""
+
+    def _build_predict_fn(self, loaded: ExportedModel) -> Callable:
+        if not loaded.has_stablehlo:
+            raise ValueError(
+                f"Export {loaded.export_dir} carries no StableHLO signature "
+                f"({loaded.metadata.get('stablehlo_error')}); serve it with "
+                "SavedModelCodePredictor instead."
+            )
+        return loaded.predict

@@ -49,6 +49,39 @@ def _step_env(env, action) -> Tuple[Any, float, bool, dict]:
     return obs, float(reward), bool(done), info
 
 
+class _TFAgentsEnvAdapter:
+    """Adapts a TF-Agents-style environment (reset/step return TimeSteps
+    with .observation/.reward/.is_last()) to the gym-tuple protocol the core
+    loop drives (reference run_tfagents_env, run_env.py:106-130)."""
+
+    def __init__(self, tfagents_env):
+        self._env = tfagents_env
+
+    def reset(self):
+        timestep = self._env.reset()
+        return timestep.observation
+
+    def step(self, action):
+        timestep = self._env.step(action)
+        reward = timestep.reward
+        return (
+            timestep.observation,
+            float(0.0 if reward is None else np.asarray(reward)),
+            bool(timestep.is_last()),
+            {},
+        )
+
+    def __getattr__(self, name):
+        return getattr(self._env, name)
+
+
+def run_tfagents_env(tfagents_env, policy, **kwargs) -> List[float]:
+    """run_env over a TF-Agents-style environment (reference
+    run_tfagents_env, research/dql_grasping_lib/run_env.py:106): same
+    episode loop, TimeStep protocol adapted at the boundary."""
+    return run_env(_TFAgentsEnvAdapter(tfagents_env), policy, **kwargs)
+
+
 def run_env(
     env,
     policy,

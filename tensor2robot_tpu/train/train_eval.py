@@ -265,13 +265,14 @@ def run_named_evals(
 ) -> Dict[str, float]:
     """Evaluates every named dataset; returns merged metrics.
 
-    The primary eval's metrics (first entry with any results) keep
-    unprefixed keys — that is what exporter compare_fns gate on — and every
-    named eval's metrics are also recorded under '<name>/<key>'.
+    The FIRST entry is the primary eval: its metrics keep unprefixed keys —
+    that is what exporter compare_fns gate on. The primary never silently
+    changes: if it returns no results this round, no unprefixed metrics are
+    emitted (a Best gate must not compare across datasets). Every named
+    eval's metrics are also recorded under '<name>/<key>'.
     """
     merged: Dict[str, float] = {}
-    primary_done = False
-    for name, generator in eval_generators.items():
+    for i, (name, generator) in enumerate(eval_generators.items()):
         metrics = evaluate(
             compiled,
             state,
@@ -283,9 +284,8 @@ def run_named_evals(
             continue
         if writers is not None and step is not None and name in writers:
             writers[name].write(step, metrics)
-        if not primary_done:
+        if i == 0:
             merged.update(metrics)
-            primary_done = True
         if name:
             merged.update({f"{name}/{k}": v for k, v in metrics.items()})
     return merged

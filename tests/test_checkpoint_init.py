@@ -15,6 +15,7 @@ from tensor2robot_tpu.models.checkpoint_init import (
     default_init_from_checkpoint_fn,
     flatten_with_paths,
     load_checkpoint_variables,
+    path_str,
 )
 from tensor2robot_tpu.train import train_eval
 from tensor2robot_tpu.utils.mocks import MockInputGenerator, MockT2RModel
@@ -149,9 +150,7 @@ class TestDefaultWarmStart:
         paths, treedef = jax.tree_util.tree_flatten_with_path(variables)
         bad_leaves = []
         for key_path, leaf in paths:
-            path = "/".join(
-                str(getattr(e, "key", getattr(e, "name", e))) for e in key_path
-            )
+            path = path_str(key_path)
             if path == kernel_path:
                 leaf = np.zeros(
                     tuple(d + 1 for d in np.shape(leaf)), np.float32

@@ -343,6 +343,41 @@ class TestRunEnv:
         )
         assert len(rewards) == 1
 
+    def test_run_tfagents_env_matches_gym_path(self):
+        """The TimeStep adapter drives the same loop to the same rewards."""
+        import dataclasses
+
+        from tensor2robot_tpu.research.run_env import run_tfagents_env
+
+        @dataclasses.dataclass
+        class _TimeStep:
+            observation: np.ndarray
+            reward: float
+            last: bool
+
+            def is_last(self):
+                return self.last
+
+        class _TfAgentsToyEnv:
+            """_ToyEnv re-skinned behind the TF-Agents TimeStep protocol."""
+
+            def __init__(self):
+                self._env = _ToyEnv()
+
+            def reset(self):
+                return _TimeStep(self._env.reset(), None, False)
+
+            def step(self, action):
+                obs, reward, done, _ = self._env.step(action)
+                return _TimeStep(obs, reward, done)
+
+        policy = RegressionPolicy(_FakeRegressionPredictor())
+        tfa_rewards = run_tfagents_env(
+            _TfAgentsToyEnv(), policy, num_episodes=2
+        )
+        gym_rewards = run_env(_ToyEnv(), policy, num_episodes=2)
+        assert tfa_rewards == gym_rewards
+
 
 class TestCollectEvalLoop:
     def test_loop_runs_and_stops_at_max_steps(self, tmp_path):

@@ -166,3 +166,82 @@ class TestCheckpointPredictor:
             timeout=0,
         )
         assert not predictor.restore()
+
+
+class TestSavedModelV2Family:
+    """Explicit code-path vs signature-path predictors over one export
+    (reference saved_model_v2_predictor.py:33-257)."""
+
+    def test_signature_predictor_serves_stablehlo(self, trained, tmp_path):
+        from tensor2robot_tpu.predictors import SavedModelSignaturePredictor
+
+        path = _export(trained, str(tmp_path / "export"))
+        predictor = SavedModelSignaturePredictor(path)  # specific version dir
+        assert predictor.restore()
+        x = np.random.RandomState(0).rand(3, 3).astype(np.float32)
+        out = predictor.predict({"x": x})
+        assert out["a_predicted"].shape == (3, 1)
+        assert predictor.global_step >= 3
+        assert predictor.model_path == path
+
+    def test_signature_predictor_resolves_latest_from_root(self, trained, tmp_path):
+        from tensor2robot_tpu.predictors import SavedModelSignaturePredictor
+
+        root = str(tmp_path / "export")
+        _export(trained, root)
+        newest = _export(trained, root)
+        predictor = SavedModelSignaturePredictor(root)
+        assert predictor.restore()
+        assert predictor.model_path == newest
+
+    def test_signature_predictor_rejects_codeless_export(self, trained, tmp_path):
+        from tensor2robot_tpu.predictors import SavedModelSignaturePredictor
+
+        path = _export(trained, str(tmp_path / "export"), serialize_stablehlo=False)
+        predictor = SavedModelSignaturePredictor(path)
+        with pytest.raises(ValueError, match="no StableHLO signature"):
+            predictor.restore()
+
+    def test_code_predictor_matches_signature_predictor(self, trained, tmp_path):
+        from tensor2robot_tpu.predictors import (
+            SavedModelCodePredictor,
+            SavedModelSignaturePredictor,
+        )
+
+        path = _export(trained, str(tmp_path / "export"))
+        code = SavedModelCodePredictor(path, t2r_model=MockT2RModel(device_type="cpu"))
+        sig = SavedModelSignaturePredictor(path)
+        assert code.restore() and sig.restore()
+        x = np.random.RandomState(1).rand(4, 3).astype(np.float32)
+        np.testing.assert_allclose(
+            code.predict({"x": x})["a_predicted"],
+            sig.predict({"x": x})["a_predicted"],
+            rtol=1e-5,
+        )
+
+    def test_code_predictor_serves_codeless_export(self, trained, tmp_path):
+        from tensor2robot_tpu.predictors import SavedModelCodePredictor
+
+        path = _export(trained, str(tmp_path / "export"), serialize_stablehlo=False)
+        predictor = SavedModelCodePredictor(
+            path, t2r_model=MockT2RModel(device_type="cpu")
+        )
+        assert predictor.restore()
+        out = predictor.predict({"x": np.zeros((2, 3), np.float32)})
+        assert out["a_predicted"].shape == (2, 1)
+
+    def test_code_predictor_init_randomly(self):
+        from tensor2robot_tpu.predictors import SavedModelCodePredictor
+
+        predictor = SavedModelCodePredictor(
+            "/nonexistent", t2r_model=MockT2RModel(device_type="cpu")
+        )
+        predictor.init_randomly()
+        out = predictor.predict({"x": np.zeros((2, 3), np.float32)})
+        assert out["a_predicted"].shape == (2, 1)
+
+    def test_signature_predictor_restore_false_on_missing(self, tmp_path):
+        from tensor2robot_tpu.predictors import SavedModelSignaturePredictor
+
+        predictor = SavedModelSignaturePredictor(str(tmp_path / "nothing"))
+        assert predictor.restore() is False
