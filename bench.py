@@ -172,7 +172,12 @@ def bench_data() -> None:
 
     import numpy as np
 
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # Host-side pipeline bench: force the CPU backend BEFORE any device use.
+    # The env var alone does not bypass the TPU plugin on this image; only
+    # jax.config does — and a wedged/busy chip would otherwise hang import.
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
     metric = "qtopt_input_pipeline_images_per_sec"
     try:
         from tensor2robot_tpu.data import tfrecord
@@ -306,15 +311,28 @@ def main() -> None:
             )
             flops_source = "analytic"
 
+        # Anchor both ends of the timed window with a HOST READBACK of data
+        # computed by the step: on the axon tunnel backend,
+        # block_until_ready() has been observed to return before execution
+        # finishes (round-2 measured an impossible 6x-peak "MFU" trusting
+        # it), and only device_get forces the queue to drain.
+        float(jax.device_get(metrics["loss"]))
         start = time.perf_counter()
         for _ in range(steps):
             state, metrics = compiled.train_step(state, sharded, rng)
-        jax.block_until_ready((state, metrics))
+        float(jax.device_get(metrics["loss"]))
         elapsed = time.perf_counter() - start
         steps_per_sec = steps / elapsed
 
         peak = _peak_flops(device)
         mfu = flops_per_step * steps_per_sec / peak
+        if mfu > 1.0:
+            raise RuntimeError(
+                f"implied MFU {mfu:.2f} exceeds 1.0 — timing did not "
+                f"capture real execution ({steps_per_sec:.1f} steps/s, "
+                f"{flops_per_step:.3g} flops/step); refusing to report a "
+                "bogus number"
+            )
         _emit(
             {
                 "metric": metric,

@@ -1,0 +1,172 @@
+"""Profiling/tracing hooks: jax.profiler traces + per-step timing + MFU.
+
+The reference had NO tracing subsystem (SURVEY §5: absent), but the rebuild
+targets an MFU north star, so observability of where step time goes is
+first-class here:
+
+  * ProfilerHookBuilder — captures a jax.profiler trace (XPlane/perfetto,
+    viewable in TensorBoard or xprof) for a window of steps
+    [start_step, start_step + num_steps).
+  * StepTimingHookBuilder — wall-clock per-step timing with a device sync
+    every `sync_every` steps (async dispatch makes raw host timestamps
+    meaningless; a periodic blocking readback of the step's loss anchors
+    them), reporting steps/sec + optional MFU against the step's XLA FLOPs
+    estimate. Results land in a JSONL stream under model_dir/profiling/.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+
+from tensor2robot_tpu.config import configurable
+from tensor2robot_tpu.hooks.hook_builder import Hook, HookBuilder, HookContext
+
+
+class ProfilerHook(Hook):
+    def __init__(self, log_dir: str, start_step: int, num_steps: int):
+        self._log_dir = log_dir
+        self._start = start_step
+        self._stop = start_step + num_steps
+        self._active = False
+        self._done = False
+
+    def before_step(self, ctx: HookContext) -> None:
+        # >= (not a range check): in the multi-step regime ctx.step advances
+        # by iterations_per_loop and may never land inside the window.
+        if not self._active and not self._done and ctx.step >= self._start:
+            log_dir = self._log_dir
+            if not os.path.isabs(log_dir) and ctx.model_dir:
+                log_dir = os.path.join(ctx.model_dir, log_dir)
+            os.makedirs(log_dir, exist_ok=True)
+            jax.profiler.start_trace(log_dir)
+            self._active = True
+
+    def after_step(self, ctx: HookContext) -> None:
+        if self._active and ctx.step >= self._stop:
+            self._finish(ctx)
+
+    def _finish(self, ctx: HookContext) -> None:
+        # Drain in-flight device work so the trace holds whole steps.
+        if ctx.device_metrics is not None:
+            jax.block_until_ready(ctx.device_metrics)
+        jax.profiler.stop_trace()
+        self._active = False
+        self._done = True
+
+    def on_train_end(self, ctx: HookContext) -> None:
+        if self._active:
+            self._finish(ctx)
+
+
+@configurable("ProfilerHookBuilder")
+class ProfilerHookBuilder(HookBuilder):
+    """Trace steps [start_step, start_step + num_steps) into
+    model_dir/profiling/ (or an explicit log_dir)."""
+
+    def __init__(
+        self,
+        start_step: int = 10,
+        num_steps: int = 5,
+        log_dir: Optional[str] = None,
+    ):
+        self._start_step = start_step
+        self._num_steps = num_steps
+        self._log_dir = log_dir
+
+    def create_hooks(self, t2r_model, trainer=None) -> List[Hook]:
+        del t2r_model, trainer
+        log_dir = self._log_dir or "profiling"
+        return [ProfilerHook(log_dir, self._start_step, self._num_steps)]
+
+
+class StepTimingHook(Hook):
+    def __init__(
+        self,
+        sync_every: int,
+        flops_per_step: Optional[float],
+        peak_flops: Optional[float],
+        output_path: Optional[str],
+    ):
+        self._sync_every = sync_every
+        self._flops = flops_per_step
+        self._peak = peak_flops
+        self._path = output_path
+        self._t_anchor: Optional[float] = None
+        self._anchor_step: Optional[int] = None
+        self._rows: List[Dict[str, Any]] = []
+
+    def after_step(self, ctx: HookContext) -> None:
+        # Steps-since-anchor gate (not step % N == 0): multi-step dispatch
+        # advances ctx.step by iterations_per_loop, which may never hit an
+        # exact multiple of sync_every.
+        if (
+            self._anchor_step is not None
+            and ctx.step - self._anchor_step < self._sync_every
+        ):
+            return
+        # Anchor the clock with a real device sync: the loop dispatches
+        # asynchronously, so only a blocking readback marks completed work.
+        if ctx.device_metrics is not None:
+            jax.block_until_ready(ctx.device_metrics)
+            loss = ctx.device_metrics.get("loss")
+            if loss is not None:
+                float(jax.device_get(loss))
+        now = time.perf_counter()
+        if self._t_anchor is not None and ctx.step > self._anchor_step:
+            steps = ctx.step - self._anchor_step
+            steps_per_sec = steps / max(now - self._t_anchor, 1e-9)
+            row: Dict[str, Any] = {
+                "step": ctx.step,
+                "steps_per_sec": round(steps_per_sec, 4),
+            }
+            if self._flops:
+                row["model_flops_per_sec"] = self._flops * steps_per_sec
+                if self._peak:
+                    row["mfu"] = round(
+                        self._flops * steps_per_sec / self._peak, 5
+                    )
+            self._rows.append(row)
+            if self._path is not None:
+                path = self._path
+                if not os.path.isabs(path) and ctx.model_dir:
+                    path = os.path.join(ctx.model_dir, path)
+                os.makedirs(os.path.dirname(path), exist_ok=True)
+                with open(path, "a") as f:
+                    f.write(json.dumps(row) + "\n")
+        self._t_anchor = now
+        self._anchor_step = ctx.step
+
+    @property
+    def rows(self) -> List[Dict[str, Any]]:
+        return self._rows
+
+
+@configurable("StepTimingHookBuilder")
+class StepTimingHookBuilder(HookBuilder):
+    """Synced steps/sec (+MFU when FLOPs known) into
+    model_dir/profiling/step_timing.jsonl."""
+
+    def __init__(
+        self,
+        sync_every: int = 50,
+        flops_per_step: Optional[float] = None,
+        peak_flops: Optional[float] = None,
+        output_path: Optional[str] = "profiling/step_timing.jsonl",
+    ):
+        self._sync_every = sync_every
+        self._flops = flops_per_step
+        self._peak = peak_flops
+        self._output_path = output_path
+        self.hook: Optional[StepTimingHook] = None
+
+    def create_hooks(self, t2r_model, trainer=None) -> List[Hook]:
+        del t2r_model, trainer
+        self.hook = StepTimingHook(
+            self._sync_every, self._flops, self._peak, self._output_path
+        )
+        return [self.hook]

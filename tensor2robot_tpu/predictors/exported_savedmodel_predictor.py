@@ -130,19 +130,12 @@ class ExportedSavedModelPredictor(AbstractPredictor):
             raise ValueError("init_randomly requires t2r_model.")
         from tensor2robot_tpu.predictors.saved_model_v2_predictor import (
             build_model_code_serving_fn,
+            make_random_loaded,
         )
 
         predict_fn, generator = build_model_code_serving_fn(self._t2r_model)
-
-        class _RandomLoaded:
-            export_dir = "<random-init>"
-            global_step = 0
-            feature_spec = generator.serving_input_spec()
-            label_spec = generator.label_spec
-            metadata: Dict[str, Any] = {}
-
         with self._lock:
-            self._loaded = _RandomLoaded()  # type: ignore[assignment]
+            self._loaded = make_random_loaded(generator)  # type: ignore[assignment]
             self._predict_fn = predict_fn
 
     # -- predict --------------------------------------------------------------

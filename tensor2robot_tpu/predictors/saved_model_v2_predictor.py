@@ -73,6 +73,20 @@ def build_model_code_serving_fn(
     return predict_fn, generator
 
 
+def make_random_loaded(generator):
+    """A stand-in for ExportedModel carrying randomly-initialized serving
+    state — what init_randomly predictors report as their loaded artifact."""
+
+    class _RandomLoaded:
+        export_dir = "<random-init>"
+        global_step = 0
+        feature_spec = generator.serving_input_spec()
+        label_spec = generator.label_spec
+        metadata: Dict[str, Any] = {}
+
+    return _RandomLoaded()
+
+
 def _resolve_export_dir(saved_model_path: str) -> Optional[str]:
     """A specific export version dir passes through; a root resolves to its
     latest version."""
@@ -156,15 +170,7 @@ class SavedModelCodePredictor(SavedModelPredictorBase):
 
     def init_randomly(self) -> None:
         predict_fn, generator = build_model_code_serving_fn(self._t2r_model)
-
-        class _RandomLoaded:
-            export_dir = "<random-init>"
-            global_step = 0
-            feature_spec = generator.serving_input_spec()
-            label_spec = generator.label_spec
-            metadata: Dict[str, Any] = {}
-
-        self._loaded = _RandomLoaded()  # type: ignore[assignment]
+        self._loaded = make_random_loaded(generator)  # type: ignore[assignment]
         self._predict_fn = predict_fn
 
 
