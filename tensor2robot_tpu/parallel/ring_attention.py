@@ -30,29 +30,10 @@ except ImportError:  # older jax
     from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
+from tensor2robot_tpu.ops.flash_attention import reference_attention
 from tensor2robot_tpu.parallel.mesh import SEQUENCE_AXIS
 
 _NEG_INF = -1e30
-
-
-def reference_attention(
-    q: jax.Array,
-    k: jax.Array,
-    v: jax.Array,
-    causal: bool = False,
-    scale: Optional[float] = None,
-) -> jax.Array:
-    """Plain full attention over [B, S, H, D] — the numerics oracle the
-    ring implementation must match."""
-    scale = scale if scale is not None else q.shape[-1] ** -0.5
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
-    if causal:
-        q_pos = jnp.arange(q.shape[1])
-        k_pos = jnp.arange(k.shape[1])
-        mask = q_pos[:, None] >= k_pos[None, :]
-        logits = jnp.where(mask[None, None], logits, _NEG_INF)
-    probs = jax.nn.softmax(logits, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
 def _block_attend(q, k_blk, v_blk, q_offset, k_offset, scale, causal):
@@ -73,9 +54,16 @@ def _block_attend(q, k_blk, v_blk, q_offset, k_offset, scale, causal):
     return o, l, m
 
 
-def _ring_shard_fn(q, k, v, axis_name: str, causal: bool, scale: float):
-    """Per-device body: q is resident; k/v circulate the ring."""
-    axis_size = lax.psum(1, axis_name)
+def _ring_shard_fn(
+    q, k, v, *, axis_name: str, causal: bool, scale: float,
+    axis_size: int, use_flash: bool = False, interpret: bool = False,
+):
+    """Per-device body: q is resident; k/v circulate the ring.
+
+    axis_size is static (the mesh is known at trace time), so the ring is
+    unrolled: XLA schedules each hop's ppermute DMA against the next hop's
+    compute without a loop counter in the way.
+    """
     my_index = lax.axis_index(axis_name)
     block = q.shape[1]
     q_offset = my_index * block
@@ -84,9 +72,10 @@ def _ring_shard_fn(q, k, v, axis_name: str, causal: bool, scale: float):
     o_acc = jnp.zeros(q.shape, jnp.float32)
     l_acc = jnp.zeros((batch, heads, block), jnp.float32)
     m_acc = jnp.full((batch, heads, block), _NEG_INF, jnp.float32)
-    # Mark the device-local accumulators as varying over the ring axis so
-    # the fori_loop carry types line up with the axis-index-dependent
-    # updates (shard_map's varying-axes tracking).
+    # Mark the device-local accumulators as varying over the ring axis:
+    # shard_map's vma tracking (when check_vma is on, the reference path)
+    # requires them to match the axis-index-dependent tile updates they
+    # accumulate.
     if hasattr(lax, "pvary"):
         o_acc, l_acc, m_acc = lax.pvary((o_acc, l_acc, m_acc), (axis_name,))
     perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
@@ -94,10 +83,21 @@ def _ring_shard_fn(q, k, v, axis_name: str, causal: bool, scale: float):
     def body(i, carry):
         o_acc, l_acc, m_acc, k_blk, v_blk = carry
         # Block i arrived from the device i hops ring-upstream.
-        src_index = (my_index - i) % axis_size
-        o_blk, l_blk, m_blk = _block_attend(
-            q, k_blk, v_blk, q_offset, src_index * block, scale, causal
-        )
+        src_index = lax.rem(my_index - i + axis_size, axis_size)
+        if use_flash:
+            # Pallas flash tile: the per-hop hot op, no [Sq, Sk] logits in
+            # HBM (ops/flash_attention.py).
+            from tensor2robot_tpu.ops.flash_attention import flash_attention_tile
+
+            o_blk, l_blk, m_blk = flash_attention_tile(
+                q, k_blk, v_blk, causal=causal, scale=scale,
+                q_offset=q_offset, k_offset=src_index * block,
+                interpret=interpret, vma=(axis_name,),
+            )
+        else:
+            o_blk, l_blk, m_blk = _block_attend(
+                q, k_blk, v_blk, q_offset, src_index * block, scale, causal
+            )
         # Online-softmax merge of the new tile into the running state.
         m_new = jnp.maximum(m_acc, m_blk)
         alpha = jnp.exp(m_acc - m_new)
@@ -114,9 +114,10 @@ def _ring_shard_fn(q, k, v, axis_name: str, causal: bool, scale: float):
         v_next = lax.ppermute(v_blk, axis_name, perm)
         return o_new, l_new, m_new, k_next, v_next
 
-    o_acc, l_acc, m_acc, _, _ = lax.fori_loop(
-        0, axis_size, body, (o_acc, l_acc, m_acc, k, v)
-    )
+    carry = (o_acc, l_acc, m_acc, k, v)
+    for i in range(axis_size):  # static unroll — axis_size is mesh shape
+        carry = body(i, carry)
+    o_acc, l_acc, m_acc, _, _ = carry
     l_acc = jnp.maximum(l_acc, 1e-30)
     out = o_acc / jnp.transpose(l_acc, (0, 2, 1))[..., None]
     return out.astype(q.dtype)
@@ -130,6 +131,8 @@ def ring_attention(
     axis_name: str = SEQUENCE_AXIS,
     causal: bool = False,
     scale: Optional[float] = None,
+    use_flash: Optional[bool] = None,
+    interpret: bool = False,
 ) -> jax.Array:
     """Sequence-parallel attention over `mesh`'s `axis_name`.
 
@@ -140,6 +143,9 @@ def ring_attention(
       axis_name: mesh axis carrying the sequence shards.
       causal: apply causal masking over *global* positions.
       scale: logit scale; defaults to dim ** -0.5.
+      use_flash: per-hop tiles via the Pallas flash kernel
+        (ops/flash_attention.py). Default: on for the TPU backend.
+      interpret: run the Pallas kernel in interpreter mode (tests on CPU).
 
     Returns:
       [batch, seq, heads, dim] attention output, sequence-sharded like q.
@@ -153,13 +159,59 @@ def ring_attention(
             f"axis size {axis_size}."
         )
     scale = scale if scale is not None else q.shape[-1] ** -0.5
+    if use_flash is None:
+        # Flash is the TPU default; interpret=True keeps it on (interpreted)
+        # so CPU tests exercise the same kernel the TPU compiles.
+        use_flash = jax.default_backend() == "tpu" or interpret
+    if use_flash:
+        return _ring_flash(q, k, v, mesh, axis_name, causal, scale, interpret)
+    return _ring_call(q, k, v, mesh, axis_name, causal, scale, False, False)
+
+
+def _ring_call(q, k, v, mesh, axis_name, causal, scale, use_flash, interpret):
+    axis_size = mesh.shape[axis_name]
     spec = P(None, axis_name, None, None)
+    extra = {}
+    if use_flash:
+        # Pallas kernels inside shard_map trip the varying-manual-axes
+        # checker (jax recommends check_vma=False as the workaround); the
+        # reference path keeps full checking.
+        extra["check_vma"] = False
     fn = shard_map(
         functools.partial(
-            _ring_shard_fn, axis_name=axis_name, causal=causal, scale=scale
+            _ring_shard_fn, axis_name=axis_name, causal=causal, scale=scale,
+            axis_size=axis_size, use_flash=use_flash, interpret=interpret,
         ),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
+        **extra,
     )
     return fn(q, k, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _ring_flash(q, k, v, mesh, axis_name, causal, scale, interpret):
+    """Flash-tile ring forward with a reference-ring backward: pallas_call
+    has no autodiff rule, so gradients recompute the attention through the
+    einsum ring (exact same math; see ops/flash_attention._bwd)."""
+    return _ring_call(q, k, v, mesh, axis_name, causal, scale, True, interpret)
+
+
+def _ring_flash_fwd(q, k, v, mesh, axis_name, causal, scale, interpret):
+    out = _ring_flash(q, k, v, mesh, axis_name, causal, scale, interpret)
+    return out, (q, k, v)
+
+
+def _ring_flash_bwd(mesh, axis_name, causal, scale, interpret, residuals, g):
+    q, k, v = residuals
+    _, vjp = jax.vjp(
+        lambda q, k, v: _ring_call(
+            q, k, v, mesh, axis_name, causal, scale, False, False
+        ),
+        q, k, v,
+    )
+    return vjp(g)
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
