@@ -164,6 +164,11 @@ def flash_attention_tile(
     vma: mesh axis names the outputs vary over — required when called
     inside shard_map (the ring passes its sequence axis).
     """
+    if not interpret and jax.default_backend() != "tpu":
+        raise ValueError(
+            "flash_attention_tile compiles only on TPU; pass interpret=True "
+            "to run the kernel in interpreter mode on this backend."
+        )
     from jax.experimental.pallas import tpu as pltpu  # noqa: F401
 
     batch, s_q, heads, dim = q.shape
@@ -212,13 +217,19 @@ def flash_attention_tile(
 
 
 def _pick_block(size: int, preferred: int) -> Optional[int]:
-    """Largest divisor of `size` that is <= preferred (None if size == 0)."""
+    """Usable kernel block size for a sequence dim: the whole dim when it
+    fits one block, else the largest divisor <= preferred that is still
+    MXU/VPU-viable (>= 8 rows). None -> no viable blocking (prime-ish
+    lengths); callers fall back to the einsum reference rather than run a
+    degenerate (1, D)-block grid."""
     if size <= 0:
         return None
-    block = min(size, preferred)
-    while size % block:
-        block -= 1
-    return block
+    if size <= preferred:
+        return size
+    for block in range(preferred, 7, -1):
+        if size % block == 0:
+            return block
+    return None
 
 
 def _flash_attention_fwd_impl(

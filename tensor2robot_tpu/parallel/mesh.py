@@ -113,25 +113,41 @@ def shard_batch(batch, mesh: Mesh):
     return jax.tree_util.tree_map(put, batch)
 
 
-def fsdp_param_sharding(mesh: Mesh, min_weight_size: int = 2 ** 14):
-    """Returns a tree-map-able rule sharding large parameter leaves over the
-    fsdp axis (largest dim that divides), replicating small ones."""
-    axis_size = mesh.shape[FSDP_AXIS]
+def param_sharding(mesh: Mesh, min_weight_size: int = 2 ** 14):
+    """Tree-map-able parameter sharding rule over the fsdp and model axes.
+
+    Tensor parallelism: matrix/conv-kernel leaves shard their OUTPUT dim
+    (last axis — flax dense kernels are [in, out], conv kernels HWIO) over
+    the `model` axis; GSPMD then propagates the sharding through the
+    matmul and inserts the per-layer collectives (the Megatron column
+    split). FSDP: the largest remaining divisible dim shards over `fsdp`
+    (ZeRO-3-style parameter sharding; gathered on use). Small leaves stay
+    replicated — sharding a bias buys nothing and costs collectives.
+    """
+    model_size = mesh.shape[MODEL_AXIS]
+    fsdp_size = mesh.shape[FSDP_AXIS]
 
     def rule(leaf):
-        if not hasattr(leaf, "shape") or axis_size == 1:
+        shape = getattr(leaf, "shape", None)
+        if (
+            shape is None
+            or (model_size == 1 and fsdp_size == 1)
+            or np.prod(shape) < min_weight_size
+        ):
             return NamedSharding(mesh, PartitionSpec())
-        if np.prod(leaf.shape) < min_weight_size:
-            return NamedSharding(mesh, PartitionSpec())
-        # Shard the largest divisible dimension.
-        dims = sorted(
-            range(len(leaf.shape)), key=lambda i: leaf.shape[i], reverse=True
-        )
-        for dim in dims:
-            if leaf.shape[dim] % axis_size == 0:
-                spec = [None] * len(leaf.shape)
-                spec[dim] = FSDP_AXIS
-                return NamedSharding(mesh, PartitionSpec(*spec))
-        return NamedSharding(mesh, PartitionSpec())
+        spec = [None] * len(shape)
+        if model_size > 1 and len(shape) >= 2 and shape[-1] % model_size == 0:
+            spec[-1] = MODEL_AXIS
+        if fsdp_size > 1:
+            dims = sorted(
+                range(len(shape)), key=lambda i: shape[i], reverse=True
+            )
+            for dim in dims:
+                if spec[dim] is None and shape[dim] % fsdp_size == 0:
+                    spec[dim] = FSDP_AXIS
+                    break
+        return NamedSharding(mesh, PartitionSpec(*spec))
 
     return rule
+
+

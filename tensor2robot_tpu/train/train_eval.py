@@ -80,12 +80,14 @@ class CompiledModel:
         model: AbstractT2RModel,
         mesh=None,
         donate_state: bool = True,
+        param_min_shard_size: int = 2 ** 14,
     ):
         self.model = model
         self.mesh = mesh if mesh is not None else mesh_lib.make_mesh()
         self.preprocessor = model.preprocessor
         self.optimizer = model.create_optimizer()
         self._donate = donate_state
+        self._param_min_shard_size = param_min_shard_size
 
         def train_step(state: TrainState, batch, rng):
             step_rng = jax.random.fold_in(rng, state.step)
@@ -172,12 +174,18 @@ class CompiledModel:
             rng=jax.random.PRNGKey(0),
         )
         state = create_train_state(self.model, rng, features, self.optimizer)
-        if self.mesh.shape[mesh_lib.FSDP_AXIS] > 1:
-            # FSDP regime: large parameter (and mirrored optimizer/EMA)
-            # leaves sharded over the fsdp axis; small leaves replicated.
-            # GSPMD propagates these shardings through the elementwise
-            # optimizer update, so params stay sharded across steps.
-            rule = mesh_lib.fsdp_param_sharding(self.mesh)
+        if (
+            self.mesh.shape[mesh_lib.FSDP_AXIS] > 1
+            or self.mesh.shape[mesh_lib.MODEL_AXIS] > 1
+        ):
+            # Sharded-parameter regimes: fsdp shards large leaves (and the
+            # mirrored optimizer/EMA copies) ZeRO-style; the model axis
+            # column-splits kernels for tensor parallelism. GSPMD
+            # propagates these shardings through the optimizer update, so
+            # params stay sharded across steps.
+            rule = mesh_lib.param_sharding(
+                self.mesh, min_weight_size=self._param_min_shard_size
+            )
             return jax.tree_util.tree_map(
                 lambda x: jax.device_put(x, rule(x)), state
             )

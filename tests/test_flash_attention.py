@@ -167,3 +167,26 @@ class TestRingWithFlashTiles:
         out = flash_attention(q, q, q, causal=True, interpret=False)
         ref = reference_attention(q, q, q, causal=True)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref))
+
+    def test_prime_length_falls_back_to_reference(self):
+        """No MXU-viable block divides a prime length > block size: the
+        documented einsum fallback must actually engage."""
+        from tensor2robot_tpu.ops.flash_attention import _pick_block
+
+        assert _pick_block(257, 128) is None
+        assert _pick_block(64, 128) == 64   # single block
+        assert _pick_block(256, 128) == 128
+        rng = np.random.RandomState(4)
+        q = jnp.asarray(rng.randn(1, 257, 2, 8).astype(np.float32))
+        out = flash_attention(q, q, q, causal=True, interpret=True)
+        ref = reference_attention(q, q, q, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+        )
+
+    def test_tile_raises_clear_error_off_tpu(self):
+        with pytest.raises(ValueError, match="interpreter mode"):
+            flash_attention_tile(
+                jnp.zeros((1, 16, 1, 8)), jnp.zeros((1, 16, 1, 8)),
+                jnp.zeros((1, 16, 1, 8)), interpret=False,
+            )
