@@ -223,6 +223,10 @@ class RecordDataset:
         reference FractionalRecordInputGenerator).
       num_parse_workers: thread-pool size for parallel proto-parse and
         jpeg decode; None -> default_parse_workers(), 0 -> synchronous.
+      shard_by_host: in multi-host runs, each process reads only its
+        round-robin slice of the file list (the reference's per-host
+        infeed, utils/tfdata.py:38-61); batch_size is then the PER-HOST
+        batch. Single-process runs are unaffected.
     """
 
     def __init__(
@@ -239,6 +243,7 @@ class RecordDataset:
         drop_remainder: bool = True,
         file_fraction: float = 1.0,
         num_parse_workers: Optional[int] = None,
+        shard_by_host: bool = False,
     ):
         self._parser = SpecParser(specs)
         self._batch_size = batch_size
@@ -265,6 +270,20 @@ class RecordDataset:
             for k, files in self._files.items():
                 n = max(1, int(len(files) * file_fraction))
                 self._files[k] = files[:n]
+        if shard_by_host:
+            import jax
+
+            index, count = jax.process_index(), jax.process_count()
+            if count > 1:
+                for k, files in self._files.items():
+                    mine = files[index::count]
+                    if not mine:
+                        raise ValueError(
+                            f"Host {index}/{count} got no files for dataset "
+                            f"{k!r} ({len(files)} files total); need at "
+                            "least one shard per host."
+                        )
+                    self._files[k] = mine
         missing = set(self._parser.dataset_keys) - set(self._files.keys())
         if missing:
             raise ValueError(

@@ -120,6 +120,8 @@ class DefaultRecordInputGenerator(AbstractInputGenerator):
         seed: Optional[int] = None,
         file_fraction: float = 1.0,
         prefetch_depth: int = 2,
+        num_parse_workers: Optional[int] = None,
+        shard_by_host: bool = False,
     ):
         super().__init__(batch_size=batch_size)
         if (file_patterns is None) == (dataset_map is None):
@@ -129,6 +131,8 @@ class DefaultRecordInputGenerator(AbstractInputGenerator):
         self._seed = seed
         self._file_fraction = file_fraction
         self._prefetch_depth = prefetch_depth
+        self._num_parse_workers = num_parse_workers
+        self._shard_by_host = shard_by_host
 
     def _create_dataset(self, mode: str) -> Iterator[TensorSpecStruct]:
         dataset = RecordDataset(
@@ -140,6 +144,8 @@ class DefaultRecordInputGenerator(AbstractInputGenerator):
             seed=self._seed,
             file_fraction=self._file_fraction,
             prefetch_depth=self._prefetch_depth,
+            num_parse_workers=self._num_parse_workers,
+            shard_by_host=self._shard_by_host,
         )
         return iter(dataset)
 

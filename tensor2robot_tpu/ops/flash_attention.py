@@ -177,6 +177,12 @@ def flash_attention_tile(
     scale = scale if scale is not None else dim ** -0.5
     bq = _pick_block(s_q, block_q)
     bk = _pick_block(s_k, block_k)
+    if bq is None or bk is None:
+        raise ValueError(
+            f"No MXU-viable block divides shard lengths (q={s_q}, k={s_k}); "
+            "use the reference path (ring_attention use_flash=False) for "
+            "these shapes."
+        )
     offsets = jnp.stack(
         [jnp.asarray(q_offset, jnp.int32), jnp.asarray(k_offset, jnp.int32)]
     )

@@ -163,6 +163,15 @@ def ring_attention(
         # Flash is the TPU default; interpret=True keeps it on (interpreted)
         # so CPU tests exercise the same kernel the TPU compiles.
         use_flash = jax.default_backend() == "tpu" or interpret
+        if use_flash:
+            # Per-device shard lengths must admit a viable kernel block;
+            # otherwise quietly keep the einsum path (an explicit
+            # use_flash=True with bad shapes raises in the tile instead).
+            from tensor2robot_tpu.ops.flash_attention import _pick_block
+
+            local = q.shape[1] // axis_size
+            if _pick_block(local, 128) is None:
+                use_flash = False
     if use_flash:
         return _ring_flash(q, k, v, mesh, axis_name, causal, scale, interpret)
     return _ring_call(q, k, v, mesh, axis_name, causal, scale, False, False)

@@ -306,21 +306,36 @@ def evaluate(
     eval_steps: Optional[int] = None,
     use_ema: bool = False,
 ) -> Dict[str, float]:
-    """Averages model_eval_fn metrics over up to eval_steps batches."""
-    totals: Dict[str, float] = {}
+    """Averages model_eval_fn metrics over up to eval_steps batches.
+
+    Accumulates on-device: steps dispatch back-to-back (transfers
+    double-buffered) and the host reads the totals once at the end, rather
+    than a blocking device_get per batch.
+    """
+    if eval_steps is not None:
+        eval_batches = itertools.islice(eval_batches, eval_steps)
+    totals: Optional[Dict[str, jax.Array]] = None
     count = 0
-    for i, batch in enumerate(eval_batches):
-        if eval_steps is not None and i >= eval_steps:
-            break
-        batch = compiled.shard_batch(batch)
+    for batch in infeed.device_prefetch(
+        eval_batches, compiled.shard_batch, depth=2
+    ):
         metrics = compiled.eval_step(state, batch, use_ema)
-        metrics = jax.device_get(metrics)
-        for key, value in metrics.items():
-            totals[key] = totals.get(key, 0.0) + float(value)
+        # Accumulate in f32: bf16 metric scalars would saturate (spacing 2
+        # past 256) over long eval runs.
+        metrics = {
+            key: value.astype(jnp.float32) for key, value in metrics.items()
+        }
+        if totals is None:
+            totals = metrics
+        else:
+            totals = {
+                key: totals[key] + value for key, value in metrics.items()
+            }
         count += 1
-    if count == 0:
+    if not count or totals is None:
         return {}
-    return {key: value / count for key, value in totals.items()}
+    host_totals = jax.device_get(totals)
+    return {key: float(value) / count for key, value in host_totals.items()}
 
 
 # -- the entry point ----------------------------------------------------------

@@ -542,3 +542,23 @@ class TestCompression:
         assert len(compressed["frames"][0]) == 4
         restored = create_decompress_fn(spec)(compressed)
         np.testing.assert_array_equal(restored["frames"], batch["frames"])
+
+
+class TestHostSharding:
+    def test_single_process_unaffected(self, tmp_path):
+        spec = TensorSpecStruct()
+        spec["y"] = ExtendedTensorSpec(shape=(), dtype=np.int64, name="y")
+        for shard in range(4):
+            tfrecord.write_tfrecords(
+                str(tmp_path / f"s-{shard}.tfrecord"),
+                [encode_example(spec, {"y": np.asarray(shard, np.int64)})],
+            )
+        dataset = RecordDataset(
+            specs=spec,
+            file_patterns=str(tmp_path / "s-*.tfrecord"),
+            batch_size=2,
+            mode="eval",
+            shard_by_host=True,  # process_count()==1 -> no-op
+        )
+        ys = np.concatenate([b["y"] for b in dataset])
+        assert sorted(ys.tolist()) == [0, 1, 2, 3]
