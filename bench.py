@@ -324,12 +324,41 @@ def main() -> None:
         elapsed = time.perf_counter() - start
         steps_per_sec = steps / elapsed
 
+        # Multi-step dispatch (iterations_per_loop equivalent): K scanned
+        # steps per host round-trip amortize tunnel/dispatch latency. The
+        # headline is the better of the two regimes.
+        scan_steps_per_sec = 0.0
+        try:
+            scan_k = int(os.environ.get("BENCH_SCAN_K", "10"))
+        except ValueError:
+            scan_k = 0  # malformed env: skip the optional path, keep per-step
+        if scan_k > 1:
+            try:
+                from tensor2robot_tpu.train import infeed
+
+                stacked = infeed.shard_stacked_batch(
+                    infeed.stack_batches([batch] * scan_k), compiled.mesh
+                )
+                state, m = compiled.train_scan(state, stacked, rng)
+                float(jax.device_get(m["loss"][-1]))  # warmup/compile
+                n_loops = max(2, steps // scan_k)
+                start = time.perf_counter()
+                for _ in range(n_loops):
+                    state, m = compiled.train_scan(state, stacked, rng)
+                float(jax.device_get(m["loss"][-1]))
+                scan_elapsed = time.perf_counter() - start
+                scan_steps_per_sec = n_loops * scan_k / scan_elapsed
+            except Exception as scan_err:  # noqa: BLE001 — report per-step
+                # numbers rather than dying on the optimization path.
+                print(f"bench: scan path failed: {scan_err}", file=sys.stderr)
+        best_steps_per_sec = max(steps_per_sec, scan_steps_per_sec)
+
         peak = _peak_flops(device)
-        mfu = flops_per_step * steps_per_sec / peak
+        mfu = flops_per_step * best_steps_per_sec / peak
         if mfu > 1.0:
             raise RuntimeError(
                 f"implied MFU {mfu:.2f} exceeds 1.0 — timing did not "
-                f"capture real execution ({steps_per_sec:.1f} steps/s, "
+                f"capture real execution ({best_steps_per_sec:.1f} steps/s, "
                 f"{flops_per_step:.3g} flops/step); refusing to report a "
                 "bogus number"
             )
@@ -340,7 +369,9 @@ def main() -> None:
                 "unit": "fraction_of_peak",
                 "vs_baseline": round(mfu / 0.50, 4),
                 "detail": {
-                    "steps_per_sec": round(steps_per_sec, 3),
+                    "steps_per_sec": round(best_steps_per_sec, 3),
+                    "per_step_dispatch_steps_per_sec": round(steps_per_sec, 3),
+                    "scan_dispatch_steps_per_sec": round(scan_steps_per_sec, 3),
                     "flops_per_step": flops_per_step,
                     "flops_source": flops_source,
                     "device_kind": getattr(device, "device_kind", "?"),

@@ -332,6 +332,11 @@ def evaluate(
                 key: totals[key] + value for key, value in metrics.items()
             }
         count += 1
+        if count % 32 == 0:
+            # Periodic sync: without it nothing bounds the dispatch queue
+            # and long evals pile batches up on the device. A readback of
+            # one accumulated scalar drains everything queued so far.
+            jax.device_get(next(iter(totals.values())))
     if not count or totals is None:
         return {}
     host_totals = jax.device_get(totals)

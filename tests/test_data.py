@@ -545,6 +545,53 @@ class TestCompression:
 
 
 class TestHostSharding:
+    def _write_shards(self, tmp_path, n=4):
+        spec = TensorSpecStruct()
+        spec["y"] = ExtendedTensorSpec(shape=(), dtype=np.int64, name="y")
+        for shard in range(n):
+            tfrecord.write_tfrecords(
+                str(tmp_path / f"s-{shard}.tfrecord"),
+                [encode_example(spec, {"y": np.asarray(shard, np.int64)})],
+            )
+        return spec
+
+    def test_hosts_get_disjoint_complete_slices(self, tmp_path, monkeypatch):
+        import jax
+
+        spec = self._write_shards(tmp_path)
+        seen = []
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        for host in range(2):
+            monkeypatch.setattr(jax, "process_index", lambda h=host: h)
+            dataset = RecordDataset(
+                specs=spec,
+                file_patterns=str(tmp_path / "s-*.tfrecord"),
+                batch_size=1,
+                mode="eval",
+                drop_remainder=False,
+                shard_by_host=True,
+            )
+            seen.append(
+                sorted(int(b["y"][0]) for b in dataset)
+            )
+        # Round-robin over the sorted file list: disjoint and complete.
+        assert seen[0] == [0, 2] and seen[1] == [1, 3]
+
+    def test_host_without_files_raises(self, tmp_path, monkeypatch):
+        import jax
+
+        spec = self._write_shards(tmp_path, n=1)
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(jax, "process_index", lambda: 1)
+        with pytest.raises(ValueError, match="no files"):
+            RecordDataset(
+                specs=spec,
+                file_patterns=str(tmp_path / "s-*.tfrecord"),
+                batch_size=1,
+                mode="eval",
+                shard_by_host=True,
+            )
+
     def test_single_process_unaffected(self, tmp_path):
         spec = TensorSpecStruct()
         spec["y"] = ExtendedTensorSpec(shape=(), dtype=np.int64, name="y")
