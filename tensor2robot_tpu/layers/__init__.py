@@ -36,3 +36,8 @@ from tensor2robot_tpu.layers.vision_layers import (
     ImagesToFeaturesNet,
     apply_film,
 )
+from tensor2robot_tpu.layers.transformer import (
+    MultiHeadAttention,
+    TransformerBlock,
+    TransformerEncoder,
+)

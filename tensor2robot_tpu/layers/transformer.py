@@ -1,0 +1,139 @@
+"""Transformer blocks on the flash-attention hot op.
+
+Beyond the reference layer library (its temporal models top out at
+SNAIL/TCN scale, layers/snail.py; SURVEY §5 long-context row): a standard
+pre-norm transformer whose attention routes through ops/flash_attention —
+single-device flash on TPU, and sequence-parallel ring attention
+(parallel/ring_attention.py) when constructed with a mesh whose `sequence`
+axis is >1. Sequence length lives in the specs, so the same model trains
+short episodes on one chip and long contexts on a CP mesh without code
+changes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tensor2robot_tpu.ops import flash_attention as flash_lib
+from tensor2robot_tpu.parallel import mesh as mesh_lib
+
+
+class MultiHeadAttention(nn.Module):
+    """Self-attention over [batch, seq, features].
+
+    mesh: when given with a sequence axis > 1, attention runs the
+    sequence-parallel ring; otherwise the single-device flash kernel
+    (with its reference fallback off-TPU).
+    """
+
+    num_heads: int
+    head_dim: int
+    causal: bool = True
+    mesh: Optional[object] = None
+    use_flash: Optional[bool] = None
+    interpret: bool = False
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        batch, seq, _ = x.shape
+        features = self.num_heads * self.head_dim
+        qkv = nn.Dense(3 * features, use_bias=False, name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return t.reshape(batch, seq, self.num_heads, self.head_dim)
+
+        q, k, v = heads(q), heads(k), heads(v)
+        if (
+            self.mesh is not None
+            and self.mesh.shape[mesh_lib.SEQUENCE_AXIS] > 1
+        ):
+            from tensor2robot_tpu.parallel.ring_attention import ring_attention
+
+            out = ring_attention(
+                q, k, v, mesh=self.mesh, causal=self.causal,
+                use_flash=self.use_flash, interpret=self.interpret,
+            )
+        elif self.use_flash is False:
+            # Explicit opt-out: the einsum reference on any backend.
+            out = flash_lib.reference_attention(q, k, v, causal=self.causal)
+        else:
+            out = flash_lib.flash_attention(
+                q, k, v, causal=self.causal, interpret=self.interpret
+            )
+        out = out.reshape(batch, seq, features)
+        return nn.Dense(x.shape[-1], use_bias=False, name="out")(out)
+
+
+class TransformerBlock(nn.Module):
+    """Pre-norm block: x + MHA(LN(x)); x + MLP(LN(x))."""
+
+    num_heads: int
+    head_dim: int
+    mlp_ratio: int = 4
+    causal: bool = True
+    mesh: Optional[object] = None
+    use_flash: Optional[bool] = None
+    interpret: bool = False
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = x + MultiHeadAttention(
+            num_heads=self.num_heads,
+            head_dim=self.head_dim,
+            causal=self.causal,
+            mesh=self.mesh,
+            use_flash=self.use_flash,
+            interpret=self.interpret,
+            name="attention",
+        )(nn.LayerNorm(name="ln_attn")(x))
+        h = nn.LayerNorm(name="ln_mlp")(x)
+        h = nn.Dense(self.mlp_ratio * x.shape[-1], name="mlp_in")(h)
+        h = nn.gelu(h)
+        h = nn.Dense(x.shape[-1], name="mlp_out")(h)
+        return x + h
+
+
+class TransformerEncoder(nn.Module):
+    """N pre-norm blocks with learned positional embeddings over
+    [batch, seq, features]; final LayerNorm."""
+
+    num_layers: int
+    num_heads: int
+    head_dim: int
+    max_seq_len: int = 2048
+    mlp_ratio: int = 4
+    causal: bool = True
+    mesh: Optional[object] = None
+    use_flash: Optional[bool] = None
+    interpret: bool = False
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        batch, seq, features = x.shape
+        if seq > self.max_seq_len:
+            raise ValueError(
+                f"sequence length {seq} exceeds max_seq_len={self.max_seq_len}"
+            )
+        positions = self.param(
+            "pos_embedding",
+            nn.initializers.normal(0.02),
+            (self.max_seq_len, features),
+        )
+        x = x + positions[None, :seq, :]
+        for i in range(self.num_layers):
+            x = TransformerBlock(
+                num_heads=self.num_heads,
+                head_dim=self.head_dim,
+                mlp_ratio=self.mlp_ratio,
+                causal=self.causal,
+                mesh=self.mesh,
+                use_flash=self.use_flash,
+                interpret=self.interpret,
+                name=f"block_{i}",
+            )(x)
+        return nn.LayerNorm(name="ln_final")(x)
