@@ -48,10 +48,12 @@ class MultiHeadAttention(nn.Module):
             return t.reshape(batch, seq, self.num_heads, self.head_dim)
 
         q, k, v = heads(q), heads(k), heads(v)
-        if (
-            self.mesh is not None
-            and self.mesh.shape[mesh_lib.SEQUENCE_AXIS] > 1
-        ):
+        sequence_axis = (
+            dict(self.mesh.shape).get(mesh_lib.SEQUENCE_AXIS, 1)
+            if self.mesh is not None
+            else 1
+        )
+        if sequence_axis > 1:
             from tensor2robot_tpu.parallel.ring_attention import ring_attention
 
             out = ring_attention(
