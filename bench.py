@@ -198,7 +198,8 @@ def bench_data() -> None:
             "features": model.preprocessor.get_in_feature_specification("train"),
             "labels": model.preprocessor.get_in_label_specification("train"),
         }
-        n_records, batch_size = 256, 64
+        n_records = int(os.environ.get("BENCH_DATA_RECORDS", "256"))
+        batch_size = int(os.environ.get("BENCH_DATA_BATCH", "64"))
         rng_values = make_random_numpy(specs, batch_size=n_records, seed=0)
         with tempfile.TemporaryDirectory() as tmp:
             path = os.path.join(tmp, "bench.tfrecord")
@@ -221,7 +222,7 @@ def bench_data() -> None:
             )
             it = iter(dataset)
             next(it)  # spin up pool + warm caches
-            n_batches = 24
+            n_batches = int(os.environ.get("BENCH_DATA_BATCHES", "24"))
             start = time.perf_counter()
             for _ in range(n_batches):
                 next(it)
@@ -235,8 +236,10 @@ def bench_data() -> None:
         )
         images_per_sec = records_per_sec * max(n_images, 1)
         # A 50%-MFU step on v5e consumes ~2.3 batches/sec at bs64 (from the
-        # analytic FLOPs of the full tower): the demand the pipeline must meet.
-        step_flops = _analytic_train_flops((472, 472), 64)
+        # analytic FLOPs of the full tower): the demand the pipeline must
+        # meet. FLOPs are computed at the measured batch so the ratio stays
+        # batch-independent under BENCH_DATA_BATCH overrides.
+        step_flops = _analytic_train_flops((472, 472), batch_size)
         demand = 0.50 * _PEAK_FLOPS["TPU v5e"] / step_flops * batch_size
         _emit(
             {

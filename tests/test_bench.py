@@ -1,0 +1,52 @@
+"""bench.py contract smoke tests.
+
+The driver runs `python bench.py` / `python bench.py data` at round end and
+records the single JSON line; these tests pin that contract (one parseable
+line, required keys, sane values) at toy sizes so a regression is caught
+before the round-end artifact is produced.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_bench(*args, env_extra=None, timeout=420):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + ":" + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(env_extra or {})
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "bench.py"), *args],
+        capture_output=True,
+        text=True,
+        env=env,
+        timeout=timeout,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = proc.stdout.strip().splitlines()[-1]
+    return json.loads(line)
+
+
+@pytest.mark.slow
+def test_bench_data_contract():
+    payload = _run_bench(
+        "data",
+        env_extra={
+            "BENCH_DATA_RECORDS": "8",
+            "BENCH_DATA_BATCH": "4",
+            "BENCH_DATA_BATCHES": "2",
+        },
+    )
+    assert payload["metric"] == "qtopt_input_pipeline_images_per_sec"
+    assert payload["unit"] == "images_per_sec"
+    assert payload["value"] > 0
+    detail = payload["detail"]
+    assert detail["records_per_sec"] > 0
+    assert detail["batch_size"] == 4
+    assert detail["parse_workers"] >= 1
