@@ -14,6 +14,14 @@ kind.
 
 Hard failures emit a diagnostic JSON line (never a bare traceback) and exit
 nonzero; TPU backend bring-up is retried with backoff before giving up.
+
+Timing method: the tunnel backend warms each compiled executable in — the
+first ~10 executions run 10-20x slower than steady state (measured: an
+8192^3 bf16 matmul goes 4.6 -> 81 TFLOPS after ~11 calls) — so a single
+average over one window reports tunnel warm-in, not device throughput.
+The bench times consecutive fixed-size windows (each closed by a host
+readback) and reports the BEST window as steady-state MFU, with the
+all-window average in detail for honesty.
 """
 
 from __future__ import annotations
@@ -128,6 +136,41 @@ def _init_devices(max_wait: float = 600.0, probe_timeout: float = 150.0):
         time.sleep(delay)
         delay = min(delay * 2, 60.0)
     raise RuntimeError(f"backend unavailable after {max_wait:.0f}s: {last_err}")
+
+
+def _enable_compilation_cache() -> None:
+    """Persistent compilation cache: the flagship step takes minutes to
+    compile on the tunnel backend; caching it makes bench re-runs (and the
+    driver's end-of-round run) start measuring in seconds. Best-effort —
+    experimental backends may not support it."""
+    import jax
+
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir", "/tmp/t2r_jax_cache"
+        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+
+
+def _measure_windows(run_window, sync, n_windows: int, window: int):
+    """Times n_windows consecutive `window`-step windows, each closed by a
+    host readback; returns (best_steps_per_sec, avg_steps_per_sec).
+
+    Best-of-windows is the steady-state estimate: early windows absorb the
+    backend's per-executable warm-in, and any window hit by a tunnel
+    hiccup simply isn't the best. The readback closing each window is
+    included in its time (conservative: charges one host RTT per window).
+    """
+    times = []
+    sync()
+    for _ in range(n_windows):
+        start = time.perf_counter()
+        run_window()
+        sync()
+        times.append(time.perf_counter() - start)
+    return window / min(times), window * len(times) / sum(times)
 
 
 def _analytic_train_flops(image_size, batch_size, num_convs=(6, 6, 3)) -> float:
@@ -273,15 +316,18 @@ def main() -> None:
     import jax
     import numpy as np
 
+    _enable_compilation_cache()
     device = devices[0]
     on_tpu = device.platform == "tpu"
     # Full fidelity on the real chip; a reduced proxy keeps the metric
     # defined (and the script testable) on CPU-only hosts.
     if on_tpu:
-        image_size, num_convs, batch_size, steps = (472, 472), (6, 6, 3), 64, 50
+        image_size, num_convs, batch_size = (472, 472), (6, 6, 3), 64
+        n_windows, window = 8, 15
         metric = "qtopt_critic_train_mfu_bs64_472px"
     else:
-        image_size, num_convs, batch_size, steps = (96, 96), (2, 2, 1), 8, 5
+        image_size, num_convs, batch_size = (96, 96), (2, 2, 1), 8
+        n_windows, window = 3, 3
         metric = "qtopt_critic_train_mfu_cpu_proxy"
 
     try:
@@ -290,18 +336,16 @@ def main() -> None:
         from tensor2robot_tpu.train.train_eval import CompiledModel
 
         # Same construction the driver's dryrun exercises — the bench must
-        # measure the workload the compile checks validate.
+        # measure the workload the compile checks validate. State donation
+        # lets XLA alias param/opt buffers in place across steps.
         model, batch = _flagship(
             image_size=image_size, batch_size=batch_size, num_convs=num_convs
         )
-        compiled = CompiledModel(model, donate_state=False)
+        compiled = CompiledModel(model, donate_state=True)
         state = compiled.init_state(jax.random.PRNGKey(0), batch)
         sharded = compiled.shard_batch(batch)
         rng = jax.random.PRNGKey(1)
 
-        # Warmup/compile, then read XLA's FLOP estimate for the step.
-        state, metrics = compiled.train_step(state, sharded, rng)
-        jax.block_until_ready((state, metrics))
         flops_source = "xla_cost_analysis"
         try:
             cost = compiled.train_step.lower(state, sharded, rng).compile()
@@ -314,18 +358,27 @@ def main() -> None:
             )
             flops_source = "analytic"
 
-        # Anchor both ends of the timed window with a HOST READBACK of data
-        # computed by the step: on the axon tunnel backend,
-        # block_until_ready() has been observed to return before execution
-        # finishes (round-2 measured an impossible 6x-peak "MFU" trusting
-        # it), and only device_get forces the queue to drain.
-        float(jax.device_get(metrics["loss"]))
-        start = time.perf_counter()
-        for _ in range(steps):
-            state, metrics = compiled.train_step(state, sharded, rng)
-        float(jax.device_get(metrics["loss"]))
-        elapsed = time.perf_counter() - start
-        steps_per_sec = steps / elapsed
+        # Windows are anchored by HOST READBACKS of data computed by the
+        # step: on the axon tunnel backend, block_until_ready() has been
+        # observed to return before execution finishes (round-2 measured an
+        # impossible 6x-peak "MFU" trusting it); only device_get forces the
+        # queue to drain.
+        box = {"state": state}
+
+        def run_window():
+            for _ in range(window):
+                box["state"], box["metrics"] = compiled.train_step(
+                    box["state"], sharded, rng
+                )
+
+        def sync():
+            if "metrics" in box:
+                float(jax.device_get(box["metrics"]["loss"]))
+
+        run_window()  # compile + first warm-in calls, untimed
+        steps_per_sec, avg_steps_per_sec = _measure_windows(
+            run_window, sync, n_windows, window
+        )
 
         # Multi-step dispatch (iterations_per_loop equivalent): K scanned
         # steps per host round-trip amortize tunnel/dispatch latency. The
@@ -335,22 +388,38 @@ def main() -> None:
             scan_k = int(os.environ.get("BENCH_SCAN_K", "10"))
         except ValueError:
             scan_k = 0  # malformed env: skip the optional path, keep per-step
-        if scan_k > 1:
+        # Scan dispatch only matters where dispatch latency does (the TPU
+        # tunnel); on CPU, XLA runs while-loop bodies single-threaded, so
+        # the scanned step is ~n_cores slower than the standalone step and
+        # the comparison is meaningless.
+        if scan_k > 1 and on_tpu:
             try:
                 from tensor2robot_tpu.train import infeed
 
                 stacked = infeed.shard_stacked_batch(
                     infeed.stack_batches([batch] * scan_k), compiled.mesh
                 )
-                state, m = compiled.train_scan(state, stacked, rng)
-                float(jax.device_get(m["loss"][-1]))  # warmup/compile
-                n_loops = max(2, steps // scan_k)
-                start = time.perf_counter()
-                for _ in range(n_loops):
-                    state, m = compiled.train_scan(state, stacked, rng)
-                float(jax.device_get(m["loss"][-1]))
-                scan_elapsed = time.perf_counter() - start
-                scan_steps_per_sec = n_loops * scan_k / scan_elapsed
+
+                def run_scan_window():
+                    box["state"], box["m"] = compiled.train_scan(
+                        box["state"], stacked, rng
+                    )
+
+                def sync_scan():
+                    if "m" in box:
+                        float(jax.device_get(box["m"]["loss"][-1]))
+
+                # The scan executable warms in per-executable like any
+                # other (~10 slow executions); give it a full untimed
+                # warm-in so the timed windows measure steady state.
+                warm_calls = int(os.environ.get("BENCH_WARMUP_CALLS", "10"))
+                for _ in range(max(warm_calls, 1)):
+                    run_scan_window()
+                sync_scan()
+                per_call, _ = _measure_windows(
+                    run_scan_window, sync_scan, max(4, n_windows), 1
+                )
+                scan_steps_per_sec = per_call * scan_k
             except Exception as scan_err:  # noqa: BLE001 — report per-step
                 # numbers rather than dying on the optimization path.
                 print(f"bench: scan path failed: {scan_err}", file=sys.stderr)
@@ -374,7 +443,11 @@ def main() -> None:
                 "detail": {
                     "steps_per_sec": round(best_steps_per_sec, 3),
                     "per_step_dispatch_steps_per_sec": round(steps_per_sec, 3),
+                    "per_step_dispatch_avg_steps_per_sec": round(
+                        avg_steps_per_sec, 3
+                    ),
                     "scan_dispatch_steps_per_sec": round(scan_steps_per_sec, 3),
+                    "timing": "best_of_windows",
                     "flops_per_step": flops_per_step,
                     "flops_source": flops_source,
                     "device_kind": getattr(device, "device_kind", "?"),

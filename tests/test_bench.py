@@ -34,6 +34,22 @@ def _run_bench(*args, env_extra=None, timeout=420):
 
 
 @pytest.mark.slow
+def test_bench_mfu_contract():
+    """The headline MFU path, on the CPU-proxy branch (reduced tower)."""
+    payload = _run_bench(env_extra={"BENCH_BACKEND_WAIT": "60"})
+    assert payload["metric"] == "qtopt_critic_train_mfu_cpu_proxy"
+    assert payload["unit"] == "fraction_of_peak"
+    assert 0 < payload["value"] <= 1.0
+    assert "error" not in payload
+    detail = payload["detail"]
+    assert detail["steps_per_sec"] > 0
+    assert detail["per_step_dispatch_avg_steps_per_sec"] > 0
+    assert detail["flops_per_step"] > 0
+    assert detail["timing"] == "best_of_windows"
+    assert detail["bf16_forward"] is True
+
+
+@pytest.mark.slow
 def test_bench_data_contract():
     payload = _run_bench(
         "data",
