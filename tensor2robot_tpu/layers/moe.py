@@ -1,0 +1,60 @@
+"""Flax wrapper over the expert-parallel MoE op (ops/moe.py).
+
+`MoEBlock` drops in where a dense MLP would sit (e.g. the feed-forward of
+layers/transformer.TransformerBlock): [batch, seq, features] in and out,
+plus the router's load-balance aux loss, which callers fold into the
+training loss (weight ~1e-2, the Switch Transformer default).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tensor2robot_tpu.ops import moe as moe_ops
+
+
+class MoEBlock(nn.Module):
+    """Top-k routed expert MLP over [batch, seq, features]."""
+
+    num_experts: int
+    hidden_dim: int
+    num_selected: int = 2
+    capacity_factor: float = 2.0
+    group_size: Optional[int] = None  # default: one group per batch element
+    mesh: Optional[object] = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        batch, seq, features = x.shape
+        router_kernel = self.param(
+            "router",
+            nn.initializers.lecun_normal(),
+            (features, self.num_experts),
+        )
+        w_in = self.param(
+            "w_in",
+            nn.initializers.lecun_normal(),
+            (self.num_experts, features, self.hidden_dim),
+        )
+        w_out = self.param(
+            "w_out",
+            nn.initializers.lecun_normal(),
+            (self.num_experts, self.hidden_dim, features),
+        )
+        y, aux_loss = moe_ops.moe_mlp(
+            x.reshape(batch * seq, features),
+            router_kernel,
+            w_in,
+            w_out,
+            num_selected=self.num_selected,
+            capacity_factor=self.capacity_factor,
+            # Per-batch-element routing groups keep dispatch linear in
+            # batch size (ops/moe.py group_size doc).
+            group_size=self.group_size or seq,
+            mesh=self.mesh,
+        )
+        return y.reshape(batch, seq, features), aux_loss

@@ -72,7 +72,13 @@ class MultiHeadAttention(nn.Module):
 
 
 class TransformerBlock(nn.Module):
-    """Pre-norm block: x + MHA(LN(x)); x + MLP(LN(x))."""
+    """Pre-norm block: x + MHA(LN(x)); x + FFN(LN(x)).
+
+    The feed-forward is dense by default; `num_experts > 1` swaps in the
+    expert-parallel MoE (layers/moe.py, experts sharded over the mesh's
+    `expert` axis), whose router aux loss is accumulated into the
+    "moe_aux_loss" collection for the caller's loss term.
+    """
 
     num_heads: int
     head_dim: int
@@ -81,6 +87,8 @@ class TransformerBlock(nn.Module):
     mesh: Optional[object] = None
     use_flash: Optional[bool] = None
     interpret: bool = False
+    num_experts: int = 1
+    num_selected_experts: int = 2
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -94,9 +102,21 @@ class TransformerBlock(nn.Module):
             name="attention",
         )(nn.LayerNorm(name="ln_attn")(x))
         h = nn.LayerNorm(name="ln_mlp")(x)
-        h = nn.Dense(self.mlp_ratio * x.shape[-1], name="mlp_in")(h)
-        h = nn.gelu(h)
-        h = nn.Dense(x.shape[-1], name="mlp_out")(h)
+        if self.num_experts > 1:
+            from tensor2robot_tpu.layers.moe import MoEBlock
+
+            h, aux_loss = MoEBlock(
+                num_experts=self.num_experts,
+                hidden_dim=self.mlp_ratio * x.shape[-1],
+                num_selected=self.num_selected_experts,
+                mesh=self.mesh,
+                name="moe",
+            )(h)
+            self.sow("moe_aux_loss", "aux_loss", aux_loss)
+        else:
+            h = nn.Dense(self.mlp_ratio * x.shape[-1], name="mlp_in")(h)
+            h = nn.gelu(h)
+            h = nn.Dense(x.shape[-1], name="mlp_out")(h)
         return x + h
 
 
@@ -113,6 +133,8 @@ class TransformerEncoder(nn.Module):
     mesh: Optional[object] = None
     use_flash: Optional[bool] = None
     interpret: bool = False
+    num_experts: int = 1
+    num_selected_experts: int = 2
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -136,6 +158,8 @@ class TransformerEncoder(nn.Module):
                 mesh=self.mesh,
                 use_flash=self.use_flash,
                 interpret=self.interpret,
+                num_experts=self.num_experts,
+                num_selected_experts=self.num_selected_experts,
                 name=f"block_{i}",
             )(x)
         return nn.LayerNorm(name="ln_final")(x)

@@ -1,0 +1,159 @@
+"""Mixture-of-Experts with expert parallelism (GShard-style dense dispatch).
+
+Beyond the reference (SURVEY §2.7 lists expert parallelism as ABSENT
+there): a top-k routed expert MLP whose dispatch/combine are dense einsums
+over a [tokens, experts, capacity] one-hot tensor — the TPU-native MoE
+formulation (GShard / Switch Transformer): static shapes, no gather/
+scatter, everything lands on the MXU, and when the expert dimension of the
+weights is sharded over the `expert` mesh axis GSPMD lowers the dispatch
+einsum to an all_to_all over ICI. Tokens beyond an expert's capacity are
+dropped (contribute zero), the standard capacity-factor contract.
+
+Pure functions here; `layers.moe.MoEBlock` is the flax wrapper.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from tensor2robot_tpu.parallel.mesh import EXPERT_AXIS
+
+
+class Routing(NamedTuple):
+    """Dense dispatch/combine for [T] tokens, [E] experts, [C] capacity."""
+
+    dispatch: jax.Array  # [T, E, C] 0/1 — token t occupies slot c of expert e
+    combine: jax.Array  # [T, E, C] gate-weighted dispatch
+    aux_loss: jax.Array  # scalar load-balance loss (Switch eq. 4 style)
+
+
+def top_k_routing(
+    router_logits: jax.Array,
+    num_selected: int,
+    capacity: int,
+) -> Routing:
+    """Builds dispatch/combine tensors from router logits [T, E].
+
+    Top-k gating with renormalized softmax gates; per-expert slots assigned
+    in token order (cumsum ranking); tokens ranked past `capacity` are
+    dropped. The aux loss is E * sum_e(load_e * importance_e) where load is
+    the fraction of top-1 assignments and importance the mean router
+    probability — minimized by uniform routing.
+    """
+    tokens, num_experts = router_logits.shape
+    probs = jax.nn.softmax(router_logits, axis=-1)
+    gate_values, expert_ids = jax.lax.top_k(probs, num_selected)
+    if num_selected > 1:
+        # Renormalize the selected gates so they sum to 1 per token.
+        gate_values = gate_values / jnp.maximum(
+            jnp.sum(gate_values, axis=-1, keepdims=True), 1e-9
+        )
+    # Top-1 keeps the RAW probability as the gate (Switch Transformer):
+    # renormalizing would pin it to 1.0 and cut the router out of the task
+    # loss's gradient entirely.
+
+    dispatch = jnp.zeros((tokens, num_experts, capacity), probs.dtype)
+    combine = jnp.zeros((tokens, num_experts, capacity), probs.dtype)
+    # Slots fill selection-major: all k=0 picks rank before any k=1 pick,
+    # so a token's primary expert wins capacity over another's secondary.
+    slots_used = jnp.zeros((num_experts,), jnp.int32)
+    for k in range(num_selected):
+        onehot = jax.nn.one_hot(
+            expert_ids[:, k], num_experts, dtype=jnp.int32
+        )  # [T, E]
+        rank = jnp.cumsum(onehot, axis=0) - 1 + slots_used[None, :]  # [T, E]
+        slots_used = slots_used + jnp.sum(onehot, axis=0)
+        position = jnp.sum(rank * onehot, axis=1)  # [T] slot within expert
+        kept = position < capacity
+        slot_onehot = jax.nn.one_hot(position, capacity, dtype=probs.dtype)
+        contribution = (
+            onehot.astype(probs.dtype)[:, :, None] * slot_onehot[:, None, :]
+        )
+        contribution = contribution * kept.astype(probs.dtype)[:, None, None]
+        dispatch = dispatch + contribution
+        combine = combine + contribution * gate_values[:, k][:, None, None]
+
+    # Load-balance: fraction of tokens whose TOP-1 pick is e, dotted with
+    # mean router prob for e, scaled by E (1.0 at perfect uniformity).
+    top1 = jax.nn.one_hot(expert_ids[:, 0], num_experts, dtype=probs.dtype)
+    load = jnp.mean(top1, axis=0)
+    importance = jnp.mean(probs, axis=0)
+    aux_loss = num_experts * jnp.sum(load * importance)
+    return Routing(dispatch=dispatch, combine=combine, aux_loss=aux_loss)
+
+
+def expert_capacity(
+    tokens: int,
+    num_experts: int,
+    num_selected: int,
+    capacity_factor: float,
+) -> int:
+    """Slots per expert: ceil(k*T/E * factor), floored at num_selected so
+    toy shapes keep at least one slot per selection."""
+    raw = num_selected * tokens * capacity_factor / num_experts
+    return max(int(-(-raw // 1)), num_selected)
+
+
+def moe_mlp(
+    x: jax.Array,
+    router_kernel: jax.Array,
+    w_in: jax.Array,
+    w_out: jax.Array,
+    *,
+    num_selected: int = 2,
+    capacity_factor: float = 2.0,
+    group_size: Optional[int] = None,
+    mesh: Optional[object] = None,
+):
+    """Expert-routed MLP over [T, F] tokens.
+
+    Args:
+      x: [T, F] tokens (flatten batch/seq upstream).
+      router_kernel: [F, E].
+      w_in: [E, F, H] per-expert up-projection; w_out: [E, H, F].
+      group_size: tokens are routed in independent groups of this size
+        (must divide T), with capacity computed PER GROUP — the GShard
+        grouping that keeps the dense dispatch tensors linear in T
+        ([G, g, E, C_g] with C_g ∝ g/E) instead of quadratic (a single
+        global group's capacity grows with T, making [T, E, C] ~ T^2).
+        None = one global group (fine for small T).
+      mesh: when given with an `expert` axis > 1, expert-dim sharding
+        constraints are applied so GSPMD inserts the token all_to_all and
+        each device computes only its resident experts' FFNs.
+
+    Returns (y [T, F], aux_loss scalar — mean over groups).
+    """
+    tokens, features = x.shape
+    num_experts = w_in.shape[0]
+    if group_size is None:
+        group_size = tokens
+    if tokens % group_size != 0:
+        raise ValueError(
+            f"group_size {group_size} does not divide token count {tokens}"
+        )
+    groups = tokens // group_size
+    capacity = expert_capacity(
+        group_size, num_experts, num_selected, capacity_factor
+    )
+
+    xg = x.reshape(groups, group_size, features)
+    logits = jnp.einsum("gtf,fe->gte", xg, router_kernel)
+    routing = jax.vmap(
+        lambda lg: top_k_routing(lg, num_selected, capacity)
+    )(logits)
+
+    expert_inputs = jnp.einsum("gtec,gtf->gecf", routing.dispatch, xg)
+    if mesh is not None and dict(mesh.shape).get(EXPERT_AXIS, 1) > 1:
+        expert_inputs = jax.lax.with_sharding_constraint(
+            expert_inputs,
+            jax.sharding.NamedSharding(
+                mesh, jax.sharding.PartitionSpec(None, EXPERT_AXIS)
+            ),
+        )
+    hidden = jax.nn.gelu(jnp.einsum("gecf,efh->gech", expert_inputs, w_in))
+    expert_outputs = jnp.einsum("gech,ehf->gecf", hidden, w_out)
+    y = jnp.einsum("gtec,gecf->gtf", routing.combine, expert_outputs)
+    return y.reshape(tokens, features), jnp.mean(routing.aux_loss)

@@ -25,6 +25,8 @@ DATA_AXIS = "data"
 FSDP_AXIS = "fsdp"
 MODEL_AXIS = "model"
 SEQUENCE_AXIS = "sequence"
+PIPE_AXIS = "pipe"
+EXPERT_AXIS = "expert"
 
 
 def initialize_distributed(
@@ -55,31 +57,41 @@ def make_mesh(
     fsdp: int = 1,
     model: int = 1,
     sequence: int = 1,
+    pipe: int = 1,
+    expert: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
-    """Builds a mesh over (data, fsdp, model, sequence) axes.
+    """Builds a mesh over (data, fsdp, model, sequence, pipe, expert) axes.
 
     `data=None` absorbs all remaining devices. Axis sizes must multiply to
     the device count. Device order follows jax.devices(), which enumerates
-    ICI-contiguous chips first — so the fastest-varying (model/sequence)
-    axes land on ICI neighbors and data-parallel all-reduce rides the slower
-    links, the standard TPU layout.
+    ICI-contiguous chips first — so the fastest-varying (model/sequence/
+    pipe/expert) axes land on ICI neighbors and data-parallel all-reduce
+    rides the slower links, the standard TPU layout.
     """
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
-    fixed = fsdp * model * sequence
+    fixed = fsdp * model * sequence * pipe * expert
     if data is None:
         if n % fixed != 0:
             raise ValueError(
-                f"{n} devices not divisible by fsdp*model*sequence={fixed}"
+                f"{n} devices not divisible by "
+                f"fsdp*model*sequence*pipe*expert={fixed}"
             )
         data = n // fixed
     if data * fixed != n:
         raise ValueError(
-            f"Mesh {data}x{fsdp}x{model}x{sequence} != {n} devices"
+            f"Mesh {data}x{fsdp}x{model}x{sequence}x{pipe}x{expert} "
+            f"!= {n} devices"
         )
-    array = np.asarray(devices).reshape(data, fsdp, model, sequence)
-    return Mesh(array, (DATA_AXIS, FSDP_AXIS, MODEL_AXIS, SEQUENCE_AXIS))
+    array = np.asarray(devices).reshape(
+        data, fsdp, model, sequence, pipe, expert
+    )
+    return Mesh(
+        array,
+        (DATA_AXIS, FSDP_AXIS, MODEL_AXIS, SEQUENCE_AXIS, PIPE_AXIS,
+         EXPERT_AXIS),
+    )
 
 
 def data_sharding(mesh: Mesh) -> NamedSharding:

@@ -117,3 +117,73 @@ class TestTransformerEncoder:
         np.testing.assert_allclose(
             np.asarray(out_ref), np.asarray(out_flash), rtol=2e-5, atol=2e-5
         )
+
+
+class TestMoETransformer:
+    def test_moe_ffn_trains_and_reports_aux_loss(self):
+        """num_experts>1 swaps the dense FFN for the expert-parallel MoE;
+        the router aux loss lands in the moe_aux_loss collection."""
+        encoder = TransformerEncoder(
+            num_layers=2,
+            num_heads=2,
+            head_dim=4,
+            max_seq_len=16,
+            use_flash=False,
+            num_experts=4,
+        )
+        x = jnp.asarray(
+            np.random.RandomState(7).randn(2, 8, 8).astype(np.float32)
+        )
+        variables = encoder.init(jax.random.PRNGKey(0), x)
+        params = {"params": variables["params"]}
+        assert "moe" in params["params"]["block_0"]
+
+        @jax.jit
+        def loss_fn(params):
+            out, collections = encoder.apply(
+                params, x, mutable=["moe_aux_loss"]
+            )
+            aux_losses = jax.tree_util.tree_leaves(
+                collections["moe_aux_loss"]
+            )
+            assert len(aux_losses) == 2  # one per block
+            return jnp.mean(out ** 2) + 0.01 * sum(aux_losses)
+
+        grads = jax.grad(loss_fn)(params)
+        router_grad = grads["params"]["block_0"]["moe"]["router"]
+        assert float(jnp.max(jnp.abs(router_grad))) > 0
+
+    def test_expert_mesh_composes_with_sequence_ring(self):
+        """expert=2 x sequence=4 mesh: MoE dispatch and ring attention in
+        one block, on the virtual CPU mesh."""
+        mesh = mesh_lib.make_mesh(data=1, sequence=4, expert=2)
+        encoder = TransformerEncoder(
+            num_layers=1,
+            num_heads=2,
+            head_dim=4,
+            max_seq_len=32,
+            mesh=mesh,
+            use_flash=False,
+            num_experts=2,
+        )
+        x = jnp.asarray(
+            np.random.RandomState(8).randn(2, 32, 8).astype(np.float32)
+        )
+        variables = encoder.init(jax.random.PRNGKey(0), x)
+        params = {"params": variables["params"]}
+        out, _ = encoder.apply(params, x, mutable=["moe_aux_loss"])
+        assert out.shape == x.shape
+
+        # Oracle: same params, no mesh (fully local execution).
+        local = TransformerEncoder(
+            num_layers=1,
+            num_heads=2,
+            head_dim=4,
+            max_seq_len=32,
+            use_flash=False,
+            num_experts=2,
+        )
+        out_local, _ = local.apply(params, x, mutable=["moe_aux_loss"])
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(out_local), rtol=1e-4, atol=1e-5
+        )
