@@ -12,7 +12,6 @@ from typing import Optional, Tuple
 
 import flax.linen as nn
 import jax
-import jax.numpy as jnp
 
 from tensor2robot_tpu.ops import moe as moe_ops
 

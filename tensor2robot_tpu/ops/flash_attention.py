@@ -14,10 +14,12 @@ invocation can compute one (q-shard × k-shard) tile of a longer sequence
 
 Dispatch: the Pallas path runs on TPU (or anywhere with interpret=True,
 which tests use); other backends and non-divisible block shapes fall back
-to the einsum reference. Gradients: jax.custom_vjp with the reference
-backward — forward pass is flash, backward recomputes attention the plain
-way (adequate at robotics sequence lengths; a flash backward kernel is a
-further optimization).
+to the einsum reference. Gradients: jax.custom_vjp with a FLASH backward —
+two Pallas kernels (dq; dk+dv) recompute attention probabilities tile by
+tile from the forward's saved row statistics L = m + log(l) and
+D = rowsum(dO*O), so the backward is also O(S·D) HBM (the
+FlashAttention-2 scheme); the S×S logit matrix never materializes in
+either direction.
 """
 
 from __future__ import annotations
@@ -271,6 +273,229 @@ def _flash_attention_fwd_impl(
     return jnp.transpose(out.reshape(batch, heads, s_q, dim), (0, 2, 1, 3))
 
 
+def _bwd_tile(q_scaled, k_blk, v_blk, do_blk, lse, delta, q_pos, k_pos,
+              causal):
+    """Shared backward-tile recompute: probabilities and dS for one
+    (q-tile x k-tile) pair, from the saved row stats.
+
+    q_scaled must already carry the softmax scale (s = q_scaled @ k^T), so
+    ds @ k (for dQ) and ds^T @ q_scaled (for dK) each carry exactly one
+    factor of scale — dQ multiplies its own factor afterwards.
+    Returns (p, ds), both [block_q, block_k] f32.
+    """
+    s = jax.lax.dot_general(
+        q_scaled, k_blk,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    p = jnp.exp(s - lse[:, None])
+    if causal:
+        p = jnp.where(q_pos >= k_pos, p, 0.0)
+    dp = jax.lax.dot_general(
+        do_blk, v_blk,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = p * (dp - delta[:, None])
+    return p, ds
+
+
+def _flash_bwd_dq_kernel(
+    offsets_ref,  # SMEM [2] int32
+    q_ref,  # VMEM [1, block_q, D]
+    k_ref,  # VMEM [1, S_k, D]
+    v_ref,  # VMEM [1, S_k, D]
+    do_ref,  # VMEM [1, block_q, D]
+    lse_ref,  # VMEM [1, block_q]  L = m + log(l)
+    delta_ref,  # VMEM [1, block_q]  D = rowsum(dO * O)
+    dq_ref,  # VMEM [1, block_q, D]
+    *,
+    block_k: int,
+    scale: float,
+    causal: bool,
+):
+    """dQ_i = scale * sum_j dS_ij K_j, with P recomputed per k-tile from
+    the saved row stats (FlashAttention-2 backward, query-parallel half)."""
+    qi = pl.program_id(1)
+    block_q = q_ref.shape[1]
+    dim = q_ref.shape[2]
+    s_k = k_ref.shape[1]
+    num_kb = s_k // block_k
+
+    q = q_ref[0].astype(jnp.float32) * scale
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]
+    delta = delta_ref[0]
+    q_pos = (
+        offsets_ref[0]
+        + qi * block_q
+        + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    )
+
+    def body(j, acc):
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        k_pos = (
+            offsets_ref[1]
+            + j * block_k
+            + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        )
+        _, ds = _bwd_tile(q, k_blk, v_blk, do, lse, delta, q_pos, k_pos,
+                          causal)
+        return acc + jax.lax.dot_general(
+            ds, k_blk,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    acc = lax.fori_loop(0, num_kb, body, jnp.zeros((block_q, dim), jnp.float32))
+    dq_ref[0] = (acc * scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(
+    offsets_ref,  # SMEM [2] int32
+    q_ref,  # VMEM [1, S_q, D]
+    k_ref,  # VMEM [1, block_k, D]
+    v_ref,  # VMEM [1, block_k, D]
+    do_ref,  # VMEM [1, S_q, D]
+    lse_ref,  # VMEM [1, S_q]
+    delta_ref,  # VMEM [1, S_q]
+    dk_ref,  # VMEM [1, block_k, D]
+    dv_ref,  # VMEM [1, block_k, D]
+    *,
+    block_q: int,
+    scale: float,
+    causal: bool,
+):
+    """dK_j = scale * sum_i dS_ij^T Q_i; dV_j = sum_i P_ij^T dO_i (the
+    key-parallel half: each grid step owns one k-tile, loops q-tiles)."""
+    ki = pl.program_id(1)
+    block_k = k_ref.shape[1]
+    dim = k_ref.shape[2]
+    s_q = q_ref.shape[1]
+    num_qb = s_q // block_q
+
+    k_blk = k_ref[0].astype(jnp.float32)
+    v_blk = v_ref[0].astype(jnp.float32)
+    k_pos = (
+        offsets_ref[1]
+        + ki * block_k
+        + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    )
+
+    def body(i, carry):
+        dk_acc, dv_acc = carry
+        q_blk = (
+            q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+            * scale
+        )
+        do_blk = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * block_q, block_q)]
+        delta = delta_ref[0, pl.ds(i * block_q, block_q)]
+        q_pos = (
+            offsets_ref[0]
+            + i * block_q
+            + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        )
+        p, ds = _bwd_tile(q_blk, k_blk, v_blk, do_blk, lse, delta, q_pos,
+                          k_pos, causal)
+        dv_acc = dv_acc + jax.lax.dot_general(
+            p, do_blk,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dk_acc = dk_acc + jax.lax.dot_general(
+            ds, q_blk,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return dk_acc, dv_acc
+
+    dk_acc, dv_acc = lax.fori_loop(
+        0,
+        num_qb,
+        body,
+        (
+            jnp.zeros((block_k, dim), jnp.float32),
+            jnp.zeros((block_k, dim), jnp.float32),
+        ),
+    )
+    # q was pre-scaled, so ds @ q already carries one factor of scale; dk
+    # needs exactly one (dS/dK_j = scale * q_i), which it therefore has.
+    dk_ref[0] = dk_acc.astype(dk_ref.dtype)
+    dv_ref[0] = dv_acc.astype(dv_ref.dtype)
+
+
+def _flash_attention_bwd_impl(
+    q, k, v, out, lse, g, offsets, causal, scale, block_q, block_k, interpret
+):
+    """Pallas backward: the two-kernel FlashAttention-2 scheme."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    batch, s_q, heads, dim = q.shape
+    s_k = k.shape[1]
+    bh = batch * heads
+
+    def fold(x):
+        return jnp.transpose(x, (0, 2, 1, 3)).reshape(bh, x.shape[1], dim)
+
+    qf, kf, vf = fold(q), fold(k), fold(v)
+    dof = fold(g)
+    # D_i = rowsum(dO * O): O(S*D) precompute outside the kernels.
+    delta = jnp.sum(
+        dof.astype(jnp.float32) * fold(out).astype(jnp.float32), axis=-1
+    )  # [bh, S_q]
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel, block_k=block_k, scale=scale, causal=causal
+        ),
+        out_shape=jax.ShapeDtypeStruct((bh, s_q, dim), q.dtype),
+        grid=(bh, s_q // block_q),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, block_q, dim), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, s_k, dim), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, s_k, dim), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, dim), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, dim), lambda b, i: (b, i, 0)),
+        interpret=interpret,
+    )(offsets, qf, kf, vf, dof, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkv_kernel, block_q=block_q, scale=scale, causal=causal
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, s_k, dim), k.dtype),
+            jax.ShapeDtypeStruct((bh, s_k, dim), v.dtype),
+        ),
+        grid=(bh, s_k // block_k),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, s_q, dim), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, block_k, dim), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, dim), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, s_q, dim), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, s_q), lambda b, j: (b, 0)),
+            pl.BlockSpec((1, s_q), lambda b, j: (b, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, block_k, dim), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, dim), lambda b, j: (b, j, 0)),
+        ),
+        interpret=interpret,
+    )(offsets, qf, kf, vf, dof, lse, delta)
+
+    def unfold(x, s):
+        return jnp.transpose(x.reshape(batch, heads, s, dim), (0, 2, 1, 3))
+
+    return unfold(dq, s_q), unfold(dk, s_k), unfold(dv, s_k)
+
+
 @functools.partial(
     jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9)
 )
@@ -286,27 +511,28 @@ def _flash_attention(
 
 
 def _fwd(q, k, v, q_offset, k_offset, causal, scale, block_q, block_k, interpret):
-    out = _flash_attention(
-        q, k, v, q_offset, k_offset, causal, scale, block_q, block_k, interpret
+    # Forward via the tile kernel so the row stats (l, m) come out as
+    # residuals; normalization happens here (one O(S*D) elementwise pass).
+    o, l, m = flash_attention_tile(
+        q, k, v, causal=causal, scale=scale,
+        q_offset=q_offset, k_offset=k_offset,
+        block_q=block_q, block_k=block_k, interpret=interpret,
     )
-    return out, (q, k, v, q_offset, k_offset)
+    l_safe = jnp.maximum(l, 1e-30)
+    out = (o / jnp.transpose(l_safe, (0, 2, 1))[..., None]).astype(q.dtype)
+    lse = (m + jnp.log(l_safe)).reshape(l.shape[0] * l.shape[1], l.shape[2])
+    return out, (q, k, v, out, lse, q_offset, k_offset)
 
 
 def _bwd(causal, scale, block_q, block_k, interpret, residuals, g):
-    # Reference backward: recompute attention the materialized way and let
-    # autodiff produce exact grads (flash fwd and reference fwd agree to
-    # fp tolerance, so these are the true gradients at robotics scales).
-    del block_q, block_k, interpret
-    q, k, v, q_offset, k_offset = residuals
-
-    def ref(q, k, v):
-        return reference_attention(
-            q, k, v, causal=causal, scale=scale,
-            q_offset=q_offset, k_offset=k_offset,
-        )
-
-    _, vjp = jax.vjp(ref, q, k, v)
-    dq, dk, dv = vjp(g)
+    q, k, v, out, lse, q_offset, k_offset = residuals
+    offsets = jnp.stack(
+        [jnp.asarray(q_offset, jnp.int32), jnp.asarray(k_offset, jnp.int32)]
+    )
+    dq, dk, dv = _flash_attention_bwd_impl(
+        q, k, v, out, lse, g, offsets, causal, scale, block_q, block_k,
+        interpret,
+    )
     return dq, dk, dv, None, None
 
 

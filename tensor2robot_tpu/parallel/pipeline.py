@@ -162,11 +162,13 @@ def _pipeline_shard(stacked_params, micro, *, stage_fn, num_stages,
     out0 = jnp.zeros((num_microbatches,) + mb_shape, micro.dtype)
     # The body makes the carry vary over the pipe axis (stage_idx masks,
     # ppermute); mark the initial carry the same way for shard_map's
-    # varying-manual-axes tracking.
-    resident0, out0 = jax.tree_util.tree_map(
-        lambda leaf: lax.pcast(leaf, (axis_name,), to="varying"),
-        (resident0, out0),
-    )
+    # varying-manual-axes tracking (guarded like ring_attention's pvary:
+    # older jax has neither the tracking nor the op).
+    if hasattr(lax, "pcast"):
+        resident0, out0 = jax.tree_util.tree_map(
+            lambda leaf: lax.pcast(leaf, (axis_name,), to="varying"),
+            (resident0, out0),
+        )
     (_, out_acc), _ = lax.scan(
         tick, (resident0, out0), jnp.arange(num_ticks)
     )

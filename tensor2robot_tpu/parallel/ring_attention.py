@@ -203,7 +203,9 @@ def _ring_call(q, k, v, mesh, axis_name, causal, scale, use_flash, interpret):
 def _ring_flash(q, k, v, mesh, axis_name, causal, scale, interpret):
     """Flash-tile ring forward with a reference-ring backward: pallas_call
     has no autodiff rule, so gradients recompute the attention through the
-    einsum ring (exact same math; see ops/flash_attention._bwd)."""
+    einsum ring (exact same math). Note ops/flash_attention now has a
+    Pallas flash backward for the single-device case; teaching the ring to
+    chain those per-hop backward kernels is a further optimization."""
     return _ring_call(q, k, v, mesh, axis_name, causal, scale, True, interpret)
 
 

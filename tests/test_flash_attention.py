@@ -77,6 +77,60 @@ class TestFlashAttention:
                 np.asarray(gf), np.asarray(gr), rtol=2e-5, atol=2e-5
             )
 
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_gradients_rectangular_with_offsets(self, qkv, causal):
+        """The flash backward on a (q-shard x k-shard) tile: s_q != s_k,
+        nonzero global offsets — the exact shape a ring hop differentiates."""
+        q, k, v = qkv
+        q_shard = q[:, 16:48, :, :]
+
+        def loss_flash(q, k, v):
+            return (
+                flash_attention(
+                    q, k, v, causal=causal, q_offset=16,
+                    interpret=True, block_q=16, block_k=16,
+                )
+                ** 2
+            ).sum()
+
+        def loss_ref(q, k, v):
+            return (
+                reference_attention(q, k, v, causal=causal, q_offset=16) ** 2
+            ).sum()
+
+        grads_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q_shard, k, v)
+        grads_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q_shard, k, v)
+        for gf, gr in zip(grads_flash, grads_ref):
+            np.testing.assert_allclose(
+                np.asarray(gf), np.asarray(gr), rtol=2e-4, atol=2e-4
+            )
+
+    def test_gradients_bf16_inputs(self, qkv):
+        """bf16 q/k/v (the TPU wrapper's forward dtype): grads keep the
+        input dtype and track the reference within bf16 tolerance."""
+        q, k, v = (t.astype(jnp.bfloat16) for t in qkv)
+
+        def loss_flash(q, k, v):
+            return flash_attention(
+                q, k, v, causal=True, interpret=True, block_q=16, block_k=16
+            ).astype(jnp.float32).sum()
+
+        def loss_ref(q, k, v):
+            return reference_attention(q, k, v, causal=True).astype(
+                jnp.float32
+            ).sum()
+
+        grads_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        grads_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for gf, gr in zip(grads_flash, grads_ref):
+            assert gf.dtype == jnp.bfloat16
+            np.testing.assert_allclose(
+                np.asarray(gf, np.float32),
+                np.asarray(gr, np.float32),
+                rtol=0.1,
+                atol=0.1,
+            )
+
     def test_cpu_fallback_is_reference(self, qkv):
         q, k, v = qkv
         out = flash_attention(q, k, v, causal=True)  # cpu backend -> fallback
