@@ -426,69 +426,118 @@ def _flash_bwd_dkv_kernel(
     dv_ref[0] = dv_acc.astype(dv_ref.dtype)
 
 
-def _flash_attention_bwd_impl(
-    q, k, v, out, lse, g, offsets, causal, scale, block_q, block_k, interpret
+def flash_attention_bwd_delta(dout: jax.Array, out: jax.Array) -> jax.Array:
+    """delta = rowsum(dO * O) in [B, H, Sq] layout — the O(S*D) precompute
+    both backward entry points (single-device _bwd, ring hop) feed to the
+    backward kernels."""
+    return jnp.transpose(
+        jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32), axis=-1),
+        (0, 2, 1),
+    )
+
+
+def flash_attention_bwd_tile(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    do: jax.Array,
+    lse: jax.Array,
+    delta: jax.Array,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    q_offset=0,
+    k_offset=0,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+    vma=None,
 ):
-    """Pallas backward: the two-kernel FlashAttention-2 scheme."""
+    """Backward of one (q-shard x k-shard) tile: (dq, dk, dv).
+
+    The ring-hop counterpart of flash_attention_tile: given the GLOBAL row
+    stats lse = m + log(l) and delta = rowsum(dO*O) (both [B, H, Sq]),
+    recomputes this tile's probabilities in the two backward kernels and
+    returns its additive contributions — a ring hop accumulates dq locally
+    and sends dk/dv around with the k/v blocks. All outputs f32.
+
+    vma: mesh axis names the outputs vary over (shard_map callers).
+    """
+    if not interpret and jax.default_backend() != "tpu":
+        raise ValueError(
+            "flash_attention_bwd_tile compiles only on TPU; pass "
+            "interpret=True to run in interpreter mode on this backend."
+        )
     from jax.experimental.pallas import tpu as pltpu
 
     batch, s_q, heads, dim = q.shape
     s_k = k.shape[1]
     bh = batch * heads
+    scale = scale if scale is not None else dim ** -0.5
+    bq = _pick_block(s_q, block_q)
+    bk = _pick_block(s_k, block_k)
+    if bq is None or bk is None:
+        raise ValueError(
+            f"No MXU-viable block divides shard lengths (q={s_q}, k={s_k})."
+        )
+    offsets = jnp.stack(
+        [jnp.asarray(q_offset, jnp.int32), jnp.asarray(k_offset, jnp.int32)]
+    )
 
     def fold(x):
         return jnp.transpose(x, (0, 2, 1, 3)).reshape(bh, x.shape[1], dim)
 
-    qf, kf, vf = fold(q), fold(k), fold(v)
-    dof = fold(g)
-    # D_i = rowsum(dO * O): O(S*D) precompute outside the kernels.
-    delta = jnp.sum(
-        dof.astype(jnp.float32) * fold(out).astype(jnp.float32), axis=-1
-    )  # [bh, S_q]
+    def out_struct(shape, dtype=jnp.float32):
+        if vma is not None:
+            return jax.ShapeDtypeStruct(shape, dtype, vma=frozenset(vma))
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+    qf, kf, vf, dof = fold(q), fold(k), fold(v), fold(do)
+    lsef = lse.reshape(bh, s_q)
+    deltaf = delta.reshape(bh, s_q)
 
     dq = pl.pallas_call(
         functools.partial(
-            _flash_bwd_dq_kernel, block_k=block_k, scale=scale, causal=causal
+            _flash_bwd_dq_kernel, block_k=bk, scale=scale, causal=causal
         ),
-        out_shape=jax.ShapeDtypeStruct((bh, s_q, dim), q.dtype),
-        grid=(bh, s_q // block_q),
+        out_shape=out_struct((bh, s_q, dim)),
+        grid=(bh, s_q // bq),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, block_q, dim), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, dim), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, s_k, dim), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, s_k, dim), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, block_q, dim), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, bq, dim), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bq), lambda b, i: (b, i)),
+            pl.BlockSpec((1, bq), lambda b, i: (b, i)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, dim), lambda b, i: (b, i, 0)),
+        out_specs=pl.BlockSpec((1, bq, dim), lambda b, i: (b, i, 0)),
         interpret=interpret,
-    )(offsets, qf, kf, vf, dof, lse, delta)
+    )(offsets, qf, kf, vf, dof, lsef, deltaf)
 
     dk, dv = pl.pallas_call(
         functools.partial(
-            _flash_bwd_dkv_kernel, block_q=block_q, scale=scale, causal=causal
+            _flash_bwd_dkv_kernel, block_q=bq, scale=scale, causal=causal
         ),
         out_shape=(
-            jax.ShapeDtypeStruct((bh, s_k, dim), k.dtype),
-            jax.ShapeDtypeStruct((bh, s_k, dim), v.dtype),
+            out_struct((bh, s_k, dim)),
+            out_struct((bh, s_k, dim)),
         ),
-        grid=(bh, s_k // block_k),
+        grid=(bh, s_k // bk),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((1, s_q, dim), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, block_k, dim), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, dim), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, dim), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, dim), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, s_q, dim), lambda b, j: (b, 0, 0)),
             pl.BlockSpec((1, s_q), lambda b, j: (b, 0)),
             pl.BlockSpec((1, s_q), lambda b, j: (b, 0)),
         ],
         out_specs=(
-            pl.BlockSpec((1, block_k, dim), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, dim), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, dim), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, dim), lambda b, j: (b, j, 0)),
         ),
         interpret=interpret,
-    )(offsets, qf, kf, vf, dof, lse, delta)
+    )(offsets, qf, kf, vf, dof, lsef, deltaf)
 
     def unfold(x, s):
         return jnp.transpose(x.reshape(batch, heads, s, dim), (0, 2, 1, 3))
@@ -520,20 +569,21 @@ def _fwd(q, k, v, q_offset, k_offset, causal, scale, block_q, block_k, interpret
     )
     l_safe = jnp.maximum(l, 1e-30)
     out = (o / jnp.transpose(l_safe, (0, 2, 1))[..., None]).astype(q.dtype)
-    lse = (m + jnp.log(l_safe)).reshape(l.shape[0] * l.shape[1], l.shape[2])
+    lse = m + jnp.log(l_safe)  # [B, H, Sq]
     return out, (q, k, v, out, lse, q_offset, k_offset)
 
 
 def _bwd(causal, scale, block_q, block_k, interpret, residuals, g):
     q, k, v, out, lse, q_offset, k_offset = residuals
-    offsets = jnp.stack(
-        [jnp.asarray(q_offset, jnp.int32), jnp.asarray(k_offset, jnp.int32)]
+    dq, dk, dv = flash_attention_bwd_tile(
+        q, k, v, g,
+        lse,
+        flash_attention_bwd_delta(g, out),
+        causal=causal, scale=scale,
+        q_offset=q_offset, k_offset=k_offset,
+        block_q=block_q, block_k=block_k, interpret=interpret,
     )
-    dq, dk, dv = _flash_attention_bwd_impl(
-        q, k, v, out, lse, g, offsets, causal, scale, block_q, block_k,
-        interpret,
-    )
-    return dq, dk, dv, None, None
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype), None, None
 
 
 _flash_attention.defvjp(_fwd, _bwd)

@@ -36,6 +36,18 @@ from tensor2robot_tpu.parallel.mesh import SEQUENCE_AXIS
 _NEG_INF = -1e30
 
 
+def _mark_varying(tree, axis_name):
+    """Marks device-local accumulators varying over the ring axis for
+    shard_map's vma tracking (no-op on jax without the tracking)."""
+    if hasattr(lax, "pcast"):
+        return jax.tree_util.tree_map(
+            lambda leaf: lax.pcast(leaf, (axis_name,), to="varying"), tree
+        )
+    if hasattr(lax, "pvary"):  # pragma: no cover - pre-pcast jax
+        return lax.pvary(tree, (axis_name,))
+    return tree  # pragma: no cover - jax without vma tracking
+
+
 def _block_attend(q, k_blk, v_blk, q_offset, k_offset, scale, causal):
     """One (q-shard x k-block) tile: returns (o_partial, row_sum, row_max)
     in the online-softmax decomposition."""
@@ -57,6 +69,7 @@ def _block_attend(q, k_blk, v_blk, q_offset, k_offset, scale, causal):
 def _ring_shard_fn(
     q, k, v, *, axis_name: str, causal: bool, scale: float,
     axis_size: int, use_flash: bool = False, interpret: bool = False,
+    return_lse: bool = False,
 ):
     """Per-device body: q is resident; k/v circulate the ring.
 
@@ -76,8 +89,7 @@ def _ring_shard_fn(
     # shard_map's vma tracking (when check_vma is on, the reference path)
     # requires them to match the axis-index-dependent tile updates they
     # accumulate.
-    if hasattr(lax, "pvary"):
-        o_acc, l_acc, m_acc = lax.pvary((o_acc, l_acc, m_acc), (axis_name,))
+    o_acc, l_acc, m_acc = _mark_varying((o_acc, l_acc, m_acc), axis_name)
     perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
 
     def body(i, carry):
@@ -120,6 +132,9 @@ def _ring_shard_fn(
     o_acc, l_acc, m_acc, _, _ = carry
     l_acc = jnp.maximum(l_acc, 1e-30)
     out = o_acc / jnp.transpose(l_acc, (0, 2, 1))[..., None]
+    if return_lse:
+        # Global log-sum-exp per row: the backward ring's residual.
+        return out.astype(q.dtype), m_acc + jnp.log(l_acc)
     return out.astype(q.dtype)
 
 
@@ -177,7 +192,8 @@ def ring_attention(
     return _ring_call(q, k, v, mesh, axis_name, causal, scale, False, False)
 
 
-def _ring_call(q, k, v, mesh, axis_name, causal, scale, use_flash, interpret):
+def _ring_call(q, k, v, mesh, axis_name, causal, scale, use_flash, interpret,
+               return_lse=False):
     axis_size = mesh.shape[axis_name]
     spec = P(None, axis_name, None, None)
     extra = {}
@@ -190,39 +206,105 @@ def _ring_call(q, k, v, mesh, axis_name, causal, scale, use_flash, interpret):
         functools.partial(
             _ring_shard_fn, axis_name=axis_name, causal=causal, scale=scale,
             axis_size=axis_size, use_flash=use_flash, interpret=interpret,
+            return_lse=return_lse,
         ),
         mesh=mesh,
         in_specs=(spec, spec, spec),
-        out_specs=spec,
+        out_specs=(spec, P(None, None, axis_name)) if return_lse else spec,
         **extra,
     )
     return fn(q, k, v)
 
 
+def _ring_bwd_shard_fn(
+    q, k, v, dout, out, lse, *, axis_name: str, causal: bool, scale: float,
+    axis_size: int, interpret: bool,
+):
+    """Backward ring: dq accumulates on the q-owner; dk/dv contributions
+    RIDE THE RING with their k/v blocks, so after the full rotation each
+    block arrives home carrying every device's contribution (the ring
+    formulation of the FlashAttention-2 backward; per hop, the two Pallas
+    backward kernels recompute this tile's probabilities from the global
+    row stats)."""
+    from tensor2robot_tpu.ops.flash_attention import (
+        flash_attention_bwd_delta,
+        flash_attention_bwd_tile,
+    )
+
+    my_index = lax.axis_index(axis_name)
+    block = q.shape[1]
+    q_offset = my_index * block
+    delta = flash_attention_bwd_delta(dout, out)  # [B, H, Sq_local]
+
+    dq_acc = jnp.zeros(q.shape, jnp.float32)
+    dk_travel = jnp.zeros(k.shape, jnp.float32)
+    dv_travel = jnp.zeros(v.shape, jnp.float32)
+    dq_acc, dk_travel, dv_travel = _mark_varying(
+        (dq_acc, dk_travel, dv_travel), axis_name
+    )
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+
+    carry = (dq_acc, dk_travel, dv_travel, k, v)
+    for i in range(axis_size):  # static unroll, as in the forward ring
+        dq_acc, dk_travel, dv_travel, k_blk, v_blk = carry
+        src_index = lax.rem(my_index - i + axis_size, axis_size)
+        dq_t, dk_t, dv_t = flash_attention_bwd_tile(
+            q, k_blk, v_blk, dout, lse, delta,
+            causal=causal, scale=scale,
+            q_offset=q_offset, k_offset=src_index * block,
+            interpret=interpret, vma=(axis_name,),
+        )
+        dq_acc = dq_acc + dq_t
+        dk_travel = dk_travel + dk_t
+        dv_travel = dv_travel + dv_t
+        # Rotate the block AND its accumulated gradient together; the
+        # final rotation delivers them back to the block's owner.
+        k_blk, v_blk, dk_travel, dv_travel = (
+            lax.ppermute(t, axis_name, perm)
+            for t in (k_blk, v_blk, dk_travel, dv_travel)
+        )
+        carry = (dq_acc, dk_travel, dv_travel, k_blk, v_blk)
+    dq_acc, dk_travel, dv_travel, _, _ = carry
+    return (
+        dq_acc.astype(q.dtype),
+        dk_travel.astype(k.dtype),
+        dv_travel.astype(v.dtype),
+    )
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _ring_flash(q, k, v, mesh, axis_name, causal, scale, interpret):
-    """Flash-tile ring forward with a reference-ring backward: pallas_call
-    has no autodiff rule, so gradients recompute the attention through the
-    einsum ring (exact same math). Note ops/flash_attention now has a
-    Pallas flash backward for the single-device case; teaching the ring to
-    chain those per-hop backward kernels is a further optimization."""
+    """Flash-tile ring forward with a flash ring BACKWARD: pallas_call has
+    no autodiff rule, so the custom vjp runs a second ring whose hops are
+    the FlashAttention-2 backward kernels (flash_attention_bwd_tile) —
+    O(seq/devices * dim) memory in both directions."""
     return _ring_call(q, k, v, mesh, axis_name, causal, scale, True, interpret)
 
 
 def _ring_flash_fwd(q, k, v, mesh, axis_name, causal, scale, interpret):
-    out = _ring_flash(q, k, v, mesh, axis_name, causal, scale, interpret)
-    return out, (q, k, v)
+    out, lse = _ring_call(
+        q, k, v, mesh, axis_name, causal, scale, True, interpret,
+        return_lse=True,
+    )
+    return out, (q, k, v, out, lse)
 
 
 def _ring_flash_bwd(mesh, axis_name, causal, scale, interpret, residuals, g):
-    q, k, v = residuals
-    _, vjp = jax.vjp(
-        lambda q, k, v: _ring_call(
-            q, k, v, mesh, axis_name, causal, scale, False, False
+    q, k, v, out, lse = residuals
+    axis_size = mesh.shape[axis_name]
+    spec = P(None, axis_name, None, None)
+    lse_spec = P(None, None, axis_name)
+    fn = shard_map(
+        functools.partial(
+            _ring_bwd_shard_fn, axis_name=axis_name, causal=causal,
+            scale=scale, axis_size=axis_size, interpret=interpret,
         ),
-        q, k, v,
+        mesh=mesh,
+        in_specs=(spec, spec, spec, spec, spec, lse_spec),
+        out_specs=(spec, spec, spec),
+        check_vma=False,
     )
-    return vjp(g)
+    return fn(q, k, v, g, out, lse)
 
 
 _ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
