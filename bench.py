@@ -303,6 +303,108 @@ def bench_data() -> None:
         _fail("bench_data", err, metric=metric)
 
 
+def bench_predict() -> None:
+    """Robot-side serving latency: exported-model predict rate for the
+    QT-Opt critic at CEM megabatch size (one call = one CEM iteration's
+    objective evaluation over all samples).
+
+    Invoked as `python bench.py predict`. The reference's design target is
+    1-10 Hz action selection on a robot workstation (README.md:54-55);
+    vs_baseline reports predict-calls/sec against the top of that band, so
+    1.0 means every CEM iteration fits a 10 Hz loop with one iteration.
+    """
+    import os
+    import tempfile
+
+    try:
+        devices = _init_devices(
+            max_wait=float(os.environ.get("BENCH_BACKEND_WAIT", "240"))
+        )
+    except Exception as err:
+        _fail("backend_init", err, metric="qtopt_cem_predict_hz")
+
+    import jax
+
+    _enable_compilation_cache()
+    on_tpu = devices[0].platform == "tpu"
+    if on_tpu:
+        image_size, num_convs = (472, 472), (6, 6, 3)
+        metric = "qtopt_cem_predict_hz"
+    else:
+        image_size, num_convs = (96, 96), (2, 2, 1)
+        metric = "qtopt_cem_predict_hz_cpu_proxy"
+    cem_samples = int(os.environ.get("BENCH_PREDICT_SAMPLES", "64"))
+
+    try:
+        from __graft_entry__ import _flagship
+
+        from tensor2robot_tpu.export.export_generators import (
+            DefaultExportGenerator,
+        )
+        from tensor2robot_tpu.export.saved_model import save_exported_model
+        from tensor2robot_tpu.predictors.exported_savedmodel_predictor import (
+            ExportedSavedModelPredictor,
+        )
+        from tensor2robot_tpu.specs import make_random_numpy
+        from tensor2robot_tpu.train.train_eval import CompiledModel
+
+        model, batch = _flagship(
+            image_size=image_size, batch_size=2, num_convs=num_convs
+        )
+        compiled = CompiledModel(model, donate_state=False)
+        state = compiled.init_state(jax.random.PRNGKey(0), batch)
+        generator = DefaultExportGenerator()
+        generator.set_specification_from_model(compiled.model)
+        variables = state.export_variables()
+        with tempfile.TemporaryDirectory() as root:
+            save_exported_model(
+                root,
+                variables=variables,
+                feature_spec=generator.serving_input_spec(),
+                label_spec=generator.label_spec,
+                global_step=0,
+                predict_fn=generator.create_serving_fn(compiled, variables),
+                example_features=generator.create_example_features(),
+                serialize_stablehlo=True,
+            )
+            predictor = ExportedSavedModelPredictor(export_dir=root)
+            if not predictor.restore():
+                raise RuntimeError("predictor restore failed")
+            features = make_random_numpy(
+                generator.serving_input_spec(), batch_size=cem_samples, seed=0
+            )
+
+            n_windows, window = (8, 5) if on_tpu else (4, 3)
+
+            def run_window():
+                # _measure_windows divides by `window`, so run that many
+                # calls; predict returns host numpy, hence self-syncing.
+                for _ in range(window):
+                    predictor.predict(features)
+
+            run_window()  # compile + warm-in, untimed
+            best_hz, avg_hz = _measure_windows(
+                run_window, lambda: None, n_windows, window
+            )
+        _emit(
+            {
+                "metric": metric,
+                "value": round(best_hz, 3),
+                "unit": "predict_calls_per_sec",
+                "vs_baseline": round(best_hz / 10.0, 4),
+                "detail": {
+                    "avg_calls_per_sec": round(avg_hz, 3),
+                    "cem_samples_per_call": cem_samples,
+                    "image_size": list(image_size),
+                    "interface": "stablehlo_exported_model",
+                    "reference_design_band_hz": [1, 10],
+                },
+            }
+        )
+    except Exception as err:
+        _fail("bench_predict", err, metric=metric)
+
+
 def main() -> None:
     import os
 
@@ -463,5 +565,7 @@ def main() -> None:
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "data":
         bench_data()
+    elif len(sys.argv) > 1 and sys.argv[1] == "predict":
+        bench_predict()
     else:
         main()

@@ -41,3 +41,4 @@ from tensor2robot_tpu.layers.transformer import (
     TransformerBlock,
     TransformerEncoder,
 )
+from tensor2robot_tpu.layers.moe import MoEBlock

@@ -1,0 +1,32 @@
+"""Parallelism over the device mesh: axes, collectives, schedules.
+
+Six named mesh axes (parallel/mesh.py) cover every regime the framework
+ships: data/fsdp (batch + ZeRO-3 parameter sharding), model (tensor
+parallelism), sequence (ring attention), pipe (GPipe pipeline schedule),
+expert (MoE dispatch). See docs/PARALLELISM.md.
+"""
+
+from tensor2robot_tpu.parallel.mesh import (
+    DATA_AXIS,
+    EXPERT_AXIS,
+    FSDP_AXIS,
+    MODEL_AXIS,
+    PIPE_AXIS,
+    SEQUENCE_AXIS,
+    data_sharding,
+    initialize_distributed,
+    make_mesh,
+    param_sharding,
+    replicated,
+    shard_batch,
+)
+from tensor2robot_tpu.parallel.pipeline import (
+    pipeline_apply,
+    stack_stage_params,
+    stage_sharding,
+)
+
+# NOTE: ring_attention is NOT re-exported as a function here — the package
+# attribute `parallel.ring_attention` must stay the submodule (callers use
+# `from tensor2robot_tpu.parallel import ring_attention` then
+# `ring_attention.ring_attention(...)`; rebinding it breaks them).

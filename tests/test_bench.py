@@ -66,3 +66,17 @@ def test_bench_data_contract():
     assert detail["records_per_sec"] > 0
     assert detail["batch_size"] == 4
     assert detail["parse_workers"] >= 1
+
+
+@pytest.mark.slow
+def test_bench_predict_contract():
+    payload = _run_bench(
+        "predict",
+        env_extra={"BENCH_BACKEND_WAIT": "60", "BENCH_PREDICT_SAMPLES": "8"},
+    )
+    assert payload["metric"] == "qtopt_cem_predict_hz_cpu_proxy"
+    assert payload["unit"] == "predict_calls_per_sec"
+    assert payload["value"] > 0
+    assert "error" not in payload
+    assert payload["detail"]["cem_samples_per_call"] == 8
+    assert payload["detail"]["interface"] == "stablehlo_exported_model"
