@@ -1,0 +1,232 @@
+"""Transformer model family: long-context behavioral cloning.
+
+A model family beyond the reference's temporal ceiling: the reference's
+sequence models top out at SNAIL/TCN scale over ~40-step episodes
+(reference layers/snail.py, research/vrgripper/vrgripper_env_models.py
+:139-324 — the BC contract this family mirrors); this one runs a causal
+transformer over the episode with flash attention on TPU and ring
+attention when the mesh has a sequence axis — the same model trains short
+episodes on one chip and long-horizon demonstrations on a context-
+parallel mesh without code changes. Optional mixture-of-experts feed-
+forwards ride the `expert` axis (docs/PARALLELISM.md).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import flax
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensor2robot_tpu.layers.spatial_softmax import spatial_softmax
+from tensor2robot_tpu.layers.transformer import TransformerEncoder
+from tensor2robot_tpu.models.abstract_model import (
+    MODE_TRAIN,
+    FlaxT2RModel,
+)
+from tensor2robot_tpu.specs import (
+    ExtendedTensorSpec,
+    TensorSpecStruct,
+    copy_tensorspec,
+)
+
+
+class _TransformerBCNet(nn.Module):
+    """Per-step conv embed -> causal transformer over time -> action head."""
+
+    action_size: int
+    d_model: int = 64
+    num_layers: int = 2
+    num_heads: int = 4
+    head_dim: int = 16
+    max_seq_len: int = 2048
+    num_experts: int = 1
+    mesh: Optional[object] = None
+    use_flash: Optional[bool] = None
+    interpret: bool = False
+
+    @nn.compact
+    def __call__(self, features, mode, labels=None):
+        del labels
+        image = features["image"]  # [B, T, H, W, 3]
+        pose = features["gripper_pose"]  # [B, T, P]
+        batch, steps = image.shape[:2]
+        x = image.reshape((batch * steps,) + image.shape[2:])
+        for filters in (32, 64):
+            x = nn.Conv(filters, (3, 3), strides=(2, 2))(x)
+            x = nn.relu(x)
+        points, _ = spatial_softmax(x)  # [B*T, 2*filters]
+        x = points.reshape(batch, steps, -1)
+        x = jnp.concatenate([x, pose], axis=-1)
+        x = nn.Dense(self.d_model, name="embed")(x)
+        x = TransformerEncoder(
+            num_layers=self.num_layers,
+            num_heads=self.num_heads,
+            head_dim=self.head_dim,
+            max_seq_len=self.max_seq_len,
+            causal=True,
+            mesh=self.mesh,
+            use_flash=self.use_flash,
+            interpret=self.interpret,
+            num_experts=self.num_experts,
+            name="encoder",
+        )(x)
+        action = nn.Dense(self.action_size, name="action_head")(x)
+        return {"inference_output": action, "action": action}
+
+
+class TransformerBCModel(FlaxT2RModel):
+    """Behavioral cloning over episodes with a causal transformer.
+
+    Same spec contract as the VRGripper BC family (per-step image +
+    proprioception in, per-step action out; reference
+    vrgripper_env_models.py:139-324), but the temporal core is attention:
+    flash on a single chip, ring attention over the mesh's `sequence`
+    axis for long-horizon episodes, optional expert-parallel MoE
+    feed-forwards (`num_experts > 1`, router aux loss folded into the
+    training loss).
+    """
+
+    _NETWORK_TAKES_LABELS = True
+
+    def __init__(
+        self,
+        action_size: int = 7,
+        pose_size: int = 14,
+        episode_length: int = 40,
+        image_size: Tuple[int, int] = (64, 64),
+        d_model: int = 64,
+        num_layers: int = 2,
+        num_heads: int = 4,
+        head_dim: int = 16,
+        num_experts: int = 1,
+        moe_aux_weight: float = 0.01,
+        mesh: Optional[object] = None,
+        use_flash: Optional[bool] = None,
+        interpret: bool = False,
+        **kwargs,
+    ):
+        super().__init__(**kwargs)
+        self._action_size = action_size
+        self._pose_size = pose_size
+        self._episode_length = episode_length
+        self._image_size = tuple(image_size)
+        self._d_model = d_model
+        self._num_layers = num_layers
+        self._num_heads = num_heads
+        self._head_dim = head_dim
+        self._num_experts = num_experts
+        self._moe_aux_weight = moe_aux_weight
+        self._mesh = mesh
+        self._use_flash = use_flash
+        self._interpret = interpret
+
+    def get_feature_specification(self, mode: str) -> TensorSpecStruct:
+        del mode
+        spec = TensorSpecStruct(
+            image=ExtendedTensorSpec(
+                shape=self._image_size + (3,),
+                dtype=np.float32,
+                name="image",
+                data_format="jpeg",
+            ),
+            gripper_pose=ExtendedTensorSpec(
+                shape=(self._pose_size,),
+                dtype=np.float32,
+                name="gripper_pose",
+            ),
+        )
+        return copy_tensorspec(spec, batch_size=self._episode_length)
+
+    def get_label_specification(self, mode: str) -> TensorSpecStruct:
+        del mode
+        spec = TensorSpecStruct(
+            action=ExtendedTensorSpec(
+                shape=(self._action_size,), dtype=np.float32, name="action"
+            )
+        )
+        return copy_tensorspec(spec, batch_size=self._episode_length)
+
+    def create_network(self) -> nn.Module:
+        return _TransformerBCNet(
+            action_size=self._action_size,
+            d_model=self._d_model,
+            num_layers=self._num_layers,
+            num_heads=self._num_heads,
+            head_dim=self._head_dim,
+            max_seq_len=max(self._episode_length, 8),
+            num_experts=self._num_experts,
+            mesh=self._mesh,
+            use_flash=self._use_flash,
+            interpret=self._interpret,
+        )
+
+    def init_variables(self, rng, features, mode=MODE_TRAIN):
+        variables = super().init_variables(rng, features, mode)
+        # Flax init keeps custom collections: drop the init-time sown aux
+        # values so they neither persist into checkpoints nor get averaged
+        # into later forwards (sow APPENDS to a pre-existing collection).
+        variables.pop("moe_aux_loss", None)
+        return variables
+
+    def inference_network_fn(
+        self, variables, features, mode, rng=None, labels=None
+    ):
+        if self._num_experts <= 1:
+            return super().inference_network_fn(
+                variables, features, mode, rng=rng, labels=labels
+            )
+        # MoE: the router aux loss is sown into the moe_aux_loss collection
+        # by each block; surface its mean in the TRAIN outputs so
+        # model_train_fn can fold it into the loss. Defense in depth
+        # against stale sown values riding in (see init_variables).
+        variables = {
+            key: value
+            for key, value in variables.items()
+            if key != "moe_aux_loss"
+        }
+        mutable = [c for c in self._MUTABLE_COLLECTIONS if c in variables]
+        mutable.append("moe_aux_loss")
+        rngs = {}
+        if rng is not None:
+            rng_dropout, rng_sample = jax.random.split(rng)
+            rngs = {"dropout": rng_dropout, "sample": rng_sample}
+        outputs, updates = self.network.apply(
+            variables, features, mode, labels, mutable=mutable, rngs=rngs
+        )
+        updates = flax.core.unfreeze(updates)
+        aux_leaves = jax.tree_util.tree_leaves(
+            updates.pop("moe_aux_loss", {})
+        )
+        outputs = dict(outputs)
+        if mode == MODE_TRAIN and aux_leaves:
+            # Train-only: the aux scalar must not leak into eval/serving
+            # signatures (create_export_outputs_fn exports all outputs).
+            outputs["moe_aux_loss"] = sum(aux_leaves) / len(aux_leaves)
+        if mode != MODE_TRAIN:
+            updates = {}
+        return outputs, updates
+
+    def model_train_fn(self, features, labels, inference_outputs, mode):
+        mse = jnp.mean(
+            jnp.square(inference_outputs["inference_output"] - labels["action"])
+        )
+        metrics = {"loss/mse": mse}
+        loss = mse
+        if "moe_aux_loss" in inference_outputs:
+            aux = inference_outputs["moe_aux_loss"]
+            metrics["loss/moe_aux"] = aux
+            loss = loss + self._moe_aux_weight * aux
+        return loss, metrics
+
+    def model_eval_fn(self, features, labels, inference_outputs):
+        return {
+            "eval/mse": jnp.mean(
+                jnp.square(
+                    inference_outputs["inference_output"] - labels["action"]
+                )
+            )
+        }

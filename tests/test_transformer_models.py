@@ -1,0 +1,117 @@
+"""Transformer BC model family: long-context episodes through the real
+trainer on a sequence-parallel mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensor2robot_tpu.models.transformer_models import TransformerBCModel
+from tensor2robot_tpu.parallel import mesh as mesh_lib
+from tensor2robot_tpu.specs import make_random_numpy
+from tensor2robot_tpu.train.train_eval import CompiledModel
+
+
+def _batch(model, batch_size=4, seed=0):
+    features = make_random_numpy(
+        model.get_feature_specification("train"),
+        batch_size=batch_size,
+        seed=seed,
+    )
+    labels = make_random_numpy(
+        model.get_label_specification("train"), batch_size=batch_size, seed=seed + 1
+    )
+    return {"features": features, "labels": labels}
+
+
+class TestTransformerBCModel:
+    def test_forward_shapes(self):
+        model = TransformerBCModel(
+            action_size=3, episode_length=8, image_size=(16, 16),
+            use_flash=False,
+        )
+        batch = _batch(model, batch_size=2)
+        variables = model.init_variables(
+            jax.random.PRNGKey(0), batch["features"]
+        )
+        outputs, _ = model.inference_network_fn(
+            variables, batch["features"], "eval"
+        )
+        assert outputs["inference_output"].shape == (2, 8, 3)
+
+    def test_trains_on_sequence_mesh(self):
+        """End to end through CompiledModel with the episode sharded over
+        the sequence axis — ring attention inside the real train step."""
+        mesh = mesh_lib.make_mesh(data=2, sequence=4)
+        model = TransformerBCModel(
+            action_size=3, episode_length=8, image_size=(16, 16),
+            mesh=mesh, use_flash=False,
+        )
+        compiled = CompiledModel(model, mesh=mesh, donate_state=False)
+        batch = _batch(model)
+        state = compiled.init_state(jax.random.PRNGKey(0), batch)
+        sharded = compiled.shard_batch(batch)
+        losses = []
+        for step in range(5):
+            state, metrics = compiled.train_step(
+                state, sharded, jax.random.PRNGKey(1)
+            )
+            losses.append(float(jax.device_get(metrics["loss"])))
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0]  # same batch: loss must drop
+
+    def test_moe_variant_folds_aux_loss(self):
+        model = TransformerBCModel(
+            action_size=2, episode_length=4, image_size=(16, 16),
+            num_experts=4, use_flash=False,
+        )
+        batch = _batch(model, batch_size=2)
+        variables = model.init_variables(
+            jax.random.PRNGKey(0), batch["features"]
+        )
+        outputs, _ = model.inference_network_fn(
+            variables, batch["features"], "train", rng=jax.random.PRNGKey(2)
+        )
+        assert "moe_aux_loss" in outputs
+        # Exactly one fresh aux value per block, no stale init-time sows.
+        loss, metrics = model.model_train_fn(
+            batch["features"], batch["labels"], outputs, "train"
+        )
+        assert "loss/moe_aux" in metrics
+        expected = float(metrics["loss/mse"]) + 0.01 * float(
+            outputs["moe_aux_loss"]
+        )
+        np.testing.assert_allclose(float(loss), expected, rtol=1e-6)
+
+    def test_moe_aux_excluded_from_eval_and_variables(self):
+        model = TransformerBCModel(
+            action_size=2, episode_length=4, image_size=(16, 16),
+            num_experts=4, use_flash=False,
+        )
+        batch = _batch(model, batch_size=2)
+        variables = model.init_variables(
+            jax.random.PRNGKey(0), batch["features"]
+        )
+        assert "moe_aux_loss" not in variables  # not checkpointed
+        outputs, updates = model.inference_network_fn(
+            variables, batch["features"], "eval"
+        )
+        assert "moe_aux_loss" not in outputs  # no serving leak
+        assert updates == {}
+
+    def test_eval_metrics(self):
+        model = TransformerBCModel(
+            action_size=2, episode_length=4, image_size=(16, 16),
+            use_flash=False,
+        )
+        batch = _batch(model, batch_size=2)
+        variables = model.init_variables(
+            jax.random.PRNGKey(0), batch["features"]
+        )
+        outputs, _ = model.inference_network_fn(
+            variables, batch["features"], "eval"
+        )
+        metrics = model.model_eval_fn(
+            batch["features"], batch["labels"], outputs
+        )
+        assert float(metrics["eval/mse"]) > 0
