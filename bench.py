@@ -109,7 +109,17 @@ def _probe_backend_subprocess(timeout: float) -> tuple[bool, str]:
 
 
 def _init_devices(max_wait: float = 600.0, probe_timeout: float = 150.0):
-    """jax.devices() surviving slow, flaky, or hanging TPU bring-up."""
+    """jax.devices() surviving slow, flaky, hanging, or WEDGED TPU
+    bring-up.
+
+    Returns (devices, backend_note): backend_note is None on a healthy
+    backend; when bring-up never succeeds within max_wait (e.g. the tunnel
+    is wedged by an earlier killed client), the bench falls back to the
+    CPU backend rather than zeroing out the round's evidence — the metric
+    name then says cpu_proxy and backend_note records why.
+    """
+    import os
+
     deadline = time.time() + max_wait
     delay = 5.0
     last_err = "no attempt made"
@@ -118,14 +128,12 @@ def _init_devices(max_wait: float = 600.0, probe_timeout: float = 150.0):
             min(probe_timeout, max(deadline - time.time(), 30.0))
         )
         if ok:
-            import os
-
             import jax
 
             platforms = os.environ.get("JAX_PLATFORMS")
             if platforms:
                 jax.config.update("jax_platforms", platforms)
-            return jax.devices()
+            return jax.devices(), None
         last_err = detail
         if time.time() + delay > deadline:
             break
@@ -135,7 +143,13 @@ def _init_devices(max_wait: float = 600.0, probe_timeout: float = 150.0):
         )
         time.sleep(delay)
         delay = min(delay * 2, 60.0)
-    raise RuntimeError(f"backend unavailable after {max_wait:.0f}s: {last_err}")
+    note = f"tpu_unavailable after {max_wait:.0f}s: {last_err}"
+    print(f"bench: {note}; falling back to CPU", file=sys.stderr)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    return jax.devices(), note
 
 
 def _enable_compilation_cache() -> None:
@@ -316,10 +330,9 @@ def bench_predict() -> None:
     import os
     import tempfile
 
+    max_wait = float(os.environ.get("BENCH_BACKEND_WAIT", "240"))
     try:
-        devices = _init_devices(
-            max_wait=float(os.environ.get("BENCH_BACKEND_WAIT", "240"))
-        )
+        devices, backend_note = _init_devices(max_wait=max_wait)
     except Exception as err:
         _fail("backend_init", err, metric="qtopt_cem_predict_hz")
 
@@ -398,6 +411,11 @@ def bench_predict() -> None:
                     "image_size": list(image_size),
                     "interface": "stablehlo_exported_model",
                     "reference_design_band_hz": [1, 10],
+                    **(
+                        {"backend_note": backend_note}
+                        if backend_note
+                        else {}
+                    ),
                 },
             }
         )
@@ -408,10 +426,9 @@ def bench_predict() -> None:
 def main() -> None:
     import os
 
+    max_wait = float(os.environ.get("BENCH_BACKEND_WAIT", "240"))
     try:
-        devices = _init_devices(
-            max_wait=float(os.environ.get("BENCH_BACKEND_WAIT", "240"))
-        )
+        devices, backend_note = _init_devices(max_wait=max_wait)
     except Exception as err:
         _fail("backend_init", err)
 
@@ -555,6 +572,11 @@ def main() -> None:
                     "device_kind": getattr(device, "device_kind", "?"),
                     "peak_flops": peak,
                     "bf16_forward": True,
+                    **(
+                        {"backend_note": backend_note}
+                        if backend_note
+                        else {}
+                    ),
                 },
             }
         )

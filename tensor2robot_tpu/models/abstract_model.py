@@ -267,6 +267,22 @@ class FlaxT2RModel(AbstractT2RModel):
         variables = self.network.init(rng, example, mode)
         return flax.core.unfreeze(variables)
 
+    def _extra_mutable_collections(self, mode) -> tuple:
+        """Extra flax collections to open during a TRAIN apply (beyond
+        _MUTABLE_COLLECTIONS); subclasses whose networks sow auxiliary
+        values (e.g. MoE router losses) name the collections here and
+        consume them in `_postprocess_network_outputs`."""
+        del mode
+        return ()
+
+    def _postprocess_network_outputs(self, outputs, updates, mode):
+        """Hook between network.apply and the trainer: subclasses may move
+        sown collection values from `updates` into `outputs` (anything
+        left in `updates` is merged into the train state's variables).
+        Receives mutable copies; returns (outputs, updates)."""
+        del mode
+        return outputs, updates
+
     def inference_network_fn(
         self, variables, features, mode, rng=None, labels=None
     ):
@@ -279,10 +295,21 @@ class FlaxT2RModel(AbstractT2RModel):
         args = (features, mode)
         if self._NETWORK_TAKES_LABELS:
             args = (features, mode, labels)
+        if mode == MODE_TRAIN:
+            mutable = mutable + [
+                c
+                for c in self._extra_mutable_collections(mode)
+                if c not in mutable
+            ]
         if mode == MODE_TRAIN and mutable:
             outputs, updates = self.network.apply(
                 variables, *args, mutable=mutable, rngs=rngs
             )
-            return outputs, flax.core.unfreeze(updates)
+            return self._postprocess_network_outputs(
+                dict(outputs), flax.core.unfreeze(updates), mode
+            )
         outputs = self.network.apply(variables, *args, rngs=rngs)
+        outputs, _ = self._postprocess_network_outputs(
+            dict(outputs), {}, mode
+        )
         return outputs, {}

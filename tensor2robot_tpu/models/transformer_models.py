@@ -15,7 +15,6 @@ from __future__ import annotations
 
 from typing import Optional, Tuple
 
-import flax
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
@@ -49,8 +48,7 @@ class _TransformerBCNet(nn.Module):
     interpret: bool = False
 
     @nn.compact
-    def __call__(self, features, mode, labels=None):
-        del labels
+    def __call__(self, features, mode):
         image = features["image"]  # [B, T, H, W, 3]
         pose = features["gripper_pose"]  # [B, T, P]
         batch, steps = image.shape[:2]
@@ -89,8 +87,6 @@ class TransformerBCModel(FlaxT2RModel):
     feed-forwards (`num_experts > 1`, router aux loss folded into the
     training loss).
     """
-
-    _NETWORK_TAKES_LABELS = True
 
     def __init__(
         self,
@@ -172,42 +168,21 @@ class TransformerBCModel(FlaxT2RModel):
         variables.pop("moe_aux_loss", None)
         return variables
 
-    def inference_network_fn(
-        self, variables, features, mode, rng=None, labels=None
-    ):
-        if self._num_experts <= 1:
-            return super().inference_network_fn(
-                variables, features, mode, rng=rng, labels=labels
-            )
-        # MoE: the router aux loss is sown into the moe_aux_loss collection
-        # by each block; surface its mean in the TRAIN outputs so
-        # model_train_fn can fold it into the loss. Defense in depth
-        # against stale sown values riding in (see init_variables).
-        variables = {
-            key: value
-            for key, value in variables.items()
-            if key != "moe_aux_loss"
-        }
-        mutable = [c for c in self._MUTABLE_COLLECTIONS if c in variables]
-        mutable.append("moe_aux_loss")
-        rngs = {}
-        if rng is not None:
-            rng_dropout, rng_sample = jax.random.split(rng)
-            rngs = {"dropout": rng_dropout, "sample": rng_sample}
-        outputs, updates = self.network.apply(
-            variables, features, mode, labels, mutable=mutable, rngs=rngs
-        )
-        updates = flax.core.unfreeze(updates)
+    def _extra_mutable_collections(self, mode):
+        del mode
+        return ("moe_aux_loss",) if self._num_experts > 1 else ()
+
+    def _postprocess_network_outputs(self, outputs, updates, mode):
+        # The router aux loss is sown into moe_aux_loss by each block;
+        # surface its mean in the TRAIN outputs (only — the scalar must
+        # not leak into eval/serving signatures, which export all outputs)
+        # so model_train_fn can fold it into the loss. Popping it from
+        # `updates` also keeps it out of the train state's variables.
         aux_leaves = jax.tree_util.tree_leaves(
             updates.pop("moe_aux_loss", {})
         )
-        outputs = dict(outputs)
         if mode == MODE_TRAIN and aux_leaves:
-            # Train-only: the aux scalar must not leak into eval/serving
-            # signatures (create_export_outputs_fn exports all outputs).
             outputs["moe_aux_loss"] = sum(aux_leaves) / len(aux_leaves)
-        if mode != MODE_TRAIN:
-            updates = {}
         return outputs, updates
 
     def model_train_fn(self, features, labels, inference_outputs, mode):
