@@ -330,9 +330,8 @@ def bench_predict() -> None:
     import os
     import tempfile
 
-    max_wait = float(os.environ.get("BENCH_BACKEND_WAIT", "240"))
     try:
-        devices, backend_note = _init_devices(max_wait=max_wait)
+        devices, backend_note = _init_devices(max_wait=_backend_wait())
     except Exception as err:
         _fail("backend_init", err, metric="qtopt_cem_predict_hz")
 
@@ -423,12 +422,23 @@ def bench_predict() -> None:
         _fail("bench_predict", err, metric=metric)
 
 
+def _backend_wait() -> float:
+    """BENCH_BACKEND_WAIT, with malformed values reported through the
+    one-JSON-line failure contract rather than a bare traceback."""
+    import os
+
+    raw = os.environ.get("BENCH_BACKEND_WAIT", "240")
+    try:
+        return float(raw)
+    except ValueError as err:
+        _fail("config", err)
+
+
 def main() -> None:
     import os
 
-    max_wait = float(os.environ.get("BENCH_BACKEND_WAIT", "240"))
     try:
-        devices, backend_note = _init_devices(max_wait=max_wait)
+        devices, backend_note = _init_devices(max_wait=_backend_wait())
     except Exception as err:
         _fail("backend_init", err)
 

@@ -104,3 +104,10 @@ for _cls in (
     _vrgripper.VRGripperEnvRegressionModelMAML,
 ):
     globals()[_cls.__name__] = external_configurable(_cls, _cls.__name__)
+
+# -- transformer model family -------------------------------------------------
+from tensor2robot_tpu.models import transformer_models as _transformer_models
+
+TransformerBCModel = external_configurable(
+    _transformer_models.TransformerBCModel, "TransformerBCModel"
+)

@@ -168,6 +168,23 @@ class TransformerBCModel(FlaxT2RModel):
         variables.pop("moe_aux_loss", None)
         return variables
 
+    def inference_network_fn(
+        self, variables, features, mode, rng=None, labels=None
+    ):
+        # Defense in depth against stale sown values riding in (a
+        # warm-start from a checkpoint written before init_variables
+        # stripped the collection): sow APPENDS to pre-existing entries,
+        # which would bias the aux-loss mean.
+        if "moe_aux_loss" in variables:
+            variables = {
+                key: value
+                for key, value in variables.items()
+                if key != "moe_aux_loss"
+            }
+        return super().inference_network_fn(
+            variables, features, mode, rng=rng, labels=labels
+        )
+
     def _extra_mutable_collections(self, mode):
         del mode
         return ("moe_aux_loss",) if self._num_experts > 1 else ()

@@ -74,6 +74,15 @@ def _pose_env_mc():
     return pose_env.PoseEnvContinuousMCModel(device_type="cpu")
 
 
+def _transformer_bc():
+    from tensor2robot_tpu.models.transformer_models import TransformerBCModel
+
+    return TransformerBCModel(
+        action_size=3, episode_length=4, image_size=(16, 16),
+        use_flash=False, device_type="cpu",
+    )
+
+
 MODEL_FACTORIES = {
     "mock": _mock,
     "qtopt": _qtopt,
@@ -82,6 +91,7 @@ MODEL_FACTORIES = {
     "vrgripper_regression": _vrgripper,
     "pose_env_regression": _pose_env_regression,
     "pose_env_mc": _pose_env_mc,
+    "transformer_bc": _transformer_bc,
 }
 
 
