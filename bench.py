@@ -331,7 +331,9 @@ def bench_predict() -> None:
     import tempfile
 
     try:
-        devices, backend_note = _init_devices(max_wait=_backend_wait())
+        devices, backend_note = _init_devices(
+            max_wait=_backend_wait(metric="qtopt_cem_predict_hz")
+        )
     except Exception as err:
         _fail("backend_init", err, metric="qtopt_cem_predict_hz")
 
@@ -422,16 +424,17 @@ def bench_predict() -> None:
         _fail("bench_predict", err, metric=metric)
 
 
-def _backend_wait() -> float:
+def _backend_wait(metric: str = "qtopt_critic_train_mfu_bs64_472px") -> float:
     """BENCH_BACKEND_WAIT, with malformed values reported through the
-    one-JSON-line failure contract rather than a bare traceback."""
+    one-JSON-line failure contract (under the caller's metric) rather
+    than a bare traceback."""
     import os
 
     raw = os.environ.get("BENCH_BACKEND_WAIT", "240")
     try:
         return float(raw)
     except ValueError as err:
-        _fail("config", err)
+        _fail("config", err, metric=metric)
 
 
 def main() -> None:

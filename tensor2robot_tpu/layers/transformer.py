@@ -3,10 +3,12 @@
 Beyond the reference layer library (its temporal models top out at
 SNAIL/TCN scale, layers/snail.py; SURVEY §5 long-context row): a standard
 pre-norm transformer whose attention routes through ops/flash_attention —
-single-device flash on TPU, and sequence-parallel ring attention
-(parallel/ring_attention.py) when constructed with a mesh whose `sequence`
-axis is >1. Sequence length lives in the specs, so the same model trains
-short episodes on one chip and long contexts on a CP mesh without code
+single-device flash on TPU, and sequence-parallel attention when
+constructed with a mesh whose `sequence` axis is >1 — the ring
+(parallel/ring_attention.py) by default, or Ulysses all-to-all
+(parallel/ulysses_attention.py) via `sequence_parallel_mode="ulysses"`.
+Sequence length lives in the specs, so the same model trains short
+episodes on one chip and long contexts on a CP mesh without code
 changes.
 """
 
@@ -25,9 +27,10 @@ from tensor2robot_tpu.parallel import mesh as mesh_lib
 class MultiHeadAttention(nn.Module):
     """Self-attention over [batch, seq, features].
 
-    mesh: when given with a sequence axis > 1, attention runs the
-    sequence-parallel ring; otherwise the single-device flash kernel
-    (with its reference fallback off-TPU).
+    mesh: when given with a sequence axis > 1, attention runs
+    sequence-parallel (the ring by default; `sequence_parallel_mode=
+    "ulysses"` selects the all-to-all strategy); otherwise the
+    single-device flash kernel (with its reference fallback off-TPU).
     """
 
     num_heads: int
@@ -36,6 +39,10 @@ class MultiHeadAttention(nn.Module):
     mesh: Optional[object] = None
     use_flash: Optional[bool] = None
     interpret: bool = False
+    # Context-parallel strategy when the mesh's sequence axis is >1:
+    # "ring" (K/V rotate, O(seq/N) memory/device) or "ulysses" (head-
+    # scatter all_to_all, one collective round, needs heads % N == 0).
+    sequence_parallel_mode: str = "ring"
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -48,12 +55,28 @@ class MultiHeadAttention(nn.Module):
             return t.reshape(batch, seq, self.num_heads, self.head_dim)
 
         q, k, v = heads(q), heads(k), heads(v)
+        if self.sequence_parallel_mode not in ("ring", "ulysses"):
+            # Validate eagerly — a typo must fail on the laptop run, not
+            # only once the config reaches a multi-device CP mesh.
+            raise ValueError(
+                "sequence_parallel_mode must be 'ring' or 'ulysses', "
+                f"got {self.sequence_parallel_mode!r}"
+            )
         sequence_axis = (
             dict(self.mesh.shape).get(mesh_lib.SEQUENCE_AXIS, 1)
             if self.mesh is not None
             else 1
         )
-        if sequence_axis > 1:
+        if sequence_axis > 1 and self.sequence_parallel_mode == "ulysses":
+            from tensor2robot_tpu.parallel.ulysses_attention import (
+                ulysses_attention,
+            )
+
+            out = ulysses_attention(
+                q, k, v, mesh=self.mesh, causal=self.causal,
+                use_flash=self.use_flash, interpret=self.interpret,
+            )
+        elif sequence_axis > 1:
             from tensor2robot_tpu.parallel.ring_attention import ring_attention
 
             out = ring_attention(
@@ -89,6 +112,7 @@ class TransformerBlock(nn.Module):
     interpret: bool = False
     num_experts: int = 1
     num_selected_experts: int = 2
+    sequence_parallel_mode: str = "ring"
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -99,6 +123,7 @@ class TransformerBlock(nn.Module):
             mesh=self.mesh,
             use_flash=self.use_flash,
             interpret=self.interpret,
+            sequence_parallel_mode=self.sequence_parallel_mode,
             name="attention",
         )(nn.LayerNorm(name="ln_attn")(x))
         h = nn.LayerNorm(name="ln_mlp")(x)
@@ -135,6 +160,7 @@ class TransformerEncoder(nn.Module):
     interpret: bool = False
     num_experts: int = 1
     num_selected_experts: int = 2
+    sequence_parallel_mode: str = "ring"
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -160,6 +186,7 @@ class TransformerEncoder(nn.Module):
                 interpret=self.interpret,
                 num_experts=self.num_experts,
                 num_selected_experts=self.num_selected_experts,
+                sequence_parallel_mode=self.sequence_parallel_mode,
                 name=f"block_{i}",
             )(x)
         return nn.LayerNorm(name="ln_final")(x)

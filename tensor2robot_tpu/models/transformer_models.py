@@ -46,6 +46,7 @@ class _TransformerBCNet(nn.Module):
     mesh: Optional[object] = None
     use_flash: Optional[bool] = None
     interpret: bool = False
+    sequence_parallel_mode: str = "ring"
 
     @nn.compact
     def __call__(self, features, mode):
@@ -70,6 +71,7 @@ class _TransformerBCNet(nn.Module):
             use_flash=self.use_flash,
             interpret=self.interpret,
             num_experts=self.num_experts,
+            sequence_parallel_mode=self.sequence_parallel_mode,
             name="encoder",
         )(x)
         action = nn.Dense(self.action_size, name="action_head")(x)
@@ -103,6 +105,7 @@ class TransformerBCModel(FlaxT2RModel):
         mesh: Optional[object] = None,
         use_flash: Optional[bool] = None,
         interpret: bool = False,
+        sequence_parallel_mode: str = "ring",
         **kwargs,
     ):
         super().__init__(**kwargs)
@@ -119,6 +122,7 @@ class TransformerBCModel(FlaxT2RModel):
         self._mesh = mesh
         self._use_flash = use_flash
         self._interpret = interpret
+        self._sequence_parallel_mode = sequence_parallel_mode
 
     def get_feature_specification(self, mode: str) -> TensorSpecStruct:
         del mode
@@ -158,6 +162,7 @@ class TransformerBCModel(FlaxT2RModel):
             mesh=self._mesh,
             use_flash=self._use_flash,
             interpret=self._interpret,
+            sequence_parallel_mode=self._sequence_parallel_mode,
         )
 
     def init_variables(self, rng, features, mode=MODE_TRAIN):

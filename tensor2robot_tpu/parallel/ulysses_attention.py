@@ -1,0 +1,129 @@
+"""Ulysses-style all-to-all sequence parallelism (DeepSpeed-Ulysses).
+
+The second context-parallel strategy next to parallel/ring_attention.py:
+instead of rotating K/V around a ring (N hops, compute overlapped with
+ppermute DMAs), TWO all_to_all collectives re-shard the problem so each
+device computes FULL attention for a subset of heads:
+
+    [B, S/N, H, D]  --all_to_all-->  [B, S, H/N, D]
+    full (flash) attention per local head group
+    [B, S, H/N, D]  --all_to_all-->  [B, S/N, H, D]
+
+Trade-off vs the ring: one collective round instead of N hops (better
+when the per-hop compute is too small to hide a ppermute), but it
+requires heads % N == 0 and moves Q as well as K/V. Per-device memory is
+O(S * H/N * D) — linear in global sequence length over the head shard,
+vs the ring's O(S/N * H * D); both avoid S^2 logits via the flash
+kernel. Gradients flow through all_to_all natively (its transpose is the
+inverse all_to_all), so no custom vjp is needed — including through the
+flash kernel path, whose custom vjp runs the Pallas backward per head
+group.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+from jax import lax
+
+try:
+    from jax import shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tensor2robot_tpu.ops.flash_attention import (
+    flash_attention,
+    reference_attention,
+)
+from tensor2robot_tpu.parallel.mesh import SEQUENCE_AXIS
+
+
+def _ulysses_shard_fn(
+    q, k, v, *, axis_name: str, causal: bool, scale: float,
+    use_flash: bool, interpret: bool,
+):
+    """Per-device body: seq-sharded in, seq-sharded out.
+
+    all_to_all splits the heads axis across devices and concatenates the
+    sequence axis, giving each device the FULL sequence for H/N heads;
+    attention is then entirely local (no masking subtleties — global
+    positions are contiguous here, unlike ring hops).
+    """
+    # [B, S/N, H, D] -> [B, S, H/N, D]: scatter heads (axis 2), gather
+    # sequence (axis 1).
+    def scatter_heads(x):
+        return lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=1, tiled=True
+        )
+
+    def gather_heads(x):
+        return lax.all_to_all(
+            x, axis_name, split_axis=1, concat_axis=2, tiled=True
+        )
+
+    q_local = scatter_heads(q)
+    k_local = scatter_heads(k)
+    v_local = scatter_heads(v)
+    if use_flash:
+        out = flash_attention(
+            q_local, k_local, v_local, causal=causal, scale=scale,
+            interpret=interpret,
+        )
+    else:
+        out = reference_attention(
+            q_local, k_local, v_local, causal=causal, scale=scale
+        )
+    return gather_heads(out)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    axis_name: str = SEQUENCE_AXIS,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    use_flash: Optional[bool] = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Sequence-parallel attention via head-scatter all_to_all.
+
+    Same contract as ring_attention: q/k/v are [batch, seq, heads, dim]
+    with seq sharded over `axis_name`; returns the seq-sharded output.
+    Requires seq % axis_size == 0 AND heads % axis_size == 0 (each device
+    owns whole heads after the scatter).
+    """
+    if q.ndim != 4:
+        raise ValueError(f"Expected [B, S, H, D], got {q.shape}")
+    axis_size = mesh.shape[axis_name]
+    _, seq, heads, _ = q.shape
+    if seq % axis_size != 0:
+        raise ValueError(
+            f"Sequence length {seq} must divide the {axis_name!r} axis "
+            f"size {axis_size}."
+        )
+    if heads % axis_size != 0:
+        raise ValueError(
+            f"Ulysses all-to-all needs heads ({heads}) divisible by the "
+            f"{axis_name!r} axis size ({axis_size}); use ring_attention "
+            "for head counts that do not split."
+        )
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    if use_flash is None:
+        use_flash = jax.default_backend() == "tpu" or interpret
+    spec = P(None, axis_name, None, None)
+    fn = shard_map(
+        functools.partial(
+            _ulysses_shard_fn, axis_name=axis_name, causal=causal,
+            scale=scale, use_flash=use_flash, interpret=interpret,
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)

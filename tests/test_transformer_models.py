@@ -60,6 +60,21 @@ class TestTransformerBCModel:
         assert all(np.isfinite(losses))
         assert losses[-1] < losses[0]  # same batch: loss must drop
 
+    def test_trains_with_ulysses_mode(self):
+        mesh = mesh_lib.make_mesh(data=2, sequence=4)
+        model = TransformerBCModel(
+            action_size=2, episode_length=8, image_size=(16, 16),
+            num_heads=4, mesh=mesh, use_flash=False,
+            sequence_parallel_mode="ulysses",
+        )
+        compiled = CompiledModel(model, mesh=mesh, donate_state=False)
+        batch = _batch(model)
+        state = compiled.init_state(jax.random.PRNGKey(0), batch)
+        state, metrics = compiled.train_step(
+            state, compiled.shard_batch(batch), jax.random.PRNGKey(1)
+        )
+        assert np.isfinite(float(jax.device_get(metrics["loss"])))
+
     def test_moe_variant_folds_aux_loss(self):
         model = TransformerBCModel(
             action_size=2, episode_length=4, image_size=(16, 16),

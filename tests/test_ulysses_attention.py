@@ -1,0 +1,145 @@
+"""Ulysses all-to-all sequence parallelism vs the attention oracle."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensor2robot_tpu.ops.flash_attention import reference_attention
+from tensor2robot_tpu.parallel import mesh as mesh_lib
+from tensor2robot_tpu.parallel.ulysses_attention import ulysses_attention
+
+
+def _mesh(n):
+    return mesh_lib.make_mesh(data=1, sequence=n, devices=jax.devices()[:n])
+
+
+class TestUlyssesAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_reference(self, causal):
+        n = 4
+        mesh = _mesh(n)
+        rng = np.random.RandomState(0)
+        q, k, v = (
+            jnp.asarray(rng.randn(2, 8 * n, 4, 8).astype(np.float32))
+            for _ in range(3)
+        )
+        ref = reference_attention(q, k, v, causal=causal)
+        out = ulysses_attention(
+            q, k, v, mesh=mesh, causal=causal, use_flash=False
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-5
+        )
+
+    def test_flash_path_matches_reference(self):
+        n = 4
+        mesh = _mesh(n)
+        rng = np.random.RandomState(1)
+        q = jnp.asarray(rng.randn(1, 8 * n, 4, 8).astype(np.float32))
+        ref = reference_attention(q, q, q, causal=True)
+        out = ulysses_attention(
+            q, q, q, mesh=mesh, causal=True, use_flash=True, interpret=True
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-4
+        )
+
+    def test_gradients_match_reference(self):
+        n = 4
+        mesh = _mesh(n)
+        rng = np.random.RandomState(2)
+        shape = (1, 4 * n, 4, 8)
+        q, k, v = (
+            jnp.asarray(rng.randn(*shape).astype(np.float32))
+            for _ in range(3)
+        )
+
+        def loss_ulysses(q, k, v):
+            return jnp.sum(
+                ulysses_attention(
+                    q, k, v, mesh=mesh, causal=True, use_flash=False
+                )
+                ** 2
+            )
+
+        def loss_ref(q, k, v):
+            return jnp.sum(reference_attention(q, k, v, causal=True) ** 2)
+
+        g_u = jax.grad(loss_ulysses, argnums=(0, 1, 2))(q, k, v)
+        g_r = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip("qkv", g_u, g_r):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4,
+                err_msg=f"d{name} mismatch",
+            )
+
+    def test_indivisible_heads_raise(self):
+        mesh = _mesh(4)
+        q = jnp.ones((1, 16, 3, 8), jnp.float32)  # 3 heads, 4 devices
+        with pytest.raises(ValueError, match="divisible"):
+            ulysses_attention(q, q, q, mesh=mesh)
+
+    def test_indivisible_sequence_raises(self):
+        mesh = _mesh(4)
+        q = jnp.ones((1, 10, 4, 8), jnp.float32)
+        with pytest.raises(ValueError, match="divide"):
+            ulysses_attention(q, q, q, mesh=mesh)
+
+    def test_agrees_with_ring(self):
+        """Both context-parallel strategies compute the same function."""
+        from tensor2robot_tpu.parallel.ring_attention import ring_attention
+
+        n = 4
+        mesh = _mesh(n)
+        rng = np.random.RandomState(3)
+        q, k, v = (
+            jnp.asarray(rng.randn(1, 8 * n, 4, 8).astype(np.float32))
+            for _ in range(3)
+        )
+        out_ring = ring_attention(
+            q, k, v, mesh=mesh, causal=True, use_flash=False
+        )
+        out_ulysses = ulysses_attention(
+            q, k, v, mesh=mesh, causal=True, use_flash=False
+        )
+        np.testing.assert_allclose(
+            np.asarray(out_ulysses), np.asarray(out_ring),
+            rtol=1e-4, atol=1e-5,
+        )
+
+
+class TestUlyssesInTransformer:
+    def test_mha_ulysses_matches_local(self):
+        from tensor2robot_tpu.layers import MultiHeadAttention
+
+        mesh = _mesh(4)
+        x = jnp.asarray(
+            np.random.RandomState(5).randn(2, 32, 16).astype(np.float32)
+        )
+        mha_local = MultiHeadAttention(
+            num_heads=4, head_dim=8, causal=True, use_flash=False
+        )
+        params = mha_local.init(jax.random.PRNGKey(0), x)
+        mha_ulysses = MultiHeadAttention(
+            num_heads=4, head_dim=8, causal=True, use_flash=False,
+            mesh=mesh, sequence_parallel_mode="ulysses",
+        )
+        out_local = mha_local.apply(params, x)
+        out_ulysses = mha_ulysses.apply(params, x)
+        np.testing.assert_allclose(
+            np.asarray(out_ulysses), np.asarray(out_local),
+            rtol=1e-4, atol=1e-5,
+        )
+
+    def test_bad_mode_raises(self):
+        from tensor2robot_tpu.layers import MultiHeadAttention
+
+        mesh = _mesh(4)
+        x = jnp.ones((1, 16, 8), jnp.float32)
+        mha = MultiHeadAttention(
+            num_heads=2, head_dim=4, mesh=mesh,
+            sequence_parallel_mode="spiral",
+        )
+        with pytest.raises(ValueError, match="ring.*ulysses|ulysses.*ring"):
+            mha.init(jax.random.PRNGKey(0), x)
