@@ -170,8 +170,8 @@ def ring_attention(
     axis_size = mesh.shape[axis_name]
     if q.shape[1] % axis_size != 0:
         raise ValueError(
-            f"Sequence length {q.shape[1]} must divide the {axis_name!r} "
-            f"axis size {axis_size}."
+            f"Sequence length {q.shape[1]} must be divisible by the "
+            f"{axis_name!r} axis size {axis_size}."
         )
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     if use_flash is None:

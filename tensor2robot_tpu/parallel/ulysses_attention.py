@@ -103,8 +103,8 @@ def ulysses_attention(
     _, seq, heads, _ = q.shape
     if seq % axis_size != 0:
         raise ValueError(
-            f"Sequence length {seq} must divide the {axis_name!r} axis "
-            f"size {axis_size}."
+            f"Sequence length {seq} must be divisible by the "
+            f"{axis_name!r} axis size {axis_size}."
         )
     if heads % axis_size != 0:
         raise ValueError(
@@ -116,6 +116,12 @@ def ulysses_attention(
     if use_flash is None:
         use_flash = jax.default_backend() == "tpu" or interpret
     spec = P(None, axis_name, None, None)
+    extra = {}
+    if use_flash:
+        # Pallas kernels inside shard_map trip the varying-manual-axes
+        # checker; the einsum path keeps full checking (as in
+        # ring_attention._ring_call).
+        extra["check_vma"] = False
     fn = shard_map(
         functools.partial(
             _ulysses_shard_fn, axis_name=axis_name, causal=causal,
@@ -124,6 +130,6 @@ def ulysses_attention(
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        check_vma=False,
+        **extra,
     )
     return fn(q, k, v)

@@ -70,7 +70,7 @@ class TestRingAttention:
             data=1, sequence=8, devices=jax.devices()[:8]
         )
         q, k, v = _qkv(seq=20)
-        with pytest.raises(ValueError, match="divide"):
+        with pytest.raises(ValueError, match="divisible"):
             ring_attention(q, k, v, mesh=mesh)
 
     def test_bf16_inputs(self):
