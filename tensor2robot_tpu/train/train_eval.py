@@ -81,13 +81,119 @@ class CompiledModel:
         mesh=None,
         donate_state: bool = True,
         param_min_shard_size: int = 2 ** 14,
+        remat: bool = False,
+        grad_accum_steps: int = 1,
     ):
+        """Args beyond the model/mesh:
+
+        remat: rematerialize the forward pass under autodiff
+          (jax.checkpoint) — activations are recomputed in the backward
+          instead of stored, trading ~1/3 more FLOPs for O(depth) less
+          HBM; the standard lever when a big batch or long episode
+          doesn't fit.
+        grad_accum_steps: K>1 splits each batch into K microbatches,
+          accumulates gradients over them in a lax.scan, and applies ONE
+          optimizer update of their mean — the effective batch stays the
+          same while peak activation memory drops by ~K. Caveat: batch
+          norm computes statistics per MICRObatch (the standard
+          grad-accumulation behavior), so BN models are not bit-identical
+          to the unaccumulated step.
+        """
         self.model = model
         self.mesh = mesh if mesh is not None else mesh_lib.make_mesh()
         self.preprocessor = model.preprocessor
         self.optimizer = model.create_optimizer()
         self._donate = donate_state
         self._param_min_shard_size = param_min_shard_size
+        if grad_accum_steps < 1:
+            raise ValueError(f"grad_accum_steps must be >= 1, got {grad_accum_steps}")
+
+        def forward_loss(params, variables, features, labels, rng_net):
+            variables = dict(variables)
+            variables["params"] = params
+            f, l, outputs, mutable = model.packed_inference(
+                variables, features, MODE_TRAIN, labels=labels, rng=rng_net
+            )
+            loss, train_metrics = model.model_train_fn(
+                f, l, outputs, MODE_TRAIN
+            )
+            return loss, (train_metrics, mutable)
+
+        if remat:
+            # Differentiating through the checkpointed forward recomputes
+            # activations in the backward pass instead of storing them.
+            forward_loss = jax.checkpoint(
+                forward_loss, static_argnums=(), policy=None
+            )
+
+        def compute_grads(state, features, labels, rng_net):
+            """(loss, metrics, mutable, grads) for one (micro)batch."""
+            (loss, (train_metrics, mutable)), grads = jax.value_and_grad(
+                forward_loss, has_aux=True
+            )(state.params, state.variables, features, labels, rng_net)
+            return loss, train_metrics, mutable, grads
+
+        def accumulated_grads(state, features, labels, rng_net):
+            """Mean grads/metrics over K microbatches via lax.scan — one
+            microbatch's activations alive at a time, ONE traced copy of
+            the model (the accumulator is seeded with zeros shaped via
+            eval_shape, so the forward/backward graph exists only in the
+            scan body)."""
+            if grad_accum_steps == 1:
+                return compute_grads(state, features, labels, rng_net)
+
+            def split(leaf):
+                batch = leaf.shape[0]
+                if batch % grad_accum_steps != 0:
+                    raise ValueError(
+                        f"Batch {batch} not divisible by grad_accum_steps="
+                        f"{grad_accum_steps}"
+                    )
+                return leaf.reshape(
+                    (grad_accum_steps, batch // grad_accum_steps)
+                    + leaf.shape[1:]
+                )
+
+            micro = jax.tree_util.tree_map(split, (features, labels))
+            example = jax.tree_util.tree_map(lambda leaf: leaf[0], micro)
+            shapes = jax.eval_shape(
+                compute_grads, state, example[0], example[1], rng_net
+            )
+            zeros = jax.tree_util.tree_map(
+                lambda s: jnp.zeros(s.shape, s.dtype), shapes
+            )
+
+            def body(carry, index_and_micro):
+                index, (micro_features, micro_labels) = index_and_micro
+                loss, metrics, mutable, grads = compute_grads(
+                    state,
+                    micro_features,
+                    micro_labels,
+                    # Independent stochasticity (dropout masks) per
+                    # microbatch, as one large-batch draw would have.
+                    jax.random.fold_in(rng_net, index),
+                )
+                acc_loss, acc_metrics, _, acc_grads = carry
+                new_carry = (
+                    acc_loss + loss / grad_accum_steps,
+                    jax.tree_util.tree_map(
+                        lambda a, m: a + m / grad_accum_steps,
+                        acc_metrics,
+                        metrics,
+                    ),
+                    mutable,  # last microbatch's batch-norm stats win
+                    jax.tree_util.tree_map(
+                        lambda a, g: a + g / grad_accum_steps,
+                        acc_grads,
+                        grads,
+                    ),
+                )
+                return new_carry, None
+
+            (loss, train_metrics, mutable, grads), _ = jax.lax.scan(
+                body, zeros, (jnp.arange(grad_accum_steps), micro)
+            )
+            return loss, train_metrics, mutable, grads
 
         def train_step(state: TrainState, batch, rng):
             step_rng = jax.random.fold_in(rng, state.step)
@@ -95,21 +201,9 @@ class CompiledModel:
             features, labels = self.preprocessor.preprocess(
                 batch["features"], batch["labels"], mode=MODE_TRAIN, rng=rng_pre
             )
-
-            def loss_fn(params):
-                variables = dict(state.variables)
-                variables["params"] = params
-                f, l, outputs, mutable = model.packed_inference(
-                    variables, features, MODE_TRAIN, labels=labels, rng=rng_net
-                )
-                loss, train_metrics = model.model_train_fn(
-                    f, l, outputs, MODE_TRAIN
-                )
-                return loss, (train_metrics, mutable)
-
-            (loss, (train_metrics, mutable)), grads = jax.value_and_grad(
-                loss_fn, has_aux=True
-            )(state.params)
+            loss, train_metrics, mutable, grads = accumulated_grads(
+                state, features, labels, rng_net
+            )
             updates, opt_state = self.optimizer.update(
                 grads, state.opt_state, state.params
             )
@@ -364,6 +458,8 @@ def train_eval_model(
     use_tensorboard: Optional[bool] = None,
     iterations_per_loop: int = 1,
     infeed_depth: int = 2,
+    remat: bool = False,
+    grad_accum_steps: int = 1,
 ) -> Dict[str, float]:
     """Trains (and periodically evaluates/exports) the model.
 
@@ -375,13 +471,18 @@ def train_eval_model(
     hooks then observe loop granularity, exactly as reference SessionRunHooks
     did under TPUEstimator. infeed_depth batches are kept device-resident
     ahead of the consumer (double-buffered host->device transfer).
+    remat / grad_accum_steps are the memory levers (see CompiledModel):
+    recompute activations in the backward, and/or split each batch into
+    K gradient-accumulation microbatches.
     """
     model = maybe_wrap_for_tpu(t2r_model)
     print_specification(model)
     os.makedirs(model_dir, exist_ok=True)
     _save_operative_config(model_dir)
 
-    compiled = CompiledModel(model, mesh=mesh)
+    compiled = CompiledModel(
+        model, mesh=mesh, remat=remat, grad_accum_steps=grad_accum_steps
+    )
     if use_ema_for_eval is None:
         use_ema_for_eval = getattr(model, "use_avg_model_params", False)
 

@@ -320,3 +320,81 @@ class TestParamSharding:
             state, compiled.shard_batch(batch), jax.random.PRNGKey(1)
         )
         assert float(jax.device_get(metrics["loss"])) > 0
+
+
+class TestMemoryLevers:
+    """remat and gradient accumulation must be numerically transparent:
+    same batch, same rng -> same updated parameters as the plain step."""
+
+    def _setup(self, use_batch_norm=True, **compiled_kwargs):
+        model = MockT2RModel(
+            device_type="cpu", use_batch_norm=use_batch_norm
+        )
+        generator = MockInputGenerator(batch_size=8)
+        generator.set_specification_from_model(model, "train")
+        batch = next(iter(generator.create_dataset("train")))
+        compiled = train_eval.CompiledModel(
+            model, donate_state=False, **compiled_kwargs
+        )
+        state = compiled.init_state(jax.random.PRNGKey(0), batch)
+        return compiled, state, batch
+
+    def _one_step_params(self, compiled, state, batch):
+        state, metrics = compiled.train_step(
+            state, compiled.shard_batch(batch), jax.random.PRNGKey(7)
+        )
+        return (
+            jax.device_get(state.params),
+            float(jax.device_get(metrics["loss"])),
+        )
+
+    def test_remat_matches_plain_step(self):
+        compiled, state, batch = self._setup()
+        params_plain, loss_plain = self._one_step_params(
+            compiled, state, batch
+        )
+        compiled_r, state_r, _ = self._setup(remat=True)
+        params_remat, loss_remat = self._one_step_params(
+            compiled_r, state_r, batch
+        )
+        assert abs(loss_plain - loss_remat) < 1e-6
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7),
+            params_plain,
+            params_remat,
+        )
+
+    def test_grad_accum_matches_plain_step(self):
+        """Mean-of-microbatch grads == full-batch grads for a mean loss,
+        so the updated params must agree to fp tolerance. Batch norm is
+        off: per-microbatch statistics differ from full-batch statistics
+        by construction (the standard grad-accumulation caveat), so
+        transparency only holds for BN-free models."""
+        compiled, state, batch = self._setup(use_batch_norm=False)
+        params_plain, loss_plain = self._one_step_params(
+            compiled, state, batch
+        )
+        compiled_a, state_a, _ = self._setup(
+            use_batch_norm=False, grad_accum_steps=4
+        )
+        params_accum, loss_accum = self._one_step_params(
+            compiled_a, state_a, batch
+        )
+        assert abs(loss_plain - loss_accum) < 1e-5
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6),
+            params_plain,
+            params_accum,
+        )
+
+    def test_grad_accum_rejects_indivisible_batch(self):
+        compiled, state, batch = self._setup(grad_accum_steps=3)
+        with pytest.raises(ValueError, match="divisible"):
+            compiled.train_step(
+                state, compiled.shard_batch(batch), jax.random.PRNGKey(7)
+            )
+
+    def test_bad_accum_steps_rejected(self):
+        model = MockT2RModel(device_type="cpu")
+        with pytest.raises(ValueError, match="grad_accum_steps"):
+            train_eval.CompiledModel(model, grad_accum_steps=0)
