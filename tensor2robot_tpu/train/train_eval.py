@@ -83,6 +83,7 @@ class CompiledModel:
         param_min_shard_size: int = 2 ** 14,
         remat: bool = False,
         grad_accum_steps: int = 1,
+        shard_weight_update: bool = False,
     ):
         """Args beyond the model/mesh:
 
@@ -91,6 +92,13 @@ class CompiledModel:
           instead of stored, trading ~1/3 more FLOPs for O(depth) less
           HBM; the standard lever when a big batch or long episode
           doesn't fit.
+        shard_weight_update: in pure data parallelism, shard optimizer
+          moments and the EMA mirror over the data axis (cross-replica
+          weight-update sharding, arXiv:2004.13336 / ZeRO-2) — params
+          stay replicated for compute while optimizer-state memory drops
+          by the data-axis size; GSPMD rewrites the gradient all-reduce
+          into reduce-scatter + sharded update + all-gather. Ignored when
+          the fsdp/model axes already shard parameters.
         grad_accum_steps: K>1 splits each batch into K microbatches,
           accumulates gradients over them in a lax.scan, and applies ONE
           optimizer update of their mean — the effective batch stays the
@@ -105,6 +113,7 @@ class CompiledModel:
         self.optimizer = model.create_optimizer()
         self._donate = donate_state
         self._param_min_shard_size = param_min_shard_size
+        self._shard_weight_update = shard_weight_update
         if grad_accum_steps < 1:
             raise ValueError(f"grad_accum_steps must be >= 1, got {grad_accum_steps}")
 
@@ -133,54 +142,64 @@ class CompiledModel:
             )(state.params, state.variables, features, labels, rng_net)
             return loss, train_metrics, mutable, grads
 
+        def _microbatch(tree, index):
+            """Slice microbatch `index` out of every batch-carrying leaf.
+
+            Mirrors shard_batch's tolerance: leaves whose leading dim
+            divides K split; 0-d and unit-leading leaves replicate into
+            every microbatch; a >1 leading dim that does not divide is a
+            real batch that cannot split — raise.
+            """
+
+            def take(leaf):
+                shape = getattr(leaf, "shape", ())
+                if len(shape) == 0 or shape[0] == 1:
+                    return leaf
+                if shape[0] % grad_accum_steps != 0:
+                    raise ValueError(
+                        f"Leaf batch {shape[0]} not divisible by "
+                        f"grad_accum_steps={grad_accum_steps}"
+                    )
+                size = shape[0] // grad_accum_steps
+                return jax.lax.dynamic_slice_in_dim(
+                    leaf, index * size, size, axis=0
+                )
+
+            return jax.tree_util.tree_map(take, tree)
+
         def accumulated_grads(state, features, labels, rng_net):
-            """Mean grads/metrics over K microbatches via lax.scan — one
+            """Grads averaged over K microbatches via lax.scan — one
             microbatch's activations alive at a time, ONE traced copy of
             the model (the accumulator is seeded with zeros shaped via
-            eval_shape, so the forward/backward graph exists only in the
-            scan body)."""
+            eval_shape; microbatches are dynamic slices of the full
+            batch, so the forward/backward graph exists only in the scan
+            body). Metrics come back stacked per microbatch and are
+            recombined shape-aware afterwards.
+            """
             if grad_accum_steps == 1:
                 return compute_grads(state, features, labels, rng_net)
 
-            def split(leaf):
-                batch = leaf.shape[0]
-                if batch % grad_accum_steps != 0:
-                    raise ValueError(
-                        f"Batch {batch} not divisible by grad_accum_steps="
-                        f"{grad_accum_steps}"
-                    )
-                return leaf.reshape(
-                    (grad_accum_steps, batch // grad_accum_steps)
-                    + leaf.shape[1:]
-                )
-
-            micro = jax.tree_util.tree_map(split, (features, labels))
-            example = jax.tree_util.tree_map(lambda leaf: leaf[0], micro)
-            shapes = jax.eval_shape(
-                compute_grads, state, example[0], example[1], rng_net
-            )
-            zeros = jax.tree_util.tree_map(
-                lambda s: jnp.zeros(s.shape, s.dtype), shapes
-            )
-
-            def body(carry, index_and_micro):
-                index, (micro_features, micro_labels) = index_and_micro
-                loss, metrics, mutable, grads = compute_grads(
+            def grads_at(index):
+                return compute_grads(
                     state,
-                    micro_features,
-                    micro_labels,
+                    _microbatch(features, index),
+                    _microbatch(labels, index),
                     # Independent stochasticity (dropout masks) per
                     # microbatch, as one large-batch draw would have.
                     jax.random.fold_in(rng_net, index),
                 )
-                acc_loss, acc_metrics, _, acc_grads = carry
+
+            shapes = jax.eval_shape(grads_at, jnp.int32(0))
+            zeros = jax.tree_util.tree_map(
+                lambda s: jnp.zeros(s.shape, s.dtype),
+                (shapes[0], shapes[2], shapes[3]),
+            )
+
+            def body(carry, index):
+                loss, metrics, mutable, grads = grads_at(index)
+                acc_loss, _, acc_grads = carry
                 new_carry = (
                     acc_loss + loss / grad_accum_steps,
-                    jax.tree_util.tree_map(
-                        lambda a, m: a + m / grad_accum_steps,
-                        acc_metrics,
-                        metrics,
-                    ),
                     mutable,  # last microbatch's batch-norm stats win
                     jax.tree_util.tree_map(
                         lambda a, g: a + g / grad_accum_steps,
@@ -188,10 +207,25 @@ class CompiledModel:
                         grads,
                     ),
                 )
-                return new_carry, None
+                return new_carry, metrics
 
-            (loss, train_metrics, mutable, grads), _ = jax.lax.scan(
-                body, zeros, (jnp.arange(grad_accum_steps), micro)
+            (loss, mutable, grads), stacked_metrics = jax.lax.scan(
+                body, zeros, jnp.arange(grad_accum_steps)
+            )
+
+            def combine_metric(stacked):
+                # [K] scalar floats: mean of per-microbatch means == the
+                # full-batch mean. [K] integers: per-microbatch counts sum
+                # to the full-batch count. [K, B/K, ...] tensors (e.g.
+                # golden-value captures): concatenate back to full batch.
+                if stacked.ndim == 1:
+                    if jnp.issubdtype(stacked.dtype, jnp.floating):
+                        return jnp.mean(stacked)
+                    return jnp.sum(stacked)
+                return stacked.reshape((-1,) + stacked.shape[2:])
+
+            train_metrics = jax.tree_util.tree_map(
+                combine_metric, stacked_metrics
             )
             return loss, train_metrics, mutable, grads
 
@@ -285,9 +319,27 @@ class CompiledModel:
             )
         # Replicate onto the mesh so jitted steps see mesh-placed inputs.
         replicated = mesh_lib.replicated(self.mesh)
-        return jax.tree_util.tree_map(
+        state = jax.tree_util.tree_map(
             lambda x: jax.device_put(x, replicated), state
         )
+        if (
+            self._shard_weight_update
+            and self.mesh.shape[mesh_lib.DATA_AXIS] > 1
+        ):
+            # Cross-replica weight-update sharding (ZeRO-2): only the
+            # optimizer-side mirrors shard; params/variables stay
+            # replicated for the forward/backward.
+            rule = mesh_lib.weight_update_sharding(
+                self.mesh, min_weight_size=self._param_min_shard_size
+            )
+            resharded = jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, rule(x)),
+                (state.opt_state, state.ema_params),
+            )
+            state = state.replace(
+                opt_state=resharded[0], ema_params=resharded[1]
+            )
+        return state
 
     def shard_batch(self, batch):
         return mesh_lib.shard_batch(batch, self.mesh)
@@ -460,6 +512,7 @@ def train_eval_model(
     infeed_depth: int = 2,
     remat: bool = False,
     grad_accum_steps: int = 1,
+    shard_weight_update: bool = False,
 ) -> Dict[str, float]:
     """Trains (and periodically evaluates/exports) the model.
 
@@ -471,9 +524,10 @@ def train_eval_model(
     hooks then observe loop granularity, exactly as reference SessionRunHooks
     did under TPUEstimator. infeed_depth batches are kept device-resident
     ahead of the consumer (double-buffered host->device transfer).
-    remat / grad_accum_steps are the memory levers (see CompiledModel):
-    recompute activations in the backward, and/or split each batch into
-    K gradient-accumulation microbatches.
+    remat / grad_accum_steps / shard_weight_update are the memory levers
+    (see CompiledModel): recompute activations in the backward, split
+    each batch into K gradient-accumulation microbatches, and/or shard
+    optimizer state across data-parallel replicas (ZeRO-2).
     """
     model = maybe_wrap_for_tpu(t2r_model)
     print_specification(model)
@@ -481,7 +535,8 @@ def train_eval_model(
     _save_operative_config(model_dir)
 
     compiled = CompiledModel(
-        model, mesh=mesh, remat=remat, grad_accum_steps=grad_accum_steps
+        model, mesh=mesh, remat=remat, grad_accum_steps=grad_accum_steps,
+        shard_weight_update=shard_weight_update,
     )
     if use_ema_for_eval is None:
         use_ema_for_eval = getattr(model, "use_avg_model_params", False)
