@@ -212,17 +212,31 @@ class CompiledModel:
             (loss, mutable, grads), stacked_metrics = jax.lax.scan(
                 body, zeros, jnp.arange(grad_accum_steps)
             )
+            # The per-microbatch batch size, for telling batch-carrying
+            # metric tensors apart from fixed-size vector metrics.
+            micro_sizes = {
+                leaf.shape[0] // grad_accum_steps
+                for leaf in jax.tree_util.tree_leaves((features, labels))
+                if getattr(leaf, "ndim", 0) >= 1
+                and leaf.shape[0] > 1
+                and leaf.shape[0] % grad_accum_steps == 0
+            }
 
             def combine_metric(stacked):
-                # [K] scalar floats: mean of per-microbatch means == the
-                # full-batch mean. [K] integers: per-microbatch counts sum
-                # to the full-batch count. [K, B/K, ...] tensors (e.g.
-                # golden-value captures): concatenate back to full batch.
-                if stacked.ndim == 1:
-                    if jnp.issubdtype(stacked.dtype, jnp.floating):
-                        return jnp.mean(stacked)
-                    return jnp.sum(stacked)
-                return stacked.reshape((-1,) + stacked.shape[2:])
+                # Per-metric stacked leaves are [K, ...]. Batch-carrying
+                # tensors ([K, B/K, ...], e.g. golden-value captures)
+                # concatenate back to the full batch; everything else is
+                # reduced over the K axis shape-preserving — floats
+                # average (mean of per-microbatch means == full-batch
+                # mean), integer counts sum.
+                if (
+                    stacked.ndim >= 2
+                    and stacked.shape[1] in micro_sizes
+                ):
+                    return stacked.reshape((-1,) + stacked.shape[2:])
+                if jnp.issubdtype(stacked.dtype, jnp.floating):
+                    return jnp.mean(stacked, axis=0)
+                return jnp.sum(stacked, axis=0)
 
             train_metrics = jax.tree_util.tree_map(
                 combine_metric, stacked_metrics
@@ -319,27 +333,30 @@ class CompiledModel:
             )
         # Replicate onto the mesh so jitted steps see mesh-placed inputs.
         replicated = mesh_lib.replicated(self.mesh)
-        state = jax.tree_util.tree_map(
-            lambda x: jax.device_put(x, replicated), state
-        )
         if (
             self._shard_weight_update
             and self.mesh.shape[mesh_lib.DATA_AXIS] > 1
         ):
             # Cross-replica weight-update sharding (ZeRO-2): only the
             # optimizer-side mirrors shard; params/variables stay
-            # replicated for the forward/backward.
+            # replicated for the forward/backward. The mirrors go straight
+            # to their sharded layout — materializing them replicated
+            # first would need the very memory this mode exists to avoid.
             rule = mesh_lib.weight_update_sharding(
                 self.mesh, min_weight_size=self._param_min_shard_size
             )
-            resharded = jax.tree_util.tree_map(
+            opt_state, ema_params = jax.tree_util.tree_map(
                 lambda x: jax.device_put(x, rule(x)),
                 (state.opt_state, state.ema_params),
             )
-            state = state.replace(
-                opt_state=resharded[0], ema_params=resharded[1]
+            state = state.replace(opt_state=(), ema_params=None)
+            state = jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, replicated), state
             )
-        return state
+            return state.replace(opt_state=opt_state, ema_params=ema_params)
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, replicated), state
+        )
 
     def shard_batch(self, batch):
         return mesh_lib.shard_batch(batch, self.mesh)

@@ -422,12 +422,7 @@ class TestWeightUpdateSharding:
         state = compiled.init_state(jax.random.PRNGKey(0), batch)
         return compiled, state, batch
 
-    def test_opt_state_sharded_params_replicated(self):
-        compiled, state, _ = self._setup(shard_weight_update=True)
-        assert all(
-            leaf.sharding.is_fully_replicated
-            for leaf in jax.tree_util.tree_leaves(state.params)
-        )
+    def _assert_some_opt_leaf_sharded(self, state, context):
         opt_leaves = [
             leaf
             for leaf in jax.tree_util.tree_leaves(state.opt_state)
@@ -435,7 +430,15 @@ class TestWeightUpdateSharding:
         ]
         assert any(
             not leaf.sharding.is_fully_replicated for leaf in opt_leaves
-        ), "no optimizer-state leaf was sharded"
+        ), f"no optimizer-state leaf sharded {context}"
+
+    def test_opt_state_sharded_params_replicated(self):
+        compiled, state, _ = self._setup(shard_weight_update=True)
+        assert all(
+            leaf.sharding.is_fully_replicated
+            for leaf in jax.tree_util.tree_leaves(state.params)
+        )
+        self._assert_some_opt_leaf_sharded(state, "at init")
 
     def test_training_math_unchanged(self):
         compiled, state, batch = self._setup()
@@ -465,11 +468,4 @@ class TestWeightUpdateSharding:
         state, _ = compiled.train_step(
             state, compiled.shard_batch(batch), jax.random.PRNGKey(3)
         )
-        opt_leaves = [
-            leaf
-            for leaf in jax.tree_util.tree_leaves(state.opt_state)
-            if hasattr(leaf, "sharding") and leaf.ndim >= 1
-        ]
-        assert any(
-            not leaf.sharding.is_fully_replicated for leaf in opt_leaves
-        ), "GSPMD dropped the optimizer-state sharding across the update"
+        self._assert_some_opt_leaf_sharded(state, "after the update")
