@@ -174,7 +174,14 @@ class AbstractT2RModel(ModelInterface):
         mode: str,
     ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
         """Returns (scalar loss, {metric_name: scalar}) — the metrics dict
-        replaces TF summaries as the observability channel."""
+        replaces TF summaries as the observability channel.
+
+        Metric values are normally scalars (or fixed-size vectors),
+        averaged across gradient-accumulation microbatches. A metric whose
+        value carries a leading BATCH dimension (per-example captures)
+        must declare it by key prefix — `golden/` (see add_golden_tensor)
+        or `per_example/` — so the trainer concatenates microbatch slices
+        back to the full batch instead of averaging them."""
 
     def model_eval_fn(
         self,

@@ -65,9 +65,14 @@ def top_k_routing(
             expert_ids[:, k], num_experts, dtype=jnp.int32
         )  # [T, E]
         rank = jnp.cumsum(onehot, axis=0) - 1 + slots_used[None, :]  # [T, E]
-        slots_used = slots_used + jnp.sum(onehot, axis=0)
         position = jnp.sum(rank * onehot, axis=1)  # [T] slot within expert
         kept = position < capacity
+        # slots_used counts KEPT assignments, so it is a true slots-filled
+        # count (saturates at capacity). Note this does not change which
+        # tokens are kept vs the naive all-assignments count: a round can
+        # only drop once the expert is full, and a full expert drops every
+        # later-k candidate under either accounting.
+        slots_used = slots_used + jnp.sum(onehot * kept[:, None], axis=0)
         slot_onehot = jax.nn.one_hot(position, capacity, dtype=probs.dtype)
         contribution = (
             onehot.astype(probs.dtype)[:, :, None] * slot_onehot[:, None, :]

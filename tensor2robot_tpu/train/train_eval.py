@@ -30,6 +30,7 @@ import numpy as np
 import optax
 import orbax.checkpoint as ocp
 
+from tensor2robot_tpu.hooks.golden_values_hook_builder import GOLDEN_PREFIX
 from tensor2robot_tpu.hooks.hook_builder import Hook, HookBuilder, HookContext
 from tensor2robot_tpu.models.abstract_model import (
     MODE_EVAL,
@@ -43,6 +44,23 @@ from tensor2robot_tpu.specs import TensorSpecStruct, make_example_args
 from tensor2robot_tpu.train import infeed
 from tensor2robot_tpu.train.metrics import MetricsWriter
 from tensor2robot_tpu.train.state import TrainState, create_train_state, update_ema
+
+
+#: Metric-key prefixes whose values carry a leading batch dimension
+#: (concatenated, not averaged, when recombining grad-accum microbatches).
+BATCH_CARRYING_METRIC_PREFIXES = (GOLDEN_PREFIX, "per_example/")
+
+
+def _is_batch_carrying_metric(path) -> bool:
+    """True when any key along the metric's tree path declares a
+    batch-carrying value via BATCH_CARRYING_METRIC_PREFIXES."""
+    for entry in path:
+        key = getattr(entry, "key", None)
+        if isinstance(key, str) and key.startswith(
+            BATCH_CARRYING_METRIC_PREFIXES
+        ):
+            return True
+    return False
 
 
 def print_specification(model: AbstractT2RModel) -> None:
@@ -174,7 +192,8 @@ class CompiledModel:
             eval_shape; microbatches are dynamic slices of the full
             batch, so the forward/backward graph exists only in the scan
             body). Metrics come back stacked per microbatch and are
-            recombined shape-aware afterwards.
+            recombined by KEY afterwards (see combine_metric /
+            BATCH_CARRYING_METRIC_PREFIXES).
             """
             if grad_accum_steps == 1:
                 return compute_grads(state, features, labels, rng_net)
@@ -212,33 +231,24 @@ class CompiledModel:
             (loss, mutable, grads), stacked_metrics = jax.lax.scan(
                 body, zeros, jnp.arange(grad_accum_steps)
             )
-            # The per-microbatch batch size, for telling batch-carrying
-            # metric tensors apart from fixed-size vector metrics.
-            micro_sizes = {
-                leaf.shape[0] // grad_accum_steps
-                for leaf in jax.tree_util.tree_leaves((features, labels))
-                if getattr(leaf, "ndim", 0) >= 1
-                and leaf.shape[0] > 1
-                and leaf.shape[0] % grad_accum_steps == 0
-            }
 
-            def combine_metric(stacked):
+            def combine_metric(path, stacked):
                 # Per-metric stacked leaves are [K, ...]. Batch-carrying
-                # tensors ([K, B/K, ...], e.g. golden-value captures)
+                # metrics are identified by KEY, not shape (a fixed-size
+                # vector metric could coincide with B/K): keys under the
+                # `golden/` (add_golden_tensor) or `per_example/` prefix
                 # concatenate back to the full batch; everything else is
                 # reduced over the K axis shape-preserving — floats
                 # average (mean of per-microbatch means == full-batch
-                # mean), integer counts sum.
-                if (
-                    stacked.ndim >= 2
-                    and stacked.shape[1] in micro_sizes
-                ):
+                # mean), integer counts sum. Contract documented on
+                # AbstractT2RModel.model_train_fn.
+                if _is_batch_carrying_metric(path) and stacked.ndim >= 2:
                     return stacked.reshape((-1,) + stacked.shape[2:])
                 if jnp.issubdtype(stacked.dtype, jnp.floating):
                     return jnp.mean(stacked, axis=0)
                 return jnp.sum(stacked, axis=0)
 
-            train_metrics = jax.tree_util.tree_map(
+            train_metrics = jax.tree_util.tree_map_with_path(
                 combine_metric, stacked_metrics
             )
             return loss, train_metrics, mutable, grads

@@ -61,6 +61,36 @@ class TestTopKRouting:
         expert0 = np.asarray(routing.dispatch)[:, 0, :].sum(axis=1)
         np.testing.assert_array_equal(expert0, [0, 1, 1])
 
+    def test_slot_accounting_saturates_at_capacity(self):
+        # Three tokens pick expert 0 first (capacity 2 drops token 2's
+        # primary); token 3 picks expert 1 first with expert 0 second.
+        logits = jnp.asarray(
+            [
+                [5.0, 1.0, -9.0],
+                [5.0, 1.0, -9.0],
+                [5.0, 1.0, -9.0],
+                [1.0, 5.0, -9.0],
+            ],
+            jnp.float32,
+        )
+        routing = moe_ops.top_k_routing(logits, num_selected=2, capacity=2)
+        dispatch = np.asarray(routing.dispatch)
+        # Expert 0: tokens 0-1 fill both slots; token 2's primary and
+        # token 3's secondary are both dropped (full is full — a dropped
+        # primary never frees capacity, because drops only start once the
+        # expert is saturated).
+        expert0 = dispatch[:, 0, :].sum(axis=1)
+        np.testing.assert_array_equal(expert0, [1, 1, 0, 0])
+        # Expert 1 candidates in slot order: token 3's primary (k=0
+        # round), then tokens 0-2's secondaries in token order. Capacity 2
+        # keeps the primary + token 0's secondary; per-slot occupancy is
+        # exactly one token each (slots-filled accounting saturates at
+        # capacity, it never over-counts dropped assignments).
+        expert1 = dispatch[:, 1, :].sum(axis=1)
+        np.testing.assert_array_equal(expert1, [1, 0, 0, 1])
+        assert dispatch[:, 1, :].sum() == 2
+        assert dispatch.sum(axis=0).max() <= 1 + 1e-6
+
 
 class TestMoEMLP:
     def _reference(self, x, router_kernel, w_in, w_out, num_selected):

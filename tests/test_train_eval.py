@@ -387,6 +387,45 @@ class TestMemoryLevers:
             params_accum,
         )
 
+    def test_grad_accum_metric_recombination_is_key_driven(self):
+        """Batch-carrying metrics are declared by key prefix, not inferred
+        from shape: a fixed-size float vector that coincidentally has
+        length B/K must be AVERAGED (shape-preserving), while `golden/` /
+        `per_example/` keys concatenate back to the full batch."""
+        K, B = 4, 8
+
+        class MetricModel(MockT2RModel):
+            def model_train_fn(self, features, labels, outputs, mode):
+                loss, metrics = super().model_train_fn(
+                    features, labels, outputs, mode
+                )
+                # Collision case: fixed-size vector of length B/K == 2.
+                metrics["hist/fixed_vector"] = jnp.ones(
+                    (B // K,), jnp.float32
+                )
+                # Declared batch-carrying: per-example residuals.
+                metrics["per_example/pred"] = outputs["a_predicted"][:, 0]
+                return loss, metrics
+
+        import jax.numpy as jnp
+
+        model = MetricModel(device_type="cpu", use_batch_norm=False)
+        generator = MockInputGenerator(batch_size=B)
+        generator.set_specification_from_model(model, "train")
+        batch = next(iter(generator.create_dataset("train")))
+        compiled = train_eval.CompiledModel(
+            model, donate_state=False, grad_accum_steps=K
+        )
+        state = compiled.init_state(jax.random.PRNGKey(0), batch)
+        _, metrics = compiled.train_step(
+            state, compiled.shard_batch(batch), jax.random.PRNGKey(7)
+        )
+        assert metrics["hist/fixed_vector"].shape == (B // K,)
+        np.testing.assert_allclose(
+            np.asarray(metrics["hist/fixed_vector"]), np.ones(B // K)
+        )
+        assert metrics["per_example/pred"].shape == (B,)
+
     def test_grad_accum_rejects_indivisible_batch(self):
         compiled, state, batch = self._setup(grad_accum_steps=3)
         with pytest.raises(ValueError, match="divisible"):
