@@ -145,9 +145,47 @@ class TransformerBlock(nn.Module):
         return x + h
 
 
+class PipelineStage(nn.Module):
+    """The repeating unit of the pipelined encoder: a run of pre-norm
+    blocks. Stage-internal attention is single-device (the pipe axis is
+    the only mesh axis a pipelined encoder may exceed 1 on)."""
+
+    num_blocks: int
+    num_heads: int
+    head_dim: int
+    mlp_ratio: int = 4
+    causal: bool = True
+    use_flash: Optional[bool] = None
+    interpret: bool = False
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        for i in range(self.num_blocks):
+            x = TransformerBlock(
+                num_heads=self.num_heads,
+                head_dim=self.head_dim,
+                mlp_ratio=self.mlp_ratio,
+                causal=self.causal,
+                mesh=None,
+                use_flash=self.use_flash,
+                interpret=self.interpret,
+                name=f"block_{i}",
+            )(x)
+        return x
+
+
 class TransformerEncoder(nn.Module):
     """N pre-norm blocks with learned positional embeddings over
-    [batch, seq, features]; final LayerNorm."""
+    [batch, seq, features]; final LayerNorm.
+
+    pipeline_stages > 1 runs the block stack as a GPipe pipeline over the
+    mesh's `pipe` axis (parallel/pipeline.py): the blocks split into
+    equal stages whose stacked parameters live under the `pipe_stages`
+    param key (sharded dim-0 over `pipe` by the trainer's sharding
+    rules), and the batch streams through in `pipeline_microbatches`
+    microbatches. Composes with the data axis; mutually exclusive with
+    sequence parallelism and MoE inside the pipelined stack.
+    """
 
     num_layers: int
     num_heads: int
@@ -161,6 +199,8 @@ class TransformerEncoder(nn.Module):
     num_experts: int = 1
     num_selected_experts: int = 2
     sequence_parallel_mode: str = "ring"
+    pipeline_stages: int = 1
+    pipeline_microbatches: Optional[int] = None
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -175,18 +215,102 @@ class TransformerEncoder(nn.Module):
             (self.max_seq_len, features),
         )
         x = x + positions[None, :seq, :]
-        for i in range(self.num_layers):
-            x = TransformerBlock(
-                num_heads=self.num_heads,
-                head_dim=self.head_dim,
-                mlp_ratio=self.mlp_ratio,
-                causal=self.causal,
-                mesh=self.mesh,
-                use_flash=self.use_flash,
-                interpret=self.interpret,
-                num_experts=self.num_experts,
-                num_selected_experts=self.num_selected_experts,
-                sequence_parallel_mode=self.sequence_parallel_mode,
-                name=f"block_{i}",
-            )(x)
+        if self.pipeline_stages > 1:
+            x = self._pipelined_blocks(x)
+        else:
+            for i in range(self.num_layers):
+                x = TransformerBlock(
+                    num_heads=self.num_heads,
+                    head_dim=self.head_dim,
+                    mlp_ratio=self.mlp_ratio,
+                    causal=self.causal,
+                    mesh=self.mesh,
+                    use_flash=self.use_flash,
+                    interpret=self.interpret,
+                    num_experts=self.num_experts,
+                    num_selected_experts=self.num_selected_experts,
+                    sequence_parallel_mode=self.sequence_parallel_mode,
+                    name=f"block_{i}",
+                )(x)
         return nn.LayerNorm(name="ln_final")(x)
+
+    def _pipelined_blocks(self, x: jax.Array) -> jax.Array:
+        """Blocks as a GPipe schedule over the mesh's pipe axis."""
+        from tensor2robot_tpu.parallel import mesh as mesh_mod
+        from tensor2robot_tpu.parallel import pipeline
+
+        stages = self.pipeline_stages
+        if self.num_layers % stages != 0:
+            raise ValueError(
+                f"num_layers={self.num_layers} not divisible by "
+                f"pipeline_stages={stages}"
+            )
+        if self.num_experts > 1:
+            raise ValueError(
+                "pipeline_stages > 1 does not compose with MoE feed-"
+                "forwards (the router aux-loss channel does not cross the "
+                "pipeline schedule)"
+            )
+        if self.mesh is None:
+            raise ValueError("pipeline_stages > 1 requires a mesh")
+        mesh_axes = dict(self.mesh.shape)
+        if mesh_axes.get(mesh_mod.PIPE_AXIS, 1) != stages:
+            raise ValueError(
+                f"mesh pipe axis {mesh_axes.get(mesh_mod.PIPE_AXIS, 1)} "
+                f"!= pipeline_stages={stages}"
+            )
+        if mesh_axes.get(mesh_mod.SEQUENCE_AXIS, 1) > 1:
+            raise ValueError(
+                "pipeline_stages > 1 does not compose with sequence "
+                "parallelism (attention inside a stage is single-device)"
+            )
+        stage = PipelineStage(
+            num_blocks=self.num_layers // stages,
+            num_heads=self.num_heads,
+            head_dim=self.head_dim,
+            mlp_ratio=self.mlp_ratio,
+            causal=self.causal,
+            use_flash=self.use_flash,
+            interpret=self.interpret,
+        )
+        batch = x.shape[0]
+        data_size = mesh_axes.get(mesh_mod.DATA_AXIS, 1)
+        if self.pipeline_microbatches is not None:
+            micro = self.pipeline_microbatches
+            if batch % micro != 0:
+                raise ValueError(
+                    f"batch {batch} not divisible by pipeline_microbatches="
+                    f"{micro}"
+                )
+        else:
+            # Default: the largest valid microbatch count up to 2*S (~33%
+            # bubble). Valid = divides the batch AND leaves each
+            # microbatch's example dim divisible by the data axis
+            # (pipeline_apply shards it there under dp x pp).
+            if batch % data_size != 0:
+                raise ValueError(
+                    f"batch {batch} not divisible by data axis {data_size}"
+                )
+            limit = batch // data_size
+            micro = max(
+                d
+                for d in range(1, min(limit, 2 * stages) + 1)
+                if limit % d == 0
+            )
+
+        def init_stacked(rng):
+            dummy = jnp.zeros((1,) + x.shape[1:], x.dtype)
+            rngs = jax.random.split(rng, stages)
+            return pipeline.stack_stage_params(
+                [stage.init(r, dummy)["params"] for r in rngs]
+            )
+
+        stacked = self.param(mesh_mod.PIPE_STAGES_KEY, init_stacked)
+        return pipeline.pipeline_apply(
+            lambda p, h: stage.apply({"params": p}, h),
+            stacked,
+            x,
+            mesh=self.mesh,
+            num_microbatches=micro,
+            batch_axis=mesh_mod.DATA_AXIS if data_size > 1 else None,
+        )

@@ -47,6 +47,8 @@ class _TransformerBCNet(nn.Module):
     use_flash: Optional[bool] = None
     interpret: bool = False
     sequence_parallel_mode: str = "ring"
+    pipeline_stages: int = 1
+    pipeline_microbatches: Optional[int] = None
 
     @nn.compact
     def __call__(self, features, mode):
@@ -72,6 +74,8 @@ class _TransformerBCNet(nn.Module):
             interpret=self.interpret,
             num_experts=self.num_experts,
             sequence_parallel_mode=self.sequence_parallel_mode,
+            pipeline_stages=self.pipeline_stages,
+            pipeline_microbatches=self.pipeline_microbatches,
             name="encoder",
         )(x)
         action = nn.Dense(self.action_size, name="action_head")(x)
@@ -106,6 +110,8 @@ class TransformerBCModel(FlaxT2RModel):
         use_flash: Optional[bool] = None,
         interpret: bool = False,
         sequence_parallel_mode: str = "ring",
+        pipeline_stages: int = 1,
+        pipeline_microbatches: Optional[int] = None,
         **kwargs,
     ):
         super().__init__(**kwargs)
@@ -123,6 +129,8 @@ class TransformerBCModel(FlaxT2RModel):
         self._use_flash = use_flash
         self._interpret = interpret
         self._sequence_parallel_mode = sequence_parallel_mode
+        self._pipeline_stages = pipeline_stages
+        self._pipeline_microbatches = pipeline_microbatches
 
     def get_feature_specification(self, mode: str) -> TensorSpecStruct:
         del mode
@@ -163,6 +171,8 @@ class TransformerBCModel(FlaxT2RModel):
             use_flash=self._use_flash,
             interpret=self._interpret,
             sequence_parallel_mode=self._sequence_parallel_mode,
+            pipeline_stages=self._pipeline_stages,
+            pipeline_microbatches=self._pipeline_microbatches,
         )
 
     def init_variables(self, rng, features, mode=MODE_TRAIN):

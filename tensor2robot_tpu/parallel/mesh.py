@@ -28,6 +28,11 @@ SEQUENCE_AXIS = "sequence"
 PIPE_AXIS = "pipe"
 EXPERT_AXIS = "expert"
 
+#: Param-tree key under which a pipelined module stores its stacked
+#: [S, ...] per-stage parameters (layers/transformer.py pipelined
+#: encoder); pipe_stage_param_rule shards that subtree's dim 0 over pipe.
+PIPE_STAGES_KEY = "pipe_stages"
+
 
 def initialize_distributed(
     coordinator_address: Optional[str] = None,
@@ -159,6 +164,34 @@ def weight_update_sharding(mesh: Mesh, min_weight_size: int = 2 ** 14):
         spec = [None] * len(shape)
         _assign_largest_divisible_dim(spec, shape, data_size, DATA_AXIS)
         return NamedSharding(mesh, PartitionSpec(*spec))
+
+    return rule
+
+
+def pipe_stage_param_rule(mesh: Mesh, base_rule):
+    """Path-aware sharding rule layering pipeline-stage placement over a
+    per-leaf base rule: any leaf under a PIPE_STAGES_KEY tree key whose
+    leading dim equals the pipe-axis size shards dim 0 over `pipe` (the
+    layout pipeline_apply consumes); every other leaf falls through to
+    base_rule. Optimizer moments and the EMA mirror the param tree's
+    keys, so the same rule places them without special cases.
+    """
+    pipe_size = mesh.shape[PIPE_AXIS]
+    stage_sharding = NamedSharding(mesh, PartitionSpec(PIPE_AXIS))
+
+    def rule(path, leaf):
+        shape = getattr(leaf, "shape", None)
+        if (
+            pipe_size > 1
+            and shape
+            and shape[0] == pipe_size
+            and any(
+                getattr(entry, "key", None) == PIPE_STAGES_KEY
+                for entry in path
+            )
+        ):
+            return stage_sharding
+        return base_rule(leaf)
 
     return rule
 

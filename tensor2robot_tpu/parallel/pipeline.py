@@ -65,6 +65,7 @@ def pipeline_apply(
     mesh,
     num_microbatches: int,
     axis_name: str = PIPE_AXIS,
+    batch_axis: str | None = None,
 ):
     """Runs x through S chained stages with GPipe microbatch overlap.
 
@@ -77,9 +78,14 @@ def pipeline_apply(
       x: [batch, ...] with batch divisible by num_microbatches.
       mesh: mesh whose `axis_name` axis has size S.
       num_microbatches: M; the bubble fraction is (S-1)/(M+S-1).
+      batch_axis: optional mesh axis the batch is data-sharded over
+        (dp x pp composition): each microbatch's example dim shards over
+        it, the schedule runs on local examples, and gradients psum over
+        it via shard_map's transpose. The per-microbatch size must divide
+        by that axis.
 
     Returns [batch, ...]: the composition stage_{S-1}(...stage_0(x)),
-    replicated over the pipe axis.
+    replicated over the pipe axis (data-sharded over batch_axis if given).
     """
     num_stages = mesh.shape[axis_name]
     batch = x.shape[0]
@@ -89,6 +95,16 @@ def pipeline_apply(
         )
     micro = jnp.reshape(x, (num_microbatches, batch // num_microbatches)
                         + x.shape[1:])
+    if batch_axis is not None:
+        data_size = mesh.shape[batch_axis]
+        if (batch // num_microbatches) % data_size != 0:
+            raise ValueError(
+                f"microbatch size {batch // num_microbatches} not divisible "
+                f"by {batch_axis} axis size {data_size}"
+            )
+        x_spec = PartitionSpec(None, batch_axis)
+    else:
+        x_spec = PartitionSpec()
 
     spec_params = jax.tree_util.tree_map(
         lambda _: PartitionSpec(axis_name), stacked_params
@@ -100,17 +116,19 @@ def pipeline_apply(
             num_stages=num_stages,
             num_microbatches=num_microbatches,
             axis_name=axis_name,
+            varying_axes=(axis_name,)
+            + ((batch_axis,) if batch_axis is not None else ()),
         ),
         mesh=mesh,
-        in_specs=(spec_params, PartitionSpec()),
-        out_specs=PartitionSpec(),
+        in_specs=(spec_params, x_spec),
+        out_specs=x_spec,
     )
     out = shard_mapped(stacked_params, micro)
     return jnp.reshape(out, (batch,) + out.shape[2:])
 
 
 def _pipeline_shard(stacked_params, micro, *, stage_fn, num_stages,
-                    num_microbatches, axis_name):
+                    num_microbatches, axis_name, varying_axes=None):
     """The per-device program: scan over M+S-1 clock ticks.
 
     Each device sees its own stage's params ([1, ...] leaves from the pipe
@@ -161,12 +179,14 @@ def _pipeline_shard(stacked_params, micro, *, stage_fn, num_stages,
     resident0 = jnp.zeros(mb_shape, micro.dtype)
     out0 = jnp.zeros((num_microbatches,) + mb_shape, micro.dtype)
     # The body makes the carry vary over the pipe axis (stage_idx masks,
-    # ppermute); mark the initial carry the same way for shard_map's
-    # varying-manual-axes tracking (guarded like ring_attention's pvary:
-    # older jax has neither the tracking nor the op).
+    # ppermute) and over the batch axis when the input is data-sharded;
+    # mark the initial carry the same way for shard_map's varying-manual-
+    # axes tracking (guarded like ring_attention's pvary: older jax has
+    # neither the tracking nor the op).
     if hasattr(lax, "pcast"):
+        axes = tuple(varying_axes or (axis_name,))
         resident0, out0 = jax.tree_util.tree_map(
-            lambda leaf: lax.pcast(leaf, (axis_name,), to="varying"),
+            lambda leaf: lax.pcast(leaf, axes, to="varying"),
             (resident0, out0),
         )
     (_, out_acc), _ = lax.scan(

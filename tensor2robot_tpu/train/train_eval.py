@@ -326,6 +326,16 @@ class CompiledModel:
             rng=jax.random.PRNGKey(0),
         )
         state = create_train_state(self.model, rng, features, self.optimizer)
+
+        def place(tree, base_rule):
+            # Pipeline-stage placement layers over every regime: leaves
+            # under the pipe_stages key shard dim 0 over `pipe` (a
+            # passthrough to base_rule when the pipe axis is 1).
+            rule = mesh_lib.pipe_stage_param_rule(self.mesh, base_rule)
+            return jax.tree_util.tree_map_with_path(
+                lambda path, x: jax.device_put(x, rule(path, x)), tree
+            )
+
         if (
             self.mesh.shape[mesh_lib.FSDP_AXIS] > 1
             or self.mesh.shape[mesh_lib.MODEL_AXIS] > 1
@@ -335,14 +345,15 @@ class CompiledModel:
             # column-splits kernels for tensor parallelism. GSPMD
             # propagates these shardings through the optimizer update, so
             # params stay sharded across steps.
-            rule = mesh_lib.param_sharding(
-                self.mesh, min_weight_size=self._param_min_shard_size
-            )
-            return jax.tree_util.tree_map(
-                lambda x: jax.device_put(x, rule(x)), state
+            return place(
+                state,
+                mesh_lib.param_sharding(
+                    self.mesh, min_weight_size=self._param_min_shard_size
+                ),
             )
         # Replicate onto the mesh so jitted steps see mesh-placed inputs.
         replicated = mesh_lib.replicated(self.mesh)
+        replicate_rule = lambda leaf: replicated  # noqa: E731
         if (
             self._shard_weight_update
             and self.mesh.shape[mesh_lib.DATA_AXIS] > 1
@@ -352,21 +363,16 @@ class CompiledModel:
             # replicated for the forward/backward. The mirrors go straight
             # to their sharded layout — materializing them replicated
             # first would need the very memory this mode exists to avoid.
-            rule = mesh_lib.weight_update_sharding(
-                self.mesh, min_weight_size=self._param_min_shard_size
-            )
-            opt_state, ema_params = jax.tree_util.tree_map(
-                lambda x: jax.device_put(x, rule(x)),
+            opt_state, ema_params = place(
                 (state.opt_state, state.ema_params),
+                mesh_lib.weight_update_sharding(
+                    self.mesh, min_weight_size=self._param_min_shard_size
+                ),
             )
             state = state.replace(opt_state=(), ema_params=None)
-            state = jax.tree_util.tree_map(
-                lambda x: jax.device_put(x, replicated), state
-            )
+            state = place(state, replicate_rule)
             return state.replace(opt_state=opt_state, ema_params=ema_params)
-        return jax.tree_util.tree_map(
-            lambda x: jax.device_put(x, replicated), state
-        )
+        return place(state, replicate_rule)
 
     def shard_batch(self, batch):
         return mesh_lib.shard_batch(batch, self.mesh)

@@ -119,6 +119,88 @@ class TestTransformerEncoder:
         )
 
 
+class TestPipelinedEncoder:
+    """GPipe pipelining of the block stack over the mesh's pipe axis.
+
+    Oracle: the same stacked stage params applied SEQUENTIALLY (plain
+    chain of stages) must reproduce the pipelined output exactly — and
+    the output must not depend on the microbatch count (schedule-
+    correctness: masking/accumulation bugs show up as M-dependence).
+    """
+
+    def _encoder(self, mesh, microbatches=None):
+        return TransformerEncoder(
+            num_layers=4, num_heads=2, head_dim=8, max_seq_len=64,
+            use_flash=False, mesh=mesh, pipeline_stages=2,
+            pipeline_microbatches=microbatches,
+        )
+
+    def test_matches_sequential_chain(self, x):
+        import flax.linen as nn
+
+        from tensor2robot_tpu.layers.transformer import PipelineStage
+
+        mesh = mesh_lib.make_mesh(data=1, pipe=2, devices=jax.devices()[:2])
+        encoder = self._encoder(mesh)
+        variables = encoder.init(jax.random.PRNGKey(0), x)
+        out = encoder.apply(variables, x)
+        assert out.shape == x.shape
+
+        params = variables["params"]
+        stage = PipelineStage(
+            num_blocks=2, num_heads=2, head_dim=8, use_flash=False
+        )
+        h = x + params["pos_embedding"][None, : x.shape[1], :]
+        for s in range(2):
+            stage_params = jax.tree_util.tree_map(
+                lambda leaf: leaf[s], params[mesh_lib.PIPE_STAGES_KEY]
+            )
+            h = stage.apply({"params": stage_params}, h)
+        expected = nn.LayerNorm().apply(
+            {"params": params["ln_final"]}, h
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(expected), rtol=2e-5, atol=2e-5
+        )
+
+    def test_microbatch_count_invariance(self, x):
+        mesh = mesh_lib.make_mesh(data=1, pipe=2, devices=jax.devices()[:2])
+        enc2 = self._encoder(mesh, microbatches=2)
+        variables = enc2.init(jax.random.PRNGKey(0), x)
+        out2 = enc2.apply(variables, x)
+        # batch=2: M=1 streams the whole batch as one microbatch.
+        out1 = self._encoder(mesh, microbatches=1).apply(variables, x)
+        np.testing.assert_allclose(
+            np.asarray(out2), np.asarray(out1), rtol=1e-5, atol=1e-5
+        )
+
+    def test_bad_configs_rejected(self, x):
+        mesh = mesh_lib.make_mesh(data=1, pipe=2, devices=jax.devices()[:2])
+        with pytest.raises(ValueError, match="divisible"):
+            TransformerEncoder(
+                num_layers=3, num_heads=2, head_dim=8, mesh=mesh,
+                use_flash=False, pipeline_stages=2,
+            ).init(jax.random.PRNGKey(0), x)
+        with pytest.raises(ValueError, match="MoE"):
+            TransformerEncoder(
+                num_layers=4, num_heads=2, head_dim=8, mesh=mesh,
+                use_flash=False, pipeline_stages=2, num_experts=4,
+            ).init(jax.random.PRNGKey(0), x)
+        with pytest.raises(ValueError, match="requires a mesh"):
+            TransformerEncoder(
+                num_layers=4, num_heads=2, head_dim=8,
+                use_flash=False, pipeline_stages=2,
+            ).init(jax.random.PRNGKey(0), x)
+        seq_mesh = mesh_lib.make_mesh(
+            data=1, sequence=2, pipe=2, devices=jax.devices()[:4]
+        )
+        with pytest.raises(ValueError, match="sequence"):
+            TransformerEncoder(
+                num_layers=4, num_heads=2, head_dim=8, mesh=seq_mesh,
+                use_flash=False, pipeline_stages=2,
+            ).init(jax.random.PRNGKey(0), x)
+
+
 class TestMoETransformer:
     def test_moe_ffn_trains_and_reports_aux_loss(self):
         """num_experts>1 swaps the dense FFN for the expert-parallel MoE;
