@@ -248,6 +248,7 @@ def bench_data() -> None:
         from tensor2robot_tpu.data import tfrecord
         from tensor2robot_tpu.data.dataset import (
             RecordDataset,
+            default_parse_backend,
             default_parse_workers,
         )
         from tensor2robot_tpu.data.encoder import encode_example
@@ -316,6 +317,7 @@ def bench_data() -> None:
                     "records_per_sec": round(records_per_sec, 2),
                     "batch_size": batch_size,
                     "parse_workers": default_parse_workers(),
+                    "parse_backend": default_parse_backend(),
                     "host_cpus": os.cpu_count(),
                     "demand_images_per_sec_at_50pct_mfu": round(demand, 2),
                 },

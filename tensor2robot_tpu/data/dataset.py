@@ -155,16 +155,72 @@ def default_parse_workers() -> int:
     return min(8, os.cpu_count() or 1)
 
 
-class _ParallelBatcher:
-    """Ordered parallel parse: N batches in flight across a thread pool.
+def default_parse_backend() -> str:
+    """'thread' (default) or 'process' (T2R_PARSE_BACKEND).
 
-    Record chunks are submitted to a ThreadPoolExecutor and results are
-    yielded in submission order, keeping up to `max_in_flight` parse jobs
-    running ahead of the consumer. Parsing a batch is dominated by jpeg
-    decode (PIL releases the GIL in its decoder) and numpy copies, so
-    threads scale on multi-core hosts without pickling batches across
-    processes. This is the rebuild of tf.data's parallel parse/decode maps
-    (reference utils/tfdata.py:630-689, num_parallel_calls=AUTOTUNE).
+    Threads suffice while the pool is small: the hot ops release the GIL
+    (PIL jpeg decode, the TFRecord codec — measured in
+    tools/measure_gil_release.py), but each parse still holds the GIL for
+    its python/numpy glue (~1/3 of its runtime on this image), so thread
+    scaling saturates around 3-4 workers. The process backend sidesteps
+    the GIL entirely for many-core hosts feeding a fast chip: workers
+    re-parse in forked/spawned interpreters and ship back parsed numpy
+    batches (raw jpeg chunks are cheap to send; the returned uint8 image
+    batch is the dominant IPC cost).
+    """
+    backend = os.environ.get("T2R_PARSE_BACKEND", "thread")
+    if backend not in ("thread", "process"):
+        raise ValueError(
+            f"T2R_PARSE_BACKEND must be 'thread' or 'process', got {backend!r}"
+        )
+    return backend
+
+
+# Per-process parser for the process-pool backend (set by the pool
+# initializer in each worker; module-level so submitted jobs can reach it
+# without pickling the parser per chunk).
+_PROCESS_PARSER: Optional[SpecParser] = None
+
+
+def _process_pool_init(specs_blob: bytes) -> None:
+    import pickle
+
+    global _PROCESS_PARSER
+    _PROCESS_PARSER = SpecParser(pickle.loads(specs_blob))
+
+
+def _parse_with(parser: SpecParser, chunk) -> TensorSpecStruct:
+    """Parses one chunk (multi-dataset rows regrouped by key) — the single
+    implementation both the thread and process backends run."""
+    if isinstance(chunk[0], dict):
+        by_key = {k: [row[k] for row in chunk] for k in chunk[0].keys()}
+        return parser.parse_batch(by_key)
+    return parser.parse_batch(chunk)
+
+
+def _process_parse_chunk(chunk):
+    parser = _PROCESS_PARSER
+    if parser is None:  # pragma: no cover - initializer always runs first
+        raise RuntimeError("process pool worker missing parser init")
+    # Ship a plain dict of arrays; the parent rebuilds the struct (cheap)
+    # rather than relying on TensorSpecStruct pickling across versions.
+    return dict(_parse_with(parser, chunk).items())
+
+
+class _ParallelBatcher:
+    """Ordered parallel parse: N batches in flight across a worker pool.
+
+    Record chunks are submitted to an Executor and results are yielded in
+    submission order, keeping up to `max_in_flight` parse jobs running
+    ahead of the consumer. Default pool: a ThreadPoolExecutor — parsing is
+    dominated by jpeg decode (PIL releases the GIL in its decoder) and
+    numpy copies, so a few threads scale without pickling batches across
+    processes. Callers may pass any Executor instead (the process backend
+    passes a ProcessPoolExecutor, which DOES pickle chunks out and parsed
+    batches back); an externally-passed pool is the caller's to shut down
+    (reused across epochs). This is the rebuild of tf.data's parallel
+    parse/decode maps (reference utils/tfdata.py:630-689,
+    num_parallel_calls=AUTOTUNE).
     """
 
     def __init__(
@@ -173,10 +229,12 @@ class _ParallelBatcher:
         parse_fn: Callable,
         num_workers: int,
         max_in_flight: Optional[int] = None,
+        pool: Optional[concurrent.futures.Executor] = None,
     ):
         self._chunks = chunks
         self._parse_fn = parse_fn
-        self._pool = concurrent.futures.ThreadPoolExecutor(
+        self._owns_pool = pool is None
+        self._pool = pool or concurrent.futures.ThreadPoolExecutor(
             max_workers=num_workers, thread_name_prefix="t2r-parse"
         )
         self._in_flight: "queue.Queue" = queue.Queue()
@@ -202,7 +260,13 @@ class _ParallelBatcher:
                     self._submit_one()
                 yield future.result()
         finally:
-            self._pool.shutdown(wait=False, cancel_futures=True)
+            if self._owns_pool:
+                self._pool.shutdown(wait=False, cancel_futures=True)
+            else:
+                # External pool (reused across epochs): cancel what we
+                # queued but leave the executor alive for the next epoch.
+                while not self._in_flight.empty():
+                    self._in_flight.get().cancel()
 
 
 class RecordDataset:
@@ -221,8 +285,11 @@ class RecordDataset:
       prefetch_depth: parsed batches buffered ahead by a background thread.
       file_fraction: use only the first fraction of files (data-ablation,
         reference FractionalRecordInputGenerator).
-      num_parse_workers: thread-pool size for parallel proto-parse and
+      num_parse_workers: worker-pool size for parallel proto-parse and
         jpeg decode; None -> default_parse_workers(), 0 -> synchronous.
+      parse_backend: 'thread' (default) or 'process'
+        (see default_parse_backend; env T2R_PARSE_BACKEND). The process
+        backend removes the GIL ceiling on many-core hosts.
       shard_by_host: in multi-host runs, each process reads only its
         round-robin slice of the file list (the reference's per-host
         infeed, utils/tfdata.py:38-61); batch_size is then the PER-HOST
@@ -243,8 +310,19 @@ class RecordDataset:
         drop_remainder: bool = True,
         file_fraction: float = 1.0,
         num_parse_workers: Optional[int] = None,
+        parse_backend: Optional[str] = None,
         shard_by_host: bool = False,
     ):
+        self._specs = specs
+        self._process_pool: Optional[concurrent.futures.Executor] = None
+        self._parse_backend = (
+            default_parse_backend() if parse_backend is None else parse_backend
+        )
+        if self._parse_backend not in ("thread", "process"):
+            raise ValueError(
+                f"parse_backend must be 'thread' or 'process', got "
+                f"{self._parse_backend!r}"
+            )
         self._parser = SpecParser(specs)
         self._batch_size = batch_size
         self._train = mode == "train"
@@ -352,14 +430,60 @@ class RecordDataset:
             yield chunk
 
     def _parse_chunk(self, chunk) -> TensorSpecStruct:
-        if isinstance(chunk[0], dict):
-            by_key = {k: [row[k] for row in chunk] for k in chunk[0].keys()}
-            return self._parser.parse_batch(by_key)
-        return self._parser.parse_batch(chunk)
+        return _parse_with(self._parser, chunk)
+
+    def _rebuild_struct(self, flat: Mapping[str, np.ndarray]) -> TensorSpecStruct:
+        out = TensorSpecStruct()
+        for key, value in flat.items():
+            out[key] = value
+        return out
+
+    def _get_process_pool(self) -> concurrent.futures.Executor:
+        """Lazy, cached per-dataset worker pool: spawn cost (each worker
+        re-imports jax, ~seconds) is paid once per RecordDataset, not per
+        epoch/iterator."""
+        if self._process_pool is None:
+            import multiprocessing
+            import pickle
+
+            # Spawn, not fork: the parent typically holds an initialized
+            # XLA backend whose internal threads/locks do not survive a
+            # fork (deadlock risk).
+            self._process_pool = concurrent.futures.ProcessPoolExecutor(
+                max_workers=self._num_parse_workers,
+                mp_context=multiprocessing.get_context("spawn"),
+                initializer=_process_pool_init,
+                initargs=(pickle.dumps(self._specs),),
+            )
+        return self._process_pool
+
+    def close(self) -> None:
+        """Shuts down the cached process pool (no-op for thread backend)."""
+        if self._process_pool is not None:
+            self._process_pool.shutdown(wait=False, cancel_futures=True)
+            self._process_pool = None
+
+    def __del__(self):  # best-effort; close() is the explicit path
+        try:
+            self.close()
+        except Exception:
+            pass
 
     def __iter__(self) -> Iterator[TensorSpecStruct]:
-        if self._num_parse_workers > 0:
-            batches: Iterator[TensorSpecStruct] = iter(
+        if self._num_parse_workers > 0 and self._parse_backend == "process":
+            batches: Iterator[TensorSpecStruct] = map(
+                self._rebuild_struct,
+                _ParallelBatcher(
+                    self._chunks(),
+                    _process_parse_chunk,
+                    num_workers=self._num_parse_workers,
+                    max_in_flight=self._num_parse_workers
+                    + max(self._prefetch_depth, 1),
+                    pool=self._get_process_pool(),
+                ),
+            )
+        elif self._num_parse_workers > 0:
+            batches = iter(
                 _ParallelBatcher(
                     self._chunks(),
                     self._parse_chunk,

@@ -225,6 +225,59 @@ class TestRecordDataset:
         all_y = np.concatenate([b["y"] for b in batches])
         assert sorted(all_y.tolist()) == list(range(16))
 
+    def test_process_parse_backend_matches_thread(self, tmp_path):
+        """The process-pool decode path must yield the same batches as the
+        thread pool (order is deterministic in eval mode)."""
+        spec = self.make_records(tmp_path)
+
+        thread_ds = RecordDataset(
+            specs=spec,
+            file_patterns=str(tmp_path / "data-*.tfrecord"),
+            batch_size=4,
+            mode="eval",
+            num_parse_workers=2,
+            parse_backend="thread",
+        )
+        process_ds = RecordDataset(
+            specs=spec,
+            file_patterns=str(tmp_path / "data-*.tfrecord"),
+            batch_size=4,
+            mode="eval",
+            num_parse_workers=2,
+            parse_backend="process",
+        )
+        thread_batches = list(thread_ds)
+        process_batches = list(process_ds)
+        assert len(thread_batches) == len(process_batches) == 4
+        for a, b in zip(thread_batches, process_batches):
+            assert sorted(a.keys()) == sorted(b.keys())
+            for key in a.keys():
+                np.testing.assert_array_equal(
+                    np.asarray(a[key]), np.asarray(b[key])
+                )
+        # The spawn pool is cached on the dataset: a second epoch reuses it
+        # (no re-spawn) and still yields the same data.
+        pool_first = process_ds._process_pool
+        assert pool_first is not None
+        second_epoch = list(process_ds)
+        assert process_ds._process_pool is pool_first
+        np.testing.assert_array_equal(
+            np.asarray(second_epoch[0]["y"]),
+            np.asarray(process_batches[0]["y"]),
+        )
+        process_ds.close()
+        assert process_ds._process_pool is None
+
+    def test_bad_parse_backend_rejected(self, tmp_path):
+        spec = self.make_records(tmp_path)
+        with pytest.raises(ValueError, match="parse_backend"):
+            RecordDataset(
+                specs=spec,
+                file_patterns=str(tmp_path / "data-*.tfrecord"),
+                batch_size=4,
+                parse_backend="greenlet",
+            )
+
     def test_train_repeats_and_shuffles(self, tmp_path):
         spec = self.make_records(tmp_path)
         dataset = RecordDataset(

@@ -1,0 +1,141 @@
+"""Measures whether the input pipeline's hot ops release the GIL.
+
+The host-feed design argument (docs/PERFORMANCE.md) is that thread-pool
+parse scales across cores because PIL's jpeg decode and the native
+TFRecord codec release the GIL. A 1-core container cannot show wall-clock
+thread scaling, but GIL release is directly measurable on one core: run a
+pure-Python counter in the main thread while a worker thread does the hot
+op in a loop. If the op HOLDS the GIL, the counter's rate collapses to
+near zero; if it releases it, the counter keeps most of its solo rate
+(the OS timeslices two runnable threads, so ~50% is full release on one
+core; Python-bytecode-bound work drops to the GIL switch-interval floor).
+
+Emits one JSON line (committed as BENCH_GIL_r{N}.json).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import sys
+import threading
+import time
+
+sys.path.insert(0, "/root/repo")
+
+import numpy as np
+
+
+def counter_rate(stop_event, duration: float) -> float:
+    """Counts pure-Python increments until `duration` elapses."""
+    count = 0
+    start = time.perf_counter()
+    while time.perf_counter() - start < duration:
+        count += 1
+    return count / duration
+
+
+def rate_with_background(work_fn, duration: float = 2.0) -> float:
+    """Main-thread counter rate while `work_fn` loops in a worker."""
+    stop = threading.Event()
+
+    def worker():
+        while not stop.is_set():
+            work_fn()
+
+    thread = threading.Thread(target=worker, daemon=True)
+    thread.start()
+    try:
+        return counter_rate(stop, duration)
+    finally:
+        stop.set()
+        thread.join(timeout=10)
+
+
+def main() -> None:
+    from PIL import Image
+
+    from tensor2robot_tpu.data import tfrecord
+
+    # A QT-Opt-sized jpeg (512x640 RGB).
+    rng = np.random.RandomState(0)
+    array = rng.randint(0, 255, (512, 640, 3), np.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(array).save(buf, format="JPEG")
+    jpeg_bytes = buf.getvalue()
+
+    def decode_jpeg():
+        img = Image.open(io.BytesIO(jpeg_bytes))
+        np.asarray(img)
+
+    # A TFRecord shard for the codec path.
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as tmp:
+        path = f"{tmp}/gil.tfrecord"
+        tfrecord.write_tfrecords(path, [b"x" * 4096] * 256)
+
+        def read_shard():
+            for _ in tfrecord.read_tfrecords(path):
+                pass
+
+        import re
+
+        def python_spin():  # fair-share reference: bytecode vs bytecode
+            total = 0
+            for i in range(200_000):
+                total += i
+            return total
+
+        # GIL-HOLDING control: a long C-level call that does not drop the
+        # GIL (catastrophic-backtracking regex) starves the counter to the
+        # switch-interval floor — the signature a GIL-bound decode would
+        # show.
+        holding_pattern = re.compile(r"(a+)+b")
+        holding_input = "a" * 23
+
+        def gil_holding_c_call():
+            holding_pattern.match(holding_input)
+
+        solo = counter_rate(None, 2.0)
+        with_decode = rate_with_background(decode_jpeg)
+        with_codec = rate_with_background(read_shard)
+        with_python = rate_with_background(python_spin)
+        with_holding = rate_with_background(gil_holding_c_call)
+
+    def frac(rate):
+        return round(rate / solo, 3)
+
+    # On one core: a fair bytecode pair timeshares at ~0.5; a C call that
+    # HOLDS the GIL starves the counter toward 0 (see the holding
+    # control); a C call that RELEASES the GIL lets the counter run while
+    # the worker computes GIL-free, pushing its fraction ABOVE 0.5.
+    fractions = {
+        "jpeg_decode_background": frac(with_decode),
+        "tfrecord_codec_background": frac(with_codec),
+        "python_spin_background_fair_share": frac(with_python),
+        "gil_holding_c_call_control": frac(with_holding),
+    }
+    payload = {
+        "metric": "input_pipeline_gil_release",
+        "solo_counter_rate": round(solo, 0),
+        "counter_fraction_vs_solo": fractions,
+        "interpretation": (
+            "above the ~0.5 fair share = hot op releases the GIL while "
+            "computing (thread pool scales across cores); near the "
+            "holding control's floor = GIL-bound"
+        ),
+        "host_cpus": __import__("os").cpu_count(),
+    }
+    margin = fractions["gil_holding_c_call_control"] + 0.2
+    payload["jpeg_releases_gil"] = (
+        fractions["jpeg_decode_background"] > max(0.5, margin)
+    )
+    payload["codec_releases_gil"] = (
+        fractions["tfrecord_codec_background"] > max(0.5, margin)
+    )
+    print(json.dumps(payload))
+
+
+if __name__ == "__main__":
+    main()
