@@ -49,6 +49,25 @@ class TestPoseToyEnv:
         drift = debug["target_pose"] - env._rendered_pose[:2]
         np.testing.assert_allclose(drift, env._hidden_drift_xy, atol=1e-6)
 
+    def test_golden_trace(self):
+        """Fixed-seed rollouts replay the committed golden trace
+        bit-exactly (tests/golden/pose_env_golden_trace.npz, regenerated
+        only via tools/make_pose_env_golden.py). Pins the analytic
+        renderer/reward/task sampling that replaces the reference's
+        PyBullet env (reference pose_env.py:52) against silent drift."""
+        from tools.make_pose_env_golden import GOLDEN_PATH, rollout
+
+        golden = np.load(GOLDEN_PATH)
+        trace = rollout()
+        np.testing.assert_array_equal(
+            trace["observations"], golden["observations"]
+        )
+        np.testing.assert_array_equal(trace["actions"], golden["actions"])
+        np.testing.assert_array_equal(trace["rewards"], golden["rewards"])
+        np.testing.assert_array_equal(
+            trace["target_poses"], golden["target_poses"]
+        )
+
     def test_random_policy(self):
         policy = pose_env.PoseEnvRandomPolicy(seed=0)
         action, debug = policy.sample_action(None, 0.0)

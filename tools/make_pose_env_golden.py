@@ -1,0 +1,72 @@
+"""Regenerates the PoseEnv golden trace fixture.
+
+The analytic PoseToyEnv replaces the reference's PyBullet renderer
+(reference research/pose_env/pose_env.py:52 renders a duck mesh in
+pybullet; here an oriented ellipse + striped ground — the documented
+deliberate non-port, README "Deliberate non-ports"). This trace pins its
+observable behavior: fixed-seed episode rollouts (observations, target
+poses, rewards for a fixed action sequence) that
+tests/test_pose_env.py::test_golden_trace replays bit-exactly, so any
+drift in the renderer/reward/task sampling is caught as a regression.
+
+Run `python tools/make_pose_env_golden.py` ONLY when the env's behavior
+is intentionally changed; commit the regenerated .npz with that change.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tensor2robot_tpu.research.pose_env.pose_env import (  # noqa: E402
+    PoseEnvRandomPolicy,
+    PoseToyEnv,
+)
+
+GOLDEN_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tests",
+    "golden",
+    "pose_env_golden_trace.npz",
+)
+
+NUM_EPISODES = 5
+
+
+def rollout():
+    env = PoseToyEnv(hidden_drift=True, seed=123)
+    policy = PoseEnvRandomPolicy(seed=7)
+    observations, actions, rewards, targets = [], [], [], []
+    for _ in range(NUM_EPISODES):
+        env.reset_task()
+        obs = env.reset()
+        action, _ = policy.sample_action(obs, explore_prob=1.0)
+        next_obs, reward, done, debug = env.step(action)
+        assert done
+        observations.append(obs)
+        actions.append(np.asarray(action, np.float32))
+        rewards.append(np.float32(reward))
+        targets.append(debug["target_pose"])
+    return {
+        "observations": np.stack(observations),
+        "actions": np.stack(actions),
+        "rewards": np.stack(rewards),
+        "target_poses": np.stack(targets),
+    }
+
+
+def main() -> None:
+    trace = rollout()
+    os.makedirs(os.path.dirname(GOLDEN_PATH), exist_ok=True)
+    np.savez_compressed(GOLDEN_PATH, **trace)
+    print(f"wrote {GOLDEN_PATH}")
+    for key, value in trace.items():
+        print(f"  {key}: {value.shape} {value.dtype}")
+
+
+if __name__ == "__main__":
+    main()

@@ -17,16 +17,19 @@ from __future__ import annotations
 
 import io
 import json
+import os
 import sys
 import threading
 import time
 
-sys.path.insert(0, "/root/repo")
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
 
 import numpy as np
 
 
-def counter_rate(stop_event, duration: float) -> float:
+def counter_rate(duration: float) -> float:
     """Counts pure-Python increments until `duration` elapses."""
     count = 0
     start = time.perf_counter()
@@ -46,7 +49,7 @@ def rate_with_background(work_fn, duration: float = 2.0) -> float:
     thread = threading.Thread(target=worker, daemon=True)
     thread.start()
     try:
-        return counter_rate(stop, duration)
+        return counter_rate(duration)
     finally:
         stop.set()
         thread.join(timeout=10)
@@ -97,7 +100,7 @@ def main() -> None:
         def gil_holding_c_call():
             holding_pattern.match(holding_input)
 
-        solo = counter_rate(None, 2.0)
+        solo = counter_rate(2.0)
         with_decode = rate_with_background(decode_jpeg)
         with_codec = rate_with_background(read_shard)
         with_python = rate_with_background(python_spin)
