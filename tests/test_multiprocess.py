@@ -1,0 +1,62 @@
+"""True multi-process distributed bring-up: 2 OS processes, one
+coordinator, cross-host collectives over the DCN (gRPC) path.
+
+Beyond the reference's test strategy (SURVEY §4: "there are no true
+multi-process/multi-worker tests" — SyncReplicas/TF_CONFIG paths were
+untested in OSS): this spawns two real processes that each own one CPU
+device, join via `initialize_distributed` (the TF_CONFIG analogue), build
+the global data mesh, contribute per-process shards, and check a pjit
+global mean plus a process_allgather. The same code path a TPU pod uses
+over DCN, minus the chips.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tools",
+    "_mp_worker.py",
+)
+
+
+@pytest.mark.slow
+def test_two_process_distributed_collectives(tmp_path):
+    import socket
+
+    with socket.socket() as probe:
+        probe.bind(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
+    coordinator = f"127.0.0.1:{port}"
+
+    env = dict(os.environ)
+    # Each worker must see exactly its own single CPU device; scrub the
+    # virtual-device flag the surrounding test session sets.
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+
+    workers = [
+        subprocess.Popen(
+            [sys.executable, _WORKER, coordinator, "2", str(pid)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+        )
+        for pid in range(2)
+    ]
+    outputs = []
+    try:
+        for proc in workers:
+            out, _ = proc.communicate(timeout=240)
+            outputs.append(out)
+    except subprocess.TimeoutExpired:
+        for proc in workers:
+            proc.kill()
+        pytest.fail(f"distributed workers hung; partial output: {outputs}")
+    for pid, (proc, out) in enumerate(zip(workers, outputs)):
+        assert proc.returncode == 0, f"worker {pid} failed:\n{out}"
+        assert f"mp_worker {pid}: OK" in out

@@ -1,0 +1,69 @@
+"""Worker for the multi-process distributed test (tests/test_multiprocess.py).
+
+Each invocation is one "host": it joins the coordinator, builds the global
+data mesh, contributes its per-process shard, and verifies the cross-host
+collective results. Exits 0 only when every check passes on this process.
+
+Usage: python tools/_mp_worker.py <coordinator> <num_processes> <process_id>
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from tensor2robot_tpu.parallel import mesh as mesh_lib  # noqa: E402
+
+
+def main(coordinator: str, num_processes: int, process_id: int) -> None:
+    mesh_lib.initialize_distributed(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    assert jax.process_count() == num_processes, jax.process_count()
+    assert jax.process_index() == process_id, jax.process_index()
+
+    # Global data mesh over every process's devices (1 CPU device each).
+    mesh = mesh_lib.make_mesh()
+    assert mesh.shape[mesh_lib.DATA_AXIS] == num_processes
+
+    # Per-host data sharding: each process contributes its own batch rows
+    # (the multi-host infeed path RecordDataset(shard_by_host=True) feeds).
+    local = np.full((2, 4), float(process_id + 1), np.float32)
+    global_shape = (2 * num_processes, 4)
+    arr = jax.make_array_from_process_local_data(
+        mesh_lib.data_sharding(mesh), local, global_shape
+    )
+    assert arr.shape == global_shape
+
+    # A cross-host collective through pjit: the global mean sees BOTH
+    # hosts' contributions (mean of 1s and 2s = 1.5 with 2 processes).
+    mean = jax.jit(lambda x: x.mean())(arr)
+    expected = np.mean([p + 1.0 for p in range(num_processes)])
+    np.testing.assert_allclose(float(mean), expected, rtol=1e-6)
+
+    # process_allgather (DCN gather): every host sees every host's shard.
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(
+        np.asarray([float(process_id)], np.float32)
+    )
+    np.testing.assert_array_equal(
+        np.sort(gathered.ravel()), np.arange(num_processes, dtype=np.float32)
+    )
+    print(f"mp_worker {process_id}: OK (mean={float(mean)})")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1], int(sys.argv[2]), int(sys.argv[3]))

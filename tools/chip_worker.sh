@@ -26,6 +26,9 @@ for i in $(seq 1 "$tries"); do
     BENCH_BACKEND_WAIT=300 python bench.py predict \
       > BENCH_PREDICT_r03.json 2>/tmp/chip_predict.err || true
     echo "chip_worker: predict bench done" >&2
+    BENCH_BACKEND_WAIT=300 BENCH_BATCH=128 BENCH_REMAT=1 python bench.py \
+      > BENCH_r03_bs128.json 2>/tmp/chip_bs128.err || true
+    echo "chip_worker: bs128+remat bench done" >&2
     exit 0
   fi
   echo "chip_worker: TPU still unavailable ($(tail -c 200 /tmp/chip_bench.err | tr '\n' ' '))" >&2
