@@ -451,10 +451,25 @@ def _backend_wait(metric: str = "qtopt_critic_train_mfu_bs64_472px") -> float:
 def main() -> None:
     import os
 
+    # The INTENDED (TPU) metric name, derived from the env knobs before
+    # anything can fail, so backend-init/config failures are labeled with
+    # the regime that was requested — a wedged-tunnel bs128 run must not
+    # report a failure under the canonical bs64 name.
+    use_remat = os.environ.get("BENCH_REMAT", "0") == "1"
     try:
-        devices, backend_note = _init_devices(max_wait=_backend_wait())
+        env_batch = int(os.environ.get("BENCH_BATCH", "64"))
+    except ValueError as err:
+        _fail("config", err)
+    intended_metric = f"qtopt_critic_train_mfu_bs{env_batch}_472px" + (
+        "_remat" if use_remat else ""
+    )
+
+    try:
+        devices, backend_note = _init_devices(
+            max_wait=_backend_wait(metric=intended_metric)
+        )
     except Exception as err:
-        _fail("backend_init", err)
+        _fail("backend_init", err, metric=intended_metric)
 
     import jax
     import numpy as np
@@ -467,20 +482,19 @@ def main() -> None:
     if on_tpu:
         # BENCH_BATCH / BENCH_REMAT explore larger batches (remat trades
         # recompute for the activation memory a bigger batch needs); the
-        # default keeps the driver's canonical bs64 metric name.
-        batch_size = int(os.environ.get("BENCH_BATCH", "64"))
+        # default keeps the driver's canonical bs64 metric name, and a
+        # remat run always reports under a distinct "_remat" name.
+        batch_size = env_batch
         image_size, num_convs = (472, 472), (6, 6, 3)
         n_windows, window = 8, 15
-        metric = f"qtopt_critic_train_mfu_bs{batch_size}_472px"
+        metric = intended_metric
     else:
         image_size, num_convs, batch_size = (96, 96), (2, 2, 1), 8
         n_windows, window = 3, 3
         metric = "qtopt_critic_train_mfu_cpu_proxy"
-    use_remat = os.environ.get("BENCH_REMAT", "0") == "1"
-    if use_remat and not metric.endswith("_cpu_proxy"):
-        # A remat run is a different regime; never report it under the
-        # canonical metric name.
-        metric += "_remat"
+        # The CPU proxy measures one fixed regime; a remat'd proxy under
+        # the same metric name would pollute cross-run comparisons.
+        use_remat = False
 
     try:
         from __graft_entry__ import _flagship
