@@ -459,7 +459,14 @@ def main() -> None:
     try:
         env_batch = int(os.environ.get("BENCH_BATCH", "64"))
     except ValueError as err:
-        _fail("config", err)
+        # A distinct name: a malformed request must not pollute any real
+        # metric series (the batch size it asked for is unknowable).
+        _fail(
+            "config",
+            err,
+            metric="qtopt_critic_train_mfu_invalid_config"
+            + ("_remat" if use_remat else ""),
+        )
     intended_metric = f"qtopt_critic_train_mfu_bs{env_batch}_472px" + (
         "_remat" if use_remat else ""
     )

@@ -14,6 +14,10 @@ from tensor2robot_tpu.export.exporters import (
     create_valid_result_larger,
     create_valid_result_smaller,
 )
+from tensor2robot_tpu.export.quantization import (
+    dequantize_variables,
+    quantize_variables,
+)
 from tensor2robot_tpu.export.saved_model import (
     ExportedModel,
     is_valid_export_dir,

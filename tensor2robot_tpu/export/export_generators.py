@@ -88,20 +88,53 @@ class AbstractExportGenerator:
         return filter_required_flat_tensor_spec(spec)
 
     def create_serving_fn(
-        self, compiled, variables
-    ) -> Callable[[Dict[str, Any]], Dict[str, Any]]:
-        """flat raw features -> flat export outputs, pure jax (exportable)."""
+        self, compiled, variables, quantize_weights: bool = False
+    ) -> Callable[..., Dict[str, Any]]:
+        """flat raw features -> flat export outputs, pure jax (exportable).
+
+        quantize_weights: the returned function takes the int8-quantized
+        variables as its FIRST argument (signature (variables, features))
+        and dequantizes them inside the trace. Weights-as-arguments is
+        what makes the exported artifact small: closed-over constants are
+        concrete at trace time, so a closure would constant-fold the
+        dequantize and embed full-size f32 weights; as arguments, the
+        StableHLO artifact contains NO weight constants at all — the int8
+        weights live once, in variables.msgpack. The function's exemplar
+        tree is attached as `serving_fn.variables_in_args` for
+        save_exported_model to store and to trace against.
+        """
         preprocessor = self._preprocessor
         raw = self._export_raw_receivers
 
-        def serving_fn(flat_features: Dict[str, Any]) -> Dict[str, Any]:
+        def run(bound_variables, flat_features):
             features = TensorSpecStruct(dict(flat_features))
             if not raw:
                 features, _ = preprocessor.preprocess(
                     features, None, mode="predict", rng=None
                 )
-            outputs = compiled.predict_step(variables, features)
+            outputs = compiled.predict_step(bound_variables, features)
             return dict(flatten_spec_structure(outputs).items())
+
+        if quantize_weights:
+            import jax
+
+            from tensor2robot_tpu.export.quantization import (
+                dequantize_variables,
+                quantize_variables,
+            )
+
+            quantized, _ = quantize_variables(jax.device_get(variables))
+
+            def serving_fn(quantized_variables, flat_features):
+                return run(
+                    dequantize_variables(quantized_variables), flat_features
+                )
+
+            serving_fn.variables_in_args = quantized
+            return serving_fn
+
+        def serving_fn(flat_features: Dict[str, Any]) -> Dict[str, Any]:
+            return run(variables, flat_features)
 
         return serving_fn
 

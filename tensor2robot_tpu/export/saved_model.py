@@ -93,6 +93,7 @@ def save_exported_model(
     example_features: Optional[Mapping[str, Any]] = None,
     serialize_stablehlo: bool = True,
     metadata: Optional[Dict[str, Any]] = None,
+    quantize_weights: bool = False,
 ) -> str:
     """Writes one export version; returns its final path.
 
@@ -111,6 +112,12 @@ def save_exported_model(
       serialize_stablehlo: disable to skip the code-free serving artifact
         (predictors then need model code, like the CheckpointPredictor path).
       metadata: extra JSON-serializable entries for t2r_metadata.json.
+      quantize_weights: store the variables file with int8 weight-only
+        quantization (export/quantization.py, ~4x smaller); loaders
+        dequantize transparently (metadata flag `weights_int8`). For a
+        quantized StableHLO artifact, build predict_fn through
+        `create_serving_fn(..., quantize_weights=True)` — the artifact
+        embeds its own weight constants independently of this flag.
     """
     os.makedirs(export_root, exist_ok=True)
     final_name = _unique_timestamp_dir(export_root)
@@ -124,14 +131,34 @@ def save_exported_model(
         tmp_path, feature_spec, label_spec=label_spec, global_step=global_step
     )
 
+    # A serving fn built with quantize_weights=True carries its own
+    # quantized tree (weights-as-arguments; see create_serving_fn) — store
+    # exactly that tree so the artifact's argument contract matches the
+    # variables file bit-for-bit.
+    variables_in_args = getattr(predict_fn, "variables_in_args", None)
+    if variables_in_args is not None:
+        stored_variables = _to_plain(variables_in_args)
+        quantize_weights = True
+    else:
+        stored_variables = _to_plain(variables)
+        if quantize_weights:
+            from tensor2robot_tpu.export.quantization import (
+                quantize_variables,
+            )
+
+            stored_variables, _ = quantize_variables(stored_variables)
     with open(os.path.join(tmp_path, VARIABLES_FILENAME), "wb") as f:
-        f.write(serialization.to_bytes(_to_plain(variables)))
+        f.write(serialization.to_bytes(stored_variables))
 
     stablehlo_ok = False
     stablehlo_error = None
     if serialize_stablehlo and predict_fn is not None and example_features is not None:
         try:
-            artifact = _export_stablehlo(predict_fn, example_features)
+            artifact = _export_stablehlo(
+                predict_fn,
+                example_features,
+                variables_in_args=variables_in_args,
+            )
             hlo_dir = os.path.join(tmp_path, STABLEHLO_DIR)
             os.makedirs(hlo_dir, exist_ok=True)
             with open(os.path.join(hlo_dir, STABLEHLO_FILENAME), "wb") as f:
@@ -146,6 +173,8 @@ def save_exported_model(
         "timestamp": int(os.path.basename(final_path)),
         "stablehlo": stablehlo_ok,
         "stablehlo_error": stablehlo_error,
+        "weights_int8": bool(quantize_weights),
+        "stablehlo_weights_in_args": variables_in_args is not None,
         "format_version": 1,
     }
     if metadata:
@@ -163,13 +192,19 @@ def _to_plain(tree):
     return jax.tree_util.tree_map(np.asarray, jax.device_get(dict(tree)))
 
 
-def _export_stablehlo(predict_fn, example_features) -> bytes:
+def _export_stablehlo(
+    predict_fn, example_features, variables_in_args=None
+) -> bytes:
     """Serializes predict_fn over batch-polymorphic input shapes.
 
     The leading dim of every input becomes the same symbolic 'b', mirroring
     the reference's batch_size=None serving placeholders
     (utils/tensorspec_utils.py:783-814). Lowered for both cpu and tpu so the
     artifact serves on robot workstations and accelerators alike.
+
+    variables_in_args: exemplar variables tree when predict_fn takes
+    (variables, features) — traced as an ARGUMENT, so the artifact carries
+    no weight constants (the caller feeds variables at serve time).
     """
     from jax import export as jax_export
 
@@ -186,13 +221,23 @@ def _export_stablehlo(predict_fn, example_features) -> bytes:
                 f"Serving input {key!r} must have a leading batch dim, got {shape}."
             )
         args[key] = jax.ShapeDtypeStruct((b,) + tuple(shape[1:]), dtype)
+    if variables_in_args is not None:
+        variables_exemplar = jax.tree_util.tree_map(
+            lambda leaf: jax.ShapeDtypeStruct(
+                np.asarray(leaf).shape, np.asarray(leaf).dtype
+            ),
+            variables_in_args,
+        )
+        call_args = (variables_exemplar, args)
+    else:
+        call_args = (args,)
     try:
         exported = jax_export.export(
             jax.jit(predict_fn), platforms=("cpu", "tpu")
-        )(args)
+        )(*call_args)
     except Exception:  # noqa: BLE001 — multi-platform lowering can fail for
         # platform-specific ops; a single-platform artifact is still useful.
-        exported = jax_export.export(jax.jit(predict_fn))(args)
+        exported = jax_export.export(jax.jit(predict_fn))(*call_args)
     return exported.serialize()
 
 
@@ -207,6 +252,7 @@ class ExportedModel:
             export_dir
         )
         self._stablehlo_call = None
+        self._arg_variables = None
         if self.metadata.get("stablehlo"):
             self._stablehlo_call = self._load_stablehlo()
 
@@ -231,14 +277,44 @@ class ExportedModel:
                 f"({self.metadata.get('stablehlo_error')})."
             )
         arrays = {k: np.asarray(v) for k, v in flat_features.items()}
-        out = self._stablehlo_call(arrays)
+        if self.metadata.get("stablehlo_weights_in_args"):
+            # Weights-as-arguments artifact (quantized exports): the int8
+            # variables live in variables.msgpack, loaded once and fed to
+            # every call.
+            if self._arg_variables is None:
+                with open(
+                    os.path.join(self.export_dir, VARIABLES_FILENAME), "rb"
+                ) as f:
+                    self._arg_variables = serialization.msgpack_restore(
+                        f.read()
+                    )
+            out = self._stablehlo_call(self._arg_variables, arrays)
+        else:
+            out = self._stablehlo_call(arrays)
         return {k: np.asarray(v) for k, v in dict(out).items()}
 
     def load_variables(self, target: Optional[Mapping[str, Any]] = None):
         """Deserializes variables.msgpack; with `target`, restores into that
-        pytree structure (exact dtypes/shapes), else returns raw nested dicts."""
+        pytree structure (exact dtypes/shapes), else returns raw nested
+        dicts. int8-quantized exports (metadata `weights_int8`) are
+        dequantized transparently."""
         with open(os.path.join(self.export_dir, VARIABLES_FILENAME), "rb") as f:
             data = f.read()
+        if self.metadata.get("weights_int8"):
+            from tensor2robot_tpu.export.quantization import (
+                dequantize_variables,
+            )
+
+            import numpy as _np
+
+            restored = dequantize_variables(
+                serialization.msgpack_restore(data), dtype=_np.float32
+            )
+            if target is None:
+                return restored
+            # Re-route through msgpack so target-directed restore keeps its
+            # exact structure/dtype semantics.
+            data = serialization.to_bytes(restored)
         if target is not None:
             return serialization.from_bytes(_to_plain(target), data)
         return serialization.msgpack_restore(data)

@@ -277,6 +277,34 @@ class TestGrasping44Model:
         assert export["q_predicted"].dtype == jnp.float32
 
     @pytest.mark.slow
+    def test_golden_values(self):
+        """Data->checkpoint golden regression for the flagship (reference
+        t2r_test_fixture.train_and_check_golden_predictions :142-195):
+        two deterministic train steps over the committed TFRecord must
+        reproduce the stored q_predicted/loss to decimal=5. Catches drift
+        anywhere in parse -> decode -> crop/distort -> forward -> loss.
+        Regenerate (intentional changes only) via
+        tools/make_qtopt_golden.py."""
+        from tools.make_qtopt_golden import (
+            VALUES_PATH,
+            build_model,
+            train_and_capture,
+        )
+
+        golden = np.load(VALUES_PATH, allow_pickle=True)
+        captures = train_and_capture(build_model())
+        assert len(captures) == len(golden)
+        for step, (got, want) in enumerate(zip(captures, golden)):
+            np.testing.assert_almost_equal(
+                got["loss"], want["loss"], decimal=5,
+                err_msg=f"loss drifted at step {step}",
+            )
+            np.testing.assert_almost_equal(
+                got["q_predicted"], want["q_predicted"], decimal=5,
+                err_msg=f"q_predicted drifted at step {step}",
+            )
+
+    @pytest.mark.slow
     def test_train_step_and_tiled_predict(self):
         from tensor2robot_tpu.train.train_eval import CompiledModel
 
