@@ -85,12 +85,16 @@ class Exporter:
         exports_to_keep: int = 5,
         serialize_stablehlo: bool = True,
         warmup_batch_sizes: Sequence[int] = (),
+        quantize_weights: bool = False,
     ):
         self.name = name
         self._export_generator = export_generator or DefaultExportGenerator()
         self._gc = DirectoryVersionGC(exports_to_keep)
         self._serialize_stablehlo = serialize_stablehlo
         self._warmup_batch_sizes = tuple(warmup_batch_sizes)
+        # int8 weight-only exports (export/quantization.py): ~4x smaller
+        # artifacts for the robots polling this export root.
+        self._quantize_weights = quantize_weights
 
     def export_root(self, model_dir: str) -> str:
         return os.path.join(model_dir, "export", self.name)
@@ -120,7 +124,9 @@ class Exporter:
         generator.set_specification_from_model(model)
         use_ema = getattr(model, "use_avg_model_params", False)
         variables = state.export_variables(use_ema=use_ema)
-        serving_fn = generator.create_serving_fn(compiled, variables)
+        serving_fn = generator.create_serving_fn(
+            compiled, variables, quantize_weights=self._quantize_weights
+        )
         path = save_exported_model(
             root,
             variables=variables,
@@ -131,6 +137,7 @@ class Exporter:
             example_features=generator.create_example_features(),
             serialize_stablehlo=self._serialize_stablehlo,
             metadata={"exporter": self.name, "eval_metrics": eval_metrics},
+            quantize_weights=self._quantize_weights,
         )
         if self._warmup_batch_sizes:
             generator.create_warmup_requests_numpy(self._warmup_batch_sizes, path)
@@ -194,6 +201,7 @@ def create_default_exporters(
     exports_to_keep: int = 5,
     serialize_stablehlo: bool = True,
     warmup_batch_sizes: Sequence[int] = (),
+    quantize_weights: bool = False,
 ) -> List[Exporter]:
     """latest + best exporter pair (reference create_default_exporters,
     train_eval.py:295-385; one artifact serves both the numpy and tf.Example
@@ -207,6 +215,7 @@ def create_default_exporters(
             exports_to_keep=exports_to_keep,
             serialize_stablehlo=serialize_stablehlo,
             warmup_batch_sizes=warmup_batch_sizes,
+            quantize_weights=quantize_weights,
         ),
         BestExporter(
             name="best",
@@ -215,5 +224,6 @@ def create_default_exporters(
             exports_to_keep=exports_to_keep,
             serialize_stablehlo=serialize_stablehlo,
             warmup_batch_sizes=warmup_batch_sizes,
+            quantize_weights=quantize_weights,
         ),
     ]

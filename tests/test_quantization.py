@@ -142,6 +142,30 @@ class TestQuantizedExport:
             not np.array_equal(out_q[key], out_f32[key]) for key in out_f32
         )
 
+    def test_exporter_quantize_weights_flag(self, trained, tmp_path):
+        """LatestExporter(quantize_weights=True): the train-time export
+        policy produces int8 artifacts end to end."""
+        from tensor2robot_tpu.export import LatestExporter
+
+        compiled, state = trained
+        exporter = LatestExporter(
+            name="latest_q", quantize_weights=True
+        )
+        path = exporter.maybe_export(
+            step=1, state=state, eval_metrics={"loss": 1.0},
+            compiled=compiled, model_dir=str(tmp_path),
+        )
+        model = ExportedModel(path)
+        assert model.metadata["weights_int8"] is True
+        assert model.metadata["stablehlo_weights_in_args"] is True
+        features = {
+            "x": np.random.RandomState(5).uniform(-1, 1, (2, 3)).astype(
+                np.float32
+            )
+        }
+        out = model.predict(features)
+        assert np.all(np.isfinite(out["a_predicted"]))
+
     def test_target_directed_restore_of_quantized_export(
         self, trained, tmp_path
     ):
