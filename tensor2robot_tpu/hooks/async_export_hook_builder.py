@@ -29,17 +29,22 @@ def default_create_export_fn(
     compiled,
     export_generator=None,
     warmup_batch_sizes: Sequence[int] = (),
+    quantize_weights: bool = False,
 ) -> Callable:
     """Builds fn(state, export_dir, global_step) -> path exporting a serving
     artifact with the t2r-assets spec contract (reference
-    default_create_export_fn :41-82)."""
+    default_create_export_fn :41-82). quantize_weights selects int8
+    weight-only artifacts (export/quantization.py), matching the Exporter
+    policies' flag."""
     generator = export_generator or DefaultExportGenerator()
     generator.set_specification_from_model(model)
 
     def export_fn(state, export_dir: str, global_step: int) -> str:
         use_ema = getattr(model, "use_avg_model_params", False)
         variables = state.export_variables(use_ema=use_ema)
-        serving_fn = generator.create_serving_fn(compiled, variables)
+        serving_fn = generator.create_serving_fn(
+            compiled, variables, quantize_weights=quantize_weights
+        )
         path = save_exported_model(
             export_dir,
             variables=variables,
@@ -48,6 +53,7 @@ def default_create_export_fn(
             global_step=global_step,
             predict_fn=serving_fn,
             example_features=generator.create_example_features(),
+            quantize_weights=quantize_weights,
         )
         if warmup_batch_sizes:
             generator.create_warmup_requests_numpy(warmup_batch_sizes, path)
@@ -134,12 +140,14 @@ class AsyncExportHookBuilder(HookBuilder):
         num_versions: Optional[int] = 3,
         export_generator=None,
         warmup_batch_sizes: Sequence[int] = (),
+        quantize_weights: bool = False,
     ):
         self._export_dir = export_dir
         self._save_secs = save_secs
         self._num_versions = num_versions
         self._export_generator = export_generator
         self._warmup_batch_sizes = tuple(warmup_batch_sizes)
+        self._quantize_weights = quantize_weights
 
     def _make_listener_and_state_fn(self, t2r_model, trainer):
         export_fn = default_create_export_fn(
@@ -147,6 +155,7 @@ class AsyncExportHookBuilder(HookBuilder):
             trainer,
             export_generator=self._export_generator,
             warmup_batch_sizes=self._warmup_batch_sizes,
+            quantize_weights=self._quantize_weights,
         )
 
         def state_export_fn(export_dir: str, global_step: int) -> str:
