@@ -269,18 +269,26 @@ class ExportedModel:
         return self._stablehlo_call is not None
 
     def predict(self, flat_features: Dict[str, Any]) -> Dict[str, Any]:
-        """Code-free serving via the StableHLO artifact."""
+        """Code-free serving via the StableHLO artifact (host numpy in/out;
+        weights-as-arguments artifacts feed their int8 variables from
+        variables.msgpack transparently). Raises via traced_predict when
+        no artifact exists."""
+        arrays = {k: np.asarray(v) for k, v in flat_features.items()}
+        out = self.traced_predict(arrays)
+        return {k: np.asarray(v) for k, v in out.items()}
+
+    def traced_predict(self, flat_features: Dict[str, Any]) -> Dict[str, Any]:
+        """predict() without host conversions: inputs/outputs stay jax
+        values, so the call can sit INSIDE a jitted program (e.g. the
+        jit-native CEM loop, policies.JitCEMPolicy). Raises like predict()
+        when no StableHLO artifact exists."""
         if self._stablehlo_call is None:
             raise RuntimeError(
                 f"Export {self.export_dir} has no StableHLO artifact; "
-                "serve it with a model-code predictor instead "
+                "traced serving requires one "
                 f"({self.metadata.get('stablehlo_error')})."
             )
-        arrays = {k: np.asarray(v) for k, v in flat_features.items()}
         if self.metadata.get("stablehlo_weights_in_args"):
-            # Weights-as-arguments artifact (quantized exports): the int8
-            # variables live in variables.msgpack, loaded once and fed to
-            # every call.
             if self._arg_variables is None:
                 with open(
                     os.path.join(self.export_dir, VARIABLES_FILENAME), "rb"
@@ -288,10 +296,8 @@ class ExportedModel:
                     self._arg_variables = serialization.msgpack_restore(
                         f.read()
                     )
-            out = self._stablehlo_call(self._arg_variables, arrays)
-        else:
-            out = self._stablehlo_call(arrays)
-        return {k: np.asarray(v) for k, v in dict(out).items()}
+            return dict(self._stablehlo_call(self._arg_variables, flat_features))
+        return dict(self._stablehlo_call(flat_features))
 
     def load_variables(self, target: Optional[Mapping[str, Any]] = None):
         """Deserializes variables.msgpack; with `target`, restores into that

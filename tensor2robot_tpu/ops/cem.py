@@ -1,0 +1,86 @@
+"""Jit-native cross-entropy method: the whole CEM loop as ONE XLA program.
+
+The reference's CEM (utils/cross_entropy.py:31-155, rebuilt in
+utils/cross_entropy.py here) runs numpy on the robot host, crossing the
+host<->accelerator boundary once per iteration for the batched critic
+call. Because this framework's exported artifacts rehydrate as jax
+callables (export/saved_model.py ExportedModel), the objective can be
+TRACED — sampling, scoring, elite refit, and the iteration loop fuse into
+one jitted program with a single dispatch per action selection
+(policies.JitCEMPolicy). Same proposal family and elite-refit math as the
+numpy engine; keep them in sync.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def cross_entropy_maximize(
+    objective_fn: Callable[[jax.Array], jax.Array],
+    mean: jax.Array,
+    stddev: jax.Array,
+    rng: jax.Array,
+    *,
+    num_samples: int,
+    num_iterations: int,
+    elite_fraction: float = 0.1,
+    low: Optional[float] = None,
+    high: Optional[float] = None,
+    min_stddev: float = 1e-6,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Maximizes objective_fn over a diagonal-Gaussian proposal.
+
+    Args:
+      objective_fn: [num_samples, *action] -> [num_samples] scores; traced
+        (may contain an exported-model call).
+      mean/stddev: initial proposal, shape [*action].
+      rng: PRNG key.
+      num_samples: population per iteration (static).
+      num_iterations: refit rounds (static; the loop is lax.fori_loop).
+      elite_fraction: top fraction refit each round (>= 1 elite).
+      low/high: optional box bounds; samples clip BEFORE scoring so elites
+        refit on the actions actually scored (the numpy engine's rule).
+      min_stddev: floor keeping later iterations samplable.
+
+    Returns (mean, stddev, best_action, best_score) — best over ALL
+    iterations' populations, not just the final mean.
+    """
+    num_elites = max(1, int(num_samples * elite_fraction))
+
+    def body(index, carry):
+        mean, stddev, best_action, best_score, rng = carry
+        rng, key = jax.random.split(rng)
+        samples = mean[None, ...] + stddev[None, ...] * jax.random.normal(
+            key, (num_samples,) + mean.shape, mean.dtype
+        )
+        if low is not None or high is not None:
+            samples = jnp.clip(samples, low, high)
+        scores = objective_fn(samples)
+        top_scores, top_idx = lax.top_k(scores, num_elites)
+        elites = samples[top_idx]
+        new_mean = jnp.mean(elites, axis=0)
+        new_stddev = jnp.maximum(jnp.std(elites, axis=0), min_stddev)
+        improved = top_scores[0] > best_score
+        best_action = jnp.where(improved, elites[0], best_action)
+        best_score = jnp.where(improved, top_scores[0], best_score)
+        return new_mean, new_stddev, best_action, best_score, rng
+
+    init = (
+        mean,
+        stddev,
+        # Parity with the numpy engine: if no iteration ever improves
+        # (e.g. all-NaN scores from a broken critic), return the initial
+        # proposal mean, not zeros (which may sit outside the action box).
+        mean,
+        jnp.asarray(-jnp.inf, mean.dtype),
+        rng,
+    )
+    mean, stddev, best_action, best_score, _ = lax.fori_loop(
+        0, num_iterations, body, init
+    )
+    return mean, stddev, best_action, best_score

@@ -2,6 +2,7 @@
 
 from tensor2robot_tpu.policies.policies import (
     CEMPolicy,
+    JitCEMPolicy,
     LSTMCEMPolicy,
     OUExploreRegressionPolicy,
     PerEpisodeSwitchPolicy,

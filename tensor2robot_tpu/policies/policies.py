@@ -6,6 +6,7 @@ policies/policies.py:34-365:
 
   Policy                      restore/init delegation + sample_action
   CEMPolicy                   CEM argmax over a critic's q_predicted
+  JitCEMPolicy                + the whole CEM loop jitted (beyond ref)
   LSTMCEMPolicy               + recurrent hidden-state carry
   RegressionPolicy            regression model's inference_output as action
   SequentialRegressionPolicy  + observation-history stacking
@@ -141,6 +142,10 @@ class CEMPolicy(Policy):
             # [low, high] and never recover.
             return np.clip(samples, action_low, action_high)
 
+        self._cem_samples = cem_samples
+        self._cem_iterations = cem_iterations
+        self._elite_fraction = elite_fraction
+        self._seed = seed
         self._cem = CrossEntropyMethod(
             sample_fn=sample_clipped,
             num_samples=cem_samples,
@@ -206,6 +211,101 @@ class CEMPolicy(Policy):
     def SelectAction(self, state, context=None, timestep: int = 0) -> np.ndarray:
         features = self._pack(state, context, timestep)
         return self.get_cem_action(features)
+
+
+@configurable("JitCEMPolicy")
+class JitCEMPolicy(CEMPolicy):
+    """CEM with the ENTIRE sample/score/refit loop jitted around the
+    exported model's traced StableHLO call (ops/cem.py): one program
+    dispatch per action selection instead of one predictor round-trip per
+    CEM iteration. Beyond the reference (its CEM is host numpy,
+    policies.py:107-185) — possible here because exports rehydrate as jax
+    callables. Falls back to the numpy engine for predictors without a
+    loaded StableHLO export (checkpoint predictors, random-init serving).
+    """
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        import jax
+
+        self._jit_key = jax.random.PRNGKey(
+            0 if self._seed is None else self._seed
+        )
+        self._jit_select = None
+        self._jit_source = None  # the ExportedModel the jit was built for
+
+    def seed(self, seed: int) -> None:
+        super().seed(seed)
+        import jax
+
+        self._jit_key = jax.random.PRNGKey(seed)
+        # Keep the numpy fallback engine in the same seeding contract.
+        self._cem._rng = np.random.RandomState(seed)
+
+    def _maybe_build_jit(self, loaded) -> None:
+        if self._jit_source is loaded:
+            return
+        import jax
+        import jax.numpy as jnp
+
+        from tensor2robot_tpu.ops import cem as cem_ops
+
+        action_key = self._resolve_action_key()
+        low, high = self._low, self._high
+        action_size = self._action_size
+        q_key = self._q_key
+
+        num_samples = self._cem_samples
+
+        def select(flat_features, key):
+            def objective(samples):
+                batch = {
+                    k: jnp.asarray(v)[None, ...]
+                    for k, v in flat_features.items()
+                }
+                batch[action_key] = samples[None, ...]
+                out = loaded.traced_predict(batch)
+                q = jnp.reshape(out[q_key], (-1,))
+                # Shapes are static at trace time: catch a critic/export
+                # population mismatch exactly like the numpy objective
+                # (an out-of-bounds top_k gather would silently clamp).
+                if q.shape[0] != num_samples:
+                    raise ValueError(
+                        f"Critic returned {q.shape[0]} Q values for "
+                        f"population {num_samples}; was the model exported "
+                        f"with action_batch_size = {num_samples}?"
+                    )
+                return q
+
+            mean = jnp.full((action_size,), (low + high) / 2.0, jnp.float32)
+            stddev = jnp.full((action_size,), (high - low) / 2.0, jnp.float32)
+            _, _, best, best_q = cem_ops.cross_entropy_maximize(
+                objective,
+                mean,
+                stddev,
+                key,
+                num_samples=self._cem_samples,
+                num_iterations=self._cem_iterations,
+                elite_fraction=self._elite_fraction,
+                low=low,
+                high=high,
+            )
+            return jnp.clip(best, low, high), best_q
+
+        self._jit_select = jax.jit(select)
+        self._jit_source = loaded
+
+    def get_cem_action(self, features: Dict[str, Any]) -> np.ndarray:
+        import jax
+
+        loaded = getattr(self._predictor, "loaded_model", None)
+        if loaded is None or not getattr(loaded, "has_stablehlo", False):
+            return super().get_cem_action(features)
+        self._maybe_build_jit(loaded)
+        self._jit_key, key = jax.random.split(self._jit_key)
+        flat = {k: np.asarray(v) for k, v in features.items()}
+        best, _ = self._jit_select(flat, key)
+        return np.asarray(jax.device_get(best), np.float32)
 
 
 @configurable("LSTMCEMPolicy")

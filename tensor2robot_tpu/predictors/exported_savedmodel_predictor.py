@@ -138,6 +138,14 @@ class ExportedSavedModelPredictor(AbstractPredictor):
             self._loaded = make_random_loaded(generator)  # type: ignore[assignment]
             self._predict_fn = predict_fn
 
+    @property
+    def loaded_model(self):
+        """The currently-loaded ExportedModel (None before restore). Jit-
+        native consumers (policies.JitCEMPolicy) trace through its
+        StableHLO call instead of the numpy predict surface."""
+        with self._lock:
+            return self._loaded
+
     # -- predict --------------------------------------------------------------
 
     def predict(self, features: Mapping[str, Any]) -> Dict[str, Any]:

@@ -1,0 +1,143 @@
+"""Property-based tests for TensorSpecStruct's view semantics.
+
+SURVEY §7 flags the flat/hierarchical-view duality (reference
+utils/tensorspec_utils.py:303-683, README.md:190-395 documents the exact
+observable behavior) as the subtlest heavily-relied-on contract in the
+framework; example-based tests in test_specs.py pin known cases, these
+hypothesis properties pin the INVARIANTS over arbitrary key structures:
+
+  1. path/attribute duality: s[a/b/c] == s.a.b.c, always
+  2. views are live in both directions (mutate child <-> parent sees it)
+  3. flat iteration order is insertion order, views preserve it
+  4. copy() detaches storage; pytree roundtrip is the identity
+  5. deletion through a view deletes in the parent
+"""
+
+import string
+
+import numpy as np
+import pytest
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from tensor2robot_tpu.specs import TensorSpecStruct
+
+# Path segments: valid python identifiers not colliding with the class's
+# methods/attrs (the attribute-view surface).
+_RESERVED = frozenset(dir(TensorSpecStruct)) | {"_storage", "_prefix"}
+segment = (
+    st.text(string.ascii_lowercase, min_size=1, max_size=4)
+    .filter(lambda s: s not in _RESERVED and not s.startswith("_"))
+)
+
+
+@st.composite
+def key_sets(draw):
+    """Sets of '/'-joined paths where no path is a prefix of another
+    (the leaf-vs-subtree collision the struct itself rejects)."""
+    paths = draw(
+        st.lists(
+            st.lists(segment, min_size=1, max_size=3).map(tuple),
+            min_size=1,
+            max_size=6,
+            unique=True,
+        )
+    )
+    kept = []
+    for path in paths:
+        if any(
+            path[: len(other)] == other or other[: len(path)] == path
+            for other in kept
+            if other != path
+        ):
+            continue
+        kept.append(path)
+    return ["/".join(path) for path in kept]
+
+
+def build(keys):
+    struct = TensorSpecStruct()
+    for index, key in enumerate(keys):
+        struct[key] = np.full((2,), float(index), np.float32)
+    return struct
+
+
+class TestViewProperties:
+    @settings(max_examples=60, deadline=None)
+    @given(key_sets())
+    def test_path_attribute_duality(self, keys):
+        struct = build(keys)
+        for key in keys:
+            node = struct
+            for part in key.split("/"):
+                node = getattr(node, part)
+            np.testing.assert_array_equal(node, struct[key])
+
+    @settings(max_examples=60, deadline=None)
+    @given(key_sets())
+    def test_views_are_live_both_directions(self, keys):
+        struct = build(keys)
+        for key in keys:
+            if "/" not in key:
+                continue
+            head, rest = key.split("/", 1)
+            view = getattr(struct, head)
+            # child -> parent
+            view[rest] = np.full((2,), 99.0, np.float32)
+            np.testing.assert_array_equal(struct[key], 99.0)
+            # parent -> child
+            struct[key] = np.full((2,), -1.0, np.float32)
+            np.testing.assert_array_equal(view[rest], -1.0)
+
+    @settings(max_examples=60, deadline=None)
+    @given(key_sets())
+    def test_iteration_order_is_insertion_order(self, keys):
+        struct = build(keys)
+        assert list(struct.keys()) == keys
+        # A subtree view lists its members in the parent's order.
+        heads = [k.split("/", 1) for k in keys if "/" in k]
+        for head in {h for h, _ in heads}:
+            expected = [rest for h, rest in heads if h == head]
+            assert list(getattr(struct, head).keys()) == expected
+
+    @settings(max_examples=60, deadline=None)
+    @given(key_sets())
+    def test_copy_detaches_and_pytree_roundtrips(self, keys):
+        import jax
+
+        struct = build(keys)
+        clone = struct.copy()
+        clone[keys[0]] = np.full((2,), 7.0, np.float32)
+        assert not np.array_equal(struct[keys[0]], clone[keys[0]])
+
+        leaves, treedef = jax.tree_util.tree_flatten(struct)
+        rebuilt = jax.tree_util.tree_unflatten(treedef, leaves)
+        assert list(rebuilt.keys()) == list(struct.keys())
+        for key in keys:
+            np.testing.assert_array_equal(rebuilt[key], struct[key])
+
+    @settings(max_examples=60, deadline=None)
+    @given(key_sets())
+    def test_deletion_through_view_hits_parent(self, keys):
+        struct = build(keys)
+        nested = [k for k in keys if "/" in k]
+        if not nested:
+            return
+        key = nested[0]
+        head, rest = key.split("/", 1)
+        del getattr(struct, head)[rest]
+        assert key not in struct
+        remaining = [k for k in keys if k != key]
+        assert list(struct.keys()) == remaining
+
+    @settings(max_examples=40, deadline=None)
+    @given(key_sets())
+    def test_prefix_collisions_always_rejected(self, keys):
+        struct = build(keys)
+        for key in keys:
+            with pytest.raises(ValueError):
+                struct[key + "/child"] = np.zeros((2,), np.float32)
+            if "/" in key:
+                prefix = key.rsplit("/", 1)[0]
+                with pytest.raises(ValueError):
+                    struct[prefix] = np.zeros((2,), np.float32)
