@@ -1,0 +1,142 @@
+"""Concurrent actor/learner topology: two live processes, filesystem bus.
+
+The reference's distributed-RL shape (SURVEY §2.7 async actor/learner
+row; README:44-51): a learner exports SavedModels on a timer while
+robots poll-restore and write episode shards. The sequential CLI test
+(test_cli.py) proves each stage; this test runs learner and collector
+CONCURRENTLY so the real races happen: the collector polls while exports
+are being written (tmp-dir rename atomicity), observes a MOVING global
+step, and its replay shards land while the learner still trains.
+"""
+
+import glob
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+_LEARNER = r"""
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+model_dir, export_dir = sys.argv[1], sys.argv[2]
+from tensor2robot_tpu.data.input_generators import DefaultRandomInputGenerator
+from tensor2robot_tpu.hooks.async_export_hook_builder import AsyncExportHookBuilder
+from tensor2robot_tpu.research.pose_env.pose_env_models import PoseEnvRegressionModel
+from tensor2robot_tpu.train.train_eval import train_eval_model
+
+train_eval_model(
+    PoseEnvRegressionModel(device_type="cpu"),
+    input_generator_train=DefaultRandomInputGenerator(batch_size=2),
+    model_dir=model_dir,
+    max_train_steps=120,
+    eval_steps=None,
+    save_checkpoints_steps=1000,
+    log_every_steps=50,
+    hook_builders=[AsyncExportHookBuilder(export_dir=export_dir, save_secs=2.0)],
+)
+print("LEARNER_DONE", flush=True)
+"""
+
+_COLLECTOR = r"""
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+root_dir, export_dir = sys.argv[1], sys.argv[2]
+import functools
+from tensor2robot_tpu.policies import RegressionPolicy
+from tensor2robot_tpu.predictors.exported_savedmodel_predictor import (
+    ExportedSavedModelPredictor,
+)
+from tensor2robot_tpu.research.pose_env.episode_to_transitions import (
+    episode_to_transitions_pose_toy,
+)
+from tensor2robot_tpu.research.pose_env.pose_env import PoseToyEnv
+from tensor2robot_tpu.research.run_env import run_env
+from tensor2robot_tpu.utils.continuous_collect_eval import collect_eval_loop
+from tensor2robot_tpu.utils.writer import TFRecordReplayWriter
+
+predictor = ExportedSavedModelPredictor(export_dir=export_dir, timeout=120)
+policy = RegressionPolicy(predictor)
+last = collect_eval_loop(
+    root_dir=root_dir,
+    policy=policy,
+    collect_env=PoseToyEnv(seed=3),
+    eval_env=None,
+    num_collect=2,
+    run_agent_fn=functools.partial(
+        run_env,
+        episode_to_transitions_fn=episode_to_transitions_pose_toy,
+        replay_writer=TFRecordReplayWriter(),
+    ),
+    idle_sleep_secs=1.0,
+    max_cycles=40,
+)
+print("COLLECTOR_DONE", last, flush=True)
+"""
+
+
+@pytest.mark.slow
+def test_concurrent_actor_learner(tmp_path):
+    model_dir = str(tmp_path / "learner")
+    export_dir = str(tmp_path / "exports")
+    collect_root = str(tmp_path / "robot")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    cwd = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    # File-backed stdout: the OS drains both processes without the test
+    # having to, so neither child can block on a full pipe and silently
+    # serialize the "concurrent" run.
+    learner_log = open(tmp_path / "learner.log", "w+")
+    collector_log = open(tmp_path / "collector.log", "w+")
+    learner = subprocess.Popen(
+        [sys.executable, "-c", _LEARNER, model_dir, export_dir],
+        stdout=learner_log, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=cwd,
+    )
+    collector = subprocess.Popen(
+        [sys.executable, "-c", _COLLECTOR, collect_root, export_dir],
+        stdout=collector_log, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=cwd,
+    )
+
+    def read(log):
+        log.flush()
+        log.seek(0)
+        return log.read()
+
+    try:
+        try:
+            learner.wait(timeout=600)
+        except subprocess.TimeoutExpired:
+            pytest.fail(f"learner hung; output: {read(learner_log)[-2000:]}")
+        # Surface a learner crash immediately, before burning the
+        # collector's poll timeouts.
+        learner_out = read(learner_log)
+        assert learner.returncode == 0, learner_out[-2000:]
+        assert "LEARNER_DONE" in learner_out
+        try:
+            collector.wait(timeout=300)
+        except subprocess.TimeoutExpired:
+            pytest.fail(
+                f"collector hung; output: {read(collector_log)[-2000:]}"
+            )
+    finally:
+        for proc in (learner, collector):
+            if proc.poll() is None:
+                proc.kill()
+        collector_out = read(collector_log)
+        learner_log.close()
+        collector_log.close()
+
+    assert collector.returncode == 0, collector_out[-2000:]
+    match = re.search(r"COLLECTOR_DONE (-?\d+)", collector_out)
+    assert match, collector_out[-1500:]
+    # The collector observed a live (nonzero) global step from an export
+    # written WHILE training ran, and wrote replay shards.
+    assert int(match.group(1)) > 0, collector_out[-1500:]
+    shards = glob.glob(os.path.join(collect_root, "policy_collect", "*"))
+    assert shards, "collector wrote no replay shards"

@@ -204,6 +204,30 @@ class TestTransformerBCModel:
         )
         assert outputs_dp["inference_output"].shape == (4, 8, 2)
 
+    def test_pipeline_composes_with_grad_accum_and_remat(self):
+        """Both microbatching levels stack: grad accumulation slices the
+        batch on the host-loop level, the GPipe schedule re-microbatches
+        each slice across stages; remat wraps the whole pipelined
+        forward. One step must run and stay finite."""
+        mesh = mesh_lib.make_mesh(
+            data=1, pipe=2, devices=jax.devices()[:2]
+        )
+        model = TransformerBCModel(
+            action_size=2, episode_length=8, image_size=(16, 16),
+            num_layers=2, mesh=mesh, use_flash=False, pipeline_stages=2,
+            pipeline_microbatches=2,
+        )
+        compiled = CompiledModel(
+            model, mesh=mesh, donate_state=False,
+            grad_accum_steps=2, remat=True,
+        )
+        batch = _batch(model, batch_size=8)
+        state = compiled.init_state(jax.random.PRNGKey(0), batch)
+        state, metrics = compiled.train_step(
+            state, compiled.shard_batch(batch), jax.random.PRNGKey(1)
+        )
+        assert np.isfinite(float(jax.device_get(metrics["loss"])))
+
     def test_pipeline_matches_sequential_model(self):
         """The pipelined model must compute the same function: identical
         stacked params applied by a plain (pipeline_stages=1) twin via
