@@ -128,7 +128,7 @@ class CEMPolicy(Policy):
         self._action_size = action_size
         self._low, self._high = action_low, action_high
         self._action_key = action_key
-        self._resolved_action_key: Optional[str] = None
+        self._resolved_action_leaves = None
         self._q_key = q_key
 
         def sample_clipped(mean, stddev, n, rng):
@@ -154,29 +154,57 @@ class CEMPolicy(Policy):
             seed=seed,
         )
 
-    def _resolve_action_key(self) -> str:
-        """The exported spec may nest the action (CriticModel packs it under
-        'action/<leaf>'); resolve the concrete leaf key once and cache it
-        (the spec is only available after the predictor has restored)."""
-        if self._resolved_action_key is not None:
-            return self._resolved_action_key
+    def _resolve_action_leaves(self):
+        """All action leaves under the action key, IN SPEC ORDER, with their
+        trailing dims: [(leaf_key, size), ...]. A multi-part action spec
+        (e.g. QT-Opt's 7 named components) is optimized as one flat
+        [sum(sizes)] CEM vector that the objective splits back per leaf;
+        SelectAction returns that flat vector in the same spec order.
+        Cached — the spec is only available after the predictor restores."""
+        if self._resolved_action_leaves is not None:
+            return self._resolved_action_leaves
         spec = flatten_spec_structure(self._predictor.get_feature_specification())
         if self._action_key in list(spec.keys()):  # leaf keys only: `in spec`
-            self._resolved_action_key = self._action_key  # matches prefixes too
-            return self._action_key
-        prefix = self._action_key + "/"
-        leaves = [k for k in spec.keys() if k.startswith(prefix)]
-        if len(leaves) == 1:
-            self._resolved_action_key = leaves[0]
-            return leaves[0]
-        raise ValueError(
-            f"Cannot resolve action key {self._action_key!r} in spec keys "
-            f"{sorted(spec.keys())}; multi-leaf action specs need a custom "
-            "pack_fn/action_key."
-        )
+            leaves = [self._action_key]
+        else:
+            prefix = self._action_key + "/"
+            leaves = [k for k in spec.keys() if k.startswith(prefix)]
+        if not leaves:
+            raise ValueError(
+                f"Cannot resolve action key {self._action_key!r} in spec "
+                f"keys {sorted(spec.keys())}."
+            )
+        def leaf_size(key):
+            # PREDICT specs carry the CEM population as the leading dim
+            # (CriticModel tiling contract), so a vector leaf shows as
+            # [population, size] and a SCALAR leaf as [population] — a
+            # rank<2 predict-spec leaf therefore contributes one dim.
+            shape = tuple(spec[key].shape)
+            return int(shape[-1]) if len(shape) >= 2 else 1
+
+        resolved = [(key, leaf_size(key)) for key in leaves]
+        total = sum(size for _, size in resolved)
+        if total != self._action_size:
+            raise ValueError(
+                f"Action leaves {resolved} sum to {total} dims but "
+                f"action_size={self._action_size}."
+            )
+        self._resolved_action_leaves = resolved
+        return resolved
+
+    @staticmethod
+    def _split_action(xp, samples, leaves):
+        """Splits a flat [..., sum(sizes)] action along its last dim into
+        {leaf_key: [..., size]} in spec order (numpy or jnp via `xp`)."""
+        parts = {}
+        offset = 0
+        for key, size in leaves:
+            parts[key] = xp.asarray(samples[..., offset:offset + size])
+            offset += size
+        return parts
 
     def _objective_fn(self, features: Dict[str, Any]) -> Callable:
-        action_key = self._resolve_action_key()
+        leaves = self._resolve_action_leaves()
 
         def objective(samples: np.ndarray) -> np.ndarray:
             n = samples.shape[0]
@@ -185,7 +213,8 @@ class CEMPolicy(Policy):
                 key: np.asarray(value)[None, ...]
                 for key, value in features.items()
             }
-            batch[action_key] = actions[None, ...]  # [1, n, action_size]
+            for key, part in self._split_action(np, actions, leaves).items():
+                batch[key] = part[None, ...]  # [1, n, leaf_size]
             out = self._predictor.predict(batch)
             q = np.asarray(out[self._q_key]).reshape(-1)
             if q.shape[0] != n:
@@ -250,7 +279,7 @@ class JitCEMPolicy(CEMPolicy):
 
         from tensor2robot_tpu.ops import cem as cem_ops
 
-        action_key = self._resolve_action_key()
+        leaves = self._resolve_action_leaves()
         low, high = self._low, self._high
         action_size = self._action_size
         q_key = self._q_key
@@ -263,7 +292,10 @@ class JitCEMPolicy(CEMPolicy):
                     k: jnp.asarray(v)[None, ...]
                     for k, v in flat_features.items()
                 }
-                batch[action_key] = samples[None, ...]
+                for leaf_key, part in self._split_action(
+                    jnp, samples, leaves
+                ).items():
+                    batch[leaf_key] = part[None, ...]
                 out = loaded.traced_predict(batch)
                 q = jnp.reshape(out[q_key], (-1,))
                 # Shapes are static at trace time: catch a critic/export
@@ -330,9 +362,12 @@ class LSTMCEMPolicy(CEMPolicy):
             features[self._state_input_key] = self._hidden
         action = self.get_cem_action(features)
         # One more pass to advance the recurrent state with the chosen action,
-        # fed under the same resolved leaf key the CEM objective used.
+        # fed under the same per-leaf keys the CEM objective used.
         batch = {k: np.asarray(v)[None, ...] for k, v in features.items()}
-        batch[self._resolve_action_key()] = action[None, None, ...]
+        for key, part in self._split_action(
+            np, action, self._resolve_action_leaves()
+        ).items():
+            batch[key] = part[None, None, ...]
         out = self._predictor.predict(batch)
         if self._state_output_key in out:
             self._hidden = np.asarray(out[self._state_output_key])[0]
