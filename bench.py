@@ -410,6 +410,79 @@ def bench_predict() -> None:
             median_hz, best_hz, avg_hz = _measure_windows(
                 run_window, lambda: None, n_windows, window
             )
+
+            # Full action-selection rate under the jit-native CEM (the
+            # whole sample/score/refit loop in ONE dispatch,
+            # policies.JitCEMPolicy). Needs its own export with the CEM
+            # population baked into the action spec (the tiling contract
+            # an on-robot CEM deployment exports with).
+            jit_cem_hz = 0.0
+            try:
+                from tensor2robot_tpu.policies import JitCEMPolicy
+                from tensor2robot_tpu.research.qtopt.t2r_models import (
+                    Grasping44E2EOpenCloseTerminateGripperStatusHeightToBottom,
+                )
+                from tensor2robot_tpu.train.train_eval import (
+                    maybe_wrap_for_tpu,
+                )
+
+                cem_model = maybe_wrap_for_tpu(
+                    Grasping44E2EOpenCloseTerminateGripperStatusHeightToBottom(
+                        device_type="tpu",
+                        image_size=image_size,
+                        num_convs=num_convs,
+                        action_batch_size=cem_samples,
+                    )
+                )
+                cem_compiled = CompiledModel(cem_model, donate_state=False)
+                cem_state = cem_compiled.init_state(
+                    jax.random.PRNGKey(0), batch
+                )
+                cem_generator = DefaultExportGenerator()
+                cem_generator.set_specification_from_model(cem_model)
+                cem_root = os.path.join(root, "cem")
+                cem_variables = cem_state.export_variables()
+                save_exported_model(
+                    cem_root,
+                    variables=cem_variables,
+                    feature_spec=cem_generator.serving_input_spec(),
+                    global_step=0,
+                    predict_fn=cem_generator.create_serving_fn(
+                        cem_compiled, cem_variables
+                    ),
+                    example_features=cem_generator.create_example_features(),
+                )
+                cem_predictor = ExportedSavedModelPredictor(
+                    export_dir=cem_root
+                )
+                if not cem_predictor.restore():
+                    raise RuntimeError("CEM predictor restore failed")
+                policy = JitCEMPolicy(
+                    cem_predictor,
+                    action_size=10,
+                    cem_samples=cem_samples,
+                    cem_iterations=3,
+                    seed=0,
+                )
+                cem_features = make_random_numpy(
+                    cem_generator.serving_input_spec(), batch_size=1, seed=0
+                )
+                state_features = {
+                    key: value[0]
+                    for key, value in cem_features.items()
+                    if key.startswith("state")
+                }
+
+                def run_select_window():
+                    for _ in range(window):
+                        policy.SelectAction(state_features)
+
+                run_select_window()  # compile + warm-in
+                jit_cem_hz, _, _ = _measure_windows(
+                    run_select_window, lambda: None, n_windows, window
+                )
+            except Exception as cem_err:  # noqa: BLE001 — optional metric
+                print(f"bench: jit-CEM path failed: {cem_err}", file=sys.stderr)
         _emit(
             {
                 "metric": metric,
@@ -419,6 +492,7 @@ def bench_predict() -> None:
                 "detail": {
                     "best_calls_per_sec": round(best_hz, 3),
                     "avg_calls_per_sec": round(avg_hz, 3),
+                    "jit_cem_action_selects_per_sec": round(jit_cem_hz, 3),
                     "cem_samples_per_call": cem_samples,
                     "image_size": list(image_size),
                     "interface": "stablehlo_exported_model",

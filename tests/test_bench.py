@@ -83,3 +83,5 @@ def test_bench_predict_contract():
     assert "error" not in payload
     assert payload["detail"]["cem_samples_per_call"] == 8
     assert payload["detail"]["interface"] == "stablehlo_exported_model"
+    # The jit-native CEM leg really ran (one fused program per selection).
+    assert payload["detail"]["jit_cem_action_selects_per_sec"] > 0
