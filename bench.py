@@ -372,17 +372,22 @@ def bench_predict() -> None:
         from tensor2robot_tpu.specs import make_random_numpy
         from tensor2robot_tpu.train.train_eval import CompiledModel
 
-        model, batch = _flagship(
-            image_size=image_size, batch_size=2, num_convs=num_convs
-        )
-        compiled = CompiledModel(model, donate_state=False)
-        state = compiled.init_state(jax.random.PRNGKey(0), batch)
-        generator = DefaultExportGenerator()
-        generator.set_specification_from_model(compiled.model)
-        variables = state.export_variables()
-        with tempfile.TemporaryDirectory() as root:
+        def export_and_restore(export_root, action_batch_size=None):
+            """One flagship export + restored predictor (the shared recipe
+            for the raw-predict and jit-CEM legs — keep them identical)."""
+            model, batch = _flagship(
+                image_size=image_size,
+                batch_size=2,
+                num_convs=num_convs,
+                action_batch_size=action_batch_size,
+            )
+            compiled = CompiledModel(model, donate_state=False)
+            state = compiled.init_state(jax.random.PRNGKey(0), batch)
+            generator = DefaultExportGenerator()
+            generator.set_specification_from_model(compiled.model)
+            variables = state.export_variables()
             save_exported_model(
-                root,
+                export_root,
                 variables=variables,
                 feature_spec=generator.serving_input_spec(),
                 label_spec=generator.label_spec,
@@ -391,9 +396,13 @@ def bench_predict() -> None:
                 example_features=generator.create_example_features(),
                 serialize_stablehlo=True,
             )
-            predictor = ExportedSavedModelPredictor(export_dir=root)
+            predictor = ExportedSavedModelPredictor(export_dir=export_root)
             if not predictor.restore():
                 raise RuntimeError("predictor restore failed")
+            return predictor, generator
+
+        with tempfile.TemporaryDirectory() as root:
+            predictor, generator = export_and_restore(root)
             features = make_random_numpy(
                 generator.serving_input_spec(), batch_size=cem_samples, seed=0
             )
@@ -417,46 +426,14 @@ def bench_predict() -> None:
             # population baked into the action spec (the tiling contract
             # an on-robot CEM deployment exports with).
             jit_cem_hz = 0.0
+            jit_cem_error = None
             try:
                 from tensor2robot_tpu.policies import JitCEMPolicy
-                from tensor2robot_tpu.research.qtopt.t2r_models import (
-                    Grasping44E2EOpenCloseTerminateGripperStatusHeightToBottom,
-                )
-                from tensor2robot_tpu.train.train_eval import (
-                    maybe_wrap_for_tpu,
-                )
 
-                cem_model = maybe_wrap_for_tpu(
-                    Grasping44E2EOpenCloseTerminateGripperStatusHeightToBottom(
-                        device_type="tpu",
-                        image_size=image_size,
-                        num_convs=num_convs,
-                        action_batch_size=cem_samples,
-                    )
+                cem_predictor, cem_generator = export_and_restore(
+                    os.path.join(root, "cem"),
+                    action_batch_size=cem_samples,
                 )
-                cem_compiled = CompiledModel(cem_model, donate_state=False)
-                cem_state = cem_compiled.init_state(
-                    jax.random.PRNGKey(0), batch
-                )
-                cem_generator = DefaultExportGenerator()
-                cem_generator.set_specification_from_model(cem_model)
-                cem_root = os.path.join(root, "cem")
-                cem_variables = cem_state.export_variables()
-                save_exported_model(
-                    cem_root,
-                    variables=cem_variables,
-                    feature_spec=cem_generator.serving_input_spec(),
-                    global_step=0,
-                    predict_fn=cem_generator.create_serving_fn(
-                        cem_compiled, cem_variables
-                    ),
-                    example_features=cem_generator.create_example_features(),
-                )
-                cem_predictor = ExportedSavedModelPredictor(
-                    export_dir=cem_root
-                )
-                if not cem_predictor.restore():
-                    raise RuntimeError("CEM predictor restore failed")
                 policy = JitCEMPolicy(
                     cem_predictor,
                     action_size=10,
@@ -481,7 +458,9 @@ def bench_predict() -> None:
                 jit_cem_hz, _, _ = _measure_windows(
                     run_select_window, lambda: None, n_windows, window
                 )
-            except Exception as cem_err:  # noqa: BLE001 — optional metric
+            except Exception as cem_err:  # noqa: BLE001 — optional metric;
+                # the error rides in the payload so a 0.0 is self-diagnosing.
+                jit_cem_error = f"{type(cem_err).__name__}: {cem_err}"
                 print(f"bench: jit-CEM path failed: {cem_err}", file=sys.stderr)
         _emit(
             {
@@ -493,6 +472,11 @@ def bench_predict() -> None:
                     "best_calls_per_sec": round(best_hz, 3),
                     "avg_calls_per_sec": round(avg_hz, 3),
                     "jit_cem_action_selects_per_sec": round(jit_cem_hz, 3),
+                    **(
+                        {"jit_cem_error": jit_cem_error}
+                        if jit_cem_error
+                        else {}
+                    ),
                     "cem_samples_per_call": cem_samples,
                     "image_size": list(image_size),
                     "interface": "stablehlo_exported_model",

@@ -309,9 +309,9 @@ class _TwoLeafCritic(CriticModel):
         return spec
 
 
-@pytest.fixture(scope="module")
-def two_leaf_predictor(tmp_path_factory):
-    root = str(tmp_path_factory.mktemp("two_leaf_export"))
+def _export_two_leaf_critic(root: str, quantize: bool = False):
+    """Exports a _TwoLeafCritic and returns a restored predictor (the one
+    recipe the plain fixture and the quantized-composition test share)."""
     model = _TwoLeafCritic(device_type="cpu", action_batch_size=_POP)
     compiled = CompiledModel(model, donate_state=False)
     generator = DefaultExportGenerator()
@@ -326,12 +326,22 @@ def two_leaf_predictor(tmp_path_factory):
         variables=variables,
         feature_spec=generator.serving_input_spec(),
         global_step=1,
-        predict_fn=generator.create_serving_fn(compiled, variables),
+        predict_fn=generator.create_serving_fn(
+            compiled, variables, quantize_weights=quantize
+        ),
         example_features=example,
+        quantize_weights=quantize,
     )
     predictor = ExportedSavedModelPredictor(export_dir=root)
     assert predictor.restore()
     return predictor
+
+
+@pytest.fixture(scope="module")
+def two_leaf_predictor(tmp_path_factory):
+    return _export_two_leaf_critic(
+        str(tmp_path_factory.mktemp("two_leaf_export"))
+    )
 
 
 class TestMultiLeafActionCEM:
@@ -365,6 +375,23 @@ class TestMultiLeafActionCEM:
         )
         self._assert_optimum(policy)
         assert policy._jit_select is not None  # really took the jit path
+
+    def test_jit_engine_over_quantized_export(self, tmp_path):
+        """Composition: the jitted CEM traces through a weights-as-args
+        int8 artifact (the robot-fleet deployment shape: small download,
+        fused selection)."""
+        from tensor2robot_tpu.policies import JitCEMPolicy
+
+        predictor = _export_two_leaf_critic(
+            str(tmp_path / "q_export"), quantize=True
+        )
+        assert predictor.loaded_model.metadata["stablehlo_weights_in_args"]
+        policy = JitCEMPolicy(
+            predictor, action_size=3, cem_samples=_POP,
+            cem_iterations=8, seed=0,
+        )
+        self._assert_optimum(policy)
+        assert policy._jit_select is not None
 
     def test_action_size_mismatch_rejected(self, two_leaf_predictor):
         policy = CEMPolicy(
