@@ -27,10 +27,25 @@ _WORKER = os.path.join(
 def test_two_process_distributed_collectives(tmp_path):
     import socket
 
+    import numpy as np
+
+    from tensor2robot_tpu.data import tfrecord
+    from tensor2robot_tpu.data.encoder import encode_example
+    from tensor2robot_tpu.specs import ExtendedTensorSpec, TensorSpecStruct
+
     with socket.socket() as probe:
         probe.bind(("127.0.0.1", 0))
         port = probe.getsockname()[1]
     coordinator = f"127.0.0.1:{port}"
+
+    # Record shards for the per-host infeed leg (shard_by_host).
+    spec = TensorSpecStruct()
+    spec["y"] = ExtendedTensorSpec(shape=(), dtype=np.int64, name="y")
+    for shard in range(4):
+        tfrecord.write_tfrecords(
+            str(tmp_path / f"s-{shard}.tfrecord"),
+            [encode_example(spec, {"y": np.asarray(shard, np.int64)})],
+        )
 
     env = dict(os.environ)
     # Each worker must see exactly its own single CPU device; scrub the
@@ -40,7 +55,7 @@ def test_two_process_distributed_collectives(tmp_path):
 
     workers = [
         subprocess.Popen(
-            [sys.executable, _WORKER, coordinator, "2", str(pid)],
+            [sys.executable, _WORKER, coordinator, "2", str(pid), str(tmp_path)],
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             text=True,

@@ -4,7 +4,8 @@ Each invocation is one "host": it joins the coordinator, builds the global
 data mesh, contributes its per-process shard, and verifies the cross-host
 collective results. Exits 0 only when every check passes on this process.
 
-Usage: python tools/_mp_worker.py <coordinator> <num_processes> <process_id>
+Usage: python tools/_mp_worker.py <coordinator> <num_processes> \
+    <process_id> [shard_data_dir]
 """
 
 from __future__ import annotations
@@ -25,7 +26,12 @@ import numpy as np  # noqa: E402
 from tensor2robot_tpu.parallel import mesh as mesh_lib  # noqa: E402
 
 
-def main(coordinator: str, num_processes: int, process_id: int) -> None:
+def main(
+    coordinator: str,
+    num_processes: int,
+    process_id: int,
+    data_dir: "str | None" = None,
+) -> None:
     mesh_lib.initialize_distributed(
         coordinator_address=coordinator,
         num_processes=num_processes,
@@ -94,6 +100,33 @@ def main(coordinator: str, num_processes: int, process_id: int) -> None:
         np.asarray([digest], np.float64)
     )
     np.testing.assert_allclose(digests.ravel(), digest, rtol=0, atol=0)
+    # Per-host infeed with REAL processes: shard_by_host slices the file
+    # list by jax.process_index(); the union across hosts must be exactly
+    # the full record set with no overlap.
+    if data_dir:
+        from tensor2robot_tpu.data.dataset import RecordDataset
+        from tensor2robot_tpu.specs import (
+            ExtendedTensorSpec,
+            TensorSpecStruct,
+        )
+
+        spec = TensorSpecStruct()
+        spec["y"] = ExtendedTensorSpec(shape=(), dtype=np.int64, name="y")
+        dataset = RecordDataset(
+            specs=spec,
+            file_patterns=os.path.join(data_dir, "s-*.tfrecord"),
+            batch_size=1,
+            mode="eval",
+            drop_remainder=False,
+            shard_by_host=True,
+        )
+        mine = sorted(int(b["y"][0]) for b in dataset)
+        padded = np.full((8,), -1, np.int64)
+        padded[: len(mine)] = mine
+        all_rows = multihost_utils.process_allgather(padded)
+        union = sorted(int(v) for v in all_rows.ravel() if v >= 0)
+        assert union == [0, 1, 2, 3], union  # complete AND non-overlapping
+
     print(
         f"mp_worker {process_id}: OK (mean={float(mean)}, "
         f"train losses={['%.4f' % l for l in losses]})"
@@ -101,4 +134,9 @@ def main(coordinator: str, num_processes: int, process_id: int) -> None:
 
 
 if __name__ == "__main__":
-    main(sys.argv[1], int(sys.argv[2]), int(sys.argv[3]))
+    main(
+        sys.argv[1],
+        int(sys.argv[2]),
+        int(sys.argv[3]),
+        sys.argv[4] if len(sys.argv) > 4 else None,
+    )
