@@ -150,6 +150,25 @@ def main() -> None:
                "cases": rows})
         return
 
+    # Block-size sweep: Mosaic tiling sweet spots are hardware facts, not
+    # guessable offline; record the landscape so the default (128, 128)
+    # can be tuned from evidence.
+    block_sweep = {}
+    for bq, bk in ((128, 128), (256, 128), (128, 256), (256, 256),
+                   (512, 128)):
+        try:
+            fn = jax.jit(
+                lambda q, k, v, bq=bq, bk=bk: fa.flash_attention(
+                    q, k, v, causal=True, block_q=bq, block_k=bk
+                )
+            )
+            block_sweep[f"{bq}x{bk}"] = round(
+                timed(fn, n_warm=5, n_windows=4) * 1e3, 3
+            )
+        except Exception as err:  # noqa: BLE001 — a block combo exceeding
+            # VMEM is data, not a failure.
+            block_sweep[f"{bq}x{bk}"] = f"{type(err).__name__}"
+
     # Causal attention FLOPs: 4*B*H*S^2*D (QK^T + PV), halved by the mask;
     # bwd re-does QK^T plus four more S^2 matmuls => ~2.5x the fwd.
     fwd_flops = 0.5 * 4.0 * b * h * s * s * d
@@ -166,6 +185,7 @@ def main() -> None:
             "fwd_mfu": round(fwd_flops / t_fwd / peak, 4),
             "fwd_bwd_ms": round(t_fwdbwd * 1e3, 3),
             "fwd_bwd_tflops": round(3.5 * fwd_flops / t_fwdbwd / 1e12, 2),
+            "block_sweep_fwd_ms": block_sweep,
             "timing": "median_of_windows",
         },
         **({"backend_note": note} if note else {}),
