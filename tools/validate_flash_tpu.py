@@ -166,8 +166,11 @@ def main() -> None:
                 timed(fn, n_warm=5, n_windows=4) * 1e3, 3
             )
         except Exception as err:  # noqa: BLE001 — a block combo exceeding
-            # VMEM is data, not a failure.
-            block_sweep[f"{bq}x{bk}"] = f"{type(err).__name__}"
+            # VMEM is data, not a failure; keep enough of the message to
+            # tell a VMEM budget from a tiling constraint.
+            block_sweep[f"{bq}x{bk}"] = (
+                f"{type(err).__name__}: {str(err)[:160]}"
+            )
 
     # Causal attention FLOPs: 4*B*H*S^2*D (QK^T + PV), halved by the mask;
     # bwd re-does QK^T plus four more S^2 matmuls => ~2.5x the fwd.
