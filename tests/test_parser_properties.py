@@ -14,7 +14,6 @@ lengths reported and batch stacking correct.
 import string
 
 import numpy as np
-import pytest
 from hypothesis import given, settings
 from hypothesis import strategies as st
 
