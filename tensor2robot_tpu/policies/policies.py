@@ -175,12 +175,15 @@ class CEMPolicy(Policy):
                 f"keys {sorted(spec.keys())}."
             )
         def leaf_size(key):
-            # PREDICT specs carry the CEM population as the leading dim
-            # (CriticModel tiling contract), so a vector leaf shows as
-            # [population, size] and a SCALAR leaf as [population] — a
-            # rank<2 predict-spec leaf therefore contributes one dim.
+            # The trailing dim is the leaf's action size both with and
+            # without the CEM population dim (tiling prepends it:
+            # [size] -> [population, size]). The one ambiguous layout —
+            # a SCALAR action leaf exported WITH a population (shape
+            # [population]) — cannot be told apart from a vector leaf;
+            # it has no in-repo producer and surfaces as the explicit
+            # size-sum mismatch below rather than silent misbehavior.
             shape = tuple(spec[key].shape)
-            return int(shape[-1]) if len(shape) >= 2 else 1
+            return int(shape[-1]) if shape else 1
 
         resolved = [(key, leaf_size(key)) for key in leaves]
         total = sum(size for _, size in resolved)
