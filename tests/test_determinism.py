@@ -1,0 +1,78 @@
+"""Cross-process training determinism: same seed, bit-identical weights.
+
+Stronger than the golden-value gates (decimal=5 tolerance, one process):
+two INDEPENDENT OS processes train the same model/seed and must produce
+byte-identical final parameters. Catches hidden nondeterminism —
+unseeded rngs, iteration-order dependence, time-based branching — that
+tolerance-based checks absorb. (Same-platform only by design: the
+fixture regeneration caveat for cross-platform drift is documented on
+the golden tools.)
+"""
+
+import hashlib
+import os
+import subprocess
+import sys
+
+import pytest
+
+_TRAINER = r"""
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+model_dir = sys.argv[1]
+import hashlib
+import numpy as np
+from tensor2robot_tpu.train.train_eval import train_eval_model
+from tensor2robot_tpu.utils.mocks import MockInputGenerator, MockT2RModel
+from tensor2robot_tpu.train import train_eval as te
+
+train_eval_model(
+    MockT2RModel(device_type="cpu"),
+    input_generator_train=MockInputGenerator(batch_size=4, seed=11),
+    model_dir=model_dir,
+    max_train_steps=25,
+    eval_steps=None,
+    save_checkpoints_steps=25,
+    seed=123,
+)
+# Hash the final checkpoint's param bytes deterministically.
+from tensor2robot_tpu.train.train_eval import CompiledModel
+
+model = MockT2RModel(device_type="cpu")
+gen = MockInputGenerator(batch_size=4, seed=11)
+gen.set_specification_from_model(model, "train")
+compiled = CompiledModel(model, donate_state=False)
+manager = te.create_checkpoint_manager(model_dir, save_interval_steps=25)
+restored = te.restore_or_init_state(manager, compiled, jax.random.PRNGKey(0),
+                                    next(iter(gen.create_dataset("train"))))
+digest = hashlib.sha256()
+for leaf in jax.tree_util.tree_leaves(jax.device_get(restored.params)):
+    digest.update(np.ascontiguousarray(leaf).tobytes())
+print("PARAM_SHA256", digest.hexdigest(), "STEP", int(restored.step), flush=True)
+"""
+
+
+@pytest.mark.slow
+def test_same_seed_trains_bit_identically_across_processes(tmp_path):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    cwd = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    digests = []
+    for run in range(2):
+        proc = subprocess.run(
+            [sys.executable, "-c", _TRAINER, str(tmp_path / f"run{run}")],
+            capture_output=True,
+            text=True,
+            timeout=420,
+            env=env,
+            cwd=cwd,
+        )
+        assert proc.returncode == 0, proc.stdout[-1500:] + proc.stderr[-1500:]
+        line = [
+            l for l in proc.stdout.splitlines() if l.startswith("PARAM_SHA256")
+        ]
+        assert line, proc.stdout[-1500:]
+        digests.append(line[0])
+    assert digests[0] == digests[1], digests
+    assert "STEP 25" in digests[0]
