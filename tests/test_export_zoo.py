@@ -95,11 +95,11 @@ MODEL_FACTORIES = {
 }
 
 
-@pytest.mark.parametrize("name", sorted(MODEL_FACTORIES))
-def test_zoo_stablehlo_export_is_hard_guarantee(name, tmp_path):
+def _trained_export_parts(name):
+    """(compiled, generator, variables) for one zoo model — the shared
+    setup of the export-guarantee tests."""
     model = maybe_wrap_for_tpu(MODEL_FACTORIES[name]())
     compiled = CompiledModel(model, donate_state=False)
-
     train_features = make_random_numpy(
         model.preprocessor.get_in_feature_specification("train"),
         batch_size=2,
@@ -114,10 +114,14 @@ def test_zoo_stablehlo_export_is_hard_guarantee(name, tmp_path):
         jax.random.PRNGKey(0),
         {"features": train_features, "labels": train_labels},
     )
-
     generator = DefaultExportGenerator()
     generator.set_specification_from_model(model)
-    variables = state.export_variables()
+    return compiled, generator, state.export_variables()
+
+
+@pytest.mark.parametrize("name", sorted(MODEL_FACTORIES))
+def test_zoo_stablehlo_export_is_hard_guarantee(name, tmp_path):
+    compiled, generator, variables = _trained_export_parts(name)
     serving_fn = generator.create_serving_fn(compiled, variables)
     example_features = generator.create_example_features()
 
@@ -157,4 +161,48 @@ def test_zoo_stablehlo_export_is_hard_guarantee(name, tmp_path):
             rtol=1e-4,
             atol=1e-5,
             err_msg=f"{name}:{key}",
+        )
+
+
+def test_flagship_quantized_export_same_guarantee(tmp_path):
+    """The int8 weights-as-args format holds the zoo guarantee on the
+    flagship too: StableHLO present, serve within weight-rounding error
+    of the f32 path."""
+    compiled, generator, variables = _trained_export_parts("qtopt")
+    serving_fn_f32 = generator.create_serving_fn(compiled, variables)
+    serving_fn_q = generator.create_serving_fn(
+        compiled, variables, quantize_weights=True
+    )
+    path = save_exported_model(
+        str(tmp_path / "export_q"),
+        variables=variables,
+        feature_spec=generator.serving_input_spec(),
+        global_step=0,
+        predict_fn=serving_fn_q,
+        example_features=generator.create_example_features(),
+        quantize_weights=True,
+    )
+    exported = ExportedModel(path)
+    assert exported.metadata["stablehlo"] is True, exported.metadata.get(
+        "stablehlo_error"
+    )
+    assert exported.metadata["stablehlo_weights_in_args"] is True
+    request = dict(
+        make_random_numpy(
+            generator.serving_input_spec(), batch_size=2, seed=7
+        ).items()
+    )
+    served = exported.predict(request)
+    direct = {
+        key: np.asarray(value)
+        for key, value in serving_fn_f32(request).items()
+    }
+    assert sorted(served) == sorted(direct)
+    for key in direct:
+        np.testing.assert_allclose(
+            np.asarray(served[key], np.float32),
+            np.asarray(direct[key], np.float32),
+            rtol=0.05,
+            atol=0.05,
+            err_msg=key,
         )
