@@ -8,7 +8,10 @@ callables (export/saved_model.py ExportedModel), the objective can be
 TRACED — sampling, scoring, elite refit, and the iteration loop fuse into
 one jitted program with a single dispatch per action selection
 (policies.JitCEMPolicy). Same proposal family and elite-refit math as the
-numpy engine; keep them in sync.
+numpy engine; keep them in sync. (One deliberate difference: the numpy
+engine's early_termination_stddev has no analogue here — a fixed
+iteration count keeps the program static, and at one dispatch per
+selection there is no per-iteration round-trip to save.)
 """
 
 from __future__ import annotations
