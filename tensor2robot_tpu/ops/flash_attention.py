@@ -34,6 +34,18 @@ from jax.experimental import pallas as pl
 
 _NEG_INF = -1e30
 
+# Row statistics (l, m, lse, delta) cross the pallas_call boundary stored
+# with a trailing broadcast dim of _STATS_LANES so their blocks satisfy
+# Mosaic's (8, 128) tile constraint; a [block_q]-shaped block would need a
+# sublane dim divisible by 8, which a per-row vector cannot provide. This
+# mirrors the upstream jax.experimental.pallas TPU flash kernel's own l/m
+# layout. It costs 128x HBM on the stat tensors (still O(S) vs the O(S^2)
+# logits the kernel avoids); a [bh, 1, s_q] stats-in-lanes layout would be
+# 128x slimmer but constrains partial q-blocks to multiples of 128 and
+# needs an in-kernel sublane->lane transpose — worth exploring only after
+# this layout is validated on hardware.
+_STATS_LANES = 128
+
 
 def reference_attention(
     q: jax.Array,
@@ -91,14 +103,16 @@ def _flash_body(offsets_ref, q_ref, k_ref, v_ref, block_k, scale, causal):
                 + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
             )
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        m_blk = jnp.max(s, axis=-1)
+        # Row stats stay [block_q, 1] (keepdims) — 2D shapes lower cleanly
+        # on Mosaic where 1D per-row vectors may not.
+        m_blk = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_acc, m_blk)
         alpha = jnp.exp(m_acc - m_new)
-        p = jnp.exp(s - m_new[:, None])
+        p = jnp.exp(s - m_new)
         # Fully-masked tiles contribute nothing (not exp(0)=1 garbage).
-        p = jnp.where((m_new == _NEG_INF)[:, None], 0.0, p)
-        l_new = l_acc * alpha + jnp.sum(p, axis=-1)
-        o_new = o_acc * alpha[:, None] + jax.lax.dot_general(
+        p = jnp.where(m_new == _NEG_INF, 0.0, p)
+        l_new = l_acc * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        o_new = o_acc * alpha + jax.lax.dot_general(
             p,
             v_blk,
             dimension_numbers=(((1,), (0,)), ((), ())),
@@ -107,8 +121,8 @@ def _flash_body(offsets_ref, q_ref, k_ref, v_ref, block_k, scale, causal):
         return o_new, l_new, m_new
 
     o_acc = jnp.zeros((block_q, dim), jnp.float32)
-    l_acc = jnp.zeros((block_q,), jnp.float32)
-    m_acc = jnp.full((block_q,), _NEG_INF, jnp.float32)
+    l_acc = jnp.zeros((block_q, 1), jnp.float32)
+    m_acc = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
     return lax.fori_loop(0, num_kb, body, (o_acc, l_acc, m_acc))
 
 
@@ -127,7 +141,7 @@ def _flash_kernel(
         offsets_ref, q_ref, k_ref, v_ref, block_k, scale, causal
     )
     l_acc = jnp.maximum(l_acc, 1e-30)
-    o_ref[0] = (o_acc / l_acc[:, None]).astype(o_ref.dtype)
+    o_ref[0] = (o_acc / l_acc).astype(o_ref.dtype)
 
 
 def _flash_tile_kernel(
@@ -135,13 +149,14 @@ def _flash_tile_kernel(
 ):
     """Like _flash_kernel but emits the UNNORMALIZED accumulator triple
     (o_partial, row_sum, row_max) — the online-softmax residuals a ring hop
-    merges across devices (parallel/ring_attention.py)."""
+    merges across devices (parallel/ring_attention.py). l/m blocks are
+    [1, block_q, _STATS_LANES] with the stat broadcast along the lane dim."""
     o_acc, l_acc, m_acc = _flash_body(
         offsets_ref, q_ref, k_ref, v_ref, block_k, scale, causal
     )
     o_ref[0] = o_acc
-    l_ref[0] = l_acc
-    m_ref[0] = m_acc
+    l_ref[0] = jnp.broadcast_to(l_acc, l_ref.shape[1:])
+    m_ref[0] = jnp.broadcast_to(m_acc, m_ref.shape[1:])
 
 
 def flash_attention_tile(
@@ -203,8 +218,8 @@ def flash_attention_tile(
         ),
         out_shape=(
             out_struct((bh, s_q, dim)),
-            out_struct((bh, s_q)),
-            out_struct((bh, s_q)),
+            out_struct((bh, s_q, _STATS_LANES)),
+            out_struct((bh, s_q, _STATS_LANES)),
         ),
         grid=(bh, s_q // bq),
         in_specs=[
@@ -215,26 +230,30 @@ def flash_attention_tile(
         ],
         out_specs=(
             pl.BlockSpec((1, bq, dim), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, i: (b, i)),
-            pl.BlockSpec((1, bq), lambda b, i: (b, i)),
+            pl.BlockSpec((1, bq, _STATS_LANES), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, _STATS_LANES), lambda b, i: (b, i, 0)),
         ),
         interpret=interpret,
     )(offsets, fold(q), fold(k), fold(v))
     o = jnp.transpose(o.reshape(batch, heads, s_q, dim), (0, 2, 1, 3))
-    return o, l.reshape(batch, heads, s_q), m.reshape(batch, heads, s_q)
+    l = l[..., 0].reshape(batch, heads, s_q)
+    m = m[..., 0].reshape(batch, heads, s_q)
+    return o, l, m
 
 
 def _pick_block(size: int, preferred: int) -> Optional[int]:
     """Usable kernel block size for a sequence dim: the whole dim when it
     fits one block, else the largest divisor <= preferred that is still
-    MXU/VPU-viable (>= 8 rows). None -> no viable blocking (prime-ish
-    lengths); callers fall back to the einsum reference rather than run a
-    degenerate (1, D)-block grid."""
+    MXU/VPU-viable. A partial block must be a multiple of 8 (Mosaic's
+    sublane tile — checked at lowering on real TPU, not by the CPU
+    interpreter); the full dim is always legal regardless of size. None ->
+    no viable blocking (prime-ish lengths); callers fall back to the
+    einsum reference rather than run a degenerate (1, D)-block grid."""
     if size <= 0:
         return None
     if size <= preferred:
         return size
-    for block in range(preferred, 7, -1):
+    for block in range(preferred - preferred % 8, 7, -8):
         if size % block == 0:
             return block
     return None
@@ -281,14 +300,15 @@ def _bwd_tile(q_scaled, k_blk, v_blk, do_blk, lse, delta, q_pos, k_pos,
     q_scaled must already carry the softmax scale (s = q_scaled @ k^T), so
     ds @ k (for dQ) and ds^T @ q_scaled (for dK) each carry exactly one
     factor of scale — dQ multiplies its own factor afterwards.
-    Returns (p, ds), both [block_q, block_k] f32.
+    lse/delta are [block_q, 1] columns. Returns (p, ds), both
+    [block_q, block_k] f32.
     """
     s = jax.lax.dot_general(
         q_scaled, k_blk,
         dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
-    p = jnp.exp(s - lse[:, None])
+    p = jnp.exp(s - lse)
     if causal:
         p = jnp.where(q_pos >= k_pos, p, 0.0)
     dp = jax.lax.dot_general(
@@ -296,7 +316,7 @@ def _bwd_tile(q_scaled, k_blk, v_blk, do_blk, lse, delta, q_pos, k_pos,
         dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
-    ds = p * (dp - delta[:, None])
+    ds = p * (dp - delta)
     return p, ds
 
 
@@ -306,8 +326,8 @@ def _flash_bwd_dq_kernel(
     k_ref,  # VMEM [1, S_k, D]
     v_ref,  # VMEM [1, S_k, D]
     do_ref,  # VMEM [1, block_q, D]
-    lse_ref,  # VMEM [1, block_q]  L = m + log(l)
-    delta_ref,  # VMEM [1, block_q]  D = rowsum(dO * O)
+    lse_ref,  # VMEM [1, block_q, _STATS_LANES]  L = m + log(l), lane-bcast
+    delta_ref,  # VMEM [1, block_q, _STATS_LANES]  D = rowsum(dO*O), bcast
     dq_ref,  # VMEM [1, block_q, D]
     *,
     block_k: int,
@@ -324,8 +344,8 @@ def _flash_bwd_dq_kernel(
 
     q = q_ref[0].astype(jnp.float32) * scale
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0]
-    delta = delta_ref[0]
+    lse = lse_ref[0][:, 0:1]
+    delta = delta_ref[0][:, 0:1]
     q_pos = (
         offsets_ref[0]
         + qi * block_q
@@ -358,8 +378,8 @@ def _flash_bwd_dkv_kernel(
     k_ref,  # VMEM [1, block_k, D]
     v_ref,  # VMEM [1, block_k, D]
     do_ref,  # VMEM [1, S_q, D]
-    lse_ref,  # VMEM [1, S_q]
-    delta_ref,  # VMEM [1, S_q]
+    lse_ref,  # VMEM [1, S_q, _STATS_LANES]
+    delta_ref,  # VMEM [1, S_q, _STATS_LANES]
     dk_ref,  # VMEM [1, block_k, D]
     dv_ref,  # VMEM [1, block_k, D]
     *,
@@ -390,8 +410,8 @@ def _flash_bwd_dkv_kernel(
             * scale
         )
         do_blk = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(i * block_q, block_q)]
-        delta = delta_ref[0, pl.ds(i * block_q, block_q)]
+        lse = lse_ref[0, pl.ds(i * block_q, block_q), :][:, 0:1]
+        delta = delta_ref[0, pl.ds(i * block_q, block_q), :][:, 0:1]
         q_pos = (
             offsets_ref[0]
             + i * block_q
@@ -492,8 +512,13 @@ def flash_attention_bwd_tile(
         return jax.ShapeDtypeStruct(shape, dtype)
 
     qf, kf, vf, dof = fold(q), fold(k), fold(v), fold(do)
-    lsef = lse.reshape(bh, s_q)
-    deltaf = delta.reshape(bh, s_q)
+    # Row stats enter the kernels lane-broadcast (see _STATS_LANES).
+    lsef = jnp.broadcast_to(
+        lse.reshape(bh, s_q)[..., None], (bh, s_q, _STATS_LANES)
+    )
+    deltaf = jnp.broadcast_to(
+        delta.reshape(bh, s_q)[..., None], (bh, s_q, _STATS_LANES)
+    )
 
     dq = pl.pallas_call(
         functools.partial(
@@ -507,8 +532,8 @@ def flash_attention_bwd_tile(
             pl.BlockSpec((1, s_k, dim), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, s_k, dim), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, bq, dim), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, i: (b, i)),
-            pl.BlockSpec((1, bq), lambda b, i: (b, i)),
+            pl.BlockSpec((1, bq, _STATS_LANES), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, _STATS_LANES), lambda b, i: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, bq, dim), lambda b, i: (b, i, 0)),
         interpret=interpret,
@@ -529,8 +554,8 @@ def flash_attention_bwd_tile(
             pl.BlockSpec((1, bk, dim), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, bk, dim), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, s_q, dim), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, s_q), lambda b, j: (b, 0)),
-            pl.BlockSpec((1, s_q), lambda b, j: (b, 0)),
+            pl.BlockSpec((1, s_q, _STATS_LANES), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, s_q, _STATS_LANES), lambda b, j: (b, 0, 0)),
         ],
         out_specs=(
             pl.BlockSpec((1, bk, dim), lambda b, j: (b, j, 0)),
