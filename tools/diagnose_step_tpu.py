@@ -1,0 +1,202 @@
+"""On-chip bisection of the flagship train step: which part is slow?
+
+The round-3 headline measurement (BENCH_r03_early.json) put the QT-Opt
+critic train step at 740 ms on the real chip — 1.1% MFU against a
+demonstrated 41%-of-peak matmul ceiling on the same device. The step's
+FLOPs are dominated by healthy MXU shapes (64-channel 5x5 convs at 79x79),
+so the slowdown must be structural; this tool isolates it by timing, in one
+serialized chip session:
+
+  1. dominant conv block alone (fwd / fwd+bwd)      — is the op class slow?
+  2. first conv (3->64 @ 472px, stride 2) alone      — thin-channel entry?
+  3. image tower forward alone                       — tower vs heads?
+  4. full model forward (inference_network_fn)       — fwd vs bwd split?
+  5. full train step (the bench's measurement)       — reproduces headline
+  6. a reference 8192^3 bf16 matmul                  — re-pins the ceiling
+
+Each timing uses the bench's readback-anchored median-of-windows method.
+Emits one JSON document (commit as DIAG_STEP_r{N}.json). Run ONLY through
+tools/chip_worker.sh (chip access is serialized there).
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+
+def main() -> None:
+    import bench
+
+    try:
+        devices, note = bench._init_devices(max_wait=bench._backend_wait())
+    except Exception as err:  # noqa: BLE001
+        print(json.dumps({"metric": "train_step_diagnosis", "ok": False,
+                          "error": f"backend_init: {err}"}))
+        return
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    device = devices[0]
+    if device.platform != "tpu":
+        print(json.dumps({"metric": "train_step_diagnosis", "ok": False,
+                          "error": f"tpu_unavailable: {note or device.platform}"}))
+        return
+
+    peak = bench._peak_flops(device)
+    out = {"metric": "train_step_diagnosis", "ok": True,
+           "device_kind": getattr(device, "device_kind", "?"),
+           "peak_flops": peak, "cases": {}}
+
+    def timed(fn, args, n_warm=6, n_windows=6, calls=2):
+        """Median seconds per call, readback-anchored (bench method)."""
+        box = {}
+
+        def once():
+            box["out"] = fn(*args)
+
+        once()
+        for _ in range(n_warm):
+            once()
+        jax.tree_util.tree_map(
+            lambda x: np.asarray(jax.device_get(jnp.ravel(x)[0])), box["out"]
+        )
+        times = []
+        for _ in range(n_windows):
+            t0 = time.perf_counter()
+            for _ in range(calls):
+                once()
+            jax.tree_util.tree_map(
+                lambda x: np.asarray(jax.device_get(jnp.ravel(x)[0])),
+                box["out"],
+            )
+            times.append((time.perf_counter() - t0) / calls)
+        return statistics.median(times)
+
+    def record(name, seconds, flops=None, extra=None):
+        row = {"ms": round(seconds * 1e3, 3)}
+        if flops:
+            row["tflops"] = round(flops / seconds / 1e12, 2)
+            row["pct_peak"] = round(100.0 * flops / seconds / peak, 2)
+        if extra:
+            row.update(extra)
+        out["cases"][name] = row
+        print(f"diag: {name}: {row}", file=sys.stderr)
+
+    B = 64
+    key = jax.random.PRNGKey(0)
+
+    # --- 6. matmul ceiling first (cheap, re-pins the reference point) ---
+    n = 8192
+    a = jax.random.normal(key, (n, n), jnp.bfloat16)
+    b = jax.random.normal(key, (n, n), jnp.bfloat16)
+    mm = jax.jit(lambda a, b: a @ b)
+    t = timed(mm, (a, b))
+    record("matmul_8192_bf16", t, flops=2.0 * n**3)
+
+    # --- 1. dominant conv block: 5x5 64->64 @ 79x79, batch 64 ---
+    import flax.linen as nn
+
+    class Block(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            for _ in range(6):
+                x = nn.Conv(64, (5, 5), padding="SAME", use_bias=False,
+                            dtype=jnp.bfloat16)(x)
+                x = nn.relu(x)
+            return x
+
+    x79 = jax.random.normal(key, (B, 79, 79, 64), jnp.bfloat16)
+    blk = Block()
+    pb = blk.init(key, x79)
+    blk_fwd = jax.jit(lambda p, x: blk.apply(p, x))
+    flops_blk = 6 * 2.0 * B * 79 * 79 * (5 * 5 * 64) * 64
+    t = timed(blk_fwd, (pb, x79))
+    record("conv5x5_block6_fwd", t, flops=flops_blk)
+
+    def blk_loss(p, x):
+        return jnp.sum(blk.apply(p, x).astype(jnp.float32))
+
+    blk_bwd = jax.jit(jax.grad(blk_loss))
+    t = timed(blk_bwd, (pb, x79))
+    record("conv5x5_block6_fwd_bwd", t, flops=3.0 * flops_blk)
+
+    # --- same block WITH BatchNorm (the real tower's composition) ---
+    class BlockBN(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            for _ in range(6):
+                x = nn.Conv(64, (5, 5), padding="SAME", use_bias=False,
+                            dtype=jnp.bfloat16)(x)
+                x = nn.BatchNorm(use_running_average=False,
+                                 momentum=0.997)(x)
+                x = nn.relu(x).astype(jnp.bfloat16)
+            return x
+
+    bnblk = BlockBN()
+    pbn = bnblk.init(key, x79)
+
+    def bn_loss(p, x):
+        y, _ = bnblk.apply(p, x, mutable=["batch_stats"])
+        return jnp.sum(y.astype(jnp.float32))
+
+    t = timed(jax.jit(jax.grad(bn_loss)), (pbn, x79))
+    record("conv5x5_block6_bn_fwd_bwd", t, flops=3.0 * flops_blk)
+
+    # --- 2. entry conv: 6x6x3->64 /2 @ 472px ---
+    class Entry(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Conv(64, (6, 6), strides=(2, 2), padding="SAME",
+                           use_bias=False, dtype=jnp.bfloat16)(x)
+
+    x472 = jax.random.normal(key, (B, 472, 472, 3), jnp.bfloat16)
+    ent = Entry()
+    pe = ent.init(key, x472)
+    flops_ent = 2.0 * B * 236 * 236 * (6 * 6 * 3) * 64
+    t = timed(jax.jit(lambda p, x: ent.apply(p, x)), (pe, x472))
+    record("entry_conv_472_fwd", t, flops=flops_ent)
+
+    def ent_loss(p, x):
+        return jnp.sum(ent.apply(p, x).astype(jnp.float32))
+
+    t = timed(jax.jit(jax.grad(ent_loss)), (pe, x472))
+    record("entry_conv_472_fwd_bwd", t, flops=3.0 * flops_ent)
+
+    # --- 3/4/5. the real model: tower fwd, full fwd, full train step ---
+    from __graft_entry__ import _flagship
+    from tensor2robot_tpu.train.train_eval import CompiledModel
+
+    model, batch = _flagship(image_size=(472, 472), batch_size=B,
+                             num_convs=(6, 6, 3))
+    compiled = CompiledModel(model, donate_state=False)
+    state = compiled.init_state(jax.random.PRNGKey(0), batch)
+    sharded = compiled.shard_batch(batch)
+    rng = jax.random.PRNGKey(1)
+
+    try:
+        # Full forward + loss, no grads (already jit with static use_ema).
+        t = timed(lambda s, b: compiled.eval_step(s, b, False),
+                  (state, sharded))
+        record("model_fwd_eval_step", t)
+    except Exception as err:  # noqa: BLE001
+        out["cases"]["model_fwd_eval_step"] = {"error": str(err)[:200]}
+
+    t = timed(compiled.train_step, (state, sharded, rng))
+    try:
+        cost = compiled.train_step.lower(state, sharded, rng).compile()
+        step_flops = float(cost.cost_analysis()["flops"])
+    except Exception:  # noqa: BLE001
+        step_flops = None
+    record("full_train_step", t, flops=step_flops)
+
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

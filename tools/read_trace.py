@@ -1,0 +1,96 @@
+"""Summarize a jax.profiler trace directory: top device ops by self time.
+
+Offline companion to the bench's BENCH_PROFILE_DIR capture — answers "where
+did the step time go" without TensorBoard (not in this image). Parses the
+.xplane.pb via jax.profiler.ProfileData (no tf dependency).
+
+Usage: python tools/read_trace.py <trace_dir> [top_n]
+The trace dir is what was passed as BENCH_PROFILE_DIR (the tool finds the
+plugins/profile/**/.xplane.pb underneath). Prints a JSON document:
+{"planes": [...], "top_ops": [{"name", "total_ms", "count"}...],
+ "total_device_ms": N} restricted to the TPU device plane when present.
+"""
+
+from __future__ import annotations
+
+import collections
+import glob
+import json
+import os
+import sys
+
+
+def find_xplanes(root: str) -> list[str]:
+    return sorted(
+        glob.glob(os.path.join(root, "**", "*.xplane.pb"), recursive=True)
+    )
+
+
+def summarize(path: str, top_n: int = 30) -> dict:
+    from jax.profiler import ProfileData
+
+    data = ProfileData.from_file(path)
+    planes = []
+    device_best = None  # preferred: a TPU/device-named plane
+    any_best = None  # fallback: busiest non-metadata plane (CPU runs)
+    for plane in data.planes:
+        planes.append(plane.name)
+        if plane.name in ("/host:metadata", "Task Environment"):
+            continue
+        per_op = collections.Counter()
+        counts = collections.Counter()
+        total_ns = 0
+        for line in plane.lines:
+            # XLA op lines carry one event per executed op instance.
+            for ev in line.events:
+                dur = ev.duration_ns
+                name = ev.name
+                per_op[name] += dur
+                counts[name] += 1
+                total_ns += dur
+        if not per_op:
+            continue
+        cand = {
+            "plane": plane.name,
+            "per_op": per_op,
+            "counts": counts,
+            "total_ns": total_ns,
+        }
+        is_device = "TPU" in plane.name or "/device:" in plane.name
+        if is_device and (device_best is None
+                          or total_ns > device_best["total_ns"]):
+            device_best = cand
+        if any_best is None or total_ns > any_best["total_ns"]:
+            any_best = cand
+    best = device_best or any_best
+    if best is None:
+        return {"planes": planes, "error": "no plane with events"}
+    top = [
+        {
+            "name": name[:160],
+            "total_ms": round(ns / 1e6, 3),
+            "count": best["counts"][name],
+        }
+        for name, ns in best["per_op"].most_common(top_n)
+    ]
+    return {
+        "planes": planes,
+        "device_plane": best["plane"],
+        "total_device_ms": round(best["total_ns"] / 1e6, 3),
+        "top_ops": top,
+    }
+
+
+def main() -> None:
+    root = sys.argv[1]
+    top_n = int(sys.argv[2]) if len(sys.argv) > 2 else 30
+    xplanes = find_xplanes(root)
+    if not xplanes:
+        print(json.dumps({"error": f"no .xplane.pb under {root}"}))
+        return
+    # The latest capture (bench writes one session).
+    print(json.dumps(summarize(xplanes[-1], top_n), indent=1))
+
+
+if __name__ == "__main__":
+    main()
