@@ -126,6 +126,42 @@ def main() -> None:
     t = timed(blk_bwd, (pb, x79))
     record("conv5x5_block6_fwd_bwd", t, flops=3.0 * flops_blk)
 
+    # --- controls: is the slowness specific to dtype or kernel size? ---
+    # f32 twin of the dominant block: if f32 is ~as fast (or faster), the
+    # bf16 conv lowering on this backend is broken, not convs in general.
+    class BlockF32(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            for _ in range(6):
+                x = nn.Conv(64, (5, 5), padding="SAME",
+                            use_bias=False)(x)
+                x = nn.relu(x)
+            return x
+
+    blk32 = BlockF32()
+    x79_32 = x79.astype(jnp.float32)
+    pb32 = blk32.init(key, x79_32)
+    t = timed(jax.jit(lambda p, x: blk32.apply(p, x)), (pb32, x79_32))
+    record("conv5x5_block6_f32_fwd", t, flops=flops_blk)
+
+    # 1x1-conv block (a pure matmul in conv clothing) at the same tensor
+    # shapes: fast 1x1 + slow 5x5 => spatial conv lowering is the problem;
+    # both slow => the conv op class (or this backend's conv path) is.
+    class Block1x1(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            for _ in range(6):
+                x = nn.Conv(64, (1, 1), padding="SAME", use_bias=False,
+                            dtype=jnp.bfloat16)(x)
+                x = nn.relu(x)
+            return x
+
+    blk1 = Block1x1()
+    pb1 = blk1.init(key, x79)
+    flops_1x1 = 6 * 2.0 * B * 79 * 79 * 64 * 64
+    t = timed(jax.jit(lambda p, x: blk1.apply(p, x)), (pb1, x79))
+    record("conv1x1_block6_fwd", t, flops=flops_1x1)
+
     # --- same block WITH BatchNorm (the real tower's composition) ---
     class BlockBN(nn.Module):
         @nn.compact
