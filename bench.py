@@ -493,6 +493,144 @@ def bench_predict() -> None:
         _fail("bench_predict", err, metric=metric)
 
 
+def _analytic_bc_train_flops(
+    batch, steps, image, d_model, num_layers, num_heads, head_dim,
+    pose=14, action=7, mlp_ratio=4,
+) -> float:
+    """One transformer-BC train step (fwd x3): conv embed + causal
+    attention + MLP MACs x2. Analytic because the flash path's Pallas
+    FLOPs are invisible to XLA cost analysis."""
+    bt = float(batch * steps)
+    h = image // 2
+    flops = 2.0 * bt * h * h * 9 * 3 * 32  # conv1 3->32 /2
+    h = h // 2
+    flops += 2.0 * bt * h * h * 9 * 32 * 64  # conv2 32->64 /2
+    flops += 2.0 * bt * (2 * 64 + pose) * d_model  # embed dense
+    per_layer = (8.0 + 2.0 * mlp_ratio * 2.0) * bt * d_model * d_model
+    attn = 2.0 * batch * steps * steps * (num_heads * head_dim)  # causal half
+    flops += num_layers * (per_layer + attn)
+    flops += 2.0 * bt * d_model * action
+    return flops * 3.0
+
+
+def bench_bc() -> None:
+    """Long-context transformer BC train-step MFU — the attention family's
+    headline (the flash kernels' model-level number, vs the conv critic's
+    qtopt metric). TPU: batch 8 x 1024-step episodes, d_model 256; CPU
+    proxy: tiny shapes under a distinct metric name."""
+    try:
+        devices, backend_note = _init_devices(
+            max_wait=_backend_wait(metric="transformer_bc_train_mfu")
+        )
+    except Exception as err:  # noqa: BLE001
+        _fail("backend_init", err, metric="transformer_bc_train_mfu")
+
+    import jax
+
+    _enable_compilation_cache()
+    device = devices[0]
+    on_tpu = device.platform == "tpu"
+    if on_tpu:
+        batch, steps, image = 8, 1024, 64
+        d_model, num_layers, num_heads, head_dim = 256, 4, 8, 32
+        n_windows, window = 8, 10
+        metric = f"transformer_bc_train_mfu_b{batch}_t{steps}"
+    else:
+        batch, steps, image = 2, 64, 16
+        d_model, num_layers, num_heads, head_dim = 32, 2, 2, 16
+        n_windows, window = 3, 3
+        metric = "transformer_bc_train_mfu_cpu_proxy"
+
+    try:
+        from tensor2robot_tpu.models.transformer_models import (
+            TransformerBCModel,
+        )
+        from tensor2robot_tpu.specs import make_random_numpy
+        from tensor2robot_tpu.train.train_eval import CompiledModel
+
+        model = TransformerBCModel(
+            pose_size=14,
+            episode_length=steps,
+            image_size=(image, image),
+            d_model=d_model,
+            num_layers=num_layers,
+            num_heads=num_heads,
+            head_dim=head_dim,
+        )
+        batch_np = {
+            "features": make_random_numpy(
+                model.get_feature_specification("train"), batch_size=batch
+            ),
+            "labels": make_random_numpy(
+                model.get_label_specification("train"), batch_size=batch
+            ),
+        }
+        compiled = CompiledModel(model, donate_state=True)
+        state = compiled.init_state(jax.random.PRNGKey(0), batch_np)
+        sharded = compiled.shard_batch(batch_np)
+        rng = jax.random.PRNGKey(1)
+
+        flops_per_step = _analytic_bc_train_flops(
+            batch, steps, image, d_model, num_layers, num_heads, head_dim
+        )
+
+        box = {"state": state}
+
+        def run_window():
+            for _ in range(window):
+                box["state"], box["metrics"] = compiled.train_step(
+                    box["state"], sharded, rng
+                )
+
+        def sync():
+            if "metrics" in box:
+                float(jax.device_get(box["metrics"]["loss"]))
+
+        run_window()  # compile + warm-in, untimed
+        steps_per_sec, best_steps_per_sec, avg_steps_per_sec = (
+            _measure_windows(run_window, sync, n_windows, window)
+        )
+
+        peak = _peak_flops(device)
+        mfu = flops_per_step * steps_per_sec / peak
+        if mfu > 1.0:
+            raise RuntimeError(
+                f"implied MFU {mfu:.2f} exceeds 1.0 — timing did not "
+                "capture execution (readback anchoring failed?)"
+            )
+        _emit(
+            {
+                "metric": metric,
+                "value": round(mfu, 4),
+                "unit": "fraction_of_peak",
+                "vs_baseline": round(mfu / 0.5, 4),
+                "detail": {
+                    "steps_per_sec": round(steps_per_sec, 3),
+                    "best_steps_per_sec": round(best_steps_per_sec, 3),
+                    "avg_steps_per_sec": round(avg_steps_per_sec, 3),
+                    "timing": "median_of_windows",
+                    "flops_per_step": flops_per_step,
+                    "flops_source": "analytic_transformer",
+                    "device_kind": getattr(device, "device_kind", "?"),
+                    "peak_flops": peak,
+                    "shape": {
+                        "batch": batch, "steps": steps, "image": image,
+                        "d_model": d_model, "num_layers": num_layers,
+                        "num_heads": num_heads, "head_dim": head_dim,
+                    },
+                    "attention": "flash (pallas) on tpu; reference off-tpu",
+                    **(
+                        {"backend_note": backend_note}
+                        if backend_note
+                        else {}
+                    ),
+                },
+            }
+        )
+    except Exception as err:  # noqa: BLE001
+        _fail("bc_bench", err, metric=metric)
+
+
 def _backend_wait(metric: str = "qtopt_critic_train_mfu_bs64_472px") -> float:
     """BENCH_BACKEND_WAIT, with malformed values reported through the
     one-JSON-line failure contract (under the caller's metric) rather
@@ -731,5 +869,7 @@ if __name__ == "__main__":
         bench_data()
     elif len(sys.argv) > 1 and sys.argv[1] == "predict":
         bench_predict()
+    elif len(sys.argv) > 1 and sys.argv[1] == "bc":
+        bench_bc()
     else:
         main()
