@@ -99,6 +99,27 @@ def main() -> None:
     t = timed(mm, (a, b))
     record("matmul_8192_bf16", t, flops=2.0 * n**3)
 
+    # --- 0. per-kernel overhead probe. The compiled train step holds ~700
+    # schedulable kernels (674 fusions + 40 convs + 11 dots, CPU-optimized
+    # proxy count) and 740 ms / ~700 = 1.05 ms/kernel — if the tunnel
+    # charges ~1 ms per kernel EXECUTION, the whole mystery is explained
+    # (single-kernel matmul fast, many-kernel step slow, scan no help).
+    # A chain of N dependent small matmuls (unfusable, ~us of compute each)
+    # measures ms/kernel directly; two lengths check linearity. ---
+    def chain(n):
+        def f(y, w):
+            for _ in range(n):
+                y = y @ w
+            return y
+        return jax.jit(f)
+
+    y0 = jax.random.normal(key, (128, 128), jnp.bfloat16)
+    w0 = jax.random.normal(key, (128, 128), jnp.bfloat16)
+    for n in (20, 200):
+        t = timed(chain(n), (y0, w0))
+        record(f"kernel_chain_{n}", t,
+               extra={"ms_per_kernel": round(t * 1e3 / n, 4)})
+
     # --- 1. dominant conv block: 5x5 64->64 @ 79x79, batch 64 ---
     import flax.linen as nn
 
