@@ -47,6 +47,19 @@ _NEG_INF = -1e30
 _STATS_LANES = 128
 
 
+def _dot_precision(dtype) -> Optional[lax.Precision]:
+    """Matmul precision for kernel dots computing in f32 from `dtype` inputs.
+
+    The TPU MXU natively multiplies bf16; at DEFAULT precision an f32
+    matmul is decomposed into a single bf16 pass (~2^-8 relative error).
+    For f32 inputs that silently downgrades the kernel below f32 accuracy,
+    so request HIGHEST (the multi-pass bf16 decomposition, true-f32
+    accurate). For bf16 inputs the operands are exactly representable and
+    DEFAULT is both exact-enough and the fast path.
+    """
+    return lax.Precision.HIGHEST if dtype == jnp.float32 else None
+
+
 def reference_attention(
     q: jax.Array,
     k: jax.Array,
@@ -55,11 +68,12 @@ def reference_attention(
     scale: Optional[float] = None,
     q_offset=0,
     k_offset=0,
+    precision: Optional[lax.Precision] = None,
 ) -> jax.Array:
     """Materialized-logits attention over [B, S, H, D] — numerics oracle
     and non-TPU fallback. Offsets shift global positions for tiled use."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, precision=precision) * scale
     if causal:
         q_pos = q_offset + jnp.arange(q.shape[1])
         k_pos = k_offset + jnp.arange(k.shape[1])
@@ -67,10 +81,14 @@ def reference_attention(
         logits = jnp.where(mask[None, None], logits, _NEG_INF)
     # Fully-masked rows normalize against the -inf cap instead of NaN-ing.
     probs = jax.nn.softmax(logits, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v).astype(q.dtype)
+    return jnp.einsum(
+        "bhqk,bkhd->bqhd", probs, v, precision=precision
+    ).astype(q.dtype)
 
 
-def _flash_body(offsets_ref, q_ref, k_ref, v_ref, block_k, scale, causal):
+def _flash_body(
+    offsets_ref, q_ref, k_ref, v_ref, block_k, scale, causal, precision
+):
     """The shared online-softmax recurrence over k blocks; returns the raw
     accumulator triple (o_unnormalized, row_sum, row_max)."""
     qi = pl.program_id(1)
@@ -95,6 +113,7 @@ def _flash_body(offsets_ref, q_ref, k_ref, v_ref, block_k, scale, causal):
             k_blk,
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
+            precision=precision,
         )  # [block_q, block_k]
         if causal:
             k_pos = (
@@ -117,6 +136,7 @@ def _flash_body(offsets_ref, q_ref, k_ref, v_ref, block_k, scale, causal):
             v_blk,
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
+            precision=precision,
         )
         return o_new, l_new, m_new
 
@@ -136,23 +156,25 @@ def _flash_kernel(
     block_k: int,
     scale: float,
     causal: bool,
+    precision: Optional[lax.Precision] = None,
 ):
     o_acc, l_acc, _ = _flash_body(
-        offsets_ref, q_ref, k_ref, v_ref, block_k, scale, causal
+        offsets_ref, q_ref, k_ref, v_ref, block_k, scale, causal, precision
     )
     l_acc = jnp.maximum(l_acc, 1e-30)
     o_ref[0] = (o_acc / l_acc).astype(o_ref.dtype)
 
 
 def _flash_tile_kernel(
-    offsets_ref, q_ref, k_ref, v_ref, o_ref, l_ref, m_ref, *, block_k, scale, causal
+    offsets_ref, q_ref, k_ref, v_ref, o_ref, l_ref, m_ref,
+    *, block_k, scale, causal, precision=None,
 ):
     """Like _flash_kernel but emits the UNNORMALIZED accumulator triple
     (o_partial, row_sum, row_max) — the online-softmax residuals a ring hop
     merges across devices (parallel/ring_attention.py). l/m blocks are
     [1, block_q, _STATS_LANES] with the stat broadcast along the lane dim."""
     o_acc, l_acc, m_acc = _flash_body(
-        offsets_ref, q_ref, k_ref, v_ref, block_k, scale, causal
+        offsets_ref, q_ref, k_ref, v_ref, block_k, scale, causal, precision
     )
     o_ref[0] = o_acc
     l_ref[0] = jnp.broadcast_to(l_acc, l_ref.shape[1:])
@@ -214,7 +236,8 @@ def flash_attention_tile(
 
     o, l, m = pl.pallas_call(
         functools.partial(
-            _flash_tile_kernel, block_k=bk, scale=scale, causal=causal
+            _flash_tile_kernel, block_k=bk, scale=scale, causal=causal,
+            precision=_dot_precision(q.dtype),
         ),
         out_shape=(
             out_struct((bh, s_q, dim)),
@@ -276,7 +299,8 @@ def _flash_attention_fwd_impl(
     grid = (bh, s_q // block_q)
     out = pl.pallas_call(
         functools.partial(
-            _flash_kernel, block_k=block_k, scale=scale, causal=causal
+            _flash_kernel, block_k=block_k, scale=scale, causal=causal,
+            precision=_dot_precision(q.dtype),
         ),
         out_shape=jax.ShapeDtypeStruct((bh, s_q, dim), q.dtype),
         grid=grid,
@@ -293,7 +317,7 @@ def _flash_attention_fwd_impl(
 
 
 def _bwd_tile(q_scaled, k_blk, v_blk, do_blk, lse, delta, q_pos, k_pos,
-              causal):
+              causal, precision=None):
     """Shared backward-tile recompute: probabilities and dS for one
     (q-tile x k-tile) pair, from the saved row stats.
 
@@ -307,6 +331,7 @@ def _bwd_tile(q_scaled, k_blk, v_blk, do_blk, lse, delta, q_pos, k_pos,
         q_scaled, k_blk,
         dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
+        precision=precision,
     )
     p = jnp.exp(s - lse)
     if causal:
@@ -315,6 +340,7 @@ def _bwd_tile(q_scaled, k_blk, v_blk, do_blk, lse, delta, q_pos, k_pos,
         do_blk, v_blk,
         dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
+        precision=precision,
     )
     ds = p * (dp - delta)
     return p, ds
@@ -333,6 +359,7 @@ def _flash_bwd_dq_kernel(
     block_k: int,
     scale: float,
     causal: bool,
+    precision: Optional[lax.Precision] = None,
 ):
     """dQ_i = scale * sum_j dS_ij K_j, with P recomputed per k-tile from
     the saved row stats (FlashAttention-2 backward, query-parallel half)."""
@@ -361,11 +388,12 @@ def _flash_bwd_dq_kernel(
             + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
         )
         _, ds = _bwd_tile(q, k_blk, v_blk, do, lse, delta, q_pos, k_pos,
-                          causal)
+                          causal, precision)
         return acc + jax.lax.dot_general(
             ds, k_blk,
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
+            precision=precision,
         )
 
     acc = lax.fori_loop(0, num_kb, body, jnp.zeros((block_q, dim), jnp.float32))
@@ -386,6 +414,7 @@ def _flash_bwd_dkv_kernel(
     block_q: int,
     scale: float,
     causal: bool,
+    precision: Optional[lax.Precision] = None,
 ):
     """dK_j = scale * sum_i dS_ij^T Q_i; dV_j = sum_i P_ij^T dO_i (the
     key-parallel half: each grid step owns one k-tile, loops q-tiles)."""
@@ -418,16 +447,18 @@ def _flash_bwd_dkv_kernel(
             + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
         )
         p, ds = _bwd_tile(q_blk, k_blk, v_blk, do_blk, lse, delta, q_pos,
-                          k_pos, causal)
+                          k_pos, causal, precision)
         dv_acc = dv_acc + jax.lax.dot_general(
             p, do_blk,
             dimension_numbers=(((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
+            precision=precision,
         )
         dk_acc = dk_acc + jax.lax.dot_general(
             ds, q_blk,
             dimension_numbers=(((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
+            precision=precision,
         )
         return dk_acc, dv_acc
 
@@ -522,7 +553,8 @@ def flash_attention_bwd_tile(
 
     dq = pl.pallas_call(
         functools.partial(
-            _flash_bwd_dq_kernel, block_k=bk, scale=scale, causal=causal
+            _flash_bwd_dq_kernel, block_k=bk, scale=scale, causal=causal,
+            precision=_dot_precision(q.dtype),
         ),
         out_shape=out_struct((bh, s_q, dim)),
         grid=(bh, s_q // bq),
@@ -541,7 +573,8 @@ def flash_attention_bwd_tile(
 
     dk, dv = pl.pallas_call(
         functools.partial(
-            _flash_bwd_dkv_kernel, block_q=bq, scale=scale, causal=causal
+            _flash_bwd_dkv_kernel, block_q=bq, scale=scale, causal=causal,
+            precision=_dot_precision(q.dtype),
         ),
         out_shape=(
             out_struct((bh, s_k, dim)),

@@ -60,18 +60,27 @@ def main() -> None:
         v = jax.random.normal(kv, shape, dtype)
         dout = jax.random.normal(kd, shape, dtype)
 
+        # The oracle must be at least as accurate as the kernel under test:
+        # f32 kernels run HIGHEST-precision dots (true f32 on the MXU), so
+        # the einsum reference must too — at DEFAULT both would be
+        # independently-rounded single-pass bf16 approximations and the
+        # comparison would measure MXU rounding, not kernel correctness.
+        prec = fa._dot_precision(dtype)
+
         def loss_flash(q, k, v):
             out = fa.flash_attention(q, k, v, causal=causal)
             return jnp.sum(out.astype(jnp.float32) * dout.astype(jnp.float32))
 
         def loss_ref(q, k, v):
-            out = fa.reference_attention(q, k, v, causal=causal)
+            out = fa.reference_attention(q, k, v, causal=causal,
+                                         precision=prec)
             return jnp.sum(out.astype(jnp.float32) * dout.astype(jnp.float32))
 
         out_flash = jax.jit(
             lambda q, k, v: fa.flash_attention(q, k, v, causal=causal)
         )(q, k, v)
-        out_ref = fa.reference_attention(q, k, v, causal=causal)
+        out_ref = fa.reference_attention(q, k, v, causal=causal,
+                                         precision=prec)
         grads_flash = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
         grads_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
 
