@@ -54,27 +54,35 @@ def main() -> None:
            "peak_flops": peak, "cases": {}}
 
     def timed(fn, args, n_warm=6, n_windows=6, calls=2):
-        """Median seconds per call, readback-anchored (bench method)."""
+        """Median seconds per call, readback-anchored (bench method).
+
+        The anchor reads back ONE leaf, not the whole output tree: each
+        device_get is a tunnel RPC (~40-100 ms observed), so a per-leaf
+        anchor multiplies RPC latency by leaf count and poisoned the
+        multi-leaf cases of the first r03 diagnostic run (a 30-leaf grad
+        tree billed ~1 s of readback to "compute"). Every kernel the
+        executable runs must complete before ANY output buffer is
+        readable, so one leaf is a sufficient fence.
+        """
         box = {}
 
         def once():
             box["out"] = fn(*args)
 
+        def sync():
+            first = jax.tree_util.tree_leaves(box["out"])[0]
+            np.asarray(jax.device_get(jnp.ravel(first)[0]))
+
         once()
         for _ in range(n_warm):
             once()
-        jax.tree_util.tree_map(
-            lambda x: np.asarray(jax.device_get(jnp.ravel(x)[0])), box["out"]
-        )
+        sync()
         times = []
         for _ in range(n_windows):
             t0 = time.perf_counter()
             for _ in range(calls):
                 once()
-            jax.tree_util.tree_map(
-                lambda x: np.asarray(jax.device_get(jnp.ravel(x)[0])),
-                box["out"],
-            )
+            sync()
             times.append((time.perf_counter() - t0) / calls)
         return statistics.median(times)
 
@@ -90,6 +98,34 @@ def main() -> None:
 
     B = 64
     key = jax.random.PRNGKey(0)
+
+    # --- tunnel characterization: every wall-clock number on this backend
+    # is (dispatch semantics + RPC RTT) away from device time; measure both
+    # so the other cases can be decomposed. ---
+    tiny = jnp.zeros((8, 128), jnp.float32)
+    tiny_fn = jax.jit(lambda x: x + 1.0)
+    tiny_out = tiny_fn(tiny)  # compile
+    np.asarray(jax.device_get(jnp.ravel(tiny_out)[0]))
+    # Pure readback RTT: device_get of an already-computed buffer.
+    rtts = []
+    for _ in range(8):
+        t0 = time.perf_counter()
+        np.asarray(jax.device_get(jnp.ravel(tiny_out)[0]))
+        rtts.append(time.perf_counter() - t0)
+    record("tunnel_readback_rtt", statistics.median(rtts))
+    # Dispatch cost without sync: N back-to-back dispatches of a trivial
+    # kernel, one readback at the end. If dispatch is async/cheap, per-call
+    # cost ~ RTT/N; if each dispatch blocks on an RPC, per-call ~ RTT.
+    for n in (1, 10):
+        ts = []
+        for _ in range(5):
+            y = tiny
+            t0 = time.perf_counter()
+            for _ in range(n):
+                y = tiny_fn(y)
+            np.asarray(jax.device_get(jnp.ravel(y)[0]))
+            ts.append((time.perf_counter() - t0) / n)
+        record(f"tiny_dispatch_x{n}", statistics.median(ts))
 
     # --- 6. matmul ceiling first (cheap, re-pins the reference point) ---
     n = 8192
