@@ -98,17 +98,20 @@ def _rgb_to_hsv(rgb: jax.Array) -> jax.Array:
 
 
 def _hsv_to_rgb(hsv: jax.Array) -> jax.Array:
+    # Branchless sector-free formulation: c(n) = v - v*s*clip(min(k, 4-k), 0, 1)
+    # with k = (n + 6h) mod 6. Equivalent to the classic 6-sector table but
+    # pure elementwise VPU code. The table version (jnp.choose over a
+    # stacked [..., 6] candidate array) lowers to a per-pixel gather, which
+    # the round-3 TPU profile showed costing 225 ms PER CHANNEL per step on
+    # a [64, 472, 472] image batch — 92% of the whole train step — vs ~0 for
+    # this form, which fuses into the surrounding elementwise pipeline.
     h, s, v = hsv[..., 0], hsv[..., 1], hsv[..., 2]
-    i = jnp.floor(h * 6.0)
-    f = h * 6.0 - i
-    p = v * (1.0 - s)
-    q = v * (1.0 - s * f)
-    t = v * (1.0 - s * (1.0 - f))
-    i = i.astype(jnp.int32) % 6
-    r = jnp.choose(i, [v, q, p, p, t, v], mode="clip")
-    g = jnp.choose(i, [t, v, v, q, p, p], mode="clip")
-    b = jnp.choose(i, [p, p, t, v, v, q], mode="clip")
-    return jnp.stack([r, g, b], axis=-1)
+
+    def channel(n):
+        k = jnp.mod(n + h * 6.0, 6.0)
+        return v - v * s * jnp.clip(jnp.minimum(k, 4.0 - k), 0.0, 1.0)
+
+    return jnp.stack([channel(5.0), channel(3.0), channel(1.0)], axis=-1)
 
 
 def adjust_brightness(image: jax.Array, delta: jax.Array) -> jax.Array:
