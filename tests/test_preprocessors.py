@@ -130,6 +130,46 @@ class TestPhotometric:
         back = it._hsv_to_rgb(it._rgb_to_hsv(rgb))
         np.testing.assert_allclose(np.asarray(back), np.asarray(rgb), atol=1e-4)
 
+    def test_hsv_to_rgb_matches_colorsys(self):
+        import colorsys
+
+        hsv = np.asarray(
+            it._rgb_to_hsv(jax.random.uniform(jax.random.PRNGKey(3), (200, 3)))
+        )
+        got = np.asarray(it._hsv_to_rgb(jnp.asarray(hsv)))
+        expected = np.array([colorsys.hsv_to_rgb(*row) for row in hsv])
+        np.testing.assert_allclose(got, expected, atol=1e-5)
+
+    def test_distortion_pipeline_has_no_elementwise_gather(self):
+        """The round-3 TPU profile showed jnp.choose in _hsv_to_rgb lowering
+        to per-pixel gathers that cost 225 ms per channel per step (92% of
+        the flagship train step). Pin the fix structurally: the lowered
+        crop+distort pipeline may contain only block gathers (the
+        per-example crop window), never per-element ones."""
+        import re
+
+        def run(rng, img):
+            img = it.random_crop_image_batch(rng, img, (12, 12))
+            img = img.astype(jnp.float32) / 255.0
+            return it.apply_photometric_image_distortions(rng, img)
+
+        img = jnp.zeros((4, 16, 20, 3), jnp.uint8)
+        txt = (
+            jax.jit(run)
+            .lower(jax.random.PRNGKey(0), img)
+            .compile()
+            .as_text()
+        )
+        for line in txt.splitlines():
+            match = re.search(r"gather\(.*slice_sizes=\{([\d,]+)\}", line)
+            if not match:
+                continue
+            sizes = [int(s) for s in match.group(1).split(",")]
+            product = int(np.prod(sizes))
+            assert product >= 12 * 12, (
+                f"per-element gather in distortion pipeline: {line[:200]}"
+            )
+
     def test_distortions_bounded_and_random(self):
         rng = jax.random.PRNGKey(0)
         images = jax.random.uniform(jax.random.PRNGKey(2), (4, 8, 8, 3))
