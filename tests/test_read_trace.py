@@ -48,6 +48,13 @@ def test_read_trace_summarizes_a_capture(tmp_path):
     row = summary["top_ops"][0]
     assert set(row) == {"name", "total_ms", "count"}
     assert row["total_ms"] >= 0 and row["count"] >= 1
+    # Category attribution: totals exist and every value is non-negative.
+    assert summary["category_ms"], summary
+    assert all(v >= 0 for v in summary["category_ms"].values())
+    # The jitted module span is detected and normalized per step.
+    if "category_ms_per_step" in summary:
+        assert summary["step_count"] >= 1
+        assert "module" not in summary["category_ms_per_step"]
 
 
 def test_read_trace_reports_missing_dir(tmp_path):

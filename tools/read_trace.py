@@ -26,6 +26,38 @@ def find_xplanes(root: str) -> list[str]:
     )
 
 
+def categorize(name: str) -> str:
+    """Rough XLA-op categories for per-step attribution. `module` rows are
+    whole-executable spans (jit_train_step etc.); numeric names are the
+    per-core step rows xplane emits; both excluded from category totals to
+    avoid double counting."""
+    import re
+
+    if name.startswith("jit_") or re.fullmatch(r"\d+", name):
+        return "module"
+    if "gather" in name or ("fusion" in name and "s32[" in name):
+        return "gather"
+    if "convolution" in name:
+        return "conv"
+    if "copy" in name:
+        return "copy"
+    if "select-and-scatter" in name:
+        return "pool_bwd"
+    if "reduce-window" in name:
+        return "pool"
+    if "all-reduce" in name or "all-gather" in name or "collective" in name:
+        return "collective"
+    if "dot" in name:
+        return "dot"
+    if "reduce" in name:
+        return "reduce"
+    if "fusion" in name:
+        return "fusion"
+    if "slice" in name or "dynamic-update" in name:
+        return "slice"
+    return "other"
+
+
 def summarize(path: str, top_n: int = 30) -> dict:
     from jax.profiler import ProfileData
 
@@ -73,12 +105,40 @@ def summarize(path: str, top_n: int = 30) -> dict:
         }
         for name, ns in best["per_op"].most_common(top_n)
     ]
-    return {
+    # Per-step category attribution: module spans named `jit_<fn>` carry
+    # an execution count; divide each category's total by the step count
+    # of the busiest module to get ms/step.
+    by_cat = collections.Counter()
+    for name, ns in best["per_op"].items():
+        by_cat[categorize(name)] += ns
+    steps = 0
+    step_module = None
+    for name, ns in best["per_op"].items():
+        if name.startswith("jit_") and best["counts"][name] > steps:
+            steps = best["counts"][name]
+            step_module = name
+    categories = {
+        cat: round(ns / 1e6, 3) for cat, ns in by_cat.most_common()
+    }
+    result = {
         "planes": planes,
         "device_plane": best["plane"],
         "total_device_ms": round(best["total_ns"] / 1e6, 3),
+        "category_ms": categories,
         "top_ops": top,
     }
+    if steps:
+        result["step_module"] = step_module[:80]
+        result["step_count"] = steps
+        result["category_ms_per_step"] = {
+            cat: round(ns / 1e6 / steps, 3)
+            for cat, ns in by_cat.most_common()
+            if cat != "module"
+        }
+        result["module_ms_per_step"] = round(
+            best["per_op"][step_module] / 1e6 / steps, 3
+        )
+    return result
 
 
 def main() -> None:
