@@ -706,11 +706,19 @@ def main() -> None:
 
         # Same construction the driver's dryrun exercises — the bench must
         # measure the workload the compile checks validate. State donation
-        # lets XLA alias param/opt buffers in place across steps.
+        # lets XLA alias param/opt buffers in place across steps. The
+        # optimizer update runs flattened by default (BENCH_FLAT_OPT=0
+        # opts out): one fused whole-model Adam instead of per-leaf small
+        # kernels, which the round-3 profile showed paying ~1-4 ms each
+        # on this backend.
+        flat_opt = os.environ.get("BENCH_FLAT_OPT", "1") != "0"
         model, batch = _flagship(
             image_size=image_size, batch_size=batch_size, num_convs=num_convs
         )
-        compiled = CompiledModel(model, donate_state=True, remat=use_remat)
+        compiled = CompiledModel(
+            model, donate_state=True, remat=use_remat,
+            flatten_optimizer_update=flat_opt,
+        )
         state = compiled.init_state(jax.random.PRNGKey(0), batch)
         sharded = compiled.shard_batch(batch)
         rng = jax.random.PRNGKey(1)
@@ -852,6 +860,7 @@ def main() -> None:
                     "bf16_forward": True,
                     "batch_size": batch_size,
                     "remat": use_remat,
+                    "flat_optimizer_update": flat_opt,
                     **(
                         {"backend_note": backend_note}
                         if backend_note
