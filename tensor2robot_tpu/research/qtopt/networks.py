@@ -131,6 +131,14 @@ class Grasping44(nn.Module):
         )(images)
         net = nn.BatchNorm(use_scale=False, name="bn1", **bn_kwargs)(net)
         net = nn.relu(net)
+        # Back to the compute dtype BEFORE the pool (same policy as
+        # _ConvBNRelu): bn1's f32 output is the largest activation in the
+        # network ([B, 236, 236, 64] at 472px), and leaving it f32 doubles
+        # the HBM traffic of the stem pool fwd+bwd — the round-3 profile
+        # showed the resulting f32 select-and-scatter as the single most
+        # expensive non-gather op in the train step (5.8 ms).
+        if dtype is not None:
+            net = net.astype(dtype)
         net = nn.max_pool(net, (3, 3), strides=(3, 3), padding="SAME")
 
         for i in range(self.num_convs[0]):
