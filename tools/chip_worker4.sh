@@ -85,11 +85,30 @@ for i in $(seq 1 "$tries"); do
     commit_artifact BENCH_BC_r03.json "On-chip long-context BC train MFU"
   fi
 
-  BENCH_BACKEND_WAIT=240 BENCH_BATCH=128 BENCH_REMAT=1 python bench.py \
+  # Batch 128 plain first (the stem bf16 cast roughly halves stem
+  # activation memory, so bs128 may fit without remat); remat variant as
+  # the fallback datapoint.
+  BENCH_BACKEND_WAIT=240 BENCH_BATCH=128 python bench.py \
     > /tmp/w4_bs128.json 2>/tmp/w4_bs128.err || true
   if grep -q '"metric"' /tmp/w4_bs128.json && ! grep -q cpu_proxy /tmp/w4_bs128.json; then
     cp /tmp/w4_bs128.json BENCH_r03_bs128.json
-    commit_artifact BENCH_r03_bs128.json "Batch-128 remat MFU leg"
+    commit_artifact BENCH_r03_bs128.json "Batch-128 MFU leg"
+  fi
+  BENCH_BACKEND_WAIT=240 BENCH_BATCH=128 BENCH_REMAT=1 python bench.py \
+    > /tmp/w4_bs128r.json 2>/tmp/w4_bs128r.err || true
+  if grep -q '"metric"' /tmp/w4_bs128r.json && ! grep -q cpu_proxy /tmp/w4_bs128r.json; then
+    cp /tmp/w4_bs128r.json BENCH_r03_bs128_remat.json
+    commit_artifact BENCH_r03_bs128_remat.json "Batch-128 remat MFU leg"
+  fi
+
+  # Fused-optimizer A/B on the canonical bs64 config: quantifies the
+  # per-leaf small-kernel tax directly (same session, same chip state).
+  BENCH_BACKEND_WAIT=240 BENCH_FLAT_OPT=0 python bench.py \
+    > /tmp/w4_perleaf.json 2>/tmp/w4_perleaf.err || true
+  if grep -q 'qtopt_critic_train_mfu_bs64_472px"' /tmp/w4_perleaf.json; then
+    cp /tmp/w4_perleaf.json BENCH_r03_perleaf_opt.json
+    commit_artifact BENCH_r03_perleaf_opt.json \
+      "Per-leaf optimizer A/B control for the fused update"
   fi
 
   log "chain complete"
