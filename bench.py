@@ -565,7 +565,12 @@ def bench_bc() -> None:
                 model.get_label_specification("train"), batch_size=batch
             ),
         }
-        compiled = CompiledModel(model, donate_state=True)
+        compiled = CompiledModel(
+            model, donate_state=True,
+            flatten_optimizer_update=(
+                os.environ.get("BENCH_FLAT_OPT", "1") != "0"
+            ),
+        )
         state = compiled.init_state(jax.random.PRNGKey(0), batch_np)
         sharded = compiled.shard_batch(batch_np)
         rng = jax.random.PRNGKey(1)
