@@ -132,7 +132,12 @@ def main() -> None:
 
     fwdbwd = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
 
-    def timed(fn, n_warm=10, n_windows=8, calls=3):
+    def timed(fn, n_warm=10, n_windows=6, calls=20):
+        # calls must be large: each timing window is anchored by ONE
+        # readback, but on this tunnel the readback RPC costs ~40-100 ms
+        # — at 3 calls/window that floor dominated the round-3 first
+        # capture (a ~1 ms kernel read as ~25 ms). 20 calls bounds the
+        # per-call RTT contribution at ~5 ms worst-case.
         out = fn(q, k, v)
         for _ in range(n_warm):
             out = fn(q, k, v)
