@@ -30,6 +30,15 @@ for i in $(seq 1 "$tries"); do
   if pgrep -f "chip_worker[23].sh" >/dev/null 2>&1; then
     log "older worker alive, waiting ($i/$tries)"; sleep "$sleep_s"; continue
   fi
+  # The relay process must exist before anything touches jax: a
+  # timeout-killed jax probe is exactly the SIGTERM-on-TPU-client hazard
+  # that wedges the tunnel, so don't even start one while the relay is
+  # plainly absent.
+  if ! pgrep -f '/root/\.relay\.py' >/dev/null 2>&1; then
+    log "relay process absent ($i/$tries)"; sleep "$sleep_s"; continue
+  fi
+  # Give a freshly-restored relay a moment before the first client.
+  sleep 15
   # Cheap liveness probe in a subprocess (hard timeout, hang-safe).
   if ! timeout 90 python -c "import jax; ds=jax.devices(); assert ds[0].platform=='tpu'" \
       >/dev/null 2>&1; then
