@@ -73,8 +73,8 @@ for i in $(seq 1 "$tries"); do
       "Post-gather-fix on-chip MFU headline"
     env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python tools/read_trace.py \
       /root/repo/profiles/r03b 60 > /tmp/w4_trace.json 2>/tmp/w4_trace.err \
-      && cp /tmp/w4_trace.json PROFILE_SUMMARY_r03.json \
-      && commit_artifact PROFILE_SUMMARY_r03.json \
+      && cp /tmp/w4_trace.json PROFILE_SUMMARY_r03_postfix.json \
+      && commit_artifact PROFILE_SUMMARY_r03_postfix.json \
            "Post-gather-fix profile summary"
   else
     log "bench not tpu: $(tail -c 160 /tmp/w4_bench.json)"
