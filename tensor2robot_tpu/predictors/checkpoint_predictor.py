@@ -99,9 +99,47 @@ class CheckpointPredictor(AbstractPredictor):
                     latest = manager.latest_step()
                     if latest is not None and latest != self._restored_step:
                         state = self._get_template_state()
-                        abstract = jax.tree_util.tree_map(
-                            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), state
+                        # Every template leaf carries an explicit
+                        # serving-host sharding: leaving it unset makes
+                        # orbax read shardings from the checkpoint's
+                        # sharding file, which cannot be reconstructed when
+                        # the trainer ran on a different topology (e.g. an
+                        # 8-chip mesh feeding a 1-device robot host).
+                        host = jax.sharding.SingleDeviceSharding(
+                            jax.local_devices()[0]
                         )
+                        abstract = jax.tree_util.tree_map(
+                            lambda x: jax.ShapeDtypeStruct(
+                                x.shape, x.dtype, sharding=host
+                            ),
+                            state,
+                        )
+                        # Predictors consume params/variables/EMA/step only.
+                        # The opt_state layout depends on how the TRAINER was
+                        # configured (per-leaf vs optax.flatten, custom
+                        # optimizers) and must not constrain serving-side
+                        # restore — take the opt_state template from the
+                        # checkpoint's own metadata so restore always matches
+                        # what the trainer wrote.
+                        try:
+                            from etils import epath
+
+                            meta = ocp.StandardCheckpointHandler().metadata(
+                                epath.Path(path) / str(latest) / "default"
+                            )
+                            meta_tree = getattr(meta, "tree", meta)
+                            abstract = abstract.replace(
+                                opt_state=jax.tree_util.tree_map(
+                                    lambda m: jax.ShapeDtypeStruct(
+                                        m.shape, m.dtype, sharding=host
+                                    ),
+                                    meta_tree["opt_state"],
+                                )
+                            )
+                        except Exception:  # noqa: BLE001 — metadata probing
+                            # is best-effort; fall back to the model-derived
+                            # template (exact for same-config trainers).
+                            pass
                         restored = manager.restore(
                             latest, args=ocp.args.StandardRestore(abstract)
                         )
@@ -143,7 +181,16 @@ class CheckpointPredictor(AbstractPredictor):
     # -- introspection --------------------------------------------------------
 
     def get_feature_specification(self) -> TensorSpecStruct:
-        return self._model.get_feature_specification_for_packing("predict")
+        """The client-facing input contract: the preprocessor's RAW in-spec
+        (what predict() itself validates), filtered to required tensors —
+        reference predictors/checkpoint_predictor.py:72-75,118-120. The
+        model's packed spec describes the post-preprocess network input and
+        is NOT what a caller feeds."""
+        from tensor2robot_tpu.specs.utils import (
+            filter_required_flat_tensor_spec,
+        )
+
+        return filter_required_flat_tensor_spec(self._feature_spec)
 
     @property
     def model_version(self) -> int:

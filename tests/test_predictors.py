@@ -159,6 +159,91 @@ class TestCheckpointPredictor:
         assert out["a_predicted"].shape == (2, 1)
         assert predictor.model_path.endswith("4")
 
+    def test_restore_checkpoint_with_different_opt_layout(self, tmp_path):
+        """Serving must not care how the TRAINER laid out its optimizer
+        state: a checkpoint written with flatten_optimizer_update=True (one
+        concatenated moment vector) restores into a predictor whose
+        model-derived template is per-leaf — the opt_state template comes
+        from the checkpoint's own metadata."""
+        from tensor2robot_tpu.train.train_eval import train_eval_model
+
+        model_dir = str(tmp_path / "run")
+        train_eval_model(
+            MockT2RModel(device_type="cpu"),
+            input_generator_train=MockInputGenerator(batch_size=8),
+            model_dir=model_dir,
+            max_train_steps=2,
+            save_checkpoints_steps=2,
+            log_every_steps=2,
+            flatten_optimizer_update=True,
+        )
+        predictor = CheckpointPredictor(
+            t2r_model=MockT2RModel(device_type="cpu"),
+            checkpoint_dir=model_dir,
+            timeout=5,
+        )
+        assert predictor.restore()
+        out = predictor.predict({"x": np.zeros((2, 3), np.float32)})
+        assert out["a_predicted"].shape == (2, 1)
+
+        # Cross-topology serving: the same checkpoint (written on this
+        # process's 8-device mesh) restores in a ONE-device process — the
+        # robot-host-loads-pod-checkpoint workflow. Template leaves carry
+        # explicit host shardings, so orbax never consults the
+        # checkpoint's topology-specific sharding file.
+        import subprocess
+        import sys as _sys
+
+        env = {
+            k: v
+            for k, v in os.environ.items()
+            if k not in ("PYTHONPATH", "XLA_FLAGS")
+        }
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.run(
+            [
+                _sys.executable,
+                "-c",
+                "import sys; sys.path.insert(0, '/root/repo')\n"
+                "import jax, numpy as np\n"
+                "assert len(jax.devices()) == 1\n"
+                "from tensor2robot_tpu.predictors.checkpoint_predictor "
+                "import CheckpointPredictor\n"
+                "from tensor2robot_tpu.utils.mocks import MockT2RModel\n"
+                "p = CheckpointPredictor(t2r_model=MockT2RModel("
+                "device_type='cpu'), checkpoint_dir=%r, timeout=5)\n"
+                "assert p.restore()\n"
+                "out = p.predict({'x': np.zeros((2, 3), np.float32)})\n"
+                "assert out['a_predicted'].shape == (2, 1)\n"
+                "print('OK')" % model_dir,
+            ],
+            capture_output=True,
+            text=True,
+            timeout=300,
+            env=env,
+        )
+        assert proc.returncode == 0 and "OK" in proc.stdout, proc.stderr[-2000:]
+
+    def test_feature_specification_is_the_raw_in_spec(self):
+        """get_feature_specification returns what predict() actually
+        validates: the preprocessor's raw in-spec filtered to required
+        tensors (reference checkpoint_predictor.py:72-75,118-120) — not
+        the model's post-preprocess packing spec."""
+        from tensor2robot_tpu.specs.utils import flatten_spec_structure
+
+        predictor = CheckpointPredictor(
+            t2r_model=MockT2RModel(device_type="cpu")
+        )
+        predictor.init_randomly()
+        spec = predictor.get_feature_specification()
+        for key, item in flatten_spec_structure(spec).items():
+            assert not getattr(item, "is_optional", False), key
+        # Feeding exactly this spec works end to end.
+        from tensor2robot_tpu.specs import make_random_numpy
+
+        out = predictor.predict(make_random_numpy(spec, batch_size=3))
+        assert out["a_predicted"].shape == (3, 1)
+
     def test_restore_times_out(self, tmp_path):
         predictor = CheckpointPredictor(
             t2r_model=MockT2RModel(device_type="cpu"),

@@ -9,7 +9,7 @@
 # Chain (all outputs via tmp files, moved+committed only on real results):
 #   1. tools/validate_flash_tpu.py  -> BENCH_FLASH_r03.json   (f32-precision fix)
 #   2. tools/diagnose_step_tpu.py   -> DIAG_STEP_r03.json     (single-leaf anchor + RTT probes)
-#   3. bench.py (+profile)          -> BENCH_r03.json + PROFILE_SUMMARY_r03.json
+#   3. bench.py (+profile)          -> BENCH_r03.json + PROFILE_SUMMARY_r03_postfix.json
 #      (post-HSV-fix headline: the gather fix should move MFU ~10x)
 #   4. bench.py predict             -> BENCH_PREDICT_r03.json
 #   5. bench.py bc                  -> BENCH_BC_r03.json
