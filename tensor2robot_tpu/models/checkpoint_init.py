@@ -100,7 +100,19 @@ def load_checkpoint_variables(
     root, resolved = _checkpoint_root_and_step(checkpoint_path, step)
     manager = ocp.CheckpointManager(root)
     try:
-        tree = manager.restore(resolved, args=ocp.args.StandardRestore())
+        # Restore against the checkpoint's own metadata with host-placed
+        # leaves: a bare StandardRestore() replays the TRAINER topology's
+        # sharding file and fails whenever the warm-starting job runs on a
+        # different device count (pod checkpoint -> single-host finetune).
+        from tensor2robot_tpu.train.state import checkpoint_metadata_template
+
+        try:
+            abstract = checkpoint_metadata_template(root, resolved)
+        except Exception:  # noqa: BLE001 — metadata probing is best-effort
+            abstract = None
+        tree = manager.restore(
+            resolved, args=ocp.args.StandardRestore(abstract)
+        )
     finally:
         manager.close()
     variables = tree.get("variables", tree) if isinstance(tree, dict) else tree

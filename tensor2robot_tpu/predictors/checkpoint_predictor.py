@@ -98,54 +98,48 @@ class CheckpointPredictor(AbstractPredictor):
                 with ocp.CheckpointManager(path) as manager:
                     latest = manager.latest_step()
                     if latest is not None and latest != self._restored_step:
-                        state = self._get_template_state()
-                        # Every template leaf carries an explicit
-                        # serving-host sharding: leaving it unset makes
-                        # orbax read shardings from the checkpoint's
-                        # sharding file, which cannot be reconstructed when
-                        # the trainer ran on a different topology (e.g. an
-                        # 8-chip mesh feeding a 1-device robot host).
-                        host = jax.sharding.SingleDeviceSharding(
-                            jax.local_devices()[0]
+                        # Restore against the checkpoint's OWN metadata with
+                        # host-placed leaves (train/state.py): serving must
+                        # depend neither on the trainer's topology (whose
+                        # sharding file a template-less restore replays) nor
+                        # on its optimizer layout (per-leaf vs
+                        # optax.flatten). Fall back to the model-derived
+                        # template — exact for same-config trainers — only
+                        # if metadata probing fails.
+                        from tensor2robot_tpu.train.state import (
+                            checkpoint_metadata_template,
                         )
-                        abstract = jax.tree_util.tree_map(
-                            lambda x: jax.ShapeDtypeStruct(
-                                x.shape, x.dtype, sharding=host
-                            ),
-                            state,
-                        )
-                        # Predictors consume params/variables/EMA/step only.
-                        # The opt_state layout depends on how the TRAINER was
-                        # configured (per-leaf vs optax.flatten, custom
-                        # optimizers) and must not constrain serving-side
-                        # restore — take the opt_state template from the
-                        # checkpoint's own metadata so restore always matches
-                        # what the trainer wrote.
-                        try:
-                            from etils import epath
 
-                            meta = ocp.StandardCheckpointHandler().metadata(
-                                epath.Path(path) / str(latest) / "default"
+                        try:
+                            abstract = checkpoint_metadata_template(
+                                path, latest
                             )
-                            meta_tree = getattr(meta, "tree", meta)
-                            abstract = abstract.replace(
-                                opt_state=jax.tree_util.tree_map(
-                                    lambda m: jax.ShapeDtypeStruct(
-                                        m.shape, m.dtype, sharding=host
-                                    ),
-                                    meta_tree["opt_state"],
-                                )
+                        except Exception:  # noqa: BLE001 — best-effort
+                            state = self._get_template_state()
+                            abstract = jax.tree_util.tree_map(
+                                lambda x: jax.ShapeDtypeStruct(
+                                    x.shape, x.dtype
+                                ),
+                                state,
                             )
-                        except Exception:  # noqa: BLE001 — metadata probing
-                            # is best-effort; fall back to the model-derived
-                            # template (exact for same-config trainers).
-                            pass
                         restored = manager.restore(
                             latest, args=ocp.args.StandardRestore(abstract)
                         )
-                        self._variables = restored.export_variables(
-                            use_ema=self._use_ema
-                        )
+                        # Metadata-derived restore yields the raw on-disk
+                        # dict; the model-template fallback yields a
+                        # TrainState. Both carry the same fields.
+                        if isinstance(restored, dict):
+                            variables = dict(restored["variables"])
+                            if (
+                                self._use_ema
+                                and restored.get("ema_params") is not None
+                            ):
+                                variables["params"] = restored["ema_params"]
+                            self._variables = variables
+                        else:
+                            self._variables = restored.export_variables(
+                                use_ema=self._use_ema
+                            )
                         self._restored_step = int(latest)
                         return True
             if latest is not None and latest == self._restored_step:

@@ -66,3 +66,28 @@ def update_ema(ema_params, new_params, decay: float):
         ema_params,
         new_params,
     )
+
+
+def checkpoint_metadata_template(root, step):
+    """Abstract restore template read from a checkpoint's OWN metadata,
+    with every leaf placed on the local host.
+
+    Restoring with this template makes the read independent of (a) the
+    topology the trainer ran on — leaving shardings unset replays the
+    checkpoint's sharding file, which cannot be reconstructed on a host
+    with a different device count — and (b) the consumer's own guess at
+    the saved structure (e.g. which optimizer layout the trainer used).
+    Returns a pytree of jax.ShapeDtypeStruct mirroring the on-disk tree.
+    """
+    import orbax.checkpoint as ocp
+    from etils import epath
+
+    meta = ocp.StandardCheckpointHandler().metadata(
+        epath.Path(root) / str(step) / "default"
+    )
+    meta_tree = getattr(meta, "tree", meta)
+    host = jax.sharding.SingleDeviceSharding(jax.local_devices()[0])
+    return jax.tree_util.tree_map(
+        lambda m: jax.ShapeDtypeStruct(m.shape, m.dtype, sharding=host),
+        meta_tree,
+    )
