@@ -288,6 +288,24 @@ class JitCEMPolicy(CEMPolicy):
         q_key = self._q_key
 
         num_samples = self._cem_samples
+        # Fail fast with the deployment recipe instead of a rank-mismatch
+        # error from deep inside the traced export: the jitted engine
+        # scores the whole population in ONE critic call, so the export's
+        # action leaves must carry the population dim.
+        spec = flatten_spec_structure(
+            self._predictor.get_feature_specification()
+        )
+        for leaf_key, _ in leaves:
+            shape = tuple(spec[leaf_key].shape)
+            if not shape or int(shape[0]) != num_samples:
+                raise ValueError(
+                    f"JitCEMPolicy needs the export's action leaf "
+                    f"{leaf_key!r} to carry the CEM population as its "
+                    f"leading dim: spec shape {shape}, expected "
+                    f"({num_samples}, ...). Re-export the serving model "
+                    f"with action_batch_size={num_samples} "
+                    "(docs/SERVING.md), or use CEMPolicy (numpy engine)."
+                )
 
         def select(flat_features, key):
             def objective(samples):
