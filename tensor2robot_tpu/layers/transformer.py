@@ -39,6 +39,11 @@ class MultiHeadAttention(nn.Module):
     mesh: Optional[object] = None
     use_flash: Optional[bool] = None
     interpret: bool = False
+    # Causal sliding window W (each query attends to its last W steps);
+    # single-device flash/reference paths only — the sequence-parallel
+    # strategies reject it until their hop/scatter schedules learn to
+    # skip out-of-window work.
+    window: Optional[int] = None
     # Context-parallel strategy when the mesh's sequence axis is >1:
     # "ring" (K/V rotate, O(seq/N) memory/device) or "ulysses" (head-
     # scatter all_to_all, one collective round, needs heads % N == 0).
@@ -67,6 +72,11 @@ class MultiHeadAttention(nn.Module):
             if self.mesh is not None
             else 1
         )
+        if self.window is not None and sequence_axis > 1:
+            raise NotImplementedError(
+                "window is not yet supported with sequence parallelism; "
+                "run windowed attention on a mesh without a sequence axis"
+            )
         if sequence_axis > 1 and self.sequence_parallel_mode == "ulysses":
             from tensor2robot_tpu.parallel.ulysses_attention import (
                 ulysses_attention,
@@ -85,10 +95,13 @@ class MultiHeadAttention(nn.Module):
             )
         elif self.use_flash is False:
             # Explicit opt-out: the einsum reference on any backend.
-            out = flash_lib.reference_attention(q, k, v, causal=self.causal)
+            out = flash_lib.reference_attention(
+                q, k, v, causal=self.causal, window=self.window
+            )
         else:
             out = flash_lib.flash_attention(
-                q, k, v, causal=self.causal, interpret=self.interpret
+                q, k, v, causal=self.causal, interpret=self.interpret,
+                window=self.window,
             )
         out = out.reshape(batch, seq, features)
         return nn.Dense(x.shape[-1], use_bias=False, name="out")(out)
@@ -113,6 +126,7 @@ class TransformerBlock(nn.Module):
     num_experts: int = 1
     num_selected_experts: int = 2
     sequence_parallel_mode: str = "ring"
+    window: Optional[int] = None
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -124,6 +138,7 @@ class TransformerBlock(nn.Module):
             use_flash=self.use_flash,
             interpret=self.interpret,
             sequence_parallel_mode=self.sequence_parallel_mode,
+            window=self.window,
             name="attention",
         )(nn.LayerNorm(name="ln_attn")(x))
         h = nn.LayerNorm(name="ln_mlp")(x)
@@ -157,6 +172,7 @@ class PipelineStage(nn.Module):
     causal: bool = True
     use_flash: Optional[bool] = None
     interpret: bool = False
+    window: Optional[int] = None
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -169,6 +185,7 @@ class PipelineStage(nn.Module):
                 mesh=None,
                 use_flash=self.use_flash,
                 interpret=self.interpret,
+                window=self.window,
                 name=f"block_{i}",
             )(x)
         return x
@@ -201,6 +218,7 @@ class TransformerEncoder(nn.Module):
     sequence_parallel_mode: str = "ring"
     pipeline_stages: int = 1
     pipeline_microbatches: Optional[int] = None
+    window: Optional[int] = None
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -230,6 +248,7 @@ class TransformerEncoder(nn.Module):
                     num_experts=self.num_experts,
                     num_selected_experts=self.num_selected_experts,
                     sequence_parallel_mode=self.sequence_parallel_mode,
+                    window=self.window,
                     name=f"block_{i}",
                 )(x)
         return nn.LayerNorm(name="ln_final")(x)
@@ -272,6 +291,7 @@ class TransformerEncoder(nn.Module):
             causal=self.causal,
             use_flash=self.use_flash,
             interpret=self.interpret,
+            window=self.window,
         )
         batch = x.shape[0]
         data_size = mesh_axes.get(mesh_mod.DATA_AXIS, 1)

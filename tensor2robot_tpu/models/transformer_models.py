@@ -49,6 +49,10 @@ class _TransformerBCNet(nn.Module):
     sequence_parallel_mode: str = "ring"
     pipeline_stages: int = 1
     pipeline_microbatches: Optional[int] = None
+    # Causal sliding window over the episode (None = full history): each
+    # step attends to its last `attention_window` steps, O(T*W) compute —
+    # the streaming-robot regime where recent context dominates.
+    attention_window: Optional[int] = None
 
     @nn.compact
     def __call__(self, features, mode):
@@ -76,6 +80,7 @@ class _TransformerBCNet(nn.Module):
             sequence_parallel_mode=self.sequence_parallel_mode,
             pipeline_stages=self.pipeline_stages,
             pipeline_microbatches=self.pipeline_microbatches,
+            window=self.attention_window,
             name="encoder",
         )(x)
         action = nn.Dense(self.action_size, name="action_head")(x)
@@ -112,6 +117,7 @@ class TransformerBCModel(FlaxT2RModel):
         sequence_parallel_mode: str = "ring",
         pipeline_stages: int = 1,
         pipeline_microbatches: Optional[int] = None,
+        attention_window: Optional[int] = None,
         **kwargs,
     ):
         super().__init__(**kwargs)
@@ -131,6 +137,7 @@ class TransformerBCModel(FlaxT2RModel):
         self._sequence_parallel_mode = sequence_parallel_mode
         self._pipeline_stages = pipeline_stages
         self._pipeline_microbatches = pipeline_microbatches
+        self._attention_window = attention_window
 
     def get_feature_specification(self, mode: str) -> TensorSpecStruct:
         del mode
@@ -173,6 +180,7 @@ class TransformerBCModel(FlaxT2RModel):
             sequence_parallel_mode=self._sequence_parallel_mode,
             pipeline_stages=self._pipeline_stages,
             pipeline_microbatches=self._pipeline_microbatches,
+            attention_window=self._attention_window,
         )
 
     def init_variables(self, rng, features, mode=MODE_TRAIN):

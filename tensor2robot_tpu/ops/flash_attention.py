@@ -47,6 +47,39 @@ _NEG_INF = -1e30
 _STATS_LANES = 128
 
 
+def _check_window(window: Optional[int], causal: bool) -> None:
+    """Shared entry-point validation: a window needs causal semantics, and
+    window < 1 would mask EVERYTHING — in the reference path the finite
+    _NEG_INF cap then normalizes to uniform attention over all positions
+    (a silent future-information leak), so it must be rejected, not
+    computed."""
+    if window is None:
+        return
+    if not causal:
+        raise ValueError("window requires causal=True (causal sliding window)")
+    if window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
+
+
+def _k_block_bounds(q0, block_q, block_k, num_kb, k_off, causal, window):
+    """[j_lo, j_hi) over k blocks visible to the q block starting at GLOBAL
+    position q0. A k block j covers global [k_off + j*bk, k_off + (j+1)*bk).
+    Causal keeps blocks whose min k <= the block's max q; the window keeps
+    blocks whose max k > q0 - W — both exact (floor division on possibly
+    negative numerators). Shared by the forward recurrence and the dq
+    backward so their visibility can never desynchronize."""
+    j_lo = 0
+    j_hi = num_kb
+    if causal:
+        j_hi = jnp.maximum(
+            0,
+            jnp.minimum(num_kb, (q0 + block_q - 1 - k_off) // block_k + 1),
+        )
+    if window is not None:
+        j_lo = jnp.maximum(0, (q0 - window + 1 - k_off) // block_k)
+    return j_lo, j_hi
+
+
 def _dot_precision(dtype) -> Optional[lax.Precision]:
     """Matmul precision for kernel dots computing in f32 from `dtype` inputs.
 
@@ -69,15 +102,21 @@ def reference_attention(
     q_offset=0,
     k_offset=0,
     precision: Optional[lax.Precision] = None,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Materialized-logits attention over [B, S, H, D] — numerics oracle
-    and non-TPU fallback. Offsets shift global positions for tiled use."""
+    and non-TPU fallback. Offsets shift global positions for tiled use.
+    window=W restricts each query to the last W keys (q-W < k <= q, the
+    causal sliding window); requires causal=True."""
+    _check_window(window, causal)
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, precision=precision) * scale
     if causal:
         q_pos = q_offset + jnp.arange(q.shape[1])
         k_pos = k_offset + jnp.arange(k.shape[1])
         mask = q_pos[:, None] >= k_pos[None, :]
+        if window is not None:
+            mask = mask & (q_pos[:, None] - k_pos[None, :] < window)
         logits = jnp.where(mask[None, None], logits, _NEG_INF)
     # Fully-masked rows normalize against the -inf cap instead of NaN-ing.
     probs = jax.nn.softmax(logits, axis=-1)
@@ -87,10 +126,16 @@ def reference_attention(
 
 
 def _flash_body(
-    offsets_ref, q_ref, k_ref, v_ref, block_k, scale, causal, precision
+    offsets_ref, q_ref, k_ref, v_ref, block_k, scale, causal, precision,
+    window=None,
 ):
     """The shared online-softmax recurrence over k blocks; returns the raw
-    accumulator triple (o_unnormalized, row_sum, row_max)."""
+    accumulator triple (o_unnormalized, row_sum, row_max).
+
+    window=W (causal sliding window, q-W < k <= q) masks per element AND
+    tightens the k-block loop bounds, so compute is O(S*W) instead of
+    O(S^2) — the whole point of local attention at long context.
+    """
     qi = pl.program_id(1)
     block_q = q_ref.shape[1]
     dim = q_ref.shape[2]
@@ -102,6 +147,11 @@ def _flash_body(
         offsets_ref[0]
         + qi * block_q
         + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    )
+
+    q0 = offsets_ref[0] + qi * block_q
+    j_lo, j_hi = _k_block_bounds(
+        q0, block_q, block_k, num_kb, offsets_ref[1], causal, window
     )
 
     def body(j, carry):
@@ -121,7 +171,10 @@ def _flash_body(
                 + j * block_k
                 + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
             )
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+            visible = q_pos >= k_pos
+            if window is not None:
+                visible = visible & (q_pos - k_pos < window)
+            s = jnp.where(visible, s, _NEG_INF)
         # Row stats stay [block_q, 1] (keepdims) — 2D shapes lower cleanly
         # on Mosaic where 1D per-row vectors may not.
         m_blk = jnp.max(s, axis=-1, keepdims=True)
@@ -143,7 +196,7 @@ def _flash_body(
     o_acc = jnp.zeros((block_q, dim), jnp.float32)
     l_acc = jnp.zeros((block_q, 1), jnp.float32)
     m_acc = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
-    return lax.fori_loop(0, num_kb, body, (o_acc, l_acc, m_acc))
+    return lax.fori_loop(j_lo, j_hi, body, (o_acc, l_acc, m_acc))
 
 
 def _flash_kernel(
@@ -157,9 +210,11 @@ def _flash_kernel(
     scale: float,
     causal: bool,
     precision: Optional[lax.Precision] = None,
+    window: Optional[int] = None,
 ):
     o_acc, l_acc, _ = _flash_body(
-        offsets_ref, q_ref, k_ref, v_ref, block_k, scale, causal, precision
+        offsets_ref, q_ref, k_ref, v_ref, block_k, scale, causal, precision,
+        window,
     )
     l_acc = jnp.maximum(l_acc, 1e-30)
     o_ref[0] = (o_acc / l_acc).astype(o_ref.dtype)
@@ -167,14 +222,15 @@ def _flash_kernel(
 
 def _flash_tile_kernel(
     offsets_ref, q_ref, k_ref, v_ref, o_ref, l_ref, m_ref,
-    *, block_k, scale, causal, precision=None,
+    *, block_k, scale, causal, precision=None, window=None,
 ):
     """Like _flash_kernel but emits the UNNORMALIZED accumulator triple
     (o_partial, row_sum, row_max) — the online-softmax residuals a ring hop
     merges across devices (parallel/ring_attention.py). l/m blocks are
     [1, block_q, _STATS_LANES] with the stat broadcast along the lane dim."""
     o_acc, l_acc, m_acc = _flash_body(
-        offsets_ref, q_ref, k_ref, v_ref, block_k, scale, causal, precision
+        offsets_ref, q_ref, k_ref, v_ref, block_k, scale, causal, precision,
+        window,
     )
     o_ref[0] = o_acc
     l_ref[0] = jnp.broadcast_to(l_acc, l_ref.shape[1:])
@@ -193,6 +249,7 @@ def flash_attention_tile(
     block_k: int = 128,
     interpret: bool = False,
     vma=None,
+    window: Optional[int] = None,
 ):
     """One (q-shard × k-shard) flash tile over [B, S, H, D].
 
@@ -202,7 +259,9 @@ def flash_attention_tile(
 
     vma: mesh axis names the outputs vary over — required when called
     inside shard_map (the ring passes its sequence axis).
+    window: causal sliding window W (q-W < k <= q) in GLOBAL positions.
     """
+    _check_window(window, causal)
     if not interpret and jax.default_backend() != "tpu":
         raise ValueError(
             "flash_attention_tile compiles only on TPU; pass interpret=True "
@@ -237,7 +296,7 @@ def flash_attention_tile(
     o, l, m = pl.pallas_call(
         functools.partial(
             _flash_tile_kernel, block_k=bk, scale=scale, causal=causal,
-            precision=_dot_precision(q.dtype),
+            precision=_dot_precision(q.dtype), window=window,
         ),
         out_shape=(
             out_struct((bh, s_q, dim)),
@@ -283,7 +342,8 @@ def _pick_block(size: int, preferred: int) -> Optional[int]:
 
 
 def _flash_attention_fwd_impl(
-    q, k, v, offsets, causal, scale, block_q, block_k, interpret
+    q, k, v, offsets, causal, scale, block_q, block_k, interpret,
+    window=None,
 ):
     from jax.experimental.pallas import tpu as pltpu
 
@@ -300,7 +360,7 @@ def _flash_attention_fwd_impl(
     out = pl.pallas_call(
         functools.partial(
             _flash_kernel, block_k=block_k, scale=scale, causal=causal,
-            precision=_dot_precision(q.dtype),
+            precision=_dot_precision(q.dtype), window=window,
         ),
         out_shape=jax.ShapeDtypeStruct((bh, s_q, dim), q.dtype),
         grid=grid,
@@ -317,7 +377,7 @@ def _flash_attention_fwd_impl(
 
 
 def _bwd_tile(q_scaled, k_blk, v_blk, do_blk, lse, delta, q_pos, k_pos,
-              causal, precision=None):
+              causal, precision=None, window=None):
     """Shared backward-tile recompute: probabilities and dS for one
     (q-tile x k-tile) pair, from the saved row stats.
 
@@ -335,7 +395,10 @@ def _bwd_tile(q_scaled, k_blk, v_blk, do_blk, lse, delta, q_pos, k_pos,
     )
     p = jnp.exp(s - lse)
     if causal:
-        p = jnp.where(q_pos >= k_pos, p, 0.0)
+        visible = q_pos >= k_pos
+        if window is not None:
+            visible = visible & (q_pos - k_pos < window)
+        p = jnp.where(visible, p, 0.0)
     dp = jax.lax.dot_general(
         do_blk, v_blk,
         dimension_numbers=(((1,), (1,)), ((), ())),
@@ -360,6 +423,7 @@ def _flash_bwd_dq_kernel(
     scale: float,
     causal: bool,
     precision: Optional[lax.Precision] = None,
+    window: Optional[int] = None,
 ):
     """dQ_i = scale * sum_j dS_ij K_j, with P recomputed per k-tile from
     the saved row stats (FlashAttention-2 backward, query-parallel half)."""
@@ -379,6 +443,12 @@ def _flash_bwd_dq_kernel(
         + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
     )
 
+    # Same k-block visibility bounds as the forward (shared helper).
+    q0 = offsets_ref[0] + qi * block_q
+    j_lo, j_hi = _k_block_bounds(
+        q0, block_q, block_k, num_kb, offsets_ref[1], causal, window
+    )
+
     def body(j, acc):
         k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
         v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
@@ -388,7 +458,7 @@ def _flash_bwd_dq_kernel(
             + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
         )
         _, ds = _bwd_tile(q, k_blk, v_blk, do, lse, delta, q_pos, k_pos,
-                          causal, precision)
+                          causal, precision, window)
         return acc + jax.lax.dot_general(
             ds, k_blk,
             dimension_numbers=(((1,), (0,)), ((), ())),
@@ -396,7 +466,9 @@ def _flash_bwd_dq_kernel(
             precision=precision,
         )
 
-    acc = lax.fori_loop(0, num_kb, body, jnp.zeros((block_q, dim), jnp.float32))
+    acc = lax.fori_loop(
+        j_lo, j_hi, body, jnp.zeros((block_q, dim), jnp.float32)
+    )
     dq_ref[0] = (acc * scale).astype(dq_ref.dtype)
 
 
@@ -415,6 +487,7 @@ def _flash_bwd_dkv_kernel(
     scale: float,
     causal: bool,
     precision: Optional[lax.Precision] = None,
+    window: Optional[int] = None,
 ):
     """dK_j = scale * sum_i dS_ij^T Q_i; dV_j = sum_i P_ij^T dO_i (the
     key-parallel half: each grid step owns one k-tile, loops q-tiles)."""
@@ -432,6 +505,24 @@ def _flash_bwd_dkv_kernel(
         + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
     )
 
+    # q-block visibility bounds for this k block (the forward's relation
+    # transposed): causal keeps q blocks whose max q >= the block's min k;
+    # the window keeps q blocks whose min q <= max k + W - 1.
+    k0 = offsets_ref[1] + ki * block_k
+    i_lo = 0
+    i_hi = num_qb
+    if causal:
+        i_lo = jnp.maximum(0, (k0 - offsets_ref[0]) // block_q)
+    if window is not None:
+        i_hi = jnp.maximum(
+            0,
+            jnp.minimum(
+                num_qb,
+                (k0 + block_k - 1 + window - 1 - offsets_ref[0]) // block_q
+                + 1,
+            ),
+        )
+
     def body(i, carry):
         dk_acc, dv_acc = carry
         q_blk = (
@@ -447,7 +538,7 @@ def _flash_bwd_dkv_kernel(
             + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
         )
         p, ds = _bwd_tile(q_blk, k_blk, v_blk, do_blk, lse, delta, q_pos,
-                          k_pos, causal, precision)
+                          k_pos, causal, precision, window)
         dv_acc = dv_acc + jax.lax.dot_general(
             p, do_blk,
             dimension_numbers=(((0,), (0,)), ((), ())),
@@ -463,8 +554,8 @@ def _flash_bwd_dkv_kernel(
         return dk_acc, dv_acc
 
     dk_acc, dv_acc = lax.fori_loop(
-        0,
-        num_qb,
+        i_lo,
+        i_hi,
         body,
         (
             jnp.zeros((block_k, dim), jnp.float32),
@@ -502,6 +593,7 @@ def flash_attention_bwd_tile(
     block_k: int = 128,
     interpret: bool = False,
     vma=None,
+    window: Optional[int] = None,
 ):
     """Backward of one (q-shard x k-shard) tile: (dq, dk, dv).
 
@@ -512,7 +604,9 @@ def flash_attention_bwd_tile(
     and sends dk/dv around with the k/v blocks. All outputs f32.
 
     vma: mesh axis names the outputs vary over (shard_map callers).
+    window: causal sliding window W in GLOBAL positions.
     """
+    _check_window(window, causal)
     if not interpret and jax.default_backend() != "tpu":
         raise ValueError(
             "flash_attention_bwd_tile compiles only on TPU; pass "
@@ -554,7 +648,7 @@ def flash_attention_bwd_tile(
     dq = pl.pallas_call(
         functools.partial(
             _flash_bwd_dq_kernel, block_k=bk, scale=scale, causal=causal,
-            precision=_dot_precision(q.dtype),
+            precision=_dot_precision(q.dtype), window=window,
         ),
         out_shape=out_struct((bh, s_q, dim)),
         grid=(bh, s_q // bq),
@@ -574,7 +668,7 @@ def flash_attention_bwd_tile(
     dk, dv = pl.pallas_call(
         functools.partial(
             _flash_bwd_dkv_kernel, block_q=bq, scale=scale, causal=causal,
-            precision=_dot_precision(q.dtype),
+            precision=_dot_precision(q.dtype), window=window,
         ),
         out_shape=(
             out_struct((bh, s_k, dim)),
@@ -604,26 +698,31 @@ def flash_attention_bwd_tile(
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9)
+    jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10)
 )
 def _flash_attention(
-    q, k, v, q_offset, k_offset, causal, scale, block_q, block_k, interpret
+    q, k, v, q_offset, k_offset, causal, scale, block_q, block_k, interpret,
+    window,
 ):
     offsets = jnp.stack(
         [jnp.asarray(q_offset, jnp.int32), jnp.asarray(k_offset, jnp.int32)]
     )
     return _flash_attention_fwd_impl(
-        q, k, v, offsets, causal, scale, block_q, block_k, interpret
+        q, k, v, offsets, causal, scale, block_q, block_k, interpret, window
     )
 
 
-def _fwd(q, k, v, q_offset, k_offset, causal, scale, block_q, block_k, interpret):
+def _fwd(
+    q, k, v, q_offset, k_offset, causal, scale, block_q, block_k, interpret,
+    window,
+):
     # Forward via the tile kernel so the row stats (l, m) come out as
     # residuals; normalization happens here (one O(S*D) elementwise pass).
     o, l, m = flash_attention_tile(
         q, k, v, causal=causal, scale=scale,
         q_offset=q_offset, k_offset=k_offset,
         block_q=block_q, block_k=block_k, interpret=interpret,
+        window=window,
     )
     l_safe = jnp.maximum(l, 1e-30)
     out = (o / jnp.transpose(l_safe, (0, 2, 1))[..., None]).astype(q.dtype)
@@ -631,7 +730,7 @@ def _fwd(q, k, v, q_offset, k_offset, causal, scale, block_q, block_k, interpret
     return out, (q, k, v, out, lse, q_offset, k_offset)
 
 
-def _bwd(causal, scale, block_q, block_k, interpret, residuals, g):
+def _bwd(causal, scale, block_q, block_k, interpret, window, residuals, g):
     q, k, v, out, lse, q_offset, k_offset = residuals
     dq, dk, dv = flash_attention_bwd_tile(
         q, k, v, g,
@@ -640,6 +739,7 @@ def _bwd(causal, scale, block_q, block_k, interpret, residuals, g):
         causal=causal, scale=scale,
         q_offset=q_offset, k_offset=k_offset,
         block_q=block_q, block_k=block_k, interpret=interpret,
+        window=window,
     )
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype), None, None
 
@@ -658,6 +758,7 @@ def flash_attention(
     block_q: int = 128,
     block_k: int = 128,
     interpret: Optional[bool] = None,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Attention over [B, S, H, D] with the flash recurrence on TPU.
 
@@ -665,9 +766,14 @@ def flash_attention(
     test path) and for sequence lengths with no usable block divisor.
     q_offset/k_offset shift the global positions of the q/k shards for the
     causal mask (ring-attention tiles).
+
+    window=W restricts each query to the last W keys (causal sliding
+    window, q-W < k <= q): the kernel skips k blocks wholly outside the
+    window, so long-context compute drops from O(S^2) to O(S*W).
     """
     if q.ndim != 4:
         raise ValueError(f"Expected [B, S, H, D], got {q.shape}")
+    _check_window(window, causal)
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     if interpret is None:
         interpret = False
@@ -677,15 +783,15 @@ def flash_attention(
     if jax.default_backend() != "tpu" and not interpret:
         return reference_attention(
             q, k, v, causal=causal, scale=scale,
-            q_offset=q_offset, k_offset=k_offset,
+            q_offset=q_offset, k_offset=k_offset, window=window,
         )
     bq = _pick_block(q.shape[1], block_q)
     bk = _pick_block(k.shape[1], block_k)
     if bq is None or bk is None:
         return reference_attention(
             q, k, v, causal=causal, scale=scale,
-            q_offset=q_offset, k_offset=k_offset,
+            q_offset=q_offset, k_offset=k_offset, window=window,
         )
     return _flash_attention(
-        q, k, v, q_offset, k_offset, causal, scale, bq, bk, interpret
+        q, k, v, q_offset, k_offset, causal, scale, bq, bk, interpret, window
     )

@@ -39,6 +39,64 @@ class TestTransformerBCModel:
         )
         assert outputs["inference_output"].shape == (2, 8, 3)
 
+    def test_attention_window_trains_and_bounds_context(self):
+        """A windowed model trains end to end, and the window genuinely
+        bounds context: with window=W, output at step t is INDEPENDENT of
+        inputs more than W steps back (full attention is not)."""
+        import numpy as np
+
+        episode = 12
+        window = 3
+        model = TransformerBCModel(
+            action_size=3, episode_length=episode, image_size=(16, 16),
+            use_flash=False, attention_window=window,
+        )
+        batch = _batch(model, batch_size=2)
+        compiled = CompiledModel(model, donate_state=False)
+        state = compiled.init_state(jax.random.PRNGKey(0), batch)
+        state, metrics = compiled.train_step(
+            state, compiled.shard_batch(batch), jax.random.PRNGKey(1)
+        )
+        assert np.isfinite(float(jax.device_get(metrics["loss"])))
+
+        variables = model.init_variables(
+            jax.random.PRNGKey(0), batch["features"]
+        )
+
+        def out_last(features):
+            outputs, _ = model.inference_network_fn(
+                variables, features, "eval"
+            )
+            return np.asarray(outputs["inference_output"])[:, -1]
+
+        base = out_last(batch["features"])
+        # Perturb an early step (more than `window` before the last one):
+        # the last step's output must not move.
+        perturbed = jax.tree_util.tree_map(lambda x: x, batch["features"])
+        img = np.array(perturbed["image"])
+        img[:, 0] = img[:, 0] + 10.0
+        perturbed["image"] = img
+        np.testing.assert_allclose(out_last(perturbed), base, atol=1e-5)
+
+        # Control: the FULL-attention twin does depend on step 0.
+        full = TransformerBCModel(
+            action_size=3, episode_length=episode, image_size=(16, 16),
+            use_flash=False,
+        )
+        full_vars = full.init_variables(
+            jax.random.PRNGKey(0), batch["features"]
+        )
+
+        def full_last(features):
+            outputs, _ = full.inference_network_fn(
+                full_vars, features, "eval"
+            )
+            return np.asarray(outputs["inference_output"])[:, -1]
+
+        assert not np.allclose(
+            full_last(perturbed), full_last(batch["features"]), atol=1e-5
+        )
+
     def test_trains_on_sequence_mesh(self):
         """End to end through CompiledModel with the episode sharded over
         the sequence axis — ring attention inside the real train step."""
