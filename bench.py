@@ -27,6 +27,7 @@ all-window average in detail for honesty.
 from __future__ import annotations
 
 import json
+import os
 import statistics
 import sys
 import time
@@ -495,11 +496,15 @@ def bench_predict() -> None:
 
 def _analytic_bc_train_flops(
     batch, steps, image, d_model, num_layers, num_heads, head_dim,
-    pose=14, action=7, mlp_ratio=4,
+    pose=14, action=7, mlp_ratio=4, attn_window=None,
 ) -> float:
     """One transformer-BC train step (fwd x3): conv embed + causal
     attention + MLP MACs x2. Analytic because the flash path's Pallas
-    FLOPs are invisible to XLA cost analysis."""
+    FLOPs are invisible to XLA cost analysis.
+
+    attn_window counts only the USEFUL windowed pairs (sum_t min(t+1, W)
+    = S*W - W*(W-1)/2) so the windowed metric cannot inflate its MFU with
+    work the kernel skipped."""
     bt = float(batch * steps)
     h = image // 2
     flops = 2.0 * bt * h * h * 9 * 3 * 32  # conv1 3->32 /2
@@ -507,7 +512,12 @@ def _analytic_bc_train_flops(
     flops += 2.0 * bt * h * h * 9 * 32 * 64  # conv2 32->64 /2
     flops += 2.0 * bt * (2 * 64 + pose) * d_model  # embed dense
     per_layer = (8.0 + 2.0 * mlp_ratio * 2.0) * bt * d_model * d_model
-    attn = 2.0 * batch * steps * steps * (num_heads * head_dim)  # causal half
+    if attn_window:
+        w = min(attn_window, steps)
+        pairs = float(steps) * w - w * (w - 1) / 2.0
+    else:
+        pairs = float(steps) * steps / 2.0  # causal half
+    attn = 4.0 * batch * pairs * (num_heads * head_dim)  # QK^T + PV MACs
     flops += num_layers * (per_layer + attn)
     flops += 2.0 * bt * d_model * action
     return flops * 3.0
@@ -535,11 +545,18 @@ def bench_bc() -> None:
         d_model, num_layers, num_heads, head_dim = 256, 4, 8, 32
         n_windows, window = 8, 10
         metric = f"transformer_bc_train_mfu_b{batch}_t{steps}"
+        # BENCH_BC_WINDOW=W benches the sliding-window variant (O(T*W)
+        # attention) under a distinct metric name for the full-vs-window
+        # on-chip comparison.
+        attn_window = int(os.environ.get("BENCH_BC_WINDOW", "0")) or None
+        if attn_window:
+            metric += f"_w{attn_window}"
     else:
         batch, steps, image = 2, 64, 16
         d_model, num_layers, num_heads, head_dim = 32, 2, 2, 16
         n_windows, window = 3, 3
         metric = "transformer_bc_train_mfu_cpu_proxy"
+        attn_window = None
 
     try:
         from tensor2robot_tpu.models.transformer_models import (
@@ -556,6 +573,7 @@ def bench_bc() -> None:
             num_layers=num_layers,
             num_heads=num_heads,
             head_dim=head_dim,
+            attention_window=attn_window,
         )
         batch_np = {
             "features": make_random_numpy(
@@ -576,7 +594,8 @@ def bench_bc() -> None:
         rng = jax.random.PRNGKey(1)
 
         flops_per_step = _analytic_bc_train_flops(
-            batch, steps, image, d_model, num_layers, num_heads, head_dim
+            batch, steps, image, d_model, num_layers, num_heads, head_dim,
+            attn_window=attn_window,
         )
 
         box = {"state": state}
