@@ -17,7 +17,7 @@
 set -u
 cd /root/repo
 
-tries="${CHIP_WORKER_TRIES:-60}"
+tries="${CHIP_WORKER_TRIES:-140}"
 sleep_s="${CHIP_WORKER_SLEEP:-300}"
 
 log() { echo "chip_worker4: $* $(date -u +%H:%M:%S)" >&2; }
