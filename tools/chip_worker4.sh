@@ -93,6 +93,14 @@ for i in $(seq 1 "$tries"); do
     cp /tmp/w4_bc.json BENCH_BC_r03.json
     commit_artifact BENCH_BC_r03.json "On-chip long-context BC train MFU"
   fi
+  # Sliding-window variant (O(T*W) attention): the full-vs-window delta
+  # on the same chip in the same session.
+  BENCH_BACKEND_WAIT=240 BENCH_BC_WINDOW=128 python bench.py bc \
+    > /tmp/w4_bcw.json 2>/tmp/w4_bcw.err || true
+  if grep -q '_w128"' /tmp/w4_bcw.json; then
+    cp /tmp/w4_bcw.json BENCH_BC_r03_w128.json
+    commit_artifact BENCH_BC_r03_w128.json "Windowed (W=128) BC train MFU"
+  fi
 
   # Batch 128 plain first (the stem bf16 cast roughly halves stem
   # activation memory, so bs128 may fit without remat); remat variant as
