@@ -39,10 +39,10 @@ class MultiHeadAttention(nn.Module):
     mesh: Optional[object] = None
     use_flash: Optional[bool] = None
     interpret: bool = False
-    # Causal sliding window W (each query attends to its last W steps);
-    # single-device flash/reference paths only — the sequence-parallel
-    # strategies reject it until their hop/scatter schedules learn to
-    # skip out-of-window work.
+    # Causal sliding window W (each query attends to its last W steps).
+    # Works on every path: single-device flash tightens its k-block loop,
+    # the ring truncates its rotation to the hops carrying visible tiles,
+    # and ulysses passes W to its full-sequence local attention.
     window: Optional[int] = None
     # Context-parallel strategy when the mesh's sequence axis is >1:
     # "ring" (K/V rotate, O(seq/N) memory/device) or "ulysses" (head-
@@ -72,11 +72,6 @@ class MultiHeadAttention(nn.Module):
             if self.mesh is not None
             else 1
         )
-        if self.window is not None and sequence_axis > 1:
-            raise NotImplementedError(
-                "window is not yet supported with sequence parallelism; "
-                "run windowed attention on a mesh without a sequence axis"
-            )
         if sequence_axis > 1 and self.sequence_parallel_mode == "ulysses":
             from tensor2robot_tpu.parallel.ulysses_attention import (
                 ulysses_attention,
@@ -85,6 +80,7 @@ class MultiHeadAttention(nn.Module):
             out = ulysses_attention(
                 q, k, v, mesh=self.mesh, causal=self.causal,
                 use_flash=self.use_flash, interpret=self.interpret,
+                window=self.window,
             )
         elif sequence_axis > 1:
             from tensor2robot_tpu.parallel.ring_attention import ring_attention
@@ -92,6 +88,7 @@ class MultiHeadAttention(nn.Module):
             out = ring_attention(
                 q, k, v, mesh=self.mesh, causal=self.causal,
                 use_flash=self.use_flash, interpret=self.interpret,
+                window=self.window,
             )
         elif self.use_flash is False:
             # Explicit opt-out: the einsum reference on any backend.

@@ -48,7 +48,20 @@ def _mark_varying(tree, axis_name):
     return tree  # pragma: no cover - jax without vma tracking
 
 
-def _block_attend(q, k_blk, v_blk, q_offset, k_offset, scale, causal):
+def _ring_hops(axis_size: int, block: int, causal: bool,
+               window: Optional[int]) -> int:
+    """Compute hops the ring actually needs. Visibility of the block
+    arriving at hop i depends only on i (src = me - i uniformly), so with
+    a causal window W over per-device shards of length B, every hop past
+    floor((W + B - 2) / B) delivers a fully-masked tile on EVERY device —
+    the ring truncates to that many hops, device-uniformly."""
+    if not causal or window is None:
+        return axis_size
+    return min(axis_size, (window + block - 2) // block + 1)
+
+
+def _block_attend(q, k_blk, v_blk, q_offset, k_offset, scale, causal,
+                  window=None):
     """One (q-shard x k-block) tile: returns (o_partial, row_sum, row_max)
     in the online-softmax decomposition."""
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk) * scale
@@ -56,6 +69,8 @@ def _block_attend(q, k_blk, v_blk, q_offset, k_offset, scale, causal):
         q_pos = q_offset + jnp.arange(q.shape[1])
         k_pos = k_offset + jnp.arange(k_blk.shape[1])
         mask = q_pos[:, None] >= k_pos[None, :]
+        if window is not None:
+            mask = mask & (q_pos[:, None] - k_pos[None, :] < window)
         s = jnp.where(mask[None, None], s, _NEG_INF)
     m = jnp.max(s, axis=-1)  # [B, H, Sq]
     p = jnp.exp(s - m[..., None])
@@ -69,7 +84,7 @@ def _block_attend(q, k_blk, v_blk, q_offset, k_offset, scale, causal):
 def _ring_shard_fn(
     q, k, v, *, axis_name: str, causal: bool, scale: float,
     axis_size: int, use_flash: bool = False, interpret: bool = False,
-    return_lse: bool = False,
+    return_lse: bool = False, window: Optional[int] = None,
 ):
     """Per-device body: q is resident; k/v circulate the ring.
 
@@ -104,11 +119,12 @@ def _ring_shard_fn(
             o_blk, l_blk, m_blk = flash_attention_tile(
                 q, k_blk, v_blk, causal=causal, scale=scale,
                 q_offset=q_offset, k_offset=src_index * block,
-                interpret=interpret, vma=(axis_name,),
+                interpret=interpret, vma=(axis_name,), window=window,
             )
         else:
             o_blk, l_blk, m_blk = _block_attend(
-                q, k_blk, v_blk, q_offset, src_index * block, scale, causal
+                q, k_blk, v_blk, q_offset, src_index * block, scale, causal,
+                window,
             )
         # Online-softmax merge of the new tile into the running state.
         m_new = jnp.maximum(m_acc, m_blk)
@@ -127,7 +143,9 @@ def _ring_shard_fn(
         return o_new, l_new, m_new, k_next, v_next
 
     carry = (o_acc, l_acc, m_acc, k, v)
-    for i in range(axis_size):  # static unroll — axis_size is mesh shape
+    # Static unroll — axis_size is mesh shape; a causal window truncates
+    # the rotation to the hops whose tiles are not fully masked.
+    for i in range(_ring_hops(axis_size, block, causal, window)):
         carry = body(i, carry)
     o_acc, l_acc, m_acc, _, _ = carry
     l_acc = jnp.maximum(l_acc, 1e-30)
@@ -148,6 +166,7 @@ def ring_attention(
     scale: Optional[float] = None,
     use_flash: Optional[bool] = None,
     interpret: bool = False,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Sequence-parallel attention over `mesh`'s `axis_name`.
 
@@ -161,12 +180,20 @@ def ring_attention(
       use_flash: per-hop tiles via the Pallas flash kernel
         (ops/flash_attention.py). Default: on for the TPU backend.
       interpret: run the Pallas kernel in interpreter mode (tests on CPU).
+      window: causal sliding window W in GLOBAL positions. Besides the
+        per-tile masking, the ring itself truncates: only
+        ceil((W + B - 2) / B) + 1-ish hops of the rotation carry visible
+        tiles (B = per-device shard), so a bounded window makes ring cost
+        independent of the TOTAL context length.
 
     Returns:
       [batch, seq, heads, dim] attention output, sequence-sharded like q.
     """
     if q.ndim != 4:
         raise ValueError(f"Expected [B, S, H, D], got {q.shape}")
+    from tensor2robot_tpu.ops.flash_attention import _check_window
+
+    _check_window(window, causal)
     axis_size = mesh.shape[axis_name]
     if q.shape[1] % axis_size != 0:
         raise ValueError(
@@ -188,12 +215,16 @@ def ring_attention(
             if _pick_block(local, 128) is None:
                 use_flash = False
     if use_flash:
-        return _ring_flash(q, k, v, mesh, axis_name, causal, scale, interpret)
-    return _ring_call(q, k, v, mesh, axis_name, causal, scale, False, False)
+        return _ring_flash(
+            q, k, v, mesh, axis_name, causal, scale, interpret, window
+        )
+    return _ring_call(
+        q, k, v, mesh, axis_name, causal, scale, False, False, window=window
+    )
 
 
 def _ring_call(q, k, v, mesh, axis_name, causal, scale, use_flash, interpret,
-               return_lse=False):
+               return_lse=False, window=None):
     axis_size = mesh.shape[axis_name]
     spec = P(None, axis_name, None, None)
     extra = {}
@@ -206,7 +237,7 @@ def _ring_call(q, k, v, mesh, axis_name, causal, scale, use_flash, interpret,
         functools.partial(
             _ring_shard_fn, axis_name=axis_name, causal=causal, scale=scale,
             axis_size=axis_size, use_flash=use_flash, interpret=interpret,
-            return_lse=return_lse,
+            return_lse=return_lse, window=window,
         ),
         mesh=mesh,
         in_specs=(spec, spec, spec),
@@ -218,7 +249,7 @@ def _ring_call(q, k, v, mesh, axis_name, causal, scale, use_flash, interpret,
 
 def _ring_bwd_shard_fn(
     q, k, v, dout, out, lse, *, axis_name: str, causal: bool, scale: float,
-    axis_size: int, interpret: bool,
+    axis_size: int, interpret: bool, window: Optional[int] = None,
 ):
     """Backward ring: dq accumulates on the q-owner; dk/dv contributions
     RIDE THE RING with their k/v blocks, so after the full rotation each
@@ -244,15 +275,16 @@ def _ring_bwd_shard_fn(
     )
     perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
 
+    hops = _ring_hops(axis_size, block, causal, window)
     carry = (dq_acc, dk_travel, dv_travel, k, v)
-    for i in range(axis_size):  # static unroll, as in the forward ring
+    for i in range(hops):  # static unroll, as in the forward ring
         dq_acc, dk_travel, dv_travel, k_blk, v_blk = carry
         src_index = lax.rem(my_index - i + axis_size, axis_size)
         dq_t, dk_t, dv_t = flash_attention_bwd_tile(
             q, k_blk, v_blk, dout, lse, delta,
             causal=causal, scale=scale,
             q_offset=q_offset, k_offset=src_index * block,
-            interpret=interpret, vma=(axis_name,),
+            interpret=interpret, vma=(axis_name,), window=window,
         )
         dq_acc = dq_acc + dq_t
         dk_travel = dk_travel + dk_t
@@ -265,6 +297,13 @@ def _ring_bwd_shard_fn(
         )
         carry = (dq_acc, dk_travel, dv_travel, k_blk, v_blk)
     dq_acc, dk_travel, dv_travel, _, _ = carry
+    if hops < axis_size:
+        # A truncated rotation leaves each traveling gradient `hops` shifts
+        # from home; one ppermute with the remaining shift delivers it.
+        home = [(j, (j + axis_size - hops) % axis_size)
+                for j in range(axis_size)]
+        dk_travel = lax.ppermute(dk_travel, axis_name, home)
+        dv_travel = lax.ppermute(dv_travel, axis_name, home)
     return (
         dq_acc.astype(q.dtype),
         dk_travel.astype(k.dtype),
@@ -272,24 +311,29 @@ def _ring_bwd_shard_fn(
     )
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _ring_flash(q, k, v, mesh, axis_name, causal, scale, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _ring_flash(q, k, v, mesh, axis_name, causal, scale, interpret, window):
     """Flash-tile ring forward with a flash ring BACKWARD: pallas_call has
     no autodiff rule, so the custom vjp runs a second ring whose hops are
     the FlashAttention-2 backward kernels (flash_attention_bwd_tile) —
     O(seq/devices * dim) memory in both directions."""
-    return _ring_call(q, k, v, mesh, axis_name, causal, scale, True, interpret)
+    return _ring_call(
+        q, k, v, mesh, axis_name, causal, scale, True, interpret,
+        window=window,
+    )
 
 
-def _ring_flash_fwd(q, k, v, mesh, axis_name, causal, scale, interpret):
+def _ring_flash_fwd(q, k, v, mesh, axis_name, causal, scale, interpret,
+                    window):
     out, lse = _ring_call(
         q, k, v, mesh, axis_name, causal, scale, True, interpret,
-        return_lse=True,
+        return_lse=True, window=window,
     )
     return out, (q, k, v, out, lse)
 
 
-def _ring_flash_bwd(mesh, axis_name, causal, scale, interpret, residuals, g):
+def _ring_flash_bwd(mesh, axis_name, causal, scale, interpret, window,
+                    residuals, g):
     q, k, v, out, lse = residuals
     axis_size = mesh.shape[axis_name]
     spec = P(None, axis_name, None, None)
@@ -298,6 +342,7 @@ def _ring_flash_bwd(mesh, axis_name, causal, scale, interpret, residuals, g):
         functools.partial(
             _ring_bwd_shard_fn, axis_name=axis_name, causal=causal,
             scale=scale, axis_size=axis_size, interpret=interpret,
+            window=window,
         ),
         mesh=mesh,
         in_specs=(spec, spec, spec, spec, spec, lse_spec),

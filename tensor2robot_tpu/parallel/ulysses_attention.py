@@ -43,7 +43,7 @@ from tensor2robot_tpu.parallel.mesh import SEQUENCE_AXIS
 
 def _ulysses_shard_fn(
     q, k, v, *, axis_name: str, causal: bool, scale: float,
-    use_flash: bool, interpret: bool,
+    use_flash: bool, interpret: bool, window=None,
 ):
     """Per-device body: seq-sharded in, seq-sharded out.
 
@@ -70,11 +70,12 @@ def _ulysses_shard_fn(
     if use_flash:
         out = flash_attention(
             q_local, k_local, v_local, causal=causal, scale=scale,
-            interpret=interpret,
+            interpret=interpret, window=window,
         )
     else:
         out = reference_attention(
-            q_local, k_local, v_local, causal=causal, scale=scale
+            q_local, k_local, v_local, causal=causal, scale=scale,
+            window=window,
         )
     return gather_heads(out)
 
@@ -89,6 +90,7 @@ def ulysses_attention(
     scale: Optional[float] = None,
     use_flash: Optional[bool] = None,
     interpret: bool = False,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Sequence-parallel attention via head-scatter all_to_all.
 
@@ -99,6 +101,9 @@ def ulysses_attention(
     """
     if q.ndim != 4:
         raise ValueError(f"Expected [B, S, H, D], got {q.shape}")
+    from tensor2robot_tpu.ops.flash_attention import _check_window
+
+    _check_window(window, causal)
     axis_size = mesh.shape[axis_name]
     _, seq, heads, _ = q.shape
     if seq % axis_size != 0:
@@ -126,6 +131,7 @@ def ulysses_attention(
         functools.partial(
             _ulysses_shard_fn, axis_name=axis_name, causal=causal,
             scale=scale, use_flash=use_flash, interpret=interpret,
+            window=window,
         ),
         mesh=mesh,
         in_specs=(spec, spec, spec),

@@ -65,6 +65,77 @@ class TestRingAttention:
                 np.asarray(rg), np.asarray(fg), atol=5e-5, rtol=5e-5
             )
 
+    @pytest.mark.parametrize("window", [3, 8, 13, 100])
+    @pytest.mark.parametrize("n_shards", [4, 8])
+    def test_sliding_window_matches_reference(self, window, n_shards):
+        """Windowed ring == windowed full attention for windows smaller
+        than a shard, shard-straddling, and wider than the sequence. Also
+        exercises the ring's hop TRUNCATION (fewer hops than shards when
+        W is small) — an over-truncated rotation would break numerics."""
+        mesh = mesh_lib.make_mesh(
+            data=1, sequence=n_shards, devices=jax.devices()[:n_shards]
+        )
+        q, k, v = _qkv()
+        expected = reference_attention(
+            q, k, v, causal=True, window=window
+        )
+        actual = ring_attention(
+            q, k, v, mesh=mesh, causal=True, window=window
+        )
+        np.testing.assert_allclose(
+            np.asarray(actual), np.asarray(expected), atol=2e-5, rtol=2e-5
+        )
+
+    @pytest.mark.parametrize("use_flash", [False, True])
+    def test_sliding_window_gradients(self, use_flash):
+        """Windowed gradients match the windowed reference on BOTH ring
+        engines. The flash variant (interpret mode) is the one that
+        exercises the truncated backward ring's homeward ppermute: with
+        window=5 over 4-step shards the rotation truncates to 2 of 4
+        hops, so the traveling dk/dv must take the final shift to reach
+        their owners — a wrong shift corrupts dk/dv only on this path."""
+        mesh = mesh_lib.make_mesh(
+            data=1, sequence=4, devices=jax.devices()[:4]
+        )
+        q, k, v = _qkv(seq=16)
+        window = 5  # straddles the 4-step shards: hops = 2 of 4
+
+        def ring_loss(q, k, v):
+            return jnp.sum(
+                ring_attention(
+                    q, k, v, mesh=mesh, causal=True, window=window,
+                    use_flash=use_flash, interpret=use_flash,
+                )
+            )
+
+        def full_loss(q, k, v):
+            return jnp.sum(
+                reference_attention(q, k, v, causal=True, window=window)
+            )
+
+        ring_grads = jax.grad(ring_loss, argnums=(0, 1, 2))(q, k, v)
+        full_grads = jax.grad(full_loss, argnums=(0, 1, 2))(q, k, v)
+        for rg, fg in zip(ring_grads, full_grads):
+            np.testing.assert_allclose(
+                np.asarray(rg), np.asarray(fg), atol=5e-5, rtol=5e-5
+            )
+
+    def test_window_hop_truncation_counts(self):
+        from tensor2robot_tpu.parallel.ring_attention import _ring_hops
+
+        # W within one shard: own block + previous = 2 hops.
+        assert _ring_hops(8, 16, True, 16) == 2
+        assert _ring_hops(8, 16, True, 1) == 1
+        # W=17 reaches exactly the start of the previous 16-block (2 hops);
+        # W=18 crosses into the one before (3 hops).
+        assert _ring_hops(8, 16, True, 17) == 2
+        assert _ring_hops(8, 16, True, 18) == 3
+        # Wider than the ring: all hops.
+        assert _ring_hops(4, 16, True, 1000) == 4
+        # No window / no causal: full rotation.
+        assert _ring_hops(8, 16, True, None) == 8
+        assert _ring_hops(8, 16, False, None) == 8
+
     def test_uneven_shard_rejected(self):
         mesh = mesh_lib.make_mesh(
             data=1, sequence=8, devices=jax.devices()[:8]

@@ -45,6 +45,25 @@ class TestUlyssesAttention:
             np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-4
         )
 
+    @pytest.mark.parametrize("window", [5, 11, 1000])
+    def test_sliding_window_matches_reference(self, window):
+        """After the head scatter each device holds the full sequence, so
+        the window applies directly in the local attention."""
+        n = 4
+        mesh = _mesh(n)
+        rng = np.random.RandomState(2)
+        q, k, v = (
+            jnp.asarray(rng.randn(2, 8 * n, 4, 8).astype(np.float32))
+            for _ in range(3)
+        )
+        ref = reference_attention(q, k, v, causal=True, window=window)
+        out = ulysses_attention(
+            q, k, v, mesh=mesh, causal=True, use_flash=False, window=window
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-5
+        )
+
     def test_gradients_match_reference(self):
         n = 4
         mesh = _mesh(n)
