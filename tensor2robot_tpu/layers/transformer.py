@@ -19,6 +19,7 @@ from typing import Optional
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from tensor2robot_tpu.ops import flash_attention as flash_lib
 from tensor2robot_tpu.parallel import mesh as mesh_lib
@@ -48,6 +49,13 @@ class MultiHeadAttention(nn.Module):
     # "ring" (K/V rotate, O(seq/N) memory/device) or "ulysses" (head-
     # scatter all_to_all, one collective round, needs heads % N == 0).
     sequence_parallel_mode: str = "ring"
+    # Incremental decoding: calls carry ONE new step ([B, 1, F]) which is
+    # appended to a K/V cache ("cache" variable collection, capacity
+    # decode_max_len) and attended against the cached prefix — the
+    # streaming-serving mode (O(cache) per step; O(window) when a window
+    # caps it). Requires causal=True and no sequence-parallel mesh.
+    decode: bool = False
+    decode_max_len: int = 2048
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -60,6 +68,10 @@ class MultiHeadAttention(nn.Module):
             return t.reshape(batch, seq, self.num_heads, self.head_dim)
 
         q, k, v = heads(q), heads(k), heads(v)
+        if self.decode:
+            out = self._decode_step(q, k, v)
+            out = out.reshape(batch, seq, features)
+            return nn.Dense(x.shape[-1], use_bias=False, name="out")(out)
         if self.sequence_parallel_mode not in ("ring", "ulysses"):
             # Validate eagerly — a typo must fail on the laptop run, not
             # only once the config reaches a multi-device CP mesh.
@@ -103,6 +115,85 @@ class MultiHeadAttention(nn.Module):
         out = out.reshape(batch, seq, features)
         return nn.Dense(x.shape[-1], use_bias=False, name="out")(out)
 
+    def _decode_step(self, q, k, v):
+        """Appends this step's k/v to the cache and attends q against the
+        cached prefix. One step per call ([B, 1, H, D]); with a window,
+        attention reads only the last `window` cache slots (dynamic_slice
+        with clamped start), so per-step cost is O(window) not O(max_len).
+
+        Cache lifecycle: `init` RUNS the module, so the cache it returns
+        has already consumed the init step — zero it before the first real
+        step (`jax.tree_util.tree_map(jnp.zeros_like, variables["cache"])`)
+        and thread the mutated collection between calls
+        (`apply(..., mutable=["cache"])`).
+        """
+        if not self.causal:
+            raise ValueError("decode mode requires causal=True")
+        if self.mesh is not None and (
+            dict(self.mesh.shape).get(mesh_lib.SEQUENCE_AXIS, 1) > 1
+        ):
+            raise ValueError(
+                "decode mode is single-device (serving); drop the "
+                "sequence-parallel mesh"
+            )
+        batch, seq, heads, dim = q.shape
+        if seq != 1:
+            raise ValueError(
+                f"decode mode consumes ONE step per call, got seq={seq}; "
+                "run the full-sequence forward for teacher forcing"
+            )
+        cached_k = self.variable(
+            "cache", "cached_key",
+            jnp.zeros, (batch, self.decode_max_len, heads, dim), k.dtype,
+        )
+        cached_v = self.variable(
+            "cache", "cached_value",
+            jnp.zeros, (batch, self.decode_max_len, heads, dim), v.dtype,
+        )
+        index = self.variable(
+            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+        )
+        i = index.value
+        cached_k.value = lax.dynamic_update_slice(
+            cached_k.value, k, (0, i, 0, 0)
+        )
+        cached_v.value = lax.dynamic_update_slice(
+            cached_v.value, v, (0, i, 0, 0)
+        )
+        index.value = i + 1
+
+        if self.window is not None:
+            span = min(self.window, self.decode_max_len)
+            # Last `span` slots ending at i (clamped at the left edge; the
+            # positions mask below hides any pre-history the clamp drags
+            # in at the start of the episode).
+            start = jnp.clip(i - span + 1, 0, self.decode_max_len - span)
+            k_ctx = lax.dynamic_slice(
+                cached_k.value, (0, start, 0, 0),
+                (batch, span, heads, dim),
+            )
+            v_ctx = lax.dynamic_slice(
+                cached_v.value, (0, start, 0, 0),
+                (batch, span, heads, dim),
+            )
+            k_pos = start + jnp.arange(span)
+        else:
+            k_ctx, v_ctx = cached_k.value, cached_v.value
+            k_pos = jnp.arange(self.decode_max_len)
+        scale = dim ** -0.5
+        s = jnp.einsum(
+            "bqhd,bkhd->bhqk", q.astype(jnp.float32),
+            k_ctx.astype(jnp.float32),
+        ) * scale
+        visible = k_pos <= i
+        if self.window is not None:
+            visible = visible & (i - k_pos < self.window)
+        s = jnp.where(visible[None, None, None, :], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum(
+            "bhqk,bkhd->bqhd", p, v_ctx.astype(jnp.float32)
+        ).astype(q.dtype)
+
 
 class TransformerBlock(nn.Module):
     """Pre-norm block: x + MHA(LN(x)); x + FFN(LN(x)).
@@ -124,6 +215,8 @@ class TransformerBlock(nn.Module):
     num_selected_experts: int = 2
     sequence_parallel_mode: str = "ring"
     window: Optional[int] = None
+    decode: bool = False
+    decode_max_len: int = 2048
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -136,6 +229,8 @@ class TransformerBlock(nn.Module):
             interpret=self.interpret,
             sequence_parallel_mode=self.sequence_parallel_mode,
             window=self.window,
+            decode=self.decode,
+            decode_max_len=self.decode_max_len,
             name="attention",
         )(nn.LayerNorm(name="ln_attn")(x))
         h = nn.LayerNorm(name="ln_mlp")(x)
@@ -216,6 +311,7 @@ class TransformerEncoder(nn.Module):
     pipeline_stages: int = 1
     pipeline_microbatches: Optional[int] = None
     window: Optional[int] = None
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -229,6 +325,8 @@ class TransformerEncoder(nn.Module):
             nn.initializers.normal(0.02),
             (self.max_seq_len, features),
         )
+        if self.decode:
+            return self._decode_step(x, positions)
         x = x + positions[None, :seq, :]
         if self.pipeline_stages > 1:
             x = self._pipelined_blocks(x)
@@ -248,6 +346,41 @@ class TransformerEncoder(nn.Module):
                     window=self.window,
                     name=f"block_{i}",
                 )(x)
+        return nn.LayerNorm(name="ln_final")(x)
+
+    def _decode_step(self, x: jax.Array, positions: jax.Array) -> jax.Array:
+        """One incremental step: positional embedding at the episode
+        position (own cache counter), then the block stack in decode mode
+        (each attention appends to its K/V cache). Mutate the "cache"
+        collection across calls: `module.apply(..., mutable=["cache"])`.
+        """
+        if self.pipeline_stages > 1:
+            raise ValueError("decode mode does not compose with pipelining")
+        pos = self.variable(
+            "cache", "position", lambda: jnp.zeros((), jnp.int32)
+        )
+        step = lax.dynamic_slice(
+            positions, (pos.value, 0), (1, positions.shape[1])
+        )
+        pos.value = pos.value + 1
+        x = x + step[None]
+        for i in range(self.num_layers):
+            x = TransformerBlock(
+                num_heads=self.num_heads,
+                head_dim=self.head_dim,
+                mlp_ratio=self.mlp_ratio,
+                causal=self.causal,
+                mesh=self.mesh,
+                use_flash=self.use_flash,
+                interpret=self.interpret,
+                num_experts=self.num_experts,
+                num_selected_experts=self.num_selected_experts,
+                sequence_parallel_mode=self.sequence_parallel_mode,
+                window=self.window,
+                decode=True,
+                decode_max_len=self.max_seq_len,
+                name=f"block_{i}",
+            )(x)
         return nn.LayerNorm(name="ln_final")(x)
 
     def _pipelined_blocks(self, x: jax.Array) -> jax.Array:

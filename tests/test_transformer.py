@@ -119,6 +119,62 @@ class TestTransformerEncoder:
         )
 
 
+class TestIncrementalDecode:
+    """KV-cache decoding: feeding the sequence one step at a time through
+    decode-mode modules must reproduce the full-sequence forward."""
+
+    @pytest.mark.parametrize("window", [None, 5])
+    def test_encoder_decode_matches_full_forward(self, x, window):
+        full_encoder = TransformerEncoder(
+            num_layers=2, num_heads=2, head_dim=8, max_seq_len=32,
+            use_flash=False, causal=True, window=window,
+        )
+        variables = full_encoder.init(jax.random.PRNGKey(0), x)
+        full_out = full_encoder.apply(variables, x)
+
+        decoder = TransformerEncoder(
+            num_layers=2, num_heads=2, head_dim=8, max_seq_len=32,
+            use_flash=False, causal=True, window=window, decode=True,
+        )
+        # Initialize the cache collection with a single-step trace, then
+        # ZERO it: flax init runs the module, so the returned cache has
+        # already consumed one step (index=1 with the trace's k/v in
+        # slot 0).
+        cache = jax.tree_util.tree_map(
+            jnp.zeros_like,
+            decoder.init(jax.random.PRNGKey(0), x[:, :1])["cache"],
+        )
+        steps = []
+        for t in range(x.shape[1]):
+            out, mutated = decoder.apply(
+                {"params": variables["params"], "cache": cache},
+                x[:, t : t + 1],
+                mutable=["cache"],
+            )
+            cache = mutated["cache"]
+            steps.append(out)
+        decoded = jnp.concatenate(steps, axis=1)
+        np.testing.assert_allclose(
+            np.asarray(decoded), np.asarray(full_out), atol=2e-5, rtol=2e-5
+        )
+
+    def test_decode_rejects_multi_step_calls(self, x):
+        decoder = TransformerEncoder(
+            num_layers=1, num_heads=2, head_dim=8, max_seq_len=32,
+            use_flash=False, causal=True, decode=True,
+        )
+        with pytest.raises(ValueError, match="ONE step"):
+            decoder.init(jax.random.PRNGKey(0), x[:, :4])
+
+    def test_decode_requires_causal(self, x):
+        decoder = TransformerEncoder(
+            num_layers=1, num_heads=2, head_dim=8, max_seq_len=32,
+            use_flash=False, causal=False, decode=True,
+        )
+        with pytest.raises(ValueError, match="causal"):
+            decoder.init(jax.random.PRNGKey(0), x[:, :1])
+
+
 class TestPipelinedEncoder:
     """GPipe pipelining of the block stack over the mesh's pipe axis.
 
