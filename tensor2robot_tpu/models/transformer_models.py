@@ -53,6 +53,9 @@ class _TransformerBCNet(nn.Module):
     # step attends to its last `attention_window` steps, O(T*W) compute —
     # the streaming-robot regime where recent context dominates.
     attention_window: Optional[int] = None
+    # Incremental serving: one step per call against a K/V cache (see
+    # MultiHeadAttention.decode). Training always uses the full forward.
+    decode: bool = False
 
     @nn.compact
     def __call__(self, features, mode):
@@ -81,6 +84,7 @@ class _TransformerBCNet(nn.Module):
             pipeline_stages=self.pipeline_stages,
             pipeline_microbatches=self.pipeline_microbatches,
             window=self.attention_window,
+            decode=self.decode,
             name="encoder",
         )(x)
         action = nn.Dense(self.action_size, name="action_head")(x)
@@ -165,7 +169,7 @@ class TransformerBCModel(FlaxT2RModel):
         )
         return copy_tensorspec(spec, batch_size=self._episode_length)
 
-    def create_network(self) -> nn.Module:
+    def create_network(self, decode: bool = False) -> nn.Module:
         return _TransformerBCNet(
             action_size=self._action_size,
             d_model=self._d_model,
@@ -174,14 +178,21 @@ class TransformerBCModel(FlaxT2RModel):
             head_dim=self._head_dim,
             max_seq_len=max(self._episode_length, 8),
             num_experts=self._num_experts,
-            mesh=self._mesh,
+            mesh=None if decode else self._mesh,
             use_flash=self._use_flash,
             interpret=self._interpret,
             sequence_parallel_mode=self._sequence_parallel_mode,
-            pipeline_stages=self._pipeline_stages,
+            pipeline_stages=1 if decode else self._pipeline_stages,
             pipeline_microbatches=self._pipeline_microbatches,
             attention_window=self._attention_window,
+            decode=decode,
         )
+
+    def create_streaming_policy(
+        self, variables, batch_size: int = 1
+    ) -> "StreamingBCPolicy":
+        """Per-step serving over trained variables (KV-cache decode)."""
+        return StreamingBCPolicy(self, variables, batch_size=batch_size)
 
     def init_variables(self, rng, features, mode=MODE_TRAIN):
         variables = super().init_variables(rng, features, mode)
@@ -245,3 +256,68 @@ class TransformerBCModel(FlaxT2RModel):
                 )
             )
         }
+
+
+class StreamingBCPolicy:
+    """Stateful per-step serving for a trained TransformerBCModel.
+
+    Each step() consumes ONE observation (image + proprioception) and
+    returns that step's action: the conv embed runs on the single frame
+    and attention reads the K/V cache — O(attention_window) per step when
+    the model has one, never a full-episode recompute. The robot-loop
+    counterpart of the training-time forward; the whole step is one jitted
+    dispatch with the cache donated in place.
+
+    Episodes are bounded by the model's max_seq_len (steps beyond it
+    overwrite the last cache slot — call reset() between episodes).
+    """
+
+    def __init__(self, model: TransformerBCModel, variables, batch_size=1):
+        self._net = model.create_network(decode=True)
+        self._params = variables["params"]
+        dummy = {
+            "image": jnp.zeros(
+                (batch_size, 1) + model._image_size + (3,), jnp.float32
+            ),
+            "gripper_pose": jnp.zeros(
+                (batch_size, 1, model._pose_size), jnp.float32
+            ),
+        }
+        # init RUNS the module (consuming one cache step); zero for the
+        # real episode start.
+        cache = self._net.init(jax.random.PRNGKey(0), dummy, "predict")[
+            "cache"
+        ]
+        self._zero_cache = jax.tree_util.tree_map(jnp.zeros_like, cache)
+        self._cache = self._zero_cache
+
+        def step(params, cache, image, pose):
+            out, mutated = self._net.apply(
+                {"params": params, "cache": cache},
+                {"image": image, "gripper_pose": pose},
+                "predict",
+                mutable=["cache"],
+            )
+            return out["action"][:, 0], mutated["cache"]
+
+        # No cache donation: the zeroed template must stay alive for
+        # reset(), and per-step cache copies are a few MB at robot rates.
+        self._step = jax.jit(step)
+
+    def reset(self) -> None:
+        """Starts a new episode (empty cache, position 0)."""
+        self._cache = self._zero_cache
+
+    def step(self, image, gripper_pose) -> np.ndarray:
+        """One control step: [B?, H, W, 3] image + [B?, P] pose -> [B, A]
+        action for THIS step (batch dim optional for batch_size=1)."""
+        image = jnp.asarray(image, jnp.float32)
+        pose = jnp.asarray(gripper_pose, jnp.float32)
+        if image.ndim == 3:
+            image = image[None]
+        if pose.ndim == 1:
+            pose = pose[None]
+        action, self._cache = self._step(
+            self._params, self._cache, image[:, None], pose[:, None]
+        )
+        return np.asarray(jax.device_get(action))

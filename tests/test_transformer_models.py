@@ -97,6 +97,41 @@ class TestTransformerBCModel:
             full_last(perturbed), full_last(batch["features"]), atol=1e-5
         )
 
+    @pytest.mark.parametrize("window", [None, 3])
+    def test_streaming_policy_matches_full_forward(self, window):
+        """The KV-cache streaming policy reproduces the full-episode
+        forward step for step — the robot-loop serving contract."""
+        import numpy as np
+
+        episode = 10
+        model = TransformerBCModel(
+            action_size=3, episode_length=episode, image_size=(16, 16),
+            use_flash=False, attention_window=window,
+        )
+        batch = _batch(model, batch_size=1)
+        variables = model.init_variables(
+            jax.random.PRNGKey(0), batch["features"]
+        )
+        outputs, _ = model.inference_network_fn(
+            variables, batch["features"], "eval"
+        )
+        full_actions = np.asarray(outputs["inference_output"])[0]
+
+        policy = model.create_streaming_policy(variables)
+        images = np.asarray(batch["features"]["image"])[0]
+        poses = np.asarray(batch["features"]["gripper_pose"])[0]
+        streamed = [
+            policy.step(images[t], poses[t])[0] for t in range(episode)
+        ]
+        np.testing.assert_allclose(
+            np.stack(streamed), full_actions, atol=2e-5, rtol=2e-5
+        )
+
+        # reset() starts a fresh episode: the first step reproduces t=0.
+        policy.reset()
+        again = policy.step(images[0], poses[0])[0]
+        np.testing.assert_allclose(again, full_actions[0], atol=2e-5)
+
     def test_trains_on_sequence_mesh(self):
         """End to end through CompiledModel with the episode sharded over
         the sequence axis — ring attention inside the real train step."""
