@@ -655,6 +655,93 @@ def bench_bc() -> None:
         _fail("bc_bench", err, metric=metric)
 
 
+def bench_stream() -> None:
+    """Streaming BC serving rate: control-loop steps/sec through the
+    KV-cache StreamingBCPolicy (one jitted dispatch per step, O(window)
+    attention). The serving-side counterpart of `bench.py bc`."""
+    metric_base = "streaming_bc_policy_steps_per_sec"
+    try:
+        devices, backend_note = _init_devices(
+            max_wait=_backend_wait(metric=metric_base)
+        )
+    except Exception as err:  # noqa: BLE001
+        _fail("backend_init", err, metric=metric_base)
+
+    import jax
+    import numpy as np
+
+    _enable_compilation_cache()
+    device = devices[0]
+    on_tpu = device.platform == "tpu"
+    if on_tpu:
+        episode, image, window = 1024, 64, 128
+        d_model, num_layers, num_heads, head_dim = 256, 4, 8, 32
+        metric = metric_base
+    else:
+        episode, image, window = 64, 16, 16
+        d_model, num_layers, num_heads, head_dim = 32, 2, 2, 16
+        metric = metric_base + "_cpu_proxy"
+
+    try:
+        from tensor2robot_tpu.models.transformer_models import (
+            TransformerBCModel,
+        )
+        from tensor2robot_tpu.specs import make_random_numpy
+
+        model = TransformerBCModel(
+            pose_size=14, episode_length=episode, image_size=(image, image),
+            d_model=d_model, num_layers=num_layers, num_heads=num_heads,
+            head_dim=head_dim, attention_window=window,
+        )
+        features = make_random_numpy(
+            model.get_feature_specification("predict"), batch_size=1
+        )
+        variables = model.init_variables(jax.random.PRNGKey(0), features)
+        policy = model.create_streaming_policy(variables)
+        img = np.asarray(features["image"])[0, 0]
+        pose = np.asarray(features["gripper_pose"])[0, 0]
+
+        policy.step(img, pose)  # compile
+        for _ in range(5):
+            policy.step(img, pose)  # warm-in
+        # policy.step device_gets the action every call — self-anchoring.
+        n_windows, calls = 5, 20
+        times = []
+        for _ in range(n_windows):
+            policy.reset()
+            t0 = time.perf_counter()
+            for _ in range(calls):
+                policy.step(img, pose)
+            times.append((time.perf_counter() - t0) / calls)
+        per_step = statistics.median(times)
+        _emit(
+            {
+                "metric": metric,
+                "value": round(1.0 / per_step, 2),
+                "unit": "control_steps_per_sec",
+                # Design band: the reference targets 1-10 Hz control.
+                "vs_baseline": round((1.0 / per_step) / 10.0, 2),
+                "detail": {
+                    "per_step_ms": round(per_step * 1e3, 3),
+                    "episode_capacity": episode,
+                    "attention_window": window,
+                    "image_size": [image, image],
+                    "d_model": d_model,
+                    "num_layers": num_layers,
+                    "device_kind": getattr(device, "device_kind", "?"),
+                    "timing": "median_of_windows",
+                    **(
+                        {"backend_note": backend_note}
+                        if backend_note
+                        else {}
+                    ),
+                },
+            }
+        )
+    except Exception as err:  # noqa: BLE001
+        _fail("stream_bench", err, metric=metric)
+
+
 def _backend_wait(metric: str = "qtopt_critic_train_mfu_bs64_472px") -> float:
     """BENCH_BACKEND_WAIT, with malformed values reported through the
     one-JSON-line failure contract (under the caller's metric) rather
@@ -904,5 +991,7 @@ if __name__ == "__main__":
         bench_predict()
     elif len(sys.argv) > 1 and sys.argv[1] == "bc":
         bench_bc()
+    elif len(sys.argv) > 1 and sys.argv[1] == "stream":
+        bench_stream()
     else:
         main()

@@ -102,6 +102,14 @@ for i in $(seq 1 "$tries"); do
     commit_artifact BENCH_BC_r03_w128.json "Windowed (W=128) BC train MFU"
   fi
 
+  # Streaming (KV-cache) serving rate on the chip.
+  BENCH_BACKEND_WAIT=240 python bench.py stream \
+    > /tmp/w4_stream.json 2>/tmp/w4_stream.err || true
+  if grep -q 'streaming_bc_policy_steps_per_sec"' /tmp/w4_stream.json; then
+    cp /tmp/w4_stream.json BENCH_STREAM_r03.json
+    commit_artifact BENCH_STREAM_r03.json "On-chip streaming BC serving rate"
+  fi
+
   # Batch 128 plain first (the stem bf16 cast roughly halves stem
   # activation memory, so bs128 may fit without remat); remat variant as
   # the fallback datapoint.
