@@ -90,6 +90,17 @@ def maybe_wrap_for_tpu(model: AbstractT2RModel) -> AbstractT2RModel:
     return model
 
 
+def _batch_labels(batch):
+    """The batch's labels subtree, or None for label-less (self-supervised)
+    models whose generators emit no 'labels' keys — grasp2vec's empty
+    label spec is the in-repo case; preprocessors and model fns already
+    accept labels=None."""
+    try:
+        return batch["labels"]
+    except KeyError:
+        return None
+
+
 class CompiledModel:
     """The model's hooks compiled into mesh-placed pure step functions."""
 
@@ -283,7 +294,8 @@ class CompiledModel:
             step_rng = jax.random.fold_in(rng, state.step)
             rng_pre, rng_net = jax.random.split(step_rng)
             features, labels = self.preprocessor.preprocess(
-                batch["features"], batch["labels"], mode=MODE_TRAIN, rng=rng_pre
+                batch["features"], _batch_labels(batch),
+                mode=MODE_TRAIN, rng=rng_pre,
             )
             loss, train_metrics, mutable, grads = accumulated_grads(
                 state, features, labels, rng_net
@@ -310,7 +322,8 @@ class CompiledModel:
 
         def eval_step(state: TrainState, batch, use_ema: bool):
             features, labels = self.preprocessor.preprocess(
-                batch["features"], batch["labels"], mode=MODE_EVAL, rng=None
+                batch["features"], _batch_labels(batch),
+                mode=MODE_EVAL, rng=None,
             )
             variables = state.export_variables(use_ema=use_ema)
             f, l, outputs, _ = model.packed_inference(
@@ -347,7 +360,7 @@ class CompiledModel:
         # init shapes match exactly what train_step will feed the network.
         features, _ = self.preprocessor.preprocess(
             example_batch["features"],
-            example_batch["labels"],
+            _batch_labels(example_batch),
             mode=MODE_TRAIN,
             rng=jax.random.PRNGKey(0),
         )

@@ -68,6 +68,27 @@ class TestLosses:
 
 
 class TestGrasp2VecModel:
+    def test_trains_through_train_eval_model(self, tmp_path):
+        """Label-less (self-supervised) end to end through the public
+        trainer: generators emit no 'labels' subtree for an empty label
+        spec, and the trainer must tolerate that (regression — it used to
+        KeyError on batch['labels'])."""
+        from tensor2robot_tpu.data.input_generators import (
+            DefaultRandomInputGenerator,
+        )
+        from tensor2robot_tpu.train.train_eval import train_eval_model
+
+        train_eval_model(
+            small_model(),
+            model_dir=str(tmp_path / "run"),
+            input_generator_train=DefaultRandomInputGenerator(batch_size=2),
+            max_train_steps=2,
+            save_checkpoints_steps=2,
+        )
+        import os
+
+        assert os.path.isdir(str(tmp_path / "run" / "checkpoints"))
+
     def test_specs(self):
         model = small_model()
         spec = model.get_feature_specification("train")
