@@ -165,8 +165,8 @@ class MultiHeadAttention(nn.Module):
         if self.window is not None:
             span = min(self.window, self.decode_max_len)
             # Last `span` slots ending at i (clamped at the left edge; the
-            # positions mask below hides any pre-history the clamp drags
-            # in at the start of the episode).
+            # global-position mask inside reference_attention hides any
+            # pre-history the clamp drags in at the start of the episode).
             start = jnp.clip(i - span + 1, 0, self.decode_max_len - span)
             k_ctx = lax.dynamic_slice(
                 cached_k.value, (0, start, 0, 0),
@@ -176,22 +176,19 @@ class MultiHeadAttention(nn.Module):
                 cached_v.value, (0, start, 0, 0),
                 (batch, span, heads, dim),
             )
-            k_pos = start + jnp.arange(span)
         else:
+            start = 0
             k_ctx, v_ctx = cached_k.value, cached_v.value
-            k_pos = jnp.arange(self.decode_max_len)
-        scale = dim ** -0.5
-        s = jnp.einsum(
-            "bqhd,bkhd->bhqk", q.astype(jnp.float32),
+        # The numerics oracle already speaks tiled global positions: the
+        # single query sits at position i, the cache slice at `start`.
+        return flash_lib.reference_attention(
+            q.astype(jnp.float32),
             k_ctx.astype(jnp.float32),
-        ) * scale
-        visible = k_pos <= i
-        if self.window is not None:
-            visible = visible & (i - k_pos < self.window)
-        s = jnp.where(visible[None, None, None, :], s, -1e30)
-        p = jax.nn.softmax(s, axis=-1)
-        return jnp.einsum(
-            "bhqk,bkhd->bqhd", p, v_ctx.astype(jnp.float32)
+            v_ctx.astype(jnp.float32),
+            causal=True,
+            q_offset=i,
+            k_offset=start,
+            window=self.window,
         ).astype(q.dtype)
 
 
@@ -332,21 +329,28 @@ class TransformerEncoder(nn.Module):
             x = self._pipelined_blocks(x)
         else:
             for i in range(self.num_layers):
-                x = TransformerBlock(
-                    num_heads=self.num_heads,
-                    head_dim=self.head_dim,
-                    mlp_ratio=self.mlp_ratio,
-                    causal=self.causal,
-                    mesh=self.mesh,
-                    use_flash=self.use_flash,
-                    interpret=self.interpret,
-                    num_experts=self.num_experts,
-                    num_selected_experts=self.num_selected_experts,
-                    sequence_parallel_mode=self.sequence_parallel_mode,
-                    window=self.window,
-                    name=f"block_{i}",
-                )(x)
+                x = self._block(i)(x)
         return nn.LayerNorm(name="ln_final")(x)
+
+    def _block(self, i: int, decode: bool = False) -> "TransformerBlock":
+        """One stack block; the decode twin differs only in cache mode
+        (identical param naming, so trained variables slot straight in)."""
+        return TransformerBlock(
+            num_heads=self.num_heads,
+            head_dim=self.head_dim,
+            mlp_ratio=self.mlp_ratio,
+            causal=self.causal,
+            mesh=self.mesh,
+            use_flash=self.use_flash,
+            interpret=self.interpret,
+            num_experts=self.num_experts,
+            num_selected_experts=self.num_selected_experts,
+            sequence_parallel_mode=self.sequence_parallel_mode,
+            window=self.window,
+            decode=decode,
+            decode_max_len=self.max_seq_len,
+            name=f"block_{i}",
+        )
 
     def _decode_step(self, x: jax.Array, positions: jax.Array) -> jax.Array:
         """One incremental step: positional embedding at the episode
@@ -365,22 +369,7 @@ class TransformerEncoder(nn.Module):
         pos.value = pos.value + 1
         x = x + step[None]
         for i in range(self.num_layers):
-            x = TransformerBlock(
-                num_heads=self.num_heads,
-                head_dim=self.head_dim,
-                mlp_ratio=self.mlp_ratio,
-                causal=self.causal,
-                mesh=self.mesh,
-                use_flash=self.use_flash,
-                interpret=self.interpret,
-                num_experts=self.num_experts,
-                num_selected_experts=self.num_selected_experts,
-                sequence_parallel_mode=self.sequence_parallel_mode,
-                window=self.window,
-                decode=True,
-                decode_max_len=self.max_seq_len,
-                name=f"block_{i}",
-            )(x)
+            x = self._block(i, decode=True)(x)
         return nn.LayerNorm(name="ln_final")(x)
 
     def _pipelined_blocks(self, x: jax.Array) -> jax.Array:
