@@ -25,3 +25,8 @@ from tensor2robot_tpu.export.saved_model import (
     list_export_dirs,
     save_exported_model,
 )
+from tensor2robot_tpu.export.streaming import (
+    StreamingExportedPolicy,
+    is_streaming_export,
+    save_streaming_export,
+)

@@ -1,0 +1,168 @@
+"""Streaming (KV-cache) serving exports for the transformer BC family.
+
+The standard export (saved_model.py) serializes the FULL-episode predict —
+right for offline scoring, wasteful in a robot control loop that adds one
+observation per tick. This module exports the incremental step itself:
+
+    step(params, cache, image, pose) -> (action, new_cache)
+
+as a StableHLO artifact plus the zeroed cache template, so a robot host
+can stream actions from the downloaded artifact alone — no model code,
+O(attention_window) attention per tick (models/transformer_models.py
+StreamingBCPolicy is the in-process twin of the loaded policy here).
+
+Layout (inside a timestamped export dir, alongside metadata):
+
+    streaming_metadata.json        shapes, capacity, window
+    variables.msgpack              flax-serialized params
+    cache_template.msgpack         zeroed cache pytree (episode start)
+    stablehlo/stream_fn.bin        jax.export artifact of the step
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import serialization
+
+STREAM_METADATA_FILENAME = "streaming_metadata.json"
+STREAM_VARIABLES_FILENAME = "variables.msgpack"
+STREAM_CACHE_FILENAME = "cache_template.msgpack"
+STREAM_STABLEHLO_DIR = "stablehlo"
+STREAM_FN_FILENAME = "stream_fn.bin"
+
+
+def _step_fn(net):
+    def step(params, cache, image, pose):
+        out, mutated = net.apply(
+            {"params": params, "cache": cache},
+            {"image": image, "gripper_pose": pose},
+            "predict",
+            mutable=["cache"],
+        )
+        return out["action"][:, 0], mutated["cache"]
+
+    return step
+
+
+def save_streaming_export(
+    export_dir: str, model, variables, batch_size: int = 1
+) -> str:
+    """Serializes the model's incremental step into `export_dir`.
+
+    The batch size is fixed at export time (a robot control loop serves a
+    known batch, usually 1); episode capacity and window come from the
+    model (`episode_length`, `attention_window`).
+    """
+    os.makedirs(export_dir, exist_ok=True)
+    net = model.create_network(decode=True)
+    image_shape = (batch_size, 1) + model._image_size + (3,)
+    pose_shape = (batch_size, 1, model._pose_size)
+    dummy = {
+        "image": jnp.zeros(image_shape, jnp.float32),
+        "gripper_pose": jnp.zeros(pose_shape, jnp.float32),
+    }
+    cache = jax.tree_util.tree_map(
+        jnp.zeros_like,
+        net.init(jax.random.PRNGKey(0), dummy, "predict")["cache"],
+    )
+    params = variables["params"]
+
+    from jax import export as jax_export
+
+    struct = lambda t: jax.tree_util.tree_map(  # noqa: E731
+        lambda x: jax.ShapeDtypeStruct(np.asarray(x).shape, np.asarray(x).dtype),
+        t,
+    )
+    step = _step_fn(net)
+    try:
+        exported = jax_export.export(jax.jit(step), platforms=("cpu", "tpu"))(
+            struct(params), struct(cache),
+            jax.ShapeDtypeStruct(image_shape, jnp.float32),
+            jax.ShapeDtypeStruct(pose_shape, jnp.float32),
+        )
+    except Exception:  # noqa: BLE001 — platform-specific lowering fallback,
+        # as in saved_model._export_stablehlo.
+        exported = jax_export.export(jax.jit(step))(
+            struct(params), struct(cache),
+            jax.ShapeDtypeStruct(image_shape, jnp.float32),
+            jax.ShapeDtypeStruct(pose_shape, jnp.float32),
+        )
+
+    os.makedirs(os.path.join(export_dir, STREAM_STABLEHLO_DIR), exist_ok=True)
+    with open(
+        os.path.join(export_dir, STREAM_STABLEHLO_DIR, STREAM_FN_FILENAME),
+        "wb",
+    ) as f:
+        f.write(exported.serialize())
+    plain = lambda t: jax.tree_util.tree_map(  # noqa: E731
+        np.asarray, jax.device_get(dict(t))
+    )
+    with open(os.path.join(export_dir, STREAM_VARIABLES_FILENAME), "wb") as f:
+        f.write(serialization.msgpack_serialize(plain({"params": params})))
+    with open(os.path.join(export_dir, STREAM_CACHE_FILENAME), "wb") as f:
+        f.write(serialization.msgpack_serialize(plain(cache)))
+    with open(os.path.join(export_dir, STREAM_METADATA_FILENAME), "w") as f:
+        json.dump(
+            {
+                "batch_size": batch_size,
+                "image_shape": list(image_shape[2:]),
+                "pose_size": model._pose_size,
+                "episode_capacity": max(model._episode_length, 8),
+                "attention_window": model._attention_window,
+            },
+            f,
+        )
+    return export_dir
+
+
+def is_streaming_export(path: str) -> bool:
+    return os.path.isfile(os.path.join(path, STREAM_METADATA_FILENAME))
+
+
+class StreamingExportedPolicy:
+    """A robot-side control-loop policy loaded from a streaming export —
+    no model code needed, one StableHLO dispatch per tick."""
+
+    def __init__(self, export_dir: str):
+        from jax import export as jax_export
+
+        with open(os.path.join(export_dir, STREAM_METADATA_FILENAME)) as f:
+            self.metadata = json.load(f)
+        with open(
+            os.path.join(export_dir, STREAM_VARIABLES_FILENAME), "rb"
+        ) as f:
+            self._params = serialization.msgpack_restore(f.read())["params"]
+        with open(os.path.join(export_dir, STREAM_CACHE_FILENAME), "rb") as f:
+            self._zero_cache = serialization.msgpack_restore(f.read())
+        with open(
+            os.path.join(
+                export_dir, STREAM_STABLEHLO_DIR, STREAM_FN_FILENAME
+            ),
+            "rb",
+        ) as f:
+            self._step = jax_export.deserialize(f.read()).call
+        self._cache = self._zero_cache
+
+    def reset(self) -> None:
+        """Starts a new episode (empty cache, position 0)."""
+        self._cache = self._zero_cache
+
+    def step(self, image, gripper_pose) -> np.ndarray:
+        """One control tick: image + proprioception in, this step's action
+        out (batch dim optional for batch_size=1)."""
+        image = jnp.asarray(image, jnp.float32)
+        pose = jnp.asarray(gripper_pose, jnp.float32)
+        if image.ndim == 3:
+            image = image[None]
+        if pose.ndim == 1:
+            pose = pose[None]
+        action, self._cache = self._step(
+            self._params, self._cache, image[:, None], pose[:, None]
+        )
+        return np.asarray(jax.device_get(action))
