@@ -24,6 +24,8 @@ import numpy as np
 
 Q_KEY = "__t2r_int8_q__"
 SCALE_KEY = "__t2r_int8_scale__"
+Q4_KEY = "__t2r_int4_packed__"
+Q4_SHAPE_KEY = "__t2r_int4_shape__"
 
 #: Leaves smaller than this stay f32 — quantizing a bias or LayerNorm
 #: scale saves nothing and risks accuracy where 8 bits hurt most.
@@ -31,7 +33,9 @@ DEFAULT_MIN_SIZE = 1024
 
 
 def _is_quantized_node(node: Any) -> bool:
-    return isinstance(node, Mapping) and Q_KEY in node and SCALE_KEY in node
+    return isinstance(node, Mapping) and SCALE_KEY in node and (
+        Q_KEY in node or Q4_KEY in node
+    )
 
 
 def _quantize_leaf(leaf: np.ndarray) -> dict:
@@ -43,15 +47,44 @@ def _quantize_leaf(leaf: np.ndarray) -> dict:
     return {Q_KEY: q, SCALE_KEY: scale}
 
 
+def _quantize_leaf_int4(leaf: np.ndarray) -> dict:
+    """Symmetric per-output-channel int4, two values packed per byte.
+
+    Values quantize to [-7, 7], store biased by +8 in a nibble; the flat
+    C-order array (padded to even length) packs even indices in the low
+    nibble. The original shape rides along so the traceable unpack can
+    restore it."""
+    reduce_axes = tuple(range(leaf.ndim - 1))
+    max_abs = np.max(np.abs(leaf), axis=reduce_axes)
+    scale = np.maximum(max_abs / 7.0, 1e-12).astype(np.float32)
+    q = np.clip(np.round(leaf / scale), -7, 7).astype(np.int8) + 8
+    flat = q.reshape(-1).astype(np.uint8)
+    if flat.size % 2:
+        flat = np.concatenate([flat, np.zeros((1,), np.uint8)])
+    pairs = flat.reshape(-1, 2)
+    packed = (pairs[:, 0] | (pairs[:, 1] << 4)).astype(np.uint8)
+    return {
+        Q4_KEY: packed,
+        SCALE_KEY: scale,
+        Q4_SHAPE_KEY: np.asarray(leaf.shape, np.int32),
+    }
+
+
 def quantize_variables(
-    variables: Any, min_size: int = DEFAULT_MIN_SIZE
+    variables: Any, min_size: int = DEFAULT_MIN_SIZE, bits: int = 8
 ) -> Tuple[Any, int]:
     """Returns (quantized tree, number of quantized leaves).
 
     Quantizes float leaves with ndim >= 2 and >= min_size elements
     (dense/conv kernels); everything else (biases, norms, batch stats,
-    integer state) passes through untouched.
+    integer state) passes through untouched. bits=8 (default) or bits=4
+    (two weights per byte — ~8x smaller than f32, for fleets where
+    download size dominates restore latency; rounding error doubles, so
+    gate it on a golden-values check for the model in question).
     """
+    if bits not in (4, 8):
+        raise ValueError(f"bits must be 4 or 8, got {bits}")
+    quantize_leaf = _quantize_leaf if bits == 8 else _quantize_leaf_int4
     count = 0
 
     def walk(node):
@@ -68,19 +101,35 @@ def quantize_variables(
             and leaf.size >= min_size
         ):
             count += 1
-            return _quantize_leaf(leaf.astype(np.float32))
+            return quantize_leaf(leaf.astype(np.float32))
         return node
 
     return walk(variables), count
 
 
+def _dequantize_int4(node: Mapping, dtype) -> Any:
+    """Traceable unpack of an int4 node (jnp bit ops)."""
+    packed = jnp.asarray(node[Q4_KEY])
+    shape = tuple(int(d) for d in np.asarray(node[Q4_SHAPE_KEY]))
+    low = packed & jnp.uint8(0xF)
+    high = packed >> jnp.uint8(4)
+    flat = jnp.stack([low, high], axis=-1).reshape(-1)
+    size = int(np.prod(shape))
+    values = flat[:size].astype(jnp.int32) - 8
+    return (
+        values.reshape(shape).astype(dtype) * node[SCALE_KEY].astype(dtype)
+    )
+
+
 def dequantize_variables(variables: Any, dtype=jnp.float32) -> Any:
     """Inverse of quantize_variables; traceable (jnp ops), so it can run
-    inside an exported/jitted serving function where the int8 arrays
+    inside an exported/jitted serving function where the int8/int4 arrays
     become compact constants in the artifact."""
 
     def walk(node):
         if _is_quantized_node(node):
+            if Q4_KEY in node:
+                return _dequantize_int4(node, dtype)
             return node[Q_KEY].astype(dtype) * node[SCALE_KEY].astype(dtype)
         if isinstance(node, Mapping):
             return {key: walk(value) for key, value in node.items()}

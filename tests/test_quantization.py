@@ -45,6 +45,49 @@ class TestQuantizeRoundtrip:
             restored["params"]["dense"]["bias"], np.zeros(96, np.float32)
         )
 
+    def test_int4_roundtrip_half_step_and_size(self):
+        rng = np.random.RandomState(1)
+        kernel = (rng.randn(65, 96) * 0.3).astype(np.float32)  # odd count
+        tree = {"params": {"dense": {"kernel": kernel}}}
+        quantized, count = quantize_variables(tree, min_size=128, bits=4)
+        assert count == 1
+        assert is_quantized(quantized)
+        node = quantized["params"]["dense"]["kernel"]
+        # Two weights per byte (plus per-channel scales): ~8x under f32.
+        assert node["__t2r_int4_packed__"].nbytes == (65 * 96 + 1) // 2
+        restored = dequantize_variables(quantized, dtype=np.float32)
+        scale = np.max(np.abs(kernel), axis=0) / 7.0
+        err = np.abs(restored["params"]["dense"]["kernel"] - kernel)
+        assert np.all(err <= scale[None, :] / 2 + 1e-7)
+
+    def test_int4_dequantize_traceable(self):
+        """int4 unpack must work INSIDE jit (bit ops on constants), the
+        weights-as-arguments serving path."""
+        import jax
+
+        rng = np.random.RandomState(2)
+        kernel = (rng.randn(64, 64) * 0.1).astype(np.float32)
+        quantized, _ = quantize_variables(
+            {"k": kernel}, min_size=128, bits=4
+        )
+
+        @jax.jit
+        def matvec(x):
+            w = dequantize_variables(quantized)["k"]
+            return x @ w
+
+        out = matvec(np.ones((1, 64), np.float32))
+        expected = np.ones((1, 64), np.float32) @ np.asarray(
+            dequantize_variables(quantized, dtype=np.float32)["k"]
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), expected, rtol=1e-5, atol=1e-5
+        )
+
+    def test_bad_bits_rejected(self):
+        with pytest.raises(ValueError, match="bits"):
+            quantize_variables({"k": np.ones((64, 64), np.float32)}, bits=2)
+
     def test_small_and_integer_leaves_untouched(self):
         tree = {
             "count": np.arange(10, dtype=np.int64),
