@@ -88,7 +88,8 @@ class AbstractExportGenerator:
         return filter_required_flat_tensor_spec(spec)
 
     def create_serving_fn(
-        self, compiled, variables, quantize_weights: bool = False
+        self, compiled, variables, quantize_weights: bool = False,
+        quantize_bits: int = 8,
     ) -> Callable[..., Dict[str, Any]]:
         """flat raw features -> flat export outputs, pure jax (exportable).
 
@@ -119,13 +120,22 @@ class AbstractExportGenerator:
             import jax
 
             from tensor2robot_tpu.export.quantization import (
+                attach_static_shapes,
                 dequantize_variables,
                 quantize_variables,
             )
 
-            quantized, _ = quantize_variables(jax.device_get(variables))
+            quantized, _ = quantize_variables(
+                jax.device_get(variables), bits=quantize_bits
+            )
 
             def serving_fn(quantized_variables, flat_features):
+                # int4 nodes carry their original shapes as metadata;
+                # under tracing those must be the CONCRETE closure values
+                # (reshape needs static dims).
+                quantized_variables = attach_static_shapes(
+                    quantized_variables, quantized
+                )
                 return run(
                     dequantize_variables(quantized_variables), flat_features
                 )

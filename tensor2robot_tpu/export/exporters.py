@@ -86,6 +86,7 @@ class Exporter:
         serialize_stablehlo: bool = True,
         warmup_batch_sizes: Sequence[int] = (),
         quantize_weights: bool = False,
+        quantize_bits: int = 8,
     ):
         self.name = name
         self._export_generator = export_generator or DefaultExportGenerator()
@@ -95,6 +96,7 @@ class Exporter:
         # int8 weight-only exports (export/quantization.py): ~4x smaller
         # artifacts for the robots polling this export root.
         self._quantize_weights = quantize_weights
+        self._quantize_bits = quantize_bits
 
     def export_root(self, model_dir: str) -> str:
         return os.path.join(model_dir, "export", self.name)
@@ -125,7 +127,8 @@ class Exporter:
         use_ema = getattr(model, "use_avg_model_params", False)
         variables = state.export_variables(use_ema=use_ema)
         serving_fn = generator.create_serving_fn(
-            compiled, variables, quantize_weights=self._quantize_weights
+            compiled, variables, quantize_weights=self._quantize_weights,
+            quantize_bits=self._quantize_bits,
         )
         path = save_exported_model(
             root,
@@ -138,6 +141,7 @@ class Exporter:
             serialize_stablehlo=self._serialize_stablehlo,
             metadata={"exporter": self.name, "eval_metrics": eval_metrics},
             quantize_weights=self._quantize_weights,
+            quantize_bits=self._quantize_bits,
         )
         if self._warmup_batch_sizes:
             generator.create_warmup_requests_numpy(self._warmup_batch_sizes, path)
@@ -202,6 +206,7 @@ def create_default_exporters(
     serialize_stablehlo: bool = True,
     warmup_batch_sizes: Sequence[int] = (),
     quantize_weights: bool = False,
+    quantize_bits: int = 8,
 ) -> List[Exporter]:
     """latest + best exporter pair (reference create_default_exporters,
     train_eval.py:295-385; one artifact serves both the numpy and tf.Example
@@ -216,6 +221,7 @@ def create_default_exporters(
             serialize_stablehlo=serialize_stablehlo,
             warmup_batch_sizes=warmup_batch_sizes,
             quantize_weights=quantize_weights,
+            quantize_bits=quantize_bits,
         ),
         BestExporter(
             name="best",
@@ -225,5 +231,6 @@ def create_default_exporters(
             serialize_stablehlo=serialize_stablehlo,
             warmup_batch_sizes=warmup_batch_sizes,
             quantize_weights=quantize_weights,
+            quantize_bits=quantize_bits,
         ),
     ]

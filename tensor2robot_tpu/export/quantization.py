@@ -138,6 +138,25 @@ def dequantize_variables(variables: Any, dtype=jnp.float32) -> Any:
     return walk(variables)
 
 
+def attach_static_shapes(tree: Any, concrete: Any) -> Any:
+    """Replaces int4 shape leaves in `tree` with the CONCRETE arrays from
+    `concrete`. Shapes are static metadata: in weights-as-arguments
+    serving the whole quantized tree is traced, but `reshape` needs
+    concrete dims — the serving fn closes over the exemplar tree and
+    grafts its shape leaves back before dequantizing (tiny int arrays, so
+    constant-folding them into the artifact is free)."""
+    if _is_quantized_node(tree) and Q4_KEY in tree:
+        out = dict(tree)
+        out[Q4_SHAPE_KEY] = np.asarray(concrete[Q4_SHAPE_KEY])
+        return out
+    if isinstance(tree, Mapping):
+        return {
+            key: attach_static_shapes(value, concrete[key])
+            for key, value in tree.items()
+        }
+    return tree
+
+
 def is_quantized(variables: Any) -> bool:
     """True if any node in the tree is a quantized leaf."""
 

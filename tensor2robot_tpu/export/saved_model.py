@@ -94,6 +94,7 @@ def save_exported_model(
     serialize_stablehlo: bool = True,
     metadata: Optional[Dict[str, Any]] = None,
     quantize_weights: bool = False,
+    quantize_bits: int = 8,
 ) -> str:
     """Writes one export version; returns its final path.
 
@@ -146,7 +147,9 @@ def save_exported_model(
                 quantize_variables,
             )
 
-            stored_variables, _ = quantize_variables(stored_variables)
+            stored_variables, _ = quantize_variables(
+                stored_variables, bits=quantize_bits
+            )
     with open(os.path.join(tmp_path, VARIABLES_FILENAME), "wb") as f:
         f.write(serialization.to_bytes(stored_variables))
 
