@@ -95,6 +95,11 @@ class Exporter:
         self._warmup_batch_sizes = tuple(warmup_batch_sizes)
         # int8 weight-only exports (export/quantization.py): ~4x smaller
         # artifacts for the robots polling this export root.
+        if quantize_bits not in (4, 8):
+            # Fail at CONFIG time, not on the first export tick mid-run.
+            raise ValueError(
+                f"quantize_bits must be 4 or 8, got {quantize_bits}"
+            )
         self._quantize_weights = quantize_weights
         self._quantize_bits = quantize_bits
 

@@ -177,6 +177,14 @@ def save_exported_model(
         "stablehlo": stablehlo_ok,
         "stablehlo_error": stablehlo_error,
         "weights_int8": bool(quantize_weights),
+        # Bit width of the quantized leaves (absent when unquantized):
+        # int4 artifacts are NOT readable by pre-int4 loaders, so tooling
+        # and fleet rollout gates need the distinction on record.
+        **(
+            {"weights_quantize_bits": int(quantize_bits)}
+            if quantize_weights
+            else {}
+        ),
         "stablehlo_weights_in_args": variables_in_args is not None,
         "format_version": 1,
     }

@@ -30,6 +30,7 @@ def default_create_export_fn(
     export_generator=None,
     warmup_batch_sizes: Sequence[int] = (),
     quantize_weights: bool = False,
+    quantize_bits: int = 8,
 ) -> Callable:
     """Builds fn(state, export_dir, global_step) -> path exporting a serving
     artifact with the t2r-assets spec contract (reference
@@ -43,7 +44,8 @@ def default_create_export_fn(
         use_ema = getattr(model, "use_avg_model_params", False)
         variables = state.export_variables(use_ema=use_ema)
         serving_fn = generator.create_serving_fn(
-            compiled, variables, quantize_weights=quantize_weights
+            compiled, variables, quantize_weights=quantize_weights,
+            quantize_bits=quantize_bits,
         )
         path = save_exported_model(
             export_dir,
@@ -54,6 +56,7 @@ def default_create_export_fn(
             predict_fn=serving_fn,
             example_features=generator.create_example_features(),
             quantize_weights=quantize_weights,
+            quantize_bits=quantize_bits,
         )
         if warmup_batch_sizes:
             generator.create_warmup_requests_numpy(warmup_batch_sizes, path)

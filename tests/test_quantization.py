@@ -47,14 +47,14 @@ class TestQuantizeRoundtrip:
 
     def test_int4_roundtrip_half_step_and_size(self):
         rng = np.random.RandomState(1)
-        kernel = (rng.randn(65, 96) * 0.3).astype(np.float32)  # odd count
+        kernel = (rng.randn(65, 97) * 0.3).astype(np.float32)  # odd count
         tree = {"params": {"dense": {"kernel": kernel}}}
         quantized, count = quantize_variables(tree, min_size=128, bits=4)
         assert count == 1
         assert is_quantized(quantized)
         node = quantized["params"]["dense"]["kernel"]
         # Two weights per byte (plus per-channel scales): ~8x under f32.
-        assert node["__t2r_int4_packed__"].nbytes == (65 * 96 + 1) // 2
+        assert node["__t2r_int4_packed__"].nbytes == (65 * 97 + 1) // 2
         restored = dequantize_variables(quantized, dtype=np.float32)
         scale = np.max(np.abs(kernel), axis=0) / 7.0
         err = np.abs(restored["params"]["dense"]["kernel"] - kernel)
