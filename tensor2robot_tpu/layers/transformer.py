@@ -56,22 +56,54 @@ class MultiHeadAttention(nn.Module):
     # caps it). Requires causal=True and no sequence-parallel mesh.
     decode: bool = False
     decode_max_len: int = 2048
+    # Grouped-query attention: num_kv_heads < num_heads shares each K/V
+    # head across a GROUP of query heads (GQA, arXiv:2305.13245). The
+    # projection and — the point for robots — the decode-mode K/V cache
+    # shrink by the group factor; K/V are broadcast back to num_heads
+    # only at attend time. None = num_heads (standard MHA).
+    num_kv_heads: Optional[int] = None
+
+    def _kv_heads(self) -> int:
+        kv = self.num_kv_heads if self.num_kv_heads is not None else self.num_heads
+        if self.num_heads % kv != 0:
+            raise ValueError(
+                f"num_heads={self.num_heads} must be divisible by "
+                f"num_kv_heads={kv}"
+            )
+        return kv
+
+    def _expand_kv(self, t: jax.Array) -> jax.Array:
+        """[B, S, KVH, D] -> [B, S, H, D] by repeating each kv head over
+        its query group (no-op for standard MHA)."""
+        groups = self.num_heads // t.shape[2]
+        if groups == 1:
+            return t
+        return jnp.repeat(t, groups, axis=2)
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         batch, seq, _ = x.shape
         features = self.num_heads * self.head_dim
-        qkv = nn.Dense(3 * features, use_bias=False, name="qkv")(x)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-
-        def heads(t):
-            return t.reshape(batch, seq, self.num_heads, self.head_dim)
-
-        q, k, v = heads(q), heads(k), heads(v)
+        kv_heads = self._kv_heads()
+        kv_features = kv_heads * self.head_dim
+        qkv = nn.Dense(
+            features + 2 * kv_features, use_bias=False, name="qkv"
+        )(x)
+        q, k, v = jnp.split(
+            qkv, [features, features + kv_features], axis=-1
+        )
+        q = q.reshape(batch, seq, self.num_heads, self.head_dim)
+        k = k.reshape(batch, seq, kv_heads, self.head_dim)
+        v = v.reshape(batch, seq, kv_heads, self.head_dim)
         if self.decode:
+            # The cache stores kv_heads only (the GQA memory win); the
+            # group broadcast happens on the read inside _decode_step.
             out = self._decode_step(q, k, v)
             out = out.reshape(batch, seq, features)
             return nn.Dense(x.shape[-1], use_bias=False, name="out")(out)
+        # Training/full-forward paths attend at full head count: the
+        # flash/ring/ulysses kernels take equal q/k head dims.
+        k, v = self._expand_kv(k), self._expand_kv(v)
         if self.sequence_parallel_mode not in ("ring", "ulysses"):
             # Validate eagerly — a typo must fail on the laptop run, not
             # only once the config reaches a multi-device CP mesh.
@@ -136,7 +168,8 @@ class MultiHeadAttention(nn.Module):
                 "decode mode is single-device (serving); drop the "
                 "sequence-parallel mesh"
             )
-        batch, seq, heads, dim = q.shape
+        batch, seq, _, dim = q.shape
+        kv_heads = k.shape[2]
         if seq != 1:
             raise ValueError(
                 f"decode mode consumes ONE step per call, got seq={seq}; "
@@ -144,11 +177,11 @@ class MultiHeadAttention(nn.Module):
             )
         cached_k = self.variable(
             "cache", "cached_key",
-            jnp.zeros, (batch, self.decode_max_len, heads, dim), k.dtype,
+            jnp.zeros, (batch, self.decode_max_len, kv_heads, dim), k.dtype,
         )
         cached_v = self.variable(
             "cache", "cached_value",
-            jnp.zeros, (batch, self.decode_max_len, heads, dim), v.dtype,
+            jnp.zeros, (batch, self.decode_max_len, kv_heads, dim), v.dtype,
         )
         index = self.variable(
             "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
@@ -170,15 +203,18 @@ class MultiHeadAttention(nn.Module):
             start = jnp.clip(i - span + 1, 0, self.decode_max_len - span)
             k_ctx = lax.dynamic_slice(
                 cached_k.value, (0, start, 0, 0),
-                (batch, span, heads, dim),
+                (batch, span, kv_heads, dim),
             )
             v_ctx = lax.dynamic_slice(
                 cached_v.value, (0, start, 0, 0),
-                (batch, span, heads, dim),
+                (batch, span, kv_heads, dim),
             )
         else:
             start = 0
             k_ctx, v_ctx = cached_k.value, cached_v.value
+        # GQA: broadcast the cached kv heads to the query head count only
+        # here, at attend time — the cache itself stays kv_heads wide.
+        k_ctx, v_ctx = self._expand_kv(k_ctx), self._expand_kv(v_ctx)
         # The numerics oracle already speaks tiled global positions: the
         # single query sits at position i, the cache slice at `start`.
         return flash_lib.reference_attention(
