@@ -250,6 +250,7 @@ class TransformerBlock(nn.Module):
     window: Optional[int] = None
     decode: bool = False
     decode_max_len: int = 2048
+    num_kv_heads: Optional[int] = None
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -264,6 +265,7 @@ class TransformerBlock(nn.Module):
             window=self.window,
             decode=self.decode,
             decode_max_len=self.decode_max_len,
+            num_kv_heads=self.num_kv_heads,
             name="attention",
         )(nn.LayerNorm(name="ln_attn")(x))
         h = nn.LayerNorm(name="ln_mlp")(x)
@@ -345,6 +347,7 @@ class TransformerEncoder(nn.Module):
     pipeline_microbatches: Optional[int] = None
     window: Optional[int] = None
     decode: bool = False
+    num_kv_heads: Optional[int] = None
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -385,6 +388,7 @@ class TransformerEncoder(nn.Module):
             window=self.window,
             decode=decode,
             decode_max_len=self.max_seq_len,
+            num_kv_heads=self.num_kv_heads,
             name=f"block_{i}",
         )
 

@@ -56,6 +56,8 @@ class _TransformerBCNet(nn.Module):
     # Incremental serving: one step per call against a K/V cache (see
     # MultiHeadAttention.decode). Training always uses the full forward.
     decode: bool = False
+    # Grouped-query attention (see MultiHeadAttention.num_kv_heads).
+    num_kv_heads: Optional[int] = None
 
     @nn.compact
     def __call__(self, features, mode):
@@ -85,6 +87,7 @@ class _TransformerBCNet(nn.Module):
             pipeline_microbatches=self.pipeline_microbatches,
             window=self.attention_window,
             decode=self.decode,
+            num_kv_heads=self.num_kv_heads,
             name="encoder",
         )(x)
         action = nn.Dense(self.action_size, name="action_head")(x)
@@ -122,6 +125,7 @@ class TransformerBCModel(FlaxT2RModel):
         pipeline_stages: int = 1,
         pipeline_microbatches: Optional[int] = None,
         attention_window: Optional[int] = None,
+        num_kv_heads: Optional[int] = None,
         **kwargs,
     ):
         super().__init__(**kwargs)
@@ -142,6 +146,7 @@ class TransformerBCModel(FlaxT2RModel):
         self._pipeline_stages = pipeline_stages
         self._pipeline_microbatches = pipeline_microbatches
         self._attention_window = attention_window
+        self._num_kv_heads = num_kv_heads
 
     def get_feature_specification(self, mode: str) -> TensorSpecStruct:
         del mode
@@ -185,6 +190,7 @@ class TransformerBCModel(FlaxT2RModel):
             pipeline_stages=1 if decode else self._pipeline_stages,
             pipeline_microbatches=self._pipeline_microbatches,
             attention_window=self._attention_window,
+            num_kv_heads=self._num_kv_heads,
             decode=decode,
         )
 

@@ -132,6 +132,39 @@ class TestTransformerBCModel:
         again = policy.step(images[0], poses[0])[0]
         np.testing.assert_allclose(again, full_actions[0], atol=2e-5)
 
+    def test_gqa_model_streams_and_trains(self):
+        """Model-level GQA: trains, and the streaming policy (narrow
+        cache) matches the full forward."""
+        import numpy as np
+
+        model = TransformerBCModel(
+            action_size=3, episode_length=8, image_size=(16, 16),
+            use_flash=False, num_heads=4, head_dim=8, num_kv_heads=2,
+            attention_window=4,
+        )
+        batch = _batch(model, batch_size=1)
+        compiled = CompiledModel(model, donate_state=False)
+        state = compiled.init_state(jax.random.PRNGKey(0), batch)
+        state, metrics = compiled.train_step(
+            state, compiled.shard_batch(batch), jax.random.PRNGKey(1)
+        )
+        assert np.isfinite(float(jax.device_get(metrics["loss"])))
+
+        variables = model.init_variables(
+            jax.random.PRNGKey(0), batch["features"]
+        )
+        outputs, _ = model.inference_network_fn(
+            variables, batch["features"], "eval"
+        )
+        full_actions = np.asarray(outputs["inference_output"])[0]
+        policy = model.create_streaming_policy(variables)
+        images = np.asarray(batch["features"]["image"])[0]
+        poses = np.asarray(batch["features"]["gripper_pose"])[0]
+        streamed = [policy.step(images[t], poses[t])[0] for t in range(8)]
+        np.testing.assert_allclose(
+            np.stack(streamed), full_actions, atol=2e-5, rtol=2e-5
+        )
+
     def test_streaming_export_roundtrip(self, tmp_path):
         """The robot-deployment shape: the incremental step serialized as
         a StableHLO artifact + cache template, reloaded WITHOUT model
