@@ -300,6 +300,7 @@ class PipelineStage(nn.Module):
     use_flash: Optional[bool] = None
     interpret: bool = False
     window: Optional[int] = None
+    num_kv_heads: Optional[int] = None
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -313,6 +314,7 @@ class PipelineStage(nn.Module):
                 use_flash=self.use_flash,
                 interpret=self.interpret,
                 window=self.window,
+                num_kv_heads=self.num_kv_heads,
                 name=f"block_{i}",
             )(x)
         return x
@@ -451,6 +453,7 @@ class TransformerEncoder(nn.Module):
             use_flash=self.use_flash,
             interpret=self.interpret,
             window=self.window,
+            num_kv_heads=self.num_kv_heads,
         )
         batch = x.shape[0]
         data_size = mesh_axes.get(mesh_mod.DATA_AXIS, 1)
