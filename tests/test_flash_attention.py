@@ -124,6 +124,58 @@ class TestFlashAttention:
             np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
         )
 
+    def test_k_block_bounds_exact_over_small_grid(self):
+        """Exhaustive check of the kernel's visibility bounds: for every
+        (q-block, window, block size, offsets) combination on a small
+        grid, [j_lo, j_hi) contains EXACTLY the k blocks holding at least
+        one visible (q, k) pair — too-narrow breaks numerics, too-wide is
+        silent wasted compute; the docstring claims exact."""
+        from tensor2robot_tpu.ops.flash_attention import _k_block_bounds
+
+        for block_q in (2, 3, 8):
+            for block_k in (2, 4):
+                for num_kb in (1, 3):
+                    s_k = block_k * num_kb
+                    for q_off in (0, 5, -3):
+                        for k_off in (0, 7):
+                            for qi in range(3):
+                                q0 = q_off + qi * block_q
+                                for window in (None, 1, 2, 5, 100):
+                                    j_lo, j_hi = _k_block_bounds(
+                                        q0, block_q, block_k, num_kb,
+                                        k_off, True, window,
+                                    )
+                                    visible_blocks = set()
+                                    for dq in range(block_q):
+                                        for kk in range(s_k):
+                                            q_pos = q0 + dq
+                                            k_pos = k_off + kk
+                                            vis = q_pos >= k_pos
+                                            if window is not None:
+                                                vis &= (
+                                                    q_pos - k_pos < window
+                                                )
+                                            if vis:
+                                                visible_blocks.add(
+                                                    kk // block_k
+                                                )
+                                    expected = (
+                                        set(range(int(j_lo), int(j_hi)))
+                                        if visible_blocks
+                                        else set()
+                                    )
+                                    # Exactness when anything is visible;
+                                    # an empty visible set allows any
+                                    # (possibly empty) range whose blocks
+                                    # are all masked.
+                                    if visible_blocks:
+                                        assert expected == visible_blocks, (
+                                            block_q, block_k, num_kb,
+                                            q0, k_off, window,
+                                            sorted(expected),
+                                            sorted(visible_blocks),
+                                        )
+
     def test_window_requires_causal(self, qkv):
         q, k, v = qkv
         with pytest.raises(ValueError, match="causal"):
