@@ -196,6 +196,50 @@ def _measure_windows(run_window, sync, n_windows: int, window: int):
     )
 
 
+def _pin_matmul_ceiling(
+    device, n_windows: int = 4, calls: int = 20, n: int = 8192
+) -> dict:
+    """Same-session achievable-matmul ceiling (VERDICT r3 weak #5).
+
+    Single-dispatch microbenches on the tunnel backend vary wildly between
+    sessions (the same 8192^3 bf16 matmul has measured 81 and 25 TFLOPS on
+    different days), so an MFU headline is only interpretable next to a
+    matmul ceiling pinned in the SAME session. Multi-call windows anchored
+    by one scalar readback; median window is the estimate.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    a = jax.device_put(
+        jax.random.normal(jax.random.PRNGKey(0), (n, n), jnp.bfloat16), device
+    )
+    b = jax.device_put(
+        jax.random.normal(jax.random.PRNGKey(1), (n, n), jnp.bfloat16), device
+    )
+    matmul = jax.jit(lambda a, b: a @ b)
+    box = {}
+
+    def run_window():
+        for _ in range(calls):
+            box["out"] = matmul(a, b)
+
+    def sync():
+        if "out" in box:
+            float(jax.device_get(box["out"][0, 0]))
+
+    for _ in range(10):  # per-executable tunnel warm-in
+        box["out"] = matmul(a, b)
+    calls_per_sec, _, _ = _measure_windows(run_window, sync, n_windows, calls)
+    tflops = 2.0 * n * n * n * calls_per_sec / 1e12
+    return {
+        "matmul_ceiling_tflops": round(tflops, 2),
+        "matmul_ceiling_fraction_of_peak": round(
+            tflops * 1e12 / _peak_flops(device), 4
+        ),
+        "matmul_shape": n,
+    }
+
+
 def _analytic_train_flops(image_size, batch_size, num_convs=(6, 6, 3)) -> float:
     """Fallback FLOPs estimate for one Grasping44 train step: summed conv
     and dense MACs x2, x3 for forward+backward (standard 1:2 fwd:bwd)."""
@@ -933,6 +977,49 @@ def main() -> None:
             except Exception as scan_err:  # noqa: BLE001 — report per-step
                 # numbers rather than dying on the optimization path.
                 print(f"bench: scan path failed: {scan_err}", file=sys.stderr)
+        # Infeed-in-the-loop leg (VERDICT r3 item 5): fresh HOST batches
+        # through train/infeed.py double-buffering each step, instead of
+        # the pre-sharded device batch. The ratio to the pre-sharded rate
+        # is the overlap efficiency — 1.0 means host->device transfer
+        # fully hides behind compute.
+        infeed_steps_per_sec = 0.0
+        try:
+            import itertools
+
+            from tensor2robot_tpu.train import infeed as infeed_lib
+
+            # Distinct host arrays so no transfer can be deduplicated.
+            host_batches = [
+                jax.tree_util.tree_map(lambda x: np.asarray(x).copy(), batch)
+                for _ in range(3)
+            ]
+
+            def run_infeed_window():
+                feed = infeed_lib.device_prefetch(
+                    itertools.islice(itertools.cycle(host_batches), window),
+                    compiled.shard_batch,
+                    depth=2,
+                )
+                for device_batch in feed:
+                    box["state"], box["metrics"] = compiled.train_step(
+                        box["state"], device_batch, rng
+                    )
+
+            run_infeed_window()  # transfer-path warm-in, untimed
+            sync()
+            infeed_steps_per_sec, _, _ = _measure_windows(
+                run_infeed_window, sync, max(3, n_windows // 2), window
+            )
+        except Exception as infeed_err:  # noqa: BLE001 — optional leg
+            print(f"bench: infeed leg failed: {infeed_err}", file=sys.stderr)
+
+        ceiling = {}
+        if on_tpu:
+            try:
+                ceiling = _pin_matmul_ceiling(device)
+            except Exception as pin_err:  # noqa: BLE001 — optional leg
+                print(f"bench: ceiling pin failed: {pin_err}", file=sys.stderr)
+
         # Across REGIMES (per-step vs scan dispatch) the better one is the
         # headline — a deliberate design choice, not a max-statistic over
         # jittery samples; WITHIN each regime the estimate is the median.
@@ -963,6 +1050,25 @@ def main() -> None:
                         avg_steps_per_sec, 3
                     ),
                     "scan_dispatch_steps_per_sec": round(scan_steps_per_sec, 3),
+                    "infeed_steps_per_sec": round(infeed_steps_per_sec, 3),
+                    "infeed_overlap_efficiency": round(
+                        infeed_steps_per_sec / steps_per_sec, 4
+                    )
+                    if steps_per_sec > 0
+                    else 0.0,
+                    **ceiling,
+                    **(
+                        {
+                            "mfu_vs_matmul_ceiling": round(
+                                flops_per_step
+                                * best_steps_per_sec
+                                / (ceiling["matmul_ceiling_tflops"] * 1e12),
+                                4,
+                            )
+                        }
+                        if ceiling.get("matmul_ceiling_tflops")
+                        else {}
+                    ),
                     "timing": "median_of_windows_best_regime",
                     "flops_per_step": flops_per_step,
                     "flops_source": flops_source,
