@@ -1,0 +1,80 @@
+"""Non-overlapping max pooling with a scatter-free backward.
+
+XLA lowers the gradient of window max pooling to SelectAndScatter, which
+on TPU executes as a slow, poorly-fusible per-window scatter — the round-3
+profile showed the Grasping44 stem pool's select-and-scatter as the single
+most expensive non-gather op in the train step. Every pool in the
+Grasping44 tower (reference research/qtopt/networks.py:446,460,540) is
+NON-overlapping (window == stride), where the backward has a much better
+formulation: reshape the input into its disjoint windows, compare against
+the broadcast pooled maximum, and split the incoming gradient over the
+mask — pure elementwise/reduce work that XLA fuses.
+
+The forward stays `lax.reduce_window` (already optimal on TPU); only the
+VJP is replaced via `jax.custom_vjp`.
+
+Gradient tie-breaking: where a window holds several elements equal to the
+maximum (common after relu: exact zeros), the incoming gradient is split
+EQUALLY among them, whereas SelectAndScatter routes it all to the first.
+Both are valid subgradients of the same function; the equal split is the
+same choice `jnp.max`'s native gradient makes.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _same_pads(shape, window: Tuple[int, int]):
+    """Per-dimension (low, high) pads for SAME padding on an NHWC input,
+    matching lax.reduce_window's padtype_to_pads for stride == window."""
+    dims = (1, window[0], window[1], 1)
+    return lax.padtype_to_pads(shape, dims, dims, "SAME")
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def max_pool_nonoverlap(x: jax.Array, window: Tuple[int, int]) -> jax.Array:
+    """SAME-padded max pool over NHWC with stride == window."""
+    dims = (1, window[0], window[1], 1)
+    init = jnp.asarray(-jnp.inf, x.dtype)
+    return lax.reduce_window(x, init, lax.max, dims, dims, "SAME")
+
+
+def _fwd(x, window):
+    return max_pool_nonoverlap(x, window), x
+
+
+def _bwd(window, x, g):
+    # The window maximum is RECOMPUTED here from the same reshaped-window
+    # tensor the mask compares against, rather than reusing the forward's
+    # output: inside a large fused program XLA may rematerialize the
+    # forward max with different intermediate numerics (e.g. a different
+    # relu/cast fusion upstream), and an equality test against a
+    # not-bit-identical max can match zero elements in a window —
+    # turning the g/count split into inf. Self-consistency by
+    # construction guarantees count >= 1. (It also shrinks the residual
+    # to just x.)
+    wh, ww = window
+    pads = _same_pads(x.shape, window)
+    xp = jnp.pad(x, pads, constant_values=-jnp.inf)
+    b, hp, wp, c = xp.shape
+    oh, ow = hp // wh, wp // ww
+    windows = xp.reshape(b, oh, wh, ow, ww, c)
+    mask = windows == jnp.max(windows, axis=(2, 4), keepdims=True)
+    count = jnp.sum(mask, axis=(2, 4), keepdims=True)
+    share = (g[:, :, None, :, None, :] / count.astype(g.dtype)) * mask
+    gx = share.reshape(b, hp, wp, c)[
+        :,
+        pads[1][0] : hp - pads[1][1],
+        pads[2][0] : wp - pads[2][1],
+        :,
+    ]
+    return (gx.astype(x.dtype),)
+
+
+max_pool_nonoverlap.defvjp(_fwd, _bwd)

@@ -29,6 +29,8 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from tensor2robot_tpu.ops import pooling
+
 # Named grasp-param sub-blocks of the E2E variant: {name: (offset, size)}
 # (reference networks.py:724-732). Separate per-block input projections.
 E2E_GRASP_PARAM_BLOCKS: Dict[str, Tuple[int, int]] = {
@@ -55,9 +57,15 @@ class _ConvBNRelu(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array, is_training: bool) -> jax.Array:
-        # Conv computes in `dtype` (bf16 on the TPU forward path: params are
-        # cast for the MXU matmul, master copies stay f32); BatchNorm is left
-        # to promote to f32 so running statistics never accumulate in bf16.
+        # Conv AND BatchNorm compute in `dtype` (bf16 on the TPU forward
+        # path: params are cast for the MXU matmul, master copies stay
+        # f32). Passing dtype to BN is statistics-safe — flax computes
+        # batch mean/var internally in f32 regardless, and the running
+        # stats live in f32 param storage — while keeping the normalized
+        # activation in the compute dtype, so no f32 copy of the full
+        # activation ever needs to reach HBM (at bs64/472px the stage-1
+        # activation is 456 MB in bf16; an f32 normalize output doubles
+        # the block's write traffic on the usual-bottleneck bandwidth).
         x = nn.Conv(
             self.features,
             self.kernel,
@@ -72,9 +80,9 @@ class _ConvBNRelu(nn.Module):
             momentum=self.momentum,
             epsilon=self.epsilon,
             use_scale=True,
+            dtype=self.dtype,
         )(x)
-        x = nn.relu(x)
-        return x.astype(self.dtype) if self.dtype is not None else x
+        return nn.relu(x)
 
 
 class Grasping44(nn.Module):
@@ -117,10 +125,16 @@ class Grasping44(nn.Module):
         # path. BatchNorm always promotes to f32 (see _ConvBNRelu).
         dtype = jnp.bfloat16 if images.dtype == jnp.bfloat16 else None
 
+        # BN computes in the network dtype (stats stay f32 inside flax;
+        # see _ConvBNRelu) so no f32 copy of a full activation reaches
+        # HBM — bn1's output is the largest activation in the network
+        # ([B, 236, 236, 64] at 472px) and the round-3 profile showed its
+        # f32 spill dominating the stem's bandwidth.
         bn_kwargs = dict(
             use_running_average=not is_training,
             momentum=self.batch_norm_momentum,
             epsilon=self.batch_norm_epsilon,
+            dtype=dtype,
         )
 
         # Stem: conv without norm/activation, then a standalone unscaled BN
@@ -131,15 +145,10 @@ class Grasping44(nn.Module):
         )(images)
         net = nn.BatchNorm(use_scale=False, name="bn1", **bn_kwargs)(net)
         net = nn.relu(net)
-        # Back to the compute dtype BEFORE the pool (same policy as
-        # _ConvBNRelu): bn1's f32 output is the largest activation in the
-        # network ([B, 236, 236, 64] at 472px), and leaving it f32 doubles
-        # the HBM traffic of the stem pool fwd+bwd — the round-3 profile
-        # showed the resulting f32 select-and-scatter as the single most
-        # expensive non-gather op in the train step (5.8 ms).
-        if dtype is not None:
-            net = net.astype(dtype)
-        net = nn.max_pool(net, (3, 3), strides=(3, 3), padding="SAME")
+        # Non-overlapping pools use the scatter-free backward (the XLA
+        # SelectAndScatter pool gradient was the top non-gather op in the
+        # round-3 profile); forward is bit-identical to nn.max_pool.
+        net = pooling.max_pool_nonoverlap(net, (3, 3))
 
         for i in range(self.num_convs[0]):
             net = _ConvBNRelu(
@@ -149,7 +158,7 @@ class Grasping44(nn.Module):
                 name=f"conv{2 + i}",
                 dtype=dtype,
             )(net, is_training)
-        net = nn.max_pool(net, (3, 3), strides=(3, 3), padding="SAME")
+        net = pooling.max_pool_nonoverlap(net, (3, 3))
         end_points["pool2"] = net
 
         # Grasp-param input head: one linear projection per named block,
@@ -194,7 +203,7 @@ class Grasping44(nn.Module):
                 name=f"conv{2 + self.num_convs[0] + i}",
                 dtype=dtype,
             )(net, is_training)
-        net = nn.max_pool(net, (2, 2), strides=(2, 2), padding="SAME")
+        net = pooling.max_pool_nonoverlap(net, (2, 2))
         for i in range(self.num_convs[2]):
             net = _ConvBNRelu(
                 64, (3, 3), padding="VALID",
@@ -221,8 +230,6 @@ class Grasping44(nn.Module):
             )
             net = nn.BatchNorm(name=f"bn_fc{i}", **bn_kwargs)(net)
             net = nn.relu(net)
-            if dtype is not None:
-                net = net.astype(dtype)
 
         # Logit head computes and emits float32: the loss-bearing scalar
         # (and the sigmoid CEM objective) should not quantize to bf16.

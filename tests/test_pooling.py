@@ -1,0 +1,152 @@
+"""Non-overlapping max pool: forward parity with nn.max_pool, the
+scatter-free gradient, and the structural no-SelectAndScatter pin."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensor2robot_tpu.ops.pooling import max_pool_nonoverlap
+
+
+class TestForwardParity:
+    @pytest.mark.parametrize("window", [(3, 3), (2, 2)])
+    @pytest.mark.parametrize(
+        "shape",
+        [(2, 236, 236, 4), (2, 79, 79, 4), (1, 6, 6, 3), (3, 7, 11, 2)],
+    )
+    def test_matches_nn_max_pool_same(self, window, shape):
+        x = jax.random.normal(jax.random.PRNGKey(0), shape)
+        got = max_pool_nonoverlap(x, window)
+        want = nn.max_pool(x, window, strides=window, padding="SAME")
+        assert got.shape == want.shape
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_bfloat16(self):
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 9, 9, 8), jnp.bfloat16)
+        got = max_pool_nonoverlap(x, (3, 3))
+        want = nn.max_pool(x, (3, 3), strides=(3, 3), padding="SAME")
+        assert got.dtype == jnp.bfloat16
+        np.testing.assert_array_equal(
+            np.asarray(got, np.float32), np.asarray(want, np.float32)
+        )
+
+
+class TestGradient:
+    def test_matches_select_and_scatter_without_ties(self):
+        # Continuous random input: ties have probability ~0, where the
+        # custom VJP must agree exactly with XLA's select-and-scatter.
+        x = jax.random.normal(jax.random.PRNGKey(2), (2, 10, 13, 3))
+
+        def loss_custom(x):
+            return jnp.sum(max_pool_nonoverlap(x, (3, 3)) ** 2)
+
+        def loss_xla(x):
+            return jnp.sum(
+                nn.max_pool(x, (3, 3), strides=(3, 3), padding="SAME") ** 2
+            )
+
+        np.testing.assert_allclose(
+            np.asarray(jax.grad(loss_custom)(x)),
+            np.asarray(jax.grad(loss_xla)(x)),
+            rtol=1e-6,
+        )
+
+    def test_gradient_mass_is_preserved(self):
+        # Each output's cotangent lands in its window exactly once (split
+        # over ties, but summing to the original) — including windows that
+        # straddle the SAME padding.
+        x = jnp.zeros((1, 7, 7, 1))  # all ties everywhere
+
+        def loss(x):
+            return jnp.sum(max_pool_nonoverlap(x, (3, 3)) * 2.0)
+
+        gx = jax.grad(loss)(x)
+        np.testing.assert_allclose(float(jnp.sum(gx)), 2.0 * 3 * 3, rtol=1e-6)
+
+    def test_ties_split_equally(self):
+        x = jnp.array([[1.0, 1.0], [0.0, 1.0]]).reshape(1, 2, 2, 1)
+        gx = jax.grad(lambda x: jnp.sum(max_pool_nonoverlap(x, (2, 2))))(x)
+        np.testing.assert_allclose(
+            np.asarray(gx).reshape(2, 2),
+            np.array([[1 / 3, 1 / 3], [0.0, 1 / 3]]),
+            rtol=1e-6,
+        )
+
+    def test_grad_dtype_follows_input(self):
+        x = jax.random.normal(jax.random.PRNGKey(3), (1, 6, 6, 2), jnp.bfloat16)
+        gx = jax.grad(
+            lambda x: jnp.sum(max_pool_nonoverlap(x, (2, 2)).astype(jnp.float32))
+        )(x)
+        assert gx.dtype == jnp.bfloat16
+
+
+class TestStructural:
+    def test_backward_has_no_select_and_scatter(self):
+        """The whole point: the pool gradient must not lower to XLA
+        SelectAndScatter (the round-3 profile's top non-gather op)."""
+
+        def loss(x):
+            return jnp.sum(max_pool_nonoverlap(x, (3, 3)))
+
+        txt = (
+            jax.jit(jax.grad(loss))
+            .lower(jnp.zeros((2, 236, 236, 64), jnp.bfloat16))
+            .compile()
+            .as_text()
+        )
+        assert "select-and-scatter" not in txt.lower()
+
+    def test_grasping44_train_grad_has_no_select_and_scatter(self):
+        """Every pool in the Grasping44 tower is non-overlapping; pin that
+        the full network gradient stays scatter-free."""
+        from tensor2robot_tpu.research.qtopt.networks import Grasping44
+
+        model = Grasping44(num_convs=(1, 1, 1))
+        images = jnp.zeros((2, 96, 96, 3), jnp.bfloat16)
+        params = jnp.zeros((2, 10), jnp.float32)
+        variables = model.init(
+            jax.random.PRNGKey(0), images, params, is_training=True
+        )
+
+        def loss(v):
+            logits, _ = model.apply(
+                v, images, params, is_training=True, mutable=["batch_stats"]
+            )[0]
+            return jnp.sum(logits)
+
+        txt = (
+            jax.jit(jax.grad(loss))
+            .lower(variables)
+            .compile()
+            .as_text()
+        )
+        assert "select-and-scatter" not in txt.lower()
+
+
+class TestBatchNormDtype:
+    def test_tower_activations_stay_bf16(self):
+        """BN in compute dtype: with bf16 images no f32 copy of a tower
+        activation is produced (the r3 bandwidth finding) — end_points
+        carry the compute dtype, while the loss-bearing logits stay f32."""
+        from tensor2robot_tpu.research.qtopt.networks import Grasping44
+
+        model = Grasping44(num_convs=(1, 1, 1))
+        images = jnp.zeros((2, 96, 96, 3), jnp.bfloat16)
+        params = jnp.zeros((2, 10), jnp.float32)
+        variables = model.init(
+            jax.random.PRNGKey(0), images, params, is_training=True
+        )
+        (logits, end_points), _ = model.apply(
+            variables, images, params, is_training=True,
+            mutable=["batch_stats"],
+        )
+        assert end_points["pool2"].dtype == jnp.bfloat16
+        assert end_points["vsum"].dtype == jnp.bfloat16
+        assert end_points["final_conv"].dtype == jnp.bfloat16
+        assert end_points["fcgrasp"].dtype == jnp.bfloat16
+        assert logits.dtype == jnp.float32
+        # Running statistics must still accumulate in f32.
+        stats = jax.tree_util.tree_leaves(variables["batch_stats"])
+        assert all(s.dtype == jnp.float32 for s in stats)

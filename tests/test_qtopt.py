@@ -318,11 +318,17 @@ class TestGrasping44Model:
             "labels": {"reward": np.ones((2, 1), np.float32)},
         }
         state = compiled.init_state(jax.random.PRNGKey(0), batch)
-        state, metrics = compiled.train_step(
-            state, batch, jax.random.PRNGKey(1)
-        )
-        assert np.isfinite(float(metrics["loss"]))
-        assert int(jax.device_get(state.step)) == 1
+        # MULTIPLE steps, each checked finite: the round-4 pool-VJP bug
+        # produced a clean step-0 loss while poisoning the step-0 params
+        # with inf (a g/0 split when XLA rematerialized the pool max with
+        # different numerics inside the fused program) — only the step-1
+        # loss went NaN.
+        for i in range(3):
+            state, metrics = compiled.train_step(
+                state, batch, jax.random.PRNGKey(1 + i)
+            )
+            assert np.isfinite(float(metrics["loss"])), f"step {i}"
+        assert int(jax.device_get(state.step)) == 3
         # EMA params maintained (use_avg_model_params default True).
         assert state.ema_params is not None
 

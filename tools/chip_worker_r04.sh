@@ -33,7 +33,10 @@ sleep_s="${CHIP_WORKER_SLEEP:-300}"
 log() { echo "chip_worker_r04: $* $(date -u +%H:%M:%S)" >&2; }
 
 commit_artifact() {  # commit_artifact <file> <message>
-  git add "$1" && git commit -q -m "$2" && log "committed $1"
+  # Pathspec-limited: the worker runs unattended next to live development,
+  # so it must never sweep half-finished staged changes into an artifact
+  # commit.
+  git add "$1" && git commit -q -m "$2" -- "$1" && log "committed $1"
 }
 
 # have <file> <must-grep> — artifact already captured on real TPU?
@@ -46,13 +49,33 @@ have() {
     && ! grep -q '"error":' "$1"
 }
 
+probe_pid=""
 tunnel_alive() {
   # Relay process must exist before anything touches jax (see header).
   pgrep -f '/root/\.relay\.py' >/dev/null 2>&1 || return 1
+  # NEVER signal a probe that may have touched jax — not even via
+  # `timeout` (the round-3 wedge was a timeout-killed probe mid-
+  # handshake). The probe runs unsupervised and reports through a
+  # sentinel file; if it stalls we leave it alone, report the tunnel
+  # down, and refuse to stack another probe on top of it.
+  if [ -n "$probe_pid" ] && kill -0 "$probe_pid" 2>/dev/null; then
+    log "previous probe (pid $probe_pid) still pending; not stacking"
+    return 1
+  fi
   sleep 10  # let a freshly-restored relay settle before the first client
-  timeout 90 python -c \
-    "import jax; ds=jax.devices(); assert ds[0].platform=='tpu'" \
-    >/dev/null 2>&1
+  rm -f /tmp/w_r04_probe_ok
+  ( python -c \
+      "import jax; ds=jax.devices(); assert ds[0].platform=='tpu'" \
+      >/dev/null 2>&1 && touch /tmp/w_r04_probe_ok ) &
+  probe_pid=$!
+  for _ in $(seq 1 48); do  # wait up to 240s — checking, never signaling
+    if ! kill -0 "$probe_pid" 2>/dev/null; then
+      [ -f /tmp/w_r04_probe_ok ]; return $?
+    fi
+    sleep 5
+  done
+  log "probe still pending after 240s; leaving it be"
+  return 1
 }
 
 all_done() {

@@ -26,6 +26,19 @@ sys.path.insert(
     0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 )
 
+# The golden contract is "what the TEST environment computes": tests run on
+# the 8-virtual-device CPU mesh (tests/conftest.py), and sharded reductions
+# accumulate in a different order than single-device ones — enough to move
+# decimal=5 comparisons. Pin the same topology here so regeneration from a
+# plain shell reproduces the values the suite will check.
+if "xla_force_host_platform_device_count" not in os.environ.get(
+    "XLA_FLAGS", ""
+):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
 GOLDEN_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     "tests",
