@@ -100,6 +100,7 @@ class _Grasping44Net(nn.Module):
 
     grasp_param_blocks: Optional[Dict[str, Tuple[int, int]]] = None
     num_convs: Tuple[int, int, int] = (6, 6, 3)
+    batch_norm_momentum: float = 0.997
 
     @nn.compact
     def __call__(self, features, mode):
@@ -110,6 +111,7 @@ class _Grasping44Net(nn.Module):
         logits, end_points = Grasping44(
             grasp_param_blocks=self.grasp_param_blocks,
             num_convs=self.num_convs,
+            batch_norm_momentum=self.batch_norm_momentum,
             name="grasping44",
         )(
             features.state.image,
@@ -194,10 +196,16 @@ class Grasping44E2EOpenCloseTerminateGripperStatusHeightToBottom(
         self,
         image_size: Tuple[int, int] = (472, 472),
         num_convs: Tuple[int, int, int] = (6, 6, 3),
+        batch_norm_momentum: float = 0.997,
         **kwargs,
     ):
         self._image_size = tuple(image_size)
         self._num_convs = tuple(num_convs)
+        # Reference default 0.997 (slim arg_scope); exposed because short
+        # trainings (tests, the AUC bench) need running stats that adapt
+        # within a few hundred steps to produce meaningful eval-mode
+        # inference.
+        self._batch_norm_momentum = batch_norm_momentum
         super().__init__(**kwargs)
 
     def get_state_specification(self) -> TensorSpecStruct:
@@ -229,4 +237,5 @@ class Grasping44E2EOpenCloseTerminateGripperStatusHeightToBottom(
         return _Grasping44Net(
             grasp_param_blocks=E2E_GRASP_PARAM_BLOCKS,
             num_convs=self._num_convs,
+            batch_norm_momentum=self._batch_norm_momentum,
         )

@@ -72,6 +72,26 @@ def test_bench_data_contract():
 
 
 @pytest.mark.slow
+def test_bench_auc_contract():
+    """The bf16-accuracy-budget leg at toy step counts: pins the JSON
+    contract and the tie-safe AUC (values must be genuine fractions, not
+    the degenerate 0/1 an untie-corrected rank sum produces on constant
+    predictors)."""
+    payload = _run_bench(
+        "auc", env_extra={"BENCH_AUC_STEPS": "4", "BENCH_AUC_BATCH": "8"}
+    )
+    assert payload["metric"] == "qtopt_bf16_eval_auc_delta"
+    assert payload["unit"] == "auc_delta"
+    assert 0.0 <= payload["value"] <= 1.0
+    assert "error" not in payload
+    detail = payload["detail"]
+    assert 0.0 <= detail["auc_f32"] <= 1.0
+    assert 0.0 <= detail["auc_bf16"] <= 1.0
+    assert detail["train_steps"] == 4
+    assert detail["auc_method"] == "mann_whitney_rank"
+
+
+@pytest.mark.slow
 def test_bench_predict_contract():
     payload = _run_bench(
         "predict",
