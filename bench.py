@@ -460,20 +460,9 @@ def bench_auc() -> None:
             features, labels = synth(model, n_train, seed=0)
             eval_features, eval_labels = synth(model, n_eval, seed=1)
             compiled = CompiledModel(model, donate_state=False)
-            batch0 = {
-                "features": {
-                    k: np.asarray(v)[:batch_size]
-                    for k, v in features.items()
-                },
-                "labels": {
-                    "reward": labels[:batch_size].astype(np.float32)
-                },
-            }
-            state = compiled.init_state(jax.random.PRNGKey(0), batch0)
-            n_batches = n_train // batch_size
-            for step in range(steps):
-                lo = (step % n_batches) * batch_size
-                batch = {
+
+            def make_batch(lo):
+                return {
                     "features": {
                         k: np.asarray(v)[lo : lo + batch_size]
                         for k, v in features.items()
@@ -484,6 +473,11 @@ def bench_auc() -> None:
                         )
                     },
                 }
+
+            state = compiled.init_state(jax.random.PRNGKey(0), make_batch(0))
+            n_batches = n_train // batch_size
+            for step in range(steps):
+                batch = make_batch((step % n_batches) * batch_size)
                 state, metrics = compiled.train_step(
                     state, compiled.shard_batch(batch), jax.random.PRNGKey(2)
                 )

@@ -241,6 +241,83 @@ def main() -> None:
     t = timed(jax.jit(jax.grad(bn_loss)), (pbn, x79))
     record("conv5x5_block6_bn_fwd_bwd", t, flops=3.0 * flops_blk)
 
+    # --- round-4 A/Bs: the BN-compute-dtype fix, the scatter-free pool,
+    # and the conv-efficiency hypotheses (odd 79x79 spatial tiling;
+    # 64 channels on the 128-lane MXU). Each pairs with a control above
+    # so the post-fix chip session decomposes the remaining step time. ---
+    class BlockBNFix(nn.Module):
+        """The round-4 tower composition: BN in the compute dtype."""
+
+        @nn.compact
+        def __call__(self, x):
+            for _ in range(6):
+                x = nn.Conv(64, (5, 5), padding="SAME", use_bias=False,
+                            dtype=jnp.bfloat16)(x)
+                x = nn.BatchNorm(use_running_average=False, momentum=0.997,
+                                 dtype=jnp.bfloat16)(x)
+                x = nn.relu(x)
+            return x
+
+    bnfix = BlockBNFix()
+    pbnf = bnfix.init(key, x79)
+
+    def bnfix_loss(p, x):
+        y, _ = bnfix.apply(p, x, mutable=["batch_stats"])
+        return jnp.sum(y.astype(jnp.float32))
+
+    t = timed(jax.jit(jax.grad(bnfix_loss)), (pbnf, x79))
+    record("conv5x5_block6_bnfix_fwd_bwd", t, flops=3.0 * flops_blk)
+
+    # Stem-pool backward A/B: scatter-free custom VJP vs XLA
+    # SelectAndScatter, at the stem activation size.
+    from tensor2robot_tpu.ops.pooling import max_pool_nonoverlap
+
+    x236 = jax.random.normal(key, (B, 236, 236, 64), jnp.bfloat16)
+
+    def pool_free_loss(x):
+        return jnp.sum(
+            max_pool_nonoverlap(x, (3, 3)).astype(jnp.float32)
+        )
+
+    def pool_sas_loss(x):
+        return jnp.sum(
+            nn.max_pool(x, (3, 3), strides=(3, 3), padding="SAME").astype(
+                jnp.float32
+            )
+        )
+
+    t = timed(jax.jit(jax.grad(pool_free_loss)), (x236,))
+    record("stem_pool_bwd_scatterfree", t)
+    t = timed(jax.jit(jax.grad(pool_sas_loss)), (x236,))
+    record("stem_pool_bwd_selectscatter", t)
+
+    # Spatial-tiling hypothesis: same block at 80x80 (8-aligned) vs the
+    # tower's 79x79. A large gap would justify padding the tower stages.
+    x80 = jax.random.normal(key, (B, 80, 80, 64), jnp.bfloat16)
+    t = timed(blk_fwd, (pb, x80))
+    record("conv5x5_block6_pad80_fwd", t,
+           flops=6 * 2.0 * B * 80 * 80 * (5 * 5 * 64) * 64)
+
+    # Channel-width hypothesis: 64 channels fill half the 128-lane MXU.
+    # A 128-channel twin at matched depth shows the achievable pct_peak
+    # when the lanes are full — the architecture-ceiling datapoint for
+    # the written analysis.
+    class Block128(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            for _ in range(6):
+                x = nn.Conv(128, (5, 5), padding="SAME", use_bias=False,
+                            dtype=jnp.bfloat16)(x)
+                x = nn.relu(x)
+            return x
+
+    blk128 = Block128()
+    x79c128 = jax.random.normal(key, (B, 79, 79, 128), jnp.bfloat16)
+    pb128 = blk128.init(key, x79c128)
+    t = timed(jax.jit(lambda p, x: blk128.apply(p, x)), (pb128, x79c128))
+    record("conv5x5_block6_c128_fwd", t,
+           flops=6 * 2.0 * B * 79 * 79 * (5 * 5 * 128) * 128)
+
     # --- 2. entry conv: 6x6x3->64 /2 @ 472px ---
     class Entry(nn.Module):
         @nn.compact
@@ -278,7 +355,10 @@ def main() -> None:
                   (state, sharded))
         record("model_fwd_eval_step", t)
     except Exception as err:  # noqa: BLE001
-        out["cases"]["model_fwd_eval_step"] = {"error": str(err)[:200]}
+        # "case_error", not "error": the chip worker treats a top-level
+        # '"error":' key as a crashed run and retries; one failed optional
+        # case must not discard an otherwise-complete diagnosis.
+        out["cases"]["model_fwd_eval_step"] = {"case_error": str(err)[:200]}
 
     t = timed(compiled.train_step, (state, sharded, rng))
     try:
