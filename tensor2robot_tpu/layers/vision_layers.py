@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 
 from tensor2robot_tpu.layers.spatial_softmax import spatial_softmax
+from tensor2robot_tpu.ops import pooling
 
 
 def apply_film(x: jax.Array, film_gamma_beta: Optional[jax.Array]) -> jax.Array:
@@ -155,7 +156,8 @@ class ImagesToFeaturesHighResNet(nn.Module):
         net = nn.relu(nn.LayerNorm(name="norm2")(net))
         block_outs.append(nn.Conv(32, (1, 1), name="conv2_1x1")(net))
         for i in range(1, self.num_blocks):
-            net = nn.max_pool(net, (2, 2), strides=(2, 2), padding="VALID")
+            # Non-overlapping pool: scatter-free backward (ops/pooling.py).
+            net = pooling.max_pool_nonoverlap(net, (2, 2), "VALID")
             net = nn.Conv(
                 32,
                 (self.filter_size, self.filter_size),

@@ -30,26 +30,28 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def _same_pads(shape, window: Tuple[int, int]):
-    """Per-dimension (low, high) pads for SAME padding on an NHWC input,
-    matching lax.reduce_window's padtype_to_pads for stride == window."""
+def _pool_pads(shape, window: Tuple[int, int], padding: str):
+    """Per-dimension (low, high) pads on an NHWC input, matching
+    lax.reduce_window's padtype_to_pads for stride == window."""
     dims = (1, window[0], window[1], 1)
-    return lax.padtype_to_pads(shape, dims, dims, "SAME")
+    return lax.padtype_to_pads(shape, dims, dims, padding)
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(1,))
-def max_pool_nonoverlap(x: jax.Array, window: Tuple[int, int]) -> jax.Array:
-    """SAME-padded max pool over NHWC with stride == window."""
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def max_pool_nonoverlap(
+    x: jax.Array, window: Tuple[int, int], padding: str = "SAME"
+) -> jax.Array:
+    """Max pool over NHWC with stride == window, SAME or VALID padding."""
     dims = (1, window[0], window[1], 1)
     init = jnp.asarray(-jnp.inf, x.dtype)
-    return lax.reduce_window(x, init, lax.max, dims, dims, "SAME")
+    return lax.reduce_window(x, init, lax.max, dims, dims, padding)
 
 
-def _fwd(x, window):
-    return max_pool_nonoverlap(x, window), x
+def _fwd(x, window, padding):
+    return max_pool_nonoverlap(x, window, padding), x
 
 
-def _bwd(window, x, g):
+def _bwd(window, padding, x, g):
     # The window maximum is RECOMPUTED here from the same reshaped-window
     # tensor the mask compares against, rather than reusing the forward's
     # output: inside a large fused program XLA may rematerialize the
@@ -59,21 +61,39 @@ def _bwd(window, x, g):
     # turning the g/count split into inf. Self-consistency by
     # construction guarantees count >= 1. (It also shrinks the residual
     # to just x.)
+    #
+    # SAME pads with -inf so partial windows align; VALID instead DROPS
+    # the trailing remainder (those inputs get zero gradient, matching
+    # reduce_window's VALID semantics).
     wh, ww = window
-    pads = _same_pads(x.shape, window)
-    xp = jnp.pad(x, pads, constant_values=-jnp.inf)
-    b, hp, wp, c = xp.shape
-    oh, ow = hp // wh, wp // ww
+    b, h, w, c = x.shape
+    # reduce_window uppercases padding strings in the forward; match it,
+    # or a lowercase "valid" would take the SAME branch here.
+    padding = padding.upper()
+    if padding == "VALID":
+        oh, ow = h // wh, w // ww
+        xp = x[:, : oh * wh, : ow * ww, :]
+        hp, wp = oh * wh, ow * ww
+        pads = None
+    else:
+        pads = _pool_pads(x.shape, window, padding)
+        xp = jnp.pad(x, pads, constant_values=-jnp.inf)
+        hp, wp = xp.shape[1], xp.shape[2]
+        oh, ow = hp // wh, wp // ww
     windows = xp.reshape(b, oh, wh, ow, ww, c)
     mask = windows == jnp.max(windows, axis=(2, 4), keepdims=True)
     count = jnp.sum(mask, axis=(2, 4), keepdims=True)
     share = (g[:, :, None, :, None, :] / count.astype(g.dtype)) * mask
-    gx = share.reshape(b, hp, wp, c)[
-        :,
-        pads[1][0] : hp - pads[1][1],
-        pads[2][0] : wp - pads[2][1],
-        :,
-    ]
+    gx = share.reshape(b, hp, wp, c)
+    if padding == "VALID":
+        gx = jnp.pad(gx, ((0, 0), (0, h - hp), (0, w - wp), (0, 0)))
+    else:
+        gx = gx[
+            :,
+            pads[1][0] : hp - pads[1][1],
+            pads[2][0] : wp - pads[2][1],
+            :,
+        ]
     return (gx.astype(x.dtype),)
 
 

@@ -23,6 +23,17 @@ class TestForwardParity:
         assert got.shape == want.shape
         np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
+    @pytest.mark.parametrize("window", [(3, 3), (2, 2)])
+    @pytest.mark.parametrize(
+        "shape", [(2, 7, 11, 3), (1, 6, 6, 2), (2, 9, 8, 4)]
+    )
+    def test_matches_nn_max_pool_valid(self, window, shape):
+        x = jax.random.normal(jax.random.PRNGKey(4), shape)
+        got = max_pool_nonoverlap(x, window, "VALID")
+        want = nn.max_pool(x, window, strides=window, padding="VALID")
+        assert got.shape == want.shape
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
     def test_bfloat16(self):
         x = jax.random.normal(jax.random.PRNGKey(1), (2, 9, 9, 8), jnp.bfloat16)
         got = max_pool_nonoverlap(x, (3, 3))
@@ -73,6 +84,26 @@ class TestGradient:
             np.array([[1 / 3, 1 / 3], [0.0, 1 / 3]]),
             rtol=1e-6,
         )
+
+    def test_valid_gradient_matches_xla_and_zeroes_remainder(self):
+        # VALID drops the trailing remainder; those inputs must get zero
+        # gradient, and covered inputs must match select-and-scatter on
+        # tie-free data.
+        x = jax.random.normal(jax.random.PRNGKey(5), (2, 7, 11, 3))
+
+        def loss_custom(x):
+            return jnp.sum(max_pool_nonoverlap(x, (3, 3), "VALID") ** 2)
+
+        def loss_xla(x):
+            return jnp.sum(
+                nn.max_pool(x, (3, 3), strides=(3, 3), padding="VALID") ** 2
+            )
+
+        g_custom = np.asarray(jax.grad(loss_custom)(x))
+        g_xla = np.asarray(jax.grad(loss_xla)(x))
+        np.testing.assert_allclose(g_custom, g_xla, rtol=1e-6)
+        assert np.all(g_custom[:, 6:, :, :] == 0)
+        assert np.all(g_custom[:, :, 9:, :] == 0)
 
     def test_grad_dtype_follows_input(self):
         x = jax.random.normal(jax.random.PRNGKey(3), (1, 6, 6, 2), jnp.bfloat16)
