@@ -853,7 +853,11 @@ def bench_bc() -> None:
                         "d_model": d_model, "num_layers": num_layers,
                         "num_heads": num_heads, "head_dim": head_dim,
                     },
-                    "attention": "flash (pallas) on tpu; reference off-tpu",
+                    "attention": (
+                        "xla reference (model default; flash is opt-in "
+                        "after BENCH_FLASH_r03 measured the pallas kernel "
+                        "at 0.7% MFU)"
+                    ),
                     **(
                         {"backend_note": backend_note}
                         if backend_note

@@ -1,15 +1,18 @@
-"""Transformer blocks on the flash-attention hot op.
+"""Transformer blocks over the attention hot op.
 
 Beyond the reference layer library (its temporal models top out at
 SNAIL/TCN scale, layers/snail.py; SURVEY §5 long-context row): a standard
 pre-norm transformer whose attention routes through ops/flash_attention —
-single-device flash on TPU, and sequence-parallel attention when
-constructed with a mesh whose `sequence` axis is >1 — the ring
-(parallel/ring_attention.py) by default, or Ulysses all-to-all
-(parallel/ulysses_attention.py) via `sequence_parallel_mode="ulysses"`.
-Sequence length lives in the specs, so the same model trains short
-episodes on one chip and long contexts on a CP mesh without code
-changes.
+single-device attention on the XLA einsum path by default (the Pallas
+flash kernel is opt-in via `use_flash=True`; see
+MultiHeadAttention.use_flash for the measured rationale), and
+sequence-parallel attention when constructed with a mesh whose
+`sequence` axis is >1 — the ring (parallel/ring_attention.py) by
+default, or Ulysses all-to-all (parallel/ulysses_attention.py) via
+`sequence_parallel_mode="ulysses"`; the mesh paths prefer flash tiles
+for their O(seq) memory. Sequence length lives in the specs, so the same
+model trains short episodes on one chip and long contexts on a CP mesh
+without code changes.
 """
 
 from __future__ import annotations
@@ -30,14 +33,29 @@ class MultiHeadAttention(nn.Module):
 
     mesh: when given with a sequence axis > 1, attention runs
     sequence-parallel (the ring by default; `sequence_parallel_mode=
-    "ulysses"` selects the all-to-all strategy); otherwise the
-    single-device flash kernel (with its reference fallback off-TPU).
+    "ulysses"` selects the all-to-all strategy); otherwise single-device
+    attention via plain XLA (default) or the Pallas flash kernel
+    (use_flash=True).
     """
 
     num_heads: int
     head_dim: int
     causal: bool = True
     mesh: Optional[object] = None
+    # Attention kernel policy, tri-state:
+    #   None (default) — single-device attention takes the XLA einsum
+    #     path, measured FASTER than the Pallas flash kernel on the
+    #     available chip (BENCH_FLASH_r03 microbench: flash fwd 1.33
+    #     TFLOPS at b4/s2048/h8/d128 bf16, ~0.7% of peak;
+    #     docs/PERFORMANCE.md). Sequence-parallel (mesh) attention keeps
+    #     ring/ulysses' own auto default, which PREFERS flash tiles:
+    #     there the einsum path materializes S_local^2 logits per hop,
+    #     so flash is a memory lever before it is a speed one.
+    #   True — force the flash kernel everywhere (the O(S)-memory lever
+    #     single-device too).
+    #   False — force the einsum path everywhere.
+    # The on-chip A/B in BENCH_FLASH_r04 re-evaluates this default each
+    # capture.
     use_flash: Optional[bool] = None
     interpret: bool = False
     # Causal sliding window W (each query attends to its last W steps).
@@ -121,6 +139,10 @@ class MultiHeadAttention(nn.Module):
                 ulysses_attention,
             )
 
+            # The sequence-parallel paths KEEP their own None=auto flash
+            # default (ring_attention.py:204): per-hop tiles materialize
+            # S_local^2 logits on the einsum path, so flash there is a
+            # memory lever first and the kernels' shape-fallback applies.
             out = ulysses_attention(
                 q, k, v, mesh=self.mesh, causal=self.causal,
                 use_flash=self.use_flash, interpret=self.interpret,
@@ -134,15 +156,17 @@ class MultiHeadAttention(nn.Module):
                 use_flash=self.use_flash, interpret=self.interpret,
                 window=self.window,
             )
-        elif self.use_flash is False:
-            # Explicit opt-out: the einsum reference on any backend.
-            out = flash_lib.reference_attention(
-                q, k, v, causal=self.causal, window=self.window
-            )
-        else:
+        elif self.use_flash:
+            # Explicit opt-in (O(S)-memory lever; see use_flash above).
             out = flash_lib.flash_attention(
                 q, k, v, causal=self.causal, interpret=self.interpret,
                 window=self.window,
+            )
+        else:
+            # Default: plain-XLA attention, measured faster on-chip than
+            # the Pallas kernel at these sizes (use_flash docstring).
+            out = flash_lib.reference_attention(
+                q, k, v, causal=self.causal, window=self.window
             )
         out = out.reshape(batch, seq, features)
         return nn.Dense(x.shape[-1], use_bias=False, name="out")(out)

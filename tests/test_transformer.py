@@ -27,10 +27,12 @@ def x():
 
 class TestMultiHeadAttention:
     def test_matches_reference_attention(self, x):
-        # interpret=True: the Pallas kernel really runs (a default CPU MHA
-        # would fall back to the oracle and compare it against itself).
+        # use_flash=True + interpret=True: the Pallas kernel really runs
+        # (since the r4 default flip, a default MHA takes the einsum path
+        # and would compare the oracle against itself).
         mha = MultiHeadAttention(
-            num_heads=2, head_dim=8, causal=True, interpret=True
+            num_heads=2, head_dim=8, causal=True, use_flash=True,
+            interpret=True,
         )
         variables = mha.init(jax.random.PRNGKey(0), x)
         out = mha.apply(variables, x)
@@ -112,7 +114,8 @@ class TestTransformerEncoder:
         variables = mha_ref.init(jax.random.PRNGKey(0), x)
         out_ref = mha_ref.apply(variables, x)
         out_flash = MultiHeadAttention(
-            num_heads=2, head_dim=8, causal=True, interpret=True
+            num_heads=2, head_dim=8, causal=True, use_flash=True,
+            interpret=True,
         ).apply(variables, x)
         np.testing.assert_allclose(
             np.asarray(out_ref), np.asarray(out_flash), rtol=2e-5, atol=2e-5
