@@ -123,7 +123,14 @@ def load_checkpoint_variables(
                 "ema_params (trained without use_avg_model_params)."
             )
         variables = dict(variables)
-        variables["params"] = tree["ema_params"]
+        # ema_as_tree: a flat-EMA checkpoint (flatten_optimizer_update)
+        # stores one 1-D vector; unravel it against the checkpoint's own
+        # params structure before path-based matching sees it.
+        from tensor2robot_tpu.train.state import ema_as_tree
+
+        variables["params"] = ema_as_tree(
+            tree["ema_params"], variables["params"]
+        )
     return variables
 
 

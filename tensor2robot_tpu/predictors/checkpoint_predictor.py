@@ -19,6 +19,7 @@ import numpy as np
 from tensor2robot_tpu.config import configurable
 from tensor2robot_tpu.predictors.abstract_predictor import AbstractPredictor
 from tensor2robot_tpu.specs import TensorSpecStruct, flatten_spec_structure
+from tensor2robot_tpu.train import state as state_lib
 
 
 @configurable("CheckpointPredictor")
@@ -134,7 +135,13 @@ class CheckpointPredictor(AbstractPredictor):
                                 self._use_ema
                                 and restored.get("ema_params") is not None
                             ):
-                                variables["params"] = restored["ema_params"]
+                                # ema_as_tree: a flat-EMA checkpoint
+                                # (flatten_optimizer_update) stores one
+                                # 1-D vector, not a params tree.
+                                variables["params"] = state_lib.ema_as_tree(
+                                    restored["ema_params"],
+                                    variables["params"],
+                                )
                             self._variables = variables
                         else:
                             self._variables = restored.export_variables(

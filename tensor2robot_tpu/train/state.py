@@ -13,8 +13,28 @@ from typing import Any, Dict, Optional
 
 import flax.struct
 import jax
+import jax.flatten_util  # registers jax.flatten_util.ravel_pytree
 import jax.numpy as jnp
 import optax
+
+
+def _is_flat_ema(ema) -> bool:
+    """True when the EMA is stored as one concatenated vector (the
+    flatten_optimizer_update regime) rather than a params-shaped tree."""
+    return hasattr(ema, "ndim") and ema.ndim == 1
+
+
+def ema_as_tree(ema_params, params_tree):
+    """EMA as a params-shaped tree, whatever the stored layout.
+
+    Every consumer that reads ema_params — live state, restored
+    checkpoints (predictors, warm start) — must route through this, not
+    use the raw value: a flat-stored EMA (flatten_optimizer_update
+    regime) is a single 1-D vector that only this unravel, against the
+    matching params structure, turns back into variables."""
+    if _is_flat_ema(ema_params):
+        return jax.flatten_util.ravel_pytree(params_tree)[1](ema_params)
+    return ema_params
 
 
 @flax.struct.dataclass
@@ -29,10 +49,14 @@ class TrainState:
         return self.variables["params"]
 
     def export_variables(self, use_ema: bool = False) -> Dict[str, Any]:
-        """Variables to serve/export: EMA params when present and requested."""
+        """Variables to serve/export: EMA params when present and requested.
+
+        A flat-stored EMA (one concatenated vector; see update_ema) is
+        unraveled here against the live params' structure — export/eval
+        is the only place the EMA is ever needed as a tree."""
         if use_ema and self.ema_params is not None:
             out = dict(self.variables)
-            out["params"] = self.ema_params
+            out["params"] = ema_as_tree(self.ema_params, self.params)
             return out
         return dict(self.variables)
 
@@ -42,16 +66,24 @@ def create_train_state(
     rng: jax.Array,
     example_features,
     optimizer: optax.GradientTransformation,
+    flat_ema: bool = False,
 ) -> TrainState:
-    """Initializes variables (with warm-start hook) + optimizer state."""
+    """Initializes variables (with warm-start hook) + optimizer state.
+
+    flat_ema stores the EMA as one concatenated vector (see update_ema);
+    like optax.flatten it changes the checkpoint layout, so it is only
+    set by the flatten_optimizer_update regime."""
     variables = model.init_variables(rng, example_features)
     variables = model.maybe_init_from_checkpoint(variables)
     opt_state = optimizer.init(variables["params"])
-    ema = (
-        jax.tree_util.tree_map(jnp.copy, variables["params"])
-        if getattr(model, "use_avg_model_params", False)
-        else None
-    )
+    if getattr(model, "use_avg_model_params", False):
+        ema = (
+            jax.flatten_util.ravel_pytree(variables["params"])[0]
+            if flat_ema
+            else jax.tree_util.tree_map(jnp.copy, variables["params"])
+        )
+    else:
+        ema = None
     return TrainState(
         step=jnp.zeros((), jnp.int32),
         variables=variables,
@@ -61,6 +93,17 @@ def create_train_state(
 
 
 def update_ema(ema_params, new_params, decay: float):
+    """One EMA step. Tree-shaped EMA updates leaf-wise; a flat-stored EMA
+    (flatten_optimizer_update regime) updates as ONE fused axpy over the
+    concatenated parameter vector — the per-leaf form compiles to one
+    small kernel per parameter, which on a backend with fixed per-kernel
+    latency costs more than the math (same rationale as optax.flatten,
+    CompiledModel docstring)."""
+    if _is_flat_ema(ema_params):
+        flat = jax.flatten_util.ravel_pytree(new_params)[0]
+        return ema_params * decay + flat.astype(ema_params.dtype) * (
+            1.0 - decay
+        )
     return jax.tree_util.tree_map(
         lambda e, p: e * decay + p.astype(e.dtype) * (1.0 - decay),
         ema_params,

@@ -143,10 +143,14 @@ class CompiledModel:
           instead of ~3 small kernels PER PARAMETER — the round-3 TPU
           profile showed those small per-leaf update kernels costing
           0.9-4 ms each (a 4 ms Adam update on a 28 KB entry-conv kernel)
-          on a backend where tiny ops pay a fixed latency. Changes the
-          opt_state pytree structure (checkpoints are not interchangeable
-          with the unflattened layout) and is rejected in sharded-param
-          regimes, where moments must follow the parameter sharding.
+          on a backend where tiny ops pay a fixed latency. The EMA mirror
+          is stored flat in the same regime (one fused axpy per step
+          instead of one kernel per parameter; unraveled only at
+          export/eval — train/state.py update_ema). Changes the
+          opt_state/ema pytree structure (checkpoints are not
+          interchangeable with the unflattened layout) and is rejected in
+          sharded-param regimes, where moments must follow the parameter
+          sharding.
         """
         self.model = model
         self.mesh = mesh if mesh is not None else mesh_lib.make_mesh()
@@ -166,6 +170,7 @@ class CompiledModel:
                     "parameter regimes."
                 )
             self.optimizer = optax.flatten(self.optimizer)
+        self._flat_ema = flatten_optimizer_update
         self._donate = donate_state
         self._param_min_shard_size = param_min_shard_size
         self._shard_weight_update = shard_weight_update
@@ -364,7 +369,10 @@ class CompiledModel:
             mode=MODE_TRAIN,
             rng=jax.random.PRNGKey(0),
         )
-        state = create_train_state(self.model, rng, features, self.optimizer)
+        state = create_train_state(
+            self.model, rng, features, self.optimizer,
+            flat_ema=self._flat_ema,
+        )
 
         def place(tree, base_rule):
             # Pipeline-stage placement layers over every regime: leaves

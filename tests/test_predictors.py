@@ -159,6 +159,43 @@ class TestCheckpointPredictor:
         assert out["a_predicted"].shape == (2, 1)
         assert predictor.model_path.endswith("4")
 
+    def test_restore_flat_ema_checkpoint(self, tmp_path):
+        """A checkpoint from the flatten_optimizer_update regime stores
+        the EMA as ONE concatenated vector; every consumer must unravel
+        it against the params structure (train/state.py ema_as_tree), not
+        serve the raw 1-D vector as 'params'."""
+        from tensor2robot_tpu.models.checkpoint_init import (
+            load_checkpoint_variables,
+        )
+        from tensor2robot_tpu.train.train_eval import train_eval_model
+
+        model_dir = str(tmp_path / "run")
+        train_eval_model(
+            MockT2RModel(device_type="cpu", use_avg_model_params=True),
+            input_generator_train=MockInputGenerator(batch_size=8),
+            model_dir=model_dir,
+            max_train_steps=2,
+            save_checkpoints_steps=2,
+            log_every_steps=2,
+            flatten_optimizer_update=True,
+        )
+        predictor = CheckpointPredictor(
+            t2r_model=MockT2RModel(
+                device_type="cpu", use_avg_model_params=True
+            ),
+            checkpoint_dir=model_dir,
+            timeout=5,
+            use_ema=True,
+        )
+        assert predictor.restore()
+        out = predictor.predict({"x": np.zeros((2, 3), np.float32)})
+        assert out["a_predicted"].shape == (2, 1)
+
+        # Warm-start consumer: path-based matching must see real
+        # per-variable paths, not one flat 'params' leaf.
+        variables = load_checkpoint_variables(model_dir, use_ema=True)
+        assert "kernel" in variables["params"]["Dense_0"]
+
     def test_restore_checkpoint_with_different_opt_layout(self, tmp_path):
         """Serving must not care how the TRAINER laid out its optimizer
         state: a checkpoint written with flatten_optimizer_update=True (one
