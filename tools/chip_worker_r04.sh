@@ -171,6 +171,13 @@ for i in $(seq 1 "$tries"); do
     "Round-4 batch-128 remat MFU leg" \
     BENCH_BACKEND_WAIT=240 BENCH_BATCH=128 BENCH_REMAT=1 -- python bench.py
 
+  # Stretch leg (not in all_done): batch 256 under remat — the strongest
+  # probe of the kernel-count-floor hypothesis (4x the FLOPs per kernel
+  # of bs64 at an unchanged kernel count).
+  run_leg BENCH_r04_bs256_remat.json 'mfu_bs256_472px_remat"' \
+    "Round-4 batch-256 remat MFU leg" \
+    BENCH_BACKEND_WAIT=240 BENCH_BATCH=256 BENCH_REMAT=1 -- python bench.py || true
+
   if all_done; then log "chain complete"; exit 0; fi
   log "chain pass $i incomplete; waiting for tunnel"
   sleep "$sleep_s"
