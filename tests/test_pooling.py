@@ -11,7 +11,7 @@ from tensor2robot_tpu.ops.pooling import max_pool_nonoverlap
 
 
 class TestForwardParity:
-    @pytest.mark.parametrize("window", [(3, 3), (2, 2)])
+    @pytest.mark.parametrize("window", [(3, 3), (2, 2), (4, 4), (5, 5)])
     @pytest.mark.parametrize(
         "shape",
         [(2, 236, 236, 4), (2, 79, 79, 4), (1, 6, 6, 3), (3, 7, 11, 2)],
@@ -45,17 +45,18 @@ class TestForwardParity:
 
 
 class TestGradient:
-    def test_matches_select_and_scatter_without_ties(self):
+    @pytest.mark.parametrize("window", [(3, 3), (2, 2), (4, 4)])
+    def test_matches_select_and_scatter_without_ties(self, window):
         # Continuous random input: ties have probability ~0, where the
         # custom VJP must agree exactly with XLA's select-and-scatter.
         x = jax.random.normal(jax.random.PRNGKey(2), (2, 10, 13, 3))
 
         def loss_custom(x):
-            return jnp.sum(max_pool_nonoverlap(x, (3, 3)) ** 2)
+            return jnp.sum(max_pool_nonoverlap(x, window) ** 2)
 
         def loss_xla(x):
             return jnp.sum(
-                nn.max_pool(x, (3, 3), strides=(3, 3), padding="SAME") ** 2
+                nn.max_pool(x, window, strides=window, padding="SAME") ** 2
             )
 
         np.testing.assert_allclose(
