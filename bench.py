@@ -414,7 +414,7 @@ def bench_auc() -> None:
                 image_size=image_size,
                 num_convs=num_convs,
                 # Eval-mode inference needs ADAPTED running BN stats and
-                # an ADAPTED EMA: the reference-scale decays (0.997 BN,
+                # an ADAPTED EMA: the reference-scale decays (0.9997 BN,
                 # 0.9999 EMA) are tuned for millions of steps and leave
                 # init values dominating after 300 — the eval surface
                 # would score warm-up garbage, not the dtype policy.

@@ -51,7 +51,7 @@ class _ConvBNRelu(nn.Module):
     kernel: Tuple[int, int]
     strides: Tuple[int, int] = (1, 1)
     padding: str = "SAME"
-    momentum: float = 0.997
+    momentum: float = 0.9997
     epsilon: float = 0.001
     dtype: Optional[jnp.dtype] = None
 
@@ -99,7 +99,8 @@ class Grasping44(nn.Module):
     num_convs: Sequence[int] = (6, 6, 3)
     hid_layers: int = 2
     num_classes: int = 1
-    batch_norm_momentum: float = 0.997
+    # Reference batch_norm_decay=0.9997 (networks.py:45 slim arg_scope).
+    batch_norm_momentum: float = 0.9997
     batch_norm_epsilon: float = 0.001
 
     @nn.compact

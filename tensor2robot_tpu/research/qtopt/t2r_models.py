@@ -100,7 +100,7 @@ class _Grasping44Net(nn.Module):
 
     grasp_param_blocks: Optional[Dict[str, Tuple[int, int]]] = None
     num_convs: Tuple[int, int, int] = (6, 6, 3)
-    batch_norm_momentum: float = 0.997
+    batch_norm_momentum: float = 0.9997
 
     @nn.compact
     def __call__(self, features, mode):
@@ -196,15 +196,15 @@ class Grasping44E2EOpenCloseTerminateGripperStatusHeightToBottom(
         self,
         image_size: Tuple[int, int] = (472, 472),
         num_convs: Tuple[int, int, int] = (6, 6, 3),
-        batch_norm_momentum: float = 0.997,
+        batch_norm_momentum: float = 0.9997,
         **kwargs,
     ):
         self._image_size = tuple(image_size)
         self._num_convs = tuple(num_convs)
-        # Reference default 0.997 (slim arg_scope); exposed because short
-        # trainings (tests, the AUC bench) need running stats that adapt
-        # within a few hundred steps to produce meaningful eval-mode
-        # inference.
+        # Reference batch_norm_decay=0.9997 (research/qtopt/networks.py:45
+        # slim arg_scope); exposed because short trainings (tests, the AUC
+        # bench) need running stats that adapt within a few hundred steps
+        # to produce meaningful eval-mode inference.
         self._batch_norm_momentum = batch_norm_momentum
         super().__init__(**kwargs)
 
