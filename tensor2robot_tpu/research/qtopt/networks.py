@@ -102,6 +102,11 @@ class Grasping44(nn.Module):
     # Reference batch_norm_decay=0.9997 (networks.py:45 slim arg_scope).
     batch_norm_momentum: float = 0.9997
     batch_norm_epsilon: float = 0.001
+    # Conv-tower channel count. 64 is the reference architecture; 128 is
+    # the MXU-width-aligned twin used to settle whether the 64-channel
+    # tower (half the 128-lane systolic array width) caps achievable MFU
+    # (docs/PERFORMANCE.md ceiling analysis). Not a reference knob.
+    width: int = 64
 
     @nn.compact
     def __call__(
@@ -141,7 +146,7 @@ class Grasping44(nn.Module):
         # Stem: conv without norm/activation, then a standalone unscaled BN
         # (reference keeps scale=False on the standalone BNs, :444-458).
         net = nn.Conv(
-            64, (6, 6), strides=(2, 2), padding="SAME", use_bias=False,
+            self.width, (6, 6), strides=(2, 2), padding="SAME", use_bias=False,
             kernel_init=_CONV_INIT, name="conv1_1", dtype=dtype,
         )(images)
         net = nn.BatchNorm(use_scale=False, name="bn1", **bn_kwargs)(net)
@@ -153,7 +158,7 @@ class Grasping44(nn.Module):
 
         for i in range(self.num_convs[0]):
             net = _ConvBNRelu(
-                64, (5, 5),
+                self.width, (5, 5),
                 momentum=self.batch_norm_momentum,
                 epsilon=self.batch_norm_epsilon,
                 name=f"conv{2 + i}",
@@ -179,13 +184,13 @@ class Grasping44(nn.Module):
             fcgrasp
         )
         fcgrasp = nn.relu(fcgrasp)
-        fcgrasp = nn.Dense(64, kernel_init=_CONV_INIT, name="fcgrasp2", dtype=dtype)(
-            fcgrasp
-        )
+        fcgrasp = nn.Dense(
+            self.width, kernel_init=_CONV_INIT, name="fcgrasp2", dtype=dtype
+        )(fcgrasp)
         fcgrasp = nn.BatchNorm(name="bn_fcgrasp2", **bn_kwargs)(fcgrasp)
         fcgrasp = nn.relu(fcgrasp)
         end_points["fcgrasp"] = fcgrasp
-        context = fcgrasp.reshape(-1, 1, 1, 64)
+        context = fcgrasp.reshape(-1, 1, 1, self.width)
         if dtype is not None:
             context = context.astype(dtype)
 
@@ -198,7 +203,7 @@ class Grasping44(nn.Module):
 
         for i in range(self.num_convs[1]):
             net = _ConvBNRelu(
-                64, (3, 3),
+                self.width, (3, 3),
                 momentum=self.batch_norm_momentum,
                 epsilon=self.batch_norm_epsilon,
                 name=f"conv{2 + self.num_convs[0] + i}",
@@ -207,7 +212,7 @@ class Grasping44(nn.Module):
         net = pooling.max_pool_nonoverlap(net, (2, 2))
         for i in range(self.num_convs[2]):
             net = _ConvBNRelu(
-                64, (3, 3), padding="VALID",
+                self.width, (3, 3), padding="VALID",
                 momentum=self.batch_norm_momentum,
                 epsilon=self.batch_norm_epsilon,
                 name=f"conv{2 + sum(self.num_convs[:2]) + i}",

@@ -101,6 +101,7 @@ class _Grasping44Net(nn.Module):
     grasp_param_blocks: Optional[Dict[str, Tuple[int, int]]] = None
     num_convs: Tuple[int, int, int] = (6, 6, 3)
     batch_norm_momentum: float = 0.9997
+    width: int = 64
 
     @nn.compact
     def __call__(self, features, mode):
@@ -112,6 +113,7 @@ class _Grasping44Net(nn.Module):
             grasp_param_blocks=self.grasp_param_blocks,
             num_convs=self.num_convs,
             batch_norm_momentum=self.batch_norm_momentum,
+            width=self.width,
             name="grasping44",
         )(
             features.state.image,
@@ -197,10 +199,14 @@ class Grasping44E2EOpenCloseTerminateGripperStatusHeightToBottom(
         image_size: Tuple[int, int] = (472, 472),
         num_convs: Tuple[int, int, int] = (6, 6, 3),
         batch_norm_momentum: float = 0.9997,
+        width: int = 64,
         **kwargs,
     ):
         self._image_size = tuple(image_size)
         self._num_convs = tuple(num_convs)
+        # Tower channel count: 64 is the reference; 128 is the round-5
+        # MXU-alignment twin (networks.Grasping44.width).
+        self._width = width
         # Reference batch_norm_decay=0.9997 (research/qtopt/networks.py:45
         # slim arg_scope); exposed because short trainings (tests, the AUC
         # bench) need running stats that adapt within a few hundred steps
@@ -238,4 +244,5 @@ class Grasping44E2EOpenCloseTerminateGripperStatusHeightToBottom(
             grasp_param_blocks=E2E_GRASP_PARAM_BLOCKS,
             num_convs=self._num_convs,
             batch_norm_momentum=self._batch_norm_momentum,
+            width=self._width,
         )

@@ -160,6 +160,27 @@ class TestGrasping44Network:
         assert "batch_stats" in updates
         assert np.all(np.isfinite(np.asarray(end_points["predictions"])))
 
+    def test_width_twin_tower(self):
+        """The c128 MXU-alignment twin (bench BENCH_WIDTH leg): every conv
+        kernel carries the widened channel count and the forward still
+        produces per-example predictions."""
+        net = Grasping44(num_convs=(1, 1, 1), width=32)
+        images = jnp.zeros((2, 96, 96, 3))
+        flat_params = jnp.zeros((2, 10))
+        variables = net.init(
+            jax.random.PRNGKey(0), images, flat_params, is_training=False
+        )
+        assert variables["params"]["conv1_1"]["kernel"].shape[-1] == 32
+        assert variables["params"]["conv2"]["Conv_0"]["kernel"].shape[-2:] == (
+            32,
+            32,
+        )
+        assert variables["params"]["fcgrasp2"]["kernel"].shape[-1] == 32
+        _, end_points = net.apply(
+            variables, images, flat_params, is_training=False
+        )
+        assert end_points["predictions"].shape == (2,)
+
     def test_concat_e2e_grasp_params_layout(self):
         action = {
             "world_vector": jnp.arange(3.0).reshape(1, 3),
