@@ -240,9 +240,12 @@ def _pin_matmul_ceiling(
     }
 
 
-def _analytic_train_flops(image_size, batch_size, num_convs=(6, 6, 3)) -> float:
+def _analytic_train_flops(
+    image_size, batch_size, num_convs=(6, 6, 3), width=64
+) -> float:
     """Fallback FLOPs estimate for one Grasping44 train step: summed conv
-    and dense MACs x2, x3 for forward+backward (standard 1:2 fwd:bwd)."""
+    and dense MACs x2, x3 for forward+backward (standard 1:2 fwd:bwd).
+    `width` is the tower channel count (64 reference / 128 MXU twin)."""
     h, w = image_size
     flops = 0.0
 
@@ -252,21 +255,62 @@ def _analytic_train_flops(image_size, batch_size, num_convs=(6, 6, 3)) -> float:
         flops += 2.0 * batch_size * h * w * cout * k * k * cin
         return h, w
 
-    h, w = conv(h, w, 3, 64, 6, 2)
+    h, w = conv(h, w, 3, width, 6, 2)
     h, w = -(-h // 3), -(-w // 3)
     for _ in range(num_convs[0]):
-        h, w = conv(h, w, 64, 64, 5)
+        h, w = conv(h, w, width, width, 5)
     h, w = -(-h // 3), -(-w // 3)
     for _ in range(num_convs[1]):
-        h, w = conv(h, w, 64, 64, 3)
+        h, w = conv(h, w, width, width, 3)
     h, w = -(-h // 2), -(-w // 2)
     for _ in range(num_convs[2]):
         h, w = h - 2, w - 2
-        flops += 2.0 * batch_size * h * w * 64 * 9 * 64
+        flops += 2.0 * batch_size * h * w * width * 9 * width
     # Dense head (grasp-param blocks + fc tail) is negligible next to the
     # conv tower but counted for completeness.
-    flops += 2.0 * batch_size * (10 * 256 + 256 * 64 + h * w * 64 * 64 + 64 * 64 + 64)
+    flops += 2.0 * batch_size * (
+        10 * 256 + 256 * width + h * w * width * 64 + 64 * 64 + 64
+    )
     return flops * 3.0
+
+
+def _proxy_fields(on_tpu: bool) -> dict:
+    """Top-level self-description for CPU-proxy payloads (VERDICT r4 weak
+    #6): an explicit "proxy": true plus a note that vs_baseline is computed
+    against a synthetic CPU peak / reduced shapes and is not comparable to
+    the TPU target — so a proxy artifact can never masquerade as chip
+    evidence on one overlookable detail field."""
+    if on_tpu:
+        return {}
+    return {
+        "proxy": True,
+        "vs_baseline_note": (
+            "cpu proxy (synthetic peak / reduced shapes); not comparable "
+            "to the TPU baseline target"
+        ),
+    }
+
+
+def _overlap_fields(infeed_steps_per_sec: float, steps_per_sec: float) -> dict:
+    """Infeed-overlap ratio with the physically-impossible tail clamped.
+
+    A fresh host feed cannot beat a pre-sharded resident batch, so a raw
+    ratio above 1.0 is timing noise (VERDICT r4 weak #6: BENCH_r04 shipped
+    1.0431 uncommented). The headline field is clamped at 1.0; the raw
+    ratio always rides alongside, with an explicit note when it was noise.
+    """
+    if steps_per_sec <= 0:
+        return {"infeed_overlap_efficiency": 0.0}
+    raw = infeed_steps_per_sec / steps_per_sec
+    fields = {
+        "infeed_overlap_efficiency": round(min(raw, 1.0), 4),
+        "infeed_overlap_efficiency_raw": round(raw, 4),
+    }
+    if raw > 1.0:
+        fields["infeed_overlap_note"] = (
+            "raw ratio exceeded 1.0 (timing noise); clamped"
+        )
+    return fields
 
 
 def bench_data() -> None:
@@ -374,24 +418,41 @@ def bench_data() -> None:
 
 def bench_auc() -> None:
     """bf16 accuracy budget: trains the QT-Opt critic twice on the same
-    synthetic grasp dataset — once in full f32, once under the TPU bf16
-    dtype policy (same CPU backend, so ONLY the policy differs) — and
-    reports the eval-AUC delta. BASELINE.md's north star allows <=2%.
+    synthetic grasp dataset — once with the f32 policy, once under the
+    TPU bf16 dtype policy — and reports the eval-AUC delta — the two legs share a backend so the
+    dtype policy is the only intended difference. BASELINE.md's north
+    star allows <=2%.
 
     Invoked as `python bench.py auc`. The synthetic task is learnable from
     pixels (reward = bright center patch), so AUC separates from 0.5
     within a few hundred steps and a dtype-policy regression shows up as
     a real separability gap, not noise.
+
+    On TPU both legs run on the chip, so the bf16 leg exercises REAL MXU
+    bf16 accumulation — the numerics the <=2% budget exists for (VERDICT
+    r4 missing #3); the f32 leg runs at XLA's default f32 conv precision.
+    Falls back to a CPU policy-only comparison (distinct _cpu_proxy
+    metric) when the backend is unavailable. The reduced 96px tower is
+    used on both backends: the budget question is dtype policy, and the
+    reduced tower runs the same conv/BN/MXU ops at trainable scale.
     """
     import os
 
-    import jax
+    metric_base = "qtopt_bf16_eval_auc_delta"
+    try:
+        devices, backend_note = _init_devices(
+            max_wait=_backend_wait(metric=metric_base)
+        )
+    except Exception as err:  # noqa: BLE001
+        _fail("backend_init", err, metric=metric_base)
 
-    jax.config.update("jax_platforms", "cpu")
+    import jax
     import jax.numpy as jnp
     import numpy as np
 
-    metric = "qtopt_bf16_eval_auc_delta"
+    _enable_compilation_cache()
+    on_tpu = devices[0].platform == "tpu"
+    metric = metric_base if on_tpu else metric_base + "_cpu_proxy"
     try:
         from tensor2robot_tpu.research.qtopt.t2r_models import (
             Grasping44E2EOpenCloseTerminateGripperStatusHeightToBottom,
@@ -531,8 +592,18 @@ def bench_auc() -> None:
                     "image_size": list(image_size),
                     "num_convs": list(num_convs),
                     "auc_method": "mann_whitney_rank",
-                    "backend": "cpu (policy-only comparison)",
+                    "backend": devices[0].platform,
+                    "device_kind": getattr(devices[0], "device_kind", "?"),
+                    "f32_leg_precision": (
+                        "xla_default" if on_tpu else "true_f32"
+                    ),
+                    **(
+                        {"backend_note": backend_note}
+                        if backend_note
+                        else {}
+                    ),
                 },
+                **_proxy_fields(on_tpu),
             }
         )
     except Exception as err:  # noqa: BLE001
@@ -699,6 +770,7 @@ def bench_predict() -> None:
                         else {}
                     ),
                 },
+                **_proxy_fields(on_tpu),
             }
         )
     except Exception as err:
@@ -833,6 +905,16 @@ def bench_bc() -> None:
                 f"implied MFU {mfu:.2f} exceeds 1.0 — timing did not "
                 "capture execution (readback anchoring failed?)"
             )
+        # Same-session matmul ceiling (as in the qtopt headline): the BC
+        # family is the width-aligned workload of the ceiling proof, so
+        # its MFU must be interpretable against what THIS session's MXU
+        # actually sustains, not the nameplate peak.
+        ceiling = {}
+        if on_tpu:
+            try:
+                ceiling = _pin_matmul_ceiling(device)
+            except Exception as pin_err:  # noqa: BLE001 — optional leg
+                print(f"bench: ceiling pin failed: {pin_err}", file=sys.stderr)
         _emit(
             {
                 "metric": metric,
@@ -844,6 +926,19 @@ def bench_bc() -> None:
                     "best_steps_per_sec": round(best_steps_per_sec, 3),
                     "avg_steps_per_sec": round(avg_steps_per_sec, 3),
                     "timing": "median_of_windows",
+                    **ceiling,
+                    **(
+                        {
+                            "mfu_vs_matmul_ceiling": round(
+                                flops_per_step
+                                * steps_per_sec
+                                / (ceiling["matmul_ceiling_tflops"] * 1e12),
+                                4,
+                            )
+                        }
+                        if ceiling.get("matmul_ceiling_tflops")
+                        else {}
+                    ),
                     "flops_per_step": flops_per_step,
                     "flops_source": "analytic_transformer",
                     "device_kind": getattr(device, "device_kind", "?"),
@@ -864,6 +959,7 @@ def bench_bc() -> None:
                         else {}
                     ),
                 },
+                **_proxy_fields(on_tpu),
             }
         )
     except Exception as err:  # noqa: BLE001
@@ -951,10 +1047,188 @@ def bench_stream() -> None:
                         else {}
                     ),
                 },
+                **_proxy_fields(on_tpu),
             }
         )
     except Exception as err:  # noqa: BLE001
         _fail("stream_bench", err, metric=metric)
+
+
+def bench_pipe() -> None:
+    """End-to-end input composite (VERDICT r4 item 3): the REAL tfrecord
+    parse pipeline — DefaultRecordInputGenerator -> parallel parse workers
+    -> device_prefetch double-buffering — feeding the flagship train step,
+    measured against the same step on a resident pre-sharded batch.
+
+    Invoked as `python bench.py pipe`. value = end-to-end steps/sec;
+    vs_baseline = e2e / resident ratio, i.e. the fraction of the chip's
+    compute rate the host pipeline sustains when it must parse, decode,
+    and transfer every batch (1.0 = host keeps the chip fed). `bench.py
+    data` measures the host side alone; this leg closes the loop through
+    the device.
+    """
+    import itertools
+    import tempfile
+
+    metric_base = "qtopt_e2e_pipeline_steps_per_sec"
+    try:
+        devices, backend_note = _init_devices(
+            max_wait=_backend_wait(metric=metric_base)
+        )
+    except Exception as err:  # noqa: BLE001
+        _fail("backend_init", err, metric=metric_base)
+
+    import jax
+    import numpy as np
+
+    _enable_compilation_cache()
+    device = devices[0]
+    on_tpu = device.platform == "tpu"
+    if on_tpu:
+        image_size, num_convs, batch_size = (472, 472), (6, 6, 3), 64
+        n_windows, window = 4, 5
+        metric = metric_base
+    else:
+        image_size, num_convs, batch_size = (96, 96), (2, 2, 1), 4
+        n_windows, window = 3, 2
+        metric = metric_base + "_cpu_proxy"
+
+    try:
+        n_records = int(
+            os.environ.get("BENCH_PIPE_RECORDS", str(batch_size * 2))
+        )
+    except ValueError as err:
+        _fail("config", err, metric=metric)
+
+    try:
+        from __graft_entry__ import _flagship
+
+        from tensor2robot_tpu.data import tfrecord
+        from tensor2robot_tpu.data.dataset import (
+            default_parse_backend,
+            default_parse_workers,
+        )
+        from tensor2robot_tpu.data.encoder import encode_example
+        from tensor2robot_tpu.data.input_generators import (
+            DefaultRecordInputGenerator,
+        )
+        from tensor2robot_tpu.specs import make_random_numpy
+        from tensor2robot_tpu.train import infeed as infeed_lib
+        from tensor2robot_tpu.train.train_eval import CompiledModel
+
+        model, batch = _flagship(
+            image_size=image_size, batch_size=batch_size, num_convs=num_convs
+        )
+        specs = {
+            "features": model.preprocessor.get_in_feature_specification(
+                "train"
+            ),
+            "labels": model.preprocessor.get_in_label_specification("train"),
+        }
+        compiled = CompiledModel(
+            model, donate_state=True, flatten_optimizer_update=True
+        )
+        state = compiled.init_state(jax.random.PRNGKey(0), batch)
+        resident = compiled.shard_batch(batch)
+        rng = jax.random.PRNGKey(1)
+        box = {"state": state}
+
+        def run_resident_window():
+            for _ in range(window):
+                box["state"], box["metrics"] = compiled.train_step(
+                    box["state"], resident, rng
+                )
+
+        def sync():
+            if "metrics" in box:
+                float(jax.device_get(box["metrics"]["loss"]))
+
+        run_resident_window()  # compile + warm-in, untimed
+        resident_sps, _, _ = _measure_windows(
+            run_resident_window, sync, n_windows, window
+        )
+
+        with tempfile.TemporaryDirectory() as tmp:
+            path = os.path.join(tmp, "pipe.tfrecord")
+            rows = make_random_numpy(specs, batch_size=n_records, seed=0)
+            records = [
+                encode_example(
+                    specs,
+                    {key: np.asarray(value[i]) for key, value in rows.items()},
+                )
+                for i in range(n_records)
+            ]
+            tfrecord.write_tfrecords(path, records)
+
+            generator = DefaultRecordInputGenerator(
+                file_patterns=path, batch_size=batch_size
+            )
+            generator.set_specification_from_model(model, mode="train")
+            batches = generator.create_dataset("train")
+
+            def run_pipe_window():
+                feed = infeed_lib.device_prefetch(
+                    itertools.islice(batches, window),
+                    compiled.shard_batch,
+                    depth=2,
+                )
+                for device_batch in feed:
+                    box["state"], box["metrics"] = compiled.train_step(
+                        box["state"], device_batch, rng
+                    )
+
+            run_pipe_window()  # parse-pool + transfer-path warm-in, untimed
+            sync()
+            pipe_sps, best_pipe_sps, avg_pipe_sps = _measure_windows(
+                run_pipe_window, sync, n_windows, window
+            )
+
+        # Same clamp discipline as the infeed ratio (_overlap_fields): a
+        # parsed-and-transferred feed cannot beat the resident batch, so
+        # a raw ratio above 1.0 is timing noise.
+        raw_ratio = pipe_sps / resident_sps if resident_sps > 0 else 0.0
+        ratio = min(raw_ratio, 1.0)
+        _emit(
+            {
+                "metric": metric,
+                "value": round(pipe_sps, 3),
+                "unit": "steps_per_sec",
+                "vs_baseline": round(ratio, 4),
+                "detail": {
+                    "resident_batch_steps_per_sec": round(resident_sps, 3),
+                    "e2e_fraction_of_compute_rate": round(ratio, 4),
+                    "e2e_fraction_of_compute_rate_raw": round(raw_ratio, 4),
+                    **(
+                        {
+                            "e2e_fraction_note": (
+                                "raw ratio exceeded 1.0 (timing noise); "
+                                "clamped"
+                            )
+                        }
+                        if raw_ratio > 1.0
+                        else {}
+                    ),
+                    "best_e2e_steps_per_sec": round(best_pipe_sps, 3),
+                    "avg_e2e_steps_per_sec": round(avg_pipe_sps, 3),
+                    "batch_size": batch_size,
+                    "records_in_file": n_records,
+                    "parse_workers": default_parse_workers(),
+                    "parse_backend": default_parse_backend(),
+                    "host_cpus": os.cpu_count(),
+                    "image_size": list(image_size),
+                    "device_kind": getattr(device, "device_kind", "?"),
+                    "timing": "median_of_windows",
+                    **(
+                        {"backend_note": backend_note}
+                        if backend_note
+                        else {}
+                    ),
+                },
+                **_proxy_fields(on_tpu),
+            }
+        )
+    except Exception as err:  # noqa: BLE001
+        _fail("pipe_bench", err, metric=metric)
 
 
 def _backend_wait(metric: str = "qtopt_critic_train_mfu_bs64_472px") -> float:
@@ -980,6 +1254,7 @@ def main() -> None:
     use_remat = os.environ.get("BENCH_REMAT", "0") == "1"
     try:
         env_batch = int(os.environ.get("BENCH_BATCH", "64"))
+        env_width = int(os.environ.get("BENCH_WIDTH", "64"))
     except ValueError as err:
         # A distinct name: a malformed request must not pollute any real
         # metric series (the batch size it asked for is unknowable).
@@ -989,8 +1264,12 @@ def main() -> None:
             metric="qtopt_critic_train_mfu_invalid_config"
             + ("_remat" if use_remat else ""),
         )
-    intended_metric = f"qtopt_critic_train_mfu_bs{env_batch}_472px" + (
-        "_remat" if use_remat else ""
+    # BENCH_WIDTH != 64 runs the MXU-width-aligned tower twin (the c128
+    # half of the two-number ceiling proof) under a distinct metric name.
+    intended_metric = (
+        f"qtopt_critic_train_mfu_bs{env_batch}_472px"
+        + (f"_c{env_width}" if env_width != 64 else "")
+        + ("_remat" if use_remat else "")
     )
 
     try:
@@ -1015,14 +1294,16 @@ def main() -> None:
         # remat run always reports under a distinct "_remat" name.
         batch_size = env_batch
         image_size, num_convs = (472, 472), (6, 6, 3)
+        width = env_width
         n_windows, window = 8, 15
         metric = intended_metric
     else:
         image_size, num_convs, batch_size = (96, 96), (2, 2, 1), 8
+        width = 64
         n_windows, window = 3, 3
         metric = "qtopt_critic_train_mfu_cpu_proxy"
-        # The CPU proxy measures one fixed regime; a remat'd proxy under
-        # the same metric name would pollute cross-run comparisons.
+        # The CPU proxy measures one fixed regime; a remat'd (or widened)
+        # proxy under the same metric name would pollute comparisons.
         use_remat = False
 
     try:
@@ -1039,7 +1320,8 @@ def main() -> None:
         # on this backend.
         flat_opt = os.environ.get("BENCH_FLAT_OPT", "1") != "0"
         model, batch = _flagship(
-            image_size=image_size, batch_size=batch_size, num_convs=num_convs
+            image_size=image_size, batch_size=batch_size,
+            num_convs=num_convs, width=width,
         )
         compiled = CompiledModel(
             model, donate_state=True, remat=use_remat,
@@ -1066,7 +1348,7 @@ def main() -> None:
                 raise ValueError(f"bogus flops {flops_per_step}")
         except Exception:
             flops_per_step = _analytic_train_flops(
-                image_size, batch_size, num_convs
+                image_size, batch_size, num_convs, width=width
             )
             flops_source = "analytic"
 
@@ -1222,11 +1504,7 @@ def main() -> None:
                     ),
                     "scan_dispatch_steps_per_sec": round(scan_steps_per_sec, 3),
                     "infeed_steps_per_sec": round(infeed_steps_per_sec, 3),
-                    "infeed_overlap_efficiency": round(
-                        infeed_steps_per_sec / steps_per_sec, 4
-                    )
-                    if steps_per_sec > 0
-                    else 0.0,
+                    **_overlap_fields(infeed_steps_per_sec, steps_per_sec),
                     **ceiling,
                     **(
                         {
@@ -1247,6 +1525,7 @@ def main() -> None:
                     "peak_flops": peak,
                     "bf16_forward": True,
                     "batch_size": batch_size,
+                    "tower_width": width,
                     "remat": use_remat,
                     "flat_optimizer_update": flat_opt,
                     **(
@@ -1255,6 +1534,7 @@ def main() -> None:
                         else {}
                     ),
                 },
+                **_proxy_fields(on_tpu),
             }
         )
     except Exception as err:
@@ -1272,5 +1552,7 @@ if __name__ == "__main__":
         bench_bc()
     elif len(sys.argv) > 1 and sys.argv[1] == "stream":
         bench_stream()
+    elif len(sys.argv) > 1 and sys.argv[1] == "pipe":
+        bench_pipe()
     else:
         main()

@@ -227,7 +227,7 @@ def main() -> None:
                 x = nn.Conv(64, (5, 5), padding="SAME", use_bias=False,
                             dtype=jnp.bfloat16)(x)
                 x = nn.BatchNorm(use_running_average=False,
-                                 momentum=0.997)(x)
+                                 momentum=0.9997)(x)
                 x = nn.relu(x).astype(jnp.bfloat16)
             return x
 
@@ -253,7 +253,7 @@ def main() -> None:
             for _ in range(6):
                 x = nn.Conv(64, (5, 5), padding="SAME", use_bias=False,
                             dtype=jnp.bfloat16)(x)
-                x = nn.BatchNorm(use_running_average=False, momentum=0.997,
+                x = nn.BatchNorm(use_running_average=False, momentum=0.9997,
                                  dtype=jnp.bfloat16)(x)
                 x = nn.relu(x)
             return x
