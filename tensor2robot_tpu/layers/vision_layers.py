@@ -16,6 +16,8 @@ from __future__ import annotations
 from typing import Dict, Optional, Sequence, Tuple
 
 import flax.linen as nn
+
+from tensor2robot_tpu.layers.batch_norm import BatchNorm
 import jax
 import jax.numpy as jnp
 
@@ -63,7 +65,7 @@ class ImagesToFeaturesNet(nn.Module):
         if self.normalizer == "layer_norm":
             return nn.LayerNorm(use_scale=scale, name=f"norm_{idx}")(x)
         if self.normalizer == "batch_norm":
-            return nn.BatchNorm(
+            return BatchNorm(
                 use_running_average=not train,
                 momentum=0.99,
                 epsilon=1e-4,

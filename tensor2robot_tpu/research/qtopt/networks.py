@@ -29,6 +29,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from tensor2robot_tpu.layers.batch_norm import BatchNorm
 from tensor2robot_tpu.ops import pooling
 
 # Named grasp-param sub-blocks of the E2E variant: {name: (offset, size)}
@@ -75,7 +76,7 @@ class _ConvBNRelu(nn.Module):
             kernel_init=_CONV_INIT,
             dtype=self.dtype,
         )(x)
-        x = nn.BatchNorm(
+        x = BatchNorm(
             use_running_average=not is_training,
             momentum=self.momentum,
             epsilon=self.epsilon,
@@ -149,7 +150,7 @@ class Grasping44(nn.Module):
             self.width, (6, 6), strides=(2, 2), padding="SAME", use_bias=False,
             kernel_init=_CONV_INIT, name="conv1_1", dtype=dtype,
         )(images)
-        net = nn.BatchNorm(use_scale=False, name="bn1", **bn_kwargs)(net)
+        net = BatchNorm(use_scale=False, name="bn1", **bn_kwargs)(net)
         net = nn.relu(net)
         # Non-overlapping pools use the scatter-free backward (the XLA
         # SelectAndScatter pool gradient was the top non-gather op in the
@@ -180,14 +181,14 @@ class Grasping44(nn.Module):
                 grasp_params[:, offset : offset + size]
             )
             fcgrasp = piece if fcgrasp is None else fcgrasp + piece
-        fcgrasp = nn.BatchNorm(use_scale=False, name="bn_fcgrasp", **bn_kwargs)(
+        fcgrasp = BatchNorm(use_scale=False, name="bn_fcgrasp", **bn_kwargs)(
             fcgrasp
         )
         fcgrasp = nn.relu(fcgrasp)
         fcgrasp = nn.Dense(
             self.width, kernel_init=_CONV_INIT, name="fcgrasp2", dtype=dtype
         )(fcgrasp)
-        fcgrasp = nn.BatchNorm(name="bn_fcgrasp2", **bn_kwargs)(fcgrasp)
+        fcgrasp = BatchNorm(name="bn_fcgrasp2", **bn_kwargs)(fcgrasp)
         fcgrasp = nn.relu(fcgrasp)
         end_points["fcgrasp"] = fcgrasp
         context = fcgrasp.reshape(-1, 1, 1, self.width)
@@ -234,7 +235,7 @@ class Grasping44(nn.Module):
             net = nn.Dense(64, kernel_init=_CONV_INIT, name=f"fc{i}", dtype=dtype)(
                 net
             )
-            net = nn.BatchNorm(name=f"bn_fc{i}", **bn_kwargs)(net)
+            net = BatchNorm(name=f"bn_fc{i}", **bn_kwargs)(net)
             net = nn.relu(net)
 
         # Logit head computes and emits float32: the loss-bearing scalar

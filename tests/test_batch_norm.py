@@ -1,0 +1,108 @@
+"""layers.batch_norm.BatchNorm: bit-parity with flax + deferred stats.
+
+The module replaces every `nn.BatchNorm` in the tree, so its normalize
+numerics must be EXACTLY flax's in both modes and both dtypes — pinned
+here directly (the module deliberately avoids flax's private
+normalization helpers, so this test is the compatibility guarantee a
+flax upgrade is checked against)."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensor2robot_tpu.layers.batch_norm import (
+    NEW_STATS_COLLECTION,
+    BatchNorm,
+)
+
+
+def _pair(use_scale, use_bias, momentum=0.9, epsilon=1e-3, dtype=None):
+    kwargs = dict(
+        momentum=momentum,
+        epsilon=epsilon,
+        use_scale=use_scale,
+        use_bias=use_bias,
+        dtype=dtype,
+    )
+    return BatchNorm(**kwargs), nn.BatchNorm(**kwargs)
+
+
+@pytest.mark.parametrize("dtype", [None, jnp.bfloat16])
+@pytest.mark.parametrize("use_scale", [True, False])
+@pytest.mark.parametrize("train", [True, False])
+def test_bit_parity_with_flax(dtype, use_scale, train):
+    ours, theirs = _pair(use_scale=use_scale, use_bias=True, dtype=dtype)
+    x = jax.random.normal(
+        jax.random.PRNGKey(0), (4, 6, 6, 8),
+        jnp.bfloat16 if dtype is not None else jnp.float32,
+    )
+    v_ours = ours.init(jax.random.PRNGKey(1), x, use_running_average=False)
+    v_theirs = theirs.init(
+        jax.random.PRNGKey(1), x, use_running_average=False
+    )
+    # Same variable structure (drop-in): params + batch_stats.
+    assert jax.tree_util.tree_structure(
+        v_ours
+    ) == jax.tree_util.tree_structure(v_theirs)
+
+    if train:
+        (y_ours, updates_ours) = ours.apply(
+            v_ours, x, use_running_average=False, mutable=["batch_stats"]
+        )
+        (y_theirs, updates_theirs) = theirs.apply(
+            v_theirs, x, use_running_average=False, mutable=["batch_stats"]
+        )
+        # In-place EMA path must track flax exactly.
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)
+            ),
+            updates_ours["batch_stats"],
+            updates_theirs["batch_stats"],
+        )
+    else:
+        y_ours = ours.apply(v_ours, x, use_running_average=True)
+        y_theirs = theirs.apply(v_theirs, x, use_running_average=True)
+    assert y_ours.dtype == y_theirs.dtype
+    np.testing.assert_array_equal(np.asarray(y_ours), np.asarray(y_theirs))
+
+
+def test_deferred_stats_collection():
+    """With 'batch_stats_new' mutable, raw batch stats (not an EMA) land
+    in the new collection and running stats stay untouched."""
+    ours, _ = _pair(use_scale=True, use_bias=True, momentum=0.8)
+    x = jax.random.normal(jax.random.PRNGKey(0), (16, 8))
+    variables = ours.init(jax.random.PRNGKey(1), x, use_running_average=False)
+    y, updates = ours.apply(
+        variables,
+        x,
+        use_running_average=False,
+        mutable=["batch_stats", NEW_STATS_COLLECTION],
+    )
+    new = updates[NEW_STATS_COLLECTION]
+    np.testing.assert_allclose(
+        np.asarray(new["mean"]),
+        np.asarray(x).mean(0),
+        rtol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(new["var"]),
+        np.asarray(x).var(0),
+        rtol=1e-5,
+    )
+    assert float(new["momentum"]) == pytest.approx(0.8)
+    # Running stats untouched (still init values).
+    np.testing.assert_array_equal(
+        np.asarray(updates["batch_stats"]["mean"]), np.zeros(8)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(updates["batch_stats"]["var"]), np.ones(8)
+    )
+    # Deferral must not change the normalized output: same apply without
+    # the new collection (flax-identical in-place path) agrees exactly.
+    y_inplace, _ = ours.apply(
+        variables, x, use_running_average=False, mutable=["batch_stats"]
+    )
+    np.testing.assert_array_equal(np.asarray(y), np.asarray(y_inplace))
