@@ -1266,10 +1266,14 @@ def main() -> None:
         )
     # BENCH_WIDTH != 64 runs the MXU-width-aligned tower twin (the c128
     # half of the two-number ceiling proof) under a distinct metric name.
+    # BENCH_FUSE_STATS=0 opts out of the fused batch-stats update (the
+    # on-chip A/B against the default; distinct metric name).
+    env_fuse_stats = os.environ.get("BENCH_FUSE_STATS")
     intended_metric = (
         f"qtopt_critic_train_mfu_bs{env_batch}_472px"
         + (f"_c{env_width}" if env_width != 64 else "")
         + ("_remat" if use_remat else "")
+        + ("_nofusestats" if env_fuse_stats == "0" else "")
     )
 
     try:
@@ -1326,6 +1330,11 @@ def main() -> None:
         compiled = CompiledModel(
             model, donate_state=True, remat=use_remat,
             flatten_optimizer_update=flat_opt,
+            **(
+                {"fuse_batch_stats_update": env_fuse_stats != "0"}
+                if env_fuse_stats is not None
+                else {}
+            ),
         )
         state = compiled.init_state(jax.random.PRNGKey(0), batch)
         sharded = compiled.shard_batch(batch)
@@ -1528,6 +1537,7 @@ def main() -> None:
                     "tower_width": width,
                     "remat": use_remat,
                     "flat_optimizer_update": flat_opt,
+                    "fuse_batch_stats_update": compiled._fuse_stats,
                     **(
                         {"backend_note": backend_note}
                         if backend_note

@@ -176,6 +176,13 @@ for i in $(seq 1 "$tries"); do
     "Round-5 host-pipeline->device-step e2e composite" \
     BENCH_BACKEND_WAIT=240 -- python bench.py pipe
 
+  # A/B: fused batch-stats update off (default on) — decides whether the
+  # 38->1 BN-param buffer collapse moves the small-DMA line of the r3
+  # trace on the device plane. Not in all_done (stretch evidence).
+  run_leg BENCH_r05_nofusestats.json '_nofusestats"' \
+    "Round-5 A/B: per-leaf batch-stats twin of the headline" \
+    BENCH_BACKEND_WAIT=240 BENCH_FUSE_STATS=0 -- python bench.py || true
+
   run_leg BENCH_r05_bs128.json 'mfu_bs128_472px"' \
     "Round-5 batch-128 MFU leg" \
     BENCH_BACKEND_WAIT=240 BENCH_BATCH=128 -- python bench.py
