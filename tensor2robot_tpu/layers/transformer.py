@@ -3,16 +3,16 @@
 Beyond the reference layer library (its temporal models top out at
 SNAIL/TCN scale, layers/snail.py; SURVEY §5 long-context row): a standard
 pre-norm transformer whose attention routes through ops/flash_attention —
-single-device attention on the XLA einsum path by default (the Pallas
-flash kernel is opt-in via `use_flash=True`; see
+single-device attention on the XLA einsum path below _FLASH_AUTO_SEQ
+and the Pallas flash kernel above it (O(S^2) logits vs O(S) tiles; see
 MultiHeadAttention.use_flash for the measured rationale), and
 sequence-parallel attention when constructed with a mesh whose
 `sequence` axis is >1 — the ring (parallel/ring_attention.py) by
 default, or Ulysses all-to-all (parallel/ulysses_attention.py) via
-`sequence_parallel_mode="ulysses"`; the mesh paths prefer flash tiles
-for their O(seq) memory. Sequence length lives in the specs, so the same
-model trains short episodes on one chip and long contexts on a CP mesh
-without code changes.
+`sequence_parallel_mode="ulysses"`; the mesh paths share the same
+einsum-first dispatch policy (flash opt-in). Sequence length lives in
+the specs, so the same model trains short episodes on one chip and long
+contexts on a CP mesh without code changes.
 """
 
 from __future__ import annotations
@@ -26,6 +26,14 @@ from jax import lax
 
 from tensor2robot_tpu.ops import flash_attention as flash_lib
 from tensor2robot_tpu.parallel import mesh as mesh_lib
+
+# Single-device auto-dispatch crossover: below this sequence length the
+# XLA einsum path wins on measured speed; at/above it the einsum path's
+# [S, S] logits (b8/h8 f32 at S=4096: ~4 GiB) OOM territory where the
+# flash kernel's O(S) tiles still fit. The constant is shared with the
+# sequence-parallel paths (same policy on the per-device attended
+# length): ops/flash_attention.FLASH_AUTO_SEQ.
+_FLASH_AUTO_SEQ = flash_lib.FLASH_AUTO_SEQ
 
 
 class MultiHeadAttention(nn.Module):
@@ -43,19 +51,23 @@ class MultiHeadAttention(nn.Module):
     causal: bool = True
     mesh: Optional[object] = None
     # Attention kernel policy, tri-state:
-    #   None (default) — single-device attention takes the XLA einsum
-    #     path, measured FASTER than the Pallas flash kernel on the
-    #     available chip (BENCH_FLASH_r03 microbench: flash fwd 1.33
-    #     TFLOPS at b4/s2048/h8/d128 bf16, ~0.7% of peak;
-    #     docs/PERFORMANCE.md). Sequence-parallel (mesh) attention keeps
-    #     ring/ulysses' own auto default, which PREFERS flash tiles:
-    #     there the einsum path materializes S_local^2 logits per hop,
-    #     so flash is a memory lever before it is a speed one.
+    #   None (default) — auto. Single-device attention takes the XLA
+    #     einsum path below _FLASH_AUTO_SEQ, measured FASTER than the
+    #     Pallas flash kernel on the available chip (BENCH_FLASH_r03
+    #     microbench: flash fwd 1.33 TFLOPS at b4/s2048/h8/d128 bf16,
+    #     ~0.7% of peak; docs/PERFORMANCE.md); at seq >=
+    #     _FLASH_AUTO_SEQ it switches to the flash kernel because the
+    #     einsum path's [S, S] logits are O(S^2) HBM and OOM where
+    #     flash's O(S) tiles still fit (the r4 A/B's expected einsum
+    #     OOM at S=4096). Sequence-parallel (mesh) attention defaults
+    #     to the einsum path too (ring/ulysses follow the same r3
+    #     evidence; per-hop logits there are [S/N, S/N] shards, so the
+    #     memory pressure is divided by the mesh).
     #   True — force the flash kernel everywhere (the O(S)-memory lever
-    #     single-device too).
-    #   False — force the einsum path everywhere.
-    # The on-chip A/B in BENCH_FLASH_r04 re-evaluates this default each
-    # capture.
+    #     at any length).
+    #   False — force the einsum path everywhere (long S may OOM).
+    # The on-chip A/B (tools/validate_flash_tpu.py -> BENCH_FLASH_r05)
+    # re-evaluates this default each capture.
     use_flash: Optional[bool] = None
     interpret: bool = False
     # Causal sliding window W (each query attends to its last W steps).
@@ -156,18 +168,24 @@ class MultiHeadAttention(nn.Module):
                 use_flash=self.use_flash, interpret=self.interpret,
                 window=self.window,
             )
-        elif self.use_flash:
-            # Explicit opt-in (O(S)-memory lever; see use_flash above).
-            out = flash_lib.flash_attention(
-                q, k, v, causal=self.causal, interpret=self.interpret,
-                window=self.window,
-            )
         else:
-            # Default: plain-XLA attention, measured faster on-chip than
-            # the Pallas kernel at these sizes (use_flash docstring).
-            out = flash_lib.reference_attention(
-                q, k, v, causal=self.causal, window=self.window
-            )
+            use_flash = self.use_flash
+            if use_flash is None:
+                # Auto: einsum wins on measured speed at moderate S, but
+                # its [S, S] logits are O(S^2) HBM — above the threshold
+                # only flash's O(S) tiles fit (use_flash docstring).
+                use_flash = seq >= _FLASH_AUTO_SEQ
+            if use_flash:
+                out = flash_lib.flash_attention(
+                    q, k, v, causal=self.causal, interpret=self.interpret,
+                    window=self.window,
+                )
+            else:
+                # Plain-XLA attention, measured faster on-chip than the
+                # Pallas kernel at these sizes (use_flash docstring).
+                out = flash_lib.reference_attention(
+                    q, k, v, causal=self.causal, window=self.window
+                )
         out = out.reshape(batch, seq, features)
         return nn.Dense(x.shape[-1], use_bias=False, name="out")(out)
 

@@ -47,6 +47,16 @@ _NEG_INF = -1e30
 _STATS_LANES = 128
 
 
+# Auto-dispatch crossover shared by every attention entry point
+# (layers/transformer.py single-device, parallel/ring_attention.py per-hop
+# local length, parallel/ulysses_attention.py full length): below this
+# per-device attended length the XLA einsum path wins on measured speed
+# (BENCH_FLASH_r03); at/above it the einsum path's O(S^2) logits OOM
+# where the flash kernel's O(S) tiles still fit (the r4 A/B's expected
+# einsum OOM at S=4096). Re-evaluated by each BENCH_FLASH capture.
+FLASH_AUTO_SEQ = 4096
+
+
 def _check_window(window: Optional[int], causal: bool) -> None:
     """Shared entry-point validation: a window needs causal semantics, and
     window < 1 would mask EVERYTHING — in the reference path the finite

@@ -18,6 +18,13 @@ maximum (common after relu: exact zeros), the incoming gradient is split
 EQUALLY among them, whereas SelectAndScatter routes it all to the first.
 Both are valid subgradients of the same function; the equal split is the
 same choice `jnp.max`'s native gradient makes.
+
+Known limitation: `jax.custom_vjp` forecloses FORWARD-mode autodiff —
+`jax.jvp`/`jax.jacfwd` through any model containing these pools raises
+TypeError, a capability `nn.max_pool` had. No in-repo caller uses
+forward mode; if one ever does, the equal-split rule has a natural
+linear JVP (mask-weighted tangent average) and the op can be
+restructured as `jax.custom_jvp` to support both modes.
 """
 
 from __future__ import annotations

@@ -178,7 +178,14 @@ def ring_attention(
       causal: apply causal masking over *global* positions.
       scale: logit scale; defaults to dim ** -0.5.
       use_flash: per-hop tiles via the Pallas flash kernel
-        (ops/flash_attention.py). Default: on for the TPU backend.
+        (ops/flash_attention.py). Default (None): the XLA einsum path,
+        matching the single-device dispatch policy (BENCH_FLASH_r03
+        measured the Pallas kernel at 0.7% of peak vs the XLA path's
+        win on-chip), auto-switching to flash when the per-hop LOCAL
+        length S/N reaches ops.flash_attention.FLASH_AUTO_SEQ — past
+        that the [S/N, S/N] logit shards are the O(S^2) memory hazard
+        flash's O(S) tiles avoid. Pass True/False to force either path
+        (tools/validate_flash_tpu.py re-evaluates the default).
       interpret: run the Pallas kernel in interpreter mode (tests on CPU).
       window: causal sliding window W in GLOBAL positions. Besides the
         per-tile masking, the ring itself truncates: only
@@ -202,9 +209,19 @@ def ring_attention(
         )
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     if use_flash is None:
-        # Flash is the TPU default; interpret=True keeps it on (interpreted)
-        # so CPU tests exercise the same kernel the TPU compiles.
-        use_flash = jax.default_backend() == "tpu" or interpret
+        # One dispatch policy everywhere (VERDICT r4 item 4): the XLA
+        # einsum path by default exactly as in single-device attention
+        # (layers/transformer.py), on the same r3 on-chip evidence —
+        # switching to flash tiles when the per-hop LOCAL length crosses
+        # FLASH_AUTO_SEQ, where the einsum path's [S/N, S/N] logit
+        # shards become the same O(S^2) memory hazard the single-device
+        # threshold guards. interpret=True still selects the
+        # (interpreted) kernel so CPU tests exercise what an opt-in TPU
+        # run compiles.
+        from tensor2robot_tpu.ops.flash_attention import FLASH_AUTO_SEQ
+
+        local_seq = q.shape[1] // axis_size
+        use_flash = interpret or local_seq >= FLASH_AUTO_SEQ
         if use_flash:
             # Per-device shard lengths must admit a viable kernel block;
             # otherwise quietly keep the einsum path (an explicit
