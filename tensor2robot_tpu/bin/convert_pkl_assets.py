@@ -1,0 +1,235 @@
+"""Convert legacy pickle-based T2R assets to t2r_assets.pbtxt.
+
+Behavioral reference: tensor2robot/utils/convert_pkl_assets_to_proto_assets.py:36-57
+(convert): read `input_specs.pkl` (+ optional `global_step.pkl`) from an
+exported-savedmodel assets directory and write the `t2r_assets.pbtxt`
+sidecar the proto-era tooling (and this framework's predictors) read.
+
+The reference tool unpickled with TF1 + the original tensor2robot classes
+on the path. Those legacy pickles reference
+`tensor2robot.utils.tensorspec_utils.{ExtendedTensorSpec,TensorSpecStruct}`
+plus TF internals (`TensorShape`, `Dimension`, `as_dtype`) — none of
+which exist in this image — so this port resolves them with a restricted
+custom Unpickler that maps each legacy global to a small shim
+constructing THIS framework's spec objects (the reference
+ExtendedTensorSpec pickles via __reduce__ with the 9 constructor args in
+the exact order our dataclass declares — tensorspec_utils.py:275-279).
+Unknown globals are refused (pickle is code execution; a migration tool
+must not import arbitrary classes from an untrusted file).
+
+Usage:
+  python -m tensor2robot_tpu.bin.convert_pkl_assets --assets_filepath DIR
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import os
+import pickle
+from typing import Any
+
+import numpy as np
+
+from tensor2robot_tpu.proto import t2r_pb2
+from tensor2robot_tpu.specs import ExtendedTensorSpec, TensorSpecStruct
+from tensor2robot_tpu.specs.proto_io import (
+    T2R_ASSETS_FILENAME,
+    struct_to_proto,
+)
+
+try:  # text_format ships with protobuf (a jax dependency on this image)
+    from google.protobuf import text_format
+except ImportError as err:  # pragma: no cover
+    raise ImportError("protobuf text_format is required") from err
+
+
+# TF DType enum -> numpy dtype name (tensorflow/core/framework/types.proto;
+# the subset a spec pickle can carry).
+_TF_ENUM_TO_NP = {
+    1: "float32", 2: "float64", 3: "int32", 4: "uint8", 5: "int16",
+    6: "int8", 7: "bytes", 9: "int64", 10: "bool", 14: "bfloat16",
+    17: "uint16", 19: "float16", 22: "uint32", 23: "uint64",
+}
+
+
+def _as_np_dtype(value: Any) -> np.dtype:
+    """tf.as_dtype twin onto numpy: accepts a DType shim result, a name
+    string, or a TF enum int."""
+    if isinstance(value, np.dtype):
+        return value
+    if isinstance(value, int):
+        try:
+            value = _TF_ENUM_TO_NP[value]
+        except KeyError:
+            raise ValueError(
+                f"Legacy spec uses TF dtype enum {value}, which has no "
+                "numpy equivalent in this framework (quantized/complex "
+                "dtypes are not part of the T2R spec surface)."
+            )
+    if value == "string" or value == "bytes":
+        return np.dtype("S")
+    if value == "bfloat16":
+        import jax.numpy as jnp
+
+        return np.dtype(jnp.bfloat16)
+    return np.dtype(value)
+
+
+def _dimension(value):
+    """tf.compat.v1 Dimension(value) — pickles carry the raw value."""
+    return value
+
+
+def _tensor_shape(dims=None):
+    """TensorShape(dims) -> tuple with None for unknown dims."""
+    if dims is None:
+        return None
+    out = []
+    for d in dims:
+        if d is None:
+            out.append(None)
+        else:
+            # Either a raw int or a Dimension shim's value.
+            out.append(None if int(d) == -1 else int(d))
+    return tuple(out)
+
+
+def _extended_tensor_spec(
+    shape,
+    dtype,
+    name=None,
+    is_optional=None,
+    is_sequence=False,
+    is_extracted=False,
+    data_format=None,
+    dataset_key=None,
+    varlen_default_value=None,
+):
+    """The reference ExtendedTensorSpec.__reduce__ arg order
+    (tensorspec_utils.py:275-279), constructing OUR spec."""
+    if not isinstance(shape, (tuple, list)) and shape is not None:
+        shape = _tensor_shape(getattr(shape, "dims", None))
+    if shape is None:
+        # TensorShape(None) = unknown RANK; coercing it to () would claim
+        # a scalar contract for a tensor of unknown arity.
+        raise ValueError(
+            f"Legacy spec {name!r} has unknown rank (TensorShape(None)); "
+            "fill in the shape before migrating."
+        )
+    return ExtendedTensorSpec(
+        shape=tuple(shape),
+        dtype=_as_np_dtype(dtype),
+        name=name,
+        is_optional=bool(is_optional) if is_optional is not None else False,
+        is_sequence=bool(is_sequence),
+        is_extracted=bool(is_extracted),
+        data_format=data_format,
+        dataset_key=dataset_key or "",
+        varlen_default_value=varlen_default_value,
+    )
+
+
+class _LegacyStruct(collections.OrderedDict):
+    """Stand-in for the reference TensorSpecStruct during unpickling: an
+    OrderedDict subclass whose pickle state (e.g. _path_prefix) is
+    absorbed into the instance dict and otherwise ignored."""
+
+
+# Legacy global -> shim. Every (module, name) a reference spec pickle can
+# contain; anything else is refused.
+_ALLOWED_GLOBALS = {
+    ("tensor2robot.utils.tensorspec_utils", "ExtendedTensorSpec"):
+        _extended_tensor_spec,
+    ("tensor2robot.utils.tensorspec_utils", "TensorSpecStruct"):
+        _LegacyStruct,
+    ("tensorflow.python.framework.tensor_shape", "TensorShape"):
+        _tensor_shape,
+    ("tensorflow.python.framework.tensor_shape", "Dimension"): _dimension,
+    ("tensorflow.python.framework.dtypes", "as_dtype"): _as_np_dtype,
+    ("tensorflow.python.framework.dtypes", "DType"): _as_np_dtype,
+    ("collections", "OrderedDict"): collections.OrderedDict,
+    ("numpy", "dtype"): np.dtype,
+    ("numpy.core.multiarray", "scalar"): (
+        lambda dt, payload: np.frombuffer(payload, dtype=dt)[0]
+    ),
+}
+
+
+class _LegacyUnpickler(pickle.Unpickler):
+    def find_class(self, module, name):
+        try:
+            return _ALLOWED_GLOBALS[(module, name)]
+        except KeyError:
+            raise pickle.UnpicklingError(
+                f"Refusing to unpickle legacy global {module}.{name} — not "
+                "part of the T2R spec pickle surface."
+            )
+
+
+def _to_struct(legacy) -> TensorSpecStruct:
+    """Legacy OrderedDict of specs (flat '/'-paths or nested subtrees) ->
+    our flat TensorSpecStruct. Anything that is neither a spec nor a
+    mapping is a loud error — silently dropping entries would hand
+    downstream predictors an incomplete input contract."""
+    struct = TensorSpecStruct()
+
+    def walk(prefix, node):
+        if isinstance(node, ExtendedTensorSpec):
+            struct[prefix] = node
+        elif isinstance(node, dict):
+            for key, value in node.items():
+                walk(f"{prefix}/{key}" if prefix else key, value)
+        else:
+            raise ValueError(
+                f"Legacy spec entry {prefix!r} is a "
+                f"{type(node).__name__}, not a spec or subtree; refusing "
+                "to drop it silently."
+            )
+
+    walk("", legacy)
+    return struct
+
+
+def convert(assets_filepath: str) -> str:
+    """Reads input_specs.pkl (+ optional global_step.pkl) and writes
+    t2r_assets.pbtxt into `assets_filepath`; returns the written path."""
+    input_spec_path = os.path.join(assets_filepath, "input_specs.pkl")
+    if not os.path.exists(input_spec_path):
+        raise ValueError(f"No file exists for {input_spec_path}.")
+    with open(input_spec_path, "rb") as f:
+        spec_data = _LegacyUnpickler(f).load()
+    feature_spec = _to_struct(spec_data["in_feature_spec"])
+    label_spec = _to_struct(spec_data["in_label_spec"])
+
+    assets = t2r_pb2.T2RAssets()
+    assets.feature_spec.CopyFrom(struct_to_proto(feature_spec))
+    assets.label_spec.CopyFrom(struct_to_proto(label_spec))
+
+    global_step_path = os.path.join(assets_filepath, "global_step.pkl")
+    if os.path.exists(global_step_path):
+        with open(global_step_path, "rb") as f:
+            step_data = _LegacyUnpickler(f).load()
+        assets.global_step = int(step_data["global_step"])
+
+    out_path = os.path.join(assets_filepath, T2R_ASSETS_FILENAME)
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(text_format.MessageToString(assets))
+    os.replace(tmp, out_path)
+    return out_path
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--assets_filepath",
+        required=True,
+        help="The path to the exported savedmodel assets directory.",
+    )
+    args = parser.parse_args()
+    print(convert(args.assets_filepath))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,171 @@
+"""Legacy pkl-asset migration (bin/convert_pkl_assets).
+
+Fabricates a byte-faithful legacy pickle — throwaway classes registered
+under the ORIGINAL module paths (`tensor2robot.utils.tensorspec_utils`,
+TF framework internals) whose __reduce__ mirrors the reference exactly
+(tensorspec_utils.py:275-279) — then runs the converter and checks the
+resulting t2r_assets.pbtxt round-trips into this framework's specs."""
+
+import collections
+import os
+import pickle
+import sys
+import types
+
+import numpy as np
+import pytest
+
+from google.protobuf import text_format
+
+from tensor2robot_tpu.bin import convert_pkl_assets
+from tensor2robot_tpu.proto import t2r_pb2
+from tensor2robot_tpu.specs.proto_io import struct_from_proto
+
+
+def _install_legacy_modules(monkeypatch):
+    """Registers stand-in legacy modules so pickling records the original
+    global names (what a real TF1-era pkl contains)."""
+
+    tshape = types.ModuleType("tensorflow.python.framework.tensor_shape")
+
+    class Dimension:
+        def __init__(self, value):
+            self.value = value
+
+        def __reduce__(self):
+            return Dimension, (self.value,)
+
+    class TensorShape:
+        def __init__(self, dims):
+            self.dims = [
+                d if isinstance(d, Dimension) or d is None else Dimension(d)
+                for d in dims
+            ]
+
+        def __reduce__(self):
+            return TensorShape, (self.dims,)
+
+    tshape.TensorShape = TensorShape
+    tshape.Dimension = Dimension
+    Dimension.__module__ = tshape.__name__
+    Dimension.__qualname__ = "Dimension"
+    TensorShape.__module__ = tshape.__name__
+    TensorShape.__qualname__ = "TensorShape"
+
+    tdtypes = types.ModuleType("tensorflow.python.framework.dtypes")
+
+    def as_dtype(name):
+        return _DType(name)
+
+    class _DType:
+        def __init__(self, name):
+            self.name = name
+
+        def __reduce__(self):
+            return as_dtype, (self.name,)
+
+    tdtypes.as_dtype = as_dtype
+    tdtypes.DType = _DType
+    as_dtype.__module__ = tdtypes.__name__
+    as_dtype.__qualname__ = "as_dtype"
+    _DType.__module__ = tdtypes.__name__
+    _DType.__qualname__ = "DType"
+    tdtypes.DType = _DType
+
+    t2r = types.ModuleType("tensor2robot.utils.tensorspec_utils")
+
+    class ExtendedTensorSpec:
+        def __init__(self, shape, dtype, name, is_optional, is_sequence,
+                     is_extracted, data_format, dataset_key,
+                     varlen_default_value):
+            self.args = (shape, dtype, name, is_optional, is_sequence,
+                         is_extracted, data_format, dataset_key,
+                         varlen_default_value)
+
+        def __reduce__(self):
+            return ExtendedTensorSpec, self.args
+
+    class TensorSpecStruct(collections.OrderedDict):
+        pass
+
+    t2r.ExtendedTensorSpec = ExtendedTensorSpec
+    t2r.TensorSpecStruct = TensorSpecStruct
+    ExtendedTensorSpec.__module__ = t2r.__name__
+    ExtendedTensorSpec.__qualname__ = "ExtendedTensorSpec"
+    TensorSpecStruct.__module__ = t2r.__name__
+    TensorSpecStruct.__qualname__ = "TensorSpecStruct"
+
+    for mod in (tshape, tdtypes, t2r):
+        monkeypatch.setitem(sys.modules, mod.__name__, mod)
+        # pickle verifies globals by __import__ of the dotted path, which
+        # walks the PARENT packages — register stubs for those too.
+        parts = mod.__name__.split(".")
+        for i in range(1, len(parts)):
+            parent = ".".join(parts[:i])
+            if parent not in sys.modules:
+                monkeypatch.setitem(
+                    sys.modules, parent, types.ModuleType(parent)
+                )
+    return t2r, tshape, tdtypes
+
+
+def test_convert_legacy_assets(tmp_path, monkeypatch):
+    t2r, tshape, tdtypes = _install_legacy_modules(monkeypatch)
+
+    def spec(shape, dtype, name, **kw):
+        return t2r.ExtendedTensorSpec(
+            tshape.TensorShape(shape), tdtypes.as_dtype(dtype), name,
+            kw.get("is_optional"), kw.get("is_sequence", False), False,
+            kw.get("data_format"), kw.get("dataset_key"), None,
+        )
+
+    features = t2r.TensorSpecStruct()
+    features["state/image"] = spec(
+        (512, 640, 3), "uint8", "image/encoded", data_format="jpeg"
+    )
+    features["state/pose"] = spec((7,), "float32", "pose", is_optional=True)
+    labels = t2r.TensorSpecStruct()
+    labels["reward"] = spec((1,), "float32", "grasp_success")
+
+    with open(tmp_path / "input_specs.pkl", "wb") as f:
+        pickle.dump(
+            {"in_feature_spec": features, "in_label_spec": labels}, f
+        )
+    with open(tmp_path / "global_step.pkl", "wb") as f:
+        pickle.dump({"global_step": 1234}, f)
+
+    out = convert_pkl_assets.convert(str(tmp_path))
+    assert os.path.basename(out) == "t2r_assets.pbtxt"
+
+    with open(out) as f:
+        assets = text_format.Parse(f.read(), t2r_pb2.T2RAssets())
+    assert assets.global_step == 1234
+    feature_struct = struct_from_proto(assets.feature_spec)
+    image = feature_struct["state/image"]
+    assert image.shape == (512, 640, 3)
+    assert image.dtype == np.dtype("uint8")
+    assert image.name == "image/encoded"
+    assert image.data_format == "jpeg"
+    pose = feature_struct["state/pose"]
+    assert pose.is_optional
+    label_struct = struct_from_proto(assets.label_spec)
+    assert label_struct["reward"].shape == (1,)
+
+
+def test_unknown_global_is_refused(tmp_path, monkeypatch):
+    """The unpickler must reject globals outside the spec surface —
+    a pickle naming os.system must not resolve, let alone run."""
+
+    class Evil:
+        def __reduce__(self):
+            return os.system, ("true",)
+
+    with open(tmp_path / "input_specs.pkl", "wb") as f:
+        pickle.dump({"in_feature_spec": Evil(), "in_label_spec": {}}, f)
+    with pytest.raises(pickle.UnpicklingError, match="Refusing"):
+        convert_pkl_assets.convert(str(tmp_path))
+
+
+def test_missing_pkl_raises(tmp_path):
+    with pytest.raises(ValueError, match="No file exists"):
+        convert_pkl_assets.convert(str(tmp_path))
