@@ -25,6 +25,7 @@ tensor2robot/utils/tfdata.py:213-543 and utils/tensorspec_utils.py:1571-1593):
 from __future__ import annotations
 
 import io
+import threading as _threading
 from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence, Tuple, Union
 
 import jax.numpy as jnp
@@ -39,6 +40,93 @@ from tensor2robot_tpu.specs import (
     pad_or_clip_tensor_to_spec_shape,
 )
 
+# -- native jpeg decode (one-shot libjpeg into the output array) -------------
+# The PIL path feeds the decoder in 64 KB chunks through a Python loop and
+# copies the frame twice more (mode convert + numpy export); profiling put
+# ~90% of record-parse time there. native/jpeg_decode.cc decodes the whole
+# buffer in one call directly into the numpy array. PIL stays as the
+# fallback (and the png path).
+_jpeg_lib = None
+_jpeg_lib_failed = False
+_jpeg_lib_lock = _threading.Lock()
+
+
+def _load_jpeg_native():
+    global _jpeg_lib, _jpeg_lib_failed
+    if _jpeg_lib is not None or _jpeg_lib_failed:
+        return _jpeg_lib
+    import ctypes
+    import os
+    import subprocess
+
+    with _jpeg_lib_lock:
+        if _jpeg_lib is not None or _jpeg_lib_failed:
+            return _jpeg_lib
+        return _load_jpeg_native_locked(ctypes, os, subprocess)
+
+
+def _load_jpeg_native_locked(ctypes, os, subprocess):
+    global _jpeg_lib, _jpeg_lib_failed
+    native_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native"
+    )
+    lib_path = os.path.join(native_dir, "libt2r_jpeg.so")
+    try:
+        if not os.path.exists(lib_path):
+            subprocess.run(
+                ["make", "-C", native_dir, "libt2r_jpeg.so"],
+                check=True,
+                capture_output=True,
+            )
+        lib = ctypes.CDLL(lib_path)
+        lib.t2r_decode_jpeg.restype = ctypes.c_int
+        lib.t2r_decode_jpeg.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+            ctypes.c_void_p,
+            ctypes.c_size_t,
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int),
+        ]
+        _jpeg_lib = lib
+    except Exception:
+        _jpeg_lib_failed = True
+    return _jpeg_lib
+
+
+def _decode_jpeg_native(data: bytes, shape) -> Optional[np.ndarray]:
+    """One-shot decode into a fresh uint8 array of `shape`; None on any
+    mismatch/failure (caller falls back to PIL)."""
+    lib = _load_jpeg_native()
+    if lib is None:
+        return None
+    import ctypes
+
+    channels = shape[-1] if len(shape) == 3 else 1
+    if channels != 3:
+        # Grayscale requests stay on PIL: libjpeg's JCS_GRAYSCALE takes
+        # the Y plane directly while PIL recomputes luma from the
+        # reconstructed RGB — different pixels for color sources, and
+        # decoded values must not depend on whether the native library
+        # built.
+        return None
+    out = np.empty(shape, np.uint8)
+    h = ctypes.c_int()
+    w = ctypes.c_int()
+    rc = lib.t2r_decode_jpeg(
+        data,
+        len(data),
+        out.ctypes.data_as(ctypes.c_void_p),
+        out.nbytes,
+        channels,
+        ctypes.byref(h),
+        ctypes.byref(w),
+    )
+    if rc != 0 or (h.value, w.value) != tuple(shape[:2]):
+        return None
+    return out
+
 
 def decode_image(data: bytes, spec: ExtendedTensorSpec) -> np.ndarray:
     """Decodes a jpeg/png byte string to the spec's image shape.
@@ -51,6 +139,14 @@ def decode_image(data: bytes, spec: ExtendedTensorSpec) -> np.ndarray:
         raise ValueError(f"Image spec {spec.name!r} must have static H/W/C, got {shape}")
     if not data:
         return np.zeros(shape, dtype=canonical_dtype(spec.dtype))
+    if (
+        spec.data_format
+        and spec.data_format.lower() in ("jpeg", "jpg")
+        and data[:2] == b"\xff\xd8"
+    ):
+        decoded = _decode_jpeg_native(data, shape)
+        if decoded is not None:
+            return decoded.astype(canonical_dtype(spec.dtype), copy=False)
     from PIL import Image  # deferred: PIL not needed on non-image paths
 
     img = Image.open(io.BytesIO(data))

@@ -40,8 +40,11 @@ def _load_native() -> Optional[ctypes.CDLL]:
             return _lib
         try:
             if not os.path.exists(_LIB_PATH):
+                # Pin the target: `all` also builds the libjpeg-dependent
+                # decoder, whose absence of dev headers must not fail the
+                # record codec this loader needs.
                 subprocess.run(
-                    ["make", "-C", _NATIVE_DIR],
+                    ["make", "-C", _NATIVE_DIR, "libt2r_io.so"],
                     check=True,
                     capture_output=True,
                 )

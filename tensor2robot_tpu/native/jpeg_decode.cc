@@ -1,0 +1,101 @@
+// Direct libjpeg(-turbo) JPEG decode into a caller-provided buffer.
+//
+// The Python parse pipeline's profile (docs/PERFORMANCE.md host-feed
+// section) shows ~90% of record-parse time inside PIL's chunked jpeg
+// decode: the bytes are fed to the decoder in 64 KB increments through a
+// Python-level loop, the decoded image lands in a PIL object, and the
+// mode conversion + numpy export each copy the full frame. This path
+// decodes the whole in-memory buffer in ONE libjpeg call directly into
+// the numpy array the parser hands over — no chunk loop, no PIL object,
+// no convert copy.
+//
+// Exported C ABI (ctypes-consumed by tensor2robot_tpu/data/parser.py):
+//   t2r_decode_jpeg(data, len, out, out_capacity, want_channels,
+//                   &h, &w) -> 0 on success, negative on failure.
+//     want_channels: 3 (RGB) or 1 (grayscale); the decoder converts
+//     whatever subsampling/colorspace the file uses.
+//
+// libjpeg's default error handler calls exit(); a setjmp-based handler
+// turns decode errors into error returns instead.
+
+#include <csetjmp>
+#include <cstddef>
+#include <cstdio>
+#include <cstring>
+
+#include <jpeglib.h>
+
+namespace {
+
+struct ErrorMgr {
+  jpeg_error_mgr pub;
+  std::jmp_buf jump;
+};
+
+void error_exit(j_common_ptr cinfo) {
+  ErrorMgr* mgr = reinterpret_cast<ErrorMgr*>(cinfo->err);
+  std::longjmp(mgr->jump, 1);
+}
+
+void emit_message(j_common_ptr, int) {}  // silence warnings
+
+}  // namespace
+
+extern "C" {
+
+// Returns 0 on success; -1 bad args, -2 decode error, -3 buffer too
+// small, -4 unsupported channel request.
+int t2r_decode_jpeg(const unsigned char* data, size_t len,
+                    unsigned char* out, size_t out_capacity,
+                    int want_channels, int* height, int* width) {
+  if (data == nullptr || out == nullptr || len == 0) return -1;
+  if (want_channels != 1 && want_channels != 3) return -4;
+
+  jpeg_decompress_struct cinfo;
+  ErrorMgr err;
+  cinfo.err = jpeg_std_error(&err.pub);
+  err.pub.error_exit = error_exit;
+  err.pub.emit_message = emit_message;
+  if (setjmp(err.jump)) {
+    jpeg_destroy_decompress(&cinfo);
+    return -2;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<unsigned char*>(data),
+               static_cast<unsigned long>(len));
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return -2;
+  }
+  cinfo.out_color_space = (want_channels == 3) ? JCS_RGB : JCS_GRAYSCALE;
+  jpeg_start_decompress(&cinfo);
+
+  const size_t row_stride =
+      static_cast<size_t>(cinfo.output_width) * cinfo.output_components;
+  const size_t need =
+      row_stride * static_cast<size_t>(cinfo.output_height);
+  if (need > out_capacity) {
+    jpeg_abort_decompress(&cinfo);
+    jpeg_destroy_decompress(&cinfo);
+    return -3;
+  }
+
+  while (cinfo.output_scanline < cinfo.output_height) {
+    // Decode as many rows per call as libjpeg will give us, straight
+    // into the output buffer (rec_outbuf_height rows per call typically).
+    JSAMPROW rows[4];
+    unsigned int n = 0;
+    for (; n < 4 && cinfo.output_scanline + n < cinfo.output_height; ++n) {
+      rows[n] = out + (cinfo.output_scanline + n) * row_stride;
+    }
+    jpeg_read_scanlines(&cinfo, rows, n);
+  }
+
+  *height = static_cast<int>(cinfo.output_height);
+  *width = static_cast<int>(cinfo.output_width);
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return 0;
+}
+
+}  // extern "C"

@@ -166,6 +166,52 @@ class TestParser:
         out = decode_image(b"", spec)
         np.testing.assert_array_equal(out, np.zeros((4, 4, 3), np.uint8))
 
+    def test_native_jpeg_decode_matches_pil(self):
+        """The one-shot libjpeg path (native/jpeg_decode.cc) must be
+        BIT-IDENTICAL to the PIL fallback — both sit on libjpeg-turbo, so
+        any divergence means the wiring (colorspace, stride, channel
+        request) is wrong, not the codec."""
+        import io as iomod
+
+        from PIL import Image
+
+        from tensor2robot_tpu.data import parser as parser_mod
+        from tensor2robot_tpu.data.encoder import encode_image
+
+        if parser_mod._load_jpeg_native() is None:
+            pytest.skip("no C++ toolchain / libjpeg dev files on this host")
+        img = np.random.RandomState(3).randint(
+            0, 256, (96, 128, 3), np.uint8
+        )
+        data = encode_image(img, "jpeg")
+        native = parser_mod._decode_jpeg_native(data, (96, 128, 3))
+        assert native is not None
+        pil = np.asarray(Image.open(iomod.BytesIO(data)).convert("RGB"))
+        np.testing.assert_array_equal(native, pil)
+
+    def test_native_jpeg_decode_rejects_garbage(self):
+        """Corrupt buffers must return None (PIL fallback handles the
+        error reporting), never crash the process — libjpeg's default
+        handler would exit()."""
+        from tensor2robot_tpu.data import parser as parser_mod
+
+        assert (
+            parser_mod._decode_jpeg_native(
+                b"\xff\xd8" + b"not a jpeg" * 10, (8, 8, 3)
+            )
+            is None
+        )
+        # Shape mismatch (spec says 4x4, file is bigger) -> None, fallback.
+        from tensor2robot_tpu.data.encoder import encode_image
+
+        img = np.zeros((16, 16, 3), np.uint8)
+        assert (
+            parser_mod._decode_jpeg_native(
+                encode_image(img, "jpeg"), (4, 4, 3)
+            )
+            is None
+        )
+
     def test_sequence_roundtrip_and_lengths(self):
         spec = TensorSpecStruct()
         spec["obs"] = ExtendedTensorSpec(
