@@ -274,6 +274,16 @@ def _analytic_train_flops(
     return flops * 3.0
 
 
+def _pool_backward_mode() -> str:
+    """Which pool VJP this process traced with (ops/pooling.max_pool)."""
+    from tensor2robot_tpu.ops.pooling import resolve_backward_mode
+
+    resolved = resolve_backward_mode()
+    if os.environ.get("T2R_POOL_BACKWARD", "auto") == "auto":
+        return f"auto:{resolved}"
+    return resolved
+
+
 def _proxy_fields(on_tpu: bool) -> dict:
     """Top-level self-description for CPU-proxy payloads (VERDICT r4 weak
     #6): an explicit "proxy": true plus a note that vs_baseline is computed
@@ -1538,6 +1548,7 @@ def main() -> None:
                     "remat": use_remat,
                     "flat_optimizer_update": flat_opt,
                     "fuse_batch_stats_update": compiled._fuse_stats,
+                    "pool_backward": _pool_backward_mode(),
                     **(
                         {"backend_note": backend_note}
                         if backend_note

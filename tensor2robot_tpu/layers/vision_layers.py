@@ -158,8 +158,9 @@ class ImagesToFeaturesHighResNet(nn.Module):
         net = nn.relu(nn.LayerNorm(name="norm2")(net))
         block_outs.append(nn.Conv(32, (1, 1), name="conv2_1x1")(net))
         for i in range(1, self.num_blocks):
-            # Non-overlapping pool: scatter-free backward (ops/pooling.py).
-            net = pooling.max_pool_nonoverlap(net, (2, 2), "VALID")
+            # Non-overlapping pool: backend-dispatched backward
+            # (ops/pooling.py; SelectAndScatter on TPU per DIAG_STEP_r05).
+            net = pooling.max_pool(net, (2, 2), "VALID")
             net = nn.Conv(
                 32,
                 (self.filter_size, self.filter_size),

@@ -1,14 +1,26 @@
-"""Non-overlapping max pooling with a scatter-free backward.
+"""Non-overlapping max pooling with a backend-dispatched backward.
 
-XLA lowers the gradient of window max pooling to SelectAndScatter, which
-on TPU executes as a slow, poorly-fusible per-window scatter — the round-3
-profile showed the Grasping44 stem pool's select-and-scatter as the single
-most expensive non-gather op in the train step. Every pool in the
-Grasping44 tower (reference research/qtopt/networks.py:446,460,540) is
-NON-overlapping (window == stride), where the backward has a much better
-formulation: reshape the input into its disjoint windows, compare against
-the broadcast pooled maximum, and split the incoming gradient over the
-mask — pure elementwise/reduce work that XLA fuses.
+Two backward formulations exist for a non-overlapping (window == stride)
+max pool — every pool in the Grasping44 tower is of this form (reference
+research/qtopt/networks.py:446,460,540):
+
+* XLA-native: `lax.reduce_window`'s registered gradient, which lowers to
+  SelectAndScatter.
+* Scatter-free (`max_pool_nonoverlap` below): reshape the input into its
+  disjoint windows, compare against the broadcast pooled maximum, and
+  split the incoming gradient over the mask — pure elementwise/reduce
+  work.
+
+Which one wins is a HARDWARE question, and the two measurements disagree:
+on CPU the scatter-free VJP removed the top non-gather op of the step
+(round-4 HLO census), but the round-5 on-chip A/B at the stem activation
+size (DIAG_STEP_r05.json, TPU v5e, bs64 236x236x64: scatterfree 55.7 ms
+vs SelectAndScatter 41.7 ms against a shared ~34 ms readback floor, i.e.
+~22 ms vs ~8 ms of compute) shows TPU's native SelectAndScatter pool
+gradient beating the reshape/mask formulation ~3x. `max_pool` therefore
+dispatches on the backend at trace time: native on TPU, scatter-free
+elsewhere; `T2R_POOL_BACKWARD=scatterfree|native` forces either path
+(the bench A/B uses this).
 
 The forward stays `lax.reduce_window` (already optimal on TPU); only the
 VJP is replaced via `jax.custom_vjp`.
@@ -29,12 +41,50 @@ restructured as `jax.custom_jvp` to support both modes.
 
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import Tuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+
+def resolve_backward_mode() -> str:
+    """Resolves T2R_POOL_BACKWARD to the concrete VJP path.
+
+    Returns "native" or "scatterfree"; unknown values fail fast (a typo
+    silently selecting the slow backward would poison a benchmark round).
+    """
+    mode = os.environ.get("T2R_POOL_BACKWARD", "auto")
+    if mode == "auto":
+        return "native" if jax.default_backend() == "tpu" else "scatterfree"
+    if mode not in ("native", "scatterfree"):
+        raise ValueError(
+            f"T2R_POOL_BACKWARD={mode!r}: expected auto|native|scatterfree"
+        )
+    return mode
+
+
+def max_pool(
+    x: jax.Array, window: Tuple[int, int], padding: str = "SAME"
+) -> jax.Array:
+    """Non-overlapping max pool with the fastest backward for the backend.
+
+    Forward is `lax.reduce_window` on every path (bit-identical results);
+    the paths differ only in the VJP (and in subgradient tie-breaking:
+    native SelectAndScatter routes tied gradients to the first maximal
+    element, scatter-free splits them equally — both valid subgradients).
+    """
+    if resolve_backward_mode() == "native":
+        dims = (1, window[0], window[1], 1)
+        # Init must be the -inf LITERAL: jax's reverse-mode rule for max
+        # pooling pattern-matches (literal init, lax.max) — a device-array
+        # init turns this into a general reduce_window with no transpose.
+        return lax.reduce_window(
+            x, -jnp.inf, lax.max, dims, dims, padding.upper()
+        )
+    return max_pool_nonoverlap(x, window, padding)
 
 
 def _pool_pads(shape, window: Tuple[int, int], padding: str):

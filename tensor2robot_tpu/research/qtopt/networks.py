@@ -152,10 +152,11 @@ class Grasping44(nn.Module):
         )(images)
         net = BatchNorm(use_scale=False, name="bn1", **bn_kwargs)(net)
         net = nn.relu(net)
-        # Non-overlapping pools use the scatter-free backward (the XLA
-        # SelectAndScatter pool gradient was the top non-gather op in the
-        # round-3 profile); forward is bit-identical to nn.max_pool.
-        net = pooling.max_pool_nonoverlap(net, (3, 3))
+        # Non-overlapping pools dispatch the backward on the backend:
+        # SelectAndScatter on TPU, scatter-free elsewhere (ops/pooling.py;
+        # on-chip A/B in DIAG_STEP_r05.json). Forward is bit-identical to
+        # nn.max_pool either way.
+        net = pooling.max_pool(net, (3, 3))
 
         for i in range(self.num_convs[0]):
             net = _ConvBNRelu(
@@ -165,7 +166,7 @@ class Grasping44(nn.Module):
                 name=f"conv{2 + i}",
                 dtype=dtype,
             )(net, is_training)
-        net = pooling.max_pool_nonoverlap(net, (3, 3))
+        net = pooling.max_pool(net, (3, 3))
         end_points["pool2"] = net
 
         # Grasp-param input head: one linear projection per named block,
@@ -210,7 +211,7 @@ class Grasping44(nn.Module):
                 name=f"conv{2 + self.num_convs[0] + i}",
                 dtype=dtype,
             )(net, is_training)
-        net = pooling.max_pool_nonoverlap(net, (2, 2))
+        net = pooling.max_pool(net, (2, 2))
         for i in range(self.num_convs[2]):
             net = _ConvBNRelu(
                 self.width, (3, 3), padding="VALID",

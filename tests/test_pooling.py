@@ -182,3 +182,56 @@ class TestBatchNormDtype:
         # Running statistics must still accumulate in f32.
         stats = jax.tree_util.tree_leaves(variables["batch_stats"])
         assert all(s.dtype == jnp.float32 for s in stats)
+
+
+class TestBackendDispatch:
+    """max_pool picks the backward per backend; forward is identical."""
+
+    def test_auto_is_scatterfree_off_tpu(self, monkeypatch):
+        from tensor2robot_tpu.ops import pooling
+
+        if jax.default_backend() == "tpu":
+            pytest.skip("auto resolves to native on a TPU backend")
+        monkeypatch.delenv("T2R_POOL_BACKWARD", raising=False)
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 12, 12, 3))
+        # On the CPU test backend the auto path must be the custom VJP:
+        # forward-mode autodiff through it raises (custom_vjp), which is
+        # exactly how we can tell the paths apart without reading HLO.
+        with pytest.raises(TypeError):
+            jax.jvp(lambda x: pooling.max_pool(x, (2, 2)), (x,), (x,))
+
+    def test_forced_native_has_no_custom_vjp(self, monkeypatch):
+        from tensor2robot_tpu.ops import pooling
+
+        monkeypatch.setenv("T2R_POOL_BACKWARD", "native")
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 12, 12, 3))
+        # Native reduce_window supports forward mode - and matches the
+        # scatter-free forward bit-for-bit.
+        y, _ = jax.jvp(lambda x: pooling.max_pool(x, (2, 2)), (x,), (x,))
+        np.testing.assert_array_equal(
+            y, max_pool_nonoverlap(x, (2, 2))
+        )
+
+    @pytest.mark.parametrize("mode", ["native", "scatterfree"])
+    def test_grads_agree_without_ties(self, monkeypatch, mode):
+        from tensor2robot_tpu.ops import pooling
+
+        monkeypatch.setenv("T2R_POOL_BACKWARD", mode)
+        # Distinct values in every window => no subgradient tie-breaking
+        # ambiguity, so both backwards must agree exactly.
+        x = (
+            jnp.arange(2 * 12 * 12 * 3, dtype=jnp.float32)
+            .reshape(2, 12, 12, 3)
+        ) * 0.37
+        gx = jax.grad(lambda x: jnp.sum(pooling.max_pool(x, (3, 3)) ** 2))(x)
+        want = jax.grad(
+            lambda x: jnp.sum(max_pool_nonoverlap(x, (3, 3)) ** 2)
+        )(x)
+        np.testing.assert_allclose(gx, want, rtol=1e-6)
+
+    def test_unknown_mode_fails_fast(self, monkeypatch):
+        from tensor2robot_tpu.ops import pooling
+
+        monkeypatch.setenv("T2R_POOL_BACKWARD", "scatter-free")
+        with pytest.raises(ValueError, match="T2R_POOL_BACKWARD"):
+            pooling.max_pool(jnp.zeros((1, 4, 4, 1)), (2, 2))
