@@ -284,6 +284,13 @@ def _pool_backward_mode() -> str:
     return resolved
 
 
+def _stem_s2d() -> bool:
+    """Whether the stem traced with the space-to-depth lowering."""
+    from tensor2robot_tpu.layers.s2d_conv import stem_s2d_enabled
+
+    return stem_s2d_enabled()
+
+
 def _proxy_fields(on_tpu: bool) -> dict:
     """Top-level self-description for CPU-proxy payloads (VERDICT r4 weak
     #6): an explicit "proxy": true plus a note that vs_baseline is computed
@@ -1549,6 +1556,7 @@ def main() -> None:
                     "flat_optimizer_update": flat_opt,
                     "fuse_batch_stats_update": compiled._fuse_stats,
                     "pool_backward": _pool_backward_mode(),
+                    "stem_s2d": _stem_s2d(),
                     **(
                         {"backend_note": backend_note}
                         if backend_note

@@ -1,0 +1,120 @@
+"""Space-to-depth lowering of a strided stem convolution.
+
+A K×K, stride-S convolution whose kernel size is a multiple of its stride
+is mathematically IDENTICAL to: space-to-depth by S (fold each S×S spatial
+block into channels), then a (K/S)×(K/S), stride-1 convolution whose kernel
+is a pure reshape/transpose of the original. Receptive fields coincide
+exactly — output (i, j) reads input rows S·i−pad .. S·i−pad+K−1 on both
+paths — and SAME zero-padding maps to SAME zero-padding, so outputs agree
+to numerical exactness.
+
+Why bother: TPU convolutions with tiny input-channel counts (the RGB stem:
+C_in = 3) leave most of the MXU's 128 reduction lanes idle. Folding S²
+spatial positions into channels multiplies C_in by S² at identical FLOPs,
+which is the classic TPU stem transform (used by every production ResNet
+on TPU). The round-5 diagnosis measured the reference stem conv at ~0.6%
+of peak — the worst op in the Grasping44 tower by an order of magnitude.
+
+The parameter is stored in the ORIGINAL (K, K, C_in, features) layout under
+the same name a plain `nn.Conv` would use, so checkpoints are bit-portable
+between the two lowerings; the reshape happens at trace time.
+
+Behavioral reference for the stem this lowers:
+research/qtopt/networks.py:441-445 (6×6 stride-2 SAME conv on RGB).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax import lax
+
+
+def stem_s2d_enabled() -> bool:
+    """Whether strided stems lower via space-to-depth.
+
+    T2R_STEM_S2D=1 forces on, =0 forces off; "auto" (default) currently
+    resolves OFF everywhere until the on-chip A/B (DIAG entry_conv_s2d
+    cases) proves the win — flip the auto rule here when it does.
+    """
+    mode = os.environ.get("T2R_STEM_S2D", "auto")
+    if mode == "auto":
+        return False  # pending the on-chip A/B; see docstring
+    if mode not in ("0", "1"):
+        raise ValueError(f"T2R_STEM_S2D={mode!r}: expected auto|0|1")
+    return mode == "1"
+
+
+class SpaceToDepthConv(nn.Module):
+    """Drop-in twin of `nn.Conv(features, (K, K), strides=(S, S), "SAME")`
+    for K % S == 0, lowered as space-to-depth(S) + (K/S)² stride-1 conv.
+
+    Stores its kernel in the plain-Conv layout (K, K, C_in, features) under
+    the param name "kernel" so the two implementations are checkpoint-
+    compatible in both directions.
+    """
+
+    features: int
+    kernel_size: Tuple[int, int] = (6, 6)
+    strides: Tuple[int, int] = (2, 2)
+    dtype: jnp.dtype | None = None
+    kernel_init: Callable = nn.initializers.lecun_normal()
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        kh, kw = self.kernel_size
+        sh, sw = self.strides
+        if kh % sh or kw % sw:
+            raise ValueError(
+                f"kernel {self.kernel_size} not a multiple of strides "
+                f"{self.strides}; space-to-depth lowering needs K % S == 0"
+            )
+        if (kh - sh) % (2 * sh) or (kw - sw) % (2 * sw):
+            # SAME on the strided conv pads (K-S)/2 per side; that is only
+            # expressible as whole folded pixels when (K-S)/2 is a multiple
+            # of S (true for the 6x6/2 stem: pad 2 = one folded pixel).
+            raise ValueError(
+                f"SAME padding of kernel {self.kernel_size} stride "
+                f"{self.strides} is not a whole number of space-to-depth "
+                "blocks per side"
+            )
+        b, h, w, c = x.shape
+        if h % sh or w % sw:
+            raise ValueError(
+                f"input spatial dims {(h, w)} not divisible by strides "
+                f"{self.strides}"
+            )
+        kernel = self.param(
+            "kernel", self.kernel_init, (kh, kw, c, self.features), jnp.float32
+        )
+        dtype = self.dtype or x.dtype
+        x = x.astype(dtype)
+        kernel = kernel.astype(dtype)
+
+        ah, aw = kh // sh, kw // sw
+        # Kernel index (kh, kw) = (sh*a + p, sw*b + q)  ->  tap (a, b) over
+        # folded channel (p, q, c); channel order must match the
+        # space-to-depth fold below: index = (p*sw + q)*c + c_orig.
+        k = kernel.reshape(ah, sh, aw, sw, c, self.features)
+        k = k.transpose(0, 2, 1, 3, 4, 5).reshape(
+            ah, aw, sh * sw * c, self.features
+        )
+        # Space-to-depth fold: [B, H, W, C] -> [B, H/S, W/S, S*S*C].
+        xs = x.reshape(b, h // sh, sh, w // sw, sw, c)
+        xs = xs.transpose(0, 1, 3, 2, 4, 5).reshape(
+            b, h // sh, w // sw, sh * sw * c
+        )
+        # SAME on the strided conv pads (K-S)/2 input rows per side, i.e.
+        # exactly (K-S)/(2S) folded pixels per side (guard above).
+        ph, pw = (kh - sh) // (2 * sh), (kw - sw) // (2 * sw)
+        return lax.conv_general_dilated(
+            xs,
+            k,
+            window_strides=(1, 1),
+            padding=((ph, ph), (pw, pw)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )

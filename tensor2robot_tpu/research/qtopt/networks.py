@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 
 from tensor2robot_tpu.layers.batch_norm import BatchNorm
+from tensor2robot_tpu.layers.s2d_conv import SpaceToDepthConv, stem_s2d_enabled
 from tensor2robot_tpu.ops import pooling
 
 # Named grasp-param sub-blocks of the E2E variant: {name: (offset, size)}
@@ -146,10 +147,20 @@ class Grasping44(nn.Module):
 
         # Stem: conv without norm/activation, then a standalone unscaled BN
         # (reference keeps scale=False on the standalone BNs, :444-458).
-        net = nn.Conv(
-            self.width, (6, 6), strides=(2, 2), padding="SAME", use_bias=False,
-            kernel_init=_CONV_INIT, name="conv1_1", dtype=dtype,
-        )(images)
+        # The stem can lower via space-to-depth (layers/s2d_conv.py) — an
+        # exact reformulation that fills the MXU's reduction lanes; both
+        # lowerings share the checkpoint layout and the "conv1_1" name.
+        if stem_s2d_enabled():
+            net = SpaceToDepthConv(
+                self.width, (6, 6), strides=(2, 2),
+                kernel_init=_CONV_INIT, name="conv1_1", dtype=dtype,
+            )(images)
+        else:
+            net = nn.Conv(
+                self.width, (6, 6), strides=(2, 2), padding="SAME",
+                use_bias=False, kernel_init=_CONV_INIT, name="conv1_1",
+                dtype=dtype,
+            )(images)
         net = BatchNorm(use_scale=False, name="bn1", **bn_kwargs)(net)
         net = nn.relu(net)
         # Non-overlapping pools dispatch the backward on the backend:
