@@ -56,6 +56,8 @@ all_done() {
   have BENCH_r05.json '"pool_backward": "auto:native"' &&
   have BENCH_r05_s2d.json '"stem_s2d": true' &&
   have BENCH_r05_poolfree.json '"pool_backward": "scatterfree"' &&
+  have BENCH_r05_c128_v2.json '_c128"' &&
+  have BENCH_r05_c128_s2d.json '"stem_s2d": true' &&
   have DIAG_STEP_r05b.json '"ok": true' &&
   have BENCH_PREDICT_r05.json 'cem_predict_hz"' &&
   have BENCH_STREAM_r05.json 'streaming_bc_policy_steps_per_sec"' &&
@@ -120,6 +122,18 @@ for i in $(seq 1 "$tries"); do
   run_leg BENCH_r05_poolfree.json '"pool_backward": "scatterfree"' \
     "Round-5 A/B: scatter-free pool twin of the post-fix headline" \
     BENCH_BACKEND_WAIT=240 T2R_POOL_BACKWARD=scatterfree -- python bench.py
+
+  # 3b/3c. The width-aligned twin under the new levers: c128 + native
+  # pool (BENCH_r05_c128.json was captured with the old scatter-free
+  # backward), then c128 + native pool + s2d stem — the best-known
+  # configuration. Either may cross 50% MFU ABSOLUTE.
+  run_leg BENCH_r05_c128_v2.json '_c128"' \
+    "Round-5 c128 twin re-measure with the TPU-native pool backward" \
+    BENCH_BACKEND_WAIT=240 BENCH_WIDTH=128 -- python bench.py
+
+  run_leg BENCH_r05_c128_s2d.json '"stem_s2d": true' \
+    "Round-5 best-known config: c128 + native pool + s2d stem" \
+    BENCH_BACKEND_WAIT=240 BENCH_WIDTH=128 T2R_STEM_S2D=1 -- python bench.py
 
   # 4. Diagnosis v2: readback-floor-corrected efficiencies + s2d cases.
   run_leg DIAG_STEP_r05b.json '"ok": true' \
