@@ -29,6 +29,28 @@ have() {
     && ! grep -q '"proxy": true' "$1" && ! grep -q '"error":' "$1"
 }
 
+abandoned_pids=""
+abandoned_cpu=""
+abandoned_revived() {
+  # True if any abandoned (wedged, never-signaled) client is alive AND
+  # burning cpu again — running a new leg beside it would violate the
+  # one-TPU-client rule. Inert wedged clients (cpu frozen) don't block.
+  local pid cpu prev new_cpu=""
+  for pid in $abandoned_pids; do
+    kill -0 "$pid" 2>/dev/null || continue
+    cpu=$(leg_cpu "$pid")
+    prev=$(echo "$abandoned_cpu" | tr ' ' '\n' | grep "^$pid:" | cut -d: -f2)
+    new_cpu="$new_cpu $pid:$cpu"
+    if [ -n "$prev" ] && [ "$cpu" != "$prev" ]; then
+      abandoned_cpu="$new_cpu"
+      log "abandoned client $pid is active again; yielding this pass"
+      return 0
+    fi
+  done
+  abandoned_cpu="$new_cpu"
+  return 1
+}
+
 probe_pid=""
 tunnel_alive() {
   pgrep -f '/root/\.relay\.py' >/dev/null 2>&1 || return 1
@@ -70,6 +92,10 @@ all_done() {
   have BENCH_r05_nofusestats.json '_nofusestats"'
 }
 
+leg_cpu() {  # total jiffies (utime+stime) of pid $1, 0 if gone
+  awk '{print $14 + $15}' "/proc/$1/stat" 2>/dev/null || echo 0
+}
+
 run_leg() {  # run_leg <artifact> <grep> <message> <env...> -- <cmd...>
   local artifact="$1" pattern="$2" message="$3"; shift 3
   local -a envs=()
@@ -78,7 +104,27 @@ run_leg() {  # run_leg <artifact> <grep> <message> <env...> -- <cmd...>
     log "skip $artifact (already captured)"; return 0
   fi
   local tmp="/tmp/w_r05b_$(basename "$artifact")"
-  env ${envs[@]+"${envs[@]}"} "$@" > "$tmp" 2>"${tmp}.err" || true
+  # Wedge watchdog (the first chain's AUC leg blocked forever on an RPC
+  # the dead tunnel would never answer): run the leg in background and
+  # watch its CPU time. A wedged jax client burns ZERO cpu (blocked in
+  # recv); a slow-but-working leg keeps accumulating jiffies. If the
+  # client is past the runtime floor AND its cpu clock has been frozen
+  # for 10 min, ABANDON the wait — never signal it (relay-safety rule) —
+  # and let the chain cycle back to the tunnel probe.
+  env ${envs[@]+"${envs[@]}"} "$@" > "$tmp" 2>"${tmp}.err" &
+  local leg_pid=$! elapsed=0 last_cpu=0 frozen_s=0
+  while kill -0 "$leg_pid" 2>/dev/null; do
+    sleep 30; elapsed=$((elapsed + 30))
+    local cpu; cpu=$(leg_cpu "$leg_pid")
+    if [ "$cpu" != "$last_cpu" ]; then last_cpu="$cpu"; frozen_s=0
+    else frozen_s=$((frozen_s + 30)); fi
+    if [ "$elapsed" -ge 1200 ] && [ "$frozen_s" -ge 600 ]; then
+      log "$artifact leg wedged (pid $leg_pid: ${elapsed}s elapsed, cpu frozen ${frozen_s}s); abandoning wait, NOT signaling"
+      abandoned_pids="$abandoned_pids $leg_pid"
+      return 1
+    fi
+  done
+  wait "$leg_pid" 2>/dev/null
   if grep -q "$pattern" "$tmp" && ! grep -q cpu_proxy "$tmp" \
       && ! grep -q '"proxy": true' "$tmp" && ! grep -q '"error":' "$tmp"; then
     cp "$tmp" "$artifact"
@@ -94,6 +140,7 @@ for i in $(seq 1 "$tries"); do
   if ! tunnel_alive; then
     log "tunnel down ($i/$tries)"; sleep "$sleep_s"; continue
   fi
+  if abandoned_revived; then sleep "$sleep_s"; continue; fi
   log "tunnel alive — running chain (pass $i)"
 
   # 1. Loop-close: the post-pool-fix headline (the official bench.py
