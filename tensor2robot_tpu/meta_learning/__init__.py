@@ -3,6 +3,12 @@ from tensor2robot_tpu.meta_learning.maml_inner_loop import (
     MAMLInnerLoopGradientDescent,
 )
 from tensor2robot_tpu.meta_learning.maml_model import MAMLModel
+from tensor2robot_tpu.meta_learning.meta_models import (
+    MetalearningModel,
+    MetaPreprocessor,
+    create_meta_spec,
+    select_mode,
+)
 from tensor2robot_tpu.meta_learning.meta_policies import (
     FixedLengthSequentialRegressionPolicy,
     MAMLCEMPolicy,

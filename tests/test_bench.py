@@ -56,6 +56,12 @@ def test_bench_mfu_contract():
     )
     assert detail["bf16_forward"] is True
     assert detail["tower_width"] == 64
+    # Round-5 provenance fields: which pool VJP and stem lowering this
+    # process traced with (the on-chip A/B legs key off these).
+    assert detail["pool_backward"] in (
+        "auto:native", "auto:scatterfree", "native", "scatterfree"
+    )
+    assert isinstance(detail["stem_s2d"], bool)
     # The clamped overlap headline can never exceed 1.0; the raw ratio
     # rides alongside whenever the infeed leg ran.
     assert detail["infeed_overlap_efficiency"] <= 1.0

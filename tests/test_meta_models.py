@@ -1,0 +1,219 @@
+"""Legacy TrainValPair meta-learning path (meta_models.py): spec algebra,
+select_mode switching, MetaPreprocessor round trip, MetalearningModel
+plumbing with a concrete RL^2-style subclass over the mock model."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensor2robot_tpu.meta_learning.meta_models import (
+    MetalearningModel,
+    MetaPreprocessor,
+    create_meta_spec,
+    select_mode,
+)
+from tensor2robot_tpu.specs import TensorSpecStruct
+from tensor2robot_tpu.utils.mocks import MockT2RModel
+
+TRAIN = "train"
+
+
+class TestCreateMetaSpec:
+    def test_structure_names_and_optionality(self):
+        base = MockT2RModel()
+        spec = create_meta_spec(
+            base.get_feature_specification(TRAIN), "features", 5, 3
+        )
+        # Flattened paths carry both branches plus the switch.
+        assert "train/x" in spec
+        assert "val/x" in spec
+        assert spec.val_mode.dtype == np.bool_
+        assert spec.val_mode.name == "val_mode/features"
+        # Serialized names are branch-prefixed (reference
+        # _create_meta_spec via copy_tensorspec :773-778).
+        assert spec["train/x"].name.startswith("train/")
+        assert spec["val/x"].name.startswith("val/")
+        # Branch batch dims are the per-task sample counts, non-optional.
+        assert spec["train/x"].shape[0] == 5
+        assert spec["val/x"].shape[0] == 3
+        assert not spec["train/x"].is_optional
+        assert not spec["val/x"].is_optional
+
+    def test_rejects_unknown_spec_type(self):
+        base = MockT2RModel()
+        with pytest.raises(ValueError, match="spec_type"):
+            create_meta_spec(
+                base.get_feature_specification(TRAIN), "outputs", 5, 3
+            )
+
+
+class TestSelectMode:
+    def test_switches_whole_tasks(self):
+        train = {"a": jnp.zeros((4, 2, 3))}
+        val = {"a": jnp.ones((4, 2, 3))}
+        val_mode = jnp.array([[True], [False], [True], [False]])
+        out = select_mode(val_mode, train, val)
+        got = np.asarray(out["a"])[:, 0, 0]
+        np.testing.assert_array_equal(got, [1.0, 0.0, 1.0, 0.0])
+
+    def test_structure_mismatch_raises(self):
+        with pytest.raises(ValueError, match="identical train/val"):
+            select_mode(
+                jnp.asarray(True),
+                {"a": jnp.zeros((2,))},
+                {"b": jnp.zeros((2,))},
+            )
+
+    def test_scalar_mode(self):
+        train = {"a": jnp.zeros((2, 2))}
+        val = {"a": jnp.ones((2, 2))}
+        np.testing.assert_array_equal(
+            np.asarray(select_mode(jnp.asarray(True), train, val)["a"]),
+            np.ones((2, 2)),
+        )
+
+
+def _meta_batch(model, num_tasks, n_train, n_val, with_labels=True):
+    """Builds a [tasks, samples, ...] TrainValPair batch for the mock spec
+    (one feature 'x' of shape (3,), one label 'a_target' of shape (1,))."""
+    rng = np.random.RandomState(0)
+    features = TensorSpecStruct()
+    features["train/x"] = rng.rand(num_tasks, n_train, 3).astype(np.float32)
+    features["val/x"] = rng.rand(num_tasks, n_val, 3).astype(np.float32)
+    features["val_mode"] = np.zeros((num_tasks, 1), bool)
+    labels = None
+    if with_labels:
+        labels = TensorSpecStruct()
+        labels["train/a_target"] = rng.randint(
+            0, 2, (num_tasks, n_train, 1)
+        ).astype(np.float32)
+        labels["val/a_target"] = rng.randint(0, 2, (num_tasks, n_val, 1)).astype(
+            np.float32
+        )
+        labels["val_mode"] = np.zeros((num_tasks, 1), bool)
+    return features, labels
+
+
+class TestMetaPreprocessor:
+    def test_round_trip_shapes(self):
+        base = MockT2RModel()
+        pre = MetaPreprocessor(base.preprocessor, 5, 3)
+        features, labels = _meta_batch(base, num_tasks=4, n_train=5, n_val=3)
+        out_f, out_l = pre.preprocess(
+            features, labels, mode=TRAIN, rng=jax.random.PRNGKey(0)
+        )
+        assert out_f["train/x"].shape == (4, 5, 3)
+        assert out_f["val/x"].shape == (4, 3, 3)
+        assert out_f.val_mode.shape == (4, 1)
+        assert out_l["train/a_target"].shape == (4, 5, 1)
+        assert out_l["val/a_target"].shape == (4, 3, 1)
+
+    def test_spec_surface_matches_model(self):
+        base = MockT2RModel()
+        pre = MetaPreprocessor(base.preprocessor, 5, 3)
+        for getter in (
+            pre.get_in_feature_specification,
+            pre.get_out_feature_specification,
+        ):
+            spec = getter(TRAIN)
+            assert "train/x" in spec and "val/x" in spec
+
+    def test_mode_required(self):
+        base = MockT2RModel()
+        pre = MetaPreprocessor(base.preprocessor, 2, 2)
+        features, labels = _meta_batch(base, 1, 2, 2)
+        with pytest.raises(ValueError):
+            pre._preprocess_fn(features, labels, None, None)
+
+
+class _RL2Mock(MetalearningModel):
+    """Concrete subclass: runs the base network on the val_mode-selected
+    branch (equal sample counts), flattened over the meta dim — the
+    minimal RL^2-style composition the legacy base class exists for."""
+
+    def init_variables(self, rng, features, mode=TRAIN):
+        from tensor2robot_tpu.meta_learning import meta_tfdata
+
+        flat = meta_tfdata.flatten_batch_examples(
+            {"x": features["train/x"]}
+        )
+        return self._base_model.init_variables(rng, flat, mode)
+
+    def inference_network_fn(self, variables, features, mode, rng=None,
+                             labels=None):
+        from tensor2robot_tpu.meta_learning import meta_tfdata
+
+        selected = select_mode(
+            features.val_mode,
+            {"x": features["train/x"]},
+            {"x": features["val/x"]},
+        )
+        num_samples = features["train/x"].shape[1]
+        flat = meta_tfdata.flatten_batch_examples(selected)
+        outputs, mutable = self._base_model.inference_network_fn(
+            variables, flat, mode, rng=rng
+        )
+        outputs = meta_tfdata.unflatten_batch_examples(outputs, num_samples)
+        return outputs, mutable
+
+    def model_train_fn(self, features, labels, inference_outputs, mode):
+        from tensor2robot_tpu.meta_learning import meta_tfdata
+
+        selected_labels = select_mode(
+            labels.val_mode,
+            {"a_target": labels["train/a_target"]},
+            {"a_target": labels["val/a_target"]},
+        )
+        flat_outputs = meta_tfdata.flatten_batch_examples(inference_outputs)
+        flat_labels = meta_tfdata.flatten_batch_examples(selected_labels)
+        return self._base_model.model_train_fn(
+            None, flat_labels, flat_outputs, mode
+        )
+
+
+class TestMetalearningModel:
+    def test_spec_surface_and_preprocessor(self):
+        model = _RL2Mock(MockT2RModel(), 4, 4)
+        fspec = model.get_feature_specification(TRAIN)
+        assert "train/x" in fspec and "val/x" in fspec
+        pre = model.preprocessor
+        assert isinstance(pre, MetaPreprocessor)
+        assert pre.base_preprocessor is not None
+
+    def test_end_to_end_loss_and_grads(self):
+        model = _RL2Mock(MockT2RModel(use_batch_norm=False), 4, 4)
+        features, labels = _meta_batch(model, num_tasks=3, n_train=4, n_val=4)
+        features = TensorSpecStruct(dict(features.items()))
+        variables = model.init_variables(
+            jax.random.PRNGKey(0), features, TRAIN
+        )
+
+        def loss_fn(params):
+            v = dict(variables)
+            v["params"] = params
+            outputs, _ = model.inference_network_fn(
+                v, features, TRAIN, rng=jax.random.PRNGKey(1)
+            )
+            loss, _ = model.model_train_fn(
+                features, labels, outputs, TRAIN
+            )
+            return loss
+
+        loss, grads = jax.value_and_grad(loss_fn)(variables["params"])
+        assert np.isfinite(float(loss))
+        gnorm = sum(
+            float(jnp.sum(g**2))
+            for g in jax.tree_util.tree_leaves(grads)
+        )
+        assert gnorm > 0
+
+    def test_flatten_and_add_meta_dim(self):
+        model = _RL2Mock(MockT2RModel(), 2, 2)
+        train = {"x": np.zeros((2, 3), np.float32)}
+        val = {"x": np.ones((2, 3), np.float32)}
+        flat = model.flatten_and_add_meta_dim(
+            train, val, np.zeros((1,), bool)
+        )
+        assert flat["train/x"].shape == (1, 2, 3)
+        assert flat["val/x"].shape == (1, 2, 3)
