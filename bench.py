@@ -1425,7 +1425,13 @@ def main() -> None:
         # tunnel); on CPU, XLA runs while-loop bodies single-threaded, so
         # the scanned step is ~n_cores slower than the standalone step and
         # the comparison is meaningless.
-        if scan_k > 1 and on_tpu:
+        # BENCH_SKIP_SCAN=1 drops this optional leg. CAUTION: the headline
+        # value is max(per-step, scan), so skipping scan makes the value
+        # regime-inconsistent with full runs — only use it for artifacts
+        # that are never compared on absolute value (the chain keeps scan
+        # everywhere for exactly this reason).
+        skip_scan = os.environ.get("BENCH_SKIP_SCAN") == "1"
+        if scan_k > 1 and on_tpu and not skip_scan:
             try:
                 from tensor2robot_tpu.train import infeed
 
@@ -1462,7 +1468,8 @@ def main() -> None:
         # is the overlap efficiency — 1.0 means host->device transfer
         # fully hides behind compute.
         infeed_steps_per_sec = 0.0
-        try:
+
+        def _run_infeed_leg():
             import itertools
 
             from tensor2robot_tpu.train import infeed as infeed_lib
@@ -1486,11 +1493,23 @@ def main() -> None:
 
             run_infeed_window()  # transfer-path warm-in, untimed
             sync()
-            infeed_steps_per_sec, _, _ = _measure_windows(
+            rate, _, _ = _measure_windows(
                 run_infeed_window, sync, max(3, n_windows // 2), window
             )
-        except Exception as infeed_err:  # noqa: BLE001 — optional leg
-            print(f"bench: infeed leg failed: {infeed_err}", file=sys.stderr)
+            return rate
+
+        # BENCH_SKIP_INFEED=1 drops this optional leg (A/B chain legs only
+        # need the per-step headline; saves chip time per run). The
+        # payload marks the skip so a zero rate can never be misread as
+        # an overlap collapse or a swallowed failure.
+        skip_infeed = os.environ.get("BENCH_SKIP_INFEED") == "1"
+        if not skip_infeed:
+            try:
+                infeed_steps_per_sec = _run_infeed_leg()
+            except Exception as infeed_err:  # noqa: BLE001 — optional leg
+                print(
+                    f"bench: infeed leg failed: {infeed_err}", file=sys.stderr
+                )
 
         ceiling = {}
         if on_tpu:
@@ -1530,6 +1549,8 @@ def main() -> None:
                     ),
                     "scan_dispatch_steps_per_sec": round(scan_steps_per_sec, 3),
                     "infeed_steps_per_sec": round(infeed_steps_per_sec, 3),
+                    **({"infeed_leg": "skipped"} if skip_infeed else {}),
+                    **({"scan_leg": "skipped"} if skip_scan else {}),
                     **_overlap_fields(infeed_steps_per_sec, steps_per_sec),
                     **ceiling,
                     **(

@@ -164,11 +164,11 @@ for i in $(seq 1 "$tries"); do
   # 2/3. End-to-end A/Bs of the two levers against the new headline.
   run_leg BENCH_r05_s2d.json '"stem_s2d": true' \
     "Round-5 A/B: space-to-depth stem lowering on the headline workload" \
-    BENCH_BACKEND_WAIT=240 T2R_STEM_S2D=1 -- python bench.py
+    BENCH_BACKEND_WAIT=240 BENCH_SKIP_INFEED=1 T2R_STEM_S2D=1 -- python bench.py
 
   run_leg BENCH_r05_poolfree.json '"pool_backward": "scatterfree"' \
     "Round-5 A/B: scatter-free pool twin of the post-fix headline" \
-    BENCH_BACKEND_WAIT=240 T2R_POOL_BACKWARD=scatterfree -- python bench.py
+    BENCH_BACKEND_WAIT=240 BENCH_SKIP_INFEED=1 T2R_POOL_BACKWARD=scatterfree -- python bench.py
 
   # 3b/3c. The width-aligned twin under the new levers: c128 + native
   # pool (BENCH_r05_c128.json was captured with the old scatter-free
@@ -176,11 +176,11 @@ for i in $(seq 1 "$tries"); do
   # configuration. Either may cross 50% MFU ABSOLUTE.
   run_leg BENCH_r05_c128_v2.json '_c128"' \
     "Round-5 c128 twin re-measure with the TPU-native pool backward" \
-    BENCH_BACKEND_WAIT=240 BENCH_WIDTH=128 -- python bench.py
+    BENCH_BACKEND_WAIT=240 BENCH_SKIP_INFEED=1 BENCH_WIDTH=128 -- python bench.py
 
   run_leg BENCH_r05_c128_s2d.json '"stem_s2d": true' \
     "Round-5 best-known config: c128 + native pool + s2d stem" \
-    BENCH_BACKEND_WAIT=240 BENCH_WIDTH=128 T2R_STEM_S2D=1 -- python bench.py
+    BENCH_BACKEND_WAIT=240 BENCH_SKIP_INFEED=1 BENCH_WIDTH=128 T2R_STEM_S2D=1 -- python bench.py
 
   # 4. Diagnosis v2: readback-floor-corrected efficiencies + s2d cases.
   run_leg DIAG_STEP_r05b.json '"ok": true' \
@@ -199,11 +199,11 @@ for i in $(seq 1 "$tries"); do
   # 7/8. Batch-scaling legs of the ceiling model.
   run_leg BENCH_r05_bs128.json 'mfu_bs128_472px"' \
     "Round-5 batch-128 MFU leg" \
-    BENCH_BACKEND_WAIT=240 BENCH_BATCH=128 -- python bench.py
+    BENCH_BACKEND_WAIT=240 BENCH_SKIP_INFEED=1 BENCH_BATCH=128 -- python bench.py
 
   run_leg BENCH_r05_bs128_remat.json 'mfu_bs128_472px_remat"' \
     "Round-5 batch-128 remat MFU leg" \
-    BENCH_BACKEND_WAIT=240 BENCH_BATCH=128 BENCH_REMAT=1 -- python bench.py
+    BENCH_BACKEND_WAIT=240 BENCH_SKIP_INFEED=1 BENCH_BATCH=128 BENCH_REMAT=1 -- python bench.py
 
   # 9. Real-MXU bf16 AUC budget (VERDICT r4 missing #3). Wedged at ~25
   # min in the first chain when the tunnel died mid-run; retried here.
@@ -229,12 +229,12 @@ for i in $(seq 1 "$tries"); do
   # 13. Fused-stats A/B (stretch evidence).
   run_leg BENCH_r05_nofusestats.json '_nofusestats"' \
     "Round-5 A/B: per-leaf batch-stats twin of the headline" \
-    BENCH_BACKEND_WAIT=240 BENCH_FUSE_STATS=0 -- python bench.py || true
+    BENCH_BACKEND_WAIT=240 BENCH_SKIP_INFEED=1 BENCH_FUSE_STATS=0 -- python bench.py || true
 
   # Stretch: batch-256 remat (not in all_done).
   run_leg BENCH_r05_bs256_remat.json 'mfu_bs256_472px_remat"' \
     "Round-5 batch-256 remat MFU leg" \
-    BENCH_BACKEND_WAIT=240 BENCH_BATCH=256 BENCH_REMAT=1 -- python bench.py || true
+    BENCH_BACKEND_WAIT=240 BENCH_SKIP_INFEED=1 BENCH_BATCH=256 BENCH_REMAT=1 -- python bench.py || true
 
   if all_done; then log "chain complete"; exit 0; fi
   log "chain pass $i incomplete; waiting for tunnel"
