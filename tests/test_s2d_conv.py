@@ -121,3 +121,45 @@ class TestGrasping44Wiring:
         np.testing.assert_allclose(
             np.asarray(out_s2d), np.asarray(out_plain), rtol=1e-4, atol=1e-4
         )
+
+
+class TestStructural:
+    # Note: match the HLO op-call form ("gather(") — the plain word also
+    # appears in stack-frame METADATA whenever any enclosing Python
+    # function name contains it.
+
+    def test_fwd_lowering_is_one_conv_no_indexed_ops(self):
+        """The fold must stay reshape/transpose + ONE convolution: a
+        gather or scatter in the lowered module would defeat the MXU
+        purpose of the transform."""
+        s2d = SpaceToDepthConv(32, (6, 6), strides=(2, 2))
+        x = jnp.zeros((2, 96, 96, 3))
+        v = s2d.init(jax.random.PRNGKey(0), x)
+        txt = (
+            jax.jit(lambda v, x: s2d.apply(v, x))
+            .lower(v, x)
+            .compile()
+            .as_text()
+        )
+        assert txt.count(" convolution(") == 1
+        assert " gather(" not in txt
+        assert " scatter(" not in txt
+        assert "select-and-scatter" not in txt
+
+    def test_bwd_lowering_has_no_indexed_ops(self):
+        s2d = SpaceToDepthConv(16, (6, 6), strides=(2, 2))
+        x = jnp.zeros((2, 48, 48, 3))
+        v = s2d.init(jax.random.PRNGKey(0), x)
+        txt = (
+            jax.jit(
+                jax.grad(
+                    lambda v, x: jnp.sum(s2d.apply(v, x) ** 2), argnums=(0, 1)
+                )
+            )
+            .lower(v, x)
+            .compile()
+            .as_text()
+        )
+        assert " gather(" not in txt
+        assert " scatter(" not in txt
+        assert "select-and-scatter" not in txt
