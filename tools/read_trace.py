@@ -26,34 +26,68 @@ def find_xplanes(root: str) -> list[str]:
     )
 
 
+def _opcode_match(name: str):
+    """Matches the HLO opcode in a full op string.
+
+    Op text is `%opname = <type> opcode(operands)`; matching the whole
+    string misattributes ops whose OPERANDS mention e.g. %copy-done (the
+    round-5 summary billed fusion compute to 'copy' this way). The opcode
+    is the identifier between the result type's closing bracket and the
+    first argument paren. Returns the re.Match or None."""
+    import re
+
+    return re.search(r"[\]\})]\s*([a-z][a-z0-9\-_]*)\(", name)
+
+
 def categorize(name: str) -> str:
     """Rough XLA-op categories for per-step attribution. `module` rows are
     whole-executable spans (jit_train_step etc.); numeric names are the
     per-core step rows xplane emits; both excluded from category totals to
-    avoid double counting."""
+    avoid double counting.
+
+    Full HLO op text is categorized by OPCODE + op NAME only — never by
+    the operand list (a fusion consuming a %copy-done operand is compute,
+    not copy; the round-5 summary misbilled ~40 ms/step this way)."""
     import re
 
     if name.startswith("jit_") or re.fullmatch(r"\d+", name):
         return "module"
-    if "gather" in name or ("fusion" in name and "s32[" in name):
-        return "gather"
-    if "convolution" in name:
-        return "conv"
-    if "copy" in name:
-        return "copy"
-    if "select-and-scatter" in name:
-        return "pool_bwd"
-    if "reduce-window" in name:
-        return "pool"
-    if "all-reduce" in name or "all-gather" in name or "collective" in name:
+    if " = " in name:
+        opname, rest = name.split(" = ", 1)
+        m = _opcode_match(name)
+        if m:
+            key = f"{opname} {m.group(1)}"
+            result_type = name[len(opname) + 3 : m.start(1)]
+            operands = name[m.end(1) :]
+        else:
+            key, result_type, operands = name, rest, rest
+    else:
+        key, result_type, operands = name, "", ""
+    # Collectives before the gather check: 'all-gather' contains 'gather'.
+    if "all-reduce" in key or "all-gather" in key or "collective" in key:
         return "collective"
-    if "dot" in name:
+    # Gather-ish: gather opcode/name, or a fusion whose result or operand
+    # types carry s32 indices (embedding-style gathers return f32 but
+    # consume s32 index operands).
+    if "gather" in key or (
+        "fusion" in key and ("s32[" in result_type or "s32[" in operands)
+    ):
+        return "gather"
+    if "convolution" in key:
+        return "conv"
+    if "copy" in key:
+        return "copy"
+    if "select-and-scatter" in key:
+        return "pool_bwd"
+    if "reduce-window" in key:
+        return "pool"
+    if "dot" in key:
         return "dot"
-    if "reduce" in name:
+    if "reduce" in key:
         return "reduce"
-    if "fusion" in name:
+    if "fusion" in key:
         return "fusion"
-    if "slice" in name or "dynamic-update" in name:
+    if "slice" in key or "dynamic-update" in key:
         return "slice"
     return "other"
 
@@ -71,8 +105,15 @@ def summarize(path: str, top_n: int = 30) -> dict:
             continue
         per_op = collections.Counter()
         counts = collections.Counter()
+        sync_ops = collections.Counter()
+        sync_counts = collections.Counter()
         total_ns = 0
         for line in plane.lines:
+            # The synchronous per-op line is where the step time actually
+            # goes; async lines (copy-start DMAs) overlap massively and
+            # dominate raw totals misleadingly (round-5 lesson: 7.7 s of
+            # async copy spans inside a 0.8 s step window).
+            is_sync = line.name == "XLA Ops"
             # XLA op lines carry one event per executed op instance.
             for ev in line.events:
                 dur = ev.duration_ns
@@ -80,12 +121,17 @@ def summarize(path: str, top_n: int = 30) -> dict:
                 per_op[name] += dur
                 counts[name] += 1
                 total_ns += dur
+                if is_sync:
+                    sync_ops[name] += dur
+                    sync_counts[name] += 1
         if not per_op:
             continue
         cand = {
             "plane": plane.name,
             "per_op": per_op,
             "counts": counts,
+            "sync_ops": sync_ops,
+            "sync_counts": sync_counts,
             "total_ns": total_ns,
         }
         is_device = "TPU" in plane.name or "/device:" in plane.name
@@ -97,14 +143,17 @@ def summarize(path: str, top_n: int = 30) -> dict:
     best = device_best or any_best
     if best is None:
         return {"planes": planes, "error": "no plane with events"}
-    top = [
-        {
-            "name": name[:160],
-            "total_ms": round(ns / 1e6, 3),
-            "count": best["counts"][name],
-        }
-        for name, ns in best["per_op"].most_common(top_n)
-    ]
+    def top_list(per_op, counts):
+        return [
+            {
+                "name": name[:160],
+                "total_ms": round(ns / 1e6, 3),
+                "count": counts[name],
+            }
+            for name, ns in per_op.most_common(top_n)
+        ]
+
+    top = top_list(best["per_op"], best["counts"])
     # Per-step category attribution: module spans named `jit_<fn>` carry
     # an execution count; divide each category's total by the step count
     # of the busiest module to get ms/step.
@@ -127,6 +176,25 @@ def summarize(path: str, top_n: int = 30) -> dict:
         "category_ms": categories,
         "top_ops": top,
     }
+    if best.get("sync_ops"):
+        result["top_sync_ops"] = top_list(
+            best["sync_ops"], best["sync_counts"]
+        )
+        result["total_sync_ms"] = round(
+            sum(best["sync_ops"].values()) / 1e6, 3
+        )
+        sync_by_cat = collections.Counter()
+        for name, ns in best["sync_ops"].items():
+            sync_by_cat[categorize(name)] += ns
+        result["category_ms_sync"] = {
+            cat: round(ns / 1e6, 3) for cat, ns in sync_by_cat.most_common()
+        }
+        if steps:
+            result["category_ms_per_step_sync"] = {
+                cat: round(ns / 1e6 / steps, 3)
+                for cat, ns in sync_by_cat.most_common()
+                if cat != "module"
+            }
     if steps:
         result["step_module"] = step_module[:80]
         result["step_count"] = steps
