@@ -291,6 +291,40 @@ def main() -> None:
     t = timed(jax.jit(jax.grad(bnfix_loss)), (pbnf, x79))
     record("conv5x5_block6_bnfix_fwd_bwd", t, flops=3.0 * flops_blk)
 
+    # BN-stats A/B: the r05 sync-op profile bills ~18 ms/step to reduce
+    # fusions (BN mean/var at 64 channels = half-empty 128-lane tiles).
+    # Candidate fix: put the reduction on the MXU as a ones-row matmul
+    # (bf16 inputs accumulate f32 on TPU). Three cases: the vector
+    # reduce at c64, the dot form at c64, and the vector reduce at c128
+    # (isolates the tile-occupancy effect on the reduce itself).
+    x79s = jax.random.normal(key, (B, 79, 79, 64), jnp.bfloat16)
+
+    def stats_reduce(x):
+        xf = x.astype(jnp.float32)
+        m = jnp.mean(xf, axis=(0, 1, 2))
+        v = jnp.mean(xf * xf, axis=(0, 1, 2)) - m * m
+        return m, v
+
+    t = timed(jax.jit(stats_reduce), (x79s,))
+    record("bn_stats_reduce_c64", t)
+
+    def stats_dot(x):
+        n = x.shape[0] * x.shape[1] * x.shape[2]
+        xf = x.reshape(n, x.shape[3])
+        ones = jnp.ones((8, n), jnp.bfloat16)  # 8 rows fill the sublanes
+        s = (ones @ xf)[0].astype(jnp.float32) / n
+        s2 = (ones @ (xf * xf))[0].astype(jnp.float32) / n
+        return s, s2 - s * s
+
+    t = timed(jax.jit(stats_dot), (x79s,))
+    record("bn_stats_dot_c64", t)
+
+    t = timed(
+        jax.jit(stats_reduce),
+        (jax.random.normal(key, (B, 79, 79, 128), jnp.bfloat16),),
+    )
+    record("bn_stats_reduce_c128", t)
+
     # Stem-pool backward A/B: scatter-free custom VJP vs XLA
     # SelectAndScatter, at the stem activation size.
     from tensor2robot_tpu.ops.pooling import max_pool_nonoverlap
