@@ -21,17 +21,21 @@ from __future__ import annotations
 
 import concurrent.futures
 import itertools
+import logging
 import os
 import queue
 import random
 import threading
-from typing import Callable, Dict, Iterator, List, Mapping, Optional, Sequence, Union
+from typing import Any, Callable, Dict, Iterator, List, Mapping, Optional, Sequence, Union
 
 import numpy as np
 
 from tensor2robot_tpu.data import tfrecord
 from tensor2robot_tpu.data.parser import SpecParser
+from tensor2robot_tpu.data.wire import FastSpecParser
 from tensor2robot_tpu.specs import TensorSpecStruct
+
+_log = logging.getLogger(__name__)
 
 
 def _interleave_files(
@@ -176,35 +180,286 @@ def default_parse_backend() -> str:
     return backend
 
 
-# Per-process parser for the process-pool backend (set by the pool
+def default_parse_fast() -> bool:
+    """Whether the wire-format fast parser (data/wire.py) is the default.
+
+    T2R_PARSE_FAST=0 disables it (the SpecParser oracle then runs every
+    batch). The fast path self-disables per dataset on unsupported specs
+    and falls back per batch on any parse failure, so enabling it is
+    always semantics-preserving.
+    """
+    env = os.environ.get("T2R_PARSE_FAST", "1")
+    if env not in ("0", "1"):
+        raise ValueError(f"T2R_PARSE_FAST must be '0' or '1', got {env!r}")
+    return env == "1"
+
+
+def default_parse_shm() -> bool:
+    """Whether the process backend returns batches via shared memory.
+
+    T2R_PARSE_SHM=0 reverts to pickling parsed batches through the result
+    pipe (the decoded uint8 image batch — ~60 MB at batch 64 for the
+    QT-Opt spec — then pays serialize + pipe-write + deserialize)."""
+    env = os.environ.get("T2R_PARSE_SHM", "1")
+    if env not in ("0", "1"):
+        raise ValueError(f"T2R_PARSE_SHM must be '0' or '1', got {env!r}")
+    return env == "1"
+
+
+class _FastParseState:
+    """A FastSpecParser plus its fallback accounting (shared thread/process).
+
+    After `max_fallbacks` failed batches the fast path is switched off for
+    the owning dataset/worker: persistent fallback means the data disagrees
+    with the compiled schema and re-parsing every batch twice helps nobody.
+    """
+
+    max_fallbacks = 8
+
+    def __init__(self, specs, enabled: bool):
+        self.parser: Optional[FastSpecParser] = None
+        if enabled:
+            fast = FastSpecParser(specs)
+            if fast.supported:
+                self.parser = fast
+            else:
+                _log.info(
+                    "fast parser disabled for this spec structure: %s",
+                    fast.unsupported_reason,
+                )
+
+    def note_fallback(self) -> None:
+        parser = self.parser
+        if parser is None:
+            return
+        parser.fallbacks += 1
+        if parser.fallbacks == 1:
+            _log.warning(
+                "fast parse failed for a batch; re-parsing with SpecParser"
+            )
+        if parser.fallbacks >= self.max_fallbacks:
+            _log.warning(
+                "fast parser disabled after %d fallbacks", parser.fallbacks
+            )
+            self.parser = None
+
+
+# Per-process parse state for the process-pool backend (set by the pool
 # initializer in each worker; module-level so submitted jobs can reach it
 # without pickling the parser per chunk).
 _PROCESS_PARSER: Optional[SpecParser] = None
+_PROCESS_FAST: Optional[_FastParseState] = None
+_PROCESS_SHM_FREE = None  # free-slot name queue, or None (inline returns)
+_PROCESS_SHM_CACHE: Dict[str, Any] = {}  # name -> attached SharedMemory
+
+# Arrays below this size ride the result pipe; shm slots are for the big
+# decoded image batches where pickling is the dominant IPC cost.
+_SHM_MIN_SHIP_BYTES = 1 << 20
+_SHM_ALIGN = 64
 
 
-def _process_pool_init(specs_blob: bytes) -> None:
+def _process_pool_init(
+    specs_blob: bytes, parse_fast: bool, shm_free, decode_cache_mb: int
+) -> None:
     import pickle
 
-    global _PROCESS_PARSER
-    _PROCESS_PARSER = SpecParser(pickle.loads(specs_blob))
+    global _PROCESS_PARSER, _PROCESS_FAST, _PROCESS_SHM_FREE
+    specs = pickle.loads(specs_blob)
+    _PROCESS_PARSER = SpecParser(specs)
+    _PROCESS_FAST = _FastParseState(specs, parse_fast)
+    _PROCESS_SHM_FREE = shm_free
+    # The decode cache is per-process: give each worker its share of the
+    # configured budget rather than the full budget times the worker
+    # count (records land on arbitrary workers, so per-worker hit rates
+    # are diluted anyway — the budget must not multiply).
+    os.environ["T2R_DECODE_CACHE_MB"] = str(decode_cache_mb)
+
+
+def _regroup_chunk(chunk):
+    """Multi-dataset chunks arrive as per-record dicts; both parsers want
+    {dataset_key: [record, ...]} columns."""
+    if isinstance(chunk[0], dict):
+        return {k: [row[k] for row in chunk] for k in chunk[0].keys()}
+    return chunk
 
 
 def _parse_with(parser: SpecParser, chunk) -> TensorSpecStruct:
     """Parses one chunk (multi-dataset rows regrouped by key) — the single
     implementation both the thread and process backends run."""
-    if isinstance(chunk[0], dict):
-        by_key = {k: [row[k] for row in chunk] for k in chunk[0].keys()}
-        return parser.parse_batch(by_key)
-    return parser.parse_batch(chunk)
+    return parser.parse_batch(_regroup_chunk(chunk))
+
+
+def _parse_chunk_impl(
+    fast_state: Optional[_FastParseState], parser: SpecParser, chunk
+) -> TensorSpecStruct:
+    """Fast wire-format parse with automatic SpecParser fallback.
+
+    Any fast-path failure re-parses the batch with the oracle: genuinely
+    bad data then raises the canonical error; a fast-path limitation
+    degrades to slow-but-correct. test_fast_parser.py pins the parity."""
+    fast = fast_state.parser if fast_state is not None else None
+    if fast is not None:
+        try:
+            return fast.parse_batch(_regroup_chunk(chunk))
+        except Exception:
+            fast_state.note_fallback()
+    return _parse_with(parser, chunk)
+
+
+def _shm_attach(name: str):
+    shm = _PROCESS_SHM_CACHE.get(name)
+    if shm is None:
+        from multiprocessing import shared_memory
+
+        shm = shared_memory.SharedMemory(name=name)
+        _PROCESS_SHM_CACHE[name] = shm
+    return shm
+
+
+def _shm_align(nbytes: int) -> int:
+    return (nbytes + _SHM_ALIGN - 1) // _SHM_ALIGN * _SHM_ALIGN
 
 
 def _process_parse_chunk(chunk):
+    """Worker-side parse + zero-copy return.
+
+    Large arrays (decoded image batches) are written into a shared-memory
+    ring slot and returned as (dtype, shape, offset) descriptors; only
+    small arrays ride the pickle pipe. When no slot frees up in time (the
+    consumer is holding every in-flight batch) the whole batch falls back
+    to the inline pickle path — slower, never stuck.
+    """
     parser = _PROCESS_PARSER
     if parser is None:  # pragma: no cover - initializer always runs first
         raise RuntimeError("process pool worker missing parser init")
-    # Ship a plain dict of arrays; the parent rebuilds the struct (cheap)
+    # Ship plain (key, value) pairs; the parent rebuilds the struct (cheap)
     # rather than relying on TensorSpecStruct pickling across versions.
-    return dict(_parse_with(parser, chunk).items())
+    flat = list(_parse_chunk_impl(_PROCESS_FAST, parser, chunk).items())
+    free_queue = _PROCESS_SHM_FREE
+    if free_queue is None:
+        return ("inline", flat)
+    large = [(k, v) for k, v in flat if v.nbytes >= _SHM_MIN_SHIP_BYTES]
+    if not large:
+        return ("inline", flat)
+    need = sum(_shm_align(v.nbytes) for _, v in large)
+    try:
+        # Non-blocking: before the parent seeds the ring (it sizes slots
+        # from the first inline batch) the queue is empty and chunks must
+        # not stall; after seeding, ring capacity exceeds max in-flight
+        # so a slot is normally free the moment a worker wants one.
+        name = free_queue.get_nowait()
+    except queue.Empty:
+        return ("inline", flat)
+    shm = _shm_attach(name)
+    if need > shm.size:
+        free_queue.put(name)
+        return ("inline", flat)
+    entries = []
+    offset = 0
+    for key, value in flat:
+        if value.nbytes < _SHM_MIN_SHIP_BYTES:
+            entries.append((key, None, value))
+            continue
+        view = np.frombuffer(
+            shm.buf, dtype=value.dtype, count=value.size, offset=offset
+        ).reshape(value.shape)
+        np.copyto(view, value)
+        del view
+        entries.append((key, (value.dtype, value.shape, offset), None))
+        offset += _shm_align(value.nbytes)
+    return ("shm", name, entries)
+
+
+class _ShmSlotToken:
+    """Returns a ring slot to the free queue when the last view of the
+    batch it carries is garbage-collected."""
+
+    __slots__ = ("_ring", "_name")
+
+    def __init__(self, ring: "_ShmBatchRing", name: str):
+        self._ring = ring
+        self._name = name
+
+    def __del__(self):
+        try:
+            self._ring.release(self._name)
+        except Exception:
+            pass
+
+
+class _ShmArray(np.ndarray):
+    """ndarray view into a shm ring slot; keeps the slot's release token
+    alive for as long as the array (or any derived view) exists."""
+
+    _t2r_token: Optional[_ShmSlotToken] = None
+
+
+class _ShmBatchRing:
+    """Fixed set of shared-memory slots cycling worker -> consumer.
+
+    The parent creates the slots and seeds the workers' free queue (the
+    SAME queue the pool initializer handed to every worker — release()
+    must feed the queue workers actually read); a worker takes a name,
+    writes one parsed batch, and returns the name in its result; the
+    parent wraps the slot in numpy views whose token releases the name
+    back to the queue once the consumer drops the batch. Capacity is
+    in-flight-bounded, so a consumer that retains batches only degrades
+    workers to the inline path (get_nowait misses), never blocks the
+    pipeline.
+    """
+
+    def __init__(self, free_queue, slot_bytes: int, num_slots: int):
+        from multiprocessing import shared_memory
+
+        self.slot_bytes = slot_bytes
+        self.slots: Dict[str, Any] = {}
+        self.free_queue = free_queue
+        # Create ALL slots before publishing any name: a mid-loop failure
+        # (small /dev/shm) must not leave workers holding slot names the
+        # parent never registered — the caller catches the error and the
+        # pipeline degrades to inline returns, with nothing leaked.
+        created: List[Any] = []
+        try:
+            for _ in range(num_slots):
+                created.append(
+                    shared_memory.SharedMemory(create=True, size=slot_bytes)
+                )
+        except Exception:
+            for shm in created:
+                try:
+                    shm.close()
+                    shm.unlink()
+                except Exception:
+                    pass
+            raise
+        for shm in created:
+            self.slots[shm.name] = shm
+            self.free_queue.put(shm.name)
+        self._closed = False
+        self._zombies: List[Any] = []
+
+    def release(self, name: str) -> None:
+        if not self._closed:
+            try:
+                self.free_queue.put_nowait(name)
+            except Exception:
+                pass
+
+    def close(self) -> None:
+        self._closed = True
+        for shm in self.slots.values():
+            try:
+                shm.unlink()
+            except FileNotFoundError:
+                pass
+            try:
+                shm.close()
+            except BufferError:
+                # A consumer still holds views into this slot; the mapping
+                # frees when they die. Keep the object so its __del__ does
+                # not spam at arbitrary gc time.
+                self._zombies.append(shm)
+        self.slots = {}
 
 
 class _ParallelBatcher:
@@ -230,6 +485,7 @@ class _ParallelBatcher:
         num_workers: int,
         max_in_flight: Optional[int] = None,
         pool: Optional[concurrent.futures.Executor] = None,
+        on_discard: Optional[Callable] = None,
     ):
         self._chunks = chunks
         self._parse_fn = parse_fn
@@ -240,6 +496,10 @@ class _ParallelBatcher:
         self._in_flight: "queue.Queue" = queue.Queue()
         self._max_in_flight = max_in_flight or num_workers + 2
         self._exhausted = False
+        # Called with each completed-but-unconsumed result when iteration
+        # is abandoned (consumer breaks early): results may carry
+        # resources (shm ring slot names) that must be returned.
+        self._on_discard = on_discard
 
     def _submit_one(self) -> bool:
         try:
@@ -265,8 +525,19 @@ class _ParallelBatcher:
             else:
                 # External pool (reused across epochs): cancel what we
                 # queued but leave the executor alive for the next epoch.
+                # Futures past cancellation (running or done) are drained
+                # so their results' resources (shm slots) are released
+                # instead of leaking with the discarded future.
                 while not self._in_flight.empty():
-                    self._in_flight.get().cancel()
+                    future = self._in_flight.get()
+                    if future.cancel():
+                        continue
+                    try:
+                        result = future.result()
+                    except Exception:
+                        continue
+                    if self._on_discard is not None:
+                        self._on_discard(result)
 
 
 class RecordDataset:
@@ -289,7 +560,12 @@ class RecordDataset:
         jpeg decode; None -> default_parse_workers(), 0 -> synchronous.
       parse_backend: 'thread' (default) or 'process'
         (see default_parse_backend; env T2R_PARSE_BACKEND). The process
-        backend removes the GIL ceiling on many-core hosts.
+        backend removes the GIL ceiling on many-core hosts; parsed image
+        batches return through a shared-memory ring (T2R_PARSE_SHM=0
+        reverts to pickling them through the result pipe).
+      parse_fast: use the wire-format fast parser (data/wire.py) with
+        automatic SpecParser fallback; None -> default_parse_fast()
+        (env T2R_PARSE_FAST, default on).
       shard_by_host: in multi-host runs, each process reads only its
         round-robin slice of the file list (the reference's per-host
         infeed, utils/tfdata.py:38-61); batch_size is then the PER-HOST
@@ -311,6 +587,7 @@ class RecordDataset:
         file_fraction: float = 1.0,
         num_parse_workers: Optional[int] = None,
         parse_backend: Optional[str] = None,
+        parse_fast: Optional[bool] = None,
         shard_by_host: bool = False,
     ):
         self._specs = specs
@@ -324,6 +601,13 @@ class RecordDataset:
                 f"{self._parse_backend!r}"
             )
         self._parser = SpecParser(specs)
+        self._parse_fast = (
+            default_parse_fast() if parse_fast is None else parse_fast
+        )
+        self._fast_state = _FastParseState(specs, self._parse_fast)
+        self._shm_ring: Optional[_ShmBatchRing] = None
+        self._shm_free_queue = None
+        self._mp_context = None
         self._batch_size = batch_size
         self._train = mode == "train"
         self._shuffle_buffer_size = shuffle_buffer_size if self._train else 0
@@ -430,12 +714,75 @@ class RecordDataset:
             yield chunk
 
     def _parse_chunk(self, chunk) -> TensorSpecStruct:
-        return _parse_with(self._parser, chunk)
+        return _parse_chunk_impl(self._fast_state, self._parser, chunk)
 
-    def _rebuild_struct(self, flat: Mapping[str, np.ndarray]) -> TensorSpecStruct:
+    def _max_in_flight(self) -> int:
+        return self._num_parse_workers + max(self._prefetch_depth, 1)
+
+    def _maybe_seed_ring(self, entries) -> None:
+        """Creates the shm ring the first time a (large) batch comes back
+        inline: slot size must fit a real parsed batch, which is only
+        known once one exists (sequence batches size to the batch max)."""
+        if self._shm_ring is not None or self._shm_free_queue is None:
+            return
+        need = sum(
+            _shm_align(v.nbytes)
+            for _, desc, v in entries
+            if v is not None and v.nbytes >= _SHM_MIN_SHIP_BYTES
+        )
+        if need == 0:
+            return
+        slot_bytes = need + need // 2 + (1 << 20)
+        try:
+            self._shm_ring = _ShmBatchRing(
+                self._shm_free_queue, slot_bytes, self._max_in_flight() + 2
+            )
+        except OSError as err:
+            _log.warning("shm ring unavailable (%s); using inline returns", err)
+            self._shm_free_queue = None
+
+    def _discard_worker_payload(self, payload) -> None:
+        """Returns the ring slot of a parsed-but-never-consumed batch
+        (consumer abandoned the iterator mid-epoch)."""
+        if (
+            isinstance(payload, tuple)
+            and payload
+            and payload[0] == "shm"
+            and self._shm_ring is not None
+        ):
+            self._shm_ring.release(payload[1])
+
+    def _rebuild_struct(self, payload) -> TensorSpecStruct:
+        """Parent-side batch reassembly for both process-return forms."""
         out = TensorSpecStruct()
-        for key, value in flat.items():
-            out[key] = value
+        if payload[0] == "inline":
+            for key, value in payload[1]:
+                out[key] = value
+            self._maybe_seed_ring(
+                [(key, None, value) for key, value in payload[1]]
+            )
+            return out
+        _, name, entries = payload
+        ring = self._shm_ring
+        if ring is None or name not in ring.slots:
+            raise RuntimeError(f"worker returned unknown shm slot {name!r}")
+        shm = ring.slots[name]
+        token = _ShmSlotToken(ring, name)
+        for key, desc, value in entries:
+            if desc is None:
+                out[key] = value
+                continue
+            dtype, shape, offset = desc
+            count = 1
+            for dim in shape:
+                count *= dim
+            view = (
+                np.frombuffer(shm.buf, dtype=dtype, count=count, offset=offset)
+                .reshape(shape)
+                .view(_ShmArray)
+            )
+            view._t2r_token = token
+            out[key] = view
         return out
 
     def _get_process_pool(self) -> concurrent.futures.Executor:
@@ -449,19 +796,43 @@ class RecordDataset:
             # Spawn, not fork: the parent typically holds an initialized
             # XLA backend whose internal threads/locks do not survive a
             # fork (deadlock risk).
+            self._mp_context = multiprocessing.get_context("spawn")
+            if default_parse_shm():
+                # The free-slot queue exists up front (workers learn it at
+                # init); the slots themselves are seeded after the first
+                # batch returns and sizes are known (_maybe_seed_ring).
+                self._shm_free_queue = self._mp_context.Queue()
+            from tensor2robot_tpu.data.wire import default_decode_cache_mb
+
             self._process_pool = concurrent.futures.ProcessPoolExecutor(
                 max_workers=self._num_parse_workers,
-                mp_context=multiprocessing.get_context("spawn"),
+                mp_context=self._mp_context,
                 initializer=_process_pool_init,
-                initargs=(pickle.dumps(self._specs),),
+                initargs=(
+                    pickle.dumps(self._specs),
+                    self._parse_fast,
+                    self._shm_free_queue,
+                    default_decode_cache_mb()
+                    // max(self._num_parse_workers, 1),
+                ),
             )
         return self._process_pool
 
     def close(self) -> None:
-        """Shuts down the cached process pool (no-op for thread backend)."""
+        """Shuts down the cached process pool and shm ring (no-op for the
+        thread backend)."""
         if self._process_pool is not None:
             self._process_pool.shutdown(wait=False, cancel_futures=True)
             self._process_pool = None
+        if self._shm_ring is not None:
+            self._shm_ring.close()
+            self._shm_ring = None
+        if self._shm_free_queue is not None:
+            try:
+                self._shm_free_queue.close()
+            except Exception:
+                pass
+            self._shm_free_queue = None
 
     def __del__(self):  # best-effort; close() is the explicit path
         try:
@@ -477,9 +848,9 @@ class RecordDataset:
                     self._chunks(),
                     _process_parse_chunk,
                     num_workers=self._num_parse_workers,
-                    max_in_flight=self._num_parse_workers
-                    + max(self._prefetch_depth, 1),
+                    max_in_flight=self._max_in_flight(),
                     pool=self._get_process_pool(),
+                    on_discard=self._discard_worker_payload,
                 ),
             )
         elif self._num_parse_workers > 0:
@@ -488,8 +859,7 @@ class RecordDataset:
                     self._chunks(),
                     self._parse_chunk,
                     num_workers=self._num_parse_workers,
-                    max_in_flight=self._num_parse_workers
-                    + max(self._prefetch_depth, 1),
+                    max_in_flight=self._max_in_flight(),
                 )
             )
         else:

@@ -39,7 +39,30 @@ def encode_image(array: np.ndarray, data_format: str, quality: int = 95) -> byte
 
 def _fill_feature(feature: example_pb2.Feature, spec: ExtendedTensorSpec, value: Any) -> None:
     if spec.data_format is not None:
+        if isinstance(value, (bytes, bytearray)):
+            # Pre-encoded image bytes pass through unchanged: replay
+            # writers usually hold the camera's jpeg already, and a
+            # decode->re-encode round trip would both recompress (lossy)
+            # and burn the write path's CPU budget.
+            feature.bytes_list.value.append(bytes(value))
+            return
         arr = np.asarray(value)
+        if arr.dtype.kind in ("S", "O", "U"):
+            for item in arr.ravel():
+                if isinstance(item, str):
+                    data = item.encode()
+                elif isinstance(item, (bytes, bytearray, np.bytes_)):
+                    data = bytes(item)
+                else:
+                    # bytes(5) would silently mean five NUL bytes; a
+                    # mistyped value must fail at the writer, not
+                    # surface later as an undecodable image.
+                    raise ValueError(
+                        f"Pre-encoded image values for {spec.name!r} must "
+                        f"be bytes/str, got {type(item).__name__}"
+                    )
+                feature.bytes_list.value.append(data)
+            return
         if arr.ndim >= 4:
             # Image stacks (camera arrays / varlen image lists): one encoded
             # bytes entry per leading-dim image, the layout the parser's

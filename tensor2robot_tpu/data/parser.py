@@ -95,35 +95,51 @@ def _load_jpeg_native_locked(ctypes, os, subprocess):
     return _jpeg_lib
 
 
-def _decode_jpeg_native(data: bytes, shape) -> Optional[np.ndarray]:
-    """One-shot decode into a fresh uint8 array of `shape`; None on any
-    mismatch/failure (caller falls back to PIL)."""
+def decode_image_into_native(data: bytes, out: np.ndarray) -> bool:
+    """Decodes a jpeg directly INTO `out` (uint8, HxWx3, C-contiguous).
+
+    The zero-copy half of the fast batch parser (data/wire.py): `out` is a
+    record's slot inside a preallocated batch array, so a successful decode
+    writes scanlines straight into the batch with no intermediate frame.
+    Returns False on any mismatch/failure — the slot contents are then
+    undefined and the caller must fall back to `decode_image` (which either
+    fills the slot or raises the canonical error).
+    """
     lib = _load_jpeg_native()
     if lib is None:
-        return None
+        return False
     import ctypes
 
-    channels = shape[-1] if len(shape) == 3 else 1
-    if channels != 3:
+    if out.dtype != np.uint8 or out.ndim != 3 or out.shape[-1] != 3:
         # Grayscale requests stay on PIL: libjpeg's JCS_GRAYSCALE takes
         # the Y plane directly while PIL recomputes luma from the
         # reconstructed RGB — different pixels for color sources, and
         # decoded values must not depend on whether the native library
         # built.
-        return None
-    out = np.empty(shape, np.uint8)
+        return False
+    if not out.flags.c_contiguous:
+        return False
     h = ctypes.c_int()
     w = ctypes.c_int()
     rc = lib.t2r_decode_jpeg(
         data,
         len(data),
-        out.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_void_p(out.ctypes.data),
         out.nbytes,
-        channels,
+        3,
         ctypes.byref(h),
         ctypes.byref(w),
     )
-    if rc != 0 or (h.value, w.value) != tuple(shape[:2]):
+    return rc == 0 and (h.value, w.value) == tuple(out.shape[:2])
+
+
+def _decode_jpeg_native(data: bytes, shape) -> Optional[np.ndarray]:
+    """One-shot decode into a fresh uint8 array of `shape`; None on any
+    mismatch/failure (caller falls back to PIL)."""
+    if len(shape) != 3 or shape[-1] != 3:
+        return None
+    out = np.empty(shape, np.uint8)
+    if not decode_image_into_native(data, out):
         return None
     return out
 

@@ -39,15 +39,34 @@ def _load_native() -> Optional[ctypes.CDLL]:
         if _lib is not None or _lib_failed:
             return _lib
         try:
+            # Pin the target: `all` also builds the libjpeg-dependent
+            # decoder, whose absence of dev headers must not fail the
+            # record codec this loader needs. make also runs when the .so
+            # exists so a stale build from an older source picks up new
+            # entry points (mtime no-op costs ~10 ms once) — but a host
+            # with a prebuilt .so and no toolchain must still load it.
+            import multiprocessing
+
+            in_child = multiprocessing.parent_process() is not None
             if not os.path.exists(_LIB_PATH):
-                # Pin the target: `all` also builds the libjpeg-dependent
-                # decoder, whose absence of dev headers must not fail the
-                # record codec this loader needs.
                 subprocess.run(
                     ["make", "-C", _NATIVE_DIR, "libt2r_io.so"],
                     check=True,
                     capture_output=True,
                 )
+            elif not in_child:
+                # Freshness rebuild in the MAIN process only: N spawned
+                # parse workers must not race `make` over the same .so
+                # while siblings dlopen it mid-link (workers always find
+                # a current build — the parent loads before spawning).
+                try:
+                    subprocess.run(
+                        ["make", "-C", _NATIVE_DIR, "libt2r_io.so"],
+                        check=False,
+                        capture_output=True,
+                    )
+                except OSError:
+                    pass  # no make on PATH; the existing build serves
             lib = ctypes.CDLL(_LIB_PATH)
             lib.t2r_masked_crc32c.restype = ctypes.c_uint32
             lib.t2r_masked_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
@@ -66,6 +85,21 @@ def _load_native() -> Optional[ctypes.CDLL]:
                 ctypes.c_size_t,
                 ctypes.c_char_p,
             ]
+            try:
+                lib.t2r_index_records_partial.restype = ctypes.c_int64
+                lib.t2r_index_records_partial.argtypes = [
+                    ctypes.c_char_p,
+                    ctypes.c_size_t,
+                    ctypes.POINTER(ctypes.c_uint64),
+                    ctypes.POINTER(ctypes.c_uint64),
+                    ctypes.c_size_t,
+                    ctypes.c_int,
+                    ctypes.POINTER(ctypes.c_uint64),
+                ]
+            except AttributeError:
+                # Stale .so from before the streaming indexer existed; the
+                # reader falls back to per-record framing.
+                lib.t2r_index_records_partial = None
             _lib = lib
         except Exception:
             _lib_failed = True
@@ -205,12 +239,91 @@ def index_tfrecord_buffer(
     return np.asarray(offsets, np.uint64), np.asarray(lengths, np.uint64)
 
 
-def read_tfrecords(path: str, verify_crc: bool = True) -> Iterator[bytes]:
+# How much of a shard the buffered reader holds at once. Big enough to
+# amortize syscalls and native-indexer crossings over many records, small
+# enough that the interleaver can hold several shards open (multi-GB
+# episode files must never be slurped whole).
+_READ_BUFFER_BYTES = 8 << 20
+# Upper bound on records indexed per native call (bounds the offset/length
+# scratch arrays; the loop just calls again for the rest of the block).
+_INDEX_BATCH = 4096
+
+
+def read_tfrecords(
+    path: str, verify_crc: bool = True, buffer_bytes: int = _READ_BUFFER_BYTES
+) -> Iterator[bytes]:
     """Streams record payloads from a TFRecord file with bounded memory.
 
-    Reads header-then-payload per record (multi-GB episode shards must not be
-    slurped whole — the interleaver holds several of these open at once).
+    Block-buffered: reads `buffer_bytes` at a time and indexes all complete
+    records in the block with ONE native call (t2r_index_records_partial),
+    so the per-record cost is a payload slice instead of two f.read()s,
+    three CRC round-trips, and header unpacking. Falls back to per-record
+    framing when the native codec is unavailable.
     """
+    lib = _load_native()
+    if lib is None or getattr(lib, "t2r_index_records_partial", None) is None:
+        yield from _read_tfrecords_streaming(path, verify_crc)
+        return
+    offsets = (ctypes.c_uint64 * _INDEX_BATCH)()
+    lengths = (ctypes.c_uint64 * _INDEX_BATCH)()
+    consumed = ctypes.c_uint64()
+    with open(path, "rb") as f:
+        base = 0  # file offset of buf[0]
+        buf = b""
+        want = buffer_bytes
+        while True:
+            chunk = f.read(want)
+            want = buffer_bytes
+            if chunk:
+                buf = buf + chunk if buf else chunk
+            while buf:
+                count = lib.t2r_index_records_partial(
+                    buf,
+                    len(buf),
+                    offsets,
+                    lengths,
+                    _INDEX_BATCH,
+                    1 if verify_crc else 0,
+                    ctypes.byref(consumed),
+                )
+                if count < 0:
+                    raise TFRecordCorruptionError(
+                        f"Corrupt TFRecord data at byte {base - count - 1}"
+                    )
+                if count == 0:
+                    break
+                for i in range(count):
+                    off = offsets[i]
+                    yield buf[off : off + lengths[i]]
+                buf = buf[consumed.value :]
+                base += consumed.value
+            if not chunk:
+                if buf:
+                    raise TFRecordCorruptionError(
+                        f"Truncated record at byte {base} "
+                        f"({len(buf)} trailing bytes)"
+                    )
+                return
+            if len(buf) >= 12:
+                # The partial indexer reports an over-long length claim as
+                # an incomplete tail; bound it here before buffering more
+                # (a corrupt length field must error, not accrete memory),
+                # and for a legitimate record larger than the block size
+                # read the missing remainder in ONE request — repeated
+                # block-sized accretion would re-copy the whole tail per
+                # round (quadratic in record size).
+                (length,) = struct.unpack_from("<Q", buf, 0)
+                if length > (1 << 40):
+                    raise TFRecordCorruptionError(
+                        f"Implausible record length at {base}"
+                    )
+                needed = 12 + int(length) + 4 - len(buf)
+                if needed > buffer_bytes:
+                    want = needed
+
+
+def _read_tfrecords_streaming(path: str, verify_crc: bool) -> Iterator[bytes]:
+    """Per-record framing fallback (no native codec)."""
     with open(path, "rb") as f:
         pos = 0
         while True:

@@ -50,12 +50,20 @@ def stem_s2d_enabled() -> bool:
 
 
 class SpaceToDepthConv(nn.Module):
-    """Drop-in twin of `nn.Conv(features, (K, K), strides=(S, S), "SAME")`
-    for K % S == 0, lowered as space-to-depth(S) + (K/S)² stride-1 conv.
+    """Twin of `nn.Conv(features, (K, K), strides=(S, S), "SAME",
+    use_bias=False)` on rank-4 NHWC input, for K % S == 0, lowered as
+    space-to-depth(S) + (K/S)² stride-1 conv.
+
+    Equivalence caveats (vs. a bare `nn.Conv`): there is NO bias — a
+    checkpoint carrying a `bias` param (from an `nn.Conv` trained with the
+    default use_bias=True) is rejected at apply time rather than silently
+    dropped (flax does not error on unused params on its own) — and the
+    input must be rank-4 NHWC with spatial dims divisible by the strides
+    (nn.Conv accepts other ranks/odd sizes).
 
     Stores its kernel in the plain-Conv layout (K, K, C_in, features) under
-    the param name "kernel" so the two implementations are checkpoint-
-    compatible in both directions.
+    the param name "kernel" so bias-free checkpoints are bit-portable
+    between the two lowerings in both directions.
     """
 
     features: int
@@ -81,6 +89,13 @@ class SpaceToDepthConv(nn.Module):
                 f"SAME padding of kernel {self.kernel_size} stride "
                 f"{self.strides} is not a whole number of space-to-depth "
                 "blocks per side"
+            )
+        if self.has_variable("params", "bias"):
+            raise ValueError(
+                "SpaceToDepthConv has no bias: a 'bias' param was restored "
+                "into this module (nn.Conv(use_bias=True) checkpoint?); it "
+                "would be silently ignored, changing the computation vs. "
+                "the source Conv. Fold the bias away or load into nn.Conv."
             )
         b, h, w, c = x.shape
         if h % sh or w % sw:

@@ -137,6 +137,47 @@ int64_t t2r_index_records(const uint8_t* buf, size_t n, uint64_t* offsets,
   return count;
 }
 
+// Like t2r_index_records, but for STREAMING use over a block buffer that
+// may end mid-record: a trailing incomplete record is not an error.
+// Scans complete records only, stops at max_records or the first
+// incomplete tail, and reports via *consumed how many leading bytes of
+// buf were fully indexed (the caller slides its window by that amount and
+// reads more). Corruption inside a complete record (bad header or payload
+// CRC) still returns -(byte_position+1). Note a corrupt length field that
+// claims more bytes than the buffer holds is indistinguishable from an
+// incomplete tail here; the Python caller bounds that case (implausible
+// lengths, leftover bytes at EOF) and reports corruption itself.
+int64_t t2r_index_records_partial(const uint8_t* buf, size_t n,
+                                  uint64_t* offsets, uint64_t* lengths,
+                                  size_t max_records, int verify_crc,
+                                  uint64_t* consumed) {
+  (void)Tables();
+  size_t pos = 0;
+  int64_t count = 0;
+  while (pos < n && (size_t)count < max_records) {
+    if (pos + 12 > n) break;  // incomplete header
+    uint64_t len = ReadU64(buf + pos);
+    uint32_t len_crc = ReadU32(buf + pos + 8);
+    if (Mask(Crc32cUpdate(0, buf + pos, 8)) != len_crc) {
+      return -(int64_t)(pos + 1);
+    }
+    size_t remaining = n - (pos + 12);
+    if (remaining < 4 || len > remaining - 4) break;  // incomplete payload
+    if (verify_crc) {
+      uint32_t data_crc = ReadU32(buf + pos + 12 + len);
+      if (Mask(Crc32cUpdate(0, buf + pos + 12, len)) != data_crc) {
+        return -(int64_t)(pos + 1);
+      }
+    }
+    offsets[count] = pos + 12;
+    lengths[count] = len;
+    ++count;
+    pos += 12 + len + 4;
+  }
+  *consumed = pos;
+  return count;
+}
+
 // Frames a single record into out (which must hold 16 + len bytes).
 // Returns the framed size.
 size_t t2r_frame_record(const uint8_t* data, size_t len, uint8_t* out) {

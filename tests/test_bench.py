@@ -102,6 +102,9 @@ def test_analytic_flops_width_scaling():
 
 @pytest.mark.slow
 def test_bench_data_contract():
+    """bench.py data on the (default) fast path at toy sizes: one JSON
+    line, the three-leg breakdown (fast+cache headline, cold fast,
+    SpecParser oracle), and sane values."""
     payload = _run_bench(
         "data",
         env_extra={
@@ -117,6 +120,33 @@ def test_bench_data_contract():
     assert detail["records_per_sec"] > 0
     assert detail["batch_size"] == 4
     assert detail["parse_workers"] >= 1
+    # Fast-path provenance: which parser produced the headline and what
+    # each mechanism contributed (ISSUE 1 tentpole).
+    assert detail["parse_fast"] is True
+    assert detail["fast_no_cache_images_per_sec"] > 0
+    assert detail["specparser_images_per_sec"] > 0
+    assert detail["fast_vs_specparser"] > 0
+    if detail["decode_cache_mb"] > 0 and detail["decode_cache"] is not None:
+        cache = detail["decode_cache"]
+        assert cache["hits"] + cache["misses"] > 0
+        assert 0.0 <= cache["hit_rate"] <= 1.0
+
+
+@pytest.mark.slow
+def test_bench_data_slow_path_still_runs():
+    """T2R_PARSE_FAST=0 must keep the bench (and pipeline) functional —
+    the oracle path is the fallback story."""
+    payload = _run_bench(
+        "data",
+        env_extra={
+            "BENCH_DATA_RECORDS": "8",
+            "BENCH_DATA_BATCH": "4",
+            "BENCH_DATA_BATCHES": "2",
+            "T2R_PARSE_FAST": "0",
+        },
+    )
+    assert payload["value"] > 0
+    assert "error" not in payload
 
 
 @pytest.mark.slow

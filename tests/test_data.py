@@ -52,6 +52,22 @@ class TestTFRecordIO:
             w.write(b"zzz")
         assert list(tfrecord.read_tfrecords(theirs)) == [b"zzz"]
 
+    def test_buffered_reader_matches_streaming(self, tmp_path):
+        """The block-buffered native-indexed reader and the per-record
+        framing fallback must yield identical record streams, including
+        when records straddle block boundaries (tiny buffer_bytes)."""
+        from tensor2robot_tpu.data.tfrecord import _read_tfrecords_streaming
+
+        path = str(tmp_path / "blocks.tfrecord")
+        rng = np.random.RandomState(0)
+        records = [bytes(rng.randint(0, 256, n, np.uint8).tobytes())
+                   for n in (0, 1, 100, 5000, 17, 64 << 10)]
+        tfrecord.write_tfrecords(path, records)
+        assert list(tfrecord.read_tfrecords(path)) == records
+        assert list(tfrecord.read_tfrecords(path, buffer_bytes=64)) == records
+        assert list(_read_tfrecords_streaming(path, True)) == records
+        assert list(tfrecord.read_tfrecords(path, verify_crc=False)) == records
+
     def test_list_files(self, tmp_path):
         for name in ["a-0.rec", "a-1.rec", "b-0.rec"]:
             tfrecord.write_tfrecords(str(tmp_path / name), [b"r"])
@@ -557,6 +573,72 @@ class TestParallelParse:
         it = iter(dataset)
         batches = [next(it) for _ in range(10)]  # > one epoch; repeats fine
         assert all(b["img"].shape == (4, 8, 10, 3) for b in batches)
+
+    @pytest.mark.slow
+    def test_process_backend_shm_ring_roundtrip(self, tmp_path):
+        """Batches big enough for the shared-memory return path (>= 1 MB
+        of decoded image) must round-trip bit-exact through ring slots,
+        across epochs (slot reuse), and slots must recycle rather than
+        leak (bounded ring)."""
+        spec = TensorSpecStruct()
+        spec["img"] = ExtendedTensorSpec(
+            shape=(320, 320, 3), dtype=np.uint8, name="img", data_format="png"
+        )
+        spec["y"] = ExtendedTensorSpec(shape=(), dtype=np.int64, name="y")
+        records = []
+        for i in range(8):
+            img = np.full((320, 320, 3), i * 7 % 250, np.uint8)
+            records.append(
+                encode_example(spec, {"img": img, "y": np.asarray(i, np.int64)})
+            )
+        tfrecord.write_tfrecords(str(tmp_path / "shm.tfrecord"), records)
+        kwargs = dict(
+            specs=spec,
+            file_patterns=str(tmp_path / "shm.tfrecord"),
+            batch_size=4,
+            mode="eval",
+            num_parse_workers=2,
+        )
+        ref = list(RecordDataset(parse_backend="thread", **kwargs))
+        ds = RecordDataset(parse_backend="process", **kwargs)
+        from tensor2robot_tpu.data.dataset import _ShmArray
+
+        shm_batches = 0
+        # Enough epochs that total shm cycles exceed the ring size
+        # (max_in_flight + 2 slots): recycling, not just first use.
+        num_epochs = 8
+        for epoch in range(num_epochs):
+            batches = list(ds)
+            assert len(batches) == len(ref) == 2
+            for a, b in zip(batches, ref):
+                if isinstance(a["img"], _ShmArray):
+                    shm_batches += 1
+                np.testing.assert_array_equal(
+                    np.asarray(a["img"]), np.asarray(b["img"])
+                )
+                np.testing.assert_array_equal(
+                    np.asarray(a["y"]), np.asarray(b["y"])
+                )
+            del a, b, batches  # release views so slots return to the ring
+        assert ds._shm_ring is not None
+        ring_size = len(ds._shm_ring.slots)
+        assert ring_size > 0
+        # First batches return inline (they size the ring); after that the
+        # shm path must carry the image batches, INCLUDING after every
+        # slot has been used once — i.e. released slots really recycle.
+        assert shm_batches > ring_size, (shm_batches, ring_size)
+        # Early abandonment must not leak ring slots: drop an iterator
+        # mid-epoch, then a fresh full epoch must still ride the shm path
+        # (completed-but-unconsumed futures return their slots on discard).
+        for _ in range(3):
+            it = iter(ds)
+            next(it)
+            del it
+        batches = list(ds)
+        assert any(isinstance(b["img"], _ShmArray) for b in batches)
+        del batches
+        ds.close()
+        assert ds._shm_ring is None
 
     def test_parse_error_propagates(self, tmp_path):
         spec = TensorSpecStruct()

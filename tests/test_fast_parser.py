@@ -1,0 +1,488 @@
+"""Parity suite: the wire-format fast parser vs. the SpecParser oracle.
+
+The fast path (data/wire.py) re-implements the generated parser at batch
+granularity (spans + decode-into + vectorized varints); `SpecParser` stays
+the semantics oracle. Every test here round-trips spec-conforming values
+through `encode_example` and asserts the two parsers produce BYTE-IDENTICAL
+outputs — same keys, same dtypes, same shapes, same bits — across the spec
+families the framework ships (QT-Opt, transformer-BC, meta-learning) and
+the corner-case features the oracle documents (varlen pad/clip, jpeg/png
+decode + zero-image fallback, dataset_key zip, sequence `_length`
+sidecars, bfloat16 egress, optional features).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from tensor2robot_tpu.data.encoder import encode_example, encode_examples_by_dataset
+from tensor2robot_tpu.data.parser import SpecParser
+from tensor2robot_tpu.data.wire import (
+    DecodeCache,
+    FastSpecParser,
+    decode_packed_varints,
+    reset_decode_cache,
+)
+from tensor2robot_tpu.specs import (
+    ExtendedTensorSpec,
+    TensorSpecStruct,
+    make_random_numpy,
+)
+
+
+def _records_for(specs, batch, seed=0):
+    values = make_random_numpy(specs, batch_size=batch, seed=seed)
+    rows = [
+        {key: np.asarray(value[i]) for key, value in values.items()}
+        for i in range(batch)
+    ]
+    return [encode_example(specs, row) for row in rows], rows
+
+
+def assert_parity(specs, records, cache=None):
+    """Both parsers on the same batch -> byte-identical structs."""
+    slow = SpecParser(specs).parse_batch(records)
+    fast_parser = FastSpecParser(specs)
+    assert fast_parser.supported, fast_parser.unsupported_reason
+    fast = fast_parser.parse_batch(records, cache=cache)
+    assert set(slow.keys()) == set(fast.keys())
+    for key in slow.keys():
+        want = np.asarray(slow[key])
+        got = np.asarray(fast[key])
+        assert want.dtype == got.dtype, (key, want.dtype, got.dtype)
+        assert want.shape == got.shape, (key, want.shape, got.shape)
+        np.testing.assert_array_equal(
+            want.view(np.uint8) if want.dtype.itemsize else want,
+            got.view(np.uint8) if got.dtype.itemsize else got,
+            err_msg=key,
+        )
+    return fast
+
+
+class TestModelSpecParity:
+    @pytest.mark.slow
+    def test_qtopt_spec(self):
+        from tensor2robot_tpu.research.qtopt.t2r_models import (
+            Grasping44E2EOpenCloseTerminateGripperStatusHeightToBottom,
+        )
+
+        model = Grasping44E2EOpenCloseTerminateGripperStatusHeightToBottom(
+            device_type="cpu"
+        )
+        specs = {
+            "features": model.preprocessor.get_in_feature_specification("train"),
+            "labels": model.preprocessor.get_in_label_specification("train"),
+        }
+        records, _ = _records_for(specs, batch=4)
+        assert_parity(specs, records)
+
+    def test_transformer_bc_spec(self):
+        from tensor2robot_tpu.models.transformer_models import TransformerBCModel
+
+        model = TransformerBCModel(
+            action_size=2,
+            pose_size=4,
+            episode_length=4,
+            image_size=(16, 16),
+            use_flash=False,
+            device_type="cpu",
+        )
+        feature_spec = model.preprocessor.get_in_feature_specification("train")
+        label_spec = model.preprocessor.get_in_label_specification("train")
+        specs = {"features": feature_spec, "labels": label_spec}
+        values = make_random_numpy(specs, batch_size=3, seed=1)
+        records = []
+        for i in range(3):
+            row = {k: np.asarray(v[i]) for k, v in values.items()}
+            for key, value in row.items():
+                spec = dict(specs["features"]).get(key.split("/", 1)[-1])
+                if getattr(spec, "data_format", None):
+                    row[key] = (np.clip(value, 0, 1) * 255).astype(np.uint8)
+            records.append(encode_example(specs, row))
+        assert_parity(specs, records)
+
+    def test_meta_learning_metaexample_spec(self):
+        from tensor2robot_tpu.meta_learning.preprocessors import (
+            create_metaexample_spec,
+        )
+        from tensor2robot_tpu.utils.mocks import MockT2RModel
+
+        model = MockT2RModel()
+        specs = create_metaexample_spec(
+            model.get_feature_specification("train"), 3, "condition"
+        )
+        records, _ = _records_for(specs, batch=5)
+        assert_parity(specs, records)
+
+
+class TestFeatureParity:
+    def test_scalar_and_ranked_numerics(self):
+        specs = TensorSpecStruct()
+        specs["s"] = ExtendedTensorSpec(shape=(), dtype=np.float32, name="s")
+        specs["v"] = ExtendedTensorSpec(shape=(7,), dtype=np.float64, name="v")
+        specs["m"] = ExtendedTensorSpec(shape=(3, 4), dtype=np.int32, name="m")
+        specs["b"] = ExtendedTensorSpec(shape=(2,), dtype=bool, name="b")
+        specs["big"] = ExtendedTensorSpec(shape=(5,), dtype=np.int64, name="big")
+        records, _ = _records_for(specs, batch=6, seed=3)
+        assert_parity(specs, records)
+
+    def test_negative_and_large_int64(self):
+        """Multi-byte and 10-byte (negative) varints through the vectorized
+        decoder, against the protobuf-serialized truth."""
+        specs = TensorSpecStruct()
+        specs["x"] = ExtendedTensorSpec(shape=(6,), dtype=np.int64, name="x")
+        rows = [
+            {"x": np.array([0, -1, 1, -(2**62), 2**62, 127], np.int64)},
+            {"x": np.array([128, 300, -300, 2**40, -(2**40), 1], np.int64)},
+        ]
+        records = [encode_example(specs, row) for row in rows]
+        fast = assert_parity(specs, records)
+        np.testing.assert_array_equal(np.asarray(fast["x"])[0], rows[0]["x"])
+
+    def test_bfloat16_egress_cast(self):
+        import jax.numpy as jnp
+
+        specs = TensorSpecStruct()
+        specs["h"] = ExtendedTensorSpec(shape=(4,), dtype=jnp.bfloat16, name="h")
+        records, _ = _records_for(specs, batch=3, seed=5)
+        fast = assert_parity(specs, records)
+        assert np.asarray(fast["h"]).dtype == jnp.bfloat16
+
+    def test_varlen_pad_and_clip(self):
+        specs = TensorSpecStruct()
+        specs["v"] = ExtendedTensorSpec(
+            shape=(5,), dtype=np.float32, name="v", varlen_default_value=-1.0
+        )
+        specs["n"] = ExtendedTensorSpec(
+            shape=(3,), dtype=np.int64, name="n", varlen_default_value=7.0
+        )
+        rows = [
+            {"v": np.arange(2, dtype=np.float32), "n": np.arange(9)},  # pad/clip
+            {"v": np.arange(8, dtype=np.float32), "n": np.arange(1)},  # clip/pad
+            {"v": np.arange(5, dtype=np.float32), "n": np.arange(3)},  # exact
+        ]
+        records = [encode_example(specs, row) for row in rows]
+        fast = assert_parity(specs, records)
+        np.testing.assert_array_equal(
+            np.asarray(fast["v"])[0], [0.0, 1.0, -1.0, -1.0, -1.0]
+        )
+        np.testing.assert_array_equal(np.asarray(fast["n"])[1], [0, 7, 7])
+
+    def test_sequence_lengths_and_padding(self):
+        specs = TensorSpecStruct()
+        specs["seq"] = ExtendedTensorSpec(
+            shape=(3,), dtype=np.float32, name="seq", is_sequence=True
+        )
+        specs["ctx"] = ExtendedTensorSpec(shape=(2,), dtype=np.float32, name="ctx")
+        rng = np.random.RandomState(0)
+        rows = [
+            {"seq": rng.randn(t, 3).astype(np.float32),
+             "ctx": rng.randn(2).astype(np.float32)}
+            for t in (1, 4, 2)
+        ]
+        records = [encode_example(specs, row) for row in rows]
+        fast = assert_parity(specs, records)
+        np.testing.assert_array_equal(np.asarray(fast["seq_length"]), [1, 4, 2])
+        assert np.asarray(fast["seq"]).shape == (3, 4, 3)
+
+    def test_dataset_key_zip(self):
+        specs = TensorSpecStruct()
+        specs["a"] = ExtendedTensorSpec(
+            shape=(2,), dtype=np.float32, name="a", dataset_key="d1"
+        )
+        specs["b"] = ExtendedTensorSpec(
+            shape=(3,), dtype=np.int64, name="b", dataset_key="d2"
+        )
+        values = make_random_numpy(specs, batch_size=4, seed=2)
+        serialized = {"d1": [], "d2": []}
+        for i in range(4):
+            row = {k: np.asarray(v[i]) for k, v in values.items()}
+            by_key = encode_examples_by_dataset(specs, row)
+            for key, record in by_key.items():
+                serialized[key].append(record)
+        slow = SpecParser(specs).parse_batch(serialized)
+        fast_parser = FastSpecParser(specs)
+        assert fast_parser.supported
+        fast = fast_parser.parse_batch(serialized)
+        for key in slow.keys():
+            np.testing.assert_array_equal(
+                np.asarray(slow[key]), np.asarray(fast[key]), err_msg=key
+            )
+
+    def test_optional_all_absent_and_partial(self):
+        specs = TensorSpecStruct()
+        specs["req"] = ExtendedTensorSpec(shape=(2,), dtype=np.float32, name="req")
+        specs["opt"] = ExtendedTensorSpec(
+            shape=(2,), dtype=np.float32, name="opt", is_optional=True
+        )
+        with_opt = encode_example(
+            specs, {"req": np.zeros(2, np.float32), "opt": np.ones(2, np.float32)}
+        )
+        without_opt = encode_example(specs, {"req": np.zeros(2, np.float32)})
+        fast = assert_parity(specs, [without_opt, without_opt])
+        assert "opt" not in fast
+        assert_parity(specs, [with_opt, with_opt])
+        with pytest.raises(ValueError, match="only some"):
+            FastSpecParser(specs).parse_batch([with_opt, without_opt])
+
+
+class TestImageParity:
+    def _image_specs(self, data_format="jpeg", channels=3, dtype=np.uint8):
+        specs = TensorSpecStruct()
+        specs["img"] = ExtendedTensorSpec(
+            shape=(24, 20, channels), dtype=dtype, name="img",
+            data_format=data_format,
+        )
+        return specs
+
+    def _pixel_rows(self, specs, batch, seed=0):
+        rng = np.random.RandomState(seed)
+        shape = tuple(specs["img"].shape)
+        return [
+            {"img": rng.randint(0, 256, shape, dtype=np.uint8)}
+            for _ in range(batch)
+        ]
+
+    def test_jpeg_rgb(self):
+        specs = self._image_specs("jpeg")
+        rows = self._pixel_rows(specs, 3)
+        records = [encode_example(specs, r) for r in rows]
+        assert_parity(specs, records)
+
+    def test_png_rgb_and_grayscale(self):
+        """PNG (and 1-channel) decode rides the PIL path in both parsers."""
+        for channels in (3, 1):
+            specs = self._image_specs("png", channels=channels)
+            rows = self._pixel_rows(specs, 2, seed=channels)
+            records = [encode_example(specs, r) for r in rows]
+            assert_parity(specs, records)
+
+    def test_float_image_spec(self):
+        """Specs may declare the DECODED dtype (e.g. f32); parity includes
+        the post-decode cast."""
+        specs = self._image_specs("jpeg", dtype=np.float32)
+        rows = self._pixel_rows(specs, 2, seed=9)
+        records = [encode_example(specs, r) for r in rows]
+        assert_parity(specs, records)
+
+    def test_empty_string_zero_image_fallback(self):
+        specs = self._image_specs("jpeg")
+        record = encode_example(specs, {"img": b""})
+        fast = assert_parity(specs, [record])
+        assert not np.asarray(fast["img"]).any()
+
+    def test_image_stack(self):
+        specs = TensorSpecStruct()
+        specs["stack"] = ExtendedTensorSpec(
+            shape=(3, 12, 10, 3), dtype=np.uint8, name="stack",
+            data_format="png",
+        )
+        rng = np.random.RandomState(4)
+        rows = [
+            {"stack": rng.randint(0, 256, (3, 12, 10, 3), dtype=np.uint8)}
+            for _ in range(2)
+        ]
+        records = [encode_example(specs, r) for r in rows]
+        assert_parity(specs, records)
+
+    def test_varlen_image_stack_pads_with_zero_images(self):
+        specs = TensorSpecStruct()
+        specs["stack"] = ExtendedTensorSpec(
+            shape=(4, 12, 10, 3), dtype=np.uint8, name="stack",
+            data_format="png", varlen_default_value=0.0,
+        )
+        rng = np.random.RandomState(5)
+        rows = [
+            {"stack": rng.randint(0, 256, (2, 12, 10, 3), dtype=np.uint8)},
+            {"stack": rng.randint(0, 256, (6, 12, 10, 3), dtype=np.uint8)},
+        ]
+        records = [encode_example(specs, r) for r in rows]
+        fast = assert_parity(specs, records)
+        assert not np.asarray(fast["stack"])[0, 2:].any()  # zero-padded
+
+    def test_sequence_images_with_lengths(self):
+        specs = TensorSpecStruct()
+        specs["cam"] = ExtendedTensorSpec(
+            shape=(8, 6, 3), dtype=np.uint8, name="cam",
+            data_format="png", is_sequence=True,
+        )
+        rng = np.random.RandomState(6)
+        rows = [
+            {"cam": rng.randint(0, 256, (t, 8, 6, 3), dtype=np.uint8)}
+            for t in (2, 3)
+        ]
+        records = [encode_example(specs, r) for r in rows]
+        fast = assert_parity(specs, records)
+        np.testing.assert_array_equal(np.asarray(fast["cam_length"]), [2, 3])
+
+
+class TestDecodeCache:
+    def test_cache_hit_is_bit_identical(self):
+        specs = TensorSpecStruct()
+        specs["img"] = ExtendedTensorSpec(
+            shape=(24, 20, 3), dtype=np.uint8, name="img", data_format="jpeg"
+        )
+        rng = np.random.RandomState(7)
+        record = encode_example(
+            specs, {"img": rng.randint(0, 256, (24, 20, 3), dtype=np.uint8)}
+        )
+        cache = DecodeCache(64 << 20)
+        parser = FastSpecParser(specs)
+        cold = parser.parse_batch([record], cache=cache)
+        assert cache.misses >= 1 and cache.hits == 0
+        warm = parser.parse_batch([record], cache=cache)
+        assert cache.hits >= 1
+        np.testing.assert_array_equal(
+            np.asarray(cold["img"]), np.asarray(warm["img"])
+        )
+        # ... and identical to the oracle.
+        slow = SpecParser(specs).parse_batch([record])
+        np.testing.assert_array_equal(
+            np.asarray(slow["img"]), np.asarray(warm["img"])
+        )
+
+    def test_cache_budget_evicts(self):
+        cache = DecodeCache(4096)
+        for i in range(8):
+            cache.put("sig", bytes([i]), np.full((32, 32), i, np.uint8))
+        assert cache.stats()["bytes"] <= 4096
+        assert cache.stats()["entries"] <= 4
+
+    def test_fingerprint_collision_degrades_to_miss_not_wrong_pixels(self):
+        """Two encoded payloads crafted to share a fingerprint (same
+        length, head, middle, tail) must never serve each other's pixels:
+        the exact-verify memcmp turns the collision into a miss."""
+        cache = DecodeCache(64 << 20)
+        base = bytearray(np.random.RandomState(0).bytes(4096))
+        other = bytearray(base)
+        other[100] ^= 0xFF  # differs outside every sampled window
+        a, b = bytes(base), bytes(other)
+        assert DecodeCache.fingerprint("sig", a) == DecodeCache.fingerprint(
+            "sig", b
+        )
+        img_a = np.full((4, 4), 1, np.uint8)
+        cache.put("sig", a, img_a)
+        assert cache.get("sig", b) is None  # collision -> miss
+        np.testing.assert_array_equal(cache.get("sig", a), img_a)
+
+    def test_cache_env_zero_disables(self, monkeypatch):
+        from tensor2robot_tpu.data import wire
+
+        monkeypatch.setenv("T2R_DECODE_CACHE_MB", "0")
+        reset_decode_cache()
+        assert wire.get_decode_cache() is None
+        monkeypatch.setenv("T2R_DECODE_CACHE_MB", "8")
+        reset_decode_cache()
+        assert wire.get_decode_cache() is not None
+        monkeypatch.delenv("T2R_DECODE_CACHE_MB")
+        reset_decode_cache()
+
+
+class TestVarintDecoder:
+    def test_single_byte_fast_path(self):
+        raw = np.array([0, 1, 127], np.uint8)
+        np.testing.assert_array_equal(
+            decode_packed_varints(raw), [0, 1, 127]
+        )
+
+    def test_multibyte_and_negative(self):
+        from tensor2robot_tpu.proto import example_pb2
+
+        values = [0, 1, 127, 128, 300, 2**32, 2**62, -1, -300, -(2**62)]
+        feature = example_pb2.Feature()
+        feature.int64_list.value.extend(values)
+        wire_bytes = feature.int64_list.SerializeToString()
+        # Strip the field-1 LEN frame (tag byte + length varint(s)).
+        pos = 1
+        while wire_bytes[pos] & 0x80:
+            pos += 1
+        raw = np.frombuffer(wire_bytes, np.uint8, offset=pos + 1)
+        np.testing.assert_array_equal(decode_packed_varints(raw), values)
+
+    def test_truncated_run_raises(self):
+        from tensor2robot_tpu.data.wire import FastParseError
+
+        with pytest.raises(FastParseError):
+            decode_packed_varints(np.array([0x80], np.uint8))
+
+    def test_empty(self):
+        assert decode_packed_varints(np.empty(0, np.uint8)).size == 0
+
+
+class TestFallback:
+    def test_unsupported_specs_flagged_at_compile(self):
+        specs = TensorSpecStruct()
+        specs["raw"] = ExtendedTensorSpec(shape=(1,), dtype=np.str_, name="raw")
+        parser = FastSpecParser(specs)
+        assert not parser.supported
+        with pytest.raises(Exception):
+            parser.parse_batch([b""])
+
+    def test_dataset_falls_back_on_garbage_record(self):
+        """A record the fast path cannot scan re-parses via SpecParser,
+        which raises the canonical error."""
+        from tensor2robot_tpu.data.dataset import _FastParseState, _parse_chunk_impl
+        from tensor2robot_tpu.data.parser import SpecParser as Oracle
+
+        specs = TensorSpecStruct()
+        specs["x"] = ExtendedTensorSpec(shape=(2,), dtype=np.float32, name="x")
+        state = _FastParseState(specs, enabled=True)
+        oracle = Oracle(specs)
+        good, _ = _records_for(specs, batch=2)
+        out = _parse_chunk_impl(state, oracle, good)
+        assert np.asarray(out["x"]).shape == (2, 2)
+        with pytest.raises(Exception):
+            _parse_chunk_impl(state, oracle, [b"\xff\xff\xff"])
+        assert state.parser is None or state.parser.fallbacks >= 1
+
+    def test_fast_state_disables_after_repeated_fallbacks(self):
+        from tensor2robot_tpu.data.dataset import _FastParseState, _parse_chunk_impl
+        from tensor2robot_tpu.data.parser import SpecParser as Oracle
+
+        specs = TensorSpecStruct()
+        specs["x"] = ExtendedTensorSpec(shape=(2,), dtype=np.float32, name="x")
+        state = _FastParseState(specs, enabled=True)
+        oracle = Oracle(specs)
+        for _ in range(_FastParseState.max_fallbacks):
+            with pytest.raises(Exception):
+                _parse_chunk_impl(state, oracle, [b"\x00garbage"])
+        assert state.parser is None
+
+
+@pytest.mark.skipif(
+    os.environ.get("T2R_SKIP_HYPOTHESIS") == "1", reason="explicitly skipped"
+)
+class TestFuzzParity:
+    """Hypothesis fuzz mirroring test_parser_properties, but asserting the
+    two parsers against EACH OTHER (bit-exact, including bf16)."""
+
+    def test_random_spec_structures(self):
+        st = pytest.importorskip("hypothesis.strategies")
+        hypothesis = pytest.importorskip("hypothesis")
+        import string
+
+        name = st.text(string.ascii_lowercase, min_size=1, max_size=5)
+
+        @st.composite
+        def leaf_specs(draw, key):
+            dtype = draw(st.sampled_from([np.int64, np.float32, "bfloat16"]))
+            rank = draw(st.integers(0, 3))
+            shape = tuple(draw(st.integers(1, 4)) for _ in range(rank))
+            return ExtendedTensorSpec(shape=shape, dtype=dtype, name=key)
+
+        @st.composite
+        def spec_structs(draw):
+            keys = draw(st.lists(name, min_size=1, max_size=5, unique=True))
+            struct = TensorSpecStruct()
+            for key in keys:
+                struct[key] = draw(leaf_specs(key))
+            return struct
+
+        @hypothesis.settings(max_examples=25, deadline=None)
+        @hypothesis.given(spec_structs(), st.integers(0, 2**31 - 1))
+        def run(specs, seed):
+            records, _ = _records_for(specs, batch=3, seed=seed % (2**31 - 1))
+            assert_parity(specs, records)
+
+        run()

@@ -14,6 +14,11 @@ lengths reported and batch stacking correct.
 import string
 
 import numpy as np
+import pytest
+
+hypothesis = pytest.importorskip(
+    "hypothesis", reason="property tests need hypothesis (requirements-test.txt)"
+)
 from hypothesis import given, settings
 from hypothesis import strategies as st
 

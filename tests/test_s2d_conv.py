@@ -88,6 +88,21 @@ class TestGuards:
                 jax.random.PRNGKey(0), x
             )
 
+    def test_rejects_bias_carrying_checkpoint(self):
+        """A bias param restored from an nn.Conv(use_bias=True) checkpoint
+        must raise at apply time, not be silently ignored (ADVICE r5)."""
+        x = jnp.zeros((1, 12, 12, 3))
+        module = SpaceToDepthConv(4, (6, 6), strides=(2, 2))
+        params = module.init(jax.random.PRNGKey(0), x)
+        params = {
+            "params": {
+                **params["params"],
+                "bias": jnp.zeros((4,), jnp.float32),
+            }
+        }
+        with pytest.raises(ValueError, match="no bias"):
+            module.apply(params, x)
+
     def test_env_knob_validation(self, monkeypatch):
         monkeypatch.setenv("T2R_STEM_S2D", "yes")
         with pytest.raises(ValueError, match="T2R_STEM_S2D"):

@@ -53,7 +53,12 @@ def main() -> None:
            "device_kind": getattr(device, "device_kind", "?"),
            "peak_flops": peak, "cases": {}}
 
-    def timed(fn, args, n_warm=6, n_windows=6, calls=6):
+    # One constant shared by timed() and record(): their call counts must
+    # agree or the rtt/calls floor correction in record() silently drifts
+    # from the windows timed() actually ran (ADVICE r5).
+    CALLS_PER_WINDOW = 6
+
+    def timed(fn, args, n_warm=6, n_windows=6, calls=CALLS_PER_WINDOW):
         """Median seconds per call, readback-anchored (bench method).
 
         The anchor reads back ONE leaf, not the whole output tree: each
@@ -88,7 +93,7 @@ def main() -> None:
 
     rtt_cell = {"s": 0.0}
 
-    def record(name, seconds, flops=None, extra=None, calls=6):
+    def record(name, seconds, flops=None, extra=None, calls=CALLS_PER_WINDOW):
         """Raw per-call ms plus readback-floor-corrected fields.
 
         Each timing window issues `calls` dispatches closed by ONE readback
