@@ -291,21 +291,88 @@ def _stem_s2d() -> bool:
     return stem_s2d_enabled()
 
 
-def _proxy_fields(on_tpu: bool) -> dict:
+def _last_onchip(metric_base: str) -> "dict | None":
+    """Pointer to the most recent committed ON-CHIP artifact of a metric
+    family (VERDICT r5 next #7): {metric, value, artifact, utc}, or None.
+
+    Scans the repo-root *.json artifacts for payloads whose metric starts
+    with `metric_base`, excluding proxies and failures; recency comes from
+    the artifact's last git commit (falling back to file mtime for
+    uncommitted files). Lets a round-close CPU-proxy payload SAY where the
+    real hardware number lives instead of burying it in backend_note.
+    """
+    import datetime
+    import glob
+    import subprocess
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    best = None
+    for path in glob.glob(os.path.join(root, "*.json")):
+        try:
+            with open(path) as f:
+                payload = json.loads(f.read(1 << 20))
+        except Exception:
+            continue
+        if not isinstance(payload, dict):
+            continue
+        metric = payload.get("metric")
+        if not isinstance(metric, str) or not metric.startswith(metric_base):
+            continue
+        if payload.get("proxy") or "cpu_proxy" in metric or "error" in payload:
+            continue
+        epoch = None
+        try:
+            out = subprocess.run(
+                ["git", "log", "-1", "--format=%ct", "--", path],
+                capture_output=True, text=True, cwd=root, timeout=10,
+            )
+            if out.returncode == 0 and out.stdout.strip():
+                epoch = float(out.stdout.strip())
+        except Exception:
+            pass
+        if epoch is None:
+            try:
+                epoch = os.path.getmtime(path)
+            except OSError:
+                continue
+        if best is None or epoch > best[0]:
+            best = (
+                epoch,
+                {
+                    "metric": metric,
+                    "value": payload.get("value"),
+                    "artifact": os.path.basename(path),
+                    "utc": datetime.datetime.fromtimestamp(
+                        epoch, datetime.timezone.utc
+                    ).strftime("%Y-%m-%dT%H:%M:%SZ"),
+                },
+            )
+    return best[1] if best else None
+
+
+def _proxy_fields(on_tpu: bool, metric_base: "str | None" = None) -> dict:
     """Top-level self-description for CPU-proxy payloads (VERDICT r4 weak
     #6): an explicit "proxy": true plus a note that vs_baseline is computed
     against a synthetic CPU peak / reduced shapes and is not comparable to
     the TPU target — so a proxy artifact can never masquerade as chip
-    evidence on one overlookable detail field."""
+    evidence on one overlookable detail field. With `metric_base` the
+    payload also carries `last_onchip` — a pointer to the newest committed
+    real-hardware artifact of the family (null when none exists yet)."""
     if on_tpu:
         return {}
-    return {
+    fields = {
         "proxy": True,
         "vs_baseline_note": (
             "cpu proxy (synthetic peak / reduced shapes); not comparable "
             "to the TPU baseline target"
         ),
     }
+    if metric_base is not None:
+        try:
+            fields["last_onchip"] = _last_onchip(metric_base)
+        except Exception:  # the pointer is advisory; never fail the bench
+            fields["last_onchip"] = None
+    return fields
 
 
 def _overlap_fields(infeed_steps_per_sec: float, steps_per_sec: float) -> dict:
@@ -330,6 +397,44 @@ def _overlap_fields(infeed_steps_per_sec: float, steps_per_sec: float) -> dict:
     return fields
 
 
+def _camera_like_frames(n: int, height: int, width: int, seed: int):
+    """Synthetic robot-camera frames: smooth low-frequency background +
+    object-like rectangles + mild sensor noise.
+
+    The r05/r06 data legs encoded UNIFORM-NOISE frames — jpeg's entropy
+    worst case (~385 KB at q95 for 512x640, vs ~40-150 KB for real camera
+    captures), where Huffman decode dominates and per-pixel work (IDCT /
+    upsampling / color convert — exactly what ROI decode skips) is a
+    minority. Real grasping-bin frames are spatially coherent; these
+    frames match that compressibility class so the bench measures the
+    decode regime deployments actually run. The noise-content legs still
+    ride in the payload (BENCH_DATA_CONTENT=noise for a full noise run)
+    for series continuity with r05/r06.
+    """
+    import numpy as np
+    from PIL import Image
+
+    rng = np.random.RandomState(seed)
+    frames = np.empty((n, height, width, 3), np.uint8)
+    for i in range(n):
+        small = rng.randint(0, 256, (height // 16, width // 16, 3))
+        base = np.asarray(
+            Image.fromarray(small.astype(np.uint8)).resize(
+                (width, height), Image.BILINEAR
+            ),
+            dtype=np.float32,
+        )
+        for _ in range(rng.randint(3, 8)):  # objects in the bin
+            h = rng.randint(height // 16, height // 3)
+            w = rng.randint(width // 16, width // 3)
+            y = rng.randint(0, height - h)
+            x = rng.randint(0, width - w)
+            base[y : y + h, x : x + w] = rng.randint(0, 256, 3)
+        base += rng.normal(0.0, 4.0, base.shape)  # sensor noise
+        frames[i] = np.clip(base, 0, 255).astype(np.uint8)
+    return frames
+
+
 def bench_data() -> None:
     """Input-pipeline throughput: records/sec + images/sec for the QT-Opt
     spec (512x640 jpeg), batch 64, through the parallel parse pipeline.
@@ -337,6 +442,18 @@ def bench_data() -> None:
     Invoked as `python bench.py data`. Emits one JSON line; vs_baseline
     compares pipeline images/sec against the batch rate a 50%-MFU TPU step
     would demand (the pipeline must outrun the chip to keep it fed).
+
+    Regimes measured per run (ISSUE 2):
+      * headline — default config (fast parser + decode cache + decode-time
+        ROI from the model preprocessor's crop spec) at default workers;
+      * worker sweep — parse_workers in {1, 2}, each with cold (no cache),
+        fast (cache) and SpecParser-oracle legs: the first measured
+        multi-worker scaling points;
+      * ROI attribution — the cold leg with ROI disabled (full-frame
+        decode, the r06 path) under identical content;
+      * content continuity — uniform-noise-frame cold legs (ROI on/off),
+        directly comparable to the r05/r06 series (see
+        _camera_like_frames for why noise is not the headline content).
     """
     import os
     import tempfile
@@ -351,9 +468,10 @@ def bench_data() -> None:
     jax.config.update("jax_platforms", "cpu")
     metric = "qtopt_input_pipeline_images_per_sec"
     try:
-        from tensor2robot_tpu.data import tfrecord
+        from tensor2robot_tpu.data import tfrecord, wire
         from tensor2robot_tpu.data.dataset import (
             RecordDataset,
+            default_decode_roi,
             default_parse_backend,
             default_parse_fast,
             default_parse_workers,
@@ -371,23 +489,65 @@ def bench_data() -> None:
             "features": model.preprocessor.get_in_feature_specification("train"),
             "labels": model.preprocessor.get_in_label_specification("train"),
         }
-        from tensor2robot_tpu.data import wire
-
         n_records = int(os.environ.get("BENCH_DATA_RECORDS", "256"))
         batch_size = int(os.environ.get("BENCH_DATA_BATCH", "64"))
+        content = os.environ.get("BENCH_DATA_CONTENT", "camera")
+        if content not in ("camera", "noise"):
+            raise ValueError(
+                f"BENCH_DATA_CONTENT must be camera|noise, got {content!r}"
+            )
+        image_spec = specs["features"]["state/image"]
+        src_h, src_w = int(image_spec.shape[0]), int(image_spec.shape[1])
+        # The preprocessor's crop spec, as a decode-time ROI (the same map
+        # DefaultRecordInputGenerator forwards in training).
+        roi_map = {
+            f"features/{key}": value
+            for key, value in model.preprocessor.get_decode_rois(
+                "train"
+            ).items()
+        }
+        roi_spec = next(iter(roi_map.values()))
+        # Decoded images per record, from the spec: every rate in the
+        # payload (sweep legs included) reports images/sec, not records/sec.
+        n_images = max(
+            sum(
+                1
+                for s in specs["features"].values()
+                if getattr(s, "data_format", None)
+            ),
+            1,
+        )
         rng_values = make_random_numpy(specs, batch_size=n_records, seed=0)
-        with tempfile.TemporaryDirectory() as tmp:
-            path = os.path.join(tmp, "bench.tfrecord")
+
+        def write_records(path, frames):
             records = []
             for i in range(n_records):
                 row = {
                     key: np.asarray(value[i])
                     for key, value in rng_values.items()
                 }
+                row["features/state/image"] = frames[i]
                 records.append(encode_example(specs, row))
             tfrecord.write_tfrecords(path, records)
 
-            def run_leg(n_batches, parse_fast, cache_mb):
+        with tempfile.TemporaryDirectory() as tmp:
+            camera_path = os.path.join(tmp, "camera.tfrecord")
+            noise_path = os.path.join(tmp, "noise.tfrecord")
+            write_records(
+                camera_path, _camera_like_frames(n_records, src_h, src_w, 7)
+            )
+            write_records(
+                noise_path,
+                np.random.RandomState(0).randint(
+                    0, 256, (n_records, src_h, src_w, 3), dtype=np.uint8
+                ),
+            )
+            headline_path = camera_path if content == "camera" else noise_path
+
+            def run_leg(
+                n_batches, parse_fast, cache_mb, workers=None, roi=True,
+                path=None,
+            ):
                 """Records/sec through the full pipeline for one config."""
                 saved = os.environ.get("T2R_DECODE_CACHE_MB")
                 os.environ["T2R_DECODE_CACHE_MB"] = str(cache_mb)
@@ -395,12 +555,14 @@ def bench_data() -> None:
                 try:
                     dataset = RecordDataset(
                         specs=specs,
-                        file_patterns=path,
+                        file_patterns=path or headline_path,
                         batch_size=batch_size,
                         mode="train",
                         shuffle_buffer_size=128,
                         seed=1,
                         parse_fast=parse_fast,
+                        num_parse_workers=workers,
+                        decode_roi=roi_map if roi else None,
                     )
                     it = iter(dataset)
                     # Warm two full epochs before timing: spins up the pool
@@ -454,10 +616,10 @@ def bench_data() -> None:
             warmup_batches = 2 * max(1, -(-n_records // batch_size))
             cache_mb = wire.default_decode_cache_mb()
             parse_fast_default = default_parse_fast()
+            roi_enabled = default_decode_roi()
             # Headline: the default configuration (wire-format fast parser,
-            # decode cache on — both overridable via T2R_PARSE_FAST /
-            # T2R_DECODE_CACHE_MB). Side legs quantify each mechanism: the
-            # cold fast path (cache off) and the SpecParser oracle.
+            # decode cache on, decode-time ROI — overridable via
+            # T2R_PARSE_FAST / T2R_DECODE_CACHE_MB / T2R_DECODE_ROI).
             records_per_sec, cache_stats, window_rates = run_leg(
                 n_batches, parse_fast=parse_fast_default, cache_mb=cache_mb
             )
@@ -467,12 +629,50 @@ def bench_data() -> None:
             slow_records_per_sec, _, _ = run_leg(
                 side_batches, parse_fast=False, cache_mb=0
             )
-        # Count decoded images per record from the spec.
-        flat = model.preprocessor.get_in_feature_specification("train")
-        n_images = sum(
-            1 for s in flat.values() if getattr(s, "data_format", None)
-        )
-        images_per_sec = records_per_sec * max(n_images, 1)
+            # ROI attribution: the identical cold leg with full-frame
+            # decode (the r06 path) on the same records.
+            cold_noroi_records_per_sec, _, _ = run_leg(
+                side_batches, parse_fast=True, cache_mb=0, roi=False
+            )
+            # First measured multi-worker scaling points (VERDICT r5
+            # missing #5): cold/fast/oracle per worker count. Even
+            # oversubscribed on a 2-cpu host this pins per-worker overhead.
+            worker_sweep = {}
+            for workers in (1, 2):
+                cold_w, _, _ = run_leg(
+                    side_batches, parse_fast=True, cache_mb=0, workers=workers
+                )
+                fast_w, _, _ = run_leg(
+                    side_batches,
+                    parse_fast=parse_fast_default,
+                    cache_mb=cache_mb,
+                    workers=workers,
+                )
+                oracle_w, _, _ = run_leg(
+                    side_batches, parse_fast=False, cache_mb=0, workers=workers
+                )
+                worker_sweep[str(workers)] = {
+                    "cold_images_per_sec": round(cold_w * n_images, 2),
+                    "fast_images_per_sec": round(fast_w * n_images, 2),
+                    "specparser_images_per_sec": round(
+                        oracle_w * n_images, 2
+                    ),
+                }
+            # Continuity with the r05/r06 series: uniform-noise frames,
+            # cold, ROI on and off. (When the headline content IS noise,
+            # these equal the cold legs above; skip the duplicate work.)
+            if content == "camera":
+                noise_cold, _, _ = run_leg(
+                    side_batches, parse_fast=True, cache_mb=0, path=noise_path
+                )
+                noise_cold_noroi, _, _ = run_leg(
+                    side_batches, parse_fast=True, cache_mb=0, roi=False,
+                    path=noise_path,
+                )
+            else:
+                noise_cold = cold_records_per_sec
+                noise_cold_noroi = cold_noroi_records_per_sec
+        images_per_sec = records_per_sec * n_images
         # A 50%-MFU step on v5e consumes ~2.3 batches/sec at bs64 (from the
         # analytic FLOPs of the full tower): the demand the pipeline must
         # meet. FLOPs are computed at the measured batch so the ratio stays
@@ -491,22 +691,58 @@ def bench_data() -> None:
                     "parse_workers": default_parse_workers(),
                     "parse_backend": default_parse_backend(),
                     "parse_fast": parse_fast_default,
+                    "content": content,
+                    "content_note": (
+                        "camera-like frames (smooth background + objects "
+                        "+ sensor noise; see bench._camera_like_frames) — "
+                        "r05/r06 used uniform-noise frames, jpeg's entropy "
+                        "worst case; their directly-comparable legs ride "
+                        "in noise_content"
+                    ),
+                    "decode_roi": roi_enabled,
+                    "roi": {
+                        "keys": sorted(roi_map.keys()),
+                        "crop": [roi_spec.height, roi_spec.width],
+                        "source": [src_h, src_w],
+                        "mode": roi_spec.mode,
+                    },
                     "warmup_batches": warmup_batches,
                     "timing": "median_of_3_windows",
                     "window_images_per_sec": [
-                        round(r * max(n_images, 1), 2) for r in window_rates
+                        round(r * n_images, 2) for r in window_rates
                     ],
                     "decode_cache_mb": cache_mb,
                     "decode_cache": cache_stats,
                     "fast_no_cache_images_per_sec": round(
-                        cold_records_per_sec * max(n_images, 1), 2
+                        cold_records_per_sec * n_images, 2
+                    ),
+                    "cold_noroi_images_per_sec": round(
+                        cold_noroi_records_per_sec * n_images, 2
+                    ),
+                    "roi_cold_speedup": round(
+                        cold_records_per_sec
+                        / max(cold_noroi_records_per_sec, 1e-9),
+                        3,
                     ),
                     "specparser_images_per_sec": round(
-                        slow_records_per_sec * max(n_images, 1), 2
+                        slow_records_per_sec * n_images, 2
                     ),
                     "fast_vs_specparser": round(
                         records_per_sec / slow_records_per_sec, 2
                     ),
+                    "worker_sweep": worker_sweep,
+                    "noise_content": {
+                        "cold_images_per_sec": round(
+                            noise_cold * n_images, 2
+                        ),
+                        "cold_noroi_images_per_sec": round(
+                            noise_cold_noroi * n_images, 2
+                        ),
+                        "note": (
+                            "uniform-noise frames — direct continuation "
+                            "of the r05/r06 cold series (r06 cold: 209.85)"
+                        ),
+                    },
                     "host_cpus": os.cpu_count(),
                     "demand_images_per_sec_at_50pct_mfu": round(demand, 2),
                 },
@@ -680,7 +916,13 @@ def bench_auc() -> None:
                 "value": round(delta, 4),
                 "unit": "auc_delta",
                 # Budget: <=0.02 (BASELINE.md); <1 means within budget.
+                # vs_baseline on a budget-DELTA metric reads like a
+                # throughput ratio at first glance (VERDICT r5 weak #6);
+                # fraction_of_budget is the same number under its honest
+                # name (vs_baseline stays for cross-artifact tooling).
                 "vs_baseline": round(delta / 0.02, 4),
+                "fraction_of_budget": round(delta / 0.02, 4),
+                "budget": 0.02,
                 "detail": {
                     "auc_f32": round(auc_f32, 4),
                     "auc_bf16": round(auc_bf16, 4),
@@ -703,7 +945,7 @@ def bench_auc() -> None:
                         else {}
                     ),
                 },
-                **_proxy_fields(on_tpu),
+                **_proxy_fields(on_tpu, "qtopt_bf16_eval_auc_delta"),
             }
         )
     except Exception as err:  # noqa: BLE001
@@ -870,7 +1112,7 @@ def bench_predict() -> None:
                         else {}
                     ),
                 },
-                **_proxy_fields(on_tpu),
+                **_proxy_fields(on_tpu, "qtopt_cem_predict_hz"),
             }
         )
     except Exception as err:
@@ -1059,7 +1301,7 @@ def bench_bc() -> None:
                         else {}
                     ),
                 },
-                **_proxy_fields(on_tpu),
+                **_proxy_fields(on_tpu, "transformer_bc_train_mfu"),
             }
         )
     except Exception as err:  # noqa: BLE001
@@ -1147,7 +1389,7 @@ def bench_stream() -> None:
                         else {}
                     ),
                 },
-                **_proxy_fields(on_tpu),
+                **_proxy_fields(on_tpu, "streaming_bc_policy_steps_per_sec"),
             }
         )
     except Exception as err:  # noqa: BLE001
@@ -1324,7 +1566,7 @@ def bench_pipe() -> None:
                         else {}
                     ),
                 },
-                **_proxy_fields(on_tpu),
+                **_proxy_fields(on_tpu, "qtopt_e2e_pipeline_steps_per_sec"),
             }
         )
     except Exception as err:  # noqa: BLE001
@@ -1667,7 +1909,7 @@ def main() -> None:
                         else {}
                     ),
                 },
-                **_proxy_fields(on_tpu),
+                **_proxy_fields(on_tpu, "qtopt_critic_train_mfu"),
             }
         )
     except Exception as err:

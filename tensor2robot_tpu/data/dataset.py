@@ -32,6 +32,11 @@ import numpy as np
 
 from tensor2robot_tpu.data import tfrecord
 from tensor2robot_tpu.data.parser import SpecParser
+from tensor2robot_tpu.data.roi import (
+    DecodeROI,
+    normalize_decode_rois,
+    resolve_decode_rois,
+)
 from tensor2robot_tpu.data.wire import FastSpecParser
 from tensor2robot_tpu.specs import TensorSpecStruct
 
@@ -194,6 +199,20 @@ def default_parse_fast() -> bool:
     return env == "1"
 
 
+def default_decode_roi() -> bool:
+    """Whether decode-time ROI cropping (data/roi.py) is honored.
+
+    T2R_DECODE_ROI=0 makes RecordDataset IGNORE any decode_roi request:
+    image fields then decode full-frame and the consumer crops, exactly
+    the pre-ROI pipeline. The gate sits at the dataset so one env flip
+    restores the old path end to end (bench A/Bs, regression bisects).
+    """
+    env = os.environ.get("T2R_DECODE_ROI", "1")
+    if env not in ("0", "1"):
+        raise ValueError(f"T2R_DECODE_ROI must be '0' or '1', got {env!r}")
+    return env == "1"
+
+
 def default_parse_shm() -> bool:
     """Whether the process backend returns batches via shared memory.
 
@@ -283,27 +302,40 @@ def _regroup_chunk(chunk):
     return chunk
 
 
-def _parse_with(parser: SpecParser, chunk) -> TensorSpecStruct:
+def _split_payload(payload):
+    """A parse payload is either a plain chunk (the pre-ROI wire format,
+    unchanged) or ("roi", chunk, {key: ResolvedROI}) when decode-time ROI
+    is active — the offsets were resolved once in the parent so thread
+    and process workers (and a fast-path fallback) all crop identically."""
+    if isinstance(payload, tuple) and len(payload) == 3 and payload[0] == "roi":
+        return payload[1], payload[2]
+    return payload, None
+
+
+def _parse_with(parser: SpecParser, chunk, roi=None) -> TensorSpecStruct:
     """Parses one chunk (multi-dataset rows regrouped by key) — the single
     implementation both the thread and process backends run."""
-    return parser.parse_batch(_regroup_chunk(chunk))
+    return parser.parse_batch(_regroup_chunk(chunk), roi=roi)
 
 
 def _parse_chunk_impl(
-    fast_state: Optional[_FastParseState], parser: SpecParser, chunk
+    fast_state: Optional[_FastParseState], parser: SpecParser, payload
 ) -> TensorSpecStruct:
     """Fast wire-format parse with automatic SpecParser fallback.
 
     Any fast-path failure re-parses the batch with the oracle: genuinely
     bad data then raises the canonical error; a fast-path limitation
-    degrades to slow-but-correct. test_fast_parser.py pins the parity."""
+    degrades to slow-but-correct. A ROI payload falls back with the SAME
+    resolved offsets, so the oracle reproduces the identical batch.
+    test_fast_parser.py / test_roi_decode.py pin the parity."""
+    chunk, roi = _split_payload(payload)
     fast = fast_state.parser if fast_state is not None else None
     if fast is not None:
         try:
-            return fast.parse_batch(_regroup_chunk(chunk))
+            return fast.parse_batch(_regroup_chunk(chunk), roi=roi)
         except Exception:
             fast_state.note_fallback()
-    return _parse_with(parser, chunk)
+    return _parse_with(parser, chunk, roi=roi)
 
 
 def _shm_attach(name: str):
@@ -566,6 +598,13 @@ class RecordDataset:
       parse_fast: use the wire-format fast parser (data/wire.py) with
         automatic SpecParser fallback; None -> default_parse_fast()
         (env T2R_PARSE_FAST, default on).
+      decode_roi: optional {flat spec key: DecodeROI} — decode-time crop
+        of the named image fields (data/roi.py): batches then carry the
+        cropped shape and the decoder skips the pixels outside the
+        window. Offsets resolve per chunk (random mode draws from this
+        dataset's seeded RNG BEFORE decode); honored only while
+        T2R_DECODE_ROI=1 (the default) — T2R_DECODE_ROI=0 restores
+        full-frame decode exactly.
       shard_by_host: in multi-host runs, each process reads only its
         round-robin slice of the file list (the reference's per-host
         infeed, utils/tfdata.py:38-61); batch_size is then the PER-HOST
@@ -588,9 +627,15 @@ class RecordDataset:
         num_parse_workers: Optional[int] = None,
         parse_backend: Optional[str] = None,
         parse_fast: Optional[bool] = None,
+        decode_roi: Optional[Mapping[str, DecodeROI]] = None,
         shard_by_host: bool = False,
     ):
         self._specs = specs
+        self._decode_roi = (
+            normalize_decode_rois(decode_roi, specs)
+            if decode_roi and default_decode_roi()
+            else None
+        )
         self._process_pool: Optional[concurrent.futures.Executor] = None
         self._parse_backend = (
             default_parse_backend() if parse_backend is None else parse_backend
@@ -705,13 +750,29 @@ class RecordDataset:
 
     def _chunks(self) -> Iterator:
         stream = self._record_stream()
+        roi_rng = (
+            np.random.default_rng(self._seed) if self._decode_roi else None
+        )
         while True:
             chunk = list(itertools.islice(stream, self._batch_size))
             if not chunk:
                 return
             if len(chunk) < self._batch_size and self._drop_remainder:
                 return
-            yield chunk
+            if self._decode_roi is None:
+                yield chunk
+                continue
+            # Offsets resolve HERE, once per chunk, in the parent: every
+            # consumer of this payload (thread worker, process worker,
+            # oracle fallback after a fast-path failure) crops with the
+            # same rects, so the batch is reproducible across paths.
+            yield (
+                "roi",
+                chunk,
+                resolve_decode_rois(
+                    self._decode_roi, self._specs, len(chunk), roi_rng
+                ),
+            )
 
     def _parse_chunk(self, chunk) -> TensorSpecStruct:
         return _parse_chunk_impl(self._fast_state, self._parser, chunk)

@@ -43,6 +43,9 @@ class AbstractInputGenerator(abc.ABC):
         self._batch_size = batch_size
         self._feature_spec: Optional[TensorSpecStruct] = None
         self._label_spec: Optional[TensorSpecStruct] = None
+        # {mode: {combined-spec key: DecodeROI}} captured from the model's
+        # preprocessor — record datasets crop at decode time (data/roi.py).
+        self._decode_rois_by_mode: dict = {}
 
     @property
     def batch_size(self) -> int:
@@ -75,12 +78,28 @@ class AbstractInputGenerator(abc.ABC):
         preprocessor = model.preprocessor
         self._feature_spec = preprocessor.get_in_feature_specification(mode)
         self._label_spec = preprocessor.get_in_label_specification(mode)
+        # Decode-time ROIs travel with the specs: the preprocessor's crop
+        # becomes the dataset's decode window (keys shift to the combined
+        # "features/..." namespace the dataset parses under). Honoring is
+        # still gated by T2R_DECODE_ROI inside RecordDataset.
+        get_rois = getattr(preprocessor, "get_decode_rois", None)
+        rois = get_rois(mode) if callable(get_rois) else None
+        self._decode_rois_by_mode[mode] = (
+            {f"features/{key}": roi for key, roi in rois.items()}
+            if rois
+            else None
+        )
 
     def set_specification(
         self, feature_spec: TensorSpecStruct, label_spec: Optional[TensorSpecStruct]
     ) -> None:
         self._feature_spec = feature_spec
         self._label_spec = label_spec
+        self._decode_rois_by_mode = {}
+
+    def decode_rois(self, mode: str):
+        """The decode-time ROI map captured for `mode`, or None."""
+        return self._decode_rois_by_mode.get(mode)
 
     def combined_spec(self) -> TensorSpecStruct:
         spec = TensorSpecStruct()
@@ -145,6 +164,7 @@ class DefaultRecordInputGenerator(AbstractInputGenerator):
             file_fraction=self._file_fraction,
             prefetch_depth=self._prefetch_depth,
             num_parse_workers=self._num_parse_workers,
+            decode_roi=self.decode_rois(mode),
             shard_by_host=self._shard_by_host,
         )
         return iter(dataset)
@@ -225,6 +245,7 @@ class WeightedRecordInputGenerator(AbstractInputGenerator):
                 batch_size=self._batch_size,
                 mode=mode,
                 seed=self._seed,
+                decode_roi=self.decode_rois(mode),
                 **self._kwargs,
             )
             for patterns in self._sources

@@ -89,6 +89,25 @@ def _load_jpeg_native_locked(ctypes, os, subprocess):
             ctypes.POINTER(ctypes.c_int),
             ctypes.POINTER(ctypes.c_int),
         ]
+        try:
+            lib.t2r_decode_jpeg_roi.restype = ctypes.c_int
+            lib.t2r_decode_jpeg_roi.argtypes = [
+                ctypes.c_char_p,
+                ctypes.c_size_t,
+                ctypes.c_void_p,
+                ctypes.c_size_t,
+                ctypes.c_int,
+                ctypes.c_int,
+                ctypes.c_int,
+                ctypes.c_int,
+                ctypes.c_int,
+                ctypes.POINTER(ctypes.c_int),
+                ctypes.POINTER(ctypes.c_int),
+            ]
+        except AttributeError:
+            # A stale .so from before the ROI entry point existed: the
+            # full-frame path still works; ROI decode falls back.
+            pass
         _jpeg_lib = lib
     except Exception:
         _jpeg_lib_failed = True
@@ -131,6 +150,140 @@ def decode_image_into_native(data: bytes, out: np.ndarray) -> bool:
         ctypes.byref(w),
     )
     return rc == 0 and (h.value, w.value) == tuple(out.shape[:2])
+
+
+# -- ROI (cropped) decode -----------------------------------------------------
+# The native ROI entry point (t2r_decode_jpeg_roi) skips rows outside the
+# crop window before IDCT/upsampling and trims columns at iMCU granularity;
+# the claim that its output is BIT-IDENTICAL to full-decode-then-crop is
+# verified empirically, once per process, by `_roi_native_ok` below —
+# decoded pixels must never depend on which libjpeg the host happens to
+# ship. On canary failure (or no ROI API in the .so) every ROI decode
+# falls back to full decode + numpy crop: slower, identical pixels.
+_roi_native_state: Optional[bool] = None
+
+
+def _roi_native_ok() -> bool:
+    """One-time self-test: ROI decode == full decode + crop on this host.
+
+    Exercises sub-MCU offsets and window edges on a deterministic
+    synthetic image at the default (4:2:0) and 4:4:4 subsamplings — the
+    cases where libjpeg's cropped fancy-upsampling could diverge from a
+    full decode if the margin handling in jpeg_decode.cc were wrong.
+    """
+    global _roi_native_state
+    if _roi_native_state is not None:
+        return _roi_native_state
+    lib = _load_jpeg_native()
+    if lib is None or not hasattr(lib, "t2r_decode_jpeg_roi"):
+        _roi_native_state = False
+        return False
+    try:
+        import io
+
+        from PIL import Image
+
+        rng = np.random.RandomState(0)
+        src = rng.randint(0, 256, (48, 64, 3), dtype=np.uint8)
+        ok = True
+        for subsampling in (2, 0):  # 4:2:0 (PIL default) and 4:4:4
+            buf = io.BytesIO()
+            Image.fromarray(src).save(
+                buf, format="JPEG", quality=90, subsampling=subsampling
+            )
+            data = buf.getvalue()
+            full = np.empty((48, 64, 3), np.uint8)
+            if not decode_image_into_native(data, full):
+                ok = False
+                break
+            for rect in ((0, 0, 48, 64), (17, 23, 23, 29), (7, 3, 41, 61)):
+                y, x, th, tw = rect
+                out = np.empty((th, tw, 3), np.uint8)
+                if not _roi_decode_into(lib, data, out, y, x, (48, 64)):
+                    ok = False
+                    break
+                if not np.array_equal(out, full[y : y + th, x : x + tw]):
+                    ok = False
+                    break
+            if not ok:
+                break
+        _roi_native_state = ok
+    except Exception:
+        _roi_native_state = False
+    return _roi_native_state
+
+
+def _roi_decode_into(lib, data: bytes, out: np.ndarray, y: int, x: int,
+                     expected_hw) -> bool:
+    """Raw native ROI call; False on any failure or source-dim mismatch."""
+    import ctypes
+
+    fh = ctypes.c_int()
+    fw = ctypes.c_int()
+    rc = lib.t2r_decode_jpeg_roi(
+        data,
+        len(data),
+        ctypes.c_void_p(out.ctypes.data),
+        out.nbytes,
+        3,
+        y,
+        x,
+        out.shape[0],
+        out.shape[1],
+        ctypes.byref(fh),
+        ctypes.byref(fw),
+    )
+    return rc == 0 and (fh.value, fw.value) == tuple(expected_hw)
+
+
+def decode_image_roi_into_native(
+    data: bytes, out: np.ndarray, y: int, x: int, expected_hw
+) -> bool:
+    """ROI-decodes a jpeg window directly INTO `out` (uint8, th x tw x 3).
+
+    `expected_hw` is the source image's (H, W) from the spec: a source
+    whose real dimensions differ must fail here so the caller's fallback
+    path raises the canonical shape error instead of silently cropping a
+    different geometry. Returns False on any mismatch/failure (slot
+    contents then undefined; caller falls back to full decode + crop).
+    """
+    lib = _load_jpeg_native()
+    if lib is None or not _roi_native_ok():
+        return False
+    if out.dtype != np.uint8 or out.ndim != 3 or out.shape[-1] != 3:
+        return False
+    if not out.flags.c_contiguous:
+        return False
+    return _roi_decode_into(lib, data, out, y, x, expected_hw)
+
+
+def decode_image_roi(
+    data: bytes, spec: ExtendedTensorSpec, y: int, x: int, th: int, tw: int
+) -> np.ndarray:
+    """Decodes only the (y, x, th, tw) window of an encoded image.
+
+    Bit-identical to `decode_image(data, spec)[y:y+th, x:x+tw]` by
+    construction: the native path's parity is canary-verified
+    (`_roi_native_ok`), and the fallback literally full-decodes and
+    crops. Empty data yields a zero window (the zero-image fallback,
+    cropped)."""
+    shape = tuple(spec.shape[-3:]) if len(spec.shape) >= 3 else tuple(spec.shape)
+    if any(d is None for d in shape):
+        raise ValueError(f"Image spec {spec.name!r} must have static H/W/C, got {shape}")
+    if not data:
+        return np.zeros((th, tw) + shape[2:], dtype=canonical_dtype(spec.dtype))
+    if (
+        len(shape) == 3
+        and shape[-1] == 3
+        and spec.data_format
+        and spec.data_format.lower() in ("jpeg", "jpg")
+        and data[:2] == b"\xff\xd8"
+        and canonical_dtype(spec.dtype) == np.dtype(np.uint8)
+    ):
+        out = np.empty((th, tw, 3), np.uint8)
+        if decode_image_roi_into_native(data, out, y, x, shape[:2]):
+            return out
+    return decode_image(data, spec)[y : y + th, x : x + tw]
 
 
 def _decode_jpeg_native(data: bytes, shape) -> Optional[np.ndarray]:
@@ -406,8 +559,15 @@ class SpecParser:
         return out
 
     def parse_batch(
-        self, serialized_batch: Union[Sequence[bytes], Mapping[str, Sequence[bytes]]]
+        self,
+        serialized_batch: Union[Sequence[bytes], Mapping[str, Sequence[bytes]]],
+        roi: Optional[Mapping[str, Any]] = None,
     ) -> TensorSpecStruct:
+        """Parses + stacks a batch; `roi` ({key: ResolvedROI}) crops the
+        named image fields AFTER the full decode — the ground-truth
+        semantics decode-time ROI (data/wire.py) must reproduce bit for
+        bit. Offsets are resolved by the caller so a fast-path fallback
+        re-parse produces the identical batch."""
         if isinstance(serialized_batch, Mapping):
             n = len(next(iter(serialized_batch.values())))
             rows = [
@@ -442,4 +602,8 @@ class SpecParser:
         for key in self._bf16_keys:
             if key in out:
                 out[key] = out[key].astype(jnp.bfloat16)
+        if roi:
+            from tensor2robot_tpu.data.roi import apply_roi_to_batch
+
+            apply_roi_to_batch(out, roi)
         return out

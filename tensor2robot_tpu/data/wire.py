@@ -56,7 +56,9 @@ import numpy as np
 from tensor2robot_tpu.data.parser import (
     decode_image,
     decode_image_into_native,
+    decode_image_roi_into_native,
 )
+from tensor2robot_tpu.data.roi import ResolvedROI
 from tensor2robot_tpu.specs import (
     ExtendedTensorSpec,
     TensorSpecStruct,
@@ -369,6 +371,25 @@ class DecodeCache:
                 _, (old_data, old_value) = self._entries.popitem(last=False)
                 self._bytes -= old_value.nbytes + len(old_data)
 
+    def thrashing(self) -> bool:
+        """True when the cache is full and hits are negligible — the
+        working set provably does not fit the byte budget (FIFO eviction
+        under a cyclic epoch scan then yields ~0 hits forever). Callers
+        use this to stop paying population costs for entries that will be
+        evicted before they can ever be served: specifically, randomized-
+        ROI decode stops full-frame decoding to feed the cache and drops
+        to the pure (cheaper) ROI decode. Thresholds: full means >=90% of
+        budget, negligible means <5% hit rate over >=512 lookups — a set
+        that fits reaches a high hit rate by its second epoch, well
+        before a full-at-512-lookups cache can misclassify it (the
+        default 512 MB budget holds ~380 full QT-Opt frames)."""
+        total = self.hits + self.misses
+        return (
+            total >= 512
+            and self._bytes * 10 >= self.capacity_bytes * 9
+            and self.hits * 20 < total
+        )
+
     def stats(self) -> Dict[str, float]:
         total = self.hits + self.misses
         return {
@@ -558,12 +579,17 @@ class _CompiledField:
         span: Tuple[int, int],
         out_slice: np.ndarray,
         cache: Optional[DecodeCache],
+        rect: Optional[Tuple[int, int, int, int]] = None,
+        randomized: bool = False,
     ) -> None:
         off, ln = span
         if ln == 0:
             out_slice[...] = 0
             return
         data = record[off : off + ln]
+        if rect is not None:
+            self._decode_one_image_roi(data, out_slice, cache, rect, randomized)
+            return
         if cache is not None:
             hit = cache.get(self.cache_sig, data)
             if hit is not None:
@@ -583,16 +609,98 @@ class _CompiledField:
         if cache is not None:
             cache.put(self.cache_sig, data, np.ascontiguousarray(arr))
 
+    def _roi_decode(self, data, out_slice, y, x, th, tw) -> None:
+        """ROI decode into the slot: native when possible, else full
+        decode + crop (bit-identical either way). The fallback goes
+        straight to `decode_image` — native eligibility was just decided
+        here, and `decode_image_roi`'s own native attempt would re-parse
+        the jpeg header a second time on every deterministic failure
+        (e.g. a progressive-jpeg dataset)."""
+        if (
+            self.native_image_ok
+            and data[:2] == b"\xff\xd8"
+            and out_slice.flags.c_contiguous
+            and decode_image_roi_into_native(
+                data, out_slice, y, x, self.image_shape[:2]
+            )
+        ):
+            return
+        out_slice[...] = decode_image(data, self.spec)[y : y + th, x : x + tw]
+
+    def _decode_one_image_roi(
+        self, data, out_slice, cache, rect, randomized
+    ) -> None:
+        """Cropped decode with an offset-repetition-aware cache policy.
+
+        Static offsets (center/fixed crops — eval) repeat every epoch, so
+        the cache keys on (sig, rect) and stores the CROPPED window: the
+        same byte budget then holds ~1/(crop fraction) more frames. Random
+        offsets (the training crop) almost never repeat — keying on them
+        would miss every epoch — so the cache keeps the FULL frame under
+        the plain sig (shared with non-ROI decode) and serves each fresh
+        window as a slice copy; only the cache-MISS decode pays full price
+        (exactly the r06 cost), and hits get cheaper (window-sized copy).
+
+        Scale guard: when the training set exceeds the byte budget, FIFO
+        eviction under the cyclic epoch scan means ~every lookup misses —
+        paying a full-frame decode per record to populate entries that
+        evict before they serve would erase the ROI win entirely. Once the
+        cache reports `thrashing()` (full + negligible hits), randomized
+        ROI stops feeding it and decodes just the window, recovering the
+        cold-path ROI speedup at any dataset scale.
+        """
+        y, x, th, tw = rect
+        if cache is not None and randomized:
+            hit = cache.get(self.cache_sig, data)
+            if hit is not None:
+                out_slice[...] = hit[y : y + th, x : x + tw]
+                return
+            if cache.thrashing():
+                self._roi_decode(data, out_slice, y, x, th, tw)
+                return
+            arr = decode_image(data, self.spec)
+            out_slice[...] = arr[y : y + th, x : x + tw]
+            cache.put(self.cache_sig, data, np.ascontiguousarray(arr))
+            return
+        if cache is not None:
+            sig = (self.cache_sig, y, x, th, tw)
+            hit = cache.get(sig, data)
+            if hit is not None:
+                out_slice[...] = hit
+                return
+            self._roi_decode(data, out_slice, y, x, th, tw)
+            cache.put(sig, data, out_slice.copy())
+            return
+        self._roi_decode(data, out_slice, y, x, th, tw)
+
     def fill_image(
         self,
         record: bytes,
         feature: _Feature,
         out_slice: np.ndarray,
         cache: Optional[DecodeCache],
+        rect: Optional[Tuple[int, int, int, int]] = None,
+        randomized: bool = False,
     ) -> None:
         kind, spans, scalars = feature
         if kind != 1 or scalars is not None:
             raise FastParseError(f"image feature {self.key!r} not bytes_list")
+        if rect is not None:
+            # normalize_decode_rois restricts ROI to single-image specs;
+            # this guards the invariant if a caller bypasses it.
+            if self.stack_size is not None:
+                raise FastParseError(
+                    f"ROI decode unsupported for image stack {self.key!r}"
+                )
+            if len(spans) != 1:
+                raise FastParseError(
+                    f"feature {self.key!r} holds {len(spans)} images, spec "
+                    "declares one"
+                )
+            self._decode_one_image(
+                record, spans[0], out_slice, cache, rect, randomized
+            )
+            return
         if self.varlen and self.stack_size is not None:
             target = self.stack_size
             keep = min(len(spans), target)
@@ -660,6 +768,7 @@ class _CompiledGroup:
         records: Sequence[bytes],
         out: Dict[str, np.ndarray],
         cache: Optional[DecodeCache],
+        roi: Optional[Mapping[str, ResolvedROI]] = None,
     ) -> None:
         n = len(records)
         scans = [scan_record(bytes(r), self.is_sequence) for r in records]
@@ -681,6 +790,29 @@ class _CompiledGroup:
                     "or all-absent within a batch."
                 )
             if field.is_image_field():
+                resolved = roi.get(field.key) if roi else None
+                if resolved is not None:
+                    if len(resolved.ys) != n:
+                        raise FastParseError(
+                            f"ResolvedROI for {field.key!r} has "
+                            f"{len(resolved.ys)} offsets, batch holds {n}"
+                        )
+                    batch = np.empty(
+                        (n, resolved.height, resolved.width)
+                        + tuple(field.shape[2:]),
+                        dtype=field.out_dtype,
+                    )
+                    for i in range(n):
+                        field.fill_image(
+                            records[i],
+                            features[i],
+                            batch[i],
+                            cache,
+                            rect=resolved.rect(i),
+                            randomized=resolved.randomized,
+                        )
+                    out[field.key] = batch
+                    continue
                 batch = np.empty(
                     (n,) + tuple(field.shape), dtype=field.out_dtype
                 )
@@ -776,7 +908,11 @@ class FastSpecParser:
         self,
         serialized_batch: Union[Sequence[bytes], Mapping[str, Sequence[bytes]]],
         cache: Optional[DecodeCache] = None,
+        roi: Optional[Mapping[str, ResolvedROI]] = None,
     ) -> TensorSpecStruct:
+        """Fast parse; `roi` ({flat key: ResolvedROI}) decodes the named
+        image fields cropped (decode-time ROI) — bit-identical to
+        `SpecParser.parse_batch(..., roi=roi)`'s full-decode-then-crop."""
         if not self.supported:
             raise FastParseError(
                 f"unsupported spec structure: {self.unsupported_reason}"
@@ -801,7 +937,7 @@ class FastSpecParser:
                 raise KeyError(
                     f"Missing serialized record for dataset {dataset_key!r}"
                 )
-            group.parse_into(by_key[dataset_key], flat, cache)
+            group.parse_into(by_key[dataset_key], flat, cache, roi)
         out = TensorSpecStruct()
         for key, value in flat.items():
             out[key] = value

@@ -14,6 +14,19 @@
 //                   &h, &w) -> 0 on success, negative on failure.
 //     want_channels: 3 (RGB) or 1 (grayscale); the decoder converts
 //     whatever subsampling/colorspace the file uses.
+//   t2r_decode_jpeg_roi(data, len, out, out_capacity, want_channels,
+//                       crop_y, crop_x, crop_h, crop_w, &full_h, &full_w)
+//     -> decode ONLY the crop window into `out` (crop_h x crop_w x C).
+//     Rows above the window are skipped before IDCT/upsampling
+//     (jpeg_skip_scanlines), rows below are never read
+//     (jpeg_abort_decompress), and columns are trimmed at iMCU
+//     granularity (jpeg_crop_scanline); the sub-MCU horizontal residual
+//     is resolved by decoding the MCU-aligned span into a scratch row
+//     and memcpy'ing the requested window — so the output is
+//     bit-identical to a full decode followed by the same crop.
+//     Requires the libjpeg-turbo API (Makefile probes jpeglib.h and
+//     defines T2R_HAVE_JPEG_ROI); without it the entry point returns -6
+//     and the Python caller falls back to full-decode-then-crop.
 //
 // libjpeg's default error handler calls exit(); a setjmp-based handler
 // turns decode errors into error returns instead.
@@ -96,6 +109,150 @@ int t2r_decode_jpeg(const unsigned char* data, size_t len,
   jpeg_finish_decompress(&cinfo);
   jpeg_destroy_decompress(&cinfo);
   return 0;
+}
+
+// Returns 0 on success; -1 bad args, -2 decode error, -3 buffer too
+// small, -4 unsupported channel request, -5 crop outside the image,
+// -6 ROI API not compiled in, -7 progressive source (ROI skip is not
+// worth it there: progressive decode buffers whole passes anyway).
+int t2r_decode_jpeg_roi(const unsigned char* data, size_t len,
+                        unsigned char* out, size_t out_capacity,
+                        int want_channels, int crop_y, int crop_x,
+                        int crop_h, int crop_w, int* full_height,
+                        int* full_width) {
+#ifndef T2R_HAVE_JPEG_ROI
+  (void)data; (void)len; (void)out; (void)out_capacity;
+  (void)want_channels; (void)crop_y; (void)crop_x; (void)crop_h;
+  (void)crop_w; (void)full_height; (void)full_width;
+  return -6;
+#else
+  if (data == nullptr || out == nullptr || len == 0) return -1;
+  if (want_channels != 1 && want_channels != 3) return -4;
+  if (crop_y < 0 || crop_x < 0 || crop_h <= 0 || crop_w <= 0) return -5;
+
+  jpeg_decompress_struct cinfo;
+  ErrorMgr err;
+  cinfo.err = jpeg_std_error(&err.pub);
+  err.pub.error_exit = error_exit;
+  err.pub.emit_message = emit_message;
+  if (setjmp(err.jump)) {
+    jpeg_destroy_decompress(&cinfo);
+    return -2;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<unsigned char*>(data),
+               static_cast<unsigned long>(len));
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return -2;
+  }
+  if (cinfo.progressive_mode) {
+    jpeg_destroy_decompress(&cinfo);
+    return -7;
+  }
+  cinfo.out_color_space = (want_channels == 3) ? JCS_RGB : JCS_GRAYSCALE;
+  jpeg_start_decompress(&cinfo);
+
+  *full_height = static_cast<int>(cinfo.output_height);
+  *full_width = static_cast<int>(cinfo.output_width);
+  if (crop_y + crop_h > *full_height || crop_x + crop_w > *full_width) {
+    jpeg_abort_decompress(&cinfo);
+    jpeg_destroy_decompress(&cinfo);
+    return -5;
+  }
+  const size_t out_stride =
+      static_cast<size_t>(crop_w) * cinfo.output_components;
+  if (out_stride * static_cast<size_t>(crop_h) > out_capacity) {
+    jpeg_abort_decompress(&cinfo);
+    jpeg_destroy_decompress(&cinfo);
+    return -3;
+  }
+
+  // Fancy upsampling (the libjpeg default, and what a full decode uses)
+  // reads neighboring chroma samples; at the edges of a cropped span it
+  // falls back to edge replication, which changes the boundary pixels.
+  // A full decode only replicates at the true image edges — so to stay
+  // bit-identical we decode a MARGIN around the requested window (2 px,
+  // then iMCU-aligned, clamped to the image) and slice the exact window
+  // out. The margin is at most one extra iMCU row/column of work.
+  const int mcu_w = cinfo.max_h_samp_factor * DCTSIZE;
+  const int mcu_h = cinfo.max_v_samp_factor * DCTSIZE;
+  const int margin = 2;
+
+  // Columns: trim to the iMCU span covering the margin-padded window.
+  // jpeg_crop_scanline aligns xoff DOWN and widens the span; the
+  // sub-MCU residual `lead` is sliced off each scratch row below.
+  const int left = crop_x > margin ? (crop_x - margin) / mcu_w * mcu_w : 0;
+  const int right =
+      crop_x + crop_w + margin < *full_width ? crop_x + crop_w + margin
+                                             : *full_width;
+  JDIMENSION xoff = static_cast<JDIMENSION>(left);
+  JDIMENSION xw = static_cast<JDIMENSION>(right - left);
+  jpeg_crop_scanline(&cinfo, &xoff, &xw);
+  if (static_cast<JDIMENSION>(crop_x) < xoff ||
+      static_cast<JDIMENSION>(crop_x + crop_w) > xoff + xw) {
+    jpeg_abort_decompress(&cinfo);
+    jpeg_destroy_decompress(&cinfo);
+    return -2;
+  }
+  const size_t lead =
+      (static_cast<size_t>(crop_x) - xoff) * cinfo.output_components;
+  const JDIMENSION span_stride = xw * cinfo.output_components;
+
+  // Scratch rows come from libjpeg's image-lifetime pool, freed by
+  // jpeg_destroy_decompress on every exit path (including longjmp).
+  const JDIMENSION n_scratch = 4;
+  JSAMPARRAY scratch = (*cinfo.mem->alloc_sarray)(
+      reinterpret_cast<j_common_ptr>(&cinfo), JPOOL_IMAGE, span_stride,
+      n_scratch);
+
+  // Rows above the window: skip whole iMCU rows up to the margin-padded
+  // start (entropy decode still walks them — the bitstream is
+  // sequential — but IDCT/upsample/color-convert are bypassed), then
+  // decode-and-discard the residual margin rows so the upsampler enters
+  // the window with the same context a full decode would have.
+  JDIMENSION target = static_cast<JDIMENSION>(crop_y);
+  const JDIMENSION y_start = static_cast<JDIMENSION>(
+      crop_y > margin ? (crop_y - margin) / mcu_h * mcu_h : 0);
+  while (cinfo.output_scanline < y_start) {
+    if (jpeg_skip_scanlines(&cinfo, y_start - cinfo.output_scanline) == 0) {
+      jpeg_abort_decompress(&cinfo);
+      jpeg_destroy_decompress(&cinfo);
+      return -2;
+    }
+  }
+  while (cinfo.output_scanline < target) {
+    JDIMENSION want = target - cinfo.output_scanline;
+    if (want > n_scratch) want = n_scratch;
+    if (jpeg_read_scanlines(&cinfo, scratch, want) == 0) {
+      jpeg_abort_decompress(&cinfo);
+      jpeg_destroy_decompress(&cinfo);
+      return -2;
+    }
+  }
+
+  const JDIMENSION end = target + static_cast<JDIMENSION>(crop_h);
+  while (cinfo.output_scanline < end) {
+    JDIMENSION want = end - cinfo.output_scanline;
+    if (want > n_scratch) want = n_scratch;
+    JDIMENSION got = jpeg_read_scanlines(&cinfo, scratch, want);
+    if (got == 0) {
+      jpeg_abort_decompress(&cinfo);
+      jpeg_destroy_decompress(&cinfo);
+      return -2;
+    }
+    for (JDIMENSION r = 0; r < got; ++r) {
+      const size_t out_row = cinfo.output_scanline - got + r - target;
+      std::memcpy(out + out_row * out_stride, scratch[r] + lead,
+                  out_stride);
+    }
+  }
+
+  // Rows below the window are never decoded: abort instead of finish.
+  jpeg_abort_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return 0;
+#endif  // T2R_HAVE_JPEG_ROI
 }
 
 }  // extern "C"

@@ -55,6 +55,14 @@ def resolve_backward_mode() -> str:
 
     Returns "native" or "scatterfree"; unknown values fail fast (a typo
     silently selecting the slow backward would poison a benchmark round).
+
+    "auto" reports the path the CURRENT DEFAULT BACKEND would run — a
+    provenance answer (bench payloads), not a promise about every
+    execution: `max_pool`'s auto mode dispatches via
+    `lax.platform_dependent`, so the VJP is selected by each lowering's
+    actual platform and an AOT export compiled for a different backend
+    gets THAT backend's path, not this process's (ADVICE round-5). The
+    forced modes bake the named path in at trace time on every platform.
     """
     mode = os.environ.get("T2R_POOL_BACKWARD", "auto")
     if mode == "auto":
@@ -66,6 +74,18 @@ def resolve_backward_mode() -> str:
     return mode
 
 
+def _native_pool(
+    x: jax.Array, window: Tuple[int, int], padding: str
+) -> jax.Array:
+    dims = (1, window[0], window[1], 1)
+    # Init must be the -inf LITERAL: jax's reverse-mode rule for max
+    # pooling pattern-matches (literal init, lax.max) — a device-array
+    # init turns this into a general reduce_window with no transpose.
+    return lax.reduce_window(
+        x, -jnp.inf, lax.max, dims, dims, padding.upper()
+    )
+
+
 def max_pool(
     x: jax.Array, window: Tuple[int, int], padding: str = "SAME"
 ) -> jax.Array:
@@ -75,15 +95,24 @@ def max_pool(
     the paths differ only in the VJP (and in subgradient tie-breaking:
     native SelectAndScatter routes tied gradients to the first maximal
     element, scatter-free splits them equally — both valid subgradients).
+
+    Auto mode binds at LOWERING, not trace: `lax.platform_dependent`
+    embeds both formulations and selects by the platform each lowering
+    actually targets, so a computation traced on one backend but compiled
+    for another (AOT export, explicit backend= jit) runs the VJP that is
+    fast THERE. Forced modes (T2R_POOL_BACKWARD=native|scatterfree) stay
+    trace-time on purpose — they exist for A/B benches that must pin one
+    path everywhere.
     """
-    if resolve_backward_mode() == "native":
-        dims = (1, window[0], window[1], 1)
-        # Init must be the -inf LITERAL: jax's reverse-mode rule for max
-        # pooling pattern-matches (literal init, lax.max) — a device-array
-        # init turns this into a general reduce_window with no transpose.
-        return lax.reduce_window(
-            x, -jnp.inf, lax.max, dims, dims, padding.upper()
+    mode = os.environ.get("T2R_POOL_BACKWARD", "auto")
+    if mode == "auto" and hasattr(lax, "platform_dependent"):
+        return lax.platform_dependent(
+            x,
+            tpu=lambda x: _native_pool(x, window, padding),
+            default=lambda x: max_pool_nonoverlap(x, window, padding),
         )
+    if resolve_backward_mode() == "native":
+        return _native_pool(x, window, padding)
     return max_pool_nonoverlap(x, window, padding)
 
 

@@ -64,6 +64,20 @@ class AbstractPreprocessor(abc.ABC):
     def get_out_label_specification(self, mode: str) -> TensorSpecStruct:
         """Spec of the labels this preprocessor produces."""
 
+    # -- decode-time ROI ------------------------------------------------------
+
+    def get_decode_rois(self, mode: str):
+        """Optional {in-feature key: data.roi.DecodeROI} describing crops
+        the DATA LAYER may apply at jpeg-decode time instead of this
+        preprocessor applying them on device (the pixels are identical;
+        see data/roi.py). The input generator forwards the map to
+        RecordDataset; `preprocess` then accepts the named features at
+        either the source or the cropped shape, and `_preprocess_fn`
+        must skip its own crop when the input already arrives cropped.
+        Base: no ROIs (None)."""
+        del mode
+        return None
+
     # -- transform ------------------------------------------------------------
 
     @abc.abstractmethod
@@ -88,8 +102,19 @@ class AbstractPreprocessor(abc.ABC):
         flatten(out-spec) (reference :172-218)."""
         if mode not in ALL_MODES:
             raise ValueError(f"mode must be one of {ALL_MODES}, got {mode!r}")
+        in_feature_spec = self.get_in_feature_specification(mode)
+        decode_rois = self.get_decode_rois(mode)
+        if decode_rois:
+            # Features named in the decode-ROI map may arrive already
+            # cropped (a ROI-decoding dataset) or at the source shape
+            # (direct feeds / T2R_DECODE_ROI=0); accept exactly those two.
+            from tensor2robot_tpu.data.roi import adjust_spec_for_roi_tensors
+
+            in_feature_spec = adjust_spec_for_roi_tensors(
+                in_feature_spec, decode_rois, features
+            )
         packed_features = validate_and_pack(
-            self.get_in_feature_specification(mode), features, ignore_batch=True
+            in_feature_spec, features, ignore_batch=True
         )
         packed_labels = None
         if labels is not None:

@@ -48,7 +48,14 @@ class DefaultGrasping44ImagePreprocessor(SpecTransformationPreprocessor):
     otherwise) -> float [0,1] -> train-only photometric distortion
     (reference t2r_models.py:241-307). For models configured with a smaller
     `image_size`, the source keeps the reference's crop slack (+40 rows,
-    +168 cols)."""
+    +168 cols).
+
+    The crop is also published as a decode-time ROI (`get_decode_rois`):
+    a ROI-enabled RecordDataset then decodes ONLY the crop window on the
+    host (identical pixels — data/roi.py) and this preprocessor skips its
+    own crop, keeping float conversion + distortion on device. Crop
+    randomness moves with the crop: decode-time random offsets come from
+    the dataset's seeded numpy RNG instead of this step's `rng` key."""
 
     def _target_shape(self):
         model_image = self._model.get_feature_specification(
@@ -70,25 +77,48 @@ class DefaultGrasping44ImagePreprocessor(SpecTransformationPreprocessor):
         )
         return spec
 
+    def get_decode_rois(self, mode):
+        from tensor2robot_tpu.data.roi import DecodeROI
+
+        th, tw = self._target_shape()
+        return {
+            "state/image": DecodeROI(
+                th, tw, mode="random" if mode == MODE_TRAIN else "center"
+            )
+        }
+
     def _preprocess_fn(self, features, labels, mode, rng):
         image = features.state.image
         target_shape = self._target_shape()
-        # No rng = no stochastic augmentation (deterministic center crop),
-        # matching the framework-wide None-rng convention; silently reusing
-        # a fixed key would repeat identical distortions every batch.
+        # Decode-time ROI (get_decode_rois) may have cropped already — the
+        # image then arrives at the target shape and the crop here must
+        # not re-crop. Static shape check, so jit traces the right branch.
+        # NOTE: for pre-cropped inputs the crop offsets (random in train)
+        # were drawn by the DATASET's seeded numpy RNG at decode time, so
+        # the None-rng convention below governs only the photometric
+        # distortion — a train batch from a ROI dataset is random-cropped
+        # even when rng is None. Feed source-shaped images (or set
+        # T2R_DECODE_ROI=0) where the deterministic center crop matters.
+        cropped = tuple(image.shape[-3:-1]) == tuple(target_shape)
+        # No rng = no stochastic augmentation (deterministic center crop
+        # when cropping here), matching the framework-wide None-rng
+        # convention; silently reusing a fixed key would repeat identical
+        # distortions every batch.
         if mode == MODE_TRAIN and rng is not None:
             rng_crop, rng_distort = jax.random.split(rng)
-            image = image_transformations.random_crop_image_batch(
-                rng_crop, image, target_shape
-            )
+            if not cropped:
+                image = image_transformations.random_crop_image_batch(
+                    rng_crop, image, target_shape
+                )
             image = image.astype(jnp.float32) / 255.0
             image = image_transformations.apply_photometric_image_distortions(
                 rng_distort, image
             )
         else:
-            image = image_transformations.center_crop_image_batch(
-                image, target_shape
-            )
+            if not cropped:
+                image = image_transformations.center_crop_image_batch(
+                    image, target_shape
+                )
             image = image.astype(jnp.float32) / 255.0
         features.state.image = image
         return features, labels

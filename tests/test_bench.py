@@ -46,6 +46,10 @@ def test_bench_mfu_contract():
     # detail-channel backend note.
     assert payload["proxy"] is True
     assert "vs_baseline_note" in payload
+    # The proxy self-description includes the on-chip pointer: this repo
+    # carries committed TPU headline artifacts, so it must resolve.
+    assert payload["last_onchip"] is not None
+    assert payload["last_onchip"]["metric"].startswith("qtopt_critic_train_mfu")
     detail = payload["detail"]
     assert detail["steps_per_sec"] > 0
     assert detail["per_step_dispatch_avg_steps_per_sec"] > 0
@@ -89,6 +93,23 @@ def test_overlap_fields_clamp():
     }
 
 
+def test_last_onchip_pointer():
+    """Unit-pins _last_onchip (VERDICT r5 next #7): the pointer finds the
+    newest committed real-hardware artifact of a metric family, skips
+    proxies/failures, and degrades to None for unknown families."""
+    sys.path.insert(0, REPO_ROOT)
+    import bench
+
+    pointer = bench._last_onchip("qtopt_critic_train_mfu")
+    assert pointer is not None
+    assert pointer["metric"].startswith("qtopt_critic_train_mfu")
+    assert "cpu_proxy" not in pointer["metric"]
+    assert pointer["artifact"].endswith(".json")
+    # Strict UTC ISO-8601 Zulu (sortable, timezone-unambiguous).
+    assert pointer["utc"].endswith("Z") and "T" in pointer["utc"]
+    assert bench._last_onchip("metric_family_that_never_existed") is None
+
+
 def test_analytic_flops_width_scaling():
     """The width knob reaches the analytic FLOPs model: the c128 twin's
     conv tower must cost ~4x the reference 64-wide tower (c_in*c_out)."""
@@ -130,6 +151,23 @@ def test_bench_data_contract():
         cache = detail["decode_cache"]
         assert cache["hits"] + cache["misses"] > 0
         assert 0.0 <= cache["hit_rate"] <= 1.0
+    # ISSUE 2 tentpole provenance: decode-ROI config, the ROI-off cold
+    # attribution twin, the content mode + its r06-continuity legs, and
+    # the first measured parse_workers sweep.
+    assert detail["content"] == "camera"
+    assert detail["decode_roi"] in (True, False)
+    assert detail["roi"]["crop"] == [472, 472]
+    assert detail["roi"]["source"] == [512, 640]
+    assert detail["roi"]["mode"] == "random"
+    assert detail["cold_noroi_images_per_sec"] > 0
+    assert detail["roi_cold_speedup"] > 0
+    assert set(detail["worker_sweep"].keys()) == {"1", "2"}
+    for legs in detail["worker_sweep"].values():
+        assert legs["cold_images_per_sec"] > 0
+        assert legs["fast_images_per_sec"] > 0
+        assert legs["specparser_images_per_sec"] > 0
+    assert detail["noise_content"]["cold_images_per_sec"] > 0
+    assert detail["noise_content"]["cold_noroi_images_per_sec"] > 0
 
 
 @pytest.mark.slow
@@ -170,6 +208,16 @@ def test_bench_auc_contract():
     assert payload["unit"] == "auc_delta"
     assert 0.0 <= payload["value"] <= 1.0
     assert "error" not in payload
+    # Budget-delta metrics name their ratio honestly (VERDICT r5 weak #6):
+    # fraction_of_budget == vs_baseline == value / budget, budget explicit.
+    assert payload["budget"] == 0.02
+    assert payload["fraction_of_budget"] == payload["vs_baseline"]
+    assert payload["fraction_of_budget"] == pytest.approx(
+        payload["value"] / 0.02, abs=1e-3
+    )
+    # Proxy payloads point at the newest on-chip artifact of the family
+    # (VERDICT r5 next #7) — present even when None.
+    assert "last_onchip" in payload
     detail = payload["detail"]
     assert detail["backend"] == "cpu"
     assert detail["f32_leg_precision"] == "true_f32"

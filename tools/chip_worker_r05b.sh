@@ -96,10 +96,20 @@ leg_cpu() {  # total jiffies (utime+stime) of pid $1, 0 if gone
   awk '{print $14 + $15}' "/proc/$1/stat" 2>/dev/null || echo 0
 }
 
+# Set when run_leg abandons a wedged client mid-pass: the rest of the
+# pass must NOT launch more legs next to a possibly-still-attached jax
+# client (sole-TPU-owner rule) — every later run_leg call no-ops and the
+# pass falls through to the next tunnel_alive probe (ADVICE round-5).
+leg_wedged=""
+
 run_leg() {  # run_leg <artifact> <grep> <message> <env...> -- <cmd...>
   local artifact="$1" pattern="$2" message="$3"; shift 3
   local -a envs=()
   while [ "$1" != "--" ]; do envs+=("$1"); shift; done; shift
+  if [ -n "$leg_wedged" ]; then
+    log "skip $artifact (pass abandoned after a wedged leg; re-probing tunnel first)"
+    return 1
+  fi
   if have "$artifact" "$pattern"; then
     log "skip $artifact (already captured)"; return 0
   fi
@@ -119,8 +129,9 @@ run_leg() {  # run_leg <artifact> <grep> <message> <env...> -- <cmd...>
     if [ "$cpu" != "$last_cpu" ]; then last_cpu="$cpu"; frozen_s=0
     else frozen_s=$((frozen_s + 30)); fi
     if [ "$elapsed" -ge 1200 ] && [ "$frozen_s" -ge 600 ]; then
-      log "$artifact leg wedged (pid $leg_pid: ${elapsed}s elapsed, cpu frozen ${frozen_s}s); abandoning wait, NOT signaling"
+      log "$artifact leg wedged (pid $leg_pid: ${elapsed}s elapsed, cpu frozen ${frozen_s}s); abandoning wait, NOT signaling; breaking pass back to tunnel probe"
       abandoned_pids="$abandoned_pids $leg_pid"
+      leg_wedged=1
       return 1
     fi
   done
@@ -141,6 +152,7 @@ for i in $(seq 1 "$tries"); do
     log "tunnel down ($i/$tries)"; sleep "$sleep_s"; continue
   fi
   if abandoned_revived; then sleep "$sleep_s"; continue; fi
+  leg_wedged=""
   log "tunnel alive — running chain (pass $i)"
 
   # 1. Loop-close: the post-pool-fix headline (the official bench.py
