@@ -33,6 +33,8 @@ import sys
 import time
 import traceback
 
+from tensor2robot_tpu import flags as t2r_flags
+
 # Per-chip peak dense bf16 FLOPS by device kind.
 _PEAK_FLOPS = {
     "TPU v4": 275e12,
@@ -279,7 +281,7 @@ def _pool_backward_mode() -> str:
     from tensor2robot_tpu.ops.pooling import resolve_backward_mode
 
     resolved = resolve_backward_mode()
-    if os.environ.get("T2R_POOL_BACKWARD", "auto") == "auto":
+    if t2r_flags.get_enum("T2R_POOL_BACKWARD") == "auto":
         return f"auto:{resolved}"
     return resolved
 
@@ -549,8 +551,8 @@ def bench_data() -> None:
                 path=None,
             ):
                 """Records/sec through the full pipeline for one config."""
-                saved = os.environ.get("T2R_DECODE_CACHE_MB")
-                os.environ["T2R_DECODE_CACHE_MB"] = str(cache_mb)
+                saved = t2r_flags.read_raw("T2R_DECODE_CACHE_MB")
+                t2r_flags.write_env("T2R_DECODE_CACHE_MB", cache_mb)
                 wire.reset_decode_cache()
                 try:
                     dataset = RecordDataset(
@@ -603,10 +605,7 @@ def bench_data() -> None:
                     rate = sorted(window_rates)[len(window_rates) // 2]
                     return rate, stats, window_rates
                 finally:
-                    if saved is None:
-                        os.environ.pop("T2R_DECODE_CACHE_MB", None)
-                    else:
-                        os.environ["T2R_DECODE_CACHE_MB"] = saved
+                    t2r_flags.restore_env("T2R_DECODE_CACHE_MB", saved)
                     wire.reset_decode_cache()
 
             n_batches = int(os.environ.get("BENCH_DATA_BATCHES", "24"))

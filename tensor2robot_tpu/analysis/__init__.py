@@ -1,0 +1,54 @@
+"""Static analysis: the spec contract and the flag/native discipline,
+checked ahead of time.
+
+Three passes, all host-only (no accelerator, no real data):
+
+  * `specflow` — propagates shapes/dtypes abstractly from feature/label
+    specs through each registered preprocessor (including the decode-ROI
+    dual-shape contract) into the model signature via `jax.eval_shape`,
+    so a spec/preprocessor/model mismatch fails in seconds on a laptop
+    instead of minutes into a pod allocation.
+  * `lints` — AST rules over the package source: every `T2R_*` env gate
+    must go through the `tensor2robot_tpu.flags` registry, no host numpy
+    materialization inside jitted regions, and the shm-ring/lock
+    discipline in the process-worker return path.
+  * sanitizer pass — `make -C native sanitize` builds the wire/jpeg
+    parsers under ASan/UBSan and drives them over a malformed-record
+    corpus (tools/gen_fuzz_corpus.py); wired in tools/t2r_check.py.
+
+Entry point: `python tools/t2r_check.py` (docs/static_analysis.md).
+"""
+
+# Re-exports resolve lazily (PEP 562): the lint pass must run even when
+# the package under lint is import-broken mid-refactor (lints.py works on
+# source text), and `t2r-check --lint-only` must not pay specflow's jax
+# import. Eager imports here would couple all three passes together.
+_EXPORTS = {
+    "Diagnostic": "tensor2robot_tpu.analysis.diagnostics",
+    "format_diagnostics": "tensor2robot_tpu.analysis.diagnostics",
+    "lint_paths": "tensor2robot_tpu.analysis.lints",
+    "lint_source": "tensor2robot_tpu.analysis.lints",
+    "check_model": "tensor2robot_tpu.analysis.specflow",
+    "check_targets": "tensor2robot_tpu.analysis.specflow",
+    "CheckTarget": "tensor2robot_tpu.analysis.targets",
+    "default_targets": "tensor2robot_tpu.analysis.targets",
+    "register_target": "tensor2robot_tpu.analysis.targets",
+    "corpus": "tensor2robot_tpu.analysis",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name):
+    if name == "corpus":
+        import importlib
+
+        return importlib.import_module("tensor2robot_tpu.analysis.corpus")
+    module_name = _EXPORTS.get(name)
+    if module_name is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        )
+    import importlib
+
+    return getattr(importlib.import_module(module_name), name)

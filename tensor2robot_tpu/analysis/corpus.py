@@ -1,0 +1,305 @@
+"""Malformed/truncated-record corpus generation for the sanitizer pass.
+
+The native parsers' safety argument is only as strong as the inputs
+thrown at them. This module manufactures the nasty ones — around a seed
+of VALID artifacts (a real TFRecord file of spec-conforming Examples, a
+real jpeg) it derives the corruption families the wire format admits:
+
+  * truncations at every structurally interesting boundary (mid-header,
+    mid-payload, mid-crc) plus a sweep of arbitrary cuts;
+  * bit flips at seeded offsets (CRC-caught and CRC-missed regions);
+  * protobuf pathologies inside the record payload: varint runs longer
+    than 10 bytes, varints with no terminator, LEN fields whose length
+    points past EOF, deeply nested LEN frames;
+  * jpeg pathologies: headers whose SOF dimensions lie about the frame,
+    truncated entropy data, garbage with a valid SOI, EOF mid-marker;
+  * seeded random insertion mutations (deterministic by design — see
+    random_mutations; the hypothesis-driven exploration lives in
+    tests/test_wire_fuzz.py where replay/shrinking are managed).
+
+The same corpus drives BOTH parser layers: the ASan/UBSan-built native
+driver (native/fuzz_driver.cc, via `make sanitize`) and the Python-level
+fuzz suite (tests/test_wire_fuzz.py) that asserts fallback-to-oracle
+semantics. `tools/gen_fuzz_corpus.py` is the CLI wrapper.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = [
+    "valid_example_records",
+    "valid_tfrecord_bytes",
+    "valid_jpeg_bytes",
+    "corrupt_record_variants",
+    "corrupt_jpeg_variants",
+    "protobuf_pathologies",
+    "random_mutations",
+    "write_corpus",
+]
+
+_SEED = 0x7273  # deterministic corpus: a crash names a reproducible file
+
+
+def _spec_family():
+    """A small spec structure covering every storage family the fast
+    parser compiles (floats, packed ints, varlen, jpeg image)."""
+    from tensor2robot_tpu.specs import ExtendedTensorSpec, TensorSpecStruct
+
+    spec = TensorSpecStruct()
+    spec["features/image"] = ExtendedTensorSpec(
+        shape=(24, 32, 3), dtype=np.uint8, name="image", data_format="jpeg"
+    )
+    spec["features/pose"] = ExtendedTensorSpec(
+        shape=(7,), dtype=np.float32, name="pose"
+    )
+    spec["features/step"] = ExtendedTensorSpec(
+        shape=(1,), dtype=np.int64, name="step"
+    )
+    spec["features/tags"] = ExtendedTensorSpec(
+        shape=(4,), dtype=np.int64, name="tags", varlen_default_value=0
+    )
+    spec["labels/reward"] = ExtendedTensorSpec(
+        shape=(1,), dtype=np.float32, name="reward"
+    )
+    return spec
+
+
+def valid_example_records(n: int = 4, seed: int = _SEED) -> List[bytes]:
+    """Serialized spec-conforming Examples (the corruption substrate)."""
+    from tensor2robot_tpu.data.encoder import encode_example
+    from tensor2robot_tpu.specs import make_random_numpy
+
+    spec = _spec_family()
+    values = make_random_numpy(spec, batch_size=n, seed=seed)
+    records = []
+    for i in range(n):
+        row = {key: np.asarray(value[i]) for key, value in values.items()}
+        records.append(encode_example(spec, row))
+    return records
+
+
+def fuzz_spec():
+    """The spec the valid records conform to (for parser-side fuzzing)."""
+    return _spec_family()
+
+
+def valid_tfrecord_bytes(seed: int = _SEED) -> bytes:
+    """A complete in-memory TFRecord file of valid Examples."""
+    from tensor2robot_tpu.data.tfrecord import masked_crc32c
+
+    out = bytearray()
+    for record in valid_example_records(seed=seed):
+        header = struct.pack("<Q", len(record))
+        out += header
+        out += struct.pack("<I", masked_crc32c(header))
+        out += record
+        out += struct.pack("<I", masked_crc32c(record))
+    return bytes(out)
+
+
+def valid_jpeg_bytes(
+    shape=(24, 32), seed: int = _SEED, progressive: bool = False
+) -> bytes:
+    import io
+
+    from PIL import Image
+
+    rng = np.random.RandomState(seed)
+    array = rng.randint(0, 256, shape + (3,), dtype=np.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(array).save(
+        buf, format="JPEG", quality=90, progressive=progressive
+    )
+    return buf.getvalue()
+
+
+# -- corruption families ------------------------------------------------------
+
+
+def corrupt_record_variants(seed: int = _SEED) -> Dict[str, bytes]:
+    """Truncated and bit-flipped TFRecord buffers."""
+    base = valid_tfrecord_bytes(seed)
+    rng = np.random.RandomState(seed + 1)
+    variants: Dict[str, bytes] = {}
+    # Structural truncation points of record 0: inside the length header
+    # (4), at the header/crc seam (8, 12), mid-payload, one byte short of
+    # the payload crc.
+    first_len = struct.unpack("<Q", base[:8])[0]
+    cuts = [4, 8, 12, 12 + first_len // 2, 12 + first_len + 3]
+    # Plus an arbitrary sweep across the whole file.
+    cuts += [int(c) for c in rng.randint(1, len(base), size=8)]
+    for cut in sorted(set(cuts)):
+        variants[f"rec_trunc_{cut:06d}"] = base[:cut]
+    for i, offset in enumerate(rng.randint(0, len(base), size=12)):
+        flipped = bytearray(base)
+        flipped[int(offset)] ^= 1 << int(rng.randint(0, 8))
+        variants[f"rec_bitflip_{i:02d}"] = bytes(flipped)
+    # A length field claiming nearly 2^64 (the overflow-check case).
+    huge = bytearray(base)
+    huge[:8] = struct.pack("<Q", (1 << 63) + 12345)
+    variants["rec_huge_length"] = bytes(huge)
+    # A length crc that matches a corrupted length (crc forged): payload
+    # bounds must still be enforced.
+    from tensor2robot_tpu.data.tfrecord import masked_crc32c
+
+    forged = bytearray(base)
+    bad_header = struct.pack("<Q", len(base) * 4)
+    forged[:8] = bad_header
+    forged[8:12] = struct.pack("<I", masked_crc32c(bad_header))
+    variants["rec_forged_length_crc"] = bytes(forged)
+    return variants
+
+
+def protobuf_pathologies() -> Dict[str, bytes]:
+    """Hand-written Example payloads abusing the proto wire format.
+
+    These are framed as VALID TFRecords (correct CRCs) whose payload
+    bytes are hostile — the layer under test is the Example scanner
+    (data/wire.py scan_record), not the container framing.
+    """
+    from tensor2robot_tpu.data.tfrecord import masked_crc32c
+
+    def frame(payload: bytes) -> bytes:
+        header = struct.pack("<Q", len(payload))
+        return (
+            header
+            + struct.pack("<I", masked_crc32c(header))
+            + payload
+            + struct.pack("<I", masked_crc32c(payload))
+        )
+
+    def keyed_feature(key: bytes, feature_payload: bytes) -> bytes:
+        entry = (
+            b"\x0a" + bytes([len(key)]) + key
+            + b"\x12" + bytes([len(feature_payload)]) + feature_payload
+        )
+        features = b"\x0a" + bytes([len(entry)]) + entry
+        return b"\x0a" + bytes([len(features)]) + features
+
+    cases: Dict[str, bytes] = {}
+    # int64_list with an 11-byte varint (shift overflow probe).
+    cases["pb_varint_11bytes"] = frame(
+        keyed_feature(b"step", b"\x1a\x0b" + b"\xff" * 10 + b"\x01")
+    )
+    # int64_list whose varint run never terminates (all continuation).
+    cases["pb_varint_no_end"] = frame(
+        keyed_feature(b"step", b"\x1a\x04" + b"\xff\xff\xff\xff")
+    )
+    # bytes entry whose LEN points past the end of the record.
+    cases["pb_len_past_eof"] = frame(
+        keyed_feature(b"image", b"\x0a\x7f" + b"\x00" * 4)
+    )
+    # Feature map entry whose inner frame overruns its declared length.
+    cases["pb_nested_overrun"] = frame(
+        b"\x0a\x06" + b"\x0a\x08" + b"\x00" * 4
+    )
+    # float_list with a payload not divisible by 4.
+    cases["pb_float_misaligned"] = frame(
+        keyed_feature(b"pose", b"\x12\x05" + b"\x0a\x03" + b"\x00\x00\x00")
+    )
+    # Deep LEN nesting (each level claims the rest of the buffer).
+    deep = b"\x01"
+    for _ in range(64):
+        deep = b"\x0a" + bytes([min(len(deep), 127)]) + deep
+    cases["pb_deep_nesting"] = frame(deep)
+    return cases
+
+
+def corrupt_jpeg_variants(seed: int = _SEED) -> Dict[str, bytes]:
+    """Jpeg byte strings whose structure lies, truncates, or is noise."""
+    rng = np.random.RandomState(seed + 2)
+    base = valid_jpeg_bytes(seed=seed)
+    variants: Dict[str, bytes] = {
+        "jpg_valid": base,
+        "jpg_progressive": valid_jpeg_bytes(seed=seed, progressive=True),
+    }
+    variants["jpg_trunc_header"] = base[:8]
+    variants["jpg_trunc_mid"] = base[: len(base) // 2]
+    variants["jpg_trunc_tail"] = base[:-2]
+    for i, offset in enumerate(rng.randint(2, len(base), size=6)):
+        flipped = bytearray(base)
+        flipped[int(offset)] ^= 0xFF
+        variants[f"jpg_bitflip_{i}"] = bytes(flipped)
+    # SOF dimension lies: the header claims a different geometry than the
+    # entropy-coded data carries; decode-into must bound writes by the
+    # CALLER buffer, and spec-shape checks must reject the frame.
+    sof = _find_sof(base)
+    if sof is not None:
+        for name, (h, w) in (
+            ("jpg_sof_lies_big", (4096, 4096)),
+            ("jpg_sof_lies_small", (1, 1)),
+            ("jpg_sof_lies_zero", (0, 0)),
+        ):
+            lied = bytearray(base)
+            lied[sof + 5 : sof + 7] = struct.pack(">H", h)
+            lied[sof + 7 : sof + 9] = struct.pack(">H", w)
+            variants[name] = bytes(lied)
+    variants["jpg_soi_only"] = b"\xff\xd8"
+    variants["jpg_soi_garbage"] = b"\xff\xd8" + bytes(
+        rng.randint(0, 256, size=512, dtype=np.uint8).tobytes()
+    )
+    variants["jpg_pure_noise"] = bytes(
+        rng.randint(0, 256, size=777, dtype=np.uint8).tobytes()
+    )
+    return variants
+
+
+def _find_sof(data: bytes) -> Optional[int]:
+    """Offset of the SOF0/SOF2 marker (0xFFC0/0xFFC2), or None."""
+    i = 2
+    while i + 4 <= len(data):
+        if data[i] != 0xFF:
+            return None
+        marker = data[i + 1]
+        if marker in (0xC0, 0xC1, 0xC2):
+            return i
+        if marker == 0xD8 or 0xD0 <= marker <= 0xD7:
+            i += 2
+            continue
+        seg_len = struct.unpack(">H", data[i + 2 : i + 4])[0]
+        i += 2 + seg_len
+    return None
+
+
+def random_mutations(count: int = 16, seed: int = _SEED) -> Dict[str, bytes]:
+    """Seeded random insertion mutations of the valid TFRecord file.
+
+    Deliberately NOT hypothesis-driven even when hypothesis is
+    installed: the corpus contract is determinism (a sanitizer crash
+    must name a file whose bytes the next run reproduces for the
+    bisect), and `strategy.example()` is random per process. The
+    hypothesis-powered exploration lives in tests/test_wire_fuzz.py
+    under `@given`, where the library manages shrinking and replay."""
+    base = valid_tfrecord_bytes(seed)
+    rng = np.random.RandomState(seed + 3)
+    out: Dict[str, bytes] = {}
+    for i in range(count):
+        offset = int(rng.randint(0, len(base)))
+        insert = rng.randint(
+            0, 256, size=int(rng.randint(1, 64)), dtype=np.uint8
+        ).tobytes()
+        out[f"rnd_mut_{i:02d}"] = base[:offset] + insert + base[offset:]
+    return out
+
+
+def write_corpus(directory: str, with_mutations: bool = True) -> List[str]:
+    """Materializes the full corpus; returns the written paths."""
+    os.makedirs(directory, exist_ok=True)
+    cases: Dict[str, bytes] = {"rec_valid": valid_tfrecord_bytes()}
+    cases.update(corrupt_record_variants())
+    cases.update(protobuf_pathologies())
+    cases.update(corrupt_jpeg_variants())
+    if with_mutations:
+        cases.update(random_mutations())
+    paths = []
+    for name, data in sorted(cases.items()):
+        path = os.path.join(directory, name)
+        with open(path, "wb") as f:
+            f.write(data)
+        paths.append(path)
+    return paths

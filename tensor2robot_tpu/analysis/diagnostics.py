@@ -1,0 +1,64 @@
+"""Diagnostic records shared by every t2r-check pass.
+
+A diagnostic is a compiler-style finding: `path:line: [rule] message`.
+The spec-flow pass anchors findings to the *source of the contract* (the
+preprocessor or model class definition line, via inspect) rather than to
+the framework frame that happened to raise — the person fixing a broken
+out-spec needs the class, not validate_and_flatten's internals.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import inspect
+import os
+from typing import Iterable, List, Optional, Tuple
+
+ERROR = "error"
+WARNING = "warning"
+
+
+@dataclasses.dataclass(frozen=True)
+class Diagnostic:
+    """One finding: where, which rule, what broke."""
+
+    path: str
+    line: int
+    rule: str
+    message: str
+    severity: str = ERROR
+
+    def format(self, root: Optional[str] = None) -> str:
+        path = self.path
+        if root:
+            try:
+                rel = os.path.relpath(path, root)
+                if not rel.startswith(".."):
+                    path = rel
+            except ValueError:
+                pass
+        # Collapse internal newlines: one diagnostic, one grep-able line.
+        message = " ".join(self.message.split())
+        return f"{path}:{self.line}: {self.severity}: [{self.rule}] {message}"
+
+
+def format_diagnostics(
+    diagnostics: Iterable[Diagnostic], root: Optional[str] = None
+) -> str:
+    return "\n".join(d.format(root) for d in diagnostics)
+
+
+def source_anchor(obj) -> Tuple[str, int]:
+    """(file, line) of a class/function definition, for anchoring a
+    contract diagnostic at the code that DECLARED the contract."""
+    try:
+        target = obj if inspect.isclass(obj) or inspect.isfunction(obj) else type(obj)
+        path = inspect.getsourcefile(target) or "<unknown>"
+        _, line = inspect.getsourcelines(target)
+        return path, line
+    except (OSError, TypeError):
+        return "<unknown>", 0
+
+
+def errors(diagnostics: Iterable[Diagnostic]) -> List[Diagnostic]:
+    return [d for d in diagnostics if d.severity == ERROR]

@@ -1,0 +1,463 @@
+"""Custom AST lints: project-specific discipline the type system can't see.
+
+Three rule families, each born from a real failure mode in this codebase:
+
+* Flag discipline (`env-*`) — PR 1-2 left ~10 `T2R_*` env gates read ad
+  hoc across six modules; two readers of one flag can drift in default
+  or accepted spellings. Every read/write of a `T2R_*` variable must go
+  through the `tensor2robot_tpu.flags` registry; direct `os.environ`
+  touches are flagged, as are registry calls naming undeclared flags,
+  getter/kind mismatches, and (on direct reads) defaults that disagree
+  with the declaration.
+
+* Jit discipline (`jit-host-numpy`) — a `np.*` materializing call inside
+  a jitted function silently forces the traced value to the host (a
+  ConcretizationTypeError at best, a per-step device->host sync at
+  worst). Functions decorated with `jax.jit`/`nn.jit` (or wrapped via
+  `jax.jit(fn)`/`partial(jax.jit, ...)`) must not call host numpy array
+  constructors/converters on traced data. Shape arithmetic (`np.prod`,
+  dtypes, constants) stays allowed — the blocklist names only
+  materializers — and `nn.compact` bodies are deliberately OUT of scope:
+  flax modules idiomatically build host-side constant masks/bins with
+  numpy there (XLA constant-folds them; no sync), and without dataflow
+  analysis flagging them is pure noise.
+
+* Shm-ring discipline (`shm-*`) — the process-worker return path
+  (data/dataset.py) cycles shared-memory slots worker->consumer through
+  a free-name queue. The protocol's liveness rests on three rules the
+  runtime cannot check: slots are created/unlinked ONLY by the ring
+  owner; the worker side NEVER blocks acquiring a slot (`get_nowait`,
+  fall back to inline returns); and release paths reachable from
+  `__del__` NEVER block returning one (`put_nowait`). Violations
+  deadlock a training job at arbitrary gc time — the worst possible
+  failure to debug on a pod.
+
+All rules run on source text: no imports of the linted code, so a broken
+module still lints.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Sequence, Set
+
+from tensor2robot_tpu.analysis.diagnostics import Diagnostic, ERROR
+
+__all__ = ["lint_source", "lint_paths", "DEFAULT_LINT_ROOTS"]
+
+# Files allowed to touch os.environ for T2R_* keys: the registry itself.
+_FLAG_REGISTRY_FILES = ("tensor2robot_tpu/flags.py",)
+
+# numpy calls that MATERIALIZE data on the host (traced-value poison
+# inside jit). Deliberately excludes shape/dtype arithmetic (np.prod,
+# np.dtype, np.float32, np.pi, ...) which is trace-safe and idiomatic.
+_NP_MATERIALIZERS = frozenset(
+    {
+        "array",
+        "asarray",
+        "ascontiguousarray",
+        "asfortranarray",
+        "frombuffer",
+        "fromiter",
+        "fromstring",
+        "copyto",
+        "zeros",
+        "zeros_like",
+        "ones",
+        "ones_like",
+        "empty",
+        "empty_like",
+        "full",
+        "full_like",
+        "arange",
+        "linspace",
+        "concatenate",
+        "stack",
+        "vstack",
+        "hstack",
+        "save",
+        "load",
+        "savez",
+    }
+)
+_NP_MODULE_ALIASES = frozenset({"np", "numpy"})
+
+_FLAG_GETTER_KINDS = {
+    "get_bool": "bool",
+    "get_int": "int",
+    "get_optional_int": "int",
+    "get_enum": "enum",
+    "get_str": "str",
+    "read_raw": None,  # kind-agnostic by design (save/restore)
+    "write_env": None,
+    "restore_env": None,
+    "get_flag": None,
+}
+
+
+def _flag_registry():
+    """{name: FlagSpec} from the live registry (lazy import: lints must
+    run even if package import order is mid-refactor)."""
+    try:
+        from tensor2robot_tpu import flags
+
+        return {spec.name: spec for spec in flags.all_flags()}
+    except Exception:
+        return {}
+
+
+def _canonical_default(spec) -> Optional[str]:
+    if spec.default is None:
+        return None
+    if spec.kind == "bool":
+        return "1" if spec.default else "0"
+    return str(spec.default)
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, path: str, registry: Dict[str, object]):
+        self.path = path
+        self.registry = registry
+        self.diagnostics: List[Diagnostic] = []
+        self.is_flags_module = any(
+            path.replace(os.sep, "/").endswith(suffix)
+            for suffix in _FLAG_REGISTRY_FILES
+        )
+        # Function names wrapped via jax.jit(fn) / partial(jax.jit, fn).
+        self.jit_wrapped: Set[str] = set()
+        self._class_stack: List[str] = []
+        self._func_stack: List[str] = []
+        self._jit_depth = 0
+
+    def _emit(self, node: ast.AST, rule: str, message: str) -> None:
+        self.diagnostics.append(
+            Diagnostic(
+                path=self.path,
+                line=getattr(node, "lineno", 0),
+                rule=rule,
+                message=message,
+                severity=ERROR,
+            )
+        )
+
+    # -- helpers --------------------------------------------------------------
+
+    @staticmethod
+    def _dotted(node: ast.AST) -> str:
+        """'a.b.c' for Name/Attribute chains, '' otherwise."""
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if isinstance(node, ast.Name):
+            parts.append(node.id)
+            return ".".join(reversed(parts))
+        return ""
+
+    @staticmethod
+    def _t2r_literal(node: ast.AST) -> Optional[str]:
+        if (
+            isinstance(node, ast.Constant)
+            and isinstance(node.value, str)
+            and node.value.startswith("T2R_")
+        ):
+            return node.value
+        return None
+
+    def _is_environ(self, node: ast.AST) -> bool:
+        return self._dotted(node) in (
+            "os.environ",
+            "environ",
+            "os.environb",
+        )
+
+    # -- flag discipline ------------------------------------------------------
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        if not self.is_flags_module and self._is_environ(node.value):
+            key = self._t2r_literal(node.slice)
+            if key is not None:
+                access = (
+                    "write" if isinstance(node.ctx, ast.Store) else "read"
+                )
+                self._emit(
+                    node,
+                    "env-undeclared",
+                    f"direct os.environ {access} of {key!r}; go through "
+                    "tensor2robot_tpu.flags "
+                    f"({'write_env' if access == 'write' else 'typed getters'})",
+                )
+        self.generic_visit(node)
+
+    def _check_environ_call(self, node: ast.Call) -> None:
+        dotted = self._dotted(node.func)
+        key_node: Optional[ast.AST] = None
+        if dotted in ("os.getenv",) and node.args:
+            key_node = node.args[0]
+        elif dotted.endswith("environ.get") or dotted.endswith(
+            "environ.setdefault"
+        ) or dotted.endswith("environ.pop"):
+            if self._is_environ(node.func.value) and node.args:
+                key_node = node.args[0]
+        if key_node is None:
+            return
+        key = self._t2r_literal(key_node)
+        if key is None:
+            return
+        self._emit(
+            node,
+            "env-undeclared",
+            f"direct os.environ access of {key!r}; go through "
+            "tensor2robot_tpu.flags",
+        )
+        # Bonus precision: a drifted inline default is usually the actual
+        # bug that motivated the read-site audit.
+        spec = self.registry.get(key)
+        if spec is not None and len(node.args) > 1:
+            default = node.args[1]
+            if isinstance(default, ast.Constant):
+                canonical = _canonical_default(spec)
+                if canonical is not None and str(default.value) != canonical:
+                    self._emit(
+                        node,
+                        "env-inconsistent-default",
+                        f"inline default {default.value!r} for {key} "
+                        f"disagrees with the registry default {canonical!r}",
+                    )
+
+    def _check_flags_call(self, node: ast.Call) -> None:
+        dotted = self._dotted(node.func)
+        parts = dotted.split(".")
+        if len(parts) < 2 or parts[-1] not in _FLAG_GETTER_KINDS:
+            return
+        if parts[-2] not in ("flags", "t2r_flags"):
+            return
+        if not node.args:
+            return
+        key = self._t2r_literal(node.args[0])
+        if key is None:
+            if isinstance(node.args[0], ast.Constant) and isinstance(
+                node.args[0].value, str
+            ):
+                self._emit(
+                    node,
+                    "env-unknown-flag",
+                    f"flags.{parts[-1]} of non-T2R name "
+                    f"{node.args[0].value!r}",
+                )
+            return
+        if not self.registry:
+            return
+        spec = self.registry.get(key)
+        if spec is None:
+            self._emit(
+                node,
+                "env-unknown-flag",
+                f"flags.{parts[-1]}({key!r}): flag is not declared in "
+                "tensor2robot_tpu/flags.py",
+            )
+            return
+        want = _FLAG_GETTER_KINDS[parts[-1]]
+        if want is not None and spec.kind != want:
+            self._emit(
+                node,
+                "env-kind-mismatch",
+                f"flags.{parts[-1]}({key!r}) but {key} is declared "
+                f"{spec.kind}",
+            )
+
+    # -- jit discipline -------------------------------------------------------
+
+    def _decorator_is_jit(self, decorator: ast.AST) -> bool:
+        dotted = self._dotted(decorator)
+        if dotted in ("jax.jit", "jit", "nn.jit"):
+            return True
+        if isinstance(decorator, ast.Call):
+            dotted = self._dotted(decorator.func)
+            if dotted in ("jax.jit", "jit", "nn.jit"):
+                return True
+            if dotted in ("partial", "functools.partial") and decorator.args:
+                return self._dotted(decorator.args[0]) in ("jax.jit", "jit")
+        return False
+
+    def _note_jit_wraps(self, node: ast.Call) -> None:
+        """fn = jax.jit(inner) / partial(jax.jit, inner): `inner` is jitted."""
+        dotted = self._dotted(node.func)
+        candidates: List[ast.AST] = []
+        if dotted in ("jax.jit", "jit", "nn.jit"):
+            candidates = list(node.args[:1])
+        elif dotted in ("partial", "functools.partial") and len(node.args) > 1:
+            if self._dotted(node.args[0]) in ("jax.jit", "jit"):
+                candidates = list(node.args[1:2])
+        for arg in candidates:
+            if isinstance(arg, ast.Name):
+                self.jit_wrapped.add(arg.id)
+
+    def _check_np_call(self, node: ast.Call) -> None:
+        if self._jit_depth == 0:
+            return
+        dotted = self._dotted(node.func)
+        parts = dotted.split(".")
+        if len(parts) < 2 or parts[0] not in _NP_MODULE_ALIASES:
+            return
+        if parts[1] == "random" or parts[-1] in _NP_MATERIALIZERS:
+            self._emit(
+                node,
+                "jit-host-numpy",
+                f"host numpy call {dotted}() inside a jitted region; use "
+                "jnp (or hoist the computation out of the traced function)",
+            )
+
+    # -- shm-ring discipline --------------------------------------------------
+
+    def _in_ring_class(self) -> bool:
+        return any(
+            "Ring" in name or "Shm" in name for name in self._class_stack
+        )
+
+    def _check_shm_call(self, node: ast.Call, func_stack: List[str]) -> None:
+        dotted = self._dotted(node.func)
+        # Slot lifecycle ownership.
+        if dotted.endswith("SharedMemory"):
+            creates = any(
+                kw.arg == "create"
+                and isinstance(kw.value, ast.Constant)
+                and kw.value.value is True
+                for kw in node.keywords
+            )
+            if creates and not self._in_ring_class():
+                self._emit(
+                    node,
+                    "shm-create-outside-ring",
+                    "SharedMemory(create=True) outside the ring owner; "
+                    "slots are created only by _ShmBatchRing so teardown "
+                    "can unlink every one",
+                )
+        if dotted.endswith(".unlink") and "shm" in dotted.split(".")[0].lower():
+            if not self._in_ring_class():
+                self._emit(
+                    node,
+                    "shm-unlink-outside-ring",
+                    f"{dotted}() outside the ring owner; a worker unlinking "
+                    "a live slot invalidates the consumer's views",
+                )
+        # Worker side must never block acquiring a slot.
+        if dotted.endswith(".get") and "free" in dotted.lower():
+            self._emit(
+                node,
+                "shm-blocking-get",
+                f"blocking {dotted}() on the free-slot queue; use "
+                "get_nowait() and fall back to the inline return path",
+            )
+        # Release paths reachable from __del__ must never block.
+        in_release = any(
+            name in ("release", "__del__") for name in func_stack
+        )
+        if (
+            in_release
+            and self._in_ring_class()
+            and dotted.endswith(".put")
+            and not dotted.endswith("put_nowait")
+        ):
+            self._emit(
+                node,
+                "shm-blocking-put-in-release",
+                f"blocking {dotted}() in a slot-release path (reachable "
+                "from __del__); use put_nowait()",
+            )
+
+    # -- traversal ------------------------------------------------------------
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._class_stack.append(node.name)
+        self.generic_visit(node)
+        self._class_stack.pop()
+
+    def _visit_function(self, node) -> None:
+        # Name-based wrap matching (`f = jax.jit(step)`) must not hit a
+        # METHOD that shares the local closure's name: jit wraps of
+        # methods spell `jax.jit(self.step)` (an Attribute), never a bare
+        # Name, so functions taking self/cls are exempt from name match.
+        args = node.args.posonlyargs + node.args.args
+        is_method = bool(args) and args[0].arg in ("self", "cls")
+        jitted = any(
+            self._decorator_is_jit(d) for d in node.decorator_list
+        ) or (not is_method and node.name in self.jit_wrapped)
+        self._func_stack.append(node.name)
+        if jitted:
+            self._jit_depth += 1
+        self.generic_visit(node)
+        if jitted:
+            self._jit_depth -= 1
+        self._func_stack.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._visit_function(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._visit_function(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        self._note_jit_wraps(node)
+        if not self.is_flags_module:
+            self._check_environ_call(node)
+        self._check_flags_call(node)
+        self._check_np_call(node)
+        self._check_shm_call(node, self._func_stack)
+        self.generic_visit(node)
+
+
+def lint_source(
+    source: str, path: str = "<memory>", registry=None
+) -> List[Diagnostic]:
+    """Lints one module's source text; returns its diagnostics."""
+    if registry is None:
+        registry = _flag_registry()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as err:
+        return [
+            Diagnostic(
+                path=path,
+                line=err.lineno or 0,
+                rule="syntax-error",
+                message=str(err.msg),
+                severity=ERROR,
+            )
+        ]
+    # Two passes so `fn = jax.jit(inner)` marks `inner` even when the
+    # wrap happens after (or above) the def.
+    prepass = _Visitor(path, registry)
+    prepass.visit(tree)
+    visitor = _Visitor(path, registry)
+    visitor.jit_wrapped = prepass.jit_wrapped
+    visitor.visit(tree)
+    return visitor.diagnostics
+
+
+# Default lint scope: the package plus the repo-level python entry points.
+DEFAULT_LINT_ROOTS = ("tensor2robot_tpu", "bench.py", "tools")
+
+
+def lint_paths(paths: Sequence[str], root: Optional[str] = None) -> List[Diagnostic]:
+    """Lints every .py under the given files/directories."""
+    registry = _flag_registry()
+    diagnostics: List[Diagnostic] = []
+    files: List[str] = []
+    for entry in paths:
+        full = entry if os.path.isabs(entry) else os.path.join(root or ".", entry)
+        if os.path.isdir(full):
+            for dirpath, dirnames, filenames in os.walk(full):
+                dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+                files.extend(
+                    os.path.join(dirpath, f)
+                    for f in sorted(filenames)
+                    if f.endswith(".py")
+                )
+        elif full.endswith(".py") and os.path.exists(full):
+            files.append(full)
+    for path in files:
+        with open(path, "r", encoding="utf-8") as f:
+            diagnostics.extend(lint_source(f.read(), path, registry))
+    return diagnostics

@@ -1,0 +1,332 @@
+"""Spec-flow checking: the layer-0 -> layer-2 contract, machine-checked.
+
+The framework's core promise is that typed tensor specs DRIVE everything:
+the parse pipeline materializes the preprocessor's in-specs, the
+preprocessor transforms them to its out-specs, and the model consumes
+exactly those. Every link is validated at runtime — which on a TPU pod
+means step 1 of a job that took minutes to schedule. This pass runs the
+whole chain abstractly on the host in seconds:
+
+  1. spec surface — all four preprocessor specs and both model specs
+     must be constructible, and the preprocessor's out-specs must cover
+     the model's in-specs key-by-key with matching shape/dtype;
+  2. decode-ROI contract — `get_decode_rois` maps must validate against
+     the in-feature specs (eligible image specs, crops inside the
+     source), the dual-shape contract introduced in PR 2;
+  3. abstract execution — `jax.eval_shape` runs preprocess ->
+     init_variables -> inference -> train loss over ShapeDtypeStructs
+     built from the specs: shapes and dtypes propagate through the REAL
+     code (including every runtime validator on the path) with zero
+     FLOPs, no accelerator, and no data. ROI-declaring preprocessors are
+     executed twice — once with source-shaped inputs, once with
+     pre-cropped inputs — because a ROI pipeline must accept both.
+
+Failures become compiler-style diagnostics anchored at the class that
+declared the broken contract (see diagnostics.source_anchor).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from tensor2robot_tpu.analysis.diagnostics import (
+    Diagnostic,
+    ERROR,
+    source_anchor,
+)
+from tensor2robot_tpu.specs import (
+    ExtendedTensorSpec,
+    flatten_spec_structure,
+    make_example_args,
+)
+
+MODES_DEFAULT = ("train", "eval")
+_BATCH = 2  # abstract batch size; any static value exercises the contract
+
+
+def _diag(obj, rule: str, message: str) -> Diagnostic:
+    path, line = source_anchor(obj)
+    return Diagnostic(path=path, line=line, rule=rule, message=message,
+                      severity=ERROR)
+
+
+def _abstract_key():
+    """An abstract PRNG key: eval_shape never materializes it, so a raw
+    uint32[2] ShapeDtypeStruct stands in for jax.random.PRNGKey(0)."""
+    import jax
+
+    return jax.ShapeDtypeStruct((2,), np.uint32)
+
+
+def _spec_surface(model, preprocessor, mode: str) -> Tuple[list, dict]:
+    """Collects the six spec structures; returns (diagnostics, specs)."""
+    diagnostics: List[Diagnostic] = []
+    specs = {}
+    getters = (
+        ("in_features", preprocessor, "get_in_feature_specification"),
+        ("in_labels", preprocessor, "get_in_label_specification"),
+        ("out_features", preprocessor, "get_out_feature_specification"),
+        ("out_labels", preprocessor, "get_out_label_specification"),
+        ("model_features", model, "get_feature_specification"),
+        ("model_labels", model, "get_label_specification"),
+    )
+    for name, owner, getter in getters:
+        try:
+            specs[name] = getattr(owner, getter)(mode)
+        except Exception as err:
+            diagnostics.append(
+                _diag(
+                    owner,
+                    "specflow-spec",
+                    f"{type(owner).__name__}.{getter}({mode!r}) raised "
+                    f"{type(err).__name__}: {err}",
+                )
+            )
+    return diagnostics, specs
+
+
+def _check_covers(producer_spec, consumer_spec, preprocessor, mode, what):
+    """Every required consumer key must be produced with the same
+    shape/dtype (ExtendedTensorSpec equality is exactly shape+dtype)."""
+    diagnostics: List[Diagnostic] = []
+    produced = flatten_spec_structure(producer_spec)
+    for key, spec in flatten_spec_structure(consumer_spec).items():
+        if not isinstance(spec, ExtendedTensorSpec):
+            continue
+        got = produced.get(key)
+        if got is None:
+            if spec.is_optional:
+                continue
+            diagnostics.append(
+                _diag(
+                    preprocessor,
+                    "specflow-contract",
+                    f"[{mode}] {what}: model consumes {key!r} "
+                    f"{tuple(spec.shape)}/{np.dtype(spec.dtype).name} but the "
+                    "preprocessor out-spec does not produce it",
+                )
+            )
+            continue
+        if isinstance(got, ExtendedTensorSpec) and got != spec:
+            diagnostics.append(
+                _diag(
+                    preprocessor,
+                    "specflow-contract",
+                    f"[{mode}] {what}: {key!r} produced as "
+                    f"{tuple(got.shape)}/{np.dtype(got.dtype).name} but the "
+                    f"model consumes {tuple(spec.shape)}/"
+                    f"{np.dtype(spec.dtype).name}",
+                )
+            )
+    return diagnostics
+
+
+def _check_rois(preprocessor, in_features, mode: str):
+    """Validates the decode-ROI map; returns (diagnostics, rois)."""
+    from tensor2robot_tpu.data.roi import normalize_decode_rois
+
+    get_rois = getattr(preprocessor, "get_decode_rois", None)
+    if not callable(get_rois):
+        return [], None
+    try:
+        rois = get_rois(mode)
+    except Exception as err:
+        return [
+            _diag(
+                preprocessor,
+                "specflow-roi",
+                f"[{mode}] get_decode_rois raised "
+                f"{type(err).__name__}: {err}",
+            )
+        ], None
+    if not rois:
+        return [], None
+    try:
+        rois = normalize_decode_rois(rois, in_features)
+    except Exception as err:
+        return [
+            _diag(
+                preprocessor,
+                "specflow-roi",
+                f"[{mode}] decode-ROI map rejected against the in-feature "
+                f"specs: {type(err).__name__}: {err}",
+            )
+        ], None
+    return [], rois
+
+
+def _example_inputs(in_features, in_labels, rois=None):
+    """ShapeDtypeStruct inputs from the in-specs; `rois` substitutes the
+    cropped (H, W) on the named image keys (the dual-shape variant)."""
+    features = make_example_args(in_features, batch_size=_BATCH)
+    labels = (
+        make_example_args(in_labels, batch_size=_BATCH)
+        if in_labels is not None and len(list(flatten_spec_structure(in_labels)))
+        else None
+    )
+    if rois:
+        import jax
+
+        flat_spec = flatten_spec_structure(in_features)
+        for key, roi in rois.items():
+            spec = flat_spec[key]
+            shape = (_BATCH, roi.height, roi.width, int(spec.shape[2]))
+            features[key] = jax.ShapeDtypeStruct(
+                shape, features[key].dtype
+            )
+    return features, labels
+
+
+def _eval_shape_flow(model, preprocessor, mode, features, labels, variant):
+    """eval_shape the full chain; converts failures into one diagnostic
+    naming the stage that broke."""
+    import jax
+
+    key = _abstract_key()
+    stage = "preprocess"
+    owner = preprocessor
+    try:
+        out_features, out_labels = jax.eval_shape(
+            lambda f, l, r: preprocessor.preprocess(f, l, mode=mode, rng=r),
+            features,
+            labels,
+            key,
+        )
+        stage = "init_variables"
+        owner = model
+        variables = jax.eval_shape(
+            lambda r, f: model.init_variables(r, f, mode), key, out_features
+        )
+        stage = "inference"
+        outputs = jax.eval_shape(
+            lambda v, f, l, r: model.packed_inference(
+                v, f, mode, labels=l, rng=r
+            )[2],
+            variables,
+            out_features,
+            out_labels,
+            key,
+        )
+        if mode == "train" and out_labels is not None:
+            stage = "train_loss"
+            loss, _ = jax.eval_shape(
+                lambda f, l, o: model.model_train_fn(f, l, o, mode),
+                out_features,
+                out_labels,
+                outputs,
+            )
+            if tuple(loss.shape) != ():
+                return [
+                    _diag(
+                        model,
+                        "specflow-loss",
+                        f"[{mode}{variant}] model_train_fn loss must be a "
+                        f"scalar, got shape {tuple(loss.shape)}",
+                    )
+                ]
+    except Exception as err:
+        return [
+            _diag(
+                owner,
+                f"specflow-{stage}",
+                f"[{mode}{variant}] abstract execution failed at {stage}: "
+                f"{type(err).__name__}: {err}",
+            )
+        ]
+    return []
+
+
+def check_model(
+    model,
+    name: Optional[str] = None,
+    modes: Sequence[str] = MODES_DEFAULT,
+) -> List[Diagnostic]:
+    """Runs the full spec-flow pass over one model/preprocessor pairing."""
+    del name  # reserved for future per-target suppression
+    diagnostics: List[Diagnostic] = []
+    try:
+        preprocessor = model.preprocessor
+    except Exception as err:
+        return [
+            _diag(
+                model,
+                "specflow-spec",
+                f"constructing the preprocessor raised "
+                f"{type(err).__name__}: {err}",
+            )
+        ]
+    for mode in modes:
+        mode_diags, specs = _spec_surface(model, preprocessor, mode)
+        if not mode_diags:  # spec surface intact; check the contracts
+            mode_diags.extend(
+                _check_covers(
+                    specs["out_features"], specs["model_features"],
+                    preprocessor, mode, "features",
+                )
+            )
+            mode_diags.extend(
+                _check_covers(
+                    specs["out_labels"], specs["model_labels"],
+                    preprocessor, mode, "labels",
+                )
+            )
+            roi_diags, rois = _check_rois(
+                preprocessor, specs["in_features"], mode
+            )
+            mode_diags.extend(roi_diags)
+            if not mode_diags:
+                # Statically consistent; now flow shapes through the real
+                # code. (A static break would only re-report here with a
+                # worse message.)
+                features, labels = _example_inputs(
+                    specs["in_features"], specs["in_labels"]
+                )
+                mode_diags.extend(
+                    _eval_shape_flow(
+                        model, preprocessor, mode, features, labels, ""
+                    )
+                )
+                if rois:
+                    features, labels = _example_inputs(
+                        specs["in_features"], specs["in_labels"], rois
+                    )
+                    mode_diags.extend(
+                        _eval_shape_flow(
+                            model, preprocessor, mode, features, labels,
+                            "/roi-cropped",
+                        )
+                    )
+        diagnostics.extend(mode_diags)
+    return diagnostics
+
+
+def check_targets(targets=None) -> List[Tuple[str, List[Diagnostic]]]:
+    """Runs check_model over every registered pairing (analysis.targets)."""
+    from tensor2robot_tpu.analysis.targets import default_targets
+
+    results: List[Tuple[str, List[Diagnostic]]] = []
+    for target in targets if targets is not None else default_targets():
+        try:
+            model = target.factory()
+        except Exception as err:
+            results.append(
+                (
+                    target.name,
+                    [
+                        Diagnostic(
+                            path="<target>",
+                            line=0,
+                            rule="specflow-target",
+                            message=(
+                                f"target {target.name!r} factory raised "
+                                f"{type(err).__name__}: {err}"
+                            ),
+                        )
+                    ],
+                )
+            )
+            continue
+        results.append((target.name, check_model(model, target.name, target.modes)))
+    return results

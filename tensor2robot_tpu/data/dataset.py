@@ -30,6 +30,7 @@ from typing import Any, Callable, Dict, Iterator, List, Mapping, Optional, Seque
 
 import numpy as np
 
+from tensor2robot_tpu import flags
 from tensor2robot_tpu.data import tfrecord
 from tensor2robot_tpu.data.parser import SpecParser
 from tensor2robot_tpu.data.roi import (
@@ -158,9 +159,9 @@ def default_parse_workers() -> int:
     utils/tfdata.py:630-689 used num_parallel_calls=AUTOTUNE). Overridable
     via T2R_PARSE_WORKERS; 0 disables the pool (synchronous parse).
     """
-    env = os.environ.get("T2R_PARSE_WORKERS")
+    env = flags.get_optional_int("T2R_PARSE_WORKERS")
     if env is not None:
-        return max(0, int(env))
+        return env
     return min(8, os.cpu_count() or 1)
 
 
@@ -177,12 +178,7 @@ def default_parse_backend() -> str:
     batches (raw jpeg chunks are cheap to send; the returned uint8 image
     batch is the dominant IPC cost).
     """
-    backend = os.environ.get("T2R_PARSE_BACKEND", "thread")
-    if backend not in ("thread", "process"):
-        raise ValueError(
-            f"T2R_PARSE_BACKEND must be 'thread' or 'process', got {backend!r}"
-        )
-    return backend
+    return flags.get_enum("T2R_PARSE_BACKEND")
 
 
 def default_parse_fast() -> bool:
@@ -193,10 +189,7 @@ def default_parse_fast() -> bool:
     and falls back per batch on any parse failure, so enabling it is
     always semantics-preserving.
     """
-    env = os.environ.get("T2R_PARSE_FAST", "1")
-    if env not in ("0", "1"):
-        raise ValueError(f"T2R_PARSE_FAST must be '0' or '1', got {env!r}")
-    return env == "1"
+    return flags.get_bool("T2R_PARSE_FAST")
 
 
 def default_decode_roi() -> bool:
@@ -207,10 +200,7 @@ def default_decode_roi() -> bool:
     the pre-ROI pipeline. The gate sits at the dataset so one env flip
     restores the old path end to end (bench A/Bs, regression bisects).
     """
-    env = os.environ.get("T2R_DECODE_ROI", "1")
-    if env not in ("0", "1"):
-        raise ValueError(f"T2R_DECODE_ROI must be '0' or '1', got {env!r}")
-    return env == "1"
+    return flags.get_bool("T2R_DECODE_ROI")
 
 
 def default_parse_shm() -> bool:
@@ -219,10 +209,7 @@ def default_parse_shm() -> bool:
     T2R_PARSE_SHM=0 reverts to pickling parsed batches through the result
     pipe (the decoded uint8 image batch — ~60 MB at batch 64 for the
     QT-Opt spec — then pays serialize + pipe-write + deserialize)."""
-    env = os.environ.get("T2R_PARSE_SHM", "1")
-    if env not in ("0", "1"):
-        raise ValueError(f"T2R_PARSE_SHM must be '0' or '1', got {env!r}")
-    return env == "1"
+    return flags.get_bool("T2R_PARSE_SHM")
 
 
 class _FastParseState:
@@ -291,7 +278,7 @@ def _process_pool_init(
     # configured budget rather than the full budget times the worker
     # count (records land on arbitrary workers, so per-worker hit rates
     # are diluted anyway — the budget must not multiply).
-    os.environ["T2R_DECODE_CACHE_MB"] = str(decode_cache_mb)
+    flags.write_env("T2R_DECODE_CACHE_MB", decode_cache_mb)
 
 
 def _regroup_chunk(chunk):

@@ -13,11 +13,11 @@ trainer under jit (see data/dataset.py docstring for the rationale).
 from __future__ import annotations
 
 import abc
-import os
 from typing import Any, Callable, Iterator, Mapping, Optional, Sequence, Union
 
 import numpy as np
 
+from tensor2robot_tpu import flags
 from tensor2robot_tpu.data.dataset import (
     GeneratorDataset,
     RecordDataset,
@@ -188,7 +188,7 @@ class MultiEvalRecordInputGenerator(DefaultRecordInputGenerator):
         eval_name: Optional[str] = None,
         **kwargs,
     ):
-        eval_name = eval_name or os.environ.get("T2R_MULTI_EVAL_NAME")
+        eval_name = eval_name or flags.get_str("T2R_MULTI_EVAL_NAME")
         if not eval_name:
             raise ValueError(
                 "MultiEvalRecordInputGenerator requires eval_name (arg or "

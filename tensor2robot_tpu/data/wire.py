@@ -29,7 +29,12 @@ full copy in `np.stack`. This module parses the TFRecord `tf.Example` /
 back to `SpecParser` for that batch — a genuinely corrupt record then
 raises the canonical error, and a fast-path bug degrades to slow-but-
 correct instead of wrong. The parity suite (tests/test_fast_parser.py)
-asserts byte-identical outputs across the covered spec families.
+asserts byte-identical outputs across the covered spec families, and
+the fuzz suite (tests/test_wire_fuzz.py) pins the REJECTION side: the
+scanners below are strict about wire framing (every LEN frame must end
+exactly where it claims; skips may not cross EOF) so the fast path
+refuses every record protobuf refuses — acceptance leniency here would
+silently change pipeline semantics vs. T2R_PARSE_FAST=0.
 
 Wire layout recap (proto3, tensor2robot_tpu/proto/example.proto):
   Example          = { 1: Features }
@@ -45,7 +50,6 @@ Wire layout recap (proto3, tensor2robot_tpu/proto/example.proto):
 
 from __future__ import annotations
 
-import os
 import threading
 from collections import OrderedDict
 from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple, Union
@@ -53,6 +57,7 @@ from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple, Union
 import jax.numpy as jnp
 import numpy as np
 
+from tensor2robot_tpu import flags
 from tensor2robot_tpu.data.parser import (
     decode_image,
     decode_image_into_native,
@@ -176,6 +181,8 @@ def _scan_feature(data: bytes, pos: int, end: int) -> _Feature:
             kind = fnum
             length, pos = _uvarint(data, pos)
             inner_end = pos + length
+            if inner_end > end:
+                raise FastParseError("value list frame exceeds feature")
             while pos < inner_end:
                 tag2, pos = _uvarint(data, pos)
                 f2, w2 = tag2 >> 3, tag2 & 7
@@ -199,7 +206,12 @@ def _scan_feature(data: bytes, pos: int, end: int) -> _Feature:
                     )
                 else:
                     pos = _skip_field(data, pos, w2)
-            pos = inner_end
+            if pos != inner_end:
+                # A value entry claimed bytes past its list frame: the
+                # oracle (protobuf) rejects this record; accepting it
+                # here would make the fast path MORE lenient than
+                # T2R_PARSE_FAST=0 — a silent semantics change.
+                raise FastParseError("value list overran its frame")
         else:
             pos = _skip_field(data, pos, wt)
     if pos != end:
@@ -216,6 +228,8 @@ def _scan_features(
         if tag == 0x0A:  # map entry
             length, pos = _uvarint(data, pos)
             entry_end = pos + length
+            if entry_end > end:
+                raise FastParseError("map entry frame exceeds message")
             key = b""
             feature: Optional[_Feature] = None
             while pos < entry_end:
@@ -226,14 +240,20 @@ def _scan_features(
                     pos += klen
                 elif tag2 == 0x12:  # value Feature
                     flen, pos = _uvarint(data, pos)
+                    if pos + flen > entry_end:
+                        raise FastParseError("feature frame exceeds entry")
                     feature = _scan_feature(data, pos, pos + flen)
                     pos += flen
                 else:
                     pos = _skip_field(data, pos, tag2 & 7)
+            if pos != entry_end:
+                raise FastParseError("map entry overran its frame")
             if feature is not None:
                 out[key] = feature  # map semantics: last entry wins
         else:
             pos = _skip_field(data, pos, tag & 7)
+    if pos != end:
+        raise FastParseError("features scan overran its frame")
 
 
 def _scan_feature_lists(
@@ -245,6 +265,8 @@ def _scan_feature_lists(
         if tag == 0x0A:  # map entry
             length, pos = _uvarint(data, pos)
             entry_end = pos + length
+            if entry_end > end:
+                raise FastParseError("map entry frame exceeds message")
             key = b""
             steps: List[_Feature] = []
             while pos < entry_end:
@@ -256,19 +278,35 @@ def _scan_feature_lists(
                 elif tag2 == 0x12:  # value FeatureList
                     flen, pos = _uvarint(data, pos)
                     flist_end = pos + flen
+                    if flist_end > entry_end:
+                        raise FastParseError(
+                            "feature list frame exceeds entry"
+                        )
                     while pos < flist_end:
                         tag3, pos = _uvarint(data, pos)
                         if tag3 == 0x0A:  # one step's Feature
                             slen, pos = _uvarint(data, pos)
+                            if pos + slen > flist_end:
+                                raise FastParseError(
+                                    "step feature exceeds its list"
+                                )
                             steps.append(_scan_feature(data, pos, pos + slen))
                             pos += slen
                         else:
                             pos = _skip_field(data, pos, tag3 & 7)
+                    if pos != flist_end:
+                        raise FastParseError(
+                            "feature list overran its frame"
+                        )
                 else:
                     pos = _skip_field(data, pos, tag2 & 7)
+            if pos != entry_end:
+                raise FastParseError("map entry overran its frame")
             out[key] = steps
         else:
             pos = _skip_field(data, pos, tag & 7)
+    if pos != end:
+        raise FastParseError("feature lists scan overran its frame")
 
 
 def scan_record(
@@ -288,14 +326,24 @@ def scan_record(
         tag, pos = _uvarint(data, pos)
         if tag == 0x0A:  # features / context
             length, pos = _uvarint(data, pos)
+            if pos + length > end:
+                raise FastParseError("features frame exceeds record")
             _scan_features(data, pos, pos + length, features)
             pos += length
         elif tag == 0x12 and want_feature_lists:
             length, pos = _uvarint(data, pos)
+            if pos + length > end:
+                raise FastParseError("feature lists frame exceeds record")
             _scan_feature_lists(data, pos, pos + length, feature_lists)
             pos += length
         else:
             pos = _skip_field(data, pos, tag & 7)
+    if pos != end:
+        # A skipped field claimed bytes past EOF: a truncated record.
+        # Protobuf's FromString rejects it; so must the fast scan —
+        # otherwise T2R_PARSE_FAST=1 silently ACCEPTS records the
+        # T2R_PARSE_FAST=0 pipeline refuses (found by test_wire_fuzz).
+        raise FastParseError("record scan overran EOF (truncated record)")
     return features, feature_lists
 
 
@@ -406,7 +454,7 @@ _decode_cache_lock = threading.Lock()
 
 
 def default_decode_cache_mb() -> int:
-    return max(0, int(os.environ.get("T2R_DECODE_CACHE_MB", "512")))
+    return flags.get_int("T2R_DECODE_CACHE_MB")
 
 
 def get_decode_cache() -> Optional[DecodeCache]:

@@ -1,0 +1,334 @@
+"""Central registry of every `T2R_*` environment gate.
+
+The framework's runtime toggles are env vars so one flip A/Bs a whole
+pipeline (bench legs, regression bisects, pod-launch wrappers) — but
+after PRs 1-2 the ~10 gates were read ad hoc across six modules, each
+re-implementing its own parse + default. Drift between two readers of
+the same flag (different defaults, different accepted spellings) is a
+contract break the type system never sees; it surfaces minutes into a
+pod allocation as a silently-wrong pipeline configuration.
+
+This module is the single source of truth:
+
+  * every flag is DECLARED once (name, kind, default, doc, owning
+    module) in `_DECLARATIONS` below;
+  * every read goes through the typed getters (`get_bool`, `get_int`,
+    `get_enum`, `get_str`, `get_optional_int`), which parse and
+    validate identically everywhere and fail fast — with the flag name
+    in the message — on a bad value;
+  * writes that must cross a process boundary (worker initializers,
+    bench save/restore) go through `write_env` / `read_raw` /
+    `restore_env` so they stay visible to the same registry;
+  * the AST lint (analysis/lints.py, rule env-undeclared) fails the
+    build on any `os.environ` read of a `T2R_*` key outside this file,
+    so an undeclared or locally-reparsed flag cannot land.
+
+Contribution rule (docs/static_analysis.md): adding a gate = one
+`_declare(...)` line here + reads via the getters. Nothing else.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, Optional, Tuple
+
+__all__ = [
+    "FlagSpec",
+    "all_flags",
+    "get_flag",
+    "get_bool",
+    "get_int",
+    "get_optional_int",
+    "get_enum",
+    "get_str",
+    "read_raw",
+    "write_env",
+    "restore_env",
+    "describe",
+]
+
+_BOOL, _INT, _ENUM, _STR = "bool", "int", "enum", "str"
+
+
+@dataclasses.dataclass(frozen=True)
+class FlagSpec:
+    """One declared env gate.
+
+    Attributes:
+      name: The full environment variable name (T2R_...).
+      kind: 'bool' ('0'/'1'), 'int', 'enum' (one of `choices`), or 'str'.
+      default: The value returned when the variable is unset. For 'bool'
+        flags this is the parsed bool; for 'int' the parsed int; for
+        'enum'/'str' the raw string (or None for optional strings).
+      doc: One-line description of what the gate controls.
+      owner: The module that owns the behavior (where the flag is
+        consumed), for `t2r-check --flags` listings and the docs table.
+      choices: Accepted values for 'enum' flags.
+      minimum: Lower clamp for 'int' flags (values below are clamped,
+        matching the pre-registry readers' max(0, ...) behavior).
+    """
+
+    name: str
+    kind: str
+    default: object
+    doc: str
+    owner: str
+    choices: Optional[Tuple[str, ...]] = None
+    minimum: Optional[int] = None
+
+
+_REGISTRY: Dict[str, FlagSpec] = {}
+
+
+def _declare(
+    name: str,
+    kind: str,
+    default,
+    doc: str,
+    owner: str,
+    choices: Optional[Tuple[str, ...]] = None,
+    minimum: Optional[int] = None,
+) -> FlagSpec:
+    if name in _REGISTRY:
+        raise ValueError(f"flag {name} declared twice")
+    if not name.startswith("T2R_"):
+        raise ValueError(f"flag {name} must be namespaced T2R_*")
+    if kind == _ENUM and not choices:
+        raise ValueError(f"enum flag {name} needs choices")
+    spec = FlagSpec(name, kind, default, doc, owner, choices, minimum)
+    _REGISTRY[name] = spec
+    return spec
+
+
+# -- the registry -------------------------------------------------------------
+# One line per gate. Keep alphabetical; the lint only checks reads, but
+# reviewers check this table against docs/static_analysis.md.
+
+_declare(
+    "T2R_DECODE_CACHE_MB",
+    _INT,
+    512,
+    "Decoded-image cache byte budget in MB; 0 disables the cache.",
+    "tensor2robot_tpu/data/wire.py",
+    minimum=0,
+)
+_declare(
+    "T2R_DECODE_ROI",
+    _BOOL,
+    True,
+    "Honor decode-time ROI crops; 0 restores full-frame decode exactly.",
+    "tensor2robot_tpu/data/dataset.py",
+)
+_declare(
+    "T2R_MULTI_EVAL_NAME",
+    _STR,
+    None,
+    "Selects the eval dataset for MultiEvalRecordInputGenerator.",
+    "tensor2robot_tpu/data/input_generators.py",
+)
+_declare(
+    "T2R_PARSE_BACKEND",
+    _ENUM,
+    "thread",
+    "Parse worker pool backend.",
+    "tensor2robot_tpu/data/dataset.py",
+    choices=("thread", "process"),
+)
+_declare(
+    "T2R_PARSE_FAST",
+    _BOOL,
+    True,
+    "Wire-format fast parser (SpecParser stays the per-batch fallback).",
+    "tensor2robot_tpu/data/dataset.py",
+)
+_declare(
+    "T2R_PARSE_SHM",
+    _BOOL,
+    True,
+    "Process-backend batches return via the shared-memory ring.",
+    "tensor2robot_tpu/data/dataset.py",
+)
+_declare(
+    "T2R_PARSE_WORKERS",
+    _INT,
+    None,
+    "Parse pool size; 0 = synchronous; unset = min(8, cpu_count).",
+    "tensor2robot_tpu/data/dataset.py",
+    minimum=0,
+)
+_declare(
+    "T2R_POOL_BACKWARD",
+    _ENUM,
+    "auto",
+    "Max-pool VJP path; auto dispatches per lowering platform.",
+    "tensor2robot_tpu/ops/pooling.py",
+    choices=("auto", "native", "scatterfree"),
+)
+_declare(
+    "T2R_SKIP_HYPOTHESIS",
+    _BOOL,
+    False,
+    "Skip hypothesis-driven property/fuzz tests explicitly.",
+    "tests/",
+)
+_declare(
+    "T2R_STEM_S2D",
+    _ENUM,
+    "auto",
+    "Strided stem space-to-depth lowering; auto currently resolves off.",
+    "tensor2robot_tpu/layers/s2d_conv.py",
+    choices=("auto", "0", "1"),
+)
+
+
+# -- lookup -------------------------------------------------------------------
+
+
+def all_flags() -> Tuple[FlagSpec, ...]:
+    return tuple(_REGISTRY[name] for name in sorted(_REGISTRY))
+
+
+def get_flag(name: str) -> FlagSpec:
+    spec = _REGISTRY.get(name)
+    if spec is None:
+        raise KeyError(
+            f"{name} is not a declared T2R flag; declare it in "
+            "tensor2robot_tpu/flags.py (see docs/static_analysis.md)"
+        )
+    return spec
+
+
+def _raw(spec: FlagSpec) -> Optional[str]:
+    return os.environ.get(spec.name)
+
+
+# -- typed getters ------------------------------------------------------------
+
+
+def get_bool(name: str) -> bool:
+    """'0'/'1' flags; anything else fails fast with the flag name."""
+    spec = get_flag(name)
+    if spec.kind != _BOOL:
+        raise TypeError(f"{name} is a {spec.kind} flag, not bool")
+    raw = _raw(spec)
+    if raw is None:
+        return bool(spec.default)
+    if raw not in ("0", "1"):
+        raise ValueError(f"{name} must be '0' or '1', got {raw!r}")
+    return raw == "1"
+
+
+def get_int(name: str) -> int:
+    spec = get_flag(name)
+    if spec.kind != _INT:
+        raise TypeError(f"{name} is a {spec.kind} flag, not int")
+    raw = _raw(spec)
+    if raw is None:
+        value = spec.default
+        if value is None:
+            raise ValueError(
+                f"{name} has no default; use get_optional_int"
+            )
+        value = int(value)
+    else:
+        try:
+            value = int(raw)
+        except ValueError as err:
+            raise ValueError(f"{name} must be an integer, got {raw!r}") from err
+    if spec.minimum is not None:
+        value = max(spec.minimum, value)
+    return value
+
+
+def get_optional_int(name: str) -> Optional[int]:
+    """Int flag whose unset state is meaningful (caller picks the default)."""
+    spec = get_flag(name)
+    if spec.kind != _INT:
+        raise TypeError(f"{name} is a {spec.kind} flag, not int")
+    if _raw(spec) is None:
+        return None
+    return get_int(name)
+
+
+def get_enum(name: str) -> str:
+    spec = get_flag(name)
+    if spec.kind != _ENUM:
+        raise TypeError(f"{name} is a {spec.kind} flag, not enum")
+    raw = _raw(spec)
+    if raw is None:
+        return str(spec.default)
+    if raw not in spec.choices:
+        raise ValueError(
+            f"{name}={raw!r}: expected {'|'.join(spec.choices)}"
+        )
+    return raw
+
+
+def get_str(name: str) -> Optional[str]:
+    spec = get_flag(name)
+    if spec.kind != _STR:
+        raise TypeError(f"{name} is a {spec.kind} flag, not str")
+    raw = _raw(spec)
+    return spec.default if raw is None else raw
+
+
+# -- declared writes ----------------------------------------------------------
+# Some owners must WRITE a flag across a process boundary (a pool
+# initializer scoping the decode-cache budget per worker; the bench
+# save/flip/restore around a leg). Routing those through here keeps every
+# touch of a T2R_* variable attached to the registry (and lintable).
+
+
+def read_raw(name: str) -> Optional[str]:
+    """The raw env string (None when unset) — save/restore bookkeeping."""
+    return os.environ.get(get_flag(name).name)
+
+
+def write_env(name: str, value) -> None:
+    """Sets a DECLARED flag in this process's environment, validating at
+    the write site — a malformed value must fail HERE, not at some later
+    read in a spawned worker."""
+    spec = get_flag(name)
+    raw = "1" if value is True else "0" if value is False else str(value)
+    if spec.kind == _ENUM and raw not in spec.choices:
+        raise ValueError(f"{name}={raw!r}: expected {'|'.join(spec.choices)}")
+    if spec.kind == _BOOL and raw not in ("0", "1"):
+        raise ValueError(f"{name} must be '0' or '1', got {raw!r}")
+    if spec.kind == _INT:
+        try:
+            int(raw)
+        except ValueError as err:
+            raise ValueError(
+                f"{name} must be an integer, got {raw!r}"
+            ) from err
+    os.environ[spec.name] = raw
+
+
+def restore_env(name: str, saved: Optional[str]) -> None:
+    """Restores a flag to a value captured with read_raw (None unsets)."""
+    get_flag(name)
+    if saved is None:
+        os.environ.pop(name, None)
+    else:
+        os.environ[name] = saved
+
+
+def describe() -> str:
+    """Human-readable registry table (t2r_check.py --flags)."""
+    lines = []
+    for spec in all_flags():
+        default = (
+            "unset"
+            if spec.default is None
+            else ("1" if spec.default is True else
+                  "0" if spec.default is False else str(spec.default))
+        )
+        kind = (
+            f"enum[{'|'.join(spec.choices)}]" if spec.kind == _ENUM else spec.kind
+        )
+        lines.append(
+            f"{spec.name:22s} {kind:28s} default={default:8s} "
+            f"owner={spec.owner}\n    {spec.doc}"
+        )
+    return "\n".join(lines)

@@ -25,13 +25,14 @@ research/qtopt/networks.py:441-445 (6×6 stride-2 SAME conv on RGB).
 
 from __future__ import annotations
 
-import os
 from typing import Callable, Tuple
 
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
 from jax import lax
+
+from tensor2robot_tpu import flags
 
 
 def stem_s2d_enabled() -> bool:
@@ -41,11 +42,9 @@ def stem_s2d_enabled() -> bool:
     resolves OFF everywhere until the on-chip A/B (DIAG entry_conv_s2d
     cases) proves the win — flip the auto rule here when it does.
     """
-    mode = os.environ.get("T2R_STEM_S2D", "auto")
+    mode = flags.get_enum("T2R_STEM_S2D")
     if mode == "auto":
         return False  # pending the on-chip A/B; see docstring
-    if mode not in ("0", "1"):
-        raise ValueError(f"T2R_STEM_S2D={mode!r}: expected auto|0|1")
     return mode == "1"
 
 

@@ -1,0 +1,205 @@
+// Sanitizer fuzz driver for the native wire/jpeg parsers.
+//
+// The hot path (PRs 1-2) runs raw pointer/span arithmetic over UNTRUSTED
+// record bytes: the TFRecord indexers walk length fields read from the
+// file, and the jpeg decoders write scanlines into caller buffers sized
+// from the SPEC, not from the file. Every one of those is a classic
+// out-of-bounds read/write shape. This driver feeds corpus files (valid,
+// truncated, bit-flipped, dimension-lying — tools/gen_fuzz_corpus.py)
+// through every native entry point, compiled under ASan/UBSan
+// (`make -C tensor2robot_tpu/native sanitize`):
+//
+//   * t2r_index_records / t2r_index_records_partial, verify_crc on+off,
+//     plus an undersized max_records to exercise the counting tail;
+//   * t2r_decode_jpeg into a spec-sized buffer AND into a deliberately
+//     undersized buffer (the -3 path);
+//   * t2r_decode_jpeg_roi with interior, edge, and out-of-frame crops.
+//
+// The contract under test is NOT "parse everything" — it is "return a
+// negative status and touch only your own buffers, whatever the bytes
+// say". Any OOB access, UB, or leak aborts the process with a sanitizer
+// report; exit 0 means every file was survived. The driver prints one
+// line per file so a crash names its input.
+//
+// Build: make -C tensor2robot_tpu/native sanitize
+//        ./t2r_fuzz_asan <dir|files>   (plain twin: make t2r_fuzz)
+
+#include <dirent.h>
+#include <sys/stat.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+extern "C" {
+int64_t t2r_index_records(const uint8_t* buf, size_t n, uint64_t* offsets,
+                          uint64_t* lengths, size_t max_records,
+                          int verify_crc);
+int64_t t2r_index_records_partial(const uint8_t* buf, size_t n,
+                                  uint64_t* offsets, uint64_t* lengths,
+                                  size_t max_records, int verify_crc,
+                                  uint64_t* consumed);
+int t2r_decode_jpeg(const unsigned char* data, size_t len, unsigned char* out,
+                    size_t out_capacity, int want_channels, int* height,
+                    int* width);
+int t2r_decode_jpeg_roi(const unsigned char* data, size_t len,
+                        unsigned char* out, size_t out_capacity,
+                        int want_channels, int crop_y, int crop_x, int crop_h,
+                        int crop_w, int* full_height, int* full_width);
+}
+
+namespace {
+
+// Big enough for the QT-Opt 512x640 frames the corpus uses; a file whose
+// header claims more must fail with -3, never scribble past the end.
+constexpr size_t kDecodeCap = size_t(1024) * 1024 * 3;
+constexpr size_t kMaxRecords = 4096;
+
+std::vector<uint8_t> ReadFile(const std::string& path) {
+  std::vector<uint8_t> data;
+  FILE* f = std::fopen(path.c_str(), "rb");
+  if (f == nullptr) return data;
+  std::fseek(f, 0, SEEK_END);
+  long size = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  if (size > 0) {
+    data.resize(static_cast<size_t>(size));
+    if (std::fread(data.data(), 1, data.size(), f) != data.size()) {
+      data.clear();
+    }
+  }
+  std::fclose(f);
+  return data;
+}
+
+void DriveTfrecord(const std::vector<uint8_t>& data) {
+  std::vector<uint64_t> offsets(kMaxRecords), lengths(kMaxRecords);
+  for (int verify = 0; verify <= 1; ++verify) {
+    t2r_index_records(data.data(), data.size(), offsets.data(),
+                      lengths.data(), kMaxRecords, verify);
+    // Undersized max_records: the indexer keeps counting past the
+    // arrays; the tail must not write them.
+    t2r_index_records(data.data(), data.size(), offsets.data(),
+                      lengths.data(), 1, verify);
+    uint64_t consumed = 0;
+    t2r_index_records_partial(data.data(), data.size(), offsets.data(),
+                              lengths.data(), kMaxRecords, verify, &consumed);
+    // Feed every tail of the buffer too: streaming readers resume at
+    // arbitrary offsets after a partial block.
+    if (data.size() > 1) {
+      t2r_index_records_partial(data.data() + data.size() / 2,
+                                data.size() - data.size() / 2, offsets.data(),
+                                lengths.data(), kMaxRecords, verify,
+                                &consumed);
+    }
+  }
+}
+
+void DriveJpeg(const std::vector<uint8_t>& data) {
+  static std::vector<unsigned char> out(kDecodeCap);
+  int h = 0, w = 0;
+  for (int channels = 1; channels <= 3; channels += 2) {
+    t2r_decode_jpeg(data.data(), data.size(), out.data(), out.size(),
+                    channels, &h, &w);
+    // Undersized output: must return -3 before writing row 0.
+    t2r_decode_jpeg(data.data(), data.size(), out.data(), 64, channels, &h,
+                    &w);
+  }
+  struct Rect {
+    int y, x, h, w;
+  };
+  const Rect rects[] = {
+      {0, 0, 16, 16},      // interior, top-left
+      {17, 23, 23, 29},    // sub-MCU offsets
+      {0, 0, 1, 1},        // minimal
+      {500, 620, 12, 20},  // bottom-right edge of a 512x640 source
+      {0, 0, 100000, 100000},  // far outside any frame (-5)
+      {100000, 100000, 8, 8},  // offset outside the frame (-5)
+  };
+  int fh = 0, fw = 0;
+  for (const Rect& r : rects) {
+    t2r_decode_jpeg_roi(data.data(), data.size(), out.data(), out.size(), 3,
+                        r.y, r.x, r.h, r.w, &fh, &fw);
+    // Exact-fit output for the crop: any margin-handling bug that writes
+    // one row/column extra lands outside this allocation.
+    size_t need = size_t(r.h) * size_t(r.w) * 3;
+    if (need <= kDecodeCap && r.h <= 4096 && r.w <= 4096) {
+      std::vector<unsigned char> exact(need);
+      t2r_decode_jpeg_roi(data.data(), data.size(), exact.data(),
+                          exact.size(), 3, r.y, r.x, r.h, r.w, &fh, &fw);
+    }
+  }
+}
+
+int DriveFile(const std::string& path) {
+  std::vector<uint8_t> data = ReadFile(path);
+  std::printf("[t2r_fuzz] %s (%zu bytes)\n", path.c_str(), data.size());
+  std::fflush(stdout);
+  if (data.empty()) return 0;
+  // Every file goes through BOTH parser families: the corpus does not
+  // promise well-formedness in either format — that is the point.
+  DriveTfrecord(data);
+  DriveJpeg(data);
+  return 0;
+}
+
+void CollectInputs(const std::string& path, std::vector<std::string>* files) {
+  struct stat st;
+  if (stat(path.c_str(), &st) != 0) return;
+  if (!S_ISDIR(st.st_mode)) {
+    files->push_back(path);
+    return;
+  }
+  DIR* dir = opendir(path.c_str());
+  if (dir == nullptr) return;
+  std::vector<std::string> entries;
+  while (dirent* entry = readdir(dir)) {
+    if (entry->d_name[0] == '.') continue;
+    entries.push_back(path + "/" + entry->d_name);
+  }
+  closedir(dir);
+  // Deterministic order: a crash report names the same file every run.
+  for (size_t i = 1; i < entries.size(); ++i) {
+    for (size_t j = i; j > 0 && entries[j] < entries[j - 1]; --j) {
+      std::swap(entries[j], entries[j - 1]);
+    }
+  }
+  for (const std::string& entry : entries) CollectInputs(entry, files);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    std::fprintf(stderr,
+                 "usage: %s <corpus-dir-or-files...> | --self-test-oob\n",
+                 argv[0]);
+    return 2;
+  }
+  if (std::strcmp(argv[1], "--self-test-oob") == 0) {
+    // Sanitizer canary: a deliberate heap OOB read. Under the `sanitize`
+    // build this MUST abort with an ASan report — a run of the corpus
+    // only means something if this exits nonzero first (otherwise the
+    // binary was silently built without instrumentation and "survived"
+    // is vacuous). tools/run_checks.sh asserts the abort.
+    volatile uint8_t* buf = new uint8_t[16];
+    volatile uint8_t poison = buf[16];
+    std::printf("[t2r_fuzz] self-test OOB read returned %d — sanitizer "
+                "NOT active\n",
+                int(poison));
+    delete[] buf;
+    return 3;
+  }
+  std::vector<std::string> files;
+  for (int i = 1; i < argc; ++i) CollectInputs(argv[i], &files);
+  if (files.empty()) {
+    std::fprintf(stderr, "[t2r_fuzz] no corpus files found\n");
+    return 2;
+  }
+  for (const std::string& file : files) DriveFile(file);
+  std::printf("[t2r_fuzz] OK: %zu files survived\n", files.size());
+  return 0;
+}

@@ -41,13 +41,14 @@ restructured as `jax.custom_jvp` to support both modes.
 
 from __future__ import annotations
 
-import os
 from functools import partial
 from typing import Tuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+from tensor2robot_tpu import flags
 
 
 def resolve_backward_mode() -> str:
@@ -64,13 +65,9 @@ def resolve_backward_mode() -> str:
     gets THAT backend's path, not this process's (ADVICE round-5). The
     forced modes bake the named path in at trace time on every platform.
     """
-    mode = os.environ.get("T2R_POOL_BACKWARD", "auto")
+    mode = flags.get_enum("T2R_POOL_BACKWARD")
     if mode == "auto":
         return "native" if jax.default_backend() == "tpu" else "scatterfree"
-    if mode not in ("native", "scatterfree"):
-        raise ValueError(
-            f"T2R_POOL_BACKWARD={mode!r}: expected auto|native|scatterfree"
-        )
     return mode
 
 
@@ -104,7 +101,7 @@ def max_pool(
     trace-time on purpose — they exist for A/B benches that must pin one
     path everywhere.
     """
-    mode = os.environ.get("T2R_POOL_BACKWARD", "auto")
+    mode = flags.get_enum("T2R_POOL_BACKWARD")
     if mode == "auto" and hasattr(lax, "platform_dependent"):
         return lax.platform_dependent(
             x,

@@ -16,6 +16,7 @@ import os
 import numpy as np
 import pytest
 
+from tensor2robot_tpu import flags as t2r_flags
 from tensor2robot_tpu.data.encoder import encode_example, encode_examples_by_dataset
 from tensor2robot_tpu.data.parser import SpecParser
 from tensor2robot_tpu.data.wire import (
@@ -451,7 +452,7 @@ class TestFallback:
 
 
 @pytest.mark.skipif(
-    os.environ.get("T2R_SKIP_HYPOTHESIS") == "1", reason="explicitly skipped"
+    t2r_flags.get_bool("T2R_SKIP_HYPOTHESIS"), reason="explicitly skipped"
 )
 class TestFuzzParity:
     """Hypothesis fuzz mirroring test_parser_properties, but asserting the

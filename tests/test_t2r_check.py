@@ -1,0 +1,421 @@
+"""Tier-1 gate for the static-analysis subsystem (ISSUE 3).
+
+Asserts three things so regressions fail fast:
+  1. the shipped package IS clean: every registered model/preprocessor
+     pairing passes the spec-flow checker and the whole package passes
+     the custom lints;
+  2. each pass actually CATCHES its violation class: a broken
+     preprocessor out-spec, a broken decode-ROI declaration, a broken
+     abstract execution, undeclared env reads, numpy-in-jit, shm
+     discipline breaks — all seeded here and asserted caught;
+  3. the flag registry parses/validates like the readers it replaced
+     (same accepted spellings, errors naming the flag).
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from tensor2robot_tpu import flags
+from tensor2robot_tpu.analysis.diagnostics import Diagnostic, format_diagnostics
+from tensor2robot_tpu.analysis.lints import (
+    DEFAULT_LINT_ROOTS,
+    lint_paths,
+    lint_source,
+)
+from tensor2robot_tpu.analysis.specflow import check_model
+from tensor2robot_tpu.analysis.targets import default_targets
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- 1. the package is clean --------------------------------------------------
+
+
+class TestPackageClean:
+    def test_lints_clean_over_package(self):
+        diagnostics = lint_paths(DEFAULT_LINT_ROOTS, root=_REPO)
+        assert not diagnostics, "\n" + format_diagnostics(
+            diagnostics, root=_REPO
+        )
+
+    def test_specflow_mock_and_transformer_clean(self):
+        from tensor2robot_tpu.models.transformer_models import (
+            TransformerBCModel,
+        )
+        from tensor2robot_tpu.utils.mocks import MockT2RModel
+
+        assert check_model(MockT2RModel(), "mock") == []
+        model = TransformerBCModel(
+            action_size=2,
+            pose_size=4,
+            episode_length=4,
+            image_size=(16, 16),
+            use_flash=False,
+            device_type="cpu",
+        )
+        diags = check_model(model, "transformer-bc")
+        assert diags == [], "\n" + format_diagnostics(diags)
+
+    def test_specflow_qtopt_clean(self):
+        """The QT-Opt pairing at its real geometry (472x472 from a
+        512x640 jpeg source with the decode-ROI dual-shape contract) —
+        eval_shape only traces, so this stays seconds, not minutes."""
+        from tensor2robot_tpu.research.qtopt.t2r_models import (
+            Grasping44E2EOpenCloseTerminateGripperStatusHeightToBottom,
+        )
+
+        model = Grasping44E2EOpenCloseTerminateGripperStatusHeightToBottom(
+            device_type="cpu"
+        )
+        diags = check_model(model, "qtopt")
+        assert diags == [], "\n" + format_diagnostics(diags)
+
+    def test_all_registered_targets_constructible(self):
+        names = [t.name for t in default_targets()]
+        assert "qtopt-grasping44" in names
+        assert "transformer-bc" in names
+
+
+# -- 2. seeded violations are caught ------------------------------------------
+
+
+def _qtopt_model(preprocessor_cls):
+    from tensor2robot_tpu.research.qtopt.t2r_models import (
+        Grasping44E2EOpenCloseTerminateGripperStatusHeightToBottom,
+    )
+
+    return Grasping44E2EOpenCloseTerminateGripperStatusHeightToBottom(
+        device_type="cpu", preprocessor_cls=preprocessor_cls
+    )
+
+
+class TestSpecflowCatches:
+    def test_broken_out_spec(self):
+        from tensor2robot_tpu.research.qtopt.t2r_models import (
+            DefaultGrasping44ImagePreprocessor,
+        )
+
+        class BrokenOutSpec(DefaultGrasping44ImagePreprocessor):
+            def get_out_feature_specification(self, mode):
+                spec = super().get_out_feature_specification(mode)
+                self.update_spec(spec, "state/image", shape=(100, 100, 3))
+                return spec
+
+        diags = check_model(_qtopt_model(BrokenOutSpec), "broken")
+        assert diags, "broken out-spec must produce diagnostics"
+        assert any(d.rule == "specflow-contract" for d in diags)
+        text = format_diagnostics(diags)
+        assert "state/image" in text and "(100, 100, 3)" in text
+        # Anchored at THIS file (the class that declared the contract).
+        assert any(
+            os.path.basename(d.path) == os.path.basename(__file__)
+            and d.line > 0
+            for d in diags
+        )
+
+    def test_broken_decode_roi(self):
+        from tensor2robot_tpu.research.qtopt.t2r_models import (
+            DefaultGrasping44ImagePreprocessor,
+        )
+
+        class BrokenROI(DefaultGrasping44ImagePreprocessor):
+            def get_decode_rois(self, mode):
+                from tensor2robot_tpu.data.roi import DecodeROI
+
+                return {"state/image": DecodeROI(9999, 9999, mode="center")}
+
+        diags = check_model(_qtopt_model(BrokenROI), "broken-roi")
+        assert any(d.rule == "specflow-roi" for d in diags)
+        assert "exceeds source" in format_diagnostics(diags)
+
+    def test_broken_preprocess_fn_shape(self):
+        """An out-spec-violating _preprocess_fn is caught by abstract
+        execution (the runtime validators run under eval_shape)."""
+        from tensor2robot_tpu.research.qtopt.t2r_models import (
+            DefaultGrasping44ImagePreprocessor,
+        )
+
+        class BrokenTransform(DefaultGrasping44ImagePreprocessor):
+            def _preprocess_fn(self, features, labels, mode, rng):
+                features, labels = super()._preprocess_fn(
+                    features, labels, mode, rng
+                )
+                features.state.image = features.state.image[:, :10, :10, :]
+                return features, labels
+
+        diags = check_model(
+            _qtopt_model(BrokenTransform), "broken-fn", modes=("train",)
+        )
+        assert any(d.rule == "specflow-preprocess" for d in diags)
+
+    def test_missing_model_key(self):
+        from tensor2robot_tpu.preprocessors.abstract_preprocessor import (
+            NoOpPreprocessor,
+        )
+
+        class DropsImage(NoOpPreprocessor):
+            def get_out_feature_specification(self, mode):
+                spec = self._model.get_feature_specification(mode).copy()
+                del spec["state/image"]
+                return spec
+
+        diags = check_model(_qtopt_model(DropsImage), "drops-key")
+        assert any(
+            d.rule == "specflow-contract" and "does not produce" in d.message
+            for d in diags
+        )
+
+
+class TestLintsCatch:
+    def _rules(self, source):
+        return {d.rule for d in lint_source(source, "seeded.py")}
+
+    def test_undeclared_env_read(self):
+        rules = self._rules(
+            "import os\nx = os.environ.get('T2R_PARSE_FAST', '1')\n"
+        )
+        assert "env-undeclared" in rules
+
+    def test_undeclared_env_subscript_and_write(self):
+        rules = self._rules(
+            "import os\n"
+            "y = os.environ['T2R_DECODE_ROI']\n"
+            "os.environ['T2R_BRAND_NEW'] = '1'\n"
+        )
+        assert "env-undeclared" in rules
+
+    def test_inconsistent_default(self):
+        diags = lint_source(
+            "import os\nx = os.environ.get('T2R_PARSE_FAST', '0')\n",
+            "seeded.py",
+        )
+        assert any(d.rule == "env-inconsistent-default" for d in diags)
+
+    def test_unknown_flag_through_registry(self):
+        rules = self._rules(
+            "from tensor2robot_tpu import flags\n"
+            "x = flags.get_bool('T2R_DOES_NOT_EXIST')\n"
+        )
+        assert "env-unknown-flag" in rules
+
+    def test_getter_kind_mismatch(self):
+        rules = self._rules(
+            "from tensor2robot_tpu import flags\n"
+            "x = flags.get_int('T2R_PARSE_BACKEND')\n"
+        )
+        assert "env-kind-mismatch" in rules
+
+    def test_numpy_in_jit_decorated(self):
+        rules = self._rules(
+            "import jax\nimport numpy as np\n"
+            "@jax.jit\ndef f(x):\n    return np.asarray(x) + 1\n"
+        )
+        assert "jit-host-numpy" in rules
+
+    def test_numpy_in_jit_wrapped(self):
+        rules = self._rules(
+            "import jax\nimport numpy as np\n"
+            "def step(x):\n    return np.zeros(3) + x\n"
+            "run = jax.jit(step)\n"
+        )
+        assert "jit-host-numpy" in rules
+
+    def test_numpy_shape_arithmetic_allowed(self):
+        rules = self._rules(
+            "import jax\nimport numpy as np\n"
+            "@jax.jit\ndef f(x):\n"
+            "    n = np.prod(x.shape)\n"
+            "    return x.reshape(n).astype(np.float32)\n"
+        )
+        assert "jit-host-numpy" not in rules
+
+    def test_shm_discipline(self):
+        source = (
+            "from multiprocessing import shared_memory\n"
+            "def worker(free_queue):\n"
+            "    name = free_queue.get()\n"
+            "    shm = shared_memory.SharedMemory(create=True, size=8)\n"
+            "    shm.unlink()\n"
+        )
+        rules = self._rules(source)
+        assert {
+            "shm-blocking-get",
+            "shm-create-outside-ring",
+            "shm-unlink-outside-ring",
+        } <= rules
+
+    def test_shm_blocking_put_in_release(self):
+        source = (
+            "class _MyShmRing:\n"
+            "    def release(self, name):\n"
+            "        self.free_queue.put(name)\n"
+        )
+        rules = self._rules(source)
+        assert "shm-blocking-put-in-release" in rules
+
+    def test_ring_owner_is_allowed(self):
+        source = (
+            "from multiprocessing import shared_memory\n"
+            "class _ShmBatchRing:\n"
+            "    def __init__(self):\n"
+            "        self.shm = shared_memory.SharedMemory(create=True, size=8)\n"
+            "    def close(self):\n"
+            "        self.shm.unlink()\n"
+            "    def release(self, name):\n"
+            "        self.free_queue.put_nowait(name)\n"
+        )
+        assert lint_source(source, "ring.py") == []
+
+    def test_syntax_error_is_a_diagnostic(self):
+        diags = lint_source("def broken(:\n", "bad.py")
+        assert [d.rule for d in diags] == ["syntax-error"]
+
+
+# -- 3. the flag registry -----------------------------------------------------
+
+
+class TestFlagRegistry:
+    def test_every_declared_flag_is_namespaced_and_documented(self):
+        for spec in flags.all_flags():
+            assert spec.name.startswith("T2R_")
+            assert spec.doc and spec.owner
+
+    def test_bool_parse_and_error(self, monkeypatch):
+        monkeypatch.delenv("T2R_PARSE_FAST", raising=False)
+        assert flags.get_bool("T2R_PARSE_FAST") is True
+        monkeypatch.setenv("T2R_PARSE_FAST", "0")
+        assert flags.get_bool("T2R_PARSE_FAST") is False
+        monkeypatch.setenv("T2R_PARSE_FAST", "yes")
+        with pytest.raises(ValueError, match="T2R_PARSE_FAST"):
+            flags.get_bool("T2R_PARSE_FAST")
+
+    def test_enum_parse_and_error(self, monkeypatch):
+        monkeypatch.setenv("T2R_PARSE_BACKEND", "process")
+        assert flags.get_enum("T2R_PARSE_BACKEND") == "process"
+        monkeypatch.setenv("T2R_PARSE_BACKEND", "fork")
+        with pytest.raises(ValueError, match="T2R_PARSE_BACKEND"):
+            flags.get_enum("T2R_PARSE_BACKEND")
+
+    def test_int_clamps_to_minimum(self, monkeypatch):
+        monkeypatch.setenv("T2R_DECODE_CACHE_MB", "-5")
+        assert flags.get_int("T2R_DECODE_CACHE_MB") == 0
+        monkeypatch.setenv("T2R_DECODE_CACHE_MB", "64")
+        assert flags.get_int("T2R_DECODE_CACHE_MB") == 64
+        monkeypatch.setenv("T2R_DECODE_CACHE_MB", "lots")
+        with pytest.raises(ValueError, match="T2R_DECODE_CACHE_MB"):
+            flags.get_int("T2R_DECODE_CACHE_MB")
+
+    def test_optional_int_unset_is_none(self, monkeypatch):
+        monkeypatch.delenv("T2R_PARSE_WORKERS", raising=False)
+        assert flags.get_optional_int("T2R_PARSE_WORKERS") is None
+        monkeypatch.setenv("T2R_PARSE_WORKERS", "3")
+        assert flags.get_optional_int("T2R_PARSE_WORKERS") == 3
+
+    def test_unknown_flag_rejected(self):
+        with pytest.raises(KeyError, match="not a declared T2R flag"):
+            flags.get_bool("T2R_NOT_A_FLAG")
+
+    def test_kind_mismatch_rejected(self):
+        with pytest.raises(TypeError, match="enum flag"):
+            flags.get_bool("T2R_PARSE_BACKEND")
+
+    def test_write_and_restore_roundtrip(self, monkeypatch):
+        monkeypatch.delenv("T2R_DECODE_ROI", raising=False)
+        saved = flags.read_raw("T2R_DECODE_ROI")
+        assert saved is None
+        flags.write_env("T2R_DECODE_ROI", False)
+        assert flags.get_bool("T2R_DECODE_ROI") is False
+        flags.restore_env("T2R_DECODE_ROI", saved)
+        assert flags.get_bool("T2R_DECODE_ROI") is True
+        with pytest.raises(ValueError, match="T2R_PARSE_BACKEND"):
+            flags.write_env("T2R_PARSE_BACKEND", "fork")
+
+    def test_migrated_readers_agree_with_registry(self, monkeypatch):
+        """The pre-registry readers' semantics survived the migration:
+        same defaults, same accepted spellings (drift fix satellite)."""
+        from tensor2robot_tpu.data.dataset import (
+            default_decode_roi,
+            default_parse_backend,
+            default_parse_fast,
+            default_parse_shm,
+        )
+        from tensor2robot_tpu.data.wire import default_decode_cache_mb
+
+        for name in (
+            "T2R_DECODE_ROI",
+            "T2R_PARSE_BACKEND",
+            "T2R_PARSE_FAST",
+            "T2R_PARSE_SHM",
+            "T2R_DECODE_CACHE_MB",
+        ):
+            monkeypatch.delenv(name, raising=False)
+        assert default_decode_roi() is True
+        assert default_parse_backend() == "thread"
+        assert default_parse_fast() is True
+        assert default_parse_shm() is True
+        assert default_decode_cache_mb() == 512
+
+
+# -- CLI ----------------------------------------------------------------------
+
+
+class TestCLI:
+    def test_lint_only_clean_file_exits_zero(self, tmp_path):
+        clean = tmp_path / "clean.py"
+        clean.write_text("x = 1\n")
+        result = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "tools", "t2r_check.py"),
+             "--lint-only", str(clean)],
+            capture_output=True, text=True, cwd=_REPO,
+        )
+        assert result.returncode == 0, result.stdout + result.stderr
+
+    def test_lint_only_seeded_violation_exits_one(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text(
+            "import os\nx = os.environ.get('T2R_PARSE_FAST', '0')\n"
+        )
+        result = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "tools", "t2r_check.py"),
+             "--lint-only", str(bad)],
+            capture_output=True, text=True, cwd=_REPO,
+        )
+        assert result.returncode == 1, result.stdout + result.stderr
+        assert "env-undeclared" in result.stdout
+        assert "env-inconsistent-default" in result.stdout
+
+    def test_flags_listing(self):
+        result = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "tools", "t2r_check.py"),
+             "--flags"],
+            capture_output=True, text=True, cwd=_REPO,
+        )
+        assert result.returncode == 0
+        for spec in flags.all_flags():
+            assert spec.name in result.stdout
+
+    def test_run_checks_script_exists_and_executable(self):
+        script = os.path.join(_REPO, "tools", "run_checks.sh")
+        assert os.path.exists(script)
+        assert os.access(script, os.X_OK)
+
+    @pytest.mark.slow
+    def test_sanitize_pass_end_to_end(self, tmp_path):
+        """Builds the ASan/UBSan driver, asserts the OOB canary aborts,
+        and survives the full malformed corpus (acceptance: truncated-
+        record corpus under the sanitizer build is caught by its pass)."""
+        result = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "tools", "t2r_check.py"),
+             "--skip-specflow", "--skip-lints", "--sanitize",
+             "--corpus", str(tmp_path / "corpus")],
+            capture_output=True, text=True, cwd=_REPO,
+        )
+        if "build failed" in result.stdout:
+            pytest.skip("no ASan toolchain on this host")
+        assert result.returncode == 0, result.stdout + result.stderr
+        assert "sanitizer canary OK" in result.stdout
+        assert "survived" in result.stdout

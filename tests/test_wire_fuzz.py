@@ -1,0 +1,259 @@
+"""Fuzz/property suite: hostile bytes through the fast parse/decode path.
+
+The contract under test (ISSUE 3 satellite): truncated and bit-flipped
+records through `FastSpecParser`, and malformed jpegs through the ROI
+decode entry points, must FALL BACK to the `SpecParser` oracle or raise
+a typed error — never segfault, never hang, never return silently-wrong
+tensors. The corruption families come from the same generator the
+ASan/UBSan native driver consumes (tensor2robot_tpu/analysis/corpus.py),
+so the Python-level semantics and the native-level memory safety are
+exercised on identical inputs.
+
+The oracle-equivalence property is checked at the dataset seam
+(`_parse_chunk_impl`): for any batch, the fast+fallback composition must
+behave exactly like the oracle alone — same tensors bit for bit, or the
+same refusal.
+"""
+
+import numpy as np
+import pytest
+
+from tensor2robot_tpu.analysis import corpus
+from tensor2robot_tpu.data.dataset import _FastParseState, _parse_chunk_impl
+from tensor2robot_tpu.data.parser import (
+    SpecParser,
+    decode_image,
+    decode_image_into_native,
+    decode_image_roi,
+    decode_image_roi_into_native,
+)
+from tensor2robot_tpu.data.wire import FastSpecParser
+from tensor2robot_tpu.specs import ExtendedTensorSpec
+
+# Exceptions a corrupt record may legitimately raise out of a parse:
+# FastParseError/ValueError (wire scan), KeyError (missing feature),
+# IndexError (varint read past EOF), OSError/SyntaxError (PIL refusing a
+# corrupted embedded image — the oracle raises the identical error from
+# the shared decode_image). Anything outside this set, or a crash/hang,
+# is a bug.
+_TYPED_ERRORS = (
+    ValueError,
+    KeyError,
+    IndexError,
+    TypeError,
+    OverflowError,
+    OSError,
+    SyntaxError,
+)
+
+
+def _oracle_behavior(spec, batch):
+    """(result, error) of the oracle on a batch; exactly one is None."""
+    try:
+        return SpecParser(spec).parse_batch(batch), None
+    except Exception as err:  # noqa: BLE001 - classified below
+        return None, err
+
+
+def _assert_structs_equal(want, got):
+    assert set(want.keys()) == set(got.keys())
+    for key in want.keys():
+        w, g = np.asarray(want[key]), np.asarray(got[key])
+        assert w.dtype == g.dtype and w.shape == g.shape, key
+        np.testing.assert_array_equal(w, g, err_msg=key)
+
+
+def assert_fallback_contract(spec, batch):
+    """The property: fast-with-fallback == oracle, on success AND on
+    refusal. Also pins that a bare fast-path failure is a typed error."""
+    want, oracle_err = _oracle_behavior(spec, batch)
+    fast = FastSpecParser(spec)
+    if fast.supported:
+        try:
+            fast_result = fast.parse_batch(batch)
+        except Exception as err:  # noqa: BLE001 - the assertion target
+            assert isinstance(err, _TYPED_ERRORS), (
+                f"fast path raised untyped {type(err).__name__}: {err}"
+            )
+            fast_result = None
+        if fast_result is not None and want is not None:
+            _assert_structs_equal(want, fast_result)
+    # The dataset seam: fast + oracle fallback must equal the oracle.
+    state = _FastParseState(spec, enabled=True)
+    parser = SpecParser(spec)
+    if oracle_err is None:
+        got = _parse_chunk_impl(state, parser, batch)
+        _assert_structs_equal(want, got)
+    else:
+        with pytest.raises(type(oracle_err)):
+            _parse_chunk_impl(state, parser, batch)
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return corpus.fuzz_spec()
+
+
+@pytest.fixture(scope="module")
+def records():
+    return corpus.valid_example_records(n=3)
+
+
+class TestRecordFuzz:
+    def test_valid_records_parity(self, spec, records):
+        assert_fallback_contract(spec, records)
+
+    def test_truncations_every_boundary(self, spec, records):
+        record = records[0]
+        # Every prefix boundary in the first 64 bytes (tag/varint/len
+        # seams live there) plus a sweep across the payload.
+        cuts = list(range(0, min(64, len(record)))) + list(
+            range(64, len(record), 97)
+        )
+        for cut in cuts:
+            assert_fallback_contract(spec, [record[:cut]])
+
+    def test_bitflips(self, spec, records):
+        rng = np.random.RandomState(7)
+        record = records[1]
+        for _ in range(48):
+            offset = int(rng.randint(0, len(record)))
+            flipped = bytearray(record)
+            flipped[offset] ^= 1 << int(rng.randint(0, 8))
+            assert_fallback_contract(spec, [bytes(flipped)])
+
+    def test_mixed_batch_one_bad_record(self, spec, records):
+        """A single corrupt record poisons the batch the same way for
+        fast+fallback as for the oracle (no partial batches)."""
+        bad = records[0][: len(records[0]) // 2]
+        assert_fallback_contract(spec, [records[1], bad, records[2]])
+
+    def test_protobuf_pathologies(self, spec):
+        for name, framed in corpus.protobuf_pathologies().items():
+            payload = framed[12:-4]  # strip TFRecord framing
+            assert_fallback_contract(spec, [payload])
+
+    def test_pathologies_raise_not_hang(self, spec):
+        """Direct fast-parse of hostile payloads: typed errors only."""
+        fast = FastSpecParser(spec)
+        assert fast.supported
+        for name, framed in corpus.protobuf_pathologies().items():
+            payload = framed[12:-4]
+            try:
+                fast.parse_batch([payload])
+            except _TYPED_ERRORS:
+                pass  # refusal is the expected outcome
+
+    def test_random_garbage(self, spec):
+        rng = np.random.RandomState(13)
+        for size in (0, 1, 7, 64, 1024):
+            blob = rng.randint(0, 256, size=size, dtype=np.uint8).tobytes()
+            assert_fallback_contract(spec, [blob])
+
+
+class TestJpegFuzz:
+    """Malformed jpegs through decode (full + ROI, native + fallback)."""
+
+    @pytest.fixture(scope="class")
+    def image_spec(self):
+        return ExtendedTensorSpec(
+            shape=(24, 32, 3), dtype=np.uint8, name="image",
+            data_format="jpeg",
+        )
+
+    def test_corrupt_jpegs_never_crash_decode(self, image_spec):
+        for name, data in corpus.corrupt_jpeg_variants().items():
+            try:
+                decoded = decode_image(data, image_spec)
+            except _TYPED_ERRORS:
+                continue  # typed refusal (PIL raises OSError/SyntaxError)
+            # Silent success must honor the spec geometry exactly.
+            assert decoded.shape == (24, 32, 3), name
+            assert decoded.dtype == np.uint8, name
+
+    def test_corrupt_jpegs_native_into(self, image_spec):
+        out = np.empty((24, 32, 3), np.uint8)
+        for name, data in corpus.corrupt_jpeg_variants().items():
+            ok = decode_image_into_native(data, out)
+            if ok:
+                # Claimed success must mean REAL success: identical to a
+                # fresh full decode through the canonical path.
+                np.testing.assert_array_equal(
+                    out, decode_image(data, image_spec), err_msg=name
+                )
+
+    def test_sof_dimension_lies_rejected(self, image_spec):
+        variants = corpus.corrupt_jpeg_variants()
+        out = np.empty((24, 32, 3), np.uint8)
+        for name in ("jpg_sof_lies_big", "jpg_sof_lies_small",
+                     "jpg_sof_lies_zero"):
+            data = variants.get(name)
+            if data is None:
+                pytest.skip("SOF marker not found in the seed jpeg")
+            # Native decode-into must refuse (dims disagree with the
+            # slot) rather than write a different geometry.
+            assert not decode_image_into_native(data, out), name
+            with pytest.raises(_TYPED_ERRORS):
+                decode_image(data, image_spec)
+
+    def test_roi_decode_corrupt_inputs(self, image_spec):
+        out = np.empty((8, 8, 3), np.uint8)
+        for name, data in corpus.corrupt_jpeg_variants().items():
+            ok = decode_image_roi_into_native(data, out, 2, 3, (24, 32))
+            if ok:
+                full = decode_image(data, image_spec)
+                np.testing.assert_array_equal(
+                    out, full[2:10, 3:11], err_msg=name
+                )
+
+    def test_roi_rect_outside_frame(self):
+        data = corpus.valid_jpeg_bytes()
+        out = np.empty((8, 8, 3), np.uint8)
+        # Offsets beyond the 24x32 frame: refusal, never OOB.
+        assert not decode_image_roi_into_native(data, out, 100, 0, (24, 32))
+        assert not decode_image_roi_into_native(data, out, 0, 100, (24, 32))
+        # Source-dimension mismatch (spec says 48x64, file is 24x32).
+        assert not decode_image_roi_into_native(data, out, 0, 0, (48, 64))
+
+    def test_roi_oracle_fallback_identity(self, image_spec):
+        """decode_image_roi == full-decode-then-crop on the valid seed,
+        and refuses the corrupt ones exactly like decode_image."""
+        data = corpus.valid_jpeg_bytes()
+        window = decode_image_roi(data, image_spec, 2, 3, 8, 8)
+        full = decode_image(data, image_spec)
+        np.testing.assert_array_equal(window, full[2:10, 3:11])
+        for name, bad in corpus.corrupt_jpeg_variants().items():
+            try:
+                window = decode_image_roi(bad, image_spec, 2, 3, 8, 8)
+            except _TYPED_ERRORS:
+                with pytest.raises(_TYPED_ERRORS):
+                    decode_image(bad, image_spec)
+                continue
+            np.testing.assert_array_equal(
+                window,
+                decode_image(bad, image_spec)[2:10, 3:11],
+                err_msg=name,
+            )
+
+
+class TestHypothesisFuzz:
+    """Property-based mutations when hypothesis is installed (the image
+    does not bake it in; the deterministic suites above are the floor)."""
+
+    def test_insertion_mutations(self, spec, records):
+        hypothesis = pytest.importorskip("hypothesis")
+        st = pytest.importorskip("hypothesis.strategies")
+
+        @hypothesis.settings(max_examples=40, deadline=None)
+        @hypothesis.given(
+            index=st.integers(0, 2),
+            offset=st.integers(0, 4096),
+            payload=st.binary(min_size=1, max_size=64),
+        )
+        def run(index, offset, payload):
+            record = records[index]
+            offset = min(offset, len(record))
+            mutated = record[:offset] + payload + record[offset:]
+            assert_fallback_contract(spec, [mutated])
+
+        run()

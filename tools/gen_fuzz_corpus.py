@@ -1,0 +1,42 @@
+#!/usr/bin/env python
+"""Writes the malformed/truncated-record fuzz corpus to a directory.
+
+Thin CLI over tensor2robot_tpu/analysis/corpus.py — the same generator
+the Python fuzz suite (tests/test_wire_fuzz.py) consumes in memory.
+
+Usage:
+  python tools/gen_fuzz_corpus.py [--out DIR] [--no-mutations]
+
+Then drive the sanitized native parsers over it:
+  make -C tensor2robot_tpu/native sanitize
+  ./tensor2robot_tpu/native/t2r_fuzz_asan DIR
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--out", default="/tmp/t2r_fuzz_corpus", help="output directory"
+    )
+    parser.add_argument(
+        "--no-mutations",
+        action="store_true",
+        help="only the deterministic corruption families",
+    )
+    args = parser.parse_args()
+
+    from tensor2robot_tpu.analysis.corpus import write_corpus
+
+    paths = write_corpus(args.out, with_mutations=not args.no_mutations)
+    print(f"[gen_fuzz_corpus] wrote {len(paths)} files to {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

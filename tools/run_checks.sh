@@ -1,0 +1,56 @@
+#!/usr/bin/env bash
+# The standing correctness gate: spec-flow + lints + sanitizer corpus +
+# the t2r-check tier-1 tests. Every perf PR runs this before claiming a
+# win — a misconfigured spec contract must fail HERE, in seconds, not
+# minutes into a pod allocation (docs/static_analysis.md).
+#
+# Usage: tools/run_checks.sh [--no-sanitize] [--no-tests]
+
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+SANITIZE=1
+TESTS=1
+for arg in "$@"; do
+  case "$arg" in
+    --no-sanitize) SANITIZE=0 ;;
+    --no-tests) TESTS=0 ;;
+    *) echo "unknown arg: $arg" >&2; exit 2 ;;
+  esac
+done
+
+status=0
+
+echo "== t2r-check: spec-flow + lints =="
+if ! JAX_PLATFORMS=cpu python tools/t2r_check.py; then
+  status=1
+fi
+
+if [ "$SANITIZE" = 1 ]; then
+  echo "== sanitizer corpus (ASan/UBSan) =="
+  # t2r_check --sanitize builds, verifies the canary aborts, generates
+  # the corpus, and drives it; exit 2 = toolchain missing (warn, don't
+  # fail: laptops without ASan runtimes still get passes 1+2).
+  JAX_PLATFORMS=cpu python tools/t2r_check.py --skip-specflow --skip-lints --sanitize
+  rc=$?
+  if [ "$rc" = 1 ]; then
+    status=1
+  elif [ "$rc" = 2 ]; then
+    echo "WARNING: sanitizer pass skipped (toolchain)" >&2
+  fi
+fi
+
+if [ "$TESTS" = 1 ]; then
+  echo "== checker self-tests (tier-1 slice) =="
+  if ! JAX_PLATFORMS=cpu python -m pytest tests/test_t2r_check.py tests/test_wire_fuzz.py \
+      -q -m 'not slow' -p no:cacheprovider; then
+    status=1
+  fi
+fi
+
+if [ "$status" = 0 ]; then
+  echo "== run_checks: ALL CLEAN =="
+else
+  echo "== run_checks: FAILURES ==" >&2
+fi
+exit "$status"
