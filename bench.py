@@ -1572,6 +1572,365 @@ def bench_pipe() -> None:
         _fail("pipe_bench", err, metric=metric)
 
 
+def _serve_fixture(warmup_batch_sizes):
+    """One exported mock model + restored predictor under a temp root.
+
+    The serve bench measures the SERVER (queueing, coalescing, padding,
+    hot-swap), not the model: the mock MLP makes per-call dispatch
+    overhead the dominant cost, which is exactly the regime where
+    micro-batching must earn its keep. Returns (tmpdir_handle,
+    export_root, predictor, compiled, state, exporter)."""
+    import tempfile
+
+    import jax
+
+    from tensor2robot_tpu.export.exporters import LatestExporter
+    from tensor2robot_tpu.predictors.exported_savedmodel_predictor import (
+        ExportedSavedModelPredictor,
+    )
+    from tensor2robot_tpu.train.train_eval import CompiledModel
+    from tensor2robot_tpu.utils.mocks import MockInputGenerator, MockT2RModel
+
+    model = MockT2RModel(device_type="cpu")
+    generator = MockInputGenerator(batch_size=8)
+    generator.set_specification_from_model(model, "train")
+    batches = iter(generator.create_dataset("train"))
+    compiled = CompiledModel(model, donate_state=False)
+    state = compiled.init_state(jax.random.PRNGKey(0), next(batches))
+    tmpdir = tempfile.TemporaryDirectory(prefix="bench_serve_")
+    exporter = LatestExporter(
+        name="latest", warmup_batch_sizes=warmup_batch_sizes
+    )
+    exporter.maybe_export(
+        step=1, state=state, eval_metrics={"loss": 1.0},
+        compiled=compiled, model_dir=tmpdir.name,
+    )
+    export_root = exporter.export_root(tmpdir.name)
+    predictor = ExportedSavedModelPredictor(export_dir=export_root)
+    if not predictor.restore():
+        raise RuntimeError("serve fixture: predictor restore failed")
+    return tmpdir, export_root, predictor, compiled, state, exporter
+
+
+def _serve_open_loop(
+    server, request_fn, rate_hz, duration_s, deadline_ms, seed,
+    swap_at_s=None, swap_fn=None,
+):
+    """Open-loop Poisson arrivals: interarrival times are drawn ahead of
+    the clock and NEVER stretched by completions — the load the server
+    sees at an offered rate is independent of how it is coping, which is
+    what makes deadline-miss/shed counts meaningful. Returns the leg's
+    measurement dict."""
+    import numpy as np
+
+    from tensor2robot_tpu.serving import ServeError
+    from tensor2robot_tpu.serving.metrics import percentile
+
+    rng = np.random.RandomState(seed)
+    futures = []
+    refused = 0
+    swapped = swap_at_s is None
+    t_start = time.monotonic()
+    t_next = t_start
+    t_end = t_start + duration_s
+    while True:
+        t_next += rng.exponential(1.0 / rate_hz)
+        if t_next >= t_end:
+            break
+        if not swapped and t_next - t_start >= swap_at_s:
+            swap_fn()
+            swapped = True
+        delay = t_next - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        try:
+            futures.append((t_next - t_start, server.submit(
+                request_fn(), deadline_ms=deadline_ms
+            )))
+        except ServeError:
+            refused += 1  # reject-policy admission refusal
+    offered = len(futures) + refused
+    completions = []
+    errors = {}
+    versions = {}
+    for t_offset, future in futures:
+        try:
+            response = future.result(timeout=deadline_ms / 1e3 + 30.0)
+            completions.append((t_offset, response.spans.get("total_ms", 0.0)))
+            versions[response.model_version] = (
+                versions.get(response.model_version, 0) + 1
+            )
+        except Exception as err:  # noqa: BLE001 — shed/deadline failures are
+            # the measurement, not a bench failure.
+            errors[type(err).__name__] = errors.get(type(err).__name__, 0) + 1
+    latencies = sorted(lat for _, lat in completions)
+
+    def pct(q):
+        return percentile(latencies, q)
+
+    wall = time.monotonic() - t_start
+    snap = server.snapshot()
+    return {
+        "offered_hz": round(rate_hz, 2),
+        "offered_requests": offered,
+        "completed": len(completions),
+        "completed_hz": round(len(completions) / wall, 2),
+        "refused_at_admission": refused,
+        "errors": errors,
+        "p50_ms": round(pct(0.50), 3),
+        "p99_ms": round(pct(0.99), 3),
+        "batch_fill_ratio": round(snap["batch_fill_ratio"], 4),
+        "deadline_missed": snap["counters"]["deadline_missed"],
+        "shed": snap["counters"]["shed"],
+        "rejected": snap["counters"]["rejected"],
+        "versions_seen": {str(k): v for k, v in sorted(versions.items())},
+        "latencies_by_offset": [
+            (round(t, 3), round(lat, 3)) for t, lat in completions
+        ],
+    }
+
+
+def bench_serve(args) -> None:
+    """Fleet-serving leg: policy-server throughput/latency vs the
+    sequential single-request baseline, open-loop Poisson load sweep,
+    and a hot-swap under load (docs/SERVING.md "Fleet serving").
+
+    Invoked as `python bench.py serve`. Always a host-side measurement
+    (the server IS host code); on this image it runs on the CPU proxy
+    and reports proxy fields like the other legs.
+    """
+    import os
+
+    try:
+        devices, backend_note = _init_devices(
+            max_wait=_backend_wait(metric="policy_serve_throughput")
+        )
+    except Exception as err:
+        _fail("backend_init", err, metric="policy_serve_throughput")
+    on_tpu = devices[0].platform == "tpu"
+    metric = (
+        "policy_serve_throughput"
+        if on_tpu
+        else "policy_serve_throughput_cpu_proxy"
+    )
+    _enable_compilation_cache()
+
+    import numpy as np
+
+    try:
+        from tensor2robot_tpu.serving import PolicyServer
+
+        buckets = tuple(int(b) for b in args.buckets.split(","))
+        tmpdir, export_root, predictor, compiled, state, exporter = (
+            _serve_fixture(buckets)
+        )
+        rng = np.random.RandomState(0)
+
+        def request_fn():
+            return {"x": rng.uniform(-1, 1, size=(3,)).astype(np.float32)}
+
+        # -- sequential single-request baseline (no server): one client,
+        # one predict per request, batch 1 — the pre-subsystem topology.
+        # Median of 3 windows: this host's clock throttling makes single
+        # windows swing +/-30%.
+        one = {"x": np.zeros((1, 3), np.float32)}
+        predictor.predict(one)  # compile batch-1, untimed
+
+        def seq_window():
+            t0 = time.monotonic()
+            calls = 0
+            while time.monotonic() - t0 < max(0.8, args.baseline_secs / 3):
+                predictor.predict(one)
+                calls += 1
+            return calls / (time.monotonic() - t0)
+
+        seq_rates = sorted(seq_window() for _ in range(3))
+        seq_hz = seq_rates[1]
+
+        # -- saturation: a burst far deeper than any bucket, drained
+        # through the server. Batched throughput at 100% fill.
+        def make_saturation_server(prewarm):
+            return PolicyServer(
+                predictor, max_queue=args.burst + 8, max_wait_ms=2,
+                default_deadline_ms=120000,
+            ).start(prewarm=prewarm)
+
+        def run_burst(server, n):
+            t0 = time.monotonic()
+            futures = [server.submit(request_fn()) for _ in range(n)]
+            for future in futures:
+                future.result(timeout=120)
+            return n / (time.monotonic() - t0)
+
+        warm_server = make_saturation_server(prewarm=True)  # compiles buckets
+        run_burst(warm_server, args.burst // 2)  # thread warm-in, untimed
+        warm_server.stop()
+        # Fresh server for the timed bursts so the snapshot (batch fill,
+        # batches-by-bucket) describes ONLY the measured saturation
+        # traffic, not warm-in partial batches.
+        server = make_saturation_server(prewarm=False)
+        burst_rates = sorted(run_burst(server, args.burst) for _ in range(5))
+        sat_hz = burst_rates[2]  # median of 5
+        sat_snapshot = server.snapshot()
+        server.stop()
+        speedup = sat_hz / seq_hz if seq_hz > 0 else 0.0
+
+        # -- open-loop capacity probe: burst saturation overstates what
+        # the OPEN-LOOP topology sustains (the Poisson submitter thread
+        # itself costs GIL share), so offered-load fractions must be
+        # calibrated against a measured open-loop ceiling, not the burst
+        # number — otherwise "25% load" silently means overload.
+        server = PolicyServer(
+            predictor, max_wait_ms=args.max_wait_ms, max_queue=1024
+        )
+        server.start(prewarm=False)  # shapes already compiled above
+        probe = _serve_open_loop(
+            server, request_fn, rate_hz=max(10.0, 0.5 * sat_hz),
+            duration_s=2.5, deadline_ms=10000, seed=99,
+        )
+        server.stop()
+        capacity_hz = max(1.0, probe["completed_hz"])
+
+        # -- open-loop Poisson sweep at fractions of the open-loop
+        # capacity. Fresh server per leg isolates the counters.
+        # max_queue sized to ride out this host's observed multi-hundred-
+        # ms throttle stalls (visible in the burst-rate spread) without
+        # shedding at sub-saturation loads; the queue-full policies are
+        # measured explicitly at load_90 and in the unit tests.
+        legs = {}
+        for fraction in (0.25, 0.45, 0.9):
+            server = PolicyServer(
+                predictor, max_wait_ms=args.max_wait_ms, max_queue=1024
+            )
+            server.start(prewarm=False)
+            leg = _serve_open_loop(
+                server,
+                request_fn,
+                rate_hz=max(1.0, fraction * capacity_hz),
+                duration_s=args.leg_secs,
+                deadline_ms=args.deadline_ms,
+                seed=int(fraction * 100),
+            )
+            leg.pop("latencies_by_offset")
+            leg["offered_load_fraction"] = fraction
+            legs[f"load_{int(fraction * 100):02d}"] = leg
+            server.stop()
+
+        # -- hot-swap under load: export v2 mid-leg, async restore; no
+        # request may fail, versions must transition within the leg.
+        # Moderate (25%) load + a deep queue: the claim under test is
+        # zero-downtime swap, not backpressure (measured above).
+        server = PolicyServer(
+            predictor, max_wait_ms=args.max_wait_ms, max_queue=2048
+        )
+        server.start(prewarm=False)
+        v1 = predictor.model_version
+        swap_threads = []
+
+        def do_swap():
+            exporter.maybe_export(
+                step=2, state=state, eval_metrics={"loss": 0.9},
+                compiled=compiled, model_dir=tmpdir.name,
+            )
+            server.hot_swap()
+
+        def swap_fn():
+            # Export + restore run off the submitter thread: the arrival
+            # process must not pause while the new version materializes
+            # (that IS the zero-downtime claim under test).
+            import threading
+
+            thread = threading.Thread(target=do_swap, daemon=True)
+            thread.start()
+            swap_threads.append(thread)
+
+        swap_at = args.leg_secs * 0.35
+        swap_leg = _serve_open_loop(
+            server,
+            request_fn,
+            rate_hz=max(1.0, 0.25 * capacity_hz),
+            duration_s=args.leg_secs,
+            # Generous deadline: this leg measures swap continuity (zero
+            # failed requests), not deadline behavior — that's the sweep's
+            # job. The blip magnitude still rides in the payload.
+            deadline_ms=max(args.deadline_ms, 4 * 1e3),
+            seed=7,
+            swap_at_s=swap_at,
+            swap_fn=swap_fn,
+        )
+        for thread in swap_threads:
+            thread.join(timeout=60)
+        # The async restore may still be deserializing; give the swap a
+        # bounded window to land before reading the final version.
+        poll_deadline = time.monotonic() + 30
+        while predictor.model_version == v1 and time.monotonic() < poll_deadline:
+            time.sleep(0.05)
+        server.stop()
+        v2 = predictor.model_version
+        from tensor2robot_tpu.serving.metrics import percentile
+
+        by_offset = swap_leg.pop("latencies_by_offset")
+        pre = sorted(l for t, l in by_offset if t < swap_at)
+        post_window = sorted(l for t, l in by_offset if swap_at <= t < swap_at + 1.0)
+        swap_leg.update(
+            {
+                "swap_at_s": swap_at,
+                "version_before": v1,
+                "version_after": v2,
+                "swap_observed": v2 > v1,
+                "failed_requests": sum(swap_leg["errors"].values()),
+                "p99_before_swap_ms": round(percentile(pre, 0.99), 3),
+                "blip_max_ms_1s_after_swap": round(
+                    max(post_window), 3
+                ) if post_window else 0.0,
+            }
+        )
+
+        tmpdir.cleanup()
+        payload = {
+            "metric": metric,
+            "value": round(sat_hz, 2),
+            "unit": "requests_per_sec",
+            # Target: batched serving >= 3x the sequential baseline.
+            "vs_baseline": round(speedup / 3.0, 4),
+            "detail": {
+                "sequential_baseline_hz": round(seq_hz, 2),
+                "sequential_baseline_windows_hz": [
+                    round(rate, 2) for rate in seq_rates
+                ],
+                "saturated_hz": round(sat_hz, 2),
+                "open_loop_capacity_hz": round(capacity_hz, 2),
+                "saturation_burst_rates_hz": [
+                    round(rate, 2) for rate in burst_rates
+                ],
+                "batched_speedup": round(speedup, 2),
+                "speedup_target": 3.0,
+                "buckets": list(buckets),
+                "saturation_batch_fill": round(
+                    sat_snapshot["batch_fill_ratio"], 4
+                ),
+                "saturation_batches_by_bucket": sat_snapshot[
+                    "batches_by_bucket"
+                ],
+                "open_loop": legs,
+                "hot_swap": swap_leg,
+                "deadline_ms": args.deadline_ms,
+                "max_wait_ms": args.max_wait_ms,
+                "host_cpus": os.cpu_count(),
+                "device_kind": getattr(devices[0], "device_kind", "?"),
+                "model": "mock_mlp_3feature",
+                **({"backend_note": backend_note} if backend_note else {}),
+            },
+            **_proxy_fields(on_tpu, "policy_serve_throughput"),
+        }
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(payload, f, indent=2, sort_keys=True)
+        _emit(payload)
+    except Exception as err:  # noqa: BLE001
+        _fail("bench_serve", err, metric=metric)
+
+
 def _backend_wait(metric: str = "qtopt_critic_train_mfu_bs64_472px") -> float:
     """BENCH_BACKEND_WAIT, with malformed values reported through the
     one-JSON-line failure contract (under the caller's metric) rather
@@ -1915,18 +2274,106 @@ def main() -> None:
         _fail("bench_run", err, metric=metric)
 
 
+def _build_cli():
+    """bench legs as argparse subcommands: `python bench.py --help` lists
+    every leg, `python bench.py <leg> --help` its options and env knobs.
+    No subcommand runs the headline MFU leg (the round-end default)."""
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="bench.py",
+        description=(
+            "tensor2robot_tpu benchmark suite. Each leg prints ONE JSON "
+            "line: {metric, value, unit, vs_baseline, detail}. With no "
+            "leg, runs the headline QT-Opt critic train-MFU benchmark."
+        ),
+        epilog=(
+            "headline env knobs: BENCH_BATCH, BENCH_WIDTH, BENCH_REMAT, "
+            "BENCH_FLAT_OPT, BENCH_FUSE_STATS, BENCH_SCAN_K, "
+            "BENCH_SKIP_SCAN, BENCH_SKIP_INFEED, BENCH_PROFILE_DIR, "
+            "BENCH_BACKEND_WAIT"
+        ),
+    )
+    parser.set_defaults(func=lambda args: main())
+    sub = parser.add_subparsers(dest="leg", metavar="LEG")
+
+    def leg(name, fn, help_text, epilog=None):
+        sp = sub.add_parser(
+            name, help=help_text, description=help_text, epilog=epilog
+        )
+        sp.set_defaults(func=fn)
+        return sp
+
+    leg(
+        "data", lambda a: bench_data(),
+        "host input-pipeline throughput (images/s): fast/cold/oracle legs, "
+        "ROI attribution, parse-worker sweep",
+        epilog="env knobs: BENCH_DATA_RECORDS, BENCH_DATA_BATCH, "
+               "BENCH_DATA_BATCHES, BENCH_DATA_CONTENT=camera|noise",
+    )
+    leg(
+        "auc", lambda a: bench_auc(),
+        "training-quality AUC budget leg on the mock critic",
+        epilog="env knobs: BENCH_AUC_BATCH, BENCH_AUC_STEPS",
+    )
+    leg(
+        "predict", lambda a: bench_predict(),
+        "robot-side exported-model predict rate + jit-CEM action selects",
+        epilog="env knobs: BENCH_PREDICT_SAMPLES",
+    )
+    leg(
+        "bc", lambda a: bench_bc(),
+        "transformer-BC train throughput",
+        epilog="env knobs: BENCH_BC_WINDOW, BENCH_FLAT_OPT",
+    )
+    leg(
+        "stream", lambda a: bench_stream(),
+        "streaming KV-cache control-loop rate (steps/s)",
+    )
+    leg(
+        "pipe", lambda a: bench_pipe(),
+        "end-to-end host-feed -> device-step pipeline",
+        epilog="env knobs: BENCH_PIPE_RECORDS",
+    )
+    serve = leg(
+        "serve", bench_serve,
+        "fleet-serving leg: policy-server micro-batching throughput vs the "
+        "sequential baseline, open-loop Poisson load sweep, hot-swap under "
+        "load (docs/SERVING.md)",
+    )
+    serve.add_argument(
+        "--buckets", default="1,2,4,8,16,32",
+        help="warmup/bucket ladder exported with the fixture model "
+             "(default %(default)s)",
+    )
+    serve.add_argument(
+        "--burst", type=int, default=1024,
+        help="request count for the saturation burst (default %(default)s)",
+    )
+    serve.add_argument(
+        "--baseline-secs", type=float, default=2.0,
+        help="sequential-baseline measurement window (default %(default)s)",
+    )
+    serve.add_argument(
+        "--leg-secs", type=float, default=8.0,
+        help="duration of each open-loop Poisson leg (default %(default)s)",
+    )
+    serve.add_argument(
+        "--deadline-ms", type=float, default=500.0,
+        help="per-request deadline in the open-loop legs (default %(default)s)",
+    )
+    serve.add_argument(
+        "--max-wait-ms", type=int, default=5,
+        help="micro-batcher coalesce window (default %(default)s)",
+    )
+    serve.add_argument(
+        "--out", default="BENCH_SERVE_r08.json",
+        help="also write the payload to this file ('' disables; "
+             "default %(default)s)",
+    )
+    return parser
+
+
 if __name__ == "__main__":
-    if len(sys.argv) > 1 and sys.argv[1] == "data":
-        bench_data()
-    elif len(sys.argv) > 1 and sys.argv[1] == "auc":
-        bench_auc()
-    elif len(sys.argv) > 1 and sys.argv[1] == "predict":
-        bench_predict()
-    elif len(sys.argv) > 1 and sys.argv[1] == "bc":
-        bench_bc()
-    elif len(sys.argv) > 1 and sys.argv[1] == "stream":
-        bench_stream()
-    elif len(sys.argv) > 1 and sys.argv[1] == "pipe":
-        bench_pipe()
-    else:
-        main()
+    cli = _build_cli().parse_args()
+    cli.func(cli)

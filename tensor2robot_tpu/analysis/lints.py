@@ -22,6 +22,16 @@ Three rule families, each born from a real failure mode in this codebase:
   numpy there (XLA constant-folds them; no sync), and without dataflow
   analysis flagging them is pure noise.
 
+* Serving discipline (`serve-blocking-predict`) — inside
+  `tensor2robot_tpu/serving/` the predictor's blocking
+  `predict`/`predict_versioned`/`traced_predict` surface may be called
+  ONLY from the
+  dispatcher's batch executor (`_execute_batch`) or startup prewarm
+  (`_prewarm`). A predict call anywhere else — the submit path, a
+  metrics hook, a convenience wrapper — serializes every client behind
+  the model and silently defeats micro-batching; under load that
+  presents as mysteriously flat throughput, not an error.
+
 * Shm-ring discipline (`shm-*`) — the process-worker return path
   (data/dataset.py) cycles shared-memory slots worker->consumer through
   a free-name queue. The protocol's liveness rests on three rules the
@@ -48,6 +58,11 @@ __all__ = ["lint_source", "lint_paths", "DEFAULT_LINT_ROOTS"]
 
 # Files allowed to touch os.environ for T2R_* keys: the registry itself.
 _FLAG_REGISTRY_FILES = ("tensor2robot_tpu/flags.py",)
+
+# The serving package's only sanctioned predict call sites: the
+# dispatcher's batch executor and the startup bucket prewarm.
+_SERVING_PATH_FRAGMENT = "tensor2robot_tpu/serving/"
+_SERVE_DISPATCH_FUNCS = frozenset({"_execute_batch", "_prewarm"})
 
 # numpy calls that MATERIALIZE data on the host (traced-value poison
 # inside jit). Deliberately excludes shape/dtype arithmetic (np.prod,
@@ -123,6 +138,9 @@ class _Visitor(ast.NodeVisitor):
         self.is_flags_module = any(
             path.replace(os.sep, "/").endswith(suffix)
             for suffix in _FLAG_REGISTRY_FILES
+        )
+        self.is_serving_module = (
+            _SERVING_PATH_FRAGMENT in path.replace(os.sep, "/")
         )
         # Function names wrapped via jax.jit(fn) / partial(jax.jit, fn).
         self.jit_wrapped: Set[str] = set()
@@ -309,6 +327,26 @@ class _Visitor(ast.NodeVisitor):
                 "jnp (or hoist the computation out of the traced function)",
             )
 
+    # -- serving discipline ---------------------------------------------------
+
+    def _check_serve_call(self, node: ast.Call) -> None:
+        if not self.is_serving_module:
+            return
+        dotted = self._dotted(node.func)
+        if not dotted.endswith(
+            (".predict", ".traced_predict", ".predict_versioned")
+        ):
+            return
+        if any(name in _SERVE_DISPATCH_FUNCS for name in self._func_stack):
+            return
+        self._emit(
+            node,
+            "serve-blocking-predict",
+            f"blocking {dotted}() outside the dispatcher; in "
+            "tensor2robot_tpu/serving only _execute_batch/_prewarm may "
+            "call the predictor — route requests through submit()",
+        )
+
     # -- shm-ring discipline --------------------------------------------------
 
     def _in_ring_class(self) -> bool:
@@ -404,6 +442,7 @@ class _Visitor(ast.NodeVisitor):
             self._check_environ_call(node)
         self._check_flags_call(node)
         self._check_np_call(node)
+        self._check_serve_call(node)
         self._check_shm_call(node, self._func_stack)
         self.generic_visit(node)
 

@@ -80,6 +80,11 @@ def _mock_noop():
 register_target("qtopt-grasping44", _qtopt_grasping44)
 register_target("transformer-bc", _transformer_bc)
 register_target("mock-noop", _mock_noop)
+# The policy server's request path: predict-mode specs are what the
+# server's submit() validates against and what the micro-batcher stacks
+# into bucket batches; flowing preprocess -> inference in predict mode
+# is the static twin of request -> batch -> predict.
+register_target("mock-serving", _mock_noop, modes=("predict",))
 
 
 def default_targets() -> List[CheckTarget]:

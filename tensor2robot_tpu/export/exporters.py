@@ -144,7 +144,14 @@ class Exporter:
             predict_fn=serving_fn,
             example_features=generator.create_example_features(),
             serialize_stablehlo=self._serialize_stablehlo,
-            metadata={"exporter": self.name, "eval_metrics": eval_metrics},
+            metadata={
+                "exporter": self.name,
+                "eval_metrics": eval_metrics,
+                # The serving bucket contract: the policy server
+                # (tensor2robot_tpu/serving) pads every dispatched batch
+                # to one of these pre-warmed sizes.
+                "warmup_batch_sizes": list(self._warmup_batch_sizes),
+            },
             quantize_weights=self._quantize_weights,
             quantize_bits=self._quantize_bits,
         )

@@ -166,6 +166,49 @@ _declare(
     choices=("auto", "native", "scatterfree"),
 )
 _declare(
+    "T2R_SERVE_BUCKETS",
+    _STR,
+    None,
+    "Comma-separated batch-size bucket override for the policy server "
+    "(unset = the export's warmup_batch_sizes).",
+    "tensor2robot_tpu/serving/server.py",
+)
+_declare(
+    "T2R_SERVE_DEADLINE_MS",
+    _INT,
+    1000,
+    "Default per-request deadline (ms) when submit() passes none.",
+    "tensor2robot_tpu/serving/server.py",
+    minimum=1,
+)
+_declare(
+    "T2R_SERVE_MAX_QUEUE",
+    _INT,
+    256,
+    "Policy-server admission bound: max queued requests before the "
+    "overload policy engages.",
+    "tensor2robot_tpu/serving/server.py",
+    minimum=1,
+)
+_declare(
+    "T2R_SERVE_MAX_WAIT_MS",
+    _INT,
+    5,
+    "Micro-batcher coalesce window (ms) from first queued request to "
+    "dispatch.",
+    "tensor2robot_tpu/serving/server.py",
+    minimum=0,
+)
+_declare(
+    "T2R_SERVE_OVERLOAD",
+    _ENUM,
+    "shed_oldest",
+    "Full-queue policy: shed_oldest fails the oldest queued request, "
+    "reject refuses the incoming one.",
+    "tensor2robot_tpu/serving/server.py",
+    choices=("shed_oldest", "reject"),
+)
+_declare(
     "T2R_SKIP_HYPOTHESIS",
     _BOOL,
     False,

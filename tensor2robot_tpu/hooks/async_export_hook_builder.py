@@ -59,6 +59,8 @@ def default_create_export_fn(
             example_features=generator.create_example_features(),
             quantize_weights=quantize_weights,
             quantize_bits=quantize_bits,
+            # Bucket contract for the policy server (serving/buckets.py).
+            metadata={"warmup_batch_sizes": list(warmup_batch_sizes)},
         )
         if warmup_batch_sizes:
             generator.create_warmup_requests_numpy(warmup_batch_sizes, path)

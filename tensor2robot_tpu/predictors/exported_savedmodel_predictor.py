@@ -21,6 +21,7 @@ graph-rebuild predictors).
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Any, Callable, Dict, Mapping, Optional
@@ -64,21 +65,61 @@ class ExportedSavedModelPredictor(AbstractPredictor):
         self._predict_fn: Optional[Callable] = None
         self._lock = threading.Lock()
         self._restore_thread: Optional[threading.Thread] = None
+        # True from the moment an async restore is SCHEDULED until its
+        # thread finishes — is_alive() alone has a window where the thread
+        # exists but has not started, during which a second restore(
+        # is_async=True) would spawn a duplicate.
+        self._restore_in_flight = False
+        self._restore_thread_leaked = False
+        self._restore_prewarm: Optional[Callable] = None
+
+    def set_restore_prewarm(self, fn: Optional[Callable]) -> None:
+        """Installs `fn(loaded, predict_fn)` to run on every restore AFTER
+        the new version's serving fn is built but BEFORE it is swapped in.
+        The policy server uses this to compile every serving bucket on the
+        incoming version while the old one keeps serving — a hot swap must
+        never put a cold executable in front of live traffic. A prewarm
+        failure aborts the swap (the old version keeps serving)."""
+        with self._lock:
+            self._restore_prewarm = fn
 
     # -- restore --------------------------------------------------------------
 
     def restore(self, is_async: bool = False) -> bool:
         if is_async:
             with self._lock:
-                if self._restore_thread is not None and self._restore_thread.is_alive():
+                if self._restore_in_flight:
+                    # A restore thread is already scheduled or running;
+                    # do not start a duplicate.
                     return True
                 thread = threading.Thread(
-                    target=self._restore_sync, name="t2r-async-restore", daemon=True
+                    target=self._restore_async_target,
+                    name="t2r-async-restore",
+                    daemon=True,
                 )
+                self._restore_in_flight = True
                 self._restore_thread = thread
-            thread.start()
+                # Start under the lock: once _restore_in_flight is set no
+                # other caller can race a second thread in, and the
+                # flag/thread pair stays consistent. If start() itself
+                # fails (thread exhaustion) the flag must not stay stuck
+                # True — that would turn every future async restore into
+                # a silent no-op.
+                try:
+                    thread.start()
+                except BaseException:
+                    self._restore_in_flight = False
+                    self._restore_thread = None
+                    raise
             return True
         return self._restore_sync()
+
+    def _restore_async_target(self) -> None:
+        try:
+            self._restore_sync()
+        finally:
+            with self._lock:
+                self._restore_in_flight = False
 
     def _restore_sync(self) -> bool:
         start = time.time()
@@ -99,6 +140,20 @@ class ExportedSavedModelPredictor(AbstractPredictor):
                     # Configuration errors (no StableHLO and no model code)
                     # are permanent: propagate instead of burning the timeout.
                     predict_fn = self._build_predict_fn(loaded)
+                    prewarm = self._restore_prewarm
+                    if prewarm is not None:
+                        try:
+                            prewarm(
+                                loaded,
+                                self._serving_callable(loaded, predict_fn),
+                            )
+                        except Exception:  # noqa: BLE001 — a version that
+                            # cannot prewarm cannot serve; keep the old one.
+                            logging.exception(
+                                "restore: prewarm of %s failed; not swapping",
+                                loaded.export_dir,
+                            )
+                            return False
                     with self._lock:
                         self._loaded = loaded
                         self._predict_fn = predict_fn
@@ -148,14 +203,34 @@ class ExportedSavedModelPredictor(AbstractPredictor):
 
     # -- predict --------------------------------------------------------------
 
+    def _serving_callable(self, loaded, predict_fn) -> Callable:
+        """predict()-shaped view (flatten + tiling applied) over a SPECIFIC
+        (loaded, predict_fn) pair — the surface restore-prewarm hooks see,
+        identical to what predict() will run once the pair swaps in."""
+
+        def serve(features: Mapping[str, Any]) -> Dict[str, Any]:
+            flat = dict(flatten_spec_structure(features).items())
+            if self._tile:
+                flat = self._maybe_expand_dims(loaded.feature_spec, flat)
+            return dict(predict_fn(flat))
+
+        return serve
+
     def predict(self, features: Mapping[str, Any]) -> Dict[str, Any]:
+        return self.predict_versioned(features)[0]
+
+    def predict_versioned(
+        self, features: Mapping[str, Any]
+    ) -> "tuple[Dict[str, Any], int]":
+        """predict() plus the model version that computed it, read as one
+        atomic pair: an async-restore swap landing mid-call cannot
+        mislabel the outputs (the policy server reports this version per
+        response)."""
         self.assert_is_loaded()
         with self._lock:
             loaded, predict_fn = self._loaded, self._predict_fn
-        flat = dict(flatten_spec_structure(features).items())
-        if self._tile:
-            flat = self._maybe_expand_dims(loaded.feature_spec, flat)
-        return dict(predict_fn(flat))
+        serve = self._serving_callable(loaded, predict_fn)
+        return serve(features), self._version_of(loaded)
 
     def _maybe_expand_dims(
         self, spec: TensorSpecStruct, flat: Dict[str, Any]
@@ -189,12 +264,16 @@ class ExportedSavedModelPredictor(AbstractPredictor):
         self.assert_is_loaded()
         return self._loaded.label_spec
 
+    @staticmethod
+    def _version_of(loaded) -> int:
+        if loaded is None:
+            return -1
+        base = loaded.export_dir.rstrip("/").rsplit("/", 1)[-1]
+        return int(base) if base.isdigit() else 0
+
     @property
     def model_version(self) -> int:
-        if self._loaded is None:
-            return -1
-        base = self._loaded.export_dir.rstrip("/").rsplit("/", 1)[-1]
-        return int(base) if base.isdigit() else 0
+        return self._version_of(self._loaded)
 
     @property
     def global_step(self) -> int:
@@ -204,7 +283,26 @@ class ExportedSavedModelPredictor(AbstractPredictor):
     def model_path(self) -> Optional[str]:
         return None if self._loaded is None else self._loaded.export_dir
 
-    def close(self) -> None:
-        thread = self._restore_thread
+    @property
+    def restore_thread_leaked(self) -> bool:
+        """True when close() gave up waiting on a restore thread (it keeps
+        polling until its own timeout; fleet monitors should surface it)."""
+        return self._restore_thread_leaked
+
+    def close(self, join_timeout: float = 30.0) -> None:
+        with self._lock:
+            thread = self._restore_thread
         if thread is not None and thread.is_alive():
-            thread.join(timeout=30)
+            thread.join(timeout=join_timeout)
+            if thread.is_alive():
+                # The restore busy-wait can legitimately outlive us (its
+                # poll timeout may be minutes); surface the leak instead
+                # of silently abandoning the thread.
+                self._restore_thread_leaked = True
+                logging.warning(
+                    "ExportedSavedModelPredictor.close(): async restore "
+                    "thread still alive after %.0fs join; leaking it "
+                    "(daemon, polling %s)",
+                    join_timeout,
+                    self._export_dir,
+                )

@@ -1,0 +1,40 @@
+"""Fleet serving: dynamic micro-batching policy server (docs/SERVING.md).
+
+The host-side traffic layer over AbstractPredictor: bounded queue with
+deadlines and backpressure, bucket-padded micro-batches (ladder = the
+exporter's warmup_batch_sizes, so every served shape is pre-compiled),
+zero-downtime hot-swap, structured observability snapshots.
+"""
+
+from tensor2robot_tpu.serving.buckets import (
+    buckets_from_metadata,
+    pick_bucket,
+    resolve_buckets,
+)
+from tensor2robot_tpu.serving.metrics import RequestSpan, ServerMetrics
+from tensor2robot_tpu.serving.server import (
+    DeadlineExceeded,
+    PolicyServer,
+    RequestRejected,
+    RequestShed,
+    ServeError,
+    ServeFuture,
+    ServeResponse,
+    ServerClosed,
+)
+
+__all__ = [
+    "PolicyServer",
+    "ServeFuture",
+    "ServeResponse",
+    "ServeError",
+    "RequestRejected",
+    "RequestShed",
+    "DeadlineExceeded",
+    "ServerClosed",
+    "RequestSpan",
+    "ServerMetrics",
+    "resolve_buckets",
+    "buckets_from_metadata",
+    "pick_bucket",
+]

@@ -1,0 +1,143 @@
+"""Batch-size bucket discipline for the policy server.
+
+The exporter pre-warms a small set of batch sizes (`warmup_batch_sizes`,
+published in `t2r_metadata.json` and materialized as
+`warmup/warmup_requests.tfrecord`). The server must only ever hand the
+predictor batches at EXACTLY those sizes: the StableHLO artifact is
+batch-polymorphic, but each concrete batch size is a separate XLA
+compile, and a fresh compile in the serve path is a multi-second latency
+cliff under load. Padding every dispatch up to a bucket keeps the served
+shape set closed over what warmup already compiled.
+
+Resolution order for the ladder: explicit constructor argument >
+`T2R_SERVE_BUCKETS` > the loaded export's `warmup_batch_sizes` metadata
+> `(1,)` (the degenerate no-batching ladder).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from tensor2robot_tpu import flags as t2r_flags
+
+__all__ = [
+    "resolve_buckets",
+    "pick_bucket",
+    "pad_feature_batch",
+    "load_warmup_batches",
+]
+
+
+def _normalize(sizes: Sequence[int], source: str) -> Tuple[int, ...]:
+    out = sorted({int(s) for s in sizes})
+    if not out or any(s < 1 for s in out):
+        raise ValueError(
+            f"bucket ladder from {source} must be positive ints, got {sizes!r}"
+        )
+    return tuple(out)
+
+
+def _flag_buckets() -> Optional[Tuple[int, ...]]:
+    raw = t2r_flags.get_str("T2R_SERVE_BUCKETS")
+    if raw is None or not raw.strip():
+        return None
+    try:
+        sizes = [int(part) for part in raw.split(",") if part.strip()]
+    except ValueError as err:
+        raise ValueError(
+            f"T2R_SERVE_BUCKETS must be comma-separated ints, got {raw!r}"
+        ) from err
+    return _normalize(sizes, "T2R_SERVE_BUCKETS")
+
+
+def buckets_from_metadata(metadata: Mapping) -> Optional[Tuple[int, ...]]:
+    """The exporter-published ladder (t2r_metadata.json
+    `warmup_batch_sizes`), or None when the export predates it / was
+    written without warmup."""
+    sizes = metadata.get("warmup_batch_sizes") if metadata else None
+    if not sizes:
+        return None
+    return _normalize(sizes, "t2r_metadata.json warmup_batch_sizes")
+
+
+def resolve_buckets(
+    explicit: Optional[Sequence[int]],
+    metadata: Optional[Mapping],
+) -> Tuple[int, ...]:
+    if explicit is not None:
+        return _normalize(explicit, "batch_buckets argument")
+    from_flag = _flag_buckets()
+    if from_flag is not None:
+        return from_flag
+    from_meta = buckets_from_metadata(metadata or {})
+    if from_meta is not None:
+        return from_meta
+    return (1,)
+
+
+def pick_bucket(buckets: Tuple[int, ...], n: int) -> int:
+    """Smallest bucket that fits n requests; n above the ladder means the
+    caller must split the batch at the max bucket first."""
+    for bucket in buckets:
+        if bucket >= n:
+            return bucket
+    raise ValueError(
+        f"batch of {n} exceeds the max bucket {buckets[-1]}; dispatch at "
+        "most max-bucket requests per batch"
+    )
+
+
+def pad_feature_batch(
+    rows: List[Mapping[str, np.ndarray]], bucket: int
+) -> Dict[str, np.ndarray]:
+    """Stacks per-request flat feature rows into one batch padded to
+    `bucket` by repeating the last real row. Padding rows are pure
+    compute filler: the dispatcher never returns their outputs."""
+    if not rows:
+        raise ValueError("cannot pad an empty batch")
+    if len(rows) > bucket:
+        raise ValueError(f"{len(rows)} rows do not fit bucket {bucket}")
+    pad = bucket - len(rows)
+    out: Dict[str, np.ndarray] = {}
+    for key in rows[0]:
+        values = [np.asarray(row[key]) for row in rows]
+        values.extend([values[-1]] * pad)
+        out[key] = np.stack(values)
+    return out
+
+
+def load_warmup_batches(
+    export_dir: str, feature_spec, metadata: Mapping
+) -> Dict[int, Dict[str, np.ndarray]]:
+    """Parses `warmup/warmup_requests.tfrecord` back into per-bucket
+    batches — the exact spec-conforming payloads the exporter compiled
+    against, re-chunked by the published `warmup_batch_sizes` (rows are
+    written in ladder order). Missing warmup artifacts return {} and the
+    server synthesizes random batches instead."""
+    import os
+
+    from tensor2robot_tpu.data.parser import SpecParser
+    from tensor2robot_tpu.data.tfrecord import read_tfrecords
+    from tensor2robot_tpu.export.export_generators import (
+        WARMUP_DIR,
+        WARMUP_FILENAME,
+    )
+    from tensor2robot_tpu.specs import flatten_spec_structure
+
+    path = os.path.join(export_dir, WARMUP_DIR, WARMUP_FILENAME)
+    sizes = metadata.get("warmup_batch_sizes") if metadata else None
+    if not sizes or not os.path.exists(path):
+        return {}
+    records = list(read_tfrecords(path))
+    if len(records) != sum(sizes):
+        return {}  # foreign layout; let the caller synthesize
+    parser = SpecParser(feature_spec)
+    batches: Dict[int, Dict[str, np.ndarray]] = {}
+    offset = 0
+    for size in sizes:
+        batch = parser.parse_batch(records[offset : offset + size])
+        batches[int(size)] = dict(flatten_spec_structure(batch).items())
+        offset += size
+    return batches

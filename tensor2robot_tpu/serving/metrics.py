@@ -1,0 +1,120 @@
+"""Per-request observability for the policy server.
+
+Every request carries a span record through its lifecycle
+(enqueue -> dispatch -> compute -> reply); the server aggregates them
+into a structured snapshot cheap enough to poll at 1 Hz from a fleet
+monitor: monotonic counters (admitted/completed/shed/rejected/
+deadline-missed/hot-swaps), gauges (queue depth), latency percentiles
+over a bounded ring of recent spans, and the batch-fill ratio — the
+fraction of dispatched batch slots that carried real requests, THE
+number that says whether micro-batching is earning its latency cost.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Dict, List, Optional
+
+__all__ = ["RequestSpan", "ServerMetrics", "percentile"]
+
+
+class RequestSpan:
+    """Monotonic timestamps for one request's hops (seconds). Unset hops
+    stay None (e.g. a shed request never dispatches)."""
+
+    __slots__ = ("t_enqueue", "t_dispatch", "t_compute_done", "t_reply")
+
+    def __init__(self, t_enqueue: float):
+        self.t_enqueue = t_enqueue
+        self.t_dispatch: Optional[float] = None
+        self.t_compute_done: Optional[float] = None
+        self.t_reply: Optional[float] = None
+
+    def as_millis(self) -> Dict[str, float]:
+        """queue/compute/reply/total durations in ms (None-safe)."""
+        out: Dict[str, float] = {}
+        if self.t_dispatch is not None:
+            out["queue_ms"] = (self.t_dispatch - self.t_enqueue) * 1e3
+        if self.t_compute_done is not None and self.t_dispatch is not None:
+            out["compute_ms"] = (self.t_compute_done - self.t_dispatch) * 1e3
+        if self.t_reply is not None and self.t_compute_done is not None:
+            out["reply_ms"] = (self.t_reply - self.t_compute_done) * 1e3
+        if self.t_reply is not None:
+            out["total_ms"] = (self.t_reply - self.t_enqueue) * 1e3
+        return out
+
+
+def percentile(sorted_values: List[float], q: float) -> float:
+    """Nearest-rank percentile over an ascending list (0 when empty).
+    The single definition for both the server snapshot and the bench
+    legs, so their numbers are computed identically."""
+    if not sorted_values:
+        return 0.0
+    idx = min(len(sorted_values) - 1, int(round(q * (len(sorted_values) - 1))))
+    return sorted_values[idx]
+
+
+class ServerMetrics:
+    """Thread-safe aggregate; all mutators are O(1)."""
+
+    def __init__(self, span_window: int = 2048):
+        self._lock = threading.Lock()
+        self._spans: deque = deque(maxlen=span_window)
+        self._counters = {
+            "admitted": 0,
+            "completed": 0,
+            "failed": 0,
+            "shed": 0,
+            "rejected": 0,
+            "deadline_missed": 0,
+            "hot_swaps": 0,
+            "batches": 0,
+        }
+        self._batch_slots = 0
+        self._batch_real = 0
+        self._per_bucket: Dict[int, int] = {}
+
+    def count(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counters[name] += n
+
+    def observe_batch(self, bucket: int, real: int) -> None:
+        with self._lock:
+            self._counters["batches"] += 1
+            self._batch_slots += bucket
+            self._batch_real += real
+            self._per_bucket[bucket] = self._per_bucket.get(bucket, 0) + 1
+
+    def observe_replies(self, spans: List[Dict[str, float]]) -> None:
+        """Records a served batch's reply spans AND its completed count
+        in one lock acquisition — the only way replies are recorded, so
+        the latency window and the completed counter cannot drift."""
+        with self._lock:
+            self._spans.extend(spans)
+            self._counters["completed"] += len(spans)
+
+    def snapshot(self, queue_depth: int = 0) -> Dict:
+        with self._lock:
+            counters = dict(self._counters)
+            spans = list(self._spans)
+            slots, real = self._batch_slots, self._batch_real
+            per_bucket = dict(self._per_bucket)
+        totals = sorted(s["total_ms"] for s in spans)
+        queues = sorted(s.get("queue_ms", 0.0) for s in spans)
+        computes = sorted(s.get("compute_ms", 0.0) for s in spans)
+        return {
+            "counters": counters,
+            "queue_depth": queue_depth,
+            "batch_fill_ratio": (real / slots) if slots else 0.0,
+            "batches_by_bucket": {str(k): v for k, v in sorted(per_bucket.items())},
+            "latency_ms": {
+                "p50_total": round(percentile(totals, 0.50), 3),
+                "p99_total": round(percentile(totals, 0.99), 3),
+                "p50_queue": round(percentile(queues, 0.50), 3),
+                "p99_queue": round(percentile(queues, 0.99), 3),
+                "p50_compute": round(percentile(computes, 0.50), 3),
+                "p99_compute": round(percentile(computes, 0.99), 3),
+                "window": len(spans),
+            },
+        }

@@ -263,3 +263,75 @@ def test_bench_pipe_contract():
     assert 0 < detail["e2e_fraction_of_compute_rate"]
     assert detail["records_in_file"] == 8
     assert detail["parse_workers"] >= 1
+
+
+def test_bench_cli_lists_legs():
+    """bench.py --help must list every leg; serve --help its options
+    (the argparse-subcommand contract that replaced the argv chain)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "bench.py"), "--help"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0
+    for leg in ("data", "auc", "predict", "bc", "stream", "pipe", "serve"):
+        assert leg in proc.stdout
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "bench.py"),
+         "serve", "--help"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0
+    for option in ("--buckets", "--burst", "--deadline-ms", "--out"):
+        assert option in proc.stdout
+    # Unknown legs are an argparse error now, not a silent fallthrough
+    # into the headline benchmark.
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "bench.py"), "bogus"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode != 0
+
+
+@pytest.mark.slow
+def test_bench_serve_contract(tmp_path):
+    """The fleet-serving leg at toy scale: one JSON line + the --out
+    artifact, with the structural fields the round-end driver and
+    PERFORMANCE.md rely on."""
+    out = str(tmp_path / "serve.json")
+    payload = _run_bench(
+        "serve",
+        "--burst", "128",
+        "--baseline-secs", "0.9",
+        "--leg-secs", "1.5",
+        "--out", out,
+        env_extra={"BENCH_BACKEND_WAIT": "60"},
+        timeout=420,
+    )
+    assert payload["metric"] == "policy_serve_throughput_cpu_proxy"
+    assert payload["unit"] == "requests_per_sec"
+    assert payload["value"] > 0
+    assert "error" not in payload
+    assert payload["proxy"] is True
+    detail = payload["detail"]
+    assert detail["sequential_baseline_hz"] > 0
+    assert detail["saturated_hz"] > 0
+    assert detail["batched_speedup"] > 0
+    # The timed bursts run on a dedicated server (no warm-in batches in
+    # the snapshot); fill is ~1.0 at saturation but the first dispatch
+    # window of a burst can close partially on a loaded host.
+    assert detail["saturation_batch_fill"] >= 0.9
+    # Served batch sizes are warmup buckets only.
+    buckets = set(detail["buckets"])
+    assert set(
+        int(k) for k in detail["saturation_batches_by_bucket"]
+    ) <= buckets
+    for leg in detail["open_loop"].values():
+        assert leg["offered_hz"] > 0
+        assert "deadline_missed" in leg and "p99_ms" in leg
+    swap = detail["hot_swap"]
+    assert swap["swap_observed"] is True
+    assert swap["version_after"] > swap["version_before"]
+    import json as json_mod
+
+    with open(out) as f:
+        assert json_mod.load(f)["metric"] == payload["metric"]

@@ -115,6 +115,114 @@ class TestExportedSavedModelPredictor:
         assert predictor.model_version > 0
         predictor.close()
 
+    def test_restore_prewarm_runs_before_swap(self, trained, tmp_path):
+        """set_restore_prewarm's fn sees the incoming version's serving
+        surface BEFORE the predictor flips to it (the policy server's
+        hot-swap continuity hook)."""
+        root = str(tmp_path)
+        _export(trained, root)
+        predictor = ExportedSavedModelPredictor(export_dir=root)
+        assert predictor.restore()
+        v1 = predictor.model_version
+        seen = []
+
+        def prewarm(loaded, serve_fn):
+            # At prewarm time the OLD version is still the live one.
+            seen.append(
+                (predictor.model_version, loaded.export_dir,
+                 serve_fn({"x": np.zeros((2, 3), np.float32)}))
+            )
+
+        predictor.set_restore_prewarm(prewarm)
+        time.sleep(1.1)  # new unix-second timestamp
+        path_v2 = _export(trained, root)
+        assert predictor.restore()
+        assert predictor.model_version > v1
+        assert len(seen) == 1
+        live_at_prewarm, prewarmed_dir, outputs = seen[0]
+        assert live_at_prewarm == v1  # swap had not landed yet
+        assert prewarmed_dir == path_v2  # the incoming version compiled
+        assert outputs["a_predicted"].shape == (2, 1)
+
+    def test_restore_prewarm_failure_keeps_old_version(self, trained, tmp_path):
+        root = str(tmp_path)
+        _export(trained, root)
+        predictor = ExportedSavedModelPredictor(export_dir=root, timeout=0)
+        assert predictor.restore()
+        v1 = predictor.model_version
+
+        def broken_prewarm(loaded, serve_fn):
+            raise RuntimeError("artifact cannot compile")
+
+        predictor.set_restore_prewarm(broken_prewarm)
+        time.sleep(1.1)
+        _export(trained, root)
+        # The new version fails prewarm -> no swap, old version serves.
+        assert not predictor.restore()
+        assert predictor.model_version == v1
+        out = predictor.predict({"x": np.zeros((1, 3), np.float32)})
+        assert out["a_predicted"].shape == (1, 1)
+
+    def test_async_restore_no_duplicate_thread(self, tmp_path):
+        """A second restore(is_async=True) while one is scheduled/running
+        must not start a second thread — including the window where the
+        first thread exists but has not yet reached is_alive()."""
+        started = threading.Event()
+        release = threading.Event()
+        calls = []
+
+        class _Gated(ExportedSavedModelPredictor):
+            def _restore_sync(self):
+                calls.append(1)
+                started.set()
+                release.wait(30)
+                return False
+
+        predictor = _Gated(export_dir=str(tmp_path / "none"), timeout=0)
+        try:
+            for _ in range(5):
+                assert predictor.restore(is_async=True)
+            assert started.wait(10)
+            assert predictor._restore_in_flight
+            assert len(calls) == 1
+            alive = [
+                t for t in threading.enumerate()
+                if t.name == "t2r-async-restore" and t.is_alive()
+            ]
+            assert len(alive) == 1
+        finally:
+            release.set()
+        predictor.close()
+        # The in-flight flag clears once the thread finishes, so a LATER
+        # async restore may start again.
+        deadline = time.time() + 10
+        while predictor._restore_in_flight and time.time() < deadline:
+            time.sleep(0.01)
+        assert not predictor._restore_in_flight
+        assert not predictor.restore_thread_leaked
+
+    def test_close_surfaces_leaked_restore_thread(self, tmp_path, caplog):
+        """close() must flag + log a restore thread that outlives its
+        join timeout instead of silently leaking it."""
+        import logging as logging_mod
+
+        predictor = ExportedSavedModelPredictor(
+            # No export will ever appear: the restore busy-wait polls the
+            # empty dir for `timeout` seconds.
+            export_dir=str(tmp_path / "none"),
+            timeout=3,
+        )
+        assert predictor.restore(is_async=True)
+        with caplog.at_level(logging_mod.WARNING):
+            predictor.close(join_timeout=0.2)
+        assert predictor.restore_thread_leaked
+        assert any(
+            "restore thread still alive" in record.message
+            for record in caplog.records
+        )
+        # Bounded cleanup so the polling daemon does not outlive the test.
+        predictor._restore_thread.join(timeout=30)
+
     def test_init_randomly(self):
         predictor = ExportedSavedModelPredictor(
             export_dir="/nonexistent", t2r_model=MockT2RModel(device_type="cpu")

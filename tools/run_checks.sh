@@ -26,6 +26,15 @@ if ! JAX_PLATFORMS=cpu python tools/t2r_check.py; then
   status=1
 fi
 
+echo "== serving lint (serve-blocking-predict scope) =="
+# The package-wide lint pass above already covers serving/, but the
+# serving discipline gets its own named invocation so a violation is
+# attributed to THIS gate in CI logs (and the scope keeps working if
+# DEFAULT_LINT_ROOTS ever narrows).
+if ! JAX_PLATFORMS=cpu python tools/t2r_check.py --lint-only tensor2robot_tpu/serving; then
+  status=1
+fi
+
 if [ "$SANITIZE" = 1 ]; then
   echo "== sanitizer corpus (ASan/UBSan) =="
   # t2r_check --sanitize builds, verifies the canary aborts, generates
@@ -41,8 +50,9 @@ if [ "$SANITIZE" = 1 ]; then
 fi
 
 if [ "$TESTS" = 1 ]; then
-  echo "== checker self-tests (tier-1 slice) =="
+  echo "== checker self-tests + serving slice (tier-1) =="
   if ! JAX_PLATFORMS=cpu python -m pytest tests/test_t2r_check.py tests/test_wire_fuzz.py \
+      tests/test_serving.py \
       -q -m 'not slow' -p no:cacheprovider; then
     status=1
   fi
