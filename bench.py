@@ -1931,6 +1931,242 @@ def bench_serve(args) -> None:
         _fail("bench_serve", err, metric=metric)
 
 
+def bench_comms(args) -> None:
+    """Quantized gradient-collective leg (`python bench.py comms`).
+
+    Builds the forced 8-device host-platform mesh (the same GSPMD/
+    collective lowering a TPU slice uses; wall-times are CPU proxies,
+    byte counts are exact) and measures the ZeRO-2 gradient exchange —
+    quantized reduce-scatter + update all-gather — for fp32/fp16/int8 on
+    a QT-Opt-sized gradient tree (the flagship critic's real parameter
+    count via eval_shape). Then two correctness legs: a mock-model
+    loss-parity check (quantized-with-error-feedback vs exact within
+    tolerance after --steps training steps) and the `none`-path
+    byte-identity check against the default ZeRO-2 step.
+
+    value = int8 bytes-on-the-wire reduction vs fp32; vs_baseline =
+    reduction / 3.5 (the acceptance bar).
+    """
+    import subprocess
+
+    metric = "zero2_collective_bytes_reduction"
+    if not getattr(args, "inner", False):
+        # The 8-device host mesh must be configured before the jax
+        # backend initializes (sitecustomize imports jax at startup, but
+        # XLA_FLAGS is read at backend creation) — re-exec to be safe
+        # against any earlier leg having touched the backend.
+        env = dict(os.environ)
+        # The leg owns its mesh: an inherited device-count flag (e.g. a
+        # 4-device convention from another run) is replaced, not kept —
+        # the inner process asserts exactly 8 devices.
+        kept = [
+            part
+            for part in env.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in part
+        ]
+        env["XLA_FLAGS"] = " ".join(
+            kept + ["--xla_force_host_platform_device_count=8"]
+        )
+        env["JAX_PLATFORMS"] = "cpu"
+        # The legs own the wire format (train(None) IS the exact GSPMD
+        # baseline): an ambient fleet-wide T2R_COLLECTIVE_QUANT export
+        # must not quantize the baseline and degrade the parity check to
+        # quantized-vs-quantized.
+        env.pop("T2R_COLLECTIVE_QUANT", None)
+        env.pop("T2R_COLLECTIVE_BLOCK", None)
+        proc = subprocess.run(
+            [
+                sys.executable, os.path.abspath(__file__), "comms",
+                "--_inner", "--block", str(args.block),
+                "--steps", str(args.steps),
+                "--repeats", str(args.repeats), "--out", args.out,
+            ],
+            env=env, text=True, capture_output=True,
+        )
+        if proc.stderr:
+            sys.stderr.write(proc.stderr[-4000:])
+        lines = proc.stdout.strip().splitlines()
+        print(lines[-1] if lines else "")
+        sys.exit(proc.returncode)
+
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        import jax.flatten_util
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.sharding import PartitionSpec
+
+        devices = jax.devices()
+        if len(devices) != 8 or devices[0].platform != "cpu":
+            raise RuntimeError(
+                f"expected the forced 8-device host mesh, got {devices}"
+            )
+        from __graft_entry__ import _flagship
+
+        from tensor2robot_tpu.parallel import collectives
+        from tensor2robot_tpu.parallel import mesh as mesh_lib
+        from tensor2robot_tpu.train import train_eval
+        from tensor2robot_tpu.train.metrics import collective_record
+        from tensor2robot_tpu.utils.mocks import (
+            MockInputGenerator,
+            MockT2RModel,
+        )
+
+        mesh = mesh_lib.make_mesh(data=8)
+        axis = mesh_lib.DATA_AXIS
+        block = args.block
+
+        # The QT-Opt-sized gradient tree: the flagship critic's true
+        # parameter count, shapes only (eval_shape — nothing large is
+        # materialized at 472px on this host).
+        model, fbatch = _flagship(batch_size=1)
+        feats, _ = model.preprocessor.preprocess(
+            fbatch["features"], fbatch.get("labels"),
+            mode="train", rng=jax.random.PRNGKey(0),
+        )
+        var_shapes = jax.eval_shape(
+            lambda rng: model.init_variables(rng, feats),
+            jax.random.PRNGKey(0),
+        )
+        n_params = sum(
+            int(np.prod(leaf.shape))
+            for leaf in jax.tree_util.tree_leaves(var_shapes["params"])
+        )
+        layout = collectives.FlatShardLayout(n_params, 8, block)
+        payload = jnp.asarray(
+            np.random.RandomState(0)
+            .randn(layout.padded)
+            .astype(np.float32)
+            * 1e-3
+        )
+
+        legs = {}
+        for name in ("none", "fp16", "int8"):
+            coll = collectives.get_collective(name, block)
+
+            def exchange(flat, coll=coll):
+                reduced, _ = coll.reduce_scatter(layout.rows(flat), axis)
+                full, _ = coll.all_gather_shard(reduced / 8.0, axis)
+                return full
+
+            fn = jax.jit(
+                collectives.smap(
+                    exchange, mesh, (PartitionSpec(),), PartitionSpec()
+                )
+            )
+            jax.block_until_ready(fn(payload))  # compile outside timing
+            times = []
+            for _ in range(args.repeats):
+                start = time.perf_counter()
+                jax.block_until_ready(fn(payload))
+                times.append((time.perf_counter() - start) * 1e3)
+            times.sort()
+            pre, post = collectives.wire_summary(coll, layout.padded)
+            legs[name] = collective_record(
+                pre, post, wall_ms=times[len(times) // 2]
+            )
+        reduction = legs["int8"]["collective/compression"]
+
+        # Mock-model loss parity: same data, same seeds, N training
+        # steps; quantized-with-feedback must land within tolerance of
+        # the exact GSPMD step.
+        def train(quant):
+            mock = MockT2RModel(device_type="cpu", use_batch_norm=False)
+            generator = MockInputGenerator(batch_size=16)
+            generator.set_specification_from_model(mock, "train")
+            batches = iter(generator.create_dataset("train"))
+            first = next(batches)
+            kwargs = (
+                {}
+                if quant is None
+                else {"collective_quant": quant, "collective_block": block}
+            )
+            compiled = train_eval.CompiledModel(
+                mock, mesh=mesh, donate_state=False,
+                shard_weight_update=True, **kwargs
+            )
+            state = compiled.init_state(jax.random.PRNGKey(0), first)
+            rng = jax.random.PRNGKey(7)
+            batch, metrics = first, None
+            for _ in range(args.steps):
+                state, metrics = compiled.train_step(
+                    state, compiled.shard_batch(batch), rng
+                )
+                batch = next(batches)
+            return state, float(jax.device_get(metrics["loss"]))
+
+        exact_state, exact_loss = train(None)
+        _, fp16_loss = train("fp16")
+        _, int8_loss = train("int8")
+        tolerance = 5e-3
+        parity = {
+            "steps": args.steps,
+            "exact_loss": exact_loss,
+            "fp16_loss": fp16_loss,
+            "int8_loss": int8_loss,
+            "fp16_abs_diff": abs(fp16_loss - exact_loss),
+            "int8_abs_diff": abs(int8_loss - exact_loss),
+            "tolerance": tolerance,
+            "ok": (
+                abs(fp16_loss - exact_loss) < tolerance
+                and abs(int8_loss - exact_loss) < tolerance
+            ),
+        }
+
+        # `none` must not even engage the manual step: bitwise-identical
+        # params to the default ZeRO-2 run. (A wiring check — both legs
+        # compile the same GSPMD program, so this catches the flag
+        # accidentally engaging the manual path, not ExactCollective
+        # regressions; those live in tests/test_collectives.py.)
+        none_state, _ = train("none")
+        flat_none = jax.flatten_util.ravel_pytree(
+            jax.device_get(none_state.params)
+        )[0]
+        flat_exact = jax.flatten_util.ravel_pytree(
+            jax.device_get(exact_state.params)
+        )[0]
+        none_byte_identical = bool((flat_none == flat_exact).all())
+
+        payload_out = {
+            "metric": metric,
+            "value": reduction,
+            "unit": "x_fewer_wire_bytes",
+            "vs_baseline": reduction / 3.5,
+            "proxy": True,
+            "vs_baseline_note": (
+                "byte counts are exact (payload sizes); wall-times are "
+                "8-virtual-device host-mesh CPU proxies — on-chip ICI "
+                "timing needs a real slice"
+            ),
+            "parity_ok": parity["ok"],
+            "none_byte_identical": none_byte_identical,
+            "detail": {
+                "legs": legs,
+                "parity": parity,
+                "gradient_tree": "qtopt_grasping44_critic_params",
+                "n_params": n_params,
+                "padded": layout.padded,
+                "block": block,
+                "mesh": "8dev_host_platform_data8",
+                "host_cpus": os.cpu_count(),
+                "timing": "median_of_repeats",
+                "repeats": args.repeats,
+            },
+        }
+        _emit(payload_out)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(payload_out, f, indent=1)
+        if not parity["ok"] or not none_byte_identical or reduction < 3.5:
+            sys.exit(1)
+    except SystemExit:
+        raise
+    except Exception as err:  # noqa: BLE001
+        _fail("comms_bench", err, metric=metric)
+
+
 def _backend_wait(metric: str = "qtopt_critic_train_mfu_bs64_472px") -> float:
     """BENCH_BACKEND_WAIT, with malformed values reported through the
     one-JSON-line failure contract (under the caller's metric) rather
@@ -2334,6 +2570,37 @@ def _build_cli():
         "pipe", lambda a: bench_pipe(),
         "end-to-end host-feed -> device-step pipeline",
         epilog="env knobs: BENCH_PIPE_RECORDS",
+    )
+    comms = leg(
+        "comms", bench_comms,
+        "quantized ZeRO-2 gradient-collective leg on the forced 8-device "
+        "host mesh: bytes moved + wall-time for fp32/fp16/int8 on the "
+        "QT-Opt-sized gradient tree, mock-model loss parity, and the "
+        "none-path byte-identity check (docs/PARALLELISM.md)",
+    )
+    comms.add_argument(
+        "--block", type=int, default=512,
+        help="quantization block size, elements per scale "
+             "(default %(default)s)",
+    )
+    comms.add_argument(
+        "--steps", type=int, default=30,
+        help="mock-model training steps for the loss-parity leg "
+             "(default %(default)s)",
+    )
+    comms.add_argument(
+        "--repeats", type=int, default=7,
+        help="timed exchange repetitions per wire format "
+             "(default %(default)s)",
+    )
+    comms.add_argument(
+        "--out", default="BENCH_COMMS_r09.json",
+        help="also write the payload to this file ('' disables; "
+             "default %(default)s)",
+    )
+    comms.add_argument(
+        "--_inner", dest="inner", action="store_true",
+        help=argparse.SUPPRESS,
     )
     serve = leg(
         "serve", bench_serve,

@@ -32,6 +32,19 @@ Three rule families, each born from a real failure mode in this codebase:
   the model and silently defeats micro-batching; under load that
   presents as mysteriously flat throughput, not an error.
 
+* Collective discipline (`collective-outside-registry`) — every byte
+  that crosses a mesh axis from the trainer layers must be visible (and
+  quantizable) from ONE file: `parallel/collectives.py`, the gradient-
+  collective registry. Raw `jax.lax` manual collectives (`psum`,
+  `ppermute`, `all_to_all`, ...) or `shard_map` imported from jax inside
+  `tensor2robot_tpu/train/` or `tensor2robot_tpu/parallel/` (outside the
+  registry itself) are errors — a stray psum is exactly the
+  uncompressed, unaccounted wire traffic the quantized-collective work
+  exists to eliminate. The registry re-exports sanctioned spellings
+  (`collectives.psum`, `collectives.shard_map`, ...); zero-byte
+  manual-axis bookkeeping (`axis_index`, `pvary`/`pcast`) is out of
+  scope.
+
 * Shm-ring discipline (`shm-*`) — the process-worker return path
   (data/dataset.py) cycles shared-memory slots worker->consumer through
   a free-name queue. The protocol's liveness rests on three rules the
@@ -98,6 +111,30 @@ _NP_MATERIALIZERS = frozenset(
 )
 _NP_MODULE_ALIASES = frozenset({"np", "numpy"})
 
+# Collective discipline: the trainer layers where raw jax collectives
+# are banned, and the one file allowed to spell them.
+_COLLECTIVE_SCOPE_FRAGMENTS = (
+    "tensor2robot_tpu/train/",
+    "tensor2robot_tpu/parallel/",
+)
+_COLLECTIVE_REGISTRY_SUFFIX = "tensor2robot_tpu/parallel/collectives.py"
+# The data-moving manual collectives (bytes on the wire). axis_index /
+# pvary / pcast move nothing and stay legal raw.
+_RAW_COLLECTIVE_OPS = frozenset(
+    {
+        "psum",
+        "pmean",
+        "pmax",
+        "pmin",
+        "ppermute",
+        "pshuffle",
+        "pbroadcast",
+        "psum_scatter",
+        "all_gather",
+        "all_to_all",
+    }
+)
+
 _FLAG_GETTER_KINDS = {
     "get_bool": "bool",
     "get_int": "int",
@@ -142,6 +179,15 @@ class _Visitor(ast.NodeVisitor):
         self.is_serving_module = (
             _SERVING_PATH_FRAGMENT in path.replace(os.sep, "/")
         )
+        norm_path = path.replace(os.sep, "/")
+        self.in_collective_scope = any(
+            fragment in norm_path
+            for fragment in _COLLECTIVE_SCOPE_FRAGMENTS
+        ) and not norm_path.endswith(_COLLECTIVE_REGISTRY_SUFFIX)
+        # Module aliases bound to jax.lax in this file (`import jax.lax
+        # as jl`, `from jax import lax as jlax`): `jl.psum` must trip
+        # the collective gate exactly like `lax.psum`.
+        self._lax_aliases: Set[str] = set()
         # Function names wrapped via jax.jit(fn) / partial(jax.jit, fn).
         self.jit_wrapped: Set[str] = set()
         self._class_stack: List[str] = []
@@ -326,6 +372,79 @@ class _Visitor(ast.NodeVisitor):
                 f"host numpy call {dotted}() inside a jitted region; use "
                 "jnp (or hoist the computation out of the traced function)",
             )
+
+    # -- collective discipline ------------------------------------------------
+
+    def _check_collective_attribute(self, node: ast.Attribute) -> None:
+        """`lax.psum` / `jax.lax.all_to_all` / `jax.experimental.
+        shard_map.shard_map` spelled raw inside the trainer layers."""
+        if not self.in_collective_scope:
+            return
+        dotted = self._dotted(node)
+        parts = dotted.split(".")
+        if len(parts) < 2:
+            return
+        if parts[-1] in _RAW_COLLECTIVE_OPS and (
+            parts[-2] == "lax" or parts[-2] in self._lax_aliases
+        ):
+            self._emit(
+                node,
+                "collective-outside-registry",
+                f"raw {dotted} in the trainer layers; route it through "
+                "tensor2robot_tpu/parallel/collectives.py "
+                f"(collectives.{parts[-1]}) so every byte on the wire is "
+                "visible to the quantized-collective registry",
+            )
+        elif parts[-1] == "shard_map" and parts[0] == "jax":
+            self._emit(
+                node,
+                "collective-outside-registry",
+                f"raw {dotted} in the trainer layers; import shard_map "
+                "(or use smap) from "
+                "tensor2robot_tpu/parallel/collectives.py",
+            )
+
+    def visit_Import(self, node: ast.Import) -> None:
+        # `import jax.lax as jl` binds an alias the attribute check must
+        # see through, or `jl.psum` walks straight past the gate.
+        if self.in_collective_scope:
+            for alias in node.names:
+                if alias.name == "jax.lax" and alias.asname:
+                    self._lax_aliases.add(alias.asname)
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if self.in_collective_scope and node.module:
+            from_jax = node.module == "jax" or node.module.startswith("jax.")
+            for alias in node.names:
+                # `from jax import lax as jlax` — same aliasing hole.
+                if from_jax and alias.name == "lax" and alias.asname:
+                    self._lax_aliases.add(alias.asname)
+                if from_jax and alias.name == "shard_map":
+                    self._emit(
+                        node,
+                        "collective-outside-registry",
+                        "shard_map imported from jax in the trainer "
+                        "layers; import it from "
+                        "tensor2robot_tpu/parallel/collectives.py",
+                    )
+                elif (
+                    from_jax
+                    and node.module.endswith("lax")
+                    and alias.name in _RAW_COLLECTIVE_OPS
+                ):
+                    self._emit(
+                        node,
+                        "collective-outside-registry",
+                        f"{alias.name} imported from {node.module} in the "
+                        "trainer layers; use the sanctioned spelling in "
+                        "tensor2robot_tpu/parallel/collectives.py",
+                    )
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        self._check_collective_attribute(node)
+        self.generic_visit(node)
 
     # -- serving discipline ---------------------------------------------------
 

@@ -106,6 +106,24 @@ def _declare(
 # reviewers check this table against docs/static_analysis.md.
 
 _declare(
+    "T2R_COLLECTIVE_BLOCK",
+    _INT,
+    512,
+    "Quantization block size (elements per scale) for quantized gradient "
+    "collectives.",
+    "tensor2robot_tpu/parallel/collectives.py",
+    minimum=1,
+)
+_declare(
+    "T2R_COLLECTIVE_QUANT",
+    _ENUM,
+    "none",
+    "Gradient-collective wire format on the ZeRO-2 data-parallel path; "
+    "none keeps the exact GSPMD psum byte-for-byte.",
+    "tensor2robot_tpu/parallel/collectives.py",
+    choices=("none", "fp16", "int8"),
+)
+_declare(
     "T2R_DECODE_CACHE_MB",
     _INT,
     512,
@@ -119,6 +137,14 @@ _declare(
     True,
     "Honor decode-time ROI crops; 0 restores full-frame decode exactly.",
     "tensor2robot_tpu/data/dataset.py",
+)
+_declare(
+    "T2R_INFEED_DEPTH",
+    _INT,
+    2,
+    "Device-prefetch depth: batches kept in flight ahead of the consumer.",
+    "tensor2robot_tpu/train/infeed.py",
+    minimum=1,
 )
 _declare(
     "T2R_MULTI_EVAL_NAME",

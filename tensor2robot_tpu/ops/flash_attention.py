@@ -34,6 +34,12 @@ from jax.experimental import pallas as pl
 
 _NEG_INF = -1e30
 
+try:  # jax with varying-manual-axes tracking accepts vma annotations
+    jax.ShapeDtypeStruct((1,), jnp.float32, vma=frozenset())
+    _SDS_HAS_VMA = True
+except TypeError:  # older jax: no tracking, the annotation is a no-op
+    _SDS_HAS_VMA = False
+
 # Row statistics (l, m, lse, delta) cross the pallas_call boundary stored
 # with a trailing broadcast dim of _STATS_LANES so their blocks satisfy
 # Mosaic's (8, 128) tile constraint; a [block_q]-shaped block would need a
@@ -296,7 +302,7 @@ def flash_attention_tile(
     )
 
     def out_struct(shape):
-        if vma is not None:
+        if vma is not None and _SDS_HAS_VMA:
             return jax.ShapeDtypeStruct(shape, jnp.float32, vma=frozenset(vma))
         return jax.ShapeDtypeStruct(shape, jnp.float32)
 
@@ -642,7 +648,7 @@ def flash_attention_bwd_tile(
         return jnp.transpose(x, (0, 2, 1, 3)).reshape(bh, x.shape[1], dim)
 
     def out_struct(shape, dtype=jnp.float32):
-        if vma is not None:
+        if vma is not None and _SDS_HAS_VMA:
             return jax.ShapeDtypeStruct(shape, dtype, vma=frozenset(vma))
         return jax.ShapeDtypeStruct(shape, dtype)
 

@@ -32,12 +32,10 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-try:  # jax >= 0.7 exports shard_map at top level
-    from jax import shard_map
-except ImportError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map
 from jax.sharding import NamedSharding, PartitionSpec
 
+from tensor2robot_tpu.parallel import collectives
+from tensor2robot_tpu.parallel.collectives import shard_map
 from tensor2robot_tpu.parallel.mesh import PIPE_AXIS
 
 
@@ -169,7 +167,7 @@ def _pipeline_shard(stacked_params, micro, *, stage_fn, num_stages,
         )
         # Shift activations one stage down the chain (last stage's output
         # falls off the end; stage 0 gets zeros it overwrites next tick).
-        shifted = lax.ppermute(
+        shifted = collectives.ppermute(
             y,
             axis_name,
             perm=[(i, i + 1) for i in range(num_stages - 1)],
@@ -195,7 +193,7 @@ def _pipeline_shard(stacked_params, micro, *, stage_fn, num_stages,
     # Only the last stage holds real outputs; the masked psum replicates
     # them to every stage (out_specs is replicated), and routes cotangents
     # back to the last stage under differentiation.
-    return lax.psum(
+    return collectives.psum(
         jnp.where(stage_idx == num_stages - 1, out_acc,
                   jnp.zeros_like(out_acc)),
         axis_name,

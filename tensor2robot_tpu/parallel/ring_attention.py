@@ -24,11 +24,9 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
-try:
-    from jax import shard_map
-except ImportError:  # older jax
-    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+from tensor2robot_tpu.parallel import collectives
 
 from tensor2robot_tpu.ops.flash_attention import reference_attention
 from tensor2robot_tpu.parallel.mesh import SEQUENCE_AXIS
@@ -138,8 +136,8 @@ def _ring_shard_fn(
         )
         # Rotate K/V to the next device; XLA overlaps this DMA with the
         # next iteration's einsums.
-        k_next = lax.ppermute(k_blk, axis_name, perm)
-        v_next = lax.ppermute(v_blk, axis_name, perm)
+        k_next = collectives.ppermute(k_blk, axis_name, perm)
+        v_next = collectives.ppermute(v_blk, axis_name, perm)
         return o_new, l_new, m_new, k_next, v_next
 
     carry = (o_acc, l_acc, m_acc, k, v)
@@ -250,7 +248,7 @@ def _ring_call(q, k, v, mesh, axis_name, causal, scale, use_flash, interpret,
         # checker (jax recommends check_vma=False as the workaround); the
         # reference path keeps full checking.
         extra["check_vma"] = False
-    fn = shard_map(
+    fn = collectives.shard_map(
         functools.partial(
             _ring_shard_fn, axis_name=axis_name, causal=causal, scale=scale,
             axis_size=axis_size, use_flash=use_flash, interpret=interpret,
@@ -309,7 +307,7 @@ def _ring_bwd_shard_fn(
         # Rotate the block AND its accumulated gradient together; the
         # final rotation delivers them back to the block's owner.
         k_blk, v_blk, dk_travel, dv_travel = (
-            lax.ppermute(t, axis_name, perm)
+            collectives.ppermute(t, axis_name, perm)
             for t in (k_blk, v_blk, dk_travel, dv_travel)
         )
         carry = (dq_acc, dk_travel, dv_travel, k_blk, v_blk)
@@ -319,8 +317,8 @@ def _ring_bwd_shard_fn(
         # from home; one ppermute with the remaining shift delivers it.
         home = [(j, (j + axis_size - hops) % axis_size)
                 for j in range(axis_size)]
-        dk_travel = lax.ppermute(dk_travel, axis_name, home)
-        dv_travel = lax.ppermute(dv_travel, axis_name, home)
+        dk_travel = collectives.ppermute(dk_travel, axis_name, home)
+        dv_travel = collectives.ppermute(dv_travel, axis_name, home)
     return (
         dq_acc.astype(q.dtype),
         dk_travel.astype(k.dtype),
@@ -355,7 +353,7 @@ def _ring_flash_bwd(mesh, axis_name, causal, scale, interpret, window,
     axis_size = mesh.shape[axis_name]
     spec = P(None, axis_name, None, None)
     lse_spec = P(None, None, axis_name)
-    fn = shard_map(
+    fn = collectives.shard_map(
         functools.partial(
             _ring_bwd_shard_fn, axis_name=axis_name, causal=causal,
             scale=scale, axis_size=axis_size, interpret=interpret,

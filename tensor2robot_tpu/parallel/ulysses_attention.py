@@ -27,12 +27,9 @@ from typing import Optional
 
 import jax
 from jax import lax
-
-try:
-    from jax import shard_map
-except ImportError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+from tensor2robot_tpu.parallel import collectives
 
 from tensor2robot_tpu.ops.flash_attention import (
     flash_attention,
@@ -55,13 +52,13 @@ def _ulysses_shard_fn(
     # [B, S/N, H, D] -> [B, S, H/N, D]: scatter heads (axis 2), gather
     # sequence (axis 1).
     def scatter_heads(x):
-        return lax.all_to_all(
-            x, axis_name, split_axis=2, concat_axis=1, tiled=True
+        return collectives.all_to_all(
+            x, axis_name, 2, 1, tiled=True
         )
 
     def gather_heads(x):
-        return lax.all_to_all(
-            x, axis_name, split_axis=1, concat_axis=2, tiled=True
+        return collectives.all_to_all(
+            x, axis_name, 1, 2, tiled=True
         )
 
     q_local = scatter_heads(q)
@@ -136,7 +133,7 @@ def ulysses_attention(
         # checker; the einsum path keeps full checking (as in
         # ring_attention._ring_call).
         extra["check_vma"] = False
-    fn = shard_map(
+    fn = collectives.shard_map(
         functools.partial(
             _ulysses_shard_fn, axis_name=axis_name, causal=causal,
             scale=scale, use_flash=use_flash, interpret=interpret,

@@ -23,7 +23,16 @@ import jax
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
+from tensor2robot_tpu import flags
 from tensor2robot_tpu.parallel import mesh as mesh_lib
+
+
+def resolve_depth(depth: Optional[int] = None) -> int:
+    """Prefetch depth: an explicit argument wins; None reads the central
+    T2R_INFEED_DEPTH gate (default 2 = classic double buffering)."""
+    if depth is not None:
+        return depth
+    return flags.get_int("T2R_INFEED_DEPTH")
 
 
 def device_prefetch(
@@ -54,10 +63,37 @@ def device_prefetch(
 
 
 def stack_batches(batches: Sequence) -> object:
-    """Stacks K host batches leaf-wise along a new leading axis [K, B, ...]."""
-    return jax.tree_util.tree_map(
-        lambda *leaves: np.stack([np.asarray(l) for l in leaves]), *batches
-    )
+    """Stacks K host batches leaf-wise along a new leading axis [K, B, ...].
+
+    Each leaf writes straight into its slot of ONE preallocated output
+    array — the earlier np.asarray-then-np.stack form materialized every
+    leaf twice (a full extra copy of the whole chunk per dispatch, paid
+    on the host hot path between device steps).
+    """
+
+    def stack(*leaves):
+        # np.asarray is a no-copy view for ndarray leaves; the copy this
+        # saves is np.stack's gather into a second buffer. Shape/dtype
+        # strictness matches np.stack: mismatched shapes raise (instead
+        # of broadcasting a short tail batch across the slot) and dtypes
+        # promote to the common type (instead of pinning the first
+        # leaf's and silently wrapping).
+        arrays = [np.asarray(leaf) for leaf in leaves]
+        first = arrays[0]
+        for arr in arrays[1:]:
+            if arr.shape != first.shape:
+                raise ValueError(
+                    "all input batches must have the same leaf shapes; "
+                    f"got {arr.shape} vs {first.shape}"
+                )
+        out = np.empty(
+            (len(arrays),) + first.shape, np.result_type(*arrays)
+        )
+        for i, arr in enumerate(arrays):
+            out[i] = arr
+        return out
+
+    return jax.tree_util.tree_map(stack, *batches)
 
 
 def shard_stacked_batch(stacked, mesh):

@@ -48,6 +48,60 @@ class MetricsWriter:
             self._tb.close()
 
 
+class DeferredFetch:
+    """One-window-deferred device readback.
+
+    The eval loop needs a periodic host sync purely to bound the device
+    dispatch queue — but fetching the value it just enqueued serializes
+    dispatch behind the newest computation. Pushing the handle here and
+    draining the PREVIOUS window's handle instead keeps the queue bounded
+    (at most two windows in flight) while the fetched array has had a full
+    window to finish: the readback returns immediately instead of
+    blocking the host at the dispatch frontier.
+    """
+
+    def __init__(self):
+        self._pending = None
+
+    def push(self, device_value):
+        """Enqueues a device value; returns the PREVIOUSLY pushed value
+        fetched to host (None on the first push)."""
+        previous, self._pending = self._pending, device_value
+        if previous is None:
+            return None
+        import jax
+
+        return jax.device_get(previous)
+
+    def drain(self):
+        """Fetches and clears the pending value (end-of-loop cleanup)."""
+        pending, self._pending = self._pending, None
+        if pending is None:
+            return None
+        import jax
+
+        return jax.device_get(pending)
+
+
+def collective_record(
+    bytes_pre: float,
+    bytes_post: float,
+    wall_ms: Optional[float] = None,
+) -> Dict[str, float]:
+    """Canonical metric keys for the gradient-collective channel: pre/post
+    compression bytes per device-step and (when measured) the collective
+    wall-time. Merged into every train log record by train_eval and into
+    bench payloads by `bench.py comms`, under the same names."""
+    record = {
+        "collective/bytes_pre": float(bytes_pre),
+        "collective/bytes_post": float(bytes_post),
+        "collective/compression": float(bytes_pre) / float(bytes_post),
+    }
+    if wall_ms is not None:
+        record["collective/wall_ms"] = float(wall_ms)
+    return record
+
+
 def read_metrics(log_dir: str, filename: str = "metrics.jsonl"):
     path = os.path.join(log_dir, filename)
     if not os.path.exists(path):

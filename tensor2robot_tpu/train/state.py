@@ -31,9 +31,15 @@ def ema_as_tree(ema_params, params_tree):
     checkpoints (predictors, warm start) — must route through this, not
     use the raw value: a flat-stored EMA (flatten_optimizer_update
     regime) is a single 1-D vector that only this unravel, against the
-    matching params structure, turns back into variables."""
+    matching params structure, turns back into variables. A flat EMA
+    longer than the parameter count is the quantized-collective regime's
+    block-padded layout (parallel/collectives.FlatShardLayout); the
+    zero-gradient tail never moves and is dropped here."""
     if _is_flat_ema(ema_params):
-        return jax.flatten_util.ravel_pytree(params_tree)[1](ema_params)
+        flat, unravel = jax.flatten_util.ravel_pytree(params_tree)
+        if ema_params.shape[0] > flat.shape[0]:
+            ema_params = ema_params[: flat.shape[0]]
+        return unravel(ema_params)
     return ema_params
 
 
@@ -43,6 +49,13 @@ class TrainState:
     variables: Dict[str, Any]  # {'params': ..., 'batch_stats': ...}
     opt_state: Any
     ema_params: Optional[Any] = None
+    #: Error-feedback residual of the quantized gradient collectives
+    #: (parallel/collectives.py): {'grad': [N, padded] (dim 0 sharded over
+    #: the data axis — each replica's untransmitted gradient remainder),
+    #: 'update': [padded] (sharded — each owner-shard's untransmitted
+    #: update remainder)}. None outside the quantized ZeRO-2 regime.
+    #: Checkpointed with the state so restarts keep the exact trajectory.
+    collective_residual: Optional[Any] = None
 
     @property
     def params(self):

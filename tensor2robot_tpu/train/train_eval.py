@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import itertools
 import os
+import threading
 import time
 from typing import Any, Callable, Dict, Iterator, List, Optional
 
@@ -30,7 +31,9 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 import orbax.checkpoint as ocp
+from jax.sharding import NamedSharding, PartitionSpec
 
+from tensor2robot_tpu import flags
 from tensor2robot_tpu.hooks.golden_values_hook_builder import GOLDEN_PREFIX
 from tensor2robot_tpu.hooks.hook_builder import Hook, HookBuilder, HookContext
 from tensor2robot_tpu.models.abstract_model import (
@@ -40,16 +43,68 @@ from tensor2robot_tpu.models.abstract_model import (
     AbstractT2RModel,
 )
 from tensor2robot_tpu.models.tpu_model_wrapper import TPUT2RModelWrapper
+from tensor2robot_tpu.parallel import collectives
 from tensor2robot_tpu.parallel import mesh as mesh_lib
 from tensor2robot_tpu.specs import TensorSpecStruct, make_example_args
 from tensor2robot_tpu.train import infeed
-from tensor2robot_tpu.train.metrics import MetricsWriter
+from tensor2robot_tpu.train.metrics import (
+    DeferredFetch,
+    MetricsWriter,
+    collective_record,
+)
 from tensor2robot_tpu.train.state import TrainState, create_train_state, update_ema
 
 
 #: Metric-key prefixes whose values carry a leading batch dimension
 #: (concatenated, not averaged, when recombining grad-accum microbatches).
 BATCH_CARRYING_METRIC_PREFIXES = (GOLDEN_PREFIX, "per_example/")
+
+#: One process-wide ENQUEUE lock for multi-device (mesh-spanning) jitted
+#: programs. XLA runs each device's queue in order; two host threads
+#: enqueueing collective programs concurrently can interleave so the
+#: device queues disagree on program order — then each program sits at
+#: its collective rendezvous waiting for participants queued behind the
+#: OTHER program (queue-order inversion: a deadlock, observed between a
+#: threaded trainer and an in-process continuous_eval job). Dispatch is
+#: asynchronous, so the lock is held for the microseconds of enqueue,
+#: never for execution — trainer/eval overlap is preserved; only the
+#: ORDER every device sees becomes consistent. Production trainer and
+#: eval jobs live in separate processes and never contend here.
+_DISPATCH_LOCK = threading.Lock()
+
+
+def _serialize_dispatch(fn):
+    """Routes calls to a jitted mesh program through _DISPATCH_LOCK; jit
+    introspection (`lower`) passes through for AOT/census tests."""
+
+    def locked(*args, **kwargs):
+        with _DISPATCH_LOCK:
+            return fn(*args, **kwargs)
+
+    locked.lower = fn.lower
+    locked.__wrapped__ = fn
+    return locked
+
+
+@jax.jit
+def _init_metric_totals(metrics):
+    """Eval accumulator seed, f32 (bf16 scalars would saturate — spacing
+    2 past 256 — over long eval runs)."""
+    return {key: value.astype(jnp.float32) for key, value in metrics.items()}
+
+
+@jax.jit
+def _accumulate_metric_totals(totals, metrics):
+    return {
+        key: totals[key] + metrics[key].astype(jnp.float32)
+        for key in metrics
+    }
+
+
+# The eval accumulation runs on mesh-resident arrays — a multi-device
+# program like the steps themselves, so it takes the same enqueue lock.
+_init_metric_totals = _serialize_dispatch(_init_metric_totals)
+_accumulate_metric_totals = _serialize_dispatch(_accumulate_metric_totals)
 
 
 def _is_batch_carrying_metric(path) -> bool:
@@ -171,6 +226,8 @@ class CompiledModel:
         shard_weight_update: bool = False,
         flatten_optimizer_update: bool = False,
         fuse_batch_stats_update: Optional[bool] = None,
+        collective_quant: Optional[str] = None,
+        collective_block: Optional[int] = None,
     ):
         """Args beyond the model/mesh:
 
@@ -229,6 +286,26 @@ class CompiledModel:
           numerically the same EMA, different fusion). Use separate
           model instances when exact cross-trainer HLO stability
           matters.
+        collective_quant / collective_block: wire format for the ZeRO-2
+          gradient collectives (parallel/collectives.py). None reads the
+          central T2R_COLLECTIVE_QUANT / T2R_COLLECTIVE_BLOCK flags;
+          'none' (the default) keeps today's GSPMD-inserted psum
+          byte-for-byte. 'fp16'/'int8' switch the shard_weight_update
+          regime to an EXPLICIT shard_map step: blockwise-quantized
+          reduce-scatter of gradients + all-gather of updates with
+          per-block scales, and an error-feedback residual carried in
+          the train state (re-injected next step, so the compression
+          bias cancels and convergence is preserved). Only engages in
+          the pure data-parallel ZeRO-2 regime (shard_weight_update on,
+          data axis > 1, all other axes 1) — ignored elsewhere, so the
+          env flag can stay set fleet-wide. In this regime optimizer
+          state and the EMA mirror live on the flat block-padded
+          parameter vector (per-shard elementwise optimizer update —
+          Adam & friends; tree-structure-aware transforms like
+          global-norm clipping see one shard and are unsupported), and
+          per-replica batch-norm statistics average across the data
+          axis (the local-BN caveat, same family as grad-accum's
+          per-microbatch stats).
         """
         self.model = model
         self.mesh = mesh if mesh is not None else mesh_lib.make_mesh()
@@ -269,6 +346,52 @@ class CompiledModel:
         self._shard_weight_update = shard_weight_update
         if grad_accum_steps < 1:
             raise ValueError(f"grad_accum_steps must be >= 1, got {grad_accum_steps}")
+
+        # Quantized gradient collectives (parallel/collectives.py): only
+        # the pure data-parallel ZeRO-2 regime has the reduce-scatter /
+        # all-gather pair to compress; everywhere else the flag is inert
+        # so it can stay exported fleet-wide.
+        quant_name = (
+            collective_quant
+            if collective_quant is not None
+            else flags.get_enum("T2R_COLLECTIVE_QUANT")
+        )
+        quant_block = (
+            collective_block
+            if collective_block is not None
+            else flags.get_int("T2R_COLLECTIVE_BLOCK")
+        )
+        pure_data_parallel = all(
+            self.mesh.shape[axis] == 1
+            for axis in (
+                mesh_lib.FSDP_AXIS,
+                mesh_lib.MODEL_AXIS,
+                mesh_lib.SEQUENCE_AXIS,
+                mesh_lib.PIPE_AXIS,
+                mesh_lib.EXPERT_AXIS,
+            )
+        )
+        self._quant_collective = None
+        if (
+            quant_name != "none"
+            and shard_weight_update
+            and pure_data_parallel
+            and self.mesh.shape[mesh_lib.DATA_AXIS] > 1
+        ):
+            if self._fuse_stats:
+                raise ValueError(
+                    "fuse_batch_stats_update is unsupported with quantized "
+                    "collectives: the quantized ZeRO-2 step already runs "
+                    "per-shard on the flat parameter vector and averages "
+                    "batch-norm statistics across replicas itself."
+                )
+            self._quant_collective = collectives.get_collective(
+                quant_name, quant_block
+            )
+        # Set by init_state in the quantized-collective regime.
+        self._flat_layout = None
+        self._flat_unravel = None
+        self._quant_state_specs = None
 
         def forward_loss(params, variables, features, labels, rng_net):
             variables = dict(variables)
@@ -482,14 +605,173 @@ class CompiledModel:
                 lambda s, b: train_step(s, b, rng), state, stacked_batch
             )
 
-        self.train_step = jax.jit(
-            train_step, donate_argnums=(0,) if donate_state else ()
+        def quant_train_step(state: TrainState, batch, rng):
+            """ZeRO-2 step with EXPLICIT quantized collectives.
+
+            The GSPMD regime lets sharded autodiff insert the gradient
+            reduce-scatter and the update all-gather; to compress those
+            wires the step goes manual instead: shard_map over the data
+            axis, each replica computing grads on its local batch shard,
+            then (1) error-feedback residual added to the raveled
+            gradient, (2) blockwise-quantized reduce-scatter — each
+            replica encodes one chunk per peer, all_to_all, receivers
+            decode and sum exactly in fp32, (3) per-shard elementwise
+            optimizer update on this replica's contiguous slice of the
+            flat parameter vector (the ZeRO-2 sharded update), (4)
+            blockwise-quantized all-gather of the UPDATE (not the params:
+            every replica applies the same dequantized update, so params
+            never drift apart), (5) both quantization errors carried to
+            the next step in state.collective_residual. The payloads in
+            (2)/(4) are the gradient exchange — the traffic that scales
+            with parameter count and what wire_summary counts; metric
+            pmeans and batch-carrying metric gathers ride alongside
+            uncompressed and uncounted.
+            """
+            coll = self._quant_collective
+            layout = self._flat_layout
+            axis = mesh_lib.DATA_AXIS
+            num_shards = self.mesh.shape[axis]
+            divisor = num_shards * self.mesh.shape[mesh_lib.FSDP_AXIS]
+
+            def batch_spec(leaf):
+                shape = getattr(leaf, "shape", ())
+                if len(shape) >= 1 and shape[0] % divisor == 0:
+                    return PartitionSpec(
+                        (mesh_lib.DATA_AXIS, mesh_lib.FSDP_AXIS)
+                    )
+                return PartitionSpec()  # replicated (mirrors shard_batch)
+
+            def local_step(state, batch, rng):
+                device = collectives.axis_index(axis)
+                step_rng = jax.random.fold_in(rng, state.step)
+                rng_pre, rng_net = jax.random.split(step_rng)
+                # Independent stochasticity per replica, as one global
+                # large-batch draw would have (the microbatch fold_in
+                # precedent in accumulated_grads).
+                rng_pre = jax.random.fold_in(rng_pre, device)
+                rng_net = jax.random.fold_in(rng_net, device)
+                features, labels = self.preprocessor.preprocess(
+                    batch["features"], _batch_labels(batch),
+                    mode=MODE_TRAIN, rng=rng_pre,
+                )
+                loss, train_metrics, mutable, grads = accumulated_grads(
+                    state, features, labels, rng_net
+                )
+                residual = state.collective_residual
+                flat_grads = jax.flatten_util.ravel_pytree(grads)[0]
+                grads_fb = layout.pad(flat_grads) + residual["grad"][0]
+                rows = layout.rows(grads_fb)
+                reduced, sent = coll.reduce_scatter(rows, axis)
+                grad_residual = (rows - sent).reshape(1, layout.padded)
+                # Local losses are means over the LOCAL shard; the global
+                # mean gradient is the cross-replica sum / N.
+                grad_shard = reduced / num_shards
+                flat_params = layout.pad(
+                    jax.flatten_util.ravel_pytree(state.params)[0]
+                )
+                param_shard = layout.rows(flat_params)[device]
+                updates, opt_state = self.optimizer.update(
+                    grad_shard, state.opt_state, param_shard
+                )
+                update_fb = updates + residual["update"]
+                full_update, sent_update = coll.all_gather_shard(
+                    update_fb, axis
+                )
+                update_residual = update_fb - sent_update
+                params = self._flat_unravel(
+                    layout.unpad(flat_params + full_update)
+                )
+                new_stats = mutable.pop("batch_stats_new", None)
+                # Per-replica batch-norm statistics average across the
+                # data axis — exact for the means; the variance-of-means
+                # term is the standard local-BN caveat (same family as
+                # grad-accum's per-microbatch statistics).
+                mutable = collectives.pmean(mutable, axis)
+                if new_stats is not None:
+                    new_stats = collectives.pmean(new_stats, axis)
+                variables = dict(state.variables)
+                variables.update(mutable)
+                variables["params"] = params
+                if new_stats:
+                    variables["batch_stats"] = _apply_stats_update(
+                        variables["batch_stats"], new_stats, None
+                    )
+                ema = state.ema_params
+                if ema is not None:
+                    # The EMA mirror follows the flat sharded layout: each
+                    # replica advances its own shard with the update it
+                    # just applied (dequantized, so the mirror tracks the
+                    # params every replica actually holds).
+                    decay = model.avg_model_params_decay
+                    new_param_shard = param_shard + sent_update
+                    ema = ema * decay + new_param_shard * (1.0 - decay)
+                metrics = {"loss": loss}
+                metrics.update(train_metrics)
+
+                def combine(path, value):
+                    # Same key-driven contract as the grad-accum
+                    # recombination: batch-carrying metrics concatenate
+                    # back to the global batch, floats average, integer
+                    # counts sum.
+                    if (
+                        _is_batch_carrying_metric(path)
+                        and getattr(value, "ndim", 0) >= 1
+                    ):
+                        return collectives.all_gather(
+                            value, axis, tiled=True
+                        )
+                    if jnp.issubdtype(
+                        jnp.result_type(value), jnp.floating
+                    ):
+                        return collectives.pmean(value, axis)
+                    return collectives.psum(value, axis)
+
+                metrics = jax.tree_util.tree_map_with_path(
+                    combine, metrics
+                )
+                new_state = state.replace(
+                    step=state.step + 1,
+                    variables=variables,
+                    opt_state=opt_state,
+                    ema_params=ema,
+                    collective_residual={
+                        "grad": grad_residual,
+                        "update": update_residual,
+                    },
+                )
+                return new_state, metrics
+
+            in_specs = (
+                self._quant_state_specs,
+                jax.tree_util.tree_map(batch_spec, batch),
+                PartitionSpec(),
+            )
+            out_specs = (self._quant_state_specs, PartitionSpec())
+            return collectives.smap(
+                local_step, self.mesh, in_specs, out_specs
+            )(state, batch, rng)
+
+        def quant_train_scan(state: TrainState, stacked_batch, rng):
+            return jax.lax.scan(
+                lambda s, b: quant_train_step(s, b, rng),
+                state,
+                stacked_batch,
+            )
+
+        if self._quant_collective is not None:
+            step_fn, scan_fn = quant_train_step, quant_train_scan
+        else:
+            step_fn, scan_fn = train_step, train_scan
+        self.train_step = _serialize_dispatch(jax.jit(
+            step_fn, donate_argnums=(0,) if donate_state else ()
+        ))
+        self.train_scan = _serialize_dispatch(jax.jit(
+            scan_fn, donate_argnums=(0,) if donate_state else ()
+        ))
+        self.eval_step = _serialize_dispatch(
+            jax.jit(eval_step, static_argnums=(2,))
         )
-        self.train_scan = jax.jit(
-            train_scan, donate_argnums=(0,) if donate_state else ()
-        )
-        self.eval_step = jax.jit(eval_step, static_argnums=(2,))
-        self.predict_step = jax.jit(predict_step)
+        self.predict_step = _serialize_dispatch(jax.jit(predict_step))
 
     def init_state(self, rng: jax.Array, example_batch) -> TrainState:
         # The model initializes at its own (post-preprocess) contract: run the
@@ -529,6 +811,9 @@ class CompiledModel:
                 lambda path, x: jax.device_put(x, rule(path, x)), tree
             )
 
+        if self._quant_collective is not None:
+            return self._init_quant_state(state, place)
+
         if (
             self.mesh.shape[mesh_lib.FSDP_AXIS] > 1
             or self.mesh.shape[mesh_lib.MODEL_AXIS] > 1
@@ -566,6 +851,136 @@ class CompiledModel:
             state = place(state, replicate_rule)
             return state.replace(opt_state=opt_state, ema_params=ema_params)
         return place(state, replicate_rule)
+
+    def _init_quant_state(self, state: TrainState, place) -> TrainState:
+        """Quantized-collective (ZeRO-2) state layout.
+
+        Params/variables stay replicated for the forward/backward exactly
+        as in the GSPMD regime; optimizer state and the EMA mirror move to
+        the FLAT block-padded parameter vector, sharded over the data axis
+        (each replica owns the slice its shard_map step updates), and the
+        error-feedback residual joins the state as zeros. Like
+        flatten_optimizer_update, this changes the opt-state checkpoint
+        layout — checkpoints are not interchangeable with the tree-layout
+        regimes.
+        """
+        mesh = self.mesh
+        num_shards = mesh.shape[mesh_lib.DATA_AXIS]
+        flat, unravel = jax.flatten_util.ravel_pytree(state.params)
+        self._flat_unravel = unravel
+        layout = collectives.FlatShardLayout(
+            flat.size, num_shards, self._quant_collective.block
+        )
+        self._flat_layout = layout
+        replicated = mesh_lib.replicated(mesh)
+        sharded = NamedSharding(mesh, PartitionSpec(mesh_lib.DATA_AXIS))
+
+        def mirror_sharding(leaf):
+            if getattr(leaf, "ndim", 0) == 0:
+                return replicated
+            return sharded
+
+        ema = state.ema_params
+        state = state.replace(opt_state=(), ema_params=None)
+        state = place(state, lambda leaf: replicated)
+        # The flat mirrors are born on their sharded layout: computing
+        # them through jit with sharded out_shardings lets SPMD emit each
+        # device's slice directly, so no device ever holds a full-size
+        # padded Adam mu/nu (or the [N, padded] residual — N x params!)
+        # the way materialize-then-device_put would transiently require.
+        # That transient is exactly what ZeRO-2 sharding exists to avoid.
+        opt_shardings = jax.tree_util.tree_map(
+            mirror_sharding,
+            jax.eval_shape(lambda f: self.optimizer.init(layout.pad(f)), flat),
+        )
+        opt_state = jax.jit(
+            lambda f: self.optimizer.init(layout.pad(f)),
+            out_shardings=opt_shardings,
+        )(flat)
+        if ema is not None:
+            flat_ema = jax.flatten_util.ravel_pytree(ema)[0]
+            ema = jax.jit(layout.pad, out_shardings=sharded)(flat_ema)
+        residual = jax.jit(
+            lambda: {
+                # Per-replica untransmitted gradient remainder; dim 0 is
+                # the data axis, so each replica sees its own [1, padded]
+                # slice.
+                "grad": jnp.zeros(
+                    (num_shards, layout.padded), jnp.float32
+                ),
+                # Per-owner untransmitted update remainder on the flat
+                # layout.
+                "update": jnp.zeros((layout.padded,), jnp.float32),
+            },
+            out_shardings={"grad": sharded, "update": sharded},
+        )()
+        spec = PartitionSpec(mesh_lib.DATA_AXIS)
+        self._quant_state_specs = TrainState(
+            step=PartitionSpec(),
+            variables=jax.tree_util.tree_map(
+                lambda _: PartitionSpec(), state.variables
+            ),
+            opt_state=jax.tree_util.tree_map(
+                lambda leaf: (
+                    PartitionSpec()
+                    if getattr(leaf, "ndim", 0) == 0
+                    else spec
+                ),
+                opt_state,
+            ),
+            ema_params=None if ema is None else spec,
+            collective_residual={"grad": spec, "update": spec},
+        )
+        return state.replace(
+            opt_state=opt_state,
+            ema_params=ema,
+            collective_residual=residual,
+        )
+
+    def collective_log_record(self, measure: bool = True) -> Dict[str, float]:
+        """The gradient-collective observability channel: pre/post
+        compression bytes of the GRADIENT EXCHANGE per device-step
+        (analytic — the reduce-scatter/all-gather payloads; metric
+        pmeans/gathers ride alongside uncounted) and, when `measure`, the
+        measured wall-time of one exchange. {} outside the quantized
+        regime. Key names are shared with `bench.py comms` via
+        metrics.collective_record."""
+        if self._quant_collective is None or self._flat_layout is None:
+            return {}
+        pre, post = collectives.wire_summary(
+            self._quant_collective, self._flat_layout.padded
+        )
+        wall_ms = self.measure_collective_ms() if measure else None
+        return collective_record(pre, post, wall_ms)
+
+    def measure_collective_ms(self, repeats: int = 5) -> float:
+        """Median wall-time of one gradient exchange (quantized
+        reduce-scatter + update all-gather) in isolation, on a zeros
+        payload of the real layout — compile excluded, timed per call."""
+        coll, layout = self._quant_collective, self._flat_layout
+        axis = mesh_lib.DATA_AXIS
+
+        def local(flat):
+            reduced, _ = coll.reduce_scatter(layout.rows(flat), axis)
+            full, _ = coll.all_gather_shard(
+                reduced / layout.num_shards, axis
+            )
+            return full
+
+        fn = _serialize_dispatch(jax.jit(
+            collectives.smap(
+                local, self.mesh, (PartitionSpec(),), PartitionSpec()
+            )
+        ))
+        payload = jnp.zeros((layout.padded,), jnp.float32)
+        jax.block_until_ready(fn(payload))
+        times = []
+        for _ in range(repeats):
+            start = time.perf_counter()
+            jax.block_until_ready(fn(payload))
+            times.append((time.perf_counter() - start) * 1000.0)
+        times.sort()
+        return times[len(times) // 2]
 
     def shard_batch(self, batch):
         return mesh_lib.shard_batch(batch, self.mesh)
@@ -730,27 +1145,27 @@ def evaluate(
         eval_batches = itertools.islice(eval_batches, eval_steps)
     totals: Optional[Dict[str, jax.Array]] = None
     count = 0
+    deferred = DeferredFetch()
     for batch in infeed.device_prefetch(
-        eval_batches, compiled.shard_batch, depth=2
+        eval_batches, compiled.shard_batch, depth=infeed.resolve_depth()
     ):
         metrics = compiled.eval_step(state, batch, use_ema)
-        # Accumulate in f32: bf16 metric scalars would saturate (spacing 2
-        # past 256) over long eval runs.
-        metrics = {
-            key: value.astype(jnp.float32) for key, value in metrics.items()
-        }
+        # On-device f32 accumulation through the locked jitted helpers:
+        # these adds are mesh-spanning programs like the steps, so they
+        # must enqueue under the same dispatch lock (see _DISPATCH_LOCK).
         if totals is None:
-            totals = metrics
+            totals = _init_metric_totals(metrics)
         else:
-            totals = {
-                key: totals[key] + value for key, value in metrics.items()
-            }
+            totals = _accumulate_metric_totals(totals, metrics)
         count += 1
         if count % 32 == 0:
             # Periodic sync: without it nothing bounds the dispatch queue
-            # and long evals pile batches up on the device. A readback of
-            # one accumulated scalar drains everything queued so far.
-            jax.device_get(next(iter(totals.values())))
+            # and long evals pile batches up on the device. Deferred by
+            # one window: enqueue this window's accumulator handle and
+            # drain the PREVIOUS one (finished ~32 steps ago, so the
+            # readback returns immediately instead of serializing
+            # dispatch behind the newest computation).
+            deferred.push(next(iter(totals.values())))
     if not count or totals is None:
         return {}
     host_totals = jax.device_get(totals)
@@ -777,7 +1192,7 @@ def train_eval_model(
     use_ema_for_eval: Optional[bool] = None,
     use_tensorboard: Optional[bool] = None,
     iterations_per_loop: int = 1,
-    infeed_depth: int = 2,
+    infeed_depth: Optional[int] = None,
     remat: bool = False,
     grad_accum_steps: int = 1,
     shard_weight_update: bool = False,
@@ -792,7 +1207,8 @@ def train_eval_model(
     jitted lax.scan (reference TPUConfig.iterations_per_loop); per-step
     hooks then observe loop granularity, exactly as reference SessionRunHooks
     did under TPUEstimator. infeed_depth batches are kept device-resident
-    ahead of the consumer (double-buffered host->device transfer).
+    ahead of the consumer (None reads T2R_INFEED_DEPTH; default 2 =
+    double-buffered host->device transfer).
     remat / grad_accum_steps / shard_weight_update are the memory levers
     (see CompiledModel): recompute activations in the backward, split
     each batch into K gradient-accumulation microbatches, and/or shard
@@ -808,6 +1224,7 @@ def train_eval_model(
         shard_weight_update=shard_weight_update,
         flatten_optimizer_update=flatten_optimizer_update,
     )
+    infeed_depth = infeed.resolve_depth(infeed_depth)
     if use_ema_for_eval is None:
         use_ema_for_eval = getattr(model, "use_avg_model_params", False)
 
@@ -896,6 +1313,12 @@ def train_eval_model(
     last_saved_step = start_step
     host_batches = itertools.chain([first_batch], train_batches)
 
+    # Collective observability (quantized ZeRO-2 regime only): byte
+    # counters plus a one-off wall-time probe, merged into every log
+    # record so the metrics stream carries the comms cost alongside
+    # steps_per_sec. Empty dict everywhere else.
+    collective_info = compiled.collective_log_record()
+
     def log_metrics(step: int, metrics) -> Dict[str, float]:
         nonlocal t_last, last_log_step
         host_metrics = {
@@ -907,22 +1330,38 @@ def train_eval_model(
         host_metrics["steps_per_sec"] = (
             (step - last_log_step) / max(now - t_last, 1e-9)
         )
+        host_metrics.update(collective_info)
         t_last = now
         last_log_step = step
         writer.write(step, host_metrics)
         return host_metrics
+
+    # after_checkpoint_saved's contract is a DURABLE on-disk checkpoint
+    # (backup/eval hooks read ctx.checkpoint_path); only when such a hook
+    # is actually installed does the loop pay a finalize barrier. Plain
+    # runs let the async save overlap the next train window and finalize
+    # at exit (the `finally` below) or at the next save (orbax serializes
+    # saves internally).
+    ckpt_hooks_present = any(
+        type(hook).after_checkpoint_saved is not Hook.after_checkpoint_saved
+        for hook in hooks
+    )
 
     def checkpoint_and_eval(state, step: int) -> Dict[str, float]:
         nonlocal last_saved_step
         # Fused-stats states persist (and face hooks/exporters/eval) in
         # the canonical tree layout — the on-disk format never changes.
         state = compiled.persistable_state(state)
+        # Async save: orbax snapshots device arrays to host memory before
+        # returning, then writes in the background — the next scan window
+        # dispatches immediately instead of stalling on serialization.
         manager.save(step, args=ocp.args.StandardSave(state), force=True)
-        manager.wait_until_finished()
         last_saved_step = step
         ctx.checkpoint_path = str(
             os.path.join(model_dir, "checkpoints", str(step))
         )
+        if ckpt_hooks_present:
+            manager.wait_until_finished()
         for hook in hooks:
             hook.after_checkpoint_saved(ctx)
         return run_eval_and_export(state, step)

@@ -273,7 +273,9 @@ def test_bench_cli_lists_legs():
         capture_output=True, text=True, timeout=60,
     )
     assert proc.returncode == 0
-    for leg in ("data", "auc", "predict", "bc", "stream", "pipe", "serve"):
+    for leg in (
+        "data", "auc", "predict", "bc", "stream", "pipe", "serve", "comms"
+    ):
         assert leg in proc.stdout
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO_ROOT, "bench.py"),
@@ -282,6 +284,14 @@ def test_bench_cli_lists_legs():
     )
     assert proc.returncode == 0
     for option in ("--buckets", "--burst", "--deadline-ms", "--out"):
+        assert option in proc.stdout
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "bench.py"),
+         "comms", "--help"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0
+    for option in ("--block", "--steps", "--repeats", "--out"):
         assert option in proc.stdout
     # Unknown legs are an argparse error now, not a silent fallthrough
     # into the headline benchmark.
@@ -335,3 +345,36 @@ def test_bench_serve_contract(tmp_path):
 
     with open(out) as f:
         assert json_mod.load(f)["metric"] == payload["metric"]
+
+
+@pytest.mark.slow
+def test_bench_comms_contract(tmp_path):
+    """The quantized-collective leg at toy step counts: one JSON line +
+    the --out artifact, the >=3.5x int8 bytes-reduction bar, loss parity
+    within tolerance, and the none-path byte-identity bit."""
+    out = str(tmp_path / "comms.json")
+    payload = _run_bench(
+        "comms", "--steps", "6", "--repeats", "2", "--out", out,
+        timeout=560,
+    )
+    assert payload["metric"] == "zero2_collective_bytes_reduction"
+    assert payload["unit"] == "x_fewer_wire_bytes"
+    assert payload["value"] >= 3.5
+    assert payload["vs_baseline"] >= 1.0
+    assert payload["proxy"] is True
+    assert payload["parity_ok"] is True
+    assert payload["none_byte_identical"] is True
+    legs = payload["detail"]["legs"]
+    for name in ("none", "fp16", "int8"):
+        assert legs[name]["collective/wall_ms"] > 0
+        assert legs[name]["collective/bytes_post"] > 0
+    assert legs["none"]["collective/compression"] == 1.0
+    assert legs["fp16"]["collective/compression"] > 1.9
+    parity = payload["detail"]["parity"]
+    assert parity["int8_abs_diff"] < parity["tolerance"]
+    # The tree really is QT-Opt-critic sized (not a toy vector).
+    assert payload["detail"]["n_params"] > 1_000_000
+    import json as json_mod
+
+    with open(out) as f:
+        assert json_mod.load(f)["value"] == payload["value"]

@@ -305,6 +305,9 @@ class TestRingWithFlashTiles:
             np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-4
         )
 
+    # ~35s: pallas-interpret backward over the full ring; the per-tile
+    # flash gradients above keep fast-slice coverage of the kernel vjp.
+    @pytest.mark.slow
     def test_ring_flash_gradients(self):
         """grad must flow through the flash ring (custom vjp; the TPU
         default path is use_flash=True)."""

@@ -68,6 +68,9 @@ class TestLosses:
 
 
 class TestGrasp2VecModel:
+    # ~26s: end-to-end trainer run; the labels-subtree regression it
+    # guards is also covered by the cheap forward/loss tests above.
+    @pytest.mark.slow
     def test_trains_through_train_eval_model(self, tmp_path):
         """Label-less (self-supervised) end to end through the public
         trainer: generators emit no 'labels' subtree for an empty label

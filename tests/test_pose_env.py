@@ -270,6 +270,8 @@ class TestEndToEnd:
             cfg.clear_config()
 
 
+    # ~27s: full shipped-config MAML train run.
+    @pytest.mark.slow
     def test_maml_gin_config_trains(self, tmp_path):
         """Executes the shipped MAML config (every shipped gin config must
         run — reference train_eval_test_utils.test_train_eval_gin), with

@@ -23,7 +23,12 @@ def _qkv(batch=2, seq=32, heads=4, dim=16, seed=0):
 
 class TestRingAttention:
     @pytest.mark.parametrize("causal", [False, True])
-    @pytest.mark.parametrize("n_shards", [2, 4, 8])
+    # The 8-shard pair costs ~30s on 2 cpus; 2/4-shard variants keep
+    # the parity fast, 8 joins the slow slice.
+    @pytest.mark.parametrize(
+        "n_shards",
+        [2, 4, pytest.param(8, marks=pytest.mark.slow)],
+    )
     def test_matches_full_attention(self, causal, n_shards):
         mesh = mesh_lib.make_mesh(
             data=1, sequence=n_shards, devices=jax.devices()[:n_shards]
@@ -86,6 +91,9 @@ class TestRingAttention:
             np.asarray(actual), np.asarray(expected), atol=2e-5, rtol=2e-5
         )
 
+    # ~38s across the pair (flash = pallas-interpret): slow slice; the
+    # sliding-window forward parity tests above stay fast.
+    @pytest.mark.slow
     @pytest.mark.parametrize("use_flash", [False, True])
     def test_sliding_window_gradients(self, use_flash):
         """Windowed gradients match the windowed reference on BOTH ring
@@ -165,6 +173,9 @@ class TestRingAttention:
 
 
 class TestGraftEntry:
+    # ~200s on a 2-cpu host: the dryrun spans every parallelism regime,
+    # so it lives in the slow slice alongside the other integration runs.
+    @pytest.mark.slow
     def test_dryrun_multichip(self):
         import importlib.util
 
@@ -180,6 +191,10 @@ class TestRingFlashBackward:
     """The flash ring backward (per-hop Pallas backward kernels, dk/dv
     riding the ring home) against the differentiated einsum ring."""
 
+    # Pallas-interpret backward over the full ring is ~50s per case on
+    # CPU; the einsum-ring gradient cross-checks below keep fast-slice
+    # coverage of the same seam.
+    @pytest.mark.slow
     @pytest.mark.parametrize("causal", [False, True])
     def test_separate_qkv_gradients(self, causal):
         n = min(4, len(jax.devices()))

@@ -274,6 +274,83 @@ class TestLintsCatch:
         diags = lint_source("def broken(:\n", "bad.py")
         assert [d.rule for d in diags] == ["syntax-error"]
 
+    # -- collective discipline ------------------------------------------------
+
+    _TRAIN_PATH = "tensor2robot_tpu/train/seeded.py"
+
+    def test_raw_lax_collective_in_trainer_flagged(self):
+        source = (
+            "import jax\nfrom jax import lax\n"
+            "def f(x):\n"
+            "    return lax.psum(x, 'data') + jax.lax.all_to_all("
+            "x, 'data', 0, 0)\n"
+        )
+        diags = lint_source(source, self._TRAIN_PATH)
+        rules = [d.rule for d in diags]
+        assert rules.count("collective-outside-registry") == 2
+
+    def test_shard_map_import_in_trainer_flagged(self):
+        for stmt in (
+            "from jax import shard_map\n",
+            "from jax.experimental.shard_map import shard_map\n",
+        ):
+            diags = lint_source(stmt, self._TRAIN_PATH)
+            assert any(
+                d.rule == "collective-outside-registry" for d in diags
+            ), stmt
+
+    def test_lax_psum_from_import_flagged(self):
+        diags = lint_source(
+            "from jax.lax import psum\n", self._TRAIN_PATH
+        )
+        assert any(d.rule == "collective-outside-registry" for d in diags)
+
+    def test_lax_module_alias_flagged(self):
+        # Aliasing the module must not walk past the gate.
+        for source in (
+            "import jax.lax as jl\ndef f(x):\n"
+            "    return jl.psum(x, 'data')\n",
+            "from jax import lax as jlax\ndef f(x):\n"
+            "    return jlax.all_gather(x, 'data')\n",
+        ):
+            diags = lint_source(source, self._TRAIN_PATH)
+            assert any(
+                d.rule == "collective-outside-registry" for d in diags
+            ), source
+
+    def test_registry_itself_exempt(self):
+        source = (
+            "from jax import lax\n"
+            "from jax.experimental.shard_map import shard_map\n"
+            "def f(x):\n    return lax.psum(x, 'data')\n"
+        )
+        assert (
+            lint_source(
+                source, "tensor2robot_tpu/parallel/collectives.py"
+            )
+            == []
+        )
+
+    def test_sanctioned_spellings_and_outside_scope_clean(self):
+        # collectives.* calls in the trainer are the sanctioned route.
+        source = (
+            "from tensor2robot_tpu.parallel import collectives\n"
+            "def f(x):\n"
+            "    return collectives.psum(x, 'data') + "
+            "collectives.axis_index('data')\n"
+        )
+        assert lint_source(source, self._TRAIN_PATH) == []
+        # ops/ is out of scope for this rule.
+        raw = "from jax import lax\ndef f(x):\n    return lax.psum(x, 'i')\n"
+        assert lint_source(raw, "tensor2robot_tpu/ops/seeded.py") == []
+        # Zero-byte manual-axis bookkeeping stays legal raw.
+        bookkeeping = (
+            "from jax import lax\n"
+            "def f(x):\n    return lax.axis_index('data'), "
+            "lax.pcast(x, ('data',), to='varying')\n"
+        )
+        assert lint_source(bookkeeping, self._TRAIN_PATH) == []
+
 
 # -- 3. the flag registry -----------------------------------------------------
 

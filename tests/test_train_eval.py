@@ -265,6 +265,182 @@ class TestInfeed:
         assert shard_shape == (4, 8 // n_data, 3)
         np.testing.assert_array_equal(np.asarray(placed["x"]), stacked["x"])
 
+    def test_stack_batches_matches_np_stack_across_leaf_types(self):
+        """The preallocated single-copy stack must be value-identical to
+        np.stack for numpy, scalar, and device-array leaves."""
+        import jax.numpy as jnp
+
+        from tensor2robot_tpu.train.infeed import stack_batches
+
+        batches = [
+            {
+                "np": np.full((4, 2), i, np.float32),
+                "scalar": np.asarray(i, np.int64),
+                "dev": jnp.full((2,), i, jnp.int32),
+            }
+            for i in range(3)
+        ]
+        stacked = stack_batches(batches)
+        assert stacked["np"].dtype == np.float32
+        assert stacked["np"].shape == (3, 4, 2)
+        for key in ("np", "scalar", "dev"):
+            expected = np.stack(
+                [np.asarray(b[key]) for b in batches]
+            )
+            np.testing.assert_array_equal(np.asarray(stacked[key]), expected)
+
+    def test_resolve_depth_reads_central_flag(self):
+        from tensor2robot_tpu import flags
+        from tensor2robot_tpu.train.infeed import resolve_depth
+
+        assert resolve_depth(5) == 5
+        saved = flags.read_raw("T2R_INFEED_DEPTH")
+        try:
+            flags.restore_env("T2R_INFEED_DEPTH", None)
+            assert resolve_depth() == 2  # registry default
+            flags.write_env("T2R_INFEED_DEPTH", 4)
+            assert resolve_depth() == 4
+        finally:
+            flags.restore_env("T2R_INFEED_DEPTH", saved)
+
+
+class TestDeferredMetricsFetch:
+    def test_deferred_fetch_semantics(self):
+        import jax.numpy as jnp
+
+        from tensor2robot_tpu.train.metrics import DeferredFetch
+
+        deferred = DeferredFetch()
+        assert deferred.push(jnp.asarray(1.0)) is None  # nothing pending
+        assert float(deferred.push(jnp.asarray(2.0))) == 1.0
+        assert float(deferred.push(jnp.asarray(3.0))) == 2.0
+        assert float(deferred.drain()) == 3.0
+        assert deferred.drain() is None
+
+    def test_long_eval_averages_stay_exact(self):
+        """evaluate() crosses several 32-step deferral windows; the
+        deferred drain must not perturb the accumulated averages."""
+        model = MockT2RModel(device_type="cpu", use_batch_norm=False)
+        generator = MockInputGenerator(batch_size=8)
+        generator.set_specification_from_model(model, "train")
+        batch = next(iter(generator.create_dataset("train")))
+        compiled = train_eval.CompiledModel(model, donate_state=False)
+        state = compiled.init_state(jax.random.PRNGKey(0), batch)
+        eval_generator = MockInputGenerator(batch_size=8, seed=3)
+        eval_generator.set_specification_from_model(model, "eval")
+        metrics = train_eval.evaluate(
+            compiled,
+            state,
+            iter(eval_generator.create_dataset("eval")),
+            eval_steps=70,
+        )
+        assert 0.0 <= metrics["accuracy"] <= 1.0
+        # Reference: the same 70 batches averaged with a plain loop.
+        ref_batches = list(
+            __import__("itertools").islice(
+                iter(eval_generator.create_dataset("eval")), 70
+            )
+        )
+        totals = None
+        for ref_batch in ref_batches:
+            m = compiled.eval_step(
+                state, compiled.shard_batch(ref_batch), False
+            )
+            m = {k: float(v) for k, v in jax.device_get(m).items()}
+            totals = (
+                m
+                if totals is None
+                else {k: totals[k] + v for k, v in m.items()}
+            )
+        for key, total in totals.items():
+            assert abs(metrics[key] - total / 70) < 1e-5
+
+
+class _SpyManager:
+    """Wraps a real orbax CheckpointManager, recording call order."""
+
+    def __init__(self, inner, events):
+        self._inner = inner
+        self._events = events
+
+    def save(self, step, *args, **kwargs):
+        self._events.append(("save", step))
+        return self._inner.save(step, *args, **kwargs)
+
+    def wait_until_finished(self):
+        self._events.append(("wait", None))
+        return self._inner.wait_until_finished()
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+class TestAsyncCheckpointing:
+    """A mid-loop save must NOT block the loop on its own
+    wait_until_finished; the write finalizes at exit (or before a
+    checkpoint-consuming hook fires)."""
+
+    def _train(self, tmp_path, monkeypatch, hook_builders=None):
+        events = []
+        real_create = train_eval.create_checkpoint_manager
+
+        def spied(*args, **kwargs):
+            return _SpyManager(real_create(*args, **kwargs), events)
+
+        monkeypatch.setattr(
+            train_eval, "create_checkpoint_manager", spied
+        )
+        train_eval.train_eval_model(
+            t2r_model=MockT2RModel(device_type="cpu"),
+            input_generator_train=MockInputGenerator(batch_size=8),
+            model_dir=str(tmp_path / "run"),
+            max_train_steps=4,
+            eval_steps=None,
+            save_checkpoints_steps=2,
+            log_every_steps=10,
+            hook_builders=hook_builders,
+        )
+        return events
+
+    def test_midloop_save_does_not_wait(self, tmp_path, monkeypatch):
+        events = self._train(tmp_path, monkeypatch)
+        saves = [i for i, e in enumerate(events) if e[0] == "save"]
+        waits = [i for i, e in enumerate(events) if e[0] == "wait"]
+        assert len(saves) == 2, events
+        assert waits, "exit must finalize pending saves"
+        # No wait between the saves: the mid-loop save overlapped the
+        # next train window, and the first wait happened only after the
+        # LAST save (the exit finalize).
+        assert min(waits) > max(saves), events
+
+    def test_checkpoint_hook_forces_finalize_first(
+        self, tmp_path, monkeypatch
+    ):
+        """A hook that consumes ctx.checkpoint_path (backup/eval hooks)
+        requires a durable checkpoint: the save must finalize BEFORE the
+        hook fires, i.e. before the next save."""
+        durable = []
+
+        class BackupHookBuilder(HookBuilder):
+            def create_hooks(self, t2r_model, trainer=None):
+                class BackupHook(Hook):
+                    def after_checkpoint_saved(self, ctx):
+                        durable.append(ctx.checkpoint_path)
+
+                return [BackupHook()]
+
+        events = self._train(
+            tmp_path, monkeypatch, hook_builders=[BackupHookBuilder()]
+        )
+        assert len(durable) == 2
+        saves = [i for i, e in enumerate(events) if e[0] == "save"]
+        waits = [i for i, e in enumerate(events) if e[0] == "wait"]
+        # Each save is followed by a wait before the next save.
+        for save_index in saves:
+            assert any(i > save_index for i in waits), events
+        assert min(w for w in waits) > saves[0]
+        assert any(saves[0] < w < saves[1] for w in waits), events
+
 
 class TestParamSharding:
     def test_tensor_parallel_kernels_column_split(self):

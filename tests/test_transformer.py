@@ -362,6 +362,9 @@ class TestMoETransformer:
         router_grad = grads["params"]["block_0"]["moe"]["router"]
         assert float(jnp.max(jnp.abs(router_grad))) > 0
 
+    # ~27s: 8-virtual-device expert x sequence composition; each axis
+    # keeps its own fast-slice test.
+    @pytest.mark.slow
     def test_expert_mesh_composes_with_sequence_ring(self):
         """expert=2 x sequence=4 mesh: MoE dispatch and ring attention in
         one block, on the virtual CPU mesh."""

@@ -237,6 +237,8 @@ class TestDomainAdaptiveModel:
         )
         assert np.isfinite(float(outer_loss))
 
+    # ~21s: MAML inner/outer loop end to end.
+    @pytest.mark.slow
     def test_maml_wrapping_end_to_end(self):
         base = self.make_model()
         model = vrgripper.VRGripperEnvRegressionModelMAML(

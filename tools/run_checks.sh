@@ -35,6 +35,15 @@ if ! JAX_PLATFORMS=cpu python tools/t2r_check.py --lint-only tensor2robot_tpu/se
   status=1
 fi
 
+echo "== collective lint (collective-outside-registry scope) =="
+# Same rationale: a raw jax.lax collective / shard_map in the trainer
+# layers is uncompressed, unaccounted wire traffic — attribute it to
+# THIS gate by name.
+if ! JAX_PLATFORMS=cpu python tools/t2r_check.py --lint-only \
+    tensor2robot_tpu/train tensor2robot_tpu/parallel; then
+  status=1
+fi
+
 if [ "$SANITIZE" = 1 ]; then
   echo "== sanitizer corpus (ASan/UBSan) =="
   # t2r_check --sanitize builds, verifies the canary aborts, generates
@@ -50,9 +59,9 @@ if [ "$SANITIZE" = 1 ]; then
 fi
 
 if [ "$TESTS" = 1 ]; then
-  echo "== checker self-tests + serving slice (tier-1) =="
+  echo "== checker self-tests + serving + collectives/bench slices (tier-1) =="
   if ! JAX_PLATFORMS=cpu python -m pytest tests/test_t2r_check.py tests/test_wire_fuzz.py \
-      tests/test_serving.py \
+      tests/test_serving.py tests/test_collectives.py tests/test_bench.py \
       -q -m 'not slow' -p no:cacheprovider; then
     status=1
   fi
