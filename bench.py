@@ -1931,6 +1931,357 @@ def bench_serve(args) -> None:
         _fail("bench_serve", err, metric=metric)
 
 
+def bench_fleet(args) -> None:
+    """Replica-fleet routing leg (`python bench.py fleet`).
+
+    Measures the FleetRouter fabric — dispatch, transport, retry,
+    hedging, respawn — over N replica *processes* on the jax-free mock
+    backend (fixed per-request service time), so the numbers attribute
+    to the router layer and not to XLA compute; `bench.py serve`
+    already measures real-model serving inside one process. Four legs:
+
+      * closed-loop capacity (requests/s through the full fabric),
+      * an open-loop Poisson sweep at fractions of that capacity with
+        p50/p99/p999 and availability per leg,
+      * a chaos leg: one replica SIGKILLed mid-sweep — every request
+        must resolve (retried or shed WITH a typed error; zero lost,
+        zero hung) and p99 degradation vs the fault-free twin leg at
+        the same rate is reported against the bounded target,
+      * a rolling hot-swap across the whole fleet under load, with the
+        failed-request count (target: 0) and versions observed.
+
+    All arrival processes and jitter are seeded: rerunning the leg
+    replays the same schedule.
+    """
+    import os
+    import signal as signal_mod
+    import threading
+
+    metric = "fleet_router_capacity_cpu_proxy"
+    try:
+        import numpy as np
+
+        from tensor2robot_tpu.serving import (
+            FleetError,
+            FleetRouter,
+            ReplicaSpec,
+            mock_server_factory,
+        )
+        from tensor2robot_tpu.serving.metrics import percentile
+
+        n = args.replicas
+        spec = ReplicaSpec(
+            factory=mock_server_factory,
+            factory_kwargs={"service_ms": args.service_ms},
+        )
+
+        def make_router(**overrides):
+            kwargs = dict(
+                num_replicas=n,
+                # Tolerant probe budget (1 s of silence before SUSPECT):
+                # on this oversubscribed proxy host a saturating load leg
+                # can scheduling-starve health replies, and the monitor
+                # hard-killing CPU-starved-but-healthy replicas would
+                # measure the HOST, not the router.
+                probe_interval_ms=200.0,
+                probe_miss_limit=5,
+                backoff_ms=10.0,
+                max_respawns=5,
+                seed=11,
+            )
+            kwargs.update(overrides)
+            return FleetRouter(spec, **kwargs).start(timeout_s=120.0)
+
+        def wait_all_up(router, timeout=60.0):
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                if all(s == "up" for s in router.replica_states()):
+                    return
+                time.sleep(0.02)
+            raise RuntimeError(
+                f"fleet never fully up: {router.replica_states()}"
+            )
+
+        rng_payload = np.random.RandomState(3)
+        payload_x = rng_payload.uniform(-1, 1, size=(8,)).astype(np.float32)
+
+        def request():
+            return {"x": payload_x}
+
+        # -- closed-loop capacity: keep the fabric saturated for a
+        # window; completed/elapsed is what the router can actually move.
+        def measure_capacity(router, secs):
+            done = []
+            t0 = time.monotonic()
+            outstanding = 0
+            lock = threading.Lock()
+            cv = threading.Condition(lock)
+
+            def on_done(_):
+                nonlocal outstanding
+                with cv:
+                    outstanding -= 1
+                    done.append(time.monotonic())
+                    cv.notify()
+
+            while time.monotonic() - t0 < secs:
+                try:
+                    future = router.submit(request(), deadline_ms=10_000)
+                except FleetError:
+                    with cv:
+                        cv.wait(0.005)
+                    continue
+                with cv:
+                    outstanding += 1
+                future.add_done_callback(on_done)
+            with cv:
+                deadline = time.monotonic() + 30
+                while outstanding and time.monotonic() < deadline:
+                    cv.wait(0.1)
+            elapsed = (done[-1] if done else time.monotonic()) - t0
+            return len(done) / max(elapsed, 1e-9)
+
+        # -- one open-loop Poisson leg. Seeded arrivals; every future's
+        # outcome is recorded by a done callback; at drain time nothing
+        # may remain unresolved (lost==0 is the zero-lost guarantee).
+        def open_loop(router, rate_hz, secs, seed, kill_at_s=None,
+                      kill_index=0, swap_fn=None, swap_at_s=None):
+            rng = np.random.RandomState(seed)
+            records = []  # (t_submit_rel, latency_ms, error_type or None)
+            rec_lock = threading.Lock()
+            admission_errors: dict = {}
+            versions: dict = {}
+            killed_pid = None
+            swap_thread = None
+            swap_result = {}
+            t0 = time.monotonic()
+            t_next = t0
+            submitted = 0
+            while t_next - t0 < secs:
+                now = time.monotonic()
+                if now < t_next:
+                    time.sleep(t_next - now)
+                rel = time.monotonic() - t0
+                if (
+                    kill_at_s is not None
+                    and killed_pid is None
+                    and rel >= kill_at_s
+                ):
+                    pid = router.replica_pids()[kill_index]
+                    if pid is not None:
+                        os.kill(pid, signal_mod.SIGKILL)
+                        killed_pid = pid
+                if swap_at_s is not None and swap_thread is None and rel >= swap_at_s:
+                    swap_thread = threading.Thread(
+                        target=lambda: swap_result.update(swap_fn()),
+                        daemon=True,
+                    )
+                    swap_thread.start()
+                try:
+                    future = router.submit(
+                        request(), deadline_ms=args.deadline_ms
+                    )
+                except FleetError as err:
+                    # Typed admission shed (saturated/unavailable): the
+                    # graceful-degradation path, never a hang.
+                    name = type(err).__name__
+                    with rec_lock:
+                        admission_errors[name] = (
+                            admission_errors.get(name, 0) + 1
+                        )
+                    submitted += 1
+                    t_next += rng.exponential(1.0 / rate_hz)
+                    continue
+
+                def on_done(fut, t_submit=time.monotonic(), rel=rel):
+                    err = fut.error()
+                    latency = (time.monotonic() - t_submit) * 1e3
+                    if err is None:
+                        version = fut.result(0).model_version
+                    with rec_lock:
+                        records.append(
+                            (rel, latency,
+                             None if err is None else type(err).__name__)
+                        )
+                        if err is None:
+                            versions[version] = versions.get(version, 0) + 1
+
+                future.add_done_callback(on_done)
+                submitted += 1
+                t_next += rng.exponential(1.0 / rate_hz)
+            # Drain: every submitted future must resolve inside its
+            # deadline + retry envelope. Anything still missing is LOST.
+            drain_deadline = time.monotonic() + args.deadline_ms / 1e3 + 30
+            expected = submitted - sum(admission_errors.values())
+            while time.monotonic() < drain_deadline:
+                with rec_lock:
+                    if len(records) >= expected:
+                        break
+                time.sleep(0.02)
+            if swap_thread is not None:
+                swap_thread.join(timeout=60)
+            with rec_lock:
+                ok = sorted(r[1] for r in records if r[2] is None)
+                failed: dict = {}
+                for _, _, err_name in records:
+                    if err_name is not None:
+                        failed[err_name] = failed.get(err_name, 0) + 1
+            lost = expected - len(records)
+            leg = {
+                "offered_hz": round(rate_hz, 2),
+                "secs": secs,
+                "submitted": submitted,
+                "completed": len(ok),
+                "availability": round(len(ok) / max(submitted, 1), 5),
+                "p50_ms": round(percentile(ok, 0.50), 3),
+                "p99_ms": round(percentile(ok, 0.99), 3),
+                "p999_ms": round(percentile(ok, 0.999), 3),
+                "failed_typed": failed,
+                "shed_at_admission": admission_errors,
+                "lost": lost,  # futures that never resolved: MUST be 0
+            }
+            if versions:
+                leg["versions_observed"] = {
+                    str(k): v for k, v in sorted(versions.items())
+                }
+            if kill_at_s is not None:
+                leg["killed_pid"] = killed_pid
+                leg["kill_at_s"] = kill_at_s
+            if swap_result:
+                leg["swap_result"] = {
+                    "swapped": swap_result.get("swapped"),
+                    "failed": swap_result.get("failed"),
+                }
+            return leg
+
+        # ---- leg 1: capacity + Poisson sweep on one fleet. The fleet
+        # must be fully recovered before each leg, or a previous leg's
+        # saturation transient (evictions mid-respawn) bleeds in.
+        with make_router() as router:
+            wait_all_up(router)
+            capacity_hz = measure_capacity(router, args.capacity_secs)
+            sweep = []
+            for i, frac in enumerate((0.3, 0.6, 0.9)):
+                wait_all_up(router)
+                sweep.append(
+                    open_loop(
+                        router, capacity_hz * frac, args.leg_secs,
+                        seed=23 + i,
+                    )
+                )
+            sweep_snapshot = router.snapshot()
+
+        # ---- leg 2: fault-free twin + chaos twin at the same rate, on
+        # fresh fleets (clean death/retry counters). Rate sized so the
+        # fleet minus one replica still has headroom: the leg measures
+        # failover + retry behavior, not overload (the sweep above
+        # already characterizes saturation).
+        chaos_rate = capacity_hz * 0.35
+        with make_router() as router:
+            wait_all_up(router)
+            fault_free = open_loop(router, chaos_rate, args.leg_secs, seed=41)
+        with make_router() as router:
+            wait_all_up(router)
+            chaos_leg = open_loop(
+                router, chaos_rate, max(args.leg_secs, 2.0), seed=41,
+                kill_at_s=max(args.leg_secs, 2.0) / 2,
+            )
+            # Let the respawn land so the payload records the fleet
+            # RECOVERED, not the mid-respawn transient.
+            settle_deadline = time.monotonic() + 30
+            while time.monotonic() < settle_deadline and not all(
+                s == "up" for s in router.replica_states()
+            ):
+                time.sleep(0.05)
+            chaos_snapshot = router.snapshot()
+        p99_degradation = (
+            chaos_leg["p99_ms"] / fault_free["p99_ms"]
+            if fault_free["p99_ms"] > 0
+            else float("inf")
+        )
+
+        # ---- leg 3: rolling hot-swap across the fleet under load.
+        with make_router() as router:
+            wait_all_up(router)
+            version_before = [
+                r["version"] for r in router.snapshot()["replicas"]
+            ]
+            swap_leg = open_loop(
+                router, capacity_hz * 0.3, max(args.leg_secs, 2.0),
+                seed=59,
+                swap_fn=lambda: router.rolling_swap(swap_timeout_s=30.0),
+                swap_at_s=0.5,
+            )
+            version_after = [
+                r["version"] for r in router.snapshot()["replicas"]
+            ]
+        swap_failed_requests = (
+            sum(swap_leg["failed_typed"].values())
+            + sum(swap_leg["shed_at_admission"].values())
+            + swap_leg["lost"]
+        )
+
+        chaos_ok = (
+            chaos_leg["lost"] == 0
+            and chaos_leg["availability"] > 0
+            and p99_degradation <= args.p99_degradation_max
+        )
+        payload = {
+            "metric": metric,
+            "value": round(capacity_hz, 2),
+            "unit": "requests_per_sec",
+            # Target: the chaos leg loses nothing and p99 degradation
+            # stays inside the bound (1.0 = exactly at the bar).
+            "vs_baseline": round(
+                (args.p99_degradation_max / p99_degradation)
+                if chaos_leg["lost"] == 0 and p99_degradation > 0
+                else 0.0,
+                4,
+            ),
+            "detail": {
+                "replicas": n,
+                "service_ms": args.service_ms,
+                "deadline_ms": args.deadline_ms,
+                "closed_loop_capacity_hz": round(capacity_hz, 2),
+                "open_loop": sweep,
+                "sweep_counters": sweep_snapshot["counters"],
+                "chaos": {
+                    "fault_free_leg": fault_free,
+                    "sigkill_leg": chaos_leg,
+                    "counters": chaos_snapshot["counters"],
+                    "replica_states_after": [
+                        r["state"]
+                        for r in chaos_snapshot["replicas"]
+                    ],
+                    "p99_degradation_x": round(p99_degradation, 3),
+                    "p99_degradation_max": args.p99_degradation_max,
+                    "zero_lost": chaos_leg["lost"] == 0,
+                    "ok": chaos_ok,
+                },
+                "rolling_swap": {
+                    **swap_leg,
+                    "failed_requests": swap_failed_requests,
+                    "version_before": version_before,
+                    "version_after": version_after,
+                },
+                "backend": "mock_replica_processes",
+                "host_cpus": os.cpu_count(),
+            },
+            "cpu_proxy": True,
+            "proxy_note": (
+                "router fabric measured over mock replica processes on "
+                "CPU; absolute rates are host-bound, the availability/"
+                "degradation contracts are platform-independent"
+            ),
+        }
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(payload, f, indent=2, sort_keys=True)
+        _emit(payload)
+    except Exception as err:  # noqa: BLE001
+        _fail("bench_fleet", err, metric=metric)
+
+
 def bench_comms(args) -> None:
     """Quantized gradient-collective leg (`python bench.py comms`).
 
@@ -2635,6 +2986,46 @@ def _build_cli():
     )
     serve.add_argument(
         "--out", default="BENCH_SERVE_r08.json",
+        help="also write the payload to this file ('' disables; "
+             "default %(default)s)",
+    )
+    fleet = leg(
+        "fleet", bench_fleet,
+        "replica-fleet routing leg: closed-loop capacity + open-loop "
+        "Poisson sweep (p50/p99/p999, availability) over N replica "
+        "processes, a SIGKILL-mid-sweep chaos leg (zero lost requests, "
+        "bounded p99 degradation), and a rolling fleet-wide hot-swap "
+        "under load (docs/RESILIENCE.md)",
+    )
+    fleet.add_argument(
+        "--replicas", type=int, default=4,
+        help="replica process count, >= 3 for the acceptance sweep "
+             "(default %(default)s)",
+    )
+    fleet.add_argument(
+        "--service-ms", type=float, default=2.0,
+        help="mock per-request service time in the replicas "
+             "(default %(default)s)",
+    )
+    fleet.add_argument(
+        "--capacity-secs", type=float, default=2.0,
+        help="closed-loop capacity window (default %(default)s)",
+    )
+    fleet.add_argument(
+        "--leg-secs", type=float, default=4.0,
+        help="duration of each open-loop Poisson leg (default %(default)s)",
+    )
+    fleet.add_argument(
+        "--deadline-ms", type=float, default=400.0,
+        help="per-request deadline (default %(default)s)",
+    )
+    fleet.add_argument(
+        "--p99-degradation-max", type=float, default=10.0,
+        help="chaos-leg p99 may be at most this multiple of the "
+             "fault-free twin leg's (default %(default)s)",
+    )
+    fleet.add_argument(
+        "--out", default="BENCH_FLEET_r10.json",
         help="also write the payload to this file ('' disables; "
              "default %(default)s)",
     )

@@ -45,6 +45,20 @@ Three rule families, each born from a real failure mode in this codebase:
   manual-axis bookkeeping (`axis_index`, `pvary`/`pcast`) is out of
   scope.
 
+* Exception discipline (`swallowed-exception`) — inside
+  `tensor2robot_tpu/serving/`, `train/` and `predictors/`, a bare
+  `except:` is always an error (it eats KeyboardInterrupt/SystemExit),
+  and a broad handler (`except Exception:`/`except BaseException:`)
+  whose body does nothing (`pass`/`...`) is an error unless the
+  enclosing function carries the explicit
+  `@best_effort_cleanup` allowlist decorator
+  (tensor2robot_tpu/utils/errors.py — whose `best_effort()` wrapper is
+  the preferred spelling: no except block at the call site at all). In
+  a fault-tolerant fleet an invisible swallow is how a replica that
+  cannot reply or a checkpoint that cannot finalize degrades into an
+  unexplained hang; handlers that DO something (log, fall back,
+  re-raise) are out of scope.
+
 * Shm-ring discipline (`shm-*`) — the process-worker return path
   (data/dataset.py) cycles shared-memory slots worker->consumer through
   a free-name queue. The protocol's liveness rests on three rules the
@@ -76,6 +90,16 @@ _FLAG_REGISTRY_FILES = ("tensor2robot_tpu/flags.py",)
 # dispatcher's batch executor and the startup bucket prewarm.
 _SERVING_PATH_FRAGMENT = "tensor2robot_tpu/serving/"
 _SERVE_DISPATCH_FUNCS = frozenset({"_execute_batch", "_prewarm"})
+
+# Exception discipline: where silent broad handlers are banned, and the
+# decorator (utils/errors.py) that allowlists a cleanup function.
+_SWALLOW_SCOPE_FRAGMENTS = (
+    "tensor2robot_tpu/serving/",
+    "tensor2robot_tpu/train/",
+    "tensor2robot_tpu/predictors/",
+)
+_SWALLOW_ALLOW_DECORATOR = "best_effort_cleanup"
+_BROAD_EXCEPTION_NAMES = frozenset({"Exception", "BaseException"})
 
 # numpy calls that MATERIALIZE data on the host (traced-value poison
 # inside jit). Deliberately excludes shape/dtype arithmetic (np.prod,
@@ -184,6 +208,10 @@ class _Visitor(ast.NodeVisitor):
             fragment in norm_path
             for fragment in _COLLECTIVE_SCOPE_FRAGMENTS
         ) and not norm_path.endswith(_COLLECTIVE_REGISTRY_SUFFIX)
+        self.in_swallow_scope = any(
+            fragment in norm_path for fragment in _SWALLOW_SCOPE_FRAGMENTS
+        )
+        self._swallow_allow_depth = 0
         # Module aliases bound to jax.lax in this file (`import jax.lax
         # as jl`, `from jax import lax as jlax`): `jl.psum` must trip
         # the collective gate exactly like `lax.psum`.
@@ -466,6 +494,65 @@ class _Visitor(ast.NodeVisitor):
             "call the predictor — route requests through submit()",
         )
 
+    # -- exception discipline -------------------------------------------------
+
+    @staticmethod
+    def _handler_is_noop(handler: ast.ExceptHandler) -> bool:
+        """True when the handler body does nothing: only `pass` and/or
+        bare constant expressions (`...`, a string). Handlers that log,
+        mutate state, fall back, or re-raise are out of scope."""
+        return all(
+            isinstance(stmt, ast.Pass)
+            or (
+                isinstance(stmt, ast.Expr)
+                and isinstance(stmt.value, ast.Constant)
+            )
+            for stmt in handler.body
+        )
+
+    def _broad_exception_names(self, handler: ast.ExceptHandler) -> List[str]:
+        """The Exception/BaseException names this handler catches (as
+        written: `Exception`, a tuple containing it, ...)."""
+        nodes = (
+            handler.type.elts
+            if isinstance(handler.type, ast.Tuple)
+            else [handler.type]
+        )
+        return [
+            self._dotted(node).split(".")[-1]
+            for node in nodes
+            if self._dotted(node).split(".")[-1] in _BROAD_EXCEPTION_NAMES
+        ]
+
+    def visit_Try(self, node: ast.Try) -> None:
+        if self.in_swallow_scope:
+            for handler in node.handlers:
+                if handler.type is None:
+                    self._emit(
+                        handler,
+                        "swallowed-exception",
+                        "bare `except:` swallows KeyboardInterrupt/"
+                        "SystemExit; catch Exception (or the specific "
+                        "error) explicitly",
+                    )
+                    continue
+                broad = self._broad_exception_names(handler)
+                if (
+                    broad
+                    and self._handler_is_noop(handler)
+                    and self._swallow_allow_depth == 0
+                ):
+                    self._emit(
+                        handler,
+                        "swallowed-exception",
+                        f"silent `except {broad[0]}: pass` — in the "
+                        "fleet/trainer layers an invisible swallow turns a "
+                        "real failure into an unexplained hang; use "
+                        "utils.errors.best_effort(fn, ...) or decorate the "
+                        f"cleanup function with @{_SWALLOW_ALLOW_DECORATOR}",
+                    )
+        self.generic_visit(node)
+
     # -- shm-ring discipline --------------------------------------------------
 
     def _in_ring_class(self) -> bool:
@@ -541,10 +628,18 @@ class _Visitor(ast.NodeVisitor):
         jitted = any(
             self._decorator_is_jit(d) for d in node.decorator_list
         ) or (not is_method and node.name in self.jit_wrapped)
+        allow_swallow = any(
+            self._dotted(d).split(".")[-1] == _SWALLOW_ALLOW_DECORATOR
+            for d in node.decorator_list
+        )
         self._func_stack.append(node.name)
         if jitted:
             self._jit_depth += 1
+        if allow_swallow:
+            self._swallow_allow_depth += 1
         self.generic_visit(node)
+        if allow_swallow:
+            self._swallow_allow_depth -= 1
         if jitted:
             self._jit_depth -= 1
         self._func_stack.pop()

@@ -106,6 +106,15 @@ def _declare(
 # reviewers check this table against docs/static_analysis.md.
 
 _declare(
+    "T2R_CHAOS",
+    _STR,
+    None,
+    "Deterministic fault-injection plan (testing/chaos.py): semicolon-"
+    "separated '[scope/]site:occurrence:action[:arg]' clauses, e.g. "
+    "'r0/predict:3:kill;save:2:sigkill'. Unset = no faults.",
+    "tensor2robot_tpu/testing/chaos.py",
+)
+_declare(
     "T2R_COLLECTIVE_BLOCK",
     _INT,
     512,
@@ -137,6 +146,34 @@ _declare(
     True,
     "Honor decode-time ROI crops; 0 restores full-frame decode exactly.",
     "tensor2robot_tpu/data/dataset.py",
+)
+_declare(
+    "T2R_FLEET_HEDGE_MS",
+    _INT,
+    0,
+    "Fleet-router hedge delay (ms): a request still pending after this "
+    "long is duplicated to a second replica (first reply wins). 0 = off.",
+    "tensor2robot_tpu/serving/router.py",
+    minimum=0,
+)
+_declare(
+    "T2R_FLEET_MAX_INFLIGHT",
+    _INT,
+    64,
+    "Fleet-router per-replica in-flight cap; with every healthy replica "
+    "at the cap, new requests are shed with a typed error (never queued "
+    "unboundedly, never hung).",
+    "tensor2robot_tpu/serving/router.py",
+    minimum=1,
+)
+_declare(
+    "T2R_FLEET_RETRIES",
+    _INT,
+    2,
+    "Fleet-router max retry attempts (beyond the first dispatch) after a "
+    "replica failure, each with jittered exponential backoff.",
+    "tensor2robot_tpu/serving/router.py",
+    minimum=0,
 )
 _declare(
     "T2R_INFEED_DEPTH",
@@ -233,6 +270,17 @@ _declare(
     "reject refuses the incoming one.",
     "tensor2robot_tpu/serving/server.py",
     choices=("shed_oldest", "reject"),
+)
+_declare(
+    "T2R_SERVE_PREDICT_TIMEOUT_MS",
+    _INT,
+    0,
+    "Per-batch predictor compute watchdog (ms) in the policy server: a "
+    "predict call exceeding it fails that batch's futures with "
+    "PredictTimeout and the dispatcher keeps serving. 0 = no watchdog "
+    "(predict runs on the dispatcher thread).",
+    "tensor2robot_tpu/serving/server.py",
+    minimum=0,
 )
 _declare(
     "T2R_SKIP_HYPOTHESIS",

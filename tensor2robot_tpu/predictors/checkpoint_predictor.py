@@ -97,7 +97,18 @@ class CheckpointPredictor(AbstractPredictor):
             latest = None
             if os.path.isdir(path):
                 with ocp.CheckpointManager(path) as manager:
-                    latest = manager.latest_step()
+                    # Durable steps only (read-only skip, never quarantine):
+                    # this predictor polls a LIVE trainer's dir, where
+                    # latest_step() can name a torn final-named dir — the
+                    # durability contract (docs/RESILIENCE.md) says no
+                    # reader ever loads one. durability (not train_eval):
+                    # it is orbax/jax-free, so this serving-side poll
+                    # does not drag in the training stack.
+                    from tensor2robot_tpu.train.durability import (
+                        latest_durable_step_in,
+                    )
+
+                    latest = latest_durable_step_in(manager)
                     if latest is not None and latest != self._restored_step:
                         # Restore against the checkpoint's OWN metadata with
                         # host-placed leaves (train/state.py): serving must

@@ -1,40 +1,113 @@
-"""Fleet serving: dynamic micro-batching policy server (docs/SERVING.md).
+"""Fleet serving: micro-batching policy server + multi-replica router
+(docs/SERVING.md, docs/RESILIENCE.md).
 
 The host-side traffic layer over AbstractPredictor: bounded queue with
 deadlines and backpressure, bucket-padded micro-batches (ladder = the
 exporter's warmup_batch_sizes, so every served shape is pre-compiled),
-zero-downtime hot-swap, structured observability snapshots.
+zero-downtime hot-swap, structured observability snapshots — and, one
+level up, a FleetRouter dispatching over a pool of policy-server
+replica *processes* with deadline-aware least-loaded routing, retries,
+hedging, health eviction, and rolling deploys.
+
+Exports resolve lazily (PEP 562): replica worker processes import this
+package on spawn, and the replica entry path must not drag the full
+server/specs/jax stack into a child that may only ever run the
+lightweight mock backend. `from tensor2robot_tpu.serving import X`
+works exactly as before; `import tensor2robot_tpu.serving` alone now
+costs microseconds.
 """
 
-from tensor2robot_tpu.serving.buckets import (
-    buckets_from_metadata,
-    pick_bucket,
-    resolve_buckets,
-)
-from tensor2robot_tpu.serving.metrics import RequestSpan, ServerMetrics
-from tensor2robot_tpu.serving.server import (
-    DeadlineExceeded,
-    PolicyServer,
-    RequestRejected,
-    RequestShed,
-    ServeError,
-    ServeFuture,
-    ServeResponse,
-    ServerClosed,
-)
+from typing import TYPE_CHECKING
 
-__all__ = [
-    "PolicyServer",
-    "ServeFuture",
-    "ServeResponse",
-    "ServeError",
-    "RequestRejected",
-    "RequestShed",
-    "DeadlineExceeded",
-    "ServerClosed",
-    "RequestSpan",
-    "ServerMetrics",
-    "resolve_buckets",
-    "buckets_from_metadata",
-    "pick_bucket",
-]
+_EXPORTS = {
+    # server.py — the single-process micro-batching policy server.
+    "PolicyServer": "server",
+    "ServeFuture": "server",
+    "ServeResponse": "server",
+    "ServeError": "server",
+    "RequestRejected": "server",
+    "RequestShed": "server",
+    "DeadlineExceeded": "server",
+    "ServerClosed": "server",
+    "PredictFailed": "server",
+    "PredictTimeout": "server",
+    # metrics.py
+    "RequestSpan": "metrics",
+    "ServerMetrics": "metrics",
+    # buckets.py
+    "resolve_buckets": "buckets",
+    "buckets_from_metadata": "buckets",
+    "pick_bucket": "buckets",
+    # router.py — the multi-replica fleet layer.
+    "FleetRouter": "router",
+    "FleetResponse": "router",
+    "RouterFuture": "router",
+    "FleetError": "router",
+    "FleetSaturated": "router",
+    "ReplicaUnavailable": "router",
+    "RequestAbandoned": "router",
+    "RouterClosed": "router",
+    # replica.py — process entry + backends.
+    "ReplicaSpec": "replica",
+    "policy_server_factory": "replica",
+    "mock_server_factory": "replica",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    module_name = _EXPORTS.get(name)
+    if module_name is None:
+        raise AttributeError(
+            f"module 'tensor2robot_tpu.serving' has no attribute {name!r}"
+        )
+    import importlib
+
+    module = importlib.import_module(f"{__name__}.{module_name}")
+    value = getattr(module, name)
+    globals()[name] = value  # cache: next access skips __getattr__
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))
+
+
+if TYPE_CHECKING:  # pragma: no cover — static analyzers only
+    from tensor2robot_tpu.serving.buckets import (  # noqa: F401
+        buckets_from_metadata,
+        pick_bucket,
+        resolve_buckets,
+    )
+    from tensor2robot_tpu.serving.metrics import (  # noqa: F401
+        RequestSpan,
+        ServerMetrics,
+    )
+    from tensor2robot_tpu.serving.replica import (  # noqa: F401
+        ReplicaSpec,
+        mock_server_factory,
+        policy_server_factory,
+    )
+    from tensor2robot_tpu.serving.router import (  # noqa: F401
+        FleetError,
+        FleetResponse,
+        FleetRouter,
+        FleetSaturated,
+        ReplicaUnavailable,
+        RequestAbandoned,
+        RouterClosed,
+        RouterFuture,
+    )
+    from tensor2robot_tpu.serving.server import (  # noqa: F401
+        DeadlineExceeded,
+        PolicyServer,
+        PredictFailed,
+        PredictTimeout,
+        RequestRejected,
+        RequestShed,
+        ServeError,
+        ServeFuture,
+        ServeResponse,
+        ServerClosed,
+    )

@@ -74,10 +74,23 @@ class ServerMetrics:
         self._batch_slots = 0
         self._batch_real = 0
         self._per_bucket: Dict[int, int] = {}
+        self._failed_by_class: Dict[str, int] = {}
 
     def count(self, name: str, n: int = 1) -> None:
         with self._lock:
             self._counters[name] += n
+
+    def count_failure(self, failure_class: str, n: int = 1) -> None:
+        """Increments the failed counter AND its per-class attribution in
+        one lock acquisition. The class breakdown is what tells an
+        operator whether a red `failed` counter is a predictor crash, a
+        compute watchdog, or a structural dispatch bug — aggregated
+        `failed` alone cannot distinguish an outage from an overload."""
+        with self._lock:
+            self._counters["failed"] += n
+            self._failed_by_class[failure_class] = (
+                self._failed_by_class.get(failure_class, 0) + n
+            )
 
     def observe_batch(self, bucket: int, real: int) -> None:
         with self._lock:
@@ -100,11 +113,13 @@ class ServerMetrics:
             spans = list(self._spans)
             slots, real = self._batch_slots, self._batch_real
             per_bucket = dict(self._per_bucket)
+            failed_by_class = dict(self._failed_by_class)
         totals = sorted(s["total_ms"] for s in spans)
         queues = sorted(s.get("queue_ms", 0.0) for s in spans)
         computes = sorted(s.get("compute_ms", 0.0) for s in spans)
         return {
             "counters": counters,
+            "failed_by_class": failed_by_class,
             "queue_depth": queue_depth,
             "batch_fill_ratio": (real / slots) if slots else 0.0,
             "batches_by_bucket": {str(k): v for k, v in sorted(per_bucket.items())},

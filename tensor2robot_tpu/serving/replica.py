@@ -1,0 +1,427 @@
+"""Replica process entry: one policy-server worker in the router's pool.
+
+`replica_main` is the spawn target. It stays deliberately light at
+import time — the heavy stack (specs -> jax -> XLA) loads only inside
+`policy_server_factory`, so a mock-backend replica (tests, bench
+plumbing smoke) boots in fractions of a second while a real one pays
+the jax import exactly once.
+
+A replica owns: its request queue (router -> replica), the shared
+response queue (replica -> router), and the shared free-slot queue of
+the request shm ring (names go back as soon as a payload is copied
+out). The protocol is at the bottom of this docstring; the router is
+the only peer.
+
+Chaos scope: each replica declares `r<index>` (testing/chaos.py), so a
+plan can target one replica of a fleet ("r0/predict:3:kill") while its
+siblings stay healthy — which is exactly the partial-failure regime the
+router's retry/hedge/eviction logic exists for.
+
+Wire protocol (all tuples, pickled by multiprocessing):
+
+  router -> replica (request queue):
+    ("req", req_id, attempt, deadline_wall_s, payload)   payload: transport.py
+    ("health", probe_id)
+    ("swap", swap_id, deadline_wall_s)
+    ("stop",)
+
+  replica -> router (shared response queue):
+    ("started", index, version, pid)
+    ("rsp", index, req_id, attempt, crc, blob)     blob: ("ok", outputs,
+                                                   version, spans) |
+                                                   ("error", class, message)
+    ("health", index, probe_id, snapshot, t_wall)
+    ("swapped", index, swap_id, ok, version)
+    ("stopped", index)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import queue
+import time
+from typing import Any, Callable, Dict, Mapping, Optional, Tuple
+
+import numpy as np
+
+from tensor2robot_tpu import flags as t2r_flags
+from tensor2robot_tpu.serving import transport
+from tensor2robot_tpu.testing import chaos
+from tensor2robot_tpu.utils.errors import best_effort
+
+_log = logging.getLogger(__name__)
+
+__all__ = [
+    "ReplicaSpec",
+    "replica_main",
+    "policy_server_factory",
+    "mock_server_factory",
+]
+
+
+@dataclasses.dataclass
+class ReplicaSpec:
+    """How a replica process builds its server.
+
+    `factory` must be a module-level (picklable-by-name) callable
+    returning a started server-like object: `submit(features,
+    deadline_ms) -> future` (future: `add_done_callback`, `error()`,
+    `result()`), `snapshot()`, `hot_swap(wait)`, `stop()`. `env` entries
+    are applied in the child before the factory runs — `T2R_*` keys go
+    through the flags registry (validated), everything else through the
+    raw environment; this is the route chaos plans take into a replica.
+    """
+
+    factory: Callable
+    factory_args: Tuple = ()
+    factory_kwargs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    env: Dict[str, str] = dataclasses.field(default_factory=dict)
+    scope: Optional[str] = None  # chaos scope override (default r<index>)
+
+
+def _apply_env(env: Mapping[str, str]) -> None:
+    for key, value in env.items():
+        if key.startswith("T2R_"):
+            t2r_flags.write_env(key, value)
+        else:
+            os.environ[key] = value
+
+
+def _server_version(server) -> int:
+    version = getattr(server, "model_version", None)
+    if version is not None:
+        return int(version)
+    try:
+        return int(server.snapshot().get("model_version", -1))
+    except Exception:
+        return -1
+
+
+def replica_main(index: int, spec: ReplicaSpec, request_q, response_q,
+                 free_q) -> None:
+    """Process entry. Never raises: a replica that cannot build its
+    server posts ("started", index, -1, pid) with a follow-up error
+    reply path dead, then exits — the router sees the exit and applies
+    its death handling; a replica that cannot *reach* the router any
+    more (queue torn down) just exits."""
+    _apply_env(spec.env)
+    chaos.set_scope(spec.scope if spec.scope is not None else f"r{index}")
+    pid = os.getpid()
+    try:
+        server = spec.factory(*spec.factory_args, **spec.factory_kwargs)
+    except Exception:
+        _log.exception("replica %d: server factory failed", index)
+        # Exiting nonzero IS the failure signal; the router's monitor
+        # handles a replica that dies before serving.
+        raise
+    cache = transport.ReplicaSlotCache()
+    chaos.maybe_fire("boot")
+    response_q.put(("started", index, _server_version(server), pid))
+
+    pending_swap: Optional[Tuple[int, int, float]] = None  # id, old_v, deadline
+
+    def post_reply(req_id: int, attempt: int, body) -> None:
+        crc, blob = transport.pack(body)
+        fault = chaos.maybe_fire("reply")
+        if fault is not None and fault.action == "corrupt" and blob:
+            # Flip one byte AFTER the checksum: the router must detect
+            # the mismatch and treat this replica reply as a failure.
+            blob = blob[:-1] + bytes([blob[-1] ^ 0xFF])
+        # Router gone -> best effort; our process is about to be reaped.
+        best_effort(response_q.put, ("rsp", index, req_id, attempt, crc, blob))
+
+    def on_request(req_id: int, attempt: int, deadline_wall: float, payload):
+        chaos.maybe_fire("recv")
+        try:
+            features = transport.decode_request(payload, free_q, cache)
+        except transport.IntegrityError as err:
+            post_reply(req_id, attempt, ("error", "RequestCorrupt", str(err)))
+            return
+        remaining_ms = (deadline_wall - time.time()) * 1e3
+        if remaining_ms <= 0:
+            post_reply(
+                req_id, attempt,
+                ("error", "DeadlineExceeded",
+                 "deadline passed before the replica dequeued the request"),
+            )
+            return
+        try:
+            future = server.submit(features, deadline_ms=remaining_ms)
+        except Exception as err:  # typed submit failures (queue full, closed)
+            post_reply(req_id, attempt, ("error", type(err).__name__, str(err)))
+            return
+
+        def on_done(f, req_id=req_id, attempt=attempt):
+            err = f.error()
+            if err is not None:
+                post_reply(
+                    req_id, attempt, ("error", type(err).__name__, str(err))
+                )
+                return
+            response = f.result(0)
+            outputs = {
+                k: np.asarray(v) for k, v in response.outputs.items()
+            }
+            post_reply(
+                req_id, attempt,
+                ("ok", outputs, response.model_version,
+                 dict(response.spans)),
+            )
+
+        future.add_done_callback(on_done)
+
+    def check_pending_swap(now_wall: float) -> None:
+        nonlocal pending_swap
+        if pending_swap is None:
+            return
+        swap_id, old_version, deadline = pending_swap
+        version = _server_version(server)
+        if version != old_version:
+            pending_swap = None
+            response_q.put(("swapped", index, swap_id, True, version))
+        elif now_wall > deadline:
+            pending_swap = None
+            response_q.put(("swapped", index, swap_id, False, version))
+
+    try:
+        while True:
+            try:
+                message = request_q.get(timeout=0.05)
+            except queue.Empty:
+                check_pending_swap(time.time())
+                continue
+            except (OSError, ValueError):
+                return  # request queue torn down: router is gone
+            kind = message[0]
+            if kind == "req":
+                on_request(message[1], message[2], message[3], message[4])
+            elif kind == "health":
+                chaos.maybe_fire("health")
+                try:
+                    snap = server.snapshot()
+                except Exception as err:  # a server that cannot even
+                    # snapshot is unhealthy; say so rather than vanish.
+                    snap = {"error": f"{type(err).__name__}: {err}"}
+                response_q.put(("health", index, message[1], snap, time.time()))
+            elif kind == "swap":
+                chaos.maybe_fire("swap")
+                old_version = _server_version(server)
+                if pending_swap is not None:
+                    # A second swap while one is in flight (two concurrent
+                    # rolling_swap calls) must not overwrite pending_swap:
+                    # the first swap_id would then never be answered and
+                    # its router-side waiter would burn the full timeout.
+                    # Fail the NEW one fast instead; the in-flight swap
+                    # keeps its reply.
+                    response_q.put(
+                        ("swapped", index, message[1], False, old_version)
+                    )
+                else:
+                    try:
+                        server.hot_swap(wait=False)
+                        pending_swap = (message[1], old_version, message[2])
+                    except Exception:
+                        _log.exception("replica %d: hot_swap failed", index)
+                        response_q.put(
+                            ("swapped", index, message[1], False, old_version)
+                        )
+                check_pending_swap(time.time())
+            elif kind == "stop":
+                return
+            else:
+                _log.warning("replica %d: unknown message %r", index, kind)
+            check_pending_swap(time.time())
+    finally:
+        try:
+            server.stop()
+        except Exception:
+            _log.exception("replica %d: server stop failed", index)
+        cache.close()
+        best_effort(response_q.put, ("stopped", index))
+
+
+# -- backends ------------------------------------------------------------------
+
+
+def policy_server_factory(
+    export_root: str,
+    batch_buckets=None,
+    max_wait_ms: Optional[int] = None,
+    predict_timeout_ms: Optional[int] = None,
+    restore_timeout_s: int = 120,
+):
+    """The production backend: a PolicyServer over the newest export
+    under `export_root`, predictor wrapped for chaos `predict`-site
+    injection, every bucket prewarmed before the replica reports
+    started. Heavy imports happen here, in the child, on purpose."""
+    from tensor2robot_tpu.predictors.exported_savedmodel_predictor import (
+        ExportedSavedModelPredictor,
+    )
+    from tensor2robot_tpu.serving.server import PolicyServer
+
+    chaos.maybe_fire("restore")
+    predictor = ExportedSavedModelPredictor(
+        export_dir=export_root, timeout=restore_timeout_s
+    )
+    if not predictor.restore():
+        raise RuntimeError(
+            f"replica predictor restore timed out under {export_root}"
+        )
+    server = PolicyServer(
+        chaos.ChaosPredictor(predictor),
+        batch_buckets=batch_buckets,
+        max_wait_ms=max_wait_ms,
+        predict_timeout_ms=predict_timeout_ms,
+    )
+    server.start(prewarm=True)
+    return server
+
+
+class _LocalFuture:
+    """Minimal ServeFuture-alike for the mock backend (no jax import)."""
+
+    def __init__(self):
+        import threading
+
+        self._event = threading.Event()
+        self._response = None
+        self._error: Optional[BaseException] = None
+        self._callbacks = []
+        self._lock = threading.Lock()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def error(self) -> Optional[BaseException]:
+        return self._error if self._event.is_set() else None
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("mock request still pending")
+        if self._error is not None:
+            raise self._error
+        return self._response
+
+    def add_done_callback(self, fn) -> None:
+        with self._lock:
+            if not self._event.is_set():
+                self._callbacks.append(fn)
+                return
+        fn(self)
+
+    def _complete(self, response, error) -> None:
+        self._response, self._error = response, error
+        with self._lock:
+            self._event.set()
+            callbacks, self._callbacks = self._callbacks, []
+        for fn in callbacks:
+            fn(self)
+
+
+class _MockResponse:
+    __slots__ = ("outputs", "model_version", "spans")
+
+    def __init__(self, outputs, model_version, spans):
+        self.outputs = outputs
+        self.model_version = model_version
+        self.spans = spans
+
+
+class _MockServer:
+    """Deterministic server-surface stand-in: serial compute thread,
+    fixed per-request service time, chaos `predict`/`restore` hooks.
+    Outputs echo a checksum of the inputs so end-to-end tests can verify
+    the reply really came from the submitted features."""
+
+    def __init__(self, service_ms: float = 1.0, version: int = 1):
+        import threading
+
+        self._service_s = service_ms / 1e3
+        self.model_version = version
+        self._queue: "queue.Queue" = queue.Queue()
+        self._closed = False
+        self._completed = 0
+        self._lock = threading.Lock()
+        self._worker = threading.Thread(
+            target=self._compute_loop, name="t2r-mock-compute", daemon=True
+        )
+        self._worker.start()
+
+    def _compute_loop(self) -> None:
+        while True:
+            item = self._queue.get()
+            if item is None:
+                return
+            future, features, deadline = item
+            try:
+                chaos.maybe_fire("predict")
+                if self._service_s > 0:
+                    time.sleep(self._service_s)
+                if time.monotonic() > deadline:
+                    raise TimeoutError("mock deadline passed in compute")
+                total = 0.0
+                for key in sorted(features):
+                    total += float(np.sum(features[key].astype(np.float64)))
+                outputs = {
+                    "y": np.float32(total),
+                    "nbytes": np.int64(
+                        sum(v.nbytes for v in features.values())
+                    ),
+                }
+                with self._lock:
+                    self._completed += 1
+                future._complete(
+                    _MockResponse(
+                        outputs, self.model_version, {"compute_ms": 0.0}
+                    ),
+                    None,
+                )
+            except BaseException as err:  # noqa: BLE001 — the future is the
+                # error channel; the compute loop must survive any fault.
+                future._complete(None, err)
+
+    def submit(self, features, deadline_ms: float = 1000.0) -> _LocalFuture:
+        if self._closed:
+            raise RuntimeError("mock server is stopped")
+        future = _LocalFuture()
+        self._queue.put(
+            (future, features, time.monotonic() + deadline_ms / 1e3)
+        )
+        return future
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            completed = self._completed
+        return {
+            "counters": {"completed": completed},
+            "queue_depth": self._queue.qsize(),
+            "model_version": self.model_version,
+        }
+
+    def hot_swap(self, wait: bool = False) -> bool:
+        """Version bump on a background thread after the chaos `restore`
+        site — mirrors the async-restore shape so slow-restore plans
+        exercise the router's swap timeout without stalling serving."""
+        import threading
+
+        def flip():
+            chaos.maybe_fire("restore")
+            self.model_version += 1
+
+        if wait:
+            flip()
+            return True
+        threading.Thread(target=flip, daemon=True).start()
+        return True
+
+    def stop(self) -> None:
+        self._closed = True
+        self._queue.put(None)
+        self._worker.join(timeout=5)
+
+
+def mock_server_factory(service_ms: float = 1.0, version: int = 1):
+    """Jax-free replica backend for router tests and plumbing smokes."""
+    return _MockServer(service_ms=service_ms, version=version)

@@ -62,6 +62,8 @@ __all__ = [
     "RequestShed",
     "DeadlineExceeded",
     "ServerClosed",
+    "PredictFailed",
+    "PredictTimeout",
 ]
 
 
@@ -85,6 +87,24 @@ class ServerClosed(ServeError):
     """The server stopped before the request could be served."""
 
 
+class PredictFailed(ServeError):
+    """The predictor raised mid-batch; this batch failed, the loop lives.
+
+    `failure_class` carries the original exception's type name (it is
+    also the key in the metrics failed_by_class breakdown)."""
+
+    def __init__(self, message: str, failure_class: str = "PredictFailed"):
+        super().__init__(message)
+        self.failure_class = failure_class
+
+
+class PredictTimeout(ServeError):
+    """The predictor exceeded the compute watchdog; the batch's futures
+    failed typed and the dispatcher moved on (the stuck call is
+    abandoned on a daemon thread — a hung accelerator call cannot be
+    cancelled from the host, only routed around)."""
+
+
 class ServeResponse:
     """One request's outputs + the model version that computed them."""
 
@@ -105,9 +125,17 @@ class ServeFuture:
         self._event = threading.Event()
         self._response: Optional[ServeResponse] = None
         self._error: Optional[BaseException] = None
+        self._callbacks: List = []
+        self._cb_lock = threading.Lock()
 
     def done(self) -> bool:
         return self._event.is_set()
+
+    def error(self) -> Optional[BaseException]:
+        """The failure, if the future completed with one (None while
+        pending or on success) — lets completion callbacks branch
+        without re-raising."""
+        return self._error if self._event.is_set() else None
 
     def result(self, timeout: Optional[float] = None) -> ServeResponse:
         if not self._event.wait(timeout):
@@ -118,13 +146,31 @@ class ServeFuture:
             raise self._error
         return self._response
 
+    def add_done_callback(self, fn) -> None:
+        """Calls `fn(future)` when the future completes (immediately if it
+        already has). Callbacks run on the completing thread (the
+        dispatcher) and must be cheap and non-blocking — replica loops
+        use this to post replies without a waiter thread per request."""
+        with self._cb_lock:
+            if not self._event.is_set():
+                self._callbacks.append(fn)
+                return
+        fn(self)
+
+    def _complete(self) -> None:
+        with self._cb_lock:
+            self._event.set()
+            callbacks, self._callbacks = self._callbacks, []
+        for fn in callbacks:
+            fn(self)
+
     def _set_response(self, response: ServeResponse) -> None:
         self._response = response
-        self._event.set()
+        self._complete()
 
     def _set_error(self, error: BaseException) -> None:
         self._error = error
-        self._event.set()
+        self._complete()
 
 
 class _Request:
@@ -156,6 +202,7 @@ class PolicyServer:
         max_wait_ms: Optional[int] = None,
         overload: Optional[str] = None,
         default_deadline_ms: Optional[int] = None,
+        predict_timeout_ms: Optional[int] = None,
     ):
         self._predictor = predictor
         self._explicit_buckets = batch_buckets
@@ -179,6 +226,10 @@ class PolicyServer:
             default_deadline_ms if default_deadline_ms is not None
             else t2r_flags.get_int("T2R_SERVE_DEADLINE_MS")
         ) / 1e3
+        self._predict_timeout_s = (
+            predict_timeout_ms if predict_timeout_ms is not None
+            else t2r_flags.get_int("T2R_SERVE_PREDICT_TIMEOUT_MS")
+        ) / 1e3  # 0 = watchdog off (predict on the dispatcher thread)
         self._buckets: Tuple[int, ...] = ()
         self._flat_spec: Dict[str, ExtendedTensorSpec] = {}
         self._metrics = ServerMetrics()
@@ -305,7 +356,7 @@ class PolicyServer:
                     request.future._set_error(
                         ServerClosed(f"server stopped, request {request.id} dropped")
                     )
-                    self._metrics.count("failed")
+                    self._metrics.count_failure("ServerClosed")
             self._cond.notify_all()
         if self._dispatcher is not None:
             self._dispatcher.join(timeout=timeout)
@@ -425,6 +476,12 @@ class PolicyServer:
         snap["max_queue"] = self._max_queue
         snap["max_wait_ms"] = self._max_wait_s * 1e3
         snap["model_version"] = self._predictor.model_version
+        # Fleet-visible leak surface: a predictor whose close() abandoned
+        # a restore thread reports it here, so router health probes (which
+        # ride this snapshot) can see the wounded replica.
+        leaked = getattr(self._predictor, "restore_thread_leaked", None)
+        if leaked is not None:
+            snap["restore_thread_leaked"] = bool(leaked)
         return snap
 
     # -- hot swap -------------------------------------------------------------
@@ -477,7 +534,7 @@ class PolicyServer:
                     "dispatcher: batch of %d failed structurally", len(batch)
                 )
                 pending = [r for r in batch if not r.future.done()]
-                self._metrics.count("failed", len(pending))
+                self._metrics.count_failure("DispatchError", len(pending))
                 for request in pending:
                     request.future._set_error(
                         ServeError(
@@ -513,26 +570,71 @@ class PolicyServer:
             raise AssertionError(
                 f"padded batch has leading dims {lead}, bucket {bucket}"
             )
-        # predict_versioned reads (serving fn, version) as one atomic pair
-        # so a hot-swap landing mid-call cannot mislabel the responses;
-        # predictors without it fall back to the (benignly racy) split read.
-        predict_versioned = getattr(
-            self._predictor, "predict_versioned", None
-        )
-        try:
+        def run_predict():
+            # predict_versioned reads (serving fn, version) as one atomic
+            # pair so a hot-swap landing mid-call cannot mislabel the
+            # responses; predictors without it fall back to the (benignly
+            # racy) split read.
+            predict_versioned = getattr(
+                self._predictor, "predict_versioned", None
+            )
             if predict_versioned is not None:
-                outputs, version = predict_versioned(features)
+                return predict_versioned(features)
+            version = self._predictor.model_version
+            return self._predictor.predict(features), version
+
+        def run_predict_watchdogged():
+            # Compute watchdog: predict runs on a daemon thread and the
+            # dispatcher waits at most the configured budget. A predictor
+            # wedged inside an accelerator call cannot be interrupted
+            # from here — the thread is abandoned (daemon) and THIS
+            # batch fails typed, which is what lets a fleet router route
+            # around a stuck replica instead of hanging its clients.
+            box: Dict[str, Any] = {}
+            done = threading.Event()
+
+            def work():
+                try:
+                    box["value"] = run_predict()
+                except BaseException as err:  # noqa: BLE001 — crosses threads
+                    box["error"] = err
+                finally:
+                    done.set()
+
+            worker = threading.Thread(
+                target=work, name="t2r-serve-predict", daemon=True
+            )
+            worker.start()
+            if not done.wait(self._predict_timeout_s):
+                raise PredictTimeout(
+                    f"predict exceeded the {self._predict_timeout_s * 1e3:.0f}"
+                    "ms compute watchdog; batch failed, call abandoned"
+                )
+            if "error" in box:
+                raise box["error"]
+            return box["value"]
+
+        try:
+            if self._predict_timeout_s > 0:
+                outputs, version = run_predict_watchdogged()
             else:
-                version = self._predictor.model_version
-                outputs = self._predictor.predict(features)
+                outputs, version = run_predict()
         except Exception as err:  # noqa: BLE001 — one bad batch must not
-            # kill the dispatcher; each request learns the real error.
-            self._metrics.count("failed", len(live))
+            # kill the dispatcher; each request learns the real, TYPED
+            # error and the metrics record which failure class it was.
+            if isinstance(err, PredictTimeout):
+                failure_class = "PredictTimeout"
+                typed: ServeError = err
+            else:
+                failure_class = type(err).__name__
+                typed = PredictFailed(
+                    f"predict failed: {failure_class}: {err}",
+                    failure_class=failure_class,
+                )
+            self._metrics.count_failure(failure_class, len(live))
             self._metrics.observe_batch(bucket, len(live))
             for request in live:
-                request.future._set_error(
-                    ServeError(f"predict failed: {type(err).__name__}: {err}")
-                )
+                request.future._set_error(typed)
             return
         done = time.monotonic()
         self._metrics.observe_batch(bucket, len(live))

@@ -1,0 +1,303 @@
+"""Router <-> replica wire transport: checksummed messages + shm ring.
+
+Two concerns live here, both deliberately boring:
+
+1. **Integrity-checked inline payloads.** Every pickled blob that
+   crosses a process boundary carries a CRC32; `unpack` raises
+   `IntegrityError` on mismatch instead of handing the router a
+   silently-wrong reply. A corrupt reply is a *replica failure* the
+   router retries elsewhere — the chaos harness's `corrupt` action
+   exists precisely to prove that path.
+
+2. **Shared-memory slab ring for large request payloads.** Image-bearing
+   observations (a 472x472x3 uint8 frame is ~670 KB) would otherwise pay
+   pickle + pipe + unpickle per hop. The ring reuses the
+   `data/dataset.py` slot discipline exactly (and is checked by the same
+   `shm-*` lints): slots are created and unlinked ONLY by the ring owner
+   (the router); acquisition is `get_nowait` with an inline-pickle
+   fallback — a transport under pressure degrades to slower, never to
+   stuck; release paths use `put_nowait`. Roles are inverted from the
+   dataset (here the *owner* writes and the *worker* releases after
+   copying out), but the liveness argument is identical.
+
+   A replica SIGKILLed while holding a slot never returns its name; the
+   slot leaks until `close()`. That is bounded (num_slots) and benign —
+   an exhausted ring just means every payload rides the inline path —
+   whereas trying to reclaim a maybe-still-mapped slot risks two writers
+   on one buffer, which is corruption. Crash-safety beats throughput.
+"""
+
+from __future__ import annotations
+
+import logging
+import pickle
+import queue
+import zlib
+from typing import Any, Dict, List, Mapping, Optional, Tuple
+
+import numpy as np
+
+from tensor2robot_tpu.utils.errors import best_effort
+
+_log = logging.getLogger(__name__)
+
+__all__ = [
+    "IntegrityError",
+    "pack",
+    "unpack",
+    "ShmSlabRing",
+    "RequestCodec",
+    "decode_request",
+    "ReplicaSlotCache",
+]
+
+_SHM_ALIGN = 64
+# Payloads below this ride the pickle pipe; above it they try for a slot.
+DEFAULT_INLINE_MAX_BYTES = 64 << 10
+
+
+class IntegrityError(RuntimeError):
+    """A blob failed its CRC (or structural) check at the receiver."""
+
+
+def pack(obj: Any) -> Tuple[int, bytes]:
+    """(crc32, pickle) for one message body."""
+    blob = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    return zlib.crc32(blob), blob
+
+
+def unpack(crc: int, blob: bytes) -> Any:
+    if zlib.crc32(blob) != crc:
+        raise IntegrityError(
+            f"blob of {len(blob)} bytes failed its CRC32 check"
+        )
+    try:
+        return pickle.loads(blob)
+    except Exception as err:
+        # A blob that checksums but does not unpickle is the same wire
+        # failure from the caller's perspective.
+        raise IntegrityError(f"blob failed to decode: {err}") from err
+
+
+def _align(nbytes: int) -> int:
+    return (nbytes + _SHM_ALIGN - 1) // _SHM_ALIGN * _SHM_ALIGN
+
+
+class ShmSlabRing:
+    """Fixed set of shared-memory slots cycling owner -> worker -> owner.
+
+    The owner creates every slot up front and seeds the shared free-name
+    queue; `acquire_nowait` takes a name without blocking (None when the
+    ring is drained); the worker that consumed a payload returns the
+    name via the same queue. `close()` unlinks everything — slots still
+    mapped by a live consumer are kept as zombies until their views die
+    (same BufferError handling as the dataset ring).
+    """
+
+    def __init__(self, free_queue, slot_bytes: int, num_slots: int):
+        from multiprocessing import shared_memory
+
+        self.slot_bytes = slot_bytes
+        self.slots: Dict[str, Any] = {}
+        self.free_queue = free_queue
+        created: List[Any] = []
+        try:
+            for _ in range(num_slots):
+                created.append(
+                    shared_memory.SharedMemory(create=True, size=slot_bytes)
+                )
+        except Exception:
+            # A mid-loop failure (small /dev/shm) must publish nothing:
+            # the caller falls back to inline returns with no slot leaked.
+            for shm in created:
+                best_effort(shm.close)
+                best_effort(shm.unlink)
+            raise
+        for shm in created:
+            self.slots[shm.name] = shm
+            self.free_queue.put_nowait(shm.name)
+        self._closed = False
+        self._zombies: List[Any] = []
+
+    def acquire_nowait(self) -> Optional[str]:
+        """A free slot name, or None — the caller then goes inline."""
+        if self._closed:
+            return None
+        try:
+            return self.free_queue.get_nowait()
+        except queue.Empty:
+            return None
+        except (OSError, ValueError):
+            return None  # queue torn down under us (router stopping)
+
+    def release(self, name: str) -> None:
+        if not self._closed:
+            best_effort(self.free_queue.put_nowait, name)
+
+    def close(self) -> None:
+        self._closed = True
+        for shm in self.slots.values():
+            try:
+                shm.unlink()
+            except FileNotFoundError:
+                pass
+            try:
+                shm.close()
+            except BufferError:
+                self._zombies.append(shm)
+        self.slots = {}
+
+
+class RequestCodec:
+    """Owner-side payload encoder with lazy ring creation.
+
+    The first payload large enough to want a slot sizes the ring (plus
+    50% headroom, mirroring the dataset's `_maybe_seed_ring`); until
+    then — and whenever no slot is free — payloads go inline. Encoded
+    forms:
+
+      ("inline", crc, blob)                       blob = pickle(features)
+      ("shm", slot, entries, crc, blob)           entries =
+            [(key, dtype_str, shape, offset)]; blob = pickle(small items)
+    """
+
+    def __init__(
+        self,
+        free_queue,
+        inline_max_bytes: int = DEFAULT_INLINE_MAX_BYTES,
+        num_slots: int = 8,
+    ):
+        self._free_queue = free_queue
+        self._inline_max = inline_max_bytes
+        self._num_slots = num_slots
+        self._ring: Optional[ShmSlabRing] = None
+        self._ring_failed = False
+
+    @property
+    def ring(self) -> Optional[ShmSlabRing]:
+        return self._ring
+
+    def _inline(self, features: Mapping[str, np.ndarray]):
+        crc, blob = pack(dict(features))
+        return ("inline", crc, blob)
+
+    def release(self, payload) -> None:
+        """Returns an encoded-but-never-sent shm payload's slot to the
+        ring — for dispatch failures after encode but before the slot
+        name crossed the process boundary (nothing will ever read it, so
+        reuse is safe; NOT releasing it would shrink the ring by one
+        slot per failed dispatch). Inline payloads and torn-down rings
+        no-op. Callers own single-release discipline: a payload whose
+        name DID reach a replica is released by the replica's decode."""
+        if payload and payload[0] == "shm" and self._ring is not None:
+            self._ring.release(payload[1])
+
+    def encode(self, features: Mapping[str, np.ndarray]):
+        arrays = {k: np.asarray(v) for k, v in features.items()}
+        large = {k: v for k, v in arrays.items() if v.nbytes >= self._inline_max}
+        if not large or self._free_queue is None:
+            return self._inline(arrays)
+        need = sum(_align(v.nbytes) for v in large.values())
+        if self._ring is None and not self._ring_failed:
+            try:
+                self._ring = ShmSlabRing(
+                    self._free_queue,
+                    slot_bytes=need + need // 2 + (1 << 16),
+                    num_slots=self._num_slots,
+                )
+            except OSError as err:
+                _log.warning(
+                    "request shm ring unavailable (%s); inline transport", err
+                )
+                self._ring_failed = True
+        ring = self._ring
+        if ring is None or need > ring.slot_bytes:
+            return self._inline(arrays)
+        name = ring.acquire_nowait()
+        if name is None:
+            return self._inline(arrays)
+        shm = ring.slots.get(name)
+        if shm is None:  # foreign name (should not happen); drop it
+            return self._inline(arrays)
+        entries = []
+        offset = 0
+        small = {}
+        for key, value in arrays.items():
+            if value.nbytes < self._inline_max:
+                small[key] = value
+                continue
+            view = np.frombuffer(
+                shm.buf, dtype=value.dtype, count=value.size, offset=offset
+            ).reshape(value.shape)
+            np.copyto(view, value)
+            del view
+            entries.append((key, str(value.dtype), value.shape, offset))
+            offset += _align(value.nbytes)
+        crc, blob = pack(small)
+        return ("shm", name, entries, crc, blob)
+
+    def close(self) -> None:
+        if self._ring is not None:
+            self._ring.close()
+            self._ring = None
+
+
+class ReplicaSlotCache:
+    """Worker-side attach cache: one SharedMemory mapping per slot name
+    for the replica's lifetime (attaching is a syscall; slots cycle)."""
+
+    def __init__(self):
+        self._cache: Dict[str, Any] = {}
+
+    def attach(self, name: str):
+        shm = self._cache.get(name)
+        if shm is None:
+            from multiprocessing import shared_memory
+
+            shm = shared_memory.SharedMemory(name=name)
+            self._cache[name] = shm
+        return shm
+
+    def close(self) -> None:
+        for shm in self._cache.values():
+            best_effort(shm.close)
+        self._cache = {}
+
+
+def decode_request(
+    payload, free_queue, cache: ReplicaSlotCache
+) -> Dict[str, np.ndarray]:
+    """Worker-side decode. Shm entries are COPIED out and the slot name
+    returned to the owner's free queue immediately — the replica holds
+    no view into shared state while it computes, so a replica crash
+    after this point cannot strand a slot."""
+    kind = payload[0]
+    if kind == "inline":
+        _, crc, blob = payload
+        features = unpack(crc, blob)
+        if not isinstance(features, dict):
+            raise IntegrityError("inline request decoded to a non-dict")
+        return features
+    if kind != "shm":
+        raise IntegrityError(f"unknown request payload kind {payload[0]!r}")
+    _, name, entries, crc, blob = payload
+    try:
+        # Everything that can raise sits INSIDE the release scope: a
+        # corrupt small-items blob (unpack's CRC) or a failed attach
+        # must still return the slot, or each such request permanently
+        # shrinks the ring.
+        features = unpack(crc, blob)
+        shm = cache.attach(name)
+        for key, dtype, shape, offset in entries:
+            count = 1
+            for dim in shape:
+                count *= int(dim)
+            view = np.frombuffer(
+                shm.buf, dtype=np.dtype(dtype), count=count, offset=offset
+            ).reshape(shape)
+            features[key] = np.array(view)  # copy OUT of the slot
+            del view
+    finally:
+        if free_queue is not None:
+            best_effort(free_queue.put_nowait, name)
+    return features

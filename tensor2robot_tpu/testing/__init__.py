@@ -1,0 +1,6 @@
+"""Deterministic test instrumentation (fault injection lives here).
+
+Nothing in this package may be imported by production modules except
+through the narrow `chaos.maybe_fire(site)` hooks, which are inert (a
+counter bump and a None return) unless a `T2R_CHAOS` plan is active.
+"""

@@ -1,0 +1,296 @@
+"""Deterministic fault injection: seeded, flag-gated chaos plans.
+
+The fleet layer's failure handling (serving/router.py retry/hedge/
+circuit-break, train/ crash-consistent restore) is only trustworthy if
+the failures it survives are *reproducible*. Wall-clock fault injection
+("kill a replica after 3 seconds") makes every red run a debugging
+seance; this module injects faults at **named sites by occurrence
+count**, so a failing test replays bit-for-bit.
+
+A plan is a semicolon-separated list of clauses:
+
+    [scope/]site:occurrence:action[:arg]
+
+  * `site`    — the name a production hook passes to `maybe_fire()`
+                (e.g. `predict`, `reply`, `save`, `restore`).
+  * `occurrence` — 1-based count of `maybe_fire(site)` calls in this
+                process (within the matching scope) at which the fault
+                fires. Each clause fires at most once.
+  * `action`  — what happens (see table).
+  * `scope`   — optional; when set, the clause is inert unless the
+                process declared the same scope via `set_scope()`
+                (replica processes declare `r<index>`).
+
+Actions:
+
+  * `kill` / `sigkill` — SIGKILL this process, right here. No cleanup
+    handlers run: this is the real crash the recovery path must survive.
+  * `delay:<ms>` / `hang:<ms>` — sleep for `ms` milliseconds at the
+    site (straggler/stall injection; bounded by the plan, so tests stay
+    deterministic and inside the tier-1 time budget).
+  * `corrupt` — returns the fault to the caller, which applies the
+    corruption it is testing (e.g. the replica loop flips a byte in an
+    already-checksummed reply).
+  * `raise` — raises `ChaosFault` at the site (exception-path testing).
+
+The plan comes from the `T2R_CHAOS` env flag (declared in flags.py; the
+env route is what reaches spawned replica/trainer processes), or
+in-process via `configure()` for unit tests. Counters are per-process
+and monotonic; `reset()` re-arms everything (tests only).
+
+Example — kill replica 0 on its 3rd predict and SIGKILL a trainer in
+its 2nd checkpoint-save window:
+
+    T2R_CHAOS="r0/predict:3:kill;save:2:sigkill"
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from tensor2robot_tpu import flags as t2r_flags
+
+__all__ = [
+    "ChaosFault",
+    "ChaosPredictor",
+    "Clause",
+    "parse_plan",
+    "configure",
+    "set_scope",
+    "get_scope",
+    "active",
+    "maybe_fire",
+    "fired",
+    "counters",
+    "reset",
+]
+
+_KNOWN_ACTIONS = ("kill", "sigkill", "delay", "hang", "corrupt", "raise")
+# Injected stalls are test instrumentation: cap them so a typo'd plan
+# cannot park the tier-1 suite (the fault model is a *straggler*, and
+# 5 s is already far beyond every router timeout under test).
+_MAX_DELAY_MS = 5000.0
+
+
+class ChaosFault(RuntimeError):
+    """Raised at a site by a `raise` clause (and the base for plan errors)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Clause:
+    """One parsed fault: fire `action` at the Nth visit of `site`."""
+
+    site: str
+    occurrence: int
+    action: str
+    arg_ms: Optional[float] = None
+    scope: Optional[str] = None
+
+    def describe(self) -> str:
+        prefix = f"{self.scope}/" if self.scope else ""
+        suffix = f":{self.arg_ms:g}" if self.arg_ms is not None else ""
+        return f"{prefix}{self.site}:{self.occurrence}:{self.action}{suffix}"
+
+
+def parse_plan(spec: Optional[str]) -> Tuple[Clause, ...]:
+    """Parses a plan string; raises ValueError with the offending clause
+    on any malformation — a chaos typo must fail the test run loudly,
+    not silently inject nothing."""
+    if spec is None or not spec.strip():
+        return ()
+    clauses: List[Clause] = []
+    for raw in spec.split(";"):
+        raw = raw.strip()
+        if not raw:
+            continue
+        scope = None
+        body = raw
+        if "/" in body:
+            scope, body = body.split("/", 1)
+            scope = scope.strip()
+            if not scope:
+                raise ValueError(f"chaos clause {raw!r}: empty scope")
+        parts = [p.strip() for p in body.split(":")]
+        if len(parts) not in (3, 4):
+            raise ValueError(
+                f"chaos clause {raw!r}: expected "
+                "[scope/]site:occurrence:action[:arg]"
+            )
+        site, occurrence_s, action = parts[0], parts[1], parts[2]
+        if not site:
+            raise ValueError(f"chaos clause {raw!r}: empty site")
+        try:
+            occurrence = int(occurrence_s)
+        except ValueError as err:
+            raise ValueError(
+                f"chaos clause {raw!r}: occurrence must be an int"
+            ) from err
+        if occurrence < 1:
+            raise ValueError(
+                f"chaos clause {raw!r}: occurrence is 1-based (got "
+                f"{occurrence})"
+            )
+        if action not in _KNOWN_ACTIONS:
+            raise ValueError(
+                f"chaos clause {raw!r}: unknown action {action!r} "
+                f"(known: {', '.join(_KNOWN_ACTIONS)})"
+            )
+        arg_ms = None
+        if action in ("delay", "hang"):
+            if len(parts) != 4:
+                raise ValueError(
+                    f"chaos clause {raw!r}: {action} needs a millisecond "
+                    "argument"
+                )
+            try:
+                arg_ms = float(parts[3])
+            except ValueError as err:
+                raise ValueError(
+                    f"chaos clause {raw!r}: bad delay {parts[3]!r}"
+                ) from err
+            if not 0 <= arg_ms <= _MAX_DELAY_MS:
+                raise ValueError(
+                    f"chaos clause {raw!r}: delay must be in "
+                    f"[0, {_MAX_DELAY_MS:g}] ms"
+                )
+        elif len(parts) == 4:
+            raise ValueError(
+                f"chaos clause {raw!r}: {action} takes no argument"
+            )
+        clauses.append(Clause(site, occurrence, action, arg_ms, scope))
+    return tuple(clauses)
+
+
+# -- per-process state ---------------------------------------------------------
+
+_lock = threading.Lock()
+_plan: Optional[Tuple[Clause, ...]] = None  # None = not yet loaded from env
+_scope: Optional[str] = None
+_counters: Dict[str, int] = {}
+_fired: List[str] = []
+
+
+def _load_plan() -> Tuple[Clause, ...]:
+    global _plan
+    if _plan is None:
+        _plan = parse_plan(t2r_flags.get_str("T2R_CHAOS"))
+    return _plan
+
+
+def configure(spec: Optional[str]) -> None:
+    """Installs a plan in-process (unit tests). Resets counters. To reach
+    a *spawned* process instead, write the T2R_CHAOS env flag (via
+    flags.write_env or a replica spec's env overrides)."""
+    global _plan
+    with _lock:
+        _plan = parse_plan(spec)
+        _counters.clear()
+        _fired.clear()
+
+
+def set_scope(scope: Optional[str]) -> None:
+    """Declares this process's clause scope (replica main sets `r<i>`)."""
+    global _scope
+    with _lock:
+        _scope = scope
+
+
+def get_scope() -> Optional[str]:
+    return _scope
+
+
+def active() -> bool:
+    """True when a non-empty plan is installed (cheap enough to gate log
+    lines; maybe_fire() is self-gating either way)."""
+    with _lock:
+        return bool(_load_plan())
+
+
+def reset() -> None:
+    """Clears plan/scope/counters and re-arms env loading (tests only)."""
+    global _plan, _scope
+    with _lock:
+        _plan = None
+        _scope = None
+        _counters.clear()
+        _fired.clear()
+
+
+def counters() -> Dict[str, int]:
+    with _lock:
+        return dict(_counters)
+
+
+def fired() -> List[str]:
+    """Descriptions of clauses that have fired in this process, in order."""
+    with _lock:
+        return list(_fired)
+
+
+def maybe_fire(site: str) -> Optional[Clause]:
+    """Production hook: bumps the site counter and fires any matching
+    clause. Returns the fired Clause for caller-applied actions
+    (`corrupt`), after sleeping for `delay`/`hang`, never for `kill`
+    (the process is gone), or None when nothing matched.
+
+    Sleeps and kills happen OUTSIDE the module lock: a hung site must
+    not serialize other threads' (non-firing) hooks behind it.
+    """
+    with _lock:
+        plan = _load_plan()
+        if not plan:
+            return None
+        count = _counters.get(site, 0) + 1
+        _counters[site] = count
+        hit: Optional[Clause] = None
+        for clause in plan:
+            if clause.site != site or clause.occurrence != count:
+                continue
+            if clause.scope is not None and clause.scope != _scope:
+                continue
+            hit = clause
+            _fired.append(clause.describe())
+            break
+    if hit is None:
+        return None
+    if hit.action in ("kill", "sigkill"):
+        os.kill(os.getpid(), signal.SIGKILL)
+        # Unreachable on POSIX; keep a hard stop in case the signal is
+        # briefly pending on an alternate thread.
+        time.sleep(60)
+        raise ChaosFault(f"chaos kill at {hit.describe()} did not land")
+    if hit.action in ("delay", "hang"):
+        time.sleep((hit.arg_ms or 0.0) / 1e3)
+        return hit
+    if hit.action == "raise":
+        raise ChaosFault(f"injected fault at {hit.describe()}")
+    return hit  # corrupt: caller applies it
+
+
+class ChaosPredictor:
+    """Delegating predictor wrapper that fires the `predict` site before
+    every compute call — the hook point for replica-side straggler
+    (`delay`), crash (`kill`), and exception (`raise`) injection. Inert
+    (one dict lookup) without an active plan; replica factories install
+    it unconditionally so a chaos plan needs no code changes to reach a
+    live replica's compute path."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def predict(self, features):
+        maybe_fire("predict")
+        return self._inner.predict(features)
+
+    def predict_versioned(self, features):
+        maybe_fire("predict")
+        return self._inner.predict_versioned(features)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+

@@ -26,6 +26,7 @@ import jax
 import orbax.checkpoint as ocp
 
 from tensor2robot_tpu.models.abstract_model import MODE_EVAL, AbstractT2RModel
+from tensor2robot_tpu.train import durability
 from tensor2robot_tpu.train.metrics import MetricsWriter
 from tensor2robot_tpu.train.train_eval import (
     CompiledModel,
@@ -41,15 +42,35 @@ def _checkpoint_root(model_dir: str) -> str:
     return os.path.abspath(os.path.join(model_dir, "checkpoints"))
 
 
+# Steps that already validated durable, per checkpoint root. A durable
+# verdict is immutable (the manifest blesses a finalized checkpoint), so
+# the poll loop only pays full manifest validation — a json parse plus a
+# stat per checkpoint file — once per NEW step instead of for every step
+# on every tick; on a network filesystem the difference is a sustained
+# metadata storm. Bounded by keep_checkpoint_max per live root.
+_DURABLE_SEEN: set = set()
+
+
 def _committed_steps(checkpoint_root: str) -> List[int]:
-    """Step dirs on disk, newest last; orbax tmp dirs (uncommitted writes)
-    are excluded — commitment is the atomic rename to the bare step name."""
+    """DURABLE step dirs on disk, newest last. Orbax tmp dirs
+    (uncommitted writes) and torn final-named dirs are excluded — this
+    is the read-only side of the durability contract (train/durability):
+    an eval tail must never copy or restore a torn checkpoint, but it
+    also must not quarantine anything, because the trainer writing this
+    dir is alive."""
     if not os.path.isdir(checkpoint_root):
         return []
     steps = []
     for entry in os.listdir(checkpoint_root):
-        if entry.isdigit() and os.path.isdir(os.path.join(checkpoint_root, entry)):
-            steps.append(int(entry))
+        path = os.path.join(checkpoint_root, entry)
+        if not (entry.isdigit() and os.path.isdir(path)):
+            continue
+        key = (checkpoint_root, int(entry))
+        if key not in _DURABLE_SEEN:
+            if durability.validate_step_dir(path) is not None:
+                continue
+            _DURABLE_SEEN.add(key)
+        steps.append(int(entry))
     return sorted(steps)
 
 

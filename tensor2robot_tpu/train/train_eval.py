@@ -46,7 +46,8 @@ from tensor2robot_tpu.models.tpu_model_wrapper import TPUT2RModelWrapper
 from tensor2robot_tpu.parallel import collectives
 from tensor2robot_tpu.parallel import mesh as mesh_lib
 from tensor2robot_tpu.specs import TensorSpecStruct, make_example_args
-from tensor2robot_tpu.train import infeed
+from tensor2robot_tpu.testing import chaos
+from tensor2robot_tpu.train import durability, infeed
 from tensor2robot_tpu.train.metrics import (
     DeferredFetch,
     MetricsWriter,
@@ -1043,12 +1044,19 @@ def create_checkpoint_manager(
     )
 
 
+# Re-exported from durability (its importable, orbax-free home) for the
+# trainer-side callers below and existing importers.
+latest_durable_step_in = durability.latest_durable_step_in
+
+
 def restore_or_init_state(
     manager: ocp.CheckpointManager, compiled: CompiledModel, rng, example_batch
 ) -> TrainState:
     state = compiled.init_state(rng, example_batch)
-    latest = manager.latest_step()
+    latest = latest_durable_step_in(manager)
     if latest is not None:
+        # Chaos site: `restore` (slow-restore delay / exception injection).
+        chaos.maybe_fire("restore")
         # Checkpoints always hold the PERSISTABLE (tree-stats) layout;
         # restore against that form, then refuse back into the live fused
         # form if this trainer runs one.
@@ -1243,6 +1251,16 @@ def train_eval_model(
             generator, model, MODE_EVAL
         )
 
+    # Writer-side durability sweep BEFORE the manager opens: torn step
+    # dirs (a SIGKILL mid-save, a half-copied restore source) move to
+    # checkpoints.quarantine/ so the resumed run re-saves the replayed
+    # window without colliding with the wreckage, and latest_step can
+    # never name them. The trainer owns this dir — readers only skip.
+    for torn_name, torn_reason in durability.sweep_torn_checkpoints(model_dir):
+        print(
+            f"Quarantined torn checkpoint {torn_name!r}: {torn_reason}",
+            flush=True,
+        )
     manager = create_checkpoint_manager(
         model_dir, save_interval_steps=save_checkpoints_steps,
         keep_checkpoint_max=keep_checkpoint_max,
@@ -1312,6 +1330,16 @@ def train_eval_model(
     last_log_step = start_step
     last_saved_step = start_step
     host_batches = itertools.chain([first_batch], train_batches)
+    if start_step > 0:
+        # Crash-consistency contract: step k of a RESUMED run must see
+        # the same batch step k of an uninterrupted run saw, or the
+        # replayed trajectory diverges from the one the crash
+        # interrupted. Deterministic generators restart their stream
+        # from batch 0 each process, so skip the batches the restored
+        # steps already consumed. (Linear in start_step — the price of
+        # replay-exactness; shuffled real-data pipelines were never
+        # bitwise-resumable and merely skip cheap host parses here.)
+        host_batches = itertools.islice(host_batches, start_step, None)
 
     # Collective observability (quantized ZeRO-2 regime only): byte
     # counters plus a one-off wall-time probe, merged into every log
@@ -1352,16 +1380,28 @@ def train_eval_model(
         # Fused-stats states persist (and face hooks/exporters/eval) in
         # the canonical tree layout — the on-disk format never changes.
         state = compiled.persistable_state(state)
+        previous_saved = last_saved_step
         # Async save: orbax snapshots device arrays to host memory before
         # returning, then writes in the background — the next scan window
         # dispatches immediately instead of stalling on serialization.
         manager.save(step, args=ocp.args.StandardSave(state), force=True)
+        # Issuing this save was the commit barrier for the PREVIOUS one
+        # (orbax serializes saves): publish its durability manifest.
+        # No-op when no prior save exists (previous_saved is start_step
+        # on the first call; publish_durable ignores absent dirs).
+        durability.publish_durable(model_dir, previous_saved)
+        # Chaos site: the async write for `step` is now in flight — a
+        # `kill` clause here is the SIGKILL-mid-orbax-save fault the
+        # crash-consistency suite injects. (After the previous step's
+        # blessing: a crash mid-save must not cost the durable past.)
+        chaos.maybe_fire("save")
         last_saved_step = step
         ctx.checkpoint_path = str(
             os.path.join(model_dir, "checkpoints", str(step))
         )
         if ckpt_hooks_present:
             manager.wait_until_finished()
+            durability.publish_durable(model_dir, step)
         for hook in hooks:
             hook.after_checkpoint_saved(ctx)
         return run_eval_and_export(state, step)
@@ -1461,6 +1501,10 @@ def train_eval_model(
         for eval_writer in eval_writers.values():
             eval_writer.close()
         manager.wait_until_finished()
+        # Exit barrier: the final async save is committed — publish its
+        # durability manifest so the next run restores from it without
+        # falling back to the structural check (no-op when nothing saved).
+        durability.publish_durable(model_dir, last_saved_step)
         manager.close()
         _save_operative_config(model_dir)
     return final_eval
@@ -1496,11 +1540,11 @@ def predict_from_model(
     batches = iter(input_generator.create_dataset(MODE_PREDICT))
     first = next(batches)
     manager = create_checkpoint_manager(model_dir, save_interval_steps=1)
-    if manager.latest_step() is None:
+    if latest_durable_step_in(manager) is None:
         raise FileNotFoundError(
-            f"No checkpoint found under {model_dir!r}; refusing to serve "
-            "randomly-initialized weights. Use init_randomly on a predictor "
-            "if that is intended."
+            f"No durable checkpoint found under {model_dir!r}; refusing to "
+            "serve randomly-initialized (or torn) weights. Use init_randomly "
+            "on a predictor if that is intended."
         )
     state = restore_or_init_state(
         manager, compiled, jax.random.PRNGKey(0), first

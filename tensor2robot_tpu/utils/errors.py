@@ -1,0 +1,47 @@
+"""Sanctioned best-effort execution for teardown/cleanup paths.
+
+The `swallowed-exception` lint (analysis/lints.py) bans silent handlers
+(`except: pass`, `except Exception: pass`) inside `serving/`, `train/`
+and `predictors/` — in a fault-tolerant fleet, an invisible swallow is
+how a real failure (a replica that cannot reply, a checkpoint that
+cannot finalize) degrades into an unexplained hang or a silent data
+loss. But teardown paths legitimately do not care: returning a slot to
+a queue the router already closed, closing shared memory the other end
+unlinked. Those sites say so EXPLICITLY, one of two ways:
+
+  * call through :func:`best_effort` — no except block at the call site
+    at all, and the one sanctioned swallow lives here, greppable; or
+  * decorate the enclosing function with :func:`best_effort_cleanup`,
+    the lint's allowlist marker, when the handler needs structure a
+    plain call wrapper cannot express.
+
+Either way the intent is in the code, not in a linter ignore comment.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, TypeVar
+
+F = TypeVar("F", bound=Callable[..., Any])
+
+__all__ = ["best_effort", "best_effort_cleanup"]
+
+
+def best_effort_cleanup(fn: F) -> F:
+    """Marks `fn` as an allowlisted swallow site for the
+    `swallowed-exception` lint: silent broad handlers inside it are
+    accepted. Use only on small, single-purpose cleanup functions — the
+    allowlist covers the whole decorated body."""
+    fn.__t2r_best_effort__ = True
+    return fn
+
+
+@best_effort_cleanup
+def best_effort(fn: Callable[..., Any], *args: Any, **kwargs: Any) -> Optional[Any]:
+    """Calls ``fn(*args, **kwargs)`` swallowing ``Exception`` (never
+    ``BaseException`` — KeyboardInterrupt/SystemExit still propagate).
+    Returns the call's result, or None when it raised."""
+    try:
+        return fn(*args, **kwargs)
+    except Exception:  # the one sanctioned swallow; see module docstring
+        return None

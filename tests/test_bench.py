@@ -274,7 +274,8 @@ def test_bench_cli_lists_legs():
     )
     assert proc.returncode == 0
     for leg in (
-        "data", "auc", "predict", "bc", "stream", "pipe", "serve", "comms"
+        "data", "auc", "predict", "bc", "stream", "pipe", "serve", "comms",
+        "fleet",
     ):
         assert leg in proc.stdout
     proc = subprocess.run(
@@ -341,6 +342,59 @@ def test_bench_serve_contract(tmp_path):
     swap = detail["hot_swap"]
     assert swap["swap_observed"] is True
     assert swap["version_after"] > swap["version_before"]
+    import json as json_mod
+
+    with open(out) as f:
+        assert json_mod.load(f)["metric"] == payload["metric"]
+
+
+@pytest.mark.slow
+def test_bench_fleet_contract(tmp_path):
+    """The replica-fleet routing leg at toy scale: one JSON line + the
+    --out artifact, with the acceptance-criteria fields — sweep legs
+    carrying p50/p99/p999 + availability, a SIGKILL chaos leg with ZERO
+    lost requests and bounded p99 degradation, and a rolling fleet-wide
+    hot-swap with zero failed requests."""
+    out = str(tmp_path / "fleet.json")
+    payload = _run_bench(
+        "fleet",
+        "--replicas", "3",
+        "--capacity-secs", "0.8",
+        "--leg-secs", "1.2",
+        "--out", out,
+        timeout=420,
+    )
+    assert payload["metric"] == "fleet_router_capacity_cpu_proxy"
+    assert payload["unit"] == "requests_per_sec"
+    assert payload["value"] > 0
+    assert "error" not in payload
+    detail = payload["detail"]
+    assert detail["replicas"] == 3
+    assert len(detail["open_loop"]) == 3
+    for leg in detail["open_loop"]:
+        for key in ("p50_ms", "p99_ms", "p999_ms", "availability"):
+            assert key in leg, leg
+        # The zero-lost guarantee: every future resolved (ok or typed).
+        assert leg["lost"] == 0, leg
+    chaos = detail["chaos"]
+    assert chaos["sigkill_leg"]["killed_pid"]
+    assert chaos["zero_lost"] is True
+    assert chaos["sigkill_leg"]["lost"] == 0
+    assert chaos["fault_free_leg"]["lost"] == 0
+    assert chaos["p99_degradation_x"] <= chaos["p99_degradation_max"]
+    # The kill was real AND the fleet recovered from it.
+    assert chaos["counters"]["replica_deaths"] >= 1
+    assert chaos["counters"]["respawns"] >= 1
+    swap = detail["rolling_swap"]
+    assert swap["failed_requests"] == 0
+    assert swap["lost"] == 0
+    assert swap["swap_result"]["failed"] is None
+    assert all(
+        after > before
+        for before, after in zip(
+            swap["version_before"], swap["version_after"]
+        )
+    )
     import json as json_mod
 
     with open(out) as f:

@@ -1,0 +1,166 @@
+"""testing/chaos.py: deterministic fault plans.
+
+The whole value of the chaos harness is determinism — a plan must fire
+the same fault at the same occurrence every run, scoped to the right
+process, and a malformed plan must fail loudly. These tests pin that
+contract; the router/crash-consistency suites then lean on it.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tensor2robot_tpu import flags as t2r_flags
+from tensor2robot_tpu.testing import chaos
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+class TestParse:
+    def test_empty_and_none(self):
+        assert chaos.parse_plan(None) == ()
+        assert chaos.parse_plan("") == ()
+        assert chaos.parse_plan(" ; ; ") == ()
+
+    def test_full_grammar(self):
+        plan = chaos.parse_plan(
+            "r0/predict:3:kill; save:2:sigkill ;reply:1:corrupt;"
+            "predict:5:delay:250;restore:1:hang:10;loop:2:raise"
+        )
+        assert [c.describe() for c in plan] == [
+            "r0/predict:3:kill",
+            "save:2:sigkill",
+            "reply:1:corrupt",
+            "predict:5:delay:250",
+            "restore:1:hang:10",
+            "loop:2:raise",
+        ]
+        assert plan[0].scope == "r0" and plan[1].scope is None
+        assert plan[3].arg_ms == 250.0
+
+    @pytest.mark.parametrize(
+        "bad",
+        [
+            "predict:3",  # missing action
+            "predict:x:kill",  # bad occurrence
+            "predict:0:kill",  # 0: occurrences are 1-based
+            "predict:1:explode",  # unknown action
+            "predict:1:delay",  # delay needs ms
+            "predict:1:delay:abc",  # bad ms
+            "predict:1:delay:999999",  # over the stall cap
+            "predict:1:kill:5",  # kill takes no arg
+            "/predict:1:kill",  # empty scope
+            ":1:kill",  # empty site
+        ],
+    )
+    def test_malformed_plans_fail_loudly(self, bad):
+        with pytest.raises(ValueError):
+            chaos.parse_plan(bad)
+
+    def test_flag_declared(self):
+        spec = t2r_flags.get_flag("T2R_CHAOS")
+        assert spec.kind == "str" and spec.default is None
+
+
+class TestFire:
+    def test_inert_without_plan(self):
+        assert chaos.maybe_fire("predict") is None
+        assert not chaos.active()
+
+    def test_fires_at_exact_occurrence_once(self):
+        chaos.configure("predict:3:corrupt")
+        hits = [chaos.maybe_fire("predict") for _ in range(6)]
+        assert [h.action if h else None for h in hits] == [
+            None, None, "corrupt", None, None, None,
+        ]
+        assert chaos.fired() == ["predict:3:corrupt"]
+        assert chaos.counters() == {"predict": 6}
+
+    def test_sites_count_independently(self):
+        chaos.configure("a:2:corrupt;b:1:corrupt")
+        assert chaos.maybe_fire("a") is None
+        assert chaos.maybe_fire("b").site == "b"
+        assert chaos.maybe_fire("a").site == "a"
+
+    def test_scope_gating(self):
+        chaos.configure("r1/predict:1:corrupt")
+        assert chaos.maybe_fire("predict") is None  # no scope declared
+        chaos.configure("r1/predict:1:corrupt")
+        chaos.set_scope("r0")
+        assert chaos.maybe_fire("predict") is None  # wrong scope
+        chaos.configure("r1/predict:1:corrupt")
+        chaos.set_scope("r1")
+        assert chaos.maybe_fire("predict").action == "corrupt"
+
+    def test_delay_sleeps_roughly_arg(self):
+        chaos.configure("predict:1:delay:120")
+        t0 = time.monotonic()
+        hit = chaos.maybe_fire("predict")
+        took = time.monotonic() - t0
+        assert hit.action == "delay"
+        assert took >= 0.1
+
+    def test_raise_action(self):
+        chaos.configure("step:2:raise")
+        chaos.maybe_fire("step")
+        with pytest.raises(chaos.ChaosFault):
+            chaos.maybe_fire("step")
+
+    def test_env_flag_route(self, monkeypatch):
+        monkeypatch.setenv("T2R_CHAOS", "boot:1:corrupt")
+        chaos.reset()  # re-arm env loading
+        assert chaos.active()
+        assert chaos.maybe_fire("boot").action == "corrupt"
+
+    def test_determinism_across_runs(self):
+        """Same plan + same call sequence -> identical fired history."""
+        histories = []
+        for _ in range(2):
+            chaos.configure("a:2:corrupt;b:3:corrupt")
+            for site in ("a", "b", "a", "b", "b", "a"):
+                try:
+                    chaos.maybe_fire(site)
+                except chaos.ChaosFault:
+                    pass
+            histories.append(chaos.fired())
+        assert histories[0] == histories[1] == [
+            "a:2:corrupt", "b:3:corrupt",
+        ]
+
+
+class TestKill:
+    def test_kill_is_a_real_sigkill(self, tmp_path):
+        """The kill action must be an uncatchable SIGKILL — no atexit, no
+        finally blocks — because that is the crash the recovery paths
+        claim to survive."""
+        script = (
+            "import sys\n"
+            "from tensor2robot_tpu import flags\n"
+            "from tensor2robot_tpu.testing import chaos\n"
+            "flags.write_env('T2R_CHAOS', 'work:2:kill')\n"
+            "try:\n"
+            "    for i in range(5):\n"
+            "        chaos.maybe_fire('work')\n"
+            "        print('tick', i, flush=True)\n"
+            "finally:\n"
+            "    print('CLEANUP_RAN', flush=True)\n"
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", script],
+            capture_output=True, text=True, timeout=60, cwd=REPO,
+        )
+        assert proc.returncode == -signal.SIGKILL
+        assert "tick 0" in proc.stdout
+        assert "tick 1" not in proc.stdout  # died inside the 2nd visit
+        assert "CLEANUP_RAN" not in proc.stdout

@@ -222,6 +222,18 @@ class TestExportedSavedModelPredictor:
         )
         # Bounded cleanup so the polling daemon does not outlive the test.
         predictor._restore_thread.join(timeout=30)
+        # Once the leaked thread finally dies, the in-flight latch clears —
+        # the predictor is USABLE again (a later async restore may start,
+        # and a clean close joins it)...
+        deadline = time.time() + 10
+        while predictor._restore_in_flight and time.time() < deadline:
+            time.sleep(0.01)
+        assert not predictor._restore_in_flight
+        assert predictor.restore(is_async=True)
+        predictor.close(join_timeout=30)
+        # ...but the leak flag is STICKY: fleet monitors polling
+        # snapshot() must keep seeing the wound after recovery.
+        assert predictor.restore_thread_leaked
 
     def test_init_randomly(self):
         predictor = ExportedSavedModelPredictor(

@@ -1,9 +1,12 @@
 """tools/read_trace.py parses a real jax.profiler capture.
 
 The tool is the offline half of the on-chip profiling loop (bench.py's
-BENCH_PROFILE_DIR capture -> top-ops summary); this pins its ProfileData
-usage against the installed jaxlib so an API drift fails here, not in the
-one serialized chip window where the capture is expensive.
+BENCH_PROFILE_DIR capture -> top-ops summary); this pins its parser
+against the installed jaxlib so an API drift fails here, not in the one
+serialized chip window where the capture is expensive. (It did exactly
+that: the installed jax 0.4.37 exports no jax.profiler.ProfileData — the
+root cause of this test's long red streak — so the tool now falls back
+to its own pure-python XSpace wire parser, exercised by this capture.)
 """
 
 import json

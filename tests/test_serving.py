@@ -225,6 +225,113 @@ class TestPolicyServer:
             assert good.outputs["a_predicted"].shape == (1,)
             assert server.snapshot()["counters"]["failed"] == 1
 
+    def test_dispatcher_survives_predictor_exception_with_typed_error(
+        self, predictor
+    ):
+        """A predictor RAISING mid-_execute_batch must fail that batch's
+        futures with the typed PredictFailed (carrying the original
+        exception class), record the failure class in the metrics, and
+        keep the dispatch loop alive."""
+        from tensor2robot_tpu.serving import PredictFailed
+
+        class _RaisesOnce:
+            def __init__(self, inner):
+                self._inner = inner
+                self.raise_next = True
+
+            def predict_versioned(self, features):
+                if self.raise_next:
+                    self.raise_next = False
+                    raise ConnectionResetError("backend fell over")
+                return self._inner.predict_versioned(features)
+
+            def __getattr__(self, name):
+                return getattr(self._inner, name)
+
+        flaky = _RaisesOnce(predictor)
+        with PolicyServer(flaky, max_wait_ms=1).start(
+            prewarm=False
+        ) as server:
+            bad = server.submit(_example(), deadline_ms=30000)
+            with pytest.raises(PredictFailed, match="ConnectionResetError"):
+                bad.result(30)
+            assert bad.error().failure_class == "ConnectionResetError"
+            # The loop survived; the next request serves normally.
+            good = server.call(_example(), timeout=30)
+            assert good.outputs["a_predicted"].shape == (1,)
+            snap = server.snapshot()
+            assert snap["counters"]["failed"] == 1
+            assert snap["failed_by_class"] == {"ConnectionResetError": 1}
+
+    def test_dispatcher_survives_predictor_timeout_with_typed_error(
+        self, predictor
+    ):
+        """A predictor HANGING mid-_execute_batch must trip the compute
+        watchdog: the batch fails with PredictTimeout, the failure class
+        lands in the counters, and the dispatcher routes the next batch
+        normally (the stuck call is abandoned on its daemon thread)."""
+        from tensor2robot_tpu.serving import PredictTimeout
+
+        class _HangsOnce:
+            def __init__(self, inner):
+                self._inner = inner
+                self.hang_next = False
+                self.unhang = threading.Event()
+
+            def predict_versioned(self, features):
+                if self.hang_next:
+                    self.hang_next = False
+                    assert self.unhang.wait(30), "test never released the hang"
+                return self._inner.predict_versioned(features)
+
+            def __getattr__(self, name):
+                return getattr(self._inner, name)
+
+        stuck = _HangsOnce(predictor)
+        with PolicyServer(
+            stuck, max_wait_ms=1, predict_timeout_ms=150
+        ).start() as server:
+            # start() prewarmed every bucket outside the watchdog (hang
+            # still unarmed), so the 150ms budget below is measuring the
+            # hang, not first-call compile on a loaded host.
+            stuck.hang_next = True
+            bad = server.submit(_example(), deadline_ms=30000)
+            with pytest.raises(PredictTimeout, match="watchdog"):
+                bad.result(30)
+            # Release the abandoned thread so it doesn't outlive the test.
+            stuck.unhang.set()
+            good = server.call(_example(), timeout=30)
+            assert good.outputs["a_predicted"].shape == (1,)
+            snap = server.snapshot()
+            assert snap["failed_by_class"] == {"PredictTimeout": 1}
+
+    def test_snapshot_surfaces_restore_thread_leak(self, predictor):
+        """The fleet health probe rides snapshot(): a predictor that
+        leaked its restore thread at close() must be visible there, so
+        the router can see the wounded replica."""
+        with PolicyServer(predictor, max_wait_ms=1).start(
+            prewarm=False
+        ) as server:
+            assert server.snapshot()["restore_thread_leaked"] is False
+            predictor._inner._restore_thread_leaked = True
+            assert server.snapshot()["restore_thread_leaked"] is True
+
+    def test_future_done_callbacks_fire_on_both_paths(self, predictor):
+        """add_done_callback must fire exactly once per future — on the
+        completing thread for pending futures, immediately for already-
+        completed ones (the replica loop's reply path depends on it)."""
+        with PolicyServer(predictor, max_wait_ms=1).start(
+            prewarm=False
+        ) as server:
+            seen = []
+            future = server.submit(_example(), deadline_ms=30000)
+            future.add_done_callback(lambda f: seen.append(f.request_id))
+            future.result(30)
+            # Already-done: callback runs synchronously at registration.
+            future.add_done_callback(lambda f: seen.append(-f.request_id))
+            assert seen == [future.request_id, -future.request_id]
+            assert future.error() is None
+
     def test_stop_drains_queued_requests(self, predictor):
         server = PolicyServer(predictor, max_wait_ms=200).start()
         futures = [

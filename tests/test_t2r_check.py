@@ -274,6 +274,92 @@ class TestLintsCatch:
         diags = lint_source("def broken(:\n", "bad.py")
         assert [d.rule for d in diags] == ["syntax-error"]
 
+    # -- exception discipline -------------------------------------------------
+
+    _SERVING_PATH = "tensor2robot_tpu/serving/seeded.py"
+
+    def test_bare_except_flagged_even_with_real_body(self):
+        source = (
+            "def f():\n"
+            "    try:\n        work()\n"
+            "    except:\n        log()\n"
+        )
+        diags = lint_source(source, self._SERVING_PATH)
+        assert any(d.rule == "swallowed-exception" for d in diags)
+
+    def test_silent_broad_handler_flagged(self):
+        for handler in ("except Exception:", "except BaseException:",
+                        "except (ValueError, Exception):"):
+            source = (
+                "def f():\n"
+                "    try:\n        work()\n"
+                f"    {handler}\n        pass\n"
+            )
+            diags = lint_source(source, self._SERVING_PATH)
+            assert any(
+                d.rule == "swallowed-exception" for d in diags
+            ), handler
+
+    def test_handler_that_does_something_is_clean(self):
+        for body in ("log()", "x = None", "raise", "return 1"):
+            source = (
+                "def f():\n"
+                "    try:\n        return work()\n"
+                f"    except Exception:\n        {body}\n"
+            )
+            assert lint_source(source, self._SERVING_PATH) == [], body
+
+    def test_specific_exception_pass_is_clean(self):
+        source = (
+            "def f():\n"
+            "    try:\n        work()\n"
+            "    except FileNotFoundError:\n        pass\n"
+        )
+        assert lint_source(source, self._SERVING_PATH) == []
+
+    def test_allowlist_decorator_permits_swallow(self):
+        source = (
+            "from tensor2robot_tpu.utils.errors import best_effort_cleanup\n"
+            "@best_effort_cleanup\n"
+            "def reap(q):\n"
+            "    try:\n        q.close()\n"
+            "    except Exception:\n        pass\n"
+        )
+        assert lint_source(source, self._SERVING_PATH) == []
+        # ... but the decorator does NOT bless a bare except.
+        bare = (
+            "from tensor2robot_tpu.utils.errors import best_effort_cleanup\n"
+            "@best_effort_cleanup\n"
+            "def reap(q):\n"
+            "    try:\n        q.close()\n"
+            "    except:\n        pass\n"
+        )
+        diags = lint_source(bare, self._SERVING_PATH)
+        assert any(d.rule == "swallowed-exception" for d in diags)
+
+    def test_swallow_outside_scope_is_clean(self):
+        source = (
+            "def f():\n"
+            "    try:\n        work()\n"
+            "    except Exception:\n        pass\n"
+        )
+        assert lint_source(source, "tensor2robot_tpu/ops/seeded.py") == []
+
+    def test_swallow_in_train_and_predictors_scoped(self):
+        source = (
+            "def f():\n"
+            "    try:\n        work()\n"
+            "    except Exception:\n        pass\n"
+        )
+        for path in (
+            "tensor2robot_tpu/train/seeded.py",
+            "tensor2robot_tpu/predictors/seeded.py",
+        ):
+            diags = lint_source(source, path)
+            assert any(
+                d.rule == "swallowed-exception" for d in diags
+            ), path
+
     # -- collective discipline ------------------------------------------------
 
     _TRAIN_PATH = "tensor2robot_tpu/train/seeded.py"

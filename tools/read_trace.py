@@ -2,7 +2,12 @@
 
 Offline companion to the bench's BENCH_PROFILE_DIR capture — answers "where
 did the step time go" without TensorBoard (not in this image). Parses the
-.xplane.pb via jax.profiler.ProfileData (no tf dependency).
+.xplane.pb via jax.profiler.ProfileData when the installed jax exports it;
+otherwise falls back to a built-in pure-python XSpace wire parser (the
+installed jax 0.4.37 has no jax.profiler.ProfileData, and neither the tf
+build nor any tensorboard plugin in this image ships xplane_pb2 — the
+capture is still just protobuf wire format, which this repo parses by
+hand elsewhere too, see data/wire.py).
 
 Usage: python tools/read_trace.py <trace_dir> [top_n]
 The trace dir is what was passed as BENCH_PROFILE_DIR (the tool finds the
@@ -18,6 +23,165 @@ import glob
 import json
 import os
 import sys
+
+
+# -- fallback XSpace reader ----------------------------------------------------
+#
+# Minimal protobuf wire decoding of tsl/profiler/protobuf/xplane.proto,
+# restricted to the fields summarize() touches (field numbers verified
+# against a real capture from this image's jax 0.4.37):
+#
+#   XSpace.planes=1 ; XPlane.name=2 .lines=3 .event_metadata=4(map)
+#   XLine.events=4 .name=11 .display_name=12
+#   XEvent.metadata_id=1 .duration_ps=3
+#   XEventMetadata(map entry: key=1 value=2): .id=1 .name=2
+#   .display_name=4
+#
+# Unknown fields are skipped by wire type, so schema additions stay safe.
+
+
+def _read_varint(buf: bytes, pos: int) -> tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        byte = buf[pos]
+        pos += 1
+        result |= (byte & 0x7F) << shift
+        if not byte & 0x80:
+            return result, pos
+        shift += 7
+        if shift > 63:
+            raise ValueError("varint longer than 64 bits")
+
+
+def _fields(buf: bytes):
+    """Yields (field_number, wire_type, value) over one message's bytes.
+    LEN fields yield the sub-buffer; varints the int; fixed are skipped
+    (nothing summarize() needs rides them)."""
+    pos = 0
+    end = len(buf)
+    while pos < end:
+        key, pos = _read_varint(buf, pos)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            value, pos = _read_varint(buf, pos)
+            yield field, wire, value
+        elif wire == 2:
+            size, pos = _read_varint(buf, pos)
+            if pos + size > end:
+                raise ValueError("length-delimited field overruns buffer")
+            yield field, wire, buf[pos : pos + size]
+            pos += size
+        elif wire == 1:
+            pos += 8
+        elif wire == 5:
+            pos += 4
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+
+
+class _Event:
+    __slots__ = ("name", "duration_ns")
+
+    def __init__(self, name: str, duration_ns: float):
+        self.name = name
+        self.duration_ns = duration_ns
+
+
+class _Line:
+    __slots__ = ("name", "events")
+
+    def __init__(self, name: str, events: list):
+        self.name = name
+        self.events = events
+
+
+class _Plane:
+    __slots__ = ("name", "lines")
+
+    def __init__(self, name: str, lines: list):
+        self.name = name
+        self.lines = lines
+
+
+def _parse_event_metadata(buf: bytes) -> tuple[int, str]:
+    meta_id = 0
+    name = ""
+    display = ""
+    for field, wire, value in _fields(buf):
+        if field == 1 and wire == 0:
+            meta_id = value
+        elif field == 2 and wire == 2:
+            name = value.decode("utf-8", "replace")
+        elif field == 4 and wire == 2:
+            display = value.decode("utf-8", "replace")
+    # display_name carries the full HLO op text when present ("%fusion.3
+    # = f32[...] fusion(...)"); name alone is the short identifier.
+    return meta_id, display or name
+
+
+def _parse_plane(buf: bytes) -> _Plane:
+    name = ""
+    line_bufs: list[bytes] = []
+    metadata: dict[int, str] = {}
+    for field, wire, value in _fields(buf):
+        if field == 2 and wire == 2:
+            name = value.decode("utf-8", "replace")
+        elif field == 3 and wire == 2:
+            line_bufs.append(value)
+        elif field == 4 and wire == 2:
+            # map<int64, XEventMetadata> entry: key=1, value=2.
+            for mfield, mwire, mvalue in _fields(value):
+                if mfield == 2 and mwire == 2:
+                    meta_id, meta_name = _parse_event_metadata(mvalue)
+                    metadata[meta_id] = meta_name
+    lines = []
+    for line_buf in line_bufs:
+        line_name = ""
+        display_name = ""
+        events = []
+        for field, wire, value in _fields(line_buf):
+            if field == 11 and wire == 2:
+                line_name = value.decode("utf-8", "replace")
+            elif field == 12 and wire == 2:
+                display_name = value.decode("utf-8", "replace")
+            elif field == 4 and wire == 2:
+                metadata_id = 0
+                duration_ps = 0
+                for efield, ewire, evalue in _fields(value):
+                    if efield == 1 and ewire == 0:
+                        metadata_id = evalue
+                    elif efield == 3 and ewire == 0:
+                        duration_ps = evalue
+                events.append(
+                    _Event(
+                        metadata.get(metadata_id, str(metadata_id)),
+                        duration_ps / 1e3,
+                    )
+                )
+        lines.append(_Line(display_name or line_name, events))
+    return _Plane(name, lines)
+
+
+class _XSpaceFile:
+    """ProfileData-shaped view over one .xplane.pb, parsed by hand."""
+
+    def __init__(self, path: str):
+        with open(path, "rb") as f:
+            buf = f.read()
+        self.planes = [
+            _parse_plane(value)
+            for field, wire, value in _fields(buf)
+            if field == 1 and wire == 2
+        ]
+
+
+def _load_profile(path: str):
+    try:
+        from jax.profiler import ProfileData
+    except ImportError:
+        return _XSpaceFile(path)
+    return ProfileData.from_file(path)
 
 
 def find_xplanes(root: str) -> list[str]:
@@ -93,9 +257,7 @@ def categorize(name: str) -> str:
 
 
 def summarize(path: str, top_n: int = 30) -> dict:
-    from jax.profiler import ProfileData
-
-    data = ProfileData.from_file(path)
+    data = _load_profile(path)
     planes = []
     device_best = None  # preferred: a TPU/device-named plane
     any_best = None  # fallback: busiest non-metadata plane (CPU runs)

@@ -65,6 +65,16 @@ if [ "$TESTS" = 1 ]; then
       -q -m 'not slow' -p no:cacheprovider; then
     status=1
   fi
+
+  echo "== chaos: deterministic fault-plan + crash-consistency suite (tier-1) =="
+  # Seeded fault plans only (testing/chaos.py): replica kill / straggler /
+  # corrupt-reply routing, and SIGKILL-mid-orbax-save recovery with the
+  # bitwise-replay check. No wall-clock assertions, no injected sleep > 1s.
+  if ! JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py tests/test_fleet.py \
+      tests/test_crash_consistency.py \
+      -q -m 'not slow' -p no:cacheprovider; then
+    status=1
+  fi
 fi
 
 if [ "$status" = 0 ]; then
