@@ -159,15 +159,16 @@ def _init_devices(max_wait: float = 600.0, probe_timeout: float = 150.0):
 def _enable_compilation_cache() -> None:
     """Persistent compilation cache: the flagship step takes minutes to
     compile on the tunnel backend; caching it makes bench re-runs (and the
-    driver's end-of-round run) start measuring in seconds. Best-effort —
-    experimental backends may not support it."""
-    import jax
-
+    driver's end-of-round run) start measuring in seconds. Routed through
+    the serving-side switch so T2R_COMPILE_CACHE_DIR overrides the bench
+    default dir. Best-effort — experimental backends may not support it."""
     try:
-        jax.config.update(
-            "jax_compilation_cache_dir", "/tmp/t2r_jax_cache"
+        from tensor2robot_tpu.serving.compile_cache import (
+            enable_compile_cache,
         )
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+        if enable_compile_cache() is None:  # flag unset -> bench default
+            enable_compile_cache("/tmp/t2r_jax_cache")
     except Exception:
         pass
 
@@ -1886,6 +1887,140 @@ def bench_serve(args) -> None:
             }
         )
 
+        # -- quant legs (BENCH_SERVE_r11): the SAME trained weights
+        # exported with blockwise fp16/int8 serve-quant payloads, served
+        # through the same policy-server topology per regime. Metrics:
+        # bytes-of-param (the restore/deploy cost a replica fleet pays
+        # per version) and saturated req/s (dequant runs inside every
+        # dispatched program, so its cost is visible here; on a CPU
+        # proxy there are no int8 matmul units, so the bytes win is the
+        # expected headline and req/s is reported with attribution
+        # either way).
+        quant_detail = None
+        if not args.no_quant:
+            from tensor2robot_tpu import flags as t2r_flags
+            from tensor2robot_tpu.export.exporters import LatestExporter
+            from tensor2robot_tpu.export.saved_model import (
+                latest_export_dir,
+                quant_payload_relpath,
+            )
+            from tensor2robot_tpu.predictors.exported_savedmodel_predictor import (
+                ExportedSavedModelPredictor,
+            )
+
+            quant_exporter = LatestExporter(
+                name="quant", warmup_batch_sizes=buckets,
+                serve_quant=("fp16", "int8"),
+            )
+            quant_exporter.maybe_export(
+                step=1, state=state, eval_metrics={"loss": 1.0},
+                compiled=compiled, model_dir=tmpdir.name,
+            )
+            quant_root = quant_exporter.export_root(tmpdir.name)
+            quant_path = latest_export_dir(quant_root)
+
+            def _dir_bytes(root):
+                total = 0
+                for base, _dirs, files in os.walk(root):
+                    total += sum(
+                        os.path.getsize(os.path.join(base, name))
+                        for name in files
+                    )
+                return total
+
+            with open(
+                os.path.join(quant_path, "t2r_metadata.json")
+            ) as meta_f:
+                quant_meta = json.load(meta_f)["serve_quant"]
+            fp32_params_bytes = os.path.getsize(
+                os.path.join(quant_path, "variables.msgpack")
+            )
+            saved_regime = t2r_flags.read_raw("T2R_SERVE_QUANT")
+            regimes = {}
+            try:
+                for regime in ("none", "fp16", "int8"):
+                    t2r_flags.write_env("T2R_SERVE_QUANT", regime)
+                    quant_predictor = ExportedSavedModelPredictor(
+                        export_dir=quant_root
+                    )
+                    if not quant_predictor.restore():
+                        raise RuntimeError(
+                            f"quant leg: restore failed for {regime}"
+                        )
+                    t_restore0 = time.monotonic()
+                    quant_server = PolicyServer(
+                        quant_predictor, max_queue=args.burst + 8,
+                        max_wait_ms=2, default_deadline_ms=120000,
+                    ).start(prewarm=True)
+                    prewarm_s = time.monotonic() - t_restore0
+                    try:
+                        run_burst(quant_server, args.burst // 2)  # warm-in
+                        regime_rates = sorted(
+                            run_burst(quant_server, args.burst)
+                            for _ in range(3)
+                        )
+                        served = quant_server.snapshot()["serve_quant"]
+                        if served != regime:
+                            raise RuntimeError(
+                                f"quant leg served regime {served!r}, "
+                                f"wanted {regime!r}"
+                            )
+                    finally:
+                        # A failed leg must not leak the dispatcher/
+                        # monitor threads into the rest of the bench.
+                        quant_server.stop()
+                    params_bytes = (
+                        fp32_params_bytes
+                        if regime == "none"
+                        else os.path.getsize(
+                            os.path.join(
+                                quant_path, quant_payload_relpath(regime)
+                            )
+                        )
+                    )
+                    regimes[regime] = {
+                        "saturated_hz": round(regime_rates[1], 2),
+                        "burst_rates_hz": [
+                            round(rate, 2) for rate in regime_rates
+                        ],
+                        "params_bytes": params_bytes,
+                        "params_bytes_reduction_x": round(
+                            fp32_params_bytes / params_bytes, 3
+                        ),
+                        "prewarm_s": round(prewarm_s, 3),
+                        **(
+                            {
+                                "parity_recorded": quant_meta["parity"][
+                                    regime
+                                ],
+                            }
+                            if regime != "none"
+                            else {}
+                        ),
+                    }
+            finally:
+                t2r_flags.restore_env("T2R_SERVE_QUANT", saved_regime)
+            int8_x = regimes["int8"]["params_bytes_reduction_x"]
+            int8_speed = (
+                regimes["int8"]["saturated_hz"]
+                / max(regimes["none"]["saturated_hz"], 1e-9)
+            )
+            quant_detail = {
+                "regimes": regimes,
+                "artifact_bytes_total": _dir_bytes(quant_path),
+                "int8_params_bytes_reduction_x": int8_x,
+                "int8_reduction_target": 3.5,
+                "int8_req_s_vs_none_x": round(int8_speed, 3),
+                "req_s_attribution": (
+                    "CPU proxy: no int8 compute units, dequant traced "
+                    "into every dispatched program — req/s reflects "
+                    "host dispatch + fp32 compute + dequant, so the "
+                    "bytes-of-param reduction (restore/deploy cost) is "
+                    "the expected win on this host; on TPU the smaller "
+                    "weight reads are the throughput lever."
+                ),
+            }
+
         tmpdir.cleanup()
         payload = {
             "metric": metric,
@@ -1914,6 +2049,7 @@ def bench_serve(args) -> None:
                 ],
                 "open_loop": legs,
                 "hot_swap": swap_leg,
+                **({"quant": quant_detail} if quant_detail else {}),
                 "deadline_ms": args.deadline_ms,
                 "max_wait_ms": args.max_wait_ms,
                 "host_cpus": os.cpu_count(),
@@ -2010,7 +2146,8 @@ def bench_fleet(args) -> None:
 
         # -- closed-loop capacity: keep the fabric saturated for a
         # window; completed/elapsed is what the router can actually move.
-        def measure_capacity(router, secs):
+        def measure_capacity(router, secs, request_fn=None):
+            request_fn = request_fn or request
             done = []
             t0 = time.monotonic()
             outstanding = 0
@@ -2026,7 +2163,7 @@ def bench_fleet(args) -> None:
 
             while time.monotonic() - t0 < secs:
                 try:
-                    future = router.submit(request(), deadline_ms=10_000)
+                    future = router.submit(request_fn(), deadline_ms=10_000)
                 except FleetError:
                     with cv:
                         cv.wait(0.005)
@@ -2221,6 +2358,121 @@ def bench_fleet(args) -> None:
             + swap_leg["lost"]
         )
 
+        # ---- leg 4 (r11): mixed-precision POLICY-backend fleet. Real
+        # PolicyServer replicas over one serve-quant export — replica 0
+        # serves T2R_SERVE_QUANT=none, the rest int8 (a mid-rollout
+        # fleet). The router's health snapshots must report the regime
+        # per replica (mix-verification), and the mixed fabric must move
+        # traffic with zero lost requests.
+        quant_leg = None
+        if args.quant_replicas > 0:
+            import tempfile
+
+            import jax
+
+            from tensor2robot_tpu.export.exporters import LatestExporter
+            from tensor2robot_tpu.export.saved_model import (
+                latest_export_dir,
+                quant_payload_relpath,
+            )
+            from tensor2robot_tpu.serving import policy_server_factory
+            from tensor2robot_tpu.train.train_eval import CompiledModel
+            from tensor2robot_tpu.utils.mocks import (
+                MockInputGenerator,
+                MockT2RModel,
+            )
+
+            qtmp = tempfile.TemporaryDirectory(prefix="bench_fleet_quant_")
+            try:
+                model = MockT2RModel(device_type="cpu")
+                generator = MockInputGenerator(batch_size=8)
+                generator.set_specification_from_model(model, "train")
+                batches = iter(generator.create_dataset("train"))
+                compiled = CompiledModel(model, donate_state=False)
+                state = compiled.init_state(
+                    jax.random.PRNGKey(0), next(batches)
+                )
+                exporter = LatestExporter(
+                    name="latest", warmup_batch_sizes=(1, 4),
+                    serve_quant=("int8",),
+                )
+                exporter.maybe_export(
+                    step=1, state=state, eval_metrics={"loss": 1.0},
+                    compiled=compiled, model_dir=qtmp.name,
+                )
+                export_root = exporter.export_root(qtmp.name)
+                export_path = latest_export_dir(export_root)
+                qn = args.quant_replicas
+                specs = [
+                    ReplicaSpec(
+                        factory=policy_server_factory,
+                        factory_kwargs={
+                            "export_root": export_root, "max_wait_ms": 2,
+                        },
+                        env={
+                            "T2R_SERVE_QUANT": "none" if i == 0 else "int8",
+                            "JAX_PLATFORMS": "cpu",
+                        },
+                    )
+                    for i in range(qn)
+                ]
+                rng_q = np.random.RandomState(5)
+
+                def request_q():
+                    return {
+                        "x": rng_q.uniform(-1, 1, size=(3,)).astype(
+                            np.float32
+                        )
+                    }
+
+                with FleetRouter(
+                    specs, probe_interval_ms=200.0, probe_miss_limit=10,
+                    backoff_ms=10.0, seed=11, boot_timeout_s=600.0,
+                ).start(timeout_s=600.0) as router:
+                    wait_all_up(router, timeout=300.0)
+                    # Health snapshots carry serve_quant; wait for one
+                    # probe round so mix-verification reads real data.
+                    verify_deadline = time.monotonic() + 30
+                    while time.monotonic() < verify_deadline:
+                        replica_snaps = router.snapshot()["replicas"]
+                        if all(
+                            r["serve_quant"] is not None
+                            for r in replica_snaps
+                        ):
+                            break
+                        time.sleep(0.05)
+                    quant_capacity = measure_capacity(
+                        router, args.quant_secs, request_fn=request_q
+                    )
+                    quant_snapshot = router.snapshot()
+                regimes_seen = [
+                    r["serve_quant"] for r in quant_snapshot["replicas"]
+                ]
+                fp32_bytes = os.path.getsize(
+                    os.path.join(export_path, "variables.msgpack")
+                )
+                int8_bytes = os.path.getsize(
+                    os.path.join(export_path, quant_payload_relpath("int8"))
+                )
+                quant_leg = {
+                    "replicas": qn,
+                    "backend": "policy_server_processes",
+                    "closed_loop_capacity_hz": round(quant_capacity, 2),
+                    "replica_serve_quant": regimes_seen,
+                    "mixed_fleet_verified": (
+                        regimes_seen[0] == "none"
+                        and all(r == "int8" for r in regimes_seen[1:])
+                    ),
+                    "export_fp32_params_bytes": fp32_bytes,
+                    "export_int8_params_bytes": int8_bytes,
+                    "int8_params_bytes_reduction_x": round(
+                        fp32_bytes / int8_bytes, 3
+                    ),
+                }
+            finally:
+                # A failed leg must still remove the export tree.
+                qtmp.cleanup()
+
         chaos_ok = (
             chaos_leg["lost"] == 0
             and chaos_leg["availability"] > 0
@@ -2264,6 +2516,7 @@ def bench_fleet(args) -> None:
                     "version_before": version_before,
                     "version_after": version_after,
                 },
+                **({"quant": quant_leg} if quant_leg else {}),
                 "backend": "mock_replica_processes",
                 "host_cpus": os.cpu_count(),
             },
@@ -2985,7 +3238,12 @@ def _build_cli():
         help="micro-batcher coalesce window (default %(default)s)",
     )
     serve.add_argument(
-        "--out", default="BENCH_SERVE_r08.json",
+        "--no-quant", action="store_true",
+        help="skip the serve-quant regime legs (none/fp16/int8 req/s + "
+             "bytes-of-param comparison)",
+    )
+    serve.add_argument(
+        "--out", default="BENCH_SERVE_r11.json",
         help="also write the payload to this file ('' disables; "
              "default %(default)s)",
     )
@@ -3025,7 +3283,17 @@ def _build_cli():
              "fault-free twin leg's (default %(default)s)",
     )
     fleet.add_argument(
-        "--out", default="BENCH_FLEET_r10.json",
+        "--quant-replicas", type=int, default=2,
+        help="replica count for the mixed-precision policy-backend leg; "
+             "0 skips it (default %(default)s)",
+    )
+    fleet.add_argument(
+        "--quant-secs", type=float, default=1.5,
+        help="closed-loop window of the mixed-precision leg "
+             "(default %(default)s)",
+    )
+    fleet.add_argument(
+        "--out", default="BENCH_FLEET_r11.json",
         help="also write the payload to this file ('' disables; "
              "default %(default)s)",
     )

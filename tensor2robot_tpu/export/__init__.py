@@ -18,6 +18,10 @@ from tensor2robot_tpu.export.quantization import (
     dequantize_variables,
     quantize_variables,
 )
+from tensor2robot_tpu.export.serve_quant import (
+    SERVE_QUANT_REGIMES,
+    QuantParityError,
+)
 from tensor2robot_tpu.export.saved_model import (
     ExportedModel,
     is_valid_export_dir,

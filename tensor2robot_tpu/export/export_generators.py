@@ -148,6 +148,63 @@ class AbstractExportGenerator:
 
         return serving_fn
 
+    def create_quant_serving_fn(
+        self,
+        compiled,
+        variables,
+        regime: str,
+        block: Optional[int] = None,
+        min_size: Optional[int] = None,
+        calibration: Optional[Mapping[str, float]] = None,
+    ) -> Callable[..., Dict[str, Any]]:
+        """Blockwise low-precision serving fn: `(payload, flat_features)`.
+
+        The payload is the regime's blockwise-scaled tree
+        (export/serve_quant.py, the gradient collectives' wire format
+        reused forward); dequant + activation fake-quant are jnp ops
+        INSIDE the returned function, so tracing it (per-regime StableHLO
+        artifact) fuses them with the forward pass, and — like the
+        weights-as-arguments int8 path above — the artifact embeds no
+        weight constants at all. Attributes on the returned fn carry the
+        export-side bookkeeping: `.quant_payload` (exemplar/storage
+        tree), `.quant_layout`, `.quant_regime`, `.quant_block`,
+        `.quant_calibration`.
+        """
+        import jax
+
+        from tensor2robot_tpu.export import serve_quant
+
+        preprocessor = self._preprocessor
+        raw = self._export_raw_receivers
+        block = serve_quant.DEFAULT_BLOCK if block is None else int(block)
+        min_size = (
+            serve_quant.DEFAULT_MIN_SIZE if min_size is None else int(min_size)
+        )
+        calibration = dict(calibration or {})
+        payload, layout = serve_quant.quantize_tree(
+            jax.device_get(variables), regime, block=block, min_size=min_size
+        )
+
+        def serving_fn(quant_payload, flat_features):
+            features = serve_quant.fake_quant_activations(
+                dict(flat_features), calibration, regime
+            )
+            features = TensorSpecStruct(features)
+            if not raw:
+                features, _ = preprocessor.preprocess(
+                    features, None, mode="predict", rng=None
+                )
+            bound = serve_quant.dequantize_tree(quant_payload, layout, regime)
+            outputs = compiled.predict_step(bound, features)
+            return dict(flatten_spec_structure(outputs).items())
+
+        serving_fn.quant_payload = payload
+        serving_fn.quant_layout = layout
+        serving_fn.quant_regime = regime
+        serving_fn.quant_block = block
+        serving_fn.quant_calibration = calibration
+        return serving_fn
+
     def create_example_features(self, batch_size: int = 1) -> Dict[str, Any]:
         """ShapeDtypeStruct exemplars of the serving inputs for tracing."""
         flat = make_example_args(self.serving_input_spec(), batch_size=batch_size)
@@ -167,25 +224,53 @@ class AbstractExportGenerator:
 
         return parse_fn
 
-    def create_warmup_requests_numpy(
-        self, batch_sizes: Sequence[int], export_dir: str
+    def generate_warmup_batches(
+        self, batch_sizes: Sequence[int]
+    ) -> List[Dict[str, np.ndarray]]:
+        """One flat spec-conforming random batch per requested size, in
+        ladder order — the SAME arrays export-time calibration/parity run
+        over and `write_warmup_requests` later persists, so the recorded
+        parity is measured on exactly the corpus the artifact ships."""
+        spec = self.serving_input_spec()
+        return [
+            dict(
+                flatten_spec_structure(
+                    make_random_numpy(spec, batch_size=batch_size)
+                ).items()
+            )
+            for batch_size in batch_sizes
+        ]
+
+    def write_warmup_requests(
+        self, batches: Sequence[Mapping[str, np.ndarray]], export_dir: str
     ) -> str:
-        """Writes spec-conforming random request batches; returns the path
-        (reference abstract_export_generator.py:109-142)."""
+        """Persists pre-generated warmup batches as the tf.Example
+        TFRecord servers prewarm from; returns the path."""
         spec = self.serving_input_spec()
         warmup_dir = os.path.join(export_dir, WARMUP_DIR)
         os.makedirs(warmup_dir, exist_ok=True)
         path = os.path.join(warmup_dir, WARMUP_FILENAME)
         records: List[bytes] = []
-        for batch_size in batch_sizes:
-            batch = make_random_numpy(spec, batch_size=batch_size)
+        for batch in batches:
+            batch_size = next(
+                int(np.asarray(value).shape[0]) for value in batch.values()
+            )
             for i in range(batch_size):
                 row = TensorSpecStruct()
                 for key, value in batch.items():
-                    row[key] = value[i]
+                    row[key] = np.asarray(value)[i]
                 records.append(encoder_lib.encode_example(spec, row))
         tfrecord.write_tfrecords(path, records)
         return path
+
+    def create_warmup_requests_numpy(
+        self, batch_sizes: Sequence[int], export_dir: str
+    ) -> str:
+        """Writes spec-conforming random request batches; returns the path
+        (reference abstract_export_generator.py:109-142)."""
+        return self.write_warmup_requests(
+            self.generate_warmup_batches(batch_sizes), export_dir
+        )
 
 
 @configurable("DefaultExportGenerator")

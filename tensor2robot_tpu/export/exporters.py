@@ -87,6 +87,10 @@ class Exporter:
         warmup_batch_sizes: Sequence[int] = (),
         quantize_weights: bool = False,
         quantize_bits: int = 8,
+        serve_quant: Sequence[str] = (),
+        quant_block: Optional[int] = None,
+        quant_min_size: Optional[int] = None,
+        quant_parity_tol: Optional[Dict[str, float]] = None,
     ):
         self.name = name
         self._export_generator = export_generator or DefaultExportGenerator()
@@ -102,6 +106,41 @@ class Exporter:
             )
         self._quantize_weights = quantize_weights
         self._quantize_bits = quantize_bits
+        # Low-precision SERVING regimes (export/serve_quant.py): each
+        # export also carries blockwise fp16/int8 payloads + per-regime
+        # serving programs, calibrated and parity-gated against the
+        # artifact's own warmup corpus. Config-time validation: a typo'd
+        # regime or a missing calibration corpus must fail here, not on
+        # the first export tick minutes into a run.
+        from tensor2robot_tpu.export.serve_quant import SERVE_QUANT_REGIMES
+
+        self._serve_quant = tuple(serve_quant)
+        for regime in self._serve_quant:
+            if regime not in SERVE_QUANT_REGIMES:
+                raise ValueError(
+                    f"serve_quant regimes must be among "
+                    f"{SERVE_QUANT_REGIMES}, got {regime!r}"
+                )
+        if self._serve_quant and not self._warmup_batch_sizes:
+            raise ValueError(
+                "serve_quant exports need warmup_batch_sizes: the warmup "
+                "corpus is the calibration set and the parity-gate corpus."
+            )
+        if self._serve_quant and quantize_weights:
+            raise ValueError(
+                "serve_quant cannot combine with quantize_weights: the "
+                "parity gate needs the fp32 forward as its baseline."
+            )
+        if self._serve_quant and not serialize_stablehlo:
+            raise ValueError(
+                "serve_quant requires serialize_stablehlo=True: without "
+                "the per-regime serving programs the quantized payloads "
+                "can never be served (every T2R_SERVE_QUANT restore "
+                "would fail fleet-wide at deploy time)."
+            )
+        self._quant_block = quant_block
+        self._quant_min_size = quant_min_size
+        self._quant_parity_tol = dict(quant_parity_tol or {})
 
     def export_root(self, model_dir: str) -> str:
         return os.path.join(model_dir, "export", self.name)
@@ -135,6 +174,32 @@ class Exporter:
             compiled, variables, quantize_weights=self._quantize_weights,
             quantize_bits=self._quantize_bits,
         )
+        # The warmup corpus is generated BEFORE the export so the quant
+        # calibration + parity gate run over the exact batches the
+        # artifact will ship as warmup_requests.tfrecord.
+        warmup_batches = (
+            generator.generate_warmup_batches(self._warmup_batch_sizes)
+            if self._warmup_batch_sizes
+            else []
+        )
+        serve_quant_fns = None
+        if self._serve_quant:
+            from tensor2robot_tpu.export.serve_quant import (
+                calibrate_activations,
+            )
+
+            calibration = calibrate_activations(warmup_batches)
+            serve_quant_fns = {
+                regime: generator.create_quant_serving_fn(
+                    compiled,
+                    variables,
+                    regime=regime,
+                    block=self._quant_block,
+                    min_size=self._quant_min_size,
+                    calibration=calibration,
+                )
+                for regime in self._serve_quant
+            }
         path = save_exported_model(
             root,
             variables=variables,
@@ -154,9 +219,12 @@ class Exporter:
             },
             quantize_weights=self._quantize_weights,
             quantize_bits=self._quantize_bits,
+            serve_quant_fns=serve_quant_fns,
+            quant_parity_tol=self._quant_parity_tol,
+            calibration_batches=warmup_batches,
         )
-        if self._warmup_batch_sizes:
-            generator.create_warmup_requests_numpy(self._warmup_batch_sizes, path)
+        if warmup_batches:
+            generator.write_warmup_requests(warmup_batches, path)
         self._after_export(step, eval_metrics, root, path)
         self._gc.collect(root)
         return path
@@ -219,6 +287,8 @@ def create_default_exporters(
     warmup_batch_sizes: Sequence[int] = (),
     quantize_weights: bool = False,
     quantize_bits: int = 8,
+    serve_quant: Sequence[str] = (),
+    quant_parity_tol: Optional[Dict[str, float]] = None,
 ) -> List[Exporter]:
     """latest + best exporter pair (reference create_default_exporters,
     train_eval.py:295-385; one artifact serves both the numpy and tf.Example
@@ -234,6 +304,8 @@ def create_default_exporters(
             warmup_batch_sizes=warmup_batch_sizes,
             quantize_weights=quantize_weights,
             quantize_bits=quantize_bits,
+            serve_quant=serve_quant,
+            quant_parity_tol=quant_parity_tol,
         ),
         BestExporter(
             name="best",
@@ -244,5 +316,7 @@ def create_default_exporters(
             warmup_batch_sizes=warmup_batch_sizes,
             quantize_weights=quantize_weights,
             quantize_bits=quantize_bits,
+            serve_quant=serve_quant,
+            quant_parity_tol=quant_parity_tol,
         ),
     ]

@@ -46,6 +46,18 @@ METADATA_FILENAME = "t2r_metadata.json"
 VARIABLES_FILENAME = "variables.msgpack"
 STABLEHLO_DIR = "stablehlo"
 STABLEHLO_FILENAME = "predict_fn.bin"
+QUANT_DIR = "quant"
+
+
+def quant_payload_relpath(regime: str) -> str:
+    """Artifact-relative path of a regime's blockwise-quantized params."""
+    return os.path.join(QUANT_DIR, f"params_{regime}.msgpack")
+
+
+def quant_stablehlo_relpath(regime: str) -> str:
+    """Artifact-relative path of a regime's serving program (payload-as-
+    arguments: dequant is traced in, no weight constants embedded)."""
+    return os.path.join(STABLEHLO_DIR, f"predict_fn_{regime}.bin")
 
 
 def is_valid_export_dir(path: str) -> bool:
@@ -95,6 +107,9 @@ def save_exported_model(
     metadata: Optional[Dict[str, Any]] = None,
     quantize_weights: bool = False,
     quantize_bits: int = 8,
+    serve_quant_fns: Optional[Mapping[str, Callable]] = None,
+    quant_parity_tol: Optional[Mapping[str, float]] = None,
+    calibration_batches: Optional[Sequence[Mapping[str, Any]]] = None,
 ) -> str:
     """Writes one export version; returns its final path.
 
@@ -119,7 +134,88 @@ def save_exported_model(
         quantized StableHLO artifact, build predict_fn through
         `create_serving_fn(..., quantize_weights=True)` — the artifact
         embeds its own weight constants independently of this flag.
+      serve_quant_fns: {regime: serving fn} from
+        `create_quant_serving_fn` (export/serve_quant.py blockwise
+        payloads). Each regime adds `quant/params_<regime>.msgpack` + a
+        payload-as-arguments `stablehlo/predict_fn_<regime>.bin`
+        alongside the UNTOUCHED default artifact, and MUST pass the
+        export-time parity gate over `calibration_batches` or this call
+        raises QuantParityError and writes nothing.
+      quant_parity_tol: per-regime max-abs-divergence gate overrides
+        (defaults serve_quant.DEFAULT_PARITY_TOL).
+      calibration_batches: flat numpy feature batches (the warmup
+        corpus) the parity gate replays; required with serve_quant_fns.
     """
+    variables_in_args = getattr(predict_fn, "variables_in_args", None)
+    serve_quant_meta = None
+    quant_payload_bytes: Dict[str, bytes] = {}
+    if serve_quant_fns:
+        from tensor2robot_tpu.export import serve_quant as sq
+
+        if variables_in_args is not None:
+            raise ValueError(
+                "serve_quant_fns cannot combine with a weights-as-arguments "
+                "predict_fn (quantize_weights=True): the parity gate needs "
+                "the fp32 forward as its baseline."
+            )
+        if predict_fn is None:
+            raise ValueError(
+                "serve-quant export requires predict_fn (the fp32 forward "
+                "is the parity baseline)."
+            )
+        if not calibration_batches:
+            raise ValueError(
+                "serve-quant export requires calibration_batches — the "
+                "artifact's own warmup corpus is the calibration/parity "
+                "contract (export warmup_batch_sizes)."
+            )
+        tolerance = dict(sq.DEFAULT_PARITY_TOL)
+        tolerance.update(dict(quant_parity_tol or {}))
+        fp32_outputs = [
+            {k: np.asarray(v) for k, v in predict_fn(batch).items()}
+            for batch in calibration_batches
+        ]
+        serve_quant_meta = {
+            "regimes": sorted(serve_quant_fns),
+            "block": {},
+            "calibration": {},
+            "layout": {},
+            "parity": {},
+            "payload_bytes": {},
+            "stablehlo": {},
+        }
+        for regime in sorted(serve_quant_fns):
+            fn = serve_quant_fns[regime]
+            quant_outputs = [
+                {
+                    k: np.asarray(v)
+                    for k, v in fn(fn.quant_payload, batch).items()
+                }
+                for batch in calibration_batches
+            ]
+            divergence = sq.measure_parity(fp32_outputs, quant_outputs)
+            # The gate: a regime that cannot match the fp32 forward on
+            # the artifact's own corpus fails the WHOLE export, loudly,
+            # before any directory exists.
+            sq.check_parity(regime, divergence, tolerance[regime])
+            serve_quant_meta["block"][regime] = int(fn.quant_block)
+            serve_quant_meta["calibration"][regime] = {
+                k: float(v) for k, v in fn.quant_calibration.items()
+            }
+            serve_quant_meta["layout"][regime] = fn.quant_layout
+            serve_quant_meta["parity"][regime] = {
+                "tolerance": float(tolerance[regime]),
+                "max_divergence": {
+                    k: float(v) for k, v in sorted(divergence.items())
+                },
+            }
+            serve_quant_meta["payload_bytes"][regime] = sq.payload_nbytes(
+                fn.quant_payload
+            )
+            quant_payload_bytes[regime] = serialization.to_bytes(
+                _to_plain(fn.quant_payload)
+            )
+
     os.makedirs(export_root, exist_ok=True)
     final_name = _unique_timestamp_dir(export_root)
     tmp_path = os.path.join(export_root, TMP_DIR_PREFIX + final_name)
@@ -136,7 +232,6 @@ def save_exported_model(
     # quantized tree (weights-as-arguments; see create_serving_fn) — store
     # exactly that tree so the artifact's argument contract matches the
     # variables file bit-for-bit.
-    variables_in_args = getattr(predict_fn, "variables_in_args", None)
     if variables_in_args is not None:
         stored_variables = _to_plain(variables_in_args)
         quantize_weights = True
@@ -171,6 +266,36 @@ def save_exported_model(
             # variables + assets path below always works, so record and move on.
             stablehlo_error = f"{type(e).__name__}: {e}"
 
+    if serve_quant_meta is not None:
+        quant_dir = os.path.join(tmp_path, QUANT_DIR)
+        os.makedirs(quant_dir, exist_ok=True)
+        for regime, payload_bytes in quant_payload_bytes.items():
+            with open(
+                os.path.join(tmp_path, quant_payload_relpath(regime)), "wb"
+            ) as f:
+                f.write(payload_bytes)
+        if serialize_stablehlo and example_features is not None:
+            for regime in sorted(serve_quant_fns):
+                fn = serve_quant_fns[regime]
+                try:
+                    artifact = _export_stablehlo(
+                        fn, example_features, variables_in_args=fn.quant_payload
+                    )
+                    hlo_dir = os.path.join(tmp_path, STABLEHLO_DIR)
+                    os.makedirs(hlo_dir, exist_ok=True)
+                    with open(
+                        os.path.join(tmp_path, quant_stablehlo_relpath(regime)),
+                        "wb",
+                    ) as f:
+                        f.write(artifact)
+                    serve_quant_meta["stablehlo"][regime] = True
+                except Exception as e:  # noqa: BLE001 — same best-effort rule
+                    # as the default artifact: record why, keep exporting.
+                    serve_quant_meta["stablehlo"][regime] = False
+                    serve_quant_meta.setdefault("stablehlo_error", {})[
+                        regime
+                    ] = f"{type(e).__name__}: {e}"
+
     meta = {
         "global_step": int(global_step),
         "timestamp": int(os.path.basename(final_path)),
@@ -186,6 +311,11 @@ def save_exported_model(
             else {}
         ),
         "stablehlo_weights_in_args": variables_in_args is not None,
+        # Low-precision serving contract (absent when no regimes were
+        # exported): regimes, block sizes, calibration clip ranges, the
+        # MEASURED parity vs fp32 on the warmup corpus and the gate it
+        # passed — a router fleet mix-verifies versions off this record.
+        **({"serve_quant": serve_quant_meta} if serve_quant_meta else {}),
         "format_version": 1,
     }
     if metadata:
@@ -253,24 +383,52 @@ def _export_stablehlo(
 
 
 class ExportedModel:
-    """A loaded export version: specs + variables (+ StableHLO callable)."""
+    """A loaded export version: specs + variables (+ StableHLO callable).
 
-    def __init__(self, export_dir: str):
+    quant_regime selects the low-precision serving path: 'fp16'/'int8'
+    load the regime's payload-as-arguments artifact + blockwise payload
+    (export/serve_quant.py); None reads the central T2R_SERVE_QUANT flag;
+    'none' is byte-for-byte the unquantized loader. A regime the artifact
+    was not exported with fails LOUDLY here — a fleet must never silently
+    fall back to fp32 when the operator asked for int8.
+    """
+
+    def __init__(self, export_dir: str, quant_regime: Optional[str] = None):
         self.export_dir = export_dir
         with open(os.path.join(export_dir, METADATA_FILENAME)) as f:
             self.metadata = json.load(f)
         self.feature_spec, self.label_spec, self.global_step = read_t2r_assets(
             export_dir
         )
+        if quant_regime is None:
+            from tensor2robot_tpu import flags as t2r_flags
+
+            quant_regime = t2r_flags.get_enum("T2R_SERVE_QUANT")
+        self.quant_regime = quant_regime
         self._stablehlo_call = None
         self._arg_variables = None
-        if self.metadata.get("stablehlo"):
-            self._stablehlo_call = self._load_stablehlo()
+        if quant_regime == "none":
+            if self.metadata.get("stablehlo"):
+                self._stablehlo_call = self._load_stablehlo(STABLEHLO_FILENAME)
+        else:
+            quant_meta = self.metadata.get("serve_quant") or {}
+            if quant_regime not in (quant_meta.get("regimes") or ()):
+                raise ValueError(
+                    f"T2R_SERVE_QUANT={quant_regime} but export "
+                    f"{export_dir} carries regimes "
+                    f"{quant_meta.get('regimes') or []}; re-export with "
+                    f"serve_quant=({quant_regime!r},) or serve it with "
+                    "T2R_SERVE_QUANT=none."
+                )
+            if quant_meta.get("stablehlo", {}).get(quant_regime):
+                self._stablehlo_call = self._load_stablehlo(
+                    f"predict_fn_{quant_regime}.bin"
+                )
 
-    def _load_stablehlo(self):
+    def _load_stablehlo(self, filename: str):
         from jax import export as jax_export
 
-        path = os.path.join(self.export_dir, STABLEHLO_DIR, STABLEHLO_FILENAME)
+        path = os.path.join(self.export_dir, STABLEHLO_DIR, filename)
         with open(path, "rb") as f:
             rehydrated = jax_export.deserialize(f.read())
         return rehydrated.call
@@ -295,9 +453,16 @@ class ExportedModel:
         when no StableHLO artifact exists."""
         if self._stablehlo_call is None:
             raise RuntimeError(
-                f"Export {self.export_dir} has no StableHLO artifact; "
-                "traced serving requires one "
+                f"Export {self.export_dir} has no StableHLO artifact for "
+                f"quant regime {self.quant_regime!r}; traced serving "
+                "requires one "
                 f"({self.metadata.get('stablehlo_error')})."
+            )
+        if self.quant_regime != "none":
+            # Payload-as-arguments serving: the int8/fp16 arrays are the
+            # weights on device; dequant was traced into the program.
+            return dict(
+                self._stablehlo_call(self._quant_payload(), flat_features)
             )
         if self.metadata.get("stablehlo_weights_in_args"):
             if self._arg_variables is None:
@@ -309,6 +474,22 @@ class ExportedModel:
                     )
             return dict(self._stablehlo_call(self._arg_variables, flat_features))
         return dict(self._stablehlo_call(flat_features))
+
+    def _quant_payload(self):
+        """The active regime's blockwise payload, loaded once and put on
+        device once — every predict reuses the SAME committed buffers, so
+        per-call cost is the program dispatch, not a host->device copy of
+        the weight set."""
+        if self._arg_variables is None:
+            with open(
+                os.path.join(
+                    self.export_dir, quant_payload_relpath(self.quant_regime)
+                ),
+                "rb",
+            ) as f:
+                restored = serialization.msgpack_restore(f.read())
+            self._arg_variables = jax.device_put(restored)
+        return self._arg_variables
 
     def load_variables(self, target: Optional[Mapping[str, Any]] = None):
         """Deserializes variables.msgpack; with `target`, restores into that

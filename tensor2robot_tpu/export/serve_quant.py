@@ -1,0 +1,327 @@
+"""Blockwise low-precision serving payloads: the gradient-collective wire
+format reused FORWARD, on the export -> predictor -> policy-server leg.
+
+PR 5 built blockwise per-block-max-abs quantization for the ZeRO-2
+gradient exchange (`parallel/collectives.py` BlockScaledCollective). The
+serving fleet moves the SAME bytes the other way: every replica restores
+every export version (bytes-of-param = restore latency x N replicas),
+and every predict dispatch reads the full weight set. This module
+re-applies the identical wire format to exported params:
+
+  * `quantize_tree` ravels each eligible float leaf, pads it to the
+    quantization block, and encodes it through the SAME
+    `BlockScaledCollective.encode` the gradient collectives transmit
+    with — one quantization codec in the codebase, not two;
+  * `dequantize_tree` is pure jnp (the collectives' decode), so it
+    traces INTO the exported serving program: the artifact carries int8/
+    fp16 payload constants-as-arguments and the dequant fuses with the
+    forward pass — no host-side dequant step, and prewarm / bucket
+    ladder / hot-swap see an ordinary serving fn;
+  * activation handling: int8 serving fake-quantizes the float serving
+    INPUTS against clip ranges calibrated over the artifact's own
+    warmup_requests.tfrecord corpus (symmetric, 99.9th-percentile
+    max-abs); fp16 casts activations through fp16. Both are traced into
+    the serving fn;
+  * `measure_parity` + `check_parity`: the export-time parity gate. The
+    quantized forward is run over the warmup corpus and its max
+    Q-value/action divergence vs the fp32 forward must pass the declared
+    tolerance or the export FAILS (QuantParityError) — a fleet can trust
+    that any artifact that exists has measured, recorded parity
+    (`t2r_metadata.json` serve_quant block).
+
+Regime names are the collective registry's ("fp16", "int8"); "none"
+never reaches this module — the unquantized path is untouched byte for
+byte.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensor2robot_tpu.parallel.collectives import get_collective
+
+__all__ = [
+    "QuantParityError",
+    "SERVE_QUANT_REGIMES",
+    "DEFAULT_BLOCK",
+    "DEFAULT_MIN_SIZE",
+    "DEFAULT_PARITY_TOL",
+    "Q_KEY",
+    "S_KEY",
+    "quantize_tree",
+    "dequantize_tree",
+    "calibrate_activations",
+    "fake_quant_activations",
+    "measure_parity",
+    "check_parity",
+    "payload_nbytes",
+    "tree_nbytes",
+]
+
+#: The serve-side regimes; the collective registry's quantized formats.
+SERVE_QUANT_REGIMES = ("fp16", "int8")
+
+#: Elements per scale. 512 matches the gradient collectives' default
+#: (T2R_COLLECTIVE_BLOCK): int8 = 1 B/elem + 4 B/block ~= 3.97x under f32.
+DEFAULT_BLOCK = 512
+
+#: Float leaves below this many elements stay f32 (a LayerNorm scale
+#: saves nothing and the padded block would often COST bytes).
+DEFAULT_MIN_SIZE = 16
+
+#: Export-time parity gate defaults: max |quant - fp32| over the warmup
+#: corpus, per flat output key. fp16 rounding is ~1e-3 relative; int8
+#: blockwise weight+activation rounding lands ~1e-2-1e-1 on O(1) heads.
+DEFAULT_PARITY_TOL = {"fp16": 1e-2, "int8": 2e-1}
+
+# Sentinel node keys in the stored payload tree (flax msgpack round-trips
+# the nesting unchanged, like export/quantization.py's weight-only nodes).
+Q_KEY = "__t2r_sq_q__"
+S_KEY = "__t2r_sq_s__"
+
+
+class QuantParityError(RuntimeError):
+    """The quantized serving fn diverged from the fp32 forward beyond the
+    declared tolerance on the warmup corpus; the export must not land."""
+
+
+def _is_payload_node(node: Any) -> bool:
+    return isinstance(node, Mapping) and Q_KEY in node and S_KEY in node
+
+
+def _leaf_block(size: int, block: int) -> int:
+    """Per-leaf block: the global block, except a leaf SMALLER than one
+    block is covered by a single leaf-sized block — padding a 100-element
+    bias out to 512 would store more bytes than f32 did."""
+    return block if size >= block else size
+
+
+def quantize_tree(
+    variables: Any,
+    regime: str,
+    block: int = DEFAULT_BLOCK,
+    min_size: int = DEFAULT_MIN_SIZE,
+) -> Tuple[Any, Dict[str, Dict[str, Any]]]:
+    """Encodes eligible float leaves through the regime's collective.
+
+    Returns (payload_tree, layout). The payload tree mirrors the input
+    nesting; each quantized leaf becomes {Q_KEY: encoded values, S_KEY:
+    per-block scales} (int8 values for 'int8', fp16 for 'fp16'); every
+    other leaf passes through untouched. `layout` maps the flat
+    '/'-joined leaf path to {'shape', 'size', 'block', 'padded'} — pure
+    Python ints, JSON-serializable, and the static metadata
+    `dequantize_tree` needs to reshape under tracing.
+    """
+    if regime not in SERVE_QUANT_REGIMES:
+        raise ValueError(
+            f"serve-quant regime must be one of {SERVE_QUANT_REGIMES}, "
+            f"got {regime!r}"
+        )
+    layout: Dict[str, Dict[str, Any]] = {}
+
+    def walk(node, path):
+        if isinstance(node, Mapping):
+            return {
+                key: walk(value, path + (key,)) for key, value in node.items()
+            }
+        leaf = np.asarray(node)
+        if not (
+            jnp.issubdtype(leaf.dtype, jnp.floating)
+            and leaf.size >= min_size
+        ):
+            return node
+        size = int(leaf.size)
+        leaf_block = _leaf_block(size, block)
+        padded = -(-size // leaf_block) * leaf_block
+        flat = leaf.astype(np.float32).reshape(-1)
+        if padded != size:
+            flat = np.pad(flat, (0, padded - size))
+        collective = get_collective(regime, leaf_block)
+        payload = collective.encode(jnp.asarray(flat))
+        layout["/".join(path)] = {
+            "shape": [int(d) for d in leaf.shape],
+            "size": size,
+            "block": leaf_block,
+            "padded": padded,
+        }
+        return {
+            Q_KEY: np.asarray(payload["q"]),
+            S_KEY: np.asarray(payload["s"]),
+        }
+
+    return walk(variables, ()), layout
+
+
+def dequantize_tree(
+    payload_tree: Any,
+    layout: Mapping[str, Mapping[str, Any]],
+    regime: str,
+    dtype=jnp.float32,
+) -> Any:
+    """Inverse of quantize_tree — pure jnp (the collectives' shared
+    BlockScaledCollective.decode), so it traces into a jitted/exported
+    serving fn where the payload arrives as arguments."""
+
+    def walk(node, path):
+        if _is_payload_node(node):
+            meta = layout["/".join(path)]
+            collective = get_collective(regime, int(meta["block"]))
+            flat = collective.decode(
+                {"q": jnp.asarray(node[Q_KEY]), "s": jnp.asarray(node[S_KEY])}
+            )
+            size = int(meta["size"])
+            shape = tuple(int(d) for d in meta["shape"])
+            return flat[:size].reshape(shape).astype(dtype)
+        if isinstance(node, Mapping):
+            return {
+                key: walk(value, path + (key,)) for key, value in node.items()
+            }
+        return node
+
+    return walk(payload_tree, ())
+
+
+# -- activation calibration ----------------------------------------------------
+
+
+def calibrate_activations(
+    batches: Sequence[Mapping[str, Any]],
+    percentile: float = 99.9,
+) -> Dict[str, float]:
+    """Per-feature symmetric clip ranges from the warmup corpus.
+
+    For each FLOAT serving input key, the clip is the given percentile of
+    |x| over every warmup batch (99.9th, not the max: one outlier pixel
+    must not stretch the int8 step for the whole feature). Non-float
+    inputs (token ids, masks) are never activation-quantized and get no
+    entry. Returns {flat_key: clip} with plain floats (JSON-able — the
+    calibration is recorded in t2r_metadata.json).
+    """
+    if not batches:
+        raise ValueError("calibration needs at least one warmup batch")
+    pools: Dict[str, List[np.ndarray]] = {}
+    for batch in batches:
+        for key, value in batch.items():
+            value = np.asarray(value)
+            if not np.issubdtype(value.dtype, np.floating):
+                continue
+            pools.setdefault(key, []).append(np.abs(value).reshape(-1))
+    calibration = {}
+    for key, chunks in pools.items():
+        pool = np.concatenate(chunks)
+        clip = float(np.percentile(pool, percentile))
+        # A degenerate all-zero feature still needs a usable step.
+        calibration[key] = clip if clip > 0 else 1.0
+    return calibration
+
+
+def fake_quant_activations(
+    features: Mapping[str, Any],
+    calibration: Mapping[str, float],
+    regime: str,
+) -> Dict[str, Any]:
+    """Traced activation quantization at the serving-input boundary.
+
+    int8: symmetric fake-quant against the calibrated clip (clip ->
+    round to 255 levels -> dequantize), so the traced forward sees
+    exactly the information an int8 wire carries. fp16: cast through
+    fp16 and back. Keys without a calibration entry (non-float inputs)
+    pass through untouched.
+    """
+    out = {}
+    for key, value in features.items():
+        clip = calibration.get(key)
+        if clip is None:
+            out[key] = value
+            continue
+        x = jnp.asarray(value)
+        if regime == "fp16":
+            out[key] = x.astype(jnp.float16).astype(x.dtype)
+        else:
+            step = jnp.asarray(clip / 127.0, x.dtype)
+            q = jnp.round(jnp.clip(x, -clip, clip) / step)
+            out[key] = q * step
+    return out
+
+
+# -- the parity gate -----------------------------------------------------------
+
+
+def measure_parity(
+    fp32_outputs: Sequence[Mapping[str, Any]],
+    quant_outputs: Sequence[Mapping[str, Any]],
+) -> Dict[str, float]:
+    """Max |quant - fp32| per flat output key over paired batches.
+
+    A non-finite delta (the quantized forward produced NaN/inf where the
+    fp32 one did not) is recorded as +inf: `max(0.0, nan)` is 0.0 in
+    Python, which would let a NaN-emitting artifact sail through the
+    gate with recorded parity 0 — the exact failure the gate exists to
+    stop."""
+    divergence: Dict[str, float] = {}
+    for ref, got in zip(fp32_outputs, quant_outputs):
+        for key in ref:
+            delta = float(
+                np.max(np.abs(np.asarray(got[key]) - np.asarray(ref[key])))
+            ) if np.asarray(ref[key]).size else 0.0
+            if not np.isfinite(delta):
+                delta = float("inf")
+            divergence[key] = max(divergence.get(key, 0.0), delta)
+    return divergence
+
+
+def check_parity(
+    regime: str,
+    divergence: Mapping[str, float],
+    tolerance: float,
+) -> None:
+    """Raises QuantParityError when any output key exceeds the gate."""
+    failing = {
+        key: value for key, value in divergence.items() if value > tolerance
+    }
+    if failing:
+        raise QuantParityError(
+            f"serve-quant {regime} parity gate FAILED: max divergence vs the "
+            f"fp32 forward over the warmup corpus exceeded the declared "
+            f"tolerance {tolerance:g} on "
+            + ", ".join(
+                f"{key}={value:.3g}" for key, value in sorted(failing.items())
+            )
+            + ". The export was aborted; loosen the exporter's "
+            "quant_parity_tol only with eval evidence, or drop the regime."
+        )
+
+
+# -- size accounting -----------------------------------------------------------
+
+
+def tree_nbytes(tree: Any) -> int:
+    """Sum of array payload bytes in a (possibly quantized) tree."""
+    return sum(
+        int(np.asarray(leaf).nbytes) for leaf in jax.tree_util.tree_leaves(tree)
+    )
+
+
+def payload_nbytes(payload_tree: Any) -> Dict[str, int]:
+    """{'values': bytes of encoded leaves, 'scales': bytes of scales,
+    'passthrough': bytes of untouched leaves} — the bytes-per-param
+    attribution the bench leg reports."""
+    counts = {"values": 0, "scales": 0, "passthrough": 0}
+
+    def walk(node):
+        if _is_payload_node(node):
+            counts["values"] += int(np.asarray(node[Q_KEY]).nbytes)
+            counts["scales"] += int(np.asarray(node[S_KEY]).nbytes)
+            return
+        if isinstance(node, Mapping):
+            for value in node.values():
+                walk(value)
+            return
+        counts["passthrough"] += int(np.asarray(node).nbytes)
+
+    walk(payload_tree)
+    return counts

@@ -133,6 +133,16 @@ _declare(
     choices=("none", "fp16", "int8"),
 )
 _declare(
+    "T2R_COMPILE_CACHE_DIR",
+    _STR,
+    None,
+    "JAX persistent compilation cache directory for serving processes "
+    "(serving/compile_cache.py): replica boot and hot-swap prewarm "
+    "compiles are served from disk on the second boot. Unset = no "
+    "persistent cache.",
+    "tensor2robot_tpu/serving/compile_cache.py",
+)
+_declare(
     "T2R_DECODE_CACHE_MB",
     _INT,
     512,
@@ -235,6 +245,17 @@ _declare(
     "Comma-separated batch-size bucket override for the policy server "
     "(unset = the export's warmup_batch_sizes).",
     "tensor2robot_tpu/serving/server.py",
+)
+_declare(
+    "T2R_SERVE_QUANT",
+    _ENUM,
+    "none",
+    "Low-precision serving regime for exported-artifact predictors: "
+    "fp16/int8 serve the export's blockwise-scaled quantized payload "
+    "(export/serve_quant.py) with dequant fused into the jitted serving "
+    "fn; none is bit-exact to the unquantized serving path.",
+    "tensor2robot_tpu/export/saved_model.py",
+    choices=("none", "fp16", "int8"),
 )
 _declare(
     "T2R_SERVE_DEADLINE_MS",

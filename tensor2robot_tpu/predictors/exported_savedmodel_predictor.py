@@ -165,6 +165,16 @@ class ExportedSavedModelPredictor(AbstractPredictor):
     def _build_predict_fn(self, loaded: ExportedModel) -> Callable:
         if loaded.has_stablehlo:
             return loaded.predict
+        if getattr(loaded, "quant_regime", "none") != "none":
+            # The model-code fallback rebuilds an fp32 forward — under a
+            # quant regime that would silently serve full precision where
+            # the operator asked for int8/fp16. Fail loudly instead.
+            raise ValueError(
+                f"Export {loaded.export_dir} has no serving program for "
+                f"quant regime {loaded.quant_regime!r} "
+                f"({(loaded.metadata.get('serve_quant') or {}).get('stablehlo_error')}); "
+                "re-export it or serve with T2R_SERVE_QUANT=none."
+            )
         if self._t2r_model is None:
             raise ValueError(
                 f"Export {loaded.export_dir} has no StableHLO artifact "
@@ -282,6 +292,16 @@ class ExportedSavedModelPredictor(AbstractPredictor):
     @property
     def model_path(self) -> Optional[str]:
         return None if self._loaded is None else self._loaded.export_dir
+
+    @property
+    def quant_regime(self) -> str:
+        """The low-precision serving regime of the LOADED artifact
+        ('none' before restore or when serving unquantized). Restore
+        resolves T2R_SERVE_QUANT when it constructs the ExportedModel,
+        so every version this predictor swaps in serves the same regime
+        — fleet snapshots report it per replica for mix-verification."""
+        loaded = self.loaded_model
+        return getattr(loaded, "quant_regime", "none") if loaded else "none"
 
     @property
     def restore_thread_leaked(self) -> bool:

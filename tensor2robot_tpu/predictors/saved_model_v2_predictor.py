@@ -165,6 +165,19 @@ class SavedModelCodePredictor(SavedModelPredictorBase):
         self._t2r_model = t2r_model
 
     def _build_predict_fn(self, loaded: ExportedModel) -> Callable:
+        if getattr(loaded, "quant_regime", "none") != "none":
+            # Model-code serving rebuilds an fp32 forward from the
+            # variables file — under T2R_SERVE_QUANT=int8/fp16 that would
+            # silently serve full precision where the operator asked for
+            # a quantized regime (the same loud-failure rule as
+            # ExportedSavedModelPredictor).
+            raise ValueError(
+                f"SavedModelCodePredictor serves fp32 model code and "
+                f"cannot honor quant regime {loaded.quant_regime!r}; "
+                "serve the export's quantized program with "
+                "ExportedSavedModelPredictor/SavedModelSignaturePredictor "
+                "or set T2R_SERVE_QUANT=none."
+            )
         predict_fn, _ = build_model_code_serving_fn(self._t2r_model, loaded)
         return predict_fn
 

@@ -51,6 +51,8 @@ _EXPORTS = {
     "ReplicaSpec": "replica",
     "policy_server_factory": "replica",
     "mock_server_factory": "replica",
+    # compile_cache.py — persistent XLA compile cache for replicas.
+    "enable_compile_cache": "compile_cache",
 }
 
 __all__ = sorted(_EXPORTS)
@@ -75,6 +77,9 @@ def __dir__():
 
 
 if TYPE_CHECKING:  # pragma: no cover — static analyzers only
+    from tensor2robot_tpu.serving.compile_cache import (  # noqa: F401
+        enable_compile_cache,
+    )
     from tensor2robot_tpu.serving.buckets import (  # noqa: F401
         buckets_from_metadata,
         pick_bucket,

@@ -1,0 +1,58 @@
+"""JAX persistent compilation cache for serving processes.
+
+Replica boot cost is dominated by per-bucket XLA compiles: a policy
+server prewarms every warmup bucket before it reports started, and a
+hot-swap prewarms them again on the incoming version. None of that work
+changes between boots of the same artifact on the same topology — it is
+exactly what jax's persistent compilation cache deduplicates. This
+module is the serving-side switch for it, behind the central
+`T2R_COMPILE_CACHE_DIR` flag: replica N's first boot pays the compiles
+and writes the cache; every later boot (respawns after a chaos kill,
+rolling-deploy restarts, fleet scale-ups on the same host image)
+deserializes instead of compiling.
+
+This is the down payment on the ROADMAP's AOT-serving item: same
+outcome (compile once per artifact, not once per process), without yet
+shipping serialized executables inside the export dir.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from tensor2robot_tpu import flags as t2r_flags
+
+__all__ = ["enable_compile_cache"]
+
+
+def enable_compile_cache(cache_dir: Optional[str] = None) -> Optional[str]:
+    """Points jax's persistent compilation cache at a directory.
+
+    Resolution: explicit `cache_dir` argument > `T2R_COMPILE_CACHE_DIR`
+    flag > disabled (returns None, no config touched — the bit-exact
+    default path). Returns the directory in effect. Every compile is
+    cacheable (min compile time 0): a replica fleet re-boots the same
+    buckets, so even sub-second entries pay for themselves by the second
+    process.
+    """
+    if cache_dir is None:
+        cache_dir = t2r_flags.get_str("T2R_COMPILE_CACHE_DIR")
+    if not cache_dir:
+        return None
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    # jax memoizes the cache's enabled/disabled state at the FIRST
+    # compile: a process that compiled anything before this call (model
+    # init, an eager export) has latched "disabled" and would silently
+    # ignore the config update. reset_cache() drops the memo so the next
+    # compile re-reads the directory we just set.
+    try:
+        from jax._src import compilation_cache as _compilation_cache
+    except ImportError:  # pragma: no cover - future jax relayout
+        _compilation_cache = None
+    reset = getattr(_compilation_cache, "reset_cache", None)
+    if reset is not None:
+        reset()
+    return cache_dir

@@ -259,8 +259,14 @@ def policy_server_factory(
     from tensor2robot_tpu.predictors.exported_savedmodel_predictor import (
         ExportedSavedModelPredictor,
     )
+    from tensor2robot_tpu.serving.compile_cache import enable_compile_cache
     from tensor2robot_tpu.serving.server import PolicyServer
 
+    # Persistent compilation cache (T2R_COMPILE_CACHE_DIR): a respawned
+    # or rolling-deployed replica deserializes its bucket compiles
+    # instead of repeating them — must engage BEFORE the first compile
+    # (restore/prewarm below).
+    enable_compile_cache()
     chaos.maybe_fire("restore")
     predictor = ExportedSavedModelPredictor(
         export_dir=export_root, timeout=restore_timeout_s

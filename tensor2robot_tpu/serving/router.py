@@ -1069,6 +1069,11 @@ class FleetRouter:
                     "version": r.version,
                     "consecutive_failures": r.consecutive_failures,
                     "respawns": r.respawns,
+                    # Per-replica low-precision regime off the last health
+                    # snapshot: a mixed rollout (some replicas int8, some
+                    # fp32) is verified HERE, version by version, instead
+                    # of by observing precision drift in production.
+                    "serve_quant": r.last_health.get("serve_quant"),
                 }
                 for r in self._replicas
             ]

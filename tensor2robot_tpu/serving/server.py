@@ -476,6 +476,14 @@ class PolicyServer:
         snap["max_queue"] = self._max_queue
         snap["max_wait_ms"] = self._max_wait_s * 1e3
         snap["model_version"] = self._predictor.model_version
+        # Low-precision serving regime of the loaded artifact: router
+        # health probes carry this snapshot, so a fleet can verify a
+        # mixed rollout (some replicas int8, some fp32) version by
+        # version instead of discovering a silent precision mismatch in
+        # production Q-values.
+        regime = getattr(self._predictor, "quant_regime", None)
+        if regime is not None:
+            snap["serve_quant"] = regime
         # Fleet-visible leak surface: a predictor whose close() abandoned
         # a restore thread reports it here, so router health probes (which
         # ride this snapshot) can see the wounded replica.

@@ -342,6 +342,19 @@ def test_bench_serve_contract(tmp_path):
     swap = detail["hot_swap"]
     assert swap["swap_observed"] is True
     assert swap["version_after"] > swap["version_before"]
+    # Round-11 quant legs: every regime served, bytes-of-param reduction
+    # reported against the bar, req/s attributed honestly.
+    quant = detail["quant"]
+    assert set(quant["regimes"]) == {"none", "fp16", "int8"}
+    for regime, leg in quant["regimes"].items():
+        assert leg["saturated_hz"] > 0, (regime, leg)
+        assert leg["params_bytes"] > 0
+    assert quant["int8_params_bytes_reduction_x"] >= 3.5
+    assert quant["regimes"]["fp16"]["params_bytes_reduction_x"] >= 1.8
+    for regime in ("fp16", "int8"):
+        parity = quant["regimes"][regime]["parity_recorded"]
+        assert parity["max_divergence"]["a_predicted"] <= parity["tolerance"]
+    assert "req_s_attribution" in quant
     import json as json_mod
 
     with open(out) as f:
@@ -361,8 +374,10 @@ def test_bench_fleet_contract(tmp_path):
         "--replicas", "3",
         "--capacity-secs", "0.8",
         "--leg-secs", "1.2",
+        "--quant-replicas", "2",
+        "--quant-secs", "1.0",
         "--out", out,
-        timeout=420,
+        timeout=540,
     )
     assert payload["metric"] == "fleet_router_capacity_cpu_proxy"
     assert payload["unit"] == "requests_per_sec"
@@ -385,6 +400,14 @@ def test_bench_fleet_contract(tmp_path):
     # The kill was real AND the fleet recovered from it.
     assert chaos["counters"]["replica_deaths"] >= 1
     assert chaos["counters"]["respawns"] >= 1
+    # Round-11 mixed-precision policy-backend leg: real PolicyServer
+    # replicas, replica 0 fp32 / replica 1 int8, regimes verified off
+    # the router's health snapshots.
+    quant = detail["quant"]
+    assert quant["mixed_fleet_verified"] is True
+    assert quant["replica_serve_quant"] == ["none", "int8"]
+    assert quant["closed_loop_capacity_hz"] > 0
+    assert quant["int8_params_bytes_reduction_x"] >= 3.5
     swap = detail["rolling_swap"]
     assert swap["failed_requests"] == 0
     assert swap["lost"] == 0

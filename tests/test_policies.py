@@ -347,7 +347,18 @@ def two_leaf_predictor(tmp_path_factory):
 class TestMultiLeafActionCEM:
     """Multi-part action specs (the QT-Opt shape: several named action
     components) optimized as one flat CEM vector, split per leaf in spec
-    order by the objective — in BOTH engines."""
+    order by the objective — in BOTH engines.
+
+    History: the two jit-engine tests here were seed failures from the
+    seed round onward. Root cause (measured, not engine-specific): at
+    this geometry (32 samples -> 3 elites, 8 iterations, 3-dim action)
+    BOTH engines missed atol=0.12 on ~25% of seeds — std over 3 elite
+    points is a noisy underestimate, so the proposal collapses around an
+    early suboptimal mean and no later sample can reach the optimum;
+    the numpy tests simply drew lucky seeds while the jit tests' PRNG
+    stream drew unlucky ones. Fixed in the ENGINES (smoothed elite
+    refit, ops/cem.py + utils/cross_entropy.py), which drops the miss
+    rate to <1% of seeds for both."""
 
     def _assert_optimum(self, policy):
         # Optimum: a == (s0, s0), b == s1 -> flat [s0, s0, s1] ... the

@@ -1,0 +1,585 @@
+"""Low-precision serving tests: blockwise quant payloads, export-time
+calibration + parity gate, the T2R_SERVE_QUANT load path, and the
+persistent serving compile cache.
+
+The load-bearing contracts:
+
+  * the quantized payload reuses the GRADIENT collectives' wire format
+    (parallel/collectives.py BlockScaledCollective) — encode here must
+    decode there and vice versa;
+  * an export that fails its declared parity gate must not exist at all;
+  * `T2R_SERVE_QUANT=none` is bit-exact to an export that never heard of
+    quantization — same bytes on disk, same output bits;
+  * the policy server serves quantized artifacts through the SAME bucket
+    ladder with no fresh compiles and no client-visible changes.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensor2robot_tpu import flags as t2r_flags
+from tensor2robot_tpu.export import serve_quant as sq
+from tensor2robot_tpu.export.exporters import LatestExporter
+from tensor2robot_tpu.export.saved_model import (
+    ExportedModel,
+    quant_payload_relpath,
+)
+from tensor2robot_tpu.parallel.collectives import get_collective
+from tensor2robot_tpu.predictors import ExportedSavedModelPredictor
+from tensor2robot_tpu.serving import PolicyServer
+from tensor2robot_tpu.train.train_eval import CompiledModel
+from tensor2robot_tpu.utils.mocks import MockInputGenerator, MockT2RModel
+
+BUCKETS = (1, 2, 4)
+
+
+@pytest.fixture(scope="module")
+def trained():
+    model = MockT2RModel(device_type="cpu")
+    generator = MockInputGenerator(batch_size=8)
+    generator.set_specification_from_model(model, "train")
+    batches = iter(generator.create_dataset("train"))
+    compiled = CompiledModel(model, donate_state=False)
+    state = compiled.init_state(jax.random.PRNGKey(0), next(batches))
+    return compiled, state
+
+
+def _export(trained, model_dir, **kwargs):
+    compiled, state = trained
+    exporter = LatestExporter(
+        name="latest", warmup_batch_sizes=BUCKETS, **kwargs
+    )
+    path = exporter.maybe_export(
+        step=1, state=state, eval_metrics={"loss": 1.0},
+        compiled=compiled, model_dir=str(model_dir),
+    )
+    return path, exporter.export_root(str(model_dir))
+
+
+@pytest.fixture(scope="module")
+def quant_export(trained, tmp_path_factory):
+    """One export carrying fp16 + int8 regimes alongside the default."""
+    return _export(
+        trained,
+        tmp_path_factory.mktemp("quant_export"),
+        serve_quant=("fp16", "int8"),
+    )
+
+
+@pytest.fixture(scope="module")
+def plain_export(trained, tmp_path_factory):
+    return _export(trained, tmp_path_factory.mktemp("plain_export"))
+
+
+# -- the payload codec ---------------------------------------------------------
+
+
+class TestQuantizeTree:
+    def test_roundtrip_error_bounded_by_block_step(self):
+        rng = np.random.RandomState(0)
+        kernel = (rng.randn(64, 96) * 0.3).astype(np.float32)
+        tree = {"params": {"k": kernel}}
+        for regime, levels in (("int8", 127.0), ("fp16", None)):
+            payload, layout = sq.quantize_tree(tree, regime, block=128)
+            deq = np.asarray(
+                sq.dequantize_tree(payload, layout, regime)["params"]["k"]
+            )
+            if levels:
+                # Blockwise max-abs scale: error <= scale/2 per block.
+                flat = kernel.reshape(-1)
+                blocks = flat.reshape(-1, 128)
+                step = np.abs(blocks).max(axis=1) / levels
+                err = np.abs(deq.reshape(-1).reshape(-1, 128) - blocks)
+                assert np.all(err <= step[:, None] / 2 + 1e-7)
+            else:
+                np.testing.assert_allclose(deq, kernel, rtol=2e-3, atol=2e-3)
+
+    def test_wire_format_is_the_gradient_collectives(self):
+        """The payload decodes through BlockScaledCollective.decode
+        directly — one codec, shared with the ZeRO-2 gradient exchange."""
+        rng = np.random.RandomState(1)
+        leaf = (rng.randn(4, 128) * 0.5).astype(np.float32)
+        payload, layout = sq.quantize_tree({"k": leaf}, "int8", block=64)
+        node = payload["k"]
+        collective = get_collective("int8", 64)
+        via_collective = np.asarray(
+            collective.decode(
+                {"q": jnp.asarray(node[sq.Q_KEY]),
+                 "s": jnp.asarray(node[sq.S_KEY])}
+            )
+        )
+        via_module = np.asarray(
+            sq.dequantize_tree(payload, layout, "int8")["k"]
+        ).reshape(-1)
+        np.testing.assert_array_equal(via_collective, via_module)
+        assert node[sq.Q_KEY].dtype == np.int8
+
+    def test_small_leaves_get_leaf_sized_blocks_not_padding_bloat(self):
+        bias = np.linspace(-1, 1, 100).astype(np.float32)
+        payload, layout = sq.quantize_tree({"b": bias}, "int8", block=512)
+        assert layout["b"]["block"] == 100  # not padded out to 512
+        assert payload["b"][sq.Q_KEY].nbytes == 100
+
+    def test_min_size_and_non_float_passthrough(self):
+        tree = {"tiny": np.ones((4,), np.float32), "ids": np.arange(64)}
+        payload, layout = sq.quantize_tree(tree, "int8", min_size=16)
+        assert layout == {}
+        np.testing.assert_array_equal(payload["tiny"], tree["tiny"])
+        np.testing.assert_array_equal(payload["ids"], tree["ids"])
+
+    def test_dequantize_traces_into_jit(self):
+        kernel = np.random.RandomState(2).randn(32, 32).astype(np.float32)
+        payload, layout = sq.quantize_tree({"k": kernel}, "fp16")
+
+        @jax.jit
+        def forward(p, x):
+            return x @ sq.dequantize_tree(p, layout, "fp16")["k"]
+
+        out = forward(payload, np.ones((1, 32), np.float32))
+        assert np.all(np.isfinite(np.asarray(out)))
+
+    def test_unknown_regime_rejected(self):
+        with pytest.raises(ValueError, match="regime"):
+            sq.quantize_tree({"k": np.ones((64,), np.float32)}, "fp8")
+
+    def test_int8_payload_bytes_under_quarter_of_fp32(self):
+        kernel = np.random.RandomState(3).randn(128, 128).astype(np.float32)
+        payload, _ = sq.quantize_tree({"k": kernel}, "int8")
+        counts = sq.payload_nbytes(payload)
+        quant_bytes = counts["values"] + counts["scales"]
+        assert kernel.nbytes / quant_bytes >= 3.5
+
+
+class TestCalibration:
+    def test_percentile_clip_ignores_outliers(self):
+        x = np.zeros((10000,), np.float32)
+        x[0] = 1000.0  # one rogue sample must not stretch the int8 step
+        x[1:] = np.random.RandomState(0).uniform(-2, 2, 9999)
+        calibration = sq.calibrate_activations([{"x": x}])
+        assert calibration["x"] < 10.0
+
+    def test_non_float_features_skipped(self):
+        calibration = sq.calibrate_activations(
+            [{"ids": np.arange(8), "x": np.ones((8,), np.float32)}]
+        )
+        assert set(calibration) == {"x"}
+
+    def test_zero_feature_gets_usable_step(self):
+        calibration = sq.calibrate_activations(
+            [{"x": np.zeros((8,), np.float32)}]
+        )
+        assert calibration["x"] == 1.0
+
+    def test_fake_quant_int8_quantizes_and_fp16_casts(self):
+        calibration = {"x": 1.0}
+        x = np.asarray([0.1234567, 0.9, -2.0], np.float32)
+        q8 = np.asarray(
+            sq.fake_quant_activations({"x": x}, calibration, "int8")["x"]
+        )
+        # Values land on the 1/127 grid, clipped to the calibration range.
+        np.testing.assert_allclose(
+            q8, np.round(np.clip(x, -1, 1) * 127) / 127, atol=1e-6
+        )
+        q16 = np.asarray(
+            sq.fake_quant_activations({"x": x}, calibration, "fp16")["x"]
+        )
+        np.testing.assert_array_equal(q16, x.astype(np.float16).astype(np.float32))
+
+    def test_empty_corpus_rejected(self):
+        with pytest.raises(ValueError, match="warmup"):
+            sq.calibrate_activations([])
+
+
+# -- the export-time parity gate -----------------------------------------------
+
+
+class TestParityGate:
+    def test_check_parity_raises_with_offending_keys(self):
+        with pytest.raises(sq.QuantParityError, match="q_predicted=0.5"):
+            sq.check_parity("int8", {"q_predicted": 0.5, "ok": 0.0}, 0.1)
+
+    def test_failing_gate_aborts_export_writing_nothing(
+        self, trained, tmp_path
+    ):
+        compiled, state = trained
+        exporter = LatestExporter(
+            name="latest",
+            warmup_batch_sizes=BUCKETS,
+            serve_quant=("int8",),
+            quant_parity_tol={"int8": 1e-12},  # unmeetably tight
+        )
+        with pytest.raises(sq.QuantParityError, match="parity gate FAILED"):
+            exporter.maybe_export(
+                step=1, state=state, eval_metrics={"loss": 1.0},
+                compiled=compiled, model_dir=str(tmp_path),
+            )
+        root = exporter.export_root(str(tmp_path))
+        # Loud failure means NO artifact — not even a temp dir.
+        assert not os.path.isdir(root) or not os.listdir(root)
+
+    def test_measured_parity_recorded_in_metadata(self, quant_export):
+        path, _ = quant_export
+        with open(os.path.join(path, "t2r_metadata.json")) as f:
+            meta = json.load(f)
+        quant = meta["serve_quant"]
+        assert quant["regimes"] == ["fp16", "int8"]
+        for regime in ("fp16", "int8"):
+            parity = quant["parity"][regime]
+            assert parity["max_divergence"]["a_predicted"] <= parity["tolerance"]
+            assert quant["block"][regime] >= 1
+            assert "x" in quant["calibration"][regime]
+            assert quant["payload_bytes"][regime]["values"] > 0
+            assert quant["stablehlo"][regime] is True
+
+    def test_config_time_validation(self):
+        with pytest.raises(ValueError, match="warmup"):
+            LatestExporter(name="q", serve_quant=("int8",))
+        with pytest.raises(ValueError, match="regimes"):
+            LatestExporter(
+                name="q", warmup_batch_sizes=(1,), serve_quant=("int4",)
+            )
+        with pytest.raises(ValueError, match="fp32 forward"):
+            LatestExporter(
+                name="q", warmup_batch_sizes=(1,), serve_quant=("int8",),
+                quantize_weights=True,
+            )
+        # Quant payloads without serving programs could never be served:
+        # the incompatibility must fail at config time, not fleet-wide
+        # at the first T2R_SERVE_QUANT restore.
+        with pytest.raises(ValueError, match="serialize_stablehlo"):
+            LatestExporter(
+                name="q", warmup_batch_sizes=(1,), serve_quant=("int8",),
+                serialize_stablehlo=False,
+            )
+
+    def test_nan_divergence_fails_the_gate(self):
+        """A quantized forward that emits NaN must never pass: max(0.0,
+        nan) is 0.0 in Python, so an unguarded reduce would record
+        PERFECT parity for a NaN-serving artifact."""
+        divergence = sq.measure_parity(
+            [{"q": np.zeros((2,), np.float32)}],
+            [{"q": np.asarray([np.nan, 0.0], np.float32)}],
+        )
+        assert divergence["q"] == float("inf")
+        with pytest.raises(sq.QuantParityError):
+            sq.check_parity("int8", divergence, 1e9)
+
+
+# -- artifact sizes ------------------------------------------------------------
+
+
+class TestArtifactBytes:
+    def test_int8_payload_at_least_3_5x_under_fp32_on_disk(
+        self, quant_export
+    ):
+        path, _ = quant_export
+        fp32 = os.path.getsize(os.path.join(path, "variables.msgpack"))
+        int8 = os.path.getsize(os.path.join(path, quant_payload_relpath("int8")))
+        fp16 = os.path.getsize(os.path.join(path, quant_payload_relpath("fp16")))
+        assert fp32 / int8 >= 3.5
+        assert fp32 / fp16 >= 1.8
+
+    def test_quant_stablehlo_carries_no_weight_constants(self, quant_export):
+        path, _ = quant_export
+        default = os.path.getsize(
+            os.path.join(path, "stablehlo", "predict_fn.bin")
+        )
+        int8 = os.path.getsize(
+            os.path.join(path, "stablehlo", "predict_fn_int8.bin")
+        )
+        # The default artifact embeds the full fp32 weights; the quant
+        # program takes its payload as arguments.
+        assert int8 < 0.5 * default
+
+
+# -- the load path -------------------------------------------------------------
+
+
+class TestLoadRegimes:
+    def test_none_is_bit_exact_to_a_plain_export(
+        self, quant_export, plain_export
+    ):
+        qpath, _ = quant_export
+        ppath, _ = plain_export
+        # Same weights -> byte-identical variables file.
+        with open(os.path.join(qpath, "variables.msgpack"), "rb") as f:
+            qbytes = f.read()
+        with open(os.path.join(ppath, "variables.msgpack"), "rb") as f:
+            pbytes = f.read()
+        assert qbytes == pbytes
+        # ...and bit-identical outputs through regime 'none'.
+        x = np.random.RandomState(0).uniform(-1, 1, (4, 3)).astype(np.float32)
+        out_q = ExportedModel(qpath, quant_regime="none").predict({"x": x})
+        out_p = ExportedModel(ppath, quant_regime="none").predict({"x": x})
+        np.testing.assert_array_equal(
+            out_q["a_predicted"], out_p["a_predicted"]
+        )
+
+    def test_regimes_serve_within_their_recorded_parity(self, quant_export):
+        path, _ = quant_export
+        with open(os.path.join(path, "t2r_metadata.json")) as f:
+            tolerances = {
+                regime: entry["tolerance"]
+                for regime, entry in json.load(f)["serve_quant"][
+                    "parity"
+                ].items()
+            }
+        x = np.random.RandomState(1).uniform(-1, 1, (2, 3)).astype(np.float32)
+        ref = ExportedModel(path, quant_regime="none").predict({"x": x})
+        for regime in ("fp16", "int8"):
+            out = ExportedModel(path, quant_regime=regime).predict({"x": x})
+            diff = np.max(np.abs(out["a_predicted"] - ref["a_predicted"]))
+            assert diff <= tolerances[regime]
+            # ...and really served the quantized path, not fp32.
+            assert diff > 0 or regime == "fp16"
+
+    def test_missing_regime_fails_loudly(self, plain_export):
+        path, _ = plain_export
+        with pytest.raises(ValueError, match="T2R_SERVE_QUANT=int8"):
+            ExportedModel(path, quant_regime="int8")
+
+    def test_model_code_predictor_refuses_quant_regime(
+        self, quant_export, monkeypatch
+    ):
+        """SavedModelCodePredictor rebuilds an fp32 forward from model
+        code — under a quant regime that would be silent full-precision
+        serving, so restore must fail loudly instead."""
+        from tensor2robot_tpu.predictors.saved_model_v2_predictor import (
+            SavedModelCodePredictor,
+        )
+        from tensor2robot_tpu.utils.mocks import MockT2RModel
+
+        _, root = quant_export
+        monkeypatch.setenv("T2R_SERVE_QUANT", "int8")
+        predictor = SavedModelCodePredictor(
+            root, t2r_model=MockT2RModel(device_type="cpu")
+        )
+        with pytest.raises(ValueError, match="cannot honor quant regime"):
+            predictor.restore()
+
+    def test_predictor_resolves_regime_from_flag(
+        self, quant_export, monkeypatch
+    ):
+        _, root = quant_export
+        monkeypatch.setenv("T2R_SERVE_QUANT", "int8")
+        predictor = ExportedSavedModelPredictor(export_dir=root)
+        assert predictor.restore()
+        assert predictor.quant_regime == "int8"
+        assert predictor.loaded_model.quant_regime == "int8"
+        out = predictor.predict(
+            {"x": np.zeros((1, 3), np.float32)}
+        )
+        assert np.all(np.isfinite(out["a_predicted"]))
+
+    def test_flag_declared(self):
+        assert t2r_flags.get_enum("T2R_SERVE_QUANT") == "none"
+        spec = t2r_flags.get_flag("T2R_SERVE_QUANT")
+        assert spec.choices == ("none", "fp16", "int8")
+        assert t2r_flags.get_str("T2R_COMPILE_CACHE_DIR") is None
+
+
+# -- exporter -> predictor -> server round trip --------------------------------
+
+
+class _RecordingPredictor:
+    """Wraps the real predictor recording every served batch size — the
+    no-fresh-compile contract is 'every served shape is a warmup
+    bucket' (mirrors tests/test_serving.py)."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.batch_sizes = []
+
+    def _record(self, features):
+        sizes = {int(np.asarray(v).shape[0]) for v in features.values()}
+        assert len(sizes) == 1, f"ragged batch: {sizes}"
+        self.batch_sizes.append(sizes.pop())
+
+    def predict(self, features):
+        self._record(features)
+        return self._inner.predict(features)
+
+    def predict_versioned(self, features):
+        self._record(features)
+        return self._inner.predict_versioned(features)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+class TestServerRoundTrip:
+    @pytest.mark.parametrize("regime", ["none", "fp16", "int8"])
+    def test_every_bucket_serves_quantized_with_no_novel_shapes(
+        self, quant_export, monkeypatch, regime
+    ):
+        _, root = quant_export
+        monkeypatch.setenv("T2R_SERVE_QUANT", regime)
+        inner = ExportedSavedModelPredictor(export_dir=root)
+        assert inner.restore()
+        predictor = _RecordingPredictor(inner)
+        with PolicyServer(predictor, max_wait_ms=60).start() as server:
+            assert server.buckets == BUCKETS
+            assert server.snapshot()["serve_quant"] == regime
+            predictor.batch_sizes.clear()  # drop prewarm
+            # Drive each bucket: 1, 2, and 3->padded-to-4 concurrent rows.
+            for group in (1, 2, 3):
+                futures = [
+                    server.submit(
+                        {"x": np.full((3,), 0.1 * (i + 1), np.float32)},
+                        deadline_ms=30000,
+                    )
+                    for i in range(group)
+                ]
+                responses = [f.result(30) for f in futures]
+                for response in responses:
+                    assert np.all(np.isfinite(response.outputs["a_predicted"]))
+        assert set(predictor.batch_sizes) <= set(BUCKETS)
+
+    def test_server_outputs_match_direct_quant_predict(
+        self, quant_export, monkeypatch
+    ):
+        path, root = quant_export
+        monkeypatch.setenv("T2R_SERVE_QUANT", "int8")
+        predictor = ExportedSavedModelPredictor(export_dir=root)
+        assert predictor.restore()
+        row = {"x": np.asarray([0.3, -0.2, 0.9], np.float32)}
+        with PolicyServer(predictor, max_wait_ms=1).start() as server:
+            served = server.call(row, timeout=30).outputs["a_predicted"]
+        direct = ExportedModel(path, quant_regime="int8").predict(
+            {"x": row["x"][None, :]}
+        )["a_predicted"][0]
+        np.testing.assert_allclose(served, direct, rtol=1e-6, atol=1e-6)
+
+    def test_float64_client_coerced_under_quant(
+        self, quant_export, monkeypatch
+    ):
+        """A plain-Python-list client (float64) must be coerced at
+        admission even when the serving path is quantized — the dtype
+        contract is the spec's, regardless of regime."""
+        _, root = quant_export
+        monkeypatch.setenv("T2R_SERVE_QUANT", "int8")
+        predictor = ExportedSavedModelPredictor(export_dir=root)
+        assert predictor.restore()
+        with PolicyServer(predictor, max_wait_ms=1).start() as server:
+            response = server.call({"x": [0.1, 0.2, 0.3]}, timeout=30)
+            assert response.outputs["a_predicted"].shape == (1,)
+            assert np.all(np.isfinite(response.outputs["a_predicted"]))
+
+    def test_hot_swap_keeps_regime(self, trained, tmp_path, monkeypatch):
+        compiled, state = trained
+        monkeypatch.setenv("T2R_SERVE_QUANT", "fp16")
+        exporter = LatestExporter(
+            name="latest", warmup_batch_sizes=(1, 2),
+            serve_quant=("fp16",),
+        )
+        exporter.maybe_export(
+            step=1, state=state, eval_metrics={"loss": 1.0},
+            compiled=compiled, model_dir=str(tmp_path),
+        )
+        root = exporter.export_root(str(tmp_path))
+        predictor = ExportedSavedModelPredictor(export_dir=root)
+        assert predictor.restore()
+        v1 = predictor.model_version
+        with PolicyServer(predictor, max_wait_ms=1).start() as server:
+            exporter.maybe_export(
+                step=2, state=state, eval_metrics={"loss": 0.9},
+                compiled=compiled, model_dir=str(tmp_path),
+            )
+            assert server.hot_swap(wait=True)
+            response = server.call(
+                {"x": np.zeros((3,), np.float32)}, timeout=30
+            )
+        assert response.model_version > v1
+        assert predictor.quant_regime == "fp16"
+
+
+# -- persistent serving compile cache ------------------------------------------
+
+
+class TestCompileCache:
+    @pytest.fixture(autouse=True)
+    def _restore_jax_cache_config(self):
+        """enable_compile_cache mutates GLOBAL jax config; leaking a
+        pytest tmp dir as the cache dir (plus min-compile-time 0) into
+        the rest of the suite means every later compile writes cache
+        entries to a doomed path. Restore the config and drop the
+        latched cache state after each test."""
+        import jax
+
+        previous_dir = jax.config.jax_compilation_cache_dir
+        previous_min = jax.config.jax_persistent_cache_min_compile_time_secs
+        yield
+        jax.config.update("jax_compilation_cache_dir", previous_dir)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", previous_min
+        )
+        try:
+            from jax._src import compilation_cache
+
+            compilation_cache.reset_cache()
+        except ImportError:  # pragma: no cover - future jax relayout
+            pass
+
+    def test_flag_resolution(self, tmp_path, monkeypatch):
+        from tensor2robot_tpu.serving.compile_cache import enable_compile_cache
+
+        monkeypatch.delenv("T2R_COMPILE_CACHE_DIR", raising=False)
+        assert enable_compile_cache() is None  # unset flag = no-op
+        monkeypatch.setenv("T2R_COMPILE_CACHE_DIR", str(tmp_path))
+        assert enable_compile_cache() == str(tmp_path)
+
+    def test_second_server_boot_hits_the_cache(
+        self, quant_export, tmp_path, monkeypatch
+    ):
+        """Boot a policy server (prewarm compiles every bucket) with the
+        persistent cache on; clear jax's in-memory executable caches
+        (what a process restart discards); boot a second server over the
+        same export. The second boot must add NO new cache entries —
+        every compile was served from disk — and still serve correctly.
+        """
+        from tensor2robot_tpu.serving.compile_cache import enable_compile_cache
+
+        _, root = quant_export
+        monkeypatch.setenv("T2R_COMPILE_CACHE_DIR", str(tmp_path))
+        assert enable_compile_cache() == str(tmp_path)
+
+        def boot_and_serve():
+            predictor = ExportedSavedModelPredictor(export_dir=root)
+            assert predictor.restore()
+            with PolicyServer(predictor, max_wait_ms=1).start() as server:
+                response = server.call(
+                    {"x": np.zeros((3,), np.float32)}, timeout=30
+                )
+            return response.outputs["a_predicted"]
+
+        # Earlier tests in this process may have compiled these shapes
+        # already; drop the in-memory executables so the first boot
+        # really compiles (and therefore really writes cache entries).
+        jax.clear_caches()
+        first = boot_and_serve()
+        entries_after_first = set(os.listdir(str(tmp_path)))
+        assert entries_after_first, "first boot wrote no cache entries"
+        jax.clear_caches()
+        second = boot_and_serve()
+        entries_after_second = set(os.listdir(str(tmp_path)))
+        assert entries_after_second == entries_after_first, (
+            "second boot recompiled: new persistent-cache entries "
+            f"{entries_after_second - entries_after_first}"
+        )
+        np.testing.assert_array_equal(first, second)
+
+    def test_replica_factory_calls_enable(self):
+        """The replica boot path engages the cache before its first
+        compile (source-level pin: behavior is covered above; this keeps
+        the call from being refactored out of the child process path)."""
+        import inspect
+
+        from tensor2robot_tpu.serving import replica
+
+        source = inspect.getsource(replica.policy_server_factory)
+        assert "enable_compile_cache()" in source

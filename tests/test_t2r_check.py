@@ -209,6 +209,26 @@ class TestLintsCatch:
         )
         assert "env-kind-mismatch" in rules
 
+    def test_serve_quant_flags_covered_by_registry_lint(self):
+        """The round-11 flags ride the same rails: raw environ reads are
+        env-undeclared, wrong-kind getter reads are env-kind-mismatch,
+        and the declared getter spellings are clean."""
+        for name in ("T2R_SERVE_QUANT", "T2R_COMPILE_CACHE_DIR"):
+            assert "env-undeclared" in self._rules(
+                f"import os\nx = os.environ.get({name!r})\n"
+            )
+            assert "env-kind-mismatch" in self._rules(
+                "from tensor2robot_tpu import flags\n"
+                f"x = flags.get_bool({name!r})\n"
+            )
+        clean = self._rules(
+            "from tensor2robot_tpu import flags\n"
+            "a = flags.get_enum('T2R_SERVE_QUANT')\n"
+            "b = flags.get_str('T2R_COMPILE_CACHE_DIR')\n"
+        )
+        assert "env-kind-mismatch" not in clean
+        assert "env-unknown-flag" not in clean
+
     def test_numpy_in_jit_decorated(self):
         rules = self._rules(
             "import jax\nimport numpy as np\n"

@@ -66,6 +66,15 @@ if [ "$TESTS" = 1 ]; then
     status=1
   fi
 
+  echo "== serve-quant: low-precision serving + parity gates (tier-1) =="
+  # Blockwise quant payload codec (shared with the gradient collectives),
+  # export-time calibration + parity gate, T2R_SERVE_QUANT load regimes,
+  # server round-trip per bucket, persistent serving compile cache.
+  if ! JAX_PLATFORMS=cpu python -m pytest tests/test_serve_quant.py \
+      -q -m 'not slow' -p no:cacheprovider; then
+    status=1
+  fi
+
   echo "== chaos: deterministic fault-plan + crash-consistency suite (tier-1) =="
   # Seeded fault plans only (testing/chaos.py): replica kill / straggler /
   # corrupt-reply routing, and SIGKILL-mid-orbax-save recovery with the
