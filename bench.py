@@ -3114,6 +3114,220 @@ def main() -> None:
         _fail("bench_run", err, metric=metric)
 
 
+def bench_rl(args) -> None:
+    """Closed online-RL loop leg (`python bench.py rl`).
+
+    Runs the full QT-Opt topology on this host: pose_env actor
+    processes get actions from a FleetRouter over policy-server replica
+    processes (serving the learner's exported artifact), append
+    episodes as wire bytes to the replay-service process, and the
+    learner trains from the service's sampler, publishing a fresh
+    policy (export -> rolling fleet swap) at every checkpoint. Reports
+    episodes/s, samples/s, replay ratio and policy staleness.
+
+    Two legs, same seeds:
+
+      * fault-free — the throughput + staleness numbers;
+      * chaos — the replay service AND one actor are SIGKILLed mid-run.
+        Acceptance: the learner finishes the SAME number of steps as
+        the fault-free twin, zero torn segments are ever sampled
+        (verified against the on-disk manifests after the fact), and
+        the loss is bounded to the unsealed tail — counted and
+        reported, never guessed.
+    """
+    import shutil
+    import tempfile
+    import threading
+
+    try:
+        devices, backend_note = _init_devices(
+            max_wait=_backend_wait(metric="rl_loop_episodes_per_sec")
+        )
+    except Exception as err:
+        _fail("backend_init", err, metric="rl_loop_episodes_per_sec")
+    on_tpu = devices[0].platform == "tpu"
+    metric = (
+        "rl_loop_episodes_per_sec"
+        if on_tpu
+        else "rl_loop_episodes_per_sec_cpu_proxy"
+    )
+    _enable_compilation_cache()
+
+    try:
+        import jax
+        import numpy as np
+
+        from tensor2robot_tpu.export.exporters import LatestExporter
+        from tensor2robot_tpu.replay import OnlineLoop
+        from tensor2robot_tpu.replay.segment import list_sealed_segments
+        from tensor2robot_tpu.research.pose_env.pose_env_models import (
+            PoseEnvRegressionModel,
+        )
+        from tensor2robot_tpu.serving import FleetRouter, ReplicaSpec
+        from tensor2robot_tpu.serving.replica import policy_server_factory
+        from tensor2robot_tpu.train.train_eval import CompiledModel
+
+        def bootstrap_artifact(model_dir):
+            """Initial (untrained) policy artifact the fleet boots on."""
+            from tensor2robot_tpu.specs import TensorSpecStruct
+
+            model = PoseEnvRegressionModel()
+            generator_batch = TensorSpecStruct()
+            generator_batch["features/state"] = np.zeros(
+                (4, 64, 64, 3), np.uint8
+            )
+            generator_batch["labels/target_pose"] = np.zeros(
+                (4, 2), np.float32
+            )
+            generator_batch["labels/reward"] = np.ones((4, 1), np.float32)
+            compiled = CompiledModel(model, donate_state=False)
+            state = compiled.init_state(
+                jax.random.PRNGKey(0), generator_batch
+            )
+            exporter = LatestExporter(
+                name="latest", warmup_batch_sizes=(1,)
+            )
+            path = exporter.maybe_export(
+                step=0, state=state, eval_metrics={"loss": 1.0},
+                compiled=compiled, model_dir=model_dir,
+            )
+            return exporter.export_root(model_dir), path
+
+        def run_leg(tag, with_chaos):
+            root = tempfile.mkdtemp(prefix=f"bench_rl_{tag}_")
+            loop = OnlineLoop(
+                root,
+                num_actors=args.actors,
+                batch_size=args.batch,
+                seal_episodes=args.seal_episodes,
+                seed=11,
+                use_router=True,
+                wait_timeout_s=300.0,
+                actor_throttle_s=args.actor_throttle_ms / 1e3,
+            )
+            export_root, path = bootstrap_artifact(loop.model_dir)
+            base = os.path.basename(path.rstrip("/"))
+            if base.isdigit():
+                loop.register_artifact_version(int(base), 0)
+            router = FleetRouter(
+                ReplicaSpec(
+                    factory=policy_server_factory,
+                    factory_args=(export_root,),
+                ),
+                num_replicas=args.replicas,
+                probe_interval_ms=200.0,
+                probe_miss_limit=10,
+                seed=11,
+            ).start(timeout_s=300.0)
+            loop._router = router
+            loop.start()
+            chaos_events = {}
+            try:
+                if with_chaos:
+                    def mid_run_chaos():
+                        time.sleep(args.chaos_at_s)
+                        chaos_events["replay_pid"] = (
+                            loop.kill_replay_service()
+                        )
+                        chaos_events["actor_pid"] = loop.kill_actor(0)
+
+                    chaos_thread = threading.Thread(
+                        target=mid_run_chaos, daemon=True
+                    )
+                    chaos_thread.start()
+                loop.run_learner(
+                    max_steps=args.steps,
+                    save_steps=max(1, args.steps // 3),
+                    publish=True,
+                )
+                if with_chaos:
+                    chaos_thread.join()
+            finally:
+                report = loop.stop()
+                router.stop()
+            # Torn-segment audit: every coordinate the learner sampled
+            # must name a segment that is durable ON DISK right now.
+            sealed = {
+                seq for seq, _ in list_sealed_segments(loop.replay_root)
+            }
+            sampled = {
+                seq
+                for batch in (loop._generator.coords_log if loop._generator
+                              else [])
+                for seq, _ in batch
+            }
+            torn_sampled = sorted(sampled - sealed)
+            payload = report.to_json()
+            payload.pop("actor_reports", None)
+            payload["torn_segments_sampled"] = torn_sampled
+            payload["chaos"] = chaos_events if with_chaos else None
+            shutil.rmtree(root, ignore_errors=True)
+            return payload
+
+        fault_free = run_leg("clean", with_chaos=False)
+        chaos_leg = run_leg("chaos", with_chaos=True)
+
+        acceptance = {
+            "stats_measured": (
+                chaos_leg["stats_ok"] and fault_free["stats_ok"]
+            ),
+            "learner_steps_equal": (
+                chaos_leg["learner_steps"] == fault_free["learner_steps"]
+                and chaos_leg["learner_steps"] > 0
+            ),
+            "zero_torn_segments_sampled": (
+                not chaos_leg["torn_segments_sampled"]
+                and not fault_free["torn_segments_sampled"]
+            ),
+            "loss_bounded_to_unsealed_tail": (
+                chaos_leg["episodes_lost"] <= args.seal_episodes
+            ),
+            "loss_counted": chaos_leg["episodes_lost"],
+            "replay_service_respawned": chaos_leg["replay_restarts"] >= 1,
+            "actor_killed": chaos_leg["actors_killed"] == 1,
+        }
+        payload = {
+            "metric": metric,
+            "value": fault_free["episodes_per_s"],
+            "unit": "episodes_per_sec",
+            "vs_baseline": 0.0,
+            "detail": {
+                "fault_free": fault_free,
+                "chaos": chaos_leg,
+                "acceptance": acceptance,
+                "samples_per_sec": fault_free["samples_per_s"],
+                "replay_ratio": fault_free["replay_ratio"],
+                "staleness_mean": fault_free["staleness_mean"],
+                "staleness_max": fault_free["staleness_max"],
+                "actors": args.actors,
+                "replicas": args.replicas,
+                "learner_steps": args.steps,
+                "batch": args.batch,
+                "seal_episodes": args.seal_episodes,
+                **({"backend_note": backend_note} if backend_note else {}),
+            },
+            **_proxy_fields(on_tpu, "rl_loop_episodes_per_sec"),
+        }
+        _emit(payload)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(payload, f, indent=1, sort_keys=True)
+        if not all(
+            v is True
+            for k, v in acceptance.items()
+            if isinstance(v, bool)
+        ):
+            _fail(
+                "rl_acceptance",
+                RuntimeError(f"acceptance failed: {acceptance}"),
+                metric=metric,
+            )
+    except SystemExit:
+        raise
+    except Exception as err:
+        _fail("bench_rl", err, metric=metric)
+
+
 def _build_cli():
     """bench legs as argparse subcommands: `python bench.py --help` lists
     every leg, `python bench.py <leg> --help` its options and env knobs.
@@ -3294,6 +3508,50 @@ def _build_cli():
     )
     fleet.add_argument(
         "--out", default="BENCH_FLEET_r11.json",
+        help="also write the payload to this file ('' disables; "
+             "default %(default)s)",
+    )
+    rl = leg(
+        "rl", bench_rl,
+        "closed online-RL loop leg: pose_env actor processes -> replay "
+        "service -> learner -> exported policy -> serving fleet -> "
+        "actors; fault-free + chaos (replay-service AND actor SIGKILL "
+        "mid-run) twins with episodes/s, samples/s, replay ratio and "
+        "policy staleness (docs/RL_LOOP.md)",
+    )
+    rl.add_argument(
+        "--actors", type=int, default=2,
+        help="actor process count (default %(default)s)",
+    )
+    rl.add_argument(
+        "--replicas", type=int, default=1,
+        help="policy-server replica count behind the router "
+             "(default %(default)s)",
+    )
+    rl.add_argument(
+        "--steps", type=int, default=12,
+        help="learner steps per leg (default %(default)s)",
+    )
+    rl.add_argument(
+        "--batch", type=int, default=4,
+        help="learner batch size (default %(default)s)",
+    )
+    rl.add_argument(
+        "--seal-episodes", type=int, default=4,
+        help="episodes per sealed segment — also the crash-loss bound "
+             "(default %(default)s)",
+    )
+    rl.add_argument(
+        "--actor-throttle-ms", type=float, default=20.0,
+        help="per-episode actor throttle (default %(default)s)",
+    )
+    rl.add_argument(
+        "--chaos-at-s", type=float, default=4.0,
+        help="when the chaos leg SIGKILLs the replay service + actor 0 "
+             "(default %(default)s)",
+    )
+    rl.add_argument(
+        "--out", default="BENCH_RL_r12.json",
         help="also write the payload to this file ('' disables; "
              "default %(default)s)",
     )

@@ -97,6 +97,10 @@ _SWALLOW_SCOPE_FRAGMENTS = (
     "tensor2robot_tpu/serving/",
     "tensor2robot_tpu/train/",
     "tensor2robot_tpu/predictors/",
+    # The replay service/actor fleet is failure-handling code from top
+    # to bottom: a silent swallow here converts a counted, recoverable
+    # fault into an unexplained stall of the whole online loop.
+    "tensor2robot_tpu/replay/",
 )
 _SWALLOW_ALLOW_DECORATOR = "best_effort_cleanup"
 _BROAD_EXCEPTION_NAMES = frozenset({"Exception", "BaseException"})

@@ -305,16 +305,125 @@ def _parse_with(parser: SpecParser, chunk, roi=None) -> TensorSpecStruct:
     return parser.parse_batch(_regroup_chunk(chunk), roi=roi)
 
 
+class ParseStats:
+    """Degradation counters one dataset's consumers share (thread-safe).
+
+    `records_skipped` is the quarantine counter the T2R_PARSE_ON_ERROR
+    =skip mode surfaces: corrupt records dropped from the stream instead
+    of killing the consumer. `fast_fallbacks` aggregates WORKER-side
+    fast-parser fallbacks (the parent's own fast parser counts on
+    itself). Surfaced via RecordDataset.stats()."""
+
+    _FIELDS = (
+        "records_skipped", "batches_degraded", "batches_dropped",
+        "fast_fallbacks",
+    )
+    __slots__ = ("_lock",) + _FIELDS
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        for field in self._FIELDS:
+            setattr(self, field, 0)
+
+    def note_skipped(self, records: int, whole_batch: bool) -> None:
+        with self._lock:
+            self.records_skipped += records
+            if whole_batch:
+                self.batches_dropped += 1
+            else:
+                self.batches_degraded += 1
+
+    def merge(self, delta: Dict[str, int]) -> None:
+        """Folds a worker's per-chunk snapshot delta into these totals."""
+        with self._lock:
+            for field in self._FIELDS:
+                setattr(
+                    self, field,
+                    getattr(self, field) + delta.get(field, 0),
+                )
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return {field: getattr(self, field) for field in self._FIELDS}
+
+
+def default_parse_on_error() -> str:
+    """T2R_PARSE_ON_ERROR: 'raise' (default) kills the consumer on a
+    genuinely corrupt record; 'skip' drops-and-counts it."""
+    return flags.get_enum("T2R_PARSE_ON_ERROR")
+
+
+def _slice_roi(roi, keep: List[int]):
+    """Per-record ROI offsets restricted to the surviving records."""
+    if roi is None:
+        return None
+    import dataclasses as _dataclasses
+
+    out = {}
+    for key, resolved in roi.items():
+        out[key] = _dataclasses.replace(
+            resolved,
+            ys=np.asarray(resolved.ys)[keep],
+            xs=np.asarray(resolved.xs)[keep],
+        )
+    return out
+
+
+def _skip_and_parse(
+    parser: SpecParser, chunk, roi, stats: Optional[ParseStats],
+    original_error: BaseException,
+) -> Optional[TensorSpecStruct]:
+    """T2R_PARSE_ON_ERROR=skip: triage the failed batch record by record
+    with the oracle, drop the corrupt ones (counted), parse the rest.
+
+    Returns None when NOTHING in the chunk survives (callers drop the
+    batch entirely). The surviving batch is SHORT — graceful degradation
+    trades the static batch shape for stream survival, and the counters
+    make the trade visible instead of silent.
+
+    When every record parses individually, the failure was BATCH-level
+    (stacking, ROI application, a parser bug) — not record corruption,
+    which is the only thing skip mode is licensed to swallow: the
+    original error re-raises uncounted."""
+    keep: List[int] = []
+    for index, record in enumerate(chunk):
+        try:
+            parser.parse_single(record)
+        except Exception:
+            continue
+        keep.append(index)
+    skipped = len(chunk) - len(keep)
+    if skipped == 0:
+        raise original_error
+    if stats is not None:
+        stats.note_skipped(skipped, whole_batch=not keep)
+    _log.warning(
+        "T2R_PARSE_ON_ERROR=skip: dropped %d corrupt record(s) from a "
+        "batch of %d", skipped, len(chunk),
+    )
+    if not keep:
+        return None
+    survivors = [chunk[index] for index in keep]
+    return _parse_with(parser, survivors, roi=_slice_roi(roi, keep))
+
+
 def _parse_chunk_impl(
-    fast_state: Optional[_FastParseState], parser: SpecParser, payload
-) -> TensorSpecStruct:
+    fast_state: Optional[_FastParseState],
+    parser: SpecParser,
+    payload,
+    stats: Optional[ParseStats] = None,
+) -> Optional[TensorSpecStruct]:
     """Fast wire-format parse with automatic SpecParser fallback.
 
     Any fast-path failure re-parses the batch with the oracle: genuinely
     bad data then raises the canonical error; a fast-path limitation
     degrades to slow-but-correct. A ROI payload falls back with the SAME
     resolved offsets, so the oracle reproduces the identical batch.
-    test_fast_parser.py / test_roi_decode.py pin the parity."""
+    test_fast_parser.py / test_roi_decode.py pin the parity.
+
+    Under T2R_PARSE_ON_ERROR=skip an oracle failure additionally triages
+    per record: corrupt records are dropped-and-counted (`stats`), the
+    surviving batch is returned (None when nothing survives)."""
     chunk, roi = _split_payload(payload)
     fast = fast_state.parser if fast_state is not None else None
     if fast is not None:
@@ -322,7 +431,12 @@ def _parse_chunk_impl(
             return fast.parse_batch(_regroup_chunk(chunk), roi=roi)
         except Exception:
             fast_state.note_fallback()
-    return _parse_with(parser, chunk, roi=roi)
+    try:
+        return _parse_with(parser, chunk, roi=roi)
+    except Exception as err:
+        if default_parse_on_error() != "skip":
+            raise
+        return _skip_and_parse(parser, chunk, roi, stats, err)
 
 
 def _shm_attach(name: str):
@@ -351,15 +465,28 @@ def _process_parse_chunk(chunk):
     parser = _PROCESS_PARSER
     if parser is None:  # pragma: no cover - initializer always runs first
         raise RuntimeError("process pool worker missing parser init")
+    # Skip-mode + fallback counters ride each payload back as a
+    # per-chunk DELTA (worker processes cannot share the parent's
+    # ParseStats).
+    stats = ParseStats()
+    fast = _PROCESS_FAST.parser if _PROCESS_FAST is not None else None
+    fallbacks_before = fast.fallbacks if fast is not None else 0
+    parsed = _parse_chunk_impl(_PROCESS_FAST, parser, chunk, stats)
+    if fast is not None:
+        stats.fast_fallbacks = fast.fallbacks - fallbacks_before
+    delta = stats.snapshot()
+    delta = delta if any(delta.values()) else None
+    if parsed is None:
+        return ("dropped", delta)
     # Ship plain (key, value) pairs; the parent rebuilds the struct (cheap)
     # rather than relying on TensorSpecStruct pickling across versions.
-    flat = list(_parse_chunk_impl(_PROCESS_FAST, parser, chunk).items())
+    flat = list(parsed.items())
     free_queue = _PROCESS_SHM_FREE
     if free_queue is None:
-        return ("inline", flat)
+        return ("inline", flat, delta)
     large = [(k, v) for k, v in flat if v.nbytes >= _SHM_MIN_SHIP_BYTES]
     if not large:
-        return ("inline", flat)
+        return ("inline", flat, delta)
     need = sum(_shm_align(v.nbytes) for _, v in large)
     try:
         # Non-blocking: before the parent seeds the ring (it sizes slots
@@ -368,11 +495,11 @@ def _process_parse_chunk(chunk):
         # so a slot is normally free the moment a worker wants one.
         name = free_queue.get_nowait()
     except queue.Empty:
-        return ("inline", flat)
+        return ("inline", flat, delta)
     shm = _shm_attach(name)
     if need > shm.size:
         free_queue.put(name)
-        return ("inline", flat)
+        return ("inline", flat, delta)
     entries = []
     offset = 0
     for key, value in flat:
@@ -386,7 +513,7 @@ def _process_parse_chunk(chunk):
         del view
         entries.append((key, (value.dtype, value.shape, offset), None))
         offset += _shm_align(value.nbytes)
-    return ("shm", name, entries)
+    return ("shm", name, entries, delta)
 
 
 class _ShmSlotToken:
@@ -637,6 +764,7 @@ class RecordDataset:
             default_parse_fast() if parse_fast is None else parse_fast
         )
         self._fast_state = _FastParseState(specs, self._parse_fast)
+        self._parse_stats = ParseStats()
         self._shm_ring: Optional[_ShmBatchRing] = None
         self._shm_free_queue = None
         self._mp_context = None
@@ -761,8 +889,10 @@ class RecordDataset:
                 ),
             )
 
-    def _parse_chunk(self, chunk) -> TensorSpecStruct:
-        return _parse_chunk_impl(self._fast_state, self._parser, chunk)
+    def _parse_chunk(self, chunk) -> Optional[TensorSpecStruct]:
+        return _parse_chunk_impl(
+            self._fast_state, self._parser, chunk, self._parse_stats
+        )
 
     def _max_in_flight(self) -> int:
         return self._num_parse_workers + max(self._prefetch_depth, 1)
@@ -800,8 +930,15 @@ class RecordDataset:
         ):
             self._shm_ring.release(payload[1])
 
-    def _rebuild_struct(self, payload) -> TensorSpecStruct:
-        """Parent-side batch reassembly for both process-return forms."""
+    def _rebuild_struct(self, payload) -> Optional[TensorSpecStruct]:
+        """Parent-side batch reassembly for the process-return forms
+        (inline / shm / dropped), folding any worker-side skip counters
+        into this dataset's ParseStats."""
+        delta = payload[-1] if isinstance(payload[-1], dict) else None
+        if delta:
+            self._parse_stats.merge(delta)
+        if payload[0] == "dropped":
+            return None
         out = TensorSpecStruct()
         if payload[0] == "inline":
             for key, value in payload[1]:
@@ -810,7 +947,7 @@ class RecordDataset:
                 [(key, None, value) for key, value in payload[1]]
             )
             return out
-        _, name, entries = payload
+        _, name, entries = payload[0], payload[1], payload[2]
         ring = self._shm_ring
         if ring is None or name not in ring.slots:
             raise RuntimeError(f"worker returned unknown shm slot {name!r}")
@@ -888,9 +1025,21 @@ class RecordDataset:
         except Exception:
             pass
 
+    def stats(self) -> Dict[str, int]:
+        """Degradation counters: skip-mode quarantine (records_skipped,
+        batches_degraded/dropped — T2R_PARSE_ON_ERROR=skip) plus the
+        fast parser's fallback count. Thread-backend and parent-side
+        numbers are live; process-worker skips AND fallbacks fold in as
+        their batches arrive (the aggregate ParseStats.fast_fallbacks
+        plus the parent's own fast parser)."""
+        out = self._parse_stats.snapshot()
+        fast = self._fast_state.parser
+        out["fast_fallbacks"] += fast.fallbacks if fast is not None else 0
+        return out
+
     def __iter__(self) -> Iterator[TensorSpecStruct]:
         if self._num_parse_workers > 0 and self._parse_backend == "process":
-            batches: Iterator[TensorSpecStruct] = map(
+            batches: Iterator[Optional[TensorSpecStruct]] = map(
                 self._rebuild_struct,
                 _ParallelBatcher(
                     self._chunks(),
@@ -912,6 +1061,9 @@ class RecordDataset:
             )
         else:
             batches = map(self._parse_chunk, self._chunks())
+        # Skip-mode whole-batch drops surface as None: filter them here
+        # so every consumer-visible batch is real.
+        batches = (batch for batch in batches if batch is not None)
         if self._prefetch_depth > 0:
             return iter(_Prefetcher(batches, self._prefetch_depth))
         return batches

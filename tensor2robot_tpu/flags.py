@@ -216,6 +216,19 @@ _declare(
     "tensor2robot_tpu/data/dataset.py",
 )
 _declare(
+    "T2R_PARSE_ON_ERROR",
+    _ENUM,
+    "raise",
+    "Data-pipeline behavior on a genuinely corrupt record mid-stream "
+    "(CRC / strict-frame / proto parse failure in BOTH the fast parser "
+    "and the SpecParser oracle): raise kills the consumer with the "
+    "canonical error (default); skip drops the bad record(s), counts "
+    "them in the dataset's stats()['records_skipped'], and yields the "
+    "surviving batch.",
+    "tensor2robot_tpu/data/dataset.py",
+    choices=("raise", "skip"),
+)
+_declare(
     "T2R_PARSE_SHM",
     _BOOL,
     True,
@@ -237,6 +250,48 @@ _declare(
     "Max-pool VJP path; auto dispatches per lowering platform.",
     "tensor2robot_tpu/ops/pooling.py",
     choices=("auto", "native", "scatterfree"),
+)
+_declare(
+    "T2R_REPLAY_RETRIES",
+    _INT,
+    5,
+    "Replay-client max retry attempts (beyond the first try) for an "
+    "append/sample/stats call that failed or timed out — the service "
+    "may be mid-restart after a crash; each retry backs off with "
+    "jittered exponential delay.",
+    "tensor2robot_tpu/replay/service.py",
+    minimum=0,
+)
+_declare(
+    "T2R_REPLAY_SAMPLER",
+    _ENUM,
+    "fifo",
+    "Replay sampling policy: fifo cycles sealed segments in seal order "
+    "(deterministic — the crash-consistency contract leans on it); "
+    "prioritized draws episodes weighted by their append-time priority "
+    "from a seeded RNG.",
+    "tensor2robot_tpu/replay/service.py",
+    choices=("fifo", "prioritized"),
+)
+_declare(
+    "T2R_REPLAY_SEAL_BYTES",
+    _INT,
+    4 << 20,
+    "Auto-seal the open replay segment once it holds at least this many "
+    "payload bytes (whichever of the episode/byte thresholds trips "
+    "first).",
+    "tensor2robot_tpu/replay/service.py",
+    minimum=1,
+)
+_declare(
+    "T2R_REPLAY_SEAL_EPISODES",
+    _INT,
+    16,
+    "Auto-seal the open replay segment once it holds this many episodes "
+    "(the unsealed tail is the crash-loss bound: smaller seals = less "
+    "loss, more manifest overhead).",
+    "tensor2robot_tpu/replay/service.py",
+    minimum=1,
 )
 _declare(
     "T2R_SERVE_BUCKETS",

@@ -120,6 +120,20 @@ def pipeline_apply(
         mesh=mesh,
         in_specs=(spec_params, x_spec),
         out_specs=x_spec,
+        # Replication checking OFF for the pipeline program: jax's
+        # varying-manual-axes tracking loses the carry annotations when
+        # this shard_map's inner scan is differentiated under
+        # jax.checkpoint (partial-eval extends the scan carry with
+        # residual/tangent slots whose initializers are born unvarying,
+        # while the body emits them varying) — "Scan carry input and
+        # output got mismatched replication types", and jax's own error
+        # text prescribes check_rep=False as the workaround. Correctness
+        # does not lean on the static check here: tests/test_pipeline.py
+        # pins forward AND gradient equality against the sequential
+        # model, and tests/test_transformer_models.py pins the composed
+        # remat+grad_accum step. Minimal repro of the upstream bug:
+        # tests/test_pipeline.py::TestShardMapRematScanVma.
+        check_rep=False,
     )
     out = shard_mapped(stacked_params, micro)
     return jnp.reshape(out, (batch,) + out.shape[2:])

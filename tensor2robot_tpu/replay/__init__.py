@@ -1,0 +1,82 @@
+"""Crash-tolerant replay-buffer service + actor fleet: the online loop.
+
+The QT-Opt topology (arXiv:1806.10293) that every other subsystem was
+built for: actors run research envs against the serving fleet, append
+episodes to a replay buffer as tf.Example *wire bytes* (zero-parse
+append; the fast parser reads spans in place at sample time), and a
+learner trains from the buffer while publishing fresh policies back to
+the actors. This package is the connective tissue — built so that the
+failure modes distributed RL dies of in practice (actor SIGKILL
+mid-episode, replay-service restart, learner preemption, stale
+policies) are first-class, *tested* behaviors:
+
+  * `segment`  — CRC-framed episode segment files with seal-time
+                 durability manifests (the train/durability.py
+                 discipline applied to replay data): torn segments are
+                 never sampled, quarantined on startup sweep, and the
+                 crash-loss bound is exactly the unsealed tail —
+                 counted, not guessed.
+  * `service`  — ReplayBuffer (in-process core) + the replay service
+                 process, client, and respawning supervisor; FIFO /
+                 prioritized sampling; staleness + replay-ratio
+                 accounting.
+  * `input_generator` — the learner-side bridge: replay samples as
+                 spec-parsed batches (FastSpecParser over raw wire
+                 bytes, SpecParser fallback), deterministic in FIFO
+                 dir mode (the crash-consistency contract).
+  * `actor`    — episode collection off policy clients (serving-fleet
+                 gateway, local predictor, or seeded random), actor
+                 process entry, and the router gateway.
+  * `loop`     — the closed online loop harness used by `bench.py rl`
+                 and the chaos suites.
+
+Fault model + contract: docs/RESILIENCE.md "Online loop fault model";
+quickstart: docs/RL_LOOP.md.
+
+Exports resolve lazily (PEP 562): replay service and actor CHILD
+processes import `replay.service` / `replay.actor` through this
+package, and an eager import of `input_generator`/`loop` here would
+drag jax (via data/parser.py) into every jax-free worker.
+"""
+
+_EXPORTS = {
+    "SegmentManifest": "segment",
+    "SegmentReader": "segment",
+    "SegmentWriter": "segment",
+    "list_sealed_segments": "segment",
+    "salvage_open_segment": "segment",
+    "sweep_replay_dir": "segment",
+    "validate_segment": "segment",
+    "ReplayBuffer": "service",
+    "ReplayClient": "service",
+    "ReplayEmpty": "service",
+    "ReplayError": "service",
+    "ReplayServiceHandle": "service",
+    "ReplayUnavailable": "service",
+    "ReplayInputGenerator": "input_generator",
+    "EpisodeCollector": "actor",
+    "GatewayPolicyClient": "actor",
+    "LocalPolicyClient": "actor",
+    "RandomPolicyClient": "actor",
+    "RouterGateway": "actor",
+    "actor_main": "actor",
+    "LoopReport": "loop",
+    "OnlineLoop": "loop",
+    "PublishPolicyHook": "loop",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    module_name = _EXPORTS.get(name)
+    if module_name is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    module = importlib.import_module(f"{__name__}.{module_name}")
+    return getattr(module, name)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

@@ -1,0 +1,423 @@
+"""Actors: research envs driving episodes into the replay buffer.
+
+An actor is a loop around a policy client: reset the env task, ask the
+policy for an action, step, convert the finished episode to transition
+tf.Examples (`research/pose_env/episode_to_transitions.py`), serialize
+to wire bytes, and append the WHOLE episode to the replay buffer in one
+call. Episode-at-a-time append is the actor-crash contract: an actor
+SIGKILLed mid-episode has handed nothing to the buffer yet, so the
+crash drops exactly the partial episode and nothing else.
+
+Policy clients (the `act(obs) -> (action, policy_version)` seam):
+
+  * `GatewayPolicyClient` — the production topology: actions come from
+    the serving fleet. Actor processes cannot hold the driver's
+    FleetRouter, so a `RouterGateway` thread in the driver forwards
+    queue-borne requests into `router.submit()` and ships replies back;
+    the response's `model_version` is the policy version the episode is
+    stamped with (the staleness metric's raw material). Retries with
+    backoff through router hiccups; after the budget, falls back to a
+    seeded random action (counted — collection degrades, never stalls).
+  * `LocalPolicyClient` — in-process loops/tests: wraps any
+    `predict(features) -> outputs` callable plus a version supplier.
+  * `RandomPolicyClient` — seeded random actions (bring-up, baselines).
+
+Chaos: `actor_step` fires before every env step, under the actor
+process's `a<index>` scope — a seeded `kill` clause there is the
+actor-SIGKILL-mid-episode fault.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import queue
+import random
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from tensor2robot_tpu.replay.service import ReplayClient
+from tensor2robot_tpu.research.pose_env.episode_to_transitions import (
+    episode_to_transitions_pose_toy,
+)
+from tensor2robot_tpu.testing import chaos
+from tensor2robot_tpu.utils.errors import best_effort
+
+_log = logging.getLogger(__name__)
+
+__all__ = [
+    "EpisodeCollector",
+    "GatewayPolicyClient",
+    "LocalPolicyClient",
+    "RandomPolicyClient",
+    "RouterGateway",
+    "actor_main",
+]
+
+
+class RandomPolicyClient:
+    """Seeded uniform-random actions; policy version 0 (bring-up)."""
+
+    def __init__(self, seed: int = 0, action_size: int = 2):
+        self._rng = np.random.RandomState(seed)
+        self._action_size = action_size
+
+    def act(self, obs: np.ndarray) -> Tuple[np.ndarray, int]:
+        del obs
+        return (
+            self._rng.uniform(-1.0, 1.0, size=self._action_size).astype(
+                np.float32
+            ),
+            0,
+        )
+
+
+class LocalPolicyClient:
+    """In-process policy: wraps predict(features)->outputs + a version
+    supplier (the in-process online loop's client)."""
+
+    def __init__(
+        self,
+        predict_fn: Callable[[Dict[str, np.ndarray]], Dict[str, np.ndarray]],
+        version_fn: Callable[[], int],
+        feature_key: str = "state",
+        output_key: str = "inference_output",
+    ):
+        self._predict_fn = predict_fn
+        self._version_fn = version_fn
+        self._feature_key = feature_key
+        self._output_key = output_key
+
+    def act(self, obs: np.ndarray) -> Tuple[np.ndarray, int]:
+        outputs = self._predict_fn({self._feature_key: obs[None]})
+        action = np.asarray(outputs[self._output_key])[0]
+        return action.astype(np.float32), int(self._version_fn())
+
+
+class GatewayPolicyClient:
+    """Actor-process side of the serving-fleet gateway (see module doc).
+
+    Wire: puts (actor_id, req_id, obs) on the shared gateway request
+    queue, waits on its own response queue for (req_id, action, version,
+    error). Retries `retries` times with jittered backoff; exhausted,
+    returns a seeded random action with version -1 and bumps
+    `fallback_actions` — an actor must keep collecting through a
+    serving brown-out, and the stamp (-1) keeps those episodes honest
+    in the staleness accounting.
+    """
+
+    def __init__(
+        self,
+        actor_id: str,
+        request_q,
+        response_q,
+        timeout_s: float = 10.0,
+        retries: int = 3,
+        seed: int = 0,
+        action_size: int = 2,
+    ):
+        self._actor_id = actor_id
+        self._request_q = request_q
+        self._response_q = response_q
+        self._timeout_s = timeout_s
+        self._retries = retries
+        self._rng = np.random.RandomState(seed)
+        self._backoff = random.Random(seed)
+        self._action_size = action_size
+        # Opaque (instance token, counter) request ids, same rationale as
+        # ReplayClient: ids from different client instances sharing a
+        # queue must never alias.
+        self._token = f"{os.getpid()}-{id(self):x}"
+        self._req_counter = 0
+        self.fallback_actions = 0
+
+    def act(self, obs: np.ndarray) -> Tuple[np.ndarray, int]:
+        for attempt in range(self._retries + 1):
+            if attempt:
+                time.sleep(
+                    min(0.05 * (2 ** (attempt - 1))
+                        * (1 + self._backoff.random()), 1.0)
+                )
+            self._req_counter += 1
+            req_id = (self._token, self._req_counter)
+            try:
+                self._request_q.put(
+                    (self._actor_id, req_id, np.asarray(obs)), timeout=1.0
+                )
+            except (queue.Full, OSError, ValueError):
+                continue
+            deadline = time.monotonic() + self._timeout_s
+            while time.monotonic() < deadline:
+                try:
+                    response = self._response_q.get(
+                        timeout=max(deadline - time.monotonic(), 0.01)
+                    )
+                except queue.Empty:
+                    break
+                except (OSError, ValueError):
+                    break
+                if response[0] != req_id:
+                    continue  # stale reply from a timed-out attempt
+                _, action, version, error = response
+                if error is None:
+                    return (
+                        np.asarray(action, np.float32).reshape(-1)[
+                            : self._action_size
+                        ],
+                        int(version),
+                    )
+                break  # typed failure: next attempt
+        self.fallback_actions += 1
+        return (
+            self._rng.uniform(-1.0, 1.0, size=self._action_size).astype(
+                np.float32
+            ),
+            -1,
+        )
+
+
+class RouterGateway:
+    """Driver-side forwarder: gateway queues -> FleetRouter -> replies.
+
+    One thread drains the shared request queue and submits each request
+    to the router (non-blocking: the reply is posted from the router
+    future's done callback, so a slow replica never serializes other
+    actors' requests behind it).
+    """
+
+    def __init__(
+        self,
+        router,
+        actor_ids: Sequence[str],
+        mp_context=None,
+        feature_key: str = "state",
+        output_key: str = "inference_output",
+        deadline_ms: float = 2000.0,
+        version_translate: Optional[Dict[int, int]] = None,
+    ):
+        import multiprocessing
+        import threading
+
+        self._router = router
+        # Artifact model_versions are timestamp dir names; the loop keeps
+        # a {model_version: publish_counter} map (mutated on each
+        # publish, read here under the GIL) so episode stamps — and
+        # therefore staleness — count PUBLISHES, not timestamps.
+        self._version_translate = (
+            version_translate if version_translate is not None else {}
+        )
+        self._ctx = mp_context or multiprocessing.get_context("spawn")
+        self.request_q = self._ctx.Queue()
+        self.response_queues = {
+            actor_id: self._ctx.Queue() for actor_id in actor_ids
+        }
+        self._feature_key = feature_key
+        self._output_key = output_key
+        self._deadline_ms = deadline_ms
+        self._closed = False
+        self.requests_served = 0
+        self.requests_failed = 0
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def start(self) -> "RouterGateway":
+        self._thread.start()
+        return self
+
+    def actor_queues(self, actor_id: str):
+        return self.request_q, self.response_queues[actor_id]
+
+    def _reply(self, actor_id: str, message) -> None:
+        out = self.response_queues.get(actor_id)
+        if out is not None:
+            best_effort(out.put, message)
+
+    def _loop(self) -> None:
+        while not self._closed:
+            try:
+                actor_id, req_id, obs = self.request_q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            except (OSError, ValueError):
+                return
+            features = {self._feature_key: np.asarray(obs)[None]}
+            try:
+                future = self._router.submit(
+                    features, deadline_ms=self._deadline_ms
+                )
+            except Exception as err:
+                self.requests_failed += 1
+                self._reply(
+                    actor_id, (req_id, None, -1, f"{type(err).__name__}: {err}")
+                )
+                continue
+
+            def on_done(f, actor_id=actor_id, req_id=req_id):
+                error = f.error()
+                if error is not None:
+                    self.requests_failed += 1
+                    self._reply(
+                        actor_id,
+                        (req_id, None, -1,
+                         f"{type(error).__name__}: {error}"),
+                    )
+                    return
+                response = f.result(0)
+                action = np.asarray(
+                    response.outputs[self._output_key]
+                )[0]
+                self.requests_served += 1
+                raw_version = int(response.model_version)
+                version = self._version_translate.get(raw_version)
+                if version is None:
+                    # A version published before this gateway learned its
+                    # mapping: stamp the newest counter we know (never
+                    # the raw timestamp — it would poison staleness).
+                    version = max(
+                        self._version_translate.values(), default=0
+                    )
+                self._reply(
+                    actor_id, (req_id, action, version, None)
+                )
+
+            future.add_done_callback(on_done)
+
+    def stop(self) -> None:
+        self._closed = True
+        self._thread.join(5.0)
+        best_effort(self.request_q.close)
+        for response_q in self.response_queues.values():
+            best_effort(response_q.close)
+
+
+class EpisodeCollector:
+    """Runs episodes on a PoseToyEnv-shaped env and serializes them.
+
+    `collect()` returns (wire_records, info): one serialized tf.Example
+    per transition, plus the episode's policy version, raw/relabeled
+    reward and step count. Rewards are relabeled through
+    `binary_success_threshold` (the env's raw reward is a negative
+    distance; downstream reward-weighted losses need non-negative
+    weights — research/pose_env/episode_to_transitions.py).
+    """
+
+    def __init__(
+        self,
+        env,
+        policy_client,
+        binary_success_threshold: float = -0.35,
+        max_steps: int = 1,
+    ):
+        self._env = env
+        self._policy = policy_client
+        self._threshold = binary_success_threshold
+        self._max_steps = max_steps
+
+    def collect(self) -> Tuple[List[bytes], Dict[str, Any]]:
+        self._env.reset_task()
+        obs = self._env.reset()
+        episode = []
+        versions: List[int] = []
+        raw_reward = 0.0
+        for _ in range(self._max_steps):
+            chaos.maybe_fire("actor_step")
+            action, version = self._policy.act(obs)
+            versions.append(version)
+            new_obs, reward, done, debug = self._env.step(action)
+            episode.append((obs, action, reward, new_obs, done, debug))
+            raw_reward += float(reward)
+            obs = new_obs
+            if done:
+                break
+        examples = episode_to_transitions_pose_toy(
+            episode, binary_success_threshold=self._threshold
+        )
+        records = [example.SerializeToString() for example in examples]
+        successes = sum(
+            1 for (_, _, reward, _, _, _) in episode
+            if reward > self._threshold
+        )
+        info = {
+            "policy_version": min(versions) if versions else -1,
+            "raw_reward": raw_reward,
+            "successes": successes,
+            "steps": len(episode),
+            # Successful episodes get double weight under prioritized
+            # sampling; failures still replay (exploration signal).
+            "priority": 1.0 + float(successes),
+        }
+        return records, info
+
+
+def actor_main(
+    actor_id: int,
+    replay_queues,
+    gateway_queues=None,
+    num_episodes: int = 0,
+    seed: int = 0,
+    binary_success_threshold: float = -0.35,
+    hidden_drift: bool = False,
+    report_q=None,
+    throttle_s: float = 0.0,
+) -> None:
+    """Actor process entry (spawn-safe: queue objects ride the args).
+
+    Collects `num_episodes` episodes (0 = until the replay append path
+    raises, i.e. supervisor teardown), appending each whole episode with
+    its policy version + priority. Declares chaos scope `a<actor_id>` so
+    seeded plans can target one actor (`a1/actor_step:3:kill` is the
+    actor-SIGKILL-mid-episode fault). Posts a final summary dict on
+    `report_q` when given.
+    """
+    from tensor2robot_tpu.research.pose_env.pose_env import PoseToyEnv
+
+    chaos.set_scope(f"a{actor_id}")
+    request_q, response_q = replay_queues
+    replay = ReplayClient(
+        f"actor-{actor_id}", request_q, response_q, seed=seed
+    )
+    if gateway_queues is not None:
+        policy: Any = GatewayPolicyClient(
+            f"actor-{actor_id}", gateway_queues[0], gateway_queues[1],
+            seed=seed,
+        )
+    else:
+        policy = RandomPolicyClient(seed=seed)
+    env = PoseToyEnv(seed=seed, hidden_drift=hidden_drift)
+    collector = EpisodeCollector(
+        env, policy, binary_success_threshold=binary_success_threshold
+    )
+    episodes = 0
+    appended = 0
+    rewards: List[float] = []
+    try:
+        while num_episodes == 0 or episodes < num_episodes:
+            records, info = collector.collect()
+            episodes += 1
+            rewards.append(info["raw_reward"])
+            replay.append(
+                records,
+                policy_version=max(info["policy_version"], 0),
+                priority=info["priority"],
+            )
+            appended += 1
+            if throttle_s:
+                time.sleep(throttle_s)
+    finally:
+        if report_q is not None:
+            best_effort(
+                report_q.put,
+                {
+                    "actor_id": actor_id,
+                    "pid": os.getpid(),
+                    "episodes": episodes,
+                    "appended": appended,
+                    "mean_reward": (
+                        float(np.mean(rewards)) if rewards else 0.0
+                    ),
+                    "fallback_actions": getattr(
+                        policy, "fallback_actions", 0
+                    ),
+                },
+            )

@@ -1,0 +1,265 @@
+"""Learner-side replay consumption: wire bytes -> spec-parsed batches.
+
+`ReplayInputGenerator` is the bridge between the replay buffer and the
+trainer: it draws raw tf.Example wire-bytes records (zero-copy spans
+out of sealed segments) and parses them with the model's own in-specs
+through `data/wire.FastSpecParser` — the spans are read in place at
+sample time, never on the append path — with `SpecParser` as the
+per-batch fallback oracle, the same discipline `data/dataset.py` uses.
+
+Two sources, one contract:
+
+  * `source="dir"` — reads sealed segments straight off disk with a
+    private FIFO sampler. Deterministic: given the same directory
+    contents, batch k is the same records for every run — which is what
+    lets `train_eval_model`'s host-batch realignment (islice to the
+    restored step) restore the SAMPLING STATE of a crashed learner
+    exactly: the resumed run consumes batches [start_step:] of the very
+    schedule the uninterrupted run would have drawn, so no sealed
+    segment is ever double-sampled relative to that schedule. Sampled
+    (segment_seq, record_index) coordinates are logged per batch
+    (`coords_log`) as the audit trail the crash tests pin.
+  * `source=<ReplayClient>` — samples through the live service (the
+    online loop): blocks politely while the buffer is still empty
+    (actors haven't sealed a first segment yet), rides client retries
+    through service restarts, and surfaces the service's staleness
+    numbers per batch.
+
+Batches are packed as {features/..., labels/...} TensorSpecStructs —
+exactly what `train_eval_model` consumes.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import time
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from tensor2robot_tpu.data.parser import SpecParser
+from tensor2robot_tpu.data.wire import FastSpecParser
+from tensor2robot_tpu.data.input_generators import AbstractInputGenerator
+from tensor2robot_tpu.replay import segment as segment_lib
+from tensor2robot_tpu.replay.service import (
+    ReplayClient,
+    ReplayEmpty,
+    ReplayUnavailable,
+    _FifoSampler,
+)
+from tensor2robot_tpu.specs import TensorSpecStruct
+
+_log = logging.getLogger(__name__)
+
+__all__ = ["ReplayInputGenerator"]
+
+
+class _DirFifo(_FifoSampler):
+    """Deterministic FIFO over the sealed segments of a directory: the
+    dir-mode sampler (no service round trip, no shared cursor).
+
+    The cursor/wrap schedule IS `service._FifoSampler` — one
+    implementation, so the dir-mode and service-mode FIFO schedules can
+    never silently diverge (the crash-consistency contract names them
+    as the same schedule). This wrapper adds discovery: sealed files
+    are immutable, so `refresh` lists names cheaply and pays the
+    full-file CRC validation once per NEWLY seen seq, not per poll.
+    """
+
+    def __init__(self, root: str):
+        super().__init__(root)
+        self._checked: set = set()
+        self.refresh()
+
+    def refresh(self) -> None:
+        for seq in segment_lib.sealed_segment_seqs(self._root):
+            if seq in self._checked:
+                continue
+            self._checked.add(seq)
+            if segment_lib.validate_segment(self._root, seq) is None:
+                self.note_sealed(seq)
+
+    def empty(self) -> bool:
+        return not self._order
+
+    def draw_records(self, n: int):
+        coords = self.draw(n)
+        records: List[bytes] = []
+        versions: List[int] = []
+        for record in self.read(coords):
+            records.append(bytes(record.payload))
+            versions.append(record.policy_version)
+        return records, coords, versions
+
+
+class ReplayInputGenerator(AbstractInputGenerator):
+    """Batches for the learner out of a replay directory or service.
+
+    Args:
+      replay_root: the replay directory (dir mode reads it directly;
+        also used for bookkeeping in service mode).
+      batch_size: records per batch.
+      client: a ReplayClient — service mode. None -> dir mode.
+      wait_timeout_s: how long to wait for a first sealed segment
+        before giving up (both modes; bring-up patience).
+      refresh: dir mode only — rescan for newly sealed segments when
+        the FIFO wraps (the in-process online loop); off (the default)
+        the segment set is frozen at iterator start, which is what the
+        deterministic crash tests want.
+    """
+
+    def __init__(
+        self,
+        replay_root: str,
+        batch_size: int = 32,
+        client: Optional[ReplayClient] = None,
+        wait_timeout_s: float = 60.0,
+        refresh: bool = False,
+        staleness_anchor=None,
+    ):
+        super().__init__(batch_size=batch_size)
+        self._root = replay_root
+        self._client = client
+        self._wait_timeout_s = wait_timeout_s
+        self._refresh = refresh
+        # Dir mode computes staleness itself (there is no service to ask):
+        # anchor() -> the current published policy version.
+        self._staleness_anchor = staleness_anchor
+        self._parser: Optional[SpecParser] = None
+        self._fast: Optional[FastSpecParser] = None
+        # Observability: per-batch audit trail + running digest over the
+        # sampled (segment_seq, record_index) schedule. The digest is
+        # printable from a subprocess trainer, which is how the
+        # crash-consistency suite proves a resumed run continued the
+        # uninterrupted run's exact sample schedule. The log is BOUNDED
+        # (oldest batches trimmed past coords_log_limit, trim count in
+        # coords_log_dropped): a multi-day online learner must not grow
+        # an unbounded coordinate list — the digest stays complete.
+        self.coords_log: List[List[Tuple[int, int]]] = []
+        self.coords_log_limit = 4096
+        self.coords_log_dropped = 0
+        self.batches_drawn = 0
+        self.last_staleness: Dict[str, float] = {}
+        self._schedule_digest = hashlib.sha256()
+
+    # -- parsing ---------------------------------------------------------------
+
+    def _ensure_parsers(self) -> None:
+        if self._parser is None:
+            spec = self.combined_spec()
+            self._parser = SpecParser(spec)
+            fast = FastSpecParser(spec)
+            self._fast = fast if fast.supported else None
+            if self._fast is None:
+                _log.info(
+                    "replay fast parse unsupported for this spec; using "
+                    "SpecParser oracle"
+                )
+
+    def _parse(self, records: List[bytes]) -> TensorSpecStruct:
+        self._ensure_parsers()
+        if self._fast is not None:
+            try:
+                return self._fast.parse_batch(records)
+            except Exception:
+                self._fast.fallbacks += 1
+                _log.warning(
+                    "replay fast parse failed for a batch; re-parsing "
+                    "with SpecParser"
+                )
+        return self._parser.parse_batch(records)
+
+    def schedule_digest(self) -> str:
+        """sha256 over every (segment_seq, record_index) sampled so far,
+        in order — equal digests == identical sample schedules."""
+        return self._schedule_digest.hexdigest()
+
+    def _note_batch(self, coords) -> None:
+        coords = [(int(a), int(b)) for a, b in coords]
+        self.coords_log.append(coords)
+        if len(self.coords_log) > self.coords_log_limit:
+            drop = len(self.coords_log) - self.coords_log_limit
+            del self.coords_log[:drop]
+            self.coords_log_dropped += drop
+        self.batches_drawn += 1
+        for a, b in coords:
+            self._schedule_digest.update(f"{a}:{b};".encode())
+
+    # -- batch stream ----------------------------------------------------------
+
+    def _wait_predicate(self, ready, what: str):
+        deadline = time.monotonic() + self._wait_timeout_s
+        while True:
+            result = ready()
+            if result:
+                return result
+            if time.monotonic() >= deadline:
+                raise ReplayEmpty(
+                    f"replay buffer produced no {what} within "
+                    f"{self._wait_timeout_s}s"
+                )
+            time.sleep(0.05)
+
+    def _dir_batches(self) -> Iterator[TensorSpecStruct]:
+        fifo = _DirFifo(self._root)
+
+        def ready():
+            fifo.refresh()
+            return not fifo.empty()
+
+        self._wait_predicate(ready, "sealed segment")
+        while True:
+            if self._refresh:
+                fifo.refresh()
+            records, coords, versions = fifo.draw_records(self._batch_size)
+            if self._staleness_anchor is not None:
+                anchor = int(self._staleness_anchor())
+                staleness = [max(0, anchor - v) for v in versions]
+                self.last_staleness = {
+                    "staleness_mean": (
+                        sum(staleness) / max(len(staleness), 1)
+                    ),
+                    "staleness_max": float(max(staleness, default=0)),
+                }
+            self._note_batch(coords)
+            yield self._pack(self._parse(records))
+
+    def _service_batches(self) -> Iterator[TensorSpecStruct]:
+        client = self._client
+        assert client is not None
+        while True:
+            try:
+                records, coords, info = client.sample(self._batch_size)
+            except (ReplayEmpty, ReplayUnavailable) as err:
+                # Bring-up or a service mid-restart: wait it out within
+                # the generator's own patience, then surface. Each poll
+                # is SHORT (no retries, 2 s) so wait_timeout_s is a real
+                # bound — the client's full retry budget per poll would
+                # multiply the configured patience.
+                def ready():
+                    try:
+                        return client.sample(
+                            self._batch_size, wait_for_data=False,
+                            timeout_s=2.0, retries=0,
+                        )
+                    except (ReplayEmpty, ReplayUnavailable):
+                        return None
+
+                result = self._wait_predicate(ready, f"batch ({err})")
+                records, coords, info = result
+            self.last_staleness = dict(info)
+            self._note_batch(coords)
+            yield self._pack(self._parse(records))
+
+    def _pack(self, parsed: TensorSpecStruct) -> TensorSpecStruct:
+        out = TensorSpecStruct()
+        for key, value in parsed.items():
+            out[key] = np.asarray(value)
+        return out
+
+    def _create_dataset(self, mode: str) -> Iterator[TensorSpecStruct]:
+        del mode  # replay data is mode-less: the specs already chose
+        if self._client is not None:
+            return self._service_batches()
+        return self._dir_batches()

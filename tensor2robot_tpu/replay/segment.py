@@ -1,0 +1,518 @@
+"""Durable episode segments: CRC-framed wire bytes + seal manifests.
+
+The replay buffer's unit of durability. Actors append whole episodes as
+serialized tf.Example records (wire bytes — nothing is parsed on the
+append path); the writer accumulates them into an *open* segment file
+and periodically *seals* it. Only sealed segments are ever sampled, so
+the crash-loss bound of a replay-service SIGKILL is exactly the open
+tail — and because every record is CRC-framed, that loss is *counted*
+(salvage scans the torn tail) rather than guessed.
+
+On-disk layout (`<root>/`):
+
+    segment-00000012.seg        sealed data file (frames, below)
+    segment-00000012.json       seal manifest (atomic tmp+replace)
+    segment-00000013.open       the open tail (torn after a crash)
+    replay_state.json           writer counters (atomic tmp+replace)
+    replay.quarantine/          swept wreckage (forensics, never deleted)
+
+Frame format (little-endian), one frame per transition record:
+
+    u32 payload_length
+    u32 crc32(payload)
+    u32 episode_seq      (segment-local; groups a multi-step episode)
+    u32 policy_version   (the policy that generated this transition)
+    payload              (tf.Example wire bytes, untouched)
+
+Seal discipline (mirrors train/durability.py's manifest contract):
+flush + fsync the data file, write `segment-<seq>.json` with the
+record/episode counts, byte size, whole-file CRC and per-episode
+priorities via tmp + `os.replace`, then rename `.open` -> `.seg`.
+Validation therefore never trusts a name: a `.seg` without a readable
+manifest, or whose size/CRC disagree with it, is torn. Writers
+quarantine torn forms at startup (`sweep_replay_dir`); readers only
+ever skip.
+
+Chaos hooks: the service fires `append` before a record batch is
+written and `seal` before the manifest is published (testing/chaos.py),
+so a seeded plan can SIGKILL mid-append or mid-seal and the suite can
+pin what survives.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import shutil
+import struct
+import time
+import zlib
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+_log = logging.getLogger(__name__)
+
+__all__ = [
+    "FRAME_HEADER",
+    "SegmentManifest",
+    "SegmentRecord",
+    "SegmentReader",
+    "SegmentWriter",
+    "list_sealed_segments",
+    "open_segment_path",
+    "quarantine_root",
+    "salvage_open_segment",
+    "sealed_segment_path",
+    "manifest_path",
+    "sweep_replay_dir",
+    "validate_segment",
+]
+
+FRAME_HEADER = struct.Struct("<IIII")  # length, crc32, episode_seq, version
+_MANIFEST_VERSION = 1
+QUARANTINE_DIRNAME = "replay.quarantine"
+
+
+def sealed_segment_path(root: str, seq: int) -> str:
+    return os.path.join(root, f"segment-{seq:08d}.seg")
+
+
+def open_segment_path(root: str, seq: int) -> str:
+    return os.path.join(root, f"segment-{seq:08d}.open")
+
+
+def manifest_path(root: str, seq: int) -> str:
+    return os.path.join(root, f"segment-{seq:08d}.json")
+
+
+def quarantine_root(root: str) -> str:
+    return os.path.join(root, QUARANTINE_DIRNAME)
+
+
+@dataclasses.dataclass(frozen=True)
+class SegmentManifest:
+    """Seal-time inventory of one segment: what a reader may trust."""
+
+    seq: int
+    records: int
+    episodes: int
+    data_bytes: int
+    data_crc32: int
+    # Per-episode priorities in episode_seq order (prioritized sampling
+    # draws by these; FIFO ignores them).
+    priorities: Tuple[float, ...] = ()
+    min_policy_version: int = 0
+    max_policy_version: int = 0
+
+    def to_json(self) -> Dict:
+        return {
+            "version": _MANIFEST_VERSION,
+            "seq": self.seq,
+            "records": self.records,
+            "episodes": self.episodes,
+            "data_bytes": self.data_bytes,
+            "data_crc32": self.data_crc32,
+            "priorities": list(self.priorities),
+            "min_policy_version": self.min_policy_version,
+            "max_policy_version": self.max_policy_version,
+        }
+
+    @staticmethod
+    def from_json(payload: Dict) -> "SegmentManifest":
+        return SegmentManifest(
+            seq=int(payload["seq"]),
+            records=int(payload["records"]),
+            episodes=int(payload["episodes"]),
+            data_bytes=int(payload["data_bytes"]),
+            data_crc32=int(payload["data_crc32"]),
+            priorities=tuple(float(p) for p in payload.get("priorities", ())),
+            min_policy_version=int(payload.get("min_policy_version", 0)),
+            max_policy_version=int(payload.get("max_policy_version", 0)),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class SegmentRecord:
+    """One framed transition: a zero-copy span into the segment bytes."""
+
+    episode_seq: int
+    policy_version: int
+    payload: memoryview  # into the reader's buffer — valid while it lives
+
+
+def _atomic_write_json(path: str, payload: Dict) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=1, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+class SegmentWriter:
+    """Owns one open segment file; zero-parse episode appends + seal.
+
+    Append granularity is the EPISODE: all of an episode's records are
+    written in one buffered write followed by one flush, so a crash of
+    the *caller* between episodes never tears a record, and a crash of
+    this process mid-write tears at most the final episode (the salvage
+    scan recovers every whole frame before the tear).
+    """
+
+    def __init__(self, root: str, seq: int):
+        self.root = root
+        self.seq = seq
+        self.records = 0
+        self.episodes = 0
+        self.data_bytes = 0
+        self._crc = 0
+        self._priorities: List[float] = []
+        self._min_version: Optional[int] = None
+        self._max_version: Optional[int] = None
+        self._path = open_segment_path(root, seq)
+        self._file = open(self._path, "ab")
+
+    @property
+    def path(self) -> str:
+        return self._path
+
+    def append_episode(
+        self,
+        transitions: Sequence[bytes],
+        policy_version: int = 0,
+        priority: float = 1.0,
+    ) -> int:
+        """Appends one whole episode (a sequence of wire-bytes records);
+        returns this episode's segment-local episode_seq."""
+        if not transitions:
+            raise ValueError("an episode must carry at least one record")
+        episode_seq = self.episodes
+        chunks: List[bytes] = []
+        for payload in transitions:
+            payload = bytes(payload)
+            chunks.append(
+                FRAME_HEADER.pack(
+                    len(payload),
+                    zlib.crc32(payload) & 0xFFFFFFFF,
+                    episode_seq,
+                    policy_version,
+                )
+            )
+            chunks.append(payload)
+        blob = b"".join(chunks)
+        self._file.write(blob)
+        self._file.flush()
+        self._crc = zlib.crc32(blob, self._crc) & 0xFFFFFFFF
+        self.data_bytes += len(blob)
+        self.records += len(transitions)
+        self.episodes += 1
+        self._priorities.append(float(priority))
+        if self._min_version is None or policy_version < self._min_version:
+            self._min_version = policy_version
+        if self._max_version is None or policy_version > self._max_version:
+            self._max_version = policy_version
+        return episode_seq
+
+    def seal(self) -> Optional[SegmentManifest]:
+        """Publishes this segment durably; returns its manifest (None for
+        an empty segment, which is simply discarded).
+
+        Order matters: fsync data -> atomic manifest write -> rename to
+        the sealed name. A crash between any two steps leaves a form
+        validate_segment()/sweep_replay_dir() classify as torn — never
+        a sealed-looking segment a sampler would trust.
+        """
+        self._file.flush()
+        os.fsync(self._file.fileno())
+        self._file.close()
+        if self.records == 0:
+            os.unlink(self._path)
+            return None
+        manifest = SegmentManifest(
+            seq=self.seq,
+            records=self.records,
+            episodes=self.episodes,
+            data_bytes=self.data_bytes,
+            data_crc32=self._crc,
+            priorities=tuple(self._priorities),
+            min_policy_version=self._min_version or 0,
+            max_policy_version=self._max_version or 0,
+        )
+        _atomic_write_json(manifest_path(self.root, self.seq), manifest.to_json())
+        os.rename(self._path, sealed_segment_path(self.root, self.seq))
+        return manifest
+
+    def abort(self) -> None:
+        """Closes the file handle without sealing. A NON-empty open tail
+        stays on disk for the next sweep to count + quarantine; an empty
+        one (a writer opened but never appended to — every clean
+        shutdown leaves one) is just removed: it holds no data and no
+        forensic value."""
+        try:
+            self._file.close()
+        except OSError:
+            pass
+        if self.records == 0:
+            try:
+                if os.path.getsize(self._path) == 0:
+                    os.unlink(self._path)
+            except OSError:
+                pass
+
+
+def _scan_frames(buffer: bytes) -> Tuple[List[Tuple[int, int, int, int]], int]:
+    """Scans CRC-valid whole frames from the start of `buffer`.
+
+    Returns ([(offset, length, episode_seq, policy_version)], clean_end):
+    spans of every frame whose header fits, whose payload fits, and whose
+    CRC verifies, stopping at the first violation. clean_end is the byte
+    offset where scanning stopped (== len(buffer) iff the file is whole).
+    """
+    spans: List[Tuple[int, int, int, int]] = []
+    pos = 0
+    size = len(buffer)
+    while pos + FRAME_HEADER.size <= size:
+        length, crc, episode_seq, version = FRAME_HEADER.unpack_from(
+            buffer, pos
+        )
+        start = pos + FRAME_HEADER.size
+        end = start + length
+        if end > size:
+            break
+        if zlib.crc32(buffer[start:end]) & 0xFFFFFFFF != crc:
+            break
+        spans.append((start, length, episode_seq, version))
+        pos = end
+    return spans, pos
+
+
+class SegmentReader:
+    """Read-only view over one SEALED segment: manifest-validated, whole
+    file read once, records exposed as zero-copy payload spans."""
+
+    def __init__(self, root: str, seq: int):
+        reason = validate_segment(root, seq)
+        if reason is not None:
+            raise ValueError(
+                f"segment {seq} under {root} is not durable: {reason}"
+            )
+        with open(manifest_path(root, seq)) as f:
+            self.manifest = SegmentManifest.from_json(json.load(f))
+        with open(sealed_segment_path(root, seq), "rb") as f:
+            self._buffer = f.read()
+        spans, clean_end = _scan_frames(self._buffer)
+        if clean_end != len(self._buffer) or len(spans) != self.manifest.records:
+            # validate_segment checked size+CRC of the whole file, so this
+            # is a frame-level inconsistency (e.g. manifest forged around
+            # corrupt framing): refuse, same as torn.
+            raise ValueError(
+                f"segment {seq}: framing disagrees with manifest "
+                f"({len(spans)} scanned vs {self.manifest.records} declared)"
+            )
+        self._spans = spans
+        self._view = memoryview(self._buffer)
+
+    def __len__(self) -> int:
+        return len(self._spans)
+
+    def record(self, index: int) -> SegmentRecord:
+        offset, length, episode_seq, version = self._spans[index]
+        return SegmentRecord(
+            episode_seq=episode_seq,
+            policy_version=version,
+            payload=self._view[offset:offset + length],
+        )
+
+    def records(self) -> Iterator[SegmentRecord]:
+        for index in range(len(self._spans)):
+            yield self.record(index)
+
+    def episode_record_indices(self) -> Dict[int, List[int]]:
+        """{episode_seq: [record index, ...]} (prioritized sampling draws
+        episodes, then serves their records)."""
+        by_episode: Dict[int, List[int]] = {}
+        for index, (_, _, episode_seq, _) in enumerate(self._spans):
+            by_episode.setdefault(episode_seq, []).append(index)
+        return by_episode
+
+
+def validate_segment(root: str, seq: int) -> Optional[str]:
+    """None when sealed segment `seq` is durable, else a torn-reason.
+    Read-only — safe on a live directory (readers skip, never sweep)."""
+    data_path = sealed_segment_path(root, seq)
+    if not os.path.isfile(data_path):
+        if os.path.isfile(open_segment_path(root, seq)):
+            return "segment still open (unsealed tail)"
+        return "sealed data file missing"
+    mpath = manifest_path(root, seq)
+    if not os.path.isfile(mpath):
+        return "no seal manifest (crash between data write and seal)"
+    try:
+        with open(mpath) as f:
+            manifest = SegmentManifest.from_json(json.load(f))
+    except (OSError, ValueError, KeyError) as err:
+        return f"unreadable seal manifest: {err}"
+    actual = os.path.getsize(data_path)
+    if actual != manifest.data_bytes:
+        return (
+            f"size mismatch: data file is {actual} bytes, manifest says "
+            f"{manifest.data_bytes}"
+        )
+    with open(data_path, "rb") as f:
+        crc = 0
+        while True:
+            block = f.read(1 << 20)
+            if not block:
+                break
+            crc = zlib.crc32(block, crc) & 0xFFFFFFFF
+    if crc != manifest.data_crc32:
+        return (
+            f"CRC mismatch: data file crc32 {crc:#010x}, manifest says "
+            f"{manifest.data_crc32:#010x}"
+        )
+    return None
+
+
+def sealed_segment_seqs(root: str) -> List[int]:
+    """Seqs with a sealed-NAMED data file, ascending — a pure listdir,
+    NO validation (sealed files are immutable, so pollers validate each
+    seq once when they first see it, not on every tick)."""
+    if not os.path.isdir(root):
+        return []
+    out = []
+    for name in os.listdir(root):
+        if name.startswith("segment-") and name.endswith(".seg"):
+            try:
+                out.append(int(name[len("segment-"):-len(".seg")]))
+            except ValueError:
+                continue
+    return sorted(out)
+
+
+def list_sealed_segments(root: str) -> List[Tuple[int, SegmentManifest]]:
+    """Durable (seq, manifest) pairs ascending by seq; skips torn forms
+    (read-only: usable by concurrent readers of a live dir)."""
+    if not os.path.isdir(root):
+        return []
+    out: List[Tuple[int, SegmentManifest]] = []
+    for name in sorted(os.listdir(root)):
+        if not (name.startswith("segment-") and name.endswith(".seg")):
+            continue
+        try:
+            seq = int(name[len("segment-"):-len(".seg")])
+        except ValueError:
+            continue
+        if validate_segment(root, seq) is not None:
+            continue
+        with open(manifest_path(root, seq)) as f:
+            out.append((seq, SegmentManifest.from_json(json.load(f))))
+    return out
+
+
+def salvage_open_segment(path: str) -> Tuple[int, int, int]:
+    """Counts what a torn open segment held: (whole_records,
+    whole_episodes, torn_tail_bytes). The records themselves are NOT
+    recovered into the live buffer — a crash mid-append may have lost
+    the episode's remaining records, and a partial episode must never
+    be sampled — but the loss is thereby *counted*, which is the
+    bounded-loss report the recovery contract promises."""
+    with open(path, "rb") as f:
+        buffer = f.read()
+    spans, clean_end = _scan_frames(buffer)
+    episodes = len({episode_seq for _, _, episode_seq, _ in spans})
+    return len(spans), episodes, len(buffer) - clean_end
+
+
+def sweep_replay_dir(root: str) -> Dict[str, int]:
+    """WRITER-ONLY startup sweep: quarantines every torn form (open
+    tails, sealed-named segments that fail validation, orphan
+    manifests) into replay.quarantine/ and counts the loss.
+
+    Returns {"segments_quarantined", "episodes_lost", "records_lost",
+    "torn_tail_bytes"}. Like train/durability.py's sweep: never deletes
+    (the quarantined tree is the crash forensics), and must only run in
+    the process that OWNS the directory — a reader sweeping a live dir
+    would quarantine the write in progress.
+    """
+    report = {
+        "segments_quarantined": 0,
+        "episodes_lost": 0,
+        "records_lost": 0,
+        "torn_tail_bytes": 0,
+    }
+    if not os.path.isdir(root):
+        return report
+
+    def quarantine(name: str, reason: str) -> None:
+        src = os.path.join(root, name)
+        dest_dir = quarantine_root(root)
+        os.makedirs(dest_dir, exist_ok=True)
+        dest = os.path.join(dest_dir, f"{name}.{int(time.time() * 1e3)}")
+        while os.path.exists(dest):
+            dest += "x"
+        shutil.move(src, dest)
+        _log.warning("Quarantined replay wreckage %s -> %s (%s)",
+                     src, dest, reason)
+
+    names = sorted(os.listdir(root))
+    seen_seqs = set()
+    for name in names:
+        if name.endswith(".open") and name.startswith("segment-"):
+            records, episodes, tail = salvage_open_segment(
+                os.path.join(root, name)
+            )
+            report["records_lost"] += records
+            report["episodes_lost"] += episodes
+            report["torn_tail_bytes"] += tail
+            quarantine(name, f"unsealed tail ({episodes} episodes lost)")
+            report["segments_quarantined"] += 1
+        elif name.endswith(".seg") and name.startswith("segment-"):
+            try:
+                seq = int(name[len("segment-"):-len(".seg")])
+            except ValueError:
+                continue
+            seen_seqs.add(seq)
+            reason = validate_segment(root, seq)
+            if reason is None:
+                continue
+            # Count what the torn sealed form held before it moves: the
+            # manifest's declared counts when it is readable (truncation
+            # can tear frames the salvage scan cannot count), else the
+            # frame salvage.
+            episodes = records = tail = None
+            mpath = manifest_path(root, seq)
+            if os.path.isfile(mpath):
+                try:
+                    with open(mpath) as f:
+                        manifest = SegmentManifest.from_json(json.load(f))
+                    episodes, records, tail = (
+                        manifest.episodes, manifest.records, 0
+                    )
+                except (OSError, ValueError, KeyError):
+                    pass
+            if episodes is None:
+                records, episodes, tail = salvage_open_segment(
+                    os.path.join(root, name)
+                )
+            report["records_lost"] += records
+            report["episodes_lost"] += episodes
+            report["torn_tail_bytes"] += tail
+            quarantine(name, reason)
+            mname = os.path.basename(manifest_path(root, seq))
+            if os.path.isfile(os.path.join(root, mname)):
+                quarantine(mname, reason)
+            report["segments_quarantined"] += 1
+    # Orphan manifests (data file gone entirely).
+    for name in names:
+        if not (name.startswith("segment-") and name.endswith(".json")):
+            continue
+        try:
+            seq = int(name[len("segment-"):-len(".json")])
+        except ValueError:
+            continue
+        if seq in seen_seqs or not os.path.isfile(os.path.join(root, name)):
+            continue
+        if not os.path.isfile(sealed_segment_path(root, seq)):
+            quarantine(name, "orphan manifest (data file missing)")
+    return report

@@ -1,0 +1,883 @@
+"""Replay-buffer service: durable appends, FIFO/prioritized sampling,
+staleness + replay-ratio accounting, and a crash-respawning process
+wrapper.
+
+Three layers, outermost optional:
+
+  * `ReplayBuffer` — the in-process core every path shares: owns the
+    replay directory (startup sweep quarantines torn segments and
+    COUNTS the loss), appends episodes zero-parse into the open
+    segment, auto-seals on the episode/byte thresholds
+    (`T2R_REPLAY_SEAL_EPISODES` / `T2R_REPLAY_SEAL_BYTES`), samples
+    only sealed segments, and keeps the loop's observability counters.
+  * `replay_service_main` + `ReplayClient` — the service as a process:
+    clients (actors, the learner, the driver) talk over multiprocessing
+    queues with CRC-checked payload framing inherited from the wire
+    discipline; append retries are IDEMPOTENT (per-client nonces, so an
+    ambiguous crash-during-append retry cannot duplicate an episode).
+  * `ReplayServiceHandle` — the supervisor: spawns the service, detects
+    its death, respawns it on the same queues (the restarted process
+    recovers from durable segments — the sweep report is surfaced in
+    stats), and exposes `kill()` for chaos legs.
+
+Chaos sites (testing/chaos.py): `append` fires before an episode's
+frames are written, `seal` before a manifest is published, `sample`
+before a batch is drawn — a seeded `kill` clause at any of them is the
+corresponding crash fault, and `flake:N` clauses exercise the client
+retry path end to end.
+
+Failure semantics clients can rely on: every call either returns,
+raises a typed `ReplayError` subclass, or (service dead mid-call)
+retries with jittered backoff up to `T2R_REPLAY_RETRIES` times before
+raising `ReplayUnavailable`. Nothing hangs unboundedly.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import queue
+import random
+import signal
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from tensor2robot_tpu import flags as t2r_flags
+from tensor2robot_tpu.replay import segment as segment_lib
+from tensor2robot_tpu.testing import chaos
+from tensor2robot_tpu.utils.errors import best_effort
+
+_log = logging.getLogger(__name__)
+
+__all__ = [
+    "ReplayBuffer",
+    "ReplayClient",
+    "ReplayEmpty",
+    "ReplayError",
+    "ReplayServiceHandle",
+    "ReplayUnavailable",
+    "replay_service_main",
+]
+
+STATE_FILENAME = "replay_state.json"
+
+
+class ReplayError(RuntimeError):
+    """Base class for typed replay-service failures."""
+
+
+class ReplayEmpty(ReplayError):
+    """No sealed segment to sample yet (bring-up, or all data torn)."""
+
+
+class ReplayUnavailable(ReplayError):
+    """The service stayed unreachable through the retry budget."""
+
+
+def _load_counters(root: str) -> Dict[str, int]:
+    path = os.path.join(root, STATE_FILENAME)
+    if not os.path.isfile(path):
+        return {}
+    try:
+        with open(path) as f:
+            return {k: int(v) for k, v in json.load(f).items()}
+    except (OSError, ValueError) as err:
+        _log.warning("unreadable %s (%s); counters restart at zero",
+                     path, err)
+        return {}
+
+
+class _FifoSampler:
+    """Cycles sealed segments in seal (seq) order, records in file order.
+
+    Deterministic given the segment set and the number of draws — the
+    property the crash-consistency contract leans on: a resumed learner
+    that skips the already-consumed draw count continues the EXACT
+    schedule an uninterrupted run would have produced.
+    """
+
+    # Loaded-reader LRU bound: FIFO touches one segment at a time, but
+    # prioritized draws hop segments within one batch — re-opening a
+    # segment means a full-file CRC validation + read, so keep the hot
+    # ones resident (bounded: ~8 x seal_bytes of memory).
+    _READER_CACHE_MAX = 8
+
+    def __init__(self, root: str):
+        self._root = root
+        self._order: List[int] = []  # seqs in sampling order
+        self._pos = 0  # index into _order
+        self._record = 0  # index into the current segment
+        self._readers: "OrderedDict[int, segment_lib.SegmentReader]" = (
+            OrderedDict()
+        )
+
+    def note_sealed(self, seq: int) -> None:
+        self._order.append(seq)
+
+    def state(self) -> Dict[str, int]:
+        seq = self._order[self._pos % len(self._order)] if self._order else -1
+        return {"segment_seq": seq, "record_index": self._record}
+
+    def draw(self, n: int) -> List[Tuple[int, int]]:
+        """n (seq, record_index) coordinates, advancing the cursor."""
+        if not self._order:
+            raise ReplayEmpty("no sealed segment to sample")
+        coords: List[Tuple[int, int]] = []
+        while len(coords) < n:
+            seq = self._order[self._pos % len(self._order)]
+            reader = self._get_reader(seq)
+            while self._record < len(reader) and len(coords) < n:
+                coords.append((seq, self._record))
+                self._record += 1
+            if self._record >= len(reader):
+                self._pos = (self._pos + 1) % len(self._order)
+                self._record = 0
+        return coords
+
+    def _get_reader(self, seq: int) -> segment_lib.SegmentReader:
+        reader = self._readers.get(seq)
+        if reader is None:
+            reader = segment_lib.SegmentReader(self._root, seq)
+            self._readers[seq] = reader
+            while len(self._readers) > self._READER_CACHE_MAX:
+                self._readers.popitem(last=False)
+        else:
+            self._readers.move_to_end(seq)
+        return reader
+
+    def read(self, coords: Sequence[Tuple[int, int]]):
+        for seq, index in coords:
+            yield self._get_reader(seq).record(index)
+
+
+class _PrioritizedSampler(_FifoSampler):
+    """Episode-priority-weighted draws from a seeded RNG.
+
+    Draws an episode with probability proportional to its append-time
+    priority, then serves its records round-robin. Deterministic given
+    (segment set, seed, draw count) — chaos twins replay the same
+    schedule.
+    """
+
+    def __init__(self, root: str, seed: int = 0):
+        super().__init__(root)
+        self._rng = random.Random(seed)
+        self._episodes: List[Tuple[int, int, float]] = []  # seq, ep, priority
+        self._weights: List[float] = []
+        self._ep_records: Dict[Tuple[int, int], List[int]] = {}
+        self._ep_cursor: Dict[Tuple[int, int], int] = {}
+
+    def note_sealed(self, seq: int) -> None:
+        super().note_sealed(seq)
+        manifest_file = segment_lib.manifest_path(self._root, seq)
+        with open(manifest_file) as f:
+            manifest = segment_lib.SegmentManifest.from_json(json.load(f))
+        priorities = manifest.priorities or (1.0,) * manifest.episodes
+        for episode_seq, priority in enumerate(priorities):
+            self._episodes.append((seq, episode_seq, priority))
+            self._weights.append(max(float(priority), 1e-6))
+
+    def draw(self, n: int) -> List[Tuple[int, int]]:
+        if not self._episodes:
+            raise ReplayEmpty("no sealed segment to sample")
+        coords: List[Tuple[int, int]] = []
+        picks = self._rng.choices(
+            range(len(self._episodes)), weights=self._weights, k=n
+        )
+        for pick in picks:
+            seq, episode_seq, _ = self._episodes[pick]
+            key = (seq, episode_seq)
+            if key not in self._ep_records:
+                reader = self._get_reader(seq)
+                self._ep_records[key] = reader.episode_record_indices().get(
+                    episode_seq, []
+                )
+                self._ep_cursor[key] = 0
+            records = self._ep_records[key]
+            if not records:
+                continue
+            cursor = self._ep_cursor[key]
+            coords.append((seq, records[cursor % len(records)]))
+            self._ep_cursor[key] = cursor + 1
+        if not coords:
+            raise ReplayEmpty("prioritized draw found no records")
+        return coords
+
+
+class ReplayBuffer:
+    """The in-process replay core (see module docstring). Thread-safe:
+    in-process loops share one instance between actor threads and the
+    learner's input generator."""
+
+    def __init__(
+        self,
+        root: str,
+        seal_episodes: Optional[int] = None,
+        seal_bytes: Optional[int] = None,
+        sampler: Optional[str] = None,
+        seed: int = 0,
+        owns_dir: bool = True,
+    ):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        self._lock = threading.Lock()
+        self._seal_episodes = (
+            t2r_flags.get_int("T2R_REPLAY_SEAL_EPISODES")
+            if seal_episodes is None else max(1, seal_episodes)
+        )
+        self._seal_bytes = (
+            t2r_flags.get_int("T2R_REPLAY_SEAL_BYTES")
+            if seal_bytes is None else max(1, seal_bytes)
+        )
+        sampler_kind = (
+            t2r_flags.get_enum("T2R_REPLAY_SAMPLER")
+            if sampler is None else sampler
+        )
+        if sampler_kind == "prioritized":
+            self._sampler: _FifoSampler = _PrioritizedSampler(root, seed)
+        elif sampler_kind == "fifo":
+            self._sampler = _FifoSampler(root)
+        else:
+            raise ValueError(f"unknown sampler {sampler_kind!r}")
+        self.recovery_report: Dict[str, int] = {}
+        if owns_dir:
+            # Writer-owned startup sweep: quarantine wreckage, COUNT the
+            # loss — the bounded-loss half of the recovery contract.
+            self.recovery_report = segment_lib.sweep_replay_dir(root)
+        counters = _load_counters(root)
+        self._counters = {
+            "episodes_appended_total": counters.get(
+                "episodes_appended_total", 0
+            ),
+            "records_appended_total": counters.get(
+                "records_appended_total", 0
+            ),
+            "episodes_lost_total": counters.get("episodes_lost_total", 0)
+            + self.recovery_report.get("episodes_lost", 0),
+            "records_lost_total": counters.get("records_lost_total", 0)
+            + self.recovery_report.get("records_lost", 0),
+            "restarts": counters.get("restarts", 0) + (1 if counters else 0),
+        }
+        sealed = segment_lib.list_sealed_segments(root)
+        self._sealed_records = 0
+        self._sealed_episodes = 0
+        self._segments_sealed = len(sealed)
+        for seq, manifest in sealed:
+            self._sampler.note_sealed(seq)
+            self._sealed_records += manifest.records
+            self._sealed_episodes += manifest.episodes
+        next_seq = max(
+            [seq for seq, _ in sealed] + [counters.get("next_seq", 0) - 1]
+        ) + 1 if (sealed or counters) else 0
+        self._writer = segment_lib.SegmentWriter(root, next_seq)
+        self._samples_drawn = 0
+        self._staleness_last: Dict[str, float] = {}
+        self._staleness_max = 0
+        # The staleness anchor SURVIVES restarts (persisted with the
+        # counters): a respawned service that forgot the learner's last
+        # publish would report staleness 0 in exactly the crash window
+        # the metric exists to describe.
+        self._policy_version = counters.get("policy_version", 0)
+        self._closed = False
+        if self.recovery_report.get("segments_quarantined"):
+            self._persist_counters()
+
+    # -- write path ------------------------------------------------------------
+
+    def append(
+        self,
+        transitions: Sequence[bytes],
+        policy_version: int = 0,
+        priority: float = 1.0,
+    ) -> Dict[str, int]:
+        """Appends one whole episode; returns {episode_seq, segment_seq,
+        sealed (0/1 whether this append tripped a seal)}."""
+        chaos.maybe_fire("append")
+        with self._lock:
+            if self._closed:
+                raise ReplayError("replay buffer is closed")
+            episode_seq = self._writer.append_episode(
+                transitions, policy_version=policy_version, priority=priority
+            )
+            self._counters["episodes_appended_total"] += 1
+            self._counters["records_appended_total"] += len(transitions)
+            segment_seq = self._writer.seq
+            sealed = 0
+            if (
+                self._writer.episodes >= self._seal_episodes
+                or self._writer.data_bytes >= self._seal_bytes
+            ):
+                self._seal_locked()
+                sealed = 1
+        return {
+            "episode_seq": episode_seq,
+            "segment_seq": segment_seq,
+            "sealed": sealed,
+        }
+
+    def seal(self) -> bool:
+        """Seals the open segment if it holds any episode; returns whether
+        a segment was sealed."""
+        with self._lock:
+            if self._closed:
+                raise ReplayError("replay buffer is closed")
+            if self._writer.episodes == 0:
+                return False
+            self._seal_locked()
+            return True
+
+    def _seal_locked(self) -> None:
+        chaos.maybe_fire("seal")
+        manifest = self._writer.seal()
+        if manifest is not None:
+            self._sampler.note_sealed(manifest.seq)
+            self._sealed_records += manifest.records
+            self._sealed_episodes += manifest.episodes
+            self._segments_sealed += 1
+        self._writer = segment_lib.SegmentWriter(
+            self.root, self._writer.seq + 1
+        )
+        self._persist_counters()
+
+    def _persist_counters(self) -> None:
+        payload = dict(self._counters)
+        payload["next_seq"] = self._writer.seq + 1
+        payload["policy_version"] = self._policy_version
+        segment_lib._atomic_write_json(
+            os.path.join(self.root, STATE_FILENAME), payload
+        )
+
+    # -- read path -------------------------------------------------------------
+
+    def sample(
+        self, batch_size: int
+    ) -> Tuple[List[bytes], List[Tuple[int, int]], Dict[str, float]]:
+        """batch_size records by the configured policy.
+
+        Returns (payloads, coords, info): the raw wire-bytes payloads,
+        their (segment_seq, record_index) coordinates (the audit trail
+        the no-double-sampling tests pin), and the batch's staleness
+        summary. Only SEALED segments are ever touched.
+        """
+        chaos.maybe_fire("sample")
+        with self._lock:
+            if self._closed:
+                raise ReplayError("replay buffer is closed")
+            coords = self._sampler.draw(batch_size)
+            payloads: List[bytes] = []
+            staleness: List[int] = []
+            for record in self._sampler.read(coords):
+                payloads.append(bytes(record.payload))
+                staleness.append(
+                    max(0, self._policy_version - record.policy_version)
+                )
+            self._samples_drawn += len(payloads)
+            info = {
+                "staleness_mean": sum(staleness) / max(len(staleness), 1),
+                "staleness_max": float(max(staleness, default=0)),
+            }
+            self._staleness_last = info
+            self._staleness_max = max(
+                self._staleness_max, int(info["staleness_max"])
+            )
+        return payloads, coords, info
+
+    # -- observability ---------------------------------------------------------
+
+    def set_policy_version(self, version: int) -> None:
+        """The learner's currently-published policy version — the anchor
+        of the staleness metric (sampled records carry the version that
+        GENERATED them; staleness = published - generated). Persisted
+        immediately (publishes are rare; the anchor must survive a
+        service crash)."""
+        with self._lock:
+            self._policy_version = int(version)
+            self._persist_counters()
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            appended = self._counters["records_appended_total"]
+            return {
+                **self._counters,
+                "segments_sealed": self._segments_sealed,
+                "sealed_records": self._sealed_records,
+                "sealed_episodes": self._sealed_episodes,
+                "unsealed_tail_episodes": self._writer.episodes,
+                "unsealed_tail_records": self._writer.records,
+                "samples_drawn": self._samples_drawn,
+                # Classic replay ratio: average times each appended record
+                # has been consumed by the learner.
+                "replay_ratio": self._samples_drawn / max(appended, 1),
+                "policy_version": self._policy_version,
+                "staleness_last": dict(self._staleness_last),
+                "staleness_max_seen": self._staleness_max,
+                "sampler_state": self._sampler.state(),
+                "recovery": dict(self.recovery_report),
+            }
+
+    def close(self, seal_tail: bool = False) -> None:
+        """seal_tail seals the open tail (clean shutdown keeps every
+        episode); default leaves it open — the crash path's behavior."""
+        with self._lock:
+            if self._closed:
+                return
+            if seal_tail and self._writer.episodes:
+                self._seal_locked()
+            self._writer.abort()
+            self._closed = True
+
+
+# -- the service process -------------------------------------------------------
+
+
+def replay_service_main(
+    root: str,
+    request_q,
+    response_q,
+    config: Optional[Dict[str, Any]] = None,
+) -> None:
+    """Process entry: serves append/sample/stats/seal over mp queues.
+
+    Protocol: requests are (client_id, req_id, op, args tuple); replies
+    (client_id, req_id, "ok", payload) | (client_id, req_id, "error",
+    error class name, message) on ONE response queue — the supervisor
+    routes them to per-client queues. The queue pair is FRESH per
+    incarnation: a SIGKILL mid-`get` leaves the queue's reader lock
+    held by a dead process forever (the poisoned-queue trap; the fleet
+    router dodges it the same way, serving/router.py `_spawn`), so the
+    supervisor bridges clients' stable queues to each incarnation's
+    fresh ones instead of sharing queues across respawns.
+
+    Append idempotency: each append carries a per-client monotonically
+    increasing nonce; a nonce at-or-below the last applied one replies
+    "ok" without re-appending, so a client that times out and retries an
+    append the service actually applied cannot duplicate the episode.
+    (The nonce map is in-memory: after a service CRASH a retried
+    ambiguous append may re-apply — but its original copy was in the
+    unsealed tail the crash already counted as lost, so the accounting
+    stays conservative.)
+    """
+    config = dict(config or {})
+    chaos.set_scope(config.get("chaos_scope", "replay"))
+    buffer = ReplayBuffer(
+        root,
+        seal_episodes=config.get("seal_episodes"),
+        seal_bytes=config.get("seal_bytes"),
+        sampler=config.get("sampler"),
+        seed=int(config.get("seed", 0)),
+    )
+    last_nonce: Dict[str, int] = {}
+    _log.info(
+        "replay service up at %s (recovery: %s)", root, buffer.recovery_report
+    )
+
+    def reply(client_id: str, message) -> None:
+        best_effort(response_q.put, (client_id,) + message)
+
+    try:
+        while True:
+            try:
+                request = request_q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            except (OSError, ValueError, EOFError):
+                return  # queue torn down: supervisor is gone
+            client_id, req_id, op, args = request
+            if op == "stop":
+                return
+            try:
+                if op == "append":
+                    transitions, policy_version, priority, nonce = args
+                    if nonce is not None and nonce <= last_nonce.get(
+                        client_id, -1
+                    ):
+                        payload: Any = {"deduped": 1}
+                    else:
+                        payload = buffer.append(
+                            transitions,
+                            policy_version=policy_version,
+                            priority=priority,
+                        )
+                        if nonce is not None:
+                            last_nonce[client_id] = nonce
+                elif op == "sample":
+                    (batch_size,) = args
+                    payloads, coords, info = buffer.sample(batch_size)
+                    payload = {
+                        "records": payloads,
+                        "coords": coords,
+                        "info": info,
+                    }
+                elif op == "stats":
+                    payload = buffer.stats()
+                elif op == "seal":
+                    payload = {"sealed": int(buffer.seal())}
+                elif op == "set_policy_version":
+                    (version,) = args
+                    buffer.set_policy_version(version)
+                    payload = {"ok": 1}
+                else:
+                    raise ReplayError(f"unknown replay op {op!r}")
+                reply(client_id, (req_id, "ok", payload))
+            except Exception as err:
+                reply(
+                    client_id,
+                    (req_id, "error", type(err).__name__, str(err)),
+                )
+    finally:
+        # Graceful stop: seal the open tail so a clean shutdown keeps
+        # every appended episode (the crash path never reaches here —
+        # its tail is the next startup's counted loss).
+        best_effort(buffer.close, True)
+
+
+class ReplayClient:
+    """One client's synchronous view of the replay service.
+
+    Every call retries through service restarts: a timeout or an
+    explicit transport failure backs off (jittered exponential, capped)
+    and retries up to `T2R_REPLAY_RETRIES` extra attempts before
+    raising ReplayUnavailable. Typed service-side errors (ReplayEmpty,
+    validation errors) are NOT retried except ReplayEmpty when
+    `wait_for_data` asks for it — an empty buffer during bring-up is a
+    normal state to wait out, not a failure.
+    """
+
+    def __init__(
+        self,
+        client_id: str,
+        request_q,
+        response_q,
+        timeout_s: float = 10.0,
+        retries: Optional[int] = None,
+        backoff_ms: float = 50.0,
+        seed: int = 0,
+    ):
+        self.client_id = client_id
+        self._request_q = request_q
+        self._response_q = response_q
+        self._timeout_s = timeout_s
+        self._retries = (
+            t2r_flags.get_int("T2R_REPLAY_RETRIES")
+            if retries is None else retries
+        )
+        self._backoff_ms = backoff_ms
+        self._rng = random.Random(seed)
+        # Request ids are OPAQUE (instance token, counter) pairs echoed
+        # verbatim by the service: two client instances sharing one
+        # response queue (the driver creates several over a run) must
+        # never alias each other's replies — a bare counter restarts at
+        # 1 per instance, and a stale reply from a timed-out call of a
+        # PREVIOUS instance would match a fresh call's id and be
+        # returned as its (wrong-op!) result.
+        self._token = f"{os.getpid()}-{id(self):x}-{random.getrandbits(32):08x}"
+        self._req_counter = 0
+        self._nonce = 0
+        self._lock = threading.Lock()
+
+    def _call(
+        self,
+        op: str,
+        args: Tuple,
+        retry_empty: bool = False,
+        timeout_s: Optional[float] = None,
+        retries: Optional[int] = None,
+    ):
+        call_timeout = self._timeout_s if timeout_s is None else timeout_s
+        call_retries = self._retries if retries is None else retries
+        with self._lock:
+            last_error: Optional[Exception] = None
+            for attempt in range(call_retries + 1):
+                if attempt:
+                    delay = (
+                        self._backoff_ms
+                        * (2 ** (attempt - 1))
+                        * (1.0 + self._rng.random())
+                        / 1e3
+                    )
+                    time.sleep(min(delay, 2.0))
+                self._req_counter += 1
+                req_id = (self._token, self._req_counter)
+                try:
+                    self._request_q.put(
+                        (self.client_id, req_id, op, args), timeout=1.0
+                    )
+                except (queue.Full, OSError, ValueError) as err:
+                    last_error = err
+                    continue
+                deadline = time.monotonic() + call_timeout
+                response = None
+                while time.monotonic() < deadline:
+                    try:
+                        candidate = self._response_q.get(
+                            timeout=max(deadline - time.monotonic(), 0.01)
+                        )
+                    except queue.Empty:
+                        break
+                    except (OSError, ValueError) as err:
+                        last_error = err
+                        break
+                    if candidate[0] == req_id:
+                        response = candidate
+                        break
+                    # Stale reply from a timed-out earlier attempt: drop.
+                if response is None:
+                    last_error = last_error or TimeoutError(
+                        f"replay {op} timed out after {call_timeout}s"
+                    )
+                    continue
+                _, status, *rest = response
+                if status == "ok":
+                    return rest[0]
+                error_class, message = rest
+                if error_class == "ReplayEmpty":
+                    if retry_empty:
+                        last_error = ReplayEmpty(message)
+                        continue
+                    raise ReplayEmpty(message)
+                if error_class == "ChaosFault":
+                    # Injected infrastructure failure (a flake/raise
+                    # clause at a service site): retryable by design —
+                    # this is exactly the path `flake:N` plans exist to
+                    # exercise (append/sample recover after N failures).
+                    last_error = ReplayError(f"{error_class}: {message}")
+                    continue
+                raise ReplayError(f"{error_class}: {message}")
+            raise ReplayUnavailable(
+                f"replay {op} failed after {call_retries + 1} attempts: "
+                f"{last_error}"
+            )
+
+    def append(
+        self,
+        transitions: Sequence[bytes],
+        policy_version: int = 0,
+        priority: float = 1.0,
+    ) -> Dict[str, int]:
+        self._nonce += 1
+        return self._call(
+            "append",
+            (
+                [bytes(t) for t in transitions],
+                policy_version,
+                priority,
+                self._nonce,
+            ),
+        )
+
+    def sample(
+        self,
+        batch_size: int,
+        wait_for_data: bool = True,
+        timeout_s: Optional[float] = None,
+        retries: Optional[int] = None,
+    ):
+        payload = self._call(
+            "sample", (batch_size,), retry_empty=wait_for_data,
+            timeout_s=timeout_s, retries=retries,
+        )
+        return payload["records"], payload["coords"], payload["info"]
+
+    def stats(self) -> Dict[str, Any]:
+        return self._call("stats", ())
+
+    def seal(self) -> bool:
+        return bool(self._call("seal", ())["sealed"])
+
+    def set_policy_version(self, version: int) -> None:
+        self._call("set_policy_version", (version,))
+
+
+class ReplayServiceHandle:
+    """Supervisor: owns the client-facing queues, spawns the service
+    process, respawns it when it dies (the chaos legs SIGKILL it on
+    purpose), and hands out per-client `ReplayClient`s.
+
+    Clients never share a queue with the service process directly: a
+    SIGKILL mid-`get`/`put` leaves that mp.Queue's lock held by a dead
+    process, poisoning it for every later user. Clients talk to queues
+    only the supervisor (which our fault model never kills) touches on
+    the other end; two bridge threads forward requests into — and
+    replies out of — a FRESH queue pair created for each incarnation.
+    Requests parked in a dead incarnation's queue are simply lost; the
+    client's timeout+retry resubmits them to the live one.
+
+    Client ids must be declared up front: mp queues have to exist
+    before a child can inherit them.
+    """
+
+    def __init__(
+        self,
+        root: str,
+        client_ids: Sequence[str],
+        config: Optional[Dict[str, Any]] = None,
+        max_respawns: int = 10,
+    ):
+        import multiprocessing
+
+        self.root = root
+        self._config = dict(config or {})
+        self._ctx = multiprocessing.get_context("spawn")
+        # Stable, client-facing (supervisor is the only peer process):
+        self._request_q = self._ctx.Queue()
+        self._response_queues = {
+            client_id: self._ctx.Queue() for client_id in client_ids
+        }
+        # Per-incarnation (fresh on every spawn):
+        self._svc_request_q = None
+        self._svc_response_q = None
+        self._incarnation = 0
+        self._max_respawns = max_respawns
+        self.respawns = 0
+        self._process = None
+        self._closed = False
+        self._threads: List[threading.Thread] = []
+
+    def start(self) -> "ReplayServiceHandle":
+        self._spawn()
+        for target in (
+            self._monitor_loop, self._forward_loop, self._drain_loop,
+        ):
+            thread = threading.Thread(target=target, daemon=True)
+            thread.start()
+            self._threads.append(thread)
+        return self
+
+    def _spawn(self) -> None:
+        self._svc_request_q = self._ctx.Queue()
+        self._svc_response_q = self._ctx.Queue()
+        self._incarnation += 1
+        self._process = self._ctx.Process(
+            target=replay_service_main,
+            args=(
+                self.root,
+                self._svc_request_q,
+                self._svc_response_q,
+                self._config,
+            ),
+            daemon=True,
+        )
+        self._process.start()
+
+    def _monitor_loop(self) -> None:
+        while not self._closed:
+            process = self._process
+            if process is not None and not process.is_alive():
+                if self._closed or self.respawns >= self._max_respawns:
+                    return
+                self.respawns += 1
+                _log.warning(
+                    "replay service died (exitcode %s); respawn %d",
+                    process.exitcode, self.respawns,
+                )
+                self._spawn()
+            time.sleep(0.05)
+
+    def _forward_loop(self) -> None:
+        """Client requests -> the CURRENT incarnation's request queue."""
+        while not self._closed:
+            try:
+                request = self._request_q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            except (OSError, ValueError, EOFError):
+                return
+            if request[2] == "stop":
+                continue  # lifecycle is the supervisor's, not clients'
+            best_effort(self._svc_request_q.put, request)
+
+    def _drain_loop(self) -> None:
+        """Service replies -> the owning client's stable queue. Tracks
+        incarnation flips so it always reads the LIVE response queue
+        (replies stranded in a dead incarnation's queue are gone, like
+        the requests; retries cover both)."""
+        incarnation = self._incarnation
+        response_q = self._svc_response_q
+        while not self._closed:
+            if incarnation != self._incarnation:
+                incarnation = self._incarnation
+                response_q = self._svc_response_q
+            try:
+                message = response_q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            except (OSError, ValueError, EOFError):
+                time.sleep(0.05)
+                continue
+            client_id, rest = message[0], message[1:]
+            out = self._response_queues.get(client_id)
+            if out is None:
+                _log.warning(
+                    "reply for unknown replay client %r dropped", client_id
+                )
+                continue
+            best_effort(out.put, rest)
+
+    def client(self, client_id: str, **kwargs) -> ReplayClient:
+        if client_id not in self._response_queues:
+            raise KeyError(
+                f"client {client_id!r} was not declared at construction "
+                f"(known: {sorted(self._response_queues)})"
+            )
+        return ReplayClient(
+            client_id,
+            self._request_q,
+            self._response_queues[client_id],
+            **kwargs,
+        )
+
+    def client_queues(self, client_id: str):
+        """(request_q, response_q) for building a ReplayClient in a
+        CHILD process (queue objects must ride the spawn args)."""
+        return self._request_q, self._response_queues[client_id]
+
+    def pid(self) -> Optional[int]:
+        process = self._process
+        return process.pid if process is not None else None
+
+    def kill(self) -> Optional[int]:
+        """SIGKILL the live service process (chaos legs); the monitor
+        respawns it. Returns the killed pid."""
+        process = self._process
+        if process is None or not process.is_alive():
+            return None
+        pid = process.pid
+        os.kill(pid, signal.SIGKILL)
+        return pid
+
+    def alive(self) -> bool:
+        process = self._process
+        return process is not None and process.is_alive()
+
+    def stop(self, timeout_s: float = 10.0) -> None:
+        # Closed FIRST: the monitor must not respawn a service that is
+        # exiting because we asked it to.
+        self._closed = True
+        process = self._process
+        if process is not None and process.is_alive():
+            best_effort(
+                self._svc_request_q.put, ("_supervisor", 0, "stop", ()),
+            )
+            process.join(timeout_s)
+        if process is not None and process.is_alive():
+            process.terminate()
+            process.join(5.0)
+        for thread in self._threads:
+            thread.join(timeout_s)
+        for q in (
+            [self._request_q, self._svc_request_q, self._svc_response_q]
+            + list(self._response_queues.values())
+        ):
+            if q is None:
+                continue
+            best_effort(q.cancel_join_thread)
+            best_effort(q.close)
+
+    def __enter__(self) -> "ReplayServiceHandle":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -32,6 +32,14 @@ Actions:
     corruption it is testing (e.g. the replica loop flips a byte in an
     already-checksummed reply).
   * `raise` — raises `ChaosFault` at the site (exception-path testing).
+  * `flake:<N>` — raises `ChaosFault` at occurrences `occurrence`
+    through `occurrence + N - 1` of the site, then never again: the
+    site fails its first N visits (from the clause's start point) and
+    succeeds afterwards. This is the *recovery* fixture — retry/backoff
+    paths (router re-dispatch, actor reconnects, replay re-appends) are
+    only proven by a fault that eventually clears, not by one that
+    fails forever. Unlike every other action, a flake clause fires up
+    to N times.
 
 The plan comes from the `T2R_CHAOS` env flag (declared in flags.py; the
 env route is what reaches spawned replica/trainer processes), or
@@ -70,7 +78,9 @@ __all__ = [
     "reset",
 ]
 
-_KNOWN_ACTIONS = ("kill", "sigkill", "delay", "hang", "corrupt", "raise")
+_KNOWN_ACTIONS = (
+    "kill", "sigkill", "delay", "hang", "corrupt", "raise", "flake",
+)
 # Injected stalls are test instrumentation: cap them so a typo'd plan
 # cannot park the tier-1 suite (the fault model is a *straggler*, and
 # 5 s is already far beyond every router timeout under test).
@@ -83,18 +93,32 @@ class ChaosFault(RuntimeError):
 
 @dataclasses.dataclass(frozen=True)
 class Clause:
-    """One parsed fault: fire `action` at the Nth visit of `site`."""
+    """One parsed fault: fire `action` at the Nth visit of `site`
+    (for `flake`, at visits N .. N + flake_n - 1)."""
 
     site: str
     occurrence: int
     action: str
     arg_ms: Optional[float] = None
     scope: Optional[str] = None
+    flake_n: Optional[int] = None
 
     def describe(self) -> str:
         prefix = f"{self.scope}/" if self.scope else ""
-        suffix = f":{self.arg_ms:g}" if self.arg_ms is not None else ""
+        if self.arg_ms is not None:
+            suffix = f":{self.arg_ms:g}"
+        elif self.flake_n is not None:
+            suffix = f":{self.flake_n}"
+        else:
+            suffix = ""
         return f"{prefix}{self.site}:{self.occurrence}:{self.action}{suffix}"
+
+    def matches(self, count: int) -> bool:
+        if self.action == "flake":
+            return (
+                self.occurrence <= count < self.occurrence + (self.flake_n or 0)
+            )
+        return self.occurrence == count
 
 
 def parse_plan(spec: Optional[str]) -> Tuple[Clause, ...]:
@@ -141,6 +165,7 @@ def parse_plan(spec: Optional[str]) -> Tuple[Clause, ...]:
                 f"(known: {', '.join(_KNOWN_ACTIONS)})"
             )
         arg_ms = None
+        flake_n = None
         if action in ("delay", "hang"):
             if len(parts) != 4:
                 raise ValueError(
@@ -158,11 +183,30 @@ def parse_plan(spec: Optional[str]) -> Tuple[Clause, ...]:
                     f"chaos clause {raw!r}: delay must be in "
                     f"[0, {_MAX_DELAY_MS:g}] ms"
                 )
+        elif action == "flake":
+            if len(parts) != 4:
+                raise ValueError(
+                    f"chaos clause {raw!r}: flake needs a failure count "
+                    "(flake:<N> fails the first N visits, then succeeds)"
+                )
+            try:
+                flake_n = int(parts[3])
+            except ValueError as err:
+                raise ValueError(
+                    f"chaos clause {raw!r}: bad flake count {parts[3]!r}"
+                ) from err
+            if flake_n < 1:
+                raise ValueError(
+                    f"chaos clause {raw!r}: flake count must be >= 1 "
+                    f"(got {flake_n})"
+                )
         elif len(parts) == 4:
             raise ValueError(
                 f"chaos clause {raw!r}: {action} takes no argument"
             )
-        clauses.append(Clause(site, occurrence, action, arg_ms, scope))
+        clauses.append(
+            Clause(site, occurrence, action, arg_ms, scope, flake_n)
+        )
     return tuple(clauses)
 
 
@@ -249,7 +293,7 @@ def maybe_fire(site: str) -> Optional[Clause]:
         _counters[site] = count
         hit: Optional[Clause] = None
         for clause in plan:
-            if clause.site != site or clause.occurrence != count:
+            if clause.site != site or not clause.matches(count):
                 continue
             if clause.scope is not None and clause.scope != _scope:
                 continue
@@ -269,6 +313,12 @@ def maybe_fire(site: str) -> Optional[Clause]:
         return hit
     if hit.action == "raise":
         raise ChaosFault(f"injected fault at {hit.describe()}")
+    if hit.action == "flake":
+        raise ChaosFault(
+            f"injected flake at {hit.describe()} (visit {count} of "
+            f"{site}; succeeds from visit "
+            f"{hit.occurrence + (hit.flake_n or 0)})"
+        )
     return hit  # corrupt: caller applies it
 
 

@@ -275,9 +275,20 @@ def test_bench_cli_lists_legs():
     assert proc.returncode == 0
     for leg in (
         "data", "auc", "predict", "bc", "stream", "pipe", "serve", "comms",
-        "fleet",
+        "fleet", "rl",
     ):
         assert leg in proc.stdout
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "bench.py"),
+         "rl", "--help"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0
+    for option in (
+        "--actors", "--replicas", "--steps", "--seal-episodes",
+        "--chaos-at-s", "--out",
+    ):
+        assert option in proc.stdout
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO_ROOT, "bench.py"),
          "serve", "--help"],
@@ -301,6 +312,44 @@ def test_bench_cli_lists_legs():
         capture_output=True, text=True, timeout=60,
     )
     assert proc.returncode != 0
+
+
+@pytest.mark.slow
+def test_bench_rl_contract(tmp_path):
+    """The closed online-RL loop leg at toy scale: one JSON line + the
+    --out artifact, both legs (fault-free + chaos) present, the chaos
+    acceptance block all-green (equal learner steps, zero torn segments
+    sampled, bounded counted loss, real respawn + actor kill), and the
+    headline rates positive. Slow slice: it spawns a replay service,
+    actor processes and a policy-server replica; tier-1 covers the same
+    loop in-process (tests/test_rl_loop.py) and the CLI surface above."""
+    out = str(tmp_path / "rl.json")
+    payload = _run_bench(
+        "rl", "--steps", "6", "--actors", "2", "--replicas", "1",
+        "--chaos-at-s", "2.0", "--out", out,
+        timeout=560,
+    )
+    assert payload["metric"] == "rl_loop_episodes_per_sec_cpu_proxy"
+    assert payload["unit"] == "episodes_per_sec"
+    assert payload["value"] > 0
+    assert "error" not in payload
+    assert payload["proxy"] is True
+    detail = payload["detail"]
+    for leg in ("fault_free", "chaos"):
+        assert detail[leg]["learner_steps"] == 6
+        assert detail[leg]["episodes_appended"] > 0
+        assert detail[leg]["samples_drawn"] > 0
+        assert detail[leg]["torn_segments_sampled"] == []
+    acceptance = detail["acceptance"]
+    assert acceptance["learner_steps_equal"] is True
+    assert acceptance["zero_torn_segments_sampled"] is True
+    assert acceptance["loss_bounded_to_unsealed_tail"] is True
+    assert acceptance["replay_service_respawned"] is True
+    assert acceptance["actor_killed"] is True
+    assert detail["chaos"]["chaos"]["replay_pid"] is not None
+    assert detail["replay_ratio"] > 0
+    with open(out) as f:
+        assert json.load(f)["metric"] == payload["metric"]
 
 
 @pytest.mark.slow

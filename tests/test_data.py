@@ -522,6 +522,131 @@ class TestHardening:
             SpecParser(one_spec).parse_single(serialized)
 
 
+class TestParseOnError:
+    """T2R_PARSE_ON_ERROR: graceful degradation on a genuinely corrupt
+    record mid-stream. Default (`raise`) keeps the canonical kill-the-
+    consumer error; `skip` drops-and-counts the bad record(s) — the
+    quarantine counter surfaced in RecordDataset.stats() — and yields
+    the surviving (short) batch instead of dying."""
+
+    def _corrupt_fixture(self, tmp_path, n=8, bad=(3,)):
+        spec = TensorSpecStruct()
+        spec["features/x"] = ExtendedTensorSpec(
+            shape=(3,), dtype=np.float32, name="x"
+        )
+        records = [
+            encode_example(spec, {"features/x": np.full(3, i, np.float32)})
+            for i in range(n)
+        ]
+        for index in bad:
+            # Forge a LEN frame that overruns the record: both the fast
+            # parser (strict framing) and protobuf reject it.
+            records[index] = records[index][:4] + b"\xff\xff\xff\xff"
+        path = str(tmp_path / "mixed.tfrecord")
+        tfrecord.write_tfrecords(path, records)
+        return spec, path
+
+    def _dataset(self, spec, path, workers=0, backend="thread"):
+        return RecordDataset(
+            spec, path, batch_size=4, mode="eval", repeat=False,
+            num_parse_workers=workers, parse_backend=backend,
+            prefetch_depth=0, drop_remainder=False,
+        )
+
+    def test_default_raise_kills_consumer(self, tmp_path, monkeypatch):
+        monkeypatch.delenv("T2R_PARSE_ON_ERROR", raising=False)
+        spec, path = self._corrupt_fixture(tmp_path)
+        dataset = self._dataset(spec, path)
+        with pytest.raises(Exception):
+            list(dataset)
+        dataset.close()
+
+    @pytest.mark.parametrize("workers", [0, 2])
+    def test_skip_counts_and_survives(self, tmp_path, monkeypatch, workers):
+        monkeypatch.setenv("T2R_PARSE_ON_ERROR", "skip")
+        spec, path = self._corrupt_fixture(tmp_path)
+        dataset = self._dataset(spec, path, workers=workers)
+        batches = list(dataset)
+        # Record 3 dropped: its batch comes back short, the stream lives,
+        # and the surviving values are exactly the good records in order.
+        sizes = [batch["features/x"].shape[0] for batch in batches]
+        assert sizes == [3, 4]
+        got = np.concatenate([np.asarray(b["features/x"])[:, 0]
+                              for b in batches])
+        np.testing.assert_array_equal(got, [0, 1, 2, 4, 5, 6, 7])
+        stats = dataset.stats()
+        assert stats["records_skipped"] == 1
+        assert stats["batches_degraded"] == 1
+        assert stats["batches_dropped"] == 0
+        dataset.close()
+
+    def test_skip_whole_bad_batch_dropped(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("T2R_PARSE_ON_ERROR", "skip")
+        spec, path = self._corrupt_fixture(
+            tmp_path, n=8, bad=(0, 1, 2, 3)
+        )
+        dataset = self._dataset(spec, path)
+        batches = list(dataset)
+        assert [b["features/x"].shape[0] for b in batches] == [4]
+        stats = dataset.stats()
+        assert stats["records_skipped"] == 4
+        assert stats["batches_dropped"] == 1
+        dataset.close()
+
+    def test_skip_mode_reraises_batch_level_failures(self, monkeypatch):
+        """Skip mode is licensed to swallow RECORD corruption only: a
+        failure where every record parses individually (stacking/ROI/
+        parser bug at batch level) must re-raise the original error
+        uncounted, not log 'dropped 0 records' and die on the retry."""
+        from tensor2robot_tpu.data.dataset import (
+            ParseStats, _parse_chunk_impl,
+        )
+
+        monkeypatch.setenv("T2R_PARSE_ON_ERROR", "skip")
+
+        class BatchLevelBroken:
+            def parse_single(self, record):
+                return {"x": np.zeros(3, np.float32)}
+
+            def parse_batch(self, chunk, roi=None):
+                raise RuntimeError("batch-level stacking failure")
+
+        stats = ParseStats()
+        with pytest.raises(RuntimeError, match="batch-level"):
+            _parse_chunk_impl(None, BatchLevelBroken(), [b"a", b"b"], stats)
+        assert stats.snapshot()["records_skipped"] == 0
+        assert stats.snapshot()["batches_degraded"] == 0
+
+    def test_skip_counts_worker_fallbacks_in_stats(
+        self, tmp_path, monkeypatch
+    ):
+        """Process backend: worker-side fast-parser fallbacks must fold
+        into the parent's stats() (they ride the payload delta)."""
+        monkeypatch.setenv("T2R_PARSE_ON_ERROR", "skip")
+        spec, path = self._corrupt_fixture(tmp_path)
+        dataset = self._dataset(spec, path, workers=2, backend="process")
+        batches = list(dataset)
+        assert [b["features/x"].shape[0] for b in batches] == [3, 4]
+        stats = dataset.stats()
+        assert stats["records_skipped"] == 1
+        # The corrupt batch forced one worker fast-parse fallback, and
+        # it must be visible HERE, not trapped in the worker process.
+        assert stats["fast_fallbacks"] >= 1
+        dataset.close()
+
+    def test_skip_mode_clean_stream_untouched(self, tmp_path, monkeypatch):
+        """With no corruption, skip mode changes nothing: same batches,
+        zero counters (the flag is a failure-path policy, not a parser
+        variant)."""
+        monkeypatch.setenv("T2R_PARSE_ON_ERROR", "skip")
+        spec, path = self._corrupt_fixture(tmp_path, bad=())
+        dataset = self._dataset(spec, path)
+        batches = list(dataset)
+        assert [b["features/x"].shape[0] for b in batches] == [4, 4]
+        assert dataset.stats()["records_skipped"] == 0
+        dataset.close()
+
+
 class TestParallelParse:
     """The thread-pool parse path must match the synchronous path exactly
     (same batches, same order) — parallelism is an implementation detail."""

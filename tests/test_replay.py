@@ -1,0 +1,543 @@
+"""Replay subsystem: durable segments, buffer/service semantics, chaos.
+
+The contracts under test (tensor2robot_tpu/replay/, docs/RESILIENCE.md
+"Online loop fault model"):
+
+  1. Segment durability — episodes append as wire bytes into CRC-framed
+     open segments; seal publishes a manifest atomically; anything torn
+     (unsealed tail, size/CRC mismatch, orphan manifest) is NEVER
+     sampled, is quarantined by the owning writer with the loss
+     COUNTED, and readers only skip.
+  2. Sampling — FIFO is deterministic (the crash-consistency lever);
+     prioritized is seeded-deterministic; both touch only sealed data.
+  3. Service — clients retry through SIGKILL + respawn; appends are
+     idempotent under retry; `flake:N` chaos clauses at service sites
+     are recovered from by the real client retry path.
+  4. Staleness / replay-ratio accounting end to end.
+
+Everything is seeded; no wall-clock assertions.
+"""
+
+import json
+import os
+import struct
+import zlib
+
+import numpy as np
+import pytest
+
+from tensor2robot_tpu.replay import segment as segment_lib
+from tensor2robot_tpu.replay.service import (
+    ReplayBuffer,
+    ReplayEmpty,
+    ReplayError,
+)
+from tensor2robot_tpu.testing import chaos
+
+
+def _fill(buffer, episodes=5, records_per=2, version_fn=None):
+    outs = []
+    for episode in range(episodes):
+        version = version_fn(episode) if version_fn else episode
+        outs.append(
+            buffer.append(
+                [
+                    f"ep{episode}-r{record}".encode()
+                    for record in range(records_per)
+                ],
+                policy_version=version,
+                priority=1.0 + episode,
+            )
+        )
+    return outs
+
+
+class TestSegmentFormat:
+    def test_append_seal_read_roundtrip(self, tmp_path):
+        root = str(tmp_path)
+        writer = segment_lib.SegmentWriter(root, 0)
+        writer.append_episode([b"a0", b"a1"], policy_version=3, priority=2.0)
+        writer.append_episode([b"b0"], policy_version=4)
+        manifest = writer.seal()
+        assert manifest.records == 3
+        assert manifest.episodes == 2
+        assert manifest.priorities == (2.0, 1.0)
+        assert manifest.min_policy_version == 3
+        assert manifest.max_policy_version == 4
+        assert segment_lib.validate_segment(root, 0) is None
+        reader = segment_lib.SegmentReader(root, 0)
+        records = list(reader.records())
+        assert [bytes(r.payload) for r in records] == [b"a0", b"a1", b"b0"]
+        assert [r.episode_seq for r in records] == [0, 0, 1]
+        assert [r.policy_version for r in records] == [3, 3, 4]
+        assert reader.episode_record_indices() == {0: [0, 1], 1: [2]}
+
+    def test_empty_seal_discards(self, tmp_path):
+        writer = segment_lib.SegmentWriter(str(tmp_path), 0)
+        assert writer.seal() is None
+        assert segment_lib.list_sealed_segments(str(tmp_path)) == []
+
+    def test_unsealed_tail_is_torn_and_counted(self, tmp_path):
+        root = str(tmp_path)
+        writer = segment_lib.SegmentWriter(root, 0)
+        writer.append_episode([b"x", b"y"])
+        writer.append_episode([b"z"])
+        # No seal: simulate the crash by just abandoning the writer.
+        assert "open" in segment_lib.validate_segment(root, 0)
+        records, episodes, tail = segment_lib.salvage_open_segment(
+            segment_lib.open_segment_path(root, 0)
+        )
+        assert (records, episodes, tail) == (3, 2, 0)
+
+    def test_salvage_counts_partial_tail(self, tmp_path):
+        root = str(tmp_path)
+        writer = segment_lib.SegmentWriter(root, 0)
+        writer.append_episode([b"whole-record"])
+        writer.abort()
+        path = segment_lib.open_segment_path(root, 0)
+        with open(path, "ab") as f:
+            f.write(segment_lib.FRAME_HEADER.pack(100, 0, 1, 0))
+            f.write(b"torn")  # length says 100, only 4 bytes present
+        records, episodes, tail = segment_lib.salvage_open_segment(path)
+        assert (records, episodes) == (1, 1)
+        assert tail == segment_lib.FRAME_HEADER.size + 4
+
+    def test_crc_flip_detected(self, tmp_path):
+        root = str(tmp_path)
+        writer = segment_lib.SegmentWriter(root, 0)
+        writer.append_episode([b"payload-bytes" * 10])
+        writer.seal()
+        path = segment_lib.sealed_segment_path(root, 0)
+        blob = bytearray(open(path, "rb").read())
+        blob[-1] ^= 0xFF
+        with open(path, "wb") as f:
+            f.write(bytes(blob))
+        reason = segment_lib.validate_segment(root, 0)
+        assert reason is not None and "CRC" in reason
+
+    def test_truncated_sealed_file_detected(self, tmp_path):
+        root = str(tmp_path)
+        writer = segment_lib.SegmentWriter(root, 0)
+        writer.append_episode([b"payload" * 50])
+        writer.seal()
+        path = segment_lib.sealed_segment_path(root, 0)
+        with open(path, "r+b") as f:
+            f.truncate(os.path.getsize(path) // 2)
+        reason = segment_lib.validate_segment(root, 0)
+        assert reason is not None and "size mismatch" in reason
+
+    def test_missing_manifest_is_torn(self, tmp_path):
+        root = str(tmp_path)
+        writer = segment_lib.SegmentWriter(root, 0)
+        writer.append_episode([b"x"])
+        writer.seal()
+        os.unlink(segment_lib.manifest_path(root, 0))
+        assert "manifest" in segment_lib.validate_segment(root, 0)
+        assert segment_lib.list_sealed_segments(root) == []
+
+    def test_sweep_quarantines_counts_and_preserves(self, tmp_path):
+        root = str(tmp_path)
+        good = segment_lib.SegmentWriter(root, 0)
+        good.append_episode([b"keep"])
+        good.seal()
+        torn = segment_lib.SegmentWriter(root, 1)
+        torn.append_episode([b"lost-a"])
+        torn.append_episode([b"lost-b"])
+        torn.abort()  # unsealed tail
+        bad_sealed = segment_lib.SegmentWriter(root, 2)
+        bad_sealed.append_episode([b"half"])
+        bad_sealed.seal()
+        data = segment_lib.sealed_segment_path(root, 2)
+        with open(data, "r+b") as f:
+            f.truncate(os.path.getsize(data) - 1)
+
+        report = segment_lib.sweep_replay_dir(root)
+        assert report["segments_quarantined"] == 2
+        assert report["episodes_lost"] == 3  # 2 tail + 1 torn-sealed
+        # Sealed survivor intact; wreckage preserved, not deleted.
+        assert [seq for seq, _ in segment_lib.list_sealed_segments(root)] == [0]
+        quarantine = segment_lib.quarantine_root(root)
+        assert len(os.listdir(quarantine)) >= 2
+        # Second sweep is a no-op.
+        assert segment_lib.sweep_replay_dir(root)["segments_quarantined"] == 0
+
+    def test_reader_refuses_torn(self, tmp_path):
+        root = str(tmp_path)
+        writer = segment_lib.SegmentWriter(root, 0)
+        writer.append_episode([b"x" * 100])
+        writer.seal()
+        path = segment_lib.sealed_segment_path(root, 0)
+        with open(path, "r+b") as f:
+            f.truncate(10)
+        with pytest.raises(ValueError, match="not durable"):
+            segment_lib.SegmentReader(root, 0)
+
+
+class TestReplayBuffer:
+    def test_fifo_sampling_is_deterministic(self, tmp_path):
+        root = str(tmp_path)
+        buffer = ReplayBuffer(root, seal_episodes=2, sampler="fifo")
+        _fill(buffer, episodes=6)
+        first = [buffer.sample(3)[1] for _ in range(4)]
+        buffer.close()
+        # A fresh buffer over the same dir draws the same schedule.
+        buffer2 = ReplayBuffer(root, sampler="fifo")
+        second = [buffer2.sample(3)[1] for _ in range(4)]
+        buffer2.close()
+        assert first == second
+        # And it cycles without repeats within one pass.
+        flat = [c for batch in first for c in batch]
+        assert len(set(flat[:6])) == 6
+
+    def test_sample_never_touches_unsealed_tail(self, tmp_path):
+        buffer = ReplayBuffer(str(tmp_path), seal_episodes=100)
+        _fill(buffer, episodes=3)  # all in the open tail
+        with pytest.raises(ReplayEmpty):
+            buffer.sample(1)
+        buffer.seal()
+        payloads, coords, _ = buffer.sample(2)
+        assert len(payloads) == 2
+        buffer.close()
+
+    def test_prioritized_is_seeded_and_weighted(self, tmp_path):
+        root = str(tmp_path)
+        buffer = ReplayBuffer(
+            root, seal_episodes=8, sampler="prioritized", seed=5
+        )
+        # Episode priorities 1..8: the last episodes dominate draws.
+        _fill(buffer, episodes=8)
+        buffer.seal()
+        coords_a = [tuple(buffer.sample(4)[1]) for _ in range(6)]
+        buffer.close()
+        buffer_b = ReplayBuffer(root, sampler="prioritized", seed=5)
+        coords_b = [tuple(buffer_b.sample(4)[1]) for _ in range(6)]
+        buffer_b.close()
+        assert coords_a == coords_b  # seeded determinism
+        buffer_c = ReplayBuffer(root, sampler="prioritized", seed=6)
+        coords_c = [tuple(buffer_c.sample(4)[1]) for _ in range(6)]
+        buffer_c.close()
+        assert coords_a != coords_c  # the seed actually matters
+
+    def test_staleness_and_replay_ratio(self, tmp_path):
+        buffer = ReplayBuffer(str(tmp_path), seal_episodes=4)
+        _fill(buffer, episodes=4, records_per=1)  # versions 0..3
+        buffer.set_policy_version(5)
+        _, _, info = buffer.sample(4)
+        assert info["staleness_mean"] == pytest.approx((5 + 4 + 3 + 2) / 4)
+        assert info["staleness_max"] == 5
+        stats = buffer.stats()
+        assert stats["samples_drawn"] == 4
+        assert stats["replay_ratio"] == pytest.approx(1.0)
+        assert stats["staleness_max_seen"] == 5
+        buffer.close()
+
+    def test_restart_resumes_without_loss_after_clean_close(self, tmp_path):
+        root = str(tmp_path)
+        buffer = ReplayBuffer(root, seal_episodes=2)
+        _fill(buffer, episodes=5)
+        buffer.close(seal_tail=True)
+        buffer2 = ReplayBuffer(root)
+        stats = buffer2.stats()
+        assert stats["episodes_lost_total"] == 0
+        assert stats["sealed_episodes"] == 5
+        assert stats["restarts"] == 1
+        # New appends land in a FRESH segment seq (no collision).
+        out = buffer2.append([b"new"], policy_version=9)
+        assert out["segment_seq"] >= 3
+        buffer2.close()
+
+    def test_staleness_anchor_survives_restart(self, tmp_path):
+        """The published-version anchor is persisted: a respawned
+        service must not report staleness 0 in exactly the crash window
+        the metric exists to describe."""
+        root = str(tmp_path)
+        buffer = ReplayBuffer(root, seal_episodes=2)
+        _fill(buffer, episodes=2, records_per=1, version_fn=lambda e: 0)
+        buffer.set_policy_version(5)
+        buffer.close(seal_tail=False)  # crash shape
+        buffer2 = ReplayBuffer(root)
+        assert buffer2.stats()["policy_version"] == 5
+        _, _, info = buffer2.sample(2)
+        assert info["staleness_max"] == 5
+        buffer2.close()
+
+    def test_restart_counts_unsealed_tail_loss(self, tmp_path):
+        root = str(tmp_path)
+        buffer = ReplayBuffer(root, seal_episodes=10)
+        _fill(buffer, episodes=3)
+        buffer.close(seal_tail=False)  # crash shape: tail abandoned
+        buffer2 = ReplayBuffer(root)
+        assert buffer2.recovery_report["episodes_lost"] == 3
+        stats = buffer2.stats()
+        assert stats["episodes_lost_total"] == 3
+        assert stats["records_lost_total"] == 6
+        buffer2.close()
+
+    def test_chaos_sites_fire(self, tmp_path):
+        chaos.reset()
+        try:
+            chaos.configure("append:2:raise;seal:1:raise;sample:1:raise")
+            buffer = ReplayBuffer(str(tmp_path), seal_episodes=2)
+            buffer.append([b"one"])
+            with pytest.raises(chaos.ChaosFault):
+                buffer.append([b"two"])
+            # Third append trips the seal threshold -> seal site raises.
+            with pytest.raises(chaos.ChaosFault):
+                buffer.append([b"three"])
+            with pytest.raises(chaos.ChaosFault):
+                buffer.sample(1)
+            assert len(chaos.fired()) == 3
+            buffer.close()
+        finally:
+            chaos.reset()
+
+
+class TestReplayServiceProcess:
+    """The service as a process: SIGKILL, respawn, retry, idempotency.
+
+    These spawn real processes but stay small (one service, tiny
+    payloads); the heavyweight closed-loop soak rides the slow slice in
+    test_rl_loop.py.
+    """
+
+    def _handle(self, tmp_path, **config):
+        from tensor2robot_tpu.replay.service import ReplayServiceHandle
+
+        merged = {"seal_episodes": 2}
+        merged.update(config)
+        return ReplayServiceHandle(
+            str(tmp_path), ["c1", "c2"], config=merged
+        ).start()
+
+    def test_append_sample_stats_roundtrip(self, tmp_path):
+        handle = self._handle(tmp_path)
+        try:
+            client = handle.client("c1", timeout_s=15)
+            for i in range(4):
+                client.append([b"r%d" % i], policy_version=i)
+            stats = client.stats()
+            assert stats["episodes_appended_total"] == 4
+            assert stats["segments_sealed"] == 2
+            records, coords, _ = handle.client("c2", timeout_s=15).sample(3)
+            assert records == [b"r0", b"r1", b"r2"]
+            assert coords == [[0, 0], [0, 1], [1, 0]] or coords == [
+                (0, 0), (0, 1), (1, 0)
+            ]
+        finally:
+            handle.stop()
+
+    def test_sigkill_respawn_counted_loss_and_retry(self, tmp_path):
+        handle = self._handle(tmp_path)
+        try:
+            client = handle.client("c1", timeout_s=15)
+            for i in range(5):
+                client.append([b"r%d" % i], policy_version=i)
+            # 4 sealed (2 segments) + 1 unsealed tail.
+            assert handle.kill() is not None
+            # The retried call rides the respawn; the tail's episode is
+            # counted lost, sealed data survives.
+            client.append([b"after"], policy_version=9)
+            stats = client.stats()
+            assert stats["episodes_lost_total"] == 1
+            assert stats["segments_sealed"] == 2
+            assert handle.respawns == 1
+            records, _, _ = handle.client("c2", timeout_s=15).sample(4)
+            assert b"r4" not in records  # the lost tail is never served
+        finally:
+            handle.stop()
+
+    def test_append_retry_is_idempotent(self, tmp_path):
+        handle = self._handle(tmp_path)
+        try:
+            client = handle.client("c1", timeout_s=15)
+            client.append([b"x"])
+            # Re-send the SAME nonce (a retry of an applied append).
+            client._nonce -= 1
+            client.append([b"x"])
+            assert client.stats()["episodes_appended_total"] == 1
+        finally:
+            handle.stop()
+
+    def test_flake_clause_recovered_by_client_retries(self, tmp_path):
+        """The satellite's recovery fixture: the first N occurrences of
+        the service's append site fail, the client's retry path rides
+        them out, and the append LANDS — recovery, not just failure."""
+        handle = self._handle(
+            tmp_path, **{"chaos_scope": "replay"}
+        )
+        try:
+            # Reach the service via its env: flake the first 2 appends.
+            handle.stop()
+            os.environ["T2R_CHAOS"] = "append:1:flake:2"
+            handle = self._handle(tmp_path)
+            client = handle.client("c1", timeout_s=15, backoff_ms=10.0)
+            out = client.append([b"flaky"])
+            assert out["episode_seq"] == 0
+            assert client.stats()["episodes_appended_total"] == 1
+        finally:
+            os.environ.pop("T2R_CHAOS", None)
+            handle.stop()
+
+
+class TestReplayInputGenerator:
+    def _collect_dir(self, tmp_path, episodes=6):
+        from tensor2robot_tpu.replay.actor import (
+            EpisodeCollector,
+            RandomPolicyClient,
+        )
+        from tensor2robot_tpu.research.pose_env.pose_env import PoseToyEnv
+
+        root = str(tmp_path / "replay")
+        buffer = ReplayBuffer(root, seal_episodes=3)
+        collector = EpisodeCollector(
+            PoseToyEnv(seed=1), RandomPolicyClient(seed=2)
+        )
+        for _ in range(episodes):
+            records, info = collector.collect()
+            buffer.append(
+                records,
+                policy_version=max(info["policy_version"], 0),
+                priority=info["priority"],
+            )
+        buffer.close(seal_tail=True)
+        return root
+
+    def test_batches_match_spec_and_oracle(self, tmp_path):
+        from tensor2robot_tpu.data.parser import SpecParser
+        from tensor2robot_tpu.replay.input_generator import (
+            ReplayInputGenerator,
+        )
+        from tensor2robot_tpu.replay.segment import SegmentReader
+        from tensor2robot_tpu.research.pose_env.pose_env_models import (
+            PoseEnvRegressionModel,
+        )
+
+        root = self._collect_dir(tmp_path)
+        model = PoseEnvRegressionModel()
+        generator = ReplayInputGenerator(
+            root, batch_size=4, wait_timeout_s=5
+        )
+        generator.set_specification_from_model(model, "train")
+        batch = next(iter(generator.create_dataset("train")))
+        assert batch["features/state"].shape == (4, 64, 64, 3)
+        assert batch["labels/target_pose"].shape == (4, 2)
+        assert batch["labels/reward"].shape == (4, 1)
+        # Fast parse must equal the SpecParser oracle byte for byte on
+        # the same wire records (the zero-parse pipeline's parity pin):
+        # re-read the records the batch actually sampled via its coords.
+        readers = {}
+        records = []
+        for seq, index in generator.coords_log[0]:
+            if seq not in readers:
+                readers[seq] = SegmentReader(root, seq)
+            records.append(bytes(readers[seq].record(index).payload))
+        oracle = SpecParser(generator.combined_spec()).parse_batch(records)
+        for key in ("features/state", "labels/target_pose", "labels/reward"):
+            np.testing.assert_array_equal(
+                np.asarray(batch[key]), np.asarray(oracle[key])
+            )
+
+    def test_dir_mode_schedule_is_deterministic(self, tmp_path):
+        from tensor2robot_tpu.replay.input_generator import (
+            ReplayInputGenerator,
+        )
+        from tensor2robot_tpu.research.pose_env.pose_env_models import (
+            PoseEnvRegressionModel,
+        )
+
+        root = self._collect_dir(tmp_path)
+        model = PoseEnvRegressionModel()
+
+        def schedule(batches):
+            generator = ReplayInputGenerator(
+                root, batch_size=2, wait_timeout_s=5
+            )
+            generator.set_specification_from_model(model, "train")
+            iterator = iter(generator.create_dataset("train"))
+            for _ in range(batches):
+                next(iterator)
+            return generator.coords_log, generator.schedule_digest()
+
+        coords_a, digest_a = schedule(5)
+        coords_b, digest_b = schedule(5)
+        assert coords_a == coords_b
+        assert digest_a == digest_b
+        # Batch k of a fresh run == batch k of any other run: the islice
+        # realignment in train_eval_model therefore restores sampling
+        # state exactly (test_rl_loop.py pins the end-to-end form).
+        coords_long, _ = schedule(7)
+        assert coords_long[:5] == coords_a
+
+    def test_staleness_anchor_dir_mode(self, tmp_path):
+        from tensor2robot_tpu.replay.input_generator import (
+            ReplayInputGenerator,
+        )
+        from tensor2robot_tpu.research.pose_env.pose_env_models import (
+            PoseEnvRegressionModel,
+        )
+
+        root = self._collect_dir(tmp_path)
+        generator = ReplayInputGenerator(
+            root, batch_size=2, wait_timeout_s=5, staleness_anchor=lambda: 7
+        )
+        generator.set_specification_from_model(
+            PoseEnvRegressionModel(), "train"
+        )
+        next(iter(generator.create_dataset("train")))
+        assert generator.last_staleness["staleness_max"] == 7.0
+
+    def test_empty_dir_times_out_typed(self, tmp_path):
+        from tensor2robot_tpu.replay.input_generator import (
+            ReplayInputGenerator,
+        )
+        from tensor2robot_tpu.research.pose_env.pose_env_models import (
+            PoseEnvRegressionModel,
+        )
+
+        generator = ReplayInputGenerator(
+            str(tmp_path / "nothing"), batch_size=2, wait_timeout_s=0.2
+        )
+        generator.set_specification_from_model(
+            PoseEnvRegressionModel(), "train"
+        )
+        with pytest.raises(ReplayEmpty):
+            next(iter(generator.create_dataset("train")))
+
+
+class TestFlakeChaosAction:
+    """Satellite: flake:N plan parsing + semantics (the real retry-path
+    integration rides TestReplayServiceProcess above and the router
+    tests in test_chaos.py)."""
+
+    def test_parse_and_describe(self):
+        plan = chaos.parse_plan("append:2:flake:3;r0/sample:1:flake:1")
+        assert plan[0].action == "flake"
+        assert plan[0].flake_n == 3
+        assert plan[0].describe() == "append:2:flake:3"
+        assert plan[1].scope == "r0"
+
+    @pytest.mark.parametrize(
+        "bad",
+        ["a:1:flake", "a:1:flake:0", "a:1:flake:x", "a:1:flake:-2"],
+    )
+    def test_malformed_flake_rejected(self, bad):
+        with pytest.raises(ValueError):
+            chaos.parse_plan(bad)
+
+    def test_fails_first_n_then_succeeds(self):
+        chaos.reset()
+        try:
+            chaos.configure("site:2:flake:3")
+            outcomes = []
+            for _ in range(7):
+                try:
+                    chaos.maybe_fire("site")
+                    outcomes.append("ok")
+                except chaos.ChaosFault:
+                    outcomes.append("fail")
+            assert outcomes == [
+                "ok", "fail", "fail", "fail", "ok", "ok", "ok",
+            ]
+            assert chaos.fired() == ["site:2:flake:3"] * 3
+        finally:
+            chaos.reset()

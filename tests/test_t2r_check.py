@@ -229,6 +229,38 @@ class TestLintsCatch:
         assert "env-kind-mismatch" not in clean
         assert "env-unknown-flag" not in clean
 
+    def test_replay_flags_covered_by_registry_lint(self):
+        """The round-12 T2R_REPLAY_* + T2R_PARSE_ON_ERROR flags ride the
+        same rails: raw environ reads are env-undeclared, wrong-kind
+        getter reads are env-kind-mismatch, declared spellings clean."""
+        for name in (
+            "T2R_REPLAY_SEAL_EPISODES", "T2R_REPLAY_SEAL_BYTES",
+            "T2R_REPLAY_SAMPLER", "T2R_REPLAY_RETRIES",
+            "T2R_PARSE_ON_ERROR",
+        ):
+            assert "env-undeclared" in self._rules(
+                f"import os\nx = os.environ.get({name!r})\n"
+            ), name
+        assert "env-kind-mismatch" in self._rules(
+            "from tensor2robot_tpu import flags\n"
+            "x = flags.get_bool('T2R_REPLAY_SAMPLER')\n"
+        )
+        assert "env-kind-mismatch" in self._rules(
+            "from tensor2robot_tpu import flags\n"
+            "x = flags.get_str('T2R_REPLAY_RETRIES')\n"
+        )
+        clean = self._rules(
+            "from tensor2robot_tpu import flags\n"
+            "a = flags.get_int('T2R_REPLAY_SEAL_EPISODES')\n"
+            "b = flags.get_int('T2R_REPLAY_SEAL_BYTES')\n"
+            "c = flags.get_enum('T2R_REPLAY_SAMPLER')\n"
+            "d = flags.get_int('T2R_REPLAY_RETRIES')\n"
+            "e = flags.get_enum('T2R_PARSE_ON_ERROR')\n"
+        )
+        assert "env-kind-mismatch" not in clean
+        assert "env-unknown-flag" not in clean
+        assert "env-undeclared" not in clean
+
     def test_numpy_in_jit_decorated(self):
         rules = self._rules(
             "import jax\nimport numpy as np\n"
@@ -379,6 +411,27 @@ class TestLintsCatch:
             assert any(
                 d.rule == "swallowed-exception" for d in diags
             ), path
+
+    def test_swallow_in_replay_scoped(self):
+        """replay/ is failure-handling code top to bottom: the silent-
+        swallow ban covers it (positive), with best_effort and specific
+        exceptions still clean (negative)."""
+        path = "tensor2robot_tpu/replay/seeded.py"
+        silent = (
+            "def f():\n"
+            "    try:\n        work()\n"
+            "    except Exception:\n        pass\n"
+        )
+        diags = lint_source(silent, path)
+        assert any(d.rule == "swallowed-exception" for d in diags)
+        clean = (
+            "from tensor2robot_tpu.utils.errors import best_effort\n"
+            "def f(q):\n"
+            "    best_effort(q.put, 1)\n"
+            "    try:\n        work()\n"
+            "    except OSError:\n        pass\n"
+        )
+        assert lint_source(clean, path) == []
 
     # -- collective discipline ------------------------------------------------
 

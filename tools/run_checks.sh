@@ -84,6 +84,18 @@ if [ "$TESTS" = 1 ]; then
       -q -m 'not slow' -p no:cacheprovider; then
     status=1
   fi
+
+  echo "== replay: online-loop durability + seeded chaos suite (tier-1) =="
+  # Segment durability (CRC + seal manifests, counted loss, quarantine),
+  # FIFO/prioritized sampling determinism, service SIGKILL/respawn with
+  # client retries (incl. flake:N recovery), the in-process closed loop,
+  # and the learner SIGKILL-mid-save bitwise-resume pin over replay data.
+  # The multi-process soak is the slow-slice twin (tests/test_rl_loop.py).
+  if ! JAX_PLATFORMS=cpu python -m pytest tests/test_replay.py \
+      tests/test_rl_loop.py \
+      -q -m 'not slow' -p no:cacheprovider; then
+    status=1
+  fi
 fi
 
 if [ "$status" = 0 ]; then
