@@ -3125,7 +3125,7 @@ def bench_rl(args) -> None:
     policy (export -> rolling fleet swap) at every checkpoint. Reports
     episodes/s, samples/s, replay ratio and policy staleness.
 
-    Two legs, same seeds:
+    Four legs, same seeds:
 
       * fault-free — the throughput + staleness numbers;
       * chaos — the replay service AND one actor are SIGKILLed mid-run.
@@ -3134,6 +3134,18 @@ def bench_rl(args) -> None:
         (verified against the on-disk manifests after the fact), and
         the loss is bounded to the unsealed tail — counted and
         reported, never guessed.
+      * sharded fault-free — the same loop over `--shards` (>= 3)
+        replay-service shards on the SOCKET transport
+        (replay/transport.py): consistent-hash episode placement,
+        per-shard durability, rotation sampling.
+      * sharded chaos — one shard SIGKILLed AND another partitioned at
+        the driver (chaos `net_send partition` clause) mid-run.
+        Acceptance: equal learner steps vs the sharded fault-free
+        twin, zero torn segments sampled, ZERO duplicate appends
+        (cross-shard episode-uid audit over the sealed manifests),
+        per-shard loss bounded to the unsealed tail and counted, and
+        the partition's coverage loss COUNTED (degraded, never
+        silent).
     """
     import shutil
     import tempfile
@@ -3160,6 +3172,11 @@ def bench_rl(args) -> None:
         from tensor2robot_tpu.export.exporters import LatestExporter
         from tensor2robot_tpu.replay import OnlineLoop
         from tensor2robot_tpu.replay.segment import list_sealed_segments
+        from tensor2robot_tpu.replay.sharded import (
+            audit_episode_uids,
+            shard_root,
+        )
+        from tensor2robot_tpu.testing import chaos as chaos_lib
         from tensor2robot_tpu.research.pose_env.pose_env_models import (
             PoseEnvRegressionModel,
         )
@@ -3264,8 +3281,109 @@ def bench_rl(args) -> None:
             shutil.rmtree(root, ignore_errors=True)
             return payload
 
+        def run_sharded_leg(tag, with_chaos):
+            """The sharded fabric on the socket transport: no serving
+            fleet (actors run the seeded random policy) — this leg
+            measures the REPLAY fabric under shard faults; the fleet
+            integration is the two legs above."""
+            root = tempfile.mkdtemp(prefix=f"bench_rl_{tag}_")
+            loop = OnlineLoop(
+                root,
+                num_actors=args.actors,
+                batch_size=args.batch,
+                seal_episodes=args.seal_episodes,
+                seed=11,
+                shards=args.shards,
+                transport="socket",
+                wait_timeout_s=300.0,
+                actor_throttle_s=args.actor_throttle_ms / 1e3,
+            )
+            loop.start()
+            chaos_events = {}
+            try:
+                if with_chaos:
+                    def mid_run_chaos():
+                        # Progress-based trigger, not wall-clock: the
+                        # faults must land while the learner is still
+                        # SAMPLING (a partition installed after the
+                        # last draw degrades nothing and the coverage
+                        # gate would measure an empty window). Wait for
+                        # about a third of the learner's batches, then
+                        # strike; chaos_at_s is the fallback ceiling.
+                        deadline = time.monotonic() + max(
+                            args.chaos_at_s, 30.0
+                        )
+                        target = max(2, args.steps // 3)
+                        while time.monotonic() < deadline:
+                            generator = loop._generator
+                            if (
+                                generator is not None
+                                and generator.batches_drawn >= target
+                            ):
+                                break
+                            time.sleep(0.05)
+                        # SIGKILL one shard (its supervisor respawns
+                        # it) AND partition another at the driver: the
+                        # learner's sampling link to s<N-1> drops from
+                        # here on, via the seeded chaos machinery.
+                        chaos_events["shard_killed"] = 1
+                        chaos_events["shard_pid"] = loop.kill_shard(1)
+                        partitioned = args.shards - 1
+                        chaos_events["shard_partitioned"] = partitioned
+                        chaos_lib.configure(
+                            f"net_send:1:partition:s{partitioned}"
+                        )
+
+                    chaos_thread = threading.Thread(
+                        target=mid_run_chaos, daemon=True
+                    )
+                    chaos_thread.start()
+                loop.run_learner(
+                    max_steps=args.steps,
+                    save_steps=max(1, args.steps // 3),
+                    publish=True,
+                )
+                if with_chaos:
+                    chaos_thread.join()
+            finally:
+                chaos_lib.reset()
+                report = loop.stop()
+            shard_roots = [
+                shard_root(loop.replay_root, k) for k in range(args.shards)
+            ]
+            # Torn-segment audit, per shard: every (shard, seq, record)
+            # the learner sampled must name a segment durable on disk.
+            sealed = {
+                (k, seq)
+                for k, sroot in enumerate(shard_roots)
+                for seq, _ in list_sealed_segments(sroot)
+            }
+            sampled = {
+                (coord[0], coord[1])
+                for batch in (loop._generator.coords_log if loop._generator
+                              else [])
+                for coord in batch
+            }
+            torn_sampled = sorted(sampled - sealed)
+            # Zero-duplicate-appends audit: episode uids across every
+            # shard's sealed manifests.
+            audit = audit_episode_uids(shard_roots)
+            payload = report.to_json()
+            payload.pop("actor_reports", None)
+            payload["torn_segments_sampled"] = torn_sampled
+            payload["uid_audit"] = {
+                "episodes": audit["episodes"],
+                "unaudited_episodes": audit["unaudited_episodes"],
+                "duplicate_count": audit["duplicate_count"],
+            }
+            payload["chaos"] = chaos_events if with_chaos else None
+            shutil.rmtree(root, ignore_errors=True)
+            return payload
+
         fault_free = run_leg("clean", with_chaos=False)
         chaos_leg = run_leg("chaos", with_chaos=True)
+        sharded_free = run_sharded_leg("shard_clean", with_chaos=False)
+        sharded_chaos = run_sharded_leg("shard_chaos", with_chaos=True)
 
         acceptance = {
             "stats_measured": (
@@ -3285,6 +3403,38 @@ def bench_rl(args) -> None:
             "loss_counted": chaos_leg["episodes_lost"],
             "replay_service_respawned": chaos_leg["replay_restarts"] >= 1,
             "actor_killed": chaos_leg["actors_killed"] == 1,
+            # -- the sharded chaos contract (ISSUE 10) --
+            "sharded_stats_measured": (
+                sharded_chaos["stats_ok"] and sharded_free["stats_ok"]
+            ),
+            "sharded_learner_steps_equal": (
+                sharded_chaos["learner_steps"]
+                == sharded_free["learner_steps"]
+                and sharded_chaos["learner_steps"] > 0
+            ),
+            "sharded_zero_torn_segments_sampled": (
+                not sharded_chaos["torn_segments_sampled"]
+                and not sharded_free["torn_segments_sampled"]
+            ),
+            "sharded_zero_duplicate_appends": (
+                sharded_chaos["uid_audit"]["duplicate_count"] == 0
+                and sharded_chaos["uid_audit"]["unaudited_episodes"] == 0
+                and sharded_free["uid_audit"]["duplicate_count"] == 0
+            ),
+            "sharded_per_shard_loss_bounded": all(
+                entry.get("episodes_lost_total", 0) <= args.seal_episodes
+                for entry in sharded_chaos["per_shard"]
+            ),
+            "sharded_loss_counted": (
+                sharded_chaos["episodes_lost"]
+                + sharded_chaos["spill_dropped_episodes"]
+            ),
+            "sharded_shard_respawned": (
+                sharded_chaos["replay_restarts"] >= 1
+            ),
+            "sharded_coverage_loss_counted": (
+                sum(sharded_chaos["coverage_lost_draws"]) > 0
+            ),
         }
         payload = {
             "metric": metric,
@@ -3294,13 +3444,19 @@ def bench_rl(args) -> None:
             "detail": {
                 "fault_free": fault_free,
                 "chaos": chaos_leg,
+                "sharded_fault_free": sharded_free,
+                "sharded_chaos": sharded_chaos,
                 "acceptance": acceptance,
                 "samples_per_sec": fault_free["samples_per_s"],
                 "replay_ratio": fault_free["replay_ratio"],
                 "staleness_mean": fault_free["staleness_mean"],
                 "staleness_max": fault_free["staleness_max"],
+                "sharded_episodes_per_sec": sharded_free["episodes_per_s"],
+                "sharded_samples_per_sec": sharded_free["samples_per_s"],
                 "actors": args.actors,
                 "replicas": args.replicas,
+                "shards": args.shards,
+                "replay_transport": "socket",
                 "learner_steps": args.steps,
                 "batch": args.batch,
                 "seal_episodes": args.seal_episodes,
@@ -3517,7 +3673,10 @@ def _build_cli():
         "service -> learner -> exported policy -> serving fleet -> "
         "actors; fault-free + chaos (replay-service AND actor SIGKILL "
         "mid-run) twins with episodes/s, samples/s, replay ratio and "
-        "policy staleness (docs/RL_LOOP.md)",
+        "policy staleness, plus sharded-fabric twins (--shards "
+        "replay shards on the socket transport; chaos variant SIGKILLs "
+        "one shard AND partitions another — zero duplicate appends, "
+        "counted per-shard + coverage loss) (docs/RL_LOOP.md)",
     )
     rl.add_argument(
         "--actors", type=int, default=2,
@@ -3542,6 +3701,13 @@ def _build_cli():
              "(default %(default)s)",
     )
     rl.add_argument(
+        "--shards", type=int, default=3,
+        help="replay-service shard count for the sharded legs (socket "
+             "transport, consistent-hash placement); >= 3 for the "
+             "kill-one-partition-another chaos acceptance "
+             "(default %(default)s)",
+    )
+    rl.add_argument(
         "--actor-throttle-ms", type=float, default=20.0,
         help="per-episode actor throttle (default %(default)s)",
     )
@@ -3551,7 +3717,7 @@ def _build_cli():
              "(default %(default)s)",
     )
     rl.add_argument(
-        "--out", default="BENCH_RL_r12.json",
+        "--out", default="BENCH_RL_r13.json",
         help="also write the payload to this file ('' disables; "
              "default %(default)s)",
     )

@@ -287,6 +287,55 @@ def random_mutations(count: int = 16, seed: int = _SEED) -> Dict[str, bytes]:
     return out
 
 
+def corrupt_frame_variants(
+    frame: bytes, header_size: int = 12, seed: int = _SEED
+) -> Dict[str, bytes]:
+    """Corruption family for a length-prefixed CRC frame (the replay
+    socket transport's wire unit, replay/transport.py).
+
+    Same shapes as `corrupt_record_variants`, applied to one valid
+    encoded frame: structural truncations (inside the header, at the
+    header/payload seam, mid-payload, one byte short), seeded bitflips
+    across the whole frame, forged length fields (huge/past-EOF — the
+    receiver must bound-check BEFORE allocating), and bad magic. Fully
+    deterministic given (frame, seed), like every corpus family.
+    """
+    if len(frame) <= header_size:
+        raise ValueError("frame must be longer than its header")
+    rng = np.random.RandomState(seed + 7)
+    variants: Dict[str, bytes] = {}
+    payload_len = len(frame) - header_size
+    cuts = [
+        2,                               # inside the magic
+        header_size // 2,                # inside the length field
+        header_size,                     # header/payload seam
+        header_size + payload_len // 2,  # mid-payload
+        len(frame) - 1,                  # one byte short
+    ]
+    cuts += [int(c) for c in rng.randint(1, len(frame), size=6)]
+    for cut in sorted(set(cuts)):
+        variants[f"frame_trunc_{cut:06d}"] = frame[:cut]
+    for i, offset in enumerate(rng.randint(0, len(frame), size=12)):
+        flipped = bytearray(frame)
+        flipped[int(offset)] ^= 1 << int(rng.randint(0, 8))
+        variants[f"frame_bitflip_{i:02d}"] = bytes(flipped)
+    # Forged length: claims ~4 GB (allocation-bound probe) but keeps the
+    # original payload bytes.
+    huge = bytearray(frame)
+    huge[4:8] = struct.pack("<I", 0xFFFF0000)
+    variants["frame_huge_length"] = bytes(huge)
+    # Forged length past EOF by one byte: must read as a torn frame,
+    # never as a short decode.
+    past = bytearray(frame)
+    past[4:8] = struct.pack("<I", payload_len + 1)
+    variants["frame_len_past_eof"] = bytes(past)
+    # Bad magic with everything else intact.
+    unmagic = bytearray(frame)
+    unmagic[0:4] = b"JUNK"
+    variants["frame_bad_magic"] = bytes(unmagic)
+    return variants
+
+
 def write_corpus(directory: str, with_mutations: bool = True) -> List[str]:
     """Materializes the full corpus; returns the written paths."""
     os.makedirs(directory, exist_ok=True)

@@ -294,6 +294,39 @@ _declare(
     minimum=1,
 )
 _declare(
+    "T2R_REPLAY_SHARDS",
+    _INT,
+    1,
+    "Replay-service shard count for the online loop: 1 = the single "
+    "service; >1 = consistent-hash episode placement over per-shard "
+    "segment directories with sample failover and bounded append spill "
+    "(replay/sharded.py).",
+    "tensor2robot_tpu/replay/loop.py",
+    minimum=1,
+)
+_declare(
+    "T2R_REPLAY_SPILL_BYTES",
+    _INT,
+    8 << 20,
+    "Client-side spill budget (bytes) for episodes addressed to an "
+    "unreachable replay shard: buffered and retried in order until the "
+    "shard returns; beyond the budget episodes are dropped AND counted "
+    "(degraded, never silent).",
+    "tensor2robot_tpu/replay/sharded.py",
+    minimum=0,
+)
+_declare(
+    "T2R_REPLAY_TRANSPORT",
+    _ENUM,
+    "queue",
+    "Replay client/service wire: queue = supervisor-bridged mp queues "
+    "(single host, the tier-1 fallback); socket = CRC-framed TCP "
+    "(replay/transport.py) with per-request deadlines — the cross-host "
+    "fabric the sharded bench runs on.",
+    "tensor2robot_tpu/replay/service.py",
+    choices=("queue", "socket"),
+)
+_declare(
     "T2R_SERVE_BUCKETS",
     _STR,
     None,

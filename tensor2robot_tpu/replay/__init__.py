@@ -27,6 +27,16 @@ policies) are first-class, *tested* behaviors:
   * `actor`    — episode collection off policy clients (serving-fleet
                  gateway, local predictor, or seeded random), actor
                  process entry, and the router gateway.
+  * `transport` — the cross-host wire: length-prefixed CRC-framed
+                 request/response over TCP with per-request deadlines,
+                 published-address discovery, and the network chaos
+                 sites (`net_send`/`net_recv`).
+  * `shard_map` / `sharded` — the sharded fabric: consistent-hash
+                 episode placement stable under shard respawn, N shard
+                 services with per-shard durability, and the
+                 placement-aware client (sample failover with COUNTED
+                 coverage loss, bounded append spill to dead shards,
+                 cross-shard zero-duplicate uid audit).
   * `loop`     — the closed online loop harness used by `bench.py rl`
                  and the chaos suites.
 
@@ -53,6 +63,10 @@ _EXPORTS = {
     "ReplayError": "service",
     "ReplayServiceHandle": "service",
     "ReplayUnavailable": "service",
+    "ShardMap": "shard_map",
+    "ShardedReplayClient": "sharded",
+    "ShardedReplayService": "sharded",
+    "audit_episode_uids": "sharded",
     "ReplayInputGenerator": "input_generator",
     "EpisodeCollector": "actor",
     "GatewayPolicyClient": "actor",

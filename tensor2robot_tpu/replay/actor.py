@@ -32,7 +32,6 @@ from __future__ import annotations
 import logging
 import os
 import queue
-import random
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -43,6 +42,7 @@ from tensor2robot_tpu.research.pose_env.episode_to_transitions import (
     episode_to_transitions_pose_toy,
 )
 from tensor2robot_tpu.testing import chaos
+from tensor2robot_tpu.utils.backoff import Backoff
 from tensor2robot_tpu.utils.errors import best_effort
 
 _log = logging.getLogger(__name__)
@@ -101,11 +101,25 @@ class GatewayPolicyClient:
 
     Wire: puts (actor_id, req_id, obs) on the shared gateway request
     queue, waits on its own response queue for (req_id, action, version,
-    error). Retries `retries` times with jittered backoff; exhausted,
-    returns a seeded random action with version -1 and bumps
-    `fallback_actions` — an actor must keep collecting through a
-    serving brown-out, and the stamp (-1) keeps those episodes honest
-    in the staleness accounting.
+    error). Retries `retries` times with jittered backoff (the shared
+    seeded schedule, utils/backoff.py); exhausted, returns a seeded
+    random action with version -1 and bumps `fallback_actions` — an
+    actor must keep collecting through a serving brown-out, and the
+    stamp (-1) keeps those episodes honest in the staleness accounting.
+
+    Two degradations, stamped and counted SEPARATELY (they used to
+    share -1, which made "we served a random action" indistinguishable
+    from "we served a fleet action of unknowable age"):
+
+      * **fallback action** (`fallback_actions`, stamp -1): the fleet
+        never answered — the action is random, version -1 by fiat.
+      * **version unknown** (`version_unknown_actions`): the fleet
+        answered, but the gateway could not translate the artifact's
+        model_version to a publish counter (version=None on the wire —
+        a reply racing the first publish, before any mapping exists).
+        The action is REAL; only its age is unknown. Stamp: the last
+        publish counter this client has ever seen, or -1 on first
+        contact — never a fabricated 0 that would claim freshness.
     """
 
     def __init__(
@@ -124,7 +138,7 @@ class GatewayPolicyClient:
         self._timeout_s = timeout_s
         self._retries = retries
         self._rng = np.random.RandomState(seed)
-        self._backoff = random.Random(seed)
+        self._backoff = Backoff(base_ms=50.0, cap_ms=1000.0, seed=seed)
         self._action_size = action_size
         # Opaque (instance token, counter) request ids, same rationale as
         # ReplayClient: ids from different client instances sharing a
@@ -132,14 +146,13 @@ class GatewayPolicyClient:
         self._token = f"{os.getpid()}-{id(self):x}"
         self._req_counter = 0
         self.fallback_actions = 0
+        self.version_unknown_actions = 0
+        self._last_known_version: Optional[int] = None
 
     def act(self, obs: np.ndarray) -> Tuple[np.ndarray, int]:
         for attempt in range(self._retries + 1):
             if attempt:
-                time.sleep(
-                    min(0.05 * (2 ** (attempt - 1))
-                        * (1 + self._backoff.random()), 1.0)
-                )
+                time.sleep(self._backoff.delay_s(attempt))
             self._req_counter += 1
             req_id = (self._token, self._req_counter)
             try:
@@ -162,12 +175,20 @@ class GatewayPolicyClient:
                     continue  # stale reply from a timed-out attempt
                 _, action, version, error = response
                 if error is None:
-                    return (
-                        np.asarray(action, np.float32).reshape(-1)[
-                            : self._action_size
-                        ],
-                        int(version),
-                    )
+                    action = np.asarray(action, np.float32).reshape(-1)[
+                        : self._action_size
+                    ]
+                    if version is None:
+                        # Staleness unknown, action real (see class doc).
+                        self.version_unknown_actions += 1
+                        stamp = (
+                            self._last_known_version
+                            if self._last_known_version is not None
+                            else -1
+                        )
+                        return action, stamp
+                    self._last_known_version = int(version)
+                    return action, int(version)
                 break  # typed failure: next attempt
         self.fallback_actions += 1
         return (
@@ -219,6 +240,7 @@ class RouterGateway:
         self._closed = False
         self.requests_served = 0
         self.requests_failed = 0
+        self.unknown_version_replies = 0
         self._thread = threading.Thread(target=self._loop, daemon=True)
 
     def start(self) -> "RouterGateway":
@@ -271,12 +293,14 @@ class RouterGateway:
                 raw_version = int(response.model_version)
                 version = self._version_translate.get(raw_version)
                 if version is None:
-                    # A version published before this gateway learned its
-                    # mapping: stamp the newest counter we know (never
-                    # the raw timestamp — it would poison staleness).
-                    version = max(
-                        self._version_translate.values(), default=0
-                    )
+                    # The artifact's model_version has no publish-counter
+                    # mapping yet (a reply racing the first publish).
+                    # Ship version=None — "staleness unknown" — and
+                    # count it; the actor-side client stamps its last
+                    # KNOWN counter (or -1 on first contact), never a
+                    # fabricated fresh 0 and never the raw timestamp
+                    # (which would poison staleness).
+                    self.unknown_version_replies += 1
                 self._reply(
                     actor_id, (req_id, action, version, None)
                 )
@@ -352,7 +376,7 @@ class EpisodeCollector:
 
 def actor_main(
     actor_id: int,
-    replay_queues,
+    replay_queues=None,
     gateway_queues=None,
     num_episodes: int = 0,
     seed: int = 0,
@@ -360,23 +384,38 @@ def actor_main(
     hidden_drift: bool = False,
     report_q=None,
     throttle_s: float = 0.0,
+    shard_specs=None,
+    stop_event=None,
 ) -> None:
     """Actor process entry (spawn-safe: queue objects ride the args).
 
     Collects `num_episodes` episodes (0 = until the replay append path
     raises, i.e. supervisor teardown), appending each whole episode with
-    its policy version + priority. Declares chaos scope `a<actor_id>` so
-    seeded plans can target one actor (`a1/actor_step:3:kill` is the
-    actor-SIGKILL-mid-episode fault). Posts a final summary dict on
-    `report_q` when given.
+    its policy version + priority. The replay wire is either the single
+    service's `replay_queues` pair, or — sharded topology —
+    `shard_specs`, the per-shard client recipes from
+    `ShardedReplayService.client_specs` (socket specs are just paths:
+    the shape a remote-host actor needs). Declares chaos scope
+    `a<actor_id>` so seeded plans can target one actor
+    (`a1/actor_step:3:kill` is the actor-SIGKILL-mid-episode fault).
+    Posts a final summary dict on `report_q` when given.
     """
     from tensor2robot_tpu.research.pose_env.pose_env import PoseToyEnv
 
     chaos.set_scope(f"a{actor_id}")
-    request_q, response_q = replay_queues
-    replay = ReplayClient(
-        f"actor-{actor_id}", request_q, response_q, seed=seed
-    )
+    if shard_specs is not None:
+        from tensor2robot_tpu.replay.sharded import (
+            sharded_client_from_specs,
+        )
+
+        replay: Any = sharded_client_from_specs(
+            shard_specs, f"actor-{actor_id}", seed=seed
+        )
+    else:
+        request_q, response_q = replay_queues
+        replay = ReplayClient(
+            f"actor-{actor_id}", request_q, response_q, seed=seed
+        )
     if gateway_queues is not None:
         policy: Any = GatewayPolicyClient(
             f"actor-{actor_id}", gateway_queues[0], gateway_queues[1],
@@ -393,6 +432,8 @@ def actor_main(
     rewards: List[float] = []
     try:
         while num_episodes == 0 or episodes < num_episodes:
+            if stop_event is not None and stop_event.is_set():
+                break  # cooperative drain: report before the terminate
             records, info = collector.collect()
             episodes += 1
             rewards.append(info["raw_reward"])
@@ -405,7 +446,12 @@ def actor_main(
             if throttle_s:
                 time.sleep(throttle_s)
     finally:
+        if shard_specs is not None:
+            # Spilled episodes get one last drain before the report, so
+            # the bench's append accounting sees what actually landed.
+            best_effort(replay.flush_spill, 5.0)
         if report_q is not None:
+            sharded_counters = dict(getattr(replay, "counters", {}))
             best_effort(
                 report_q.put,
                 {
@@ -419,5 +465,9 @@ def actor_main(
                     "fallback_actions": getattr(
                         policy, "fallback_actions", 0
                     ),
+                    "version_unknown_actions": getattr(
+                        policy, "version_unknown_actions", 0
+                    ),
+                    "replay_counters": sharded_counters,
                 },
             )

@@ -100,7 +100,9 @@ class ReplayInputGenerator(AbstractInputGenerator):
       replay_root: the replay directory (dir mode reads it directly;
         also used for bookkeeping in service mode).
       batch_size: records per batch.
-      client: a ReplayClient — service mode. None -> dir mode.
+      client: a ReplayClient — service mode — or a ShardedReplayClient
+        (replay/sharded.py; same sample() contract, shard-qualified
+        coordinates). None -> dir mode.
       wait_timeout_s: how long to wait for a first sealed segment
         before giving up (both modes; bring-up patience).
       refresh: dir mode only — rescan for newly sealed segments when
@@ -176,15 +178,23 @@ class ReplayInputGenerator(AbstractInputGenerator):
         return self._schedule_digest.hexdigest()
 
     def _note_batch(self, coords) -> None:
-        coords = [(int(a), int(b)) for a, b in coords]
+        # Coordinates are (segment_seq, record_index) pairs from a
+        # single buffer/service, or shard-qualified (shard, segment_seq,
+        # record_index) triples from the sharded client — logged and
+        # digested uniformly. The 2-tuple digest bytes are UNCHANGED
+        # ("a:b;"), which is what keeps the pre-shard crash-consistency
+        # schedule pins bitwise-stable.
+        coords = [tuple(int(part) for part in coord) for coord in coords]
         self.coords_log.append(coords)
         if len(self.coords_log) > self.coords_log_limit:
             drop = len(self.coords_log) - self.coords_log_limit
             del self.coords_log[:drop]
             self.coords_log_dropped += drop
         self.batches_drawn += 1
-        for a, b in coords:
-            self._schedule_digest.update(f"{a}:{b};".encode())
+        for coord in coords:
+            self._schedule_digest.update(
+                (":".join(str(part) for part in coord) + ";").encode()
+            )
 
     # -- batch stream ----------------------------------------------------------
 

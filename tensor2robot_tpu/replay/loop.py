@@ -45,10 +45,17 @@ from tensor2robot_tpu.replay.actor import (
     RouterGateway,
     actor_main,
 )
+from tensor2robot_tpu import flags as t2r_flags
 from tensor2robot_tpu.replay.input_generator import ReplayInputGenerator
 from tensor2robot_tpu.replay.service import (
     ReplayBuffer,
     ReplayServiceHandle,
+)
+from tensor2robot_tpu.replay.sharded import (
+    ShardedReplayClient,
+    ShardedReplayService,
+    local_shard_backends,
+    shard_root,
 )
 from tensor2robot_tpu.testing import chaos
 from tensor2robot_tpu.utils.errors import best_effort
@@ -86,6 +93,26 @@ class LoopReport:
     # counters above are then absent, not zero — acceptance gates must
     # treat the run as unmeasured, never as lossless.
     stats_ok: bool = True
+    # Serving-degradation split (distinct meanings that used to share a
+    # -1 stamp): fallback = the fleet never answered, the action is
+    # random; version-unknown = a REAL fleet action whose publish age
+    # could not be determined. Counted separately across all actors.
+    fallback_actions: int = 0
+    version_unknown_actions: int = 0
+    # Sharded-fabric accounting (empty/zero for the single service).
+    shards: int = 1
+    per_shard: List[Dict[str, Any]] = dataclasses.field(
+        default_factory=list
+    )
+    coverage_lost_draws: List[int] = dataclasses.field(
+        default_factory=list
+    )
+    spill_replayed: int = 0
+    spill_dropped_episodes: int = 0
+    appends_deduped: int = 0
+    shards_unreachable: List[int] = dataclasses.field(
+        default_factory=list
+    )
 
     def to_json(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -171,6 +198,8 @@ class OnlineLoop:
         model_fn: Optional[Callable[[], Any]] = None,
         wait_timeout_s: float = 120.0,
         actor_throttle_s: float = 0.0,
+        shards: Optional[int] = None,
+        transport: Optional[str] = None,
     ):
         self.root = root
         self.replay_root = os.path.join(root, "replay")
@@ -185,6 +214,14 @@ class OnlineLoop:
         self.seed = seed
         self.in_process = in_process
         self.use_router = use_router
+        # Sharded topology: >1 = consistent-hash placement over N shard
+        # services (replay/sharded.py); the transport picks the wire
+        # (socket = the cross-host fabric, queue = single-host default).
+        self.shards = (
+            t2r_flags.get_int("T2R_REPLAY_SHARDS")
+            if shards is None else max(1, shards)
+        )
+        self.transport = transport
         self._router = router
         self._threshold = binary_success_threshold
         self._model_fn = model_fn or self._default_model_fn
@@ -192,11 +229,15 @@ class OnlineLoop:
         self._actor_throttle_s = actor_throttle_s
 
         self._service: Optional[ReplayServiceHandle] = None
+        self._sharded: Optional[ShardedReplayService] = None
+        self._sharded_client: Optional[ShardedReplayClient] = None
+        self._shard_buffers: List[ReplayBuffer] = []
         self._buffer: Optional[ReplayBuffer] = None
         self._gateway: Optional[RouterGateway] = None
         self._actor_processes: List[Any] = []
         self._actor_threads: List[threading.Thread] = []
         self._actor_stop = threading.Event()
+        self._actor_stop_event = None  # mp.Event, multi-process modes
         self._report_q = None
         self._publish_hook: Optional[PublishPolicyHook] = None
         self._version_counter = 0
@@ -204,6 +245,7 @@ class OnlineLoop:
         self._exporter = None
         self._compiled_for_export = None
         self._driver_client = None
+        self._learner_client = None
         self._generator: Optional[ReplayInputGenerator] = None
         self._learner_steps = 0
         self._actors_killed = 0
@@ -230,13 +272,33 @@ class OnlineLoop:
         return self
 
     def _start_in_process(self) -> None:
-        self._buffer = ReplayBuffer(
-            self.replay_root,
-            seal_episodes=self.seal_episodes,
-            seal_bytes=self.seal_bytes,
-            sampler=self.sampler,
-            seed=self.seed,
-        )
+        if self.shards > 1:
+            # The tier-1 sharded twin: N in-process buffers behind the
+            # SAME placement/failover/counting client the multi-process
+            # fabric uses — every sharded code path, zero subprocesses.
+            self._shard_buffers = [
+                ReplayBuffer(
+                    shard_root(self.replay_root, shard),
+                    seal_episodes=self.seal_episodes,
+                    seal_bytes=self.seal_bytes,
+                    sampler=self.sampler,
+                    seed=self.seed,
+                )
+                for shard in range(self.shards)
+            ]
+            self._sharded_client = ShardedReplayClient(
+                local_shard_backends(self._shard_buffers),
+                client_id="loop",
+                seed=self.seed,
+            )
+        else:
+            self._buffer = ReplayBuffer(
+                self.replay_root,
+                seal_episodes=self.seal_episodes,
+                seal_bytes=self.seal_bytes,
+                sampler=self.sampler,
+                seed=self.seed,
+            )
 
         def actor_thread(index: int) -> None:
             from tensor2robot_tpu.research.pose_env.pose_env import (
@@ -248,13 +310,14 @@ class OnlineLoop:
             collector = EpisodeCollector(
                 env, policy, binary_success_threshold=self._threshold
             )
+            sink = self._sharded_client or self._buffer
             episodes = 0
             while not self._actor_stop.is_set() and (
                 self.episodes_per_actor == 0
                 or episodes < self.episodes_per_actor
             ):
                 records, info = collector.collect()
-                self._buffer.append(
+                sink.append(
                     records,
                     policy_version=max(info["policy_version"], 0),
                     priority=info["priority"],
@@ -290,16 +353,29 @@ class OnlineLoop:
         client_ids = [f"actor-{i}" for i in range(self.num_actors)] + [
             "learner", "driver",
         ]
-        self._service = ReplayServiceHandle(
-            self.replay_root,
-            client_ids,
-            config={
-                "seal_episodes": self.seal_episodes,
-                "seal_bytes": self.seal_bytes,
-                "sampler": self.sampler,
-                "seed": self.seed,
-            },
-        ).start()
+        config = {
+            "seal_episodes": self.seal_episodes,
+            "seal_bytes": self.seal_bytes,
+            "sampler": self.sampler,
+            "seed": self.seed,
+        }
+        if self.shards > 1:
+            self._sharded = ShardedReplayService(
+                self.replay_root,
+                self.shards,
+                client_ids,
+                config=config,
+                transport=self.transport,
+            ).start()
+            mp_ctx = self._sharded.handles[0]._ctx
+        else:
+            self._service = ReplayServiceHandle(
+                self.replay_root,
+                client_ids,
+                config=config,
+                transport=self.transport,
+            ).start()
+            mp_ctx = self._service._ctx
         gateway_queue_pairs: List[Any] = [None] * self.num_actors
         if self.use_router:
             if self._router is None:
@@ -311,28 +387,35 @@ class OnlineLoop:
             self._gateway = RouterGateway(
                 self._router,
                 actor_ids,
-                mp_context=self._service._ctx,
+                mp_context=mp_ctx,
                 version_translate=self._version_translate,
             ).start()
             gateway_queue_pairs = [
                 self._gateway.actor_queues(actor_id)
                 for actor_id in actor_ids
             ]
-        self._report_q = self._service._ctx.Queue()
+        self._report_q = mp_ctx.Queue()
+        self._actor_stop_event = mp_ctx.Event()
         for index in range(self.num_actors):
-            process = self._service._ctx.Process(
+            replay_kwargs: Dict[str, Any] = (
+                {"shard_specs": self._sharded.client_specs(
+                    f"actor-{index}")}
+                if self._sharded is not None
+                else {"replay_queues": self._service.client_queues(
+                    f"actor-{index}")}
+            )
+            process = mp_ctx.Process(
                 target=actor_main,
                 kwargs=dict(
                     actor_id=index,
-                    replay_queues=self._service.client_queues(
-                        f"actor-{index}"
-                    ),
                     gateway_queues=gateway_queue_pairs[index],
                     num_episodes=self.episodes_per_actor,
                     seed=self.seed + index,
                     binary_success_threshold=self._threshold,
                     report_q=self._report_q,
                     throttle_s=self._actor_throttle_s,
+                    stop_event=self._actor_stop_event,
+                    **replay_kwargs,
                 ),
                 daemon=True,
             )
@@ -350,9 +433,18 @@ class OnlineLoop:
     # -- chaos controls --------------------------------------------------------
 
     def kill_replay_service(self) -> Optional[int]:
+        if self._sharded is not None:
+            return self.kill_shard(0)
         if self._service is None:
             raise RuntimeError("no replay service in in-process mode")
         return self._service.kill()
+
+    def kill_shard(self, shard: int) -> Optional[int]:
+        """SIGKILL one shard's service process (its supervisor respawns
+        it); the fabric spills/fails over meanwhile — that is the leg."""
+        if self._sharded is None:
+            raise RuntimeError("no sharded replay service in this mode")
+        return self._sharded.kill_shard(shard)
 
     def kill_actor(self, index: int) -> Optional[int]:
         process = self._actor_processes[index]
@@ -386,7 +478,9 @@ class OnlineLoop:
                 self._router.rolling_swap()
         if self._buffer is not None:
             self._buffer.set_policy_version(self._version_counter)
-        elif self._service is not None:
+        elif self._sharded_client is not None:
+            self._sharded_client.set_policy_version(self._version_counter)
+        elif self._service is not None or self._sharded is not None:
             self._driver().set_policy_version(self._version_counter)
         return self._version_counter
 
@@ -395,9 +489,12 @@ class OnlineLoop:
         share the response queue with its predecessors (reply aliasing
         is guarded by opaque tokens, but one instance is simply right)."""
         if self._driver_client is None:
-            self._driver_client = self._service.client(
-                "driver", timeout_s=10.0, retries=3
-            )
+            if self._sharded is not None:
+                self._driver_client = self._sharded.client("driver")
+            else:
+                self._driver_client = self._service.client(
+                    "driver", timeout_s=10.0, retries=3
+                )
         return self._driver_client
 
     def run_learner(
@@ -413,11 +510,15 @@ class OnlineLoop:
         from tensor2robot_tpu.train import train_eval as te
 
         model = self._model_fn()
-        client = (
-            self._service.client("learner", timeout_s=30.0)
-            if self._service is not None
-            else None
-        )
+        if self._sharded is not None:
+            client: Any = self._sharded.client("learner")
+        elif self._sharded_client is not None:
+            client = self._sharded_client  # in-process sharded twin
+        elif self._service is not None:
+            client = self._service.client("learner", timeout_s=30.0)
+        else:
+            client = None
+        self._learner_client = client
         self._generator = ReplayInputGenerator(
             self.replay_root,
             batch_size=self.batch_size,
@@ -470,11 +571,38 @@ class OnlineLoop:
 
     # -- teardown + report -----------------------------------------------------
 
+    def _merge_fabric_counters(
+        self, report: LoopReport, client: ShardedReplayClient
+    ) -> None:
+        self._merge_fabric_counter_dict(report, client.counters)
+
+    @staticmethod
+    def _merge_fabric_counter_dict(
+        report: LoopReport, counters: Dict[str, Any]
+    ) -> None:
+        """Folds one sharded client's degradation counters into the
+        report — every client (each actor's, the learner's) keeps its
+        own, and the fabric-wide number is their sum."""
+        if not counters:
+            return
+        report.spill_replayed += counters.get("spill_replayed", 0)
+        report.spill_dropped_episodes += counters.get(
+            "spill_dropped_episodes", 0
+        )
+        report.appends_deduped += counters.get("appends_deduped", 0)
+        lost = counters.get("coverage_lost_draws") or []
+        if not report.coverage_lost_draws:
+            report.coverage_lost_draws = [0] * len(lost)
+        for shard, count in enumerate(lost):
+            if shard < len(report.coverage_lost_draws):
+                report.coverage_lost_draws[shard] += count
+
     def stop(self, timeout_s: float = 30.0) -> LoopReport:
         report = LoopReport()
         report.wall_s = time.monotonic() - self._t_start
         report.learner_steps = self._learner_steps
         report.actors_killed = self._actors_killed
+        report.shards = self.shards
         if self._publish_hook is not None:
             report.publishes = self._publish_hook.publishes
         self._actor_stop.set()
@@ -484,16 +612,42 @@ class OnlineLoop:
         if self._buffer is not None:
             stats = self._buffer.stats()
             self._buffer.close(seal_tail=True)
-        if self._service is not None:
-            # Ask actors to stop by draining their episode budget — the
-            # processes exit when append fails post-stop; collect reports
-            # first, then stop the service.
+        if self._sharded_client is not None:
+            # In-process sharded twin: the shared client holds the
+            # fabric counters; seal + close the buffers it fronts.
+            stats = self._sharded_client.stats()
+            self._merge_fabric_counters(report, self._sharded_client)
+            for buffer in self._shard_buffers:
+                buffer.close(seal_tail=True)
+        if self._service is not None or self._sharded is not None:
+            # Cooperative actor drain FIRST: the stop event lets each
+            # actor finish its in-flight episode, flush any spill, and
+            # post its report (spill/fallback counters) before the
+            # hard-terminate backstop below.
+            if self._actor_stop_event is not None:
+                self._actor_stop_event.set()
             for process in self._actor_processes:
-                process.join(0.1)
+                process.join(3.0)
             try:
-                stats = self._service.client(
-                    "driver", timeout_s=10.0, retries=3
-                ).stats()
+                if self._sharded is not None:
+                    # A shard SIGKILLed moments before stop() is mid-
+                    # respawn right now; give each supervisor a bounded
+                    # window to republish before the stats read calls
+                    # it unreachable (stats_ok=False is for shards that
+                    # STAY dark, not for losing a boot race).
+                    for handle in self._sharded.handles:
+                        handle.wait_ready(10.0)
+                stats = self._driver().stats()
+                if self._sharded is not None and stats.get(
+                    "shards_unreachable"
+                ):
+                    # Partial totals are not measured totals: a shard
+                    # whose counters could not be read means every
+                    # summed gate below would under-count.
+                    report.stats_ok = False
+                    report.shards_unreachable = list(
+                        stats["shards_unreachable"]
+                    )
             except Exception:
                 # NOT silently zeroed: fabricated-zero loss counters
                 # would pass every acceptance gate. The report says the
@@ -501,7 +655,11 @@ class OnlineLoop:
                 _log.exception("post-run replay stats read failed")
                 stats = {}
                 report.stats_ok = False
-            report.replay_restarts = self._service.respawns
+            report.replay_restarts = (
+                self._sharded.respawns
+                if self._sharded is not None
+                else self._service.respawns
+            )
             for process in self._actor_processes:
                 if process.is_alive():
                     process.terminate()
@@ -514,7 +672,24 @@ class OnlineLoop:
                         )
                     except Exception:
                         break
-            self._service.stop()
+            if self._sharded is not None:
+                self._sharded.stop()
+            else:
+                self._service.stop()
+        if (
+            isinstance(self._learner_client, ShardedReplayClient)
+            and self._learner_client is not self._sharded_client
+        ):
+            self._merge_fabric_counters(report, self._learner_client)
+        for actor_report in report.actor_reports:
+            report.fallback_actions += actor_report.get(
+                "fallback_actions", 0
+            )
+            report.version_unknown_actions += actor_report.get(
+                "version_unknown_actions", 0
+            )
+            counters = actor_report.get("replay_counters") or {}
+            self._merge_fabric_counter_dict(report, counters)
         if self._gateway is not None:
             self._gateway.stop()
         if stats:
@@ -531,6 +706,24 @@ class OnlineLoop:
             report.staleness_mean = staleness.get("staleness_mean", 0.0)
             report.staleness_max = int(stats.get("staleness_max_seen", 0))
             report.recovery = stats.get("recovery", {})
+            per_shard = stats.get("per_shard")
+            if per_shard is not None:
+                report.per_shard = [dict(entry) for entry in per_shard]
+                # Fabric-level recovery/staleness: sum the shards'
+                # recovery sweeps; take the worst staleness any shard
+                # has seen (a partitioned shard's lag must not average
+                # away).
+                merged_recovery: Dict[str, int] = {}
+                for entry in report.per_shard:
+                    for key, value in (entry.get("recovery") or {}).items():
+                        merged_recovery[key] = (
+                            merged_recovery.get(key, 0) + value
+                        )
+                    report.staleness_max = max(
+                        report.staleness_max,
+                        int(entry.get("staleness_max_seen", 0)),
+                    )
+                report.recovery = merged_recovery
         if self.in_process:
             report.episodes_appended = max(
                 report.episodes_appended, self._in_process_episodes

@@ -104,6 +104,14 @@ class SegmentManifest:
     priorities: Tuple[float, ...] = ()
     min_policy_version: int = 0
     max_policy_version: int = 0
+    # Per-episode client-assigned identities in episode_seq order (""
+    # for legacy/uid-less appends). Sealing an episode's uid is what
+    # makes append retries idempotent ACROSS service crashes: a
+    # respawned service rebuilds its dedup set from these, so a retry
+    # of an append that sealed before the crash is recognized — and the
+    # fabric's zero-duplicate audit (sharded chaos bench) counts
+    # repeated uids across every shard's manifests.
+    episode_uids: Tuple[str, ...] = ()
 
     def to_json(self) -> Dict:
         return {
@@ -116,6 +124,7 @@ class SegmentManifest:
             "priorities": list(self.priorities),
             "min_policy_version": self.min_policy_version,
             "max_policy_version": self.max_policy_version,
+            "episode_uids": list(self.episode_uids),
         }
 
     @staticmethod
@@ -129,6 +138,9 @@ class SegmentManifest:
             priorities=tuple(float(p) for p in payload.get("priorities", ())),
             min_policy_version=int(payload.get("min_policy_version", 0)),
             max_policy_version=int(payload.get("max_policy_version", 0)),
+            episode_uids=tuple(
+                str(u) for u in payload.get("episode_uids", ())
+            ),
         )
 
 
@@ -168,6 +180,7 @@ class SegmentWriter:
         self.data_bytes = 0
         self._crc = 0
         self._priorities: List[float] = []
+        self._uids: List[str] = []
         self._min_version: Optional[int] = None
         self._max_version: Optional[int] = None
         self._path = open_segment_path(root, seq)
@@ -182,9 +195,12 @@ class SegmentWriter:
         transitions: Sequence[bytes],
         policy_version: int = 0,
         priority: float = 1.0,
+        episode_uid: str = "",
     ) -> int:
         """Appends one whole episode (a sequence of wire-bytes records);
-        returns this episode's segment-local episode_seq."""
+        returns this episode's segment-local episode_seq. `episode_uid`
+        is the client-assigned identity sealed into the manifest (""
+        = uid-less legacy append)."""
         if not transitions:
             raise ValueError("an episode must carry at least one record")
         episode_seq = self.episodes
@@ -208,6 +224,7 @@ class SegmentWriter:
         self.records += len(transitions)
         self.episodes += 1
         self._priorities.append(float(priority))
+        self._uids.append(str(episode_uid or ""))
         if self._min_version is None or policy_version < self._min_version:
             self._min_version = policy_version
         if self._max_version is None or policy_version > self._max_version:
@@ -238,6 +255,7 @@ class SegmentWriter:
             priorities=tuple(self._priorities),
             min_policy_version=self._min_version or 0,
             max_policy_version=self._max_version or 0,
+            episode_uids=tuple(self._uids),
         )
         _atomic_write_json(manifest_path(self.root, self.seq), manifest.to_json())
         os.rename(self._path, sealed_segment_path(self.root, self.seq))

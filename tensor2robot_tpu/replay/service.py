@@ -10,14 +10,30 @@ Three layers, outermost optional:
     segment, auto-seals on the episode/byte thresholds
     (`T2R_REPLAY_SEAL_EPISODES` / `T2R_REPLAY_SEAL_BYTES`), samples
     only sealed segments, and keeps the loop's observability counters.
-  * `replay_service_main` + `ReplayClient` — the service as a process:
-    clients (actors, the learner, the driver) talk over multiprocessing
-    queues with CRC-checked payload framing inherited from the wire
-    discipline; append retries are IDEMPOTENT (per-client nonces, so an
-    ambiguous crash-during-append retry cannot duplicate an episode).
+  * `replay_service_main` + `ReplayClient` — the service as a process.
+    Two wires, one protocol (`T2R_REPLAY_TRANSPORT`):
+
+      - `queue` (default, the tier-1 fallback): supervisor-bridged
+        multiprocessing queues, exactly the PR 8 topology — in-process
+        and single-host tests pay no socket tax and stay byte-for-byte
+        compatible;
+      - `socket` (the cross-host wire): the service binds a TCP port
+        and publishes it to `<root>/transport.json`; clients speak the
+        CRC-framed stream protocol of `replay/transport.py` with
+        per-request deadlines. No supervisor sits in the data path,
+        which is what lets shards (replay/sharded.py) — and later
+        actors/learners — live on other hosts.
+
+    Append retries are IDEMPOTENT on either wire: every append carries
+    a client-assigned `episode_uid` sealed into the segment manifest,
+    and the buffer refuses a uid it has already made durable — so an
+    ambiguous retry cannot duplicate an episode even across a service
+    crash (the respawned buffer rebuilds its uid set from manifests).
+    Per-client nonces remain as the legacy/uid-less belt.
   * `ReplayServiceHandle` — the supervisor: spawns the service, detects
-    its death, respawns it on the same queues (the restarted process
-    recovers from durable segments — the sweep report is surfaced in
+    its death, respawns it (fresh queues per incarnation in queue mode;
+    a fresh published port in socket mode — the restarted process
+    recovers from durable segments and the sweep report is surfaced in
     stats), and exposes `kill()` for chaos legs.
 
 Chaos sites (testing/chaos.py): `append` fires before an episode's
@@ -47,7 +63,9 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from tensor2robot_tpu import flags as t2r_flags
 from tensor2robot_tpu.replay import segment as segment_lib
+from tensor2robot_tpu.replay import transport as transport_lib
 from tensor2robot_tpu.testing import chaos
+from tensor2robot_tpu.utils.backoff import Backoff
 from tensor2robot_tpu.utils.errors import best_effort
 
 _log = logging.getLogger(__name__)
@@ -59,6 +77,7 @@ __all__ = [
     "ReplayError",
     "ReplayServiceHandle",
     "ReplayUnavailable",
+    "client_from_spec",
     "replay_service_main",
 ]
 
@@ -265,10 +284,18 @@ class ReplayBuffer:
         self._sealed_records = 0
         self._sealed_episodes = 0
         self._segments_sealed = len(sealed)
+        # Durable episode identities: the idempotency set a respawned
+        # service rebuilds from manifests, so an append retry whose
+        # original SEALED before the crash is deduped, not duplicated.
+        # (Unsealed-tail uids die with the tail — its episodes were
+        # quarantined as counted loss, so the retry's copy is the only
+        # live one.) ~tens of bytes per episode; bounded by the data.
+        self._uid_seen: set = set()
         for seq, manifest in sealed:
             self._sampler.note_sealed(seq)
             self._sealed_records += manifest.records
             self._sealed_episodes += manifest.episodes
+            self._uid_seen.update(u for u in manifest.episode_uids if u)
         next_seq = max(
             [seq for seq, _ in sealed] + [counters.get("next_seq", 0) - 1]
         ) + 1 if (sealed or counters) else 0
@@ -292,16 +319,27 @@ class ReplayBuffer:
         transitions: Sequence[bytes],
         policy_version: int = 0,
         priority: float = 1.0,
+        episode_uid: Optional[str] = None,
     ) -> Dict[str, int]:
         """Appends one whole episode; returns {episode_seq, segment_seq,
-        sealed (0/1 whether this append tripped a seal)}."""
+        sealed (0/1 whether this append tripped a seal)} — or
+        {"deduped": 1} when `episode_uid` names an episode this buffer
+        already holds (the idempotent-retry contract)."""
         chaos.maybe_fire("append")
         with self._lock:
             if self._closed:
                 raise ReplayError("replay buffer is closed")
+            if episode_uid and episode_uid in self._uid_seen:
+                self._counters["appends_deduped_total"] = (
+                    self._counters.get("appends_deduped_total", 0) + 1
+                )
+                return {"deduped": 1}
             episode_seq = self._writer.append_episode(
-                transitions, policy_version=policy_version, priority=priority
+                transitions, policy_version=policy_version,
+                priority=priority, episode_uid=episode_uid or "",
             )
+            if episode_uid:
+                self._uid_seen.add(episode_uid)
             self._counters["episodes_appended_total"] += 1
             self._counters["records_appended_total"] += len(transitions)
             segment_seq = self._writer.seq
@@ -399,6 +437,12 @@ class ReplayBuffer:
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:
+            if self._closed:
+                # Mirrors a dead service process: a closed shard's
+                # counters are UNREACHABLE, not implicitly final — the
+                # sharded stats merge must report it as such instead of
+                # folding in numbers nobody maintains anymore.
+                raise ReplayError("replay buffer is closed")
             appended = self._counters["records_appended_total"]
             return {
                 **self._counters,
@@ -433,32 +477,100 @@ class ReplayBuffer:
 # -- the service process -------------------------------------------------------
 
 
+class _ServiceCore:
+    """The transport-independent op dispatcher: one request tuple in,
+    one reply tuple out — shared verbatim by the queue loop and the
+    socket server so the two wires cannot drift.
+
+    Requests are (client_id, req_id, op, args tuple); replies
+    (req_id, "ok", payload) | (req_id, "error", error class name,
+    message). `handle` returns None for the lifecycle "stop" op after
+    setting `stop_requested` — the transport loop owns what that means.
+    """
+
+    def __init__(self, buffer: ReplayBuffer):
+        self.buffer = buffer
+        self.stop_requested = threading.Event()
+        self._last_nonce: Dict[str, int] = {}
+
+    def handle(self, request) -> Optional[Tuple]:
+        try:
+            client_id, req_id, op, args = request
+        except (TypeError, ValueError):
+            _log.warning("malformed replay request %r dropped", request)
+            return None
+        if op == "stop":
+            self.stop_requested.set()
+            return None
+        try:
+            if op == "append":
+                transitions, policy_version, priority, nonce, *rest = args
+                episode_uid = rest[0] if rest else None
+                if (
+                    episode_uid is None
+                    and nonce is not None
+                    and nonce <= self._last_nonce.get(client_id, -1)
+                ):
+                    # Legacy uid-less retry: per-client monotonic nonce
+                    # dedup (in-memory; the uid path survives crashes).
+                    payload: Any = {"deduped": 1}
+                else:
+                    payload = self.buffer.append(
+                        transitions,
+                        policy_version=policy_version,
+                        priority=priority,
+                        episode_uid=episode_uid,
+                    )
+                    if nonce is not None:
+                        self._last_nonce[client_id] = nonce
+            elif op == "sample":
+                (batch_size,) = args
+                payloads, coords, info = self.buffer.sample(batch_size)
+                payload = {
+                    "records": payloads,
+                    "coords": coords,
+                    "info": info,
+                }
+            elif op == "stats":
+                payload = self.buffer.stats()
+            elif op == "seal":
+                payload = {"sealed": int(self.buffer.seal())}
+            elif op == "set_policy_version":
+                (version,) = args
+                self.buffer.set_policy_version(version)
+                payload = {"ok": 1}
+            else:
+                raise ReplayError(f"unknown replay op {op!r}")
+            return (req_id, "ok", payload)
+        except Exception as err:
+            return (req_id, "error", type(err).__name__, str(err))
+
+
 def replay_service_main(
     root: str,
-    request_q,
-    response_q,
+    request_q=None,
+    response_q=None,
     config: Optional[Dict[str, Any]] = None,
 ) -> None:
-    """Process entry: serves append/sample/stats/seal over mp queues.
+    """Process entry: serves append/sample/stats/seal over one of two
+    wires, selected by config["transport"]:
 
-    Protocol: requests are (client_id, req_id, op, args tuple); replies
-    (client_id, req_id, "ok", payload) | (client_id, req_id, "error",
-    error class name, message) on ONE response queue — the supervisor
-    routes them to per-client queues. The queue pair is FRESH per
-    incarnation: a SIGKILL mid-`get` leaves the queue's reader lock
-    held by a dead process forever (the poisoned-queue trap; the fleet
-    router dodges it the same way, serving/router.py `_spawn`), so the
-    supervisor bridges clients' stable queues to each incarnation's
-    fresh ones instead of sharing queues across respawns.
+      * "queue" — requests off `request_q`, replies (client_id-prefixed
+        for supervisor routing) onto `response_q`. The queue pair is
+        FRESH per incarnation: a SIGKILL mid-`get` leaves the queue's
+        reader lock held by a dead process forever (the poisoned-queue
+        trap; the fleet router dodges it the same way,
+        serving/router.py `_spawn`), so the supervisor bridges clients'
+        stable queues to each incarnation's fresh ones.
+      * "socket" — binds an ephemeral localhost TCP port, publishes it
+        to `<root>/transport.json`, and serves the CRC-framed stream
+        protocol (replay/transport.py). No queues, no supervisor in the
+        data path; a respawn publishes its fresh port.
 
-    Append idempotency: each append carries a per-client monotonically
-    increasing nonce; a nonce at-or-below the last applied one replies
-    "ok" without re-appending, so a client that times out and retries an
-    append the service actually applied cannot duplicate the episode.
-    (The nonce map is in-memory: after a service CRASH a retried
-    ambiguous append may re-apply — but its original copy was in the
-    unsealed tail the crash already counted as lost, so the accounting
-    stays conservative.)
+    Append idempotency (both wires): appends carry a client-assigned
+    `episode_uid` the buffer refuses to re-apply — sealed uids survive
+    crashes via the segment manifests — plus the legacy per-client
+    monotonic nonce for uid-less callers.
     """
     config = dict(config or {})
     chaos.set_scope(config.get("chaos_scope", "replay"))
@@ -469,15 +581,23 @@ def replay_service_main(
         sampler=config.get("sampler"),
         seed=int(config.get("seed", 0)),
     )
-    last_nonce: Dict[str, int] = {}
+    core = _ServiceCore(buffer)
     _log.info(
         "replay service up at %s (recovery: %s)", root, buffer.recovery_report
     )
-
-    def reply(client_id: str, message) -> None:
-        best_effort(response_q.put, (client_id,) + message)
-
     try:
+        if config.get("transport") == "socket":
+            server = transport_lib.ReplayTransportServer(core.handle).start()
+            transport_lib.publish_address(
+                root, server.port,
+                incarnation=int(config.get("incarnation", 0)),
+            )
+            try:
+                while not core.stop_requested.wait(0.2):
+                    pass
+            finally:
+                server.stop()
+            return
         while True:
             try:
                 request = request_q.get(timeout=0.1)
@@ -485,48 +605,11 @@ def replay_service_main(
                 continue
             except (OSError, ValueError, EOFError):
                 return  # queue torn down: supervisor is gone
-            client_id, req_id, op, args = request
-            if op == "stop":
+            reply = core.handle(request)
+            if core.stop_requested.is_set():
                 return
-            try:
-                if op == "append":
-                    transitions, policy_version, priority, nonce = args
-                    if nonce is not None and nonce <= last_nonce.get(
-                        client_id, -1
-                    ):
-                        payload: Any = {"deduped": 1}
-                    else:
-                        payload = buffer.append(
-                            transitions,
-                            policy_version=policy_version,
-                            priority=priority,
-                        )
-                        if nonce is not None:
-                            last_nonce[client_id] = nonce
-                elif op == "sample":
-                    (batch_size,) = args
-                    payloads, coords, info = buffer.sample(batch_size)
-                    payload = {
-                        "records": payloads,
-                        "coords": coords,
-                        "info": info,
-                    }
-                elif op == "stats":
-                    payload = buffer.stats()
-                elif op == "seal":
-                    payload = {"sealed": int(buffer.seal())}
-                elif op == "set_policy_version":
-                    (version,) = args
-                    buffer.set_policy_version(version)
-                    payload = {"ok": 1}
-                else:
-                    raise ReplayError(f"unknown replay op {op!r}")
-                reply(client_id, (req_id, "ok", payload))
-            except Exception as err:
-                reply(
-                    client_id,
-                    (req_id, "error", type(err).__name__, str(err)),
-                )
+            if reply is not None:
+                best_effort(response_q.put, (request[0],) + reply)
     finally:
         # Graceful stop: seal the open tail so a clean shutdown keeps
         # every appended episode (the crash path never reaches here —
@@ -538,34 +621,50 @@ class ReplayClient:
     """One client's synchronous view of the replay service.
 
     Every call retries through service restarts: a timeout or an
-    explicit transport failure backs off (jittered exponential, capped)
-    and retries up to `T2R_REPLAY_RETRIES` extra attempts before
-    raising ReplayUnavailable. Typed service-side errors (ReplayEmpty,
+    explicit transport failure backs off (the shared seeded schedule,
+    utils/backoff.py) and retries up to `T2R_REPLAY_RETRIES` extra
+    attempts — bounded by BOTH the retry count and `total_timeout_s`, a
+    hard wall-clock cap on the whole call: a dead service must never
+    hold an actor past its episode deadline, however generous the
+    per-attempt timeouts. Typed service-side errors (ReplayEmpty,
     validation errors) are NOT retried except ReplayEmpty when
     `wait_for_data` asks for it — an empty buffer during bring-up is a
     normal state to wait out, not a failure.
+
+    The wire is either the supervisor-bridged queue pair
+    (`request_q`/`response_q`) or a `transport.SocketChannel`
+    (`channel=`); the retry/id/idempotency discipline is identical.
     """
 
     def __init__(
         self,
         client_id: str,
-        request_q,
-        response_q,
+        request_q=None,
+        response_q=None,
         timeout_s: float = 10.0,
         retries: Optional[int] = None,
         backoff_ms: float = 50.0,
         seed: int = 0,
+        channel: Optional[transport_lib.SocketChannel] = None,
+        total_timeout_s: Optional[float] = 60.0,
     ):
+        if channel is None and (request_q is None or response_q is None):
+            raise ValueError(
+                "ReplayClient needs either a queue pair or a channel"
+            )
         self.client_id = client_id
         self._request_q = request_q
         self._response_q = response_q
+        self._channel = channel
         self._timeout_s = timeout_s
         self._retries = (
             t2r_flags.get_int("T2R_REPLAY_RETRIES")
             if retries is None else retries
         )
-        self._backoff_ms = backoff_ms
-        self._rng = random.Random(seed)
+        total_ms = None if total_timeout_s is None else total_timeout_s * 1e3
+        self._backoff = Backoff(
+            base_ms=backoff_ms, cap_ms=2000.0, total_ms=total_ms, seed=seed
+        )
         # Request ids are OPAQUE (instance token, counter) pairs echoed
         # verbatim by the service: two client instances sharing one
         # response queue (the driver creates several over a run) must
@@ -578,6 +677,35 @@ class ReplayClient:
         self._nonce = 0
         self._lock = threading.Lock()
 
+    def _attempt(self, req_id, op, args, call_timeout: float):
+        """One wire attempt: (reply tuple, None) on a matched reply, or
+        (None, error-or-None) on timeout / wire failure — the caller
+        backs off and retries."""
+        request = (self.client_id, req_id, op, args)
+        if self._channel is not None:
+            try:
+                return self._channel.call(request, req_id, call_timeout), None
+            except transport_lib.TransportError as err:
+                return None, err
+        try:
+            self._request_q.put(request, timeout=1.0)
+        except (queue.Full, OSError, ValueError) as err:
+            return None, err
+        deadline = time.monotonic() + call_timeout
+        while time.monotonic() < deadline:
+            try:
+                candidate = self._response_q.get(
+                    timeout=max(deadline - time.monotonic(), 0.01)
+                )
+            except queue.Empty:
+                break
+            except (OSError, ValueError) as err:
+                return None, err
+            if candidate[0] == req_id:
+                return candidate, None
+            # Stale reply from a timed-out earlier attempt: drop.
+        return None, None
+
     def _call(
         self,
         op: str,
@@ -589,44 +717,24 @@ class ReplayClient:
         call_timeout = self._timeout_s if timeout_s is None else timeout_s
         call_retries = self._retries if retries is None else retries
         with self._lock:
+            self._backoff.start()
             last_error: Optional[Exception] = None
+            attempts = 0
             for attempt in range(call_retries + 1):
-                if attempt:
-                    delay = (
-                        self._backoff_ms
-                        * (2 ** (attempt - 1))
-                        * (1.0 + self._rng.random())
-                        / 1e3
-                    )
-                    time.sleep(min(delay, 2.0))
+                if attempt and not self._backoff.sleep(attempt):
+                    break  # total budget exhausted: stop retrying
+                remaining = self._backoff.remaining_s()
+                if remaining <= 0:
+                    break
+                attempts += 1
                 self._req_counter += 1
                 req_id = (self._token, self._req_counter)
-                try:
-                    self._request_q.put(
-                        (self.client_id, req_id, op, args), timeout=1.0
-                    )
-                except (queue.Full, OSError, ValueError) as err:
-                    last_error = err
-                    continue
-                deadline = time.monotonic() + call_timeout
-                response = None
-                while time.monotonic() < deadline:
-                    try:
-                        candidate = self._response_q.get(
-                            timeout=max(deadline - time.monotonic(), 0.01)
-                        )
-                    except queue.Empty:
-                        break
-                    except (OSError, ValueError) as err:
-                        last_error = err
-                        break
-                    if candidate[0] == req_id:
-                        response = candidate
-                        break
-                    # Stale reply from a timed-out earlier attempt: drop.
+                response, wire_error = self._attempt(
+                    req_id, op, args, min(call_timeout, remaining)
+                )
                 if response is None:
-                    last_error = last_error or TimeoutError(
-                        f"replay {op} timed out after {call_timeout}s"
+                    last_error = wire_error or last_error or TimeoutError(
+                        f"replay {op} timed out"
                     )
                     continue
                 _, status, *rest = response
@@ -647,8 +755,9 @@ class ReplayClient:
                     continue
                 raise ReplayError(f"{error_class}: {message}")
             raise ReplayUnavailable(
-                f"replay {op} failed after {call_retries + 1} attempts: "
-                f"{last_error}"
+                f"replay {op} failed after {attempts} attempt(s) "
+                f"(retry budget {call_retries + 1}, total budget "
+                f"{self._backoff.total_ms}ms): {last_error}"
             )
 
     def append(
@@ -656,8 +765,17 @@ class ReplayClient:
         transitions: Sequence[bytes],
         policy_version: int = 0,
         priority: float = 1.0,
+        episode_uid: Optional[str] = None,
+        timeout_s: Optional[float] = None,
+        retries: Optional[int] = None,
     ) -> Dict[str, int]:
+        """Appends one whole episode. `episode_uid` is the durable
+        idempotency key; None derives one from this client's token +
+        nonce (callers that place episodes themselves — the sharded
+        client — pass their own)."""
         self._nonce += 1
+        if episode_uid is None:
+            episode_uid = f"{self._token}:{self._nonce}"
         return self._call(
             "append",
             (
@@ -665,7 +783,10 @@ class ReplayClient:
                 policy_version,
                 priority,
                 self._nonce,
+                episode_uid,
             ),
+            timeout_s=timeout_s,
+            retries=retries,
         )
 
     def sample(
@@ -690,42 +811,99 @@ class ReplayClient:
     def set_policy_version(self, version: int) -> None:
         self._call("set_policy_version", (version,))
 
+    def close(self) -> None:
+        """Closes the socket channel (queue wires are supervisor-owned)."""
+        if self._channel is not None:
+            self._channel.close()
+
+
+def client_from_spec(spec, client_id: str, **kwargs) -> ReplayClient:
+    """Builds a ReplayClient in a (possibly child) process from a
+    `ReplayServiceHandle.client_spec()` recipe."""
+    kind = spec[0]
+    if kind == "socket":
+        _, root, peer = spec
+        return ReplayClient(
+            client_id,
+            channel=transport_lib.SocketChannel(root, peer=peer),
+            **kwargs,
+        )
+    if kind == "queue":
+        _, request_q, response_q = spec
+        return ReplayClient(client_id, request_q, response_q, **kwargs)
+    raise ValueError(f"unknown replay client spec kind {kind!r}")
+
 
 class ReplayServiceHandle:
-    """Supervisor: owns the client-facing queues, spawns the service
-    process, respawns it when it dies (the chaos legs SIGKILL it on
-    purpose), and hands out per-client `ReplayClient`s.
+    """Supervisor: spawns the service process, respawns it when it dies
+    (the chaos legs SIGKILL it on purpose), and hands out per-client
+    `ReplayClient`s. Transport-aware (`T2R_REPLAY_TRANSPORT`):
 
-    Clients never share a queue with the service process directly: a
-    SIGKILL mid-`get`/`put` leaves that mp.Queue's lock held by a dead
-    process, poisoning it for every later user. Clients talk to queues
-    only the supervisor (which our fault model never kills) touches on
-    the other end; two bridge threads forward requests into — and
-    replies out of — a FRESH queue pair created for each incarnation.
-    Requests parked in a dead incarnation's queue are simply lost; the
-    client's timeout+retry resubmits them to the live one.
+    * **queue** — clients never share a queue with the service process
+      directly: a SIGKILL mid-`get`/`put` leaves that mp.Queue's lock
+      held by a dead process, poisoning it for every later user.
+      Clients talk to queues only the supervisor (which our fault model
+      never kills) touches on the other end; two bridge threads forward
+      requests into — and replies out of — a FRESH queue pair created
+      for each incarnation. Requests parked in a dead incarnation's
+      queue are simply lost; the client's timeout+retry resubmits them
+      to the live one. Client ids must be declared up front: mp queues
+      have to exist before a child can inherit them.
 
-    Client ids must be declared up front: mp queues have to exist
-    before a child can inherit them.
+    * **socket** — no queues and no bridge threads: the service binds
+      its own port and publishes it under the root; each incarnation
+      publishes afresh and clients re-resolve on reconnect. The
+      supervisor is ONLY lifecycle (spawn / monitor / respawn / stop) —
+      nothing of it sits in the data path, so clients built from just
+      the root path work from any process (`client_spec()` is what the
+      sharded fabric hands to actor children).
+
+    `peer_scope` names this service on chaos partition plans (shards
+    set `s<k>`); it is also the service process's chaos scope.
     """
 
     def __init__(
         self,
         root: str,
-        client_ids: Sequence[str],
+        client_ids: Sequence[str] = (),
         config: Optional[Dict[str, Any]] = None,
         max_respawns: int = 10,
+        transport: Optional[str] = None,
+        peer_scope: Optional[str] = None,
     ):
         import multiprocessing
 
         self.root = root
         self._config = dict(config or {})
+        self.transport = (
+            transport
+            or self._config.get("transport")
+            or t2r_flags.get_enum("T2R_REPLAY_TRANSPORT")
+        )
+        if self.transport not in ("queue", "socket"):
+            raise ValueError(f"unknown replay transport {self.transport!r}")
+        self._config["transport"] = self.transport
+        self.peer_scope = peer_scope or self._config.get(
+            "chaos_scope", "replay"
+        )
+        self._config.setdefault("chaos_scope", self.peer_scope)
         self._ctx = multiprocessing.get_context("spawn")
-        # Stable, client-facing (supervisor is the only peer process):
-        self._request_q = self._ctx.Queue()
-        self._response_queues = {
-            client_id: self._ctx.Queue() for client_id in client_ids
-        }
+        if self.transport == "socket":
+            # A stale address file from a previous run would make
+            # wait_ready() vouch for a port nobody listens on.
+            best_effort(
+                os.unlink,
+                os.path.join(root, transport_lib.ADDRESS_FILENAME),
+            )
+        if self.transport == "queue":
+            # Stable, client-facing (supervisor is the only peer process):
+            self._request_q = self._ctx.Queue()
+            self._response_queues = {
+                client_id: self._ctx.Queue() for client_id in client_ids
+            }
+        else:
+            self._request_q = None
+            self._response_queues = {}
         # Per-incarnation (fresh on every spawn):
         self._svc_request_q = None
         self._svc_response_q = None
@@ -736,20 +914,36 @@ class ReplayServiceHandle:
         self._closed = False
         self._threads: List[threading.Thread] = []
 
-    def start(self) -> "ReplayServiceHandle":
+    def start(
+        self, ready_timeout_s: float = 30.0
+    ) -> "ReplayServiceHandle":
         self._spawn()
-        for target in (
-            self._monitor_loop, self._forward_loop, self._drain_loop,
-        ):
+        targets = [self._monitor_loop]
+        if self.transport == "queue":
+            targets += [self._forward_loop, self._drain_loop]
+        for target in targets:
             thread = threading.Thread(target=target, daemon=True)
             thread.start()
             self._threads.append(thread)
+        if self.transport == "socket" and not self.wait_ready(
+            ready_timeout_s
+        ):
+            # start() returning means "addressable": clients are built
+            # with SHORT budgets on the assumption that no-address is a
+            # respawn window, not a cold start.
+            self.stop()
+            raise ReplayUnavailable(
+                f"replay service at {self.root} published no transport "
+                f"address within {ready_timeout_s}s of start"
+            )
         return self
 
     def _spawn(self) -> None:
-        self._svc_request_q = self._ctx.Queue()
-        self._svc_response_q = self._ctx.Queue()
         self._incarnation += 1
+        if self.transport == "queue":
+            self._svc_request_q = self._ctx.Queue()
+            self._svc_response_q = self._ctx.Queue()
+        self._config["incarnation"] = self._incarnation
         self._process = self._ctx.Process(
             target=replay_service_main,
             args=(
@@ -817,6 +1011,14 @@ class ReplayServiceHandle:
             best_effort(out.put, rest)
 
     def client(self, client_id: str, **kwargs) -> ReplayClient:
+        if self.transport == "socket":
+            return ReplayClient(
+                client_id,
+                channel=transport_lib.SocketChannel(
+                    self.root, peer=self.peer_scope
+                ),
+                **kwargs,
+            )
         if client_id not in self._response_queues:
             raise KeyError(
                 f"client {client_id!r} was not declared at construction "
@@ -832,7 +1034,57 @@ class ReplayServiceHandle:
     def client_queues(self, client_id: str):
         """(request_q, response_q) for building a ReplayClient in a
         CHILD process (queue objects must ride the spawn args)."""
+        if self.transport == "socket":
+            raise RuntimeError(
+                "socket transport has no client queues; build the child's "
+                "client from client_spec() instead"
+            )
         return self._request_q, self._response_queues[client_id]
+
+    def client_spec(self, client_id: str):
+        """A picklable recipe for building this service's client in a
+        CHILD process: ("socket", root, peer_scope) needs only the path
+        (the address file does the rest — the cross-host shape);
+        ("queue", request_q, response_q) carries the inherited queues."""
+        if self.transport == "socket":
+            return ("socket", self.root, self.peer_scope)
+        request_q, response_q = self.client_queues(client_id)
+        return ("queue", request_q, response_q)
+
+    def wait_ready(self, timeout_s: float = 30.0) -> bool:
+        """Blocks until the service is addressable (socket mode: the
+        CURRENT incarnation published its port — a dead predecessor's
+        stale address file does not count, or a stop()-time wait for a
+        mid-respawn shard would vouch for a port nobody listens on;
+        queue mode: immediate — the queues exist before the child
+        does). Returns readiness rather than raising: callers at
+        bring-up decide whether a late shard is fatal (the sharded
+        client would otherwise spill the first appends of a perfectly
+        healthy cold start)."""
+        if self.transport == "queue":
+            return True
+
+        def current_published() -> bool:
+            # Liveness first: right after a SIGKILL the monitor may not
+            # have bumped _incarnation yet, so the stale file still
+            # "matches" — but its process is dead, which is checkable.
+            process = self._process
+            if process is None or not process.is_alive():
+                return False
+            info = transport_lib.read_address_info(self.root)
+            return (
+                info is not None
+                and info["incarnation"] >= self._incarnation
+            )
+
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self._closed:
+                return False
+            if current_published():
+                return True
+            time.sleep(0.02)
+        return current_published()
 
     def pid(self) -> Optional[int]:
         process = self._process
@@ -858,9 +1110,16 @@ class ReplayServiceHandle:
         self._closed = True
         process = self._process
         if process is not None and process.is_alive():
-            best_effort(
-                self._svc_request_q.put, ("_supervisor", 0, "stop", ()),
-            )
+            if self.transport == "socket":
+                channel = transport_lib.SocketChannel(self.root)
+                best_effort(
+                    channel.send_only, ("_supervisor", 0, "stop", ())
+                )
+                best_effort(channel.close)
+            else:
+                best_effort(
+                    self._svc_request_q.put, ("_supervisor", 0, "stop", ()),
+                )
             process.join(timeout_s)
         if process is not None and process.is_alive():
             process.terminate()

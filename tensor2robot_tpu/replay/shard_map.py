@@ -1,0 +1,89 @@
+"""Consistent-hash episode placement for the sharded replay fabric.
+
+Placement must survive the fabric's fault model: a shard process is
+SIGKILLed and respawned constantly (that is the point), and a client
+that re-derived placements from the *live* shard set would scatter
+episodes — and, worse, sample the same episode from two homes — every
+time liveness flickered. So placement is a pure function of the
+episode key and the CONFIGURED shard count:
+
+  * The ring is built from `num_shards` alone: each shard contributes
+    `vnodes` points at `sha256(salt/shard/vnode)`. No liveness, no
+    incarnation, no port — a respawned shard owns exactly the arc it
+    owned before it died, so no surviving episode's placement ever
+    moves (the stability property the unit tests pin).
+  * Failover placement (`shard_for(key, exclude=dead)`) walks the ring
+    PAST excluded shards' points: only keys whose home shard is dead
+    move, each to the next live point on its arc — and when the shard
+    returns, `exclude` empties and every key is home again. This is
+    the classic consistent-hashing guarantee (the same construction
+    memcache/dynamo rings use), which is why shard death costs
+    1/num_shards of placements, not a reshuffle.
+
+The sharded client uses `shard_for` with no exclusions for appends
+(a dead home shard means *spill and wait*, not *re-home* — re-homing
+appends would duplicate episodes when the home returns and the spill
+drains) and exclusions only for read-side failover.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+from typing import Iterable, List, Set, Tuple
+
+__all__ = ["ShardMap"]
+
+
+def _point(token: str) -> int:
+    return int.from_bytes(
+        hashlib.sha256(token.encode()).digest()[:8], "little"
+    )
+
+
+class ShardMap:
+    """The hash ring: episode key -> shard id, stable under respawn."""
+
+    def __init__(
+        self,
+        num_shards: int,
+        vnodes: int = 64,
+        salt: str = "t2r-replay",
+    ):
+        if num_shards < 1:
+            raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+        if vnodes < 1:
+            raise ValueError(f"vnodes must be >= 1, got {vnodes}")
+        self.num_shards = num_shards
+        self.vnodes = vnodes
+        self.salt = salt
+        points: List[Tuple[int, int]] = []
+        for shard in range(num_shards):
+            for vnode in range(vnodes):
+                points.append((_point(f"{salt}/{shard}/{vnode}"), shard))
+        points.sort()
+        self._hashes = [h for h, _ in points]
+        self._shards = [s for _, s in points]
+
+    def shard_for(self, key, exclude: Iterable[int] = ()) -> int:
+        """The shard owning `key`; with `exclude`, the first non-excluded
+        shard clockwise from the key's point (read-side failover)."""
+        excluded: Set[int] = set(exclude)
+        live = self.num_shards - len(
+            excluded & set(range(self.num_shards))
+        )
+        if live <= 0:
+            raise ValueError("every shard is excluded")
+        start = bisect.bisect_right(self._hashes, _point(str(key)))
+        size = len(self._shards)
+        for step in range(size):
+            shard = self._shards[(start + step) % size]
+            if shard not in excluded:
+                return shard
+        raise AssertionError("unreachable: a live shard exists")
+
+    def placements(
+        self, keys: Iterable, exclude: Iterable[int] = ()
+    ) -> List[int]:
+        excluded = tuple(exclude)
+        return [self.shard_for(key, excluded) for key in keys]

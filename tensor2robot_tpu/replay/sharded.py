@@ -1,0 +1,647 @@
+"""The sharded replay fabric: N shard services, one placement-aware client.
+
+PR 8's replay service is crash-tolerant but singular — one process, one
+directory, one host's worth of append/sample bandwidth, and a single
+point of (recoverable) stall. This module scales it out the way
+IMPALA-class actor/learner systems assume (arXiv:1802.01561): N
+independent replay-service shards, each with its OWN segment directory,
+durability manifests, quarantine sweep and counters — exactly the
+single service's contract, times N — plus a client that owns placement
+and degradation policy:
+
+  * **Placement** is consistent-hash over the client-assigned episode
+    uid (`replay/shard_map.py`): stable under shard respawn, so a
+    SIGKILLed shard's recovery changes nothing for survivors.
+  * **Appends to a dead shard buffer-and-retry, bounded.** An episode
+    whose home shard is unreachable goes to an in-order spill buffer
+    (per shard, FIFO — order preserves the uid-idempotency story) and
+    is replayed when the shard returns; past `T2R_REPLAY_SPILL_BYTES`
+    episodes are DROPPED AND COUNTED. Appends are never re-homed: the
+    home shard may hold the episode already (ambiguous timeout), and
+    only the home shard's manifest-backed uid set can dedup the retry.
+  * **Sampling fails over to surviving shards with the coverage loss
+    COUNTED.** A draw that skips an unreachable (or chaos-partitioned)
+    shard serves from the next shard in rotation and bumps that shard's
+    `coverage_lost_draws` — the learner keeps stepping on a degraded
+    data distribution it can SEE, never on a silently narrowed one.
+  * **Nothing is fabricated.** A shard whose stats cannot be read is
+    reported `unreachable`, not zeroed — same rule as
+    `LoopReport.stats_ok`.
+
+The fabric runs on either wire (`T2R_REPLAY_TRANSPORT`): the socket
+transport is the point (shards addressable by directory + published
+port — the cross-host shape), the queue wire keeps single-host tests
+cheap, and `local_shard_backends` adapts in-process ReplayBuffers so
+the tier-1 loop twin exercises every placement/failover/counting path
+with zero subprocesses.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import threading
+import time
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional, Sequence, Tuple
+
+from tensor2robot_tpu import flags as t2r_flags
+from tensor2robot_tpu.replay import segment as segment_lib
+from tensor2robot_tpu.replay.service import (
+    ReplayBuffer,
+    ReplayClient,
+    ReplayEmpty,
+    ReplayError,
+    ReplayServiceHandle,
+    ReplayUnavailable,
+    client_from_spec,
+)
+from tensor2robot_tpu.replay.shard_map import ShardMap
+
+_log = logging.getLogger(__name__)
+
+__all__ = [
+    "ShardedReplayClient",
+    "ShardedReplayService",
+    "audit_episode_uids",
+    "local_shard_backends",
+    "shard_root",
+]
+
+# Per-shard-attempt budgets: the sharded layer owns resilience (spill +
+# failover), so each backend call is SHORT — a dead shard must cost one
+# bounded probe, not a full single-service retry storm.
+_FAST_TIMEOUT_S = 3.0
+_FAST_RETRIES = 0
+_FAST_TOTAL_S = 6.0
+
+
+def shard_root(root: str, shard: int) -> str:
+    return os.path.join(root, f"shard-{shard:02d}")
+
+
+class _LocalBackend:
+    """In-process ReplayBuffer presented through the client protocol
+    (uniform kwargs; the buffer has no wire to time out on)."""
+
+    def __init__(self, buffer: ReplayBuffer):
+        self.buffer = buffer
+
+    def append(
+        self,
+        transitions,
+        policy_version: int = 0,
+        priority: float = 1.0,
+        episode_uid: Optional[str] = None,
+        timeout_s: Optional[float] = None,
+        retries: Optional[int] = None,
+    ):
+        del timeout_s, retries
+        return self.buffer.append(
+            transitions,
+            policy_version=policy_version,
+            priority=priority,
+            episode_uid=episode_uid,
+        )
+
+    def sample(self, batch_size, wait_for_data: bool = True,
+               timeout_s: Optional[float] = None,
+               retries: Optional[int] = None):
+        del wait_for_data, timeout_s, retries
+        return self.buffer.sample(batch_size)
+
+    def stats(self):
+        return self.buffer.stats()
+
+    def seal(self):
+        return self.buffer.seal()
+
+    def set_policy_version(self, version: int):
+        self.buffer.set_policy_version(version)
+
+    def close(self):
+        pass  # buffer lifecycle belongs to the loop
+
+
+def local_shard_backends(buffers: Sequence[ReplayBuffer]):
+    return [_LocalBackend(b) for b in buffers]
+
+
+class ShardedReplayClient:
+    """One client's placement-aware view of the shard fleet.
+
+    API-compatible with `ReplayClient` (append/sample/stats/seal/
+    set_policy_version with the same shapes), so
+    `ReplayInputGenerator(client=...)` consumes it unchanged — sampled
+    coordinates become (shard, segment_seq, record_index) triples, the
+    shard-qualified audit trail.
+
+    Thread-safe; the loop shares one instance between actor threads and
+    the learner in in-process mode.
+    """
+
+    def __init__(
+        self,
+        backends: Sequence[Any],
+        client_id: str = "client",
+        shard_map: Optional[ShardMap] = None,
+        spill_bytes: Optional[int] = None,
+        probe_interval_s: float = 0.5,
+        sample_timeout_s: float = _FAST_TIMEOUT_S,
+        seed: int = 0,
+    ):
+        if not backends:
+            raise ValueError("a sharded client needs at least one backend")
+        self._backends = list(backends)
+        self.client_id = client_id
+        self.num_shards = len(self._backends)
+        self._map = shard_map or ShardMap(self.num_shards)
+        self._spill_limit = (
+            t2r_flags.get_int("T2R_REPLAY_SPILL_BYTES")
+            if spill_bytes is None else spill_bytes
+        )
+        self._probe_interval_s = probe_interval_s
+        self._sample_timeout_s = sample_timeout_s
+        self._lock = threading.Lock()
+        # Episode uids carry a per-INSTANCE token (same rationale as
+        # ReplayClient's request ids): a restarted client reusing the
+        # same client_id must never mint uids that collide with its
+        # predecessor's sealed episodes — the manifest-backed dedup
+        # would silently discard the new episodes as retries. Placement
+        # only needs each uid to be a stable hash key, which any unique
+        # string is.
+        self._uid_token = (
+            f"{os.getpid():x}-{id(self):x}-{random.getrandbits(32):08x}"
+        )
+        self._episode_seq = 0
+        self._rotation = seed % self.num_shards
+        # Per-shard down state: shard -> monotonic time of next probe.
+        self._down_until: Dict[int, float] = {}
+        # Per-shard in-order spill: entries are (uid, transitions,
+        # policy_version, priority).
+        self._spill: Dict[int, Deque[Tuple]] = {
+            k: deque() for k in range(self.num_shards)
+        }
+        self._spill_bytes = 0
+        self._anchor: Optional[int] = None
+        self._anchor_pending: set = set()
+        self.counters: Dict[str, Any] = {
+            "appends_spilled": 0,
+            "spill_replayed": 0,
+            "spill_dropped_episodes": 0,
+            "spill_dropped_records": 0,
+            "appends_deduped": 0,
+            "coverage_lost_draws": [0] * self.num_shards,
+            "sample_failovers": 0,
+        }
+
+    # -- shard liveness bookkeeping (call with lock held) ----------------------
+
+    def _is_down(self, shard: int, now: float) -> bool:
+        until = self._down_until.get(shard)
+        return until is not None and now < until
+
+    def _mark_down(self, shard: int, now: float) -> None:
+        self._down_until[shard] = now + self._probe_interval_s
+
+    def _mark_up(self, shard: int) -> None:
+        self._down_until.pop(shard, None)
+        if shard in self._anchor_pending and self._anchor is not None:
+            try:
+                self._backends[shard].set_policy_version(self._anchor)
+                self._anchor_pending.discard(shard)
+            except ReplayError:
+                pass  # still flaky; re-pushed on the next recovery
+
+    # -- write path ------------------------------------------------------------
+
+    def append(
+        self,
+        transitions: Sequence[bytes],
+        policy_version: int = 0,
+        priority: float = 1.0,
+    ) -> Dict[str, int]:
+        """Places and appends one episode; returns the backend's reply
+        plus {"shard": k}, or {"spilled": 1, "shard": k} /
+        {"spill_dropped": 1, "shard": k} on the degraded paths."""
+        transitions = [bytes(t) for t in transitions]
+        with self._lock:
+            uid = (
+                f"{self.client_id}/{self._uid_token}:{self._episode_seq}"
+            )
+            self._episode_seq += 1
+            shard = self._map.shard_for(uid)
+            entry = (uid, transitions, policy_version, priority)
+            now = time.monotonic()
+            self._drain_shard_locked(shard, now)
+            if self._spill[shard] or self._is_down(shard, now):
+                # Order matters: an episode may never jump the queue of
+                # earlier spilled episodes to its shard.
+                return self._spill_locked(shard, entry)
+            try:
+                out = self._backends[shard].append(
+                    transitions,
+                    policy_version=policy_version,
+                    priority=priority,
+                    episode_uid=uid,
+                    timeout_s=_FAST_TIMEOUT_S,
+                    retries=_FAST_RETRIES,
+                )
+            except (ReplayUnavailable, ReplayError) as err:
+                if isinstance(err, ReplayEmpty):
+                    raise  # impossible for append; do not mask a bug
+                self._mark_down(shard, now)
+                _log.warning(
+                    "append to shard %d failed (%s); spilling", shard, err
+                )
+                return self._spill_locked(shard, entry)
+            self._mark_up(shard)
+            if out.get("deduped"):
+                self.counters["appends_deduped"] += 1
+            out = dict(out)
+            out["shard"] = shard
+            return out
+
+    def _spill_locked(self, shard: int, entry: Tuple) -> Dict[str, int]:
+        uid, transitions, _, _ = entry
+        size = sum(len(t) for t in transitions)
+        if self._spill_bytes + size > self._spill_limit:
+            self.counters["spill_dropped_episodes"] += 1
+            self.counters["spill_dropped_records"] += len(transitions)
+            _log.warning(
+                "spill budget exhausted (%d + %d > %d bytes): episode %s "
+                "to shard %d DROPPED (counted)",
+                self._spill_bytes, size, self._spill_limit, uid, shard,
+            )
+            return {"spill_dropped": 1, "shard": shard}
+        self._spill[shard].append(entry)
+        self._spill_bytes += size
+        self.counters["appends_spilled"] += 1
+        return {"spilled": 1, "shard": shard}
+
+    def _drain_shard_locked(self, shard: int, now: float) -> None:
+        """Replays this shard's spill queue head-first while the shard
+        cooperates. Skipped entirely inside the shard's probe-backoff
+        window so a dead shard costs one probe per interval, not one
+        per append."""
+        if not self._spill[shard] or self._is_down(shard, now):
+            return
+        while self._spill[shard]:
+            uid, transitions, policy_version, priority = self._spill[shard][0]
+            try:
+                out = self._backends[shard].append(
+                    transitions,
+                    policy_version=policy_version,
+                    priority=priority,
+                    episode_uid=uid,
+                    timeout_s=_FAST_TIMEOUT_S,
+                    retries=_FAST_RETRIES,
+                )
+            except (ReplayUnavailable, ReplayError):
+                self._mark_down(shard, now)
+                return
+            self._spill[shard].popleft()
+            self._spill_bytes -= sum(len(t) for t in transitions)
+            self.counters["spill_replayed"] += 1
+            if out.get("deduped"):
+                self.counters["appends_deduped"] += 1
+        self._mark_up(shard)
+
+    def flush_spill(self, timeout_s: float = 10.0) -> int:
+        """Best-effort drain of every shard's spill (teardown); returns
+        the number of episodes still spilled after the deadline."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                for shard in range(self.num_shards):
+                    # Teardown is the one caller that overrides the
+                    # probe window: this is its last chance.
+                    self._down_until.pop(shard, None)
+                    self._drain_shard_locked(shard, time.monotonic())
+                pending = sum(len(q) for q in self._spill.values())
+            if pending == 0:
+                return 0
+            time.sleep(0.1)
+        with self._lock:
+            return sum(len(q) for q in self._spill.values())
+
+    # -- read path -------------------------------------------------------------
+
+    def sample(
+        self,
+        batch_size: int,
+        wait_for_data: bool = True,
+        timeout_s: Optional[float] = None,
+        retries: Optional[int] = None,
+    ):
+        """One batch from the rotation's next responsive shard.
+
+        Rotation spreads consecutive batches over shards; an
+        unreachable shard is skipped (counted as coverage loss for this
+        draw) and retried after its probe interval. Raises ReplayEmpty
+        when every reachable shard is empty (bring-up — the generator
+        waits it out), ReplayUnavailable when NO shard is reachable.
+        """
+        del wait_for_data, retries  # failover IS the retry policy
+        attempt_timeout = (
+            self._sample_timeout_s if timeout_s is None else timeout_s
+        )
+        with self._lock:
+            start = self._rotation
+            self._rotation = (self._rotation + 1) % self.num_shards
+            now = time.monotonic()
+            empties = 0
+            failed: List[int] = []
+            skipped: List[int] = []
+            for step in range(self.num_shards):
+                shard = (start + step) % self.num_shards
+                if self._is_down(shard, now):
+                    skipped.append(shard)
+                    continue
+                try:
+                    records, coords, info = self._backends[shard].sample(
+                        batch_size,
+                        wait_for_data=False,
+                        timeout_s=attempt_timeout,
+                        retries=_FAST_RETRIES,
+                    )
+                except ReplayEmpty:
+                    empties += 1
+                    self._mark_up(shard)
+                    continue
+                except (ReplayUnavailable, ReplayError):
+                    self._mark_down(shard, now)
+                    failed.append(shard)
+                    continue
+                self._mark_up(shard)
+                # Every shard this draw could NOT reach is counted
+                # coverage loss — the degradation is in the report, not
+                # inferred from silence.
+                self._count_coverage_loss(failed, skipped)
+                if failed or skipped or step > 0:
+                    self.counters["sample_failovers"] += 1
+                coords = [
+                    (shard, int(seq), int(index)) for seq, index in coords
+                ]
+                info = dict(info)
+                info["shard"] = shard
+                info["coverage_lost_shards"] = sorted(failed + skipped)
+                return records, coords, info
+            # A draw that raises still counts its unreachable shards:
+            # the empty-buffer wait loop would otherwise hide a total
+            # partition behind zero counters for its whole duration.
+            self._count_coverage_loss(failed, skipped)
+            if empties:
+                raise ReplayEmpty(
+                    f"all {empties} reachable shard(s) empty "
+                    f"({len(failed) + len(skipped)} unreachable)"
+                )
+            raise ReplayUnavailable(
+                f"no replay shard reachable (failed: {failed}, "
+                f"in probe backoff: {skipped})"
+            )
+
+    def _count_coverage_loss(self, failed, skipped) -> None:
+        for lost in failed + skipped:
+            self.counters["coverage_lost_draws"][lost] += 1
+
+    # -- control/observability -------------------------------------------------
+
+    def seal(self) -> bool:
+        sealed = False
+        for shard, backend in enumerate(self._backends):
+            try:
+                sealed = bool(backend.seal()) or sealed
+            except ReplayError as err:
+                _log.warning("seal on shard %d failed: %s", shard, err)
+        return sealed
+
+    def set_policy_version(self, version: int) -> None:
+        """Broadcasts the staleness anchor; a shard that misses it is
+        remembered and re-anchored when it next recovers (its staleness
+        would otherwise under-report for the whole outage)."""
+        with self._lock:
+            self._anchor = int(version)
+            for shard, backend in enumerate(self._backends):
+                try:
+                    backend.set_policy_version(version)
+                    self._anchor_pending.discard(shard)
+                except ReplayError as err:
+                    self._anchor_pending.add(shard)
+                    _log.warning(
+                        "anchor push to shard %d failed (%s); queued",
+                        shard, err,
+                    )
+
+    def stats(self) -> Dict[str, Any]:
+        """Fabric counters + per-shard stats. A shard whose stats read
+        fails is reported {"unreachable": True} — the caller can see
+        exactly which totals are partial (never fabricated zeros)."""
+        with self._lock:
+            per_shard: List[Dict[str, Any]] = []
+            totals = {
+                "episodes_appended_total": 0,
+                "records_appended_total": 0,
+                "episodes_lost_total": 0,
+                "records_lost_total": 0,
+                "segments_sealed": 0,
+                "samples_drawn": 0,
+            }
+            unreachable: List[int] = []
+            for shard, backend in enumerate(self._backends):
+                try:
+                    stats = backend.stats()
+                except ReplayError:
+                    per_shard.append({"shard": shard, "unreachable": True})
+                    unreachable.append(shard)
+                    continue
+                stats = dict(stats)
+                stats["shard"] = shard
+                stats["unreachable"] = False
+                per_shard.append(stats)
+                for key in totals:
+                    totals[key] += stats.get(key, 0)
+            appended = totals["records_appended_total"]
+            return {
+                **totals,
+                "replay_ratio": totals["samples_drawn"] / max(appended, 1),
+                "num_shards": self.num_shards,
+                "per_shard": per_shard,
+                "shards_unreachable": unreachable,
+                "spill_pending_episodes": sum(
+                    len(q) for q in self._spill.values()
+                ),
+                "spill_pending_bytes": self._spill_bytes,
+                **{k: (list(v) if isinstance(v, list) else v)
+                   for k, v in self.counters.items()},
+            }
+
+    def close(self) -> None:
+        for backend in self._backends:
+            close = getattr(backend, "close", None)
+            if close is not None:
+                close()
+
+
+class ShardedReplayService:
+    """N `ReplayServiceHandle`s under one root: `<root>/shard-<k>/` each
+    with its own process, supervisor, durability sweep and — in socket
+    mode — published port. Chaos scope `s<k>` per shard, so seeded
+    plans target one shard (`s1/append:3:kill`) and partition plans
+    name them (`net_send:1:partition:s1`)."""
+
+    def __init__(
+        self,
+        root: str,
+        num_shards: int,
+        client_ids: Sequence[str] = (),
+        config: Optional[Dict[str, Any]] = None,
+        transport: Optional[str] = None,
+        max_respawns: int = 10,
+    ):
+        if num_shards < 1:
+            raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+        self.root = root
+        self.num_shards = num_shards
+        self.shard_roots = [
+            shard_root(root, shard) for shard in range(num_shards)
+        ]
+        self.handles: List[ReplayServiceHandle] = []
+        for shard, sroot in enumerate(self.shard_roots):
+            os.makedirs(sroot, exist_ok=True)
+            self.handles.append(
+                ReplayServiceHandle(
+                    sroot,
+                    client_ids,
+                    config=dict(config or {}),
+                    max_respawns=max_respawns,
+                    transport=transport,
+                    peer_scope=f"s{shard}",
+                )
+            )
+        self.shard_map = ShardMap(num_shards)
+
+    def start(self, ready_timeout_s: float = 60.0) -> "ShardedReplayService":
+        for handle in self.handles:
+            handle.start()
+        late = [
+            shard for shard, handle in enumerate(self.handles)
+            if not handle.wait_ready(ready_timeout_s)
+        ]
+        if late:
+            # Bring-up is the one moment a silent degradation would be
+            # invisible forever after — fail loudly instead of letting
+            # the first appends spill against shards that never came up.
+            self.stop()
+            raise ReplayUnavailable(
+                f"shard(s) {late} not addressable within "
+                f"{ready_timeout_s}s of start"
+            )
+        return self
+
+    def client(self, client_id: str, **kwargs) -> ShardedReplayClient:
+        backends = [
+            handle.client(
+                client_id,
+                timeout_s=_FAST_TIMEOUT_S,
+                retries=_FAST_RETRIES,
+                total_timeout_s=_FAST_TOTAL_S,
+            )
+            for handle in self.handles
+        ]
+        return ShardedReplayClient(
+            backends, client_id=client_id, shard_map=self.shard_map,
+            **kwargs,
+        )
+
+    def client_specs(self, client_id: str) -> List[Tuple]:
+        """Per-shard picklable client recipes for a CHILD process (see
+        `ReplayServiceHandle.client_spec`)."""
+        return [
+            handle.client_spec(client_id) for handle in self.handles
+        ]
+
+    def kill_shard(self, shard: int) -> Optional[int]:
+        """SIGKILL shard `shard`'s live process (its supervisor respawns
+        it); returns the killed pid."""
+        return self.handles[shard].kill()
+
+    def alive(self, shard: int) -> bool:
+        return self.handles[shard].alive()
+
+    def pids(self) -> List[Optional[int]]:
+        return [handle.pid() for handle in self.handles]
+
+    @property
+    def respawns(self) -> int:
+        return sum(handle.respawns for handle in self.handles)
+
+    def stop(self, timeout_s: float = 10.0) -> None:
+        for handle in self.handles:
+            handle.stop(timeout_s)
+
+    def __enter__(self) -> "ShardedReplayService":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def sharded_client_from_specs(
+    specs: Sequence[Tuple], client_id: str, seed: int = 0, **kwargs
+) -> ShardedReplayClient:
+    """Builds the sharded client in a CHILD process from
+    `ShardedReplayService.client_specs` (actor_main's entry path)."""
+    backends = [
+        client_from_spec(
+            spec,
+            client_id,
+            timeout_s=_FAST_TIMEOUT_S,
+            retries=_FAST_RETRIES,
+            total_timeout_s=_FAST_TOTAL_S,
+            seed=seed,
+        )
+        for spec in specs
+    ]
+    return ShardedReplayClient(
+        backends, client_id=client_id, seed=seed, **kwargs
+    )
+
+
+def audit_episode_uids(shard_roots: Sequence[str]) -> Dict[str, Any]:
+    """The zero-duplicate-appends audit: reads every DURABLE segment
+    manifest under every shard and counts episode uids that appear more
+    than once (anywhere in the fabric — a cross-shard duplicate would
+    mean placement re-homed an append, an intra-shard one that the
+    idempotency contract broke). Uid-less ("") legacy episodes are
+    reported but cannot be audited."""
+    seen: Dict[str, Tuple[int, int]] = {}
+    duplicates: List[Dict[str, Any]] = []
+    episodes = 0
+    unaudited = 0
+    for shard, root in enumerate(shard_roots):
+        for seq, manifest in segment_lib.list_sealed_segments(root):
+            for uid in manifest.episode_uids:
+                episodes += 1
+                if not uid:
+                    unaudited += 1
+                    continue
+                if uid in seen:
+                    duplicates.append({
+                        "uid": uid,
+                        "first": seen[uid],
+                        "second": (shard, seq),
+                    })
+                else:
+                    seen[uid] = (shard, seq)
+            # Manifests predating the uid field carry no list at all.
+            if len(manifest.episode_uids) < manifest.episodes:
+                unaudited += manifest.episodes - len(manifest.episode_uids)
+                episodes += manifest.episodes - len(manifest.episode_uids)
+    return {
+        "episodes": episodes,
+        "unaudited_episodes": unaudited,
+        "duplicates": duplicates,
+        "duplicate_count": len(duplicates),
+    }

@@ -47,7 +47,6 @@ from __future__ import annotations
 import heapq
 import itertools
 import logging
-import random
 import threading
 import time
 from collections import deque
@@ -59,6 +58,7 @@ from tensor2robot_tpu import flags as t2r_flags
 from tensor2robot_tpu.serving import transport
 from tensor2robot_tpu.serving.metrics import percentile
 from tensor2robot_tpu.serving.replica import ReplicaSpec, replica_main
+from tensor2robot_tpu.utils.backoff import Backoff
 from tensor2robot_tpu.utils.errors import best_effort
 
 _log = logging.getLogger(__name__)
@@ -310,6 +310,12 @@ class FleetRouter:
             else t2r_flags.get_int("T2R_FLEET_RETRIES")
         )
         self._backoff_s = backoff_ms / 1e3
+        # Retry pacing through the shared schedule (utils/backoff.py):
+        # uncapped per-delay (the request deadline is the real bound),
+        # seeded so a fixed fault plan replays the same pacing.
+        self._retry_backoff = Backoff(
+            base_ms=backoff_ms, cap_ms=None, seed=seed
+        )
         self._default_deadline_s = (
             default_deadline_ms if default_deadline_ms is not None
             else t2r_flags.get_int("T2R_SERVE_DEADLINE_MS")
@@ -323,7 +329,6 @@ class FleetRouter:
         self._boot_timeout_s = boot_timeout_s
         self._inline_max = inline_max_bytes
         self._shm_slots = shm_slots
-        self._rng = random.Random(seed)
 
         self._lock = threading.RLock()
         self._metrics = _RouterMetrics()
@@ -716,10 +721,8 @@ class FleetRouter:
                 )
             else:
                 fail_now = None
-                backoff = (
-                    self._backoff_s
-                    * (2 ** max(0, request.dispatches - 1))
-                    * (1.0 + self._rng.random())
+                backoff = self._retry_backoff.delay_s(
+                    max(1, request.dispatches)
                 )
                 exclude = (replica_index,)
         if fail_now is not None:

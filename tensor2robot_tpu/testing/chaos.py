@@ -41,6 +41,27 @@ Actions:
     fails forever. Unlike every other action, a flake clause fires up
     to N times.
 
+Network fault actions (the replay fabric's transport sites pass a
+link-endpoint name as `maybe_fire(site, peer=...)` — the SENDER passes
+the remote shard's scope at `net_send`, the RECEIVER passes its own
+scope at `net_recv`, since it cannot know who is calling; so
+`partition:s1` cuts frames *to* s1 when installed sender-side and
+frames s1 *hears* when installed in s1's own process):
+
+  * `drop` — returns the fault to the caller, which discards the frame
+    (the sender skips the write; the receiver ignores the request). The
+    peer perceives a timeout — the lost-datagram fault.
+  * `slow:<ms>` — sleeps at the site: link latency injection. Identical
+    machinery to `delay`, named separately so network plans read as
+    network plans.
+  * `partition:<peers>` — a PERSISTENT link cut: from occurrence N
+    onward, every visit of the site whose `peer` is in the
+    `+`-separated peer list (e.g. `partition:s1` or `partition:s1+s2`,
+    matching the shard scopes `s<k>`) fires as a drop. Unlike every
+    single-shot action (and like `flake`), a partition clause keeps
+    firing — a partition heals when the plan is replaced
+    (`configure(...)`/`reset()`), not by itself.
+
 The plan comes from the `T2R_CHAOS` env flag (declared in flags.py; the
 env route is what reaches spawned replica/trainer processes), or
 in-process via `configure()` for unit tests. Counters are per-process
@@ -80,6 +101,7 @@ __all__ = [
 
 _KNOWN_ACTIONS = (
     "kill", "sigkill", "delay", "hang", "corrupt", "raise", "flake",
+    "drop", "slow", "partition",
 )
 # Injected stalls are test instrumentation: cap them so a typo'd plan
 # cannot park the tier-1 suite (the fault model is a *straggler*, and
@@ -94,7 +116,8 @@ class ChaosFault(RuntimeError):
 @dataclasses.dataclass(frozen=True)
 class Clause:
     """One parsed fault: fire `action` at the Nth visit of `site`
-    (for `flake`, at visits N .. N + flake_n - 1)."""
+    (for `flake`, at visits N .. N + flake_n - 1; for `partition`, at
+    every visit from N on whose peer is in `peers`)."""
 
     site: str
     occurrence: int
@@ -102,6 +125,7 @@ class Clause:
     arg_ms: Optional[float] = None
     scope: Optional[str] = None
     flake_n: Optional[int] = None
+    peers: Optional[Tuple[str, ...]] = None
 
     def describe(self) -> str:
         prefix = f"{self.scope}/" if self.scope else ""
@@ -109,6 +133,8 @@ class Clause:
             suffix = f":{self.arg_ms:g}"
         elif self.flake_n is not None:
             suffix = f":{self.flake_n}"
+        elif self.peers is not None:
+            suffix = f":{'+'.join(self.peers)}"
         else:
             suffix = ""
         return f"{prefix}{self.site}:{self.occurrence}:{self.action}{suffix}"
@@ -118,6 +144,8 @@ class Clause:
             return (
                 self.occurrence <= count < self.occurrence + (self.flake_n or 0)
             )
+        if self.action == "partition":
+            return count >= self.occurrence
         return self.occurrence == count
 
 
@@ -166,7 +194,8 @@ def parse_plan(spec: Optional[str]) -> Tuple[Clause, ...]:
             )
         arg_ms = None
         flake_n = None
-        if action in ("delay", "hang"):
+        peers = None
+        if action in ("delay", "hang", "slow"):
             if len(parts) != 4:
                 raise ValueError(
                     f"chaos clause {raw!r}: {action} needs a millisecond "
@@ -200,12 +229,24 @@ def parse_plan(spec: Optional[str]) -> Tuple[Clause, ...]:
                     f"chaos clause {raw!r}: flake count must be >= 1 "
                     f"(got {flake_n})"
                 )
+        elif action == "partition":
+            if len(parts) != 4 or not parts[3]:
+                raise ValueError(
+                    f"chaos clause {raw!r}: partition needs a '+'-separated "
+                    "peer list (partition:<peer>[+<peer>...], e.g. "
+                    "partition:s1+s2)"
+                )
+            peers = tuple(p.strip() for p in parts[3].split("+"))
+            if any(not p for p in peers):
+                raise ValueError(
+                    f"chaos clause {raw!r}: empty peer in partition list"
+                )
         elif len(parts) == 4:
             raise ValueError(
                 f"chaos clause {raw!r}: {action} takes no argument"
             )
         clauses.append(
-            Clause(site, occurrence, action, arg_ms, scope, flake_n)
+            Clause(site, occurrence, action, arg_ms, scope, flake_n, peers)
         )
     return tuple(clauses)
 
@@ -276,11 +317,17 @@ def fired() -> List[str]:
         return list(_fired)
 
 
-def maybe_fire(site: str) -> Optional[Clause]:
+def maybe_fire(site: str, peer: Optional[str] = None) -> Optional[Clause]:
     """Production hook: bumps the site counter and fires any matching
     clause. Returns the fired Clause for caller-applied actions
-    (`corrupt`), after sleeping for `delay`/`hang`, never for `kill`
-    (the process is gone), or None when nothing matched.
+    (`corrupt`, `drop`, `partition`), after sleeping for
+    `delay`/`hang`/`slow`, never for `kill` (the process is gone), or
+    None when nothing matched.
+
+    `peer` names the remote end of a link site (transport hooks pass
+    the shard scope they are talking to): `partition` clauses only
+    match when the peer is in their list; every other action ignores
+    it.
 
     Sleeps and kills happen OUTSIDE the module lock: a hung site must
     not serialize other threads' (non-firing) hooks behind it.
@@ -297,8 +344,16 @@ def maybe_fire(site: str) -> Optional[Clause]:
                 continue
             if clause.scope is not None and clause.scope != _scope:
                 continue
+            if clause.action == "partition" and (
+                peer is None or peer not in (clause.peers or ())
+            ):
+                continue
             hit = clause
-            _fired.append(clause.describe())
+            description = clause.describe()
+            # A partition fires on every matching visit; record it once
+            # so the fired log stays bounded and readable.
+            if clause.action != "partition" or description not in _fired:
+                _fired.append(description)
             break
     if hit is None:
         return None
@@ -308,7 +363,7 @@ def maybe_fire(site: str) -> Optional[Clause]:
         # briefly pending on an alternate thread.
         time.sleep(60)
         raise ChaosFault(f"chaos kill at {hit.describe()} did not land")
-    if hit.action in ("delay", "hang"):
+    if hit.action in ("delay", "hang", "slow"):
         time.sleep((hit.arg_ms or 0.0) / 1e3)
         return hit
     if hit.action == "raise":
@@ -319,7 +374,7 @@ def maybe_fire(site: str) -> Optional[Clause]:
             f"{site}; succeeds from visit "
             f"{hit.occurrence + (hit.flake_n or 0)})"
         )
-    return hit  # corrupt: caller applies it
+    return hit  # corrupt/drop/partition: caller applies it
 
 
 class ChaosPredictor:

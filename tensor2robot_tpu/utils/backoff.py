@@ -1,0 +1,133 @@
+"""One jittered-backoff implementation for every retry loop.
+
+Before this module, three subsystems each hand-rolled the same
+exponential-backoff-with-jitter formula — the fleet router's
+retry-elsewhere path (serving/router.py), the replay client's
+retry-through-restart path (replay/service.py), and the actor gateway's
+serving-brown-out fallback (replay/actor.py) — with three subtly
+different cap disciplines, and the replay client's with NO total-time
+bound at all: a dead replay service could hold an actor in backoff past
+its episode deadline. Retry pacing is a fleet-wide contract, not a
+per-module style choice, so it lives here once.
+
+The schedule is DETERMINISTIC given the seed: delay k is
+
+    min(base * factor**(k-1) * (1 + U[0,1)), cap)        (k = attempt, 1-based)
+
+with ``U`` drawn from a private ``random.Random(seed)`` in call order —
+a fixed seed replays the exact pacing, which is what lets the chaos
+suites assert timing-adjacent behavior without wall-clock flakiness.
+
+Two hard caps, both explicit:
+
+  * ``cap_ms`` bounds any single delay (None = uncapped; the router's
+    deadline already bounds it there);
+  * ``total_ms`` bounds the SUM of time this instance may spend —
+    sleeping or waiting — across one logical operation: ``start()``
+    arms the budget, ``remaining_s()``/``expired()`` read it, and
+    ``sleep()`` refuses (returns False) rather than overshoot it.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Optional
+
+__all__ = ["Backoff"]
+
+
+class Backoff:
+    """Seeded jittered exponential backoff with per-delay and total caps.
+
+    Typical retry-loop shape::
+
+        backoff = Backoff(base_ms=50, cap_ms=2000, total_ms=15000, seed=7)
+        backoff.start()
+        for attempt in range(retries + 1):
+            if attempt and not backoff.sleep(attempt):
+                break                       # total budget exhausted
+            ...one attempt, bounded by min(op_timeout, backoff.remaining_s())
+        raise Unavailable(...)
+
+    Schedulers that never sleep (the router posts a timer instead) use
+    ``delay_s(attempt)`` alone.
+    """
+
+    def __init__(
+        self,
+        base_ms: float = 50.0,
+        cap_ms: Optional[float] = 2000.0,
+        factor: float = 2.0,
+        total_ms: Optional[float] = None,
+        seed: int = 0,
+    ):
+        if base_ms < 0:
+            raise ValueError(f"base_ms must be >= 0, got {base_ms}")
+        if cap_ms is not None and cap_ms < 0:
+            raise ValueError(f"cap_ms must be >= 0, got {cap_ms}")
+        if factor < 1.0:
+            raise ValueError(f"factor must be >= 1, got {factor}")
+        self.base_ms = base_ms
+        self.cap_ms = cap_ms
+        self.factor = factor
+        self.total_ms = total_ms
+        self._rng = random.Random(seed)
+        self._deadline: Optional[float] = None
+
+    def delay_s(self, attempt: int) -> float:
+        """The next delay in seconds for 1-based retry `attempt`.
+
+        Draws one jitter sample per call — the deterministic schedule is
+        a property of (seed, call order), so callers must request
+        delays in the order they apply them.
+        """
+        if attempt < 1:
+            raise ValueError(f"attempt is 1-based, got {attempt}")
+        delay_ms = (
+            self.base_ms
+            * (self.factor ** (attempt - 1))
+            * (1.0 + self._rng.random())
+        )
+        if self.cap_ms is not None:
+            delay_ms = min(delay_ms, self.cap_ms)
+        return delay_ms / 1e3
+
+    # -- the total-time budget -------------------------------------------------
+
+    def start(self) -> "Backoff":
+        """Arms (or re-arms) the total-time budget for one logical
+        operation. A no-op when total_ms is None."""
+        self._deadline = (
+            time.monotonic() + self.total_ms / 1e3
+            if self.total_ms is not None
+            else None
+        )
+        return self
+
+    def remaining_s(self) -> float:
+        """Seconds left in the budget (inf when unbounded). Callers use
+        this to clip per-attempt waits so the LAST attempt cannot
+        overshoot the budget either."""
+        if self._deadline is None:
+            return float("inf")
+        return max(0.0, self._deadline - time.monotonic())
+
+    def expired(self) -> bool:
+        return self.remaining_s() <= 0.0
+
+    def sleep(self, attempt: int) -> bool:
+        """Sleeps the schedule's delay for `attempt`; returns False —
+        WITHOUT sleeping past the budget — when the total budget cannot
+        cover the delay (the caller should stop retrying)."""
+        delay = self.delay_s(attempt)
+        remaining = self.remaining_s()
+        if remaining <= 0.0:
+            return False
+        if delay > remaining:
+            # Sleeping the remainder then attempting would overshoot:
+            # the budget is a promise to the CALLER's caller (an actor's
+            # episode deadline), so refuse instead.
+            return False
+        time.sleep(delay)
+        return True

@@ -286,7 +286,7 @@ def test_bench_cli_lists_legs():
     assert proc.returncode == 0
     for option in (
         "--actors", "--replicas", "--steps", "--seal-episodes",
-        "--chaos-at-s", "--out",
+        "--shards", "--chaos-at-s", "--out",
     ):
         assert option in proc.stdout
     proc = subprocess.run(
@@ -317,16 +317,19 @@ def test_bench_cli_lists_legs():
 @pytest.mark.slow
 def test_bench_rl_contract(tmp_path):
     """The closed online-RL loop leg at toy scale: one JSON line + the
-    --out artifact, both legs (fault-free + chaos) present, the chaos
-    acceptance block all-green (equal learner steps, zero torn segments
-    sampled, bounded counted loss, real respawn + actor kill), and the
+    --out artifact, all four legs (fault-free + chaos, sharded
+    fault-free + sharded chaos) present, the chaos acceptance block
+    all-green (equal learner steps, zero torn segments sampled, bounded
+    counted loss, real respawn + actor kill; sharded: zero duplicate
+    appends, per-shard loss bounded, coverage loss counted), and the
     headline rates positive. Slow slice: it spawns a replay service,
-    actor processes and a policy-server replica; tier-1 covers the same
-    loop in-process (tests/test_rl_loop.py) and the CLI surface above."""
+    shard services, actor processes and a policy-server replica; tier-1
+    covers the same loops in-process (tests/test_rl_loop.py,
+    tests/test_replay_shard.py) and the CLI surface above."""
     out = str(tmp_path / "rl.json")
     payload = _run_bench(
         "rl", "--steps", "6", "--actors", "2", "--replicas", "1",
-        "--chaos-at-s", "2.0", "--out", out,
+        "--shards", "3", "--chaos-at-s", "2.0", "--out", out,
         timeout=560,
     )
     assert payload["metric"] == "rl_loop_episodes_per_sec_cpu_proxy"
@@ -335,7 +338,8 @@ def test_bench_rl_contract(tmp_path):
     assert "error" not in payload
     assert payload["proxy"] is True
     detail = payload["detail"]
-    for leg in ("fault_free", "chaos"):
+    for leg in ("fault_free", "chaos", "sharded_fault_free",
+                "sharded_chaos"):
         assert detail[leg]["learner_steps"] == 6
         assert detail[leg]["episodes_appended"] > 0
         assert detail[leg]["samples_drawn"] > 0
@@ -346,7 +350,14 @@ def test_bench_rl_contract(tmp_path):
     assert acceptance["loss_bounded_to_unsealed_tail"] is True
     assert acceptance["replay_service_respawned"] is True
     assert acceptance["actor_killed"] is True
+    assert acceptance["sharded_learner_steps_equal"] is True
+    assert acceptance["sharded_zero_duplicate_appends"] is True
+    assert acceptance["sharded_per_shard_loss_bounded"] is True
+    assert acceptance["sharded_shard_respawned"] is True
+    assert acceptance["sharded_coverage_loss_counted"] is True
     assert detail["chaos"]["chaos"]["replay_pid"] is not None
+    assert detail["sharded_chaos"]["chaos"]["shard_pid"] is not None
+    assert detail["sharded_chaos"]["uid_audit"]["episodes"] > 0
     assert detail["replay_ratio"] > 0
     with open(out) as f:
         assert json.load(f)["metric"] == payload["metric"]

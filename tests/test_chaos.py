@@ -72,6 +72,33 @@ class TestParse:
         spec = t2r_flags.get_flag("T2R_CHAOS")
         assert spec.kind == "str" and spec.default is None
 
+    def test_network_action_grammar(self):
+        plan = chaos.parse_plan(
+            "net_send:1:drop;net_recv:2:slow:150;"
+            "net_send:3:partition:s1+s2"
+        )
+        assert [c.describe() for c in plan] == [
+            "net_send:1:drop",
+            "net_recv:2:slow:150",
+            "net_send:3:partition:s1+s2",
+        ]
+        assert plan[1].arg_ms == 150.0
+        assert plan[2].peers == ("s1", "s2")
+
+    @pytest.mark.parametrize(
+        "bad",
+        [
+            "net_send:1:drop:5",  # drop takes no arg
+            "net_send:1:slow",  # slow needs ms
+            "net_send:1:partition",  # partition needs peers
+            "net_send:1:partition:",  # empty peer list
+            "net_send:1:partition:s1++s2",  # empty peer in list
+        ],
+    )
+    def test_malformed_network_plans_fail_loudly(self, bad):
+        with pytest.raises(ValueError):
+            chaos.parse_plan(bad)
+
 
 class TestFire:
     def test_inert_without_plan(self):
@@ -102,6 +129,37 @@ class TestFire:
         chaos.configure("r1/predict:1:corrupt")
         chaos.set_scope("r1")
         assert chaos.maybe_fire("predict").action == "corrupt"
+
+    def test_drop_fires_once_and_returns_to_caller(self):
+        chaos.configure("net_send:2:drop")
+        assert chaos.maybe_fire("net_send") is None
+        assert chaos.maybe_fire("net_send").action == "drop"
+        assert chaos.maybe_fire("net_send") is None  # single-shot
+
+    def test_partition_persists_and_matches_only_named_peers(self):
+        chaos.configure("net_send:2:partition:s1+s3")
+        assert chaos.maybe_fire("net_send", peer="s1") is None  # occ 1
+        assert chaos.maybe_fire("net_send", peer="s1").action == "partition"
+        assert chaos.maybe_fire("net_send", peer="s2") is None  # not cut
+        assert chaos.maybe_fire("net_send", peer="s3").action == "partition"
+        assert chaos.maybe_fire("net_send") is None  # peer-less: not cut
+        # Still firing many occurrences later (a partition never
+        # self-heals), and the fired log records it exactly once.
+        for _ in range(5):
+            assert (
+                chaos.maybe_fire("net_send", peer="s1").action == "partition"
+            )
+        assert chaos.fired() == ["net_send:2:partition:s1+s3"]
+
+    def test_receive_side_partition_matches_own_scope(self):
+        """The receiver cannot know its caller, so net_recv reports its
+        OWN scope as peer (replay/transport.py): a partition naming a
+        shard cuts that shard's receive side when installed in its
+        process."""
+        chaos.configure("net_recv:1:partition:s1")
+        chaos.set_scope("s1")
+        hit = chaos.maybe_fire("net_recv", peer=chaos.get_scope())
+        assert hit is not None and hit.action == "partition"
 
     def test_delay_sleeps_roughly_arg(self):
         chaos.configure("predict:1:delay:120")

@@ -1,0 +1,687 @@
+"""The sharded replay fabric: socket transport, placement, chaos.
+
+Acceptance contracts (ISSUE 10):
+
+  1. Socket framing is whole-frame-or-nothing: every corpus corruption
+     (truncation, bitflip, forged length, bad magic — the PR 3
+     generator's families applied to transport frames) is rejected with
+     a typed error and NEVER partially decoded; on a live service a
+     corrupt frame is retried transparently and lands exactly once.
+  2. Consistent-hash placement is stable under shard death/respawn: a
+     rebuilt map places every key identically, and excluding a dead
+     shard moves ONLY that shard's keys.
+  3. The sharded client degrades loudly, never silently: appends to a
+     dead shard spill (bounded, drops counted), sampling fails over
+     with per-shard coverage loss counted, and the cross-shard uid
+     audit proves zero duplicate appends through kill/partition chaos.
+
+Tier-1 keeps processes small (2-3 shard services, tiny payloads); the
+multi-process sharded loop soak rides the slow slice.
+"""
+
+import os
+import socket
+import time
+
+import pytest
+
+from tensor2robot_tpu.analysis import corpus
+from tensor2robot_tpu.replay import transport
+from tensor2robot_tpu.replay.service import (
+    ReplayEmpty,
+    ReplayServiceHandle,
+    ReplayUnavailable,
+)
+from tensor2robot_tpu.replay.shard_map import ShardMap
+from tensor2robot_tpu.replay.sharded import (
+    ShardedReplayClient,
+    ShardedReplayService,
+    audit_episode_uids,
+    local_shard_backends,
+)
+from tensor2robot_tpu.testing import chaos
+from tensor2robot_tpu.utils.backoff import Backoff
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+# -- the shared backoff schedule (satellite: one implementation) ---------------
+
+
+class TestBackoff:
+    def test_schedule_is_deterministic_and_capped(self):
+        a = Backoff(base_ms=50, cap_ms=400, seed=7)
+        b = Backoff(base_ms=50, cap_ms=400, seed=7)
+        delays_a = [a.delay_s(k) for k in range(1, 8)]
+        delays_b = [b.delay_s(k) for k in range(1, 8)]
+        assert delays_a == delays_b  # seeded schedule replays exactly
+        assert all(d <= 0.4 for d in delays_a)  # hard per-delay cap
+        assert delays_a[0] >= 0.05  # base * (1 + U[0,1))
+
+    def test_different_seeds_differ(self):
+        a = [Backoff(seed=1).delay_s(k) for k in range(1, 5)]
+        b = [Backoff(seed=2).delay_s(k) for k in range(1, 5)]
+        assert a != b
+
+    def test_total_budget_refuses_overshoot(self):
+        backoff = Backoff(base_ms=50, cap_ms=None, total_ms=30, seed=0)
+        backoff.start()
+        # First delay is >= 50ms > the 30ms budget: sleep() must refuse
+        # without sleeping (a dead service cannot hold the caller).
+        t0 = time.monotonic()
+        assert backoff.sleep(1) is False
+        assert time.monotonic() - t0 < 0.03
+        assert backoff.remaining_s() <= 0.03
+
+    def test_unbounded_budget_sleeps(self):
+        backoff = Backoff(base_ms=1, cap_ms=5, total_ms=None, seed=0)
+        backoff.start()
+        assert backoff.remaining_s() == float("inf")
+        assert backoff.sleep(1) is True
+
+    def test_replay_call_is_time_bounded(self, tmp_path):
+        """The satellite's named bug: a dead service must not hold a
+        client past its total budget, whatever the retry count says."""
+        from tensor2robot_tpu.replay.service import ReplayClient
+
+        channel = transport.SocketChannel(str(tmp_path))  # nobody home
+        client = ReplayClient(
+            "c", channel=channel, timeout_s=0.2, retries=50,
+            backoff_ms=20.0, total_timeout_s=1.0,
+        )
+        t0 = time.monotonic()
+        with pytest.raises(ReplayUnavailable):
+            client.append([b"x"])
+        assert time.monotonic() - t0 < 3.0  # 51 attempts would be >10s
+
+
+# -- socket framing + fuzz (satellite: PR 3 corpus over the new wire) ----------
+
+
+def _pipe():
+    a, b = socket.socketpair()
+    a.settimeout(5.0)
+    b.settimeout(5.0)
+    return a, b
+
+
+class TestFrameCodec:
+    def test_roundtrip(self):
+        a, b = _pipe()
+        try:
+            message = ("client", ("tok", 1), "append", ([b"x" * 100], 0))
+            assert transport.write_frame(a, message)
+            assert transport.read_frame(
+                b, deadline=time.monotonic() + 2
+            ) == message
+        finally:
+            a.close(); b.close()
+
+    def test_clean_close_is_typed(self):
+        a, b = _pipe()
+        a.close()
+        try:
+            with pytest.raises(transport.ConnectionClosed):
+                transport.read_frame(b, deadline=time.monotonic() + 2)
+        finally:
+            b.close()
+
+    @pytest.mark.parametrize("name", sorted(
+        corpus.corrupt_frame_variants(
+            transport.encode_frame(("c", ("t", 1), "op", (b"payload" * 40,)))
+        )
+    ))
+    def test_corpus_variant_rejected_never_partially_decoded(self, name):
+        """Every corruption family from the PR 3 generator: the reader
+        either raises a typed TransportError or (for a pure payload
+        bitflip that still checksums — impossible by construction) the
+        original message. It NEVER returns a partially-decoded or
+        wrong object, and never blocks past its deadline."""
+        frame = transport.encode_frame(
+            ("c", ("t", 1), "op", (b"payload" * 40,))
+        )
+        variant = corpus.corrupt_frame_variants(frame)[name]
+        a, b = _pipe()
+        try:
+            a.sendall(variant)
+            a.close()  # EOF after the corrupt bytes: no resync possible
+            with pytest.raises(transport.TransportError):
+                transport.read_frame(b, deadline=time.monotonic() + 2)
+        finally:
+            b.close()
+
+    def test_forged_length_bounds_before_allocation(self):
+        frame = bytearray(transport.encode_frame(("x",)))
+        import struct
+
+        frame[4:8] = struct.pack("<I", transport.MAX_FRAME_BYTES + 1)
+        a, b = _pipe()
+        try:
+            a.sendall(bytes(frame))
+            with pytest.raises(transport.BadFrame, match="forged"):
+                transport.read_frame(b, deadline=time.monotonic() + 2)
+        finally:
+            a.close(); b.close()
+
+    def test_oversize_message_refused_at_encode(self):
+        with pytest.raises(transport.TransportError):
+            transport.encode_frame(b"x" * (transport.MAX_FRAME_BYTES + 1))
+
+
+class TestTransportChaosActions:
+    """The new network fault actions drive the live wire."""
+
+    def _handle(self, tmp_path):
+        return ReplayServiceHandle(
+            str(tmp_path), config={"seal_episodes": 2}, transport="socket"
+        ).start()
+
+    def test_corrupt_frame_rejected_and_retried(self, tmp_path):
+        """THE framing pin: a corrupted request frame is rejected by the
+        server's CRC (connection torn down, nothing partially decoded)
+        and the client's retry lands the append EXACTLY once."""
+        handle = self._handle(tmp_path)
+        try:
+            chaos.configure("net_send:1:corrupt")
+            client = handle.client("c1", timeout_s=5, backoff_ms=10.0)
+            out = client.append([b"through-corruption"])
+            assert out["episode_seq"] == 0
+            assert "net_send:1:corrupt" in chaos.fired()
+            chaos.configure(None)
+            stats = client.stats()
+            assert stats["episodes_appended_total"] == 1
+            assert stats.get("appends_deduped_total", 0) == 0
+        finally:
+            handle.stop()
+
+    def test_dropped_frame_retried(self, tmp_path):
+        handle = self._handle(tmp_path)
+        try:
+            chaos.configure("net_send:1:drop")
+            client = handle.client(
+                "c1", timeout_s=0.5, backoff_ms=10.0, retries=3
+            )
+            out = client.append([b"through-loss"])
+            assert out["episode_seq"] == 0
+            assert client.stats()["episodes_appended_total"] == 1
+        finally:
+            handle.stop()
+
+    def test_slow_injects_latency(self, tmp_path):
+        handle = self._handle(tmp_path)
+        try:
+            chaos.configure("net_send:1:slow:300")
+            client = handle.client("c1", timeout_s=5)
+            t0 = time.monotonic()
+            client.append([b"slowly"])
+            assert time.monotonic() - t0 >= 0.3
+        finally:
+            handle.stop()
+
+    def test_partition_cuts_only_named_peer(self, tmp_path):
+        """A partition clause drops every frame to the named shard from
+        its occurrence on — and ONLY to that shard."""
+        handle_a = ReplayServiceHandle(
+            str(tmp_path / "a"), config={"seal_episodes": 2},
+            transport="socket", peer_scope="s0",
+        ).start()
+        handle_b = ReplayServiceHandle(
+            str(tmp_path / "b"), config={"seal_episodes": 2},
+            transport="socket", peer_scope="s1",
+        ).start()
+        try:
+            chaos.configure("net_send:1:partition:s1")
+            ok = handle_a.client(
+                "c", timeout_s=2, retries=0, total_timeout_s=5
+            )
+            cut = handle_b.client(
+                "c", timeout_s=0.3, retries=1, total_timeout_s=2
+            )
+            assert ok.append([b"x"])["episode_seq"] == 0
+            with pytest.raises(ReplayUnavailable):
+                cut.append([b"y"])
+            # The partition persists across occurrences (unlike drop).
+            with pytest.raises(ReplayUnavailable):
+                cut.append([b"z"])
+            chaos.configure(None)
+            assert cut.append([b"w"])["episode_seq"] == 0
+        finally:
+            handle_a.stop()
+            handle_b.stop()
+
+    def test_partition_parse_errors_loud(self):
+        with pytest.raises(ValueError, match="partition"):
+            chaos.parse_plan("net_send:1:partition")
+        with pytest.raises(ValueError, match="peer"):
+            chaos.parse_plan("net_send:1:partition:s1++s2")
+
+
+# -- consistent-hash stability (satellite) -------------------------------------
+
+
+class TestShardMapStability:
+    KEYS = [f"actor-{a}:{n}" for a in range(4) for n in range(250)]
+
+    def test_respawn_moves_nothing(self):
+        """Placement is a function of (key, configured shard count):
+        a shard map rebuilt after any number of deaths/respawns places
+        every key exactly where the original did."""
+        before = ShardMap(5).placements(self.KEYS)
+        after = ShardMap(5).placements(self.KEYS)
+        assert before == after
+
+    def test_death_moves_only_the_dead_shards_keys(self):
+        shard_map = ShardMap(5)
+        home = shard_map.placements(self.KEYS)
+        failover = shard_map.placements(self.KEYS, exclude=[2])
+        for key, h, f in zip(self.KEYS, home, failover):
+            if h == 2:
+                assert f != 2  # re-homed off the dead shard
+            else:
+                assert f == h  # survivors NEVER move
+
+    def test_recovery_restores_original_placement(self):
+        shard_map = ShardMap(5)
+        home = shard_map.placements(self.KEYS)
+        assert shard_map.placements(self.KEYS, exclude=()) == home
+
+    def test_distribution_is_roughly_balanced(self):
+        placements = ShardMap(4).placements(self.KEYS)
+        counts = [placements.count(s) for s in range(4)]
+        assert min(counts) > len(self.KEYS) / 4 / 3  # no starved shard
+
+    def test_all_excluded_raises(self):
+        with pytest.raises(ValueError):
+            ShardMap(2).shard_for("k", exclude=[0, 1])
+
+
+# -- the sharded client over in-process buffers (tier-1, no processes) ---------
+
+
+class TestShardedClientLocal:
+    def _buffers(self, tmp_path, n=3):
+        from tensor2robot_tpu.replay.service import ReplayBuffer
+
+        return [
+            ReplayBuffer(str(tmp_path / f"shard-{k:02d}"), seal_episodes=2)
+            for k in range(n)
+        ]
+
+    def test_append_places_and_samples_rotate(self, tmp_path):
+        buffers = self._buffers(tmp_path)
+        client = ShardedReplayClient(
+            local_shard_backends(buffers), client_id="w"
+        )
+        for i in range(12):
+            out = client.append([b"ep%02d" % i])
+            assert 0 <= out["shard"] < 3
+        shards_seen = set()
+        for _ in range(3):
+            _, coords, info = client.sample(2)
+            shards_seen.add(info["shard"])
+            assert all(len(c) == 3 for c in coords)  # shard-qualified
+        assert len(shards_seen) > 1  # rotation spreads draws
+        stats = client.stats()
+        assert stats["episodes_appended_total"] == 12
+        assert stats["num_shards"] == 3
+        for buffer in buffers:
+            buffer.close()
+
+    def test_closed_shard_spills_then_drops_counted(self, tmp_path):
+        buffers = self._buffers(tmp_path)
+        client = ShardedReplayClient(
+            local_shard_backends(buffers), client_id="w",
+            spill_bytes=64, probe_interval_s=0.05,
+        )
+        # Find a key that homes on shard 1, then kill shard 1.
+        target = client._map
+        buffers[1].close()
+        spilled = dropped = 0
+        for i in range(60):
+            out = client.append([b"E" * 24])
+            if out.get("spilled"):
+                spilled += 1
+            if out.get("spill_dropped"):
+                dropped += 1
+        assert spilled > 0
+        assert dropped > 0  # budget is 64 bytes: most spills overflow
+        assert client.counters["spill_dropped_episodes"] == dropped
+        # Degraded is visible, never silent.
+        stats = client.stats()
+        assert stats["spill_pending_episodes"] == spilled
+        for buffer in buffers:
+            buffer.close()
+
+    def test_restarted_client_same_id_mints_fresh_uids(self, tmp_path):
+        """A restarted client reusing its client_id (the documented
+        remote-actor shape) must not collide with its predecessor's
+        sealed uids — uids carry a per-instance token, so the new
+        episodes land instead of being silently deduped as retries."""
+        buffers = self._buffers(tmp_path, n=2)
+        first = ShardedReplayClient(
+            local_shard_backends(buffers), client_id="actor-0"
+        )
+        for i in range(4):
+            first.append([b"gen1-%d" % i])
+        first.seal()
+        reborn = ShardedReplayClient(
+            local_shard_backends(buffers), client_id="actor-0"
+        )
+        for i in range(4):
+            out = reborn.append([b"gen2-%d" % i])
+            assert "deduped" not in out, out
+        assert reborn.counters["appends_deduped"] == 0
+        total = sum(b.stats()["episodes_appended_total"] for b in buffers)
+        assert total == 8
+        for buffer in buffers:
+            buffer.close()
+
+    def test_raising_draw_still_counts_coverage_loss(self, tmp_path):
+        """A draw that ends in ReplayEmpty (reachable shards empty,
+        one shard dead) still counts the dead shard's coverage loss —
+        the bring-up/partition wait loop must not hide a total outage
+        behind zero counters."""
+        buffers = self._buffers(tmp_path, n=2)
+        client = ShardedReplayClient(
+            local_shard_backends(buffers), client_id="w",
+            probe_interval_s=10.0,
+        )
+        buffers[0].close()  # dead shard; shard 1 merely empty
+        for _ in range(3):
+            with pytest.raises(ReplayEmpty):
+                client.sample(2)
+        assert client.counters["coverage_lost_draws"][0] == 3
+        buffers[1].close()
+
+    def test_sample_failover_counts_coverage_loss(self, tmp_path):
+        buffers = self._buffers(tmp_path)
+        client = ShardedReplayClient(
+            local_shard_backends(buffers), client_id="w",
+            probe_interval_s=10.0,
+        )
+        for i in range(12):
+            client.append([b"ep%02d" % i])
+        buffers[0].close()
+        served = 0
+        for _ in range(6):
+            _, _, info = client.sample(2)
+            assert info["shard"] != 0
+            served += 1
+        assert served == 6  # the learner never stalled
+        assert client.counters["coverage_lost_draws"][0] > 0
+        assert client.counters["coverage_lost_draws"][1] == 0
+        assert client.counters["coverage_lost_draws"][2] == 0
+        for buffer in buffers:
+            buffer.close()
+
+    def test_all_empty_raises_empty_all_dead_raises_unavailable(
+        self, tmp_path
+    ):
+        buffers = self._buffers(tmp_path, n=2)
+        client = ShardedReplayClient(
+            local_shard_backends(buffers), client_id="w",
+            probe_interval_s=0.0,
+        )
+        with pytest.raises(ReplayEmpty):
+            client.sample(2)
+        for buffer in buffers:
+            buffer.close()
+        with pytest.raises(ReplayUnavailable):
+            client.sample(2)
+
+    def test_unreachable_shard_stats_not_fabricated(self, tmp_path):
+        buffers = self._buffers(tmp_path, n=2)
+        client = ShardedReplayClient(
+            local_shard_backends(buffers), client_id="w"
+        )
+        for i in range(4):
+            client.append([b"x%d" % i])
+        buffers[1].close()
+        stats = client.stats()
+        assert stats["shards_unreachable"] == [1]
+        entry = stats["per_shard"][1]
+        assert entry["unreachable"] is True
+        assert "episodes_appended_total" not in entry  # absent, not 0
+        buffers[0].close()
+
+
+# -- the sharded service fleet (socket transport, real processes) --------------
+
+
+class TestShardedServiceProcesses:
+    def test_kill_spill_replay_zero_duplicates(self, tmp_path):
+        """The fabric's core chaos story in miniature: SIGKILL a shard
+        mid-append-stream; its episodes spill in order, replay when the
+        supervisor respawns it, and the cross-shard uid audit finds
+        zero duplicates."""
+        service = ShardedReplayService(
+            str(tmp_path), 2, config={"seal_episodes": 2},
+            transport="socket",
+        ).start()
+        try:
+            client = service.client("w", probe_interval_s=0.2)
+            for i in range(8):
+                assert "episode_seq" in client.append([b"pre%02d" % i])
+            assert service.kill_shard(1) is not None
+            spilled = 0
+            for i in range(8, 24):
+                out = client.append([b"post%02d" % i])
+                spilled += out.get("spilled", 0)
+            assert spilled > 0  # the dead shard's stream buffered
+            left = client.flush_spill(20.0)
+            assert left == 0  # ...and drained into the respawn
+            assert service.respawns >= 1
+            client.seal()
+            audit = audit_episode_uids(service.shard_roots)
+            assert audit["duplicate_count"] == 0, audit["duplicates"]
+            # The SIGKILL may land on a non-empty unsealed tail: those
+            # episodes are the documented (and COUNTED) crash loss, so
+            # durable episodes = appended - counted-lost, exactly.
+            stats = client.stats()
+            lost = stats["episodes_lost_total"]
+            assert lost <= 2  # bounded by the seal cadence
+            assert audit["episodes"] == 24 - lost
+            assert audit["unaudited_episodes"] == 0
+        finally:
+            service.stop()
+
+    def test_partition_failover_learner_side(self, tmp_path):
+        """A driver-side partition of one shard: sampling fails over
+        with the coverage loss counted, appends to the cut shard spill;
+        healing the partition drains them. All via the seeded chaos
+        machinery — no test-only control surface."""
+        service = ShardedReplayService(
+            str(tmp_path), 2, config={"seal_episodes": 1},
+            transport="socket",
+        ).start()
+        try:
+            client = service.client("w", probe_interval_s=0.2)
+            for i in range(8):
+                client.append([b"ep%02d" % i])
+            chaos.configure("net_send:1:partition:s1")
+            # Sampling keeps serving from shard 0 and counts s1's loss.
+            for _ in range(4):
+                _, coords, info = client.sample(1)
+                assert info["shard"] == 0
+            assert client.counters["coverage_lost_draws"][1] > 0
+            # Appends homed on s1 spill behind the partition.
+            spilled = sum(
+                client.append([b"cut%02d" % i]).get("spilled", 0)
+                for i in range(8)
+            )
+            assert spilled > 0
+            chaos.configure(None)  # partition heals
+            assert client.flush_spill(20.0) == 0
+            client.seal()
+            audit = audit_episode_uids(service.shard_roots)
+            assert audit["duplicate_count"] == 0
+        finally:
+            service.stop()
+
+    def test_queue_transport_sharding_also_works(self, tmp_path):
+        """The sharded fabric is transport-agnostic: the mp.Queue wire
+        (tier-1 fallback) runs the same placement/audit paths."""
+        service = ShardedReplayService(
+            str(tmp_path), 2, ["w"], config={"seal_episodes": 2},
+            transport="queue",
+        ).start()
+        try:
+            client = service.client("w")
+            for i in range(6):
+                assert "episode_seq" in client.append([b"q%02d" % i])
+            _, coords, _ = client.sample(2)
+            assert all(len(c) == 3 for c in coords)
+            client.seal()
+            assert audit_episode_uids(
+                service.shard_roots
+            )["duplicate_count"] == 0
+        finally:
+            service.stop()
+
+
+# -- gateway version split (satellite) -----------------------------------------
+
+
+class TestGatewayVersionSplit:
+    def _client(self):
+        import queue as queue_lib
+
+        from tensor2robot_tpu.replay.actor import GatewayPolicyClient
+
+        request_q = queue_lib.Queue()
+        response_q = queue_lib.Queue()
+        client = GatewayPolicyClient(
+            "a0", request_q, response_q, timeout_s=1.0, retries=0, seed=3
+        )
+        return client, request_q, response_q
+
+    def _serve(self, request_q, response_q, version):
+        import numpy as np
+        import threading
+
+        def reply():
+            _, req_id, _ = request_q.get(timeout=2)
+            response_q.put((req_id, np.zeros(2, np.float32), version, None))
+
+        thread = threading.Thread(target=reply, daemon=True)
+        thread.start()
+        return thread
+
+    def test_unknown_version_first_contact_stamps_minus_one(self):
+        import numpy as np
+
+        client, request_q, response_q = self._client()
+        thread = self._serve(request_q, response_q, None)
+        _, version = client.act(np.zeros(3))
+        thread.join(2)
+        assert version == -1  # never a fabricated-fresh 0
+        assert client.version_unknown_actions == 1
+        assert client.fallback_actions == 0  # a REAL action, distinct
+
+    def test_unknown_version_after_known_stamps_last_known(self):
+        import numpy as np
+
+        client, request_q, response_q = self._client()
+        thread = self._serve(request_q, response_q, 7)
+        _, version = client.act(np.zeros(3))
+        thread.join(2)
+        assert version == 7
+        thread = self._serve(request_q, response_q, None)
+        _, version = client.act(np.zeros(3))
+        thread.join(2)
+        assert version == 7  # last KNOWN counter, not -1, not 0
+        assert client.version_unknown_actions == 1
+
+    def test_fallback_counts_separately(self):
+        import numpy as np
+
+        client, _, _ = self._client()
+        _, version = client.act(np.zeros(3))  # nobody serves: fallback
+        assert version == -1
+        assert client.fallback_actions == 1
+        assert client.version_unknown_actions == 0
+
+
+# -- the sharded loop twins ----------------------------------------------------
+
+REPLAY_SHARD_LOOP_STEPS = 4
+
+
+class TestInProcessShardedLoop:
+    def test_loop_closes_with_sharded_fabric(self, tmp_path):
+        """Tier-1 twin of the sharded bench leg: the full learner loop
+        over 3 in-process shards — placement, rotation sampling,
+        shard-qualified coords, merged per-shard report."""
+        from tensor2robot_tpu.replay import OnlineLoop
+
+        loop = OnlineLoop(
+            str(tmp_path), num_actors=2, batch_size=4, seal_episodes=2,
+            in_process=True, seed=3, wait_timeout_s=60,
+            actor_throttle_s=0.01, shards=3,
+        ).start()
+        try:
+            loop.run_learner(
+                max_steps=REPLAY_SHARD_LOOP_STEPS, save_steps=2,
+                publish=True,
+            )
+        finally:
+            report = loop.stop()
+        assert report.learner_steps == REPLAY_SHARD_LOOP_STEPS
+        assert report.shards == 3
+        assert len(report.per_shard) == 3
+        assert report.episodes_appended > 0
+        assert report.samples_drawn >= 4 * REPLAY_SHARD_LOOP_STEPS
+        assert report.stats_ok is True
+        assert report.spill_dropped_episodes == 0
+        # Shard-qualified audit trail reached the generator.
+        assert all(
+            len(coord) == 3
+            for batch in loop._generator.coords_log
+            for coord in batch
+        )
+
+
+@pytest.mark.slow
+class TestShardedSoak:
+    def test_shard_sigkill_plus_partition_mid_run(self, tmp_path):
+        """The slow-slice twin of `bench.py rl --shards`: real shard
+        processes over the socket transport, one SIGKILLed and one
+        partitioned mid-run; the learner finishes, losses are counted,
+        the audit stays clean."""
+        import threading
+        import time as time_lib
+
+        from tensor2robot_tpu.replay import OnlineLoop, audit_episode_uids
+        from tensor2robot_tpu.replay.sharded import shard_root
+
+        loop = OnlineLoop(
+            str(tmp_path), num_actors=2, batch_size=4, seal_episodes=2,
+            seed=3, wait_timeout_s=180, actor_throttle_s=0.02,
+            shards=3, transport="socket",
+        ).start()
+        try:
+            def chaos_mid_run():
+                time_lib.sleep(2.5)
+                loop.kill_shard(1)
+                chaos.configure("net_send:1:partition:s2")
+
+            thread = threading.Thread(target=chaos_mid_run, daemon=True)
+            thread.start()
+            loop.run_learner(max_steps=8, save_steps=4, publish=True)
+            thread.join()
+        finally:
+            chaos.reset()
+            report = loop.stop()
+        assert report.learner_steps == 8
+        assert report.replay_restarts >= 1
+        assert report.stats_ok is True
+        assert report.episodes_lost <= loop.seal_episodes
+        audit = audit_episode_uids(
+            [shard_root(loop.replay_root, k) for k in range(3)]
+        )
+        assert audit["duplicate_count"] == 0, audit["duplicates"]

@@ -261,6 +261,36 @@ class TestLintsCatch:
         assert "env-unknown-flag" not in clean
         assert "env-undeclared" not in clean
 
+    def test_replay_shard_flags_covered_by_registry_lint(self):
+        """The round-13 sharded-fabric flags (T2R_REPLAY_SHARDS /
+        T2R_REPLAY_TRANSPORT / T2R_REPLAY_SPILL_BYTES) ride the same
+        rails: raw environ reads are env-undeclared, wrong-kind getter
+        reads are env-kind-mismatch, declared spellings clean."""
+        for name in (
+            "T2R_REPLAY_SHARDS", "T2R_REPLAY_TRANSPORT",
+            "T2R_REPLAY_SPILL_BYTES",
+        ):
+            assert "env-undeclared" in self._rules(
+                f"import os\nx = os.environ.get({name!r})\n"
+            ), name
+        assert "env-kind-mismatch" in self._rules(
+            "from tensor2robot_tpu import flags\n"
+            "x = flags.get_int('T2R_REPLAY_TRANSPORT')\n"
+        )
+        assert "env-kind-mismatch" in self._rules(
+            "from tensor2robot_tpu import flags\n"
+            "x = flags.get_enum('T2R_REPLAY_SHARDS')\n"
+        )
+        clean = self._rules(
+            "from tensor2robot_tpu import flags\n"
+            "a = flags.get_int('T2R_REPLAY_SHARDS')\n"
+            "b = flags.get_enum('T2R_REPLAY_TRANSPORT')\n"
+            "c = flags.get_int('T2R_REPLAY_SPILL_BYTES')\n"
+        )
+        assert "env-kind-mismatch" not in clean
+        assert "env-unknown-flag" not in clean
+        assert "env-undeclared" not in clean
+
     def test_numpy_in_jit_decorated(self):
         rules = self._rules(
             "import jax\nimport numpy as np\n"

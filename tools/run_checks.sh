@@ -96,6 +96,19 @@ if [ "$TESTS" = 1 ]; then
       -q -m 'not slow' -p no:cacheprovider; then
     status=1
   fi
+
+  echo "== replay-shard: socket transport + sharded fabric suite (tier-1) =="
+  # Socket framing fuzz (PR 3 corpus families: truncations/bitflips/
+  # forged lengths — corrupt frame rejected + retried, never partially
+  # decoded), network chaos actions (drop/slow/corrupt/partition),
+  # consistent-hash placement stability under shard death/respawn,
+  # sharded spill/failover/counted-coverage-loss, the zero-duplicate
+  # uid audit, and the in-process sharded loop twin. The multi-process
+  # sharded soak is the slow-slice twin (TestShardedSoak).
+  if ! JAX_PLATFORMS=cpu python -m pytest tests/test_replay_shard.py \
+      -q -m 'not slow' -p no:cacheprovider; then
+    status=1
+  fi
 fi
 
 if [ "$status" = 0 ]; then
