@@ -2535,6 +2535,494 @@ def bench_fleet(args) -> None:
         _fail("bench_fleet", err, metric=metric)
 
 
+def bench_gateway(args) -> None:
+    """Multi-tenant front-door leg (`python bench.py gateway`).
+
+    Drives the FULL production story through one pool: a Gateway
+    (per-tenant quotas, gold/silver/bronze strict priority, coalescing)
+    over a FleetRouter of mock replicas with a load-driven Autoscaler —
+    replaying a seeded diurnal, bursty multi-tenant trace with
+
+      * a hot silver tenant whose observations repeat (coalescing),
+      * a flash crowd (crowd tenants x`--crowd-factor` mid-trace),
+      * a rogue bronze tenant offered at 10x its admission quota,
+
+    twice: a fault-free twin, and a chaos twin where a replica is
+    SIGKILLed mid-crowd AND a rolling swap publishes a new model
+    version through the same pool. Gates (the acceptance criteria):
+    gold availability 1.0 with bounded p99 degradation vs the twin,
+    every bronze outcome typed (zero hung or silently lost requests
+    anywhere, by per-request accounting), coalescing measurably cutting
+    dispatches with bitwise-equal responses, and the autoscaler
+    reaching the crowd's replica ceiling then draining back without
+    killing an in-flight request or flapping.
+
+    All arrivals, burst windows, and jitter are seeded: rerunning the
+    leg replays the same trace.
+    """
+    import math
+    import os
+    import signal as signal_mod
+    import threading
+
+    metric = "gateway_multitenant_slo_cpu_proxy"
+    try:
+        import numpy as np
+
+        from tensor2robot_tpu.serving import (
+            Autoscaler,
+            FleetRouter,
+            GateError,
+            Gateway,
+            ReplicaSpec,
+            TenantBinding,
+            mock_server_factory,
+        )
+        from tensor2robot_tpu.serving.metrics import percentile
+
+        scale = args.rate_scale
+        trace_secs = args.trace_secs
+        crowd_window = (0.4 * trace_secs, 0.6 * trace_secs)
+        kill_at = 0.5 * trace_secs
+        swap_at = 0.55 * trace_secs
+
+        # The tenant universe: (name, tier, base_hz, unique_obs, crowd).
+        # unique_obs=None -> every request a distinct observation;
+        # a small int -> observations repeat (the coalescing regime).
+        rogue_offered_hz = args.rogue_rate * scale
+        tenant_cfg = [
+            ("web-gold", "gold", 80.0 * scale, None, True),
+            ("app-silver-hot", "silver", 120.0 * scale, 4, True),
+            ("app-silver", "silver", 60.0 * scale, None, False),
+            ("batch-bronze", "bronze", 50.0 * scale, None, False),
+            ("rogue-bronze", "bronze", rogue_offered_hz, None, False),
+        ]
+        tier_deadline_ms = {"gold": 800.0, "silver": 800.0, "bronze": 500.0}
+
+        def make_bindings():
+            bindings = []
+            for name, tier, _hz, _uniq, _crowd in tenant_cfg:
+                quota = (
+                    # The rogue's quota is a TENTH of its offered rate:
+                    # ~90% of its traffic must shed typed at admission.
+                    max(1.0, rogue_offered_hz / 10.0)
+                    if name == "rogue-bronze"
+                    else 1e6
+                )
+                bindings.append(
+                    TenantBinding(
+                        tenant=name, tier=tier, quota_rps=quota,
+                        burst=max(4, int(quota / 4)),
+                        deadline_ms=tier_deadline_ms[tier],
+                    )
+                )
+            return bindings
+
+        # -- the seeded trace: merged (t, tenant_index) arrivals ---------------
+        def build_trace(seed):
+            rng = np.random.RandomState(seed)
+            slot_s = 0.2  # burst-modulation window
+            n_slots = int(math.ceil(trace_secs / slot_s)) + 1
+            merged = []
+            for idx, (_name, _tier, base_hz, _uniq, crowd) in enumerate(
+                tenant_cfg
+            ):
+                # Doubly-stochastic arrivals: diurnal envelope x per-slot
+                # burst multiplier x flash crowd, thinned to a Poisson
+                # process per tenant.
+                bursts = rng.choice([1.0, 1.0, 1.0, 2.5], size=n_slots)
+                t = rng.uniform(0, 0.01)
+                while t < trace_secs:
+                    rate = base_hz * (
+                        1.0 + 0.5 * math.sin(2 * math.pi * t / trace_secs)
+                    )
+                    rate *= bursts[int(t / slot_s)]
+                    if crowd and crowd_window[0] <= t <= crowd_window[1]:
+                        rate *= args.crowd_factor
+                    rate = max(rate, 0.5)
+                    t += rng.exponential(1.0 / rate)
+                    merged.append((t, idx))
+            merged.sort()
+            return merged
+
+        def run_leg(trace, *, chaos_leg):
+            spec = ReplicaSpec(
+                factory=mock_server_factory,
+                factory_kwargs={"service_ms": args.service_ms},
+            )
+            router = FleetRouter(
+                spec, args.replicas,
+                max_inflight=args.max_inflight,
+                hedge_ms=args.hedge_ms,
+                # Tight death detection: the SIGKILL latency tail is
+                # bounded by probe interval + failover retry, and the
+                # gold p99-degradation gate rides on it.
+                probe_interval_ms=25.0,
+                probe_miss_limit=10,
+                backoff_ms=10.0,
+                max_respawns=5,
+                seed=11,
+            ).start(timeout_s=120.0)
+            gateway = Gateway(
+                router, make_bindings(),
+                max_queue=1024,
+                tier_queue_budget_ms={"bronze": 250.0},
+                seed=17,
+            ).start()
+            scaler = Autoscaler(
+                router,
+                min_replicas=args.replicas,
+                max_replicas=args.max_replicas,
+                high_watermark=0.7,
+                low_watermark=0.2,
+                # Asymmetric hysteresis: react to overload in two ticks,
+                # but demand ~a second of sustained idleness before
+                # giving capacity back — a burst lull mid-trace must not
+                # thrash the pool (the no-flap gate pins this).
+                scale_up_ticks=2,
+                scale_down_ticks=12,
+                cooloff_base_ms=150.0,
+                cooloff_cap_ms=1200.0,
+                tick_interval_s=0.08,
+                drain_timeout_s=20.0,
+                seed=7,
+            ).start()
+            try:
+                deadline = time.monotonic() + 60
+                while time.monotonic() < deadline and not all(
+                    s == "up" for s in router.replica_states()
+                ):
+                    time.sleep(0.02)
+
+                unique_counter = [0]
+                obs_cache = {}
+
+                def observation(tenant_idx):
+                    _name, _tier, _hz, uniq, _crowd = tenant_cfg[tenant_idx]
+                    if uniq is None:
+                        unique_counter[0] += 1
+                        key = (tenant_idx, unique_counter[0])
+                        value = 1000.0 + unique_counter[0]
+                    else:
+                        key = (tenant_idx, unique_counter[0] % uniq)
+                        value = float((unique_counter[0] % uniq) + 1)
+                        unique_counter[0] += 1
+                    features = obs_cache.get(key)
+                    if features is None:
+                        features = {
+                            "x": np.full((8,), value, np.float32)
+                        }
+                        obs_cache[key] = features
+                        if len(obs_cache) > 4096:
+                            obs_cache.clear()
+                    return key, features
+
+                records = []
+                rec_lock = threading.Lock()
+                admission = {}  # tenant -> {error_class: count}
+                submitted = {}  # tenant -> count
+                hot_y = {}  # obs_key -> set of y values (bitwise check)
+                killed_pid = None
+                swap_thread = None
+                swap_result = {}
+                t0 = time.monotonic()
+                for t_arrival, tenant_idx in trace:
+                    name, tier, _hz, uniq, _crowd = tenant_cfg[tenant_idx]
+                    now = time.monotonic()
+                    if now - t0 < t_arrival:
+                        time.sleep(t_arrival - (now - t0))
+                    rel = time.monotonic() - t0
+                    if chaos_leg and killed_pid is None and rel >= kill_at:
+                        for r in router.snapshot()["replicas"]:
+                            if r["state"] == "up":
+                                pid = router.replica_pids()[r["index"]]
+                                if pid is not None:
+                                    os.kill(pid, signal_mod.SIGKILL)
+                                    killed_pid = pid
+                                    break
+                    if (
+                        chaos_leg
+                        and swap_thread is None
+                        and rel >= swap_at
+                    ):
+                        swap_thread = threading.Thread(
+                            target=lambda: swap_result.update(
+                                gateway.rolling_swap(swap_timeout_s=30.0)
+                            ),
+                            daemon=True,
+                        )
+                        swap_thread.start()
+                    obs_key, features = observation(tenant_idx)
+                    submitted[name] = submitted.get(name, 0) + 1
+                    try:
+                        future = gateway.submit(name, features)
+                    except GateError as err:
+                        with rec_lock:
+                            admission.setdefault(name, {})
+                            cls = type(err).__name__
+                            admission[name][cls] = (
+                                admission[name].get(cls, 0) + 1
+                            )
+                        continue
+
+                    def on_done(fut, tenant=name, rel=rel,
+                                t_submit=time.monotonic(),
+                                obs_key=obs_key, track_y=uniq is not None):
+                        err = fut.error()
+                        latency = (time.monotonic() - t_submit) * 1e3
+                        version = None
+                        coalesced = False
+                        if err is None:
+                            response = fut.result(0)
+                            version = response.model_version
+                            coalesced = response.coalesced
+                        with rec_lock:
+                            records.append(
+                                (tenant, rel, latency,
+                                 None if err is None else type(err).__name__,
+                                 coalesced, version)
+                            )
+                            if err is None and track_y:
+                                hot_y.setdefault(obs_key, set()).add(
+                                    float(response.outputs["y"])
+                                )
+
+                    future.add_done_callback(on_done)
+
+                # Drain: every admitted future must resolve, typed or ok.
+                expected = sum(submitted.values()) - sum(
+                    sum(v.values()) for v in admission.values()
+                )
+                drain_deadline = time.monotonic() + 30
+                while time.monotonic() < drain_deadline:
+                    with rec_lock:
+                        if len(records) >= expected:
+                            break
+                    time.sleep(0.02)
+                if swap_thread is not None:
+                    swap_thread.join(timeout=60)
+                # Idle window: the autoscaler must drain back unaided.
+                idle_deadline = time.monotonic() + args.drain_secs
+                while time.monotonic() < idle_deadline:
+                    if router.load()["replicas_up"] <= args.replicas:
+                        break
+                    time.sleep(0.05)
+                with rec_lock:
+                    frozen = list(records)
+                lost = expected - len(frozen)
+
+                per_tenant = {}
+                for name, tier, _hz, _uniq, _crowd in tenant_cfg:
+                    mine = [r for r in frozen if r[0] == name]
+                    ok = sorted(r[2] for r in mine if r[3] is None)
+                    failed = {}
+                    for r in mine:
+                        if r[3] is not None:
+                            failed[r[3]] = failed.get(r[3], 0) + 1
+                    n_submitted = submitted.get(name, 0)
+                    admission_typed = admission.get(name, {})
+                    resolved = len(mine) + sum(admission_typed.values())
+                    per_tenant[name] = {
+                        "tier": tier,
+                        "submitted": n_submitted,
+                        "completed": len(ok),
+                        "availability": round(
+                            len(ok) / max(n_submitted, 1), 5
+                        ),
+                        "p50_ms": round(percentile(ok, 0.50), 3),
+                        "p99_ms": round(percentile(ok, 0.99), 3),
+                        "failed_typed": failed,
+                        "shed_at_admission": admission_typed,
+                        "coalesced": sum(1 for r in mine if r[4]),
+                        "lost": n_submitted - resolved,
+                    }
+                versions = sorted(
+                    {r[5] for r in frozen if r[5] is not None}
+                )
+                gate_snap = gateway.snapshot()
+                scaler_snap = scaler.snapshot()
+                router_snap = router.snapshot()
+                final_load = router.load()
+                reversals = sum(
+                    1
+                    for a, b in zip(
+                        scaler_snap["actions"], scaler_snap["actions"][1:]
+                    )
+                    if a["direction"] != b["direction"]
+                )
+                return {
+                    "per_tenant": per_tenant,
+                    "lost_total": lost,
+                    "versions_observed": versions,
+                    "killed_pid": killed_pid,
+                    "swap_result": (
+                        {
+                            "swapped": swap_result.get("swapped"),
+                            "failed": swap_result.get("failed"),
+                        }
+                        if swap_result
+                        else None
+                    ),
+                    "gateway_counters": gate_snap["counters"],
+                    "router_counters": router_snap["counters"],
+                    "autoscaler": {
+                        "counters": scaler_snap["counters"],
+                        "actions": scaler_snap["actions"],
+                        "peak_replicas_up": scaler_snap["peak_replicas_up"],
+                        "reversals": reversals,
+                    },
+                    "final_replicas_up": final_load["replicas_up"],
+                    "hot_y_groups": {
+                        str(k): sorted(v) for k, v in hot_y.items()
+                    },
+                }
+            finally:
+                scaler.stop()
+                gateway.stop()
+                router.stop()
+
+        trace = build_trace(seed=29)
+        fault_free = run_leg(trace, chaos_leg=False)
+        chaos_leg = run_leg(trace, chaos_leg=True)
+
+        # -- gates (the acceptance criteria) -----------------------------------
+        gold_c = chaos_leg["per_tenant"]["web-gold"]
+        gold_f = fault_free["per_tenant"]["web-gold"]
+        # Sub-floor p99s on a CPU proxy host are scheduler noise; the
+        # ratio is measured against max(twin, floor) and both raw
+        # numbers ride in the payload.
+        p99_base = max(gold_f["p99_ms"], args.p99_floor_ms)
+        p99_degradation = (
+            gold_c["p99_ms"] / p99_base if p99_base > 0 else float("inf")
+        )
+        bronze_names = [
+            name for name, tier, *_ in tenant_cfg if tier == "bronze"
+        ]
+        bronze_typed_ok = all(
+            chaos_leg["per_tenant"][n]["lost"] == 0 for n in bronze_names
+        )
+        rogue = chaos_leg["per_tenant"]["rogue-bronze"]
+        rogue_throttled = rogue["shed_at_admission"].get(
+            "TenantThrottled", 0
+        )
+        hot = chaos_leg["per_tenant"]["app-silver-hot"]
+        coalesce_bitwise_ok = all(
+            len(values) == 1
+            for values in chaos_leg["hot_y_groups"].values()
+        ) and len(chaos_leg["hot_y_groups"]) > 0
+        zero_lost = (
+            chaos_leg["lost_total"] == 0
+            and fault_free["lost_total"] == 0
+            and all(
+                t["lost"] == 0
+                for leg in (chaos_leg, fault_free)
+                for t in leg["per_tenant"].values()
+            )
+        )
+        scaler_c = chaos_leg["autoscaler"]
+        retire_clean = scaler_c["counters"].get("scale_down", 0) >= 1 and (
+            chaos_leg["router_counters"].get("retirement_aborts", 0) == 0
+        )
+        gates = {
+            "gold_availability_1": gold_c["availability"] == 1.0
+            and not gold_c["failed_typed"]
+            and not gold_c["shed_at_admission"],
+            "gold_p99_bounded": (
+                p99_degradation <= args.p99_degradation_max
+            ),
+            "bronze_overload_typed": bronze_typed_ok
+            and rogue_throttled > 0
+            and rogue["availability"] < 0.5,  # the quota really bit
+            "zero_lost_all_tiers": zero_lost,
+            "coalesce_effective": (
+                hot["coalesced"] > 0
+                and chaos_leg["gateway_counters"].get("coalesced_joins", 0)
+                > 0
+                and coalesce_bitwise_ok
+            ),
+            "autoscaler_reached_ceiling": (
+                scaler_c["peak_replicas_up"] >= args.max_replicas
+            ),
+            "autoscaler_drained_back": (
+                chaos_leg["final_replicas_up"] <= args.replicas + 1
+                and retire_clean
+            ),
+            # Convergence, not rigidity: a bursty trace legitimately
+            # re-scales after an early drain (a post-crowd burst saturates
+            # the shrunk pool), so the flap bound is a few reversals with
+            # TERMINAL convergence — the run must END in a drain phase at
+            # the floor, not oscillating.
+            "autoscaler_no_flap": (
+                scaler_c["reversals"] <= 3
+                and (
+                    not scaler_c["actions"]
+                    or scaler_c["actions"][-1]["direction"] == "down"
+                )
+                and chaos_leg["final_replicas_up"] <= args.replicas + 1
+            ),
+            "killed_and_recovered": (
+                chaos_leg["killed_pid"] is not None
+                and chaos_leg["router_counters"].get("replica_deaths", 0)
+                >= 1
+                and chaos_leg["router_counters"].get("respawns", 0) >= 1
+            ),
+            "swap_published_through_pool": (
+                chaos_leg["swap_result"] is not None
+                and chaos_leg["swap_result"]["failed"] is None
+                and max(chaos_leg["versions_observed"], default=1) >= 2
+            ),
+        }
+        all_green = all(gates.values())
+        completed_total = sum(
+            t["completed"] for t in chaos_leg["per_tenant"].values()
+        )
+        payload = {
+            "metric": metric,
+            "value": round(completed_total / trace_secs, 2),
+            "unit": "requests_per_sec",
+            "vs_baseline": round(
+                (args.p99_degradation_max / p99_degradation)
+                if all_green and p99_degradation > 0
+                else 0.0,
+                4,
+            ),
+            "all_green": all_green,
+            "gates": gates,
+            "detail": {
+                "trace_secs": trace_secs,
+                "rate_scale": scale,
+                "crowd_factor": args.crowd_factor,
+                "crowd_window_s": list(crowd_window),
+                "kill_at_s": kill_at,
+                "swap_at_s": swap_at,
+                "replicas_min": args.replicas,
+                "replicas_max": args.max_replicas,
+                "service_ms": args.service_ms,
+                "max_inflight": args.max_inflight,
+                "hedge_ms": args.hedge_ms,
+                "gold_p99_degradation_x": round(p99_degradation, 3),
+                "gold_p99_floor_ms": args.p99_floor_ms,
+                "fault_free": fault_free,
+                "chaos": chaos_leg,
+                "backend": "mock_replica_processes",
+                "host_cpus": os.cpu_count(),
+            },
+            "cpu_proxy": True,
+            "proxy_note": (
+                "gateway/autoscaler control plane measured over mock "
+                "replica processes on CPU; absolute rates are host-bound, "
+                "the per-tier SLO / typed-shed / zero-lost contracts are "
+                "platform-independent"
+            ),
+        }
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(payload, f, indent=2, sort_keys=True)
+        _emit(payload)
+    except Exception as err:  # noqa: BLE001
+        _fail("bench_gateway", err, metric=metric)
+
+
 def bench_comms(args) -> None:
     """Quantized gradient-collective leg (`python bench.py comms`).
 
@@ -3664,6 +4152,78 @@ def _build_cli():
     )
     fleet.add_argument(
         "--out", default="BENCH_FLEET_r11.json",
+        help="also write the payload to this file ('' disables; "
+             "default %(default)s)",
+    )
+    gateway = leg(
+        "gateway", bench_gateway,
+        "multi-tenant front-door leg: Gateway (quotas, gold/silver/bronze "
+        "priority shedding, coalescing) + Autoscaler over a mock replica "
+        "pool, replaying a seeded diurnal bursty trace with a flash "
+        "crowd, a rogue bronze tenant at 10x quota, a replica SIGKILL "
+        "mid-crowd and a rolling swap through the same pool; gates on "
+        "per-tier SLOs, typed sheds, zero lost requests, coalescing, and "
+        "autoscaler convergence (docs/SERVING.md, docs/RESILIENCE.md)",
+    )
+    gateway.add_argument(
+        "--replicas", type=int, default=2,
+        help="starting (and minimum) replica count (default %(default)s)",
+    )
+    gateway.add_argument(
+        "--max-replicas", type=int, default=5,
+        help="autoscaler ceiling the flash crowd must reach "
+             "(default %(default)s)",
+    )
+    gateway.add_argument(
+        "--service-ms", type=float, default=3.0,
+        help="mock per-request service time (default %(default)s)",
+    )
+    gateway.add_argument(
+        "--max-inflight", type=int, default=4,
+        help="router per-replica in-flight cap (default %(default)s)",
+    )
+    gateway.add_argument(
+        "--hedge-ms", type=int, default=25,
+        help="router hedge delay, amputates the SIGKILL latency tail "
+             "(default %(default)s)",
+    )
+    gateway.add_argument(
+        "--trace-secs", type=float, default=10.0,
+        help="trace duration; the flash crowd spans [0.4, 0.6] of it "
+             "(default %(default)s)",
+    )
+    gateway.add_argument(
+        "--drain-secs", type=float, default=6.0,
+        help="post-trace idle window for the autoscaler to drain back "
+             "(default %(default)s)",
+    )
+    gateway.add_argument(
+        "--rate-scale", type=float, default=1.0,
+        help="multiplier on every tenant's offered rate "
+             "(default %(default)s)",
+    )
+    gateway.add_argument(
+        "--crowd-factor", type=float, default=6.0,
+        help="flash-crowd rate multiplier on the crowd tenants "
+             "(default %(default)s)",
+    )
+    gateway.add_argument(
+        "--rogue-rate", type=float, default=300.0,
+        help="rogue bronze tenant's offered rate; its quota is a tenth "
+             "of this (default %(default)s)",
+    )
+    gateway.add_argument(
+        "--p99-degradation-max", type=float, default=2.0,
+        help="chaos-leg gold p99 may be at most this multiple of the "
+             "fault-free twin's (default %(default)s)",
+    )
+    gateway.add_argument(
+        "--p99-floor-ms", type=float, default=25.0,
+        help="twin p99 floor for the degradation ratio (sub-floor p99s "
+             "are CPU-proxy scheduler noise) (default %(default)s)",
+    )
+    gateway.add_argument(
+        "--out", default="BENCH_GATE_r14.json",
         help="also write the payload to this file ('' disables; "
              "default %(default)s)",
     )

@@ -59,6 +59,18 @@ Three rule families, each born from a real failure mode in this codebase:
   unexplained hang; handlers that DO something (log, fall back,
   re-raise) are out of scope.
 
+* Retry-pacing discipline (`sleep-retry-outside-backoff`) — inside
+  `tensor2robot_tpu/serving/` and `tensor2robot_tpu/replay/`, a
+  `time.sleep(<constant>)` spelled inside a loop is a hand-rolled
+  retry/poll: unseeded (chaos suites cannot replay its pacing) and
+  unbounded (no hard total-time promise to the caller). Every such wait
+  must ride a `utils/backoff.py` schedule (`Backoff.poll`/`sleep`, or
+  `delay_s` feeding the sleep — a computed delay argument is out of
+  scope by design); the one sanctioned exception is a daemon monitor
+  that ticks forever at a fixed cadence, which declares itself with the
+  `@poll_loop` decorator (utils/backoff.py) so the exemption is
+  grep-able.
+
 * Shm-ring discipline (`shm-*`) — the process-worker return path
   (data/dataset.py) cycles shared-memory slots worker->consumer through
   a free-name queue. The protocol's liveness rests on three rules the
@@ -104,6 +116,15 @@ _SWALLOW_SCOPE_FRAGMENTS = (
 )
 _SWALLOW_ALLOW_DECORATOR = "best_effort_cleanup"
 _BROAD_EXCEPTION_NAMES = frozenset({"Exception", "BaseException"})
+
+# Retry-pacing discipline: where bare constant-interval sleep loops are
+# banned, and the decorator (utils/backoff.py) that allowlists a
+# fixed-interval monitor.
+_SLEEP_SCOPE_FRAGMENTS = (
+    "tensor2robot_tpu/serving/",
+    "tensor2robot_tpu/replay/",
+)
+_SLEEP_ALLOW_DECORATOR = "poll_loop"
 
 # numpy calls that MATERIALIZE data on the host (traced-value poison
 # inside jit). Deliberately excludes shape/dtype arithmetic (np.prod,
@@ -216,6 +237,11 @@ class _Visitor(ast.NodeVisitor):
             fragment in norm_path for fragment in _SWALLOW_SCOPE_FRAGMENTS
         )
         self._swallow_allow_depth = 0
+        self.in_sleep_scope = any(
+            fragment in norm_path for fragment in _SLEEP_SCOPE_FRAGMENTS
+        )
+        self._sleep_allow_depth = 0
+        self._loop_depth = 0
         # Module aliases bound to jax.lax in this file (`import jax.lax
         # as jl`, `from jax import lax as jlax`): `jl.psum` must trip
         # the collective gate exactly like `lax.psum`.
@@ -557,6 +583,50 @@ class _Visitor(ast.NodeVisitor):
                     )
         self.generic_visit(node)
 
+    # -- retry-pacing discipline ----------------------------------------------
+
+    def _check_sleep_call(self, node: ast.Call) -> None:
+        """`time.sleep(<constant>)` inside a loop in serving//replay/:
+        a hand-rolled retry/poll cadence. Computed delay arguments
+        (backoff.delay_s(...), a configured interval attribute) are out
+        of scope — the rule targets the literal-interval spelling that
+        carries no seed and no total bound."""
+        if (
+            not self.in_sleep_scope
+            or self._loop_depth == 0
+            or self._sleep_allow_depth > 0
+        ):
+            return
+        if self._dotted(node.func) not in ("time.sleep", "sleep"):
+            return
+        if not node.args:
+            return
+        arg = node.args[0]
+        if not (
+            isinstance(arg, ast.Constant)
+            and isinstance(arg.value, (int, float))
+        ):
+            return
+        self._emit(
+            node,
+            "sleep-retry-outside-backoff",
+            f"bare time.sleep({arg.value!r}) retry/poll loop in the "
+            "serving/replay layers; ride a utils/backoff.py schedule "
+            "(Backoff.poll / Backoff.sleep) so the wait is seeded and "
+            "hard-bounded, or declare a fixed-interval monitor with "
+            f"@{_SLEEP_ALLOW_DECORATOR}",
+        )
+
+    def visit_While(self, node: ast.While) -> None:
+        self._loop_depth += 1
+        self.generic_visit(node)
+        self._loop_depth -= 1
+
+    def visit_For(self, node: ast.For) -> None:
+        self._loop_depth += 1
+        self.generic_visit(node)
+        self._loop_depth -= 1
+
     # -- shm-ring discipline --------------------------------------------------
 
     def _in_ring_class(self) -> bool:
@@ -636,12 +706,24 @@ class _Visitor(ast.NodeVisitor):
             self._dotted(d).split(".")[-1] == _SWALLOW_ALLOW_DECORATOR
             for d in node.decorator_list
         )
+        allow_sleep = any(
+            self._dotted(d).split(".")[-1] == _SLEEP_ALLOW_DECORATOR
+            for d in node.decorator_list
+        )
         self._func_stack.append(node.name)
         if jitted:
             self._jit_depth += 1
         if allow_swallow:
             self._swallow_allow_depth += 1
+        if allow_sleep:
+            self._sleep_allow_depth += 1
+        # A nested def starts its own loop context: a sleep inside a
+        # function merely DEFINED within a loop is not a polling loop.
+        saved_loop_depth, self._loop_depth = self._loop_depth, 0
         self.generic_visit(node)
+        self._loop_depth = saved_loop_depth
+        if allow_sleep:
+            self._sleep_allow_depth -= 1
         if allow_swallow:
             self._swallow_allow_depth -= 1
         if jitted:
@@ -661,6 +743,7 @@ class _Visitor(ast.NodeVisitor):
         self._check_flags_call(node)
         self._check_np_call(node)
         self._check_serve_call(node)
+        self._check_sleep_call(node)
         self._check_shm_call(node, self._func_stack)
         self.generic_visit(node)
 

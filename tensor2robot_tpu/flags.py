@@ -186,6 +186,73 @@ _declare(
     minimum=0,
 )
 _declare(
+    "T2R_GATE_BURST",
+    _INT,
+    32,
+    "Gateway token-bucket depth per tenant (requests): how large an "
+    "instantaneous burst a tenant may land before admission throttles "
+    "it back to its refill rate.",
+    "tensor2robot_tpu/serving/gateway.py",
+    minimum=1,
+)
+_declare(
+    "T2R_GATE_CIRCUIT_COOLOFF_MS",
+    _INT,
+    2000,
+    "Per-tenant circuit cooloff (ms): how long an open tenant circuit "
+    "rejects at admission before the tenant is readmitted.",
+    "tensor2robot_tpu/serving/gateway.py",
+    minimum=1,
+)
+_declare(
+    "T2R_GATE_CIRCUIT_THRESHOLD",
+    _INT,
+    8,
+    "Per-tenant circuit threshold: consecutive pool-side failures of one "
+    "tenant's requests before its circuit opens (TenantSuspended at "
+    "admission) — a rogue tenant cannot brown out the shared pool.",
+    "tensor2robot_tpu/serving/gateway.py",
+    minimum=1,
+)
+_declare(
+    "T2R_GATE_COALESCE",
+    _BOOL,
+    True,
+    "Gateway request coalescing: bitwise-identical observations against "
+    "the same pool share ONE replica dispatch (never across a "
+    "model-version flip); 0 dispatches every request individually.",
+    "tensor2robot_tpu/serving/gateway.py",
+)
+_declare(
+    "T2R_GATE_DEADLINE_MS",
+    _INT,
+    1000,
+    "Default end-to-end gateway deadline (ms) when submit() passes none; "
+    "the remaining budget rides into the router and down to the replica.",
+    "tensor2robot_tpu/serving/gateway.py",
+    minimum=1,
+)
+_declare(
+    "T2R_GATE_MAX_QUEUE",
+    _INT,
+    512,
+    "Gateway admission-queue bound per pool: beyond it the strict-"
+    "priority overload policy sheds the lowest tier first (typed "
+    "TierShed, bronze before gold).",
+    "tensor2robot_tpu/serving/gateway.py",
+    minimum=1,
+)
+_declare(
+    "T2R_GATE_QUOTA_RPS",
+    _INT,
+    100,
+    "Default per-tenant admission quota (requests/s token-bucket refill) "
+    "for tenant bindings that do not set an explicit quota; over-quota "
+    "submissions fail typed (TenantThrottled) at admission.",
+    "tensor2robot_tpu/serving/gateway.py",
+    minimum=1,
+)
+_declare(
     "T2R_INFEED_DEPTH",
     _INT,
     2,

@@ -39,6 +39,7 @@ from typing import Any, Dict, Iterator, List, Optional, Tuple
 import numpy as np
 
 from tensor2robot_tpu.data.parser import SpecParser
+from tensor2robot_tpu.utils.backoff import Backoff
 from tensor2robot_tpu.data.wire import FastSpecParser
 from tensor2robot_tpu.data.input_generators import AbstractInputGenerator
 from tensor2robot_tpu.replay import segment as segment_lib
@@ -199,17 +200,18 @@ class ReplayInputGenerator(AbstractInputGenerator):
     # -- batch stream ----------------------------------------------------------
 
     def _wait_predicate(self, ready, what: str):
-        deadline = time.monotonic() + self._wait_timeout_s
-        while True:
-            result = ready()
-            if result:
-                return result
-            if time.monotonic() >= deadline:
-                raise ReplayEmpty(
-                    f"replay buffer produced no {what} within "
-                    f"{self._wait_timeout_s}s"
-                )
-            time.sleep(0.05)
+        # Seeded, hard-bounded poll (utils/backoff.py): the generator's
+        # bring-up wait cannot exceed its configured budget by more than
+        # one capped delay, and the cadence replays under a fixed seed.
+        result = Backoff(
+            base_ms=50.0, cap_ms=150.0, factor=1.0, seed=3
+        ).poll(ready, total_s=self._wait_timeout_s)
+        if result:
+            return result
+        raise ReplayEmpty(
+            f"replay buffer produced no {what} within "
+            f"{self._wait_timeout_s}s"
+        )
 
     def _dir_batches(self) -> Iterator[TensorSpecStruct]:
         fifo = _DirFifo(self._root)

@@ -65,7 +65,7 @@ from tensor2robot_tpu import flags as t2r_flags
 from tensor2robot_tpu.replay import segment as segment_lib
 from tensor2robot_tpu.replay import transport as transport_lib
 from tensor2robot_tpu.testing import chaos
-from tensor2robot_tpu.utils.backoff import Backoff
+from tensor2robot_tpu.utils.backoff import Backoff, poll_loop
 from tensor2robot_tpu.utils.errors import best_effort
 
 _log = logging.getLogger(__name__)
@@ -956,6 +956,7 @@ class ReplayServiceHandle:
         )
         self._process.start()
 
+    @poll_loop
     def _monitor_loop(self) -> None:
         while not self._closed:
             process = self._process
@@ -983,6 +984,7 @@ class ReplayServiceHandle:
                 continue  # lifecycle is the supervisor's, not clients'
             best_effort(self._svc_request_q.put, request)
 
+    @poll_loop
     def _drain_loop(self) -> None:
         """Service replies -> the owning client's stable queue. Tracks
         incarnation flips so it always reads the LIVE response queue
@@ -1077,14 +1079,17 @@ class ReplayServiceHandle:
                 and info["incarnation"] >= self._incarnation
             )
 
-        deadline = time.monotonic() + timeout_s
-        while time.monotonic() < deadline:
-            if self._closed:
-                return False
-            if current_published():
-                return True
-            time.sleep(0.02)
-        return current_published()
+        # Seeded, bounded poll (utils/backoff.py): a hard total-time
+        # bound by construction, jittered so a fleet of shards waiting
+        # on each other does not probe in lockstep.
+        return bool(
+            Backoff(base_ms=20.0, cap_ms=60.0, factor=1.0, seed=1).poll(
+                lambda: self._closed or current_published(),
+                total_s=timeout_s,
+            )
+            and not self._closed
+            and current_published()
+        )
 
     def pid(self) -> Optional[int]:
         process = self._process

@@ -48,6 +48,7 @@ from typing import Any, Deque, Dict, List, Optional, Sequence, Tuple
 
 from tensor2robot_tpu import flags as t2r_flags
 from tensor2robot_tpu.replay import segment as segment_lib
+from tensor2robot_tpu.utils.backoff import Backoff
 from tensor2robot_tpu.replay.service import (
     ReplayBuffer,
     ReplayClient,
@@ -310,19 +311,21 @@ class ShardedReplayClient:
 
     def flush_spill(self, timeout_s: float = 10.0) -> int:
         """Best-effort drain of every shard's spill (teardown); returns
-        the number of episodes still spilled after the deadline."""
-        deadline = time.monotonic() + timeout_s
-        while time.monotonic() < deadline:
+        the number of episodes still spilled after the deadline. The
+        retry cadence is a seeded, hard-bounded backoff schedule."""
+
+        def drained() -> bool:
             with self._lock:
                 for shard in range(self.num_shards):
                     # Teardown is the one caller that overrides the
                     # probe window: this is its last chance.
                     self._down_until.pop(shard, None)
                     self._drain_shard_locked(shard, time.monotonic())
-                pending = sum(len(q) for q in self._spill.values())
-            if pending == 0:
-                return 0
-            time.sleep(0.1)
+                return not any(self._spill.values())
+
+        Backoff(base_ms=100.0, cap_ms=250.0, factor=1.0, seed=2).poll(
+            drained, total_s=timeout_s
+        )
         with self._lock:
             return sum(len(q) for q in self._spill.values())
 

@@ -4,10 +4,13 @@
 The host-side traffic layer over AbstractPredictor: bounded queue with
 deadlines and backpressure, bucket-padded micro-batches (ladder = the
 exporter's warmup_batch_sizes, so every served shape is pre-compiled),
-zero-downtime hot-swap, structured observability snapshots — and, one
-level up, a FleetRouter dispatching over a pool of policy-server
-replica *processes* with deadline-aware least-loaded routing, retries,
-hedging, health eviction, and rolling deploys.
+zero-downtime hot-swap, structured observability snapshots — one level
+up, a FleetRouter dispatching over a pool of policy-server replica
+*processes* with deadline-aware least-loaded routing, retries, hedging,
+health eviction, and rolling deploys — and, at the top, the
+multi-tenant Gateway (per-tenant quotas, priority tiers, coalescing,
+per-tenant circuit breaking) with a load-driven Autoscaler spawning and
+draining replicas off the router's own load counters.
 
 Exports resolve lazily (PEP 562): replica worker processes import this
 package on spawn, and the replica entry path must not drag the full
@@ -53,6 +56,22 @@ _EXPORTS = {
     "mock_server_factory": "replica",
     # compile_cache.py — persistent XLA compile cache for replicas.
     "enable_compile_cache": "compile_cache",
+    # gateway.py — the multi-tenant front door over router pools.
+    "Gateway": "gateway",
+    "TenantBinding": "gateway",
+    "GateFuture": "gateway",
+    "GateResponse": "gateway",
+    "GateError": "gateway",
+    "UnknownTenant": "gateway",
+    "TenantThrottled": "gateway",
+    "TenantSuspended": "gateway",
+    "TierShed": "gateway",
+    "GateDeadline": "gateway",
+    "GatewayClosed": "gateway",
+    "TIERS": "gateway",
+    "observation_digest": "gateway",
+    # autoscaler.py — load-driven replica count over a router pool.
+    "Autoscaler": "autoscaler",
 }
 
 __all__ = sorted(_EXPORTS)
@@ -77,8 +96,24 @@ def __dir__():
 
 
 if TYPE_CHECKING:  # pragma: no cover — static analyzers only
+    from tensor2robot_tpu.serving.autoscaler import Autoscaler  # noqa: F401
     from tensor2robot_tpu.serving.compile_cache import (  # noqa: F401
         enable_compile_cache,
+    )
+    from tensor2robot_tpu.serving.gateway import (  # noqa: F401
+        TIERS,
+        GateDeadline,
+        GateError,
+        GateFuture,
+        GateResponse,
+        Gateway,
+        GatewayClosed,
+        TenantBinding,
+        TenantSuspended,
+        TenantThrottled,
+        TierShed,
+        UnknownTenant,
+        observation_digest,
     )
     from tensor2robot_tpu.serving.buckets import (  # noqa: F401
         buckets_from_metadata,

@@ -1,0 +1,285 @@
+"""Autoscaler: load-driven replica count over a FleetRouter pool.
+
+The router made replica failure a typed, routed-around event; the
+gateway made tenant overload a typed, shed event. What neither does is
+change CAPACITY: a flash crowd against a fixed pool can only shed, and
+a quiet pool burns accelerators serving nothing. This module closes the
+loop off the router's own load counters (`FleetRouter.load()`):
+
+  * **Watermarks + hysteresis.** Utilization (in-flight work over
+    routable capacity) above `high_watermark` for `scale_up_ticks`
+    CONSECUTIVE ticks spawns one replica (`router.add_replica`); below
+    `low_watermark` for `scale_down_ticks` consecutive ticks retires
+    one (`router.retire_replica`). One step per decision — capacity
+    moves like a thermostat, not a step function, and a single noisy
+    tick moves nothing.
+  * **Cooloff via the shared backoff schedule.** After every action the
+    scaler goes quiet for a seeded `utils/backoff.py` delay that grows
+    with the length of the same-direction streak — the anti-flap
+    discipline: a scaler oscillating around a watermark pays an
+    increasing price for each reversal-free repeat, and a fixed seed
+    replays the exact pacing under a fixed load trace.
+  * **Scale-down never kills work.** Retirement drains through the
+    router's `draining` state (unrouted, in-flight completes, then
+    stop) — the rolling-swap discipline applied to capacity. A drain
+    that cannot empty aborts and restores the replica.
+  * **Bounds.** Replica count stays in [min_replicas, max_replicas];
+    pending (starting) replicas count toward the ceiling so a slow boot
+    cannot stack spawns.
+
+Chaos: the `scale` site (testing/chaos.py) fires on every scaling
+action; a `drop` clause skips that action (a scaler whose actuator
+misses a beat), `delay` stalls it, `raise` fails the tick — each a
+real control-plane failure mode the bench leg can inject
+deterministically.
+
+`tick()` is the whole control law and is directly callable (tests,
+bench); `start()` runs it on a daemon thread at `tick_interval_s`.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, List, Optional
+
+from tensor2robot_tpu.testing import chaos
+from tensor2robot_tpu.utils.backoff import Backoff, poll_loop
+
+_log = logging.getLogger(__name__)
+
+__all__ = ["Autoscaler"]
+
+
+class Autoscaler:
+    """Thermostat over one FleetRouter: utilization in, replica count out."""
+
+    def __init__(
+        self,
+        router,
+        *,
+        min_replicas: int = 1,
+        max_replicas: int = 8,
+        high_watermark: float = 0.75,
+        low_watermark: float = 0.25,
+        scale_up_ticks: int = 2,
+        scale_down_ticks: int = 4,
+        cooloff_base_ms: float = 500.0,
+        cooloff_cap_ms: float = 5000.0,
+        tick_interval_s: float = 0.25,
+        drain_timeout_s: float = 30.0,
+        seed: int = 0,
+    ):
+        if min_replicas < 1:
+            raise ValueError(f"min_replicas must be >= 1, got {min_replicas}")
+        if max_replicas < min_replicas:
+            raise ValueError(
+                f"max_replicas ({max_replicas}) < min_replicas "
+                f"({min_replicas})"
+            )
+        if not 0.0 <= low_watermark < high_watermark <= 1.0:
+            raise ValueError(
+                f"need 0 <= low ({low_watermark}) < high ({high_watermark}) "
+                "<= 1"
+            )
+        self._router = router
+        self.min_replicas = min_replicas
+        self.max_replicas = max_replicas
+        self.high_watermark = high_watermark
+        self.low_watermark = low_watermark
+        self.scale_up_ticks = scale_up_ticks
+        self.scale_down_ticks = scale_down_ticks
+        self._tick_interval_s = tick_interval_s
+        self._drain_timeout_s = drain_timeout_s
+        # Cooloff grows with the same-direction streak and resets on a
+        # reversal: repeated one-way moves are cheap (a real ramp),
+        # repeated moves AFTER a reversal (flapping) are not.
+        self._cooloff = Backoff(
+            base_ms=cooloff_base_ms, cap_ms=cooloff_cap_ms, seed=seed
+        )
+        self._lock = threading.Lock()
+        self._above = 0  # consecutive ticks above high watermark
+        self._below = 0  # consecutive ticks below low watermark
+        self._quiet_until = 0.0
+        self._last_direction: Optional[str] = None
+        self._streak = 0
+        self._counters: Dict[str, int] = {}
+        self._actions: List[Dict] = []
+        self._peak_up = 0
+        self._thread: Optional[threading.Thread] = None
+        self._drain_thread: Optional[threading.Thread] = None
+        self._stop_event = threading.Event()
+
+    # -- the control law ------------------------------------------------------
+
+    def tick(self) -> Optional[str]:
+        """One control step: read load, update hysteresis, maybe act.
+        Returns 'up'/'down' when a scaling action landed, None
+        otherwise. Thread-safe but intended to be driven by ONE clock
+        (the background loop or a test)."""
+        load = self._router.load()
+        now = time.monotonic()
+        with self._lock:
+            self._count("ticks")
+            self._peak_up = max(self._peak_up, load["replicas_up"])
+            if load["utilization"] >= self.high_watermark:
+                self._above += 1
+                self._below = 0
+            elif load["utilization"] <= self.low_watermark:
+                self._below += 1
+                self._above = 0
+            else:
+                self._above = 0
+                self._below = 0
+            if now < self._quiet_until:
+                self._count("cooloff_skips")
+                return None
+            direction: Optional[str] = None
+            if self._above >= self.scale_up_ticks:
+                # Pending replicas count toward the ceiling: a slow boot
+                # must not stack spawns.
+                effective = load["replicas_up"] + load["replicas_pending"]
+                if effective < self.max_replicas:
+                    direction = "up"
+                self._above = 0
+            elif self._below >= self.scale_down_ticks:
+                # One drain at a time: a second retirement while one is
+                # still emptying would double-count capacity leaving.
+                drain_busy = (
+                    self._drain_thread is not None
+                    and self._drain_thread.is_alive()
+                )
+                if load["replicas_up"] > self.min_replicas and not drain_busy:
+                    direction = "down"
+                self._below = 0
+            if direction is None:
+                return None
+        return self._act(direction, load)
+
+    def _act(self, direction: str, load: Dict) -> Optional[str]:
+        fault = chaos.maybe_fire("scale")
+        if fault is not None and fault.action in ("drop", "corrupt"):
+            with self._lock:
+                self._count("chaos_skipped")
+            return None
+        if direction == "up":
+            index = self._router.add_replica()
+            ok = True
+        else:
+            index = self._pick_drain_target()
+            ok = index is not None
+            if ok:
+                # The drain blocks until the replica's in-flight work
+                # empties (or aborts) — run it OFF the control thread,
+                # or a stalled drain would park the tick loop through
+                # exactly the overload it exists to absorb. The target
+                # leaves routing the moment retire_replica marks it
+                # draining; tick() refuses a second drain while this
+                # one runs.
+                drain = threading.Thread(
+                    target=self._finish_drain,
+                    args=(index,),
+                    name="t2r-autoscaler-drain",
+                    daemon=True,
+                )
+                with self._lock:
+                    self._drain_thread = drain
+                drain.start()
+        now = time.monotonic()
+        with self._lock:
+            if self._last_direction == direction:
+                self._streak += 1
+            else:
+                self._streak = 1
+                self._last_direction = direction
+            cooloff_s = self._cooloff.delay_s(min(self._streak, 8))
+            self._quiet_until = now + cooloff_s
+            self._count(f"scale_{direction}" if ok else "scale_aborted")
+            self._actions.append(
+                {
+                    "direction": direction,
+                    "replica": index,
+                    "ok": bool(ok),
+                    "utilization": round(load["utilization"], 4),
+                    "replicas_up": load["replicas_up"],
+                    "cooloff_ms": round(cooloff_s * 1e3, 1),
+                }
+            )
+            if len(self._actions) > 256:
+                del self._actions[:-256]
+        return direction if ok else None
+
+    def _finish_drain(self, index: int) -> None:
+        ok = self._router.retire_replica(
+            index, drain_timeout_s=self._drain_timeout_s
+        )
+        with self._lock:
+            self._count("drains_completed" if ok else "drain_aborted")
+
+    def _pick_drain_target(self) -> Optional[int]:
+        """Least-loaded `up` replica — the cheapest drain."""
+        snap = self._router.snapshot()
+        up = [r for r in snap["replicas"] if r["state"] == "up"]
+        if not up:
+            return None
+        return min(up, key=lambda r: r["inflight"])["index"]
+
+    def _count(self, name: str, n: int = 1) -> None:
+        self._counters[name] = self._counters.get(name, 0) + n
+
+    # -- background clock -----------------------------------------------------
+
+    def start(self) -> "Autoscaler":
+        if self._thread is not None:
+            raise RuntimeError("Autoscaler.start() called twice")
+        self._thread = threading.Thread(
+            target=self._run, name="t2r-autoscaler", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    @poll_loop
+    def _run(self) -> None:
+        while not self._stop_event.wait(self._tick_interval_s):
+            try:
+                self.tick()
+            except chaos.ChaosFault:
+                with self._lock:
+                    self._count("chaos_faults")
+            except Exception:
+                # A broken tick (router mid-stop, transient state) must
+                # not kill the control loop; the next tick re-reads.
+                _log.exception("autoscaler tick failed")
+                with self._lock:
+                    self._count("tick_errors")
+
+    def stop(self, timeout_s: float = 5.0) -> None:
+        self._stop_event.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout_s)
+
+    def __enter__(self) -> "Autoscaler":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- introspection --------------------------------------------------------
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            return {
+                "counters": dict(self._counters),
+                "actions": list(self._actions),
+                "peak_replicas_up": self._peak_up,
+                "policy": {
+                    "min_replicas": self.min_replicas,
+                    "max_replicas": self.max_replicas,
+                    "high_watermark": self.high_watermark,
+                    "low_watermark": self.low_watermark,
+                    "scale_up_ticks": self.scale_up_ticks,
+                    "scale_down_ticks": self.scale_down_ticks,
+                    "tick_interval_ms": self._tick_interval_s * 1e3,
+                },
+            }

@@ -1,0 +1,1072 @@
+"""Gateway: the multi-tenant front door over FleetRouter pools.
+
+Everything below the router is production-hardened (hedging, circuit
+break, rolling swap, chaos), but a fleet serving millions of users is
+not one client stream against one model — it is MANY tenants, each with
+its own traffic shape, sharing replica pools that must stay saturated
+without letting any one tenant brown out the rest. This module is that
+control tier:
+
+  * **Tenant bindings.** Each tenant binds to a pool (a `FleetRouter`
+    fronting one artifact/quant-regime/bucket-ladder), a priority tier,
+    and an admission quota. Many tenants share one pool; a gateway can
+    front many pools.
+  * **Admission quotas — token bucket per tenant.** Refill at
+    `quota_rps` up to `burst` (`T2R_GATE_QUOTA_RPS`/`T2R_GATE_BURST`
+    defaults); an over-quota submit fails synchronously with the typed
+    `TenantThrottled` — cheap, counted, and BEFORE any queue or pool
+    work, so a rogue tenant's excess costs the shared pool nothing.
+  * **Priority tiers — strict-priority admission queue.** gold >
+    silver > bronze. The dispatcher always serves the highest non-empty
+    tier; when the bounded queue (`T2R_GATE_MAX_QUEUE`) overflows, the
+    OLDEST entry of the LOWEST-priority tier is shed with the typed
+    `TierShed` — bronze before gold; within a tier the oldest entry is
+    shed so the freshest survive (the policy server's shed_oldest
+    discipline generalized across tiers).
+    Per-tier queue budgets bound how long a tier may wait before it is
+    shed typed (`GateDeadline(reason='queue_budget')`): under overload
+    bronze degrades into fast typed sheds instead of slow timeouts.
+  * **Request coalescing.** Bitwise-identical observations against the
+    same pool share ONE replica dispatch (`T2R_GATE_COALESCE`): the
+    packed feature bytes are hashed (the exact-verified decode-cache
+    discipline applied to inference), followers attach to the leader's
+    future, and every rider receives the same outputs object —
+    bitwise-equal responses by construction. A coalesce entry is never
+    joinable across a model-version flip: `rolling_swap()` bumps the
+    pool's swap epoch and entries from older epochs stop accepting
+    riders, so no request is served by a dispatch from the wrong side
+    of a publish.
+  * **Deadline propagation.** The gateway deadline (submit override >
+    binding default > `T2R_GATE_DEADLINE_MS`) is fixed at admission;
+    the REMAINING budget rides into `FleetRouter.submit`, which ships
+    the wall deadline to the replica, whose policy server drops
+    expired entries at micro-batch formation. One deadline, enforced at
+    every hop.
+  * **Per-tenant circuit breaking.** A tenant whose ADMITTED requests
+    keep failing (`T2R_GATE_CIRCUIT_THRESHOLD` consecutive — pool-side
+    errors, queue sheds, and queue expiries all count; this is
+    deliberate overload backpressure, converting a tenant's queue churn
+    into cheap synchronous rejections) is suspended for a cooloff
+    (`T2R_GATE_CIRCUIT_COOLOFF_MS`): admission rejects with the typed
+    `TenantSuspended` instead of letting the tenant keep converting
+    gateway and pool capacity into deadline misses. Throttles do not
+    count — they are already free — and a coalesce RIDER's failure
+    never counts against its tenant: only a leader's own traffic is
+    evidence.
+
+Chaos sites (testing/chaos.py): `admit` fires on every admission and
+`coalesce` on every join attempt, both with the tenant's call-site
+scope `t<i>` — so a plan can target ONE tenant inside the shared
+gateway process (`t2/admit:3:raise`). A `drop` at `admit` sheds the
+admission typed; a `drop` at `coalesce` bypasses the join (the request
+dispatches individually). See docs/SERVING.md ("Multi-tenant gateway")
+and docs/RESILIENCE.md (overload policy table).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import itertools
+import logging
+import threading
+import time
+import zlib
+from collections import deque
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from tensor2robot_tpu import flags as t2r_flags
+from tensor2robot_tpu.serving.metrics import percentile
+from tensor2robot_tpu.serving.router import (
+    FleetError,
+    FleetRouter,
+    RequestAbandoned,
+    RouterClosed,
+)
+from tensor2robot_tpu.testing import chaos
+from tensor2robot_tpu.utils.backoff import Backoff
+
+_log = logging.getLogger(__name__)
+
+__all__ = [
+    "Gateway",
+    "TenantBinding",
+    "GateFuture",
+    "GateResponse",
+    "GateError",
+    "UnknownTenant",
+    "TenantThrottled",
+    "TenantSuspended",
+    "TierShed",
+    "GateDeadline",
+    "GatewayClosed",
+    "TIERS",
+]
+
+# Strict priority order: earlier tiers are served first and shed last.
+TIERS: Tuple[str, ...] = ("gold", "silver", "bronze")
+_TIER_RANK = {tier: rank for rank, tier in enumerate(TIERS)}
+
+
+class GateError(RuntimeError):
+    """Base class for gateway-level request failures. Deliberately not a
+    FleetError subclass: admission failures never reached a pool, and
+    the two layers' errors never mix in one except clause (pool-side
+    failures resolve through the future carrying the router's own typed
+    error)."""
+
+
+class UnknownTenant(GateError):
+    """No binding for this tenant name."""
+
+
+class TenantThrottled(GateError):
+    """The tenant's token bucket is empty: over-quota, shed at admission."""
+
+
+class TenantSuspended(GateError):
+    """The tenant's circuit is open after consecutive failures of its
+    admitted requests (pool-side errors, queue sheds, queue expiries)."""
+
+
+class TierShed(GateError):
+    """Shed by the strict-priority overload policy (queue overflow or an
+    injected admission drop). `tier` names the tier that was shed."""
+
+    def __init__(self, message: str, tier: str):
+        super().__init__(message)
+        self.tier = tier
+
+
+class GateDeadline(GateError):
+    """The request expired while queued at the gateway. `reason` is
+    'deadline' (end-to-end budget) or 'queue_budget' (per-tier bound)."""
+
+    def __init__(self, message: str, reason: str):
+        super().__init__(message)
+        self.reason = reason
+
+
+class GatewayClosed(GateError):
+    """The gateway stopped before the request completed."""
+
+
+@dataclasses.dataclass
+class TenantBinding:
+    """One tenant's contract with the gateway.
+
+    `pool` keys into the gateway's router pools; `tier` is one of
+    TIERS. `quota_rps`/`burst`/`deadline_ms` default (None) to the
+    `T2R_GATE_*` flags. `scope` is the tenant's chaos call-site scope;
+    unset, the gateway assigns `t<i>` by binding order.
+    """
+
+    tenant: str
+    pool: str = "default"
+    tier: str = "bronze"
+    quota_rps: Optional[float] = None
+    burst: Optional[int] = None
+    deadline_ms: Optional[float] = None
+    scope: Optional[str] = None
+
+
+class GateResponse:
+    """One request's outputs plus gateway-level provenance. Coalesced
+    riders share the SAME `outputs` object as their leader — bitwise
+    equality is structural, not re-verified."""
+
+    __slots__ = (
+        "outputs", "model_version", "spans", "tenant", "tier", "pool",
+        "replica", "attempts", "hedged", "coalesced",
+    )
+
+    def __init__(self, outputs, model_version, spans, tenant, tier, pool,
+                 replica, attempts, hedged, coalesced):
+        self.outputs = outputs
+        self.model_version = model_version
+        self.spans = spans
+        self.tenant = tenant
+        self.tier = tier
+        self.pool = pool
+        self.replica = replica
+        self.attempts = attempts
+        self.hedged = hedged
+        self.coalesced = coalesced
+
+
+class GateFuture:
+    """Completion handle for one gateway request; resolves exactly once,
+    always (success, typed failure, or GatewayClosed at stop)."""
+
+    def __init__(self, request_id: int):
+        self.request_id = request_id
+        self._event = threading.Event()
+        self._response: Optional[GateResponse] = None
+        self._error: Optional[BaseException] = None
+        self._callbacks: List = []
+        self._cb_lock = threading.Lock()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def error(self) -> Optional[BaseException]:
+        return self._error if self._event.is_set() else None
+
+    def result(self, timeout: Optional[float] = None) -> GateResponse:
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"gateway request {self.request_id} still pending after "
+                f"{timeout}s"
+            )
+        if self._error is not None:
+            raise self._error
+        return self._response
+
+    def add_done_callback(self, fn) -> None:
+        with self._cb_lock:
+            if not self._event.is_set():
+                self._callbacks.append(fn)
+                return
+        fn(self)
+
+    def _set(self, response, error) -> None:
+        with self._cb_lock:
+            if self._event.is_set():
+                return  # resolves exactly once; a loser cannot overwrite
+            self._response, self._error = response, error
+            self._event.set()
+            callbacks, self._callbacks = self._callbacks, []
+        for fn in callbacks:
+            fn(self)
+
+
+class _GateRequest:
+    __slots__ = (
+        "id", "tenant", "features", "deadline", "queue_deadline", "future",
+        "t_submit", "digest", "entry", "pool_retries",
+    )
+
+    def __init__(self, request_id, tenant, features, deadline,
+                 queue_deadline):
+        self.id = request_id
+        self.tenant = tenant
+        self.features = features
+        self.deadline = deadline  # monotonic, end-to-end
+        self.queue_deadline = queue_deadline  # monotonic, tier budget
+        self.future = GateFuture(request_id)
+        self.t_submit = time.monotonic()
+        self.digest: Optional[bytes] = None
+        self.entry: Optional["_CoalesceEntry"] = None  # led by this request
+        self.pool_retries = 0
+
+
+class _Tenant:
+    """Runtime state for one binding: token bucket + circuit + counters."""
+
+    __slots__ = (
+        "binding", "scope", "tier", "tokens", "burst", "rate",
+        "last_refill", "consecutive_failures", "suspended_until",
+        "counters",
+    )
+
+    def __init__(self, binding: TenantBinding, scope: str, rate: float,
+                 burst: float):
+        self.binding = binding
+        self.scope = scope
+        self.tier = binding.tier
+        self.rate = rate
+        self.burst = burst
+        self.tokens = burst  # a fresh tenant may burst immediately
+        self.last_refill = time.monotonic()
+        self.consecutive_failures = 0
+        self.suspended_until = 0.0
+        self.counters: Dict[str, int] = {}
+
+
+class _CoalesceEntry:
+    """One in-flight dispatch that identical observations may ride."""
+
+    __slots__ = ("digest", "leader", "followers", "epoch", "resolved")
+
+    def __init__(self, digest: bytes, leader: _GateRequest, epoch: int):
+        self.digest = digest
+        self.leader = leader
+        self.followers: List[_GateRequest] = []
+        self.epoch = epoch
+        self.resolved = False
+
+
+class _Pool:
+    """Per-pool dispatch state: strict-priority queues + coalesce map."""
+
+    __slots__ = (
+        "name", "router", "queues", "cond", "coalesce", "swap_epoch",
+        "thread", "last_sweep",
+    )
+
+    def __init__(self, name: str, router: FleetRouter):
+        self.name = name
+        self.router = router
+        self.queues: Dict[str, deque] = {tier: deque() for tier in TIERS}
+        self.cond = threading.Condition()
+        self.coalesce: Dict[bytes, _CoalesceEntry] = {}
+        self.swap_epoch = 0
+        self.thread: Optional[threading.Thread] = None
+        self.last_sweep = 0.0
+
+    def depth(self) -> int:
+        return sum(len(q) for q in self.queues.values())
+
+
+def observation_digest(arrays: Mapping[str, np.ndarray]) -> bytes:
+    """Content hash over the PACKED feature bytes (key, dtype, shape,
+    buffer) — two requests coalesce iff this matches, which is the
+    bitwise-identical-observation contract."""
+    h = hashlib.blake2b(digest_size=16)
+    for key in sorted(arrays):
+        value = arrays[key]
+        h.update(key.encode())
+        h.update(str(value.dtype).encode())
+        h.update(str(value.shape).encode())
+        h.update(np.ascontiguousarray(value).tobytes())
+    return h.digest()
+
+
+# Dispatcher tick: the upper bound on how stale an expiry sweep can be,
+# and the idle wait quantum (a queued request never waits longer than
+# this past its budget to resolve typed).
+_SWEEP_INTERVAL_S = 0.025
+
+
+class Gateway:
+    """Multi-tenant admission control over one or more FleetRouter pools.
+
+    `pools` is a mapping {name: started FleetRouter} (or one router,
+    bound as "default"); the gateway does not own the routers unless
+    stop(stop_pools=True). Constructor args override the `T2R_GATE_*`
+    flag defaults (the PolicyServer convention). `tier_queue_budget_ms`
+    bounds per-tier queue wait ({tier: ms}; absent/None = the request's
+    own deadline). `seed` drives the saturation-backoff schedule —
+    gateway pacing under a fixed fault plan is reproducible.
+    """
+
+    def __init__(
+        self,
+        pools,
+        bindings: Sequence[TenantBinding],
+        *,
+        max_queue: Optional[int] = None,
+        coalesce: Optional[bool] = None,
+        default_deadline_ms: Optional[int] = None,
+        quota_rps: Optional[float] = None,
+        burst: Optional[int] = None,
+        circuit_threshold: Optional[int] = None,
+        circuit_cooloff_ms: Optional[float] = None,
+        tier_queue_budget_ms: Optional[Mapping[str, float]] = None,
+        dispatch_backoff_ms: float = 5.0,
+        seed: int = 0,
+    ):
+        if isinstance(pools, FleetRouter):
+            pools = {"default": pools}
+        if not pools:
+            raise ValueError("a gateway needs at least one pool")
+        self._pools: Dict[str, _Pool] = {
+            name: _Pool(name, router) for name, router in pools.items()
+        }
+        self._max_queue = (
+            max_queue if max_queue is not None
+            else t2r_flags.get_int("T2R_GATE_MAX_QUEUE")
+        )
+        self._coalesce_enabled = (
+            coalesce if coalesce is not None
+            else t2r_flags.get_bool("T2R_GATE_COALESCE")
+        )
+        self._default_deadline_s = (
+            default_deadline_ms if default_deadline_ms is not None
+            else t2r_flags.get_int("T2R_GATE_DEADLINE_MS")
+        ) / 1e3
+        default_rate = (
+            quota_rps if quota_rps is not None
+            else float(t2r_flags.get_int("T2R_GATE_QUOTA_RPS"))
+        )
+        default_burst = (
+            burst if burst is not None
+            else t2r_flags.get_int("T2R_GATE_BURST")
+        )
+        self._circuit_threshold = (
+            circuit_threshold if circuit_threshold is not None
+            else t2r_flags.get_int("T2R_GATE_CIRCUIT_THRESHOLD")
+        )
+        self._circuit_cooloff_s = (
+            circuit_cooloff_ms if circuit_cooloff_ms is not None
+            else t2r_flags.get_int("T2R_GATE_CIRCUIT_COOLOFF_MS")
+        ) / 1e3
+        self._tier_budget_s: Dict[str, Optional[float]] = {
+            tier: None for tier in TIERS
+        }
+        for tier, budget_ms in (tier_queue_budget_ms or {}).items():
+            if tier not in _TIER_RANK:
+                raise ValueError(
+                    f"unknown tier {tier!r} in tier_queue_budget_ms "
+                    f"(tiers: {', '.join(TIERS)})"
+                )
+            self._tier_budget_s[tier] = (
+                None if budget_ms is None else budget_ms / 1e3
+            )
+        self._dispatch_backoff_ms = dispatch_backoff_ms
+        self._seed = seed
+
+        # Reentrant: admission counts failures while holding the state
+        # lock (the router's convention).
+        self._lock = threading.RLock()
+        self._tenants: Dict[str, _Tenant] = {}
+        for i, binding in enumerate(bindings):
+            if binding.tier not in _TIER_RANK:
+                raise ValueError(
+                    f"tenant {binding.tenant!r}: unknown tier "
+                    f"{binding.tier!r} (tiers: {', '.join(TIERS)})"
+                )
+            if binding.pool not in self._pools:
+                raise ValueError(
+                    f"tenant {binding.tenant!r}: unknown pool "
+                    f"{binding.pool!r} (pools: {', '.join(self._pools)})"
+                )
+            if binding.tenant in self._tenants:
+                raise ValueError(
+                    f"tenant {binding.tenant!r} bound twice"
+                )
+            self._tenants[binding.tenant] = _Tenant(
+                binding,
+                scope=binding.scope if binding.scope else f"t{i}",
+                rate=(
+                    binding.quota_rps if binding.quota_rps is not None
+                    else default_rate
+                ),
+                burst=float(
+                    binding.burst if binding.burst is not None
+                    else default_burst
+                ),
+            )
+        if not self._tenants:
+            raise ValueError("a gateway needs at least one tenant binding")
+
+        self._counters: Dict[str, int] = {}
+        self._latencies: deque = deque(maxlen=4096)
+        self._ids = itertools.count(1)
+        self._started = False
+        self._closed = False
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> "Gateway":
+        if self._started:
+            raise RuntimeError("Gateway.start() called twice")
+        self._started = True
+        for pool in self._pools.values():
+            pool.thread = threading.Thread(
+                target=self._dispatch_loop,
+                args=(pool,),
+                name=f"t2r-gate-dispatch-{pool.name}",
+                daemon=True,
+            )
+            pool.thread.start()
+        return self
+
+    def stop(self, stop_pools: bool = False, timeout_s: float = 10.0) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        for pool in self._pools.values():
+            orphans: List[_GateRequest] = []
+            with pool.cond:
+                for q in pool.queues.values():
+                    orphans.extend(q)
+                    q.clear()
+                # Riders of entries still in the map (their leaders may
+                # be in flight); queued leaders' riders — including
+                # riders of SHADOWED entries no longer in the map — are
+                # taken via _take_fanout below, off request.entry.
+                for entry in pool.coalesce.values():
+                    if not entry.resolved:
+                        orphans.extend(entry.followers)
+                        entry.followers = []
+                pool.coalesce.clear()
+                pool.cond.notify_all()
+            error = GatewayClosed("gateway stopped with request queued")
+            for request in orphans:
+                for member in [request] + self._take_fanout(pool, request):
+                    member.future._set(None, error)
+        for pool in self._pools.values():
+            if pool.thread is not None:
+                pool.thread.join(timeout=timeout_s)
+        if stop_pools:
+            for pool in self._pools.values():
+                pool.router.stop()
+
+    def __enter__(self) -> "Gateway":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- admission ------------------------------------------------------------
+
+    def submit(
+        self,
+        tenant: str,
+        features: Mapping[str, Any],
+        deadline_ms: Optional[float] = None,
+    ) -> GateFuture:
+        """Admits one request for `tenant`. Typed admission failures
+        (UnknownTenant / TenantSuspended / TenantThrottled / TierShed /
+        GatewayClosed) raise synchronously; everything after admission
+        resolves through the returned future, exactly once, always."""
+        if not self._started or self._closed:
+            raise GatewayClosed("gateway is not running")
+        state = self._tenants.get(tenant)
+        if state is None:
+            raise UnknownTenant(
+                f"no binding for tenant {tenant!r} "
+                f"(known: {sorted(self._tenants)})"
+            )
+        self._count("submitted")
+        self._tcount(state, "submitted")
+        # Chaos admission site, scoped to THIS tenant (t<i>): `raise`/
+        # `flake` propagate as injected admission faults; `drop` sheds
+        # the admission typed; `delay` models a slow front door.
+        fault = chaos.maybe_fire("admit", scope=state.scope)
+        if fault is not None and fault.action in ("drop", "corrupt"):
+            self._count("chaos_admit_drops")
+            self._tcount(state, "shed")
+            raise TierShed(
+                f"tenant {tenant!r} admission dropped by chaos plan "
+                f"({fault.describe()})",
+                tier=state.tier,
+            )
+        now = time.monotonic()
+        with self._lock:
+            if now < state.suspended_until:
+                self._count("suspended")
+                self._tcount(state, "suspended")
+                raise TenantSuspended(
+                    f"tenant {tenant!r} circuit open for another "
+                    f"{(state.suspended_until - now) * 1e3:.0f}ms after "
+                    f"{state.consecutive_failures} consecutive failures"
+                )
+            # Token bucket: continuous refill, one token per admission.
+            state.tokens = min(
+                state.burst,
+                state.tokens + (now - state.last_refill) * state.rate,
+            )
+            state.last_refill = now
+            if state.tokens < 1.0:
+                self._count("throttled")
+                self._tcount(state, "throttled")
+                raise TenantThrottled(
+                    f"tenant {tenant!r} over quota "
+                    f"({state.rate:g} req/s, burst {state.burst:g})"
+                )
+            state.tokens -= 1.0
+        arrays = {k: np.asarray(v) for k, v in features.items()}
+        deadline = now + (
+            deadline_ms / 1e3 if deadline_ms is not None
+            else (
+                state.binding.deadline_ms / 1e3
+                if state.binding.deadline_ms is not None
+                else self._default_deadline_s
+            )
+        )
+        budget = self._tier_budget_s.get(state.tier)
+        queue_deadline = deadline if budget is None else min(
+            deadline, now + budget
+        )
+        request = _GateRequest(
+            next(self._ids), state, arrays, deadline, queue_deadline
+        )
+        pool = self._pools[state.binding.pool]
+        if self._coalesce_enabled:
+            request.digest = observation_digest(arrays)
+            if self._try_join(pool, request):
+                return request.future
+        self._enqueue(pool, request)
+        return request.future
+
+    def call(
+        self,
+        tenant: str,
+        features: Mapping[str, Any],
+        deadline_ms: Optional[float] = None,
+        timeout: Optional[float] = None,
+    ) -> GateResponse:
+        future = self.submit(tenant, features, deadline_ms=deadline_ms)
+        if timeout is None:
+            timeout = (
+                deadline_ms / 1e3 if deadline_ms is not None
+                else self._default_deadline_s
+            ) + 30.0
+        return future.result(timeout)
+
+    def _joinable(self, pool: _Pool, request: _GateRequest) -> bool:
+        """Called under pool.cond. Joinable = same digest, same swap
+        epoch (never across a model-version flip), not yet resolved —
+        AND the leader must not drag the rider down: a rider never
+        joins a LOWER-priority leader (whose shed/starvation fate it
+        would inherit — priority inversion), and never a leader whose
+        deadline outlives its own (the dispatch carries the LEADER's
+        budget, so the rider would be served past its deadline)."""
+        entry = pool.coalesce.get(request.digest)
+        return (
+            entry is not None
+            and not entry.resolved
+            and entry.epoch == pool.swap_epoch
+            and _TIER_RANK[entry.leader.tenant.tier]
+            <= _TIER_RANK[request.tenant.tier]
+            and entry.leader.deadline <= request.deadline
+        )
+
+    def _try_join(self, pool: _Pool, request: _GateRequest) -> bool:
+        """Rides an open coalesce entry for an identical observation
+        (see _joinable for the exact contract)."""
+        with pool.cond:
+            joinable = self._joinable(pool, request)
+        if not joinable:
+            return False
+        # The chaos hook may sleep; fire it outside the pool lock and
+        # re-verify the entry afterwards (the leader may have resolved
+        # or a swap may have flipped the epoch mid-hook).
+        fault = chaos.maybe_fire("coalesce", scope=request.tenant.scope)
+        if fault is not None and fault.action in ("drop", "corrupt"):
+            self._count("chaos_coalesce_bypass")
+            return False
+        with pool.cond:
+            if not self._joinable(pool, request):
+                return False
+            pool.coalesce[request.digest].followers.append(request)
+        self._count("coalesced_joins")
+        self._tcount(request.tenant, "coalesced")
+        return True
+
+    def _enqueue(self, pool: _Pool, request: _GateRequest) -> None:
+        tier = request.tenant.tier
+        victim: Optional[_GateRequest] = None
+        with pool.cond:
+            if pool.depth() >= self._max_queue:
+                victim = self._pick_shed_victim(pool, tier)
+                if victim is None:
+                    # Every queued entry outranks the incoming tier:
+                    # reject the newcomer, never a higher tier.
+                    self._count("shed_queue")
+                    self._count(f"shed_queue_{tier}")
+                    self._tcount(request.tenant, "shed")
+                    raise TierShed(
+                        f"gateway queue full ({self._max_queue}) with no "
+                        f"{tier}-or-lower entry to shed; request rejected",
+                        tier=tier,
+                    )
+            if self._coalesce_enabled and request.digest is not None:
+                request.entry = _CoalesceEntry(
+                    request.digest, request, pool.swap_epoch
+                )
+                # May shadow a stale (older-epoch / chaos-bypassed)
+                # entry; that entry stays reachable through ITS leader's
+                # request.entry, so its riders still resolve with it.
+                pool.coalesce[request.digest] = request.entry
+            pool.queues[tier].append(request)
+            self._count("admitted")
+            self._tcount(request.tenant, "admitted")
+            pool.cond.notify()
+        if victim is not None:
+            self._resolve_shed(pool, victim)
+
+    def _pick_shed_victim(
+        self, pool: _Pool, incoming_tier: str
+    ) -> Optional[_GateRequest]:
+        """Oldest entry of the lowest-priority non-empty tier, provided
+        the incoming tier does not rank below it (called under the pool
+        cond)."""
+        for tier in reversed(TIERS):
+            q = pool.queues[tier]
+            if not q:
+                continue
+            if _TIER_RANK[incoming_tier] > _TIER_RANK[tier]:
+                return None
+            return q.popleft()
+        return None
+
+    def _resolve_shed(self, pool: _Pool, victim: _GateRequest) -> None:
+        tier = victim.tenant.tier
+        self._count("shed_queue")
+        self._count(f"shed_queue_{tier}")
+        self._tcount(victim.tenant, "shed")
+        error = TierShed(
+            f"request {victim.id} ({tier}) shed by the strict-priority "
+            "overload policy",
+            tier=tier,
+        )
+        self._resolve_failure(pool, victim, error, count_circuit=True)
+
+    # -- dispatch -------------------------------------------------------------
+
+    def _dispatch_loop(self, pool: _Pool) -> None:
+        """Pops the highest-priority live request and hands it to the
+        pool's router with its REMAINING deadline. Saturation backs off
+        on the seeded schedule (strict priority means nothing else
+        could dispatch either); expired entries across all tiers are
+        swept typed at least every _SWEEP_INTERVAL_S."""
+        backoff = Backoff(
+            base_ms=self._dispatch_backoff_ms, cap_ms=100.0,
+            seed=self._seed ^ zlib.crc32(pool.name.encode()),
+        )
+        saturated_attempts = 0
+        while True:
+            expired: List[Tuple[_GateRequest, str]] = []
+            request: Optional[_GateRequest] = None
+            with pool.cond:
+                while True:
+                    if self._closed:
+                        # A request this thread held in hand during
+                        # stop() may have been requeued AFTER stop's
+                        # drain; sweep the leftovers — fanning out each
+                        # one's coalesce riders too — so every future
+                        # still resolves (GateFuture is resolve-once,
+                        # so double-draining is harmless).
+                        leftovers = [
+                            r for q in pool.queues.values() for r in q
+                        ]
+                        for q in pool.queues.values():
+                            q.clear()
+                        closed_err = GatewayClosed(
+                            "gateway stopped with request queued"
+                        )
+                        for r in leftovers:
+                            for member in [r] + self._take_fanout(pool, r):
+                                member.future._set(None, closed_err)
+                        return
+                    now = time.monotonic()
+                    if now - pool.last_sweep >= _SWEEP_INTERVAL_S:
+                        pool.last_sweep = now
+                        expired = self._sweep_expired_locked(pool, now)
+                        if expired:
+                            break
+                    request = self._pop_live_locked(pool, now, expired)
+                    if request is not None or expired:
+                        break
+                    pool.cond.wait(timeout=_SWEEP_INTERVAL_S)
+            for victim, reason in expired:
+                self._resolve_expired(pool, victim, reason)
+            if request is None:
+                continue
+            remaining_ms = (request.deadline - time.monotonic()) * 1e3
+            try:
+                router_future = pool.router.submit(
+                    request.features, deadline_ms=remaining_ms
+                )
+            except RouterClosed:
+                self._resolve_failure(
+                    pool, request,
+                    GatewayClosed(
+                        f"pool {pool.name!r} router closed under request "
+                        f"{request.id}"
+                    ),
+                    # A closing router is infrastructure, not tenant
+                    # behavior: don't feed the circuit for it.
+                    count_circuit=False,
+                )
+                continue
+            except FleetError:
+                # Saturated / no replica: requeue at the FRONT of its
+                # tier (order preserved) and back off on the seeded
+                # schedule — strict priority means no other queued
+                # request could dispatch either. The sweep keeps
+                # resolving expiries while we wait.
+                saturated_attempts += 1
+                self._count("dispatch_saturated")
+                with pool.cond:
+                    pool.queues[request.tenant.tier].appendleft(request)
+                    delay = backoff.delay_s(min(saturated_attempts, 6))
+                    pool.cond.wait(timeout=min(delay, _SWEEP_INTERVAL_S * 4))
+                continue
+            saturated_attempts = 0
+            self._count("dispatched")
+            router_future.add_done_callback(
+                lambda rf, pool=pool, request=request:
+                self._on_pool_done(pool, request, rf)
+            )
+
+    def _pop_live_locked(
+        self, pool: _Pool, now: float,
+        expired: List[Tuple[_GateRequest, str]],
+    ) -> Optional[_GateRequest]:
+        """Highest-priority non-expired head (expired heads are shunted
+        to the expiry list typed, never dispatched)."""
+        for tier in TIERS:
+            q = pool.queues[tier]
+            while q:
+                request = q.popleft()
+                if now >= request.deadline:
+                    expired.append((request, "deadline"))
+                    continue
+                if now >= request.queue_deadline:
+                    expired.append((request, "queue_budget"))
+                    continue
+                return request
+        return None
+
+    def _sweep_expired_locked(
+        self, pool: _Pool, now: float
+    ) -> List[Tuple[_GateRequest, str]]:
+        """Removes every expired entry from every tier queue (called
+        under the pool cond; resolution happens outside it). Without
+        this, a bronze request starved by strict priority would only
+        resolve when popped — potentially never under sustained gold
+        load."""
+        expired: List[Tuple[_GateRequest, str]] = []
+        for tier in TIERS:
+            q = pool.queues[tier]
+            if not q:
+                continue
+            survivors = deque()
+            for request in q:
+                if now >= request.deadline:
+                    expired.append((request, "deadline"))
+                elif now >= request.queue_deadline:
+                    expired.append((request, "queue_budget"))
+                else:
+                    survivors.append(request)
+            pool.queues[tier] = survivors
+        return expired
+
+    def _resolve_expired(
+        self, pool: _Pool, request: _GateRequest, reason: str
+    ) -> None:
+        tier = request.tenant.tier
+        self._count("expired_in_queue")
+        self._count(f"expired_in_queue_{tier}")
+        self._tcount(request.tenant, "shed")
+        waited_ms = (time.monotonic() - request.t_submit) * 1e3
+        self._resolve_failure(
+            pool, request,
+            GateDeadline(
+                f"request {request.id} ({tier}) expired in the gateway "
+                f"queue after {waited_ms:.0f}ms ({reason})",
+                reason=reason,
+            ),
+            count_circuit=True,
+        )
+
+    # -- completion -----------------------------------------------------------
+
+    def _take_fanout(
+        self, pool: _Pool, request: _GateRequest
+    ) -> List[_GateRequest]:
+        """Atomically closes the entry this request leads and returns
+        its riders (empty for non-leaders). Works off request.entry, not
+        the map alone: a shadowed (stale-epoch) entry must still fan its
+        riders out when its own leader resolves."""
+        entry = request.entry
+        if entry is None:
+            return []
+        with pool.cond:
+            entry.resolved = True
+            if pool.coalesce.get(entry.digest) is entry:
+                del pool.coalesce[entry.digest]
+            followers, entry.followers = entry.followers, []
+            return followers
+
+    # A pool-side abandonment that is congestion, not a verdict: the
+    # router exhausted ITS budget (retries against a dying/saturated
+    # pool), but the request still holds end-to-end deadline — the
+    # front door re-queues it (front of its tier) and lets capacity
+    # recover (respawn, scale-up) instead of surfacing a kill-window
+    # blip to a gold tenant. Bounded per request; 'deadline' reasons are
+    # final.
+    _MAX_POOL_RETRIES = 3
+
+    def _retryable(self, request: _GateRequest, error: BaseException) -> bool:
+        if self._closed or request.pool_retries >= self._MAX_POOL_RETRIES:
+            return False
+        if time.monotonic() >= min(request.deadline, request.queue_deadline):
+            return False
+        return (
+            isinstance(error, RequestAbandoned)
+            and error.reason != "deadline"
+        )
+
+    def _on_pool_done(self, pool: _Pool, request: _GateRequest, rf) -> None:
+        error = rf.error()
+        if error is not None:
+            if self._retryable(request, error):
+                # The closed re-check rides INSIDE the pool cond: stop()
+                # flips _closed before it drains the queues under this
+                # same cond, so a requeue that observed _closed False
+                # here is guaranteed to be swept by stop's drain — it
+                # can never strand a future in a queue nobody reads.
+                requeued = False
+                with pool.cond:
+                    if not self._closed:
+                        request.pool_retries += 1
+                        pool.queues[request.tenant.tier].appendleft(request)
+                        pool.cond.notify()
+                        requeued = True
+                if requeued:
+                    self._count("pool_retries")
+                    self._tcount(request.tenant, "pool_retries")
+                    return
+            self._resolve_failure(pool, request, error, count_circuit=True)
+            return
+        response = rf.result(0)
+        riders = self._take_fanout(pool, request)
+        now = time.monotonic()
+        for member, coalesced in [(request, False)] + [
+            (r, True) for r in riders
+        ]:
+            state = member.tenant
+            with self._lock:
+                state.consecutive_failures = 0
+                self._latencies.append((now - member.t_submit) * 1e3)
+            self._count("completed")
+            self._tcount(state, "completed")
+            if coalesced:
+                self._count("coalesced_served")
+            spans = dict(response.spans)
+            spans["gateway_ms"] = (now - member.t_submit) * 1e3
+            member.future._set(
+                GateResponse(
+                    response.outputs, response.model_version, spans,
+                    state.binding.tenant, state.tier, pool.name,
+                    response.replica, response.attempts, response.hedged,
+                    coalesced,
+                ),
+                None,
+            )
+
+    def _resolve_failure(
+        self, pool: _Pool, request: _GateRequest, error: BaseException,
+        count_circuit: bool,
+    ) -> None:
+        """Fails a request (and any coalesce riders) typed. When
+        `count_circuit`, the failure feeds the LEADER tenant's circuit
+        breaker — every post-admission failure counts (pool-side error,
+        queue shed, queue expiry): deliberate overload backpressure."""
+        riders = self._take_fanout(pool, request)
+        for member in [request] + riders:
+            state = member.tenant
+            self._count("failed")
+            self._count(f"failed_{type(error).__name__}")
+            self._tcount(state, "failed")
+            # Only the LEADER's tenant feeds the circuit breaker: a
+            # rider failing because of its leader's fate is not
+            # evidence about the rider's own traffic.
+            if count_circuit and member is request:
+                self._note_tenant_failure(state)
+            member.future._set(None, error)
+
+    def _note_tenant_failure(self, state: _Tenant) -> None:
+        with self._lock:
+            state.consecutive_failures += 1
+            if (
+                state.consecutive_failures >= self._circuit_threshold
+                and time.monotonic() >= state.suspended_until
+            ):
+                state.suspended_until = (
+                    time.monotonic() + self._circuit_cooloff_s
+                )
+                state.consecutive_failures = 0
+                self._count("circuit_opens")
+                self._tcount(state, "circuit_opens")
+                _log.warning(
+                    "tenant %r circuit opened for %.0fms",
+                    state.binding.tenant, self._circuit_cooloff_s * 1e3,
+                )
+
+    # -- fleet operations -----------------------------------------------------
+
+    def rolling_swap(
+        self, pool: str = "default", swap_timeout_s: float = 60.0
+    ) -> Dict:
+        """Publishes the newest export through `pool` via the router's
+        zero-downtime roll. The pool's swap epoch bumps FIRST, so no
+        request admitted after the publish began can ride a dispatch
+        from before it (the coalesce version-flip guard)."""
+        state = self._pools[pool]
+        with state.cond:
+            state.swap_epoch += 1
+        self._count("rolling_swaps")
+        return state.router.rolling_swap(swap_timeout_s=swap_timeout_s)
+
+    # -- introspection --------------------------------------------------------
+
+    def _count(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+
+    def _tcount(self, state: _Tenant, name: str, n: int = 1) -> None:
+        with self._lock:
+            state.counters[name] = state.counters.get(name, 0) + n
+
+    def tenant_scope(self, tenant: str) -> str:
+        """The chaos call-site scope (`t<i>`) assigned to a tenant."""
+        return self._tenants[tenant].scope
+
+    def snapshot(self) -> Dict:
+        now = time.monotonic()
+        with self._lock:
+            counters = dict(self._counters)
+            latencies = sorted(self._latencies)
+            tenants = {
+                name: {
+                    "tier": state.tier,
+                    "scope": state.scope,
+                    "quota_rps": state.rate,
+                    "burst": state.burst,
+                    # Effective tokens NOW (refill is lazy at admission;
+                    # reporting the stored value would show a bucket
+                    # frozen at its last submit).
+                    "tokens": round(
+                        min(
+                            state.burst,
+                            state.tokens
+                            + (now - state.last_refill) * state.rate,
+                        ),
+                        3,
+                    ),
+                    "circuit_open": time.monotonic() < state.suspended_until,
+                    "counters": dict(state.counters),
+                }
+                for name, state in self._tenants.items()
+            }
+        pools = {}
+        for name, pool in self._pools.items():
+            with pool.cond:
+                pools[name] = {
+                    "queue_depth": {
+                        tier: len(q) for tier, q in pool.queues.items()
+                    },
+                    "coalesce_open": len(pool.coalesce),
+                    "swap_epoch": pool.swap_epoch,
+                }
+        return {
+            "counters": counters,
+            "latency_ms": {
+                "p50": round(percentile(latencies, 0.50), 3),
+                "p99": round(percentile(latencies, 0.99), 3),
+                "window": len(latencies),
+            },
+            "tenants": tenants,
+            "pools": pools,
+            "policy": {
+                "max_queue": self._max_queue,
+                "coalesce": self._coalesce_enabled,
+                "default_deadline_ms": self._default_deadline_s * 1e3,
+                "circuit_threshold": self._circuit_threshold,
+                "circuit_cooloff_ms": self._circuit_cooloff_s * 1e3,
+                "tier_queue_budget_ms": {
+                    tier: (None if s is None else s * 1e3)
+                    for tier, s in self._tier_budget_s.items()
+                },
+                "tiers": list(TIERS),
+            },
+        }

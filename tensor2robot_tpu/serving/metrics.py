@@ -68,6 +68,7 @@ class ServerMetrics:
             "shed": 0,
             "rejected": 0,
             "deadline_missed": 0,
+            "deadline_dropped": 0,
             "hot_swaps": 0,
             "batches": 0,
         }

@@ -58,7 +58,7 @@ from tensor2robot_tpu import flags as t2r_flags
 from tensor2robot_tpu.serving import transport
 from tensor2robot_tpu.serving.metrics import percentile
 from tensor2robot_tpu.serving.replica import ReplicaSpec, replica_main
-from tensor2robot_tpu.utils.backoff import Backoff
+from tensor2robot_tpu.utils.backoff import Backoff, poll_loop
 from tensor2robot_tpu.utils.errors import best_effort
 
 _log = logging.getLogger(__name__)
@@ -106,9 +106,12 @@ class RouterClosed(FleetError):
     """The router stopped before the request completed."""
 
 
-# Replica lifecycle states.
-_STARTING, _UP, _SUSPECT, _BROKEN, _DEAD = (
-    "starting", "up", "suspect", "broken", "dead",
+# Replica lifecycle states. `draining` is the scale-down limbo: unrouted
+# (only `up` replicas take traffic) but alive until its in-flight
+# requests finish — the state the autoscaler parks a replica in so
+# retiring capacity never kills a request.
+_STARTING, _UP, _SUSPECT, _BROKEN, _DEAD, _DRAINING = (
+    "starting", "up", "suspect", "broken", "dead", "draining",
 )
 
 
@@ -200,7 +203,7 @@ class _Replica:
     __slots__ = (
         "index", "spec", "proc", "request_q", "state", "inflight",
         "consecutive_failures", "broken_until", "version", "last_health",
-        "last_health_time", "respawns", "started_at",
+        "last_health_time", "respawns", "started_at", "retired",
     )
 
     def __init__(self, index: int, spec: ReplicaSpec):
@@ -217,6 +220,7 @@ class _Replica:
         self.last_health_time = 0.0
         self.respawns = 0
         self.started_at = 0.0
+        self.retired = False  # scale-down: exits are expected, no respawn
 
 
 class _RouterMetrics:
@@ -384,17 +388,19 @@ class FleetRouter:
             thread = threading.Thread(target=target, name=name, daemon=True)
             thread.start()
             self._threads.append(thread)
-        deadline = time.monotonic() + timeout_s
-        while time.monotonic() < deadline:
+        def bring_up_settled() -> bool:
             with self._lock:
-                if any(r.state == _UP for r in self._replicas):
-                    return self
-                if all(
+                return any(r.state == _UP for r in self._replicas) or all(
                     r.state == _DEAD and r.respawns >= self._max_respawns
                     for r in self._replicas
-                ):
-                    break
-            time.sleep(0.02)
+                )
+
+        Backoff(base_ms=20.0, cap_ms=60.0, factor=1.0, seed=0).poll(
+            bring_up_settled, total_s=timeout_s
+        )
+        with self._lock:
+            if any(r.state == _UP for r in self._replicas):
+                return self
         self.stop()
         raise RuntimeError(
             f"no replica became healthy within {timeout_s}s"
@@ -748,12 +754,16 @@ class FleetRouter:
 
     def _on_replica_death(self, replica: _Replica) -> None:
         """Process gone: fail its in-flight attempts over to siblings,
-        then respawn (bounded)."""
+        then respawn (bounded). A RETIRED replica's exit is the expected
+        end of a drain — counted separately, never respawned."""
         with self._lock:
             if replica.state == _DEAD:
                 return
             replica.state = _DEAD
-            self._metrics.count("replica_deaths")
+            if replica.retired:
+                self._metrics.count("retired_exits")
+            else:
+                self._metrics.count("replica_deaths")
             orphans = list(replica.inflight)
             replica.inflight = set()
             requests = []
@@ -763,10 +773,11 @@ class FleetRouter:
                     continue
                 request.live.discard((attempt, replica.index))
                 requests.append(request)
-        _log.warning(
-            "replica %d died with %d in-flight request(s); failing over",
-            replica.index, len(orphans),
-        )
+        if orphans or not replica.retired:
+            _log.warning(
+                "replica %d died with %d in-flight request(s); failing over",
+                replica.index, len(orphans),
+            )
         for request in requests:
             self._on_attempt_failure(
                 request, replica.index, "replica process died"
@@ -775,6 +786,7 @@ class FleetRouter:
             can_respawn = (
                 self._respawn
                 and not self._closed
+                and not replica.retired
                 and replica.respawns < self._max_respawns
             )
             if can_respawn:
@@ -935,13 +947,15 @@ class FleetRouter:
             )
             self._timer_cond.notify()
 
+    @poll_loop
     def _monitor_loop(self) -> None:
         while not self._closed:
             time.sleep(self._probe_interval_s)
             if self._closed:
                 return
             now = time.monotonic()
-            for replica in self._replicas:
+            # Copy: the autoscaler may append replicas mid-iteration.
+            for replica in list(self._replicas):
                 proc = replica.proc
                 if proc is not None and not proc.is_alive():
                     self._on_replica_death(replica)
@@ -999,6 +1013,89 @@ class FleetRouter:
                         proc.kill()
 
     # -- fleet operations ------------------------------------------------------
+
+    def add_replica(self, spec: Optional[ReplicaSpec] = None) -> int:
+        """Grows the pool by one replica (the autoscaler's scale-up
+        primitive): appends a fresh _Replica on the next index and
+        spawns it — it joins routing when it reports started. `spec`
+        defaults to the first construction spec (the homogeneous-pool
+        case). Returns the new replica's index."""
+        if not self._started:
+            raise RuntimeError("add_replica() before start()")
+        with self._lock:
+            if self._closed:
+                raise RouterClosed("router is not running")
+            replica = _Replica(
+                len(self._replicas), spec if spec is not None else self._specs[0]
+            )
+            self._replicas.append(replica)
+            self._metrics.count("scale_ups")
+            self._spawn(replica)
+            return replica.index
+
+    def retire_replica(
+        self, index: int, drain_timeout_s: float = 30.0
+    ) -> bool:
+        """Shrinks the pool by draining replica `index` (the autoscaler's
+        scale-down primitive): the replica leaves the routing set
+        immediately (state `draining`), keeps serving its in-flight
+        requests to completion, and only then is told to stop — the
+        rolling-swap discipline applied to capacity, so retiring never
+        kills a request. Returns False (and restores the replica to
+        routing) if the drain does not empty within the timeout."""
+        with self._lock:
+            replica = self._replicas[index]
+            if replica.state not in (_UP, _SUSPECT, _BROKEN):
+                return False
+            prior_state = replica.state
+            replica.state = _DRAINING
+            replica.retired = True
+            self._metrics.count("retirements")
+
+        def drained() -> bool:
+            with self._lock:
+                return not replica.inflight or self._closed
+
+        Backoff(base_ms=10.0, cap_ms=50.0, factor=1.0, seed=index).poll(
+            drained, total_s=drain_timeout_s
+        )
+        with self._lock:
+            if replica.inflight and not self._closed:
+                # Drain stalled: put the replica back rather than kill
+                # its in-flight work. The caller may retry later.
+                replica.state = prior_state
+                replica.retired = False
+                self._metrics.count("retirement_aborts")
+                return False
+        best_effort(replica.request_q.put, ("stop",))
+        return True
+
+    def load(self) -> Dict:
+        """The autoscaler's signal: live capacity and how full it is.
+        `utilization` is in-flight work over routable capacity
+        (up-replicas x max_inflight); `shed_saturated` is cumulative —
+        scalers diff it across ticks to see overload the in-flight
+        gauge already shed."""
+        with self._lock:
+            up = [r for r in self._replicas if r.state == _UP]
+            pending = [
+                r for r in self._replicas
+                if r.state in (_STARTING, _SUSPECT, _BROKEN)
+                and not r.retired
+            ]
+            draining = [r for r in self._replicas if r.state == _DRAINING]
+            inflight = sum(len(r.inflight) for r in up)
+        counters = self._metrics.snapshot()["counters"]
+        capacity = len(up) * self._max_inflight
+        return {
+            "replicas_up": len(up),
+            "replicas_pending": len(pending),
+            "replicas_draining": len(draining),
+            "inflight": inflight,
+            "capacity": capacity,
+            "utilization": (inflight / capacity) if capacity else 1.0,
+            "shed_saturated": counters.get("shed_saturated", 0),
+        }
 
     def rolling_swap(self, swap_timeout_s: float = 60.0) -> Dict:
         """Hot-swaps every live replica to the newest export, one at a

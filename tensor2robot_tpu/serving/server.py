@@ -526,9 +526,34 @@ class PolicyServer:
                     self._cond.wait(timeout=remaining)
                     if not self._queue:
                         break  # everything shed while we slept
+                # Micro-batch formation: a request whose deadline passed
+                # while queued must not occupy a batch slot — it would
+                # both burn compute (the router's backstop already
+                # resolved its client future) and displace a LIVE
+                # batchmate into the next dispatch cycle. Dropped typed
+                # and counted (deadline_dropped) right here.
                 batch: List[_Request] = []
+                expired: List[_Request] = []
+                now = time.monotonic()
                 while self._queue and len(batch) < max_bucket:
-                    batch.append(self._queue.popleft())
+                    request = self._queue.popleft()
+                    if request.deadline < now:
+                        expired.append(request)
+                    else:
+                        batch.append(request)
+            for request in expired:
+                # deadline_missed stays the aggregate expiry counter
+                # (either enforcement point); deadline_dropped attributes
+                # the formation-time drops specifically.
+                self._metrics.count("deadline_missed")
+                self._metrics.count("deadline_dropped")
+                request.future._set_error(
+                    DeadlineExceeded(
+                        f"request {request.id} dropped at batch formation "
+                        f"{(now - request.deadline) * 1e3:.1f}ms past its "
+                        "deadline"
+                    )
+                )
             if not batch:
                 continue
             try:

@@ -19,7 +19,15 @@ A plan is a semicolon-separated list of clauses:
   * `action`  — what happens (see table).
   * `scope`   — optional; when set, the clause is inert unless the
                 process declared the same scope via `set_scope()`
-                (replica processes declare `r<index>`).
+                (replica processes declare `r<index>`, replay shards
+                `s<k>`) OR the production hook passed the same
+                call-site scope via `maybe_fire(site, scope=...)` —
+                the multi-tenant gateway passes tenant scopes `t<i>`,
+                so one clause can target ONE tenant's admissions in a
+                process shared by every tenant. Call-scoped clauses
+                count occurrences PER SCOPE: `t1/admit:3:raise` fires
+                at tenant t1's third admission, not the process's
+                third.
 
 Actions:
 
@@ -317,7 +325,11 @@ def fired() -> List[str]:
         return list(_fired)
 
 
-def maybe_fire(site: str, peer: Optional[str] = None) -> Optional[Clause]:
+def maybe_fire(
+    site: str,
+    peer: Optional[str] = None,
+    scope: Optional[str] = None,
+) -> Optional[Clause]:
     """Production hook: bumps the site counter and fires any matching
     clause. Returns the fired Clause for caller-applied actions
     (`corrupt`, `drop`, `partition`), after sleeping for
@@ -329,6 +341,14 @@ def maybe_fire(site: str, peer: Optional[str] = None) -> Optional[Clause]:
     match when the peer is in their list; every other action ignores
     it.
 
+    `scope` names a CALL-SITE scope for sites shared by many logical
+    actors in one process — the gateway passes the tenant scope
+    (`t<i>`) at its `admit`/`coalesce` sites. A clause whose scope
+    equals the call scope matches against a per-(site, scope)
+    occurrence counter, so `t1/admit:3:raise` means tenant t1's third
+    admission; unscoped clauses and clauses matching the PROCESS scope
+    keep counting process-wide visits exactly as before.
+
     Sleeps and kills happen OUTSIDE the module lock: a hung site must
     not serialize other threads' (non-firing) hooks behind it.
     """
@@ -338,17 +358,30 @@ def maybe_fire(site: str, peer: Optional[str] = None) -> Optional[Clause]:
             return None
         count = _counters.get(site, 0) + 1
         _counters[site] = count
+        scoped_count: Optional[int] = None
+        if scope is not None:
+            scoped_key = f"{site}@{scope}"
+            scoped_count = _counters.get(scoped_key, 0) + 1
+            _counters[scoped_key] = scoped_count
         hit: Optional[Clause] = None
         for clause in plan:
-            if clause.site != site or not clause.matches(count):
+            if clause.site != site:
                 continue
-            if clause.scope is not None and clause.scope != _scope:
+            if clause.scope is not None and clause.scope == scope:
+                # Call-scoped clause: occurrences count per scope.
+                effective = scoped_count if scoped_count is not None else count
+            elif clause.scope is None or clause.scope == _scope:
+                effective = count
+            else:
+                continue
+            if not clause.matches(effective):
                 continue
             if clause.action == "partition" and (
                 peer is None or peer not in (clause.peers or ())
             ):
                 continue
             hit = clause
+            hit_visit = effective
             description = clause.describe()
             # A partition fires on every matching visit; record it once
             # so the fired log stays bounded and readable.
@@ -370,7 +403,7 @@ def maybe_fire(site: str, peer: Optional[str] = None) -> Optional[Clause]:
         raise ChaosFault(f"injected fault at {hit.describe()}")
     if hit.action == "flake":
         raise ChaosFault(
-            f"injected flake at {hit.describe()} (visit {count} of "
+            f"injected flake at {hit.describe()} (visit {hit_visit} of "
             f"{site}; succeeds from visit "
             f"{hit.occurrence + (hit.flake_n or 0)})"
         )

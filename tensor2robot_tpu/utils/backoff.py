@@ -32,9 +32,24 @@ from __future__ import annotations
 
 import random
 import time
-from typing import Optional
+from typing import Callable, Optional
 
-__all__ = ["Backoff"]
+__all__ = ["Backoff", "poll_loop"]
+
+
+def poll_loop(fn: Callable) -> Callable:
+    """Allowlist marker for a sanctioned FIXED-INTERVAL monitor loop.
+
+    The `sleep-retry-outside-backoff` lint (analysis/lints.py) bans bare
+    `time.sleep` retry/poll loops in serving/ and replay/ — every
+    bounded wait must ride a seeded Backoff schedule with a hard total
+    bound. The exception is a daemon monitor that ticks forever at a
+    fixed cadence by design (a respawn watcher, a queue drain): those
+    declare themselves with this decorator, which makes the exemption
+    grep-able and reviewable instead of implicit.
+    """
+    fn.__poll_loop__ = True
+    return fn
 
 
 class Backoff:
@@ -95,14 +110,17 @@ class Backoff:
 
     # -- the total-time budget -------------------------------------------------
 
-    def start(self) -> "Backoff":
+    def start(self, total_s: Optional[float] = None) -> "Backoff":
         """Arms (or re-arms) the total-time budget for one logical
-        operation. A no-op when total_ms is None."""
-        self._deadline = (
-            time.monotonic() + self.total_ms / 1e3
-            if self.total_ms is not None
-            else None
-        )
+        operation. `total_s` overrides the constructor's total_ms for
+        THIS arming (callers whose bound arrives per call, like a
+        wait_ready timeout). A no-op when neither is set."""
+        if total_s is not None:
+            self._deadline = time.monotonic() + total_s
+        elif self.total_ms is not None:
+            self._deadline = time.monotonic() + self.total_ms / 1e3
+        else:
+            self._deadline = None
         return self
 
     def remaining_s(self) -> float:
@@ -131,3 +149,28 @@ class Backoff:
             return False
         time.sleep(delay)
         return True
+
+    def poll(self, predicate: Callable[[], object],
+             total_s: Optional[float] = None):
+        """Calls `predicate()` on the seeded schedule until it returns a
+        truthy value or the total budget expires; returns the FINAL
+        predicate value (one last call after the schedule refuses, so a
+        condition that lands during the closing delay is not missed).
+        Every poll is bounded by construction: raises ValueError when
+        neither total_ms nor `total_s` supplies a budget — an unbounded
+        predicate wait is exactly the hang this module exists to ban.
+        Poll cadence wants a roughly-fixed interval, so construct with
+        factor=1.0 (jitter alone spreads concurrent pollers)."""
+        self.start(total_s)
+        if self._deadline is None:
+            raise ValueError(
+                "Backoff.poll needs a total budget (total_ms or total_s)"
+            )
+        attempt = 0
+        while True:
+            result = predicate()
+            if result:
+                return result
+            attempt += 1
+            if not self.sleep(attempt):
+                return predicate()

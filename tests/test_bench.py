@@ -484,6 +484,69 @@ def test_bench_fleet_contract(tmp_path):
         assert json_mod.load(f)["metric"] == payload["metric"]
 
 
+def test_bench_gateway_contract(tmp_path):
+    """The multi-tenant front-door leg at toy scale: one JSON line + the
+    --out artifact, per-tenant accounting with ZERO lost requests on
+    every tier, the rogue bronze tenant 100% typed at its quota, the
+    coalescing win with bitwise-equal responses, the SIGKILL + rolling
+    swap surviving, and the autoscaler scale-up/drain-back cycle. The
+    p99-degradation bar is relaxed for CPU-proxy host variance (the
+    committed BENCH_GATE artifact runs the strict default)."""
+    out = str(tmp_path / "gate.json")
+    payload = _run_bench(
+        "gateway",
+        "--trace-secs", "6",
+        "--drain-secs", "4",
+        "--rate-scale", "0.6",
+        "--max-replicas", "4",
+        "--p99-degradation-max", "10",
+        "--out", out,
+        timeout=540,
+    )
+    assert payload["metric"] == "gateway_multitenant_slo_cpu_proxy"
+    assert payload["unit"] == "requests_per_sec"
+    assert payload["value"] > 0
+    assert "error" not in payload
+    assert payload["cpu_proxy"] is True
+    gates = payload["gates"]
+    assert payload["all_green"] is True, gates
+    detail = payload["detail"]
+    for leg_name in ("fault_free", "chaos"):
+        leg = detail[leg_name]
+        # Per-request accounting: every submission resolved, ok or typed.
+        assert leg["lost_total"] == 0, leg_name
+        for tenant, stats in leg["per_tenant"].items():
+            assert stats["lost"] == 0, (leg_name, tenant)
+    chaos_leg = detail["chaos"]
+    # Gold held availability 1.0 through kill + swap + crowd.
+    assert chaos_leg["per_tenant"]["web-gold"]["availability"] == 1.0
+    # The rogue bronze tenant was quota-bound, 100% typed.
+    rogue = chaos_leg["per_tenant"]["rogue-bronze"]
+    assert rogue["shed_at_admission"].get("TenantThrottled", 0) > 0
+    assert rogue["availability"] < 0.5
+    # Coalescing measurably cut dispatches, bitwise-equal responses.
+    assert chaos_leg["per_tenant"]["app-silver-hot"]["coalesced"] > 0
+    assert chaos_leg["gateway_counters"]["coalesced_joins"] > 0
+    assert all(
+        len(v) == 1 for v in chaos_leg["hot_y_groups"].values()
+    )
+    # The kill was real, the fleet recovered, the swap published.
+    assert chaos_leg["killed_pid"]
+    assert chaos_leg["router_counters"]["replica_deaths"] >= 1
+    assert chaos_leg["router_counters"]["respawns"] >= 1
+    assert chaos_leg["swap_result"]["failed"] is None
+    assert max(chaos_leg["versions_observed"]) >= 2
+    # The autoscaler reached the ceiling during the crowd and drained
+    # back without a single aborted retirement.
+    assert chaos_leg["autoscaler"]["peak_replicas_up"] >= 4
+    assert chaos_leg["autoscaler"]["counters"].get("scale_down", 0) >= 1
+    assert chaos_leg["router_counters"].get("retirement_aborts", 0) == 0
+    import json as json_mod
+
+    with open(out) as f:
+        assert json_mod.load(f)["metric"] == payload["metric"]
+
+
 @pytest.mark.slow
 def test_bench_comms_contract(tmp_path):
     """The quantized-collective leg at toy step counts: one JSON line +

@@ -197,6 +197,93 @@ class TestFire:
         ]
 
 
+class TestTenantScopes:
+    """The gateway/autoscaler sites (`admit`/`coalesce`/`scale`) and the
+    per-tenant call-site scopes `t<i>`: one clause targets ONE tenant
+    inside the shared gateway process, counting occurrences per scope."""
+
+    def test_gateway_site_grammar(self):
+        plan = chaos.parse_plan(
+            "t0/admit:3:raise;t2/coalesce:1:drop;scale:2:drop;"
+            "t1/admit:2:delay:50"
+        )
+        assert [c.describe() for c in plan] == [
+            "t0/admit:3:raise",
+            "t2/coalesce:1:drop",
+            "scale:2:drop",
+            "t1/admit:2:delay:50",
+        ]
+        assert plan[0].scope == "t0"
+        assert plan[2].scope is None
+
+    @pytest.mark.parametrize(
+        "bad",
+        [
+            "t0/admit:0:raise",  # occurrences are 1-based
+            "t0/admit:1:throttle",  # unknown action
+            "/admit:1:raise",  # empty scope
+            "t0/admit:1:raise:5",  # raise takes no arg
+            "t0/coalesce:1:drop:x",  # drop takes no arg
+            "scale:1:delay",  # delay needs ms
+        ],
+    )
+    def test_malformed_gateway_plans_fail_loudly(self, bad):
+        with pytest.raises(ValueError):
+            chaos.parse_plan(bad)
+
+    def test_call_scope_counts_per_tenant(self):
+        """t1/admit:2:corrupt fires at tenant t1's SECOND admit — not at
+        the process's second admit overall."""
+        chaos.configure("t1/admit:2:corrupt")
+        assert chaos.maybe_fire("admit", scope="t0") is None
+        assert chaos.maybe_fire("admit", scope="t1") is None  # t1 occ 1
+        assert chaos.maybe_fire("admit", scope="t0") is None
+        hit = chaos.maybe_fire("admit", scope="t1")  # t1 occ 2
+        assert hit is not None and hit.action == "corrupt"
+        assert chaos.maybe_fire("admit", scope="t1") is None  # single-shot
+        assert chaos.counters() == {
+            "admit": 5, "admit@t0": 2, "admit@t1": 3,
+        }
+        assert chaos.fired() == ["t1/admit:2:corrupt"]
+
+    def test_call_scope_does_not_leak_to_other_tenants(self):
+        chaos.configure("t0/coalesce:1:drop")
+        for _ in range(3):
+            assert chaos.maybe_fire("coalesce", scope="t1") is None
+        assert chaos.maybe_fire("coalesce", scope="t0").action == "drop"
+
+    def test_unscoped_clause_counts_process_wide(self):
+        """An unscoped clause on a scoped site fires at the Nth visit
+        across ALL tenants (the pre-existing process-wide semantics)."""
+        chaos.configure("admit:3:corrupt")
+        assert chaos.maybe_fire("admit", scope="t0") is None
+        assert chaos.maybe_fire("admit", scope="t1") is None
+        assert chaos.maybe_fire("admit", scope="t2").action == "corrupt"
+
+    def test_process_scope_still_matches_without_call_scope(self):
+        """Call scopes must not break the replica-style process scope:
+        the scale site in a process declaring no scope matches unscoped
+        clauses; a process-scoped clause still needs set_scope."""
+        chaos.configure("scale:1:drop")
+        assert chaos.maybe_fire("scale").action == "drop"
+        chaos.configure("r1/scale:1:drop")
+        assert chaos.maybe_fire("scale") is None
+        chaos.configure("r1/scale:1:drop")
+        chaos.set_scope("r1")
+        assert chaos.maybe_fire("scale").action == "drop"
+
+    def test_scoped_flake_recovers_per_tenant(self):
+        """flake:N against a tenant scope fails that tenant's first N
+        visits from the start point and then clears — the retry-recovery
+        fixture, per tenant."""
+        chaos.configure("t0/admit:1:flake:2")
+        for _ in range(2):
+            with pytest.raises(chaos.ChaosFault):
+                chaos.maybe_fire("admit", scope="t0")
+            assert chaos.maybe_fire("admit", scope="t1") is None
+        assert chaos.maybe_fire("admit", scope="t0") is None  # recovered
+
+
 class TestKill:
     def test_kill_is_a_real_sigkill(self, tmp_path):
         """The kill action must be an uncatchable SIGKILL — no atexit, no

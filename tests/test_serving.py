@@ -397,6 +397,45 @@ class TestBackpressure:
             assert future.result(30)
         server.stop()
 
+    def test_expired_in_queue_dropped_at_formation_without_compute(
+        self, export_root
+    ):
+        """Induced queue delay: requests whose deadlines pass while
+        queued behind a pinned batch must be dropped typed at
+        micro-batch formation (deadline_dropped) WITHOUT reaching the
+        predictor or occupying batch slots — an expired entry would
+        both burn compute and displace a live batchmate."""
+        inner = ExportedSavedModelPredictor(export_dir=export_root)
+        assert inner.restore()
+        gated = _GatedPredictor(inner)
+        server = PolicyServer(
+            gated, batch_buckets=(1, 2, 4), max_queue=16, max_wait_ms=0
+        )
+        server.start(prewarm=False)
+        first = server.submit(_example(), deadline_ms=30000)
+        assert gated.entered.wait(10)
+        # Two short-deadline requests expire while queued; a long-
+        # deadline sibling queued BEHIND them must still be served in
+        # the next batch (the corpses must not consume its slots).
+        doomed = [
+            server.submit(_example(seed), deadline_ms=80) for seed in (1, 2)
+        ]
+        live = server.submit(_example(3), deadline_ms=30000)
+        time.sleep(0.25)
+        gated.release.set()
+        for future in doomed:
+            with pytest.raises(DeadlineExceeded, match="batch formation"):
+                future.result(30)
+        assert first.result(30).outputs
+        assert live.result(30).outputs
+        snap = server.snapshot()
+        assert snap["counters"]["deadline_dropped"] == 2
+        assert snap["counters"]["completed"] == 2
+        # The predictor served exactly two batches of one live request
+        # each — the expired pair never reached compute.
+        assert gated.batch_sizes == [1, 1]
+        server.stop()
+
     def test_shed_oldest_policy_fails_oldest(self, export_root):
         server, gated, first, queued = self._gated_server(
             export_root, "shed_oldest"

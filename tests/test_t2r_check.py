@@ -291,6 +291,126 @@ class TestLintsCatch:
         assert "env-unknown-flag" not in clean
         assert "env-undeclared" not in clean
 
+    def test_gate_flags_covered_by_registry_lint(self):
+        """The round-14 multi-tenant gateway flags (T2R_GATE_*) ride the
+        same rails: raw environ reads are env-undeclared, wrong-kind
+        getter reads are env-kind-mismatch, declared spellings clean."""
+        for name in (
+            "T2R_GATE_QUOTA_RPS", "T2R_GATE_BURST", "T2R_GATE_MAX_QUEUE",
+            "T2R_GATE_COALESCE", "T2R_GATE_DEADLINE_MS",
+            "T2R_GATE_CIRCUIT_THRESHOLD", "T2R_GATE_CIRCUIT_COOLOFF_MS",
+        ):
+            assert "env-undeclared" in self._rules(
+                f"import os\nx = os.environ.get({name!r})\n"
+            ), name
+        assert "env-kind-mismatch" in self._rules(
+            "from tensor2robot_tpu import flags\n"
+            "x = flags.get_bool('T2R_GATE_QUOTA_RPS')\n"
+        )
+        assert "env-kind-mismatch" in self._rules(
+            "from tensor2robot_tpu import flags\n"
+            "x = flags.get_int('T2R_GATE_COALESCE')\n"
+        )
+        clean = self._rules(
+            "from tensor2robot_tpu import flags\n"
+            "a = flags.get_int('T2R_GATE_QUOTA_RPS')\n"
+            "b = flags.get_int('T2R_GATE_BURST')\n"
+            "c = flags.get_int('T2R_GATE_MAX_QUEUE')\n"
+            "d = flags.get_bool('T2R_GATE_COALESCE')\n"
+            "e = flags.get_int('T2R_GATE_DEADLINE_MS')\n"
+            "f = flags.get_int('T2R_GATE_CIRCUIT_THRESHOLD')\n"
+            "g = flags.get_int('T2R_GATE_CIRCUIT_COOLOFF_MS')\n"
+        )
+        assert "env-kind-mismatch" not in clean
+        assert "env-unknown-flag" not in clean
+        assert "env-undeclared" not in clean
+
+    def _sleep_rules(self, source, path="tensor2robot_tpu/serving/x.py"):
+        return {d.rule for d in lint_source(source, path)}
+
+    def test_bare_sleep_retry_loop_flagged_in_serving_and_replay(self):
+        source = (
+            "import time\n"
+            "def wait_ready(self):\n"
+            "    while True:\n"
+            "        time.sleep(0.05)\n"
+        )
+        for path in (
+            "tensor2robot_tpu/serving/x.py",
+            "tensor2robot_tpu/replay/y.py",
+        ):
+            assert "sleep-retry-outside-backoff" in self._sleep_rules(
+                source, path
+            ), path
+        # `from time import sleep` is the same hand-rolled cadence.
+        assert "sleep-retry-outside-backoff" in self._sleep_rules(
+            "from time import sleep\n"
+            "def poll(self):\n"
+            "    for _ in range(9):\n"
+            "        sleep(0.1)\n"
+        )
+
+    def test_poll_loop_decorator_allowlists_fixed_interval_monitor(self):
+        assert "sleep-retry-outside-backoff" not in self._sleep_rules(
+            "import time\n"
+            "from tensor2robot_tpu.utils.backoff import poll_loop\n"
+            "@poll_loop\n"
+            "def _monitor_loop(self):\n"
+            "    while True:\n"
+            "        time.sleep(0.05)\n"
+        )
+
+    def test_computed_delay_and_outside_scope_sleep_clean(self):
+        # A schedule-driven delay (backoff.delay_s) is the sanctioned
+        # spelling; a literal sleep OUTSIDE a loop is not a poll; and
+        # the rule is scoped to serving/ + replay/ only.
+        clean = (
+            "import time\n"
+            "def retry(self, backoff, attempt):\n"
+            "    while True:\n"
+            "        time.sleep(backoff.delay_s(attempt))\n"
+            "def one_shot(self):\n"
+            "    time.sleep(0.5)\n"
+        )
+        assert "sleep-retry-outside-backoff" not in self._sleep_rules(clean)
+        looped = (
+            "import time\n"
+            "def wait(self):\n"
+            "    while True:\n"
+            "        time.sleep(0.05)\n"
+        )
+        assert "sleep-retry-outside-backoff" not in self._sleep_rules(
+            looped, "tensor2robot_tpu/train/x.py"
+        )
+
+    def test_nested_def_inside_loop_not_a_poll(self):
+        """A sleep inside a function merely DEFINED within a loop runs
+        once per call, not per iteration — out of scope."""
+        assert "sleep-retry-outside-backoff" not in self._sleep_rules(
+            "import time\n"
+            "def outer(self):\n"
+            "    while True:\n"
+            "        def once():\n"
+            "            time.sleep(0.2)\n"
+            "        once()\n"
+            "        break\n"
+        )
+
+    def test_shipped_serving_and_replay_sleep_clean(self):
+        """The sweep landed: the live serving/ and replay/ trees carry
+        no bare constant-interval sleep loops outside @poll_loop."""
+        from tensor2robot_tpu.analysis.lints import lint_paths
+
+        diagnostics = [
+            d
+            for d in lint_paths(
+                ["tensor2robot_tpu/serving", "tensor2robot_tpu/replay"],
+                root=_REPO,
+            )
+            if d.rule == "sleep-retry-outside-backoff"
+        ]
+        assert diagnostics == []
+
     def test_numpy_in_jit_decorated(self):
         rules = self._rules(
             "import jax\nimport numpy as np\n"

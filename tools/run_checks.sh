@@ -85,6 +85,18 @@ if [ "$TESTS" = 1 ]; then
     status=1
   fi
 
+  echo "== gateway: multi-tenant front door + autoscaler suite (tier-1, seeded) =="
+  # Admission quotas (typed throttle), gold/silver/bronze strict-priority
+  # shedding, per-tier queue budgets, identical-observation coalescing
+  # with the version-flip guard, per-tenant circuit breaking, chaos
+  # admit/coalesce/scale sites with t<i> tenant scopes, and the
+  # autoscaler watermark/hysteresis/cooloff cycle with drain-safe
+  # scale-down (zero in-flight killed).
+  if ! JAX_PLATFORMS=cpu python -m pytest tests/test_gateway.py \
+      -q -m 'not slow' -p no:cacheprovider; then
+    status=1
+  fi
+
   echo "== replay: online-loop durability + seeded chaos suite (tier-1) =="
   # Segment durability (CRC + seal manifests, counted loss, quarantine),
   # FIFO/prioritized sampling determinism, service SIGKILL/respawn with
