@@ -2067,6 +2067,376 @@ def bench_serve(args) -> None:
         _fail("bench_serve", err, metric=metric)
 
 
+def _aot_scrubbed_env(serve_aot: bool, cache_dir=None, platform=None) -> dict:
+    """Child-boot environment: ambient AOT/cache flags scrubbed so each
+    twin measures exactly its own tier (a leaked T2R_COMPILE_CACHE_DIR
+    would silently turn the fresh-compile twin into the cache twin).
+    `platform` pins the child to the PARENT's backend — the fixture's
+    executables are topology-keyed, so a child on a different platform
+    would measure the fallback path, not the AOT tier."""
+    import os
+
+    env = dict(os.environ)
+    # Every serving flag the child resolves is scrubbed: a leaked bucket
+    # ladder or quant regime would change what the twins boot (and fail
+    # the acceptance gates) as surely as a leaked cache dir would.
+    for key in (
+        "T2R_SERVE_AOT", "T2R_AOT_REQUIRE", "T2R_COMPILE_CACHE_DIR",
+        "T2R_SERVE_BUCKETS", "T2R_SERVE_QUANT",
+    ):
+        env.pop(key, None)
+    env["T2R_SERVE_AOT"] = "1" if serve_aot else "0"
+    if cache_dir:
+        env["T2R_COMPILE_CACHE_DIR"] = str(cache_dir)
+    if platform:
+        env["JAX_PLATFORMS"] = str(platform)
+    else:
+        env.setdefault("JAX_PLATFORMS", "cpu")
+    return env
+
+
+def _aot_boot_child(args) -> None:
+    """Hidden `bench.py aot --_boot` mode: ONE fresh process = one cold
+    replica boot. Measures restore -> full-prewarm server start -> first
+    reply against whatever restore tier the environment selects (the
+    parent sets T2R_SERVE_AOT / T2R_COMPILE_CACHE_DIR), and reports the
+    audit surface (prewarm sources, aot counters, fresh_trace_calls) the
+    acceptance gates read. Out-of-process on purpose: jax's in-memory
+    executable caches would otherwise let the second twin ride the
+    first's compiles."""
+    import os
+
+    import jax
+
+    jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+    import numpy as np
+
+    from tensor2robot_tpu.predictors.exported_savedmodel_predictor import (
+        ExportedSavedModelPredictor,
+    )
+    from tensor2robot_tpu.serving import PolicyServer
+    from tensor2robot_tpu import flags as t2r_flags
+    from tensor2robot_tpu.specs import flatten_spec_structure, make_random_numpy
+
+    cache_dir = t2r_flags.get_str("T2R_COMPILE_CACHE_DIR")
+    cache_before = (
+        len(os.listdir(cache_dir))
+        if cache_dir and os.path.isdir(cache_dir)
+        else 0
+    )
+    t0 = time.monotonic()
+    predictor = ExportedSavedModelPredictor(export_dir=args.export_root)
+    if not predictor.restore():
+        raise RuntimeError("aot boot child: restore failed")
+    t_restored = time.monotonic()
+    server = PolicyServer(predictor, max_wait_ms=1).start(prewarm=True)
+    t_started = time.monotonic()
+    spec = predictor.get_feature_specification()
+    row = {
+        key: np.asarray(value)[0]
+        for key, value in flatten_spec_structure(
+            make_random_numpy(spec, batch_size=1, seed=0)
+        ).items()
+    }
+    response = server.call(row, deadline_ms=120000, timeout=120)
+    t_first_reply = time.monotonic()
+    snap = server.snapshot()
+    server.stop()
+    loaded = predictor.loaded_model
+    report = {
+        "restore_s": round(t_restored - t0, 4),
+        "server_start_s": round(t_started - t_restored, 4),
+        "first_reply_ms": round((t_first_reply - t_started) * 1e3, 3),
+        "cold_start_s": round(t_first_reply - t0, 4),
+        "prewarm_source": snap["prewarm_source"],
+        "aot_hits": snap["counters"]["aot_hits"],
+        "aot_misses": snap["counters"]["aot_misses"],
+        "aot_fallbacks": snap.get("aot_fallbacks", {}),
+        "fresh_trace_calls": getattr(loaded, "fresh_trace_calls", None),
+        "model_version": response.model_version,
+        "cache_entries_added": (
+            len(os.listdir(cache_dir)) - cache_before
+            if cache_dir and os.path.isdir(cache_dir)
+            else 0
+        ),
+    }
+    with open(args.json_out, "w") as f:
+        json.dump(report, f)
+
+
+def bench_aot(args) -> None:
+    """Instant-deploy leg (`python bench.py aot`): cold-start-to-first-
+    reply and rolling-swap behavior with serialized AOT executables vs
+    the persistent-cache and fresh-compile tiers (docs/SERVING.md "AOT
+    executables").
+
+    Three out-of-process boot twins over the SAME exported artifact:
+    `fresh` (T2R_SERVE_AOT=0, no cache), `cache` (T2R_SERVE_AOT=0 +
+    T2R_COMPILE_CACHE_DIR; booted twice, the second boot is the
+    steady-state measurement), and `aot` (deserialize per bucket).
+    Acceptance: the AOT boot performs ZERO fresh bucket compiles
+    (prewarm_source all "aot", fresh_trace_calls == 0, no misses) and
+    its cold start is strictly below the fresh twin's. The in-process
+    half measures the publish->swap cycle: hot-swap latency (swap
+    request -> new version serving, prewarm included) with AOT vs with
+    the compile path, under open-loop load with zero failed requests.
+    """
+    import os
+    import subprocess
+
+    if getattr(args, "boot", False):
+        _aot_boot_child(args)
+        return
+    try:
+        devices, backend_note = _init_devices(
+            max_wait=_backend_wait(metric="serve_cold_start_aot_speedup")
+        )
+    except Exception as err:
+        _fail("backend_init", err, metric="serve_cold_start_aot_speedup")
+    on_tpu = devices[0].platform == "tpu"
+    metric = (
+        "serve_cold_start_aot_speedup"
+        if on_tpu
+        else "serve_cold_start_aot_speedup_cpu_proxy"
+    )
+
+    import numpy as np
+
+    try:
+        from tensor2robot_tpu import flags as t2r_flags
+        from tensor2robot_tpu.serving import PolicyServer
+        from tensor2robot_tpu.serving.metrics import percentile
+
+        buckets = tuple(int(b) for b in args.buckets.split(","))
+        # The fixture export carries AOT executables (T2R_AOT_EXPORT
+        # default); the same artifact serves every twin — only the
+        # restore tier differs.
+        tmpdir, export_root, predictor, compiled, state, exporter = (
+            _serve_fixture(buckets)
+        )
+        with open(
+            os.path.join(
+                _latest_export_dir_for(export_root), "t2r_metadata.json"
+            )
+        ) as f:
+            export_meta = json.load(f)
+        if "aot" not in export_meta:
+            raise RuntimeError(
+                "fixture export carries no AOT block; cannot measure "
+                f"the AOT tier ({export_meta.get('stablehlo_error')})"
+            )
+
+        def run_boot(mode, serve_aot, cache_dir=None):
+            out_path = os.path.join(tmpdir.name, f"boot_{mode}.json")
+            cmd = [
+                sys.executable, os.path.abspath(__file__), "aot", "--_boot",
+                "--export-root", export_root, "--json-out", out_path,
+            ]
+            proc = subprocess.run(
+                cmd,
+                env=_aot_scrubbed_env(
+                    serve_aot, cache_dir, platform=devices[0].platform
+                ),
+                capture_output=True, text=True, timeout=420,
+            )
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"boot twin {mode!r} failed rc={proc.returncode}: "
+                    + "\n".join((proc.stderr or "").splitlines()[-5:])
+                )
+            with open(out_path) as f:
+                report = json.load(f)
+            report["mode"] = mode
+            return report
+
+        # The cache twin's dir lives under the fixture tmpdir so the
+        # one cleanup() reaps it, success or failure.
+        cache_dir = os.path.join(tmpdir.name, "cache")
+        os.makedirs(cache_dir, exist_ok=True)
+        boots = {}
+        boots["fresh"] = run_boot("fresh", serve_aot=False)
+        boots["cache_first"] = run_boot(
+            "cache_first", serve_aot=False, cache_dir=cache_dir
+        )
+        boots["cache"] = run_boot("cache", serve_aot=False, cache_dir=cache_dir)
+        boots["aot"] = run_boot("aot", serve_aot=True)
+
+        # -- the publish->swap half (in-process): hot-swap latency with
+        # the incoming version prewarmed from AOT vs from compiles, under
+        # open-loop load. Swap latency = swap request -> new version
+        # serving (restore + per-bucket prewarm + atomic flip).
+        def swap_leg(serve_aot: bool, step: int):
+            saved = t2r_flags.read_raw("T2R_SERVE_AOT")
+            t2r_flags.write_env("T2R_SERVE_AOT", serve_aot)
+            try:
+                server = PolicyServer(
+                    predictor, max_wait_ms=2, max_queue=4096
+                )
+                server.start(prewarm=True)
+                rng = np.random.RandomState(step)
+
+                def request_fn():
+                    return {
+                        "x": rng.uniform(-1, 1, size=(3,)).astype(np.float32)
+                    }
+
+                v_before = predictor.model_version
+                timings = {}
+
+                def do_swap():
+                    t_swap0 = time.monotonic()
+                    exporter.maybe_export(
+                        step=step, state=state,
+                        eval_metrics={"loss": 1.0 / step},
+                        compiled=compiled, model_dir=tmpdir.name,
+                    )
+                    timings["export_s"] = time.monotonic() - t_swap0
+                    t_swap1 = time.monotonic()
+                    server.hot_swap()
+                    while (
+                        predictor.model_version == v_before
+                        and time.monotonic() - t_swap1 < 120
+                    ):
+                        time.sleep(0.005)
+                    timings["swap_latency_s"] = time.monotonic() - t_swap1
+
+                def swap_fn():
+                    import threading
+
+                    thread = threading.Thread(target=do_swap, daemon=True)
+                    thread.start()
+                    timings["thread"] = thread
+
+                swap_at = args.leg_secs * 0.3
+                leg = _serve_open_loop(
+                    server, request_fn, rate_hz=args.swap_rate_hz,
+                    duration_s=args.leg_secs, deadline_ms=8000.0,
+                    seed=step, swap_at_s=swap_at, swap_fn=swap_fn,
+                )
+                timings["thread"].join(timeout=180)
+                server.stop()
+                by_offset = leg.pop("latencies_by_offset")
+                post = sorted(
+                    latency
+                    for offset, latency in by_offset
+                    if swap_at <= offset < swap_at + 2.0
+                )
+                return {
+                    "tier": "aot" if serve_aot else "compile",
+                    "swap_latency_s": round(
+                        timings.get("swap_latency_s", float("nan")), 4
+                    ),
+                    "export_s": round(timings.get("export_s", 0.0), 4),
+                    "failed_requests": sum(leg["errors"].values()),
+                    "completed": leg["completed"],
+                    "version_before": v_before,
+                    "version_after": predictor.model_version,
+                    "p99_post_swap_ms": round(percentile(post, 0.99), 3),
+                    "blip_max_ms_2s_after_swap": round(
+                        max(post), 3
+                    ) if post else 0.0,
+                }
+            finally:
+                t2r_flags.restore_env("T2R_SERVE_AOT", saved)
+
+        swap_aot = swap_leg(serve_aot=True, step=2)
+        swap_compile = swap_leg(serve_aot=False, step=3)
+
+        aot_boot, fresh_boot = boots["aot"], boots["fresh"]
+        acceptance = {
+            # Zero fresh bucket compiles on the AOT-hit boot: every
+            # bucket prewarmed from a deserialized executable, the
+            # stablehlo trace path never dispatched, nothing fell back.
+            "aot_zero_fresh_compiles": (
+                aot_boot["fresh_trace_calls"] == 0
+                and aot_boot["aot_misses"] == 0
+                and set(aot_boot["prewarm_source"].values()) == {"aot"}
+                and len(aot_boot["prewarm_source"]) == len(buckets)
+            ),
+            # Deserialize beats compile on the same artifact + host.
+            "aot_cold_start_below_fresh": (
+                aot_boot["cold_start_s"] < fresh_boot["cold_start_s"]
+            ),
+            # The cache tier still holds its PR 7 contract: the second
+            # cached boot adds no persistent-cache entries.
+            "cache_second_boot_adds_no_entries": (
+                boots["cache"]["cache_entries_added"] == 0
+            ),
+            # Swaps stay zero-downtime in both tiers.
+            "swap_zero_failed_requests": (
+                swap_aot["failed_requests"] == 0
+                and swap_compile["failed_requests"] == 0
+            ),
+            "swap_versions_advanced": (
+                swap_aot["version_after"] > swap_aot["version_before"]
+                and swap_compile["version_after"]
+                > swap_compile["version_before"]
+            ),
+        }
+        speedup = fresh_boot["cold_start_s"] / max(
+            aot_boot["cold_start_s"], 1e-9
+        )
+        tmpdir.cleanup()
+        payload = {
+            "metric": metric,
+            "value": round(speedup, 3),
+            "unit": "x_cold_start_speedup",
+            # Target: an AOT boot at least matches the fresh twin; the
+            # real bar is the strict acceptance block below.
+            "vs_baseline": round(speedup, 4),
+            "detail": {
+                "boots": boots,
+                "cold_start_s": {
+                    mode: boots[mode]["cold_start_s"] for mode in boots
+                },
+                "aot_vs_fresh_cold_start_x": round(speedup, 3),
+                "aot_vs_cache_cold_start_x": round(
+                    boots["cache"]["cold_start_s"]
+                    / max(aot_boot["cold_start_s"], 1e-9),
+                    3,
+                ),
+                "rolling_swap": {"aot": swap_aot, "compile": swap_compile},
+                "swap_latency_aot_vs_compile_x": round(
+                    swap_compile["swap_latency_s"]
+                    / max(swap_aot["swap_latency_s"], 1e-9),
+                    3,
+                ),
+                "acceptance": acceptance,
+                "buckets": list(buckets),
+                "aot_artifact_nbytes": export_meta["aot"]["nbytes"],
+                "aot_topology": export_meta["aot"]["topology"],
+                "host_cpus": os.cpu_count(),
+                "device_kind": getattr(devices[0], "device_kind", "?"),
+                "model": "mock_mlp_3feature",
+                **({"backend_note": backend_note} if backend_note else {}),
+            },
+            **_proxy_fields(on_tpu, "serve_cold_start_aot_speedup"),
+        }
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(payload, f, indent=2, sort_keys=True)
+        _emit(payload)
+        if not all(acceptance.values()):
+            _fail(
+                "aot_acceptance",
+                RuntimeError(f"acceptance failed: {acceptance}"),
+                metric=metric,
+            )
+    except SystemExit:
+        raise
+    except Exception as err:  # noqa: BLE001
+        _fail("bench_aot", err, metric=metric)
+
+
+def _latest_export_dir_for(export_root: str):
+    from tensor2robot_tpu.export.saved_model import latest_export_dir
+
+    path = latest_export_dir(export_root)
+    if path is None:
+        raise RuntimeError(f"no export under {export_root}")
+    return path
+
+
 def bench_fleet(args) -> None:
     """Replica-fleet routing leg (`python bench.py fleet`).
 
@@ -4105,6 +4475,39 @@ def _build_cli():
         help="also write the payload to this file ('' disables; "
              "default %(default)s)",
     )
+    aot = leg(
+        "aot", bench_aot,
+        "instant-deploy leg: cold-start-to-first-reply and rolling-swap "
+        "latency with serialized AOT executables vs the persistent-cache "
+        "and fresh-compile tiers, over the SAME exported artifact; gates "
+        "on zero fresh bucket compiles for the AOT boot "
+        "(docs/SERVING.md \"AOT executables\")",
+    )
+    aot.add_argument(
+        "--buckets", default="1,2,4,8,16,32",
+        help="warmup/bucket ladder exported with the fixture model "
+             "(default %(default)s)",
+    )
+    aot.add_argument(
+        "--leg-secs", type=float, default=6.0,
+        help="duration of each open-loop rolling-swap leg "
+             "(default %(default)s)",
+    )
+    aot.add_argument(
+        "--swap-rate-hz", type=float, default=50.0,
+        help="open-loop request rate during the swap legs "
+             "(default %(default)s)",
+    )
+    aot.add_argument(
+        "--out", default="BENCH_AOT_r15.json",
+        help="also write the payload to this file ('' disables; "
+             "default %(default)s)",
+    )
+    aot.add_argument(
+        "--_boot", dest="boot", action="store_true", help=argparse.SUPPRESS,
+    )
+    aot.add_argument("--export-root", default=None, help=argparse.SUPPRESS)
+    aot.add_argument("--json-out", default=None, help=argparse.SUPPRESS)
     fleet = leg(
         "fleet", bench_fleet,
         "replica-fleet routing leg: closed-loop capacity + open-loop "

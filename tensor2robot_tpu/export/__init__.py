@@ -1,6 +1,12 @@
 """Export layer: SavedModel-equivalent artifacts, serving interfaces,
 train-time export policies."""
 
+from tensor2robot_tpu.export.aot import (
+    AOTCorrupt,
+    AOTError,
+    AOTKeyMismatch,
+    device_topology,
+)
 from tensor2robot_tpu.export.export_generators import (
     AbstractExportGenerator,
     DefaultExportGenerator,

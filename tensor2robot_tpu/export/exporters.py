@@ -91,6 +91,7 @@ class Exporter:
         quant_block: Optional[int] = None,
         quant_min_size: Optional[int] = None,
         quant_parity_tol: Optional[Dict[str, float]] = None,
+        aot_executables: Optional[bool] = None,
     ):
         self.name = name
         self._export_generator = export_generator or DefaultExportGenerator()
@@ -141,6 +142,24 @@ class Exporter:
         self._quant_block = quant_block
         self._quant_min_size = quant_min_size
         self._quant_parity_tol = dict(quant_parity_tol or {})
+        # Serialized AOT executables per warmup bucket (export/aot.py):
+        # None defers to the T2R_AOT_EXPORT flag at export time. An
+        # EXPLICIT request without a warmup ladder is a config error —
+        # there is no bucket contract to compile against — and must
+        # fail here, not silently produce artifacts with no aot/ dir.
+        if aot_executables and not self._warmup_batch_sizes:
+            raise ValueError(
+                "aot_executables=True needs warmup_batch_sizes: the "
+                "warmup ladder is the set of batch shapes the AOT "
+                "executables are compiled for."
+            )
+        if aot_executables and not serialize_stablehlo:
+            raise ValueError(
+                "aot_executables=True requires serialize_stablehlo=True: "
+                "each executable is compiled from the serialized serving "
+                "program so AOT boots serve bit-identically to fresh ones."
+            )
+        self._aot_executables = aot_executables
 
     def export_root(self, model_dir: str) -> str:
         return os.path.join(model_dir, "export", self.name)
@@ -222,6 +241,7 @@ class Exporter:
             serve_quant_fns=serve_quant_fns,
             quant_parity_tol=self._quant_parity_tol,
             calibration_batches=warmup_batches,
+            aot_executables=self._aot_executables,
         )
         if warmup_batches:
             generator.write_warmup_requests(warmup_batches, path)
@@ -289,6 +309,7 @@ def create_default_exporters(
     quantize_bits: int = 8,
     serve_quant: Sequence[str] = (),
     quant_parity_tol: Optional[Dict[str, float]] = None,
+    aot_executables: Optional[bool] = None,
 ) -> List[Exporter]:
     """latest + best exporter pair (reference create_default_exporters,
     train_eval.py:295-385; one artifact serves both the numpy and tf.Example
@@ -306,6 +327,7 @@ def create_default_exporters(
             quantize_bits=quantize_bits,
             serve_quant=serve_quant,
             quant_parity_tol=quant_parity_tol,
+            aot_executables=aot_executables,
         ),
         BestExporter(
             name="best",
@@ -318,5 +340,6 @@ def create_default_exporters(
             quantize_bits=quantize_bits,
             serve_quant=serve_quant,
             quant_parity_tol=quant_parity_tol,
+            aot_executables=aot_executables,
         ),
     ]

@@ -32,6 +32,13 @@ re-applies the identical wire format to exported params:
 Regime names are the collective registry's ("fp16", "int8"); "none"
 never reaches this module — the unquantized path is untouched byte for
 byte.
+
+AOT interplay (export/aot.py): each regime's payload-as-arguments
+serving program also gets per-warmup-bucket serialized executables in
+the artifact's `aot/` dir, fingerprinted over the program bytes PLUS
+the quantized payload bytes — a regime restore deserializes instead of
+compiling, and a payload swapped under an executable can never pass the
+key check.
 """
 
 from __future__ import annotations

@@ -106,6 +106,27 @@ def _declare(
 # reviewers check this table against docs/static_analysis.md.
 
 _declare(
+    "T2R_AOT_EXPORT",
+    _BOOL,
+    True,
+    "Export-side AOT executables: serialize one compiled executable per "
+    "warmup bucket (per serve-quant regime too) into the export dir's "
+    "aot/, keyed on artifact fingerprint + device topology "
+    "(export/aot.py). 0 writes artifacts without aot/ (the pre-AOT "
+    "layout).",
+    "tensor2robot_tpu/export/saved_model.py",
+)
+_declare(
+    "T2R_AOT_REQUIRE",
+    _BOOL,
+    False,
+    "Strict AOT boots: a restore that cannot deserialize an AOT "
+    "executable for EVERY warmup bucket fails loudly instead of falling "
+    "back to the compile tiers — for fleets where a deploy-time compile "
+    "is an SLO violation, not a slow path.",
+    "tensor2robot_tpu/export/saved_model.py",
+)
+_declare(
     "T2R_CHAOS",
     _STR,
     None,
@@ -392,6 +413,16 @@ _declare(
     "fabric the sharded bench runs on.",
     "tensor2robot_tpu/replay/service.py",
     choices=("queue", "socket"),
+)
+_declare(
+    "T2R_SERVE_AOT",
+    _BOOL,
+    True,
+    "Restore-side AOT executables: resolve each warmup bucket from the "
+    "artifact's aot/ dir (deserialize instead of compile) with a LOUD, "
+    "counted fallback to persistent-cache/fresh-trace on any key "
+    "mismatch. 0 reproduces the pre-AOT restore path byte for byte.",
+    "tensor2robot_tpu/export/saved_model.py",
 )
 _declare(
     "T2R_SERVE_BUCKETS",

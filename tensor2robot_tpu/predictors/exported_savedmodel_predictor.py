@@ -137,6 +137,16 @@ class ExportedSavedModelPredictor(AbstractPredictor):
                     # (reference retry behavior :330-345).
                     loaded = None
                 if loaded is not None:
+                    # Persistent-compile-cache engagement per incoming
+                    # version, BEFORE its prewarm compiles — skipped
+                    # entirely when AOT executables cover every warmup
+                    # bucket (this version will never compile, so the
+                    # cache round-trip is pure overhead).
+                    from tensor2robot_tpu.serving.compile_cache import (
+                        enable_compile_cache_for,
+                    )
+
+                    enable_compile_cache_for(loaded)
                     # Configuration errors (no StableHLO and no model code)
                     # are permanent: propagate instead of burning the timeout.
                     predict_fn = self._build_predict_fn(loaded)

@@ -56,6 +56,7 @@ _EXPORTS = {
     "mock_server_factory": "replica",
     # compile_cache.py — persistent XLA compile cache for replicas.
     "enable_compile_cache": "compile_cache",
+    "enable_compile_cache_for": "compile_cache",
     # gateway.py — the multi-tenant front door over router pools.
     "Gateway": "gateway",
     "TenantBinding": "gateway",
@@ -99,6 +100,7 @@ if TYPE_CHECKING:  # pragma: no cover — static analyzers only
     from tensor2robot_tpu.serving.autoscaler import Autoscaler  # noqa: F401
     from tensor2robot_tpu.serving.compile_cache import (  # noqa: F401
         enable_compile_cache,
+        enable_compile_cache_for,
     )
     from tensor2robot_tpu.serving.gateway import (  # noqa: F401
         TIERS,

@@ -269,9 +269,35 @@ class Autoscaler:
 
     def snapshot(self) -> Dict:
         with self._lock:
+            actions = list(self._actions)
+        # Scale-up latency attribution: for every replica this scaler
+        # spawned, the router-measured boot duration and the restore
+        # tier each bucket booted from — the record that says whether a
+        # flash-crowd scale-up paid deserialize-time (AOT) or
+        # compile-time, per replica.
+        spawned = {
+            a["replica"] for a in actions if a["direction"] == "up" and a["ok"]
+        }
+        boots = []
+        if spawned:
+            try:
+                replicas = self._router.snapshot()["replicas"]
+            except Exception:  # router mid-stop; attribution is advisory
+                replicas = []
+            boots = [
+                {
+                    "replica": r["index"],
+                    "boot_ms": r.get("boot_ms"),
+                    "prewarm_source": r.get("prewarm_source"),
+                }
+                for r in replicas
+                if r["index"] in spawned
+            ]
+        with self._lock:
             return {
                 "counters": dict(self._counters),
-                "actions": list(self._actions),
+                "actions": actions,
+                "scale_up_boots": boots,
                 "peak_replicas_up": self._peak_up,
                 "policy": {
                     "min_replicas": self.min_replicas,

@@ -11,9 +11,14 @@ and writes the cache; every later boot (respawns after a chaos kill,
 rolling-deploy restarts, fleet scale-ups on the same host image)
 deserializes instead of compiling.
 
-This is the down payment on the ROADMAP's AOT-serving item: same
-outcome (compile once per artifact, not once per process), without yet
-shipping serialized executables inside the export dir.
+With serialized AOT executables in the artifact (export/aot.py) this
+cache is the SECOND tier of the restore ladder: AOT executable ->
+persistent compile cache -> fresh trace. `enable_compile_cache_for`
+is the restore-time entry point: a version whose warmup ladder is
+fully covered by deserialized executables will never compile, so the
+cache round-trip (config update + latched-state reset) is skipped for
+that swap — re-entering it per swap was pure overhead on AOT-hit
+boots.
 """
 
 from __future__ import annotations
@@ -22,7 +27,7 @@ from typing import Optional
 
 from tensor2robot_tpu import flags as t2r_flags
 
-__all__ = ["enable_compile_cache"]
+__all__ = ["enable_compile_cache", "enable_compile_cache_for"]
 
 
 def enable_compile_cache(cache_dir: Optional[str] = None) -> Optional[str]:
@@ -56,3 +61,33 @@ def enable_compile_cache(cache_dir: Optional[str] = None) -> Optional[str]:
     if reset is not None:
         reset()
     return cache_dir
+
+
+def enable_compile_cache_for(loaded) -> Optional[str]:
+    """Restore-time cache engagement for one loaded export version.
+
+    When the version will serve EVERY bucket of its resolved ladder
+    (T2R_SERVE_BUCKETS override included, `serving/buckets.py`
+    resolution) from deserialized AOT executables, no compile will
+    happen for it — skip the cache round-trip entirely (returns None;
+    an already-enabled cache is left as is, this only skips
+    re-entering). Otherwise behaves exactly like
+    `enable_compile_cache()`: a compile tier is live for this version
+    and the cache must engage BEFORE its first compile (the prewarm
+    that follows restore). A server constructed with an explicit
+    `batch_buckets` ladder is invisible from here; `PolicyServer`
+    re-engages at start() for any bucket outside the AOT table.
+    """
+    if loaded is not None and getattr(loaded, "aot_covered", False):
+        from tensor2robot_tpu.serving import buckets as buckets_lib
+
+        table = getattr(loaded, "aot_executables", None) or {}
+        try:
+            ladder = buckets_lib.resolve_buckets(
+                None, getattr(loaded, "metadata", None) or {}
+            )
+        except ValueError:
+            ladder = ()
+        if ladder and all(bucket in table for bucket in ladder):
+            return None
+    return enable_compile_cache()

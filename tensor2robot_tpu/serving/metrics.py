@@ -71,6 +71,13 @@ class ServerMetrics:
             "deadline_dropped": 0,
             "hot_swaps": 0,
             "batches": 0,
+            # AOT restore accounting: buckets served from a deserialized
+            # executable vs buckets that fell back to a compile tier
+            # while AOT was requested — accumulated across the boot and
+            # every hot-swap, so a fleet can see a deploy that silently
+            # started paying compiles again.
+            "aot_hits": 0,
+            "aot_misses": 0,
         }
         self._batch_slots = 0
         self._batch_real = 0

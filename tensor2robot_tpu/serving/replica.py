@@ -259,14 +259,13 @@ def policy_server_factory(
     from tensor2robot_tpu.predictors.exported_savedmodel_predictor import (
         ExportedSavedModelPredictor,
     )
-    from tensor2robot_tpu.serving.compile_cache import enable_compile_cache
     from tensor2robot_tpu.serving.server import PolicyServer
 
-    # Persistent compilation cache (T2R_COMPILE_CACHE_DIR): a respawned
-    # or rolling-deployed replica deserializes its bucket compiles
-    # instead of repeating them — must engage BEFORE the first compile
-    # (restore/prewarm below).
-    enable_compile_cache()
+    # Persistent compilation cache (T2R_COMPILE_CACHE_DIR): engaged by
+    # the predictor's restore path per incoming version, BEFORE that
+    # version's first compile (enable_compile_cache_for) — and skipped
+    # there when the artifact's AOT executables cover every warmup
+    # bucket, in which case this boot never compiles at all.
     chaos.maybe_fire("restore")
     predictor = ExportedSavedModelPredictor(
         export_dir=export_root, timeout=restore_timeout_s
@@ -404,6 +403,11 @@ class _MockServer:
             "counters": {"completed": completed},
             "queue_depth": self._queue.qsize(),
             "model_version": self.model_version,
+            # Health-snapshot parity with PolicyServer: the fleet's
+            # boot-attribution surface (router/autoscaler snapshots)
+            # reads prewarm_source off every backend; the mock has one
+            # degenerate bucket and nothing to compile.
+            "prewarm_source": {"1": "mock"},
         }
 
     def hot_swap(self, wait: bool = False) -> bool:

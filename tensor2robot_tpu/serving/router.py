@@ -203,7 +203,7 @@ class _Replica:
     __slots__ = (
         "index", "spec", "proc", "request_q", "state", "inflight",
         "consecutive_failures", "broken_until", "version", "last_health",
-        "last_health_time", "respawns", "started_at", "retired",
+        "last_health_time", "respawns", "started_at", "retired", "boot_ms",
     )
 
     def __init__(self, index: int, spec: ReplicaSpec):
@@ -221,6 +221,10 @@ class _Replica:
         self.respawns = 0
         self.started_at = 0.0
         self.retired = False  # scale-down: exits are expected, no respawn
+        # spawn -> "started" wall time of the LAST boot (None before the
+        # first): with prewarm_source this attributes a slow scale-up to
+        # its restore tier (deserialize vs compile).
+        self.boot_ms: Optional[float] = None
 
 
 class _RouterMetrics:
@@ -840,6 +844,10 @@ class FleetRouter:
                 replica.version = version
                 replica.last_health_time = time.monotonic()
                 replica.consecutive_failures = 0
+                if replica.started_at:
+                    replica.boot_ms = round(
+                        (time.monotonic() - replica.started_at) * 1e3, 3
+                    )
         elif kind == "swapped":
             _, index, swap_id, ok, version = message
             with self._lock:
@@ -1174,6 +1182,13 @@ class FleetRouter:
                     # fp32) is verified HERE, version by version, instead
                     # of by observing precision drift in production.
                     "serve_quant": r.last_health.get("serve_quant"),
+                    # Boot attribution: how long the last spawn took to
+                    # report started, and which restore tier each warmup
+                    # bucket came from (off the health snapshot) — the
+                    # pair that tells an operator whether a scale-up paid
+                    # deserialize-time or compile-time.
+                    "boot_ms": r.boot_ms,
+                    "prewarm_source": r.last_health.get("prewarm_source"),
                 }
                 for r in self._replicas
             ]

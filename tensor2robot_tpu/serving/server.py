@@ -232,6 +232,11 @@ class PolicyServer:
         ) / 1e3  # 0 = watchdog off (predict on the dispatcher thread)
         self._buckets: Tuple[int, ...] = ()
         self._flat_spec: Dict[str, ExtendedTensorSpec] = {}
+        # Per-bucket restore tier of the SERVING version ("aot" |
+        # "cache" | "compile"; mock-ish predictors report "compile"):
+        # updated at start() and on every swap prewarm, surfaced in
+        # snapshot() so router health probes carry it fleet-wide.
+        self._prewarm_source: Dict[int, str] = {}
         self._metrics = ServerMetrics()
         self._queue: deque = deque()
         self._cond = threading.Condition()
@@ -283,6 +288,8 @@ class PolicyServer:
                 (key, dims, static, len(dims), want_dtype)
             )
         self._bucket_batches = self._build_bucket_batches(loaded, spec)
+        self._ensure_compile_tier(loaded)
+        self._record_prewarm_sources(loaded)
         if prewarm:
             self._prewarm()
         # Hot-swap continuity: compile every bucket on an INCOMING version
@@ -329,18 +336,68 @@ class PolicyServer:
 
     def _prewarm(self) -> None:
         """One predict per bucket before traffic; after this, serving
-        never compiles."""
+        never compiles (on an AOT-hit version it never compiled at
+        all — each predict deserialized its bucket's executable)."""
         for bucket in self._buckets:
             self._predictor.predict(self._bucket_batches[bucket])
 
     def _prewarm_restored(self, loaded, serve_fn) -> None:
         """Runs ON THE RESTORE THREAD before a new version swaps in:
-        every bucket compiles on the incoming serving fn while the old
+        every bucket readies on the incoming serving fn while the old
         version keeps draining batches — the hot-swap blip stays queue
-        drain, never an XLA compile."""
-        del loaded  # shapes are fixed by the start()-time ladder/spec
+        drain, never an XLA compile. With AOT executables covering the
+        ladder this loop is deserialize-time, not compile-time."""
+        # Shapes are fixed by the start()-time ladder/spec; `loaded` is
+        # the INCOMING version.
+        self._ensure_compile_tier(loaded)
         for bucket in self._buckets:
             serve_fn(self._bucket_batches[bucket])
+        # Record the incoming version's restore tiers only once every
+        # bucket readied: a failed prewarm ABORTS the swap (the old
+        # version keeps serving), and its record must not be
+        # overwritten by a version that never served.
+        self._record_prewarm_sources(loaded)
+
+    def _ensure_compile_tier(self, loaded) -> None:
+        """Engages the persistent compile cache whenever THIS server's
+        resolved ladder has a bucket the loaded version cannot serve
+        from an AOT executable. The restore-time engagement
+        (enable_compile_cache_for) only sees the artifact's own ladder;
+        an explicit `batch_buckets` constructor ladder can be wider, and
+        its extra buckets must not compile uncached just because the
+        warmup ladder happened to be AOT-covered. No-op when the cache
+        flag is unset."""
+        table = getattr(loaded, "aot_executables", None) or {}
+        if any(bucket not in table for bucket in self._buckets):
+            from tensor2robot_tpu.serving.compile_cache import (
+                enable_compile_cache,
+            )
+
+            enable_compile_cache()
+
+    def _record_prewarm_sources(self, loaded) -> None:
+        """Per-bucket restore tier of `loaded` + the aot_hits/aot_misses
+        counters. A miss is counted ONLY when AOT was requested (the
+        loaded model resolved T2R_SERVE_AOT=1) and the bucket still fell
+        back — the loud, counted fallback contract."""
+        table = getattr(loaded, "aot_executables", None) or {}
+        aot_requested = bool(getattr(loaded, "aot_enabled", False))
+        cache_on = bool(t2r_flags.get_str("T2R_COMPILE_CACHE_DIR"))
+        sources: Dict[int, str] = {}
+        hits = misses = 0
+        for bucket in self._buckets:
+            if bucket in table:
+                sources[bucket] = "aot"
+                hits += 1
+            else:
+                sources[bucket] = "cache" if cache_on else "compile"
+                if aot_requested:
+                    misses += 1
+        self._prewarm_source = sources
+        if hits:
+            self._metrics.count("aot_hits", hits)
+        if misses:
+            self._metrics.count("aot_misses", misses)
 
     def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
         """Stops the dispatcher. drain=True serves everything already
@@ -484,6 +541,24 @@ class PolicyServer:
         regime = getattr(self._predictor, "quant_regime", None)
         if regime is not None:
             snap["serve_quant"] = regime
+        # Per-bucket restore tier ("aot" = deserialized executable,
+        # "cache"/"compile" = the fallback tiers): the boot-attribution
+        # surface the router/autoscaler snapshots and the bench's
+        # zero-fresh-compile audit read.
+        snap["prewarm_source"] = {
+            str(bucket): source
+            for bucket, source in sorted(self._prewarm_source.items())
+        }
+        loaded = getattr(self._predictor, "loaded_model", None)
+        fallbacks = getattr(loaded, "aot_fallbacks", None)
+        if fallbacks:
+            # WHY each declared bucket fell off the AOT tier (topology/
+            # fingerprint mismatch, corrupt file, ...) — the loud half
+            # of the loud-fallback contract, per bucket.
+            snap["aot_fallbacks"] = {
+                str(bucket): reason
+                for bucket, reason in sorted(fallbacks.items())
+            }
         # Fleet-visible leak surface: a predictor whose close() abandoned
         # a restore thread reports it here, so router health probes (which
         # ride this snapshot) can see the wounded replica.

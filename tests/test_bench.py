@@ -275,7 +275,7 @@ def test_bench_cli_lists_legs():
     assert proc.returncode == 0
     for leg in (
         "data", "auc", "predict", "bc", "stream", "pipe", "serve", "comms",
-        "fleet", "rl",
+        "fleet", "rl", "aot",
     ):
         assert leg in proc.stdout
     proc = subprocess.run(
@@ -304,6 +304,14 @@ def test_bench_cli_lists_legs():
     )
     assert proc.returncode == 0
     for option in ("--block", "--steps", "--repeats", "--out"):
+        assert option in proc.stdout
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "bench.py"),
+         "aot", "--help"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0
+    for option in ("--buckets", "--leg-secs", "--swap-rate-hz", "--out"):
         assert option in proc.stdout
     # Unknown legs are an argparse error now, not a silent fallthrough
     # into the headline benchmark.
@@ -359,6 +367,83 @@ def test_bench_rl_contract(tmp_path):
     assert detail["sharded_chaos"]["chaos"]["shard_pid"] is not None
     assert detail["sharded_chaos"]["uid_audit"]["episodes"] > 0
     assert detail["replay_ratio"] > 0
+    with open(out) as f:
+        assert json.load(f)["metric"] == payload["metric"]
+
+
+def test_aot_boot_env_scrubs_every_serving_flag(monkeypatch):
+    """The aot leg's child boots must see ONLY the flags the twin under
+    measurement sets: a leaked ambient bucket ladder / quant regime /
+    cache dir would change what the twins boot and fail the acceptance
+    gates (or worse, silently measure the wrong tier)."""
+    sys.path.insert(0, REPO_ROOT)
+    import bench
+
+    for key, value in {
+        "T2R_SERVE_AOT": "0",
+        "T2R_AOT_REQUIRE": "1",
+        "T2R_COMPILE_CACHE_DIR": "/tmp/leak",
+        "T2R_SERVE_BUCKETS": "1,2",
+        "T2R_SERVE_QUANT": "int8",
+    }.items():
+        monkeypatch.setenv(key, value)
+    env = bench._aot_scrubbed_env(True, platform="cpu")
+    for key in (
+        "T2R_AOT_REQUIRE", "T2R_COMPILE_CACHE_DIR",
+        "T2R_SERVE_BUCKETS", "T2R_SERVE_QUANT",
+    ):
+        assert key not in env, key
+    assert env["T2R_SERVE_AOT"] == "1"
+    assert env["JAX_PLATFORMS"] == "cpu"  # pinned to the parent backend
+    cached = bench._aot_scrubbed_env(False, cache_dir="/tmp/tier")
+    assert cached["T2R_SERVE_AOT"] == "0"
+    assert cached["T2R_COMPILE_CACHE_DIR"] == "/tmp/tier"
+
+
+@pytest.mark.slow
+def test_bench_aot_contract(tmp_path):
+    """The instant-deploy leg at toy scale: one JSON line + the --out
+    artifact, all three boot twins present, the acceptance block
+    all-green — in particular zero fresh bucket compiles on the AOT
+    boot (prewarm_source all "aot", fresh_trace_calls == 0) and the AOT
+    cold start strictly below the fresh-compile twin's. Slow slice: it
+    spawns four cold-boot subprocesses; tier-1 covers the restore
+    ladder in-process (tests/test_aot.py) and the CLI surface above."""
+    out = str(tmp_path / "aot.json")
+    payload = _run_bench(
+        "aot", "--buckets", "1,2,4", "--leg-secs", "2.0", "--out", out,
+        env_extra={"BENCH_BACKEND_WAIT": "60"},
+        timeout=560,
+    )
+    assert payload["metric"] == "serve_cold_start_aot_speedup_cpu_proxy"
+    assert payload["unit"] == "x_cold_start_speedup"
+    assert payload["value"] > 1.0  # strictly below fresh = speedup > 1
+    assert "error" not in payload
+    assert payload["proxy"] is True
+    detail = payload["detail"]
+    for mode in ("fresh", "cache_first", "cache", "aot"):
+        assert detail["boots"][mode]["cold_start_s"] > 0
+    aot_boot = detail["boots"]["aot"]
+    assert aot_boot["fresh_trace_calls"] == 0
+    assert aot_boot["aot_misses"] == 0
+    assert set(aot_boot["prewarm_source"].values()) == {"aot"}
+    assert aot_boot["aot_hits"] == 3
+    # The fresh twin really compiled (its sources are the compile tier).
+    assert set(detail["boots"]["fresh"]["prewarm_source"].values()) == {
+        "compile"
+    }
+    assert set(detail["boots"]["cache"]["prewarm_source"].values()) == {
+        "cache"
+    }
+    assert detail["boots"]["cache"]["cache_entries_added"] == 0
+    assert detail["boots"]["cache_first"]["cache_entries_added"] > 0
+    for tier in ("aot", "compile"):
+        swap = detail["rolling_swap"][tier]
+        assert swap["failed_requests"] == 0
+        assert swap["version_after"] > swap["version_before"]
+        assert swap["swap_latency_s"] > 0
+    acceptance = detail["acceptance"]
+    assert all(acceptance.values()), acceptance
     with open(out) as f:
         assert json.load(f)["metric"] == payload["metric"]
 

@@ -568,7 +568,29 @@ class TestAutoscaler:
             snap = scaler.snapshot()
             assert snap["counters"]["scale_up"] == 1
             assert snap["actions"][0]["direction"] == "up"
-            assert router.snapshot()["counters"]["scale_ups"] == 1
+            router_snap = router.snapshot()
+            assert router_snap["counters"]["scale_ups"] == 1
+            # Boot attribution for the scale-up: the new replica reports
+            # how long its spawn->started took and which restore tier
+            # each bucket prewarmed from (off its health snapshot), so
+            # scale-up latency is attributable to deserialize vs
+            # compile. The prewarm source arrives with the first health
+            # probe; boot_ms is measured router-side at "started".
+            new_index = snap["actions"][0]["replica"]
+            new_replica = router_snap["replicas"][new_index]
+            assert new_replica["boot_ms"] is not None
+            assert new_replica["boot_ms"] > 0
+            assert _wait(
+                lambda: router.snapshot()["replicas"][new_index][
+                    "prewarm_source"
+                ] is not None
+            ), "scale-up replica never reported its prewarm source"
+            assert router.snapshot()["replicas"][new_index][
+                "prewarm_source"
+            ] == {"1": "mock"}
+            boots = scaler.snapshot()["scale_up_boots"]
+            assert [b["replica"] for b in boots] == [new_index]
+            assert boots[0]["boot_ms"] == new_replica["boot_ms"]
 
     def test_scale_down_drains_without_killing_inflight(self):
         """Retirement must let the in-flight request finish: the drained

@@ -541,10 +541,16 @@ class TestCompileCache:
         (what a process restart discards); boot a second server over the
         same export. The second boot must add NO new cache entries —
         every compile was served from disk — and still serve correctly.
+
+        AOT restore is forced OFF: this test pins the CACHE tier of the
+        restore ladder, and an AOT-hit boot never compiles at all (so it
+        would write no cache entries — tests/test_aot.py covers that
+        tier).
         """
         from tensor2robot_tpu.serving.compile_cache import enable_compile_cache
 
         _, root = quant_export
+        monkeypatch.setenv("T2R_SERVE_AOT", "0")
         monkeypatch.setenv("T2R_COMPILE_CACHE_DIR", str(tmp_path))
         assert enable_compile_cache() == str(tmp_path)
 
@@ -573,13 +579,35 @@ class TestCompileCache:
         )
         np.testing.assert_array_equal(first, second)
 
-    def test_replica_factory_calls_enable(self):
-        """The replica boot path engages the cache before its first
-        compile (source-level pin: behavior is covered above; this keeps
-        the call from being refactored out of the child process path)."""
+    def test_restore_path_engages_cache_before_first_compile(
+        self, monkeypatch
+    ):
+        """Cache engagement moved from the replica factory into the
+        predictor's restore path (enable_compile_cache_for): it still
+        runs BEFORE the incoming version's first compile, but is skipped
+        per swap when AOT executables cover every warmup bucket (that
+        version never compiles). Source-level pin on the restore path,
+        behavioral pin on the skip condition."""
         import inspect
 
-        from tensor2robot_tpu.serving import replica
+        from tensor2robot_tpu.predictors import exported_savedmodel_predictor
+        from tensor2robot_tpu.serving.compile_cache import (
+            enable_compile_cache_for,
+        )
 
-        source = inspect.getsource(replica.policy_server_factory)
-        assert "enable_compile_cache()" in source
+        source = inspect.getsource(
+            exported_savedmodel_predictor.ExportedSavedModelPredictor
+            ._restore_sync
+        )
+        assert "enable_compile_cache_for" in source
+
+        class _Loaded:
+            aot_covered = True
+            aot_executables = {1: object(), 2: object()}
+            metadata = {"warmup_batch_sizes": [1, 2]}
+
+        # AOT covers the resolved ladder -> the cache round-trip is
+        # skipped even though the flag names a directory.
+        monkeypatch.setenv("T2R_COMPILE_CACHE_DIR", "/tmp/t2r_cache_pin")
+        monkeypatch.delenv("T2R_SERVE_BUCKETS", raising=False)
+        assert enable_compile_cache_for(_Loaded()) is None

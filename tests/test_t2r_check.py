@@ -325,6 +325,33 @@ class TestLintsCatch:
         assert "env-unknown-flag" not in clean
         assert "env-undeclared" not in clean
 
+    def test_aot_flags_covered_by_registry_lint(self):
+        """The round-15 AOT-executable flags (T2R_SERVE_AOT /
+        T2R_AOT_EXPORT / T2R_AOT_REQUIRE) ride the same rails: raw
+        environ reads are env-undeclared, wrong-kind getter reads are
+        env-kind-mismatch, declared spellings clean."""
+        for name in ("T2R_SERVE_AOT", "T2R_AOT_EXPORT", "T2R_AOT_REQUIRE"):
+            assert "env-undeclared" in self._rules(
+                f"import os\nx = os.environ.get({name!r})\n"
+            ), name
+            assert "env-kind-mismatch" in self._rules(
+                "from tensor2robot_tpu import flags\n"
+                f"x = flags.get_int({name!r})\n"
+            ), name
+        assert "env-kind-mismatch" in self._rules(
+            "from tensor2robot_tpu import flags\n"
+            "x = flags.get_str('T2R_SERVE_AOT')\n"
+        )
+        clean = self._rules(
+            "from tensor2robot_tpu import flags\n"
+            "a = flags.get_bool('T2R_SERVE_AOT')\n"
+            "b = flags.get_bool('T2R_AOT_EXPORT')\n"
+            "c = flags.get_bool('T2R_AOT_REQUIRE')\n"
+        )
+        assert "env-kind-mismatch" not in clean
+        assert "env-unknown-flag" not in clean
+        assert "env-undeclared" not in clean
+
     def _sleep_rules(self, source, path="tensor2robot_tpu/serving/x.py"):
         return {d.rule for d in lint_source(source, path)}
 

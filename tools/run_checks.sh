@@ -75,6 +75,18 @@ if [ "$TESTS" = 1 ]; then
     status=1
   fi
 
+  echo "== aot: serialized-executable restore ladder (tier-1) =="
+  # Export-side aot/ layout + metadata key contract, bit-identical
+  # AOT-hit serving vs the fresh-compile twin (fp32 and int8), the loud
+  # counted fallbacks (fingerprint/topology/jax-version mismatch,
+  # corpus-family corruption), T2R_SERVE_AOT=0 byte-compat, strict
+  # T2R_AOT_REQUIRE boots, and the server's prewarm_source/aot_hits
+  # audit surface.
+  if ! JAX_PLATFORMS=cpu python -m pytest tests/test_aot.py \
+      -q -m 'not slow' -p no:cacheprovider; then
+    status=1
+  fi
+
   echo "== chaos: deterministic fault-plan + crash-consistency suite (tier-1) =="
   # Seeded fault plans only (testing/chaos.py): replica kill / straggler /
   # corrupt-reply routing, and SIGKILL-mid-orbax-save recovery with the
