@@ -156,6 +156,7 @@ class AbstractExportGenerator:
         block: Optional[int] = None,
         min_size: Optional[int] = None,
         calibration: Optional[Mapping[str, float]] = None,
+        native: Optional[Sequence[str]] = None,
     ) -> Callable[..., Dict[str, Any]]:
         """Blockwise low-precision serving fn: `(payload, flat_features)`.
 
@@ -165,10 +166,20 @@ class AbstractExportGenerator:
         INSIDE the returned function, so tracing it (per-regime StableHLO
         artifact) fuses them with the forward pass, and — like the
         weights-as-arguments int8 path above — the artifact embeds no
-        weight constants at all. Attributes on the returned fn carry the
-        export-side bookkeeping: `.quant_payload` (exemplar/storage
-        tree), `.quant_layout`, `.quant_regime`, `.quant_block`,
-        `.quant_calibration`.
+        weight constants at all.
+
+        `native` is the per-layer eligibility map for native
+        low-precision matmuls (None resolves the default map +
+        T2R_SERVE_NATIVE_LAYERS override; () forces the pure dequant
+        path): eligible kernels are stored per-channel and the traced
+        forward contracts them in their storage dtype via
+        `serve_quant.native_lowering` — the int8/fp8 dot_general lands
+        IN the exported program.
+
+        Attributes on the returned fn carry the export-side bookkeeping:
+        `.quant_payload` (exemplar/storage tree), `.quant_layout`,
+        `.quant_regime`, `.quant_block`, `.quant_calibration`,
+        `.quant_native` (the eligibility map it was built with).
         """
         import jax
 
@@ -176,14 +187,43 @@ class AbstractExportGenerator:
 
         preprocessor = self._preprocessor
         raw = self._export_raw_receivers
+        # The UN-jitted forward: native_lowering rewrites Dense calls at
+        # trace time, so the serving fn must own its tracing. Through
+        # the jitted predict_step, an EAGER call (the export parity
+        # gates) whose avals the jit cache has already seen — and the
+        # fp32 baseline always trains the cache first with identical
+        # avals — would execute the cached no-interception program: the
+        # gate would measure the dequant path while the serialized
+        # artifact serves the native one. That failure is SILENT, so a
+        # compiled object without the un-jitted handle is a hard error,
+        # never a quiet fallback to the jitted path.
+        try:
+            predict_step = compiled.predict_step_fn
+        except AttributeError:
+            raise ValueError(
+                "create_quant_serving_fn requires compiled.predict_step_fn "
+                "(the un-jitted forward, train_eval.CompiledModel): the "
+                "jitted predict_step would let the export parity gates "
+                "measure a cached no-interception program while the "
+                "artifact serves the native-lowered one."
+            ) from None
         block = serve_quant.DEFAULT_BLOCK if block is None else int(block)
         min_size = (
             serve_quant.DEFAULT_MIN_SIZE if min_size is None else int(min_size)
         )
         calibration = dict(calibration or {})
+        host_variables = jax.device_get(variables)
+        if native is None:
+            native = serve_quant.resolve_native_eligibility(
+                host_variables, regime, min_size=min_size
+            )
+        native = tuple(sorted(native))
         payload, layout = serve_quant.quantize_tree(
-            jax.device_get(variables), regime, block=block, min_size=min_size
+            host_variables, regime, block=block, min_size=min_size,
+            native=native,
         )
+
+        fired: set = set()
 
         def serving_fn(quant_payload, flat_features):
             features = serve_quant.fake_quant_activations(
@@ -195,7 +235,10 @@ class AbstractExportGenerator:
                     features, None, mode="predict", rng=None
                 )
             bound = serve_quant.dequantize_tree(quant_payload, layout, regime)
-            outputs = compiled.predict_step(bound, features)
+            with serve_quant.native_lowering(
+                quant_payload, layout, regime, bound, fired=fired
+            ):
+                outputs = predict_step(bound, features)
             return dict(flatten_spec_structure(outputs).items())
 
         serving_fn.quant_payload = payload
@@ -203,6 +246,11 @@ class AbstractExportGenerator:
         serving_fn.quant_regime = regime
         serving_fn.quant_block = block
         serving_fn.quant_calibration = calibration
+        serving_fn.quant_native = native
+        # Populated by any run of the fn (the parity gates always run
+        # it before export): which eligible kernels the interceptor
+        # ACTUALLY lowered — the export's claimed-vs-fired truth source.
+        serving_fn.quant_native_fired = fired
         return serving_fn
 
     def create_example_features(self, batch_size: int = 1) -> Dict[str, Any]:

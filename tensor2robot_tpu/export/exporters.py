@@ -30,6 +30,47 @@ from tensor2robot_tpu.export.saved_model import (
 DEFAULT_METRIC = "loss"
 
 
+def _native_pre_gate(
+    fn,
+    rebuild_dequant: Callable[[], Any],
+    fp32_outputs,
+    warmup_batches,
+    tolerance: float,
+):
+    """Per-regime parity triage for native low-precision matmuls.
+
+    The parity gate is the arbiter of WHERE a regime computes: a
+    native-lowered serving fn that misses the regime's tolerance on the
+    warmup corpus is demoted wholesale to the dequant path (blockwise
+    payload, f32 contractions) and re-measured by the final gate in
+    save_exported_model — the artifact either computes natively within
+    parity, or dequantizes within parity, or does not exist. Returns
+    (fn, demoted); a demoted fn carries `.quant_native_demoted = True`
+    so the metadata records that the eligibility map was overridden by
+    measurement, not configuration.
+    """
+    import numpy as np
+
+    from tensor2robot_tpu.export import serve_quant as sq
+
+    quant_outputs = [
+        {k: np.asarray(v) for k, v in fn(fn.quant_payload, batch).items()}
+        for batch in warmup_batches
+    ]
+    divergence = sq.measure_parity(fp32_outputs, quant_outputs)
+    if all(value <= tolerance for value in divergence.values()):
+        # Hand the measurement to the final gate: the fn is saved
+        # unchanged, so save_exported_model need not replay the corpus
+        # through the (deliberately un-jitted, slow) native forward a
+        # second time. A demoted fn carries no measurement — the final
+        # gate measures the dequant path it actually saves.
+        fn.quant_measured_divergence = divergence
+        return fn, False
+    demoted = rebuild_dequant()
+    demoted.quant_native_demoted = True
+    return demoted, True
+
+
 def create_valid_result_smaller(metric_key: str = DEFAULT_METRIC):
     """Best = strictly smaller metric (reference train_eval.py:206-248)."""
 
@@ -203,22 +244,49 @@ class Exporter:
         )
         serve_quant_fns = None
         if self._serve_quant:
-            from tensor2robot_tpu.export.serve_quant import (
-                calibrate_activations,
-            )
+            import numpy as np
 
-            calibration = calibrate_activations(warmup_batches)
-            serve_quant_fns = {
-                regime: generator.create_quant_serving_fn(
-                    compiled,
-                    variables,
-                    regime=regime,
-                    block=self._quant_block,
-                    min_size=self._quant_min_size,
-                    calibration=calibration,
-                )
-                for regime in self._serve_quant
-            }
+            from tensor2robot_tpu.export import serve_quant as sq
+
+            calibration = sq.calibrate_activations(warmup_batches)
+            tolerance = dict(sq.DEFAULT_PARITY_TOL)
+            tolerance.update(self._quant_parity_tol)
+            serve_quant_fns = {}
+            fp32_outputs = None
+            for regime in self._serve_quant:
+
+                def make(native=None, regime=regime):
+                    return generator.create_quant_serving_fn(
+                        compiled,
+                        variables,
+                        regime=regime,
+                        block=self._quant_block,
+                        min_size=self._quant_min_size,
+                        calibration=calibration,
+                        native=native,
+                    )
+
+                fn = make()
+                if fn.quant_native:
+                    # Native matmuls ride only where measurement allows:
+                    # the fp32 forward (computed once, shared across
+                    # regimes) is the baseline for the demotion triage.
+                    if fp32_outputs is None:
+                        fp32_outputs = [
+                            {
+                                k: np.asarray(v)
+                                for k, v in serving_fn(batch).items()
+                            }
+                            for batch in warmup_batches
+                        ]
+                    fn, _ = _native_pre_gate(
+                        fn,
+                        lambda: make(native=()),
+                        fp32_outputs,
+                        warmup_batches,
+                        tolerance[regime],
+                    )
+                serve_quant_fns[regime] = fn
         path = save_exported_model(
             root,
             variables=variables,

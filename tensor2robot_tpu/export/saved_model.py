@@ -181,10 +181,11 @@ def save_exported_model(
             )
         tolerance = dict(sq.DEFAULT_PARITY_TOL)
         tolerance.update(dict(quant_parity_tol or {}))
-        fp32_outputs = [
-            {k: np.asarray(v) for k, v in predict_fn(batch).items()}
-            for batch in calibration_batches
-        ]
+        # The fp32 baseline is only needed for regimes the caller did
+        # not already measure (exporters._native_pre_gate hands its
+        # corpus replay through `quant_measured_divergence`); computed
+        # lazily, once.
+        fp32_outputs: Optional[List[Dict[str, np.ndarray]]] = None
         serve_quant_meta = {
             "regimes": sorted(serve_quant_fns),
             "block": {},
@@ -193,17 +194,33 @@ def save_exported_model(
             "parity": {},
             "payload_bytes": {},
             "stablehlo": {},
+            # Native low-precision compute contract per regime: which
+            # layers contract in the storage dtype (and whether the
+            # parity gate demoted the map), plus the channel/block
+            # granularity mix of the payload.
+            "native": {},
+            "granularity": {},
         }
         for regime in sorted(serve_quant_fns):
             fn = serve_quant_fns[regime]
-            quant_outputs = [
-                {
-                    k: np.asarray(v)
-                    for k, v in fn(fn.quant_payload, batch).items()
-                }
-                for batch in calibration_batches
-            ]
-            divergence = sq.measure_parity(fp32_outputs, quant_outputs)
+            divergence = getattr(fn, "quant_measured_divergence", None)
+            if divergence is None:
+                if fp32_outputs is None:
+                    fp32_outputs = [
+                        {
+                            k: np.asarray(v)
+                            for k, v in predict_fn(batch).items()
+                        }
+                        for batch in calibration_batches
+                    ]
+                quant_outputs = [
+                    {
+                        k: np.asarray(v)
+                        for k, v in fn(fn.quant_payload, batch).items()
+                    }
+                    for batch in calibration_batches
+                ]
+                divergence = sq.measure_parity(fp32_outputs, quant_outputs)
             # The gate: a regime that cannot match the fp32 forward on
             # the artifact's own corpus fails the WHOLE export, loudly,
             # before any directory exists.
@@ -222,6 +239,35 @@ def save_exported_model(
             serve_quant_meta["payload_bytes"][regime] = sq.payload_nbytes(
                 fn.quant_payload
             )
+            # Claimed vs fired: the eligibility map is structural, but
+            # only Dense-owned kernels actually intercept — `layers`
+            # records what the program EXECUTES natively (the fired
+            # set, populated by the parity runs above), and any
+            # claimed-but-never-lowered kernel is surfaced separately
+            # instead of inflating the attribution.
+            claimed = list(getattr(fn, "quant_native", ()) or ())
+            fired = set(getattr(fn, "quant_native_fired", ()) or ())
+            native_entry = {
+                "layers": [path for path in claimed if path in fired],
+                "demoted": bool(getattr(fn, "quant_native_demoted", False)),
+            }
+            unlowered = [path for path in claimed if path not in fired]
+            if unlowered:
+                import logging
+
+                logging.warning(
+                    "export: serve-quant %s eligibility claimed %d "
+                    "layer(s) the native lowering never intercepted "
+                    "(%s) — they serve on the dequant path; check the "
+                    "module types / T2R_SERVE_NATIVE_LAYERS map",
+                    regime, len(unlowered), ", ".join(unlowered),
+                )
+                native_entry["unlowered"] = unlowered
+            serve_quant_meta["native"][regime] = native_entry
+            granularity = {"channel": 0, "block": 0}
+            for entry in fn.quant_layout.values():
+                granularity[entry.get("granularity", "block")] += 1
+            serve_quant_meta["granularity"][regime] = granularity
             quant_payload_bytes[regime] = serialization.to_bytes(
                 _to_plain(fn.quant_payload)
             )
@@ -304,6 +350,20 @@ def save_exported_model(
                         f.write(artifact)
                     serve_quant_meta["stablehlo"][regime] = True
                     quant_artifact_bytes[regime] = artifact
+                    try:
+                        # The compute-attribution audit, on the ARTIFACT
+                        # bytes a restore will execute: contraction ops
+                        # by operand dtype — proof the native regimes'
+                        # matmuls stayed int8/fp8 in the program, not
+                        # just the payload.
+                        serve_quant_meta.setdefault("dot_audit", {})[
+                            regime
+                        ] = sq.audit_dot_dtypes(artifact)
+                    except Exception as audit_err:  # noqa: BLE001 — the
+                        # audit is bookkeeping; never fail an export on it.
+                        serve_quant_meta.setdefault("dot_audit_error", {})[
+                            regime
+                        ] = f"{type(audit_err).__name__}: {audit_err}"
                 except Exception as e:  # noqa: BLE001 — same best-effort rule
                     # as the default artifact: record why, keep exporting.
                     serve_quant_meta["stablehlo"][regime] = False
@@ -726,6 +786,19 @@ class ExportedModel:
         return bool(sizes) and all(
             int(size) in self.aot_executables for size in sizes
         )
+
+    @property
+    def native_dot_layers(self) -> tuple:
+        """Flat param paths whose contractions the loaded regime's
+        program executes NATIVELY in the storage dtype (empty for
+        'none', the fp16 cast regime, or a parity-demoted map) — the
+        per-replica compute-attribution surface health snapshots carry,
+        mirroring how `quant_regime` rides them for mix-verification."""
+        if self.quant_regime == "none":
+            return ()
+        native = (self.metadata.get("serve_quant") or {}).get("native") or {}
+        entry = native.get(self.quant_regime) or {}
+        return tuple(entry.get("layers") or ())
 
     @property
     def has_stablehlo(self) -> bool:

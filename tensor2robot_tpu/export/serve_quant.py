@@ -29,9 +29,38 @@ re-applies the identical wire format to exported params:
     that any artifact that exists has measured, recorded parity
     (`t2r_metadata.json` serve_quant block).
 
-Regime names are the collective registry's ("fp16", "int8"); "none"
-never reaches this module — the unquantized path is untouched byte for
-byte.
+Regime names are the collective registry's ("fp16", "int8", "fp8_e4m3",
+"fp8_e5m2"); "none" never reaches this module — the unquantized path is
+untouched byte for byte.
+
+Native low-precision COMPUTE (round 16): storage/wire quantization alone
+left the matmul win on the table — `dequantize_tree` rebuilt the full
+fp32 tree before every contraction, so hardware int8/fp8 units never
+saw the quantized operands (int8 serving measured 0.86x fp32 req/s on
+the CPU proxy, docs/PERFORMANCE.md round 11). For the int8/fp8 regimes,
+ELIGIBLE 2-D kernels now stay in their storage dtype end to end:
+
+  * `quantize_tree` encodes eligible kernels PER-CHANNEL (one scale per
+    output column, `GRAN_CHANNEL`) instead of per-ravel-block — the
+    granularity that lets scales move to the ACCUMULATOR: a blockwise
+    scale spanning arbitrary ravel positions cannot be applied after
+    the contraction, a per-output-channel scale can, exactly;
+  * `native_lowering` intercepts flax Dense calls (nn.intercept_methods)
+    whose kernel payload is channel-quantized and replaces the f32
+    matmul with `native_dot`: the activation is quantized per ROW
+    (dynamic per-token max-abs — each sample independent of its
+    batchmates, so bucket padding cannot perturb real rows), the
+    contraction runs `lax.dot_general` on the int8/fp8 operands
+    (`preferred_element_type` int32/f32), and BOTH scales multiply the
+    accumulator;
+  * the eligibility map (`resolve_native_eligibility`, override flag
+    `T2R_SERVE_NATIVE_LAYERS`) keeps parity-fragile layers on the
+    dequant path, and the exporter demotes a regime wholesale when the
+    parity gate demands it (gate-fails-write-nothing is unchanged);
+  * `audit_dot_dtypes` parses the SERIALIZED serving program and counts
+    contraction ops by operand element type — the proof, recorded in
+    t2r_metadata.json and asserted by bench/tests, that the matmuls
+    actually stayed low-precision rather than dequant-then-f32.
 
 AOT interplay (export/aot.py): each regime's payload-as-arguments
 serving program also gets per-warmup-bucket serialized executables in
@@ -43,17 +72,27 @@ key check.
 
 from __future__ import annotations
 
+import contextlib
+import fnmatch
 from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
-from tensor2robot_tpu.parallel.collectives import get_collective
+from tensor2robot_tpu.parallel.collectives import (
+    Fp8E4M3Collective,
+    Fp8E5M2Collective,
+    get_collective,
+)
 
 __all__ = [
     "QuantParityError",
     "SERVE_QUANT_REGIMES",
+    "NATIVE_DOT_REGIMES",
+    "GRAN_BLOCK",
+    "GRAN_CHANNEL",
     "DEFAULT_BLOCK",
     "DEFAULT_MIN_SIZE",
     "DEFAULT_PARITY_TOL",
@@ -61,6 +100,11 @@ __all__ = [
     "S_KEY",
     "quantize_tree",
     "dequantize_tree",
+    "default_native_eligibility",
+    "resolve_native_eligibility",
+    "native_dot",
+    "native_lowering",
+    "audit_dot_dtypes",
     "calibrate_activations",
     "fake_quant_activations",
     "measure_parity",
@@ -70,7 +114,36 @@ __all__ = [
 ]
 
 #: The serve-side regimes; the collective registry's quantized formats.
-SERVE_QUANT_REGIMES = ("fp16", "int8")
+SERVE_QUANT_REGIMES = ("fp16", "int8", "fp8_e4m3", "fp8_e5m2")
+
+#: fp8 storage formats: regime -> (dtype, largest finite value), read
+#: off the collective registry's classes so the two modules cannot
+#: drift apart on a format (the payload's bit-compatibility with the
+#: gradient wire depends on it). The clip before every cast is
+#: load-bearing — jax fp8 casts do not saturate, an overflow becomes
+#: NaN.
+_FP8_FORMATS = {
+    "fp8_e4m3": (Fp8E4M3Collective._DTYPE, Fp8E4M3Collective._MAX),
+    "fp8_e5m2": (Fp8E5M2Collective._DTYPE, Fp8E5M2Collective._MAX),
+}
+
+#: Regimes whose eligible kernels can execute the contraction natively
+#: on the storage dtype (fp16 is a cast regime — XLA already runs fp16
+#: matmuls natively from the dequant path, nothing to lower).
+NATIVE_DOT_REGIMES = ("int8", "fp8_e4m3", "fp8_e5m2")
+
+#: Minimum contraction depth (kernel rows) for native eligibility: a
+#: per-channel scale costs 4 bytes over `rows` 1-byte values, so shallow
+#: kernels would BLOAT the payload past the regime's byte win — and a
+#: depth-3 dot has no compute to reclaim on int8/fp8 units anyway.
+DEFAULT_MIN_NATIVE_ROWS = 16
+
+#: Payload granularities recorded per leaf in the layout: per-ravel-block
+#: (the collectives' wire format, dequant path) vs per-output-channel
+#: (native dot path — the only granularity whose scale can move to the
+#: accumulator).
+GRAN_BLOCK = "block"
+GRAN_CHANNEL = "channel"
 
 #: Elements per scale. 512 matches the gradient collectives' default
 #: (T2R_COLLECTIVE_BLOCK): int8 = 1 B/elem + 4 B/block ~= 3.97x under f32.
@@ -83,7 +156,14 @@ DEFAULT_MIN_SIZE = 16
 #: Export-time parity gate defaults: max |quant - fp32| over the warmup
 #: corpus, per flat output key. fp16 rounding is ~1e-3 relative; int8
 #: blockwise weight+activation rounding lands ~1e-2-1e-1 on O(1) heads.
-DEFAULT_PARITY_TOL = {"fp16": 1e-2, "int8": 2e-1}
+#: fp8 rounding is RELATIVE (2^-4 per value for e4m3, 2^-3 for e5m2), so
+#: per-layer error compounds faster than int8's absolute step.
+DEFAULT_PARITY_TOL = {
+    "fp16": 1e-2,
+    "int8": 2e-1,
+    "fp8_e4m3": 2.5e-1,
+    "fp8_e5m2": 5e-1,
+}
 
 # Sentinel node keys in the stored payload tree (flax msgpack round-trips
 # the nesting unchanged, like export/quantization.py's weight-only nodes).
@@ -107,28 +187,73 @@ def _leaf_block(size: int, block: int) -> int:
     return block if size >= block else size
 
 
+def _levels(regime: str) -> float:
+    """Largest encodable magnitude of the regime's storage dtype (127
+    for int8, the max finite value for fp8) — the denominator every
+    symmetric scale in this module divides by."""
+    if regime == "int8":
+        return 127.0
+    return _FP8_FORMATS[regime][1]
+
+
+def _channel_encode(
+    leaf: np.ndarray, regime: str
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-output-channel symmetric encode of a [in, out] kernel: one
+    scale per output column (axis -1), values stored in the ORIGINAL 2-D
+    shape in the regime's storage dtype — the operand `native_dot`
+    contracts against without dequantizing."""
+    absmax = np.max(np.abs(leaf), axis=0)
+    absmax = np.where(absmax > 0, absmax, 1.0).astype(np.float32)
+    scale = absmax / _levels(regime)
+    if regime == "int8":
+        q = np.clip(np.round(leaf / scale), -127, 127).astype(np.int8)
+    else:
+        dtype, fmax = _FP8_FORMATS[regime]
+        q = np.asarray(
+            jnp.asarray(np.clip(leaf / scale, -fmax, fmax)).astype(dtype)
+        )
+    return q, scale
+
+
 def quantize_tree(
     variables: Any,
     regime: str,
     block: int = DEFAULT_BLOCK,
     min_size: int = DEFAULT_MIN_SIZE,
+    native: Sequence[str] = (),
 ) -> Tuple[Any, Dict[str, Dict[str, Any]]]:
     """Encodes eligible float leaves through the regime's collective.
 
     Returns (payload_tree, layout). The payload tree mirrors the input
     nesting; each quantized leaf becomes {Q_KEY: encoded values, S_KEY:
-    per-block scales} (int8 values for 'int8', fp16 for 'fp16'); every
-    other leaf passes through untouched. `layout` maps the flat
-    '/'-joined leaf path to {'shape', 'size', 'block', 'padded'} — pure
-    Python ints, JSON-serializable, and the static metadata
-    `dequantize_tree` needs to reshape under tracing.
+    scales} (int8 values for 'int8', fp16 for 'fp16', fp8 for the fp8
+    regimes); every other leaf passes through untouched. `layout` maps
+    the flat '/'-joined leaf path to {'shape', 'size', 'granularity',
+    and for blockwise leaves 'block'/'padded'} — pure Python ints/strs,
+    JSON-serializable, and the static metadata `dequantize_tree` needs
+    to reshape under tracing.
+
+    `native` is the eligibility map (flat leaf paths, see
+    `resolve_native_eligibility`): those leaves are encoded PER-CHANNEL
+    (granularity 'channel') in their original 2-D shape so the native
+    dot path can contract the stored operands directly and apply the
+    scales to the accumulator. Everything else stays on the collectives'
+    blockwise wire format.
     """
     if regime not in SERVE_QUANT_REGIMES:
         raise ValueError(
             f"serve-quant regime must be one of {SERVE_QUANT_REGIMES}, "
-            f"got {regime!r}"
+            f"got {regime!r} (T2R_SERVE_QUANT selects the serving regime)"
+        )
+    native = frozenset(native)
+    if native and regime not in NATIVE_DOT_REGIMES:
+        raise ValueError(
+            f"native eligibility given for regime {regime!r}, but only "
+            f"{NATIVE_DOT_REGIMES} have a native dot lowering"
         )
     layout: Dict[str, Dict[str, Any]] = {}
+    seen: set = set()
 
     def walk(node, path):
         if isinstance(node, Mapping):
@@ -136,6 +261,25 @@ def quantize_tree(
                 key: walk(value, path + (key,)) for key, value in node.items()
             }
         leaf = np.asarray(node)
+        flat_path = "/".join(path)
+        if flat_path in native:
+            seen.add(flat_path)
+            if not (
+                jnp.issubdtype(leaf.dtype, jnp.floating) and leaf.ndim == 2
+            ):
+                raise ValueError(
+                    f"native-eligible leaf {flat_path!r} must be a 2-D "
+                    f"float kernel, got shape {leaf.shape} dtype "
+                    f"{leaf.dtype} (fix the T2R_SERVE_NATIVE_LAYERS "
+                    "override)"
+                )
+            q, scale = _channel_encode(leaf.astype(np.float32), regime)
+            layout[flat_path] = {
+                "shape": [int(d) for d in leaf.shape],
+                "size": int(leaf.size),
+                "granularity": GRAN_CHANNEL,
+            }
+            return {Q_KEY: q, S_KEY: scale}
         if not (
             jnp.issubdtype(leaf.dtype, jnp.floating)
             and leaf.size >= min_size
@@ -149,18 +293,27 @@ def quantize_tree(
             flat = np.pad(flat, (0, padded - size))
         collective = get_collective(regime, leaf_block)
         payload = collective.encode(jnp.asarray(flat))
-        layout["/".join(path)] = {
+        layout[flat_path] = {
             "shape": [int(d) for d in leaf.shape],
             "size": size,
             "block": leaf_block,
             "padded": padded,
+            "granularity": GRAN_BLOCK,
         }
         return {
             Q_KEY: np.asarray(payload["q"]),
             S_KEY: np.asarray(payload["s"]),
         }
 
-    return walk(variables, ()), layout
+    tree = walk(variables, ())
+    missing = native - seen
+    if missing:
+        raise ValueError(
+            "native-eligible paths not found in the variables tree: "
+            + ", ".join(sorted(missing))
+            + " (fix the T2R_SERVE_NATIVE_LAYERS override)"
+        )
+    return tree, layout
 
 
 def dequantize_tree(
@@ -170,18 +323,27 @@ def dequantize_tree(
     dtype=jnp.float32,
 ) -> Any:
     """Inverse of quantize_tree — pure jnp (the collectives' shared
-    BlockScaledCollective.decode), so it traces into a jitted/exported
-    serving fn where the payload arrives as arguments."""
+    BlockScaledCollective.decode for blockwise leaves, a per-channel
+    scale broadcast for native ones), so it traces into a jitted/
+    exported serving fn where the payload arrives as arguments. Channel
+    leaves dequantized here feed only NON-intercepted consumers — the
+    native dot reads the stored operands directly, and XLA drops the
+    unused dequant."""
 
     def walk(node, path):
         if _is_payload_node(node):
             meta = layout["/".join(path)]
+            shape = tuple(int(d) for d in meta["shape"])
+            if meta.get("granularity", GRAN_BLOCK) == GRAN_CHANNEL:
+                q = jnp.asarray(node[Q_KEY]).astype(jnp.float32)
+                return (q * jnp.asarray(node[S_KEY])).reshape(shape).astype(
+                    dtype
+                )
             collective = get_collective(regime, int(meta["block"]))
             flat = collective.decode(
                 {"q": jnp.asarray(node[Q_KEY]), "s": jnp.asarray(node[S_KEY])}
             )
             size = int(meta["size"])
-            shape = tuple(int(d) for d in meta["shape"])
             return flat[:size].reshape(shape).astype(dtype)
         if isinstance(node, Mapping):
             return {
@@ -190,6 +352,246 @@ def dequantize_tree(
         return node
 
     return walk(payload_tree, ())
+
+
+# -- native low-precision compute ----------------------------------------------
+
+
+def default_native_eligibility(
+    variables: Any,
+    regime: str,
+    min_size: int = DEFAULT_MIN_SIZE,
+) -> Tuple[str, ...]:
+    """The default eligibility map: every 2-D float '.../kernel' leaf of
+    at least `min_size` elements and `DEFAULT_MIN_NATIVE_ROWS`
+    contraction depth — the dense contractions flax Dense layers own.
+    Conv kernels (4-D) and norm/bias vectors stay on the dequant path
+    (their contraction layouts don't admit an exact per-output-channel
+    accumulator scale through this lowering), and shallow kernels stay
+    blockwise (per-channel scales would bloat them, see
+    DEFAULT_MIN_NATIVE_ROWS)."""
+    if regime not in NATIVE_DOT_REGIMES:
+        return ()
+    paths: List[str] = []
+
+    def walk(node, path):
+        if isinstance(node, Mapping):
+            for key, value in node.items():
+                walk(value, path + (key,))
+            return
+        leaf = np.asarray(node)
+        if (
+            path
+            and path[-1] == "kernel"
+            and leaf.ndim == 2
+            and jnp.issubdtype(leaf.dtype, jnp.floating)
+            and leaf.size >= min_size
+            and leaf.shape[0] >= DEFAULT_MIN_NATIVE_ROWS
+        ):
+            paths.append("/".join(path))
+
+    walk(variables, ())
+    return tuple(sorted(paths))
+
+
+def resolve_native_eligibility(
+    variables: Any,
+    regime: str,
+    min_size: int = DEFAULT_MIN_SIZE,
+    override: Optional[str] = None,
+) -> Tuple[str, ...]:
+    """The eligibility map after the T2R_SERVE_NATIVE_LAYERS override.
+
+    override None reads the flag; 'auto'/unset keeps the default map;
+    'none' disables native lowering entirely; anything else is comma-
+    separated fnmatch globs selecting among the structurally-eligible
+    (default-map) layers — a glob can DEMOTE fragile layers, never
+    promote a leaf the lowering could not contract exactly.
+    """
+    if override is None:
+        from tensor2robot_tpu import flags
+
+        override = flags.get_str("T2R_SERVE_NATIVE_LAYERS")
+    candidates = default_native_eligibility(variables, regime, min_size)
+    if override is None or override == "auto":
+        return candidates
+    if override == "none":
+        return ()
+    globs = [g.strip() for g in override.split(",") if g.strip()]
+    return tuple(
+        path
+        for path in candidates
+        if any(fnmatch.fnmatchcase(path, g) for g in globs)
+    )
+
+
+def native_dot(x: jax.Array, q: jax.Array, scale: jax.Array, regime: str):
+    """One eligible contraction, natively low-precision.
+
+    The activation is quantized per ROW (dynamic max-abs over the last
+    axis — per-token, so no sample's scale depends on its batchmates or
+    on bucket padding), the contraction runs on the quantized operands
+    (`preferred_element_type` keeps the accumulator wide), and both
+    scales multiply the ACCUMULATOR — which is exactly correct because
+    the activation scale is constant along the contraction for each row
+    and the weight scale is constant along it for each output channel.
+    Returns f32 [..., out].
+    """
+    x = jnp.asarray(x)
+    row_max = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+    a_scale = jnp.maximum(row_max, jnp.float32(1e-12)) / _levels(regime)
+    dims = (((x.ndim - 1,), (0,)), ((), ()))
+    if regime == "int8":
+        xq = jnp.clip(jnp.round(x / a_scale), -127, 127).astype(jnp.int8)
+        acc = lax.dot_general(
+            xq, q, dims, preferred_element_type=jnp.int32
+        ).astype(jnp.float32)
+    else:
+        dtype, fmax = _FP8_FORMATS[regime]
+        xq = jnp.clip(x / a_scale, -fmax, fmax).astype(dtype)
+        acc = lax.dot_general(
+            xq, q, dims, preferred_element_type=jnp.float32
+        )
+    return acc * a_scale * scale
+
+
+@contextlib.contextmanager
+def native_lowering(
+    payload_tree: Any,
+    layout: Mapping[str, Mapping[str, Any]],
+    regime: str,
+    bound_variables: Any,
+    fired: Optional[set] = None,
+):
+    """Context manager lowering eligible Dense contractions natively.
+
+    Inside the context, every flax Dense whose kernel payload is
+    channel-quantized (granularity 'channel' in `layout`) computes
+    `native_dot` on the STORED operands instead of the f32 matmul the
+    dequantized tree would produce; its bias comes from
+    `bound_variables` (the dequantized tree the non-intercepted layers
+    consume). Everything else — BatchNorm, non-eligible Dense layers,
+    custom modules — runs untouched. Pure trace-time interception: the
+    lowering is baked into whatever jit/export traces inside the
+    context, so the serialized serving program carries the int8/fp8
+    contractions (auditable via `audit_dot_dtypes`).
+
+    `fired` (optional mutable set) collects the flat payload paths the
+    interceptor ACTUALLY lowered during the traced/eager run. The
+    eligibility map is structural (any deep 2-D kernel), but only
+    kernels owned by an nn.Dense whose module path mirrors the
+    variables path ever intercept — a kernel under nn.Einsum, a custom
+    module, or a lifted transform stays on the dequant path silently.
+    The export records claimed-vs-fired off this set so the
+    compute-attribution surface reports what the program executes, not
+    what the map hoped.
+    """
+    import flax.linen as nn
+
+    channel_nodes: Dict[Tuple[str, ...], Any] = {}
+    for flat_path, meta in layout.items():
+        if meta.get("granularity") != GRAN_CHANNEL:
+            continue
+        parts = tuple(flat_path.split("/"))
+        node = payload_tree
+        for part in parts:
+            node = node[part]
+        channel_nodes[parts] = node
+
+    def _bound(parts: Tuple[str, ...]):
+        node = bound_variables
+        for part in parts:
+            if not isinstance(node, Mapping) or part not in node:
+                return None
+            node = node[part]
+        return node
+
+    def interceptor(next_fun, args, kwargs, context):
+        module = context.module
+        if context.method_name != "__call__" or not isinstance(
+            module, nn.Dense
+        ):
+            return next_fun(*args, **kwargs)
+        parts = ("params",) + tuple(module.path) + ("kernel",)
+        node = channel_nodes.get(parts)
+        if node is None:
+            return next_fun(*args, **kwargs)
+        (x,) = args
+        if fired is not None:
+            fired.add("/".join(parts))
+        y = native_dot(
+            x, jnp.asarray(node[Q_KEY]), jnp.asarray(node[S_KEY]), regime
+        )
+        if module.use_bias:
+            bias = _bound(parts[:-1] + ("bias",))
+            if bias is not None:
+                y = y + jnp.asarray(bias)
+        return y
+
+    if not channel_nodes:
+        yield
+        return
+    with nn.intercept_methods(interceptor):
+        yield
+
+
+# -- the compiled-program dot audit --------------------------------------------
+
+#: MLIR element-type spellings -> the regime-ish names the bench and
+#: metadata report ("i8", "f8e4m3", "f8e5m2", "f32", ...).
+_MLIR_DTYPE_NAMES = {
+    "f8E4M3FN": "f8e4m3",
+    "f8E4M3": "f8e4m3",
+    "f8E5M2": "f8e5m2",
+}
+
+
+def _element_type(tensor_type: str) -> str:
+    """'?x3xi8' / '3x100xf32' / 'f32' -> 'i8' / 'f32' / 'f32'."""
+    element = tensor_type.split("x")[-1].strip()
+    return _MLIR_DTYPE_NAMES.get(element, element)
+
+
+def audit_dot_dtypes(artifact_bytes: bytes) -> Dict[str, int]:
+    """Counts contraction ops in a serialized serving program by operand
+    element type — the compute-attribution audit.
+
+    Deserializes the jax.export artifact and scans its StableHLO module
+    for `dot_general` / `convolution` ops, keying each by its two
+    operand element types ('i8' when both operands are int8, 'f32xf8e4m3'
+    for mixed, ...). This is the artifact-side PROOF that a native
+    regime's matmuls stayed low-precision: a dequant-then-matmul program
+    shows only f32 contractions regardless of what the payload stores.
+    Platform-independent (the audit reads the program, not a backend's
+    optimized HLO), so the CPU proxy attests the same dtypes a TPU would
+    execute.
+    """
+    import re
+
+    from jax import export as jax_export
+
+    text = jax_export.deserialize(bytes(artifact_bytes)).mlir_module()
+    counts: Dict[str, int] = {}
+    # Per-line scan; the greedy prefix pins the LAST `: (tensor<>,
+    # tensor<>)` on the line — the op's type signature. (A lazy/[^:]
+    # prefix would stop at colons INSIDE the op's attribute dict, e.g.
+    # convolution's `batch_group_count = 1 : i64`, and miss the op.)
+    signature = re.compile(
+        r".*:\s*\(tensor<([^>]+)>,\s*tensor<([^>]+)>\)\s*->"
+    )
+    for line in text.splitlines():
+        if "stablehlo.dot_general" not in line and (
+            "stablehlo.convolution" not in line
+        ):
+            continue
+        match = signature.match(line)
+        if match is None:
+            continue
+        lhs, rhs = (_element_type(group) for group in match.groups())
+        key = lhs if lhs == rhs else f"{lhs}x{rhs}"
+        counts[key] = counts.get(key, 0) + 1
+    counts["total"] = sum(counts.values())
+    return counts
 
 
 # -- activation calibration ----------------------------------------------------
@@ -236,8 +638,10 @@ def fake_quant_activations(
     int8: symmetric fake-quant against the calibrated clip (clip ->
     round to 255 levels -> dequantize), so the traced forward sees
     exactly the information an int8 wire carries. fp16: cast through
-    fp16 and back. Keys without a calibration entry (non-float inputs)
-    pass through untouched.
+    fp16 and back. fp8 regimes: scale the calibrated clip onto the
+    format's full range, round-trip through the fp8 dtype (clipped —
+    jax fp8 casts don't saturate), and rescale. Keys without a
+    calibration entry (non-float inputs) pass through untouched.
     """
     out = {}
     for key, value in features.items():
@@ -248,6 +652,11 @@ def fake_quant_activations(
         x = jnp.asarray(value)
         if regime == "fp16":
             out[key] = x.astype(jnp.float16).astype(x.dtype)
+        elif regime in _FP8_FORMATS:
+            dtype, fmax = _FP8_FORMATS[regime]
+            scale = jnp.asarray(clip / fmax, x.dtype)
+            q = (jnp.clip(x, -clip, clip) / scale).astype(dtype)
+            out[key] = q.astype(x.dtype) * scale
         else:
             step = jnp.asarray(clip / 127.0, x.dtype)
             q = jnp.round(jnp.clip(x, -clip, clip) / step)

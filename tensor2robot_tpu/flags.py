@@ -149,9 +149,11 @@ _declare(
     _ENUM,
     "none",
     "Gradient-collective wire format on the ZeRO-2 data-parallel path; "
-    "none keeps the exact GSPMD psum byte-for-byte.",
+    "none keeps the exact GSPMD psum byte-for-byte. fp8_e4m3/fp8_e5m2 "
+    "are the blockwise fp8 formats (1 byte/element, relative rounding) "
+    "with the same error-feedback residual discipline as int8.",
     "tensor2robot_tpu/parallel/collectives.py",
-    choices=("none", "fp16", "int8"),
+    choices=("none", "fp16", "int8", "fp8_e4m3", "fp8_e5m2"),
 )
 _declare(
     "T2R_COMPILE_CACHE_DIR",
@@ -433,15 +435,33 @@ _declare(
     "tensor2robot_tpu/serving/server.py",
 )
 _declare(
+    "T2R_SERVE_NATIVE_LAYERS",
+    _STR,
+    None,
+    "Per-layer eligibility override for NATIVE low-precision matmuls in "
+    "quantized serving exports (export/serve_quant.py): unset or 'auto' "
+    "= the default map (2-D '.../kernel' leaves run int8/fp8 "
+    "dot_general with scales applied to the accumulator); 'none' = "
+    "disable native lowering (every layer dequantizes before the "
+    "matmul, the pre-round-16 path); anything else = comma-separated "
+    "fnmatch globs over flat param paths selecting WHICH structurally-"
+    "eligible layers lower natively (parity-fragile layers stay on the "
+    "dequant path).",
+    "tensor2robot_tpu/export/serve_quant.py",
+)
+_declare(
     "T2R_SERVE_QUANT",
     _ENUM,
     "none",
     "Low-precision serving regime for exported-artifact predictors: "
-    "fp16/int8 serve the export's blockwise-scaled quantized payload "
-    "(export/serve_quant.py) with dequant fused into the jitted serving "
-    "fn; none is bit-exact to the unquantized serving path.",
+    "fp16/int8/fp8_e4m3/fp8_e5m2 serve the export's blockwise-scaled "
+    "quantized payload (export/serve_quant.py) with dequant fused into "
+    "the jitted serving fn — and, for int8/fp8 regimes, eligible dense "
+    "contractions executed NATIVELY on the quantized operands "
+    "(T2R_SERVE_NATIVE_LAYERS); none is bit-exact to the unquantized "
+    "serving path.",
     "tensor2robot_tpu/export/saved_model.py",
-    choices=("none", "fp16", "int8"),
+    choices=("none", "fp16", "int8", "fp8_e4m3", "fp8_e5m2"),
 )
 _declare(
     "T2R_SERVE_DEADLINE_MS",

@@ -8,9 +8,9 @@ all-reduce recovers most of that bandwidth at negligible quality cost.
 This module is the single home for that machinery:
 
   * a registry of `GradientCollective`s — `none` (exact fp32, lowering to
-    the same psum_scatter/all_gather GSPMD emits), `fp16` and `int8`
-    (blockwise per-block scales) — selected by the central
-    `T2R_COLLECTIVE_QUANT` / `T2R_COLLECTIVE_BLOCK` flags;
+    the same psum_scatter/all_gather GSPMD emits), `fp16`, `int8`,
+    `fp8_e4m3` and `fp8_e5m2` (blockwise per-block scales) — selected by
+    the central `T2R_COLLECTIVE_QUANT` / `T2R_COLLECTIVE_BLOCK` flags;
   * error feedback: both quantized collectives return the dequantized
     copy of what was actually transmitted, so the caller can carry
     `sent - intended` as a residual and re-inject it next step (the
@@ -284,6 +284,50 @@ class Int8Collective(BlockScaledCollective):
         return n_elements + 4 * (n_elements // self.block)
 
 
+class Fp8Collective(BlockScaledCollective):
+    """Blockwise-scaled fp8: each block is normalized so its max-abs maps
+    to the format's largest finite value (the full exponent range earns
+    its keep, unlike a [-1, 1] normalization), clipped, then cast. The
+    clip is load-bearing: jax fp8 casts do NOT saturate — an overflow
+    becomes NaN, and one NaN would poison the whole reduced shard. Same
+    wire cost as int8 (1 byte/element + 4/block); the trade is rounding
+    that is RELATIVE per element (floating mantissa) instead of absolute
+    per block, which favors gradients whose blocks mix magnitudes.
+    `decode` is the shared BlockScaledCollective body — fp8 payloads are
+    bit-compatible with the rest of the registry's q/s wire format.
+    """
+
+    _DTYPE = None  # subclass: the ml_dtypes fp8 storage dtype
+    _MAX = 0.0  # subclass: largest finite value of the format
+
+    def encode(self, x):
+        blocks = _block_view(x, self.block)
+        scales = _block_scales(blocks) / self._MAX
+        values = jnp.clip(
+            blocks / scales[..., None], -self._MAX, self._MAX
+        ).astype(self._DTYPE)
+        return {"q": values.reshape(x.shape), "s": scales}
+
+    def wire_bytes(self, n_elements: int) -> int:
+        return n_elements + 4 * (n_elements // self.block)
+
+
+class Fp8E4M3Collective(Fp8Collective):
+    """fp8 e4m3 (3 mantissa bits, max 448): ~2^-4 relative rounding —
+    the precision-leaning fp8 format."""
+
+    _DTYPE = jnp.float8_e4m3fn
+    _MAX = 448.0
+
+
+class Fp8E5M2Collective(Fp8Collective):
+    """fp8 e5m2 (2 mantissa bits, max 57344): ~2^-3 relative rounding —
+    the range-leaning fp8 format (bfloat16's dynamic range, halved)."""
+
+    _DTYPE = jnp.float8_e5m2
+    _MAX = 57344.0
+
+
 # -- the registry --------------------------------------------------------------
 
 _REGISTRY: Dict[str, Callable[[int], GradientCollective]] = {}
@@ -304,6 +348,12 @@ def register_collective(name: str):
 register_collective("none")(lambda block: ExactCollective("none", block))
 register_collective("fp16")(lambda block: Fp16Collective("fp16", block))
 register_collective("int8")(lambda block: Int8Collective("int8", block))
+register_collective("fp8_e4m3")(
+    lambda block: Fp8E4M3Collective("fp8_e4m3", block)
+)
+register_collective("fp8_e5m2")(
+    lambda block: Fp8E5M2Collective("fp8_e5m2", block)
+)
 
 
 def available_collectives() -> Tuple[str, ...]:
@@ -321,9 +371,14 @@ def get_collective(
         block = flags.get_int("T2R_COLLECTIVE_BLOCK")
     factory = _REGISTRY.get(name)
     if factory is None:
+        # Name the selector AND the menu: a typo'd regime must tell the
+        # operator what values exist and which flag picks one (the same
+        # name-the-flag discipline as the flags.py getters).
         raise KeyError(
-            f"unknown collective {name!r}; registered: "
-            f"{', '.join(available_collectives())}"
+            f"unknown collective {name!r}; available regimes: "
+            f"{', '.join(available_collectives())} "
+            "(selected by T2R_COLLECTIVE_QUANT, block size by "
+            "T2R_COLLECTIVE_BLOCK)"
         )
     return factory(block)
 

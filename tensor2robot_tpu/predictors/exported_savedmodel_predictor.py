@@ -314,6 +314,14 @@ class ExportedSavedModelPredictor(AbstractPredictor):
         return getattr(loaded, "quant_regime", "none") if loaded else "none"
 
     @property
+    def native_dot_layers(self) -> tuple:
+        """Layers the loaded artifact contracts natively in the storage
+        dtype (ExportedModel.native_dot_layers); empty before restore,
+        under 'none', or when the export's parity gate demoted the map."""
+        loaded = self.loaded_model
+        return tuple(getattr(loaded, "native_dot_layers", ()) or ())
+
+    @property
     def restore_thread_leaked(self) -> bool:
         """True when close() gave up waiting on a restore thread (it keeps
         polling until its own timeout; fleet monitors should surface it)."""

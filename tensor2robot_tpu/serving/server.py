@@ -541,6 +541,14 @@ class PolicyServer:
         regime = getattr(self._predictor, "quant_regime", None)
         if regime is not None:
             snap["serve_quant"] = regime
+            if regime != "none":
+                # Which layers the loaded regime contracts NATIVELY in
+                # its storage dtype (empty = pure dequant path, e.g.
+                # fp16 or a parity-demoted map) — compute attribution
+                # per replica, next to the regime it belongs to.
+                snap["serve_quant_native_layers"] = list(
+                    getattr(self._predictor, "native_dot_layers", ()) or ()
+                )
         # Per-bucket restore tier ("aot" = deserialized executable,
         # "cache"/"compile" = the fallback tiers): the boot-attribution
         # surface the router/autoscaler snapshots and the bench's

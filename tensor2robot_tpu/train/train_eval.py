@@ -291,12 +291,15 @@ class CompiledModel:
           gradient collectives (parallel/collectives.py). None reads the
           central T2R_COLLECTIVE_QUANT / T2R_COLLECTIVE_BLOCK flags;
           'none' (the default) keeps today's GSPMD-inserted psum
-          byte-for-byte. 'fp16'/'int8' switch the shard_weight_update
-          regime to an EXPLICIT shard_map step: blockwise-quantized
-          reduce-scatter of gradients + all-gather of updates with
-          per-block scales, and an error-feedback residual carried in
-          the train state (re-injected next step, so the compression
-          bias cancels and convergence is preserved). Only engages in
+          byte-for-byte. 'fp16'/'int8'/'fp8_e4m3'/'fp8_e5m2' switch the
+          shard_weight_update regime to an EXPLICIT shard_map step:
+          blockwise-quantized reduce-scatter of gradients + all-gather
+          of updates with per-block scales, and an error-feedback
+          residual carried in the train state (re-injected next step,
+          so the compression bias cancels and convergence is
+          preserved). The fp8 formats move the same 1 byte/element as
+          int8 but round RELATIVE per value (e4m3 ~2^-4, e5m2 ~2^-3)
+          instead of absolute per block. Only engages in
           the pure data-parallel ZeRO-2 regime (shard_weight_update on,
           data axis > 1, all other axes 1) — ignored elsewhere, so the
           env flag can stay set fleet-wide. In this regime optimizer
@@ -773,6 +776,13 @@ class CompiledModel:
             jax.jit(eval_step, static_argnums=(2,))
         )
         self.predict_step = _serialize_dispatch(jax.jit(predict_step))
+        # The un-jitted forward, for callers that must control tracing
+        # themselves: a serving fn that rewrites the forward at trace
+        # time (serve_quant.native_lowering's flax interception) cannot
+        # go through the jitted version — an eager call with avals the
+        # jit cache has already seen would silently execute the OLD
+        # program, interception skipped.
+        self.predict_step_fn = predict_step
 
     def init_state(self, rng: jax.Array, example_batch) -> TrainState:
         # The model initializes at its own (post-preprocess) contract: run the

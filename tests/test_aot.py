@@ -77,10 +77,13 @@ def export_root(trained, tmp_path_factory):
 
 @pytest.fixture(scope="module")
 def quant_export_root(trained, tmp_path_factory):
+    """int8 + fp8_e4m3 regimes — both NATIVE by default since round 16
+    (eligible kernels contract in the storage dtype), so the AOT tests
+    below also pin the restore ladder for native-compute artifacts."""
     return _export(
         trained,
         str(tmp_path_factory.mktemp("aot_quant")),
-        serve_quant=("int8",),
+        serve_quant=("int8", "fp8_e4m3"),
     )
 
 
@@ -216,15 +219,27 @@ class TestRestoreLadder:
         for key in want:
             np.testing.assert_array_equal(got[key], want[key])
 
-    def test_quant_regime_aot_hit_bitwise(self, quant_export_root, monkeypatch):
+    @pytest.mark.parametrize("regime", ["int8", "fp8_e4m3"])
+    def test_quant_regime_aot_hit_bitwise(
+        self, quant_export_root, monkeypatch, regime
+    ):
         path = latest_export_dir(quant_export_root)
-        loaded = ExportedModel(path, quant_regime="int8")
+        loaded = ExportedModel(path, quant_regime=regime)
         assert sorted(loaded.aot_executables) == list(BUCKETS)
+        # The regime under test is genuinely NATIVE (its program carries
+        # int8/fp8 contractions) — the claim is an AOT cold boot of a
+        # native-compute artifact with zero fresh compiles, not just a
+        # dequant payload riding serialized executables.
+        assert loaded.native_dot_layers, loaded.metadata["serve_quant"]
+        with open(os.path.join(path, "t2r_metadata.json")) as f:
+            audit = json.load(f)["serve_quant"]["dot_audit"][regime]
+        native_key = {"int8": "i8", "fp8_e4m3": "f8e4m3"}[regime]
+        assert audit.get(native_key, 0) >= 1, audit
         features = _example()
         got = loaded.predict(features)
         assert loaded.fresh_trace_calls == 0
         want = _fresh_outputs(
-            path, features, quant_regime="int8", monkeypatch=monkeypatch
+            path, features, quant_regime=regime, monkeypatch=monkeypatch
         )
         for key in want:
             np.testing.assert_array_equal(got[key], want[key])

@@ -62,22 +62,49 @@ def _run_all_gather(coll, shards_global):
     return np.asarray(full), np.asarray(sent)
 
 
+#: Per-format quantization step as a fraction of the block max-abs:
+#: half of each is the worst-case per-element rounding error. int8 is an
+#: ABSOLUTE step (scale/127); the float formats round RELATIVE to the
+#: value (<= the block max), with 10 mantissa bits for fp16, 3 for
+#: fp8_e4m3, 2 for fp8_e5m2.
+STEP_FACTORS = {
+    "fp16": 2.0 ** -10,
+    "int8": 1 / 127.0,
+    "fp8_e4m3": 2.0 ** -3,
+    "fp8_e5m2": 2.0 ** -2,
+}
+
+QUANT_NAMES = sorted(STEP_FACTORS)
+ALL_NAMES = ["none"] + QUANT_NAMES
+
+
 class TestQuantizers:
-    @pytest.mark.parametrize("name,rtol", [("fp16", 2e-3), ("int8", 1.0)])
-    def test_roundtrip_error_bound(self, name, rtol):
+    @pytest.mark.parametrize("name", QUANT_NAMES)
+    def test_roundtrip_error_bound(self, name):
         coll = collectives.get_collective(name, BLOCK)
         x = jnp.asarray(_rows(0)[0])
         decoded = np.asarray(coll.decode(coll.encode(x)))
         blocks = np.asarray(x).reshape(N, L // BLOCK, BLOCK)
-        # Per-element error bounded by the block scale's quantile: half a
-        # step for int8 (scale/127), fp16 relative precision of the
-        # normalized value times the block max.
         scale = np.abs(blocks).max(axis=-1, keepdims=True)
-        step = scale / 127.0 if name == "int8" else scale * 2.0 ** -10
+        step = scale * STEP_FACTORS[name]
         err = np.abs(decoded.reshape(blocks.shape) - blocks)
         assert (err <= step * 0.5 * (1 + 1e-6) + 1e-12).all()
 
-    @pytest.mark.parametrize("name", ["none", "fp16", "int8"])
+    @pytest.mark.parametrize("name", ["fp8_e4m3", "fp8_e5m2"])
+    def test_fp8_encode_is_finite_and_1_byte(self, name):
+        """The clip before the fp8 cast is load-bearing: jax fp8 casts
+        don't saturate, so a block max landing ABOVE the format max
+        after rounding would decode as NaN and poison the reduced
+        shard. Large-magnitude rows + payload dtype/size pinned."""
+        coll = collectives.get_collective(name, BLOCK)
+        x = jnp.asarray(_rows(5, scale=1e4)[0])
+        payload = coll.encode(x)
+        assert np.asarray(payload["q"]).dtype.itemsize == 1
+        decoded = np.asarray(coll.decode(payload))
+        assert np.isfinite(decoded).all()
+        assert coll.wire_bytes(1 << 20) == (1 << 20) + 4 * ((1 << 20) // BLOCK)
+
+    @pytest.mark.parametrize("name", ALL_NAMES)
     def test_deterministic(self, name):
         coll = collectives.get_collective(name, BLOCK)
         x = jnp.asarray(_rows(3)[0])
@@ -94,6 +121,18 @@ class TestQuantizers:
     def test_unknown_collective_rejected(self):
         with pytest.raises(KeyError, match="unknown collective"):
             collectives.get_collective("int4", BLOCK)
+
+    def test_unknown_collective_names_flag_and_available_regimes(self):
+        """The resolution error is an operator surface: it must name the
+        registered regimes AND the flag that selects one, like the
+        flags.py getters do."""
+        with pytest.raises(KeyError) as err:
+            collectives.get_collective("int4", BLOCK)
+        message = str(err.value)
+        assert "T2R_COLLECTIVE_QUANT" in message
+        for name in collectives.available_collectives():
+            assert name in message
+        assert "fp8_e4m3" in message  # the registry carries the fp8 regimes
 
     def test_block_divisibility_enforced(self):
         coll = collectives.get_collective("int8", BLOCK)
@@ -112,10 +151,9 @@ class TestCollectiveParity:
         np.testing.assert_allclose(reduced, expected, rtol=1e-6, atol=1e-5)
         np.testing.assert_array_equal(sent.reshape(rows.shape), rows)
 
-    @pytest.mark.parametrize(
-        "name,tol_steps", [("fp16", 2.0 ** -10), ("int8", 1 / 127.0)]
-    )
-    def test_quantized_reduce_scatter_within_tolerance(self, name, tol_steps):
+    @pytest.mark.parametrize("name", QUANT_NAMES)
+    def test_quantized_reduce_scatter_within_tolerance(self, name):
+        tol_steps = STEP_FACTORS[name]
         rows = _rows(2)
         coll = collectives.get_collective(name, BLOCK)
         reduced, sent = _run_reduce_scatter(coll, rows)
@@ -128,7 +166,7 @@ class TestCollectiveParity:
         err = rows - sent.reshape(rows.shape)
         assert np.abs(err).max() <= 0.5 * np.abs(rows).max() * tol_steps * 1.01
 
-    @pytest.mark.parametrize("name", ["none", "fp16", "int8"])
+    @pytest.mark.parametrize("name", ALL_NAMES)
     def test_all_gather_parity(self, name):
         shards = _rows(4)[:, 0, :]  # [N, L]
         coll = collectives.get_collective(name, BLOCK)
@@ -141,8 +179,10 @@ class TestCollectiveParity:
         np.testing.assert_array_equal(
             full[0].reshape(N, L), sent.reshape(N, L)
         )
-        tol = 0 if name == "none" else np.abs(shards).max() * 1.01 * (
-            2.0 ** -10 if name == "fp16" else 0.5 / 127.0
+        tol = (
+            0
+            if name == "none"
+            else np.abs(shards).max() * 1.01 * 0.5 * STEP_FACTORS[name]
         )
         np.testing.assert_allclose(
             full[0].reshape(N, L), shards, atol=tol + 1e-12, rtol=0
@@ -184,6 +224,11 @@ class TestFlatShardLayout:
             collectives.get_collective("none", 512), n
         )
         assert pre0 == post0
+        for name in ("fp8_e4m3", "fp8_e5m2"):
+            pre8, post8 = collectives.wire_summary(
+                collectives.get_collective(name, 512), n
+            )
+            assert pre8 / post8 >= 3.5  # same byte win as int8
 
 
 def _setup(batch_size=16, seed=0, **kwargs):
@@ -224,7 +269,16 @@ class TestQuantizedZero2Step:
 
     @pytest.mark.parametrize(
         "quant,loss_tol,param_tol",
-        [("fp16", 2e-4, 2e-3), ("int8", 2e-3, 2e-2)],
+        [
+            ("fp16", 2e-4, 2e-3),
+            ("int8", 2e-3, 2e-2),
+            # fp8 wire formats: same 1 byte/element as int8, relative
+            # rounding; error feedback keeps the trajectory pinned to
+            # the exact path (measured ~3e-4 loss / ~6e-3 param drift
+            # over 10 steps — tolerances carry ~5x headroom).
+            ("fp8_e4m3", 2e-3, 2e-2),
+            ("fp8_e5m2", 5e-3, 5e-2),
+        ],
     )
     def test_loss_parity_with_exact(self, quant, loss_tol, param_tol):
         compiled_e, state_e, batch = _setup()
@@ -302,11 +356,12 @@ class TestQuantizedZero2Step:
         # The residual is live (int8 on real gradients cannot be exact).
         assert np.abs(res0["grad"]).max() > 0
 
-    def test_checkpoint_roundtrip_of_residual(self, tmp_path):
+    @pytest.mark.parametrize("quant", ["int8", "fp8_e4m3"])
+    def test_checkpoint_roundtrip_of_residual(self, tmp_path, quant):
         """Save mid-run, restore into a FRESH trainer, continue: the
         trajectory must match the uninterrupted run exactly — which can
         only hold if the residual state round-trips the checkpoint."""
-        kwargs = dict(collective_quant="int8", collective_block=BLOCK)
+        kwargs = dict(collective_quant=quant, collective_block=BLOCK)
         compiled, state, batch = _setup(**kwargs)
         state, _ = _run_steps(compiled, state, batch, 3)
         manager = train_eval.create_checkpoint_manager(

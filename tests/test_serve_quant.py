@@ -379,8 +379,11 @@ class TestLoadRegimes:
     def test_flag_declared(self):
         assert t2r_flags.get_enum("T2R_SERVE_QUANT") == "none"
         spec = t2r_flags.get_flag("T2R_SERVE_QUANT")
-        assert spec.choices == ("none", "fp16", "int8")
+        assert spec.choices == (
+            "none", "fp16", "int8", "fp8_e4m3", "fp8_e5m2"
+        )
         assert t2r_flags.get_str("T2R_COMPILE_CACHE_DIR") is None
+        assert t2r_flags.get_str("T2R_SERVE_NATIVE_LAYERS") is None
 
 
 # -- exporter -> predictor -> server round trip --------------------------------
@@ -611,3 +614,496 @@ class TestCompileCache:
         monkeypatch.setenv("T2R_COMPILE_CACHE_DIR", "/tmp/t2r_cache_pin")
         monkeypatch.delenv("T2R_SERVE_BUCKETS", raising=False)
         assert enable_compile_cache_for(_Loaded()) is None
+
+
+# -- native low-precision compute (round 16) -----------------------------------
+
+
+@pytest.fixture(scope="module")
+def native_export(trained, tmp_path_factory):
+    """One export carrying every native-compute regime alongside the
+    default artifact (MockT2RModel: Dense_0 is a 3-row kernel — too
+    shallow for native eligibility — so the payload is genuinely MIXED
+    granularity and the audit shows both native and f32 contractions)."""
+    return _export(
+        trained,
+        tmp_path_factory.mktemp("native_export"),
+        serve_quant=("int8", "fp8_e4m3", "fp8_e5m2"),
+    )
+
+
+NATIVE_REGIMES = ("int8", "fp8_e4m3", "fp8_e5m2")
+
+
+def _mlp_tree(seed=0, din=64, dh=96):
+    rng = np.random.RandomState(seed)
+    return {
+        "params": {
+            "Dense_0": {
+                "kernel": (rng.randn(din, dh) * 0.3).astype(np.float32),
+                "bias": (rng.randn(dh) * 0.1).astype(np.float32),
+            },
+            "Dense_1": {
+                "kernel": (rng.randn(dh, 4) * 0.3).astype(np.float32),
+                "bias": (rng.randn(4) * 0.1).astype(np.float32),
+            },
+        }
+    }
+
+
+class TestNativeEligibility:
+    def test_default_map_takes_deep_2d_kernels_only(self):
+        tree = {
+            "params": {
+                "deep": {"kernel": np.ones((64, 32), np.float32)},
+                "shallow": {"kernel": np.ones((3, 128), np.float32)},
+                "conv": {"kernel": np.ones((3, 3, 8, 8), np.float32)},
+                "deep2": {"bias": np.ones((64,), np.float32)},
+            }
+        }
+        eligible = sq.default_native_eligibility(tree, "int8")
+        assert eligible == ("params/deep/kernel",)
+        # fp16 is a cast regime: no native leg at all.
+        assert sq.default_native_eligibility(tree, "fp16") == ()
+
+    def test_override_flag_none_and_globs(self, monkeypatch):
+        tree = {
+            "params": {
+                "a": {"kernel": np.ones((64, 32), np.float32)},
+                "b": {"kernel": np.ones((64, 32), np.float32)},
+            }
+        }
+        monkeypatch.setenv("T2R_SERVE_NATIVE_LAYERS", "none")
+        assert sq.resolve_native_eligibility(tree, "int8") == ()
+        monkeypatch.setenv("T2R_SERVE_NATIVE_LAYERS", "auto")
+        assert len(sq.resolve_native_eligibility(tree, "int8")) == 2
+        monkeypatch.setenv("T2R_SERVE_NATIVE_LAYERS", "params/a/*")
+        assert sq.resolve_native_eligibility(tree, "int8") == (
+            "params/a/kernel",
+        )
+        # A glob can only DEMOTE among structural candidates, never
+        # promote an ineligible leaf.
+        monkeypatch.setenv("T2R_SERVE_NATIVE_LAYERS", "params/*/bias")
+        assert sq.resolve_native_eligibility(tree, "int8") == ()
+
+    def test_quantize_tree_validates_native_paths(self):
+        tree = {"params": {"d": {"kernel": np.ones((64, 8), np.float32)}}}
+        with pytest.raises(ValueError, match="not found"):
+            sq.quantize_tree(tree, "int8", native=("params/missing/kernel",))
+        bad = {"params": {"d": {"kernel": np.ones((64,), np.float32)}}}
+        with pytest.raises(ValueError, match="2-D"):
+            sq.quantize_tree(bad, "int8", native=("params/d/kernel",))
+        with pytest.raises(ValueError, match="native dot lowering"):
+            sq.quantize_tree(tree, "fp16", native=("params/d/kernel",))
+
+    def test_regime_error_names_the_flag(self):
+        with pytest.raises(ValueError, match="T2R_SERVE_QUANT"):
+            sq.quantize_tree({}, "int4")
+
+
+class TestChannelPayload:
+    @pytest.mark.parametrize("regime", NATIVE_REGIMES)
+    def test_channel_nodes_keep_shape_and_storage_dtype(self, regime):
+        tree = _mlp_tree()
+        native = sq.default_native_eligibility(tree, regime)
+        assert native == (
+            "params/Dense_0/kernel", "params/Dense_1/kernel",
+        )
+        payload, layout = sq.quantize_tree(tree, regime, native=native)
+        node = payload["params"]["Dense_0"]["kernel"]
+        kernel = tree["params"]["Dense_0"]["kernel"]
+        assert node[sq.Q_KEY].shape == kernel.shape  # NOT raveled
+        assert node[sq.Q_KEY].dtype.itemsize == 1
+        assert node[sq.S_KEY].shape == (kernel.shape[1],)  # per channel
+        assert layout["params/Dense_0/kernel"]["granularity"] == "channel"
+        assert layout["params/Dense_0/bias"]["granularity"] == "block"
+        # Channel dequant reconstructs within the format's step.
+        deq = np.asarray(
+            sq.dequantize_tree(payload, layout, regime)["params"]["Dense_0"][
+                "kernel"
+            ]
+        )
+        col_max = np.abs(kernel).max(axis=0)
+        step = {
+            "int8": col_max / 127.0,
+            "fp8_e4m3": col_max * 2.0 ** -3,
+            "fp8_e5m2": col_max * 2.0 ** -2,
+        }[regime]
+        assert (np.abs(deq - kernel) <= step[None, :] * 0.5 * 1.01).all()
+
+    @pytest.mark.parametrize("regime", NATIVE_REGIMES)
+    def test_native_dot_matches_dequant_reference(self, regime):
+        """native_dot (quantized operands, scales on the accumulator) vs
+        the dequantize-then-f32-matmul reference over the SAME payload:
+        the only extra error is the per-row activation quantization."""
+        tree = _mlp_tree(seed=3)
+        kernel = tree["params"]["Dense_0"]["kernel"]
+        payload, layout = sq.quantize_tree(
+            tree, regime, native=("params/Dense_0/kernel",)
+        )
+        node = payload["params"]["Dense_0"]["kernel"]
+        x = np.random.RandomState(4).uniform(-2, 2, (8, 64)).astype(
+            np.float32
+        )
+        native = np.asarray(
+            sq.native_dot(
+                jnp.asarray(x),
+                jnp.asarray(node[sq.Q_KEY]),
+                jnp.asarray(node[sq.S_KEY]),
+                regime,
+            )
+        )
+        deq = np.asarray(
+            sq.dequantize_tree(payload, layout, regime)["params"]["Dense_0"][
+                "kernel"
+            ]
+        )
+        reference = x @ deq
+        # Activation rounding: half a step per element, depth-64 dot.
+        act_step = {"int8": 1 / 127.0, "fp8_e4m3": 2.0 ** -3,
+                    "fp8_e5m2": 2.0 ** -2}[regime]
+        bound = (
+            0.5 * act_step * np.abs(x).max(axis=-1, keepdims=True)
+            * np.abs(deq).sum(axis=0)[None, :]
+        )
+        assert (np.abs(native - reference) <= bound + 1e-5).all()
+
+    def test_zero_row_is_safe(self):
+        """An all-zero activation row (bucket padding) must not divide
+        by zero or emit NaN through the dynamic per-row scale."""
+        tree = _mlp_tree()
+        payload, _ = sq.quantize_tree(
+            tree, "int8", native=("params/Dense_0/kernel",)
+        )
+        node = payload["params"]["Dense_0"]["kernel"]
+        out = np.asarray(
+            sq.native_dot(
+                jnp.zeros((2, 64)), jnp.asarray(node[sq.Q_KEY]),
+                jnp.asarray(node[sq.S_KEY]), "int8",
+            )
+        )
+        np.testing.assert_array_equal(out, np.zeros_like(out))
+
+
+class TestNativeLoweringInterception:
+    @pytest.mark.parametrize("regime", NATIVE_REGIMES)
+    def test_intercepts_eligible_dense_only(self, regime):
+        import flax.linen as nn
+
+        class Net(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                x = nn.relu(nn.Dense(96)(x))
+                return nn.Dense(4)(x)
+
+        tree = _mlp_tree(seed=5)
+        # Only Dense_0 native; Dense_1 stays on the dequant path.
+        payload, layout = sq.quantize_tree(
+            tree, regime, native=("params/Dense_0/kernel",)
+        )
+        bound = sq.dequantize_tree(payload, layout, regime)
+        net = Net()
+        x = np.random.RandomState(6).uniform(-1, 1, (4, 64)).astype(
+            np.float32
+        )
+        plain = np.asarray(net.apply({"params": bound["params"]}, x))
+        with sq.native_lowering(payload, layout, regime, bound):
+            lowered = np.asarray(net.apply({"params": bound["params"]}, x))
+        # The native path genuinely diverges from the dequant matmul
+        # (activation quantization) but stays within the regime's step.
+        assert np.abs(lowered - plain).max() > 0
+        assert np.abs(lowered - plain).max() < 0.5
+        # Outside the context the plain path is untouched.
+        again = np.asarray(net.apply({"params": bound["params"]}, x))
+        np.testing.assert_array_equal(again, plain)
+
+    def test_empty_eligibility_is_identity(self):
+        tree = _mlp_tree(seed=7)
+        payload, layout = sq.quantize_tree(tree, "int8", native=())
+        bound = sq.dequantize_tree(payload, layout, "int8")
+        import flax.linen as nn
+
+        class Net(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                return nn.Dense(96)(x)
+
+        net = Net()
+        x = np.ones((2, 64), np.float32)
+        plain = np.asarray(net.apply({"params": bound["params"]}, x))
+        with sq.native_lowering(payload, layout, "int8", bound):
+            lowered = np.asarray(net.apply({"params": bound["params"]}, x))
+        np.testing.assert_array_equal(lowered, plain)
+
+
+class TestNativeExport:
+    def test_metadata_records_native_contract(self, native_export):
+        path, _ = native_export
+        with open(os.path.join(path, "t2r_metadata.json")) as f:
+            quant = json.load(f)["serve_quant"]
+        assert quant["regimes"] == sorted(NATIVE_REGIMES)
+        for regime in NATIVE_REGIMES:
+            native = quant["native"][regime]
+            assert native["demoted"] is False
+            # Dense_0 (3 rows) is too shallow; the deep kernels lower.
+            assert native["layers"] == [
+                "params/Dense_1/kernel", "params/Dense_2/kernel",
+            ]
+            granularity = quant["granularity"][regime]
+            assert granularity["channel"] == 2
+            assert granularity["block"] > 0  # biases, batch stats, Dense_0
+            parity = quant["parity"][regime]
+            assert max(
+                parity["max_divergence"].values()
+            ) <= parity["tolerance"]
+
+    @pytest.mark.parametrize("regime", NATIVE_REGIMES)
+    def test_artifact_program_audit_proves_native_dots(
+        self, native_export, regime
+    ):
+        """The acceptance check: the SERIALIZED serving program carries
+        >= 1 contraction on int8/fp8 operands — the matmuls stayed
+        low-precision in the compiled artifact, not dequant-then-f32."""
+        path, _ = native_export
+        with open(
+            os.path.join(path, "stablehlo", f"predict_fn_{regime}.bin"), "rb"
+        ) as f:
+            audit = sq.audit_dot_dtypes(f.read())
+        native_key = {"int8": "i8", "fp8_e4m3": "f8e4m3",
+                      "fp8_e5m2": "f8e5m2"}[regime]
+        assert audit.get(native_key, 0) >= 1, audit
+        # The shallow Dense_0 stays on the dequant path: mixed audit.
+        assert audit.get("f32", 0) >= 1, audit
+        # ...and the export recorded the same audit in its metadata.
+        with open(os.path.join(path, "t2r_metadata.json")) as f:
+            recorded = json.load(f)["serve_quant"]["dot_audit"][regime]
+        assert recorded == audit
+
+    def test_dequant_only_regime_audits_all_f32(self, quant_export):
+        """The pre-round-16 regimes (and any demoted map) show ZERO
+        low-precision contractions — the audit genuinely discriminates."""
+        path, _ = quant_export
+        with open(
+            os.path.join(path, "stablehlo", "predict_fn_fp16.bin"), "rb"
+        ) as f:
+            audit = sq.audit_dot_dtypes(f.read())
+        assert audit.get("i8", 0) == 0
+        assert audit.get("f32", 0) >= 1
+
+    @pytest.mark.parametrize("regime", NATIVE_REGIMES)
+    def test_native_regimes_serve_within_recorded_parity(
+        self, native_export, regime
+    ):
+        path, _ = native_export
+        with open(os.path.join(path, "t2r_metadata.json")) as f:
+            tolerance = json.load(f)["serve_quant"]["parity"][regime][
+                "tolerance"
+            ]
+        x = np.random.RandomState(2).uniform(-1, 1, (4, 3)).astype(
+            np.float32
+        )
+        ref = ExportedModel(path, quant_regime="none").predict({"x": x})
+        out = ExportedModel(path, quant_regime=regime).predict({"x": x})
+        diff = np.max(np.abs(out["a_predicted"] - ref["a_predicted"]))
+        assert 0 < diff <= tolerance
+
+    def test_server_snapshot_carries_native_layers(
+        self, native_export, monkeypatch
+    ):
+        _, root = native_export
+        monkeypatch.setenv("T2R_SERVE_QUANT", "int8")
+        predictor = ExportedSavedModelPredictor(export_dir=root)
+        assert predictor.restore()
+        assert predictor.native_dot_layers == (
+            "params/Dense_1/kernel", "params/Dense_2/kernel",
+        )
+        with PolicyServer(predictor, max_wait_ms=1).start() as server:
+            snap = server.snapshot()
+        assert snap["serve_quant"] == "int8"
+        assert snap["serve_quant_native_layers"] == [
+            "params/Dense_1/kernel", "params/Dense_2/kernel",
+        ]
+
+    def test_override_flag_exports_dequant_only(
+        self, trained, tmp_path, monkeypatch
+    ):
+        monkeypatch.setenv("T2R_SERVE_NATIVE_LAYERS", "none")
+        path, _ = _export(trained, tmp_path, serve_quant=("int8",))
+        with open(os.path.join(path, "t2r_metadata.json")) as f:
+            quant = json.load(f)["serve_quant"]
+        assert quant["native"]["int8"]["layers"] == []
+        assert quant["granularity"]["int8"]["channel"] == 0
+        audit = quant["dot_audit"]["int8"]
+        assert audit.get("i8", 0) == 0
+
+
+class TestNativeDemotion:
+    def _stub(self, outputs):
+        def fn(payload, batch):
+            return dict(outputs)
+
+        fn.quant_payload = {}
+        fn.quant_native = ("params/d/kernel",)
+        return fn
+
+    def test_failing_native_fn_demotes_to_dequant(self):
+        from tensor2robot_tpu.export.exporters import _native_pre_gate
+
+        batches = [{"x": np.zeros((1,), np.float32)}]
+        fp32 = [{"q": np.zeros((2,), np.float32)}]
+        bad = self._stub({"q": np.full((2,), 0.9, np.float32)})
+        good = self._stub({"q": np.full((2,), 0.01, np.float32)})
+        good.quant_native = ()
+        fn, demoted = _native_pre_gate(
+            bad, lambda: good, fp32, batches, tolerance=0.1
+        )
+        assert demoted
+        assert fn is good
+        assert fn.quant_native_demoted is True
+
+    def test_passing_native_fn_rides_untouched(self):
+        from tensor2robot_tpu.export.exporters import _native_pre_gate
+
+        batches = [{"x": np.zeros((1,), np.float32)}]
+        fp32 = [{"q": np.zeros((2,), np.float32)}]
+        ok = self._stub({"q": np.full((2,), 0.05, np.float32)})
+        fn, demoted = _native_pre_gate(
+            ok, lambda: pytest.fail("must not rebuild"),
+            fp32, batches, tolerance=0.1,
+        )
+        assert not demoted
+        assert fn is ok
+        assert not getattr(fn, "quant_native_demoted", False)
+
+    def test_nan_native_forward_demotes(self):
+        """A NaN-emitting native lowering must demote (and the final
+        gate still guards the demoted path) — the measure_parity NaN
+        guard rides into the triage."""
+        from tensor2robot_tpu.export.exporters import _native_pre_gate
+
+        batches = [{"x": np.zeros((1,), np.float32)}]
+        fp32 = [{"q": np.zeros((2,), np.float32)}]
+        nan_fn = self._stub(
+            {"q": np.asarray([np.nan, 0.0], np.float32)}
+        )
+        good = self._stub({"q": np.zeros((2,), np.float32)})
+        fn, demoted = _native_pre_gate(
+            nan_fn, lambda: good, fp32, batches, tolerance=1e9
+        )
+        assert demoted and fn is good
+
+
+class TestGateMeasuresTheNativePath:
+    def test_eager_gate_call_runs_the_interceptor_not_a_stale_jit_cache(
+        self, trained
+    ):
+        """Regression: the export parity gates call the quant serving fn
+        EAGERLY, and the fp32 baseline always trains the jitted
+        predict_step's executable cache first with identical avals — if
+        the quant fn routed through that jit, the eager call would
+        execute the cached no-interception program (gate measures the
+        dequant path, artifact serves the native one). Pin: the eager
+        native output must differ from the dequant-matmul twin computed
+        over the SAME per-channel payload."""
+        from tensor2robot_tpu.export.export_generators import (
+            DefaultExportGenerator,
+        )
+        from tensor2robot_tpu.specs import TensorSpecStruct
+
+        compiled, state = trained
+        generator = DefaultExportGenerator()
+        generator.set_specification_from_model(compiled.model)
+        variables = compiled.export_variables(state)
+        batch = {
+            "x": np.random.RandomState(0)
+            .uniform(-1, 1, (4, 3))
+            .astype(np.float32)
+        }
+        # Train the jit cache exactly like save_exported_model does.
+        serving_fn = generator.create_serving_fn(compiled, variables)
+        serving_fn(batch)
+        fn = generator.create_quant_serving_fn(
+            compiled, variables, regime="int8", calibration={}
+        )
+        assert fn.quant_native  # the native map is live
+        eager = np.asarray(
+            fn(fn.quant_payload, batch)["a_predicted"]
+        )
+        # The dequant twin: same payload, same pre/post-processing,
+        # matmuls on the channel-dequantized f32 kernels — what a stale
+        # cache would silently compute.
+        bound = sq.dequantize_tree(fn.quant_payload, fn.quant_layout, "int8")
+        features = TensorSpecStruct(dict(batch))
+        features, _ = generator._preprocessor.preprocess(
+            features, None, mode="predict", rng=None
+        )
+        twin = np.asarray(
+            compiled.predict_step(bound, features)["a_predicted"]
+        )
+        assert np.abs(eager - twin).max() > 0
+
+
+class TestAuditCountsConvolutions:
+    def test_convolution_signature_is_counted(self):
+        """Regression: stablehlo.convolution lines carry colons inside
+        their attribute dict (`batch_group_count = 1 : i64`), which a
+        naive [^:]* prefix regex trips over — the audit must still see
+        the op's trailing type signature."""
+        import flax.linen as nn
+        from jax import export as jax_export
+
+        class Conv(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                return nn.Conv(4, (3, 3))(x)
+
+        module = Conv()
+        x = np.zeros((1, 8, 8, 3), np.float32)
+        variables = module.init(jax.random.PRNGKey(0), x)
+
+        def forward(v, inputs):
+            return module.apply(v, inputs)
+
+        exported = jax_export.export(jax.jit(forward))(
+            variables, jax.ShapeDtypeStruct(x.shape, x.dtype)
+        )
+        audit = sq.audit_dot_dtypes(exported.serialize())
+        assert audit.get("f32", 0) >= 1, audit
+        assert audit["total"] >= 1
+
+
+class TestClaimedVsFired:
+    def test_fired_records_only_intercepted_dense_kernels(self):
+        """The eligibility map is structural; the lowering only fires
+        for nn.Dense-owned kernels. A deep 2-D 'kernel' param on a
+        custom module is claimable but never intercepts — the fired set
+        (what the export records as `layers`) must exclude it."""
+        import flax.linen as nn
+
+        class Custom(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                k = self.param(
+                    "kernel", nn.initializers.lecun_normal(), (96, 8)
+                )
+                return x @ k
+
+        class Net(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                return Custom()(nn.relu(nn.Dense(96)(x)))
+
+        net = Net()
+        x = np.ones((2, 64), np.float32)
+        variables = jax.device_get(net.init(jax.random.PRNGKey(0), x))
+        tree = {"params": variables["params"]}
+        native = sq.default_native_eligibility(tree, "int8")
+        assert set(native) == {
+            "params/Custom_0/kernel", "params/Dense_0/kernel",
+        }
+        payload, layout = sq.quantize_tree(tree, "int8", native=native)
+        bound = sq.dequantize_tree(payload, layout, "int8")
+        fired = set()
+        with sq.native_lowering(payload, layout, "int8", bound, fired=fired):
+            net.apply({"params": bound["params"]}, x)
+        assert fired == {"params/Dense_0/kernel"}

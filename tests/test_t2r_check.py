@@ -229,6 +229,31 @@ class TestLintsCatch:
         assert "env-kind-mismatch" not in clean
         assert "env-unknown-flag" not in clean
 
+    def test_lowprec_flags_covered_by_registry_lint(self):
+        """The round-16 low-precision-compute gates ride the same rails:
+        the new eligibility-override flag is declared (raw reads are
+        env-undeclared, wrong-kind reads are env-kind-mismatch, the
+        declared spelling is clean), and the fp8 regime values are
+        registered choices of the two quant selectors."""
+        assert "env-undeclared" in self._rules(
+            "import os\nx = os.environ.get('T2R_SERVE_NATIVE_LAYERS')\n"
+        )
+        assert "env-kind-mismatch" in self._rules(
+            "from tensor2robot_tpu import flags\n"
+            "x = flags.get_bool('T2R_SERVE_NATIVE_LAYERS')\n"
+        )
+        clean = self._rules(
+            "from tensor2robot_tpu import flags\n"
+            "a = flags.get_str('T2R_SERVE_NATIVE_LAYERS')\n"
+            "b = flags.get_enum('T2R_SERVE_QUANT')\n"
+            "c = flags.get_enum('T2R_COLLECTIVE_QUANT')\n"
+        )
+        assert "env-kind-mismatch" not in clean
+        assert "env-unknown-flag" not in clean
+        for flag_name in ("T2R_SERVE_QUANT", "T2R_COLLECTIVE_QUANT"):
+            choices = flags.get_flag(flag_name).choices
+            assert "fp8_e4m3" in choices and "fp8_e5m2" in choices
+
     def test_replay_flags_covered_by_registry_lint(self):
         """The round-12 T2R_REPLAY_* + T2R_PARSE_ON_ERROR flags ride the
         same rails: raw environ reads are env-undeclared, wrong-kind

@@ -75,6 +75,19 @@ if [ "$TESTS" = 1 ]; then
     status=1
   fi
 
+  echo "== lowprec: fp8 collectives + native low-precision compute (tier-1) =="
+  # Round-16 gates, attributed by name: fp8_e4m3/fp8_e5m2 collective
+  # parity on the 8-device mesh (EF residual + checkpoint roundtrip),
+  # native int8/fp8 matmul lowering (per-channel payloads, Dense
+  # interception, eligibility override, parity-gate demotion), and the
+  # compiled-program dot audit proving matmuls stayed low-precision.
+  if ! JAX_PLATFORMS=cpu python -m pytest tests/test_collectives.py \
+      tests/test_serve_quant.py \
+      -q -m 'not slow' -k "fp8 or native or Native or lowprec" \
+      -p no:cacheprovider; then
+    status=1
+  fi
+
   echo "== aot: serialized-executable restore ladder (tier-1) =="
   # Export-side aot/ layout + metadata key contract, bit-identical
   # AOT-hit serving vs the fresh-compile twin (fp32 and int8), the loud
