@@ -3699,6 +3699,350 @@ def bench_comms(args) -> None:
         _fail("comms_bench", err, metric=metric)
 
 
+def bench_plan(args) -> None:
+    """Sharding-planner leg (`python bench.py plan`).
+
+    On the forced 8-device host mesh (same GSPMD/collective lowering a
+    TPU slice uses): (1) the byte-equality audit — every hand-wired
+    regime vs its planner preset, leaf-for-leaf identical TrainState
+    shardings plus the planner's own layout audit; (2) loss parity of
+    the planner-driven train step vs the hand-wired step for the DP
+    family (none/int8/fp8 — same regime, same program, so the gate is
+    BITWISE, not approximate); (3) the 3D DP x SP x PP (2x2x2) leg that
+    did not exist pre-PR: trains end-to-end with the weight update
+    sharded over BOTH replica axes, gated on loss parity against the
+    hand-wirable DP x PP twin, with per-axis wire-byte attribution from
+    the plan's collective schedule; (4) the ranked factorization table
+    from `plan()` for this host's topology.
+
+    value = fraction of audited presets byte-equal (must be 1.0).
+    """
+    import subprocess
+
+    metric = "plan_preset_byte_equality"
+    if not getattr(args, "inner", False):
+        env = dict(os.environ)
+        kept = [
+            part
+            for part in env.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in part
+        ]
+        env["XLA_FLAGS"] = " ".join(
+            kept + ["--xla_force_host_platform_device_count=8"]
+        )
+        env["JAX_PLATFORMS"] = "cpu"
+        # The leg owns its regimes: ambient plan/quant exports must not
+        # re-plan the hand-wired baselines out from under the audit.
+        for key in (
+            "T2R_PLAN", "T2R_PLAN_MEM_BUDGET",
+            "T2R_COLLECTIVE_QUANT", "T2R_COLLECTIVE_BLOCK",
+        ):
+            env.pop(key, None)
+        proc = subprocess.run(
+            [
+                sys.executable, os.path.abspath(__file__), "plan",
+                "--_inner", "--steps", str(args.steps),
+                "--steps-3d", str(args.steps_3d),
+                "--block", str(args.block), "--out", args.out,
+            ],
+            env=env, text=True, capture_output=True,
+        )
+        if proc.stderr:
+            sys.stderr.write(proc.stderr[-4000:])
+        lines = proc.stdout.strip().splitlines()
+        print(lines[-1] if lines else "")
+        sys.exit(proc.returncode)
+
+    try:
+        import dataclasses
+
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        import jax.flatten_util
+        import numpy as np
+
+        devices = jax.devices()
+        if len(devices) != 8 or devices[0].platform != "cpu":
+            raise RuntimeError(
+                f"expected the forced 8-device host mesh, got {devices}"
+            )
+        from tensor2robot_tpu.models.transformer_models import (
+            TransformerBCModel,
+        )
+        from tensor2robot_tpu.parallel import mesh as mesh_lib
+        from tensor2robot_tpu.parallel import planner
+        from tensor2robot_tpu.specs import make_random_numpy
+        from tensor2robot_tpu.train import train_eval
+        from tensor2robot_tpu.utils.mocks import (
+            MockInputGenerator,
+            MockT2RModel,
+        )
+
+        block = args.block
+
+        def leaf_shardings(state):
+            return [
+                (jax.tree_util.keystr(path), str(leaf.sharding))
+                for path, leaf in jax.tree_util.tree_leaves_with_path(state)
+                if hasattr(leaf, "sharding")
+            ]
+
+        def flat_params(state):
+            return jax.flatten_util.ravel_pytree(
+                jax.device_get(state.params)
+            )[0]
+
+        def mock_setup(plan=None, **kwargs):
+            model = MockT2RModel(device_type="cpu", use_batch_norm=False)
+            generator = MockInputGenerator(batch_size=16, seed=0)
+            generator.set_specification_from_model(model, "train")
+            batch = next(iter(generator.create_dataset("train")))
+            compiled = train_eval.CompiledModel(
+                model, donate_state=False, plan=plan, **kwargs
+            )
+            state = compiled.init_state(jax.random.PRNGKey(0), batch)
+            return compiled, state, batch
+
+        def run_steps(compiled, state, batch, steps):
+            rng = jax.random.PRNGKey(7)
+            metrics = None
+            for _ in range(steps):
+                state, metrics = compiled.train_step(
+                    state, compiled.shard_batch(batch), rng
+                )
+            return state, float(jax.device_get(metrics["loss"]))
+
+        # -- leg 1+2: DP family byte-equality + planner-vs-hand parity --
+        dp_family = {
+            "dp": {},
+            "dp_zero2": dict(shard_weight_update=True),
+            "dp_zero2_int8": dict(
+                shard_weight_update=True, collective_quant="int8",
+                collective_block=block,
+            ),
+            "dp_zero2_fp8_e4m3": dict(
+                shard_weight_update=True, collective_quant="fp8_e4m3",
+                collective_block=block,
+            ),
+            "dp_zero2_fp8_e5m2": dict(
+                shard_weight_update=True, collective_quant="fp8_e5m2",
+                collective_block=block,
+            ),
+        }
+        byte_audit = {}
+        for preset, kwargs in dp_family.items():
+            plan_obj = planner.resolve_preset(preset)
+            if "collective_block" in kwargs:
+                plan_obj = dataclasses.replace(
+                    plan_obj, collective_block=block
+                )
+            hand, state_h, batch = mock_setup(**kwargs)
+            planned, state_p, _ = mock_setup(plan=plan_obj)
+            layouts_equal = leaf_shardings(state_h) == leaf_shardings(
+                state_p
+            )
+            audit = planner.audit_state_layout(
+                plan_obj, planned.mesh, state_p
+            )
+            state_h, loss_h = run_steps(hand, state_h, batch, args.steps)
+            state_p, loss_p = run_steps(
+                planned, state_p, batch, args.steps
+            )
+            bitwise = bool(
+                (flat_params(state_h) == flat_params(state_p)).all()
+            )
+            byte_audit[preset] = {
+                "layouts_equal": layouts_equal,
+                "audit_leaves": audit["leaves"],
+                "audit_mismatches": len(audit["mismatches"]),
+                "hand_loss": loss_h,
+                "planned_loss": loss_p,
+                "loss_abs_diff": abs(loss_h - loss_p),
+                "params_bitwise_equal": bitwise,
+            }
+
+        # -- composed presets: layout-only audit on the transformer --
+        def transformer(mesh, **kwargs):
+            return TransformerBCModel(
+                action_size=2, episode_length=8, image_size=(16, 16),
+                num_layers=2, num_heads=4, mesh=mesh, use_flash=False,
+                **kwargs,
+            )
+
+        def transformer_batch(model, seed=0):
+            return {
+                "features": make_random_numpy(
+                    model.get_feature_specification("train"),
+                    batch_size=8, seed=seed,
+                ),
+                "labels": make_random_numpy(
+                    model.get_label_specification("train"),
+                    batch_size=8, seed=seed + 1,
+                ),
+            }
+
+        composed = {
+            "dp_sp": (dict(data=2, sequence=4), {}, {}),
+            "dp_pp": (
+                dict(data=2, pipe=2),
+                dict(pipeline_stages=2, pipeline_microbatches=2),
+                {},
+            ),
+            "dp_pp_zero2": (
+                dict(data=2, pipe=2),
+                dict(pipeline_stages=2, pipeline_microbatches=2),
+                dict(shard_weight_update=True, param_min_shard_size=0),
+            ),
+        }
+        for preset, (mesh_kwargs, model_kwargs, ckw) in composed.items():
+            plan_obj = planner.resolve_preset(preset)
+            if ckw.get("param_min_shard_size") == 0:
+                plan_obj = dataclasses.replace(
+                    plan_obj, param_min_shard_size=0
+                )
+            n_dev = int(np.prod(list(mesh_kwargs.values())))
+            mesh = mesh_lib.make_mesh(
+                devices=jax.devices()[:n_dev], **mesh_kwargs
+            )
+            model = transformer(mesh, **model_kwargs)
+            batch = transformer_batch(model)
+            hand = train_eval.CompiledModel(
+                model, mesh=mesh, donate_state=False, **ckw
+            )
+            state_h = hand.init_state(jax.random.PRNGKey(0), batch)
+            model_p = transformer(plan_obj.build_mesh(), **model_kwargs)
+            planned = train_eval.CompiledModel(
+                model_p, donate_state=False, plan=plan_obj
+            )
+            state_p = planned.init_state(jax.random.PRNGKey(0), batch)
+            audit = planner.audit_state_layout(
+                plan_obj, planned.mesh, state_p
+            )
+            byte_audit[preset] = {
+                "layouts_equal": leaf_shardings(state_h)
+                == leaf_shardings(state_p),
+                "audit_leaves": audit["leaves"],
+                "audit_mismatches": len(audit["mismatches"]),
+            }
+
+        # -- leg 3: the 3D DP x SP x PP (2x2x2) regime --
+        plan_3d = dataclasses.replace(
+            planner.resolve_preset("dp_sp_pp"), param_min_shard_size=0
+        )
+        model_3d = transformer(
+            plan_3d.build_mesh(),
+            pipeline_stages=2, pipeline_microbatches=2,
+        )
+        batch_3d = transformer_batch(model_3d)
+        compiled_3d = train_eval.CompiledModel(
+            model_3d, donate_state=False, plan=plan_3d
+        )
+        state_3d = compiled_3d.init_state(jax.random.PRNGKey(0), batch_3d)
+        audit_3d = planner.audit_state_layout(
+            plan_3d, compiled_3d.mesh, state_3d
+        )
+        losses_3d = []
+        rng = jax.random.PRNGKey(1)
+        for _ in range(args.steps_3d):
+            state_3d, m = compiled_3d.train_step(
+                state_3d, compiled_3d.shard_batch(batch_3d), rng
+            )
+            losses_3d.append(float(jax.device_get(m["loss"])))
+        # The hand-wirable 2D twin: same model/init/batch on DP x PP.
+        twin_mesh = mesh_lib.make_mesh(data=4, pipe=2)
+        model_2d = transformer(
+            twin_mesh, pipeline_stages=2, pipeline_microbatches=2
+        )
+        compiled_2d = train_eval.CompiledModel(
+            model_2d, mesh=twin_mesh, donate_state=False,
+            shard_weight_update=True, param_min_shard_size=0,
+        )
+        state_2d = compiled_2d.init_state(jax.random.PRNGKey(0), batch_3d)
+        losses_2d = []
+        for _ in range(args.steps_3d):
+            state_2d, m = compiled_2d.train_step(
+                state_2d, compiled_2d.shard_batch(batch_3d), rng
+            )
+            losses_2d.append(float(jax.device_get(m["loss"])))
+        parity_3d = max(
+            abs(a - b) for a, b in zip(losses_3d, losses_2d)
+        )
+        spec_3d = planner.ModelSpec.from_model(model_3d, batch_3d)
+        wire_attribution = plan_3d.collective_schedule(spec_3d)
+
+        # -- leg 4: the ranked factorization table --
+        table = planner.plan(
+            spec_3d, planner.Topology(num_devices=8)
+        ).to_json()
+
+        presets_equal = sum(
+            1 for entry in byte_audit.values() if entry["layouts_equal"]
+        )
+        gates = {
+            "presets_byte_equal": presets_equal == len(byte_audit),
+            "audits_clean": all(
+                entry["audit_mismatches"] == 0
+                for entry in byte_audit.values()
+            ),
+            "dp_family_bitwise": all(
+                entry["params_bitwise_equal"]
+                for name, entry in byte_audit.items()
+                if name in dp_family
+            ),
+            "plan3d_audit_clean": not audit_3d["mismatches"],
+            "plan3d_loss_decreasing": losses_3d[-1] < losses_3d[0],
+            "plan3d_parity_with_2d_twin": parity_3d < 1e-3,
+            "plan3d_wire_bytes_attributed": all(
+                entry["bytes_per_device_step"]
+                for entry in wire_attribution
+            )
+            and {"data", "sequence", "pipe"}
+            <= {a for e in wire_attribution for a in e["axes"]},
+        }
+        value = presets_equal / len(byte_audit)
+        payload = {
+            "metric": metric,
+            "value": value,
+            "unit": "fraction_presets_byte_equal",
+            "vs_baseline": value,
+            "proxy": True,
+            "vs_baseline_note": (
+                "layout equality and bitwise-step checks are exact on the "
+                "8-virtual-device host mesh (same GSPMD partitioner as a "
+                "TPU slice); wire bytes are analytic payload sizes"
+            ),
+            "gates": gates,
+            "detail": {
+                "byte_audit": byte_audit,
+                "plan3d": {
+                    "preset": plan_3d.to_json(),
+                    "losses": losses_3d,
+                    "twin_losses_dp_pp": losses_2d,
+                    "loss_parity_max_abs_diff": parity_3d,
+                    "audit_leaves": audit_3d["leaves"],
+                    "wire_byte_attribution": wire_attribution,
+                },
+                "ranked_plan_table": table,
+                "steps": args.steps,
+                "steps_3d": args.steps_3d,
+                "block": block,
+                "mesh": "8dev_host_platform",
+                "host_cpus": os.cpu_count(),
+            },
+        }
+        _emit(payload)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(payload, f, indent=1)
+        if not all(gates.values()):
+            sys.exit(1)
+    except SystemExit:
+        raise
+    except Exception as err:  # noqa: BLE001
+        _fail("bench_plan", err, metric=metric)
+
+
 def _backend_wait(metric: str = "qtopt_critic_train_mfu_bs64_472px") -> float:
     """BENCH_BACKEND_WAIT, with malformed values reported through the
     one-JSON-line failure contract (under the caller's metric) rather
@@ -4501,6 +4845,38 @@ def _build_cli():
              "default %(default)s)",
     )
     comms.add_argument(
+        "--_inner", dest="inner", action="store_true",
+        help=argparse.SUPPRESS,
+    )
+    plan_leg = leg(
+        "plan", bench_plan,
+        "sharding-planner leg on the forced 8-device host mesh: "
+        "byte-equality audit of planner presets vs the hand-wired "
+        "regimes, bitwise planner-vs-hand DP parity (none/int8/fp8), "
+        "the 3D DP x SP x PP (2x2x2) leg with per-axis wire-byte "
+        "attribution, and the ranked factorization table "
+        "(docs/PARALLELISM.md \"Sharding planner\")",
+    )
+    plan_leg.add_argument(
+        "--steps", type=int, default=4,
+        help="train steps per DP parity twin (default %(default)s)",
+    )
+    plan_leg.add_argument(
+        "--steps-3d", dest="steps_3d", type=int, default=5,
+        help="train steps for the 3D leg and its 2D twin "
+             "(default %(default)s)",
+    )
+    plan_leg.add_argument(
+        "--block", type=int, default=64,
+        help="quantization block for the quantized presets "
+             "(default %(default)s)",
+    )
+    plan_leg.add_argument(
+        "--out", default="BENCH_PLAN_r17.json",
+        help="also write the payload to this file ('' disables; "
+             "default %(default)s)",
+    )
+    plan_leg.add_argument(
         "--_inner", dest="inner", action="store_true",
         help=argparse.SUPPRESS,
     )

@@ -45,6 +45,18 @@ Three rule families, each born from a real failure mode in this codebase:
   manual-axis bookkeeping (`axis_index`, `pvary`/`pcast`) is out of
   scope.
 
+* Sharding discipline (`sharding-outside-planner`) — the sharding
+  planner (`parallel/planner.py`) is the single source of layout truth
+  for the trainer: every PartitionSpec/NamedSharding a train-layer
+  module needs exists as a mesh.py/planner helper (REPLICATED_SPEC,
+  batch_partition_spec, flat_shard_sharding, the plan's rules). Raw
+  `NamedSharding(...)`/`PartitionSpec(...)` construction inside
+  `tensor2robot_tpu/train/` (outside `parallel/`) is an error — a
+  hand-built spec there is exactly the hand-wired layout drift the
+  planner's byte-equality contract exists to end. The few legitimate
+  sites declare themselves with the `@hand_sharded` decorator
+  (parallel/planner.py) so the exemption is grep-able.
+
 * Exception discipline (`swallowed-exception`) — inside
   `tensor2robot_tpu/serving/`, `train/` and `predictors/`, a bare
   `except:` is always an error (it eats KeyboardInterrupt/SystemExit),
@@ -160,6 +172,14 @@ _NP_MATERIALIZERS = frozenset(
 )
 _NP_MODULE_ALIASES = frozenset({"np", "numpy"})
 
+# Sharding discipline: where raw NamedSharding/PartitionSpec
+# construction is banned (the planner/mesh helpers are the sanctioned
+# spellings), and the decorator (parallel/planner.py) that allowlists a
+# legitimate hand-sharded site.
+_SHARDING_SCOPE_FRAGMENTS = ("tensor2robot_tpu/train/",)
+_SHARDING_ALLOW_DECORATOR = "hand_sharded"
+_SHARDING_CONSTRUCTORS = frozenset({"NamedSharding", "PartitionSpec"})
+
 # Collective discipline: the trainer layers where raw jax collectives
 # are banned, and the one file allowed to spell them.
 _COLLECTIVE_SCOPE_FRAGMENTS = (
@@ -237,6 +257,14 @@ class _Visitor(ast.NodeVisitor):
             fragment in norm_path for fragment in _SWALLOW_SCOPE_FRAGMENTS
         )
         self._swallow_allow_depth = 0
+        self.in_sharding_scope = any(
+            fragment in norm_path for fragment in _SHARDING_SCOPE_FRAGMENTS
+        )
+        self._sharding_allow_depth = 0
+        # Aliases bound to the jax.sharding constructors in this file
+        # (`from jax.sharding import PartitionSpec as P`): `P(...)` must
+        # trip the sharding gate exactly like `PartitionSpec(...)`.
+        self._sharding_aliases: Dict[str, str] = {}
         self.in_sleep_scope = any(
             fragment in norm_path for fragment in _SLEEP_SCOPE_FRAGMENTS
         )
@@ -472,6 +500,13 @@ class _Visitor(ast.NodeVisitor):
         self.generic_visit(node)
 
     def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if self.in_sharding_scope and node.module and (
+            node.module == "jax.sharding"
+            or node.module.endswith(".sharding")
+        ):
+            for alias in node.names:
+                if alias.name in _SHARDING_CONSTRUCTORS and alias.asname:
+                    self._sharding_aliases[alias.asname] = alias.name
         if self.in_collective_scope and node.module:
             from_jax = node.module == "jax" or node.module.startswith("jax.")
             for alias in node.names:
@@ -503,6 +538,32 @@ class _Visitor(ast.NodeVisitor):
     def visit_Attribute(self, node: ast.Attribute) -> None:
         self._check_collective_attribute(node)
         self.generic_visit(node)
+
+    # -- sharding discipline --------------------------------------------------
+
+    def _check_sharding_call(self, node: ast.Call) -> None:
+        """Raw NamedSharding(...)/PartitionSpec(...) construction in
+        train/: the planner/mesh helpers are the sanctioned spellings."""
+        if not self.in_sharding_scope or self._sharding_allow_depth > 0:
+            return
+        dotted = self._dotted(node.func)
+        if not dotted:
+            return
+        last = dotted.split(".")[-1]
+        if last not in _SHARDING_CONSTRUCTORS and not (
+            "." not in dotted and dotted in self._sharding_aliases
+        ):
+            return
+        self._emit(
+            node,
+            "sharding-outside-planner",
+            f"raw {dotted}(...) in the trainer layers; layouts come from "
+            "the sharding planner — consume parallel/planner.py "
+            "ShardingPlan rules or the parallel/mesh.py helpers "
+            "(REPLICATED_SPEC, batch_partition_spec, flat_shard_sharding, "
+            "replicated, ...), or declare a legitimate hand-sharded site "
+            f"with @{_SHARDING_ALLOW_DECORATOR}",
+        )
 
     # -- serving discipline ---------------------------------------------------
 
@@ -710,6 +771,10 @@ class _Visitor(ast.NodeVisitor):
             self._dotted(d).split(".")[-1] == _SLEEP_ALLOW_DECORATOR
             for d in node.decorator_list
         )
+        allow_sharding = any(
+            self._dotted(d).split(".")[-1] == _SHARDING_ALLOW_DECORATOR
+            for d in node.decorator_list
+        )
         self._func_stack.append(node.name)
         if jitted:
             self._jit_depth += 1
@@ -717,11 +782,15 @@ class _Visitor(ast.NodeVisitor):
             self._swallow_allow_depth += 1
         if allow_sleep:
             self._sleep_allow_depth += 1
+        if allow_sharding:
+            self._sharding_allow_depth += 1
         # A nested def starts its own loop context: a sleep inside a
         # function merely DEFINED within a loop is not a polling loop.
         saved_loop_depth, self._loop_depth = self._loop_depth, 0
         self.generic_visit(node)
         self._loop_depth = saved_loop_depth
+        if allow_sharding:
+            self._sharding_allow_depth -= 1
         if allow_sleep:
             self._sleep_allow_depth -= 1
         if allow_swallow:
@@ -742,6 +811,7 @@ class _Visitor(ast.NodeVisitor):
             self._check_environ_call(node)
         self._check_flags_call(node)
         self._check_np_call(node)
+        self._check_sharding_call(node)
         self._check_serve_call(node)
         self._check_sleep_call(node)
         self._check_shm_call(node, self._func_stack)

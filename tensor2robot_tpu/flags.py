@@ -334,6 +334,28 @@ _declare(
     minimum=0,
 )
 _declare(
+    "T2R_PLAN",
+    _STR,
+    "off",
+    "Sharding-planner gate (parallel/planner.py): 'off' (default) keeps "
+    "the hand-wired trainer path byte-for-byte; a preset name (e.g. "
+    "dp_zero2_int8, dp_sp_pp — planner.preset_names()) drives the "
+    "trainer from that plan with a leaf-for-leaf layout audit; 'auto' "
+    "enumerates DP x SP x PP factorizations of the device count and "
+    "picks the winner (memory fit first, then estimated wire bytes).",
+    "tensor2robot_tpu/parallel/planner.py",
+)
+_declare(
+    "T2R_PLAN_MEM_BUDGET",
+    _INT,
+    0,
+    "Per-device memory budget in MB for T2R_PLAN=auto's factorization "
+    "search; candidates whose analytic estimate exceeds it are rejected "
+    "(with the estimate in the error when nothing fits). 0 = unbounded.",
+    "tensor2robot_tpu/parallel/planner.py",
+    minimum=0,
+)
+_declare(
     "T2R_POOL_BACKWARD",
     _ENUM,
     "auto",

@@ -92,6 +92,14 @@ class MultiHeadAttention(nn.Module):
     # shrink by the group factor; K/V are broadcast back to num_heads
     # only at attend time. None = num_heads (standard MHA).
     num_kv_heads: Optional[int] = None
+    # Manual sequence parallelism: >1 means this attention already runs
+    # INSIDE a shard_map whose manual axes include the sequence axis (the
+    # pipelined encoder's per-device program) and its input is the LOCAL
+    # sequence shard. Attention then rides
+    # ring_attention.ring_attention_manual over that axis instead of
+    # opening its own shard_map (which cannot nest). Ring only — the
+    # piece that composes SP with PP (parallel/planner.py 3D plans).
+    manual_sequence_size: int = 1
 
     def _kv_heads(self) -> int:
         kv = self.num_kv_heads if self.num_kv_heads is not None else self.num_heads
@@ -141,6 +149,26 @@ class MultiHeadAttention(nn.Module):
                 "sequence_parallel_mode must be 'ring' or 'ulysses', "
                 f"got {self.sequence_parallel_mode!r}"
             )
+        if self.manual_sequence_size > 1:
+            if self.sequence_parallel_mode != "ring":
+                raise ValueError(
+                    "manual (in-shard_map) sequence parallelism supports "
+                    "the ring strategy only; got "
+                    f"{self.sequence_parallel_mode!r}"
+                )
+            from tensor2robot_tpu.parallel.ring_attention import (
+                ring_attention_manual,
+            )
+
+            out = ring_attention_manual(
+                q, k, v,
+                axis_name=mesh_lib.SEQUENCE_AXIS,
+                axis_size=self.manual_sequence_size,
+                causal=self.causal,
+                window=self.window,
+            )
+            out = out.reshape(batch, seq, features)
+            return nn.Dense(x.shape[-1], use_bias=False, name="out")(out)
         sequence_axis = (
             dict(self.mesh.shape).get(mesh_lib.SEQUENCE_AXIS, 1)
             if self.mesh is not None
@@ -293,6 +321,7 @@ class TransformerBlock(nn.Module):
     decode: bool = False
     decode_max_len: int = 2048
     num_kv_heads: Optional[int] = None
+    manual_sequence_size: int = 1
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -308,6 +337,7 @@ class TransformerBlock(nn.Module):
             decode=self.decode,
             decode_max_len=self.decode_max_len,
             num_kv_heads=self.num_kv_heads,
+            manual_sequence_size=self.manual_sequence_size,
             name="attention",
         )(nn.LayerNorm(name="ln_attn")(x))
         h = nn.LayerNorm(name="ln_mlp")(x)
@@ -331,8 +361,11 @@ class TransformerBlock(nn.Module):
 
 class PipelineStage(nn.Module):
     """The repeating unit of the pipelined encoder: a run of pre-norm
-    blocks. Stage-internal attention is single-device (the pipe axis is
-    the only mesh axis a pipelined encoder may exceed 1 on)."""
+    blocks. Stage-internal attention is single-device by default; a
+    sequence_axis_size > 1 (the DP x SP x PP composition) runs each
+    block's attention as a MANUAL ring over the sequence axis — legal
+    because the stage executes inside pipeline_apply's shard_map, where
+    the sequence axis is manual alongside pipe."""
 
     num_blocks: int
     num_heads: int
@@ -343,6 +376,7 @@ class PipelineStage(nn.Module):
     interpret: bool = False
     window: Optional[int] = None
     num_kv_heads: Optional[int] = None
+    sequence_axis_size: int = 1
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -357,6 +391,7 @@ class PipelineStage(nn.Module):
                 interpret=self.interpret,
                 window=self.window,
                 num_kv_heads=self.num_kv_heads,
+                manual_sequence_size=self.sequence_axis_size,
                 name=f"block_{i}",
             )(x)
         return x
@@ -481,22 +516,39 @@ class TransformerEncoder(nn.Module):
                 f"mesh pipe axis {mesh_axes.get(mesh_mod.PIPE_AXIS, 1)} "
                 f"!= pipeline_stages={stages}"
             )
-        if mesh_axes.get(mesh_mod.SEQUENCE_AXIS, 1) > 1:
+        seq_size = mesh_axes.get(mesh_mod.SEQUENCE_AXIS, 1)
+        if seq_size > 1 and self.sequence_parallel_mode != "ring":
             raise ValueError(
-                "pipeline_stages > 1 does not compose with sequence "
-                "parallelism (attention inside a stage is single-device)"
+                "pipeline_stages > 1 composes with sequence parallelism "
+                "only in ring mode (the in-shard_map manual ring); got "
+                f"sequence_parallel_mode={self.sequence_parallel_mode!r}"
             )
-        stage = PipelineStage(
-            num_blocks=self.num_layers // stages,
-            num_heads=self.num_heads,
-            head_dim=self.head_dim,
-            mlp_ratio=self.mlp_ratio,
-            causal=self.causal,
-            use_flash=self.use_flash,
-            interpret=self.interpret,
-            window=self.window,
-            num_kv_heads=self.num_kv_heads,
-        )
+        if seq_size > 1 and x.shape[1] % seq_size != 0:
+            raise ValueError(
+                f"sequence length {x.shape[1]} not divisible by the "
+                f"sequence axis size {seq_size}"
+            )
+
+        def make_stage(sequence_axis_size: int) -> PipelineStage:
+            return PipelineStage(
+                num_blocks=self.num_layers // stages,
+                num_heads=self.num_heads,
+                head_dim=self.head_dim,
+                mlp_ratio=self.mlp_ratio,
+                causal=self.causal,
+                use_flash=self.use_flash,
+                interpret=self.interpret,
+                window=self.window,
+                num_kv_heads=self.num_kv_heads,
+                sequence_axis_size=sequence_axis_size,
+            )
+
+        # The applied stage runs the manual ring when the mesh shards the
+        # sequence; init runs OUTSIDE pipeline_apply's shard_map (no
+        # manual axes yet), so it uses a single-device twin — attention
+        # strategy does not change the parameter structure.
+        stage = make_stage(seq_size)
+        init_stage = make_stage(1)
         batch = x.shape[0]
         data_size = mesh_axes.get(mesh_mod.DATA_AXIS, 1)
         if self.pipeline_microbatches is not None:
@@ -526,7 +578,7 @@ class TransformerEncoder(nn.Module):
             dummy = jnp.zeros((1,) + x.shape[1:], x.dtype)
             rngs = jax.random.split(rng, stages)
             return pipeline.stack_stage_params(
-                [stage.init(r, dummy)["params"] for r in rngs]
+                [init_stage.init(r, dummy)["params"] for r in rngs]
             )
 
         stacked = self.param(mesh_mod.PIPE_STAGES_KEY, init_stacked)
@@ -537,4 +589,7 @@ class TransformerEncoder(nn.Module):
             mesh=self.mesh,
             num_microbatches=micro,
             batch_axis=mesh_mod.DATA_AXIS if data_size > 1 else None,
+            sequence_axis=(
+                mesh_mod.SEQUENCE_AXIS if seq_size > 1 else None
+            ),
         )

@@ -28,6 +28,14 @@ SEQUENCE_AXIS = "sequence"
 PIPE_AXIS = "pipe"
 EXPERT_AXIS = "expert"
 
+#: Minimum leaf size (elements) that earns a sharded layout. Below it a
+#: leaf stays replicated: sharding a bias buys nothing and costs
+#: collectives. ONE constant shared by every rule here and by the
+#: planner's memory/scoring model (parallel/planner.py) — it used to be
+#: repeated inline in weight_update_sharding and param_sharding, which is
+#: exactly the kind of drift the planner exists to end.
+MIN_WEIGHT_SIZE = 2 ** 14
+
 #: Param-tree key under which a pipelined module stores its stacked
 #: [S, ...] per-stage parameters (layers/transformer.py pipelined
 #: encoder); pipe_stage_param_rule shards that subtree's dim 0 over pipe.
@@ -99,14 +107,47 @@ def make_mesh(
     )
 
 
+#: The PartitionSpec twins of the shardings below, for callers (the
+#: quantized shard_map step, the planner) that speak specs rather than
+#: placed shardings. train/ code must consume these instead of spelling
+#: raw PartitionSpec(...) — the sharding-outside-planner lint
+#: (analysis/lints.py) enforces it.
+REPLICATED_SPEC = PartitionSpec()
+BATCH_SPEC = PartitionSpec((DATA_AXIS, FSDP_AXIS))
+FLAT_SHARD_SPEC = PartitionSpec(DATA_AXIS)
+
+
 def data_sharding(mesh: Mesh) -> NamedSharding:
     """Batch sharding: leading dim split over data (and fsdp, which acts as
     extra data parallelism for the input batch in fsdp regimes)."""
-    return NamedSharding(mesh, PartitionSpec((DATA_AXIS, FSDP_AXIS)))
+    return NamedSharding(mesh, BATCH_SPEC)
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
-    return NamedSharding(mesh, PartitionSpec())
+    return NamedSharding(mesh, REPLICATED_SPEC)
+
+
+def flat_shard_sharding(mesh: Mesh) -> NamedSharding:
+    """Dim-0 sharding over the data axis: the flat block-padded mirror
+    layout of the quantized ZeRO-2 regime (opt state, EMA, residual)."""
+    return NamedSharding(mesh, FLAT_SHARD_SPEC)
+
+
+def stacked_batch_sharding(mesh: Mesh) -> NamedSharding:
+    """[K, B, ...] scan-stacked batches: scan dim replicated, batch dim
+    split over data/fsdp (train/infeed.shard_stacked_batch's layout)."""
+    return NamedSharding(
+        mesh, PartitionSpec(None, (DATA_AXIS, FSDP_AXIS))
+    )
+
+
+def batch_partition_spec(mesh: Mesh, shape) -> PartitionSpec:
+    """Per-leaf batch spec mirroring shard_batch's tolerance: leading dim
+    divisible by the data*fsdp extent shards, everything else replicates."""
+    divisor = mesh.shape[DATA_AXIS] * mesh.shape[FSDP_AXIS]
+    if len(shape) >= 1 and shape[0] % divisor == 0:
+        return BATCH_SPEC
+    return REPLICATED_SPEC
 
 
 def shard_batch(batch, mesh: Mesh):
@@ -140,29 +181,45 @@ def _assign_largest_divisible_dim(spec, shape, axis_size, axis_name) -> None:
             return
 
 
-def weight_update_sharding(mesh: Mesh, min_weight_size: int = 2 ** 14):
-    """Sharding rule for OPTIMIZER-SIDE state in pure data parallelism
+def weight_update_sharding(
+    mesh: Mesh,
+    min_weight_size: int = MIN_WEIGHT_SIZE,
+    axes: Tuple[str, ...] = (DATA_AXIS,),
+):
+    """Sharding rule for OPTIMIZER-SIDE state under replicated parameters
     (cross-replica weight-update sharding, Xu et al. arXiv:2004.13336 —
     the ZeRO-2 layout): parameters stay replicated for the forward/
     backward, but optimizer moments and the EMA mirror shard their
-    largest divisible dim over the data axis; GSPMD turns the gradient
+    largest divisible dim over the replica axes; GSPMD turns the gradient
     all-reduce into reduce-scatter + sharded update + all-gather. Cuts
-    the optimizer-state footprint by the data-axis size with no model-
-    side change. Leaves with no dim divisible by the data-axis size stay
-    replicated (no padding is introduced).
+    the optimizer-state footprint by the replica-group size with no
+    model-side change. Leaves with no dim divisible by the group size
+    stay replicated (no padding is introduced).
+
+    axes: the mesh axes parameters are replicated over that the update
+    shards across. The classic pure-DP regime is ("data",) — a single
+    bare axis name in the spec, byte-for-byte today's layout. A composed
+    plan (parallel/planner.py) passes every replica axis, e.g.
+    ("data", "sequence") on a DP x SP x PP mesh, sharding the update
+    over the PRODUCT of the replica axes — the generalization no
+    hand-wired regime could spell.
     """
-    data_size = mesh.shape[DATA_AXIS]
+    axes = tuple(axes)
+    group_size = int(np.prod([mesh.shape[axis] for axis in axes]))
+    # A single axis keeps the bare-name spec entry (PartitionSpec("data"),
+    # not PartitionSpec(("data",))) so existing layouts compare equal.
+    axis_entry = axes[0] if len(axes) == 1 else axes
 
     def rule(leaf):
         shape = getattr(leaf, "shape", None)
         if (
             shape is None
-            or data_size == 1
+            or group_size == 1
             or np.prod(shape) < min_weight_size
         ):
             return NamedSharding(mesh, PartitionSpec())
         spec = [None] * len(shape)
-        _assign_largest_divisible_dim(spec, shape, data_size, DATA_AXIS)
+        _assign_largest_divisible_dim(spec, shape, group_size, axis_entry)
         return NamedSharding(mesh, PartitionSpec(*spec))
 
     return rule
@@ -196,7 +253,7 @@ def pipe_stage_param_rule(mesh: Mesh, base_rule):
     return rule
 
 
-def param_sharding(mesh: Mesh, min_weight_size: int = 2 ** 14):
+def param_sharding(mesh: Mesh, min_weight_size: int = MIN_WEIGHT_SIZE):
     """Tree-map-able parameter sharding rule over the fsdp and model axes.
 
     Tensor parallelism: matrix/conv-kernel leaves shard their OUTPUT dim

@@ -64,6 +64,7 @@ def pipeline_apply(
     num_microbatches: int,
     axis_name: str = PIPE_AXIS,
     batch_axis: str | None = None,
+    sequence_axis: str | None = None,
 ):
     """Runs x through S chained stages with GPipe microbatch overlap.
 
@@ -81,9 +82,19 @@ def pipeline_apply(
         it, the schedule runs on local examples, and gradients psum over
         it via shard_map's transpose. The per-microbatch size must divide
         by that axis.
+      sequence_axis: optional mesh axis x's dim 1 (the sequence) is
+        sharded over (sp x pp composition, the 3D DP x SP x PP regime of
+        parallel/planner.py): each microbatch carries only its local
+        sequence shard and stage_fn is expected to run sequence-parallel
+        attention in MANUAL mode over this axis
+        (ring_attention.ring_attention_manual) — the axis is manual
+        inside this shard_map, so ppermute over it composes with the
+        pipeline's own rotation. The sequence length must divide by the
+        axis size.
 
     Returns [batch, ...]: the composition stage_{S-1}(...stage_0(x)),
-    replicated over the pipe axis (data-sharded over batch_axis if given).
+    replicated over the pipe axis (data-sharded over batch_axis /
+    sequence-sharded over sequence_axis if given).
     """
     num_stages = mesh.shape[axis_name]
     batch = x.shape[0]
@@ -93,6 +104,7 @@ def pipeline_apply(
         )
     micro = jnp.reshape(x, (num_microbatches, batch // num_microbatches)
                         + x.shape[1:])
+    batch_entry = None
     if batch_axis is not None:
         data_size = mesh.shape[batch_axis]
         if (batch // num_microbatches) % data_size != 0:
@@ -100,7 +112,17 @@ def pipeline_apply(
                 f"microbatch size {batch // num_microbatches} not divisible "
                 f"by {batch_axis} axis size {data_size}"
             )
-        x_spec = PartitionSpec(None, batch_axis)
+        batch_entry = batch_axis
+    if sequence_axis is not None:
+        seq_size = mesh.shape[sequence_axis]
+        if x.ndim < 2 or x.shape[1] % seq_size != 0:
+            raise ValueError(
+                f"sequence dim {x.shape[1] if x.ndim > 1 else None} not "
+                f"divisible by {sequence_axis} axis size {seq_size}"
+            )
+        x_spec = PartitionSpec(None, batch_entry, sequence_axis)
+    elif batch_entry is not None:
+        x_spec = PartitionSpec(None, batch_entry)
     else:
         x_spec = PartitionSpec()
 
@@ -115,7 +137,8 @@ def pipeline_apply(
             num_microbatches=num_microbatches,
             axis_name=axis_name,
             varying_axes=(axis_name,)
-            + ((batch_axis,) if batch_axis is not None else ()),
+            + ((batch_axis,) if batch_axis is not None else ())
+            + ((sequence_axis,) if sequence_axis is not None else ()),
         ),
         mesh=mesh,
         in_specs=(spec_params, x_spec),

@@ -154,6 +154,44 @@ def _ring_shard_fn(
     return out.astype(q.dtype)
 
 
+def ring_attention_manual(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = SEQUENCE_AXIS,
+    axis_size: int,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    window: Optional[int] = None,
+) -> jax.Array:
+    """Ring attention INSIDE an enclosing shard_map (manual mode).
+
+    `ring_attention` below builds its own shard_map; a caller already
+    running under one — the pipelined encoder's per-device program, where
+    the pipe axis owns the outer shard_map and the sequence axis is also
+    manual — cannot nest another. This entry point runs the same
+    per-device ring body directly on the LOCAL shards: q/k/v are
+    [batch_local, seq/axis_size, heads, dim], the rotation rides
+    collectives.ppermute over `axis_name`, and causal masking uses global
+    positions derived from lax.axis_index. It is the piece that makes
+    DP x SP x PP composable (parallel/planner.py's 3D plans); the XLA
+    einsum tile is used per hop (the flash-kernel path stays on the
+    shard_map-owning entry points).
+    """
+    if q.ndim != 4:
+        raise ValueError(f"Expected [B, S_local, H, D], got {q.shape}")
+    from tensor2robot_tpu.ops.flash_attention import _check_window
+
+    _check_window(window, causal)
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    return _ring_shard_fn(
+        q, k, v, axis_name=axis_name, causal=causal, scale=scale,
+        axis_size=axis_size, use_flash=False, interpret=False,
+        window=window,
+    )
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,

@@ -21,7 +21,6 @@ from typing import Callable, Iterator, Optional, Sequence
 
 import jax
 import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec
 
 from tensor2robot_tpu import flags
 from tensor2robot_tpu.parallel import mesh as mesh_lib
@@ -99,10 +98,8 @@ def stack_batches(batches: Sequence) -> object:
 def shard_stacked_batch(stacked, mesh):
     """Places a [K, B, ...] stacked batch: scan axis replicated, batch axis
     (dim 1) split over data×fsdp; non-divisible leaves replicated."""
-    sharding = NamedSharding(
-        mesh, PartitionSpec(None, (mesh_lib.DATA_AXIS, mesh_lib.FSDP_AXIS))
-    )
-    replicated = NamedSharding(mesh, PartitionSpec())
+    sharding = mesh_lib.stacked_batch_sharding(mesh)
+    replicated = mesh_lib.replicated(mesh)
     divisor = mesh.shape[mesh_lib.DATA_AXIS] * mesh.shape[mesh_lib.FSDP_AXIS]
 
     def put(leaf):

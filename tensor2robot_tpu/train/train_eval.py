@@ -23,7 +23,7 @@ import itertools
 import os
 import threading
 import time
-from typing import Any, Callable, Dict, Iterator, List, Optional
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
 
 import jax
 import jax.flatten_util  # registers jax.flatten_util.ravel_pytree
@@ -31,7 +31,6 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 import orbax.checkpoint as ocp
-from jax.sharding import NamedSharding, PartitionSpec
 
 from tensor2robot_tpu import flags
 from tensor2robot_tpu.hooks.golden_values_hook_builder import GOLDEN_PREFIX
@@ -45,6 +44,7 @@ from tensor2robot_tpu.models.abstract_model import (
 from tensor2robot_tpu.models.tpu_model_wrapper import TPUT2RModelWrapper
 from tensor2robot_tpu.parallel import collectives
 from tensor2robot_tpu.parallel import mesh as mesh_lib
+from tensor2robot_tpu.parallel import planner as planner_lib
 from tensor2robot_tpu.specs import TensorSpecStruct, make_example_args
 from tensor2robot_tpu.testing import chaos
 from tensor2robot_tpu.train import durability, infeed
@@ -213,6 +213,55 @@ def _batch_labels(batch):
         return None
 
 
+def _validate_model_matches_plan(model, plan) -> None:
+    """A plan can PLACE layouts but cannot retrofit model structure: a
+    sequence- or pipeline-parallel plan requires the model BUILT with the
+    matching mesh / pipeline stages (plan.model_kwargs()). Without this
+    check a mismatch trains silently replicated — the regime degrades to
+    'replicated', whose layout audit is green, so nothing else would
+    catch it."""
+    candidates = [model, getattr(model, "_model", None)]
+    candidates = [m for m in candidates if m is not None]
+    if plan.pipe > 1:
+        stages = next(
+            (
+                getattr(m, "_pipeline_stages")
+                for m in candidates
+                if hasattr(m, "_pipeline_stages")
+            ),
+            None,
+        )
+        if stages != plan.pipe:
+            raise ValueError(
+                f"plan {plan.name!r} runs {plan.pipe} pipeline stages but "
+                f"the model was built with pipeline_stages={stages}; "
+                "construct the model with plan.model_kwargs() (and the "
+                "plan's mesh)"
+            )
+    if plan.sequence > 1:
+        model_mesh = next(
+            (
+                getattr(m, "_mesh")
+                for m in candidates
+                if getattr(m, "_mesh", None) is not None
+            ),
+            None,
+        )
+        seq = (
+            dict(model_mesh.shape).get(mesh_lib.SEQUENCE_AXIS, 1)
+            if model_mesh is not None
+            else None
+        )
+        if seq != plan.sequence:
+            raise ValueError(
+                f"plan {plan.name!r} shards the sequence {plan.sequence}-"
+                f"way but the model's mesh carries sequence axis {seq}; "
+                "construct the model with the plan's mesh "
+                "(plan.build_mesh()) so attention actually runs "
+                "sequence-parallel"
+            )
+
+
 class CompiledModel:
     """The model's hooks compiled into mesh-placed pure step functions."""
 
@@ -221,7 +270,7 @@ class CompiledModel:
         model: AbstractT2RModel,
         mesh=None,
         donate_state: bool = True,
-        param_min_shard_size: int = 2 ** 14,
+        param_min_shard_size: int = mesh_lib.MIN_WEIGHT_SIZE,
         remat: bool = False,
         grad_accum_steps: int = 1,
         shard_weight_update: bool = False,
@@ -229,6 +278,8 @@ class CompiledModel:
         fuse_batch_stats_update: Optional[bool] = None,
         collective_quant: Optional[str] = None,
         collective_block: Optional[int] = None,
+        weight_update_axes: Optional[Sequence[str]] = None,
+        plan: Optional[planner_lib.ShardingPlan] = None,
     ):
         """Args beyond the model/mesh:
 
@@ -310,8 +361,37 @@ class CompiledModel:
           per-replica batch-norm statistics average across the data
           axis (the local-BN caveat, same family as grad-accum's
           per-microbatch stats).
+        weight_update_axes: replica axes the ZeRO-2 weight update shards
+          across (mesh.weight_update_sharding's generalization). None =
+          ("data",), byte-for-byte today's layout; a composed 3D plan
+          passes every axis the params are replicated over, e.g.
+          ("data", "sequence").
+        plan: a planner_lib.ShardingPlan as the single source of
+          sharding truth. The plan is AUTHORITATIVE for the mesh (when
+          `mesh` is None), shard_weight_update, weight_update_axes,
+          collective_quant/block (pinned — the env flags are not
+          consulted), and param_min_shard_size; after init_state places
+          the TrainState, the layout is audited leaf-for-leaf against
+          the plan's predictions and a mismatch raises. None (the
+          default, and the T2R_PLAN=off path) keeps the explicit kwargs
+          exactly as before.
         """
         self.model = model
+        self.plan = plan
+        if plan is not None:
+            if mesh is None:
+                mesh = plan.build_mesh()
+            elif not plan.matches_mesh(mesh):
+                raise ValueError(
+                    f"mesh axes {dict(mesh.shape)} disagree with plan "
+                    f"{plan.name!r} axes {plan.axes_dict()}"
+                )
+            _validate_model_matches_plan(model, plan)
+            shard_weight_update = plan.shard_weight_update
+            weight_update_axes = plan.weight_update_axes
+            collective_quant = plan.collective_quant
+            collective_block = plan.collective_block
+            param_min_shard_size = plan.param_min_shard_size
         self.mesh = mesh if mesh is not None else mesh_lib.make_mesh()
         self.preprocessor = model.preprocessor
         self.optimizer = model.create_optimizer()
@@ -348,6 +428,11 @@ class CompiledModel:
         self._donate = donate_state
         self._param_min_shard_size = param_min_shard_size
         self._shard_weight_update = shard_weight_update
+        self._weight_update_axes = tuple(
+            weight_update_axes
+            if weight_update_axes is not None
+            else (mesh_lib.DATA_AXIS,)
+        )
         if grad_accum_steps < 1:
             raise ValueError(f"grad_accum_steps must be >= 1, got {grad_accum_steps}")
 
@@ -396,6 +481,35 @@ class CompiledModel:
         self._flat_layout = None
         self._flat_unravel = None
         self._quant_state_specs = None
+
+        # The layout plan this trainer ACTUALLY runs: the explicit plan,
+        # or an ad-hoc one distilled from the resolved kwargs. Either
+        # way, init_state's placement rules come from here — the planner
+        # is the single source of sharding truth; the hand-wired kwargs
+        # are just one way of naming a plan.
+        mesh_axes = dict(self.mesh.shape)
+        self._layout = planner_lib.ShardingPlan(
+            name=plan.name if plan is not None else "adhoc",
+            data=mesh_axes.get(mesh_lib.DATA_AXIS, 1),
+            fsdp=mesh_axes.get(mesh_lib.FSDP_AXIS, 1),
+            model=mesh_axes.get(mesh_lib.MODEL_AXIS, 1),
+            sequence=mesh_axes.get(mesh_lib.SEQUENCE_AXIS, 1),
+            pipe=mesh_axes.get(mesh_lib.PIPE_AXIS, 1),
+            expert=mesh_axes.get(mesh_lib.EXPERT_AXIS, 1),
+            shard_weight_update=self._shard_weight_update,
+            weight_update_axes=self._weight_update_axes,
+            collective_quant=(
+                self._quant_collective.name
+                if self._quant_collective is not None
+                else "none"
+            ),
+            collective_block=(
+                self._quant_collective.block
+                if self._quant_collective is not None
+                else quant_block
+            ),
+            param_min_shard_size=self._param_min_shard_size,
+        )
 
         def forward_loss(params, variables, features, labels, rng_net):
             variables = dict(variables)
@@ -635,15 +749,12 @@ class CompiledModel:
             layout = self._flat_layout
             axis = mesh_lib.DATA_AXIS
             num_shards = self.mesh.shape[axis]
-            divisor = num_shards * self.mesh.shape[mesh_lib.FSDP_AXIS]
 
             def batch_spec(leaf):
-                shape = getattr(leaf, "shape", ())
-                if len(shape) >= 1 and shape[0] % divisor == 0:
-                    return PartitionSpec(
-                        (mesh_lib.DATA_AXIS, mesh_lib.FSDP_AXIS)
-                    )
-                return PartitionSpec()  # replicated (mirrors shard_batch)
+                # Mirrors shard_batch's tolerance (planner-owned spec).
+                return mesh_lib.batch_partition_spec(
+                    self.mesh, getattr(leaf, "shape", ())
+                )
 
             def local_step(state, batch, rng):
                 device = collectives.axis_index(axis)
@@ -748,9 +859,9 @@ class CompiledModel:
             in_specs = (
                 self._quant_state_specs,
                 jax.tree_util.tree_map(batch_spec, batch),
-                PartitionSpec(),
+                mesh_lib.REPLICATED_SPEC,
             )
-            out_specs = (self._quant_state_specs, PartitionSpec())
+            out_specs = (self._quant_state_specs, mesh_lib.REPLICATED_SPEC)
             return collectives.smap(
                 local_step, self.mesh, in_specs, out_specs
             )(state, batch, rng)
@@ -822,31 +933,26 @@ class CompiledModel:
                 lambda path, x: jax.device_put(x, rule(path, x)), tree
             )
 
-        if self._quant_collective is not None:
-            return self._init_quant_state(state, place)
+        # Placement rules come from the layout plan — the regime branch
+        # below mirrors ShardingPlan.regime() exactly, so a plan-driven
+        # trainer and a kwargs-driven one place identically (the preset
+        # byte-equality contract; audited below when a plan is set).
+        regime = self._layout.regime()
+        if regime == "quant_zero2":
+            return self._audited(self._init_quant_state(state, place))
 
-        if (
-            self.mesh.shape[mesh_lib.FSDP_AXIS] > 1
-            or self.mesh.shape[mesh_lib.MODEL_AXIS] > 1
-        ):
+        if regime == "sharded_params":
             # Sharded-parameter regimes: fsdp shards large leaves (and the
             # mirrored optimizer/EMA copies) ZeRO-style; the model axis
             # column-splits kernels for tensor parallelism. GSPMD
             # propagates these shardings through the optimizer update, so
             # params stay sharded across steps.
-            return place(
-                state,
-                mesh_lib.param_sharding(
-                    self.mesh, min_weight_size=self._param_min_shard_size
-                ),
+            return self._audited(
+                place(state, self._layout.base_param_rule(self.mesh))
             )
         # Replicate onto the mesh so jitted steps see mesh-placed inputs.
-        replicated = mesh_lib.replicated(self.mesh)
-        replicate_rule = lambda leaf: replicated  # noqa: E731
-        if (
-            self._shard_weight_update
-            and self.mesh.shape[mesh_lib.DATA_AXIS] > 1
-        ):
+        replicate_rule = self._layout.base_param_rule(self.mesh)
+        if regime == "zero2":
             # Cross-replica weight-update sharding (ZeRO-2): only the
             # optimizer-side mirrors shard; params/variables stay
             # replicated for the forward/backward. The mirrors go straight
@@ -854,14 +960,29 @@ class CompiledModel:
             # first would need the very memory this mode exists to avoid.
             opt_state, ema_params = place(
                 (state.opt_state, state.ema_params),
-                mesh_lib.weight_update_sharding(
-                    self.mesh, min_weight_size=self._param_min_shard_size
-                ),
+                self._layout.weight_update_rule(self.mesh),
             )
             state = state.replace(opt_state=(), ema_params=None)
             state = place(state, replicate_rule)
-            return state.replace(opt_state=opt_state, ema_params=ema_params)
-        return place(state, replicate_rule)
+            return self._audited(
+                state.replace(opt_state=opt_state, ema_params=ema_params)
+            )
+        return self._audited(place(state, replicate_rule))
+
+    def _audited(self, state: TrainState) -> TrainState:
+        """Leaf-for-leaf layout audit against the plan's predictions —
+        only when an EXPLICIT plan drives this trainer (the hand-wired
+        path stays exactly as cheap as before)."""
+        if self.plan is None:
+            return state
+        audit = planner_lib.audit_state_layout(self._layout, self.mesh, state)
+        if audit["mismatches"]:
+            raise RuntimeError(
+                f"plan {self.plan.name!r} layout audit failed on "
+                f"{len(audit['mismatches'])} of {audit['leaves']} leaves: "
+                f"{audit['mismatches'][:5]}"
+            )
+        return state
 
     def _init_quant_state(self, state: TrainState, place) -> TrainState:
         """Quantized-collective (ZeRO-2) state layout.
@@ -884,7 +1005,7 @@ class CompiledModel:
         )
         self._flat_layout = layout
         replicated = mesh_lib.replicated(mesh)
-        sharded = NamedSharding(mesh, PartitionSpec(mesh_lib.DATA_AXIS))
+        sharded = mesh_lib.flat_shard_sharding(mesh)
 
         def mirror_sharding(leaf):
             if getattr(leaf, "ndim", 0) == 0:
@@ -925,15 +1046,15 @@ class CompiledModel:
             },
             out_shardings={"grad": sharded, "update": sharded},
         )()
-        spec = PartitionSpec(mesh_lib.DATA_AXIS)
+        spec = mesh_lib.FLAT_SHARD_SPEC
         self._quant_state_specs = TrainState(
-            step=PartitionSpec(),
+            step=mesh_lib.REPLICATED_SPEC,
             variables=jax.tree_util.tree_map(
-                lambda _: PartitionSpec(), state.variables
+                lambda _: mesh_lib.REPLICATED_SPEC, state.variables
             ),
             opt_state=jax.tree_util.tree_map(
                 lambda leaf: (
-                    PartitionSpec()
+                    mesh_lib.REPLICATED_SPEC
                     if getattr(leaf, "ndim", 0) == 0
                     else spec
                 ),
@@ -980,7 +1101,10 @@ class CompiledModel:
 
         fn = _serialize_dispatch(jax.jit(
             collectives.smap(
-                local, self.mesh, (PartitionSpec(),), PartitionSpec()
+                local,
+                self.mesh,
+                (mesh_lib.REPLICATED_SPEC,),
+                mesh_lib.REPLICATED_SPEC,
             )
         ))
         payload = jnp.zeros((layout.padded,), jnp.float32)
@@ -1215,6 +1339,7 @@ def train_eval_model(
     grad_accum_steps: int = 1,
     shard_weight_update: bool = False,
     flatten_optimizer_update: bool = False,
+    plan: Optional[planner_lib.ShardingPlan] = None,
 ) -> Dict[str, float]:
     """Trains (and periodically evaluates/exports) the model.
 
@@ -1231,17 +1356,16 @@ def train_eval_model(
     (see CompiledModel): recompute activations in the backward, split
     each batch into K gradient-accumulation microbatches, and/or shard
     optimizer state across data-parallel replicas (ZeRO-2).
+    plan: a planner_lib.ShardingPlan driving mesh + regime (see
+    CompiledModel); None consults the T2R_PLAN flag ('off' = the
+    hand-wired kwargs path, byte-for-byte; a preset name or 'auto'
+    resolves a plan through parallel/planner.py).
     """
     model = maybe_wrap_for_tpu(t2r_model)
     print_specification(model)
     os.makedirs(model_dir, exist_ok=True)
     _save_operative_config(model_dir)
 
-    compiled = CompiledModel(
-        model, mesh=mesh, remat=remat, grad_accum_steps=grad_accum_steps,
-        shard_weight_update=shard_weight_update,
-        flatten_optimizer_update=flatten_optimizer_update,
-    )
     infeed_depth = infeed.resolve_depth(infeed_depth)
     if use_ema_for_eval is None:
         use_ema_for_eval = getattr(model, "use_avg_model_params", False)
@@ -1278,6 +1402,17 @@ def train_eval_model(
     rng = jax.random.PRNGKey(seed)
     rng_init, rng_train = jax.random.split(rng)
     first_batch = next(train_batches)
+    if plan is None:
+        # The T2R_PLAN gate: 'off' (default) returns None and the kwargs
+        # below drive the trainer exactly as before; a preset name or
+        # 'auto' makes the planner the source of mesh + regime.
+        plan = planner_lib.resolve_plan_from_flag(model, first_batch)
+    compiled = CompiledModel(
+        model, mesh=mesh, remat=remat, grad_accum_steps=grad_accum_steps,
+        shard_weight_update=shard_weight_update,
+        flatten_optimizer_update=flatten_optimizer_update,
+        plan=plan,
+    )
     state = restore_or_init_state(manager, compiled, rng_init, first_batch)
     start_step = int(jax.device_get(state.step))
 

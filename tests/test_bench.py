@@ -275,7 +275,7 @@ def test_bench_cli_lists_legs():
     assert proc.returncode == 0
     for leg in (
         "data", "auc", "predict", "bc", "stream", "pipe", "serve", "comms",
-        "fleet", "rl", "aot",
+        "fleet", "rl", "aot", "plan",
     ):
         assert leg in proc.stdout
     proc = subprocess.run(
@@ -312,6 +312,14 @@ def test_bench_cli_lists_legs():
     )
     assert proc.returncode == 0
     for option in ("--buckets", "--leg-secs", "--swap-rate-hz", "--out"):
+        assert option in proc.stdout
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "bench.py"),
+         "plan", "--help"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0
+    for option in ("--steps", "--steps-3d", "--block", "--out"):
         assert option in proc.stdout
     # Unknown legs are an argparse error now, not a silent fallthrough
     # into the headline benchmark.
@@ -630,6 +638,46 @@ def test_bench_gateway_contract(tmp_path):
 
     with open(out) as f:
         assert json_mod.load(f)["metric"] == payload["metric"]
+
+
+@pytest.mark.slow
+def test_bench_plan_contract(tmp_path):
+    """The sharding-planner leg at toy step counts: one JSON line + the
+    --out artifact, every preset byte-equal with a clean audit, the DP
+    family bitwise planner-vs-hand, and the 3D (2x2x2) leg green with
+    per-axis wire-byte attribution and the ranked plan table."""
+    out = str(tmp_path / "plan.json")
+    payload = _run_bench(
+        "plan", "--steps", "2", "--steps-3d", "3", "--out", out,
+        timeout=700,
+    )
+    assert payload["metric"] == "plan_preset_byte_equality"
+    assert payload["value"] == 1.0
+    assert "error" not in payload
+    assert all(payload["gates"].values()), payload["gates"]
+    audit = payload["detail"]["byte_audit"]
+    for preset in (
+        "dp", "dp_zero2", "dp_zero2_int8", "dp_zero2_fp8_e4m3",
+        "dp_zero2_fp8_e5m2", "dp_sp", "dp_pp", "dp_pp_zero2",
+    ):
+        assert audit[preset]["layouts_equal"] is True, preset
+        assert audit[preset]["audit_mismatches"] == 0, preset
+    for preset in ("dp", "dp_zero2", "dp_zero2_int8"):
+        assert audit[preset]["params_bitwise_equal"] is True
+        assert audit[preset]["loss_abs_diff"] == 0.0
+    plan3d = payload["detail"]["plan3d"]
+    assert plan3d["preset"]["weight_update_axes"] == ["data", "sequence"]
+    assert plan3d["loss_parity_max_abs_diff"] < 1e-3
+    axes = {a for e in plan3d["wire_byte_attribution"] for a in e["axes"]}
+    assert {"data", "sequence", "pipe"} <= axes
+    table = payload["detail"]["ranked_plan_table"]["table"]
+    assert len(table) >= 4
+    assert any(
+        e["plan"]["name"] == "dp2_sp2_pp2" and e["feasible"]
+        for e in table
+    )
+    with open(out) as f:
+        assert json.load(f)["metric"] == payload["metric"]
 
 
 @pytest.mark.slow

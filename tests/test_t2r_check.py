@@ -316,6 +316,32 @@ class TestLintsCatch:
         assert "env-unknown-flag" not in clean
         assert "env-undeclared" not in clean
 
+    def test_plan_flags_covered_by_registry_lint(self):
+        """The round-17 sharding-planner gates (T2R_PLAN /
+        T2R_PLAN_MEM_BUDGET) ride the same rails: raw environ reads are
+        env-undeclared, wrong-kind getter reads are env-kind-mismatch,
+        declared spellings clean."""
+        for name in ("T2R_PLAN", "T2R_PLAN_MEM_BUDGET"):
+            assert "env-undeclared" in self._rules(
+                f"import os\nx = os.environ.get({name!r})\n"
+            ), name
+        assert "env-kind-mismatch" in self._rules(
+            "from tensor2robot_tpu import flags\n"
+            "x = flags.get_int('T2R_PLAN')\n"
+        )
+        assert "env-kind-mismatch" in self._rules(
+            "from tensor2robot_tpu import flags\n"
+            "x = flags.get_str('T2R_PLAN_MEM_BUDGET')\n"
+        )
+        clean = self._rules(
+            "from tensor2robot_tpu import flags\n"
+            "a = flags.get_str('T2R_PLAN')\n"
+            "b = flags.get_int('T2R_PLAN_MEM_BUDGET')\n"
+        )
+        assert "env-kind-mismatch" not in clean
+        assert "env-unknown-flag" not in clean
+        assert "env-undeclared" not in clean
+
     def test_gate_flags_covered_by_registry_lint(self):
         """The round-14 multi-tenant gateway flags (T2R_GATE_*) ride the
         same rails: raw environ reads are env-undeclared, wrong-kind
@@ -711,6 +737,73 @@ class TestLintsCatch:
             "lax.pcast(x, ('data',), to='varying')\n"
         )
         assert lint_source(bookkeeping, self._TRAIN_PATH) == []
+
+    # -- sharding discipline --------------------------------------------------
+
+    def test_raw_sharding_construction_in_trainer_flagged(self):
+        """NamedSharding/PartitionSpec spelled raw in train/ — including
+        the `as P` alias and the fully-qualified jax.sharding path — is
+        hand-wired layout drift the planner contract forbids."""
+        for source in (
+            "from jax.sharding import PartitionSpec\n"
+            "def f():\n    return PartitionSpec('data')\n",
+            "from jax.sharding import NamedSharding, PartitionSpec\n"
+            "def f(mesh):\n"
+            "    return NamedSharding(mesh, PartitionSpec())\n",
+            "from jax.sharding import PartitionSpec as P\n"
+            "def f():\n    return P(None, 'data')\n",
+            "import jax\ndef f():\n"
+            "    return jax.sharding.PartitionSpec('data')\n",
+        ):
+            diags = lint_source(source, self._TRAIN_PATH)
+            assert any(
+                d.rule == "sharding-outside-planner" for d in diags
+            ), source
+
+    def test_hand_sharded_decorator_allowlists_site(self):
+        source = (
+            "from jax.sharding import PartitionSpec\n"
+            "from tensor2robot_tpu.parallel.planner import hand_sharded\n"
+            "@hand_sharded\n"
+            "def f():\n    return PartitionSpec('data')\n"
+        )
+        assert lint_source(source, self._TRAIN_PATH) == []
+
+    def test_sharding_construction_outside_scope_clean(self):
+        # parallel/ is the sanctioned home of spec construction; other
+        # packages (export, serving, tests) are out of scope too.
+        source = (
+            "from jax.sharding import NamedSharding, PartitionSpec\n"
+            "def f(mesh):\n"
+            "    return NamedSharding(mesh, PartitionSpec('data'))\n"
+        )
+        for path in (
+            "tensor2robot_tpu/parallel/planner.py",
+            "tensor2robot_tpu/parallel/mesh.py",
+            "tensor2robot_tpu/export/seeded.py",
+        ):
+            assert lint_source(source, path) == [], path
+        # Consuming the helpers in train/ is the sanctioned route.
+        clean = (
+            "from tensor2robot_tpu.parallel import mesh as mesh_lib\n"
+            "def f(mesh, shape):\n"
+            "    return (mesh_lib.REPLICATED_SPEC,\n"
+            "            mesh_lib.batch_partition_spec(mesh, shape),\n"
+            "            mesh_lib.flat_shard_sharding(mesh))\n"
+        )
+        assert lint_source(clean, self._TRAIN_PATH) == []
+
+    def test_shipped_train_package_sharding_clean(self):
+        """The refactor actually landed: no raw constructor survives in
+        the shipped train/ package."""
+        from tensor2robot_tpu.analysis.lints import lint_paths
+
+        diags = [
+            d
+            for d in lint_paths(["tensor2robot_tpu/train"], root=_REPO)
+            if d.rule == "sharding-outside-planner"
+        ]
+        assert diags == []
 
 
 # -- 3. the flag registry -----------------------------------------------------

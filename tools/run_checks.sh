@@ -66,6 +66,20 @@ if [ "$TESTS" = 1 ]; then
     status=1
   fi
 
+  echo "== plan: sharding-planner preset byte-equality + 3D composition (tier-1) =="
+  # Round-17 gates, attributed by name: factorization enumeration with
+  # memory-infeasible rejection, preset byte-equality pins (every
+  # hand-wired regime vs its planner preset, leaf-for-leaf + bitwise
+  # none-step), checkpoint round-trip into the same plan / loud failure
+  # into a different one, plan-pins-regime-over-env composition, the
+  # sharding-outside-planner lint, and the fast 3D (2x2x2) sibling. The
+  # multi-step 3D loss-parity twin is the slow slice
+  # (tests/test_planner.py::Test3DPlan::test_loss_parity_with_data_axis_weight_update_twin).
+  if ! JAX_PLATFORMS=cpu python -m pytest tests/test_planner.py \
+      -q -m 'not slow' -p no:cacheprovider; then
+    status=1
+  fi
+
   echo "== serve-quant: low-precision serving + parity gates (tier-1) =="
   # Blockwise quant payload codec (shared with the gradient collectives),
   # export-time calibration + parity gate, T2R_SERVE_QUANT load regimes,
