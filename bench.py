@@ -1920,6 +1920,8 @@ def bench_serve(args) -> None:
             from tensor2robot_tpu.export import serve_quant as sq_lib
             from tensor2robot_tpu.export.exporters import LatestExporter
             from tensor2robot_tpu.export.saved_model import (
+                STABLEHLO_DIR,
+                STABLEHLO_FILENAME,
                 latest_export_dir,
                 quant_payload_relpath,
                 quant_stablehlo_relpath,
@@ -1957,6 +1959,24 @@ def bench_serve(args) -> None:
                 os.path.join(quant_path, "variables.msgpack")
             )
             saved_regime = t2r_flags.read_raw("T2R_SERVE_QUANT")
+            # Every in-process req/s in this section serves through the
+            # SAME restore tier (fresh jit): the main artifact carries
+            # aot/ while the A/B variants deliberately don't, and a
+            # deserialized-executable dispatch vs a jitted dispatch
+            # would contaminate the native-vs-dequant and
+            # static-vs-dynamic ratios. The AOT tier is measured by the
+            # out-of-process cold-boot gate below, against this same
+            # artifact.
+            saved_serve_aot = t2r_flags.read_raw("T2R_SERVE_AOT")
+            t2r_flags.write_env("T2R_SERVE_AOT", False)
+
+            def low_precision_ops(audit):
+                return sum(
+                    count
+                    for key, count in audit.items()
+                    if key != "total" and ("i8" in key or "f8" in key)
+                )
+
             regimes = {}
             try:
                 for regime in ("none",) + quant_regimes:
@@ -2020,11 +2040,8 @@ def bench_serve(args) -> None:
                         recorded_audit = quant_meta.get("dot_audit", {}).get(
                             regime
                         )
-                        low_precision_dots = sum(
-                            count
-                            for key, count in measured_audit.items()
-                            if key != "total"
-                            and ("i8" in key or "f8" in key)
+                        low_precision_dots = low_precision_ops(
+                            measured_audit
                         )
                         compute_attr = {
                             "dot_ops": measured_audit,
@@ -2054,6 +2071,200 @@ def bench_serve(args) -> None:
                     }
             finally:
                 t2r_flags.restore_env("T2R_SERVE_QUANT", saved_regime)
+
+            # -- dequant-vs-native A/B (the leg PERFORMANCE.md round 16
+            # promised): the SAME weights re-exported with native
+            # lowering forced off (T2R_SERVE_NATIVE_LAYERS=none), served
+            # through the identical topology — attributed req/s plus the
+            # audit delta proving the two artifacts differ exactly in
+            # WHERE they compute, nothing else. A second A/B flips the
+            # calibration mode (static vs dynamic) and re-audits the
+            # reduce counts on the artifacts this leg just served.
+            def export_int8_variant(name, env_flags=(), **exporter_kwargs):
+                saved = {key: t2r_flags.read_raw(key) for key, _ in env_flags}
+                saved["T2R_AOT_EXPORT"] = t2r_flags.read_raw("T2R_AOT_EXPORT")
+                for key, value in env_flags:
+                    t2r_flags.write_env(key, value)
+                # The A/B exports measure serving, not deploys: skip
+                # their AOT compiles (the MAIN quant export keeps its
+                # aot/ dir for the static cold-boot gate below).
+                t2r_flags.write_env("T2R_AOT_EXPORT", False)
+                try:
+                    variant_exporter = LatestExporter(
+                        name=name, warmup_batch_sizes=buckets,
+                        serve_quant=("int8",), **exporter_kwargs,
+                    )
+                    variant_exporter.maybe_export(
+                        step=1, state=state, eval_metrics={"loss": 1.0},
+                        compiled=compiled, model_dir=tmpdir.name,
+                    )
+                finally:
+                    for key, value in saved.items():
+                        t2r_flags.restore_env(key, value)
+                root = variant_exporter.export_root(tmpdir.name)
+                return root, latest_export_dir(root)
+
+            def serve_int8_burst(root):
+                saved = t2r_flags.read_raw("T2R_SERVE_QUANT")
+                t2r_flags.write_env("T2R_SERVE_QUANT", "int8")
+                try:
+                    variant_predictor = ExportedSavedModelPredictor(
+                        export_dir=root
+                    )
+                    if not variant_predictor.restore():
+                        raise RuntimeError("A/B leg: restore failed")
+                    variant_server = PolicyServer(
+                        variant_predictor, max_queue=args.burst + 8,
+                        max_wait_ms=2, default_deadline_ms=120000,
+                    ).start(prewarm=True)
+                    try:
+                        run_burst(variant_server, args.burst // 2)  # warm-in
+                        rates = sorted(
+                            run_burst(variant_server, args.burst)
+                            for _ in range(3)
+                        )
+                    finally:
+                        variant_server.stop()
+                    return rates[1]
+                finally:
+                    t2r_flags.restore_env("T2R_SERVE_QUANT", saved)
+
+            def artifact_audits(path):
+                with open(
+                    os.path.join(path, quant_stablehlo_relpath("int8")), "rb"
+                ) as program_f:
+                    program = program_f.read()
+                with open(
+                    os.path.join(path, STABLEHLO_DIR, STABLEHLO_FILENAME),
+                    "rb",
+                ) as baseline_f:
+                    baseline = baseline_f.read()
+                return (
+                    sq_lib.audit_dot_dtypes(program),
+                    sq_lib.audit_quant_reduces(program, baseline),
+                )
+
+            dequant_root, dequant_path = export_int8_variant(
+                "quant_dequant",
+                env_flags=(
+                    ("T2R_SERVE_NATIVE_LAYERS", "none"),
+                    ("T2R_SERVE_NATIVE_ATTN", "none"),
+                ),
+            )
+            dequant_hz = serve_int8_burst(dequant_root)
+            dequant_dots, dequant_reduces = artifact_audits(dequant_path)
+            native_hz = regimes["int8"]["saturated_hz"]
+            native_ab = {
+                "native_saturated_hz": native_hz,
+                "dequant_saturated_hz": round(dequant_hz, 2),
+                "native_vs_dequant_req_s_x": round(
+                    native_hz / max(dequant_hz, 1e-9), 3
+                ),
+                "native_low_precision_dot_ops": low_precision_ops(
+                    regimes["int8"]["dot_ops"]
+                ),
+                "dequant_low_precision_dot_ops": low_precision_ops(
+                    dequant_dots
+                ),
+                "dequant_dot_ops": dequant_dots,
+                # The audit delta is the attribution: same weights, same
+                # corpus, the dequant twin shows ZERO low-precision
+                # contractions while the native artifact shows them all.
+                "audit_delta_proves_lowering": (
+                    low_precision_ops(regimes["int8"]["dot_ops"]) >= 1
+                    and low_precision_ops(dequant_dots) == 0
+                ),
+            }
+
+            dyncalib_root, dyncalib_path = export_int8_variant(
+                "quant_dyncalib", serve_calib="dynamic"
+            )
+            dynamic_hz = serve_int8_burst(dyncalib_root)
+            _, dynamic_reduces = artifact_audits(dyncalib_path)
+            static_dots, static_reduces = artifact_audits(quant_path)
+            static_mode = quant_meta.get("calib", {}).get("int8", {}).get(
+                "mode"
+            )
+            calib_ab = {
+                "static_calib_mode": static_mode,
+                "static_saturated_hz": regimes["int8"]["saturated_hz"],
+                "dynamic_saturated_hz": round(dynamic_hz, 2),
+                "static_vs_dynamic_req_s_x": round(
+                    regimes["int8"]["saturated_hz"] / max(dynamic_hz, 1e-9),
+                    3,
+                ),
+                # Re-audited from the ARTIFACT bytes each sub-leg just
+                # served, cross-checked against the export record.
+                "static_reduce_audit": static_reduces,
+                "dynamic_reduce_audit": dynamic_reduces,
+                "reduce_audit_match_export_record": (
+                    quant_meta.get("reduce_audit", {}).get("int8")
+                    == static_reduces
+                ),
+                "static_zero_reduce_pass": (
+                    static_mode == "static"
+                    and static_reduces.get("activation_quant_reduces") == 0
+                ),
+                "dynamic_reduces_match_native_layers": (
+                    dynamic_reduces.get("activation_quant_reduces")
+                    == len(quant_meta["native"]["int8"]["layers"])
+                ),
+            }
+
+            t2r_flags.restore_env("T2R_SERVE_AOT", saved_serve_aot)
+
+            # -- static-calib AOT cold boot (out of process, like
+            # bench.py aot's twins): the statically-calibrated int8
+            # artifact must deserialize every bucket (zero fresh
+            # compiles) and serve BITWISE what its fresh-compile twin
+            # serves — the full-artifact-ladder acceptance for the new
+            # calibration mode.
+            import subprocess
+
+            def run_quant_boot(mode, serve_aot):
+                out_path = os.path.join(
+                    tmpdir.name, f"boot_quant_{mode}.json"
+                )
+                cmd = [
+                    sys.executable, os.path.abspath(__file__), "aot",
+                    "--_boot", "--export-root", quant_root,
+                    "--json-out", out_path,
+                ]
+                env = _aot_scrubbed_env(
+                    serve_aot, None, platform=devices[0].platform
+                )
+                env["T2R_SERVE_QUANT"] = "int8"
+                proc = subprocess.run(
+                    cmd, env=env, capture_output=True, text=True,
+                    timeout=420,
+                )
+                if proc.returncode != 0:
+                    raise RuntimeError(
+                        f"static-calib boot twin {mode!r} failed "
+                        f"rc={proc.returncode}: "
+                        + "\n".join((proc.stderr or "").splitlines()[-5:])
+                    )
+                with open(out_path) as report_f:
+                    return json.load(report_f)
+
+            aot_boot = run_quant_boot("aot", serve_aot=True)
+            fresh_boot = run_quant_boot("fresh", serve_aot=False)
+            static_aot = {
+                "calib_mode": aot_boot.get("serve_quant_calib"),
+                "fresh_trace_calls": aot_boot["fresh_trace_calls"],
+                "prewarm_source": aot_boot["prewarm_source"],
+                "aot_cold_start_s": aot_boot["cold_start_s"],
+                "fresh_cold_start_s": fresh_boot["cold_start_s"],
+                "bitwise_vs_fresh": (
+                    aot_boot["outputs_sha256"] == fresh_boot["outputs_sha256"]
+                ),
+                "zero_fresh_compiles": (
+                    aot_boot["fresh_trace_calls"] == 0
+                    and aot_boot["aot_misses"] == 0
+                    and set(aot_boot["prewarm_source"].values()) == {"aot"}
+                ),
+            }
+
             int8_x = regimes["int8"]["params_bytes_reduction_x"]
             int8_speed = (
                 regimes["int8"]["saturated_hz"]
@@ -2064,6 +2275,9 @@ def bench_serve(args) -> None:
                 for regime in quant_regimes
                 if regimes[regime].get("native_layers")
             }
+            native_audit_pass = bool(native_regime_audit) and all(
+                count >= 1 for count in native_regime_audit.values()
+            )
             quant_detail = {
                 "regimes": regimes,
                 "artifact_bytes_total": _dir_bytes(quant_path),
@@ -2074,8 +2288,21 @@ def bench_serve(args) -> None:
                 # >= 1 contraction executing on int8/fp8 operands in the
                 # program it served this leg with.
                 "native_low_precision_dot_ops": native_regime_audit,
-                "native_audit_pass": bool(native_regime_audit) and all(
-                    count >= 1 for count in native_regime_audit.values()
+                "native_audit_pass": native_audit_pass,
+                # Round-18 legs: dequant-vs-native req/s attribution,
+                # static-vs-dynamic calibration with re-audited reduce
+                # counts, and the static-calib AOT cold-boot gate.
+                "native_ab": native_ab,
+                "calib_ab": calib_ab,
+                "static_aot_boot": static_aot,
+                "r18_all_green": bool(
+                    native_audit_pass
+                    and native_ab["audit_delta_proves_lowering"]
+                    and calib_ab["static_zero_reduce_pass"]
+                    and calib_ab["dynamic_reduces_match_native_layers"]
+                    and calib_ab["reduce_audit_match_export_record"]
+                    and static_aot["bitwise_vs_fresh"]
+                    and static_aot["zero_fresh_compiles"]
                 ),
                 "req_s_attribution": (
                     "CPU proxy: no int8/fp8 matmul units, so the native "
@@ -2213,7 +2440,20 @@ def _aot_boot_child(args) -> None:
     snap = server.snapshot()
     server.stop()
     loaded = predictor.loaded_model
+    # Bitwise-comparison surface: the reply digest over the seeded
+    # request row (identical across twins by construction), so the
+    # parent can assert an AOT boot serves bit-identically to its
+    # fresh-compile twin without shipping arrays through JSON.
+    import hashlib
+
+    digest = hashlib.sha256()
+    for key in sorted(response.outputs):
+        digest.update(key.encode())
+        digest.update(np.ascontiguousarray(response.outputs[key]).tobytes())
     report = {
+        "outputs_sha256": digest.hexdigest(),
+        "serve_quant": snap.get("serve_quant"),
+        "serve_quant_calib": snap.get("serve_quant_calib"),
         "restore_s": round(t_restored - t0, 4),
         "server_start_s": round(t_started - t_restored, 4),
         "first_reply_ms": round((t_first_reply - t_started) * 1e3, 3),
@@ -4914,10 +5154,12 @@ def _build_cli():
     serve.add_argument(
         "--no-quant", action="store_true",
         help="skip the serve-quant regime legs (none/fp16/int8/fp8 "
-             "req/s + bytes-of-param + compiled-program dot audit)",
+             "req/s + bytes-of-param + compiled-program dot/reduce "
+             "audits, the dequant-vs-native and static-vs-dynamic "
+             "calibration A/Bs, and the static-calib AOT boot gate)",
     )
     serve.add_argument(
-        "--out", default="BENCH_SERVE_r16.json",
+        "--out", default="BENCH_SERVE_r18.json",
         help="also write the payload to this file ('' disables; "
              "default %(default)s)",
     )

@@ -280,10 +280,11 @@ def build_bucket_executables(
     regime: str,
     fingerprint: str,
     prefix_args: Tuple = (),
+    timings_ms: Optional[Dict[int, float]] = None,
 ) -> Dict[int, bytes]:
     """Export-side AOT pass for one regime: rehydrate the serialized
-    program once, specialize+compile it per warmup bucket, envelope each
-    executable.
+    program once, specialize+compile it per warmup bucket ACROSS A
+    THREAD POOL, envelope each executable.
 
     Compiling the REHYDRATED program (not the original python serving
     fn) makes the executable the compile of exactly what a fresh-trace
@@ -291,16 +292,32 @@ def build_bucket_executables(
     `prefix_args` are the concrete leading call arguments (the quant
     payload tree, or the weights tree for weights-as-arguments exports);
     the feature batch is always the trailing argument.
+
+    The per-bucket compiles are independent XLA invocations that release
+    the GIL, so they run concurrently (one worker per bucket, capped by
+    host cores) instead of serially per (regime, bucket); any bucket
+    failing fails the whole regime exactly as the serial loop did (the
+    caller's best-effort/error-recording contract is unchanged). When
+    `timings_ms` is given, each bucket's wall-clock COMPILE milliseconds
+    are recorded into it (the envelope serialize + round-trip check run
+    after the pool and are not included — they are cheap relative to
+    the compile) — the metadata `aot` block carries the timings so
+    publish latency is attributable per bucket.
     """
+    import concurrent.futures
+    import os
+    import time
+
     import jax
     from jax import export as jax_export
 
     rehydrated = jax_export.deserialize(artifact_bytes)
     topology = device_topology()
-    out: Dict[int, bytes] = {}
-    for batch in batches:
+
+    def compile_one(batch) -> Tuple[int, Any, Mapping[str, Any], float]:
         first = next(iter(batch.values()))
         bucket = int(np.asarray(first).shape[0])
+        t0 = time.monotonic()
         compiled = (
             jax.jit(rehydrated.call).lower(*prefix_args, batch).compile()
         )
@@ -314,5 +331,76 @@ def build_bucket_executables(
             "features": feature_signature(batch),
             "has_prefix_arg": bool(prefix_args),
         }
-        out[bucket] = serialize_compiled(compiled, header)
+        return bucket, compiled, header, (time.monotonic() - t0) * 1e3
+
+    out: Dict[int, bytes] = {}
+    if not batches:
+        return out
+    # At least two workers even on one-core hosts: the compile itself
+    # releases the GIL, so it overlaps the previous bucket's python-side
+    # lowering work.
+    workers = min(len(batches), max(2, (os.cpu_count() or 2) - 1))
+    # jax's persistent compilation cache MUST NOT serve these compiles:
+    # an executable deserialized from that cache serializes WITHOUT its
+    # object code, so the shipped blob fails every later
+    # deserialize_and_load with "Symbols not found" — even in the
+    # process that exported it. A warm cache (any process that compiled
+    # this program before, e.g. a bench re-run or a serving replica
+    # that re-exports) would corrupt every bucket. Toggling
+    # jax_enable_compilation_cache alone is NOT enough: jax memoizes
+    # cache engagement at the first compile and folds config state into
+    # the cache KEY, so a flag flip just re-keys the entries — the
+    # first build under the flipped flag WRITES them and every later
+    # build HITS them. Clearing the cache directory + reset_cache()
+    # makes reads and writes both no-op for the build; both are
+    # restored after, and the round-trip check below backstops it all.
+    # The config is process-GLOBAL: an unrelated compile in another
+    # thread during this window skips the persistent cache too (a
+    # performance miss, never a correctness one — no in-tree process
+    # serves and exports concurrently; exporters run between legs /
+    # in the learner, serving compiles in replicas).
+    prev_enabled = bool(jax.config.jax_enable_compilation_cache)
+    prev_dir = jax.config.jax_compilation_cache_dir
+
+    def _reset_cache_state():
+        try:
+            from jax._src import compilation_cache as _compilation_cache
+        except ImportError:  # pragma: no cover - future jax relayout
+            return
+        reset = getattr(_compilation_cache, "reset_cache", None)
+        if reset is not None:
+            reset()
+
+    jax.config.update("jax_enable_compilation_cache", False)
+    if prev_dir is not None:
+        jax.config.update("jax_compilation_cache_dir", None)
+    _reset_cache_state()
+    try:
+        with concurrent.futures.ThreadPoolExecutor(
+            max_workers=workers
+        ) as pool:
+            compiled_buckets = list(pool.map(compile_one, batches))
+    finally:
+        jax.config.update("jax_enable_compilation_cache", prev_enabled)
+        if prev_dir is not None:
+            jax.config.update("jax_compilation_cache_dir", prev_dir)
+        _reset_cache_state()
+    # Serialization runs AFTER the pool drains, sequentially: XLA's
+    # executable serialization snapshots process-global compiled-symbol
+    # state, and serializing while another bucket's compile is in
+    # flight has been observed to emit blobs whose object code misses
+    # symbols ("Symbols not found" on a fresh-process deserialize).
+    # Compiles are the expensive, GIL-releasing part — they keep the
+    # pool; the envelope step is cheap and stays race-free.
+    for bucket, compiled, header, elapsed_ms in compiled_buckets:
+        blob = serialize_compiled(compiled, header)
+        # Round-trip proof before the blob can ship: a blob this process
+        # cannot deserialize is corrupt by definition, and shipping it
+        # would turn EVERY boot of the artifact into a logged fallback.
+        # Raising here routes the regime into the caller's best-effort
+        # error-recording path instead (no aot/ entry, reason recorded).
+        load_executable(blob)
+        out[bucket] = blob
+        if timings_ms is not None:
+            timings_ms[bucket] = round(elapsed_ms, 3)
     return out

@@ -148,6 +148,37 @@ class AbstractExportGenerator:
 
         return serving_fn
 
+    def create_eager_serving_fn(
+        self, compiled, variables
+    ) -> Callable[[Dict[str, Any]], Dict[str, Any]]:
+        """The UN-JITTED fp32 forward (preprocess + predict_step_fn),
+        eager end to end — the capture contract for static activation
+        calibration (serve_quant.capture_activations needs CONCRETE
+        values at every intercepted module; a jitted forward hands the
+        interceptor tracers with no numbers to record)."""
+        preprocessor = self._preprocessor
+        raw = self._export_raw_receivers
+        try:
+            predict_step = compiled.predict_step_fn
+        except AttributeError:
+            raise ValueError(
+                "create_eager_serving_fn requires compiled.predict_step_fn "
+                "(the un-jitted forward, train_eval.CompiledModel): the "
+                "capture interceptor records concrete activations, which "
+                "a jitted forward never materializes per layer."
+            ) from None
+
+        def eager_fn(flat_features: Dict[str, Any]) -> Dict[str, Any]:
+            features = TensorSpecStruct(dict(flat_features))
+            if not raw:
+                features, _ = preprocessor.preprocess(
+                    features, None, mode="predict", rng=None
+                )
+            outputs = predict_step(variables, features)
+            return dict(flatten_spec_structure(outputs).items())
+
+        return eager_fn
+
     def create_quant_serving_fn(
         self,
         compiled,
@@ -157,6 +188,8 @@ class AbstractExportGenerator:
         min_size: Optional[int] = None,
         calibration: Optional[Mapping[str, float]] = None,
         native: Optional[Sequence[str]] = None,
+        static_scales: Optional[Mapping[str, float]] = None,
+        attn: Optional[str] = None,
     ) -> Callable[..., Dict[str, Any]]:
         """Blockwise low-precision serving fn: `(payload, flat_features)`.
 
@@ -169,17 +202,29 @@ class AbstractExportGenerator:
         weight constants at all.
 
         `native` is the per-layer eligibility map for native
-        low-precision matmuls (None resolves the default map +
+        low-precision contractions (None resolves the default map +
         T2R_SERVE_NATIVE_LAYERS override; () forces the pure dequant
-        path): eligible kernels are stored per-channel and the traced
-        forward contracts them in their storage dtype via
-        `serve_quant.native_lowering` — the int8/fp8 dot_general lands
-        IN the exported program.
+        path): eligible dense AND conv kernels are stored per-channel
+        and the traced forward contracts them in their storage dtype
+        via `serve_quant.native_lowering` — the int8/fp8
+        dot_general/convolution lands IN the exported program.
+
+        `static_scales` maps flat kernel paths (and attn/<path>:q|k|v
+        keys) to export-calibrated activation clips
+        (serve_quant.resolve_static_scales): contractions with an entry
+        trace the STATIC scale as a constant — zero per-dispatch
+        activation-quant reduces in the serialized program; None/{} is
+        the dynamic per-row path. `attn` is the attention-head
+        eligibility override (None resolves T2R_SERVE_NATIVE_ATTN; ()
+        disables attention lowering — the wholesale-demotion rebuild
+        passes it so a demoted regime has NO native contractions left).
 
         Attributes on the returned fn carry the export-side bookkeeping:
         `.quant_payload` (exemplar/storage tree), `.quant_layout`,
         `.quant_regime`, `.quant_block`, `.quant_calibration`,
-        `.quant_native` (the eligibility map it was built with).
+        `.quant_native` (the eligibility map it was built with),
+        `.quant_calib_mode` / `.quant_static_scales` / `.quant_attn`
+        (the calibration contract it traces under).
         """
         import jax
 
@@ -218,6 +263,14 @@ class AbstractExportGenerator:
                 host_variables, regime, min_size=min_size
             )
         native = tuple(sorted(native))
+        if regime not in serve_quant.NATIVE_DOT_REGIMES:
+            # Cast/dequant-only regimes have no native contractions to
+            # calibrate or lower — a static-scale map or attention spec
+            # handed to them must not be RECORDED as if it applied.
+            attn = ()
+            static_scales = None
+        static_scales = dict(static_scales or {})
+        attn_spec = serve_quant.resolve_native_attention(attn)
         payload, layout = serve_quant.quantize_tree(
             host_variables, regime, block=block, min_size=min_size,
             native=native,
@@ -236,7 +289,8 @@ class AbstractExportGenerator:
                 )
             bound = serve_quant.dequantize_tree(quant_payload, layout, regime)
             with serve_quant.native_lowering(
-                quant_payload, layout, regime, bound, fired=fired
+                quant_payload, layout, regime, bound, fired=fired,
+                static_scales=static_scales, attn=attn_spec,
             ):
                 outputs = predict_step(bound, features)
             return dict(flatten_spec_structure(outputs).items())
@@ -247,6 +301,51 @@ class AbstractExportGenerator:
         serving_fn.quant_block = block
         serving_fn.quant_calibration = calibration
         serving_fn.quant_native = native
+        serving_fn.quant_attn = attn_spec
+        # Recorded scales are the CONSUMABLE subset only: the capture
+        # interceptor pools every Dense/Conv input, but a clip for a
+        # layer outside the native map (or an attn/ operand whose
+        # module the attention globs don't select) is never read by
+        # the lowering — metadata's "baked into the program" contract
+        # must not list it. (saved_model further narrows this to the
+        # FIRED set at record time.)
+        native_set = set(native)
+
+        def _attn_clip_consumable(key: str) -> bool:
+            if attn_spec == ():
+                return False
+            # 'attn/<module path>:q|k|v' -> the module-path portion
+            # the interception matches its globs against.
+            module_path = key.rsplit(":", 1)[0][len("attn/"):].split("/")
+            return serve_quant._attention_eligible(attn_spec, module_path)
+
+        consumed_scales = {
+            key: value
+            for key, value in static_scales.items()
+            if (
+                _attn_clip_consumable(key)
+                if key.startswith("attn/")
+                else key in native_set
+            )
+        }
+        serving_fn.quant_static_scales = consumed_scales
+        # The calibration mode is a property of native contractions:
+        # None for a regime with nothing to calibrate (fp16's cast
+        # path, or a fully-demoted map) — the fleet surface must not
+        # report a per-dispatch quant path for a program without one.
+        # 'static' only when some native contraction actually CONSUMES
+        # a clip (an entry for an eligible kernel, or an attention
+        # operand while attention lowering is on): a stray clip for a
+        # never-intercepted layer must not relabel an all-dynamic
+        # program.
+        if regime not in serve_quant.NATIVE_DOT_REGIMES or (
+            not native and attn_spec == ()
+        ):
+            serving_fn.quant_calib_mode = None
+        else:
+            serving_fn.quant_calib_mode = (
+                "static" if consumed_scales else "dynamic"
+            )
         # Populated by any run of the fn (the parity gates always run
         # it before export): which eligible kernels the interceptor
         # ACTUALLY lowered — the export's claimed-vs-fired truth source.

@@ -132,6 +132,7 @@ class Exporter:
         quant_block: Optional[int] = None,
         quant_min_size: Optional[int] = None,
         quant_parity_tol: Optional[Dict[str, float]] = None,
+        serve_calib: Optional[str] = None,
         aot_executables: Optional[bool] = None,
     ):
         self.name = name
@@ -183,6 +184,17 @@ class Exporter:
         self._quant_block = quant_block
         self._quant_min_size = quant_min_size
         self._quant_parity_tol = dict(quant_parity_tol or {})
+        # Activation-calibration mode for the native regimes: None
+        # defers to T2R_SERVE_CALIB at export time; an explicit value is
+        # validated HERE (config time) with the flag-naming error the
+        # registry getters produce for a bad env value.
+        if serve_calib is not None:
+            from tensor2robot_tpu.export.serve_quant import (
+                resolve_calib_mode,
+            )
+
+            resolve_calib_mode(serve_calib)
+        self._serve_calib = serve_calib
         # Serialized AOT executables per warmup bucket (export/aot.py):
         # None defers to the T2R_AOT_EXPORT flag at export time. An
         # EXPLICIT request without a warmup ladder is a config error —
@@ -249,13 +261,59 @@ class Exporter:
             from tensor2robot_tpu.export import serve_quant as sq
 
             calibration = sq.calibrate_activations(warmup_batches)
+            calib_mode = sq.resolve_calib_mode(self._serve_calib)
+            static_scales: Dict[str, float] = {}
+            static_demoted: Dict[str, float] = {}
+            layer_calibration: Dict[str, Dict[str, float]] = {}
+            native_regimes = tuple(
+                regime for regime in self._serve_quant
+                if regime in sq.NATIVE_DOT_REGIMES
+            )
+            # The eager capture replay is slow (un-jitted fp32 forward
+            # over the whole corpus) — it runs only when something can
+            # CONSUME a clip: an eligible kernel in some native regime,
+            # or attention lowering left on (whether the model has
+            # einsum-path attention is only discoverable by the capture
+            # itself, so a non-empty attn spec keeps the replay).
+            capture_can_pay_off = any(
+                sq.resolve_native_eligibility(
+                    variables, regime,
+                    min_size=(
+                        sq.DEFAULT_MIN_SIZE
+                        if self._quant_min_size is None
+                        else int(self._quant_min_size)
+                    ),
+                )
+                for regime in native_regimes
+            ) or sq.resolve_native_attention(None) != ()
+            if calib_mode == "static" and native_regimes and (
+                capture_can_pay_off
+            ):
+                # Static activation calibration: the capture interceptor
+                # rides the UN-JITTED fp32 forward over the SAME corpus
+                # the parity gate replays, so the per-layer clips are
+                # measured on exactly the batches the artifact ships as
+                # warmup. Layers whose observed max overshoots the clip
+                # are demoted BACK to dynamic per-row quant here, per
+                # layer, before any regime is built.
+                eager_fn = generator.create_eager_serving_fn(
+                    compiled, variables
+                )
+                records: Dict[str, list] = {}
+                with sq.capture_activations(records):
+                    for batch in warmup_batches:
+                        eager_fn(batch)
+                layer_calibration = sq.calibrate_layer_activations(records)
+                static_scales, static_demoted = sq.resolve_static_scales(
+                    layer_calibration
+                )
             tolerance = dict(sq.DEFAULT_PARITY_TOL)
             tolerance.update(self._quant_parity_tol)
             serve_quant_fns = {}
             fp32_outputs = None
             for regime in self._serve_quant:
 
-                def make(native=None, regime=regime):
+                def make(native=None, attn=None, static=True, regime=regime):
                     return generator.create_quant_serving_fn(
                         compiled,
                         variables,
@@ -264,13 +322,31 @@ class Exporter:
                         min_size=self._quant_min_size,
                         calibration=calibration,
                         native=native,
+                        static_scales=static_scales if static else None,
+                        attn=attn,
                     )
 
                 fn = make()
-                if fn.quant_native:
-                    # Native matmuls ride only where measurement allows:
-                    # the fp32 forward (computed once, shared across
-                    # regimes) is the baseline for the demotion triage.
+                # The (deliberately un-jitted, slow) pre-gate replay
+                # runs only when the program can actually carry native
+                # contractions: eligible kernels, or attention modules
+                # the capture OBSERVED on the einsum path. An
+                # attention-only model under dynamic calib (no capture
+                # ran) skips the triage — the final gate in
+                # save_exported_model still measures it and
+                # fails-writes-nothing applies; it just cannot
+                # auto-demote wholesale.
+                capture_saw_attention = any(
+                    key.startswith("attn/") for key in layer_calibration
+                )
+                if fn.quant_native or (
+                    fn.quant_attn != () and capture_saw_attention
+                ):
+                    # Native contractions ride only where measurement
+                    # allows: the fp32 forward (computed once, shared
+                    # across regimes) is the baseline for the demotion
+                    # triage. The rebuild disables EVERY native leg —
+                    # kernels, attention, and static scales alike.
                     if fp32_outputs is None:
                         fp32_outputs = [
                             {
@@ -281,11 +357,20 @@ class Exporter:
                         ]
                     fn, _ = _native_pre_gate(
                         fn,
-                        lambda: make(native=()),
+                        lambda: make(native=(), attn=(), static=False),
                         fp32_outputs,
                         warmup_batches,
                         tolerance[regime],
                     )
+                # The per-layer static-demotion record rides the fn so
+                # the metadata can say which layers still pay a
+                # per-dispatch reduce, and why. Native regimes only —
+                # a cast regime has no contraction the record applies
+                # to (and the shared calibration table is recorded
+                # once, not per regime).
+                if regime in sq.NATIVE_DOT_REGIMES:
+                    fn.quant_static_demoted = dict(static_demoted)
+                    fn.quant_layer_calibration = layer_calibration
                 serve_quant_fns[regime] = fn
         path = save_exported_model(
             root,
@@ -377,6 +462,7 @@ def create_default_exporters(
     quantize_bits: int = 8,
     serve_quant: Sequence[str] = (),
     quant_parity_tol: Optional[Dict[str, float]] = None,
+    serve_calib: Optional[str] = None,
     aot_executables: Optional[bool] = None,
 ) -> List[Exporter]:
     """latest + best exporter pair (reference create_default_exporters,
@@ -395,6 +481,7 @@ def create_default_exporters(
             quantize_bits=quantize_bits,
             serve_quant=serve_quant,
             quant_parity_tol=quant_parity_tol,
+            serve_calib=serve_calib,
             aot_executables=aot_executables,
         ),
         BestExporter(
@@ -408,6 +495,7 @@ def create_default_exporters(
             quantize_bits=quantize_bits,
             serve_quant=serve_quant,
             quant_parity_tol=quant_parity_tol,
+            serve_calib=serve_calib,
             aot_executables=aot_executables,
         ),
     ]

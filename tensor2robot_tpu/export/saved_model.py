@@ -200,6 +200,12 @@ def save_exported_model(
             # granularity mix of the payload.
             "native": {},
             "granularity": {},
+            # Activation-calibration contract per regime: mode, the
+            # static per-layer clips baked into the program, and which
+            # layers the overshoot gate demoted back to dynamic (with
+            # the measured overshoot) — the record a fleet reads to
+            # know which layers still pay a per-dispatch reduce.
+            "calib": {},
         }
         for regime in sorted(serve_quant_fns):
             fn = serve_quant_fns[regime]
@@ -247,9 +253,21 @@ def save_exported_model(
             # instead of inflating the attribution.
             claimed = list(getattr(fn, "quant_native", ()) or ())
             fired = set(getattr(fn, "quant_native_fired", ()) or ())
+            attn_spec = getattr(fn, "quant_attn", ())
             native_entry = {
                 "layers": [path for path in claimed if path in fired],
                 "demoted": bool(getattr(fn, "quant_native_demoted", False)),
+                # Attention has no structural claim (no kernel leaf of
+                # its own), so the record is fired-only: which modules'
+                # QK^T/PV actually lowered, next to the eligibility the
+                # export ran under — auto-with-nothing-fired (e.g.
+                # flash-path heads) is visible as [] vs "auto".
+                "attention": sorted(
+                    key for key in fired if key.startswith("attn/")
+                ),
+                "attention_eligibility": (
+                    "auto" if attn_spec == "auto" else list(attn_spec)
+                ),
             }
             unlowered = [path for path in claimed if path not in fired]
             if unlowered:
@@ -268,6 +286,58 @@ def save_exported_model(
             for entry in fn.quant_layout.values():
                 granularity[entry.get("granularity", "block")] += 1
             serve_quant_meta["granularity"][regime] = granularity
+            # Fired-grounded calibration record: the claim-level scale
+            # map can still hold clips for contractions the interceptor
+            # bailed on at trace time (an unsupported conv config, an
+            # attention module outside the globs) — the recorded scales
+            # and mode reflect what the serialized program actually
+            # consumes, so snapshot()/router `serve_quant_calib` never
+            # reports 'static' for a program serving pure dequant.
+            fired_scales = {
+                key: float(value)
+                for key, value in sorted(
+                    (getattr(fn, "quant_static_scales", None) or {}).items()
+                )
+                if (
+                    key.rsplit(":", 1)[0] in fired
+                    if key.startswith("attn/")
+                    else key in fired
+                )
+            }
+            if not (native_entry["layers"] or native_entry["attention"]):
+                fired_mode = None
+            else:
+                fired_mode = "static" if fired_scales else "dynamic"
+            calib_entry = {
+                "mode": fired_mode,
+                "static_scales": fired_scales,
+                "demoted_to_dynamic": {
+                    key: float(value)
+                    for key, value in sorted(
+                        (getattr(fn, "quant_static_demoted", None) or {})
+                        .items()
+                    )
+                },
+            }
+            # The per-layer calibration table (clip/observed_max/
+            # samples) is regime-independent — recorded ONCE at the
+            # serve_quant level, not duplicated into every regime.
+            layer_calibration = getattr(fn, "quant_layer_calibration", None)
+            if layer_calibration and "layer_calibration" not in (
+                serve_quant_meta
+            ):
+                serve_quant_meta["layer_calibration"] = {
+                    key: {
+                        stat: (
+                            int(value)
+                            if stat == "samples"
+                            else float(value)
+                        )
+                        for stat, value in entry.items()
+                    }
+                    for key, entry in sorted(layer_calibration.items())
+                }
+            serve_quant_meta["calib"][regime] = calib_entry
             quant_payload_bytes[regime] = serialization.to_bytes(
                 _to_plain(fn.quant_payload)
             )
@@ -364,6 +434,23 @@ def save_exported_model(
                         serve_quant_meta.setdefault("dot_audit_error", {})[
                             regime
                         ] = f"{type(audit_err).__name__}: {audit_err}"
+                    try:
+                        # The reduce audit, against the fp32 baseline
+                        # program: activation_quant_reduces == 0 is the
+                        # static-calibration proof (every dynamically-
+                        # quantized contraction in the serialized
+                        # program shows up as +1 max reduce over the
+                        # baseline).
+                        serve_quant_meta.setdefault("reduce_audit", {})[
+                            regime
+                        ] = sq.audit_quant_reduces(
+                            artifact, baseline_bytes=stablehlo_bytes
+                        )
+                    except Exception as audit_err:  # noqa: BLE001 — same
+                        # bookkeeping rule as the dot audit.
+                        serve_quant_meta.setdefault(
+                            "reduce_audit_error", {}
+                        )[regime] = f"{type(audit_err).__name__}: {audit_err}"
                 except Exception as e:  # noqa: BLE001 — same best-effort rule
                     # as the default artifact: record why, keep exporting.
                     serve_quant_meta["stablehlo"][regime] = False
@@ -559,6 +646,7 @@ def _export_aot_executables(
     wrote_any = False
     for regime, entry in regimes.items():
         fingerprint = aot_lib.artifact_fingerprint(regime, entry["digests"])
+        compile_ms: Dict[int, float] = {}
         try:
             blobs = aot_lib.build_bucket_executables(
                 entry["artifact"],
@@ -566,6 +654,7 @@ def _export_aot_executables(
                 regime=regime,
                 fingerprint=fingerprint,
                 prefix_args=entry["prefix"],
+                timings_ms=compile_ms,
             )
         except Exception as err:  # noqa: BLE001 — a backend without
             # executable serialization must not fail the export; the
@@ -589,6 +678,11 @@ def _export_aot_executables(
         meta["fingerprint"][regime] = fingerprint
         meta["buckets"][regime] = sorted(int(b) for b in blobs)
         meta["nbytes"][regime] = int(sum(len(b) for b in blobs.values()))
+        # Per-bucket compile wall-clock (ms): the thread-pooled compiles
+        # overlap, so the regime's publish cost is ~max, not sum.
+        meta.setdefault("compile_ms", {})[regime] = {
+            str(bucket): compile_ms[bucket] for bucket in sorted(compile_ms)
+        }
         wrote_any = True
     return meta if wrote_any or "errors" in meta else None
 
@@ -799,6 +893,44 @@ class ExportedModel:
         native = (self.metadata.get("serve_quant") or {}).get("native") or {}
         entry = native.get(self.quant_regime) or {}
         return tuple(entry.get("layers") or ())
+
+    @property
+    def native_attention(self) -> tuple:
+        """Attention modules whose QK^T/PV contractions the loaded
+        regime's program executes on quantized operands (the export's
+        fired 'attn/<path>' keys); empty for 'none', fp16, or when no
+        eligible attention ever lowered (e.g. flash-path heads)."""
+        if self.quant_regime == "none":
+            return ()
+        native = (self.metadata.get("serve_quant") or {}).get("native") or {}
+        entry = native.get(self.quant_regime) or {}
+        return tuple(entry.get("attention") or ())
+
+    @property
+    def calib_mode(self) -> Optional[str]:
+        """The activation-calibration mode of the loaded regime's
+        program ('static' = per-layer clips baked in, zero per-dispatch
+        quant reduces; 'dynamic' = the round-16 per-row path; None for
+        'none'/pre-round-18 artifacts) — surfaced per replica next to
+        `quant_regime` for fleet mix-verification."""
+        if self.quant_regime == "none":
+            return None
+        calib = (self.metadata.get("serve_quant") or {}).get("calib") or {}
+        entry = calib.get(self.quant_regime)
+        return entry.get("mode") if entry else None
+
+    @property
+    def quant_reduce_audit(self) -> Optional[Dict[str, Any]]:
+        """The export-recorded reduce audit of the loaded regime's
+        serialized program (`audit_quant_reduces`):
+        `activation_quant_reduces` == 0 is the static-calibration proof.
+        None for 'none' or artifacts without the audit."""
+        if self.quant_regime == "none":
+            return None
+        audits = (
+            self.metadata.get("serve_quant") or {}
+        ).get("reduce_audit") or {}
+        return audits.get(self.quant_regime)
 
     @property
     def has_stablehlo(self) -> bool:

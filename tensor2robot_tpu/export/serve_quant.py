@@ -68,6 +68,36 @@ the artifact's `aot/` dir, fingerprinted over the program bytes PLUS
 the quantized payload bytes — a regime restore deserializes instead of
 compiling, and a payload swapped under an executable can never pass the
 key check.
+
+Static activation calibration + conv/attention lowering (round 18):
+round 16 left two costs in the native hot path. First, every eligible
+dot paid a PER-DISPATCH activation-quant reduce (the dynamic per-row
+max-abs); round 18 generalizes the input-boundary calibrator to
+INTERMEDIATE layers: `capture_activations` intercepts the fp32 forward
+over the warmup corpus, `calibrate_layer_activations` turns the
+recorded |x| pools into per-layer 99.9th-percentile clips, and
+`native_dot`/`native_conv`/the attention contractions consume the
+STATIC clip as a traced constant — the serialized program for a
+statically-calibrated layer contains ZERO activation-quant reductions
+(`audit_quant_reduces` counts reduce ops by kind against the fp32
+baseline program and records the delta in metadata next to
+`dot_audit`). A layer whose warmup activations overshoot their clip
+beyond `DEFAULT_STATIC_OVERSHOOT` is demoted BACK to dynamic
+per-row quant (`resolve_static_scales`, demotion recorded per layer);
+`T2R_SERVE_CALIB=dynamic` keeps the round-16 per-row path — same ops,
+and for a model whose eligibility map round 18 did not widen (dense
+kernels only, no attention on the einsum path) the same serialized
+program. Second, 4-D kernels and attention were
+demoted wholesale; round 18 lowers them too: `_channel_encode`
+generalizes per-output-channel scales to conv accumulator shapes
+(absmax over every non-channel axis), `native_conv` contracts
+`conv_general_dilated` on int8/fp8 operands with a per-sample (or
+static per-layer) activation scalar that is exactly constant along the
+contraction window, and the attention QK^T / PV contractions run on
+quantized operands via the `ops/flash_attention` contraction-override
+hook where heads are eligible (`T2R_SERVE_NATIVE_ATTN`) — per-row
+scales on both operands stay exact on the accumulator because each is
+constant along the contraction axis.
 """
 
 from __future__ import annotations
@@ -89,23 +119,34 @@ from tensor2robot_tpu.parallel.collectives import (
 
 __all__ = [
     "QuantParityError",
+    "CalibrationError",
     "SERVE_QUANT_REGIMES",
     "NATIVE_DOT_REGIMES",
+    "CALIB_MODES",
     "GRAN_BLOCK",
     "GRAN_CHANNEL",
     "DEFAULT_BLOCK",
     "DEFAULT_MIN_SIZE",
     "DEFAULT_PARITY_TOL",
+    "DEFAULT_STATIC_OVERSHOOT",
     "Q_KEY",
     "S_KEY",
     "quantize_tree",
     "dequantize_tree",
     "default_native_eligibility",
     "resolve_native_eligibility",
+    "resolve_native_attention",
+    "resolve_calib_mode",
+    "attn_key",
     "native_dot",
+    "native_conv",
     "native_lowering",
     "audit_dot_dtypes",
+    "audit_quant_reduces",
+    "capture_activations",
     "calibrate_activations",
+    "calibrate_layer_activations",
+    "resolve_static_scales",
     "fake_quant_activations",
     "measure_parity",
     "check_parity",
@@ -131,6 +172,22 @@ _FP8_FORMATS = {
 #: on the storage dtype (fp16 is a cast regime — XLA already runs fp16
 #: matmuls natively from the dequant path, nothing to lower).
 NATIVE_DOT_REGIMES = ("int8", "fp8_e4m3", "fp8_e5m2")
+
+#: Activation-calibration modes: 'static' bakes export-time per-layer
+#: clips into the program (zero per-dispatch quant reduces); 'dynamic'
+#: is the round-16 per-row max-abs path, op for op.
+CALIB_MODES = ("static", "dynamic")
+
+#: Per-layer demotion gate for static calibration: a layer whose
+#: observed warmup max-abs overshoots its percentile clip by more than
+#: this RELATIVE fraction falls back to dynamic per-row quant — the
+#: clip would truncate real rows, and a truncated activation is a
+#: silent accuracy cliff no end-to-end gate can attribute to a layer.
+DEFAULT_STATIC_OVERSHOOT = 0.5
+
+#: Percentile the intermediate-layer calibrator shares with the input
+#: boundary one (one outlier activation must not stretch the step).
+DEFAULT_CALIB_PERCENTILE = 99.9
 
 #: Minimum contraction depth (kernel rows) for native eligibility: a
 #: per-channel scale costs 4 bytes over `rows` 1-byte values, so shallow
@@ -176,6 +233,32 @@ class QuantParityError(RuntimeError):
     declared tolerance on the warmup corpus; the export must not land."""
 
 
+class CalibrationError(ValueError):
+    """The warmup corpus cannot calibrate activation scales (empty, or a
+    batch carries NaN/Inf) — raised BEFORE the parity gate, naming the
+    offending key, so a poisoned corpus fails the export loudly instead
+    of baking a NaN-derived clip into the artifact."""
+
+
+def resolve_calib_mode(mode: Optional[str] = None) -> str:
+    """The activation-calibration mode after the T2R_SERVE_CALIB flag.
+
+    `mode` None reads the flag; an explicit value is validated here so
+    programmatic callers get the same error a bad env var would.
+    """
+    if mode is None:
+        from tensor2robot_tpu import flags
+
+        return flags.get_enum("T2R_SERVE_CALIB")
+    if mode not in CALIB_MODES:
+        raise ValueError(
+            f"calibration mode must be one of {CALIB_MODES}, got "
+            f"{mode!r} (T2R_SERVE_CALIB selects the serving calibration "
+            "mode)"
+        )
+    return mode
+
+
 def _is_payload_node(node: Any) -> bool:
     return isinstance(node, Mapping) and Q_KEY in node and S_KEY in node
 
@@ -199,11 +282,16 @@ def _levels(regime: str) -> float:
 def _channel_encode(
     leaf: np.ndarray, regime: str
 ) -> Tuple[np.ndarray, np.ndarray]:
-    """Per-output-channel symmetric encode of a [in, out] kernel: one
-    scale per output column (axis -1), values stored in the ORIGINAL 2-D
-    shape in the regime's storage dtype — the operand `native_dot`
-    contracts against without dequantizing."""
-    absmax = np.max(np.abs(leaf), axis=0)
+    """Per-output-channel symmetric encode of an [..., out] kernel: one
+    scale per output channel (axis -1, absmax over every other axis —
+    axis 0 for a dense [in, out] kernel, the spatial+input axes for a
+    conv [*window, in, out] kernel), values stored in the ORIGINAL
+    shape in the regime's storage dtype — the operand
+    `native_dot`/`native_conv` contracts against without dequantizing.
+    The per-channel scale is the only granularity that can move to the
+    accumulator for BOTH layouts: it is constant along everything the
+    contraction sums over."""
+    absmax = np.max(np.abs(leaf), axis=tuple(range(leaf.ndim - 1)))
     absmax = np.where(absmax > 0, absmax, 1.0).astype(np.float32)
     scale = absmax / _levels(regime)
     if regime == "int8":
@@ -265,13 +353,14 @@ def quantize_tree(
         if flat_path in native:
             seen.add(flat_path)
             if not (
-                jnp.issubdtype(leaf.dtype, jnp.floating) and leaf.ndim == 2
+                jnp.issubdtype(leaf.dtype, jnp.floating)
+                and leaf.ndim in (2, 3, 4)
             ):
                 raise ValueError(
                     f"native-eligible leaf {flat_path!r} must be a 2-D "
-                    f"float kernel, got shape {leaf.shape} dtype "
-                    f"{leaf.dtype} (fix the T2R_SERVE_NATIVE_LAYERS "
-                    "override)"
+                    f"dense or 3/4-D conv float kernel, got shape "
+                    f"{leaf.shape} dtype {leaf.dtype} (fix the "
+                    "T2R_SERVE_NATIVE_LAYERS override)"
                 )
             q, scale = _channel_encode(leaf.astype(np.float32), regime)
             layout[flat_path] = {
@@ -362,13 +451,12 @@ def default_native_eligibility(
     regime: str,
     min_size: int = DEFAULT_MIN_SIZE,
 ) -> Tuple[str, ...]:
-    """The default eligibility map: every 2-D float '.../kernel' leaf of
-    at least `min_size` elements and `DEFAULT_MIN_NATIVE_ROWS`
-    contraction depth — the dense contractions flax Dense layers own.
-    Conv kernels (4-D) and norm/bias vectors stay on the dequant path
-    (their contraction layouts don't admit an exact per-output-channel
-    accumulator scale through this lowering), and shallow kernels stay
-    blockwise (per-channel scales would bloat them, see
+    """The default eligibility map: every 2-D dense and 3/4-D conv float
+    '.../kernel' leaf of at least `min_size` elements and
+    `DEFAULT_MIN_NATIVE_ROWS` contraction depth (kernel rows for dense,
+    window x input channels for conv — everything the accumulator sums
+    over). Norm/bias vectors stay on the dequant path, and shallow
+    kernels stay blockwise (per-channel scales would bloat them, see
     DEFAULT_MIN_NATIVE_ROWS)."""
     if regime not in NATIVE_DOT_REGIMES:
         return ()
@@ -383,10 +471,10 @@ def default_native_eligibility(
         if (
             path
             and path[-1] == "kernel"
-            and leaf.ndim == 2
+            and leaf.ndim in (2, 3, 4)
             and jnp.issubdtype(leaf.dtype, jnp.floating)
             and leaf.size >= min_size
-            and leaf.shape[0] >= DEFAULT_MIN_NATIVE_ROWS
+            and int(np.prod(leaf.shape[:-1])) >= DEFAULT_MIN_NATIVE_ROWS
         ):
             paths.append("/".join(path))
 
@@ -425,34 +513,283 @@ def resolve_native_eligibility(
     )
 
 
-def native_dot(x: jax.Array, q: jax.Array, scale: jax.Array, regime: str):
+def attn_key(module_path: Sequence[str]) -> str:
+    """The flat eligibility/fired/calibration key of one attention
+    module's contractions ('attn/<module path>'); operand-specific
+    static clips append ':q'/':k'/':v'."""
+    return "attn/" + "/".join(module_path)
+
+
+def resolve_native_attention(override: Optional[str] = None):
+    """Attention-head eligibility after the T2R_SERVE_NATIVE_ATTN flag.
+
+    Returns 'auto' (every attention module on the einsum path lowers its
+    QK^T/PV contractions), () for 'none', or a tuple of fnmatch globs
+    matched against the attention module's flat path. Heads on the
+    flash/ring/ulysses kernels never lower — only the materialized-
+    logits einsum path has the contraction hook.
+    """
+    if override is None:
+        from tensor2robot_tpu import flags
+
+        override = flags.get_str("T2R_SERVE_NATIVE_ATTN")
+    if override is None or override == "auto":
+        return "auto"
+    if override == "none" or override == ():
+        return ()
+    if isinstance(override, (tuple, list)):
+        return tuple(override)
+    return tuple(g.strip() for g in override.split(",") if g.strip())
+
+
+def _attention_eligible(spec, module_path: Sequence[str]) -> bool:
+    if spec == "auto":
+        return True
+    flat = "/".join(module_path)
+    return any(fnmatch.fnmatchcase(flat, g) for g in spec)
+
+
+def _activation_scale(
+    x: jax.Array,
+    regime: str,
+    a_clip: Optional[float],
+    axes: Tuple[int, ...] = (-1,),
+):
+    """The activation quant scale: dynamic max-abs over `axes` (a
+    traced reduce — per-row for dots, per-sample for convs) when
+    `a_clip` is None, or the STATIC export-calibrated clip as a traced
+    constant — the serialized program then carries zero
+    activation-quant reductions for this contraction
+    (`audit_quant_reduces` proves it)."""
+    if a_clip is None:
+        dyn_max = jnp.max(jnp.abs(x), axis=axes, keepdims=True)
+        return jnp.maximum(dyn_max, jnp.float32(1e-12)) / _levels(regime)
+    return jnp.float32(max(float(a_clip), 1e-12) / _levels(regime))
+
+
+def _quantize_activation(x: jax.Array, a_scale, regime: str) -> jax.Array:
+    if regime == "int8":
+        return jnp.clip(jnp.round(x / a_scale), -127, 127).astype(jnp.int8)
+    dtype, fmax = _FP8_FORMATS[regime]
+    return jnp.clip(x / a_scale, -fmax, fmax).astype(dtype)
+
+
+def _acc_dtype(regime: str):
+    return jnp.int32 if regime == "int8" else jnp.float32
+
+
+def native_dot(
+    x: jax.Array,
+    q: jax.Array,
+    scale: jax.Array,
+    regime: str,
+    a_clip: Optional[float] = None,
+):
     """One eligible contraction, natively low-precision.
 
     The activation is quantized per ROW (dynamic max-abs over the last
     axis — per-token, so no sample's scale depends on its batchmates or
-    on bucket padding), the contraction runs on the quantized operands
-    (`preferred_element_type` keeps the accumulator wide), and both
-    scales multiply the ACCUMULATOR — which is exactly correct because
-    the activation scale is constant along the contraction for each row
-    and the weight scale is constant along it for each output channel.
-    Returns f32 [..., out].
+    on bucket padding) or against the STATIC export-calibrated clip
+    `a_clip` (no per-dispatch reduce at all), the contraction runs on
+    the quantized operands (`preferred_element_type` keeps the
+    accumulator wide), and both scales multiply the ACCUMULATOR — which
+    is exactly correct because the activation scale is constant along
+    the contraction for each row and the weight scale is constant along
+    it for each output channel. Returns f32 [..., out].
     """
     x = jnp.asarray(x)
-    row_max = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
-    a_scale = jnp.maximum(row_max, jnp.float32(1e-12)) / _levels(regime)
+    a_scale = _activation_scale(x, regime, a_clip)
     dims = (((x.ndim - 1,), (0,)), ((), ()))
-    if regime == "int8":
-        xq = jnp.clip(jnp.round(x / a_scale), -127, 127).astype(jnp.int8)
-        acc = lax.dot_general(
-            xq, q, dims, preferred_element_type=jnp.int32
-        ).astype(jnp.float32)
-    else:
-        dtype, fmax = _FP8_FORMATS[regime]
-        xq = jnp.clip(x / a_scale, -fmax, fmax).astype(dtype)
-        acc = lax.dot_general(
-            xq, q, dims, preferred_element_type=jnp.float32
-        )
+    xq = _quantize_activation(x, a_scale, regime)
+    acc = lax.dot_general(
+        xq, q, dims, preferred_element_type=_acc_dtype(regime)
+    ).astype(jnp.float32)
     return acc * a_scale * scale
+
+
+# Channels-last dimension specs by spatial rank. Native kernels are
+# capped at ndim 4 (1-D/2-D conv) by quantize_tree/the eligibility map,
+# so spatial rank 3 (Conv3D) has no entry on purpose.
+_CONV_DIM_SPECS = {
+    1: ("NWC", "WIO", "NWC"),
+    2: ("NHWC", "HWIO", "NHWC"),
+}
+
+
+def _conv_tuple(value, n: int) -> Tuple[int, ...]:
+    if value is None:
+        return (1,) * n
+    if isinstance(value, int):
+        return (value,) * n
+    return tuple(int(v) for v in value)
+
+
+def native_conv(
+    x: jax.Array,
+    q: jax.Array,
+    scale: jax.Array,
+    regime: str,
+    *,
+    strides=None,
+    padding="SAME",
+    input_dilation=None,
+    kernel_dilation=None,
+    feature_group_count: int = 1,
+    a_clip: Optional[float] = None,
+):
+    """One eligible convolution, natively low-precision.
+
+    The kernel operand is the stored per-output-channel payload
+    ([*window, in, out] in the regime's storage dtype, one scale per
+    output channel). The activation scale must be constant along the
+    WHOLE contraction window (spatial taps x input channels), and a
+    per-row scale is not — each output position reads a different
+    patch — so the dynamic scale here is per SAMPLE (max-abs over the
+    full feature map: exact on the accumulator, still independent of
+    batchmates and bucket padding) and the static scale is the
+    export-calibrated per-layer clip (zero reduces). Channels-last
+    layouts only (flax nn.Conv's); returns f32 [N, *spatial, out].
+    """
+    x = jnp.asarray(x)
+    spatial = q.ndim - 2
+    a_scale = _activation_scale(
+        x, regime, a_clip, axes=tuple(range(1, x.ndim))
+    )
+    xq = _quantize_activation(x, a_scale, regime)
+    dn = lax.conv_dimension_numbers(
+        x.shape, q.shape, _CONV_DIM_SPECS[spatial]
+    )
+    if isinstance(padding, str):
+        pad = padding
+    elif isinstance(padding, int):
+        pad = ((int(padding), int(padding)),) * spatial
+    else:
+        pad = tuple(
+            (int(p), int(p)) if isinstance(p, int) else (int(p[0]), int(p[1]))
+            for p in padding
+        )
+    acc = lax.conv_general_dilated(
+        xq,
+        q,
+        window_strides=_conv_tuple(strides, spatial),
+        padding=pad,
+        lhs_dilation=_conv_tuple(input_dilation, spatial),
+        rhs_dilation=_conv_tuple(kernel_dilation, spatial),
+        dimension_numbers=dn,
+        feature_group_count=int(feature_group_count),
+        preferred_element_type=_acc_dtype(regime),
+    ).astype(jnp.float32)
+    return acc * a_scale * scale
+
+
+class _QuantAttentionContraction:
+    """QK^T and PV on quantized operands — the impl the lowering installs
+    through `ops/flash_attention.attention_contraction_override`.
+
+    Both contractions keep the accumulator-scale discipline exact: the
+    q/k/v operand scales are per ROW of the contraction (or the static
+    per-layer clip), so each is constant along the summed axis; the
+    softmax probs operand needs NO calibration at all — probs <= 1 by
+    construction, so the static clip 1.0 is always a valid bound and
+    that contraction never pays a quant reduce even in dynamic mode.
+    """
+
+    def __init__(self, regime: str, static_scales=None, fired=None):
+        self.regime = regime
+        self._static = dict(static_scales or {})
+        self._fired = fired
+        #: Set by the interceptor to the active module's attn_key before
+        #: the module body runs (single-threaded tracing).
+        self.path_key: Optional[str] = None
+
+    def _clip(self, operand: str) -> Optional[float]:
+        if self.path_key is None:
+            return None
+        return self._static.get(f"{self.path_key}:{operand}")
+
+    def qk(self, q, k, scale):
+        regime = self.regime
+        if self._fired is not None and self.path_key is not None:
+            self._fired.add(self.path_key)
+        q, k = jnp.asarray(q), jnp.asarray(k)
+        q_clip, k_clip = self._clip("q"), self._clip("k")
+        q_scale = _activation_scale(q, regime, q_clip)
+        k_scale = _activation_scale(k, regime, k_clip)
+        qq = _quantize_activation(q, q_scale, regime)
+        kq = _quantize_activation(k, k_scale, regime)
+        # [B,Q,H,D] x [B,K,H,D] -> [B,H,Q,K], contracting D, batching B,H.
+        acc = lax.dot_general(
+            qq, kq, (((3,), (3,)), ((0, 2), (0, 2))),
+            preferred_element_type=_acc_dtype(regime),
+        ).astype(jnp.float32)
+        if q_clip is None:
+            acc = acc * jnp.transpose(q_scale, (0, 2, 1, 3))  # [B,H,Q,1]
+        else:
+            acc = acc * q_scale
+        if k_clip is None:
+            acc = acc * jnp.transpose(k_scale, (0, 2, 3, 1))  # [B,H,1,K]
+        else:
+            acc = acc * k_scale
+        return acc * scale
+
+    def pv(self, probs, v):
+        regime = self.regime
+        v = jnp.asarray(v)
+        p_scale = jnp.float32(1.0 / _levels(regime))
+        pq = _quantize_activation(probs, p_scale, regime)
+        v_clip = self._clip("v")
+        if v_clip is None:
+            # Constant along the contraction (keys) axis per [B,H,D].
+            v_max = jnp.max(jnp.abs(v), axis=1, keepdims=True)
+            v_scale = jnp.maximum(v_max, jnp.float32(1e-12)) / _levels(
+                regime
+            )
+        else:
+            v_scale = jnp.float32(
+                max(float(v_clip), 1e-12) / _levels(regime)
+            )
+        vq = _quantize_activation(v, v_scale, regime)
+        # [B,H,Q,K] x [B,K,H,D] -> [B,H,Q,D], contracting K, batching B,H.
+        acc = lax.dot_general(
+            pq, vq, (((3,), (1,)), ((0, 1), (0, 2))),
+            preferred_element_type=_acc_dtype(regime),
+        ).astype(jnp.float32)
+        acc = acc * p_scale
+        if v_clip is None:
+            acc = acc * jnp.transpose(v_scale, (0, 2, 1, 3))  # [B,H,1,D]
+        else:
+            acc = acc * v_scale
+        return jnp.transpose(acc, (0, 2, 1, 3))  # [B,Q,H,D]
+
+
+class _CaptureAttentionContraction:
+    """Capture twin of the quantized impl: records the |q|/|k|/|v|
+    operand pools during the fp32 calibration run and computes the
+    exact reference contractions."""
+
+    def __init__(self, pool_fn):
+        self._pool = pool_fn
+        self.path_key: Optional[str] = None
+
+    def qk(self, q, k, scale):
+        self._pool(f"{self.path_key}:q", q)
+        self._pool(f"{self.path_key}:k", k)
+        return jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+
+    def pv(self, probs, v):
+        self._pool(f"{self.path_key}:v", v)
+        return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _attention_module_types() -> tuple:
+    """The attention module classes the lowering/capture intercept;
+    empty when the transformer stack cannot import (the MLP-only
+    serving paths must not grow a hard dependency on it)."""
+    try:
+        from tensor2robot_tpu.layers.transformer import MultiHeadAttention
+    except Exception:  # noqa: BLE001 — optional layer stack
+        return ()
+    return (MultiHeadAttention,)
 
 
 @contextlib.contextmanager
@@ -462,31 +799,54 @@ def native_lowering(
     regime: str,
     bound_variables: Any,
     fired: Optional[set] = None,
+    static_scales: Optional[Mapping[str, float]] = None,
+    attn: Optional[str] = None,
 ):
-    """Context manager lowering eligible Dense contractions natively.
+    """Context manager lowering eligible contractions natively.
 
-    Inside the context, every flax Dense whose kernel payload is
+    Inside the context, every flax Dense OR Conv whose kernel payload is
     channel-quantized (granularity 'channel' in `layout`) computes
-    `native_dot` on the STORED operands instead of the f32 matmul the
-    dequantized tree would produce; its bias comes from
+    `native_dot`/`native_conv` on the STORED operands instead of the f32
+    contraction the dequantized tree would produce; its bias comes from
     `bound_variables` (the dequantized tree the non-intercepted layers
-    consume). Everything else — BatchNorm, non-eligible Dense layers,
-    custom modules — runs untouched. Pure trace-time interception: the
-    lowering is baked into whatever jit/export traces inside the
-    context, so the serialized serving program carries the int8/fp8
-    contractions (auditable via `audit_dot_dtypes`).
+    consume). Eligible attention modules additionally run their
+    QK^T/PV contractions on quantized operands through the
+    `ops/flash_attention` contraction-override hook (einsum path only —
+    flash/ring/ulysses heads are never eligible). Everything else —
+    BatchNorm, non-eligible layers, custom modules — runs untouched.
+    Pure trace-time interception: the lowering is baked into whatever
+    jit/export traces inside the context, so the serialized serving
+    program carries the int8/fp8 contractions (auditable via
+    `audit_dot_dtypes`).
 
-    `fired` (optional mutable set) collects the flat payload paths the
-    interceptor ACTUALLY lowered during the traced/eager run. The
-    eligibility map is structural (any deep 2-D kernel), but only
-    kernels owned by an nn.Dense whose module path mirrors the
-    variables path ever intercept — a kernel under nn.Einsum, a custom
-    module, or a lifted transform stays on the dequant path silently.
-    The export records claimed-vs-fired off this set so the
-    compute-attribution surface reports what the program executes, not
-    what the map hoped.
+    `static_scales` maps flat kernel paths (and `attn/<path>:q|k|v`
+    keys) to export-calibrated activation clips: contractions with an
+    entry quantize against the static clip as a traced CONSTANT — the
+    serialized program carries zero activation-quant reductions for
+    them (`audit_quant_reduces`); contractions without one keep the
+    round-16 dynamic per-row reduce, op for op.
+
+    `attn` is the attention-head eligibility (None resolves the
+    T2R_SERVE_NATIVE_ATTN flag; see `resolve_native_attention`).
+
+    `fired` (optional mutable set) collects the flat payload paths (and
+    attention keys) the interceptor ACTUALLY lowered during the traced/
+    eager run. The eligibility map is structural (any deep kernel), but
+    only kernels owned by an nn.Dense/nn.Conv whose module path mirrors
+    the variables path ever intercept — a kernel under nn.Einsum, a
+    custom module, a masked/circular-padded Conv, or a lifted transform
+    stays on the dequant path silently. The export records
+    claimed-vs-fired off this set so the compute-attribution surface
+    reports what the program executes, not what the map hoped.
     """
     import flax.linen as nn
+
+    static = dict(static_scales or {})
+    attn_spec = resolve_native_attention(attn) if attn != () else ()
+    attn_types = _attention_module_types() if attn_spec != () else ()
+    attn_impl = _QuantAttentionContraction(
+        regime, static_scales=static, fired=fired
+    )
 
     channel_nodes: Dict[Tuple[str, ...], Any] = {}
     for flat_path, meta in layout.items():
@@ -506,29 +866,72 @@ def native_lowering(
             node = node[part]
         return node
 
-    def interceptor(next_fun, args, kwargs, context):
-        module = context.module
-        if context.method_name != "__call__" or not isinstance(
-            module, nn.Dense
-        ):
-            return next_fun(*args, **kwargs)
-        parts = ("params",) + tuple(module.path) + ("kernel",)
-        node = channel_nodes.get(parts)
-        if node is None:
-            return next_fun(*args, **kwargs)
-        (x,) = args
-        if fired is not None:
-            fired.add("/".join(parts))
-        y = native_dot(
-            x, jnp.asarray(node[Q_KEY]), jnp.asarray(node[S_KEY]), regime
-        )
+    def _with_bias(y, module, parts):
         if module.use_bias:
             bias = _bound(parts[:-1] + ("bias",))
             if bias is not None:
                 y = y + jnp.asarray(bias)
         return y
 
-    if not channel_nodes:
+    def interceptor(next_fun, args, kwargs, context):
+        module = context.module
+        if context.method_name != "__call__":
+            return next_fun(*args, **kwargs)
+        if attn_types and isinstance(module, attn_types):
+            path = tuple(module.path)
+            if not _attention_eligible(attn_spec, path):
+                return next_fun(*args, **kwargs)
+            from tensor2robot_tpu.ops import flash_attention as flash_lib
+
+            previous = attn_impl.path_key
+            attn_impl.path_key = attn_key(path)
+            try:
+                with flash_lib.attention_contraction_override(attn_impl):
+                    return next_fun(*args, **kwargs)
+            finally:
+                attn_impl.path_key = previous
+        if not isinstance(module, (nn.Dense, nn.Conv)):
+            return next_fun(*args, **kwargs)
+        parts = ("params",) + tuple(module.path) + ("kernel",)
+        node = channel_nodes.get(parts)
+        if node is None:
+            return next_fun(*args, **kwargs)
+        flat = "/".join(parts)
+        (x,) = args
+        if isinstance(module, nn.Dense):
+            if fired is not None:
+                fired.add(flat)
+            y = native_dot(
+                x, jnp.asarray(node[Q_KEY]), jnp.asarray(node[S_KEY]),
+                regime, a_clip=static.get(flat),
+            )
+            return _with_bias(y, module, parts)
+        # nn.Conv: lower only configurations native_conv reproduces
+        # EXACTLY; anything else (circular/causal padding, masked
+        # kernels, unbatched inputs) stays on the dequant path and is
+        # surfaced by claimed-vs-fired.
+        q = jnp.asarray(node[Q_KEY])
+        padding = module.padding
+        if isinstance(padding, str) and padding not in ("SAME", "VALID"):
+            return next_fun(*args, **kwargs)
+        if getattr(module, "mask", None) is not None:
+            return next_fun(*args, **kwargs)
+        if jnp.asarray(x).ndim != q.ndim:
+            return next_fun(*args, **kwargs)
+        if fired is not None:
+            fired.add(flat)
+        y = native_conv(
+            x, q, jnp.asarray(node[S_KEY]), regime,
+            strides=module.strides,
+            padding=padding,
+            input_dilation=module.input_dilation,
+            kernel_dilation=module.kernel_dilation,
+            feature_group_count=module.feature_group_count,
+            a_clip=static.get(flat),
+        )
+        return _with_bias(y, module, parts)
+
+    if not channel_nodes and not attn_types:
         yield
         return
     with nn.intercept_methods(interceptor):
@@ -594,7 +997,228 @@ def audit_dot_dtypes(artifact_bytes: bytes) -> Dict[str, int]:
     return counts
 
 
+#: StableHLO reduce-applier spellings -> the short kind names the audit
+#: reports. Every activation-quant reduce is a MAXIMUM reduce (max-abs
+#: scale); add/min/etc. exist so the histogram stays interpretable.
+_REDUCE_KIND_NAMES = {
+    "maximum": "max",
+    "minimum": "min",
+    "add": "add",
+    "multiply": "mul",
+    "or": "or",
+    "and": "and",
+}
+
+
+def _count_reduce_kinds(text: str) -> Dict[str, int]:
+    """{kind: count} of `stablehlo.reduce` ops in one MLIR module, by
+    the applied computation. Handles both the compact pretty form
+    (`... applies stablehlo.maximum across ...`) and the region form
+    (applier op on a following line inside the reduce body). Never
+    counts `reduce_window` (pooling) or `#loc` provenance lines."""
+    import re
+
+    applies = re.compile(
+        r"stablehlo\.reduce\(.*applies\s+stablehlo\.(\w+)\b"
+    )
+    region_op = re.compile(
+        r"stablehlo\.(maximum|minimum|add|multiply|or|and)\b"
+    )
+    counts: Dict[str, int] = {}
+    pending = False
+    for line in text.splitlines():
+        match = applies.search(line)
+        if match is not None:
+            kind = _REDUCE_KIND_NAMES.get(match.group(1), match.group(1))
+            counts[kind] = counts.get(kind, 0) + 1
+            continue
+        if "stablehlo.reduce(" in line or '"stablehlo.reduce"' in line:
+            pending = True
+            continue
+        if pending:
+            match = region_op.search(line)
+            if match is not None:
+                kind = _REDUCE_KIND_NAMES[match.group(1)]
+                counts[kind] = counts.get(kind, 0) + 1
+                pending = False
+            elif "stablehlo.return" in line:
+                # Region closed without one of the listed appliers (an
+                # argmax-style compare/select body): stop scanning, or
+                # a later ELEMENTWISE maximum/add line elsewhere in the
+                # module would be miscounted as this reduce's applier.
+                pending = False
+    counts["total"] = sum(counts.values())
+    return counts
+
+
+def audit_quant_reduces(
+    artifact_bytes: bytes,
+    baseline_bytes: Optional[bytes] = None,
+) -> Dict[str, int]:
+    """Counts reduction ops in a serialized serving program — the proof
+    that static calibration removed the per-dispatch activation-quant
+    reduces from the artifact.
+
+    Every activation-quant reduce the dynamic path traces is a MAX
+    reduce (per-row / per-sample max-abs). A model's own forward may
+    carry max reduces too (softmax stability), so the auditable number
+    is the DELTA against the fp32 baseline program (`baseline_bytes`,
+    the default artifact's): `activation_quant_reduces = quant max
+    reduces - baseline max reduces`, clamped at 0. A statically-
+    calibrated program must show 0; every dynamically-quantized
+    contraction shows up as +1. Recorded in t2r_metadata.json next to
+    `dot_audit` and re-checked by bench/tests on the artifact bytes a
+    restore executes.
+    """
+    from jax import export as jax_export
+
+    counts = _count_reduce_kinds(
+        jax_export.deserialize(bytes(artifact_bytes)).mlir_module()
+    )
+    if baseline_bytes is not None:
+        baseline = _count_reduce_kinds(
+            jax_export.deserialize(bytes(baseline_bytes)).mlir_module()
+        )
+        counts["baseline_max"] = baseline.get("max", 0)
+        counts["activation_quant_reduces"] = max(
+            0, counts.get("max", 0) - baseline.get("max", 0)
+        )
+    return counts
+
+
 # -- activation calibration ----------------------------------------------------
+
+
+#: Per-call cap on captured |activation| samples: a conv tower's
+#: feature maps are O(batch x H x W x C) floats per layer per batch,
+#: and holding every one until calibration would OOM the export on
+#: exactly the vision models static calibration targets. Above the cap
+#: the pool is stride-subsampled — with the call's TRUE max appended,
+#: so the demotion gate's observed_max stays exact while the
+#: percentile runs on a bounded, uniformly-strided sample.
+CAPTURE_SAMPLES_PER_CALL = 1 << 16
+
+
+@contextlib.contextmanager
+def capture_activations(records: Dict[str, List[np.ndarray]]):
+    """Records per-layer |activation| pools during an EAGER fp32 forward.
+
+    Inside the context, every flax Dense/Conv `__call__` appends the
+    flattened |input| of the call to `records` under its flat kernel
+    path ('params/.../kernel' — the same key the eligibility map and
+    `static_scales` use), and every attention module records its
+    q/k/v contraction operands under 'attn/<path>:q|k|v' via the
+    capture twin of the contraction override. Pools larger than
+    `CAPTURE_SAMPLES_PER_CALL` are stride-subsampled with the exact
+    max preserved (host memory stays bounded per layer per batch).
+    The capture contract: run the UN-JITTED fp32 forward over the
+    warmup corpus inside this context (concrete values only — a traced
+    run has no numbers to record), then feed `records` to
+    `calibrate_layer_activations`.
+    """
+    import flax.linen as nn
+
+    def _pool(key: str, value) -> None:
+        arr = np.asarray(value)
+        flat = np.abs(arr.astype(np.float32)).reshape(-1)
+        if flat.size > CAPTURE_SAMPLES_PER_CALL:
+            stride = -(-flat.size // CAPTURE_SAMPLES_PER_CALL)
+            flat = np.append(flat[::stride], flat.max())
+        records.setdefault(key, []).append(flat)
+
+    attn_types = _attention_module_types()
+    capture_impl = _CaptureAttentionContraction(_pool)
+
+    def interceptor(next_fun, args, kwargs, context):
+        module = context.module
+        if context.method_name != "__call__":
+            return next_fun(*args, **kwargs)
+        if isinstance(module, (nn.Dense, nn.Conv)):
+            parts = ("params",) + tuple(module.path) + ("kernel",)
+            _pool("/".join(parts), args[0])
+            return next_fun(*args, **kwargs)
+        if attn_types and isinstance(module, attn_types):
+            from tensor2robot_tpu.ops import flash_attention as flash_lib
+
+            previous = capture_impl.path_key
+            capture_impl.path_key = attn_key(tuple(module.path))
+            try:
+                with flash_lib.attention_contraction_override(capture_impl):
+                    return next_fun(*args, **kwargs)
+            finally:
+                capture_impl.path_key = previous
+        return next_fun(*args, **kwargs)
+
+    with nn.intercept_methods(interceptor):
+        yield
+
+
+def calibrate_layer_activations(
+    records: Mapping[str, Sequence[np.ndarray]],
+    percentile: float = DEFAULT_CALIB_PERCENTILE,
+) -> Dict[str, Dict[str, float]]:
+    """Per-layer symmetric clips from captured activation pools.
+
+    For each captured key the clip is the given percentile of the
+    pooled |x| (the input-boundary calibrator generalized to
+    intermediate layers: one outlier activation must not stretch the
+    whole layer's step), floored at 1.0 for a degenerate all-zero
+    layer — never a zero step, never a div-by-zero in the traced
+    quantizer. A NaN/Inf anywhere in a pool is a `CalibrationError`
+    naming the layer, raised BEFORE any gate runs — a poisoned warmup
+    batch must never bake a NaN-derived clip into an artifact.
+    Returns {key: {'clip', 'observed_max', 'samples'}} with plain
+    floats/ints (JSON-able; recorded in t2r_metadata.json).
+    """
+    calibration: Dict[str, Dict[str, float]] = {}
+    for key in sorted(records):
+        pool = np.concatenate(
+            [np.asarray(chunk, np.float32).reshape(-1) for chunk in records[key]]
+        )
+        if pool.size == 0:
+            continue
+        if not np.all(np.isfinite(pool)):
+            raise CalibrationError(
+                f"activation capture for layer {key!r} contains NaN/Inf: "
+                "the warmup corpus is poisoned; fix the corpus (or the "
+                "fp32 forward) before exporting — a NaN-derived clip "
+                "would silently zero the layer's quantization step."
+            )
+        clip = float(np.percentile(pool, percentile))
+        calibration[key] = {
+            "clip": clip if clip > 0 else 1.0,
+            "observed_max": float(pool.max()),
+            "samples": int(pool.size),
+        }
+    return calibration
+
+
+def resolve_static_scales(
+    layer_calibration: Mapping[str, Mapping[str, float]],
+    overshoot_tol: float = DEFAULT_STATIC_OVERSHOOT,
+) -> Tuple[Dict[str, float], Dict[str, float]]:
+    """Splits calibrated layers into (static_scales, demoted).
+
+    The per-layer demotion gate of the static path: a layer whose
+    observed warmup max-abs overshoots its percentile clip by more
+    than `overshoot_tol` (relative) keeps the DYNAMIC per-row quant —
+    its activation distribution is too heavy-tailed for one static
+    clip, and clipping real rows is a silent accuracy cliff. Returns
+    ({key: clip}, {key: overshoot}); the export records both so the
+    metadata says exactly which layers still pay a per-dispatch
+    reduce, and why.
+    """
+    static: Dict[str, float] = {}
+    demoted: Dict[str, float] = {}
+    for key, entry in layer_calibration.items():
+        clip = float(entry["clip"])
+        observed = float(entry["observed_max"])
+        overshoot = (observed - clip) / clip if clip > 0 else float("inf")
+        if overshoot > overshoot_tol:
+            demoted[key] = round(overshoot, 6)
+        else:
+            static[key] = clip
+    return static, demoted
 
 
 def calibrate_activations(
@@ -611,13 +1235,21 @@ def calibrate_activations(
     calibration is recorded in t2r_metadata.json).
     """
     if not batches:
-        raise ValueError("calibration needs at least one warmup batch")
+        raise CalibrationError(
+            "calibration needs at least one warmup batch"
+        )
     pools: Dict[str, List[np.ndarray]] = {}
     for batch in batches:
         for key, value in batch.items():
             value = np.asarray(value)
             if not np.issubdtype(value.dtype, np.floating):
                 continue
+            if not np.all(np.isfinite(value)):
+                raise CalibrationError(
+                    f"warmup batch feature {key!r} contains NaN/Inf: the "
+                    "calibration corpus is poisoned; fix the corpus "
+                    "before exporting."
+                )
             pools.setdefault(key, []).append(np.abs(value).reshape(-1))
     calibration = {}
     for key, chunks in pools.items():

@@ -457,6 +457,38 @@ _declare(
     "tensor2robot_tpu/serving/server.py",
 )
 _declare(
+    "T2R_SERVE_CALIB",
+    _ENUM,
+    "static",
+    "Activation-calibration mode for NATIVE low-precision serving "
+    "exports (export/serve_quant.py): 'static' (default) bakes "
+    "export-time per-layer 99.9th-percentile activation clips into the "
+    "serving program as constants — zero per-dispatch activation-quant "
+    "reductions (audit_quant_reduces), with per-layer demotion back to "
+    "dynamic when the warmup overshoot exceeds the gate; 'dynamic' "
+    "keeps the round-16 per-row max-abs quant op for op — the same "
+    "serialized program bytes for models whose eligibility map round "
+    "18 did not widen (conv/attention lowering is map-driven, not "
+    "calib-driven: disable via T2R_SERVE_NATIVE_LAYERS/"
+    "T2R_SERVE_NATIVE_ATTN for the full round-16 program).",
+    "tensor2robot_tpu/export/serve_quant.py",
+    choices=("static", "dynamic"),
+)
+_declare(
+    "T2R_SERVE_NATIVE_ATTN",
+    _STR,
+    None,
+    "Attention-head eligibility for NATIVE low-precision QK^T/PV "
+    "contractions in quantized serving exports (export/serve_quant.py): "
+    "unset or 'auto' = every attention module on the materialized-"
+    "logits einsum path quantizes both contraction operands (per-row "
+    "or static scales on the accumulator; flash/ring/ulysses heads "
+    "never lower); 'none' = attention stays on the f32 einsum path; "
+    "anything else = comma-separated fnmatch globs over attention "
+    "module paths selecting WHICH heads lower.",
+    "tensor2robot_tpu/export/serve_quant.py",
+)
+_declare(
     "T2R_SERVE_NATIVE_LAYERS",
     _STR,
     None,

@@ -24,6 +24,8 @@ either direction.
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import functools
 from typing import Optional
 
@@ -33,6 +35,33 @@ from jax import lax
 from jax.experimental import pallas as pl
 
 _NEG_INF = -1e30
+
+# -- contraction override (low-precision serving hook) -------------------------
+# The einsum path's two contractions (QK^T logits, PV mix) are the only
+# attention FLOPs a serving export can re-lower onto int8/fp8 operands
+# (export/serve_quant.py attention lowering). Rather than have the
+# serving layer re-implement attention (masking, windows, offsets), the
+# reference path exposes exactly those two ops as an override point:
+# inside `attention_contraction_override(impl)`, logits come from
+# `impl.qk(q, k, scale)` and the mixed output from `impl.pv(probs, v)`;
+# everything else (mask construction, softmax, dtypes) is unchanged.
+# The flash/ring/ulysses kernels never consult the hook — their tiled
+# recurrences have no materialized contraction to swap — which is why
+# attention-head eligibility is einsum-path-only.
+_CONTRACTION_OVERRIDE: contextvars.ContextVar = contextvars.ContextVar(
+    "t2r_attention_contraction_override", default=None
+)
+
+
+@contextlib.contextmanager
+def attention_contraction_override(impl):
+    """Installs `impl` (with .qk(q, k, scale) and .pv(probs, v)) as the
+    reference path's contraction implementation for the context."""
+    token = _CONTRACTION_OVERRIDE.set(impl)
+    try:
+        yield
+    finally:
+        _CONTRACTION_OVERRIDE.reset(token)
 
 try:  # jax with varying-manual-axes tracking accepts vma annotations
     jax.ShapeDtypeStruct((1,), jnp.float32, vma=frozenset())
@@ -126,7 +155,13 @@ def reference_attention(
     causal sliding window); requires causal=True."""
     _check_window(window, causal)
     scale = scale if scale is not None else q.shape[-1] ** -0.5
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, precision=precision) * scale
+    override = _CONTRACTION_OVERRIDE.get()
+    if override is not None:
+        logits = override.qk(q, k, scale)
+    else:
+        logits = (
+            jnp.einsum("bqhd,bkhd->bhqk", q, k, precision=precision) * scale
+        )
     if causal:
         q_pos = q_offset + jnp.arange(q.shape[1])
         k_pos = k_offset + jnp.arange(k.shape[1])
@@ -136,6 +171,8 @@ def reference_attention(
         logits = jnp.where(mask[None, None], logits, _NEG_INF)
     # Fully-masked rows normalize against the -inf cap instead of NaN-ing.
     probs = jax.nn.softmax(logits, axis=-1)
+    if override is not None:
+        return override.pv(probs, v).astype(q.dtype)
     return jnp.einsum(
         "bhqk,bkhd->bqhd", probs, v, precision=precision
     ).astype(q.dtype)
@@ -796,18 +833,27 @@ def flash_attention(
     # Pallas compiles natively only on TPU; elsewhere the kernel runs in
     # interpreter mode (tests) or falls back to the reference — including
     # when a caller explicitly passes interpret=False off-TPU.
+    # Both fallbacks SUPPRESS the serving contraction override: a
+    # flash-configured head must compute what the Pallas kernel would
+    # (f32), not silently pick up quantized contractions — otherwise
+    # the exported program's attention numerics would depend on the
+    # export HOST (off-TPU trace = reference fallback) or on the
+    # sequence's block divisibility, while T2R_SERVE_NATIVE_ATTN
+    # promises flash heads never lower.
     if jax.default_backend() != "tpu" and not interpret:
-        return reference_attention(
-            q, k, v, causal=causal, scale=scale,
-            q_offset=q_offset, k_offset=k_offset, window=window,
-        )
+        with attention_contraction_override(None):
+            return reference_attention(
+                q, k, v, causal=causal, scale=scale,
+                q_offset=q_offset, k_offset=k_offset, window=window,
+            )
     bq = _pick_block(q.shape[1], block_q)
     bk = _pick_block(k.shape[1], block_k)
     if bq is None or bk is None:
-        return reference_attention(
-            q, k, v, causal=causal, scale=scale,
-            q_offset=q_offset, k_offset=k_offset, window=window,
-        )
+        with attention_contraction_override(None):
+            return reference_attention(
+                q, k, v, causal=causal, scale=scale,
+                q_offset=q_offset, k_offset=k_offset, window=window,
+            )
     return _flash_attention(
         q, k, v, q_offset, k_offset, causal, scale, bq, bk, interpret, window
     )

@@ -70,10 +70,19 @@ def _ulysses_shard_fn(
             interpret=interpret, window=window,
         )
     else:
-        out = reference_attention(
-            q_local, k_local, v_local, causal=causal, scale=scale,
-            window=window,
+        # Sequence-parallel heads are never eligible for the serving
+        # contraction override (export/serve_quant.py) — suppress it so
+        # the local attention computes the exact reference contraction
+        # regardless of any ambient lowering context.
+        from tensor2robot_tpu.ops.flash_attention import (
+            attention_contraction_override,
         )
+
+        with attention_contraction_override(None):
+            out = reference_attention(
+                q_local, k_local, v_local, causal=causal, scale=scale,
+                window=window,
+            )
     return gather_heads(out)
 
 

@@ -322,6 +322,30 @@ class ExportedSavedModelPredictor(AbstractPredictor):
         return tuple(getattr(loaded, "native_dot_layers", ()) or ())
 
     @property
+    def native_attention(self) -> tuple:
+        """Attention modules the loaded artifact contracts on quantized
+        operands (ExportedModel.native_attention); empty before restore
+        or under 'none'."""
+        loaded = self.loaded_model
+        return tuple(getattr(loaded, "native_attention", ()) or ())
+
+    @property
+    def calib_mode(self):
+        """Activation-calibration mode of the loaded regime's program
+        (ExportedModel.calib_mode); None before restore, under 'none',
+        or when the program has no native contractions to calibrate."""
+        loaded = self.loaded_model
+        return getattr(loaded, "calib_mode", None) if loaded else None
+
+    @property
+    def quant_reduce_audit(self):
+        """The export-recorded reduce audit of the loaded regime's
+        program (ExportedModel.quant_reduce_audit); None before restore
+        or under 'none'."""
+        loaded = self.loaded_model
+        return getattr(loaded, "quant_reduce_audit", None) if loaded else None
+
+    @property
     def restore_thread_leaked(self) -> bool:
         """True when close() gave up waiting on a restore thread (it keeps
         polling until its own timeout; fleet monitors should surface it)."""

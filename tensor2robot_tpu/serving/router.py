@@ -1182,6 +1182,12 @@ class FleetRouter:
                     # fp32) is verified HERE, version by version, instead
                     # of by observing precision drift in production.
                     "serve_quant": r.last_health.get("serve_quant"),
+                    # ...and its calibration mode: a mixed static/dynamic
+                    # rollout changes per-dispatch cost (quant reduces),
+                    # so the fleet surface carries it next to the regime.
+                    "serve_quant_calib": r.last_health.get(
+                        "serve_quant_calib"
+                    ),
                     # Boot attribution: how long the last spawn took to
                     # report started, and which restore tier each warmup
                     # bucket came from (off the health snapshot) — the

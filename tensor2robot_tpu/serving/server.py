@@ -549,6 +549,24 @@ class PolicyServer:
                 snap["serve_quant_native_layers"] = list(
                     getattr(self._predictor, "native_dot_layers", ()) or ()
                 )
+                attention = getattr(
+                    self._predictor, "native_attention", ()
+                ) or ()
+                if attention:
+                    snap["serve_quant_native_attention"] = list(attention)
+                # Activation-calibration mode + the export-recorded
+                # reduce audit of the serving program: a fleet verifies
+                # per replica that statically-calibrated versions really
+                # dispatch zero activation-quant reduces
+                # (activation_quant_reduces == 0), version by version.
+                calib = getattr(self._predictor, "calib_mode", None)
+                if calib is not None:
+                    snap["serve_quant_calib"] = calib
+                reduce_audit = getattr(
+                    self._predictor, "quant_reduce_audit", None
+                )
+                if reduce_audit is not None:
+                    snap["serve_quant_reduce_audit"] = dict(reduce_audit)
         # Per-bucket restore tier ("aot" = deserialized executable,
         # "cache"/"compile" = the fallback tiers): the boot-attribution
         # surface the router/autoscaler snapshots and the bench's

@@ -615,6 +615,85 @@ class TestCompileTierEngagement:
         assert jax.config.jax_compilation_cache_dir == str(tmp_path)
 
 
+class TestWarmCompileCacheBuild:
+    """A WARM persistent compilation cache must never serve the AOT
+    build's compiles: a cache HIT returns an executable whose
+    serialization drops its object code, and the shipped blob then
+    fails every deserialize_and_load with "Symbols not found" — in the
+    exporting process too, so every boot of the artifact becomes a
+    logged fallback. Any process that compiled the same program before
+    exporting (a bench re-run, a serving replica that exports) is a
+    warm-cache exporter."""
+
+    @pytest.fixture(autouse=True)
+    def _restore_jax_cache_config(self):
+        previous_dir = jax.config.jax_compilation_cache_dir
+        previous_min = jax.config.jax_persistent_cache_min_compile_time_secs
+        yield
+        jax.config.update("jax_compilation_cache_dir", previous_dir)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", previous_min
+        )
+        try:
+            from jax._src import compilation_cache
+
+            compilation_cache.reset_cache()
+        except ImportError:  # pragma: no cover - future jax relayout
+            pass
+
+    def test_build_under_warm_cache_round_trips(self, export_root, tmp_path):
+        from jax import export as jax_export
+
+        from tensor2robot_tpu.export.saved_model import (
+            STABLEHLO_DIR,
+            STABLEHLO_FILENAME,
+        )
+        from tensor2robot_tpu.serving.compile_cache import (
+            enable_compile_cache,
+        )
+
+        with open(
+            os.path.join(
+                latest_export_dir(export_root), STABLEHLO_DIR,
+                STABLEHLO_FILENAME,
+            ),
+            "rb",
+        ) as f:
+            program_bytes = f.read()
+        cache_dir = str(tmp_path / "jaxcache")
+        enable_compile_cache(cache_dir)
+        # Warm the cache with this exact program/bucket OUTSIDE the
+        # build — the position every re-exporting process is in.
+        batch = _example(2)
+        jax.jit(jax_export.deserialize(program_bytes).call).lower(
+            batch
+        ).compile()
+        assert os.listdir(cache_dir), "cache never engaged — no warm hit"
+
+        timings = {}
+        blobs = aot_lib.build_bucket_executables(
+            program_bytes, [batch], regime="none", fingerprint="0" * 64,
+            timings_ms=timings,
+        )
+        # Pre-fix, this deserialize died with "Symbols not found".
+        _compiled, header = aot_lib.load_executable(blobs[2])
+        assert header["bucket"] == 2
+        assert timings[2] > 0
+        # SECOND build, same process, cache still configured: jax folds
+        # config state into the cache key, so a build that merely
+        # flipped the enable flag would have WRITTEN re-keyed entries
+        # above and would HIT them here — the re-export scenario (bench
+        # re-run, online-loop learner) that corrupts every bucket
+        # unless reads AND writes are both dead during the build.
+        blobs2 = aot_lib.build_bucket_executables(
+            program_bytes, [batch], regime="none", fingerprint="0" * 64,
+        )
+        aot_lib.load_executable(blobs2[2])
+        # The bypass is scoped to the builds: the cache is back on.
+        assert jax.config.jax_enable_compilation_cache
+        assert jax.config.jax_compilation_cache_dir == cache_dir
+
+
 class TestFlagsDeclared:
     def test_aot_flags_in_registry(self):
         assert t2r_flags.get_flag("T2R_SERVE_AOT").kind == "bool"

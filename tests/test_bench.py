@@ -495,10 +495,13 @@ def test_bench_serve_contract(tmp_path):
     swap = detail["hot_swap"]
     assert swap["swap_observed"] is True
     assert swap["version_after"] > swap["version_before"]
-    # Round-11 quant legs: every regime served, bytes-of-param reduction
-    # reported against the bar, req/s attributed honestly.
+    # Round-11 quant legs (regime set widened in r16): every regime
+    # served, bytes-of-param reduction reported against the bar, req/s
+    # attributed honestly.
     quant = detail["quant"]
-    assert set(quant["regimes"]) == {"none", "fp16", "int8"}
+    assert set(quant["regimes"]) == {
+        "none", "fp16", "int8", "fp8_e4m3", "fp8_e5m2"
+    }
     for regime, leg in quant["regimes"].items():
         assert leg["saturated_hz"] > 0, (regime, leg)
         assert leg["params_bytes"] > 0
@@ -508,6 +511,17 @@ def test_bench_serve_contract(tmp_path):
         parity = quant["regimes"][regime]["parity_recorded"]
         assert parity["max_divergence"]["a_predicted"] <= parity["tolerance"]
     assert "req_s_attribution" in quant
+    # Round-18 acceptance: the dequant twin shows zero low-precision
+    # contractions, the static-calib artifact shows zero activation-
+    # quant reduces, and its AOT cold boot serves bitwise with zero
+    # fresh compiles.
+    assert quant["native_audit_pass"] is True
+    assert quant["native_ab"]["audit_delta_proves_lowering"] is True
+    assert quant["calib_ab"]["static_zero_reduce_pass"] is True
+    assert quant["calib_ab"]["dynamic_reduces_match_native_layers"] is True
+    assert quant["static_aot_boot"]["bitwise_vs_fresh"] is True
+    assert quant["static_aot_boot"]["zero_fresh_compiles"] is True
+    assert quant["r18_all_green"] is True
     import json as json_mod
 
     with open(out) as f:

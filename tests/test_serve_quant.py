@@ -652,17 +652,22 @@ def _mlp_tree(seed=0, din=64, dh=96):
 
 
 class TestNativeEligibility:
-    def test_default_map_takes_deep_2d_kernels_only(self):
+    def test_default_map_takes_deep_dense_and_conv_kernels(self):
         tree = {
             "params": {
                 "deep": {"kernel": np.ones((64, 32), np.float32)},
                 "shallow": {"kernel": np.ones((3, 128), np.float32)},
+                # Conv kernels joined the map in round 18: contraction
+                # depth = window x input channels (3*3*8 = 72 here).
                 "conv": {"kernel": np.ones((3, 3, 8, 8), np.float32)},
+                # ...but a shallow conv window stays blockwise exactly
+                # like a shallow dense kernel (1*1*2 = 2 rows).
+                "conv1x1": {"kernel": np.ones((1, 1, 2, 64), np.float32)},
                 "deep2": {"bias": np.ones((64,), np.float32)},
             }
         }
         eligible = sq.default_native_eligibility(tree, "int8")
-        assert eligible == ("params/deep/kernel",)
+        assert eligible == ("params/conv/kernel", "params/deep/kernel")
         # fp16 is a cast regime: no native leg at all.
         assert sq.default_native_eligibility(tree, "fp16") == ()
 
@@ -1107,3 +1112,741 @@ class TestClaimedVsFired:
         with sq.native_lowering(payload, layout, "int8", bound, fired=fired):
             net.apply({"params": bound["params"]}, x)
         assert fired == {"params/Dense_0/kernel"}
+
+
+# -- static activation calibration + conv/attention lowering (round 18) --------
+
+
+class TestCalibModeResolution:
+    def test_flag_declared_with_static_default(self):
+        spec = t2r_flags.get_flag("T2R_SERVE_CALIB")
+        assert spec.choices == ("static", "dynamic")
+        assert spec.default == "static"
+        assert t2r_flags.get_flag("T2R_SERVE_NATIVE_ATTN").default is None
+
+    def test_explicit_mode_resolves_without_the_flag(self, monkeypatch):
+        monkeypatch.setenv("T2R_SERVE_CALIB", "dynamic")
+        assert sq.resolve_calib_mode("static") == "static"
+        assert sq.resolve_calib_mode() == "dynamic"
+
+    def test_bad_mode_names_values_and_flag(self):
+        """PR 12 convention at the new call site: the resolution error
+        must name the available values AND the selecting flag."""
+        with pytest.raises(ValueError) as err:
+            sq.resolve_calib_mode("percentile")
+        message = str(err.value)
+        assert "static" in message and "dynamic" in message
+        assert "T2R_SERVE_CALIB" in message
+
+    def test_bad_env_value_names_choices_and_flag(self, monkeypatch):
+        monkeypatch.setenv("T2R_SERVE_CALIB", "per-row")
+        with pytest.raises(ValueError, match="T2R_SERVE_CALIB"):
+            sq.resolve_calib_mode()
+
+    def test_exporter_validates_calib_at_config_time(self):
+        with pytest.raises(ValueError, match="T2R_SERVE_CALIB"):
+            LatestExporter(
+                name="q", warmup_batch_sizes=(1,), serve_quant=("int8",),
+                serve_calib="quantile",
+            )
+
+
+class TestLayerCalibration:
+    def test_constant_zero_layer_gets_floor_clip_and_safe_dot(self):
+        """An all-zero activation pool must produce a USABLE step (clip
+        floor 1.0), and the static-quantized dot over it must emit
+        zeros, not NaN."""
+        calibration = sq.calibrate_layer_activations(
+            {"params/d/kernel": [np.zeros((64,), np.float32)]}
+        )
+        entry = calibration["params/d/kernel"]
+        assert entry["clip"] == 1.0
+        assert entry["observed_max"] == 0.0
+        payload, _ = sq.quantize_tree(
+            _mlp_tree(), "int8", native=("params/Dense_0/kernel",)
+        )
+        node = payload["params"]["Dense_0"]["kernel"]
+        out = np.asarray(
+            sq.native_dot(
+                jnp.zeros((2, 64)), jnp.asarray(node[sq.Q_KEY]),
+                jnp.asarray(node[sq.S_KEY]), "int8",
+                a_clip=entry["clip"],
+            )
+        )
+        np.testing.assert_array_equal(out, np.zeros_like(out))
+
+    def test_single_sample_corpus_calibrates(self):
+        calibration = sq.calibrate_layer_activations(
+            {"k": [np.asarray([0.5], np.float32)]}
+        )
+        assert calibration["k"]["samples"] == 1
+        assert calibration["k"]["clip"] > 0
+
+    def test_nan_pool_raises_typed_error_naming_the_layer(self):
+        with pytest.raises(sq.CalibrationError, match="params/d/kernel"):
+            sq.calibrate_layer_activations(
+                {"params/d/kernel": [np.asarray([1.0, np.nan], np.float32)]}
+            )
+        with pytest.raises(sq.CalibrationError, match="inf|Inf"):
+            sq.calibrate_layer_activations(
+                {"params/d/kernel": [np.asarray([np.inf], np.float32)]}
+            )
+
+    def test_nan_warmup_batch_fails_input_calibration_loudly(self):
+        with pytest.raises(sq.CalibrationError, match="'x'"):
+            sq.calibrate_activations(
+                [{"x": np.asarray([0.1, np.nan], np.float32)}]
+            )
+
+    def test_percentile_monotonicity(self):
+        pool = np.random.RandomState(0).uniform(0, 3, 10000).astype(
+            np.float32
+        )
+        records = {"k": [pool]}
+        p50 = sq.calibrate_layer_activations(records, percentile=50.0)
+        p999 = sq.calibrate_layer_activations(records, percentile=99.9)
+        assert p50["k"]["clip"] <= p999["k"]["clip"]
+        assert p999["k"]["clip"] <= p999["k"]["observed_max"]
+
+    def test_overshoot_demotes_per_layer_and_records_magnitude(self):
+        """One heavy-tailed layer (a single far outlier) demotes back to
+        dynamic; the well-behaved layer stays static."""
+        tame = np.random.RandomState(1).uniform(0, 1, 5000).astype(
+            np.float32
+        )
+        spiky = tame.copy()
+        spiky[0] = 100.0
+        calibration = sq.calibrate_layer_activations(
+            {"tame": [tame], "spiky": [spiky]}
+        )
+        static, demoted = sq.resolve_static_scales(calibration)
+        assert "tame" in static and "tame" not in demoted
+        assert "spiky" in demoted and "spiky" not in static
+        assert demoted["spiky"] > sq.DEFAULT_STATIC_OVERSHOOT
+
+
+class TestStaticNativeDot:
+    @pytest.mark.parametrize("regime", NATIVE_REGIMES)
+    def test_static_dot_matches_dequant_reference_within_step(self, regime):
+        tree = _mlp_tree(seed=11)
+        payload, layout = sq.quantize_tree(
+            tree, regime, native=("params/Dense_0/kernel",)
+        )
+        node = payload["params"]["Dense_0"]["kernel"]
+        x = np.random.RandomState(12).uniform(-2, 2, (8, 64)).astype(
+            np.float32
+        )
+        clip = float(np.abs(x).max())
+        static = np.asarray(
+            sq.native_dot(
+                jnp.asarray(x), jnp.asarray(node[sq.Q_KEY]),
+                jnp.asarray(node[sq.S_KEY]), regime, a_clip=clip,
+            )
+        )
+        deq = np.asarray(
+            sq.dequantize_tree(payload, layout, regime)["params"]["Dense_0"][
+                "kernel"
+            ]
+        )
+        reference = x @ deq
+        act_step = {"int8": 1 / 127.0, "fp8_e4m3": 2.0 ** -3,
+                    "fp8_e5m2": 2.0 ** -2}[regime]
+        bound = 0.5 * act_step * clip * np.abs(deq).sum(axis=0)[None, :]
+        assert (np.abs(static - reference) <= bound + 1e-5).all()
+
+    def test_static_program_has_zero_quant_reduces_dynamic_has_them(self):
+        """The tentpole acceptance at op level: the SERIALIZED program
+        of a statically-calibrated dot carries zero activation-quant
+        reductions; its dynamic twin carries one per contraction."""
+        from jax import export as jax_export
+
+        tree = _mlp_tree(seed=13)
+        native = ("params/Dense_0/kernel", "params/Dense_1/kernel")
+        payload, layout = sq.quantize_tree(tree, "int8", native=native)
+
+        import flax.linen as nn
+
+        class Net(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                h = nn.relu(nn.Dense(96)(x))
+                return nn.Dense(4)(h)
+
+        net = Net()
+        x_spec = jax.ShapeDtypeStruct((2, 64), jnp.float32)
+
+        def export_program(static_scales):
+            def f(p, xx):
+                bound = sq.dequantize_tree(p, layout, "int8")
+                with sq.native_lowering(
+                    p, layout, "int8", bound, static_scales=static_scales
+                ):
+                    return net.apply({"params": bound["params"]}, xx)
+
+            return jax_export.export(jax.jit(f))(payload, x_spec).serialize()
+
+        def export_baseline():
+            def f(xx):
+                return net.apply({"params": _mlp_tree(seed=13)["params"]}, xx)
+
+            return jax_export.export(jax.jit(f))(x_spec).serialize()
+
+        baseline = export_baseline()
+        static_scales = {path: 2.0 for path in native}
+        static_prog = export_program(static_scales)
+        dynamic_prog = export_program(None)
+        static_audit = sq.audit_quant_reduces(static_prog, baseline)
+        dynamic_audit = sq.audit_quant_reduces(dynamic_prog, baseline)
+        assert static_audit["activation_quant_reduces"] == 0
+        assert dynamic_audit["activation_quant_reduces"] == len(native)
+        # Both programs still contract natively (the audit pair is the
+        # proof the static path removed reduces WITHOUT giving up the
+        # int8 dots).
+        assert sq.audit_dot_dtypes(static_prog).get("i8", 0) == len(native)
+
+    def test_reduce_parser_ignores_applierless_region_bodies(self):
+        """An argmax-style region reduce (compare/select body, none of
+        the counted appliers) must not leave the parser in a pending
+        state that miscounts a later ELEMENTWISE maximum/add line as a
+        reduce (review regression: the inflated 'max' count feeds the
+        activation_quant_reduces acceptance delta)."""
+        module = "\n".join([
+            "  %0 = stablehlo.reduce(%arg0 init: %c) across"
+            " dimensions = [1]",
+            "    reducer(%a: tensor<f32>, %b: tensor<f32>) {",
+            "      %p = stablehlo.compare GT, %a, %b : tensor<i1>",
+            "      %s = stablehlo.select %p, %a, %b : tensor<f32>",
+            "      stablehlo.return %s : tensor<f32>",
+            "    }",
+            "  %relu = stablehlo.maximum %1, %zero : tensor<2x4xf32>",
+            "  %res = stablehlo.add %relu, %bias : tensor<2x4xf32>",
+        ])
+        counts = sq._count_reduce_kinds(module)
+        assert counts.get("max", 0) == 0
+        assert counts.get("add", 0) == 0
+        assert counts["total"] == 0
+        # A real region-form max reduce still counts.
+        real = "\n".join([
+            "  %0 = stablehlo.reduce(%arg0 init: %c) across"
+            " dimensions = [1]",
+            "    reducer(%a: tensor<f32>, %b: tensor<f32>) {",
+            "      %m = stablehlo.maximum %a, %b : tensor<f32>",
+            "      stablehlo.return %m : tensor<f32>",
+            "    }",
+        ])
+        assert sq._count_reduce_kinds(real)["max"] == 1
+
+
+class TestNativeConv:
+    @pytest.mark.parametrize("regime", NATIVE_REGIMES)
+    def test_conv_lowering_matches_dequant_reference(self, regime):
+        import flax.linen as nn
+
+        class Net(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                return nn.Conv(8, (3, 3))(x)
+
+        net = Net()
+        x = np.random.RandomState(14).uniform(-1, 1, (2, 8, 8, 4)).astype(
+            np.float32
+        )
+        variables = jax.device_get(net.init(jax.random.PRNGKey(1), x))
+        tree = {"params": variables["params"]}
+        native = sq.default_native_eligibility(tree, regime)
+        assert native == ("params/Conv_0/kernel",)
+        payload, layout = sq.quantize_tree(tree, regime, native=native)
+        assert layout["params/Conv_0/kernel"]["granularity"] == "channel"
+        node = payload["params"]["Conv_0"]["kernel"]
+        assert node[sq.Q_KEY].shape == tree["params"]["Conv_0"][
+            "kernel"
+        ].shape
+        assert node[sq.S_KEY].shape == (8,)  # one scale per out channel
+        bound = sq.dequantize_tree(payload, layout, regime)
+        plain = np.asarray(net.apply({"params": bound["params"]}, x))
+        fired = set()
+        with sq.native_lowering(payload, layout, regime, bound, fired=fired):
+            lowered = np.asarray(net.apply({"params": bound["params"]}, x))
+        assert fired == {"params/Conv_0/kernel"}
+        # The native conv genuinely diverges (activation quant) but
+        # stays within the regime's step regime over a depth-36 window.
+        assert np.abs(lowered - plain).max() > 0
+        assert np.abs(lowered - plain).max() < 0.5
+
+    def test_static_conv_uses_the_calibrated_clip(self):
+        import flax.linen as nn
+
+        class Net(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                return nn.Conv(8, (3, 3))(x)
+
+        net = Net()
+        x = np.random.RandomState(15).uniform(-1, 1, (2, 8, 8, 4)).astype(
+            np.float32
+        )
+        variables = jax.device_get(net.init(jax.random.PRNGKey(2), x))
+        tree = {"params": variables["params"]}
+        payload, layout = sq.quantize_tree(
+            tree, "int8", native=("params/Conv_0/kernel",)
+        )
+        bound = sq.dequantize_tree(payload, layout, "int8")
+        records = {}
+        with sq.capture_activations(records):
+            reference = np.asarray(net.apply({"params": tree["params"]}, x))
+        assert "params/Conv_0/kernel" in records
+        static, demoted = sq.resolve_static_scales(
+            sq.calibrate_layer_activations(records)
+        )
+        assert not demoted
+        with sq.native_lowering(
+            payload, layout, "int8", bound, static_scales=static
+        ):
+            lowered = np.asarray(net.apply({"params": bound["params"]}, x))
+        assert np.abs(lowered - reference).max() < 0.1
+
+    def test_unsupported_conv_configs_stay_on_dequant_path(self):
+        """CIRCULAR padding has pre-padding semantics native_conv does
+        not replicate — the interceptor must bail (claimed-but-unfired),
+        not lower approximately."""
+        import flax.linen as nn
+
+        class Net(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                return nn.Conv(8, (3, 3), padding="CIRCULAR")(x)
+
+        net = Net()
+        x = np.random.RandomState(16).uniform(-1, 1, (2, 8, 8, 4)).astype(
+            np.float32
+        )
+        variables = jax.device_get(net.init(jax.random.PRNGKey(3), x))
+        tree = {"params": variables["params"]}
+        payload, layout = sq.quantize_tree(
+            tree, "int8", native=("params/Conv_0/kernel",)
+        )
+        bound = sq.dequantize_tree(payload, layout, "int8")
+        plain = np.asarray(net.apply({"params": bound["params"]}, x))
+        fired = set()
+        with sq.native_lowering(payload, layout, "int8", bound, fired=fired):
+            lowered = np.asarray(net.apply({"params": bound["params"]}, x))
+        assert fired == set()
+        np.testing.assert_array_equal(lowered, plain)
+
+    def test_exported_conv_program_audits_native_convolution(self):
+        """audit_dot_dtypes counts conv_general_dilated operand dtypes:
+        the serialized program of a lowered conv shows an i8
+        convolution, closing the audit over EVERY contraction kind."""
+        import flax.linen as nn
+        from jax import export as jax_export
+
+        class Net(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                return nn.Conv(8, (3, 3), strides=(2, 2))(x)
+
+        net = Net()
+        x = np.zeros((1, 8, 8, 4), np.float32)
+        variables = jax.device_get(net.init(jax.random.PRNGKey(4), x))
+        tree = {"params": variables["params"]}
+        payload, layout = sq.quantize_tree(
+            tree, "int8", native=("params/Conv_0/kernel",)
+        )
+
+        def f(p, xx):
+            bound = sq.dequantize_tree(p, layout, "int8")
+            with sq.native_lowering(p, layout, "int8", bound):
+                return net.apply({"params": bound["params"]}, xx)
+
+        artifact = jax_export.export(jax.jit(f))(
+            payload, jax.ShapeDtypeStruct(x.shape, x.dtype)
+        ).serialize()
+        audit = sq.audit_dot_dtypes(artifact)
+        assert audit.get("i8", 0) >= 1, audit
+
+
+class _AttnNet:
+    """Tiny attention net shared by the attention-lowering tests."""
+
+    @staticmethod
+    def build():
+        import flax.linen as nn
+
+        from tensor2robot_tpu.layers.transformer import MultiHeadAttention
+
+        class Net(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                h = nn.Dense(32)(x)
+                return MultiHeadAttention(num_heads=2, head_dim=8)(h)
+
+        return Net()
+
+
+class TestNativeAttention:
+    def _setup(self, seed=17):
+        net = _AttnNet.build()
+        x = np.random.RandomState(seed).uniform(-1, 1, (2, 6, 16)).astype(
+            np.float32
+        )
+        variables = jax.device_get(net.init(jax.random.PRNGKey(5), x))
+        tree = {"params": variables["params"]}
+        native = sq.default_native_eligibility(tree, "int8")
+        payload, layout = sq.quantize_tree(tree, "int8", native=native)
+        bound = sq.dequantize_tree(payload, layout, "int8")
+        return net, x, tree, payload, layout, bound
+
+    def test_qk_pv_contractions_lower_and_stay_within_step(self):
+        net, x, tree, payload, layout, bound = self._setup()
+        reference = np.asarray(net.apply({"params": tree["params"]}, x))
+        fired = set()
+        with sq.native_lowering(payload, layout, "int8", bound, fired=fired):
+            lowered = np.asarray(net.apply({"params": bound["params"]}, x))
+        assert "attn/MultiHeadAttention_0" in fired
+        assert np.abs(lowered - reference).max() > 0
+        assert np.abs(lowered - reference).max() < 0.2
+
+    def test_attn_flag_none_keeps_f32_attention(self, monkeypatch):
+        net, x, tree, payload, layout, bound = self._setup()
+        monkeypatch.setenv("T2R_SERVE_NATIVE_ATTN", "none")
+        fired = set()
+        with sq.native_lowering(payload, layout, "int8", bound, fired=fired):
+            net.apply({"params": bound["params"]}, x)
+        assert not any(key.startswith("attn/") for key in fired)
+        # ...while the Dense kernels still lowered.
+        assert any(key.endswith("/kernel") for key in fired)
+
+    def test_attn_globs_select_heads(self, monkeypatch):
+        net, x, tree, payload, layout, bound = self._setup()
+        monkeypatch.setenv("T2R_SERVE_NATIVE_ATTN", "NoSuchModule*")
+        fired = set()
+        with sq.native_lowering(payload, layout, "int8", bound, fired=fired):
+            net.apply({"params": bound["params"]}, x)
+        assert not any(key.startswith("attn/") for key in fired)
+        monkeypatch.setenv("T2R_SERVE_NATIVE_ATTN", "MultiHead*")
+        fired = set()
+        with sq.native_lowering(payload, layout, "int8", bound, fired=fired):
+            net.apply({"params": bound["params"]}, x)
+        assert "attn/MultiHeadAttention_0" in fired
+
+    def test_flash_configured_heads_never_lower_even_on_fallback(self):
+        """A use_flash=True head off-TPU falls back to the reference
+        einsum INSIDE flash_attention — that fallback must not pick up
+        the quantized contractions, or the artifact's attention
+        numerics would depend on the export host / block divisibility
+        while T2R_SERVE_NATIVE_ATTN promises flash heads never lower."""
+        import flax.linen as nn
+
+        from tensor2robot_tpu.layers.transformer import MultiHeadAttention
+
+        class Net(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                h = nn.Dense(32)(x)
+                return MultiHeadAttention(
+                    num_heads=2, head_dim=8, use_flash=True
+                )(h)
+
+        net = Net()
+        x = np.random.RandomState(21).uniform(-1, 1, (2, 6, 16)).astype(
+            np.float32
+        )
+        variables = jax.device_get(net.init(jax.random.PRNGKey(6), x))
+        tree = {"params": variables["params"]}
+        native = sq.default_native_eligibility(tree, "int8")
+        payload, layout = sq.quantize_tree(tree, "int8", native=native)
+        bound = sq.dequantize_tree(payload, layout, "int8")
+        fired = set()
+        with sq.native_lowering(payload, layout, "int8", bound, fired=fired):
+            net.apply({"params": bound["params"]}, x)
+        # Dense kernels lower; the flash-configured attention does not.
+        assert any(key.endswith("/kernel") for key in fired)
+        assert not any(key.startswith("attn/") for key in fired)
+
+    def test_static_attention_program_has_zero_quant_reduces(self):
+        """Capture records q/k/v operand pools; with their static clips
+        the attention program keeps its int8 contractions and drops
+        every activation-quant reduce (softmax's own max reduce cancels
+        against the fp32 baseline)."""
+        from jax import export as jax_export
+
+        net, x, tree, payload, layout, bound = self._setup(seed=18)
+        records = {}
+        with sq.capture_activations(records):
+            net.apply({"params": tree["params"]}, x)
+        assert {"attn/MultiHeadAttention_0:q", "attn/MultiHeadAttention_0:k",
+                "attn/MultiHeadAttention_0:v"} <= set(records)
+        static, _ = sq.resolve_static_scales(
+            sq.calibrate_layer_activations(records)
+        )
+        x_spec = jax.ShapeDtypeStruct(x.shape, x.dtype)
+
+        def export_program(static_scales):
+            def f(p, xx):
+                b = sq.dequantize_tree(p, layout, "int8")
+                with sq.native_lowering(
+                    p, layout, "int8", b, static_scales=static_scales
+                ):
+                    return net.apply({"params": b["params"]}, xx)
+
+            return jax_export.export(jax.jit(f))(payload, x_spec).serialize()
+
+        def export_baseline():
+            params = tree["params"]
+
+            def f(xx):
+                return net.apply({"params": params}, xx)
+
+            return jax_export.export(jax.jit(f))(x_spec).serialize()
+
+        baseline = export_baseline()
+        static_prog = export_program(static)
+        dynamic_prog = export_program(None)
+        assert sq.audit_quant_reduces(static_prog, baseline)[
+            "activation_quant_reduces"
+        ] == 0
+        # Dynamic: one reduce per Dense (qkv, out, Dense_0) + q,k rows
+        # + v columns; probs NEVER pays one (static 1.0 bound).
+        assert sq.audit_quant_reduces(dynamic_prog, baseline)[
+            "activation_quant_reduces"
+        ] >= 5
+        # Both keep the attention contractions on int8 operands: 3
+        # Dense matmuls + QK^T + PV.
+        assert sq.audit_dot_dtypes(static_prog).get("i8", 0) == 5
+
+
+@pytest.fixture(scope="module")
+def dynamic_export(trained, tmp_path_factory):
+    """An int8 export pinned to DYNAMIC calibration via the exporter
+    param (the programmatic twin of T2R_SERVE_CALIB=dynamic). No AOT
+    executables — these tests read programs/metadata, and the bucket
+    compiles would only cost tier-1 wall clock."""
+    return _export(
+        trained,
+        tmp_path_factory.mktemp("dynamic_export"),
+        serve_quant=("int8",),
+        serve_calib="dynamic",
+        aot_executables=False,
+    )
+
+
+class TestStaticCalibExport:
+    def test_metadata_records_static_contract(self, native_export):
+        """The default export is statically calibrated: per-regime mode
+        'static', per-layer clips recorded, nothing demoted on the
+        well-behaved mock corpus."""
+        path, _ = native_export
+        with open(os.path.join(path, "t2r_metadata.json")) as f:
+            quant = json.load(f)["serve_quant"]
+        for regime in NATIVE_REGIMES:
+            calib = quant["calib"][regime]
+            assert calib["mode"] == "static"
+            # Every native layer has a static clip; the capture also
+            # calibrated the shallow Dense_0 (harmlessly — it never
+            # intercepts).
+            for layer in quant["native"][regime]["layers"]:
+                assert calib["static_scales"][layer] > 0
+            assert calib["demoted_to_dynamic"] == {}
+        # The per-layer calibration table is regime-independent and
+        # recorded ONCE, not duplicated into every regime entry.
+        stats = quant["layer_calibration"]
+        for layer, entry in stats.items():
+            assert entry["clip"] <= entry["observed_max"] * 1.0001
+            assert entry["samples"] > 0
+        for regime in NATIVE_REGIMES:
+            assert "layer_calibration" not in quant["calib"][regime]
+
+    @pytest.mark.parametrize("regime", NATIVE_REGIMES)
+    def test_reduce_audit_proves_zero_activation_quant_reduces(
+        self, native_export, regime
+    ):
+        """The tentpole acceptance on the REAL artifact: the serialized
+        static-calib program carries ZERO activation-quant reductions,
+        and the metadata audit matches a re-audit of the bytes."""
+        path, _ = native_export
+        with open(os.path.join(path, "t2r_metadata.json")) as f:
+            recorded = json.load(f)["serve_quant"]["reduce_audit"][regime]
+        assert recorded["activation_quant_reduces"] == 0
+        with open(
+            os.path.join(path, "stablehlo", f"predict_fn_{regime}.bin"), "rb"
+        ) as f:
+            quant_bytes = f.read()
+        with open(
+            os.path.join(path, "stablehlo", "predict_fn.bin"), "rb"
+        ) as f:
+            baseline_bytes = f.read()
+        assert sq.audit_quant_reduces(quant_bytes, baseline_bytes) == recorded
+
+    def test_dynamic_mode_keeps_per_row_reduces(self, dynamic_export):
+        """T2R_SERVE_CALIB=dynamic (here the exporter-param twin) is the
+        round-16 program: one activation-quant reduce per native layer,
+        mode recorded 'dynamic', no static scales."""
+        path, _ = dynamic_export
+        with open(os.path.join(path, "t2r_metadata.json")) as f:
+            quant = json.load(f)["serve_quant"]
+        calib = quant["calib"]["int8"]
+        assert calib["mode"] == "dynamic"
+        assert calib["static_scales"] == {}
+        audit = quant["reduce_audit"]["int8"]
+        assert audit["activation_quant_reduces"] == len(
+            quant["native"]["int8"]["layers"]
+        )
+
+    def test_dynamic_flag_and_param_produce_identical_programs(
+        self, trained, dynamic_export, tmp_path, monkeypatch
+    ):
+        """The byte-for-byte pin: an export under T2R_SERVE_CALIB=dynamic
+        serializes the IDENTICAL int8 serving program as the
+        serve_calib='dynamic' exporter param — the flag path adds no
+        ops, reorders nothing. (Programs are compared op-for-op with
+        source-location metadata stripped: jax's loc() records the
+        CALLER's file:line, so two exports invoked from different test
+        lines differ in exactly those bytes and nothing else — exports
+        through the same call site are raw-byte identical, which the
+        bench's calib A/B leg relies on.)"""
+        import re
+
+        from jax import export as jax_export
+
+        monkeypatch.setenv("T2R_SERVE_CALIB", "dynamic")
+        flag_path, _ = _export(
+            trained, tmp_path, serve_quant=("int8",), aot_executables=False
+        )
+        param_path, _ = dynamic_export
+
+        def program_ops(export_dir):
+            with open(
+                os.path.join(export_dir, "stablehlo", "predict_fn_int8.bin"),
+                "rb",
+            ) as f:
+                text = jax_export.deserialize(f.read()).mlir_module()
+            return re.sub(r'#loc\d* = loc\("[^"]*"[^)]*\)', "", text)
+
+        assert program_ops(flag_path) == program_ops(param_path)
+
+    def test_static_and_dynamic_serve_within_tolerance_of_each_other(
+        self, native_export, dynamic_export
+    ):
+        """Static calibration changes the activation step, not the
+        contract: both artifacts serve within their recorded parity."""
+        spath, _ = native_export
+        dpath, _ = dynamic_export
+        x = np.random.RandomState(3).uniform(-1, 1, (4, 3)).astype(
+            np.float32
+        )
+        static_out = ExportedModel(spath, quant_regime="int8").predict(
+            {"x": x}
+        )["a_predicted"]
+        dynamic_out = ExportedModel(dpath, quant_regime="int8").predict(
+            {"x": x}
+        )["a_predicted"]
+        with open(os.path.join(spath, "t2r_metadata.json")) as f:
+            tolerance = json.load(f)["serve_quant"]["parity"]["int8"][
+                "tolerance"
+            ]
+        assert np.abs(static_out - dynamic_out).max() <= 2 * tolerance
+
+    def test_loaded_model_and_snapshot_surface_calib_and_audit(
+        self, native_export, monkeypatch
+    ):
+        path, root = native_export
+        loaded = ExportedModel(path, quant_regime="int8")
+        assert loaded.calib_mode == "static"
+        assert loaded.quant_reduce_audit["activation_quant_reduces"] == 0
+        assert ExportedModel(path, quant_regime="none").calib_mode is None
+        monkeypatch.setenv("T2R_SERVE_QUANT", "int8")
+        predictor = ExportedSavedModelPredictor(export_dir=root)
+        assert predictor.restore()
+        assert predictor.calib_mode == "static"
+        with PolicyServer(predictor, max_wait_ms=1).start() as server:
+            snap = server.snapshot()
+        assert snap["serve_quant_calib"] == "static"
+        assert snap["serve_quant_reduce_audit"][
+            "activation_quant_reduces"
+        ] == 0
+
+    def test_aot_block_records_parallel_compile_ms(self, native_export):
+        """Satellite: the thread-pooled export-time AOT compiles record
+        per-bucket wall-clock in the metadata aot block."""
+        path, _ = native_export
+        with open(os.path.join(path, "t2r_metadata.json")) as f:
+            aot = json.load(f)["aot"]
+        for regime, buckets in aot["buckets"].items():
+            timings = aot["compile_ms"][regime]
+            assert sorted(int(b) for b in timings) == buckets
+            assert all(ms > 0 for ms in timings.values())
+
+    def test_static_calib_aot_boot_is_bitwise_and_trace_free(
+        self, native_export, monkeypatch
+    ):
+        """The artifact-ladder acceptance for the static regimes: an
+        AOT-restored static-calib int8 artifact serves BITWISE what the
+        fresh-trace twin serves, with zero stablehlo-path dispatches."""
+        path, _ = native_export
+        x = {"x": np.random.RandomState(4).uniform(-1, 1, (2, 3)).astype(
+            np.float32
+        )}
+        monkeypatch.setenv("T2R_SERVE_AOT", "1")
+        aot_model = ExportedModel(path, quant_regime="int8")
+        assert aot_model.aot_covered
+        aot_out = aot_model.predict(x)
+        assert aot_model.fresh_trace_calls == 0
+        monkeypatch.setenv("T2R_SERVE_AOT", "0")
+        fresh_model = ExportedModel(path, quant_regime="int8")
+        fresh_out = fresh_model.predict(x)
+        assert fresh_model.fresh_trace_calls == 1
+        np.testing.assert_array_equal(
+            aot_out["a_predicted"], fresh_out["a_predicted"]
+        )
+
+
+class TestReviewFixes:
+    def test_capture_pool_bounded_with_exact_max(self):
+        """A conv tower's per-layer |activation| capture must stay
+        bounded in host memory (stride subsample above the cap) while
+        the demotion gate's observed_max stays EXACT."""
+        import flax.linen as nn
+
+        class Net(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                return nn.Dense(4)(x)
+
+        net = Net()
+        x = np.random.RandomState(20).uniform(
+            -1, 1, (4, 1 << 17)
+        ).astype(np.float32)
+        x[2, 12345] = 7.5  # the true max, somewhere a stride could miss
+        variables = net.init(jax.random.PRNGKey(0), x)
+        records = {}
+        with sq.capture_activations(records):
+            net.apply(variables, x)
+        (pool,) = records["params/Dense_0/kernel"]
+        assert pool.size <= sq.CAPTURE_SAMPLES_PER_CALL + 2
+        calibration = sq.calibrate_layer_activations(records)
+        assert calibration["params/Dense_0/kernel"]["observed_max"] == 7.5
+
+    def test_cast_regime_calib_mode_is_none(self, quant_export):
+        """fp16 has no native contractions — nothing to calibrate, so
+        the metadata/fleet surface must say None, not 'dynamic'."""
+        path, _ = quant_export
+        with open(os.path.join(path, "t2r_metadata.json")) as f:
+            quant = json.load(f)["serve_quant"]
+        assert quant["calib"]["fp16"]["mode"] is None
+        assert quant["calib"]["int8"]["mode"] == "static"
+        assert ExportedModel(path, quant_regime="fp16").calib_mode is None
+
+    def test_metadata_records_attention_fired_vs_eligibility(
+        self, native_export
+    ):
+        """Attention attribution is fired-only (no structural claim):
+        the MLP export records [] fired under 'auto' eligibility, so
+        auto-with-nothing-lowered is visible instead of silent."""
+        path, _ = native_export
+        with open(os.path.join(path, "t2r_metadata.json")) as f:
+            quant = json.load(f)["serve_quant"]
+        for regime in NATIVE_REGIMES:
+            native = quant["native"][regime]
+            assert native["attention"] == []
+            assert native["attention_eligibility"] == "auto"
+        assert ExportedModel(path, quant_regime="int8").native_attention == ()

@@ -254,6 +254,31 @@ class TestLintsCatch:
             choices = flags.get_flag(flag_name).choices
             assert "fp8_e4m3" in choices and "fp8_e5m2" in choices
 
+    def test_lowprec_static_flags_covered_by_registry_lint(self):
+        """The round-18 static-calibration gates ride the same rails:
+        T2R_SERVE_CALIB is a declared enum (static|dynamic, default
+        static) and T2R_SERVE_NATIVE_ATTN a declared str; raw reads are
+        env-undeclared, wrong-kind reads env-kind-mismatch, declared
+        spellings clean."""
+        assert "env-undeclared" in self._rules(
+            "import os\nx = os.environ.get('T2R_SERVE_CALIB')\n"
+        )
+        assert "env-kind-mismatch" in self._rules(
+            "from tensor2robot_tpu import flags\n"
+            "x = flags.get_bool('T2R_SERVE_CALIB')\n"
+            "y = flags.get_int('T2R_SERVE_NATIVE_ATTN')\n"
+        )
+        clean = self._rules(
+            "from tensor2robot_tpu import flags\n"
+            "a = flags.get_enum('T2R_SERVE_CALIB')\n"
+            "b = flags.get_str('T2R_SERVE_NATIVE_ATTN')\n"
+        )
+        assert "env-kind-mismatch" not in clean
+        assert "env-unknown-flag" not in clean
+        spec = flags.get_flag("T2R_SERVE_CALIB")
+        assert spec.choices == ("static", "dynamic")
+        assert spec.default == "static"
+
     def test_replay_flags_covered_by_registry_lint(self):
         """The round-12 T2R_REPLAY_* + T2R_PARSE_ON_ERROR flags ride the
         same rails: raw environ reads are env-undeclared, wrong-kind

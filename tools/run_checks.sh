@@ -68,13 +68,14 @@ if [ "$TESTS" = 1 ]; then
 
   echo "== plan: sharding-planner preset byte-equality + 3D composition (tier-1) =="
   # Round-17 gates, attributed by name: factorization enumeration with
-  # memory-infeasible rejection, preset byte-equality pins (every
-  # hand-wired regime vs its planner preset, leaf-for-leaf + bitwise
-  # none-step), checkpoint round-trip into the same plan / loud failure
-  # into a different one, plan-pins-regime-over-env composition, the
+  # memory-infeasible rejection, preset byte-equality pins (hand-wired
+  # regime vs its planner preset, leaf-for-leaf + bitwise none-step),
+  # checkpoint round-trip into the same plan / loud failure into a
+  # different one, plan-pins-regime-over-env composition, the
   # sharding-outside-planner lint, and the fast 3D (2x2x2) sibling. The
-  # multi-step 3D loss-parity twin is the slow slice
-  # (tests/test_planner.py::Test3DPlan::test_loss_parity_with_data_axis_weight_update_twin).
+  # multi-step 3D loss-parity twin AND the two ring-attention preset
+  # twins (dp_sp, sp_ring — ~75s of layout-only shard_map compiles)
+  # ride the slow slice; BENCH_PLAN_r17 re-audits all 8 presets.
   if ! JAX_PLATFORMS=cpu python -m pytest tests/test_planner.py \
       -q -m 'not slow' -p no:cacheprovider; then
     status=1
@@ -98,6 +99,21 @@ if [ "$TESTS" = 1 ]; then
   if ! JAX_PLATFORMS=cpu python -m pytest tests/test_collectives.py \
       tests/test_serve_quant.py \
       -q -m 'not slow' -k "fp8 or native or Native or lowprec" \
+      -p no:cacheprovider; then
+    status=1
+  fi
+
+  echo "== lowprec-static: static calibration + conv/attention native lowering (tier-1) =="
+  # Round-18 gates, attributed by name: static per-layer activation
+  # calibration (capture interceptor, percentile clips, per-layer
+  # demotion back to dynamic, NaN/Inf typed errors), the reduce audit
+  # proving zero per-dispatch activation-quant reductions for static
+  # programs, conv kernels contracting natively on int8/fp8 operands,
+  # attention QK^T/PV lowering behind T2R_SERVE_NATIVE_ATTN, and the
+  # T2R_SERVE_CALIB=dynamic op-for-op byte-compat pin.
+  if ! JAX_PLATFORMS=cpu python -m pytest tests/test_serve_quant.py \
+      -q -m 'not slow' \
+      -k "Calib or calib or StaticNative or NativeConv or NativeAttention or LayerCalibration" \
       -p no:cacheprovider; then
     status=1
   fi
