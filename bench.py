@@ -3953,7 +3953,17 @@ def bench_plan(args) -> None:
     sharded over BOTH replica axes, gated on loss parity against the
     hand-wirable DP x PP twin, with per-axis wire-byte attribution from
     the plan's collective schedule; (4) the ranked factorization table
-    from `plan()` for this host's topology.
+    from `plan()` for this host's topology; (5) the round-19 widened
+    points — TP (the fsdp axis) against its dp8 twin and ulysses
+    attention inside the pipeline shard_map against the ring-in-pipe
+    twin (same pipelined parameter structure), each gated on loss
+    parity and on appearing feasible in the widened ranked table;
+    (6) the measured search + persistent plan cache: a cold
+    T2R_PLAN=auto run compiles/times its shortlist and stores the
+    winner, the warm run replays it byte-for-byte with ZERO search
+    compiles (audited via the probe compile counter), with the
+    analytic-vs-measured memory-error and rank-agreement audits in the
+    artifact.
 
     value = fraction of audited presets byte-equal (must be 1.0).
     """
@@ -3976,6 +3986,8 @@ def bench_plan(args) -> None:
         for key in (
             "T2R_PLAN", "T2R_PLAN_MEM_BUDGET",
             "T2R_COLLECTIVE_QUANT", "T2R_COLLECTIVE_BLOCK",
+            "T2R_PLAN_CACHE_DIR", "T2R_PLAN_MEASURE",
+            "T2R_PLAN_MEASURE_STEPS",
         ):
             env.pop(key, None)
         proc = subprocess.run(
@@ -4216,6 +4228,165 @@ def bench_plan(args) -> None:
             spec_3d, planner.Topology(num_devices=8)
         ).to_json()
 
+        # -- leg 5: the widened factorization points (round 19) --
+        # TP (the fsdp axis) and ulysses-inside-the-pipeline were
+        # unreachable before this round; each passes its loss-parity
+        # twin and appears feasible in the widened ranked table.
+        table_widened = planner.plan(
+            spec_3d, planner.Topology(num_devices=8),
+            constraints=planner.Constraints(
+                param_min_shard_size=0,
+                sequence_parallel_mode="ulysses",
+            ),
+        ).to_json()
+        widened_feasible = {
+            e["plan"]["name"]
+            for e in table_widened["table"]
+            if e["feasible"]
+        }
+
+        def run_plan_losses(plan_obj, model_kwargs=None, steps=None):
+            model = transformer(
+                plan_obj.build_mesh(), **(model_kwargs or {})
+            )
+            compiled = train_eval.CompiledModel(
+                model, donate_state=False, plan=plan_obj
+            )
+            batch = transformer_batch(model)
+            state = compiled.init_state(jax.random.PRNGKey(0), batch)
+            losses = []
+            rng_w = jax.random.PRNGKey(7)
+            for _ in range(steps or args.steps_3d):
+                state, m = compiled.train_step(
+                    state, compiled.shard_batch(batch), rng_w
+                )
+                losses.append(float(jax.device_get(m["loss"])))
+            return losses
+
+        tp_plan = dataclasses.replace(
+            planner.ShardingPlan(name="dp4_sp1_pp1_tp2", data=4, fsdp=2),
+            param_min_shard_size=0,
+        )
+        dp_twin = dataclasses.replace(
+            planner.ShardingPlan(name="dp8", data=8),
+            param_min_shard_size=0,
+        )
+        losses_tp = run_plan_losses(tp_plan)
+        losses_tp_twin = run_plan_losses(dp_twin)
+        parity_tp = max(
+            abs(a - b) for a, b in zip(losses_tp, losses_tp_twin)
+        )
+
+        def pipe_plan(mode):
+            return dataclasses.replace(
+                planner.ShardingPlan(
+                    name=f"sp4_{mode}_pp2", sequence=4, pipe=2,
+                    sequence_parallel_mode=mode,
+                ),
+                param_min_shard_size=0,
+            )
+
+        # The twin shares the pipelined parameter structure (per-stage
+        # init from split rngs): ring-in-pipe, the PR 13 known-good path.
+        losses_up = run_plan_losses(
+            pipe_plan("ulysses"),
+            dict(pipeline_stages=2, sequence_parallel_mode="ulysses"),
+        )
+        losses_rp = run_plan_losses(
+            pipe_plan("ring"),
+            dict(pipeline_stages=2, sequence_parallel_mode="ring"),
+        )
+        parity_up = max(abs(a - b) for a, b in zip(losses_up, losses_rp))
+
+        # -- leg 6: the measured search + persistent plan cache --
+        import shutil
+        import tempfile
+        import time as time_lib
+
+        from tensor2robot_tpu import flags as t2r_flags
+        from tensor2robot_tpu.parallel import plan_cache
+
+        cache_root = tempfile.mkdtemp(prefix="t2r_plan_cache_bench_")
+        flag_saves = {
+            name: t2r_flags.read_raw(name)
+            for name in (
+                "T2R_PLAN", "T2R_PLAN_CACHE_DIR", "T2R_PLAN_MEASURE",
+                "T2R_PLAN_MEASURE_STEPS",
+            )
+        }
+        try:
+            t2r_flags.write_env("T2R_PLAN", "auto")
+            t2r_flags.write_env("T2R_PLAN_CACHE_DIR", cache_root)
+            t2r_flags.write_env("T2R_PLAN_MEASURE", "shortlist-3")
+            t2r_flags.write_env(
+                "T2R_PLAN_MEASURE_STEPS", max(args.steps, 2)
+            )
+            model_m = MockT2RModel(device_type="cpu", use_batch_norm=False)
+            gen_m = MockInputGenerator(batch_size=16, seed=0)
+            gen_m.set_specification_from_model(model_m, "train")
+            batch_m = next(iter(gen_m.create_dataset("train")))
+            start = time_lib.perf_counter()
+            cold_plan = planner.resolve_plan_from_flag(model_m, batch_m)
+            cold_wall_s = time_lib.perf_counter() - start
+            cold_stats = planner.last_search()
+            start = time_lib.perf_counter()
+            warm_plan = planner.resolve_plan_from_flag(model_m, batch_m)
+            warm_wall_s = time_lib.perf_counter() - start
+            warm_stats = planner.last_search()
+            stored = plan_cache.load(
+                cold_stats["fingerprint"], cache_root
+            )
+            # The analytic-vs-measured audits ride the stored table.
+            measured_entries = [
+                e["measured"]
+                for e in (stored or {}).get("table", [])
+                if e.get("measured") is not None
+            ]
+            memory_error_audit = [
+                {
+                    "name": m["name"],
+                    "analytic_memory_error": m.get(
+                        "analytic_memory_error"
+                    ),
+                    "memory_per_device_bytes": m.get(
+                        "memory_per_device_bytes"
+                    ),
+                }
+                for m in measured_entries
+            ]
+            timed = sorted(
+                (
+                    m
+                    for m in measured_entries
+                    if m.get("step_time_ms") is not None
+                ),
+                key=lambda m: m["analytic_rank"],
+            )
+            pairs = agree = 0
+            for i in range(len(timed)):
+                for j in range(i + 1, len(timed)):
+                    pairs += 1
+                    if timed[i]["step_time_ms"] <= timed[j]["step_time_ms"]:
+                        agree += 1
+            rank_agreement = agree / pairs if pairs else 1.0
+            winner_time = min(
+                (m["step_time_ms"] for m in timed), default=None
+            )
+            # The acceptance bar: the measured winner is no slower than
+            # the best preset's own measured step time (1.5x absorbs
+            # host-CPU timing noise between two medians).
+            preset_probe = train_eval.measure_plan_candidate(
+                model_m,
+                planner.resolve_preset("dp"),
+                batch_m,
+                steps=max(args.steps, 2),
+            )
+            preset_time = preset_probe.get("step_time_ms")
+        finally:
+            for name, value in flag_saves.items():
+                t2r_flags.restore_env(name, value)
+            shutil.rmtree(cache_root, ignore_errors=True)
+
         presets_equal = sum(
             1 for entry in byte_audit.values() if entry["layouts_equal"]
         )
@@ -4239,6 +4410,30 @@ def bench_plan(args) -> None:
             )
             and {"data", "sequence", "pipe"}
             <= {a for e in wire_attribution for a in e["axes"]},
+            # round 19: the widened factorization points.
+            "tp_point_loss_parity": parity_tp < 1e-3,
+            "ulysses_in_pipe_loss_parity": parity_up < 1e-3,
+            "widened_points_in_ranked_table": (
+                "dp4_sp1_pp1_tp2" in widened_feasible
+                and "dp1_sp4_pp2" in widened_feasible
+            ),
+            # round 19: the measured search + persistent plan cache.
+            "cold_search_measured": (
+                cold_stats.get("source") == "measured"
+                and cold_stats.get("probe_compiles", 0) >= 1
+            ),
+            "warm_cache_zero_compiles": (
+                warm_stats.get("source") == "cache"
+                and warm_stats.get("probe_compiles") == 0
+            ),
+            "warm_plan_byte_identical": (
+                warm_plan.to_json() == cold_plan.to_json()
+            ),
+            "measured_winner_not_slower_than_preset": (
+                winner_time is not None
+                and preset_time is not None
+                and winner_time <= preset_time * 1.5
+            ),
         }
         value = presets_equal / len(byte_audit)
         payload = {
@@ -4264,6 +4459,31 @@ def bench_plan(args) -> None:
                     "wire_byte_attribution": wire_attribution,
                 },
                 "ranked_plan_table": table,
+                "widened": {
+                    "ranked_plan_table": table_widened,
+                    "tp": {
+                        "plan": tp_plan.to_json(),
+                        "losses": losses_tp,
+                        "twin_losses_dp8": losses_tp_twin,
+                        "loss_parity_max_abs_diff": parity_tp,
+                    },
+                    "ulysses_in_pipe": {
+                        "plan": pipe_plan("ulysses").to_json(),
+                        "losses": losses_up,
+                        "twin_losses_ring_in_pipe": losses_rp,
+                        "loss_parity_max_abs_diff": parity_up,
+                    },
+                },
+                "measured_search": {
+                    "cold_wall_s": cold_wall_s,
+                    "warm_wall_s": warm_wall_s,
+                    "cold_stats": cold_stats,
+                    "warm_stats": warm_stats,
+                    "winner_step_time_ms": winner_time,
+                    "best_preset_step_time_ms": preset_time,
+                    "analytic_vs_measured_rank_agreement": rank_agreement,
+                    "memory_error_audit": memory_error_audit,
+                },
                 "steps": args.steps,
                 "steps_3d": args.steps_3d,
                 "block": block,
@@ -5094,7 +5314,10 @@ def _build_cli():
         "byte-equality audit of planner presets vs the hand-wired "
         "regimes, bitwise planner-vs-hand DP parity (none/int8/fp8), "
         "the 3D DP x SP x PP (2x2x2) leg with per-axis wire-byte "
-        "attribution, and the ranked factorization table "
+        "attribution, the ranked factorization table, loss-parity twins "
+        "for the widened TP / ulysses-in-pipeline points, and the "
+        "measured search + plan cache (cold measures and stores, warm "
+        "replays with zero compiles) "
         "(docs/PARALLELISM.md \"Sharding planner\")",
     )
     plan_leg.add_argument(
@@ -5112,7 +5335,7 @@ def _build_cli():
              "(default %(default)s)",
     )
     plan_leg.add_argument(
-        "--out", default="BENCH_PLAN_r17.json",
+        "--out", default="BENCH_PLAN_r19.json",
         help="also write the payload to this file ('' disables; "
              "default %(default)s)",
     )

@@ -51,7 +51,9 @@ Three rule families, each born from a real failure mode in this codebase:
   module needs exists as a mesh.py/planner helper (REPLICATED_SPEC,
   batch_partition_spec, flat_shard_sharding, the plan's rules). Raw
   `NamedSharding(...)`/`PartitionSpec(...)` construction inside
-  `tensor2robot_tpu/train/` (outside `parallel/`) is an error — a
+  `tensor2robot_tpu/train/` (outside `parallel/`) is an error — as are
+  the tensor-parallel spellings `PositionalSharding(...)` and the
+  `P(...)` alias, now that the planner searches the fsdp axis — a
   hand-built spec there is exactly the hand-wired layout drift the
   planner's byte-equality contract exists to end. The few legitimate
   sites declare themselves with the `@hand_sharded` decorator
@@ -178,7 +180,14 @@ _NP_MODULE_ALIASES = frozenset({"np", "numpy"})
 # legitimate hand-sharded site.
 _SHARDING_SCOPE_FRAGMENTS = ("tensor2robot_tpu/train/",)
 _SHARDING_ALLOW_DECORATOR = "hand_sharded"
-_SHARDING_CONSTRUCTORS = frozenset({"NamedSharding", "PartitionSpec"})
+# The tensor-parallel spellings ride the same gate: now that the planner
+# searches the fsdp/model axis (ShardingPlan regime 'sharded_params'),
+# hand-spelling a Megatron-style layout via jax.P(...) /
+# PositionalSharding(...) in train/ is the exact drift the fsdp search
+# exists to end.
+_SHARDING_CONSTRUCTORS = frozenset(
+    {"NamedSharding", "PartitionSpec", "PositionalSharding", "P"}
+)
 
 # Collective discipline: the trainer layers where raw jax collectives
 # are banned, and the one file allowed to spell them.

@@ -346,6 +346,39 @@ _declare(
     "tensor2robot_tpu/parallel/planner.py",
 )
 _declare(
+    "T2R_PLAN_CACHE_DIR",
+    _STR,
+    None,
+    "Persistent plan-cache directory for T2R_PLAN=auto "
+    "(parallel/plan_cache.py): the search's winning plan + measured "
+    "table are stored keyed on (model fingerprint, topology, jax "
+    "version, planner schema); a later auto run on the same key "
+    "deserializes the winner and performs ZERO search compiles. Unset "
+    "(the default) disables the cache — every auto run searches fresh.",
+    "tensor2robot_tpu/parallel/plan_cache.py",
+)
+_declare(
+    "T2R_PLAN_MEASURE",
+    _STR,
+    "off",
+    "Measured tier of the T2R_PLAN=auto search (parallel/planner.py): "
+    "'off' (default) ranks analytically only; 'shortlist-N' compiles "
+    "the top N analytic candidates' train steps (persistent compile "
+    "cache bypassed), reads compiled.memory_analysis(), times a "
+    "handful of real steps, and re-ranks on measured step time with "
+    "memory fit as a hard gate.",
+    "tensor2robot_tpu/parallel/planner.py",
+)
+_declare(
+    "T2R_PLAN_MEASURE_STEPS",
+    _INT,
+    3,
+    "Timed post-warmup train steps per shortlisted candidate in the "
+    "measured plan search (the probe reports their median).",
+    "tensor2robot_tpu/parallel/planner.py",
+    minimum=1,
+)
+_declare(
     "T2R_PLAN_MEM_BUDGET",
     _INT,
     0,

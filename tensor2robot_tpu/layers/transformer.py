@@ -95,10 +95,11 @@ class MultiHeadAttention(nn.Module):
     # Manual sequence parallelism: >1 means this attention already runs
     # INSIDE a shard_map whose manual axes include the sequence axis (the
     # pipelined encoder's per-device program) and its input is the LOCAL
-    # sequence shard. Attention then rides
-    # ring_attention.ring_attention_manual over that axis instead of
-    # opening its own shard_map (which cannot nest). Ring only — the
-    # piece that composes SP with PP (parallel/planner.py 3D plans).
+    # sequence shard. Attention then rides the manual entry point of the
+    # selected strategy — ring_attention.ring_attention_manual or
+    # ulysses_attention.ulysses_attention_manual — over that axis instead
+    # of opening its own shard_map (which cannot nest). The piece that
+    # composes SP with PP (parallel/planner.py 3D plans).
     manual_sequence_size: int = 1
 
     def _kv_heads(self) -> int:
@@ -150,23 +151,30 @@ class MultiHeadAttention(nn.Module):
                 f"got {self.sequence_parallel_mode!r}"
             )
         if self.manual_sequence_size > 1:
-            if self.sequence_parallel_mode != "ring":
-                raise ValueError(
-                    "manual (in-shard_map) sequence parallelism supports "
-                    "the ring strategy only; got "
-                    f"{self.sequence_parallel_mode!r}"
+            if self.sequence_parallel_mode == "ulysses":
+                from tensor2robot_tpu.parallel.ulysses_attention import (
+                    ulysses_attention_manual,
                 )
-            from tensor2robot_tpu.parallel.ring_attention import (
-                ring_attention_manual,
-            )
 
-            out = ring_attention_manual(
-                q, k, v,
-                axis_name=mesh_lib.SEQUENCE_AXIS,
-                axis_size=self.manual_sequence_size,
-                causal=self.causal,
-                window=self.window,
-            )
+                out = ulysses_attention_manual(
+                    q, k, v,
+                    axis_name=mesh_lib.SEQUENCE_AXIS,
+                    axis_size=self.manual_sequence_size,
+                    causal=self.causal,
+                    window=self.window,
+                )
+            else:
+                from tensor2robot_tpu.parallel.ring_attention import (
+                    ring_attention_manual,
+                )
+
+                out = ring_attention_manual(
+                    q, k, v,
+                    axis_name=mesh_lib.SEQUENCE_AXIS,
+                    axis_size=self.manual_sequence_size,
+                    causal=self.causal,
+                    window=self.window,
+                )
             out = out.reshape(batch, seq, features)
             return nn.Dense(x.shape[-1], use_bias=False, name="out")(out)
         sequence_axis = (
@@ -363,9 +371,11 @@ class PipelineStage(nn.Module):
     """The repeating unit of the pipelined encoder: a run of pre-norm
     blocks. Stage-internal attention is single-device by default; a
     sequence_axis_size > 1 (the DP x SP x PP composition) runs each
-    block's attention as a MANUAL ring over the sequence axis — legal
-    because the stage executes inside pipeline_apply's shard_map, where
-    the sequence axis is manual alongside pipe."""
+    block's attention as a MANUAL context-parallel strategy — ring K/V
+    rotation or ulysses head-scatter, per sequence_parallel_mode — over
+    the sequence axis, legal because the stage executes inside
+    pipeline_apply's shard_map, where the sequence axis is manual
+    alongside pipe."""
 
     num_blocks: int
     num_heads: int
@@ -377,6 +387,7 @@ class PipelineStage(nn.Module):
     window: Optional[int] = None
     num_kv_heads: Optional[int] = None
     sequence_axis_size: int = 1
+    sequence_parallel_mode: str = "ring"
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -392,6 +403,7 @@ class PipelineStage(nn.Module):
                 window=self.window,
                 num_kv_heads=self.num_kv_heads,
                 manual_sequence_size=self.sequence_axis_size,
+                sequence_parallel_mode=self.sequence_parallel_mode,
                 name=f"block_{i}",
             )(x)
         return x
@@ -406,8 +418,9 @@ class TransformerEncoder(nn.Module):
     equal stages whose stacked parameters live under the `pipe_stages`
     param key (sharded dim-0 over `pipe` by the trainer's sharding
     rules), and the batch streams through in `pipeline_microbatches`
-    microbatches. Composes with the data axis; mutually exclusive with
-    sequence parallelism and MoE inside the pipelined stack.
+    microbatches. Composes with the data axis and with sequence
+    parallelism (ring or ulysses, run manually inside the pipeline's
+    shard_map); mutually exclusive with MoE inside the pipelined stack.
     """
 
     num_layers: int
@@ -517,11 +530,25 @@ class TransformerEncoder(nn.Module):
                 f"!= pipeline_stages={stages}"
             )
         seq_size = mesh_axes.get(mesh_mod.SEQUENCE_AXIS, 1)
-        if seq_size > 1 and self.sequence_parallel_mode != "ring":
+        if seq_size > 1 and self.sequence_parallel_mode not in (
+            "ring", "ulysses"
+        ):
             raise ValueError(
                 "pipeline_stages > 1 composes with sequence parallelism "
-                "only in ring mode (the in-shard_map manual ring); got "
+                "in ring or ulysses mode (the in-shard_map manual "
+                "strategies); got "
                 f"sequence_parallel_mode={self.sequence_parallel_mode!r}"
+            )
+        if (
+            seq_size > 1
+            and self.sequence_parallel_mode == "ulysses"
+            and self.num_heads % seq_size != 0
+        ):
+            raise ValueError(
+                f"ulysses inside the pipeline needs num_heads="
+                f"{self.num_heads} divisible by the sequence axis size "
+                f"{seq_size} (each device owns whole heads after the "
+                "all_to_all scatter); use ring mode otherwise"
             )
         if seq_size > 1 and x.shape[1] % seq_size != 0:
             raise ValueError(
@@ -541,10 +568,12 @@ class TransformerEncoder(nn.Module):
                 window=self.window,
                 num_kv_heads=self.num_kv_heads,
                 sequence_axis_size=sequence_axis_size,
+                sequence_parallel_mode=self.sequence_parallel_mode,
             )
 
-        # The applied stage runs the manual ring when the mesh shards the
-        # sequence; init runs OUTSIDE pipeline_apply's shard_map (no
+        # The applied stage runs the manual context-parallel strategy
+        # (ring or ulysses) when the mesh shards the sequence; init runs
+        # OUTSIDE pipeline_apply's shard_map (no
         # manual axes yet), so it uses a single-device twin — attention
         # strategy does not change the parameter structure.
         stage = make_stage(seq_size)

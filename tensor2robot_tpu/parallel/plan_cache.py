@@ -1,0 +1,347 @@
+"""Persistent cache for measured sharding-plan search results.
+
+The measured tier of the planner (parallel/planner.py, T2R_PLAN=auto
+with T2R_PLAN_MEASURE) pays real XLA compiles to rank its shortlist —
+work that changes only when the model, the topology, or the planner
+itself changes. This module remembers the winner: the second auto run
+on a known (model, topology) pair performs ZERO search compiles, it
+deserializes the plan the first run measured (the same economics as
+the serving AOT ladder in export/aot.py, applied to the search).
+
+Cache key, all-or-nothing (any component differing is a miss):
+
+  * model-spec fingerprint — sha256 over the param/opt/batch treedefs +
+    every leaf's (path, shape, dtype) + the spec's geometry fields;
+  * device topology — platform / device_kind / device_count
+    (export/aot.py device_topology);
+  * jax version — measured timings and memory_analysis are not stable
+    across runtimes;
+  * planner schema version (PLAN_CACHE_FORMAT_VERSION) — bumped when
+    the search space or ShardingPlan schema changes, so stale winners
+    from a narrower search can never shadow a wider one.
+
+Envelope (one file per fingerprint, `plan_<fp>.bin` under
+T2R_PLAN_CACHE_DIR):
+
+    [0:4]   magic b"T2RP"
+    [4:8]   u32 LE: byte length of REST
+    [8:12]  u32 LE: crc32 of REST
+    [12:]   REST = u32 LE header length + header JSON + payload JSON
+            ({"plan": ShardingPlan.to_json(), "table": [...]})
+
+The 12-byte magic/length/crc header is the same structural shape as the
+AOT/replay frames, so `analysis/corpus.py corrupt_frame_variants`
+drives the corruption tests with no new generator. Integrity (magic,
+exact length, CRC) is verified before the header is parsed, the key
+before the payload is decoded, and the payload is JSON — never pickle.
+A corrupt or mismatched entry is a typed `PlanCacheCorrupt` /
+`PlanCacheKeyMismatch`: `load()` logs it and returns None (fresh
+search), it is never silently trusted.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import struct
+import tempfile
+import zlib
+from typing import Any, Dict, Mapping, Optional, Tuple
+
+import jax
+import numpy as np
+
+from tensor2robot_tpu import flags
+
+__all__ = [
+    "PLAN_CACHE_FORMAT_VERSION",
+    "PLAN_CACHE_MAGIC",
+    "MAX_PLAN_ENTRY_BYTES",
+    "PlanCacheError",
+    "PlanCacheCorrupt",
+    "PlanCacheKeyMismatch",
+    "cache_dir",
+    "entry_path",
+    "load",
+    "model_fingerprint",
+    "pack_entry",
+    "store",
+    "unpack_entry",
+]
+
+PLAN_CACHE_MAGIC = b"T2RP"
+#: The planner schema version: bump when the factorization space or the
+#: ShardingPlan schema changes — a winner chosen from a narrower search
+#: must not shadow the wider one.
+PLAN_CACHE_FORMAT_VERSION = 1
+_HEADER_SIZE = 12  # magic + length + crc32, the corpus frame shape
+
+#: Hard bound on a single cache entry; a forged length field must be
+#: rejected before any allocation happens (corpus frame_huge_length).
+#: Plans + their measured tables are small JSON — 16 MiB is generous.
+MAX_PLAN_ENTRY_BYTES = 1 << 24
+
+_LOG = logging.getLogger(__name__)
+
+
+class PlanCacheError(RuntimeError):
+    """Base class for plan-cache failures."""
+
+
+class PlanCacheCorrupt(PlanCacheError):
+    """The envelope failed integrity (magic/length/CRC/JSON): a
+    truncated or bitflipped file. The caller re-runs the search."""
+
+
+class PlanCacheKeyMismatch(PlanCacheError):
+    """The envelope is intact but keyed for a different model, topology,
+    jax version, or planner schema — its winner was ranked under
+    different rules. The caller re-runs the search LOUDLY."""
+
+
+def cache_dir() -> Optional[str]:
+    """The cache directory in effect (T2R_PLAN_CACHE_DIR), or None when
+    the cache is disabled — the default, zero-IO path."""
+    return flags.get_str("T2R_PLAN_CACHE_DIR") or None
+
+
+def model_fingerprint(model_spec) -> str:
+    """sha256 hex over everything the search's outcome depends on from
+    the model side: tree structure, every leaf's (path, shape, dtype),
+    and the geometry fields the feasibility gates consult."""
+
+    def tree_signature(tree) -> Dict[str, Any]:
+        if tree is None:
+            return {"treedef": None, "leaves": []}
+        leaves = []
+        for path, leaf in jax.tree_util.tree_leaves_with_path(tree):
+            shape = getattr(leaf, "shape", None)
+            dtype = getattr(leaf, "dtype", None)
+            leaves.append(
+                [
+                    jax.tree_util.keystr(path),
+                    None if shape is None else [int(d) for d in shape],
+                    None if dtype is None else np.dtype(dtype).name,
+                ]
+            )
+        return {
+            "treedef": str(jax.tree_util.tree_structure(tree)),
+            "leaves": leaves,
+        }
+
+    doc = {
+        "params": tree_signature(model_spec.param_shapes),
+        "opt": tree_signature(model_spec.opt_shapes),
+        "batch": tree_signature(model_spec.batch_shapes),
+        "has_ema": bool(model_spec.has_ema),
+        "batch_size": model_spec.batch_size,
+        "seq_len": model_spec.seq_len,
+        "num_heads": model_spec.num_heads,
+        "head_dim": model_spec.head_dim,
+        "num_layers": model_spec.num_layers,
+        "d_model": model_spec.d_model,
+        "pipeline_capable": bool(model_spec.pipeline_capable),
+    }
+    blob = json.dumps(doc, sort_keys=True).encode()
+    return hashlib.sha256(blob).hexdigest()
+
+
+def entry_path(directory: str, fingerprint: str) -> str:
+    """One file per model fingerprint; topology/jax/schema live in the
+    header key, so a topology change on the same model is a LOUD typed
+    mismatch rather than a silent parallel file."""
+    return os.path.join(directory, f"plan_{fingerprint[:16]}.bin")
+
+
+def pack_entry(
+    fingerprint: str,
+    payload_doc: Mapping[str, Any],
+    topology: Optional[Mapping[str, Any]] = None,
+    jax_version: Optional[str] = None,
+    format_version: int = PLAN_CACHE_FORMAT_VERSION,
+) -> bytes:
+    """payload_doc ({"plan": ..., "table": ...}) -> envelope bytes."""
+    from tensor2robot_tpu.export import aot
+
+    header = {
+        "format_version": int(format_version),
+        "fingerprint": str(fingerprint),
+        "topology": dict(
+            topology if topology is not None else aot.device_topology()
+        ),
+        "jax": jax_version if jax_version is not None else jax.__version__,
+    }
+    header_bytes = json.dumps(header, sort_keys=True).encode()
+    payload = json.dumps(dict(payload_doc), sort_keys=True).encode()
+    rest = struct.pack("<I", len(header_bytes)) + header_bytes + payload
+    return (
+        PLAN_CACHE_MAGIC
+        + struct.pack("<I", len(rest))
+        + struct.pack("<I", zlib.crc32(rest) & 0xFFFFFFFF)
+        + rest
+    )
+
+
+def unpack_entry(
+    blob: bytes,
+    expect_fingerprint: Optional[str] = None,
+    expect_topology: Optional[Mapping[str, Any]] = None,
+    expect_jax: Optional[str] = None,
+) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """Envelope -> (header, payload doc). Integrity first (typed
+    PlanCacheCorrupt), then the full key (typed PlanCacheKeyMismatch),
+    then — and only then — the payload JSON is decoded."""
+    if len(blob) < _HEADER_SIZE:
+        raise PlanCacheCorrupt(
+            f"plan-cache entry truncated at {len(blob)} bytes"
+        )
+    if blob[:4] != PLAN_CACHE_MAGIC:
+        raise PlanCacheCorrupt(
+            f"bad magic {blob[:4]!r} (want {PLAN_CACHE_MAGIC!r})"
+        )
+    (length,) = struct.unpack("<I", blob[4:8])
+    (crc,) = struct.unpack("<I", blob[8:12])
+    if length > MAX_PLAN_ENTRY_BYTES:
+        raise PlanCacheCorrupt(
+            f"forged length {length} exceeds the format bound"
+        )
+    rest = blob[_HEADER_SIZE:]
+    if len(rest) != length:
+        raise PlanCacheCorrupt(
+            f"length field says {length} bytes, file carries {len(rest)}"
+        )
+    if zlib.crc32(rest) & 0xFFFFFFFF != crc:
+        raise PlanCacheCorrupt("crc mismatch: plan-cache bytes are corrupt")
+    if len(rest) < 4:
+        raise PlanCacheCorrupt("envelope too short for a header")
+    (hlen,) = struct.unpack("<I", rest[:4])
+    if hlen > len(rest) - 4:
+        raise PlanCacheCorrupt(f"header length {hlen} overruns the envelope")
+    try:
+        header = json.loads(rest[4 : 4 + hlen].decode())
+    except (UnicodeDecodeError, ValueError) as err:
+        raise PlanCacheCorrupt(f"header is not JSON: {err}") from err
+    if not isinstance(header, dict):
+        raise PlanCacheCorrupt(f"header is {type(header).__name__}, not dict")
+    _check_key(header, expect_fingerprint, expect_topology, expect_jax)
+    try:
+        payload = json.loads(rest[4 + hlen :].decode())
+    except (UnicodeDecodeError, ValueError) as err:
+        raise PlanCacheCorrupt(f"payload is not JSON: {err}") from err
+    if not isinstance(payload, dict) or "plan" not in payload:
+        raise PlanCacheCorrupt("payload carries no plan document")
+    return header, payload
+
+
+def _check_key(
+    header: Mapping[str, Any],
+    expect_fingerprint: Optional[str],
+    expect_topology: Optional[Mapping[str, Any]],
+    expect_jax: Optional[str],
+) -> None:
+    if header.get("format_version") != PLAN_CACHE_FORMAT_VERSION:
+        raise PlanCacheKeyMismatch(
+            f"planner schema {header.get('format_version')} != "
+            f"{PLAN_CACHE_FORMAT_VERSION}: the entry was ranked under a "
+            "different search space"
+        )
+    expect_jax = expect_jax if expect_jax is not None else jax.__version__
+    if header.get("jax") != expect_jax:
+        raise PlanCacheKeyMismatch(
+            f"plan was measured under jax {header.get('jax')}, this "
+            f"process runs {expect_jax} — measured costs are not stable "
+            "across runtimes"
+        )
+    if (
+        expect_fingerprint is not None
+        and header.get("fingerprint") != expect_fingerprint
+    ):
+        raise PlanCacheKeyMismatch(
+            "model fingerprint mismatch: the cached winner was searched "
+            "for a different model "
+            f"({header.get('fingerprint')} != {expect_fingerprint})"
+        )
+    if expect_topology is not None:
+        got = header.get("topology") or {}
+        if dict(got) != dict(expect_topology):
+            raise PlanCacheKeyMismatch(
+                f"device topology mismatch: plan searched on {got}, "
+                f"this host is {dict(expect_topology)}"
+            )
+
+
+def store(
+    fingerprint: str,
+    payload_doc: Mapping[str, Any],
+    directory: Optional[str] = None,
+    topology: Optional[Mapping[str, Any]] = None,
+) -> Optional[str]:
+    """Writes one entry atomically (tmp + rename — a reader never sees a
+    half-written envelope; the CRC catches torn storage underneath).
+    Returns the path, or None when the cache is disabled."""
+    directory = directory if directory is not None else cache_dir()
+    if not directory:
+        return None
+    os.makedirs(directory, exist_ok=True)
+    path = entry_path(directory, fingerprint)
+    blob = pack_entry(fingerprint, payload_doc, topology=topology)
+    fd, tmp = tempfile.mkstemp(
+        dir=directory, prefix=os.path.basename(path) + ".tmp."
+    )
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return path
+
+
+def load(
+    fingerprint: str,
+    directory: Optional[str] = None,
+    topology: Optional[Mapping[str, Any]] = None,
+) -> Optional[Dict[str, Any]]:
+    """Tolerant read: the payload doc on a valid hit, None on a miss OR
+    any typed failure (corrupt / key mismatch — logged, never trusted).
+    Strict callers (tests) use `unpack_entry` directly."""
+    directory = directory if directory is not None else cache_dir()
+    if not directory:
+        return None
+    path = entry_path(directory, fingerprint)
+    try:
+        with open(path, "rb") as f:
+            blob = f.read(MAX_PLAN_ENTRY_BYTES + _HEADER_SIZE + 1)
+    except FileNotFoundError:
+        return None
+    except OSError as err:
+        _LOG.warning("plan cache unreadable at %s: %s", path, err)
+        return None
+    if topology is not None:
+        expect_topology = dict(topology)
+    else:
+        from tensor2robot_tpu.export import aot
+
+        expect_topology = aot.device_topology()
+    try:
+        _, payload = unpack_entry(
+            blob,
+            expect_fingerprint=fingerprint,
+            expect_topology=expect_topology,
+        )
+    except PlanCacheError as err:
+        _LOG.warning(
+            "plan cache entry %s rejected (%s): %s — falling back to a "
+            "fresh search",
+            path,
+            type(err).__name__,
+            err,
+        )
+        return None
+    return payload

@@ -68,6 +68,9 @@ __all__ = [
     "estimate_comm_bytes",
     "estimate_memory",
     "hand_sharded",
+    "last_search",
+    "measured_rerank",
+    "parse_measure_setting",
     "plan",
     "preset_names",
     "resolve_plan_from_flag",
@@ -207,6 +210,12 @@ class Constraints:
 
     allow_sp: bool = True
     allow_pp: bool = True
+    #: Tensor parallelism (the fsdp param-sharding axis). The search
+    #: folds it into the factorization space (ROADMAP 5(d)); a candidate
+    #: with tp > 1 is feasible only when some param leaf actually shards
+    #: under param_min_shard_size — tiny models reject it with the
+    #: reason recorded rather than paying collectives for nothing.
+    allow_tp: bool = True
     #: None reads the central T2R_COLLECTIVE_QUANT / _BLOCK flags.
     collective_quant: Optional[str] = None
     collective_block: Optional[int] = None
@@ -497,6 +506,17 @@ class ShardingPlan:
                     "bytes_fp32_equivalent": _sp_bytes(self, model_spec),
                 }
             )
+        if self.fsdp > 1:
+            entries.append(
+                {
+                    "site": "fsdp_param_gather",
+                    "ops": ["all_gather", "reduce_scatter"],
+                    "axes": [FSDP_AXIS],
+                    "collective": "none",
+                    "bytes_per_device_step": _tp_bytes(self, model_spec),
+                    "bytes_fp32_equivalent": _tp_bytes(self, model_spec),
+                }
+            )
         if self.pipe > 1:
             entries.append(
                 {
@@ -517,6 +537,26 @@ class ShardingPlan:
         out["num_devices"] = self.num_devices
         return out
 
+    @classmethod
+    def from_json(cls, doc: Mapping[str, Any]) -> "ShardingPlan":
+        """Inverse of to_json (drops the derived regime/num_devices
+        keys): the plan-cache round trip — a cached winner deserializes
+        into a plan whose to_json is byte-identical to what was stored."""
+        doc = dict(doc)
+        doc.pop("regime", None)
+        doc.pop("num_devices", None)
+        axes = doc.get("weight_update_axes")
+        if axes is not None:
+            doc["weight_update_axes"] = tuple(axes)
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(doc) - known
+        if unknown:
+            raise ValueError(
+                f"plan document carries unknown fields {sorted(unknown)} "
+                "— a newer planner schema; bump the cache format version"
+            )
+        return cls(**doc)
+
 
 # -- scoring ------------------------------------------------------------------
 
@@ -531,6 +571,32 @@ def _shard_factor(shape, group_size: int, min_size: int) -> int:
     spec: List[Optional[str]] = [None] * len(shape)
     _assign_largest_divisible_dim(spec, shape, group_size, "_probe")
     return group_size if any(entry is not None for entry in spec) else 1
+
+
+def _param_shard_factor(shape, sharding_plan: "ShardingPlan") -> int:
+    """The divide factor param_sharding (mesh.py) achieves on one leaf
+    under the plan's model/fsdp axes: the spec-level twin of the placed
+    rule, so memory estimates for sharded_params plans track the layout
+    the trainer will actually place."""
+    if int(np.prod(shape)) < sharding_plan.param_min_shard_size:
+        return 1
+    factor = 1
+    spec: List[Optional[str]] = [None] * len(shape)
+    if (
+        sharding_plan.model > 1
+        and len(shape) >= 2
+        and shape[-1] % sharding_plan.model == 0
+    ):
+        spec[-1] = MODEL_AXIS
+        factor *= sharding_plan.model
+    if sharding_plan.fsdp > 1:
+        before = list(spec)
+        _assign_largest_divisible_dim(
+            spec, shape, sharding_plan.fsdp, FSDP_AXIS
+        )
+        if spec != before:
+            factor *= sharding_plan.fsdp
+    return factor
 
 
 def _is_pipe_stage_path(path, shape, pipe: int) -> bool:
@@ -548,9 +614,10 @@ def _tree_bytes_per_device(tree, sharding_plan: "ShardingPlan",
     pipe-stage leaves divide by the pipe axis; (when shard_mirrors) every
     other large-enough leaf divides by the weight-update group."""
     total = 0.0
+    regime = sharding_plan.regime()
     group = (
         sharding_plan.weight_update_group
-        if shard_mirrors and sharding_plan.regime() in ("zero2", "quant_zero2")
+        if shard_mirrors and regime in ("zero2", "quant_zero2")
         else 1
     )
     for path, leaf in jax.tree_util.tree_leaves_with_path(tree):
@@ -560,6 +627,11 @@ def _tree_bytes_per_device(tree, sharding_plan: "ShardingPlan",
         leaf_bytes = int(np.prod(shape)) * np.dtype(leaf.dtype).itemsize
         if _is_pipe_stage_path(path, shape, sharding_plan.pipe):
             total += leaf_bytes / sharding_plan.pipe
+        elif regime == "sharded_params":
+            # Params AND their opt/EMA mirrors follow base_param_rule
+            # under this regime (CompiledModel.init_state places them
+            # with the same per-leaf rule).
+            total += leaf_bytes / _param_shard_factor(shape, sharding_plan)
         else:
             total += leaf_bytes / _shard_factor(
                 shape, group, sharding_plan.param_min_shard_size
@@ -666,6 +738,21 @@ def _pp_bytes(sharding_plan: ShardingPlan,
     return int(2 * ticks * act)
 
 
+def _tp_bytes(sharding_plan: ShardingPlan,
+              model_spec: Optional[ModelSpec]) -> Optional[int]:
+    """Per-device per-step tensor-parallel (fsdp param-sharding) bytes:
+    the ZeRO-3 pattern pays an all-gather of the sharded params for the
+    forward, another for the backward, and a reduce-scatter of the
+    gradients — ~3 full param volumes scaled by the (tp-1)/tp ring
+    fraction. Coarse on purpose: it ranks tp against dp's 8n gradient
+    exchange, it does not model overlap."""
+    if model_spec is None or sharding_plan.fsdp <= 1:
+        return None
+    n = model_spec.n_params
+    tp = sharding_plan.fsdp
+    return int(3 * 4 * n * (tp - 1) / tp)
+
+
 def estimate_comm_bytes(
     model_spec: ModelSpec, sharding_plan: ShardingPlan
 ) -> Dict[str, Optional[int]]:
@@ -688,11 +775,13 @@ def estimate_comm_bytes(
             sharding_plan.data > 1 else 0
     sp = _sp_bytes(sharding_plan, model_spec) or 0
     pp = _pp_bytes(sharding_plan, model_spec) or 0
-    total = (dp_bytes or 0) + sp + pp
+    tp = _tp_bytes(sharding_plan, model_spec) or 0
+    total = (dp_bytes or 0) + sp + pp + tp
     return {
         "data": dp_bytes or 0,
         "sequence": sp,
         "pipe": pp,
+        "fsdp": tp,
         "total": total,
     }
 
@@ -722,9 +811,12 @@ def plan(
     memory_budget: Optional[int] = None,
     constraints: Optional[Constraints] = None,
 ) -> PlanResult:
-    """Enumerates DP x SP x PP factorizations of the device count, scores
-    them (memory fit first, then estimated comm bytes), and returns the
-    winner plus the ranked table.
+    """Enumerates DP x SP x PP x TP factorizations of the device count,
+    scores them (memory fit first, then estimated comm bytes), and
+    returns the winner plus the ranked table. This is the ANALYTIC tier;
+    `measured_rerank` re-ranks a shortlist on compiled/measured cost and
+    `resolve_plan_from_flag` wires both behind T2R_PLAN=auto with the
+    persistent plan cache (parallel/plan_cache.py) in front.
 
     memory_budget: per-device bytes; None falls back to
     topology.memory_bytes, then the T2R_PLAN_MEM_BUDGET flag (MB; 0 =
@@ -751,104 +843,156 @@ def plan(
     )
     pinned = dict(constraints.pinned or {})
 
+    tp_shardable = [
+        leaf.shape
+        for leaf in jax.tree_util.tree_leaves(model_spec.param_shapes)
+        if hasattr(leaf, "shape")
+    ]
+
     entries: List[Dict[str, Any]] = []
     candidates: List[Tuple[Tuple[int, int], ShardingPlan, Dict[str, Any]]] = []
-    for sp in _divisors(n):
-        for pp in _divisors(n // sp):
-            dp = n // (sp * pp)
-            axes = {DATA_AXIS: dp, SEQUENCE_AXIS: sp, PIPE_AXIS: pp}
-            if any(axes.get(a, 1) != s for a, s in pinned.items()):
-                continue
-            reasons: List[str] = []
-            if sp > 1:
-                if not constraints.allow_sp:
-                    reasons.append("sequence parallelism disallowed")
-                elif model_spec.seq_len is None:
-                    reasons.append("model declares no sequence dimension")
-                elif model_spec.seq_len % sp:
-                    reasons.append(
-                        f"seq_len {model_spec.seq_len} % sp {sp} != 0"
+    for tp in _divisors(n):
+        for sp in _divisors(n // tp):
+            for pp in _divisors(n // (tp * sp)):
+                dp = n // (tp * sp * pp)
+                axes = {
+                    DATA_AXIS: dp,
+                    FSDP_AXIS: tp,
+                    SEQUENCE_AXIS: sp,
+                    PIPE_AXIS: pp,
+                }
+                if any(axes.get(a, 1) != s for a, s in pinned.items()):
+                    continue
+                reasons: List[str] = []
+                if sp > 1:
+                    if not constraints.allow_sp:
+                        reasons.append("sequence parallelism disallowed")
+                    elif model_spec.seq_len is None:
+                        reasons.append(
+                            "model declares no sequence dimension"
+                        )
+                    elif model_spec.seq_len % sp:
+                        reasons.append(
+                            f"seq_len {model_spec.seq_len} % sp {sp} != 0"
+                        )
+                    elif (
+                        constraints.sequence_parallel_mode == "ulysses"
+                        and (model_spec.num_heads or 0) % sp
+                    ):
+                        reasons.append(
+                            f"heads {model_spec.num_heads} % sp {sp} != 0"
+                        )
+                if pp > 1:
+                    if not constraints.allow_pp:
+                        reasons.append("pipeline parallelism disallowed")
+                    elif not model_spec.pipeline_capable:
+                        reasons.append("model is not pipeline-capable")
+                    elif (model_spec.num_layers or 0) % pp:
+                        reasons.append(
+                            f"num_layers {model_spec.num_layers} % pp "
+                            f"{pp} != 0"
+                        )
+                if tp > 1:
+                    # The fsdp (tensor-parallel) axis: params shard via
+                    # mesh.param_sharding. Probe the spec's leaves with
+                    # the same rule the trainer will place — a model
+                    # whose every leaf stays replicated under tp gains
+                    # nothing and the point is rejected with the reason.
+                    probe = dataclasses.replace(
+                        ShardingPlan(name="_probe", fsdp=tp),
+                        param_min_shard_size=(
+                            constraints.param_min_shard_size
+                        ),
                     )
-                elif (
-                    constraints.sequence_parallel_mode == "ulysses"
-                    and (model_spec.num_heads or 0) % sp
+                    if not constraints.allow_tp:
+                        reasons.append("tensor parallelism disallowed")
+                    elif pp > 1:
+                        reasons.append(
+                            "tp x pp does not compose (stacked pipeline "
+                            "stage params under param_sharding is "
+                            "unvalidated)"
+                        )
+                    elif not any(
+                        _param_shard_factor(shape, probe) > 1
+                        for shape in tp_shardable
+                    ):
+                        reasons.append(
+                            f"no param leaf >= "
+                            f"{constraints.param_min_shard_size} elements "
+                            f"with a dim divisible by tp {tp}"
+                        )
+                batch_shards = dp * tp
+                if (
+                    batch_shards > 1
+                    and model_spec.batch_size is not None
+                    and model_spec.batch_size % batch_shards
                 ):
                     reasons.append(
-                        f"heads {model_spec.num_heads} % sp {sp} != 0"
+                        f"batch {model_spec.batch_size} % (dp {dp} x tp "
+                        f"{tp}) != 0"
+                        if tp > 1
+                        else f"batch {model_spec.batch_size} % dp {dp} != 0"
                     )
-            if pp > 1:
-                if not constraints.allow_pp:
-                    reasons.append("pipeline parallelism disallowed")
-                elif not model_spec.pipeline_capable:
-                    reasons.append("model is not pipeline-capable")
-                elif (model_spec.num_layers or 0) % pp:
+                wu_axes = tuple(
+                    axis
+                    for axis, size in ((DATA_AXIS, dp), (SEQUENCE_AXIS, sp))
+                    if size > 1
+                ) or (DATA_AXIS,)
+                pure_dp = sp == 1 and pp == 1 and tp == 1
+                name = f"dp{dp}_sp{sp}_pp{pp}"
+                if tp > 1:
+                    name += f"_tp{tp}"
+                candidate = ShardingPlan(
+                    name=name,
+                    data=dp,
+                    fsdp=tp,
+                    sequence=sp,
+                    pipe=pp,
+                    shard_weight_update=constraints.shard_weight_update,
+                    weight_update_axes=wu_axes,
+                    collective_quant=(
+                        quant
+                        if (
+                            quant != "none"
+                            and pure_dp
+                            and dp > 1
+                            and constraints.shard_weight_update
+                        )
+                        else "none"
+                    ),
+                    collective_block=block,
+                    param_min_shard_size=constraints.param_min_shard_size,
+                    sequence_parallel_mode=(
+                        constraints.sequence_parallel_mode
+                    ),
+                )
+                memory = estimate_memory(
+                    model_spec, candidate,
+                    activation_multiplier=constraints.activation_multiplier,
+                )
+                comm = estimate_comm_bytes(model_spec, candidate)
+                if budget is not None and memory["total"] > budget:
                     reasons.append(
-                        f"num_layers {model_spec.num_layers} % pp {pp} != 0"
+                        f"memory estimate {memory['total']} B/device "
+                        f"exceeds budget {budget} B"
                     )
-                elif sp > 1 and constraints.sequence_parallel_mode != "ring":
-                    reasons.append("sp x pp composes in ring mode only")
-            if (
-                dp > 1
-                and model_spec.batch_size is not None
-                and model_spec.batch_size % dp
-            ):
-                reasons.append(
-                    f"batch {model_spec.batch_size} % dp {dp} != 0"
+                candidate = dataclasses.replace(
+                    candidate,
+                    memory_bytes=memory["total"],
+                    comm_bytes=comm["total"],
                 )
-            wu_axes = tuple(
-                axis
-                for axis, size in ((DATA_AXIS, dp), (SEQUENCE_AXIS, sp))
-                if size > 1
-            ) or (DATA_AXIS,)
-            pure_dp = sp == 1 and pp == 1
-            candidate = ShardingPlan(
-                name=f"dp{dp}_sp{sp}_pp{pp}",
-                data=dp,
-                sequence=sp,
-                pipe=pp,
-                shard_weight_update=constraints.shard_weight_update,
-                weight_update_axes=wu_axes,
-                collective_quant=(
-                    quant
-                    if (
-                        quant != "none"
-                        and pure_dp
-                        and dp > 1
-                        and constraints.shard_weight_update
+                entry = {
+                    "plan": candidate.to_json(),
+                    "memory": memory,
+                    "comm": comm,
+                    "feasible": not reasons,
+                    "reasons": reasons,
+                }
+                entries.append(entry)
+                if not reasons:
+                    candidates.append(
+                        ((comm["total"], memory["total"]), candidate, entry)
                     )
-                    else "none"
-                ),
-                collective_block=block,
-                param_min_shard_size=constraints.param_min_shard_size,
-                sequence_parallel_mode=constraints.sequence_parallel_mode,
-            )
-            memory = estimate_memory(
-                model_spec, candidate,
-                activation_multiplier=constraints.activation_multiplier,
-            )
-            comm = estimate_comm_bytes(model_spec, candidate)
-            if budget is not None and memory["total"] > budget:
-                reasons.append(
-                    f"memory estimate {memory['total']} B/device exceeds "
-                    f"budget {budget} B"
-                )
-            candidate = dataclasses.replace(
-                candidate,
-                memory_bytes=memory["total"],
-                comm_bytes=comm["total"],
-            )
-            entry = {
-                "plan": candidate.to_json(),
-                "memory": memory,
-                "comm": comm,
-                "feasible": not reasons,
-                "reasons": reasons,
-            }
-            entries.append(entry)
-            if not reasons:
-                candidates.append(
-                    ((comm["total"], memory["total"]), candidate, entry)
-                )
 
     entries.sort(
         key=lambda e: (
@@ -867,8 +1011,8 @@ def plan(
             else ""
         )
         raise PlanError(
-            f"no feasible DP x SP x PP factorization of {n} devices under "
-            f"the given constraints/memory budget{detail}"
+            f"no feasible DP x SP x PP x TP factorization of {n} devices "
+            f"under the given constraints/memory budget{detail}"
         )
     candidates.sort(key=lambda item: item[0])
     return PlanResult(best=candidates[0][1], table=tuple(entries))
@@ -944,13 +1088,179 @@ def resolve_preset(
     return ShardingPlan(name=name, **spec)
 
 
+def parse_measure_setting(setting: str) -> Optional[int]:
+    """T2R_PLAN_MEASURE: 'off' -> None (analytic ranking only);
+    'shortlist-N' -> N, the number of top analytic candidates the
+    measured tier compiles and times. Anything else is a loud error —
+    a typo must not silently fall back to the cheap tier."""
+    setting = (setting or "off").strip()
+    if setting == "off":
+        return None
+    if setting.startswith("shortlist-"):
+        try:
+            n = int(setting[len("shortlist-"):])
+        except ValueError:
+            n = 0
+        if n >= 1:
+            return n
+    raise ValueError(
+        f"T2R_PLAN_MEASURE={setting!r}: expected 'off' or 'shortlist-N' "
+        "with N >= 1 (e.g. shortlist-4)"
+    )
+
+
+#: Stats of the most recent resolve_plan_from_flag search — the audit
+#: surface bench/tests read to prove a warm cache run compiled nothing.
+_LAST_SEARCH: Dict[str, Any] = {}
+
+
+def last_search() -> Dict[str, Any]:
+    """A copy of the most recent auto-search's stats: {'source':
+    'cache'|'analytic'|'measured', 'probe_compiles': int, 'fingerprint',
+    'plan', 'measured': [...]} (empty before any auto run)."""
+    return dict(_LAST_SEARCH)
+
+
+def measured_rerank(
+    model,
+    example_batch,
+    result: PlanResult,
+    *,
+    shortlist: int,
+    steps: int = 3,
+    memory_budget: Optional[int] = None,
+) -> Tuple[PlanResult, Dict[str, Any]]:
+    """Tier 1 -> tier 2: compiles the top `shortlist` feasible analytic
+    candidates' train steps (train_eval.measure_plan_candidate — compile
+    cache bypassed, donated buffers, post-warmup median of `steps` real
+    steps) and re-ranks on measured step time, with measured memory fit
+    as a hard gate. Each probed table entry gains a 'measured' record
+    including the analytic-vs-measured memory error (the pruning-quality
+    audit). Plans the given model cannot run (pipe/sequence mismatch)
+    are skipped with the reason recorded; when nothing measures, the
+    analytic winner stands."""
+    from tensor2robot_tpu.train import train_eval as train_eval_lib
+
+    probed: List[Tuple[float, ShardingPlan, Dict[str, Any]]] = []
+    shortlisted = [e for e in result.table if e["feasible"]][:shortlist]
+    for rank, entry in enumerate(shortlisted):
+        candidate = ShardingPlan.from_json(entry["plan"])
+        probe = train_eval_lib.measure_plan_candidate(
+            model, candidate, example_batch, steps=steps
+        )
+        probe["analytic_rank"] = rank
+        measured_total = probe.get("memory_per_device_bytes")
+        if measured_total:
+            analytic_total = entry["memory"]["total"]
+            probe["analytic_memory_error"] = {
+                "analytic_total": analytic_total,
+                "measured_total": measured_total,
+                "ratio": analytic_total / measured_total,
+            }
+        if (
+            memory_budget is not None
+            and measured_total
+            and measured_total > memory_budget
+        ):
+            probe["memory_fit"] = False
+        else:
+            probe["memory_fit"] = probe.get("step_time_ms") is not None
+        entry["measured"] = probe
+        if probe["memory_fit"] and probe.get("step_time_ms") is not None:
+            probed.append((probe["step_time_ms"], candidate, entry))
+    stats: Dict[str, Any] = {
+        "shortlist": len(shortlisted),
+        "measured": [
+            {
+                "name": entry["plan"]["name"],
+                "step_time_ms": entry["measured"].get("step_time_ms"),
+                "skipped": entry["measured"].get("skipped"),
+                "analytic_rank": entry["measured"]["analytic_rank"],
+            }
+            for entry in shortlisted
+        ],
+    }
+    if not probed:
+        return result, stats
+    probed.sort(key=lambda item: item[0])
+    best = probed[0][1]
+    for measured_rank, (_, _, entry) in enumerate(probed):
+        entry["measured"]["measured_rank"] = measured_rank
+    stats["winner"] = best.name
+    return PlanResult(best=best, table=result.table), stats
+
+
+def _auto_search(model, example_batch) -> ShardingPlan:
+    """The three-tier T2R_PLAN=auto pipeline: persistent cache ->
+    analytic enumeration -> optional measured re-rank, with the winner
+    (and its table) written back to the cache so the NEXT run on this
+    (model, topology, jax, schema) key performs zero search compiles."""
+    from tensor2robot_tpu.parallel import plan_cache
+
+    global _LAST_SEARCH
+    model_spec = ModelSpec.from_model(model, example_batch)
+    directory = plan_cache.cache_dir()
+    stats: Dict[str, Any] = {
+        "setting": "auto",
+        "cache_dir": directory,
+        "probe_compiles": 0,
+        "fingerprint": None,
+    }
+    fingerprint = None
+    if directory:
+        fingerprint = plan_cache.model_fingerprint(model_spec)
+        stats["fingerprint"] = fingerprint
+        payload = plan_cache.load(fingerprint, directory)
+        if payload is not None:
+            best = ShardingPlan.from_json(payload["plan"])
+            stats.update(source="cache", plan=best.name)
+            _LAST_SEARCH = stats
+            return best
+    from tensor2robot_tpu.train import train_eval as train_eval_lib
+
+    compiles_before = train_eval_lib.plan_probe_compile_count()
+    result = plan(model_spec, Topology.detect())
+    stats.update(source="analytic", plan=result.best.name)
+    shortlist = parse_measure_setting(flags.get_str("T2R_PLAN_MEASURE"))
+    if shortlist:
+        steps = flags.get_int("T2R_PLAN_MEASURE_STEPS")
+        budget_mb = flags.get_int("T2R_PLAN_MEM_BUDGET")
+        result, measured_stats = measured_rerank(
+            model,
+            example_batch,
+            result,
+            shortlist=shortlist,
+            steps=steps,
+            memory_budget=budget_mb << 20 if budget_mb > 0 else None,
+        )
+        stats.update(
+            source="measured",
+            plan=result.best.name,
+            measured=measured_stats,
+        )
+    stats["probe_compiles"] = (
+        train_eval_lib.plan_probe_compile_count() - compiles_before
+    )
+    if directory and fingerprint:
+        plan_cache.store(
+            fingerprint,
+            {"plan": result.best.to_json(), "table": list(result.table)},
+            directory,
+        )
+        stats["stored"] = True
+    _LAST_SEARCH = stats
+    return result.best
+
+
 def resolve_plan_from_flag(
     model=None, example_batch=None
 ) -> Optional[ShardingPlan]:
     """The T2R_PLAN gate: 'off' (default) -> None (the hand-wired path,
-    byte-for-byte); a preset name -> that plan; 'auto' -> run the search
-    against the live device topology (requires model + example_batch for
-    the ModelSpec)."""
+    byte-for-byte); a preset name -> that plan; 'auto' -> the three-tier
+    search against the live device topology (requires model +
+    example_batch for the ModelSpec): plan-cache hit -> analytic
+    enumeration -> T2R_PLAN_MEASURE compiled/timed re-rank, winner
+    persisted under T2R_PLAN_CACHE_DIR."""
     setting = flags.get_str("T2R_PLAN") or "off"
     if setting == "off":
         return None
@@ -960,9 +1270,7 @@ def resolve_plan_from_flag(
                 "T2R_PLAN=auto needs a model and an example batch to "
                 "build the ModelSpec the search scores against"
             )
-        return plan(
-            ModelSpec.from_model(model, example_batch), Topology.detect()
-        ).best
+        return _auto_search(model, example_batch)
     return resolve_preset(setting)
 
 

@@ -86,6 +86,52 @@ def _ulysses_shard_fn(
     return gather_heads(out)
 
 
+def ulysses_attention_manual(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = SEQUENCE_AXIS,
+    axis_size: int,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    window: Optional[int] = None,
+) -> jax.Array:
+    """Ulysses attention INSIDE an enclosing shard_map (manual mode).
+
+    `ulysses_attention` below builds its own shard_map; a caller already
+    running under one — the pipelined encoder's per-device program, where
+    the pipe axis owns the outer shard_map and the sequence axis is also
+    manual — cannot nest another. This entry point runs the same
+    per-device head-scatter body directly on the LOCAL shards: q/k/v are
+    [batch_local, seq/axis_size, heads, dim], the two all_to_all rounds
+    ride collectives.all_to_all over `axis_name`, and local attention is
+    the exact reference contraction over the full gathered sequence.
+    The ring twin is ring_attention.ring_attention_manual — together
+    they make BOTH context-parallel strategies composable with pipeline
+    parallelism (parallel/planner.py's widened factorization space); the
+    XLA einsum tile is used locally (the flash-kernel path stays on the
+    shard_map-owning entry points).
+    """
+    if q.ndim != 4:
+        raise ValueError(f"Expected [B, S_local, H, D], got {q.shape}")
+    from tensor2robot_tpu.ops.flash_attention import _check_window
+
+    _check_window(window, causal)
+    heads = q.shape[2]
+    if heads % axis_size != 0:
+        raise ValueError(
+            f"Ulysses all-to-all needs heads ({heads}) divisible by the "
+            f"{axis_name!r} axis size ({axis_size}); use "
+            "ring_attention_manual for head counts that do not split."
+        )
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    return _ulysses_shard_fn(
+        q, k, v, axis_name=axis_name, causal=causal, scale=scale,
+        use_flash=False, interpret=False, window=window,
+    )
+
+
 def ulysses_attention(
     q: jax.Array,
     k: jax.Array,

@@ -19,6 +19,7 @@ utils/train_eval.py:423-612 (TPUEstimator + train_and_evaluate):
 
 from __future__ import annotations
 
+import contextlib
 import itertools
 import os
 import threading
@@ -260,6 +261,148 @@ def _validate_model_matches_plan(model, plan) -> None:
                 "(plan.build_mesh()) so attention actually runs "
                 "sequence-parallel"
             )
+
+
+# -- the measured plan-search probe (planner.measured_rerank's tier 2) --------
+
+#: Monotonic count of train-step compiles paid by measure_plan_candidate.
+#: The planner's zero-compile warm-cache contract is audited against this
+#: counter (planner.last_search()['probe_compiles'], bench.py plan).
+_PLAN_PROBE_COMPILES = 0
+
+
+def plan_probe_compile_count() -> int:
+    return _PLAN_PROBE_COMPILES
+
+
+def _reset_compile_cache_state() -> None:
+    # jax memoizes the persistent compilation cache's enabled state at
+    # the first compile; reset_cache() drops the memo so the config
+    # flip below actually takes (serving/compile_cache.py documents the
+    # latch).
+    try:
+        from jax._src import compilation_cache as _compilation_cache
+    except ImportError:  # pragma: no cover - future jax relayout
+        return
+    reset = getattr(_compilation_cache, "reset_cache", None)
+    if reset is not None:
+        reset()
+
+
+@contextlib.contextmanager
+def _plan_probe_compile_cache_bypass():
+    """Disables jax's persistent compilation cache around a plan-search
+    compile (the export/aot.py build-side discipline): a cache HIT hands
+    back an executable with no fresh object code and near-zero compile
+    time, which poisons both the timing and the compile counter the
+    search ranks and audits with. Restores the prior config — and resets
+    the latched cache state again — on the way out."""
+    prev_enabled = bool(jax.config.jax_enable_compilation_cache)
+    prev_dir = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_enable_compilation_cache", False)
+    if prev_dir:
+        jax.config.update("jax_compilation_cache_dir", None)
+    _reset_compile_cache_state()
+    try:
+        yield
+    finally:
+        jax.config.update("jax_enable_compilation_cache", prev_enabled)
+        if prev_dir:
+            jax.config.update("jax_compilation_cache_dir", prev_dir)
+        _reset_compile_cache_state()
+
+
+def _executable_memory(executable):
+    """compiled.memory_analysis() -> (total per-device bytes, fields).
+
+    The TRUE HBM accounting the analytic estimate is audited against.
+    Backends without the analysis (CPU builds, older runtimes) return
+    (None, None) — the caller records the analytic estimate unaudited
+    rather than failing the probe."""
+    try:
+        analysis = executable.memory_analysis()
+    except Exception as err:  # noqa: BLE001 - backend-optional surface
+        return None, {"unavailable": f"{type(err).__name__}: {err}"}
+    if analysis is None:
+        return None, {"unavailable": "memory_analysis() returned None"}
+    fields = {}
+    for key in (
+        "argument_size_in_bytes",
+        "output_size_in_bytes",
+        "temp_size_in_bytes",
+        "alias_size_in_bytes",
+        "generated_code_size_in_bytes",
+    ):
+        value = getattr(analysis, key, None)
+        if isinstance(value, (int, float)):
+            fields[key] = int(value)
+    total = (
+        fields.get("argument_size_in_bytes", 0)
+        + fields.get("output_size_in_bytes", 0)
+        + fields.get("temp_size_in_bytes", 0)
+        - fields.get("alias_size_in_bytes", 0)
+    )
+    return (total if total > 0 else None), (fields or None)
+
+
+def measure_plan_candidate(
+    model,
+    plan: planner_lib.ShardingPlan,
+    example_batch,
+    *,
+    steps: int = 3,
+    warmup: int = 1,
+) -> Dict[str, Any]:
+    """Compile-and-measure probe for ONE shortlisted plan: builds the
+    plan's mesh and CompiledModel (donated state — the real train-step
+    economics), compiles the train step with the persistent compile
+    cache bypassed, reads compiled.memory_analysis(), and times `steps`
+    real steps after `warmup` (median). Returns a record for the ranked
+    table; a plan the model cannot run (pipe/sequence mismatch) or a
+    probe failure comes back as {'skipped': reason} — the search skips
+    it loudly, it never kills the run."""
+    global _PLAN_PROBE_COMPILES
+    record: Dict[str, Any] = {"name": plan.name}
+    try:
+        _validate_model_matches_plan(model, plan)
+    except ValueError as err:
+        record["skipped"] = str(err)
+        return record
+    with _plan_probe_compile_cache_bypass():
+        try:
+            mesh = plan.build_mesh()
+            compiled = CompiledModel(
+                model, mesh=mesh, donate_state=True, plan=plan
+            )
+            state = compiled.init_state(jax.random.PRNGKey(0), example_batch)
+            rng = jax.random.PRNGKey(1)
+            start = time.perf_counter()
+            executable = compiled.train_step.lower(
+                state, example_batch, rng
+            ).compile()
+            _PLAN_PROBE_COMPILES += 1
+            record["compile_ms"] = (time.perf_counter() - start) * 1e3
+        except Exception as err:  # noqa: BLE001 - recorded, search goes on
+            record["skipped"] = f"{type(err).__name__}: {err}"
+            return record
+        memory_total, memory_fields = _executable_memory(executable)
+        record["memory_per_device_bytes"] = memory_total
+        record["memory_analysis"] = memory_fields
+        times_ms: List[float] = []
+        try:
+            for i in range(warmup + max(steps, 1)):
+                start = time.perf_counter()
+                state, _ = executable(state, example_batch, rng)
+                jax.block_until_ready(state)
+                if i >= warmup:
+                    times_ms.append((time.perf_counter() - start) * 1e3)
+        except Exception as err:  # noqa: BLE001 - recorded, search goes on
+            record["skipped"] = f"{type(err).__name__}: {err}"
+            return record
+    times_ms.sort()
+    record["step_time_ms"] = times_ms[len(times_ms) // 2]
+    record["steps_timed"] = len(times_ms)
+    return record
 
 
 class CompiledModel:

@@ -591,6 +591,9 @@ def test_bench_fleet_contract(tmp_path):
         assert json_mod.load(f)["metric"] == payload["metric"]
 
 
+# ~13s on 1 cpu: slow slice with the other bench leg contracts;
+# BENCH_GATE_r14.json is the committed audit of the same surface.
+@pytest.mark.slow
 def test_bench_gateway_contract(tmp_path):
     """The multi-tenant front-door leg at toy scale: one JSON line + the
     --out artifact, per-tenant accounting with ZERO lost requests on
@@ -690,6 +693,25 @@ def test_bench_plan_contract(tmp_path):
         e["plan"]["name"] == "dp2_sp2_pp2" and e["feasible"]
         for e in table
     )
+    # Round 19: the widened points pass their parity twins and rank in
+    # the widened table.
+    widened = payload["detail"]["widened"]
+    assert widened["tp"]["loss_parity_max_abs_diff"] < 1e-3
+    assert widened["ulysses_in_pipe"]["loss_parity_max_abs_diff"] < 1e-3
+    widened_table = widened["ranked_plan_table"]["table"]
+    feasible = {
+        e["plan"]["name"] for e in widened_table if e["feasible"]
+    }
+    assert {"dp4_sp1_pp1_tp2", "dp1_sp4_pp2"} <= feasible
+    # Round 19: the measured search stores its winner; the warm run
+    # replays it byte-for-byte with zero search compiles.
+    measured = payload["detail"]["measured_search"]
+    assert measured["cold_stats"]["source"] == "measured"
+    assert measured["cold_stats"]["probe_compiles"] >= 1
+    assert measured["warm_stats"]["source"] == "cache"
+    assert measured["warm_stats"]["probe_compiles"] == 0
+    assert measured["winner_step_time_ms"] > 0
+    assert 0.0 <= measured["analytic_vs_measured_rank_agreement"] <= 1.0
     with open(out) as f:
         assert json.load(f)["metric"] == payload["metric"]
 

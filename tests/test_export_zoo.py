@@ -119,7 +119,15 @@ def _trained_export_parts(name):
     return compiled, generator, state.export_variables()
 
 
-@pytest.mark.parametrize("name", sorted(MODEL_FACTORIES))
+# grasp2vec is the costliest zoo entry (~19s of conv-tower compiles on
+# 1 cpu): slow slice; the other zoo exports keep the hard guarantee fast.
+@pytest.mark.parametrize(
+    "name",
+    [
+        pytest.param(n, marks=pytest.mark.slow) if n == "grasp2vec" else n
+        for n in sorted(MODEL_FACTORIES)
+    ],
+)
 def test_zoo_stablehlo_export_is_hard_guarantee(name, tmp_path):
     compiled, generator, variables = _trained_export_parts(name)
     serving_fn = generator.create_serving_fn(compiled, variables)

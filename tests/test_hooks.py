@@ -237,6 +237,8 @@ class TestProfilingHooks:
         with open(jsonl) as f:
             assert len(f.read().strip().splitlines()) == len(rows)
 
+    # ~5s (profiler capture) on 1 cpu: slow slice — tooling smoke.
+    @pytest.mark.slow
     def test_profiler_hook_writes_trace(self, tmp_path):
         from tensor2robot_tpu.hooks import ProfilerHookBuilder
         from tensor2robot_tpu.train import train_eval

@@ -84,7 +84,12 @@ class TestVisionLayers:
 
 
 class TestResNet:
-    @pytest.mark.parametrize("size,version", [(18, 1), (18, 2), (50, 2)])
+    # The resnet-50 tower costs ~7s of conv compiles on 1 cpu: slow
+    # slice; both v1/v2 paths stay fast at depth 18.
+    @pytest.mark.parametrize(
+        "size,version",
+        [(18, 1), (18, 2), pytest.param(50, 2, marks=pytest.mark.slow)],
+    )
     def test_shapes_and_endpoints(self, size, version):
         model = layers.ResNet(num_classes=10, resnet_size=size, version=version)
         images = jnp.zeros((2, 64, 64, 3))

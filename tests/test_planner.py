@@ -574,3 +574,241 @@ class TestMemoryEstimate:
         mem = planner.estimate_memory(spec, quant)
         # Per-device flat shard: ~2 moments + residuals on n/8 elements.
         assert mem["opt_state"] < 8 * 4 * spec.n_params
+
+    def test_tp_estimate_shards_params_and_mirrors(self):
+        """sharded_params plans divide the param/opt footprint by the
+        factor param_sharding actually achieves on each leaf — the
+        spec-level twin of the placed rule."""
+        spec = _big_synthetic_spec()
+        dp = planner.resolve_preset("dp")
+        tp = dataclasses.replace(
+            planner.ShardingPlan(name="dp4_tp2", data=4, fsdp=2),
+            param_min_shard_size=0,
+        )
+        mem_dp = planner.estimate_memory(spec, dp)
+        mem_tp = planner.estimate_memory(spec, tp)
+        assert mem_tp["params"] == mem_dp["params"] // 2
+        assert mem_tp["opt_state"] == mem_dp["opt_state"] // 2
+
+
+class TestWidenedFactorization:
+    """The PR's search-space widening: the fsdp (tensor-parallel) axis
+    joins the enumeration, and ulysses attention composes inside the
+    pipeline shard_map (the old 'ring mode only' rejection is gone)."""
+
+    def test_tp_points_enumerated_and_attributed(self):
+        result = planner.plan(
+            _big_synthetic_spec(), planner.Topology(num_devices=N)
+        )
+        names = {e["plan"]["name"]: e for e in result.table}
+        entry = names["dp4_sp1_pp1_tp2"]
+        assert entry["feasible"], entry["reasons"]
+        assert entry["plan"]["fsdp"] == 2
+        assert entry["plan"]["regime"] == "sharded_params"
+        # The fsdp axis is attributed in the comm estimate and the
+        # collective schedule.
+        assert entry["comm"]["fsdp"] > 0
+        plan = planner.ShardingPlan.from_json(entry["plan"])
+        schedule = plan.collective_schedule(_big_synthetic_spec())
+        sites = {e["site"] for e in schedule}
+        assert "fsdp_param_gather" in sites
+        # TP pays strictly more wire than pure DP on every composition
+        # reachable here: the pure-DP winner is unchanged.
+        assert result.best.name == "dp8_sp1_pp1"
+
+    def test_tp_rejected_when_no_leaf_shards(self):
+        """The mock's tiny leaves fall below param_min_shard_size: every
+        tp point is infeasible with the reason recorded, not silently
+        scored as if params sharded."""
+        result = planner.plan(
+            _mock_model_spec(), planner.Topology(num_devices=N)
+        )
+        tp_entries = [e for e in result.table if e["plan"]["fsdp"] > 1]
+        assert tp_entries
+        assert all(not e["feasible"] for e in tp_entries)
+        # Where tp is the only composition question (pp=1), the recorded
+        # reason is the leaf probe; tp x pp points lead with the
+        # composition rejection instead.
+        solo_tp = [e for e in tp_entries if e["plan"]["pipe"] == 1]
+        assert solo_tp
+        for entry in solo_tp:
+            assert any("no param leaf" in r for r in entry["reasons"]), (
+                entry["reasons"]
+            )
+
+    def test_tp_disallowed_by_constraint(self):
+        result = planner.plan(
+            _big_synthetic_spec(),
+            planner.Topology(num_devices=N),
+            constraints=planner.Constraints(allow_tp=False),
+        )
+        for entry in result.table:
+            if entry["plan"]["fsdp"] > 1:
+                assert "tensor parallelism disallowed" in entry["reasons"]
+
+    def test_tp_pp_composition_rejected_with_reason(self):
+        result = planner.plan(
+            _transformer_model_spec(), planner.Topology(num_devices=N)
+        )
+        combos = [
+            e for e in result.table
+            if e["plan"]["fsdp"] > 1 and e["plan"]["pipe"] > 1
+        ]
+        assert combos
+        for entry in combos:
+            assert any("tp x pp" in r for r in entry["reasons"])
+
+    def test_ulysses_composes_with_pipeline(self):
+        """dp1_sp4_pp2 under ulysses is now a feasible point — PR 13's
+        'sp x pp composes in ring mode only' rejection is retired — while
+        the heads-divisibility gate still holds."""
+        result = planner.plan(
+            _transformer_model_spec(),
+            planner.Topology(num_devices=N),
+            constraints=planner.Constraints(
+                sequence_parallel_mode="ulysses"
+            ),
+        )
+        names = {e["plan"]["name"]: e for e in result.table}
+        entry = names["dp1_sp4_pp2"]
+        assert entry["feasible"], entry["reasons"]
+        assert entry["plan"]["sequence_parallel_mode"] == "ulysses"
+        # heads=4 cannot split 8 ways: the gate is intact.
+        sp8 = names["dp1_sp8_pp1"]
+        assert not sp8["feasible"]
+        assert any("heads" in r for r in sp8["reasons"])
+
+    def test_plan_json_roundtrip_every_table_entry(self):
+        result = planner.plan(
+            _big_synthetic_spec(), planner.Topology(num_devices=N)
+        )
+        for entry in result.table:
+            plan = planner.ShardingPlan.from_json(entry["plan"])
+            assert plan.to_json() == entry["plan"]
+
+    def test_plan_json_unknown_field_is_loud(self):
+        result = planner.plan(
+            _big_synthetic_spec(), planner.Topology(num_devices=N)
+        )
+        doc = dict(result.best.to_json())
+        doc["warp_factor"] = 9
+        with pytest.raises(ValueError, match="warp_factor"):
+            planner.ShardingPlan.from_json(doc)
+
+
+class TestMeasuredRerank:
+    """Tier 2: the compile-and-measure re-rank over the analytic
+    shortlist (the mock's single feasible point keeps this cheap)."""
+
+    def test_rerank_measures_and_records(self):
+        model = MockT2RModel(device_type="cpu", use_batch_norm=False)
+        generator = MockInputGenerator(batch_size=16, seed=0)
+        generator.set_specification_from_model(model, "train")
+        batch = next(iter(generator.create_dataset("train")))
+        spec = planner.ModelSpec.from_model(model, batch)
+        result = planner.plan(spec, planner.Topology(num_devices=N))
+        before = train_eval.plan_probe_compile_count()
+        reranked, stats = planner.measured_rerank(
+            model, batch, result, shortlist=2, steps=1
+        )
+        paid = train_eval.plan_probe_compile_count() - before
+        assert paid == stats["shortlist"] >= 1
+        assert stats["winner"] == reranked.best.name
+        probed = [
+            e for e in reranked.table if e.get("measured") is not None
+        ]
+        assert len(probed) == stats["shortlist"]
+        for entry in probed:
+            measured = entry["measured"]
+            assert measured["step_time_ms"] > 0
+            assert measured["steps_timed"] >= 1
+            assert measured["analytic_rank"] >= 0
+            assert measured["memory_fit"]
+            # The analytic-vs-measured memory audit rides the entry
+            # whenever the backend exposes memory_analysis().
+            if measured.get("memory_per_device_bytes"):
+                err = measured["analytic_memory_error"]
+                assert err["ratio"] > 0
+
+    def test_rerank_survives_nothing_measuring(self):
+        """When every shortlisted plan skips (a model that cannot run
+        any of them), the analytic winner stands."""
+        model = MockT2RModel(device_type="cpu", use_batch_norm=False)
+        generator = MockInputGenerator(batch_size=16, seed=0)
+        generator.set_specification_from_model(model, "train")
+        batch = next(iter(generator.create_dataset("train")))
+        spec = planner.ModelSpec.from_model(model, batch)
+        result = planner.plan(spec, planner.Topology(num_devices=N))
+        # A memory budget of one byte fails every measured fit.
+        reranked, stats = planner.measured_rerank(
+            model, batch, result, shortlist=1, steps=1, memory_budget=1
+        )
+        measured = [
+            e for e in reranked.table if e.get("measured") is not None
+        ]
+        assert measured
+        if measured[0]["measured"].get("memory_per_device_bytes"):
+            # Budget gate engaged: the analytic winner stands.
+            assert not measured[0]["measured"]["memory_fit"]
+            assert "winner" not in stats
+            assert reranked.best.name == result.best.name
+
+
+class TestWidenedParity:
+    """Loss-parity twins for the two previously-unreachable plan points
+    (the PR's twin discipline): each is a layout change, not a math
+    change. The twin shares the exact parameter structure — the
+    pipelined model inits per-stage from split rngs, so a non-pipelined
+    'twin' would start from different weights."""
+
+    def _run_losses(self, plan, model_kwargs=None, steps=3):
+        mesh = plan.build_mesh()
+        model = _transformer(mesh, **(model_kwargs or {}))
+        compiled = train_eval.CompiledModel(
+            model, donate_state=False, plan=plan
+        )
+        batch = _transformer_batch(model)
+        state = compiled.init_state(jax.random.PRNGKey(0), batch)
+        losses = []
+        rng = jax.random.PRNGKey(7)
+        for _ in range(steps):
+            state, metrics = compiled.train_step(
+                state, compiled.shard_batch(batch), rng
+            )
+            losses.append(float(jax.device_get(metrics["loss"])))
+        return losses
+
+    @pytest.mark.slow
+    def test_ulysses_in_pipe_matches_ring_in_pipe_twin(self):
+        def plan_for(mode):
+            return dataclasses.replace(
+                planner.ShardingPlan(
+                    name=f"sp4_{mode}_pp2", sequence=4, pipe=2,
+                    sequence_parallel_mode=mode,
+                ),
+                param_min_shard_size=0,
+            )
+
+        losses_u = self._run_losses(
+            plan_for("ulysses"),
+            dict(pipeline_stages=2, sequence_parallel_mode="ulysses"),
+        )
+        losses_r = self._run_losses(
+            plan_for("ring"),
+            dict(pipeline_stages=2, sequence_parallel_mode="ring"),
+        )
+        np.testing.assert_allclose(losses_u, losses_r, atol=1e-4)
+
+    @pytest.mark.slow
+    def test_tp_matches_dp_twin(self):
+        tp = dataclasses.replace(
+            planner.ShardingPlan(name="dp4_tp2", data=4, fsdp=2),
+            param_min_shard_size=0,
+        )
+        dp = dataclasses.replace(
+            planner.ShardingPlan(name="dp8", data=8),
+            param_min_shard_size=0,
+        )
+        np.testing.assert_allclose(
+            self._run_losses(tp), self._run_losses(dp), atol=1e-4
+        )

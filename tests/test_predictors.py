@@ -201,6 +201,8 @@ class TestExportedSavedModelPredictor:
         assert not predictor._restore_in_flight
         assert not predictor.restore_thread_leaked
 
+    # ~8s (deliberately wedged restore thread) on 1 cpu: slow slice.
+    @pytest.mark.slow
     def test_close_surfaces_leaked_restore_thread(self, tmp_path, caplog):
         """close() must flag + log a restore thread that outlives its
         join timeout instead of silently leaking it."""

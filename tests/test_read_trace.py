@@ -16,6 +16,7 @@ import sys
 
 import jax
 import jax.numpy as jnp
+import pytest
 
 # The subprocess must not run this image's axon sitecustomize (PYTHONPATH):
 # during a tunnel wedge, plugin registration blocks interpreter startup for
@@ -26,6 +27,9 @@ _CLEAN_ENV = {
 }
 
 
+# ~12s (profiler capture + jit) on 1 cpu: slow slice — tooling smoke,
+# not a trainer contract.
+@pytest.mark.slow
 def test_read_trace_summarizes_a_capture(tmp_path):
     trace_dir = tmp_path / "trace"
     a = jnp.ones((256, 256))

@@ -326,6 +326,10 @@ class TestReplayServiceProcess:
         finally:
             handle.stop()
 
+    # ~15s of multi-process SIGKILL/respawn on 1 cpu: slow slice; the
+    # in-process durability pins and test_crash_consistency's
+    # SIGKILL-mid-save bitwise pin keep the contract fast.
+    @pytest.mark.slow
     def test_sigkill_respawn_counted_loss_and_retry(self, tmp_path):
         handle = self._handle(tmp_path)
         try:

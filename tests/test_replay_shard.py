@@ -490,6 +490,10 @@ class TestShardedServiceProcesses:
         finally:
             service.stop()
 
+    # ~25s of multi-process shard orchestration on 1 cpu: slow slice
+    # (the sharded soak twin rides there too); the in-process failover
+    # and spill pins above keep the contract fast.
+    @pytest.mark.slow
     def test_partition_failover_learner_side(self, tmp_path):
         """A driver-side partition of one shard: sampling fails over
         with the coverage loss counted, appends to the cut shard spill;

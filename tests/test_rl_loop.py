@@ -176,6 +176,10 @@ def reference_run(tmp_path_factory, frozen_replay):
     }
 
 
+# ~34s (trained-fixture setup + SIGKILL/respawn) on 1 cpu: slow slice;
+# the offline SIGKILL-mid-save bitwise pin in test_crash_consistency
+# keeps the save-atomicity contract on the fast tier.
+@pytest.mark.slow
 class TestLearnerSigkillMidSaveOnline:
     def test_resume_restores_sampling_state_bitwise(
         self, tmp_path, frozen_replay, reference_run
@@ -294,6 +298,9 @@ class TestInProcessClosedLoop:
         finally:
             loop.stop()
 
+    # ~6s on 1 cpu: slow slice; the other chaos sites' containment
+    # pins keep the fault-plan contract fast.
+    @pytest.mark.slow
     def test_chaos_publish_site_fires_and_is_contained(self, tmp_path):
         """A fault at publish_policy must not kill the learner: the
         publish is skipped (counted), training continues."""

@@ -536,6 +536,10 @@ class TestCompileCache:
         monkeypatch.setenv("T2R_COMPILE_CACHE_DIR", str(tmp_path))
         assert enable_compile_cache() == str(tmp_path)
 
+    # ~14s (two full server boots) on 1 cpu: slow slice; the cache
+    # enable/scope pins above and the AOT restore-ladder tests keep
+    # the warm-boot contract fast.
+    @pytest.mark.slow
     def test_second_server_boot_hits_the_cache(
         self, quant_export, tmp_path, monkeypatch
     ):

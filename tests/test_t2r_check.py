@@ -279,6 +279,37 @@ class TestLintsCatch:
         assert spec.choices == ("static", "dynamic")
         assert spec.default == "static"
 
+    def test_plan_search_flags_covered_by_registry_lint(self):
+        """The round-19 measured-search gates ride the same rails: the
+        cache-dir/measure-mode strings and the step-count int are
+        declared (raw reads env-undeclared, wrong-kind reads
+        env-kind-mismatch, declared spellings clean)."""
+        for name in (
+            "T2R_PLAN_CACHE_DIR", "T2R_PLAN_MEASURE",
+            "T2R_PLAN_MEASURE_STEPS",
+        ):
+            assert "env-undeclared" in self._rules(
+                f"import os\nx = os.environ.get({name!r})\n"
+            ), name
+        assert "env-kind-mismatch" in self._rules(
+            "from tensor2robot_tpu import flags\n"
+            "x = flags.get_bool('T2R_PLAN_CACHE_DIR')\n"
+        )
+        assert "env-kind-mismatch" in self._rules(
+            "from tensor2robot_tpu import flags\n"
+            "x = flags.get_str('T2R_PLAN_MEASURE_STEPS')\n"
+        )
+        clean = self._rules(
+            "from tensor2robot_tpu import flags\n"
+            "a = flags.get_str('T2R_PLAN_CACHE_DIR')\n"
+            "b = flags.get_str('T2R_PLAN_MEASURE')\n"
+            "c = flags.get_int('T2R_PLAN_MEASURE_STEPS')\n"
+        )
+        assert "env-kind-mismatch" not in clean
+        assert "env-unknown-flag" not in clean
+        assert flags.get_flag("T2R_PLAN_MEASURE").default == "off"
+        assert flags.get_flag("T2R_PLAN_MEASURE_STEPS").minimum == 1
+
     def test_replay_flags_covered_by_registry_lint(self):
         """The round-12 T2R_REPLAY_* + T2R_PARSE_ON_ERROR flags ride the
         same rails: raw environ reads are env-undeclared, wrong-kind
@@ -779,6 +810,23 @@ class TestLintsCatch:
             "def f():\n    return P(None, 'data')\n",
             "import jax\ndef f():\n"
             "    return jax.sharding.PartitionSpec('data')\n",
+        ):
+            diags = lint_source(source, self._TRAIN_PATH)
+            assert any(
+                d.rule == "sharding-outside-planner" for d in diags
+            ), source
+
+    def test_tensor_parallel_spellings_flagged(self):
+        """The round-19 TP widening brings new constructor spellings
+        into reach — PositionalSharding and the conventional bare-P
+        alias — and the lint covers them in train/ too."""
+        for source in (
+            "from jax.sharding import PositionalSharding\n"
+            "def f(devices):\n    return PositionalSharding(devices)\n",
+            "import jax\ndef f(devices):\n"
+            "    return jax.sharding.PositionalSharding(devices)\n",
+            "from jax.sharding import PartitionSpec as P\n"
+            "def f():\n    return P('fsdp')\n",
         ):
             diags = lint_source(source, self._TRAIN_PATH)
             assert any(

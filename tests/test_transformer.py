@@ -318,22 +318,20 @@ class TestPipelinedEncoder:
                 num_layers=4, num_heads=2, head_dim=8,
                 use_flash=False, pipeline_stages=2,
             ).init(jax.random.PRNGKey(0), x)
-        # SP x PP composes in ring mode only (the manual in-shard_map
-        # ring); the ulysses strategy still rejects eagerly.
+        # SP x PP composes in BOTH modes since round 19 (ring rotation or
+        # the ulysses all-to-all head scatter, run manually inside the
+        # pipeline shard_map) — ulysses-in-pipe init must succeed and
+        # carry the same stacked-stage param structure as ring.
         seq_mesh = mesh_lib.make_mesh(
             data=1, sequence=2, pipe=2, devices=jax.devices()[:4]
         )
-        with pytest.raises(ValueError, match="ring"):
-            TransformerEncoder(
+        for mode in ("ring", "ulysses"):
+            variables = TransformerEncoder(
                 num_layers=4, num_heads=2, head_dim=8, mesh=seq_mesh,
                 use_flash=False, pipeline_stages=2,
-                sequence_parallel_mode="ulysses",
+                sequence_parallel_mode=mode,
             ).init(jax.random.PRNGKey(0), x)
-        variables = TransformerEncoder(
-            num_layers=4, num_heads=2, head_dim=8, mesh=seq_mesh,
-            use_flash=False, pipeline_stages=2,
-        ).init(jax.random.PRNGKey(0), x)
-        assert mesh_lib.PIPE_STAGES_KEY in variables["params"]
+            assert mesh_lib.PIPE_STAGES_KEY in variables["params"]
 
 
 class TestMoETransformer:

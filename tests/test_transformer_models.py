@@ -247,6 +247,9 @@ class TestTransformerBCModel:
         )
         assert np.isfinite(float(jax.device_get(metrics["loss"])))
 
+    # ~10s on 1 cpu: slow slice; pipeline training correctness stays fast
+    # via test_pipeline_matches_sequential_model + the data-axis composer.
+    @pytest.mark.slow
     def test_trains_on_pipeline_mesh(self):
         """End to end through CompiledModel with the encoder blocks
         pipelined over the pipe axis: stage params (and their optimizer
@@ -304,6 +307,9 @@ class TestTransformerBCModel:
         )
         assert np.isfinite(float(jax.device_get(metrics["loss"])))
 
+    # ~6s on 1 cpu: slow slice; the data-axis composition and the
+    # pipeline-vs-sequential parity pin stay fast.
+    @pytest.mark.slow
     def test_pipeline_composes_with_zero2(self):
         """shard_weight_update must keep working on a pipe mesh: stage
         moments shard over pipe, non-stage moments over data (ZeRO-2)."""
@@ -340,6 +346,9 @@ class TestTransformerBCModel:
         )
         assert np.isfinite(float(jax.device_get(metrics["loss"])))
 
+    # ~10s (two pipeline meshes) on 1 cpu: slow slice; the explicit
+    # microbatch-count invariance pin in test_transformer stays fast.
+    @pytest.mark.slow
     def test_pipeline_default_microbatches_adapt(self):
         """Omitting pipeline_microbatches must pick a valid divisor: batch
         6 on a pipe-2 mesh (6 % (2*S)=4 != 0) and batch 4 on a data-2 x
@@ -376,6 +385,8 @@ class TestTransformerBCModel:
         )
         assert outputs_dp["inference_output"].shape == (4, 8, 2)
 
+    # ~8s on 1 cpu: slow slice, same rationale as the zero2 composer.
+    @pytest.mark.slow
     def test_pipeline_composes_with_grad_accum_and_remat(self):
         """Both microbatching levels stack: grad accumulation slices the
         batch on the host-loop level, the GPipe schedule re-microbatches

@@ -194,6 +194,9 @@ class TestT2RModelFixture:
 
 
 class TestGinConfigSmoke:
+    # ~10s on 1 cpu: slow slice; test_pose_env's end-to-end
+    # collect-then-train run covers the gin-driven path on the fast tier.
+    @pytest.mark.slow
     def test_pose_env_train_config_runs(self, tmp_path):
         import glob as globlib
 

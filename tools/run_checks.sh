@@ -75,8 +75,24 @@ if [ "$TESTS" = 1 ]; then
   # sharding-outside-planner lint, and the fast 3D (2x2x2) sibling. The
   # multi-step 3D loss-parity twin AND the two ring-attention preset
   # twins (dp_sp, sp_ring — ~75s of layout-only shard_map compiles)
-  # ride the slow slice; BENCH_PLAN_r17 re-audits all 8 presets.
+  # ride the slow slice; BENCH_PLAN_r19 re-audits all 8 presets. Round
+  # 19 widens the space: TP (fsdp-axis) enumeration with typed
+  # rejections and ulysses-in-pipeline composition, their loss-parity
+  # twins on the slow slice.
   if ! JAX_PLATFORMS=cpu python -m pytest tests/test_planner.py \
+      -q -m 'not slow' -p no:cacheprovider; then
+    status=1
+  fi
+
+  echo "== plan-cache: measured plan search + persistent cache (tier-1) =="
+  # Round-19 gates, attributed by name: envelope integrity (every
+  # corpus corruption variant typed PlanCacheCorrupt, tolerant load
+  # falls back to fresh search), all-or-nothing key invalidation
+  # (fingerprint / topology / jax version / schema bump), the measured
+  # probe's compile-cache bypass pin, and the zero-compile warm-path
+  # contract: the second T2R_PLAN=auto run replays the cold run's
+  # winner byte-for-byte with zero search compiles.
+  if ! JAX_PLATFORMS=cpu python -m pytest tests/test_plan_cache.py \
       -q -m 'not slow' -p no:cacheprovider; then
     status=1
   fi
