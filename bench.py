@@ -3703,6 +3703,472 @@ def bench_gateway(args) -> None:
         _fail("bench_gateway", err, metric=metric)
 
 
+def bench_policies(args) -> None:
+    """Multi-policy fleet leg (`python bench.py policies`).
+
+    One fleet, many policies (ROADMAP item 2), measured end to end:
+
+      1. **Store phase.** Publishes `--variants` fine-tuned siblings of
+         one base export into a content-addressed ArtifactStore — the
+         program blobs dedup by hash, every sibling's weights land as a
+         quantized per-leaf delta vs the base — and gates the disk
+         accounting: the store must be >= 5x smaller than the same
+         policies stored dense, with every reconstruction hash-verified.
+      2. **Serving phase.** A 4-replica fleet hosts the whole catalog
+         behind the Gateway (each mock policy's (scale, bias) is derived
+         from its store manifest's weights sha, tying the serving
+         identity to the stored artifact), replaying a seeded diurnal
+         trace whose per-policy mix is Zipf-distributed with a ROTATING
+         hot set — the memory budget forces real eviction/cold-load
+         churn, all counted. Mid-trace, ONE policy rolling-swaps.
+
+    Gates: >= `--variants` (>=100 by default) policies; delta >= 5x
+    denser than dense; every response bitwise-equal to a single-policy
+    twin serving the same (scale, bias); ZERO cross-policy coalesce
+    joins (every served value belongs to the policy that asked); churn
+    counters nonzero at every layer (replica evictions/cold loads,
+    router placement hits/misses); the swapped policy's publish causes
+    zero failed requests on every OTHER policy; zero lost requests.
+
+    All arrivals and the policy mix are seeded: rerunning replays the
+    same trace.
+    """
+    import hashlib
+    import math
+    import shutil
+    import tempfile
+    import threading
+
+    metric = "multi_policy_fleet_delta_store_cpu_proxy"
+    try:
+        import numpy as np
+        from flax import serialization
+
+        from tensor2robot_tpu.export.artifact_store import ArtifactStore
+        from tensor2robot_tpu.serving import (
+            FleetRouter,
+            GateError,
+            Gateway,
+            ReplicaSpec,
+            TenantBinding,
+            multi_policy_mock_factory,
+        )
+        from tensor2robot_tpu.serving.metrics import percentile
+
+        n_variants = args.variants
+        trace_secs = args.trace_secs
+        swap_at = 0.5 * trace_secs
+
+        # -- store phase: one base, n_variants delta siblings ------------------
+        rng = np.random.RandomState(41)
+        base_params = {
+            "dense0": {
+                "kernel": rng.standard_normal((96, 96)).astype(np.float32),
+                "bias": rng.standard_normal((96,)).astype(np.float32),
+            },
+            "dense1": {
+                "kernel": rng.standard_normal((96, 64)).astype(np.float32),
+                "bias": rng.standard_normal((64,)).astype(np.float32),
+            },
+            "step": np.int64(1000),
+        }
+        # The shared serving program: identical bytes in every sibling
+        # export, so the store dedups it down to ONE blob.
+        program_bytes = rng.bytes(192 * 1024)
+
+        def write_export(dirname, params):
+            os.makedirs(os.path.join(dirname, "stablehlo"))
+            with open(
+                os.path.join(dirname, "stablehlo", "forward.mlir"), "wb"
+            ) as f:
+                f.write(program_bytes)
+            with open(
+                os.path.join(dirname, "t2r_metadata.json"), "w"
+            ) as f:
+                json.dump({"bench": "policies"}, f)
+            with open(
+                os.path.join(dirname, "variables.msgpack"), "wb"
+            ) as f:
+                f.write(serialization.to_bytes(params))
+
+        def perturb(params, seed):
+            prng = np.random.RandomState(seed)
+            out = {}
+            for name, group in params.items():
+                if isinstance(group, dict):
+                    out[name] = {
+                        k: (
+                            v + prng.standard_normal(v.shape).astype(
+                                np.float32
+                            ) * 1e-3
+                        )
+                        for k, v in group.items()
+                    }
+                else:
+                    out[name] = group  # the int64 step leaf ships dense
+            return out
+
+        store_root = tempfile.mkdtemp(prefix="t2r-bench-policy-store-")
+        scratch = tempfile.mkdtemp(prefix="t2r-bench-policy-exports-")
+        t_store0 = time.monotonic()
+        try:
+            store = ArtifactStore(store_root)
+            base_dir = os.path.join(scratch, "base")
+            write_export(base_dir, base_params)
+            store.put(base_dir, "base", regime="int8")
+            policy_ids = []
+            for i in range(n_variants):
+                pid = f"policy-{i:04d}"
+                export_dir = os.path.join(scratch, pid)
+                write_export(export_dir, perturb(base_params, seed=100 + i))
+                store.put(export_dir, pid, base_policy="base",
+                          regime="int8")
+                shutil.rmtree(export_dir)
+                policy_ids.append(pid)
+            store_secs = time.monotonic() - t_store0
+            stats = store.stats()
+            delta_ratio = stats["dense_bytes"] / max(
+                stats["store_bytes"], 1
+            )
+            # Hash-verified reconstruction on a seeded sample: a failed
+            # round trip raises typed out of load_weights.
+            sample = list(policy_ids[:: max(1, n_variants // 10)])
+            for pid in sample:
+                store.load_weights(pid)
+
+            # -- serving catalog off the store manifests -------------------
+            # (scale, bias) are index-spaced for guaranteed-distinct twin
+            # values, with a sha-derived component so the serving identity
+            # is a function of the STORED artifact, not just the index.
+            catalog = {}
+            twin_params = {}
+            for idx, pid in enumerate(policy_ids):
+                sha = store.manifest(pid)["payload"]["weights_sha"]
+                scale = 1.0 + idx * 1e-3
+                bias = idx * 0.01 + (int(sha[:6], 16) % 997) * 1e-7
+                catalog[pid] = {
+                    "scale": scale, "bias": bias, "version": 1,
+                    "mem_bytes": args.policy_mem_mb << 20,
+                }
+                twin_params[pid] = (scale, bias)
+        finally:
+            shutil.rmtree(scratch, ignore_errors=True)
+
+        def twin_value(pid, features):
+            """The single-policy twin: the exact float path _MockServer
+            computes — float64 accumulate over sorted keys, one cast."""
+            scale, bias = twin_params[pid]
+            total = 0.0
+            for key in sorted(features):
+                total += float(np.sum(features[key].astype(np.float64)))
+            return float(np.float32(total * scale + bias))
+
+        # -- serving phase: 4-replica fleet, rotating-Zipf diurnal mix ---------
+        spec = ReplicaSpec(
+            factory=multi_policy_mock_factory,
+            factory_kwargs={
+                "catalog": catalog,
+                "service_ms": args.service_ms,
+                "load_ms": args.load_ms,
+                "mem_budget_mb": args.mem_budget_mb,
+            },
+        )
+        router = FleetRouter(
+            spec, args.replicas,
+            max_inflight=args.max_inflight,
+            hedge_ms=0,
+            probe_interval_ms=50.0,
+            seed=11,
+        ).start(timeout_s=120.0)
+        gateway = Gateway(
+            router,
+            [
+                TenantBinding(tenant="robots-gold", tier="gold",
+                              quota_rps=1e6, deadline_ms=4000.0),
+                TenantBinding(tenant="eval-bronze", tier="bronze",
+                              quota_rps=1e6, deadline_ms=4000.0),
+            ],
+            max_queue=4096,
+            coalesce=True,
+            seed=17,
+        ).start()
+        try:
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline and not all(
+                s == "up" for s in router.replica_states()
+            ):
+                time.sleep(0.02)
+
+            # Seeded trace: Poisson arrivals under a diurnal envelope;
+            # each arrival draws (tenant, policy rank, obs id); the
+            # Zipf-ranked policy window ROTATES through the catalog so
+            # the resident sets must churn.
+            trng = np.random.RandomState(53)
+            ranks = np.arange(1, min(16, n_variants) + 1, dtype=np.float64)
+            rank_p = (1.0 / ranks) / np.sum(1.0 / ranks)
+            trace = []
+            t = trng.uniform(0, 0.01)
+            while t < trace_secs:
+                rate = args.rate * (
+                    1.0 + 0.5 * math.sin(2 * math.pi * t / trace_secs)
+                )
+                t += trng.exponential(1.0 / max(rate, 1.0))
+                rotation = int(t / max(trace_secs / 5.0, 1e-9)) * 13
+                rank = trng.choice(len(ranks), p=rank_p)
+                pid = policy_ids[(rotation + rank) % n_variants]
+                obs = int(trng.randint(1, 9))
+                tenant = (
+                    "robots-gold" if trng.uniform() < 0.7 else "eval-bronze"
+                )
+                # Echoes: back-to-back duplicates of this observation.
+                # "same" re-asks the SAME policy (must coalesce onto the
+                # leader's dispatch); "other" asks a DIFFERENT policy
+                # with bitwise-identical features — the exact request
+                # shape the old observation-only coalescing key would
+                # have joined across policies.
+                draw = trng.uniform()
+                echo = (
+                    "same" if draw < 0.25
+                    else "other" if draw < 0.40
+                    else None
+                )
+                trace.append((t, tenant, pid, obs, echo))
+            obs_cache = {
+                v: {"x": np.full((8,), float(v), np.float32)}
+                for v in range(1, 9)
+            }
+
+            records = []
+            rec_lock = threading.Lock()
+            admission = {}
+            swap_target = trace[len(trace) // 2][2]
+            swap_thread = None
+            swap_result = {}
+            submitted = 0
+
+            def fire(tenant, pid, obs, rel):
+                nonlocal submitted
+                submitted += 1
+                try:
+                    future = gateway.submit(
+                        tenant, obs_cache[obs], policy_id=pid
+                    )
+                except GateError as err:
+                    cls = type(err).__name__
+                    admission[cls] = admission.get(cls, 0) + 1
+                    return
+
+                def on_done(fut, pid=pid, obs=obs, rel=rel,
+                            t_submit=time.monotonic()):
+                    err = fut.error()
+                    latency = (time.monotonic() - t_submit) * 1e3
+                    y = None
+                    coalesced = False
+                    if err is None:
+                        response = fut.result(0)
+                        y = float(response.outputs["y"])
+                        coalesced = response.coalesced
+                    with rec_lock:
+                        records.append(
+                            (pid, obs, rel, latency, y, coalesced,
+                             None if err is None else type(err).__name__)
+                        )
+
+                future.add_done_callback(on_done)
+
+            t0 = time.monotonic()
+            for t_arrival, tenant, pid, obs, echo in trace:
+                now = time.monotonic()
+                if now - t0 < t_arrival:
+                    time.sleep(t_arrival - (now - t0))
+                rel = time.monotonic() - t0
+                if swap_thread is None and rel >= swap_at:
+                    swap_thread = threading.Thread(
+                        target=lambda: swap_result.update(
+                            gateway.rolling_swap(
+                                swap_timeout_s=30.0,
+                                policy_id=swap_target,
+                            )
+                        ),
+                        daemon=True,
+                    )
+                    swap_thread.start()
+                fire(tenant, pid, obs, rel)
+                if echo == "same":
+                    fire(tenant, pid, obs, rel)
+                elif echo == "other":
+                    other = policy_ids[
+                        (policy_ids.index(pid) + 1) % n_variants
+                    ]
+                    fire(tenant, other, obs, rel)
+
+            expected = submitted - sum(admission.values())
+            drain_deadline = time.monotonic() + 30
+            while time.monotonic() < drain_deadline:
+                with rec_lock:
+                    if len(records) >= expected:
+                        break
+                time.sleep(0.02)
+            if swap_thread is not None:
+                swap_thread.join(timeout=60)
+            with rec_lock:
+                frozen = list(records)
+            lost = expected - len(frozen)
+
+            router_snap = router.snapshot()
+            gate_snap = gateway.snapshot()
+        finally:
+            gateway.stop()
+            router.stop()
+            shutil.rmtree(store_root, ignore_errors=True)
+
+        # -- audits ------------------------------------------------------------
+        ok = [r for r in frozen if r[6] is None]
+        failed = {}
+        for r in frozen:
+            if r[6] is not None:
+                failed[r[6]] = failed.get(r[6], 0) + 1
+        # Per-policy bitwise audit vs the single-policy twin, and the
+        # cross-policy forensic: a response whose value is NOT its own
+        # policy's twin but IS some other policy's twin for the same
+        # observation is a smoking-gun cross-policy coalesce join.
+        twin_by_obs = {
+            obs: {
+                round(twin_value(pid, obs_cache[obs]), 9): pid
+                for pid in policy_ids
+            }
+            for obs in range(1, 9)
+        }
+        bitwise_mismatches = 0
+        cross_policy_joins = 0
+        group_values = {}
+        for pid, obs, _rel, _lat, y, _co, _err in ok:
+            group_values.setdefault((pid, obs), set()).add(y)
+            expected_y = twin_value(pid, obs_cache[obs])
+            if y != expected_y:
+                bitwise_mismatches += 1
+                owner = twin_by_obs[obs].get(round(y, 9))
+                if owner is not None and owner != pid:
+                    cross_policy_joins += 1
+        groups_single_valued = all(
+            len(v) == 1 for v in group_values.values()
+        )
+        policies_served = len({r[0] for r in ok})
+        coalesced_count = sum(1 for r in ok if r[5])
+        other_policy_failures = sum(
+            1 for r in frozen
+            if r[6] is not None and r[0] != swap_target
+        )
+        evictions = sum(
+            r.get("policy_evictions") or 0
+            for r in router_snap["replicas"]
+        )
+        cold_loads = sum(
+            r.get("policy_cold_loads") or 0
+            for r in router_snap["replicas"]
+        )
+        latencies = sorted(r[3] for r in ok)
+        rc = router_snap["counters"]
+
+        gates = {
+            "variants_ge_target": (
+                stats["n_policies"] >= n_variants + 1
+                and len(catalog) >= n_variants
+            ),
+            "delta_store_ge_5x": (
+                delta_ratio >= 5.0
+                and stats["n_delta_policies"] == n_variants
+            ),
+            "per_policy_bitwise_vs_twin": (
+                bitwise_mismatches == 0
+                and groups_single_valued
+                and len(ok) > 0
+            ),
+            "zero_cross_policy_joins": cross_policy_joins == 0,
+            "coalesce_still_effective": (
+                coalesced_count > 0
+                and gate_snap["counters"].get("coalesced_joins", 0) > 0
+            ),
+            "eviction_churn_counted": (
+                evictions >= 1
+                and cold_loads >= 1
+                and (
+                    rc.get("policy_resident_dispatches", 0)
+                    + rc.get("policy_cold_dispatches", 0)
+                )
+                > 0
+            ),
+            "swap_zero_blip_other_policies": (
+                swap_result.get("failed", "never-ran") is None
+                and other_policy_failures == 0
+            ),
+            "zero_lost": lost == 0 and not admission,
+        }
+        all_green = all(gates.values())
+        payload = {
+            "metric": metric,
+            "value": round(delta_ratio, 3),
+            "unit": "dense_over_store_bytes",
+            "vs_baseline": round(delta_ratio / 5.0, 4),
+            "all_green": all_green,
+            "gates": gates,
+            "detail": {
+                "variants": n_variants,
+                "store": {
+                    **stats,
+                    "delta_ratio": round(delta_ratio, 3),
+                    "publish_secs": round(store_secs, 3),
+                    "verified_sample": len(sample),
+                },
+                "trace_secs": trace_secs,
+                "offered_rate_hz": args.rate,
+                "replicas": args.replicas,
+                "mem_budget_mb": args.mem_budget_mb,
+                "policy_mem_mb": args.policy_mem_mb,
+                "submitted": submitted,
+                "completed": len(ok),
+                "failed_typed": failed,
+                "shed_at_admission": admission,
+                "lost": lost,
+                "policies_served": policies_served,
+                "coalesced": coalesced_count,
+                "bitwise_mismatches": bitwise_mismatches,
+                "cross_policy_joins": cross_policy_joins,
+                "p50_ms": round(percentile(latencies, 0.50), 3),
+                "p99_ms": round(percentile(latencies, 0.99), 3),
+                "evictions": evictions,
+                "cold_loads": cold_loads,
+                "router_policy_counters": {
+                    k: v for k, v in rc.items() if "policy" in k
+                },
+                "swap_target": swap_target,
+                "swap_result": (
+                    {
+                        "swapped": swap_result.get("swapped"),
+                        "failed": swap_result.get("failed"),
+                    }
+                    if swap_result
+                    else None
+                ),
+                "backend": "multi_policy_mock_replica_processes",
+                "host_cpus": os.cpu_count(),
+            },
+            "cpu_proxy": True,
+            "proxy_note": (
+                "placement/eviction/coalescing control plane measured "
+                "over mock replica processes on CPU; the store's delta "
+                "compression ratio and every bitwise/isolation contract "
+                "are platform-independent"
+            ),
+        }
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(payload, f, indent=2, sort_keys=True)
+        _emit(payload)
+    except Exception as err:  # noqa: BLE001
+        _fail("bench_policies", err, metric=metric)
+
+
 def bench_comms(args) -> None:
     """Quantized gradient-collective leg (`python bench.py comms`).
 
@@ -5538,6 +6004,65 @@ def _build_cli():
     )
     gateway.add_argument(
         "--out", default="BENCH_GATE_r14.json",
+        help="also write the payload to this file ('' disables; "
+             "default %(default)s)",
+    )
+    policies = leg(
+        "policies", bench_policies,
+        "multi-policy fleet leg: content-addressed artifact store "
+        "(program dedup + quantized weight deltas, >= 5x smaller than "
+        "dense), then a 4-replica fleet serving 100+ policy variants "
+        "under a memory budget behind the Gateway — seeded rotating-Zipf "
+        "diurnal mix, eviction/cold-load churn counted at every layer, "
+        "per-policy responses bitwise-audited against single-policy "
+        "twins, zero cross-policy coalesce joins, and a one-policy "
+        "rolling swap that never blips the others (docs/SERVING.md "
+        "\"Multi-policy serving\")",
+    )
+    policies.add_argument(
+        "--variants", type=int, default=100,
+        help="fine-tuned sibling count published to the store and served "
+             "(default %(default)s)",
+    )
+    policies.add_argument(
+        "--replicas", type=int, default=4,
+        help="fleet replica count (default %(default)s)",
+    )
+    policies.add_argument(
+        "--trace-secs", type=float, default=8.0,
+        help="trace duration; the one-policy rolling swap fires at half "
+             "of it (default %(default)s)",
+    )
+    policies.add_argument(
+        "--rate", type=float, default=120.0,
+        help="offered request rate (Hz) at the diurnal envelope's mean "
+             "(default %(default)s)",
+    )
+    policies.add_argument(
+        "--service-ms", type=float, default=1.0,
+        help="mock per-request service time (default %(default)s)",
+    )
+    policies.add_argument(
+        "--load-ms", type=float, default=5.0,
+        help="mock per-policy cold-load (materialize + prewarm) cost "
+             "(default %(default)s)",
+    )
+    policies.add_argument(
+        "--max-inflight", type=int, default=8,
+        help="router per-replica in-flight cap (default %(default)s)",
+    )
+    policies.add_argument(
+        "--policy-mem-mb", type=int, default=4,
+        help="declared resident footprint per policy (default %(default)s)",
+    )
+    policies.add_argument(
+        "--mem-budget-mb", type=int, default=24,
+        help="per-replica resident-set budget; << variants x policy mem, "
+             "so the rotating mix forces eviction churn "
+             "(default %(default)s)",
+    )
+    policies.add_argument(
+        "--out", default="BENCH_POLICY_r20.json",
         help="also write the payload to this file ('' disables; "
              "default %(default)s)",
     )

@@ -1,0 +1,795 @@
+"""Content-addressed artifact store with delta-compressed siblings.
+
+One fleet serving N policy variants needs N artifacts, but sibling
+fine-tune exports share almost everything: the serving program bytes,
+the AOT executables, the warmup corpus — and their weight trees differ
+by small deltas that quantize far harder than the weights themselves
+(the EQuARX thesis, arXiv:2506.17615, applied to artifact storage
+instead of collectives). This module stores exports content-addressed
+so shared files cost their bytes ONCE, and stores a sibling's weights
+as a per-leaf delta vs a named base artifact, encoded through the same
+blockwise quant codec the gradient collectives ship
+(parallel/collectives.py BlockScaledCollective).
+
+Layout under the store root::
+
+    blobs/sha256-<hex>        file contents, content-addressed (dedup)
+    policies/<policy_id>.json manifest: file table + weights payload
+
+A manifest names every file of the export as (relpath -> blob sha);
+two policies exported from the same program reference the SAME program
+and asset blobs — the second policy pays only its weights payload.
+
+Weights payloads come in two kinds:
+
+  * ``dense`` — the base case: ``variables.msgpack`` stored verbatim as
+    a blob (sha-verified on read).
+  * ``delta`` — a sibling: per-leaf ``new - base`` diffs, each raveled,
+    zero-padded to the quant block, and encoded by the collective codec
+    (``T2R_POLICY_DELTA_QUANT`` / ``T2R_POLICY_DELTA_BLOCK``). A
+    per-leaf PARITY GATE re-decodes the quantized diff against the base
+    during ``put``: a leaf that does not reconstruct within the
+    declared tolerance (``T2R_POLICY_DELTA_TOL``, relative L-inf) ships
+    dense-exact instead — gate-fails-write-nothing, the serve_quant
+    discipline. The manifest records the RECONSTRUCTED tree's sha256,
+    so ``load_weights`` is bitwise-stable and self-verifying.
+
+The delta payload rides the AOT envelope shape (magic + u32 length +
+u32 crc32, 12-byte header), so ``analysis/corpus.py
+corrupt_frame_variants`` drives the corruption tests with no new
+generator. Check order on read is the aot.py contract: integrity
+(magic/length/CRC -> ``ArtifactCorrupt``) before key (program
+fingerprint / base weights sha -> ``ArtifactKeyMismatch``) before any
+unpickle — a truncated, bitflipped, or transplanted payload is a typed
+refusal, NEVER a partially-loaded policy.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import struct
+import tempfile
+import zlib
+from typing import Any, Dict, List, Mapping, Optional, Tuple
+
+import numpy as np
+
+from tensor2robot_tpu import flags
+from tensor2robot_tpu.export import aot as aot_lib
+
+__all__ = [
+    "STORE_MAGIC",
+    "STORE_FORMAT_VERSION",
+    "ArtifactStore",
+    "ArtifactStoreError",
+    "ArtifactCorrupt",
+    "ArtifactKeyMismatch",
+    "BaseArtifactMissing",
+    "PolicyNotFound",
+    "PolicyExists",
+    "DeltaParityError",
+    "program_fingerprint",
+]
+
+STORE_FORMAT_VERSION = 1
+STORE_MAGIC = b"T2RP"
+_HEADER_SIZE = 12  # magic + length + crc32, the corpus frame shape
+
+#: Hard bound on one delta payload; a forged length field is rejected
+#: before any allocation happens (corpus frame_huge_length).
+MAX_PAYLOAD_BYTES = 1 << 30
+
+_BLOB_DIR = "blobs"
+_POLICY_DIR = "policies"
+
+# Import lazily from saved_model would drag flax at module import; the
+# two filenames the store special-cases are stable layout constants.
+_VARIABLES_FILENAME = "variables.msgpack"
+_STABLEHLO_PREFIX = "stablehlo" + os.sep
+
+
+class ArtifactStoreError(RuntimeError):
+    """Base class for artifact-store failures."""
+
+
+class ArtifactCorrupt(ArtifactStoreError):
+    """A blob or delta envelope failed integrity (sha/magic/length/CRC/
+    unpickle/reconstruction hash): truncated or bitflipped bytes. The
+    policy is NOT loaded — there is no partial-decode path."""
+
+
+class ArtifactKeyMismatch(ArtifactStoreError):
+    """The payload is intact but keyed for a different program or base:
+    decoding it would materialize the wrong weights under this policy's
+    name. Refused loudly, never reinterpreted."""
+
+
+class BaseArtifactMissing(ArtifactStoreError):
+    """A delta payload names a base policy the store does not hold (or
+    no longer holds) — the sibling cannot be reconstructed."""
+
+
+class PolicyNotFound(ArtifactStoreError):
+    """No manifest under this policy id."""
+
+
+class PolicyExists(ArtifactStoreError):
+    """``put`` refuses to silently overwrite a published policy; delete
+    first if the republish is intentional."""
+
+
+class DeltaParityError(ArtifactStoreError):
+    """The encoded payload failed its own round-trip proof during
+    ``put`` — nothing was written (gate-fails-write-nothing)."""
+
+
+def _sha256_hex(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def _flatten_tree(
+    tree: Any, prefix: str = ""
+) -> List[Tuple[str, Any]]:
+    """(path, leaf) pairs in sorted-key order; '/'-joined dict paths."""
+    if isinstance(tree, Mapping):
+        out: List[Tuple[str, Any]] = []
+        for key in sorted(tree):
+            sub = f"{prefix}/{key}" if prefix else str(key)
+            out.extend(_flatten_tree(tree[key], sub))
+        return out
+    return [(prefix, tree)]
+
+
+def _unflatten_tree(leaves: Mapping[str, Any]) -> Any:
+    root: Dict[str, Any] = {}
+    for path, value in leaves.items():
+        parts = path.split("/")
+        node = root
+        for part in parts[:-1]:
+            node = node.setdefault(part, {})
+        node[parts[-1]] = value
+    return root
+
+
+def program_fingerprint(files: Mapping[str, bytes]) -> str:
+    """Hex fingerprint of an export's PROGRAM identity: sha256 over the
+    serving-program bytes (``stablehlo/``), path-labelled, via the same
+    chained-digest construction as PR 11's AOT fingerprint. Two exports
+    are siblings (delta-eligible) iff these match. Exports with no
+    serialized program (tests, minimal dirs) fall back to every
+    non-weight file, so the key still pins content identity."""
+    program = sorted(
+        rel
+        for rel in files
+        if rel.startswith(_STABLEHLO_PREFIX)
+        or rel.startswith("stablehlo/")
+    )
+    if not program:
+        program = sorted(
+            rel
+            for rel in files
+            if rel != _VARIABLES_FILENAME
+            and not rel.startswith("quant/")
+            and not rel.startswith("quant" + os.sep)
+            and not rel.startswith("aot/")
+            and not rel.startswith("aot" + os.sep)
+        )
+    chunks: List[bytes] = []
+    for rel in program:
+        chunks.append(aot_lib.digest(rel.replace(os.sep, "/").encode()))
+        chunks.append(aot_lib.digest(files[rel]))
+    return aot_lib.artifact_fingerprint("store", chunks)
+
+
+def _pack(header: Dict[str, Any], payload: bytes) -> bytes:
+    header_bytes = json.dumps(header, sort_keys=True).encode()
+    rest = struct.pack("<I", len(header_bytes)) + header_bytes + payload
+    return (
+        STORE_MAGIC
+        + struct.pack("<I", len(rest))
+        + struct.pack("<I", zlib.crc32(rest) & 0xFFFFFFFF)
+        + rest
+    )
+
+
+def _unpack(blob: bytes) -> Tuple[Dict[str, Any], bytes]:
+    """Envelope -> (header, pickled leaves); integrity only, no keys."""
+    if len(blob) < _HEADER_SIZE:
+        raise ArtifactCorrupt(
+            f"delta payload truncated at {len(blob)} bytes"
+        )
+    if blob[:4] != STORE_MAGIC:
+        raise ArtifactCorrupt(
+            f"bad magic {blob[:4]!r} (want {STORE_MAGIC!r})"
+        )
+    (length,) = struct.unpack("<I", blob[4:8])
+    (crc,) = struct.unpack("<I", blob[8:12])
+    if length > MAX_PAYLOAD_BYTES:
+        raise ArtifactCorrupt(
+            f"forged length {length} exceeds the format bound"
+        )
+    rest = blob[_HEADER_SIZE:]
+    if len(rest) != length:
+        raise ArtifactCorrupt(
+            f"length field says {length} bytes, file carries {len(rest)}"
+        )
+    if zlib.crc32(rest) & 0xFFFFFFFF != crc:
+        raise ArtifactCorrupt("crc mismatch: delta payload is corrupt")
+    if len(rest) < 4:
+        raise ArtifactCorrupt("envelope too short for a header")
+    (hlen,) = struct.unpack("<I", rest[:4])
+    if hlen > len(rest) - 4:
+        raise ArtifactCorrupt(
+            f"header length {hlen} overruns the envelope"
+        )
+    try:
+        header = json.loads(rest[4 : 4 + hlen].decode())
+    except (UnicodeDecodeError, ValueError) as err:
+        raise ArtifactCorrupt(f"header is not JSON: {err}") from err
+    return header, rest[4 + hlen :]
+
+
+def _delta_tolerance() -> float:
+    raw = flags.get_str("T2R_POLICY_DELTA_TOL")
+    try:
+        tol = float(raw)
+    except (TypeError, ValueError) as err:
+        raise ValueError(
+            f"T2R_POLICY_DELTA_TOL={raw!r} is not a float"
+        ) from err
+    if tol < 0:
+        raise ValueError(f"T2R_POLICY_DELTA_TOL={raw!r} is negative")
+    return tol
+
+
+def _encode_leaf_delta(
+    diff: np.ndarray, regime: str, block: int
+) -> Dict[str, np.ndarray]:
+    """Encode one leaf's raveled diff through the collective codec.
+
+    The codec's block view needs the last dim to divide by the block
+    (the FlatShardLayout contract), so the diff ravels and zero-pads;
+    padded tail elements decode to zero and are sliced off."""
+    from tensor2robot_tpu.parallel import collectives
+
+    collective = collectives.get_collective(regime, block)
+    flat = np.ascontiguousarray(diff.ravel().astype(np.float32))
+    pad = (-flat.size) % block
+    if pad:
+        flat = np.pad(flat, (0, pad))
+    payload = collective.encode(flat.reshape(1, -1))
+    return {k: np.asarray(v) for k, v in payload.items()}
+
+
+def _decode_leaf_delta(
+    payload: Mapping[str, np.ndarray],
+    regime: str,
+    block: int,
+    size: int,
+) -> np.ndarray:
+    from tensor2robot_tpu.parallel import collectives
+
+    collective = collectives.get_collective(regime, block)
+    flat = np.asarray(collective.decode(dict(payload)), dtype=np.float32)
+    return flat.reshape(-1)[:size]
+
+
+class ArtifactStore:
+    """Content-addressed export store with delta-compressed siblings.
+
+    Thread-compat: writes go to temp files in the store root and land
+    via ``os.replace``; the manifest lands LAST, so a policy either
+    exists completely or not at all (a crashed ``put`` leaves only
+    unreferenced blobs, which a later identical ``put`` adopts)."""
+
+    def __init__(self, root: str):
+        self.root = str(root)
+        os.makedirs(os.path.join(self.root, _BLOB_DIR), exist_ok=True)
+        os.makedirs(os.path.join(self.root, _POLICY_DIR), exist_ok=True)
+
+    # -- paths -------------------------------------------------------------
+
+    def _blob_path(self, sha: str) -> str:
+        return os.path.join(self.root, _BLOB_DIR, f"sha256-{sha}")
+
+    def _manifest_path(self, policy_id: str) -> str:
+        return os.path.join(self.root, _POLICY_DIR, f"{policy_id}.json")
+
+    @staticmethod
+    def _check_policy_id(policy_id: str) -> str:
+        if not policy_id or not all(
+            c.isalnum() or c in "._-" for c in policy_id
+        ):
+            raise ValueError(
+                f"policy id {policy_id!r} must be non-empty "
+                "[A-Za-z0-9._-] (it names a manifest file)"
+            )
+        return policy_id
+
+    # -- queries -----------------------------------------------------------
+
+    def has(self, policy_id: str) -> bool:
+        return os.path.exists(self._manifest_path(policy_id))
+
+    def policies(self) -> List[str]:
+        pdir = os.path.join(self.root, _POLICY_DIR)
+        return sorted(
+            name[: -len(".json")]
+            for name in os.listdir(pdir)
+            if name.endswith(".json")
+        )
+
+    def manifest(self, policy_id: str) -> Dict[str, Any]:
+        path = self._manifest_path(policy_id)
+        if not os.path.exists(path):
+            raise PolicyNotFound(
+                f"no policy {policy_id!r} in store {self.root} "
+                f"(have: {', '.join(self.policies()) or 'none'})"
+            )
+        with open(path, "rb") as f:
+            return json.loads(f.read().decode())
+
+    def stats(self) -> Dict[str, Any]:
+        """Disk accounting: store bytes (blobs + manifests) vs the dense
+        bytes the same policies would cost stored as full export dirs."""
+        blob_dir = os.path.join(self.root, _BLOB_DIR)
+        blob_bytes = 0
+        n_blobs = 0
+        for name in os.listdir(blob_dir):
+            blob_bytes += os.path.getsize(os.path.join(blob_dir, name))
+            n_blobs += 1
+        manifest_bytes = 0
+        dense_bytes = 0
+        n_delta = 0
+        ids = self.policies()
+        for policy_id in ids:
+            manifest_bytes += os.path.getsize(
+                self._manifest_path(policy_id)
+            )
+            man = self.manifest(policy_id)
+            dense_bytes += int(man.get("export_nbytes", 0))
+            if man["payload"]["kind"] == "delta":
+                n_delta += 1
+        return {
+            "n_policies": len(ids),
+            "n_delta_policies": n_delta,
+            "n_blobs": n_blobs,
+            "blob_bytes": blob_bytes,
+            "manifest_bytes": manifest_bytes,
+            "store_bytes": blob_bytes + manifest_bytes,
+            "dense_bytes": dense_bytes,
+        }
+
+    # -- write path --------------------------------------------------------
+
+    def _write_blob(self, data: bytes) -> str:
+        sha = _sha256_hex(data)
+        path = self._blob_path(sha)
+        if os.path.exists(path):
+            return sha  # content-addressed: identical bytes, one blob
+        fd, tmp = tempfile.mkstemp(
+            dir=os.path.join(self.root, _BLOB_DIR), prefix=".tmp-"
+        )
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(data)
+            os.replace(tmp, path)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+        return sha
+
+    def _read_blob(self, sha: str, what: str) -> bytes:
+        path = self._blob_path(sha)
+        if not os.path.exists(path):
+            raise ArtifactCorrupt(
+                f"{what}: blob sha256-{sha} missing from the store"
+            )
+        with open(path, "rb") as f:
+            data = f.read()
+        if _sha256_hex(data) != sha:
+            raise ArtifactCorrupt(
+                f"{what}: blob sha256-{sha} fails its content hash "
+                "(bytes on disk are corrupt)"
+            )
+        return data
+
+    def put(
+        self,
+        export_dir: str,
+        policy_id: str,
+        base_policy: Optional[str] = None,
+        *,
+        regime: Optional[str] = None,
+        block: Optional[int] = None,
+        tolerance: Optional[float] = None,
+    ) -> Dict[str, Any]:
+        """Store one export dir under ``policy_id``.
+
+        With ``base_policy`` the weights store as a quantized per-leaf
+        delta vs that base (which must hold the SAME program
+        fingerprint — a cross-program delta is refused typed). Every
+        encoded payload proves its own round trip before anything is
+        written; the manifest lands last, atomically."""
+        self._check_policy_id(policy_id)
+        if self.has(policy_id):
+            raise PolicyExists(
+                f"policy {policy_id!r} already published in {self.root}"
+            )
+        if regime is None:
+            regime = flags.get_enum("T2R_POLICY_DELTA_QUANT")
+        if block is None:
+            block = flags.get_int("T2R_POLICY_DELTA_BLOCK")
+        if tolerance is None:
+            tolerance = _delta_tolerance()
+
+        files: Dict[str, bytes] = {}
+        for dirpath, _, names in os.walk(export_dir):
+            for name in names:
+                full = os.path.join(dirpath, name)
+                rel = os.path.relpath(full, export_dir).replace(
+                    os.sep, "/"
+                )
+                with open(full, "rb") as f:
+                    files[rel] = f.read()
+        if _VARIABLES_FILENAME not in files:
+            raise ArtifactStoreError(
+                f"{export_dir} has no {_VARIABLES_FILENAME} — not an "
+                "export dir"
+            )
+        fingerprint = program_fingerprint(files)
+        export_nbytes = sum(len(v) for v in files.values())
+        variables_bytes = files[_VARIABLES_FILENAME]
+
+        payload_entry: Dict[str, Any]
+        envelope: Optional[bytes] = None
+        if base_policy is None:
+            payload_entry = {
+                "kind": "dense",
+                "blob": _sha256_hex(variables_bytes),
+                "nbytes": len(variables_bytes),
+                "base": None,
+                "weights_sha": _sha256_hex(variables_bytes),
+                "weights_nbytes": len(variables_bytes),
+            }
+        else:
+            envelope, payload_entry = self._build_delta(
+                policy_id,
+                base_policy,
+                fingerprint,
+                variables_bytes,
+                regime=regime,
+                block=block,
+                tolerance=tolerance,
+            )
+
+        # Round-trip proof BEFORE any write: the payload we are about
+        # to publish must decode back to the recorded weights hash.
+        if envelope is not None:
+            reconstructed = self._decode_envelope(
+                envelope,
+                expect_fingerprint=fingerprint,
+                base_bytes=self._load_weight_bytes(base_policy),
+                what=f"put({policy_id})",
+            )
+            if _sha256_hex(reconstructed) != payload_entry["weights_sha"]:
+                raise DeltaParityError(
+                    f"policy {policy_id!r}: encoded delta payload does "
+                    "not round-trip to its recorded weights hash — "
+                    "nothing was written"
+                )
+
+        stored_files: Dict[str, Dict[str, Any]] = {}
+        for rel, data in sorted(files.items()):
+            if rel == _VARIABLES_FILENAME and base_policy is not None:
+                continue  # replaced by the delta payload
+            sha = self._write_blob(data)
+            stored_files[rel] = {"blob": sha, "nbytes": len(data)}
+        if envelope is not None:
+            payload_entry["blob"] = self._write_blob(envelope)
+            payload_entry["nbytes"] = len(envelope)
+
+        manifest = {
+            "store_version": STORE_FORMAT_VERSION,
+            "policy_id": policy_id,
+            "fingerprint": fingerprint,
+            "files": stored_files,
+            "payload": payload_entry,
+            "export_nbytes": export_nbytes,
+        }
+        data = json.dumps(manifest, sort_keys=True, indent=1).encode()
+        fd, tmp = tempfile.mkstemp(
+            dir=os.path.join(self.root, _POLICY_DIR), prefix=".tmp-"
+        )
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(data)
+            os.replace(tmp, self._manifest_path(policy_id))
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+        return manifest
+
+    def _build_delta(
+        self,
+        policy_id: str,
+        base_policy: str,
+        fingerprint: str,
+        variables_bytes: bytes,
+        *,
+        regime: str,
+        block: int,
+        tolerance: float,
+    ) -> Tuple[bytes, Dict[str, Any]]:
+        if not self.has(base_policy):
+            raise BaseArtifactMissing(
+                f"policy {policy_id!r} names base {base_policy!r}, "
+                f"which store {self.root} does not hold"
+            )
+        base_manifest = self.manifest(base_policy)
+        if base_manifest["fingerprint"] != fingerprint:
+            raise ArtifactKeyMismatch(
+                f"policy {policy_id!r} (program {fingerprint[:12]}…) is "
+                f"not a sibling of base {base_policy!r} (program "
+                f"{base_manifest['fingerprint'][:12]}…): a delta across "
+                "programs would decode garbage weights"
+            )
+        from flax import serialization
+
+        base_bytes = self._load_weight_bytes(base_policy)
+        base_leaves = dict(
+            _flatten_tree(serialization.msgpack_restore(base_bytes))
+        )
+        new_tree = serialization.msgpack_restore(variables_bytes)
+        new_leaves = _flatten_tree(new_tree)
+
+        leaf_meta: Dict[str, Dict[str, Any]] = {}
+        leaf_payload: Dict[str, Any] = {}
+        reconstructed: Dict[str, Any] = {}
+        n_delta = 0
+        for path, leaf in new_leaves:
+            arr = np.asarray(leaf)
+            base_leaf = base_leaves.get(path)
+            eligible = (
+                base_leaf is not None
+                and np.asarray(base_leaf).shape == arr.shape
+                and np.issubdtype(arr.dtype, np.floating)
+                and regime != "none"
+            )
+            if eligible:
+                base_arr = np.asarray(base_leaf).astype(np.float32)
+                diff = arr.astype(np.float32) - base_arr
+                encoded = _encode_leaf_delta(diff, regime, block)
+                decoded = _decode_leaf_delta(
+                    encoded, regime, block, arr.size
+                )
+                recon = (base_arr.ravel() + decoded).reshape(
+                    arr.shape
+                ).astype(arr.dtype)
+                scale = max(float(np.max(np.abs(arr))), 1e-8)
+                err = float(
+                    np.max(np.abs(recon.astype(np.float32) - arr))
+                )
+                if err <= tolerance * scale:
+                    leaf_meta[path] = {
+                        "enc": "delta",
+                        "shape": [int(d) for d in arr.shape],
+                        "dtype": np.dtype(arr.dtype).name,
+                        "max_abs_err": err,
+                    }
+                    leaf_payload[path] = encoded
+                    reconstructed[path] = recon
+                    n_delta += 1
+                    continue
+            # Parity gate failed (or leaf is new/reshaped/non-float):
+            # THIS LEAF ships dense-exact; the policy still publishes.
+            leaf_meta[path] = {
+                "enc": "dense",
+                "shape": [int(d) for d in np.asarray(arr).shape],
+                "dtype": np.dtype(np.asarray(arr).dtype).name,
+            }
+            leaf_payload[path] = np.asarray(leaf)
+            reconstructed[path] = np.asarray(leaf)
+
+        recon_tree = _unflatten_tree(reconstructed)
+        recon_bytes = serialization.to_bytes(recon_tree)
+        header = {
+            "format_version": STORE_FORMAT_VERSION,
+            "kind": "delta",
+            "policy_id": policy_id,
+            "base": base_policy,
+            "fingerprint": fingerprint,
+            "base_weights_sha": base_manifest["payload"]["weights_sha"],
+            "weights_sha": _sha256_hex(recon_bytes),
+            "regime": regime,
+            "block": int(block),
+            "tolerance": float(tolerance),
+            "leaves": leaf_meta,
+        }
+        envelope = _pack(header, pickle.dumps(leaf_payload, protocol=4))
+        entry = {
+            "kind": "delta",
+            "base": base_policy,
+            "weights_sha": header["weights_sha"],
+            "weights_nbytes": len(recon_bytes),
+            "regime": regime,
+            "block": int(block),
+            "tolerance": float(tolerance),
+            "leaves": {
+                "total": len(leaf_meta),
+                "delta": n_delta,
+                "dense": len(leaf_meta) - n_delta,
+            },
+        }
+        return envelope, entry
+
+    # -- read path ---------------------------------------------------------
+
+    def _decode_envelope(
+        self,
+        envelope: bytes,
+        *,
+        expect_fingerprint: str,
+        base_bytes: bytes,
+        what: str,
+    ) -> bytes:
+        """Full delta read path over in-memory bytes: integrity, then
+        key, then decode + reassembly. Returns the reconstructed
+        variables bytes (NOT yet hash-verified — callers compare vs the
+        manifest's weights_sha so corruption and key errors stay
+        distinct)."""
+        header, payload = _unpack(envelope)
+        if header.get("format_version") != STORE_FORMAT_VERSION:
+            raise ArtifactKeyMismatch(
+                f"{what}: payload format_version "
+                f"{header.get('format_version')} != {STORE_FORMAT_VERSION}"
+            )
+        if header.get("fingerprint") != expect_fingerprint:
+            raise ArtifactKeyMismatch(
+                f"{what}: delta payload is keyed to program "
+                f"{str(header.get('fingerprint'))[:12]}…, this policy "
+                f"serves {expect_fingerprint[:12]}…"
+            )
+        if header.get("base_weights_sha") != _sha256_hex(base_bytes):
+            raise ArtifactKeyMismatch(
+                f"{what}: base weights changed since this delta was "
+                "encoded (base_weights_sha mismatch) — decoding against "
+                "the wrong base would materialize garbage"
+            )
+        try:
+            leaf_payload = pickle.loads(payload)
+            if not isinstance(leaf_payload, dict):
+                raise ValueError("payload is not a leaf dict")
+        except ArtifactStoreError:
+            raise
+        except Exception as err:
+            raise ArtifactCorrupt(
+                f"{what}: delta payload does not unpickle: {err}"
+            ) from err
+        from flax import serialization
+
+        base_leaves = dict(
+            _flatten_tree(serialization.msgpack_restore(base_bytes))
+        )
+        regime = header.get("regime")
+        block = int(header.get("block", 0) or 0)
+        leaves_meta = header.get("leaves") or {}
+        reconstructed: Dict[str, Any] = {}
+        try:
+            for path, meta in leaves_meta.items():
+                entry = leaf_payload[path]
+                shape = tuple(int(d) for d in meta["shape"])
+                dtype = np.dtype(meta["dtype"])
+                if meta["enc"] == "dense":
+                    arr = np.asarray(entry)
+                    if arr.shape != shape or arr.dtype != dtype:
+                        raise ArtifactCorrupt(
+                            f"{what}: dense leaf {path!r} shape/dtype "
+                            "disagrees with its header"
+                        )
+                    reconstructed[path] = arr
+                    continue
+                base_leaf = base_leaves.get(path)
+                if base_leaf is None:
+                    raise ArtifactKeyMismatch(
+                        f"{what}: delta leaf {path!r} has no base leaf"
+                    )
+                size = int(np.prod(shape)) if shape else 1
+                decoded = _decode_leaf_delta(entry, regime, block, size)
+                base_arr = np.asarray(base_leaf).astype(np.float32)
+                reconstructed[path] = (
+                    (base_arr.ravel() + decoded)
+                    .reshape(shape)
+                    .astype(dtype)
+                )
+        except (KeyError, TypeError, ValueError) as err:
+            raise ArtifactCorrupt(
+                f"{what}: delta payload leaves are malformed: {err}"
+            ) from err
+        return serialization.to_bytes(_unflatten_tree(reconstructed))
+
+    def _load_weight_bytes(self, policy_id: str) -> bytes:
+        manifest = self.manifest(policy_id)
+        payload = manifest["payload"]
+        if payload["kind"] == "dense":
+            data = self._read_blob(
+                payload["blob"], f"policy {policy_id!r} dense weights"
+            )
+            return data
+        base = payload["base"]
+        if not self.has(base):
+            raise BaseArtifactMissing(
+                f"policy {policy_id!r} delta-references base {base!r}, "
+                f"which store {self.root} no longer holds"
+            )
+        envelope = self._read_blob(
+            payload["blob"], f"policy {policy_id!r} delta payload"
+        )
+        base_bytes = self._load_weight_bytes(base)
+        recon = self._decode_envelope(
+            envelope,
+            expect_fingerprint=manifest["fingerprint"],
+            base_bytes=base_bytes,
+            what=f"policy {policy_id!r}",
+        )
+        if _sha256_hex(recon) != payload["weights_sha"]:
+            raise ArtifactCorrupt(
+                f"policy {policy_id!r}: reconstructed weights fail "
+                "their recorded hash — refusing the partial/garbled tree"
+            )
+        return recon
+
+    def load_weights(self, policy_id: str) -> bytes:
+        """The policy's variables.msgpack bytes, delta-decoded and
+        HASH-VERIFIED (bitwise-stable across calls and hosts)."""
+        return self._load_weight_bytes(policy_id)
+
+    def materialize(self, policy_id: str, dest_dir: str) -> str:
+        """Reconstruct the full export dir under ``dest_dir``.
+
+        Every file lands from a sha-verified blob; the weights go
+        through the delta read path. Written via a temp dir + rename,
+        so a crashed materialize never looks like an export."""
+        manifest = self.manifest(policy_id)
+        weights = self.load_weights(policy_id)
+        parent = os.path.dirname(os.path.abspath(dest_dir)) or "."
+        os.makedirs(parent, exist_ok=True)
+        tmp = tempfile.mkdtemp(dir=parent, prefix=".materialize-")
+        try:
+            for rel, entry in manifest["files"].items():
+                data = self._read_blob(
+                    entry["blob"], f"policy {policy_id!r} file {rel!r}"
+                )
+                full = os.path.join(tmp, rel.replace("/", os.sep))
+                os.makedirs(os.path.dirname(full), exist_ok=True)
+                with open(full, "wb") as f:
+                    f.write(data)
+            with open(
+                os.path.join(tmp, _VARIABLES_FILENAME), "wb"
+            ) as f:
+                f.write(weights)
+            if os.path.exists(dest_dir):
+                raise ArtifactStoreError(
+                    f"materialize: {dest_dir} already exists"
+                )
+            os.replace(tmp, dest_dir)
+        except BaseException:
+            if os.path.exists(tmp):
+                import shutil
+
+                shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        return dest_dir
+
+    def delete(self, policy_id: str) -> None:
+        """Drop a policy's manifest (blobs stay — other policies may
+        reference them; orphan GC is a separate concern)."""
+        path = self._manifest_path(policy_id)
+        if not os.path.exists(path):
+            raise PolicyNotFound(f"no policy {policy_id!r} to delete")
+        os.unlink(path)

@@ -389,6 +389,75 @@ _declare(
     minimum=0,
 )
 _declare(
+    "T2R_POLICY_COLD_LOAD",
+    _BOOL,
+    True,
+    "Multi-policy replicas (serving/policies.py): load a non-resident "
+    "policy on first use (counted cold load, LRU eviction under the "
+    "memory budget). 0 = a miss is a typed refusal (PolicyEvicted for "
+    "previously-evicted policies, PolicyUnknown otherwise) — the "
+    "placement layer must route to a resident replica.",
+    "tensor2robot_tpu/serving/policies.py",
+)
+_declare(
+    "T2R_POLICY_DELTA_BLOCK",
+    _INT,
+    512,
+    "Quantization block size (elements per scale) for delta-compressed "
+    "sibling payloads in the content-addressed artifact store "
+    "(export/artifact_store.py); each leaf's diff-vs-base is raveled "
+    "and zero-padded to a block multiple before encoding.",
+    "tensor2robot_tpu/export/artifact_store.py",
+    minimum=1,
+)
+_declare(
+    "T2R_POLICY_DELTA_QUANT",
+    _ENUM,
+    "int8",
+    "Wire regime for delta-compressed sibling payloads in the artifact "
+    "store (export/artifact_store.py): per-leaf weight diffs vs the "
+    "named base artifact encode through the blockwise collective codec "
+    "(parallel/collectives.py). 'none' stores the diff dense-exact "
+    "(dedup still applies to program/AOT blobs).",
+    "tensor2robot_tpu/export/artifact_store.py",
+    choices=("none", "fp16", "int8", "fp8_e4m3", "fp8_e5m2"),
+)
+_declare(
+    "T2R_POLICY_DELTA_TOL",
+    _STR,
+    "0.05",
+    "Per-leaf parity-gate tolerance for delta payloads "
+    "(export/artifact_store.py), parsed as a float: decode(delta)+base "
+    "must reconstruct the leaf within this relative L-inf bound or THAT "
+    "LEAF ships dense-exact (gate-fails-write-nothing — demotion is "
+    "per leaf and recorded in the manifest, never a partial policy).",
+    "tensor2robot_tpu/export/artifact_store.py",
+)
+_declare(
+    "T2R_POLICY_MAX_RESIDENT",
+    _INT,
+    0,
+    "Hard cap on the number of policies resident on one multi-policy "
+    "replica (serving/policies.py); the least-recently-used idle policy "
+    "is evicted to admit a new one. 0 = unbounded (the byte budget "
+    "T2R_POLICY_MEM_BUDGET still applies).",
+    "tensor2robot_tpu/serving/policies.py",
+    minimum=0,
+)
+_declare(
+    "T2R_POLICY_MEM_BUDGET",
+    _INT,
+    0,
+    "Resident-policy memory budget in MB per multi-policy replica "
+    "(serving/policies.py): loading a policy that would push the sum of "
+    "resident policies' weight bytes over the budget first evicts "
+    "least-recently-used idle policies (typed PolicyEvicted on later "
+    "use when cold loads are disabled; counted cold-load reload "
+    "otherwise). 0 = unbounded.",
+    "tensor2robot_tpu/serving/policies.py",
+    minimum=0,
+)
+_declare(
     "T2R_POOL_BACKWARD",
     _ENUM,
     "auto",

@@ -54,6 +54,14 @@ _EXPORTS = {
     "ReplicaSpec": "replica",
     "policy_server_factory": "replica",
     "mock_server_factory": "replica",
+    "multi_policy_mock_factory": "replica",
+    "multi_policy_store_factory": "replica",
+    # policies.py — the multi-policy resident set behind one replica.
+    "MultiPolicyServer": "policies",
+    "PolicyError": "policies",
+    "PolicyUnknown": "policies",
+    "PolicyEvicted": "policies",
+    "PolicyLoadFailed": "policies",
     # compile_cache.py — persistent XLA compile cache for replicas.
     "enable_compile_cache": "compile_cache",
     "enable_compile_cache_for": "compile_cache",
@@ -126,9 +134,18 @@ if TYPE_CHECKING:  # pragma: no cover — static analyzers only
         RequestSpan,
         ServerMetrics,
     )
+    from tensor2robot_tpu.serving.policies import (  # noqa: F401
+        MultiPolicyServer,
+        PolicyError,
+        PolicyEvicted,
+        PolicyLoadFailed,
+        PolicyUnknown,
+    )
     from tensor2robot_tpu.serving.replica import (  # noqa: F401
         ReplicaSpec,
         mock_server_factory,
+        multi_policy_mock_factory,
+        multi_policy_store_factory,
         policy_server_factory,
     )
     from tensor2robot_tpu.serving.router import (  # noqa: F401

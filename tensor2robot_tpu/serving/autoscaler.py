@@ -270,6 +270,28 @@ class Autoscaler:
     def snapshot(self) -> Dict:
         with self._lock:
             actions = list(self._actions)
+        # Placement surface for multi-policy fleets: which policies each
+        # replica holds resident and its eviction/cold-load counters,
+        # read off the router's health-derived replica snapshots (the
+        # prewarm_source discipline — backend-independent; entries are
+        # omitted entirely on single-policy fleets). A capacity decision
+        # that ignores residency scales up a replica that must cold-load
+        # the hot policy before it helps.
+        policies = []
+        try:
+            for r in self._router.snapshot()["replicas"]:
+                if r.get("resident_policies") is None:
+                    continue
+                policies.append(
+                    {
+                        "replica": r["index"],
+                        "resident_policies": r["resident_policies"],
+                        "policy_evictions": r.get("policy_evictions"),
+                        "policy_cold_loads": r.get("policy_cold_loads"),
+                    }
+                )
+        except Exception:  # router mid-stop; placement view is advisory
+            policies = []
         # Scale-up latency attribution: for every replica this scaler
         # spawned, the router-measured boot duration and the restore
         # tier each bucket booted from — the record that says whether a
@@ -298,6 +320,7 @@ class Autoscaler:
                 "counters": dict(self._counters),
                 "actions": actions,
                 "scale_up_boots": boots,
+                "policies": policies,
                 "peak_replicas_up": self._peak_up,
                 "policy": {
                     "min_replicas": self.min_replicas,

@@ -179,11 +179,11 @@ class GateResponse:
 
     __slots__ = (
         "outputs", "model_version", "spans", "tenant", "tier", "pool",
-        "replica", "attempts", "hedged", "coalesced",
+        "replica", "attempts", "hedged", "coalesced", "policy_id",
     )
 
     def __init__(self, outputs, model_version, spans, tenant, tier, pool,
-                 replica, attempts, hedged, coalesced):
+                 replica, attempts, hedged, coalesced, policy_id=None):
         self.outputs = outputs
         self.model_version = model_version
         self.spans = spans
@@ -194,6 +194,7 @@ class GateResponse:
         self.attempts = attempts
         self.hedged = hedged
         self.coalesced = coalesced
+        self.policy_id = policy_id
 
 
 class GateFuture:
@@ -245,11 +246,11 @@ class GateFuture:
 class _GateRequest:
     __slots__ = (
         "id", "tenant", "features", "deadline", "queue_deadline", "future",
-        "t_submit", "digest", "entry", "pool_retries",
+        "t_submit", "digest", "entry", "pool_retries", "policy_id",
     )
 
     def __init__(self, request_id, tenant, features, deadline,
-                 queue_deadline):
+                 queue_deadline, policy_id=None):
         self.id = request_id
         self.tenant = tenant
         self.features = features
@@ -260,15 +261,22 @@ class _GateRequest:
         self.digest: Optional[bytes] = None
         self.entry: Optional["_CoalesceEntry"] = None  # led by this request
         self.pool_retries = 0
+        self.policy_id: Optional[str] = policy_id
 
 
 class _Tenant:
-    """Runtime state for one binding: token bucket + circuit + counters."""
+    """Runtime state for one binding: token buckets + circuit + counters.
+
+    Admission is keyed (tenant, policy_id): each policy stream a tenant
+    names gets its OWN token bucket at the binding's rate/burst (key
+    None is the unnamed/default stream, behaviorally identical to the
+    pre-multi-policy gateway). One policy's burst therefore throttles
+    that policy's stream, never the tenant's traffic to other policies.
+    """
 
     __slots__ = (
-        "binding", "scope", "tier", "tokens", "burst", "rate",
-        "last_refill", "consecutive_failures", "suspended_until",
-        "counters",
+        "binding", "scope", "tier", "burst", "rate", "buckets",
+        "consecutive_failures", "suspended_until", "counters",
     )
 
     def __init__(self, binding: TenantBinding, scope: str, rate: float,
@@ -278,11 +286,33 @@ class _Tenant:
         self.tier = binding.tier
         self.rate = rate
         self.burst = burst
-        self.tokens = burst  # a fresh tenant may burst immediately
-        self.last_refill = time.monotonic()
+        # policy_id -> [tokens, last_refill]; fresh buckets may burst
+        # immediately.
+        self.buckets: Dict[Optional[str], List[float]] = {}
         self.consecutive_failures = 0
         self.suspended_until = 0.0
         self.counters: Dict[str, int] = {}
+
+    def take_token(self, policy_id: Optional[str], now: float) -> bool:
+        bucket = self.buckets.get(policy_id)
+        if bucket is None:
+            bucket = self.buckets[policy_id] = [self.burst, now]
+        bucket[0] = min(
+            self.burst, bucket[0] + (now - bucket[1]) * self.rate
+        )
+        bucket[1] = now
+        if bucket[0] < 1.0:
+            return False
+        bucket[0] -= 1.0
+        return True
+
+    def tokens_now(self, policy_id: Optional[str], now: float) -> float:
+        bucket = self.buckets.get(policy_id)
+        if bucket is None:
+            return self.burst
+        return min(
+            self.burst, bucket[0] + (now - bucket[1]) * self.rate
+        )
 
 
 class _CoalesceEntry:
@@ -290,7 +320,9 @@ class _CoalesceEntry:
 
     __slots__ = ("digest", "leader", "followers", "epoch", "resolved")
 
-    def __init__(self, digest: bytes, leader: _GateRequest, epoch: int):
+    def __init__(
+        self, digest: bytes, leader: _GateRequest, epoch: Tuple[int, int]
+    ):
         self.digest = digest
         self.leader = leader
         self.followers: List[_GateRequest] = []
@@ -303,7 +335,8 @@ class _Pool:
 
     __slots__ = (
         "name", "router", "queues", "cond", "coalesce", "swap_epoch",
-        "thread", "last_sweep",
+        "policy_epochs", "thread", "last_sweep", "model_fingerprint",
+        "fingerprint_epoch",
     )
 
     def __init__(self, name: str, router: FleetRouter):
@@ -313,18 +346,53 @@ class _Pool:
         self.cond = threading.Condition()
         self.coalesce: Dict[bytes, _CoalesceEntry] = {}
         self.swap_epoch = 0
+        # Per-policy publish epochs: rolling_swap(policy_id=...) bumps
+        # ONE policy's epoch, fencing only that policy's coalesce
+        # entries; the global swap_epoch fences everything.
+        self.policy_epochs: Dict[str, int] = {}
         self.thread: Optional[threading.Thread] = None
         self.last_sweep = 0.0
+        # Cached recorded artifact fingerprint (digest ingredient),
+        # refreshed from the router snapshot when the swap epoch moves.
+        self.model_fingerprint: Optional[str] = None
+        self.fingerprint_epoch = -1
 
     def depth(self) -> int:
         return sum(len(q) for q in self.queues.values())
 
+    def epoch_key(self, policy_id: Optional[str]) -> Tuple[int, int]:
+        """Called under self.cond: the fencing epoch a coalesce entry
+        for `policy_id` is stamped with and compared against."""
+        return (
+            self.swap_epoch,
+            self.policy_epochs.get(policy_id, 0) if policy_id else 0,
+        )
 
-def observation_digest(arrays: Mapping[str, np.ndarray]) -> bytes:
+
+def observation_digest(
+    arrays: Mapping[str, np.ndarray],
+    policy_id: Optional[str] = None,
+    model_fingerprint: Optional[str] = None,
+) -> bytes:
     """Content hash over the PACKED feature bytes (key, dtype, shape,
-    buffer) — two requests coalesce iff this matches, which is the
-    bitwise-identical-observation contract."""
+    buffer) PLUS the serving identity — two requests coalesce iff this
+    matches, which is the bitwise-identical-observation contract.
+
+    The identity fields are the fix for a real coalescing bug: hashing
+    observations alone let two requests naming DIFFERENT policies (or
+    arriving across an artifact republish with identical bytes) share
+    one dispatch, silently serving tenant A's observation with tenant
+    B's policy outputs. `policy_id` and `model_fingerprint` (the
+    artifact's recorded AOT fingerprint, or the pool name when the
+    backend records none) are domain-separated from the feature bytes
+    so `{"a": 1}` under policy "x" can never collide with a crafted
+    feature key."""
     h = hashlib.blake2b(digest_size=16)
+    h.update(b"\x00policy\x00")
+    h.update((policy_id or "").encode())
+    h.update(b"\x00model\x00")
+    h.update((model_fingerprint or "").encode())
+    h.update(b"\x00features\x00")
     for key in sorted(arrays):
         value = arrays[key]
         h.update(key.encode())
@@ -519,11 +587,17 @@ class Gateway:
         tenant: str,
         features: Mapping[str, Any],
         deadline_ms: Optional[float] = None,
+        policy_id: Optional[str] = None,
     ) -> GateFuture:
         """Admits one request for `tenant`. Typed admission failures
         (UnknownTenant / TenantSuspended / TenantThrottled / TierShed /
         GatewayClosed) raise synchronously; everything after admission
-        resolves through the returned future, exactly once, always."""
+        resolves through the returned future, exactly once, always.
+        `policy_id` names the policy on a multi-policy pool: admission
+        meters the (tenant, policy) stream, the coalescing key folds the
+        policy in (identical observations against different policies
+        never share a dispatch), and the router places the request
+        policy-aware."""
         if not self._started or self._closed:
             raise GatewayClosed("gateway is not running")
         state = self._tenants.get(tenant)
@@ -556,20 +630,16 @@ class Gateway:
                     f"{(state.suspended_until - now) * 1e3:.0f}ms after "
                     f"{state.consecutive_failures} consecutive failures"
                 )
-            # Token bucket: continuous refill, one token per admission.
-            state.tokens = min(
-                state.burst,
-                state.tokens + (now - state.last_refill) * state.rate,
-            )
-            state.last_refill = now
-            if state.tokens < 1.0:
+            # Token bucket: continuous refill, one token per admission,
+            # metered per (tenant, policy) stream.
+            if not state.take_token(policy_id, now):
                 self._count("throttled")
                 self._tcount(state, "throttled")
+                stream = f" (policy {policy_id!r})" if policy_id else ""
                 raise TenantThrottled(
-                    f"tenant {tenant!r} over quota "
+                    f"tenant {tenant!r}{stream} over quota "
                     f"({state.rate:g} req/s, burst {state.burst:g})"
                 )
-            state.tokens -= 1.0
         arrays = {k: np.asarray(v) for k, v in features.items()}
         deadline = now + (
             deadline_ms / 1e3 if deadline_ms is not None
@@ -584,11 +654,16 @@ class Gateway:
             deadline, now + budget
         )
         request = _GateRequest(
-            next(self._ids), state, arrays, deadline, queue_deadline
+            next(self._ids), state, arrays, deadline, queue_deadline,
+            policy_id,
         )
         pool = self._pools[state.binding.pool]
         if self._coalesce_enabled:
-            request.digest = observation_digest(arrays)
+            request.digest = observation_digest(
+                arrays,
+                policy_id=policy_id,
+                model_fingerprint=self._pool_fingerprint(pool),
+            )
             if self._try_join(pool, request):
                 return request.future
         self._enqueue(pool, request)
@@ -600,8 +675,11 @@ class Gateway:
         features: Mapping[str, Any],
         deadline_ms: Optional[float] = None,
         timeout: Optional[float] = None,
+        policy_id: Optional[str] = None,
     ) -> GateResponse:
-        future = self.submit(tenant, features, deadline_ms=deadline_ms)
+        future = self.submit(
+            tenant, features, deadline_ms=deadline_ms, policy_id=policy_id
+        )
         if timeout is None:
             timeout = (
                 deadline_ms / 1e3 if deadline_ms is not None
@@ -609,19 +687,47 @@ class Gateway:
             ) + 30.0
         return future.result(timeout)
 
+    def _pool_fingerprint(self, pool: _Pool) -> str:
+        """Digest ingredient: the pool's recorded artifact fingerprint,
+        cached per swap epoch (a publish may change the artifact, so the
+        cache refreshes off the router snapshot after every epoch bump);
+        the pool NAME is the fallback identity when no replica records a
+        fingerprint (mock backends) — distinct pools still never share a
+        coalescing keyspace."""
+        with pool.cond:
+            epoch = pool.swap_epoch
+            if pool.fingerprint_epoch == epoch:
+                return pool.model_fingerprint
+        fingerprint = None
+        try:
+            for rep in pool.router.snapshot().get("replicas", ()):
+                fingerprint = rep.get("model_fingerprint")
+                if fingerprint:
+                    break
+        except Exception:
+            fingerprint = None
+        fingerprint = str(fingerprint) if fingerprint else f"pool:{pool.name}"
+        with pool.cond:
+            pool.model_fingerprint = fingerprint
+            pool.fingerprint_epoch = epoch
+        return fingerprint
+
     def _joinable(self, pool: _Pool, request: _GateRequest) -> bool:
-        """Called under pool.cond. Joinable = same digest, same swap
-        epoch (never across a model-version flip), not yet resolved —
-        AND the leader must not drag the rider down: a rider never
-        joins a LOWER-priority leader (whose shed/starvation fate it
-        would inherit — priority inversion), and never a leader whose
-        deadline outlives its own (the dispatch carries the LEADER's
-        budget, so the rider would be served past its deadline)."""
+        """Called under pool.cond. Joinable = same digest (which folds
+        policy_id and artifact fingerprint), same epoch key — global
+        swap epoch AND the policy's own publish epoch, so neither a
+        fleet-wide publish nor this policy's rolling swap lets a rider
+        cross a version flip — not yet resolved, AND the leader must not
+        drag the rider down: a rider never joins a LOWER-priority leader
+        (whose shed/starvation fate it would inherit — priority
+        inversion), and never a leader whose deadline outlives its own
+        (the dispatch carries the LEADER's budget, so the rider would be
+        served past its deadline)."""
         entry = pool.coalesce.get(request.digest)
         return (
             entry is not None
             and not entry.resolved
-            and entry.epoch == pool.swap_epoch
+            and entry.epoch == pool.epoch_key(request.policy_id)
             and _TIER_RANK[entry.leader.tenant.tier]
             <= _TIER_RANK[request.tenant.tier]
             and entry.leader.deadline <= request.deadline
@@ -668,7 +774,8 @@ class Gateway:
                     )
             if self._coalesce_enabled and request.digest is not None:
                 request.entry = _CoalesceEntry(
-                    request.digest, request, pool.swap_epoch
+                    request.digest, request,
+                    pool.epoch_key(request.policy_id),
                 )
                 # May shadow a stale (older-epoch / chaos-bypassed)
                 # entry; that entry stays reachable through ITS leader's
@@ -762,7 +869,9 @@ class Gateway:
             remaining_ms = (request.deadline - time.monotonic()) * 1e3
             try:
                 router_future = pool.router.submit(
-                    request.features, deadline_ms=remaining_ms
+                    request.features,
+                    deadline_ms=remaining_ms,
+                    policy_id=request.policy_id,
                 )
             except RouterClosed:
                 self._resolve_failure(
@@ -938,7 +1047,7 @@ class Gateway:
                     response.outputs, response.model_version, spans,
                     state.binding.tenant, state.tier, pool.name,
                     response.replica, response.attempts, response.hedged,
-                    coalesced,
+                    coalesced, member.policy_id,
                 ),
                 None,
             )
@@ -985,17 +1094,34 @@ class Gateway:
     # -- fleet operations -----------------------------------------------------
 
     def rolling_swap(
-        self, pool: str = "default", swap_timeout_s: float = 60.0
+        self,
+        pool: str = "default",
+        swap_timeout_s: float = 60.0,
+        policy_id: Optional[str] = None,
     ) -> Dict:
         """Publishes the newest export through `pool` via the router's
-        zero-downtime roll. The pool's swap epoch bumps FIRST, so no
-        request admitted after the publish began can ride a dispatch
-        from before it (the coalesce version-flip guard)."""
+        zero-downtime roll. The fencing epoch bumps FIRST, so no request
+        admitted after the publish began can ride a dispatch from before
+        it (the coalesce version-flip guard).
+
+        With `policy_id`, the roll is scoped to ONE policy on a
+        multi-policy pool: only that policy's publish epoch bumps (its
+        coalesce entries are fenced; every other policy's entries keep
+        accepting riders) and only that policy's server swaps per
+        replica — one policy's publish never blips another policy's
+        traffic."""
         state = self._pools[pool]
         with state.cond:
-            state.swap_epoch += 1
+            if policy_id is None:
+                state.swap_epoch += 1
+            else:
+                state.policy_epochs[policy_id] = (
+                    state.policy_epochs.get(policy_id, 0) + 1
+                )
         self._count("rolling_swaps")
-        return state.router.rolling_swap(swap_timeout_s=swap_timeout_s)
+        return state.router.rolling_swap(
+            swap_timeout_s=swap_timeout_s, policy_id=policy_id
+        )
 
     # -- introspection --------------------------------------------------------
 
@@ -1024,15 +1150,15 @@ class Gateway:
                     "burst": state.burst,
                     # Effective tokens NOW (refill is lazy at admission;
                     # reporting the stored value would show a bucket
-                    # frozen at its last submit).
-                    "tokens": round(
-                        min(
-                            state.burst,
-                            state.tokens
-                            + (now - state.last_refill) * state.rate,
-                        ),
-                        3,
-                    ),
+                    # frozen at its last submit). The unnamed key is the
+                    # default stream — single-policy traffic reads as it
+                    # always did.
+                    "tokens": round(state.tokens_now(None, now), 3),
+                    "policy_tokens": {
+                        pid: round(state.tokens_now(pid, now), 3)
+                        for pid in state.buckets
+                        if pid is not None
+                    },
                     "circuit_open": time.monotonic() < state.suspended_until,
                     "counters": dict(state.counters),
                 }
@@ -1047,6 +1173,8 @@ class Gateway:
                     },
                     "coalesce_open": len(pool.coalesce),
                     "swap_epoch": pool.swap_epoch,
+                    "policy_epochs": dict(pool.policy_epochs),
+                    "model_fingerprint": pool.model_fingerprint,
                 }
         return {
             "counters": counters,

@@ -1,0 +1,356 @@
+"""Multi-policy replica backend: a resident set of policy servers.
+
+One replica process, N policies (ROADMAP item 2): the replica hosts a
+RESIDENT SET of started policy servers keyed by policy id — the base
+artifact's payload is shared through the content-addressed store
+(export/artifact_store.py) and each sibling materializes from its delta
+payload on load. Requests name their policy (`submit(policy_id=...)`);
+a miss takes the COLD-LOAD path (counted) or a typed refusal, and the
+resident set stays under a MEMORY BUDGET by evicting the
+least-recently-used idle policy (counted, typed `PolicyEvicted` on
+later use when cold loads are off).
+
+This module is deliberately jax-free (the replica.py discipline): the
+heavy stack loads inside the `loader` callable, which is the backend
+seam — the production loader materializes an export dir from the store
+and boots a PolicyServer with the shared bucket ladder
+(server.exported_policy_loader); the mock loader builds a
+policy-parameterized `_MockServer` in microseconds.
+
+Flags (flags.py): `T2R_POLICY_MEM_BUDGET` (MB, 0 = unbounded),
+`T2R_POLICY_MAX_RESIDENT` (count, 0 = unbounded),
+`T2R_POLICY_COLD_LOAD` (0 = misses refuse typed instead of loading).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Any, Callable, Dict, Iterable, List, Optional
+
+from tensor2robot_tpu import flags
+from tensor2robot_tpu.utils.errors import best_effort
+
+__all__ = [
+    "MultiPolicyServer",
+    "PolicyError",
+    "PolicyUnknown",
+    "PolicyEvicted",
+    "PolicyLoadFailed",
+]
+
+
+class PolicyError(RuntimeError):
+    """Base class for multi-policy residency failures."""
+
+
+class PolicyUnknown(PolicyError):
+    """The policy id is not in this replica's catalog (or is not
+    resident while cold loads are disabled and it was never evicted)."""
+
+
+class PolicyEvicted(PolicyError):
+    """The policy WAS resident, was evicted under the memory budget,
+    and cold loads are disabled — the placement layer must route this
+    request to a replica where the policy is still resident."""
+
+
+class PolicyLoadFailed(PolicyError):
+    """The backend loader raised: the policy exists in the catalog but
+    could not be materialized/booted on this replica."""
+
+
+class _Resident:
+    __slots__ = ("server", "mem_bytes", "last_used", "active")
+
+    def __init__(self, server: Any, mem_bytes: int):
+        self.server = server
+        self.mem_bytes = int(mem_bytes)
+        self.last_used = time.monotonic()
+        self.active = 0  # submits currently between acquire and enqueue
+
+
+class MultiPolicyServer:
+    """Resident set of policy servers behind one replica-facing surface.
+
+    ``loader(policy_id)`` returns a STARTED server-like object
+    (`submit(features, deadline_ms=...)`, `snapshot()`,
+    `hot_swap(wait=...)`, `stop()`); its weight footprint comes from
+    ``mem_bytes_fn(policy_id, server)`` (default: the server's
+    ``mem_bytes`` attribute, else 0 — unbudgeted). Loads are
+    single-flight per policy and happen OUTSIDE the resident-set lock;
+    eviction picks the least-recently-used policy with no submit in
+    flight (a drained victim completes its queued work in ``stop``).
+    """
+
+    multi_policy = True
+
+    def __init__(
+        self,
+        loader: Callable[[str], Any],
+        catalog: Iterable[str],
+        default_policy: Optional[str] = None,
+        *,
+        mem_budget_mb: Optional[int] = None,
+        max_resident: Optional[int] = None,
+        cold_load: Optional[bool] = None,
+        preload: Iterable[str] = (),
+        mem_bytes_fn: Optional[Callable[[str, Any], int]] = None,
+    ):
+        self._loader = loader
+        self._catalog = list(dict.fromkeys(catalog))
+        if not self._catalog:
+            raise ValueError("multi-policy server needs a non-empty catalog")
+        self._catalog_set = set(self._catalog)
+        self._default = default_policy or self._catalog[0]
+        if self._default not in self._catalog_set:
+            raise ValueError(
+                f"default policy {self._default!r} is not in the catalog"
+            )
+        if mem_budget_mb is None:
+            mem_budget_mb = flags.get_int("T2R_POLICY_MEM_BUDGET")
+        if max_resident is None:
+            max_resident = flags.get_int("T2R_POLICY_MAX_RESIDENT")
+        if cold_load is None:
+            cold_load = flags.get_bool("T2R_POLICY_COLD_LOAD")
+        self._mem_budget = int(mem_budget_mb) << 20 if mem_budget_mb else 0
+        self._max_resident = int(max_resident) if max_resident else 0
+        self._cold_load = bool(cold_load)
+        self._mem_bytes_fn = mem_bytes_fn or (
+            lambda pid, server: int(getattr(server, "mem_bytes", 0))
+        )
+        self._resident: "collections.OrderedDict[str, _Resident]" = (
+            collections.OrderedDict()
+        )
+        self._evicted: set = set()
+        self._counters = {
+            "policy_loads": 0,
+            "policy_cold_loads": 0,
+            "policy_evictions": 0,
+        }
+        self._lock = threading.RLock()
+        self._load_locks: Dict[str, threading.Lock] = {}
+        self._closed = False
+        for policy_id in preload:
+            self._acquire(policy_id, cold=False)
+            self._release(policy_id)
+
+    # -- residency ---------------------------------------------------------
+
+    def is_resident(self, policy_id: str) -> bool:
+        with self._lock:
+            return policy_id in self._resident
+
+    def resident_policies(self) -> List[str]:
+        """LRU order, least-recently-used first."""
+        with self._lock:
+            return list(self._resident)
+
+    def policy_version(self, policy_id: str) -> int:
+        with self._lock:
+            res = self._resident.get(policy_id)
+            server = res.server if res is not None else None
+        if server is None:
+            return -1
+        version = getattr(server, "model_version", None)
+        if version is not None:
+            return int(version)
+        try:
+            return int(server.snapshot().get("model_version", -1))
+        except Exception:
+            return -1
+
+    @property
+    def model_version(self) -> int:
+        return self.policy_version(self._default)
+
+    def _acquire(self, policy_id: str, cold: bool) -> Any:
+        """Resident server for `policy_id`, loading it if allowed; bumps
+        the LRU clock and the active guard (pair with `_release`)."""
+        if self._closed:
+            raise PolicyError("multi-policy server is stopped")
+        with self._lock:
+            res = self._resident.get(policy_id)
+            if res is not None:
+                self._resident.move_to_end(policy_id)
+                res.last_used = time.monotonic()
+                res.active += 1
+                return res.server
+            if policy_id not in self._catalog_set:
+                raise PolicyUnknown(
+                    f"policy {policy_id!r} is not in this replica's "
+                    f"catalog of {len(self._catalog)} policies"
+                )
+            if cold and not self._cold_load:
+                if policy_id in self._evicted:
+                    raise PolicyEvicted(
+                        f"policy {policy_id!r} was evicted under the "
+                        "memory budget and cold loads are disabled "
+                        "(T2R_POLICY_COLD_LOAD=0) — route to a replica "
+                        "where it is resident"
+                    )
+                raise PolicyUnknown(
+                    f"policy {policy_id!r} is not resident and cold "
+                    "loads are disabled (T2R_POLICY_COLD_LOAD=0)"
+                )
+            load_lock = self._load_locks.setdefault(
+                policy_id, threading.Lock()
+            )
+        with load_lock:  # single-flight; the load runs OUTSIDE self._lock
+            with self._lock:
+                res = self._resident.get(policy_id)
+                if res is not None:  # raced: another thread loaded it
+                    self._resident.move_to_end(policy_id)
+                    res.last_used = time.monotonic()
+                    res.active += 1
+                    return res.server
+            try:
+                server = self._loader(policy_id)
+            except PolicyError:
+                raise
+            except Exception as err:
+                raise PolicyLoadFailed(
+                    f"loading policy {policy_id!r} failed: "
+                    f"{type(err).__name__}: {err}"
+                ) from err
+            mem = int(self._mem_bytes_fn(policy_id, server))
+            victims: List[Any] = []
+            with self._lock:
+                self._evict_for(mem, victims)
+                res = _Resident(server, mem)
+                res.active = 1
+                self._resident[policy_id] = res
+                self._evicted.discard(policy_id)
+                self._counters["policy_loads"] += 1
+                if cold:
+                    self._counters["policy_cold_loads"] += 1
+        for victim in victims:
+            # An eviction victim failing to stop cleanly must not fail
+            # the load that displaced it.
+            best_effort(victim.stop)
+        return server
+
+    def _release(self, policy_id: str) -> None:
+        with self._lock:
+            res = self._resident.get(policy_id)
+            if res is not None and res.active > 0:
+                res.active -= 1
+
+    def _evict_for(self, incoming_mem: int, victims: List[Any]) -> None:
+        """Under self._lock: pop LRU idle policies until the incoming
+        load fits the budget/count caps. A policy larger than the whole
+        budget still loads once everything idle is out — the budget is
+        eviction pressure, not an admission refusal."""
+
+        def over() -> bool:
+            if self._max_resident and (
+                len(self._resident) + 1 > self._max_resident
+            ):
+                return True
+            if self._mem_budget:
+                total = sum(r.mem_bytes for r in self._resident.values())
+                return total + incoming_mem > self._mem_budget
+            return False
+
+        while over():
+            victim_id = None
+            for pid, res in self._resident.items():  # LRU order
+                if res.active == 0:
+                    victim_id = pid
+                    break
+            if victim_id is None:
+                return  # everything busy: admit over budget, retry later
+            res = self._resident.pop(victim_id)
+            self._evicted.add(victim_id)
+            self._counters["policy_evictions"] += 1
+            victims.append(res.server)
+
+    # -- server surface ----------------------------------------------------
+
+    def submit(
+        self,
+        features,
+        deadline_ms: Optional[float] = None,
+        policy_id: Optional[str] = None,
+    ):
+        policy_id = policy_id or self._default
+        server = self._acquire(policy_id, cold=True)
+        try:
+            if deadline_ms is None:
+                return server.submit(features)
+            return server.submit(features, deadline_ms=deadline_ms)
+        finally:
+            self._release(policy_id)
+
+    def hot_swap(
+        self, wait: bool = False, policy_id: Optional[str] = None
+    ) -> bool:
+        """Swap ONE policy's server (default policy when unnamed). A
+        non-resident policy swaps trivially: the next cold load
+        materializes whatever the store now holds."""
+        policy_id = policy_id or self._default
+        with self._lock:
+            res = self._resident.get(policy_id)
+            server = res.server if res is not None else None
+        if server is None:
+            if policy_id not in self._catalog_set:
+                raise PolicyUnknown(
+                    f"cannot swap unknown policy {policy_id!r}"
+                )
+            return True
+        return bool(server.hot_swap(wait=wait))
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            resident = list(self._resident)
+            counters = dict(self._counters)
+            mem = {
+                pid: res.mem_bytes
+                for pid, res in self._resident.items()
+            }
+            default_res = self._resident.get(self._default)
+            anchor = (
+                default_res.server
+                if default_res is not None
+                else next(iter(self._resident.values())).server
+                if self._resident
+                else None
+            )
+        snap: Dict[str, Any] = {}
+        if anchor is not None:
+            try:
+                snap = dict(anchor.snapshot())
+            except Exception:
+                snap = {}
+        versions = {pid: self.policy_version(pid) for pid in resident}
+        snap.update(
+            {
+                "multi_policy": True,
+                "model_version": versions.get(self._default, -1),
+                # Backend-independent placement surface (the
+                # prewarm_source discipline): the router and autoscaler
+                # read these off health snapshots without knowing which
+                # backend produced them.
+                "resident_policies": resident,
+                "policy_loads": counters["policy_loads"],
+                "policy_cold_loads": counters["policy_cold_loads"],
+                "policy_evictions": counters["policy_evictions"],
+                "policy_mem_bytes": mem,
+                "policy_mem_budget_bytes": self._mem_budget,
+                "policy_versions": versions,
+                "default_policy": self._default,
+                "catalog_size": len(self._catalog),
+            }
+        )
+        return snap
+
+    def stop(self) -> None:
+        with self._lock:
+            self._closed = True
+            servers = [res.server for res in self._resident.values()]
+            self._resident.clear()
+        for server in servers:
+            # Shutdown is best-effort per policy; one wedged backend
+            # must not strand the rest.
+            best_effort(server.stop)

@@ -20,10 +20,17 @@ router's retry/hedge/eviction logic exists for.
 Wire protocol (all tuples, pickled by multiprocessing):
 
   router -> replica (request queue):
-    ("req", req_id, attempt, deadline_wall_s, payload)   payload: transport.py
+    ("req", req_id, attempt, deadline_wall_s, payload[, policy_id])
+                                                         payload: transport.py
     ("health", probe_id)
-    ("swap", swap_id, deadline_wall_s)
+    ("swap", swap_id, deadline_wall_s[, policy_id])
     ("stop",)
+
+The optional trailing policy_id targets one policy of a MULTI-POLICY
+backend (serving/policies.py, `multi_policy = True`); absent or None
+means the backend's default. A single-policy backend receiving a
+policy-addressed request replies with a typed PolicyUnknown error —
+never silently serving the wrong weights.
 
   replica -> router (shared response queue):
     ("started", index, version, pid)
@@ -57,7 +64,9 @@ __all__ = [
     "ReplicaSpec",
     "replica_main",
     "policy_server_factory",
+    "multi_policy_store_factory",
     "mock_server_factory",
+    "multi_policy_mock_factory",
 ]
 
 
@@ -120,7 +129,16 @@ def replica_main(index: int, spec: ReplicaSpec, request_q, response_q,
     chaos.maybe_fire("boot")
     response_q.put(("started", index, _server_version(server), pid))
 
-    pending_swap: Optional[Tuple[int, int, float]] = None  # id, old_v, deadline
+    # id, old_version, deadline, policy_id (None = whole-backend swap)
+    pending_swap: Optional[Tuple[int, int, float, Optional[str]]] = None
+
+    def _version_of(policy_id: Optional[str]) -> int:
+        if policy_id is not None and getattr(server, "multi_policy", False):
+            try:
+                return int(server.policy_version(policy_id))
+            except Exception:
+                return -1
+        return _server_version(server)
 
     def post_reply(req_id: int, attempt: int, body) -> None:
         crc, blob = transport.pack(body)
@@ -132,7 +150,8 @@ def replica_main(index: int, spec: ReplicaSpec, request_q, response_q,
         # Router gone -> best effort; our process is about to be reaped.
         best_effort(response_q.put, ("rsp", index, req_id, attempt, crc, blob))
 
-    def on_request(req_id: int, attempt: int, deadline_wall: float, payload):
+    def on_request(req_id: int, attempt: int, deadline_wall: float, payload,
+                   policy_id: Optional[str] = None):
         chaos.maybe_fire("recv")
         try:
             features = transport.decode_request(payload, free_q, cache)
@@ -147,9 +166,23 @@ def replica_main(index: int, spec: ReplicaSpec, request_q, response_q,
                  "deadline passed before the replica dequeued the request"),
             )
             return
+        if policy_id is not None and not getattr(server, "multi_policy", False):
+            post_reply(
+                req_id, attempt,
+                ("error", "PolicyUnknown",
+                 f"request names policy {policy_id!r} but this replica "
+                 "runs a single-policy backend"),
+            )
+            return
         try:
-            future = server.submit(features, deadline_ms=remaining_ms)
-        except Exception as err:  # typed submit failures (queue full, closed)
+            if policy_id is None:
+                future = server.submit(features, deadline_ms=remaining_ms)
+            else:
+                future = server.submit(
+                    features, deadline_ms=remaining_ms, policy_id=policy_id
+                )
+        except Exception as err:  # typed submit failures (queue full,
+            # closed, PolicyUnknown/PolicyEvicted residency refusals)
             post_reply(req_id, attempt, ("error", type(err).__name__, str(err)))
             return
 
@@ -176,8 +209,8 @@ def replica_main(index: int, spec: ReplicaSpec, request_q, response_q,
         nonlocal pending_swap
         if pending_swap is None:
             return
-        swap_id, old_version, deadline = pending_swap
-        version = _server_version(server)
+        swap_id, old_version, deadline, swap_policy = pending_swap
+        version = _version_of(swap_policy)
         if version != old_version:
             pending_swap = None
             response_q.put(("swapped", index, swap_id, True, version))
@@ -196,7 +229,10 @@ def replica_main(index: int, spec: ReplicaSpec, request_q, response_q,
                 return  # request queue torn down: router is gone
             kind = message[0]
             if kind == "req":
-                on_request(message[1], message[2], message[3], message[4])
+                on_request(
+                    message[1], message[2], message[3], message[4],
+                    message[5] if len(message) > 5 else None,
+                )
             elif kind == "health":
                 chaos.maybe_fire("health")
                 try:
@@ -207,7 +243,30 @@ def replica_main(index: int, spec: ReplicaSpec, request_q, response_q,
                 response_q.put(("health", index, message[1], snap, time.time()))
             elif kind == "swap":
                 chaos.maybe_fire("swap")
-                old_version = _server_version(server)
+                swap_policy = message[3] if len(message) > 3 else None
+                is_multi = getattr(server, "multi_policy", False)
+                if swap_policy is not None and not is_multi:
+                    response_q.put(
+                        ("swapped", index, message[1], False,
+                         _server_version(server))
+                    )
+                    check_pending_swap(time.time())
+                    continue
+                if (
+                    swap_policy is not None
+                    and is_multi
+                    and not server.is_resident(swap_policy)
+                ):
+                    # Nothing resident to swap: trivially done — the
+                    # next cold load materializes whatever the store
+                    # now publishes for this policy.
+                    response_q.put(
+                        ("swapped", index, message[1], True,
+                         _version_of(swap_policy))
+                    )
+                    check_pending_swap(time.time())
+                    continue
+                old_version = _version_of(swap_policy)
                 if pending_swap is not None:
                     # A second swap while one is in flight (two concurrent
                     # rolling_swap calls) must not overwrite pending_swap:
@@ -220,8 +279,15 @@ def replica_main(index: int, spec: ReplicaSpec, request_q, response_q,
                     )
                 else:
                     try:
-                        server.hot_swap(wait=False)
-                        pending_swap = (message[1], old_version, message[2])
+                        if swap_policy is None:
+                            server.hot_swap(wait=False)
+                        else:
+                            server.hot_swap(
+                                wait=False, policy_id=swap_policy
+                            )
+                        pending_swap = (
+                            message[1], old_version, message[2], swap_policy
+                        )
                     except Exception:
                         _log.exception("replica %d: hot_swap failed", index)
                         response_q.put(
@@ -340,11 +406,25 @@ class _MockServer:
     Outputs echo a checksum of the inputs so end-to-end tests can verify
     the reply really came from the submitted features."""
 
-    def __init__(self, service_ms: float = 1.0, version: int = 1):
+    def __init__(
+        self,
+        service_ms: float = 1.0,
+        version: int = 1,
+        scale: float = 1.0,
+        bias: float = 0.0,
+        mem_bytes: int = 0,
+    ):
         import threading
 
         self._service_s = service_ms / 1e3
         self.model_version = version
+        # Per-policy affine fingerprint: y = scale * sum(features) + bias
+        # computed in float64 then cast once — bitwise-reproducible, so a
+        # multi-policy fleet's responses can be audited against a
+        # single-policy twin serving the same (scale, bias).
+        self._scale = float(scale)
+        self._bias = float(bias)
+        self.mem_bytes = int(mem_bytes)
         self._queue: "queue.Queue" = queue.Queue()
         self._closed = False
         self._completed = 0
@@ -370,7 +450,7 @@ class _MockServer:
                 for key in sorted(features):
                     total += float(np.sum(features[key].astype(np.float64)))
                 outputs = {
-                    "y": np.float32(total),
+                    "y": np.float32(total * self._scale + self._bias),
                     "nbytes": np.int64(
                         sum(v.nbytes for v in features.values())
                     ),
@@ -435,3 +515,88 @@ class _MockServer:
 def mock_server_factory(service_ms: float = 1.0, version: int = 1):
     """Jax-free replica backend for router tests and plumbing smokes."""
     return _MockServer(service_ms=service_ms, version=version)
+
+
+def multi_policy_mock_factory(
+    catalog: Mapping[str, Mapping[str, Any]],
+    service_ms: float = 1.0,
+    load_ms: float = 0.0,
+    default_policy: Optional[str] = None,
+    preload=(),
+    mem_budget_mb: Optional[int] = None,
+    max_resident: Optional[int] = None,
+    cold_load: Optional[bool] = None,
+):
+    """Jax-free MULTI-policy backend: one `_MockServer` per resident
+    policy, each with its own (scale, bias, version, mem_bytes) from the
+    catalog — so every policy's replies are distinguishable and
+    bitwise-auditable against a single-policy twin. `load_ms` models the
+    cold-load (materialize + prewarm) cost."""
+    from tensor2robot_tpu.serving.policies import MultiPolicyServer
+
+    catalog = {str(k): dict(v) for k, v in catalog.items()}
+
+    def loader(policy_id: str):
+        chaos.maybe_fire("load")
+        entry = catalog[policy_id]
+        if load_ms > 0:
+            time.sleep(load_ms / 1e3)
+        return _MockServer(
+            service_ms=service_ms,
+            version=int(entry.get("version", 1)),
+            scale=float(entry.get("scale", 1.0)),
+            bias=float(entry.get("bias", 0.0)),
+            mem_bytes=int(entry.get("mem_bytes", 0)),
+        )
+
+    return MultiPolicyServer(
+        loader,
+        list(catalog),
+        default_policy=default_policy,
+        mem_budget_mb=mem_budget_mb,
+        max_resident=max_resident,
+        cold_load=cold_load,
+        preload=preload,
+    )
+
+
+def multi_policy_store_factory(
+    store_root: str,
+    policy_ids=None,
+    work_dir: Optional[str] = None,
+    batch_buckets=None,
+    max_wait_ms: Optional[int] = None,
+    predict_timeout_ms: Optional[int] = None,
+    restore_timeout_s: int = 120,
+    default_policy: Optional[str] = None,
+    preload=(),
+    mem_budget_mb: Optional[int] = None,
+    max_resident: Optional[int] = None,
+    cold_load: Optional[bool] = None,
+):
+    """The production multi-policy backend: every policy materializes
+    from the content-addressed store (export/artifact_store.py — base
+    payload shared, deltas decoded on load) into a PolicyServer
+    prewarmed off the SHARED bucket ladder. Heavy imports happen here,
+    in the child, on purpose."""
+    from tensor2robot_tpu.serving.policies import MultiPolicyServer
+    from tensor2robot_tpu.serving.server import exported_policy_loader
+
+    loader, catalog = exported_policy_loader(
+        store_root,
+        policy_ids=policy_ids,
+        work_dir=work_dir,
+        batch_buckets=batch_buckets,
+        max_wait_ms=max_wait_ms,
+        predict_timeout_ms=predict_timeout_ms,
+        restore_timeout_s=restore_timeout_s,
+    )
+    return MultiPolicyServer(
+        loader,
+        catalog,
+        default_policy=default_policy,
+        mem_budget_mb=mem_budget_mb,
+        max_resident=max_resident,
+        cold_load=cold_load,
+        preload=preload,
+    )

@@ -183,10 +183,10 @@ class RouterFuture:
 class _FleetRequest:
     __slots__ = (
         "id", "features", "deadline", "future", "t_submit", "dispatches",
-        "hedged", "hedge_attempts", "live", "last_failure",
+        "hedged", "hedge_attempts", "live", "last_failure", "policy_id",
     )
 
-    def __init__(self, request_id, features, deadline):
+    def __init__(self, request_id, features, deadline, policy_id=None):
         self.id = request_id
         self.features = features
         self.deadline = deadline  # monotonic, router-local
@@ -197,6 +197,7 @@ class _FleetRequest:
         self.hedge_attempts: Set[int] = set()  # attempt numbers placed as hedges
         self.live: Set[Tuple[int, int]] = set()  # (attempt, replica)
         self.last_failure = ""
+        self.policy_id: Optional[str] = policy_id
 
 
 class _Replica:
@@ -478,11 +479,14 @@ class FleetRouter:
         self,
         features: Mapping[str, Any],
         deadline_ms: Optional[float] = None,
+        policy_id: Optional[str] = None,
     ) -> RouterFuture:
         """Routes one example; never blocks on replicas. Raises typed
         admission errors (FleetSaturated / ReplicaUnavailable /
         RouterClosed) synchronously; everything after admission resolves
-        through the returned future."""
+        through the returned future. `policy_id` names the policy on a
+        multi-policy fleet (placement-aware: replicas already holding it
+        resident are preferred; a miss is a counted cold dispatch)."""
         if not self._started or self._closed:
             raise RouterClosed("router is not running")
         now = time.monotonic()
@@ -491,7 +495,7 @@ class FleetRouter:
             else self._default_deadline_s
         )
         arrays = {k: np.asarray(v) for k, v in features.items()}
-        request = _FleetRequest(next(self._ids), arrays, deadline)
+        request = _FleetRequest(next(self._ids), arrays, deadline, policy_id)
         with self._lock:
             # Re-check under the lock: stop() flips _closed and drains
             # _requests while holding it, so a request admitted past the
@@ -501,7 +505,7 @@ class FleetRouter:
             # forever.
             if self._closed:
                 raise RouterClosed("router is not running")
-            replica = self._pick_replica(exclude=())
+            replica = self._pick_replica(exclude=(), policy_id=policy_id)
             self._requests[request.id] = request
             self._metrics.count("submitted")
             try:
@@ -522,8 +526,11 @@ class FleetRouter:
         features: Mapping[str, Any],
         deadline_ms: Optional[float] = None,
         timeout: Optional[float] = None,
+        policy_id: Optional[str] = None,
     ) -> FleetResponse:
-        future = self.submit(features, deadline_ms=deadline_ms)
+        future = self.submit(
+            features, deadline_ms=deadline_ms, policy_id=policy_id
+        )
         if timeout is None:
             timeout = (
                 deadline_ms / 1e3 if deadline_ms is not None
@@ -534,14 +541,25 @@ class FleetRouter:
     # -- dispatch core (all called under self._lock) --------------------------
 
     def _pick_replica(
-        self, exclude: Sequence[int], count: bool = True
+        self,
+        exclude: Sequence[int],
+        count: bool = True,
+        policy_id: Optional[str] = None,
     ) -> _Replica:
         """Least-loaded healthy replica, deadline-aware admission.
 
         Raises FleetSaturated when healthy replicas exist but all are at
         the in-flight cap; ReplicaUnavailable when none are healthy.
         `count=False` suppresses the shed counters (hedge probes are
-        best-effort and must not read as admission failures)."""
+        best-effort and must not read as admission failures).
+
+        With `policy_id` on a multi-policy fleet, replicas whose last
+        health snapshot lists the policy RESIDENT are preferred among
+        the admissible candidates — dispatching to one avoids a
+        replica-side cold load. When the fleet reports residency but no
+        admissible replica holds this policy, the dispatch is counted
+        (`policy_cold_dispatches`) and falls back to least-loaded: a
+        cold load there is still cheaper than shedding."""
         up = [r for r in self._replicas if r.state == _UP]
         if not up:
             if count:
@@ -564,6 +582,26 @@ class FleetRouter:
                 f"all {len(up)} healthy replicas at the in-flight cap "
                 f"({self._max_inflight}); request shed"
             )
+        if policy_id is not None:
+            aware = [
+                r for r in candidates
+                if r.last_health.get("resident_policies") is not None
+            ]
+            if aware:
+                resident = [
+                    r for r in aware
+                    if policy_id in r.last_health["resident_policies"]
+                ]
+                if resident:
+                    candidates = resident
+                    if count:
+                        self._metrics.count("policy_resident_dispatches")
+                elif count:
+                    # No admissible replica holds this policy resident:
+                    # the dispatch will cold-load on arrival. Counted so
+                    # placement regressions show up as a ratio, not as
+                    # silent tail latency.
+                    self._metrics.count("policy_cold_dispatches")
         load = min(len(r.inflight) for r in candidates)
         tied = [r for r in candidates if len(r.inflight) == load]
         self._rr += 1
@@ -586,10 +624,13 @@ class FleetRouter:
         key = (request.id, attempt)
         replica.inflight.add(key)
         request.live.add((attempt, replica.index))
+        message = ("req", request.id, attempt, time.time() + remaining, payload)
+        if request.policy_id is not None:
+            # Optional trailing element keeps the 5-tuple wire shape for
+            # single-policy traffic byte-for-byte unchanged.
+            message = message + (request.policy_id,)
         try:
-            replica.request_q.put(
-                ("req", request.id, attempt, time.time() + remaining, payload)
-            )
+            replica.request_q.put(message)
         except Exception as err:
             replica.inflight.discard(key)
             request.live.discard((attempt, replica.index))
@@ -622,7 +663,9 @@ class FleetRouter:
             carrying = {replica for _, replica in request.live}
             try:
                 replica = self._pick_replica(
-                    exclude=tuple(carrying), count=False
+                    exclude=tuple(carrying),
+                    count=False,
+                    policy_id=request.policy_id,
                 )
             except FleetError:
                 return  # no spare capacity: hedging is best-effort
@@ -644,7 +687,9 @@ class FleetRouter:
                 return
             self._metrics.count("retries")
             try:
-                replica = self._pick_replica(exclude=exclude)
+                replica = self._pick_replica(
+                    exclude=exclude, policy_id=request.policy_id
+                )
                 self._dispatch(request, replica, hedge=False)
                 return
             except FleetError as err:
@@ -1105,14 +1150,22 @@ class FleetRouter:
             "shed_saturated": counters.get("shed_saturated", 0),
         }
 
-    def rolling_swap(self, swap_timeout_s: float = 60.0) -> Dict:
+    def rolling_swap(
+        self,
+        swap_timeout_s: float = 60.0,
+        policy_id: Optional[str] = None,
+    ) -> Dict:
         """Hot-swaps every live replica to the newest export, one at a
         time. Each replica keeps serving its OLD version until the new
         one is prewarmed (PolicyServer's restore-prewarm hook), so fleet
         capacity never drops by more than zero servers and drops by one
         only if a swap fails outright. Returns per-replica results; a
         failed swap aborts the roll (the remaining replicas keep the old
-        version — a bad artifact must not take the fleet down)."""
+        version — a bad artifact must not take the fleet down).
+
+        `policy_id` scopes the roll to ONE policy on a multi-policy
+        fleet: only that policy's server swaps per replica, so sibling
+        policies keep serving their current versions without a blip."""
         results: Dict[str, Any] = {"swapped": [], "failed": None}
         self._metrics.count("rolling_swaps")
         for replica in list(self._replicas):
@@ -1122,10 +1175,11 @@ class FleetRouter:
                 swap_id = next(self._swap_ids)
                 entry = [threading.Event(), False, replica.version]
                 self._swaps[swap_id] = entry
+                message = ("swap", swap_id, time.time() + swap_timeout_s)
+                if policy_id is not None:
+                    message = message + (policy_id,)
                 try:
-                    replica.request_q.put(
-                        ("swap", swap_id, time.time() + swap_timeout_s)
-                    )
+                    replica.request_q.put(message)
                 except Exception:
                     results["failed"] = replica.index
                     self._swaps.pop(swap_id, None)
@@ -1195,6 +1249,27 @@ class FleetRouter:
                     # deserialize-time or compile-time.
                     "boot_ms": r.boot_ms,
                     "prewarm_source": r.last_health.get("prewarm_source"),
+                    # Recorded AOT fingerprint of the loaded artifact
+                    # (None on backends without one): the gateway folds
+                    # this into its coalescing key so two pools serving
+                    # different artifacts can never share a dispatch.
+                    "model_fingerprint": r.last_health.get(
+                        "model_fingerprint"
+                    ),
+                    # Multi-policy placement surface (None on
+                    # single-policy backends): which policies this
+                    # replica holds resident right now, and its
+                    # replica-side eviction/cold-load counters — all off
+                    # the health snapshot, backend-independent.
+                    "resident_policies": r.last_health.get(
+                        "resident_policies"
+                    ),
+                    "policy_evictions": r.last_health.get(
+                        "policy_evictions"
+                    ),
+                    "policy_cold_loads": r.last_health.get(
+                        "policy_cold_loads"
+                    ),
                 }
                 for r in self._replicas
             ]

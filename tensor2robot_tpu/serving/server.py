@@ -585,6 +585,18 @@ class PolicyServer:
                 str(bucket): reason
                 for bucket, reason in sorted(fallbacks.items())
             }
+        # The artifact's recorded AOT fingerprint for the active regime
+        # (the PR-11 sha256 over program + weight-payload bytes): the
+        # gateway folds it into the coalescing key so requests against
+        # different artifacts can never share a dispatch, and the
+        # artifact store keys siblings on the same construction.
+        meta = getattr(loaded, "metadata", None)
+        if isinstance(meta, Mapping):
+            fp_table = (meta.get("aot") or {}).get("fingerprint") or {}
+            regime_key = getattr(loaded, "quant_regime", None) or "none"
+            fingerprint = fp_table.get(regime_key)
+            if fingerprint:
+                snap["model_fingerprint"] = str(fingerprint)
         # Fleet-visible leak surface: a predictor whose close() abandoned
         # a restore thread reports it here, so router health probes (which
         # ride this snapshot) can see the wounded replica.
@@ -782,3 +794,70 @@ class PolicyServer:
             request.future._set_response(ServeResponse(row, version, millis))
             spans.append(millis)
         self._metrics.observe_replies(spans)
+
+
+# -- multi-policy loader -------------------------------------------------------
+
+
+def exported_policy_loader(
+    store_root: str,
+    policy_ids=None,
+    work_dir: Optional[str] = None,
+    batch_buckets=None,
+    max_wait_ms: Optional[int] = None,
+    predict_timeout_ms: Optional[int] = None,
+    restore_timeout_s: int = 120,
+):
+    """(loader, catalog) for a MultiPolicyServer over the artifact store.
+
+    Each load MATERIALIZES the policy's export dir from the
+    content-addressed store (export/artifact_store.py — program/AOT
+    blobs shared with its base, delta payload decoded and
+    hash-verified), then boots a PolicyServer over it with the SHARED
+    bucket ladder (`batch_buckets`, defaulting to each artifact's own
+    warmup ladder — siblings share a program, hence a ladder) and
+    prewarms every bucket before the policy serves. The started
+    server's `mem_bytes` is the policy's dense weight footprint from
+    the manifest, which is what the resident-set budget meters.
+    """
+    import tempfile
+
+    from tensor2robot_tpu.export.artifact_store import ArtifactStore
+    from tensor2robot_tpu.predictors.exported_savedmodel_predictor import (
+        ExportedSavedModelPredictor,
+    )
+
+    store = ArtifactStore(store_root)
+    catalog = list(policy_ids) if policy_ids is not None else store.policies()
+    if not catalog:
+        raise ValueError(f"artifact store {store_root} holds no policies")
+    if work_dir is None:
+        work_dir = tempfile.mkdtemp(prefix="t2r-policies-")
+
+    def loader(policy_id: str):
+        import os
+
+        dest = os.path.join(work_dir, policy_id)
+        if not os.path.exists(dest):
+            store.materialize(policy_id, dest)
+        predictor = ExportedSavedModelPredictor(
+            export_dir=dest, timeout=restore_timeout_s
+        )
+        if not predictor.restore():
+            raise RuntimeError(
+                f"policy {policy_id!r} predictor restore timed out "
+                f"under {dest}"
+            )
+        server = PolicyServer(
+            predictor,
+            batch_buckets=batch_buckets,
+            max_wait_ms=max_wait_ms,
+            predict_timeout_ms=predict_timeout_ms,
+        )
+        server.start(prewarm=True)
+        server.mem_bytes = int(
+            store.manifest(policy_id)["payload"].get("weights_nbytes", 0)
+        )
+        return server
+
+    return loader, catalog

@@ -1,0 +1,393 @@
+"""export/artifact_store.py: the content-addressed multi-policy store.
+
+Pins the round-20 storage contract: program blobs dedup by content
+hash; sibling weights ship as quantized per-leaf deltas that
+reconstruct BITWISE-STABLE and hash-verified; the per-leaf parity gate
+demotes out-of-tolerance leaves to dense-exact (never a partial
+policy); and every corruption/transplant of the delta envelope is a
+TYPED refusal through the public read path — the analysis/corpus.py
+frame family drives the corruption cases unchanged, because the
+envelope deliberately rides the AOT frame shape (magic + u32 length +
+u32 crc32).
+"""
+
+import hashlib
+import json
+import os
+
+import numpy as np
+import pytest
+
+from tensor2robot_tpu.analysis import corpus
+from tensor2robot_tpu.export.artifact_store import (
+    ArtifactCorrupt,
+    ArtifactKeyMismatch,
+    ArtifactStore,
+    ArtifactStoreError,
+    BaseArtifactMissing,
+    PolicyExists,
+    PolicyNotFound,
+    program_fingerprint,
+)
+
+flax = pytest.importorskip("flax")
+from flax import serialization  # noqa: E402
+
+
+_PROGRAM = b"stablehlo-program-bytes " * 512  # shared across siblings
+
+
+def _params(seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "dense0": {
+            "kernel": rng.standard_normal((16, 16)).astype(np.float32),
+            "bias": rng.standard_normal((16,)).astype(np.float32),
+        },
+        "step": np.int64(7),
+    }
+
+
+def _perturb(params, seed, scale=1e-3):
+    rng = np.random.RandomState(seed)
+    out = {}
+    for name, group in params.items():
+        if isinstance(group, dict):
+            out[name] = {
+                k: v + rng.standard_normal(v.shape).astype(np.float32) * scale
+                for k, v in group.items()
+            }
+        else:
+            out[name] = group
+    return out
+
+
+def _write_export(dirname, params, program=_PROGRAM):
+    os.makedirs(os.path.join(dirname, "stablehlo"), exist_ok=True)
+    with open(os.path.join(dirname, "stablehlo", "forward.mlir"), "wb") as f:
+        f.write(program)
+    with open(os.path.join(dirname, "t2r_metadata.json"), "w") as f:
+        json.dump({"test": "artifact_store"}, f)
+    with open(os.path.join(dirname, "variables.msgpack"), "wb") as f:
+        f.write(serialization.to_bytes(params))
+
+
+def _publish(store, tmp_path, policy_id, params, base_policy=None, **kw):
+    export_dir = os.path.join(str(tmp_path), f"export-{policy_id}")
+    _write_export(export_dir, params)
+    return store.put(export_dir, policy_id, base_policy=base_policy, **kw)
+
+
+def _swap_payload_blob(store, policy_id, data):
+    """Point `policy_id`'s weights payload at `data`, stored under
+    data's OWN content hash — the blob-level sha passes, so the read
+    path exercises the envelope checks, not the blob checks."""
+    sha = hashlib.sha256(data).hexdigest()
+    with open(
+        os.path.join(store.root, "blobs", f"sha256-{sha}"), "wb"
+    ) as f:
+        f.write(data)
+    path = os.path.join(store.root, "policies", f"{policy_id}.json")
+    with open(path) as f:
+        manifest = json.load(f)
+    manifest["payload"]["blob"] = sha
+    manifest["payload"]["nbytes"] = len(data)
+    with open(path, "w") as f:
+        json.dump(manifest, f)
+
+
+@pytest.fixture
+def store(tmp_path):
+    return ArtifactStore(str(tmp_path / "store"))
+
+
+class TestRoundTrip:
+    def test_dense_base_bitwise(self, store, tmp_path):
+        params = _params(0)
+        manifest = _publish(store, tmp_path, "base", params)
+        assert manifest["payload"]["kind"] == "dense"
+        want = serialization.to_bytes(params)
+        assert store.load_weights("base") == want
+        restored = serialization.msgpack_restore(store.load_weights("base"))
+        np.testing.assert_array_equal(
+            restored["dense0"]["kernel"], params["dense0"]["kernel"]
+        )
+
+    def test_sibling_delta_bitwise_stable_and_within_tolerance(
+        self, store, tmp_path
+    ):
+        base = _params(0)
+        sib = _perturb(base, seed=1)
+        _publish(store, tmp_path, "base", base)
+        manifest = _publish(
+            store, tmp_path, "sib", sib, base_policy="base", regime="int8"
+        )
+        payload = manifest["payload"]
+        assert payload["kind"] == "delta"
+        assert payload["base"] == "base"
+        assert payload["leaves"]["delta"] == 2  # kernel + bias
+        # Bitwise-stable: two loads, identical bytes, matching the
+        # manifest's recorded hash.
+        first = store.load_weights("sib")
+        assert store.load_weights("sib") == first
+        assert hashlib.sha256(first).hexdigest() == payload["weights_sha"]
+        # Within the declared parity tolerance of the ORIGINAL weights.
+        restored = serialization.msgpack_restore(first)
+        for group in ("dense0",):
+            for leaf in ("kernel", "bias"):
+                want = sib[group][leaf]
+                got = restored[group][leaf]
+                tol = 0.05 * max(float(np.max(np.abs(want))), 1e-8)
+                assert float(np.max(np.abs(got - want))) <= tol
+        # The non-float leaf ships dense-exact.
+        assert restored["step"] == sib["step"]
+
+    def test_program_blob_dedup_shrinks_the_store(self, store, tmp_path):
+        base = _params(0)
+        _publish(store, tmp_path, "base", base)
+        for i in range(4):
+            _publish(
+                store, tmp_path, f"sib{i}", _perturb(base, seed=10 + i),
+                base_policy="base",
+            )
+        stats = store.stats()
+        assert stats["n_policies"] == 5
+        assert stats["n_delta_policies"] == 4
+        # ONE program blob for five policies: the program's content hash
+        # appears exactly once under blobs/.
+        sha = hashlib.sha256(_PROGRAM).hexdigest()
+        assert os.path.exists(
+            os.path.join(store.root, "blobs", f"sha256-{sha}")
+        )
+        assert stats["store_bytes"] < stats["dense_bytes"] * 0.5
+        # Exactly one blob each for the shared program, the shared
+        # metadata file, the base's dense weights — plus one delta
+        # envelope per sibling. A second program copy would show up
+        # here.
+        assert stats["n_blobs"] == 3 + 4
+
+    def test_materialize_reconstructs_the_export_dir(self, store, tmp_path):
+        base = _params(0)
+        sib = _perturb(base, seed=2)
+        _publish(store, tmp_path, "base", base)
+        _publish(store, tmp_path, "sib", sib, base_policy="base")
+        dest = str(tmp_path / "rebuilt")
+        store.materialize("sib", dest)
+        with open(os.path.join(dest, "stablehlo", "forward.mlir"), "rb") as f:
+            assert f.read() == _PROGRAM
+        with open(os.path.join(dest, "variables.msgpack"), "rb") as f:
+            assert f.read() == store.load_weights("sib")
+        with pytest.raises(ArtifactStoreError):
+            store.materialize("sib", dest)  # refuses to clobber
+
+    def test_parity_gate_demotes_hot_leaf_to_dense_exact(
+        self, store, tmp_path
+    ):
+        """A leaf whose diff cannot reconstruct within tolerance ships
+        dense-exact — per leaf, while its siblings still ship delta."""
+        base = _params(0)
+        sib = _perturb(base, seed=3)
+        # One leaf moves by a huge, high-dynamic-range delta that int8
+        # blocks cannot hold to 0.1% — the gate must catch it.
+        rng = np.random.RandomState(9)
+        sib["dense0"]["bias"] = (
+            base["dense0"]["bias"]
+            + rng.standard_normal((16,)).astype(np.float32) * 50.0
+        )
+        _publish(store, tmp_path, "base", base)
+        manifest = _publish(
+            store, tmp_path, "sib", sib, base_policy="base",
+            regime="int8", tolerance=1e-3,
+        )
+        leaves = manifest["payload"]["leaves"]
+        assert leaves["dense"] >= 2  # the demoted leaf + the int64 step
+        assert leaves["delta"] >= 1  # small-delta leaves still encode
+        restored = serialization.msgpack_restore(store.load_weights("sib"))
+        # Dense-exact means BITWISE for the demoted leaf.
+        np.testing.assert_array_equal(
+            restored["dense0"]["bias"], sib["dense0"]["bias"]
+        )
+
+    def test_tolerance_zero_demotes_everything_and_round_trips_exact(
+        self, store, tmp_path
+    ):
+        base = _params(0)
+        sib = _perturb(base, seed=4)
+        _publish(store, tmp_path, "base", base)
+        manifest = _publish(
+            store, tmp_path, "sib", sib, base_policy="base", tolerance=0.0
+        )
+        assert manifest["payload"]["leaves"]["delta"] == 0
+        # Every leaf ships dense-exact: bitwise equal to the original
+        # (the serialized KEY ORDER may differ — identity is per leaf).
+        restored = serialization.msgpack_restore(store.load_weights("sib"))
+        np.testing.assert_array_equal(
+            restored["dense0"]["kernel"], sib["dense0"]["kernel"]
+        )
+        np.testing.assert_array_equal(
+            restored["dense0"]["bias"], sib["dense0"]["bias"]
+        )
+        assert restored["step"] == sib["step"]
+
+
+class TestTypedRefusals:
+    def test_every_corrupt_frame_variant_is_typed_never_partial(
+        self, store, tmp_path
+    ):
+        """analysis/corpus.py discipline over the delta envelope:
+        structural truncations, seeded bitflips, forged/past-EOF
+        lengths, bad magic — each must raise ArtifactCorrupt from the
+        public load path (whole-payload-or-nothing; the blob-level sha
+        is re-addressed so the ENVELOPE checks are what fire)."""
+        base = _params(0)
+        _publish(store, tmp_path, "base", base)
+        manifest = _publish(
+            store, tmp_path, "sib", _perturb(base, seed=5),
+            base_policy="base",
+        )
+        with open(
+            os.path.join(
+                store.root, "blobs",
+                f"sha256-{manifest['payload']['blob']}",
+            ),
+            "rb",
+        ) as f:
+            envelope = f.read()
+        variants = corpus.corrupt_frame_variants(envelope)
+        assert len(variants) >= 15
+        for name, bad in variants.items():
+            _swap_payload_blob(store, "sib", bad)
+            with pytest.raises(ArtifactCorrupt):
+                store.load_weights("sib")
+            with pytest.raises(ArtifactCorrupt):
+                store.materialize("sib", str(tmp_path / f"dest-{name}"))
+
+    def test_blob_bytes_corrupt_on_disk_refused(self, store, tmp_path):
+        _publish(store, tmp_path, "base", _params(0))
+        sha = store.manifest("base")["payload"]["blob"]
+        path = os.path.join(store.root, "blobs", f"sha256-{sha}")
+        with open(path, "rb") as f:
+            data = bytearray(f.read())
+        data[len(data) // 2] ^= 0x40
+        with open(path, "wb") as f:
+            f.write(data)
+        with pytest.raises(ArtifactCorrupt):
+            store.load_weights("base")
+        os.unlink(path)
+        with pytest.raises(ArtifactCorrupt):
+            store.load_weights("base")  # missing blob is corrupt, typed
+
+    def test_base_missing_is_typed(self, store, tmp_path):
+        base = _params(0)
+        _publish(store, tmp_path, "base", base)
+        _publish(
+            store, tmp_path, "sib", _perturb(base, seed=6),
+            base_policy="base",
+        )
+        store.delete("base")
+        with pytest.raises(BaseArtifactMissing):
+            store.load_weights("sib")
+
+    def test_cross_program_delta_refused_at_put(self, store, tmp_path):
+        _publish(store, tmp_path, "base", _params(0))
+        other_dir = str(tmp_path / "export-other-program")
+        _write_export(
+            other_dir, _perturb(_params(0), seed=7),
+            program=b"a different program entirely " * 256,
+        )
+        with pytest.raises(ArtifactKeyMismatch):
+            store.put(other_dir, "cross", base_policy="base")
+        assert not store.has("cross")  # gate-fails-write-nothing
+
+    def test_republished_base_weights_refused_at_read(
+        self, store, tmp_path
+    ):
+        """The delta is keyed to the base WEIGHTS it was encoded
+        against: silently decoding against republished base weights
+        would materialize garbage under the sibling's name."""
+        base = _params(0)
+        _publish(store, tmp_path, "base", base)
+        _publish(
+            store, tmp_path, "sib", _perturb(base, seed=8),
+            base_policy="base",
+        )
+        store.delete("base")
+        _publish(store, tmp_path, "base", _params(99))  # same program
+        with pytest.raises(ArtifactKeyMismatch):
+            store.load_weights("sib")
+
+    def test_transplanted_envelope_refused_by_fingerprint(
+        self, store, tmp_path
+    ):
+        """An intact delta payload moved under a policy of a DIFFERENT
+        program family fails the key check, not the integrity check."""
+        base_a = _params(0)
+        _publish(store, tmp_path, "base", base_a)
+        man_a = _publish(
+            store, tmp_path, "sibA", _perturb(base_a, seed=11),
+            base_policy="base",
+        )
+        other = ArtifactStore(str(tmp_path / "storeB"))
+        dir_b = str(tmp_path / "export-baseB")
+        _write_export(dir_b, base_a, program=b"program B " * 1024)
+        other.put(dir_b, "base")
+        dir_sb = str(tmp_path / "export-sibB")
+        _write_export(
+            dir_sb, _perturb(base_a, seed=12), program=b"program B " * 1024
+        )
+        other.put(dir_sb, "sibB", base_policy="base")
+        with open(
+            os.path.join(
+                store.root, "blobs", f"sha256-{man_a['payload']['blob']}"
+            ),
+            "rb",
+        ) as f:
+            envelope_a = f.read()
+        _swap_payload_blob(other, "sibB", envelope_a)
+        with pytest.raises(ArtifactKeyMismatch):
+            other.load_weights("sibB")
+
+    def test_publish_and_lookup_refusals(self, store, tmp_path):
+        _publish(store, tmp_path, "base", _params(0))
+        with pytest.raises(PolicyExists):
+            _publish(store, tmp_path, "base", _params(1))
+        with pytest.raises(PolicyNotFound):
+            store.load_weights("nope")
+        with pytest.raises(PolicyNotFound):
+            store.delete("nope")
+        with pytest.raises(BaseArtifactMissing):
+            _publish(
+                store, tmp_path, "orphan", _params(2),
+                base_policy="never-published",
+            )
+        with pytest.raises(ValueError):
+            store.put(str(tmp_path), "bad/id")
+        not_export = str(tmp_path / "not-an-export")
+        os.makedirs(not_export)
+        with pytest.raises(ArtifactStoreError):
+            store.put(not_export, "empty")
+
+
+class TestFingerprint:
+    def test_program_identity_ignores_weights(self):
+        files_a = {
+            "stablehlo/forward.mlir": b"prog",
+            "variables.msgpack": b"weights-1",
+        }
+        files_b = {
+            "stablehlo/forward.mlir": b"prog",
+            "variables.msgpack": b"weights-2",
+        }
+        assert program_fingerprint(files_a) == program_fingerprint(files_b)
+        files_c = {
+            "stablehlo/forward.mlir": b"other prog",
+            "variables.msgpack": b"weights-1",
+        }
+        assert program_fingerprint(files_a) != program_fingerprint(files_c)
+
+    def test_programless_export_falls_back_to_non_weight_files(self):
+        files = {"t2r_metadata.json": b"{}", "variables.msgpack": b"w"}
+        other = {"t2r_metadata.json": b"{}", "variables.msgpack": b"x"}
+        assert program_fingerprint(files) == program_fingerprint(other)
+        changed = {"t2r_metadata.json": b"{!}", "variables.msgpack": b"w"}
+        assert program_fingerprint(files) != program_fingerprint(changed)

@@ -275,9 +275,20 @@ def test_bench_cli_lists_legs():
     assert proc.returncode == 0
     for leg in (
         "data", "auc", "predict", "bc", "stream", "pipe", "serve", "comms",
-        "fleet", "rl", "aot", "plan",
+        "fleet", "rl", "aot", "plan", "policies",
     ):
         assert leg in proc.stdout
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "bench.py"),
+         "policies", "--help"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0
+    for option in (
+        "--variants", "--replicas", "--trace-secs", "--mem-budget-mb",
+        "--policy-mem-mb", "--out",
+    ):
+        assert option in proc.stdout
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO_ROOT, "bench.py"),
          "rl", "--help"],
@@ -375,6 +386,50 @@ def test_bench_rl_contract(tmp_path):
     assert detail["sharded_chaos"]["chaos"]["shard_pid"] is not None
     assert detail["sharded_chaos"]["uid_audit"]["episodes"] > 0
     assert detail["replay_ratio"] > 0
+    with open(out) as f:
+        assert json.load(f)["metric"] == payload["metric"]
+
+
+@pytest.mark.slow
+def test_bench_policies_contract(tmp_path):
+    """The multi-policy fleet leg at toy scale: one JSON line + the
+    --out artifact, the content-addressed store's delta ratio clearing
+    the 5x gate, every acceptance gate green (bitwise-vs-twin, zero
+    cross-policy coalesce joins, eviction churn actually exercised,
+    per-policy rolling swap with zero blip on other policies, zero
+    lost). Slow slice: it publishes dozens of policy exports and spawns
+    a 4-replica mock fleet; tier-1 covers the store and policy-server
+    contracts in-process (tests/test_artifact_store.py,
+    tests/test_policy_fleet.py) and the CLI surface above."""
+    out = str(tmp_path / "policies.json")
+    payload = _run_bench(
+        "policies", "--variants", "40", "--trace-secs", "4",
+        "--rate", "90", "--mem-budget-mb", "8", "--out", out,
+        timeout=560,
+    )
+    assert payload["metric"] == "multi_policy_fleet_delta_store_cpu_proxy"
+    assert payload["unit"] == "dense_over_store_bytes"
+    assert payload["value"] >= 5.0
+    assert "error" not in payload
+    assert payload["cpu_proxy"] is True
+    assert payload["all_green"] is True, payload["gates"]
+    for gate in (
+        "variants_ge_target", "delta_store_ge_5x",
+        "per_policy_bitwise_vs_twin", "zero_cross_policy_joins",
+        "coalesce_still_effective", "eviction_churn_counted",
+        "swap_zero_blip_other_policies", "zero_lost",
+    ):
+        assert payload["gates"][gate] is True, gate
+    detail = payload["detail"]
+    assert detail["store"]["n_delta_policies"] == 40
+    assert detail["store"]["delta_ratio"] >= 5.0
+    assert detail["evictions"] >= 1
+    assert detail["cold_loads"] >= 1
+    assert detail["coalesced"] > 0
+    assert detail["cross_policy_joins"] == 0
+    assert detail["bitwise_mismatches"] == 0
+    assert detail["lost"] == 0
+    assert detail["swap_result"]["failed"] is None
     with open(out) as f:
         assert json.load(f)["metric"] == payload["metric"]
 

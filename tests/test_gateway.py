@@ -30,6 +30,8 @@ from tensor2robot_tpu.serving import (
     TierShed,
     UnknownTenant,
     mock_server_factory,
+    multi_policy_mock_factory,
+    observation_digest,
 )
 from tensor2robot_tpu.testing import chaos
 
@@ -678,3 +680,202 @@ class TestAutoscaler:
             assert router.load()["replicas_up"] == 1
             assert scaler.tick() == "up"  # next decision lands
             future.result(60)
+
+
+_CATALOG = {
+    "pA": {"scale": 2.0, "bias": 1.0, "version": 3, "mem_bytes": 1 << 20},
+    "pB": {"scale": -1.0, "bias": 0.5, "version": 4, "mem_bytes": 1 << 20},
+}
+
+
+def _policy_router(num=1, service_ms=1.0, **kwargs):
+    kwargs.setdefault("probe_interval_ms", 50.0)
+    kwargs.setdefault("backoff_ms", 5.0)
+    spec = ReplicaSpec(
+        factory=multi_policy_mock_factory,
+        factory_kwargs={"catalog": _CATALOG, "service_ms": service_ms},
+    )
+    return FleetRouter(spec, num, **kwargs).start(timeout_s=90.0)
+
+
+class TestMultiPolicy:
+    def test_digest_folds_policy_and_model_identity(self):
+        """The satellite-1 regression at the digest level: the coalesce
+        key domain-separates policy id and model fingerprint from the
+        feature bytes, so two tenants asking DIFFERENT policies the same
+        observation can never share one digest (they would have joined
+        one dispatch and one of them would get the wrong policy's
+        outputs)."""
+        arrays = _features(3.0)
+        base = observation_digest(arrays)
+        assert observation_digest(arrays) == base  # deterministic
+        assert observation_digest(arrays, policy_id="pA") != base
+        assert observation_digest(
+            arrays, policy_id="pA"
+        ) != observation_digest(arrays, policy_id="pB")
+        assert observation_digest(
+            arrays, model_fingerprint="f1"
+        ) != observation_digest(arrays, model_fingerprint="f2")
+        # Domain separation: a policy id must never collide with the
+        # same string in the fingerprint slot.
+        assert observation_digest(
+            arrays, policy_id="x"
+        ) != observation_digest(arrays, model_fingerprint="x")
+        assert observation_digest(
+            arrays, policy_id="pA", model_fingerprint="f"
+        ) == observation_digest(arrays, policy_id="pA", model_fingerprint="f")
+
+    def test_cross_policy_identical_observations_never_join(self):
+        """The would-have-joined regression, live: identical features
+        against pA and pB queue behind a pinned slow replica. Same-
+        policy riders coalesce; the other policy's request MUST dispatch
+        on its own — pre-fix, the feature-only digest would have joined
+        it to pA's leader and served it pA's outputs."""
+        with _policy_router(1, service_ms=150.0, max_inflight=1) as router:
+            _wait_all_up(router)
+            with Gateway(router, _bindings()).start() as gateway:
+                pin = gateway.submit(
+                    "gold0", _features(50.0), deadline_ms=60000,
+                    policy_id="pA",
+                )
+                features = _features(7.0)
+                leader_a = gateway.submit(
+                    "gold0", features, deadline_ms=60000, policy_id="pA"
+                )
+                rider_a = gateway.submit(
+                    "gold0", features, deadline_ms=60000, policy_id="pA"
+                )
+                leader_b = gateway.submit(
+                    "gold0", features, deadline_ms=60000, policy_id="pB"
+                )
+                rider_b = gateway.submit(
+                    "gold0", features, deadline_ms=60000, policy_id="pB"
+                )
+                a1, a2 = leader_a.result(60), rider_a.result(60)
+                b1, b2 = leader_b.result(60), rider_b.result(60)
+                pin.result(60)
+                # sum(features) = 28: pA -> 2*28+1, pB -> -28+0.5.
+                for response in (a1, a2):
+                    assert response.outputs["y"] == pytest.approx(57.0)
+                    assert response.policy_id == "pA"
+                for response in (b1, b2):
+                    assert response.outputs["y"] == pytest.approx(-27.5)
+                    assert response.policy_id == "pB"
+                assert a2.coalesced and b2.coalesced
+                snap = gateway.snapshot()
+                assert snap["counters"]["coalesced_joins"] == 2
+                assert snap["counters"]["dispatched"] == 3  # pin + 2
+
+    def test_per_policy_swap_epoch_isolates_coalescing(self):
+        """rolling_swap(policy_id='pB') bumps ONLY pB's coalesce epoch:
+        a pB observation queued before the swap never adopts post-swap
+        riders, while pA's identical observations keep coalescing right
+        through pB's publish — one policy's deploy never blips
+        another's traffic."""
+        with _policy_router(1, service_ms=150.0, max_inflight=1) as router:
+            _wait_all_up(router)
+            with Gateway(router, _bindings()).start() as gateway:
+                pin = gateway.submit(
+                    "gold0", _features(50.0), deadline_ms=60000,
+                    policy_id="pA",
+                )
+                features = _features(9.0)
+                leader_b = gateway.submit(
+                    "gold0", features, deadline_ms=60000, policy_id="pB"
+                )
+                swap = gateway.rolling_swap(
+                    swap_timeout_s=30.0, policy_id="pB"
+                )
+                assert swap["failed"] is None
+                follower_b = gateway.submit(
+                    "gold0", features, deadline_ms=60000, policy_id="pB"
+                )
+                leader_a = gateway.submit(
+                    "gold0", features, deadline_ms=60000, policy_id="pA"
+                )
+                rider_a = gateway.submit(
+                    "gold0", features, deadline_ms=60000, policy_id="pA"
+                )
+                assert not follower_b.result(60).coalesced
+                assert rider_a.result(60).coalesced
+                leader_b.result(60), leader_a.result(60), pin.result(60)
+                snap = gateway.snapshot()
+                pool = snap["pools"]["default"]
+                assert pool["policy_epochs"] == {"pB": 1}
+                assert pool["swap_epoch"] == 0  # global epoch untouched
+                assert snap["counters"]["coalesced_joins"] == 1
+
+    def test_admission_buckets_keyed_per_tenant_and_policy(self):
+        """One tenant, burst=1: draining pA's bucket must not throttle
+        the SAME tenant's pB traffic (or its default stream) — quotas
+        are per (tenant, policy) stream."""
+        with _policy_router(1) as router:
+            _wait_all_up(router)
+            bindings = [
+                TenantBinding(
+                    tenant="gold0", tier="gold", quota_rps=0.001, burst=1
+                ),
+                TenantBinding(
+                    tenant="bronze0", tier="bronze", quota_rps=0.001, burst=1
+                ),
+            ]
+            with Gateway(router, bindings).start() as gateway:
+                first = gateway.submit(
+                    "gold0", _features(1.0), deadline_ms=20000,
+                    policy_id="pA",
+                )
+                with pytest.raises(TenantThrottled):
+                    gateway.submit(
+                        "gold0", _features(2.0), deadline_ms=20000,
+                        policy_id="pA",
+                    )
+                other_stream = gateway.submit(
+                    "gold0", _features(3.0), deadline_ms=20000,
+                    policy_id="pB",
+                )
+                default_stream = gateway.submit(
+                    "gold0", _features(4.0), deadline_ms=20000
+                )
+                assert first.result(60).policy_id == "pA"
+                assert other_stream.result(60).policy_id == "pB"
+                assert default_stream.result(60).policy_id is None
+                snap = gateway.snapshot()["tenants"]["gold0"]
+                assert set(snap["policy_tokens"]) == {"pA", "pB"}
+                assert snap["counters"]["throttled"] == 1
+
+    def test_placement_surfaces_in_router_and_autoscaler_snapshots(self):
+        """The placement surface rides health probes into BOTH control-
+        plane snapshots: per-replica resident sets, eviction/cold-load
+        counters, and the model fingerprint slot — the data a capacity
+        decision needs to avoid scaling up a replica that must cold-load
+        the hot policy."""
+        with _policy_router(1) as router:
+            _wait_all_up(router)
+            with Gateway(router, _bindings()).start() as gateway:
+                for pid in ("pA", "pB"):
+                    gateway.call(
+                        "gold0", _features(1.0), deadline_ms=20000,
+                        policy_id=pid,
+                    )
+                assert _wait(
+                    lambda: any(
+                        set(r.get("resident_policies") or ())
+                        >= {"pA", "pB"}
+                        for r in router.snapshot()["replicas"]
+                    )
+                ), router.snapshot()["replicas"]
+                replica = router.snapshot()["replicas"][0]
+                assert replica["policy_evictions"] == 0
+                assert replica["policy_cold_loads"] >= 1
+                assert "model_fingerprint" in replica
+                placements = Autoscaler(router).snapshot()["policies"]
+                assert placements, "autoscaler saw no multi-policy replicas"
+                assert set(placements[0]["resident_policies"]) >= {
+                    "pA", "pB"
+                }
+                assert placements[0]["policy_cold_loads"] >= 1
+                # Per-policy epoch and fingerprint ride the pool
+                # snapshot for the coalesce key.
+                pool = gateway.snapshot()["pools"]["default"]
+                assert pool["policy_epochs"] == {}
+                assert pool["model_fingerprint"] is not None

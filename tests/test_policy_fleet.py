@@ -1,0 +1,278 @@
+"""serving/policies.py: the multi-policy resident set.
+
+One replica, N policies (ROADMAP item 2): requests name their policy,
+misses take a counted cold-load path or a typed refusal, and the
+resident set stays under a memory budget by LRU-evicting idle policies
+— with reloads producing BITWISE-identical replies (the artifact
+store's hash-verified reconstruction seen from the serving side).
+Most tests drive `MultiPolicyServer` in-process over the jax-free mock
+loader; the fleet test runs the same catalog through real replica
+processes behind the FleetRouter and asserts the placement surface
+(resident sets, eviction/cold-load counters) rides the health
+snapshots into the router's own snapshot.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from tensor2robot_tpu.serving import (
+    FleetRouter,
+    MultiPolicyServer,
+    PolicyError,
+    PolicyEvicted,
+    PolicyLoadFailed,
+    PolicyUnknown,
+    ReplicaSpec,
+    multi_policy_mock_factory,
+)
+
+_MB = 1 << 20
+
+CATALOG = {
+    "pA": {"scale": 2.0, "bias": 1.0, "version": 3, "mem_bytes": _MB},
+    "pB": {"scale": -1.0, "bias": 0.5, "version": 4, "mem_bytes": _MB},
+    "pC": {"scale": 0.25, "bias": -2.0, "version": 5, "mem_bytes": _MB},
+    "pD": {"scale": 10.0, "bias": 0.0, "version": 6, "mem_bytes": _MB},
+}
+
+
+def _server(**kwargs):
+    kwargs.setdefault("service_ms", 0.0)
+    kwargs.setdefault("cold_load", True)
+    return multi_policy_mock_factory(CATALOG, **kwargs)
+
+
+def _features(value=1.0, n=4):
+    return {"x": np.full((n,), value, np.float32)}
+
+
+def _y(server, policy_id=None, value=1.0):
+    future = server.submit(
+        _features(value), deadline_ms=10_000, policy_id=policy_id
+    )
+    return future.result(timeout=10.0).outputs["y"]
+
+
+def _twin(policy_id, value=1.0, n=4):
+    entry = CATALOG[policy_id]
+    total = float(np.sum(np.full((n,), value, np.float32).astype(np.float64)))
+    return np.float32(total * entry["scale"] + entry["bias"])
+
+
+class TestResidency:
+    def test_submit_routes_to_named_policy_bitwise_vs_twin(self):
+        server = _server()
+        try:
+            for pid in CATALOG:
+                got = _y(server, policy_id=pid, value=1.5)
+                want = _twin(pid, value=1.5)
+                assert got == want and got.tobytes() == want.tobytes(), pid
+            # Unnamed submits serve the default (first catalog entry).
+            assert _y(server, value=1.5) == _twin("pA", value=1.5)
+            assert server.snapshot()["default_policy"] == "pA"
+        finally:
+            server.stop()
+
+    def test_lru_eviction_under_budget_reload_identical(self):
+        server = _server(mem_budget_mb=2)
+        try:
+            first_a = _y(server, policy_id="pA")
+            _y(server, policy_id="pB")
+            assert server.resident_policies() == ["pA", "pB"]
+            # pA is the least recently used — pC's load evicts it.
+            _y(server, policy_id="pC")
+            assert server.resident_policies() == ["pB", "pC"]
+            snap = server.snapshot()
+            assert snap["policy_evictions"] == 1
+            assert snap["policy_loads"] == 3
+            # Reload after eviction: counted as a cold load, reply
+            # bitwise-identical to the pre-eviction reply.
+            again_a = _y(server, policy_id="pA")
+            assert again_a.tobytes() == first_a.tobytes()
+            snap = server.snapshot()
+            assert snap["policy_cold_loads"] == 4
+            assert snap["policy_evictions"] == 2  # pB went to admit pA
+            assert server.resident_policies() == ["pC", "pA"]
+        finally:
+            server.stop()
+
+    def test_use_bumps_lru_so_hot_policies_survive(self):
+        server = _server(mem_budget_mb=2)
+        try:
+            _y(server, policy_id="pA")
+            _y(server, policy_id="pB")
+            _y(server, policy_id="pA")  # pA is now most-recent
+            _y(server, policy_id="pC")  # evicts pB, not pA
+            assert server.resident_policies() == ["pA", "pC"]
+        finally:
+            server.stop()
+
+    def test_max_resident_cap(self):
+        server = _server(max_resident=2)
+        try:
+            for pid in ("pA", "pB", "pC", "pD"):
+                _y(server, policy_id=pid)
+            assert server.resident_policies() == ["pC", "pD"]
+            assert server.snapshot()["policy_evictions"] == 2
+        finally:
+            server.stop()
+
+    def test_preload_counts_as_warm_not_cold(self):
+        server = _server(preload=("pA", "pB"))
+        try:
+            snap = server.snapshot()
+            assert snap["policy_loads"] == 2
+            assert snap["policy_cold_loads"] == 0
+            _y(server, policy_id="pC")
+            assert server.snapshot()["policy_cold_loads"] == 1
+        finally:
+            server.stop()
+
+
+class TestTypedRefusals:
+    def test_cold_load_disabled_evicted_vs_unknown(self):
+        """With cold loads off the refusal NAMES the cause: a policy
+        evicted under the budget is PolicyEvicted (route to a resident
+        replica); one never resident here is PolicyUnknown."""
+        server = _server(
+            cold_load=False, mem_budget_mb=2,
+            preload=("pA", "pB", "pC"),  # preloading pC evicts idle pA
+        )
+        try:
+            assert server.resident_policies() == ["pB", "pC"]
+            with pytest.raises(PolicyEvicted):
+                server.submit(_features(), policy_id="pA")
+            with pytest.raises(PolicyUnknown):
+                server.submit(_features(), policy_id="pD")
+            # Resident policies still serve.
+            assert _y(server, policy_id="pB") == _twin("pB")
+        finally:
+            server.stop()
+
+    def test_uncataloged_policy_and_loader_failure(self):
+        server = _server()
+        try:
+            with pytest.raises(PolicyUnknown):
+                server.submit(_features(), policy_id="never-published")
+        finally:
+            server.stop()
+
+        def broken_loader(policy_id):
+            raise OSError(f"store lost {policy_id}")
+
+        broken = MultiPolicyServer(broken_loader, ["pX"])
+        try:
+            with pytest.raises(PolicyLoadFailed):
+                broken.submit(_features(), policy_id="pX")
+        finally:
+            broken.stop()
+
+    def test_stopped_server_refuses(self):
+        server = _server()
+        server.stop()
+        with pytest.raises(PolicyError):
+            server.submit(_features(), policy_id="pA")
+
+
+class TestSurface:
+    def test_snapshot_placement_keys(self):
+        server = _server(mem_budget_mb=3, preload=("pA", "pB"))
+        try:
+            snap = server.snapshot()
+            assert snap["multi_policy"] is True
+            assert snap["resident_policies"] == ["pA", "pB"]
+            assert snap["policy_mem_bytes"] == {"pA": _MB, "pB": _MB}
+            assert snap["policy_mem_budget_bytes"] == 3 * _MB
+            assert snap["policy_versions"] == {"pA": 3, "pB": 4}
+            assert snap["model_version"] == 3  # the default policy's
+            assert snap["catalog_size"] == 4
+            # The anchor sub-server's health rides along (completed
+            # counters, prewarm attribution) — the router's health loop
+            # reads ONE merged dict.
+            assert "counters" in snap and "prewarm_source" in snap
+        finally:
+            server.stop()
+
+    def test_hot_swap_targets_one_policy(self):
+        server = _server(preload=("pA", "pB"))
+        try:
+            assert server.policy_version("pA") == 3
+            assert server.hot_swap(wait=True, policy_id="pA") is True
+            assert server.policy_version("pA") == 4
+            assert server.policy_version("pB") == 4  # untouched
+            # Non-resident: trivially true — the next cold load picks up
+            # whatever the store now holds.
+            assert server.hot_swap(wait=True, policy_id="pC") is True
+            with pytest.raises(PolicyUnknown):
+                server.hot_swap(wait=True, policy_id="nope")
+        finally:
+            server.stop()
+
+
+class TestFleetPlacement:
+    def test_fleet_serves_catalog_and_router_sees_residency(self):
+        """The same catalog through real replica processes: per-policy
+        replies bitwise vs the twin formula, the placement surface
+        (resident sets + churn counters) visible in router.snapshot(),
+        placement-aware dispatch counted, and a per-policy rolling swap
+        that only touches the named policy."""
+        spec = ReplicaSpec(
+            factory=multi_policy_mock_factory,
+            factory_kwargs={
+                "catalog": CATALOG,
+                "service_ms": 0.5,
+                "preload": ("pA",),
+                "mem_budget_mb": 2,
+            },
+        )
+        router = FleetRouter(
+            spec, 2, probe_interval_ms=50.0, backoff_ms=5.0
+        ).start(timeout_s=90.0)
+        try:
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                if all(s == "up" for s in router.replica_states()):
+                    break
+                time.sleep(0.02)
+            for pid in ("pA", "pB", "pC"):
+                response = router.call(
+                    _features(2.0), deadline_ms=20_000, policy_id=pid
+                )
+                want = _twin(pid, value=2.0)
+                assert response.outputs["y"].tobytes() == want.tobytes()
+            # Health probes carry residency to the router snapshot.
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                snap = router.snapshot()
+                residents = [
+                    r.get("resident_policies")
+                    for r in snap["replicas"]
+                    if r.get("resident_policies")
+                ]
+                if residents and any(
+                    "pB" in r or "pC" in r for r in residents
+                ):
+                    break
+                time.sleep(0.05)
+            assert residents, snap["replicas"]
+            for r in snap["replicas"]:
+                assert r.get("policy_cold_loads") is not None
+                assert r.get("policy_evictions") is not None
+            # Placement-aware dispatch: a repeat of a resident policy
+            # counts a resident dispatch.
+            router.call(_features(2.0), deadline_ms=20_000, policy_id="pB")
+            counters = router.snapshot()["counters"]
+            assert (
+                counters.get("policy_resident_dispatches", 0)
+                + counters.get("policy_cold_dispatches", 0)
+            ) > 0
+            # One policy's publish: the fleet swaps only that policy.
+            result = router.rolling_swap(
+                swap_timeout_s=60.0, policy_id="pB"
+            )
+            assert result["failed"] is None
+            assert result["swapped"]
+        finally:
+            router.stop()

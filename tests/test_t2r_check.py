@@ -459,6 +459,48 @@ class TestLintsCatch:
         assert "env-unknown-flag" not in clean
         assert "env-undeclared" not in clean
 
+    def test_policy_flags_covered_by_registry_lint(self):
+        """The round-20 multi-policy flags (T2R_POLICY_*: artifact-store
+        delta codec + replica residency) ride the same rails: raw
+        environ reads are env-undeclared, wrong-kind getter reads are
+        env-kind-mismatch, declared spellings clean — and the delta
+        regime enum registers every collective-codec wire format."""
+        for name in (
+            "T2R_POLICY_COLD_LOAD", "T2R_POLICY_DELTA_BLOCK",
+            "T2R_POLICY_DELTA_QUANT", "T2R_POLICY_DELTA_TOL",
+            "T2R_POLICY_MAX_RESIDENT", "T2R_POLICY_MEM_BUDGET",
+        ):
+            assert "env-undeclared" in self._rules(
+                f"import os\nx = os.environ.get({name!r})\n"
+            ), name
+        assert "env-kind-mismatch" in self._rules(
+            "from tensor2robot_tpu import flags\n"
+            "x = flags.get_bool('T2R_POLICY_DELTA_BLOCK')\n"
+        )
+        assert "env-kind-mismatch" in self._rules(
+            "from tensor2robot_tpu import flags\n"
+            "x = flags.get_int('T2R_POLICY_DELTA_QUANT')\n"
+        )
+        assert "env-kind-mismatch" in self._rules(
+            "from tensor2robot_tpu import flags\n"
+            "x = flags.get_int('T2R_POLICY_COLD_LOAD')\n"
+        )
+        clean = self._rules(
+            "from tensor2robot_tpu import flags\n"
+            "a = flags.get_bool('T2R_POLICY_COLD_LOAD')\n"
+            "b = flags.get_int('T2R_POLICY_DELTA_BLOCK')\n"
+            "c = flags.get_enum('T2R_POLICY_DELTA_QUANT')\n"
+            "d = flags.get_str('T2R_POLICY_DELTA_TOL')\n"
+            "e = flags.get_int('T2R_POLICY_MAX_RESIDENT')\n"
+            "f = flags.get_int('T2R_POLICY_MEM_BUDGET')\n"
+        )
+        assert "env-kind-mismatch" not in clean
+        assert "env-unknown-flag" not in clean
+        assert "env-undeclared" not in clean
+        choices = flags.get_flag("T2R_POLICY_DELTA_QUANT").choices
+        for regime in ("none", "fp16", "int8", "fp8_e4m3", "fp8_e5m2"):
+            assert regime in choices, regime
+
     def _sleep_rules(self, source, path="tensor2robot_tpu/serving/x.py"):
         return {d.rule for d in lint_source(source, path)}
 

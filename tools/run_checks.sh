@@ -168,6 +168,22 @@ if [ "$TESTS" = 1 ]; then
     status=1
   fi
 
+  echo "== policies: content-addressed store + multi-policy fleet suite (tier-1) =="
+  # The round-20 multi-policy layer: content-addressed artifact store
+  # (program-blob dedup, delta-compressed siblings with the per-leaf
+  # parity gate, corpus-driven envelope corruption typed, transplant/
+  # base-mismatch refusals), MultiPolicyServer LRU residency under the
+  # memory budget (bitwise-identical reloads, typed PolicyEvicted/
+  # PolicyUnknown), and the placement surface through router/gateway/
+  # autoscaler snapshots with per-policy coalesce keying. The 100-policy
+  # 4-replica end-to-end churn run is the slow-slice twin
+  # (tests/test_bench.py::test_bench_policies_contract).
+  if ! JAX_PLATFORMS=cpu python -m pytest tests/test_artifact_store.py \
+      tests/test_policy_fleet.py \
+      -q -m 'not slow' -p no:cacheprovider; then
+    status=1
+  fi
+
   echo "== replay: online-loop durability + seeded chaos suite (tier-1) =="
   # Segment durability (CRC + seal manifests, counted loss, quarantine),
   # FIFO/prioritized sampling determinism, service SIGKILL/respawn with
