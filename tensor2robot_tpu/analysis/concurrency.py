@@ -1,0 +1,1210 @@
+"""Lock-discipline static pass: guard contracts, lock order, blocking calls.
+
+Every hard concurrency bug this codebase has paid for — the XLA
+enqueue-order deadlock behind `_DISPATCH_LOCK`, the SIGKILL-poisoned
+mp.Queue rlock, the gateway exactly-once-future races, the
+MultiPolicyServer single-flight load races — was found the expensive
+way: under chaos, in a soak, or in production-shaped benches. This pass
+applies the specflow recipe (a custom static analysis that fails in
+seconds on the host) to the one correctness surface that had no tooling
+at all: threads and locks in the serving/replay fabric.
+
+Three rule families over `serving/`, `replay/`, `train/`, and
+`predictors/` (plus the runtime complement in
+`tensor2robot_tpu/testing/locksmith.py`):
+
+* Guard contracts (`conc-unguarded-field`) — for every class that owns
+  a lock, infer which `self._*` fields the code treats as
+  lock-protected: a field whose accesses are MAJORITY inside
+  `with self._lock:` blocks (or inside helper methods provably only
+  called under the lock — the router's documented "dispatch core runs
+  under self._lock" discipline) is a guarded field, and the minority
+  unguarded read/write is almost always the race. The escape hatch is
+  an explicit `# t2r: unguarded-ok(reason)` comment on (or directly
+  above) the access — and the hatch itself is linted: an annotation
+  that no longer suppresses anything is a `conc-stale-annotation`
+  error, as is an empty reason.
+
+* Lock order (`conc-lock-order-cycle`) — a cross-module
+  lock-acquisition graph. Lock identity is `(class, attr)` resolved
+  through `self`/module aliases (the collective lint's alias
+  discipline): `with self._lock:` in FleetRouter and
+  `with router._lock:` in a helper are the SAME node. Edges come from
+  lexical nesting and from calls resolvable one attribute hop deep
+  (`self._metrics.count(...)` under `self._lock` is an edge
+  FleetRouter._lock -> _RouterMetrics._lock because `count` acquires
+  the metrics lock). A cycle is an error carrying BOTH acquisition
+  paths in compiler format; lexically re-entering a plain (non-R)
+  Lock is the length-1 cycle — self-deadlock.
+
+* Blocking under lock (`conc-blocking-under-lock`) — while any lock is
+  held: `queue.get/put` without timeout, no-arg `.join()`,
+  `time.sleep`/`Backoff.sleep`, socket `recv/accept/sendall/connect`,
+  the predictor `predict` surface (extending serve-blocking-predict's
+  reach to "and never under a lock"), untimed `.wait()` while holding
+  any OTHER lock, no-arg `.result()`, and calls into `@poll_loop`
+  bodies (which by contract tick forever). Escape hatch:
+  `# t2r: blocking-ok(reason)`, same staleness lint.
+
+Like every lint here, the pass runs on source text only — a broken
+module still analyzes — and lands clean-by-construction: every finding
+in the shipped tree is fixed or carries a reasoned annotation.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tensor2robot_tpu.analysis.diagnostics import Diagnostic, ERROR
+
+__all__ = [
+    "check_source",
+    "check_paths",
+    "DEFAULT_CONCURRENCY_ROOTS",
+]
+
+# The threaded fabric this pass governs.
+DEFAULT_CONCURRENCY_ROOTS = (
+    "tensor2robot_tpu/serving",
+    "tensor2robot_tpu/replay",
+    "tensor2robot_tpu/train",
+    "tensor2robot_tpu/predictors",
+)
+
+RULE_UNGUARDED = "conc-unguarded-field"
+RULE_CYCLE = "conc-lock-order-cycle"
+RULE_BLOCKING = "conc-blocking-under-lock"
+RULE_STALE = "conc-stale-annotation"
+RULE_PARSE = "conc-parse"
+
+# Escape-hatch grammar: `# t2r: unguarded-ok(reason)` on the flagged
+# line or the comment line directly above it.
+_ANNOT_RE = re.compile(r"#\s*t2r:\s*(unguarded-ok|blocking-ok)\(([^)]*)\)")
+_ANNOT_FAMILY = {"unguarded-ok": RULE_UNGUARDED, "blocking-ok": RULE_BLOCKING}
+
+# Lock constructors, keyed by their threading spelling.
+_LOCK_CTORS = {"Lock": "lock", "RLock": "rlock", "Condition": "condition"}
+# The locksmith factory seam's spellings (testing/locksmith.py).
+_FACTORY_CTORS = {
+    "make_lock": "lock",
+    "make_rlock": "rlock",
+    "make_condition": "condition",
+}
+
+# A `with X:` target we cannot resolve still counts as "a lock is held"
+# when its final name segment looks lock-ish.
+_LOCKISH_RE = re.compile(r"(lock|cond|mutex)", re.IGNORECASE)
+
+# Methods whose bodies are single-threaded by construction: guard
+# inference ignores them entirely.
+_CONSTRUCTION_METHODS = frozenset(
+    {"__init__", "__new__", "__del__", "__post_init__", "__init_subclass__"}
+)
+
+# Blocking attribute calls under a lock: socket surface + predictor
+# surface (serve-blocking-predict's reach, extended under locks).
+_SOCKET_BLOCKING = frozenset(
+    {"recv", "recv_into", "accept", "sendall", "connect"}
+)
+_PREDICT_BLOCKING = frozenset(
+    {"predict", "predict_versioned", "traced_predict"}
+)
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """`a.b.c` for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _queueish(name: str) -> bool:
+    """Heuristic: does a receiver name denote a queue? (`request_q`,
+    `self._queue`, `free_q` — but never `self._requests`, whose `.get`
+    is a dict lookup)."""
+    last = name.rsplit(".", 1)[-1].lower()
+    return last == "q" or last.endswith("_q") or last.endswith("queue")
+
+
+@dataclasses.dataclass(frozen=True)
+class LockId:
+    """One lock's identity: ('class', 'FleetRouter', '_lock') or
+    ('module', 'train_eval', '_DISPATCH_LOCK')."""
+
+    scope: str  # 'class' | 'module'
+    owner: str
+    attr: str
+
+    def display(self) -> str:
+        return f"{self.owner}.{self.attr}"
+
+
+@dataclasses.dataclass
+class _ClassInfo:
+    name: str
+    path: str
+    line: int
+    locks: Dict[str, Tuple[str, int]] = dataclasses.field(
+        default_factory=dict
+    )  # attr -> (kind, line)
+    attr_types: Dict[str, str] = dataclasses.field(default_factory=dict)
+    methods: Set[str] = dataclasses.field(default_factory=set)
+    poll_methods: Set[str] = dataclasses.field(default_factory=set)
+
+
+@dataclasses.dataclass
+class _ModuleInfo:
+    path: str
+    module: str
+    tree: ast.Module
+    source: str
+    threading_aliases: Set[str] = dataclasses.field(default_factory=set)
+    factory_aliases: Set[str] = dataclasses.field(default_factory=set)
+    time_aliases: Set[str] = dataclasses.field(default_factory=set)
+    ctor_imports: Dict[str, str] = dataclasses.field(default_factory=dict)
+    module_imports: Dict[str, str] = dataclasses.field(default_factory=dict)
+    classes: Dict[str, _ClassInfo] = dataclasses.field(default_factory=dict)
+    module_locks: Dict[str, Tuple[str, int]] = dataclasses.field(
+        default_factory=dict
+    )
+    poll_functions: Set[str] = dataclasses.field(default_factory=set)
+
+
+@dataclasses.dataclass
+class _Access:
+    """One `self._field` touch inside a lock-owning class."""
+
+    cls: str
+    field: str
+    path: str
+    line: int
+    method: str
+    guarded: bool  # lexically under a class-owned lock
+    mutating: bool  # store/del, subscript store, or mutator-method call
+
+
+# Container methods that mutate their receiver: `self._replicas[...] =`
+# never shows a Store on the attribute itself, so a field's mutability
+# is judged by these too. Immutable config read under a lock
+# incidentally is NOT a guard contract.
+_MUTATORS = frozenset(
+    {
+        "add", "append", "appendleft", "clear", "discard", "extend",
+        "insert", "pop", "popitem", "popleft", "remove", "rotate",
+        "setdefault", "sort", "update",
+    }
+)
+
+
+def _link_parents(tree: ast.Module) -> None:
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child._t2r_parent = node  # type: ignore[attr-defined]
+
+
+def _is_mutation(node: ast.Attribute) -> bool:
+    if isinstance(node.ctx, (ast.Store, ast.Del)):
+        return True
+    cur: ast.AST = node
+    parent = getattr(node, "_t2r_parent", None)
+    # `self._f[a][b] = x` / `del self._f[k]`: climb the subscript chain.
+    while isinstance(parent, ast.Subscript) and parent.value is cur:
+        if isinstance(parent.ctx, (ast.Store, ast.Del)):
+            return True
+        cur = parent
+        parent = getattr(parent, "_t2r_parent", None)
+    # `self._f.append(x)`: a mutator method called on the field.
+    if isinstance(parent, ast.Attribute) and parent.value is node:
+        grand = getattr(parent, "_t2r_parent", None)
+        if (
+            isinstance(grand, ast.Call)
+            and grand.func is parent
+            and parent.attr in _MUTATORS
+        ):
+            return True
+    return False
+
+
+@dataclasses.dataclass
+class _Edge:
+    """One observed acquisition order: `held` was held when `acquired`
+    was taken. Sites anchor the diagnostic."""
+
+    held: LockId
+    acquired: LockId
+    path: str
+    line: int  # where `acquired` was taken (or the call that takes it)
+    held_line: int  # where `held` was taken
+
+    def describe(self, root: Optional[str]) -> str:
+        path = self.path
+        if root:
+            rel = os.path.relpath(path, root)
+            if not rel.startswith(".."):
+                path = rel
+        return (
+            f"{self.held.display()} (held since {path}:{self.held_line}) "
+            f"-> {self.acquired.display()} at {path}:{self.line}"
+        )
+
+
+@dataclasses.dataclass
+class _CallSite:
+    """A resolvable call for the interprocedural passes."""
+
+    kind: str  # 'self' | 'attr' | 'mod'
+    attr: Optional[str]  # receiver attr for kind='attr'
+    name: str  # callee name
+    line: int
+    held: Tuple[LockId, ...]  # resolved locks held at the call
+    anonymous_held: int  # unresolved-but-lockish holds at the call
+
+
+class _Collector(ast.NodeVisitor):
+    """Phase 1: declarations — aliases, lock attrs, attr types,
+    @poll_loop markers."""
+
+    def __init__(self, info: _ModuleInfo):
+        self.info = info
+        self._class_stack: List[_ClassInfo] = []
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            bound = alias.asname or alias.name.split(".")[0]
+            if alias.name == "threading":
+                self.info.threading_aliases.add(bound)
+            elif alias.name == "time":
+                self.info.time_aliases.add(bound)
+            self.info.module_imports[bound] = alias.name.rsplit(".", 1)[-1]
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        mod = node.module or ""
+        for alias in node.names:
+            bound = alias.asname or alias.name
+            if mod == "threading" and alias.name in _LOCK_CTORS:
+                self.info.ctor_imports[bound] = _LOCK_CTORS[alias.name]
+            if alias.name == "threading":
+                self.info.threading_aliases.add(bound)
+            if alias.name == "time" and mod != "time":
+                self.info.time_aliases.add(bound)
+            if alias.name == "locksmith":
+                self.info.factory_aliases.add(bound)
+            self.info.module_imports[bound] = alias.name
+        self.generic_visit(node)
+
+    # -- lock creation --------------------------------------------------------
+
+    def _lock_kind(self, node: ast.AST) -> Optional[str]:
+        """'lock'/'rlock'/'condition' if any call within `node` creates
+        a threading primitive (directly, via a from-import alias, or
+        through the locksmith factory)."""
+        for call in ast.walk(node):
+            if not isinstance(call, ast.Call):
+                continue
+            func = call.func
+            if isinstance(func, ast.Name):
+                kind = self.info.ctor_imports.get(func.id)
+                if kind:
+                    return kind
+                if func.id in _FACTORY_CTORS:
+                    return _FACTORY_CTORS[func.id]
+            elif isinstance(func, ast.Attribute) and isinstance(
+                func.value, ast.Name
+            ):
+                base, attr = func.value.id, func.attr
+                if (
+                    base in self.info.threading_aliases
+                    and attr in _LOCK_CTORS
+                ):
+                    return _LOCK_CTORS[attr]
+                if (
+                    base in self.info.factory_aliases or base == "locksmith"
+                ) and attr in _FACTORY_CTORS:
+                    return _FACTORY_CTORS[attr]
+        return None
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        info = _ClassInfo(node.name, self.info.path, node.lineno)
+        self.info.classes[node.name] = info
+        self._class_stack.append(info)
+        self.generic_visit(node)
+        self._class_stack.pop()
+
+    def _note_function(self, node) -> None:
+        is_poll = any(
+            (isinstance(d, ast.Name) and d.id == "poll_loop")
+            or (isinstance(d, ast.Attribute) and d.attr == "poll_loop")
+            for d in node.decorator_list
+        )
+        if self._class_stack:
+            cls = self._class_stack[-1]
+            cls.methods.add(node.name)
+            if is_poll:
+                cls.poll_methods.add(node.name)
+        elif is_poll:
+            self.info.poll_functions.add(node.name)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._note_function(node)
+        self.generic_visit(node)
+
+    def visit_AsyncFunctionDef(self, node) -> None:
+        self._note_function(node)
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        kind = self._lock_kind(node.value)
+        for target in node.targets:
+            self._note_target(target, node, kind)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._note_target(node.target, node, self._lock_kind(node.value))
+        self.generic_visit(node)
+
+    def _note_target(self, target, node, kind: Optional[str]) -> None:
+        if (
+            isinstance(target, ast.Attribute)
+            and isinstance(target.value, ast.Name)
+            and target.value.id == "self"
+            and self._class_stack
+        ):
+            cls = self._class_stack[-1]
+            if kind and target.attr not in cls.locks:
+                cls.locks[target.attr] = (kind, node.lineno)
+            elif not kind:
+                # Attribute type seam for one-hop resolution:
+                # `self._metrics = _RouterMetrics()`.
+                value = node.value if hasattr(node, "value") else None
+                if isinstance(value, ast.Call):
+                    name = _dotted(value.func)
+                    if name:
+                        cls.attr_types.setdefault(
+                            target.attr, name.rsplit(".", 1)[-1]
+                        )
+        elif isinstance(target, ast.Name) and not self._class_stack:
+            if kind and target.id not in self.info.module_locks:
+                self.info.module_locks[target.id] = (kind, node.lineno)
+
+
+class _Analyzer(ast.NodeVisitor):
+    """Phase 2: per-file traversal with the global declaration tables.
+
+    Collects field accesses, acquisition edges, blocking findings, and
+    call sites for the interprocedural fixpoints."""
+
+    def __init__(self, info: _ModuleInfo, global_tables: "_Tables"):
+        self.info = info
+        self.tables = global_tables
+        self.accesses: List[_Access] = []
+        self.edges: List[_Edge] = []
+        self.blocking: List[Diagnostic] = []
+        # (cls|None, method) -> direct acquisitions [(LockId, line)]
+        self.acquires: Dict[Tuple[Optional[str], str], List] = {}
+        self.calls: Dict[Tuple[Optional[str], str], List[_CallSite]] = {}
+        self._class_stack: List[_ClassInfo] = []
+        self._method_stack: List[str] = []
+        # Held entries: (LockId|None, dotted_text, line, kind|None)
+        self._held: List[Tuple[Optional[LockId], str, int, Optional[str]]] = []
+
+    # -- identity resolution --------------------------------------------------
+
+    def _resolve(
+        self, expr: ast.AST
+    ) -> Tuple[Optional[LockId], Optional[str], Optional[str]]:
+        """(identity, dotted_text, kind). identity None = unresolved
+        (still lock-ish if dotted_text says so)."""
+        dotted = _dotted(expr)
+        if dotted is None:
+            return None, None, None
+        parts = dotted.split(".")
+        cls = self._class_stack[-1] if self._class_stack else None
+        # self.X — the enclosing class declared X as a lock.
+        if len(parts) == 2 and parts[0] == "self" and cls is not None:
+            decl = cls.locks.get(parts[1])
+            if decl:
+                return (
+                    LockId("class", cls.name, parts[1]),
+                    dotted,
+                    decl[0],
+                )
+        # self.A.B — A's type declared in __init__, B a lock of it.
+        if len(parts) == 3 and parts[0] == "self" and cls is not None:
+            target_cls = self.tables.classes.get(
+                cls.attr_types.get(parts[1], "")
+            )
+            if target_cls and parts[2] in target_cls.locks:
+                return (
+                    LockId("class", target_cls.name, parts[2]),
+                    dotted,
+                    target_cls.locks[parts[2]][0],
+                )
+        # Bare module-level lock (this module), or alias.X of another.
+        if len(parts) == 1:
+            decl = self.info.module_locks.get(parts[0])
+            if decl:
+                return (
+                    LockId("module", self.info.module, parts[0]),
+                    dotted,
+                    decl[0],
+                )
+        if len(parts) == 2:
+            mod = self.tables.modules.get(
+                self.info.module_imports.get(parts[0], "")
+            )
+            if mod and parts[1] in mod.module_locks:
+                return (
+                    LockId("module", mod.module, parts[1]),
+                    dotted,
+                    mod.module_locks[parts[1]][0],
+                )
+            # X.attr where attr is a lock of exactly ONE known class:
+            # `with pool.cond:` resolves through _Pool even though
+            # `pool` is a plain parameter.
+            owners = self.tables.lock_attr_owners.get(parts[1], ())
+            if len(owners) == 1:
+                owner = self.tables.classes[owners[0]]
+                return (
+                    LockId("class", owner.name, parts[1]),
+                    dotted,
+                    owner.locks[parts[1]][0],
+                )
+        return None, dotted, None
+
+    # -- traversal ------------------------------------------------------------
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._class_stack.append(self.info.classes[node.name])
+        self.generic_visit(node)
+        self._class_stack.pop()
+
+    def _visit_function(self, node) -> None:
+        # A nested def/lambda is a callback: it runs later, NOT under
+        # the lexically enclosing lock.
+        held, self._held = self._held, []
+        self._method_stack.append(node.name)
+        self.generic_visit(node)
+        self._method_stack.pop()
+        self._held = held
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._visit_function(node)
+
+    def visit_AsyncFunctionDef(self, node) -> None:
+        self._visit_function(node)
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        held, self._held = self._held, []
+        self.generic_visit(node)
+        self._held = held
+
+    def _method_key(self) -> Tuple[Optional[str], str]:
+        cls = self._class_stack[-1].name if self._class_stack else None
+        # Nested defs attribute to the OUTERMOST method: a synchronous
+        # closure shares its enclosing method's lock context (the
+        # lexical held-stack is still reset — that part stays honest
+        # for callbacks that run later).
+        method = self._method_stack[0] if self._method_stack else "<module>"
+        return (cls, method)
+
+    def visit_With(self, node: ast.With) -> None:
+        pushed = 0
+        for item in node.items:
+            identity, dotted, kind = self._resolve(item.context_expr)
+            is_lock = identity is not None or (
+                dotted is not None
+                and _LOCKISH_RE.search(dotted.rsplit(".", 1)[-1])
+            )
+            if not is_lock:
+                continue
+            if identity is not None:
+                key = self._method_key()
+                self.acquires.setdefault(key, []).append(
+                    (identity, node.lineno)
+                )
+                for held_id, _, held_line, _ in self._held:
+                    if held_id is None:
+                        continue
+                    if held_id == identity:
+                        # Lexical re-entry: fatal for a plain Lock,
+                        # designed-for with an RLock.
+                        if kind == "lock":
+                            self.edges.append(
+                                _Edge(
+                                    held_id,
+                                    identity,
+                                    self.info.path,
+                                    node.lineno,
+                                    held_line,
+                                )
+                            )
+                        continue
+                    self.edges.append(
+                        _Edge(
+                            held_id,
+                            identity,
+                            self.info.path,
+                            node.lineno,
+                            held_line,
+                        )
+                    )
+            self._held.append((identity, dotted, node.lineno, kind))
+            pushed += 1
+        self.generic_visit(node)
+        for _ in range(pushed):
+            self._held.pop()
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        cls = self._class_stack[-1] if self._class_stack else None
+        if (
+            cls is not None
+            and self._method_stack
+            and self._method_stack[0] not in _CONSTRUCTION_METHODS
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+            and node.attr.startswith("_")
+            and node.attr not in cls.locks
+            and node.attr not in cls.methods
+            and cls.locks  # only lock-owning classes have guard contracts
+        ):
+            guarded = any(
+                held_id is not None
+                and held_id.scope == "class"
+                and held_id.owner == cls.name
+                for held_id, _, _, _ in self._held
+            ) or any(
+                held_id is None and dotted and dotted.startswith("self.")
+                for held_id, dotted, _, _ in self._held
+            )
+            self.accesses.append(
+                _Access(
+                    cls.name,
+                    node.attr,
+                    self.info.path,
+                    node.lineno,
+                    self._method_stack[0],
+                    guarded,
+                    _is_mutation(node),
+                )
+            )
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        self._note_call_site(node)
+        if self._held:
+            self._check_blocking(node)
+        # Don't double-count the callee attribute as a field access:
+        # visit args/keywords, and only the receiver below the attr.
+        if isinstance(node.func, ast.Attribute):
+            self.visit(node.func.value)
+        else:
+            self.visit(node.func)
+        for arg in node.args:
+            self.visit(arg)
+        for kw in node.keywords:
+            self.visit(kw.value)
+
+    def _note_call_site(self, node: ast.Call) -> None:
+        held = tuple(h for h, _, _, _ in self._held if h is not None)
+        anonymous = sum(1 for h, _, _, _ in self._held if h is None)
+        key = self._method_key()
+        func = node.func
+        site: Optional[_CallSite] = None
+        if isinstance(func, ast.Name):
+            site = _CallSite(
+                "mod", None, func.id, node.lineno, held, anonymous
+            )
+        elif isinstance(func, ast.Attribute):
+            dotted = _dotted(func)
+            if dotted is None:
+                return
+            parts = dotted.split(".")
+            if len(parts) == 2 and parts[0] == "self":
+                site = _CallSite(
+                    "self", None, parts[1], node.lineno, held, anonymous
+                )
+            elif len(parts) == 3 and parts[0] == "self":
+                site = _CallSite(
+                    "attr", parts[1], parts[2], node.lineno, held, anonymous
+                )
+        if site is not None:
+            self.calls.setdefault(key, []).append(site)
+
+    # -- blocking-under-lock --------------------------------------------------
+
+    def _emit_blocking(self, node: ast.AST, what: str) -> None:
+        holders = ", ".join(
+            dotted or (h.display() if h else "<lock>")
+            for h, dotted, _, _ in self._held
+        )
+        self.blocking.append(
+            Diagnostic(
+                self.info.path,
+                node.lineno,
+                RULE_BLOCKING,
+                f"{what} while holding {holders} — a deadlock-or-latency "
+                "hazard; move it outside the critical section or annotate "
+                "with `# t2r: blocking-ok(reason)`",
+                ERROR,
+            )
+        )
+
+    def _kwarg(self, node: ast.Call, name: str) -> Optional[ast.AST]:
+        for kw in node.keywords:
+            if kw.arg == name:
+                return kw.value
+        return None
+
+    def _check_blocking(self, node: ast.Call) -> None:
+        func = node.func
+        if isinstance(func, ast.Name):
+            if func.id in _PREDICT_BLOCKING:
+                self._emit_blocking(node, f"{func.id}() call")
+            elif func.id in self.info.poll_functions:
+                self._emit_blocking(node, f"@poll_loop body {func.id}()")
+            elif (
+                func.id == "sleep"
+                and self.info.ctor_imports.get("sleep") is None
+                and "sleep" in self.info.module_imports
+                and self.info.module_imports["sleep"] == "sleep"
+            ):
+                self._emit_blocking(node, "sleep() call")
+            return
+        if not isinstance(func, ast.Attribute):
+            return
+        attr = func.attr
+        receiver = _dotted(func.value)
+        dotted = _dotted(func)
+        if attr == "sleep":
+            base = receiver or ""
+            if base in self.info.time_aliases or base == "time":
+                self._emit_blocking(node, "time.sleep() call")
+            else:
+                # Backoff.sleep and friends block by design too.
+                self._emit_blocking(node, f"{dotted}() sleep call")
+            return
+        if attr == "join" and not node.args and not node.keywords:
+            if isinstance(func.value, ast.Constant):
+                return  # "sep".join — string join, not a thread join
+            self._emit_blocking(node, f"untimed {dotted}() join")
+            return
+        if attr in ("get", "put") and receiver and _queueish(receiver):
+            timeout = self._kwarg(node, "timeout")
+            block = self._kwarg(node, "block")
+            if timeout is None and not (
+                isinstance(block, ast.Constant) and block.value is False
+            ):
+                self._emit_blocking(
+                    node, f"timeout-less {dotted}() queue {attr}"
+                )
+            return
+        if attr in _SOCKET_BLOCKING:
+            self._emit_blocking(node, f"socket {dotted}() call")
+            return
+        if attr in _PREDICT_BLOCKING:
+            self._emit_blocking(node, f"{dotted}() call")
+            return
+        if attr == "result" and not node.args and not node.keywords:
+            self._emit_blocking(node, f"untimed {dotted}() result wait")
+            return
+        if attr == "wait":
+            timeout = self._kwarg(node, "timeout")
+            if node.args or timeout is not None:
+                return
+            # cond.wait() releases ONLY the cond: fine when it is the
+            # sole lock held, a deadlock hazard when any other is.
+            others = [
+                d for _, d, _, _ in self._held if d and d != receiver
+            ]
+            if others:
+                self._emit_blocking(node, f"untimed {dotted}() wait")
+            return
+        # Calls into @poll_loop methods: tick-forever bodies.
+        if receiver == "self" and self._class_stack:
+            if attr in self._class_stack[-1].poll_methods:
+                self._emit_blocking(node, f"@poll_loop body self.{attr}()")
+
+
+@dataclasses.dataclass
+class _Tables:
+    """Global cross-module declaration tables."""
+
+    classes: Dict[str, _ClassInfo] = dataclasses.field(default_factory=dict)
+    modules: Dict[str, _ModuleInfo] = dataclasses.field(default_factory=dict)
+    # lock attr name -> tuple of owning class names (for the
+    # unique-attr fallback: `pool.cond` -> _Pool.cond).
+    lock_attr_owners: Dict[str, Tuple[str, ...]] = dataclasses.field(
+        default_factory=dict
+    )
+
+
+def _build_tables(infos: Sequence[_ModuleInfo]) -> _Tables:
+    tables = _Tables()
+    owners: Dict[str, List[str]] = {}
+    for info in infos:
+        tables.modules[info.module] = info
+        for cls in info.classes.values():
+            # First declaration wins on a bare-name collision; the
+            # unique-attr fallback below only fires when unambiguous.
+            tables.classes.setdefault(cls.name, cls)
+            for attr in cls.locks:
+                owners.setdefault(attr, []).append(cls.name)
+    tables.lock_attr_owners = {
+        attr: tuple(sorted(set(names))) for attr, names in owners.items()
+    }
+    return tables
+
+
+# -- interprocedural fixpoints -------------------------------------------------
+
+
+def _resolve_callee(
+    site: _CallSite,
+    caller_cls: Optional[str],
+    info: _ModuleInfo,
+    tables: _Tables,
+) -> Optional[Tuple[Optional[str], str]]:
+    """Map a call site to a (class, method) / (None-module, function)
+    key, one attribute hop deep — the alias discipline."""
+    if site.kind == "self" and caller_cls is not None:
+        cls = tables.classes.get(caller_cls)
+        if cls and site.name in cls.methods:
+            return (caller_cls, site.name)
+        return None
+    if site.kind == "attr" and caller_cls is not None:
+        cls = tables.classes.get(caller_cls)
+        if cls is None:
+            return None
+        target = tables.classes.get(cls.attr_types.get(site.attr, ""))
+        if target and site.name in target.methods:
+            return (target.name, site.name)
+        return None
+    if site.kind == "mod":
+        # Same-module function only: a bare name elsewhere is a builtin
+        # or an import we don't chase.
+        for stmt in info.tree.body:
+            if (
+                isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and stmt.name == site.name
+            ):
+                return (None, site.name)
+        return None
+    return None
+
+
+def _fix_may_acquire(
+    analyzers: Sequence[_Analyzer], tables: _Tables
+) -> Dict[Tuple[Optional[str], str], Dict[LockId, Tuple[str, int]]]:
+    """may_acquire[(cls, method)] = {lock: (path, line of an acquire
+    site)} — direct `with` acquisitions plus resolvable callees', to a
+    fixpoint."""
+    may: Dict[Tuple[Optional[str], str], Dict[LockId, Tuple[str, int]]] = {}
+    home: Dict[Tuple[Optional[str], str], _Analyzer] = {}
+    for an in analyzers:
+        for key, acquired in an.acquires.items():
+            bucket = may.setdefault(key, {})
+            for lock, line in acquired:
+                bucket.setdefault(lock, (an.info.path, line))
+            home.setdefault(key, an)
+        for key in an.calls:
+            may.setdefault(key, {})
+            home.setdefault(key, an)
+    changed = True
+    while changed:
+        changed = False
+        for an in analyzers:
+            for key, sites in an.calls.items():
+                caller_cls = key[0]
+                bucket = may.setdefault(key, {})
+                for site in sites:
+                    callee = _resolve_callee(
+                        site, caller_cls, an.info, tables
+                    )
+                    if callee is None:
+                        continue
+                    # A module-function callee key is per-module; only
+                    # follow it when it lives in the SAME module.
+                    if callee[0] is None and home.get(callee) is not an:
+                        continue
+                    for lock, where in may.get(callee, {}).items():
+                        if lock not in bucket:
+                            bucket[lock] = where
+                            changed = True
+    return may
+
+
+def _fix_lock_context(
+    analyzers: Sequence[_Analyzer], tables: _Tables
+) -> Set[Tuple[str, str]]:
+    """(cls, method) pairs provably only ever called with a
+    class-owned lock held — the router's "dispatch core runs under
+    self._lock" discipline. A method qualifies when it has >= 1
+    same-class `self.m()` call site and EVERY such site is under a
+    class-owned lock or inside an already-qualified method; any other
+    resolvable call site (module scope, other classes, thread targets
+    by name) disqualifies it."""
+    sites: Dict[Tuple[str, str], List[Tuple[Tuple[str, str], bool]]] = {}
+    disqualified: Set[Tuple[str, str]] = set()
+    for an in analyzers:
+        for (caller_cls, caller_m), call_list in an.calls.items():
+            for site in call_list:
+                if site.kind == "self" and caller_cls is not None:
+                    cls = tables.classes.get(caller_cls)
+                    if cls is None or site.name not in cls.methods:
+                        continue
+                    # Construction is single-threaded: a helper called
+                    # from __init__ needs no lock to be race-free.
+                    under = (
+                        caller_m in _CONSTRUCTION_METHODS
+                        or site.anonymous_held > 0
+                        or any(
+                            h.scope == "class" and h.owner == caller_cls
+                            for h in site.held
+                        )
+                    )
+                    sites.setdefault((caller_cls, site.name), []).append(
+                        ((caller_cls, caller_m), under)
+                    )
+                elif site.kind in ("attr", "mod"):
+                    callee = _resolve_callee(
+                        site, caller_cls, an.info, tables
+                    )
+                    if callee is not None and callee[0] is not None:
+                        disqualified.add(callee)  # reachable from outside
+        # `target=self._loop` thread seams: a method referenced (not
+        # called) is reachable outside any lock.
+        for node in ast.walk(an.info.tree):
+            if (
+                isinstance(node, ast.Attribute)
+                and isinstance(node.ctx, ast.Load)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+            ):
+                for cls in an.info.classes.values():
+                    if node.attr in cls.methods:
+                        parent_call = getattr(node, "_t2r_call_func", False)
+                        if not parent_call:
+                            pass  # handled below via reference scan
+    # Reference scan: any `self.m` NOT in call position disqualifies m.
+    for an in analyzers:
+        for node in ast.walk(an.info.tree):
+            if isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute
+            ):
+                node.func._t2r_in_call = True  # type: ignore[attr-defined]
+        for node in ast.walk(an.info.tree):
+            if (
+                isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+                and not getattr(node, "_t2r_in_call", False)
+            ):
+                for cls in an.info.classes.values():
+                    if node.attr in cls.methods:
+                        disqualified.add((cls.name, node.attr))
+    qualified: Set[Tuple[str, str]] = set()
+    changed = True
+    while changed:
+        changed = False
+        for key, call_sites in sites.items():
+            if key in qualified or key in disqualified:
+                continue
+            if all(
+                under or caller in qualified for caller, under in call_sites
+            ):
+                qualified.add(key)
+                changed = True
+    return qualified
+
+
+# -- cycle detection -----------------------------------------------------------
+
+
+def _find_cycles(edges: Sequence[_Edge], root: Optional[str]) -> List[Diagnostic]:
+    graph: Dict[LockId, Dict[LockId, _Edge]] = {}
+    for edge in edges:
+        graph.setdefault(edge.held, {}).setdefault(edge.acquired, edge)
+    diagnostics: List[Diagnostic] = []
+    seen: Set[frozenset] = set()
+    for start in sorted(graph, key=lambda lid: (lid.owner, lid.attr)):
+        # Bounded DFS for a path back to `start`.
+        stack: List[Tuple[LockId, List[_Edge]]] = [(start, [])]
+        visited: Set[LockId] = set()
+        while stack:
+            node, path = stack.pop()
+            for nxt, edge in sorted(
+                graph.get(node, {}).items(),
+                key=lambda kv: (kv[0].owner, kv[0].attr),
+            ):
+                if nxt == start and (path or edge.held == edge.acquired):
+                    cycle = path + [edge]
+                    key = frozenset(
+                        (e.held, e.acquired) for e in cycle
+                    )
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    order = " ; ".join(e.describe(root) for e in cycle)
+                    diagnostics.append(
+                        Diagnostic(
+                            cycle[0].path,
+                            cycle[0].line,
+                            RULE_CYCLE,
+                            (
+                                "lock-order cycle "
+                                + (
+                                    "(plain Lock re-entered — "
+                                    "self-deadlock): "
+                                    if len(cycle) == 1
+                                    else ""
+                                )
+                                + order
+                            ),
+                            ERROR,
+                        )
+                    )
+                elif nxt not in visited and nxt != start:
+                    visited.add(nxt)
+                    stack.append((nxt, path + [edge]))
+    return diagnostics
+
+
+# -- guard-contract tally ------------------------------------------------------
+
+
+def _guard_findings(
+    analyzers: Sequence[_Analyzer],
+    lock_context: Set[Tuple[str, str]],
+) -> List[Diagnostic]:
+    tally: Dict[Tuple[str, str], List[_Access]] = {}
+    for an in analyzers:
+        for access in an.accesses:
+            tally.setdefault((access.cls, access.field), []).append(access)
+    out: List[Diagnostic] = []
+    for (cls, field), accesses in tally.items():
+        # No post-construction mutation anywhere = immutable config;
+        # reads need no lock no matter where they happen to sit.
+        if not any(a.mutating for a in accesses):
+            continue
+        guarded = [
+            a
+            for a in accesses
+            if a.guarded or (a.cls, a.method) in lock_context
+        ]
+        unguarded = [
+            a
+            for a in accesses
+            if not (a.guarded or (a.cls, a.method) in lock_context)
+        ]
+        # Majority-guarded contract: >= 2 guarded touches and strictly
+        # more guarded than not — then the stragglers are findings.
+        if len(guarded) < 2 or len(guarded) <= len(unguarded):
+            continue
+        for a in unguarded:
+            out.append(
+                Diagnostic(
+                    a.path,
+                    a.line,
+                    RULE_UNGUARDED,
+                    f"{cls}.{field} is guarded at {len(guarded)} of "
+                    f"{len(accesses)} sites but touched here (in "
+                    f"{a.method}) without the lock; take the lock or "
+                    "annotate with `# t2r: unguarded-ok(reason)`",
+                    ERROR,
+                )
+            )
+    return out
+
+
+# -- escape hatches ------------------------------------------------------------
+
+
+def _collect_annotations(
+    source: str, path: str
+) -> Tuple[Dict[Tuple[int, str], Tuple[int, str]], List[Diagnostic]]:
+    """{(suppressed_line, rule): (annot_line, reason)} plus immediate
+    grammar errors (empty reason)."""
+    suppress: Dict[Tuple[int, str], Tuple[int, str]] = {}
+    problems: List[Diagnostic] = []
+    lines = source.splitlines()
+    for lineno, text in enumerate(lines, start=1):
+        m = _ANNOT_RE.search(text)
+        if not m:
+            continue
+        kind, reason = m.group(1), m.group(2).strip()
+        rule = _ANNOT_FAMILY[kind]
+        if not reason:
+            problems.append(
+                Diagnostic(
+                    path,
+                    lineno,
+                    RULE_STALE,
+                    f"`t2r: {kind}(...)` escape hatch requires a "
+                    "one-line reason",
+                    ERROR,
+                )
+            )
+            continue
+        target = lineno
+        if text.lstrip().startswith("#"):
+            target = lineno + 1  # comment-only line annotates the next
+        suppress[(target, rule)] = (lineno, reason)
+    return suppress, problems
+
+
+def _apply_annotations(
+    diagnostics: List[Diagnostic],
+    per_file_suppress: Dict[str, Dict[Tuple[int, str], Tuple[int, str]]],
+) -> List[Diagnostic]:
+    used: Set[Tuple[str, int]] = set()
+    kept: List[Diagnostic] = []
+    for d in diagnostics:
+        table = per_file_suppress.get(d.path, {})
+        hit = table.get((d.line, d.rule))
+        if hit is not None:
+            used.add((d.path, hit[0]))
+            continue
+        kept.append(d)
+    for path, table in per_file_suppress.items():
+        for (target, rule), (annot_line, _reason) in table.items():
+            if (path, annot_line) not in used:
+                kept.append(
+                    Diagnostic(
+                        path,
+                        annot_line,
+                        RULE_STALE,
+                        f"stale escape hatch: no [{rule}] finding on "
+                        f"line {target} to suppress — the code changed; "
+                        "delete the annotation",
+                        ERROR,
+                    )
+                )
+    return kept
+
+
+# -- entry points --------------------------------------------------------------
+
+
+def _analyze(
+    sources: Sequence[Tuple[str, str]], root: Optional[str]
+) -> List[Diagnostic]:
+    infos: List[_ModuleInfo] = []
+    diagnostics: List[Diagnostic] = []
+    per_file_suppress: Dict[str, Dict[Tuple[int, str], Tuple[int, str]]] = {}
+    for path, source in sources:
+        try:
+            tree = ast.parse(source)
+        except SyntaxError as exc:
+            diagnostics.append(
+                Diagnostic(
+                    path,
+                    exc.lineno or 0,
+                    RULE_PARSE,
+                    f"could not parse: {exc.msg}",
+                    ERROR,
+                )
+            )
+            continue
+        module = os.path.splitext(os.path.basename(path))[0]
+        info = _ModuleInfo(path, module, tree, source)
+        _Collector(info).visit(tree)
+        infos.append(info)
+        suppress, problems = _collect_annotations(source, path)
+        per_file_suppress[path] = suppress
+        diagnostics.extend(problems)
+    tables = _build_tables(infos)
+    analyzers: List[_Analyzer] = []
+    for info in infos:
+        _link_parents(info.tree)
+        an = _Analyzer(info, tables)
+        an.visit(info.tree)
+        analyzers.append(an)
+    # Call-mediated acquisition edges via the may-acquire fixpoint.
+    may = _fix_may_acquire(analyzers, tables)
+    edges: List[_Edge] = []
+    for an in analyzers:
+        edges.extend(an.edges)
+        for key, sites in an.calls.items():
+            for site in sites:
+                if not site.held:
+                    continue
+                callee = _resolve_callee(site, key[0], an.info, tables)
+                if callee is None:
+                    continue
+                for lock, (lpath, lline) in may.get(callee, {}).items():
+                    for held in site.held:
+                        if held == lock:
+                            continue  # re-entry is the RLock's contract
+                        edges.append(
+                            _Edge(
+                                held,
+                                lock,
+                                an.info.path,
+                                site.line,
+                                site.line,
+                            )
+                        )
+    lock_context = _fix_lock_context(analyzers, tables)
+    findings = list(diagnostics)
+    findings.extend(_guard_findings(analyzers, lock_context))
+    for an in analyzers:
+        findings.extend(an.blocking)
+    findings.extend(_find_cycles(edges, root))
+    findings = _apply_annotations(findings, per_file_suppress)
+    findings.sort(key=lambda d: (d.path, d.line, d.rule))
+    return findings
+
+
+def check_source(source: str, path: str = "<memory>") -> List[Diagnostic]:
+    """Single-source entry point (the test-fixture seam)."""
+    return _analyze([(path, source)], None)
+
+
+def check_sources(
+    sources: Sequence[Tuple[str, str]]
+) -> List[Diagnostic]:
+    """Multi-module entry point: `(path, source)` pairs analyzed as one
+    cross-module program (the alias-resolution test seam)."""
+    return _analyze(list(sources), None)
+
+
+def check_paths(
+    paths: Optional[Sequence[str]] = None, root: Optional[str] = None
+) -> List[Diagnostic]:
+    """Analyze the threaded fabric (or an explicit file/dir list) as
+    ONE cross-module program."""
+    if root is None:
+        root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+    if paths is None:
+        paths = [os.path.join(root, p) for p in DEFAULT_CONCURRENCY_ROOTS]
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, _dirnames, filenames in os.walk(p):
+                files.extend(
+                    os.path.join(dirpath, f)
+                    for f in sorted(filenames)
+                    if f.endswith(".py")
+                )
+        elif p.endswith(".py"):
+            files.append(p)
+        else:
+            raise OSError(f"{p}: not a .py file or a directory")
+    sources = []
+    for f in sorted(set(files)):
+        with open(f, "r", encoding="utf-8") as fh:
+            sources.append((f, fh.read()))
+    return _analyze(sources, root)

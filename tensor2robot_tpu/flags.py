@@ -284,6 +284,26 @@ _declare(
     minimum=1,
 )
 _declare(
+    "T2R_LOCK_SANITIZER",
+    _BOOL,
+    False,
+    "Instrument the threaded fabric's locks (testing/locksmith.py): "
+    "runtime lock-order cycle detection, hold-time budgets, and "
+    "blocking-call-under-lock reports. Off = plain threading "
+    "primitives, zero overhead.",
+    "tensor2robot_tpu/testing/locksmith.py",
+)
+_declare(
+    "T2R_LOCK_HOLD_BUDGET_MS",
+    _INT,
+    2000,
+    "Per-lock hold-time budget for the lock sanitizer, in ms. "
+    "Exceeding it records a typed hold-budget violation (report only, "
+    "never a kill); 0 disables the budget.",
+    "tensor2robot_tpu/testing/locksmith.py",
+    minimum=0,
+)
+_declare(
     "T2R_MULTI_EVAL_NAME",
     _STR,
     None,

@@ -23,6 +23,8 @@ from __future__ import annotations
 
 import logging
 import threading
+
+from tensor2robot_tpu.testing import locksmith
 import time
 from typing import Any, Callable, Dict, Mapping, Optional
 
@@ -63,7 +65,9 @@ class ExportedSavedModelPredictor(AbstractPredictor):
         self._tile = tile_batch_for_action
         self._loaded: Optional[ExportedModel] = None
         self._predict_fn: Optional[Callable] = None
-        self._lock = threading.Lock()
+        self._lock = locksmith.make_lock(
+            "ExportedSavedModelPredictor._lock", budget_ms=0
+        )
         self._restore_thread: Optional[threading.Thread] = None
         # True from the moment an async restore is SCHEDULED until its
         # thread finishes — is_alive() alone has a window where the thread

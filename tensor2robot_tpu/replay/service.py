@@ -57,6 +57,8 @@ import queue
 import random
 import signal
 import threading
+
+from tensor2robot_tpu.testing import locksmith
 import time
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -242,7 +244,7 @@ class ReplayBuffer:
     ):
         self.root = root
         os.makedirs(root, exist_ok=True)
-        self._lock = threading.Lock()
+        self._lock = locksmith.make_lock("ReplayBuffer._lock")
         self._seal_episodes = (
             t2r_flags.get_int("T2R_REPLAY_SEAL_EPISODES")
             if seal_episodes is None else max(1, seal_episodes)
@@ -675,7 +677,7 @@ class ReplayClient:
         self._token = f"{os.getpid()}-{id(self):x}-{random.getrandbits(32):08x}"
         self._req_counter = 0
         self._nonce = 0
-        self._lock = threading.Lock()
+        self._lock = locksmith.make_lock("ReplayClient._lock")
 
     def _attempt(self, req_id, op, args, call_timeout: float):
         """One wire attempt: (reply tuple, None) on a matched reply, or
@@ -721,6 +723,7 @@ class ReplayClient:
             last_error: Optional[Exception] = None
             attempts = 0
             for attempt in range(call_retries + 1):
+                # t2r: blocking-ok(the client lock IS the request serializer; it paces exactly one in-flight conversation)
                 if attempt and not self._backoff.sleep(attempt):
                     break  # total budget exhausted: stop retrying
                 remaining = self._backoff.remaining_s()

@@ -42,6 +42,8 @@ import logging
 import os
 import random
 import threading
+
+from tensor2robot_tpu.testing import locksmith
 import time
 from collections import deque
 from typing import Any, Deque, Dict, List, Optional, Sequence, Tuple
@@ -164,7 +166,7 @@ class ShardedReplayClient:
         )
         self._probe_interval_s = probe_interval_s
         self._sample_timeout_s = sample_timeout_s
-        self._lock = threading.Lock()
+        self._lock = locksmith.make_lock("ShardedReplayClient._lock")
         # Episode uids carry a per-INSTANCE token (same rationale as
         # ReplayClient's request ids): a restarted client reusing the
         # same client_id must never mint uids that collide with its

@@ -49,6 +49,8 @@ import pickle
 import socket
 import struct
 import threading
+
+from tensor2robot_tpu.testing import locksmith
 import time
 import zlib
 from typing import Any, Callable, List, Optional, Tuple
@@ -267,7 +269,7 @@ class ReplayTransportServer:
         self._closed = False
         self._threads: List[threading.Thread] = []
         self._conns: List[socket.socket] = []
-        self._lock = threading.Lock()
+        self._lock = locksmith.make_lock("ReplayTransportServer._lock")
         self._accept_thread: Optional[threading.Thread] = None
 
     def start(self) -> "ReplayTransportServer":

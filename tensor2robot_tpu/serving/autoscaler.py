@@ -41,6 +41,8 @@ from __future__ import annotations
 
 import logging
 import threading
+
+from tensor2robot_tpu.testing import locksmith
 import time
 from typing import Dict, List, Optional
 
@@ -98,7 +100,7 @@ class Autoscaler:
         self._cooloff = Backoff(
             base_ms=cooloff_base_ms, cap_ms=cooloff_cap_ms, seed=seed
         )
-        self._lock = threading.Lock()
+        self._lock = locksmith.make_lock("Autoscaler._lock")
         self._above = 0  # consecutive ticks above high watermark
         self._below = 0  # consecutive ticks below low watermark
         self._quiet_until = 0.0

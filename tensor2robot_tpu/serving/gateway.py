@@ -70,6 +70,8 @@ import hashlib
 import itertools
 import logging
 import threading
+
+from tensor2robot_tpu.testing import locksmith
 import time
 import zlib
 from collections import deque
@@ -207,7 +209,7 @@ class GateFuture:
         self._response: Optional[GateResponse] = None
         self._error: Optional[BaseException] = None
         self._callbacks: List = []
-        self._cb_lock = threading.Lock()
+        self._cb_lock = locksmith.make_lock("GateFuture._cb_lock")
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -343,7 +345,7 @@ class _Pool:
         self.name = name
         self.router = router
         self.queues: Dict[str, deque] = {tier: deque() for tier in TIERS}
-        self.cond = threading.Condition()
+        self.cond = locksmith.make_condition("_Pool.cond")
         self.coalesce: Dict[bytes, _CoalesceEntry] = {}
         self.swap_epoch = 0
         # Per-policy publish epochs: rolling_swap(policy_id=...) bumps
@@ -488,7 +490,7 @@ class Gateway:
 
         # Reentrant: admission counts failures while holding the state
         # lock (the router's convention).
-        self._lock = threading.RLock()
+        self._lock = locksmith.make_rlock("Gateway._lock")
         self._tenants: Dict[str, _Tenant] = {}
         for i, binding in enumerate(bindings):
             if binding.tier not in _TIER_RANK:

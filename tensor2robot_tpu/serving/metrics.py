@@ -13,6 +13,8 @@ number that says whether micro-batching is earning its latency cost.
 from __future__ import annotations
 
 import threading
+
+from tensor2robot_tpu.testing import locksmith
 from collections import deque
 from typing import Dict, List, Optional
 
@@ -59,7 +61,7 @@ class ServerMetrics:
     """Thread-safe aggregate; all mutators are O(1)."""
 
     def __init__(self, span_window: int = 2048):
-        self._lock = threading.Lock()
+        self._lock = locksmith.make_lock("ServerMetrics._lock")
         self._spans: deque = deque(maxlen=span_window)
         self._counters = {
             "admitted": 0,

@@ -26,6 +26,8 @@ from __future__ import annotations
 
 import collections
 import threading
+
+from tensor2robot_tpu.testing import locksmith
 import time
 from typing import Any, Callable, Dict, Iterable, List, Optional
 
@@ -129,7 +131,7 @@ class MultiPolicyServer:
             "policy_cold_loads": 0,
             "policy_evictions": 0,
         }
-        self._lock = threading.RLock()
+        self._lock = locksmith.make_rlock("MultiPolicyServer._lock")
         self._load_locks: Dict[str, threading.Lock] = {}
         self._closed = False
         for policy_id in preload:
@@ -195,7 +197,11 @@ class MultiPolicyServer:
                     "loads are disabled (T2R_POLICY_COLD_LOAD=0)"
                 )
             load_lock = self._load_locks.setdefault(
-                policy_id, threading.Lock()
+                policy_id,
+                locksmith.make_lock(
+                    f"MultiPolicyServer._load_locks[{policy_id}]",
+                    budget_ms=0,  # brackets a whole model load by design
+                ),
             )
         with load_lock:  # single-flight; the load runs OUTSIDE self._lock
             with self._lock:

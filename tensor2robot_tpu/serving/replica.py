@@ -355,12 +355,13 @@ class _LocalFuture:
 
     def __init__(self):
         import threading
+        from tensor2robot_tpu.testing import locksmith
 
         self._event = threading.Event()
         self._response = None
         self._error: Optional[BaseException] = None
         self._callbacks = []
-        self._lock = threading.Lock()
+        self._lock = locksmith.make_lock("_LocalFuture._lock")
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -415,6 +416,7 @@ class _MockServer:
         mem_bytes: int = 0,
     ):
         import threading
+        from tensor2robot_tpu.testing import locksmith
 
         self._service_s = service_ms / 1e3
         self.model_version = version
@@ -428,7 +430,7 @@ class _MockServer:
         self._queue: "queue.Queue" = queue.Queue()
         self._closed = False
         self._completed = 0
-        self._lock = threading.Lock()
+        self._lock = locksmith.make_lock("_MockServer._lock")
         self._worker = threading.Thread(
             target=self._compute_loop, name="t2r-mock-compute", daemon=True
         )

@@ -48,6 +48,8 @@ import heapq
 import itertools
 import logging
 import threading
+
+from tensor2robot_tpu.testing import locksmith
 import time
 from collections import deque
 from typing import Any, Dict, List, Mapping, Optional, Sequence, Set, Tuple
@@ -142,7 +144,7 @@ class RouterFuture:
         self._response: Optional[FleetResponse] = None
         self._error: Optional[BaseException] = None
         self._callbacks: List = []
-        self._cb_lock = threading.Lock()
+        self._cb_lock = locksmith.make_lock("RouterFuture._cb_lock")
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -232,7 +234,7 @@ class _RouterMetrics:
     """Counters + bounded latency window; all O(1) mutators."""
 
     def __init__(self, span_window: int = 4096):
-        self._lock = threading.Lock()
+        self._lock = locksmith.make_lock("_RouterMetrics._lock")
         self._counters: Dict[str, int] = {}
         self._latencies: deque = deque(maxlen=span_window)
 
@@ -339,7 +341,7 @@ class FleetRouter:
         self._inline_max = inline_max_bytes
         self._shm_slots = shm_slots
 
-        self._lock = threading.RLock()
+        self._lock = locksmith.make_rlock("FleetRouter._lock")
         self._metrics = _RouterMetrics()
         self._replicas: List[_Replica] = [
             _Replica(i, spec) for i, spec in enumerate(specs)
@@ -356,7 +358,7 @@ class FleetRouter:
         # Timer wheel: (when, seq, fn) heap drained by one thread.
         self._timer_heap: List = []
         self._timer_seq = itertools.count()
-        self._timer_cond = threading.Condition()
+        self._timer_cond = locksmith.make_condition("FleetRouter._timer_cond")
 
         self._ctx = None
         self._response_q = None
@@ -382,6 +384,7 @@ class FleetRouter:
             inline_max_bytes=self._inline_max,
             num_slots=self._shm_slots,
         )
+        # t2r: unguarded-ok(start() runs before any fleet thread exists)
         for replica in self._replicas:
             self._spawn(replica)
         self._started = True
@@ -442,10 +445,12 @@ class FleetRouter:
                 )
         with self._timer_cond:
             self._timer_cond.notify_all()
+        # t2r: unguarded-ok(stop() flipped _closed under the lock above; _replicas is append-only and fenced)
         for replica in self._replicas:
             if replica.request_q is not None:
                 best_effort(replica.request_q.put, ("stop",))
         deadline = time.monotonic() + timeout_s
+        # t2r: unguarded-ok(stop() flipped _closed under the lock above; _replicas is append-only and fenced)
         for replica in self._replicas:
             proc = replica.proc
             if proc is None:
@@ -460,6 +465,7 @@ class FleetRouter:
         if self._codec is not None:
             self._codec.close()
         for q in [self._response_q, self._free_q] + [
+            # t2r: unguarded-ok(stop() flipped _closed under the lock above; _replicas is append-only and fenced)
             r.request_q for r in self._replicas
         ]:
             if q is None:
@@ -487,6 +493,7 @@ class FleetRouter:
         through the returned future. `policy_id` names the policy on a
         multi-policy fleet (placement-aware: replicas already holding it
         resident are preferred; a miss is a counted cold dispatch)."""
+        # t2r: unguarded-ok(racy fast-fail only; admission re-checks _closed under the lock below)
         if not self._started or self._closed:
             raise RouterClosed("router is not running")
         now = time.monotonic()
@@ -848,6 +855,7 @@ class FleetRouter:
     def _collector_loop(self) -> None:
         import queue as queue_lib
 
+        # t2r: unguarded-ok(loop-exit staleness is one 0.1s tick; stop() also closes the queue under us)
         while not self._closed:
             try:
                 message = self._response_q.get(timeout=0.1)
@@ -971,6 +979,7 @@ class FleetRouter:
         )
 
     def _timer_loop(self) -> None:
+        # t2r: unguarded-ok(loop-exit staleness is one timer tick; stop() notifies the cond to wake us)
         while not self._closed:
             due: List = []
             with self._timer_cond:
@@ -1002,12 +1011,15 @@ class FleetRouter:
 
     @poll_loop
     def _monitor_loop(self) -> None:
+        # t2r: unguarded-ok(monitor cadence read; one stale probe tick is harmless)
         while not self._closed:
             time.sleep(self._probe_interval_s)
+            # t2r: unguarded-ok(re-check after the sleep; worst case is one extra probe)
             if self._closed:
                 return
             now = time.monotonic()
             # Copy: the autoscaler may append replicas mid-iteration.
+            # t2r: unguarded-ok(snapshot copy; list append is atomic under the GIL and state is re-checked)
             for replica in list(self._replicas):
                 proc = replica.proc
                 if proc is not None and not proc.is_alive():
@@ -1168,6 +1180,7 @@ class FleetRouter:
         policies keep serving their current versions without a blip."""
         results: Dict[str, Any] = {"swapped": [], "failed": None}
         self._metrics.count("rolling_swaps")
+        # t2r: unguarded-ok(iterates a snapshot copy; per-replica work re-validates state under the lock)
         for replica in list(self._replicas):
             with self._lock:
                 if replica.state not in (_UP, _SUSPECT, _BROKEN):
@@ -1179,6 +1192,7 @@ class FleetRouter:
                 if policy_id is not None:
                     message = message + (policy_id,)
                 try:
+                    # t2r: blocking-ok(unbounded mp.Queue put never blocks on capacity)
                     replica.request_q.put(message)
                 except Exception:
                     results["failed"] = replica.index
@@ -1203,6 +1217,7 @@ class FleetRouter:
 
     @property
     def num_replicas(self) -> int:
+        # t2r: unguarded-ok(len() of an append-only list is an atomic snapshot)
         return len(self._replicas)
 
     def replica_states(self) -> List[str]:

@@ -38,6 +38,8 @@ from __future__ import annotations
 import itertools
 import logging
 import threading
+
+from tensor2robot_tpu.testing import locksmith
 import time
 from collections import deque
 from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
@@ -126,7 +128,7 @@ class ServeFuture:
         self._response: Optional[ServeResponse] = None
         self._error: Optional[BaseException] = None
         self._callbacks: List = []
-        self._cb_lock = threading.Lock()
+        self._cb_lock = locksmith.make_lock("ServeFuture._cb_lock")
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -239,7 +241,7 @@ class PolicyServer:
         self._prewarm_source: Dict[int, str] = {}
         self._metrics = ServerMetrics()
         self._queue: deque = deque()
-        self._cond = threading.Condition()
+        self._cond = locksmith.make_condition("PolicyServer._cond")
         self._ids = itertools.count(1)
         self._dispatcher: Optional[threading.Thread] = None
         self._started = False
@@ -299,6 +301,7 @@ class PolicyServer:
         if installer is not None:
             installer(self._prewarm_restored)
         self._started = True
+        # t2r: unguarded-ok(start() runs before the dispatcher thread exists)
         self._closed = False
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, name="t2r-serve-dispatch", daemon=True

@@ -1,0 +1,508 @@
+"""Runtime lock sanitizer: the dynamic half of the lock-discipline pass.
+
+`analysis/concurrency.py` proves what it can from the AST; this module
+watches what actually happens. Behind `T2R_LOCK_SANITIZER`, the
+threaded modules create their locks through the factory seam below
+(`make_lock` / `make_rlock` / `make_condition`) instead of calling
+`threading.*` directly. With the flag OFF (the default) the factories
+return the plain `threading` primitives — bitwise identical behavior,
+zero overhead. With it ON they return instrumented wrappers that:
+
+* record per-thread acquisition stacks and maintain a global
+  acquisition-order graph keyed by the same `(Class, attr)` lock
+  identity the static pass uses — an edge A->B means "B was acquired
+  while A was held", anywhere, by any thread;
+
+* detect lock-order cycles the moment the closing edge is observed
+  (lockdep's trick: a cycle in the ORDER graph is a deadlock that some
+  interleaving can hit, so it fires deterministically even when this
+  run's timing never actually deadlocks), reporting both acquisition
+  stacks;
+
+* enforce a per-lock hold-time budget (`T2R_LOCK_HOLD_BUDGET_MS`): a
+  critical section held past the budget records a typed violation —
+  a report, never a kill. Locks that legitimately bracket long work
+  (single-flight model loads, the XLA dispatch-order lock) opt out
+  with `budget_ms=0` at the creation site, which keeps the exemption
+  grep-able like the lint allow-decorators;
+
+* detect blocking-call-under-lock dynamically: a patched `time.sleep`
+  hook (installed only while the sanitizer is on) and untimed
+  `Condition.wait` while OTHER sanitized locks are held both record
+  typed violations — this is how a chaos `delay` clause landing inside
+  a critical section becomes a visible finding instead of silent tail
+  latency.
+
+The chaos suites run with the sanitizer enabled, so every tier-1 chaos
+run doubles as a deadlock hunt; `dump_report()` writes a deterministic
+acquisition-order artifact (sorted edges, repo-relative `path:line`
+frames, no wall-clock fields in the graph) so a cycle reproduces like
+a corpus crash.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time as _time
+import traceback
+from typing import Dict, List, Optional, Tuple
+
+from tensor2robot_tpu import flags as t2r_flags
+
+__all__ = [
+    "make_lock",
+    "make_rlock",
+    "make_condition",
+    "enabled",
+    "report",
+    "violations",
+    "dump_report",
+    "load_report",
+    "reset",
+]
+
+_OWN_FILE = os.path.abspath(__file__)
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(_OWN_FILE)))
+
+# Violation kinds (the typed report vocabulary).
+ORDER_CYCLE = "order-cycle"
+HOLD_BUDGET = "hold-budget"
+BLOCKING_UNDER_LOCK = "blocking-under-lock"
+
+# -- global sanitizer state ----------------------------------------------------
+
+_state_lock = threading.Lock()
+# (held_name, acquired_name) -> first-observed {"stack": [...], "thread": str}
+_edges: Dict[Tuple[str, str], Dict] = {}
+_violations: List[Dict] = []
+_tls = threading.local()
+
+_real_sleep = _time.sleep
+_hook_installed = False
+
+
+def enabled() -> bool:
+    return t2r_flags.get_bool("T2R_LOCK_SANITIZER")
+
+
+def _stack(skip: int = 2, limit: int = 12) -> List[str]:
+    """Repo-relative `path:line:func` frames, innermost last. The
+    sanitizer's own frames are dropped — a report points at the
+    acquisition SITE, not the instrumentation; frames outside the repo
+    are kept by basename so artifacts stay stable across checkouts."""
+    del skip  # superseded by the own-file filter below
+    frames = traceback.extract_stack()[:-1]
+    out = []
+    for f in frames:
+        path = f.filename
+        if os.path.abspath(path) == _OWN_FILE:
+            continue
+        out.append(f"{_rel(path)}:{f.lineno}:{f.name}")
+    return out[-limit:]
+
+
+_rel_cache: Dict[str, str] = {}
+
+
+def _rel(path: str) -> str:
+    rel = _rel_cache.get(path)
+    if rel is None:
+        rel = os.path.relpath(path, _REPO_ROOT)
+        if rel.startswith(".."):
+            rel = os.path.basename(path)
+        _rel_cache[path] = rel
+    return rel
+
+
+def _site() -> List[str]:
+    """The single nearest non-locksmith frame, as a one-element stack.
+
+    Full `_stack()` extraction is too slow for every acquisition (it
+    would perturb the timing-sensitive suites the sanitizer rides
+    along with); the steady state pays one frame walk, and the rare
+    events — a first-seen edge, a violation — pay for a full stack."""
+    f = sys._getframe(1)
+    while f is not None and f.f_code.co_filename == __file__:
+        f = f.f_back
+    if f is None:
+        return []
+    return [f"{_rel(f.f_code.co_filename)}:{f.f_lineno}:{f.f_code.co_name}"]
+
+
+def _held_stack() -> List:
+    held = getattr(_tls, "held", None)
+    if held is None:
+        held = _tls.held = []
+    return held
+
+
+class _Held:
+    __slots__ = ("name", "t0", "frames", "count", "budget_ms")
+
+    def __init__(self, name: str, frames: List[str], budget_ms: Optional[int]):
+        self.name = name
+        self.t0 = _time.monotonic()
+        self.frames = frames
+        self.count = 1
+        self.budget_ms = budget_ms
+
+
+def _path_exists(src: str, dst: str) -> Optional[List[Tuple[str, str]]]:
+    """DFS in the order graph; returns the edge path src->...->dst or
+    None. Called under _state_lock."""
+    stack: List[Tuple[str, List[Tuple[str, str]]]] = [(src, [])]
+    seen = {src}
+    while stack:
+        node, path = stack.pop()
+        for (a, b) in _edges:
+            if a != node or b in seen and b != dst:
+                continue
+            step = path + [(a, b)]
+            if b == dst:
+                return step
+            seen.add(b)
+            stack.append((b, step))
+    return None
+
+
+def _record_violation(kind: str, detail: Dict) -> None:
+    with _state_lock:
+        _violations.append({"kind": kind, **detail})
+
+
+def _note_acquired(name: str, budget_ms: Optional[int]) -> None:
+    held = _held_stack()
+    frames: Optional[List[str]] = None
+    for h in held:
+        if h.name == name:
+            continue
+        edge = (h.name, name)
+        # Unlocked membership probe: dict reads are GIL-atomic and a
+        # stale miss just falls through to the locked re-check. The
+        # steady state (edge already known) records nothing and
+        # captures no stack.
+        if edge in _edges:
+            continue
+        if frames is None:
+            frames = _stack(skip=3)
+        with _state_lock:
+            if edge not in _edges:
+                # Closing edge check BEFORE inserting: does a path
+                # name -> ... -> h.name already exist? Then this
+                # acquisition completes a cycle.
+                back = _path_exists(name, h.name)
+                if back is not None:
+                    _violations.append(
+                        {
+                            "kind": ORDER_CYCLE,
+                            "locks": sorted(
+                                {name, h.name}
+                                | {x for e in back for x in e}
+                            ),
+                            "edge": list(edge),
+                            "stack": frames,
+                            "held_stack": list(h.frames),
+                            "reverse_path": [list(e) for e in back],
+                            "reverse_stacks": {
+                                "->".join(e): _edges[e]["stack"]
+                                for e in back
+                                if e in _edges
+                            },
+                            "thread": threading.current_thread().name,
+                        }
+                    )
+                _edges[edge] = {
+                    "stack": frames,
+                    "thread": threading.current_thread().name,
+                }
+    held.append(_Held(name, frames if frames is not None else _site(), budget_ms))
+
+
+def _note_released(name: str) -> None:
+    held = _held_stack()
+    for i in range(len(held) - 1, -1, -1):
+        if held[i].name == name:
+            entry = held.pop(i)
+            hold_ms = (_time.monotonic() - entry.t0) * 1e3
+            budget = (
+                entry.budget_ms
+                if entry.budget_ms is not None
+                else t2r_flags.get_int("T2R_LOCK_HOLD_BUDGET_MS")
+            )
+            if budget and hold_ms > budget:
+                _record_violation(
+                    HOLD_BUDGET,
+                    {
+                        "lock": name,
+                        "hold_ms": round(hold_ms, 3),
+                        "budget_ms": budget,
+                        "stack": entry.frames,
+                        "thread": threading.current_thread().name,
+                    },
+                )
+            return
+
+
+def _note_blocking(what: str, skip: int = 3) -> None:
+    held = _held_stack()
+    if not held:
+        return
+    _record_violation(
+        BLOCKING_UNDER_LOCK,
+        {
+            "call": what,
+            "locks": [h.name for h in held],
+            "stack": _stack(skip=skip),
+            "thread": threading.current_thread().name,
+        },
+    )
+
+
+def _hooked_sleep(seconds):
+    # Only a finding when a sanitized lock is held by THIS thread.
+    if getattr(_tls, "held", None):
+        _note_blocking(f"time.sleep({seconds!r})")
+    return _real_sleep(seconds)
+
+
+def _ensure_hook() -> None:
+    global _hook_installed
+    if not _hook_installed:
+        _time.sleep = _hooked_sleep
+        _hook_installed = True
+
+
+def _uninstall_hook() -> None:
+    global _hook_installed
+    if _hook_installed:
+        _time.sleep = _real_sleep
+        _hook_installed = False
+
+
+# -- instrumented primitives ---------------------------------------------------
+
+
+class _SanLock:
+    """Drop-in threading.Lock with acquisition accounting."""
+
+    _reentrant = False
+
+    def __init__(self, name: str, budget_ms: Optional[int]):
+        self._name = name
+        self._budget_ms = budget_ms
+        self._inner = self._make_inner()
+
+    @staticmethod
+    def _make_inner():
+        return threading.Lock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            if self._reentrant:
+                for h in _held_stack():
+                    if h.name is self._name and h.count:
+                        h.count += 1
+                        return got
+            _note_acquired(self._name, self._budget_ms)
+        return got
+
+    def release(self) -> None:
+        if self._reentrant:
+            for h in _held_stack():
+                if h.name is self._name and h.count > 1:
+                    h.count -= 1
+                    self._inner.release()
+                    return
+        self._inner.release()
+        _note_released(self._name)
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<{type(self).__name__} {self._name} {self._inner!r}>"
+
+
+class _SanRLock(_SanLock):
+    """Drop-in threading.RLock; recursion tracked so order/hold
+    accounting sees one logical hold. Implements the private Condition
+    protocol (`_is_owned`/`_acquire_restore`/`_release_save`) so a
+    Condition built over it can fully release around wait()."""
+
+    _reentrant = True
+
+    @staticmethod
+    def _make_inner():
+        return threading.RLock()
+
+    def _is_owned(self) -> bool:
+        return self._inner._is_owned()
+
+    def _release_save(self):
+        for i, h in enumerate(_held_stack()):
+            if h.name is self._name:
+                _held_stack().pop(i)
+                break
+        return self._inner._release_save()
+
+    def _acquire_restore(self, state) -> None:
+        self._inner._acquire_restore(state)
+        _note_acquired(self._name, self._budget_ms)
+
+
+class _SanCondition:
+    """Drop-in threading.Condition over a sanitized RLock. wait()
+    releases the underlying lock (so hold-time accounting pauses, as
+    it should) and an UNTIMED wait while other sanitized locks are
+    held records a blocking-under-lock violation."""
+
+    def __init__(self, name: str, budget_ms: Optional[int]):
+        self._name = name
+        self._lock = _SanRLock(name, budget_ms)
+        self._cond = threading.Condition(self._lock)
+
+    def acquire(self, *args, **kwargs):
+        return self._lock.acquire(*args, **kwargs)
+
+    def release(self) -> None:
+        self._lock.release()
+
+    def __enter__(self):
+        self._cond.__enter__()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._cond.__exit__(*exc)
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        if timeout is None:
+            others = [
+                h.name for h in _held_stack() if h.name is not self._name
+            ]
+            if others:
+                _note_blocking(f"{self._name}.wait() untimed")
+        return self._cond.wait(timeout)
+
+    def wait_for(self, predicate, timeout: Optional[float] = None):
+        return self._cond.wait_for(predicate, timeout)
+
+    def notify(self, n: int = 1) -> None:
+        self._cond.notify(n)
+
+    def notify_all(self) -> None:
+        self._cond.notify_all()
+
+    def __repr__(self) -> str:
+        return f"<_SanCondition {self._name}>"
+
+
+# -- the factory seam ----------------------------------------------------------
+
+
+def make_lock(name: str, budget_ms: Optional[int] = None):
+    """A Lock named by its static identity (`Class._attr`). Off-path:
+    a plain threading.Lock. `budget_ms` overrides the flag budget for
+    this lock; 0 = exempt (a designed-long-hold critical section)."""
+    if not enabled():
+        return threading.Lock()
+    _ensure_hook()
+    return _SanLock(name, budget_ms)
+
+
+def make_rlock(name: str, budget_ms: Optional[int] = None):
+    if not enabled():
+        return threading.RLock()
+    _ensure_hook()
+    return _SanRLock(name, budget_ms)
+
+
+def make_condition(name: str, budget_ms: Optional[int] = None):
+    if not enabled():
+        return threading.Condition()
+    _ensure_hook()
+    return _SanCondition(name, budget_ms)
+
+
+# -- report surface ------------------------------------------------------------
+
+
+def violations(kind: Optional[str] = None) -> List[Dict]:
+    with _state_lock:
+        out = [dict(v) for v in _violations]
+    if kind is not None:
+        out = [v for v in out if v["kind"] == kind]
+    return out
+
+
+def report() -> Dict:
+    """The full typed report: the acquisition-order graph plus every
+    violation, deterministically ordered."""
+    with _state_lock:
+        edges = [
+            {"held": a, "acquired": b, **info}
+            for (a, b), info in _edges.items()
+        ]
+        viols = [dict(v) for v in _violations]
+    edges.sort(key=lambda e: (e["held"], e["acquired"]))
+    viols.sort(
+        key=lambda v: (
+            v["kind"],
+            json.dumps(
+                {k: v[k] for k in v if k not in ("hold_ms", "thread")},
+                sort_keys=True,
+                default=str,
+            ),
+        )
+    )
+    return {
+        "schema": "t2r-locksmith-v1",
+        "enabled": enabled(),
+        "edges": edges,
+        "violations": viols,
+    }
+
+
+def dump_report(path: str) -> str:
+    """Writes the report artifact (atomic rename, sorted keys) and
+    returns `path` — a cycle reproduces like a corpus crash: the
+    artifact names both acquisition paths by `path:line:func`."""
+    payload = json.dumps(report(), indent=2, sort_keys=True, default=str)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        fh.write(payload)
+    os.replace(tmp, path)
+    return path
+
+
+def load_report(path: str) -> Dict:
+    with open(path, "r", encoding="utf-8") as fh:
+        loaded = json.load(fh)
+    if loaded.get("schema") != "t2r-locksmith-v1":
+        raise ValueError(
+            f"{path}: not a locksmith report (schema "
+            f"{loaded.get('schema')!r})"
+        )
+    return loaded
+
+
+def reset() -> None:
+    """Clears the graph and violations (per-test isolation). The sleep
+    hook stays installed while the sanitizer is on; it uninstalls when
+    the flag is off."""
+    global _edges, _violations
+    with _state_lock:
+        _edges = {}
+        _violations = []
+    if not enabled():
+        _uninstall_hook()

@@ -23,6 +23,8 @@ import contextlib
 import itertools
 import os
 import threading
+
+from tensor2robot_tpu.testing import locksmith
 import time
 from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
 
@@ -72,7 +74,7 @@ BATCH_CARRYING_METRIC_PREFIXES = (GOLDEN_PREFIX, "per_example/")
 #: never for execution — trainer/eval overlap is preserved; only the
 #: ORDER every device sees becomes consistent. Production trainer and
 #: eval jobs live in separate processes and never contend here.
-_DISPATCH_LOCK = threading.Lock()
+_DISPATCH_LOCK = locksmith.make_lock("train_eval._DISPATCH_LOCK", budget_ms=0)
 
 
 def _serialize_dispatch(fn):

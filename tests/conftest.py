@@ -19,8 +19,34 @@ if "xla_force_host_platform_device_count" not in xla_flags:
     ).strip()
 
 import jax
+import pytest
 
 jax.config.update("jax_platforms", "cpu")
+
+
+@pytest.fixture
+def locksmith_sanitizer(monkeypatch):
+    """Runs a test with the lock sanitizer armed (testing/locksmith.py).
+
+    The chaos suites opt in with a module-local autouse fixture so every
+    seeded fault run doubles as a deadlock hunt: teardown FAILS the test
+    on any lock-order cycle or hold-budget violation observed at
+    runtime. Blocking-under-lock events are reported, not failed — chaos
+    `delay` clauses land inside critical sections by design and the
+    report is the point.
+    """
+    monkeypatch.setenv("T2R_LOCK_SANITIZER", "1")
+    from tensor2robot_tpu.testing import locksmith
+
+    locksmith.reset()
+    yield locksmith
+    cycles = locksmith.violations(locksmith.ORDER_CYCLE)
+    over_budget = locksmith.violations(locksmith.HOLD_BUDGET)
+    locksmith.reset()
+    assert not cycles, f"lock-order cycle(s) observed at runtime: {cycles}"
+    assert not over_budget, (
+        f"lock hold-time budget exceeded: {over_budget}"
+    )
 
 
 def pytest_configure(config):

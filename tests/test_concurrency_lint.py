@@ -1,0 +1,571 @@
+"""Tests for the lock-discipline static pass (analysis/concurrency.py).
+
+Every rule gets a seeded-violation fixture (the pass must FIND it) and
+a negative twin (the pass must stay quiet); alias-resolution cases pin
+the lock-identity model; the shipped tree must be clean with zero
+unreviewed escape hatches.
+"""
+
+import textwrap
+
+from tensor2robot_tpu.analysis import concurrency
+
+
+def _check(src):
+    return concurrency.check_source(textwrap.dedent(src), "fixture.py")
+
+
+def _rules(diags):
+    return [d.rule for d in diags]
+
+
+# -- guard-contract inference (conc-unguarded-field) --------------------------
+
+
+class TestUnguardedField:
+    def test_majority_guarded_field_flagged_at_bare_access(self):
+        diags = _check(
+            """
+            import threading
+
+            class Hub:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = []
+
+                def add(self, x):
+                    with self._lock:
+                        self._items.append(x)
+
+                def drain(self):
+                    with self._lock:
+                        out = list(self._items)
+                        self._items.clear()
+                        return out
+
+                def peek(self):
+                    return self._items[-1]
+            """
+        )
+        assert _rules(diags) == [concurrency.RULE_UNGUARDED]
+        assert "_items" in diags[0].message
+        assert "peek" in diags[0].message
+
+    def test_immutable_config_field_not_flagged(self):
+        # Never mutated after __init__: reads race nothing.
+        diags = _check(
+            """
+            import threading
+
+            class Hub:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._limit = 8
+                    self._pending = []
+
+                def add(self, x):
+                    with self._lock:
+                        if len(self._pending) < self._limit:
+                            self._pending.append(x)
+
+                def drain(self):
+                    with self._lock:
+                        self._pending.clear()
+
+                def limit(self):
+                    return self._limit
+            """
+        )
+        assert diags == []
+
+    def test_minority_guarded_field_not_flagged(self):
+        # Guarded once, bare once: no majority contract to enforce.
+        diags = _check(
+            """
+            import threading
+
+            class Hub:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = []
+
+                def add(self, x):
+                    with self._lock:
+                        self._items.append(x)
+
+                def mutate(self):
+                    self._items.append(None)
+            """
+        )
+        assert diags == []
+
+    def test_construction_writes_exempt(self):
+        # __init__ / start() run before threads exist; bare writes
+        # there must not break the contract.
+        diags = _check(
+            """
+            import threading
+
+            class Hub:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0
+
+                def bump(self):
+                    with self._lock:
+                        self._n += 1
+
+                def bump2(self):
+                    with self._lock:
+                        self._n += 1
+            """
+        )
+        assert diags == []
+
+    def test_helper_called_only_under_lock_counts_as_guarded(self):
+        # Lock-context inference: _flush is reachable only with the
+        # lock held, so its bare accesses honor the contract.
+        diags = _check(
+            """
+            import threading
+
+            class Hub:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = []
+
+                def add(self, x):
+                    with self._lock:
+                        self._items.append(x)
+                        if len(self._items) > 8:
+                            self._flush()
+
+                def drain(self):
+                    with self._lock:
+                        self._flush()
+
+                def _flush(self):
+                    self._items.clear()
+            """
+        )
+        assert diags == []
+
+    def test_helper_also_called_bare_is_not_lock_context(self):
+        diags = _check(
+            """
+            import threading
+
+            class Hub:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = []
+
+                def add(self, x):
+                    with self._lock:
+                        self._items.append(x)
+                        self._flush()
+
+                def fast(self):
+                    with self._lock:
+                        self._items.append(None)
+
+                def racy(self):
+                    self._flush()
+
+                def _flush(self):
+                    self._items.clear()
+            """
+        )
+        assert _rules(diags) == [concurrency.RULE_UNGUARDED]
+        assert "_flush" in diags[0].message
+
+
+# -- escape hatch + staleness -------------------------------------------------
+
+
+class TestAnnotations:
+    SRC = """
+        import threading
+
+        class Hub:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+
+            def add(self, x):
+                with self._lock:
+                    self._items.append(x)
+
+            def drain(self):
+                with self._lock:
+                    self._items.clear()
+
+            def peek(self):
+                return self._items[-1]{annot}
+    """
+
+    def test_unguarded_ok_suppresses(self):
+        diags = _check(
+            self.SRC.format(
+                annot="  # t2r: unguarded-ok(read is a racy stat)"
+            )
+        )
+        assert diags == []
+
+    def test_empty_reason_is_an_error(self):
+        diags = _check(self.SRC.format(annot="  # t2r: unguarded-ok()"))
+        assert concurrency.RULE_STALE in _rules(diags)
+
+    def test_unused_annotation_is_stale(self):
+        diags = _check(
+            """
+            import threading
+
+            class Hub:
+                def quiet(self):
+                    return 1  # t2r: unguarded-ok(nothing to suppress)
+            """
+        )
+        assert _rules(diags) == [concurrency.RULE_STALE]
+
+    def test_comment_line_above_applies_to_next_line(self):
+        diags = _check(
+            self.SRC.format(annot="").replace(
+                "        return self._items[-1]",
+                "        # t2r: unguarded-ok(racy stat)\n"
+                "                return self._items[-1]",
+            )
+        )
+        assert diags == []
+
+
+# -- lock-order cycles (conc-lock-order-cycle) --------------------------------
+
+
+class TestLockOrderCycles:
+    def test_two_lock_inversion_reports_both_paths(self):
+        diags = _check(
+            """
+            import threading
+
+            class Hub:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def forward(self):
+                    with self._a:
+                        with self._b:
+                            pass
+
+                def backward(self):
+                    with self._b:
+                        with self._a:
+                            pass
+            """
+        )
+        assert concurrency.RULE_CYCLE in _rules(diags)
+        cycle = next(d for d in diags if d.rule == concurrency.RULE_CYCLE)
+        assert "Hub._a" in cycle.message and "Hub._b" in cycle.message
+        # Both acquisition sites, in path:line diagnostic format.
+        assert cycle.message.count("fixture.py:") >= 2
+
+    def test_consistent_order_is_clean(self):
+        diags = _check(
+            """
+            import threading
+
+            class Hub:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def one(self):
+                    with self._a:
+                        with self._b:
+                            pass
+
+                def two(self):
+                    with self._a:
+                        with self._b:
+                            pass
+            """
+        )
+        assert diags == []
+
+    def test_plain_lock_reentry_is_self_deadlock(self):
+        diags = _check(
+            """
+            import threading
+
+            class Hub:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def outer(self):
+                    with self._lock:
+                        with self._lock:
+                            pass
+            """
+        )
+        assert concurrency.RULE_CYCLE in _rules(diags)
+
+    def test_rlock_reentry_is_fine(self):
+        diags = _check(
+            """
+            import threading
+
+            class Hub:
+                def __init__(self):
+                    self._lock = threading.RLock()
+
+                def outer(self):
+                    with self._lock:
+                        with self._lock:
+                            pass
+            """
+        )
+        assert diags == []
+
+    def test_call_mediated_cycle_found(self):
+        # outer holds A and CALLS a method that takes B; the reverse
+        # path holds B and calls a method that takes A.
+        diags = _check(
+            """
+            import threading
+
+            class Hub:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def fwd(self):
+                    with self._a:
+                        self._take_b()
+
+                def _take_b(self):
+                    with self._b:
+                        pass
+
+                def rev(self):
+                    with self._b:
+                        self._take_a()
+
+                def _take_a(self):
+                    with self._a:
+                        pass
+            """
+        )
+        assert concurrency.RULE_CYCLE in _rules(diags)
+
+
+# -- alias resolution / lock identity -----------------------------------------
+
+
+class TestLockIdentity:
+    def test_attr_hop_resolves_to_owning_class(self):
+        # self._pool is a _Pool; `with self._pool.cond` must resolve to
+        # the SAME LockId as _Pool methods' `with self.cond`.
+        diags = _check(
+            """
+            import threading
+
+            class _Pool:
+                def __init__(self):
+                    self.cond = threading.Condition()
+                    self.items = []
+
+                def put(self, x):
+                    with self.cond:
+                        self.items.append(x)
+                        self.cond.notify()
+
+                def size(self):
+                    with self.cond:
+                        self.items.clear()
+                        return 0
+
+            class Gateway:
+                def __init__(self):
+                    self._pool = _Pool()
+
+                def flush(self):
+                    with self._pool.cond:
+                        self._pool.items.clear()
+            """
+        )
+        assert diags == []
+
+    def test_module_level_lock_via_alias_import(self):
+        diags = concurrency.check_sources(
+            [
+                (
+                    "pkg/state.py",
+                    textwrap.dedent(
+                        """
+                        import threading
+
+                        GUARD = threading.Lock()
+                        """
+                    ),
+                ),
+                (
+                    "pkg/worker.py",
+                    textwrap.dedent(
+                        """
+                        import time
+
+                        from pkg import state
+
+                        def spin():
+                            with state.GUARD:
+                                time.sleep(1.0)
+                        """
+                    ),
+                ),
+            ]
+        )
+        blocking = [
+            d for d in diags if d.rule == concurrency.RULE_BLOCKING
+        ]
+        assert len(blocking) == 1
+        assert "state.GUARD" in blocking[0].message
+
+    def test_cross_module_inversion_found(self):
+        diags = concurrency.check_sources(
+            [
+                (
+                    "pkg/a.py",
+                    textwrap.dedent(
+                        """
+                        import threading
+
+                        LOCK_A = threading.Lock()
+                        LOCK_B = threading.Lock()
+
+                        def fwd():
+                            with LOCK_A:
+                                with LOCK_B:
+                                    pass
+                        """
+                    ),
+                ),
+                (
+                    "pkg/b.py",
+                    textwrap.dedent(
+                        """
+                        from pkg import a
+
+                        def rev():
+                            with a.LOCK_B:
+                                with a.LOCK_A:
+                                    pass
+                        """
+                    ),
+                ),
+            ]
+        )
+        cycles = [d for d in diags if d.rule == concurrency.RULE_CYCLE]
+        assert cycles, _rules(diags)
+        assert "pkg/a.py:" in cycles[0].message
+        assert "pkg/b.py:" in cycles[0].message
+
+    def test_locksmith_factory_spelling_is_a_lock(self):
+        diags = _check(
+            """
+            from tensor2robot_tpu.testing import locksmith
+
+            class Hub:
+                def __init__(self):
+                    self._lock = locksmith.make_lock("Hub._lock")
+                    self._items = []
+
+                def add(self, x):
+                    with self._lock:
+                        self._items.append(x)
+
+                def drain(self):
+                    with self._lock:
+                        self._items.clear()
+
+                def peek(self):
+                    return self._items[-1]
+            """
+        )
+        assert _rules(diags) == [concurrency.RULE_UNGUARDED]
+
+
+# -- blocking calls under a lock (conc-blocking-under-lock) -------------------
+
+
+class TestBlockingUnderLock:
+    def _held(self, body, extra=""):
+        return _check(
+            f"""
+            import queue
+            import time
+            import threading
+
+            class Hub:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._q = queue.Queue()
+                    self._worker = None
+                {extra}
+                def run(self):
+                    with self._lock:
+                        {body}
+            """
+        )
+
+    def test_untimed_queue_get(self):
+        diags = self._held("return self._q.get()")
+        assert _rules(diags) == [concurrency.RULE_BLOCKING]
+
+    def test_queue_get_with_timeout_ok(self):
+        assert self._held("return self._q.get(timeout=0.5)") == []
+
+    def test_time_sleep(self):
+        diags = self._held("time.sleep(1.0)")
+        assert _rules(diags) == [concurrency.RULE_BLOCKING]
+
+    def test_bare_join(self):
+        diags = self._held("self._worker.join()")
+        assert _rules(diags) == [concurrency.RULE_BLOCKING]
+
+    def test_join_with_timeout_ok(self):
+        assert self._held("self._worker.join(timeout=1.0)") == []
+
+    def test_predict_under_lock(self):
+        diags = self._held("return self.predictor.predict({})")
+        assert _rules(diags) == [concurrency.RULE_BLOCKING]
+
+    def test_blocking_ok_annotation_suppresses(self):
+        diags = self._held(
+            "time.sleep(0.1)  # t2r: blocking-ok(test pacing only)"
+        )
+        assert diags == []
+
+    def test_no_lock_no_finding(self):
+        diags = _check(
+            """
+            import time
+
+            def pace():
+                time.sleep(1.0)
+            """
+        )
+        assert diags == []
+
+
+# -- the shipped tree ---------------------------------------------------------
+
+
+class TestShippedTree:
+    def test_threaded_fabric_is_clean(self):
+        diags = concurrency.check_paths()
+        assert diags == [], "\n".join(d.format() for d in diags)
+
+    def test_syntax_error_is_a_parse_finding(self):
+        diags = _check("def broken(:\n")
+        assert _rules(diags) == [concurrency.RULE_PARSE]

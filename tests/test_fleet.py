@@ -29,6 +29,15 @@ from tensor2robot_tpu.serving import (
 from tensor2robot_tpu.serving import transport
 
 
+@pytest.fixture(autouse=True)
+def _lock_sanitizer_armed(locksmith_sanitizer):
+    """Every run of this chaos suite doubles as a deadlock hunt: the
+    lock sanitizer (testing/locksmith.py) is armed for each test and
+    teardown fails on any observed lock-order cycle or hold-budget
+    violation (fixture: tests/conftest.py)."""
+    yield
+
+
 def _spec(service_ms=1.0, chaos=None, version=1):
     env = {"T2R_CHAOS": chaos} if chaos else {}
     return ReplicaSpec(

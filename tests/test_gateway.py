@@ -37,6 +37,15 @@ from tensor2robot_tpu.testing import chaos
 
 
 @pytest.fixture(autouse=True)
+def _lock_sanitizer_armed(locksmith_sanitizer):
+    """Every run of this chaos suite doubles as a deadlock hunt: the
+    lock sanitizer (testing/locksmith.py) is armed for each test and
+    teardown fails on any observed lock-order cycle or hold-budget
+    violation (fixture: tests/conftest.py)."""
+    yield
+
+
+@pytest.fixture(autouse=True)
 def _clean_chaos():
     chaos.reset()
     yield

@@ -1,0 +1,279 @@
+"""Tests for the runtime lock sanitizer (testing/locksmith.py).
+
+The cycle detector must fire DETERMINISTICALLY from a sequentially
+executed inversion (no timing, no real deadlock needed); the hold
+budget must fire when a chaos delay lands inside a critical section;
+the off path must hand back the plain threading primitives; and the
+report artifact must round-trip deterministically.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from tensor2robot_tpu.testing import chaos, locksmith
+
+
+@pytest.fixture(autouse=True)
+def _armed(monkeypatch):
+    monkeypatch.setenv("T2R_LOCK_SANITIZER", "1")
+    locksmith.reset()
+    yield
+    locksmith.reset()
+
+
+class TestOrderCycleDetection:
+    def test_sequential_inversion_detected_without_deadlock(self):
+        # ONE thread, fully sequential: A->B then B->A. A timing-based
+        # detector would need two racing threads to actually collide;
+        # the order-graph detector fires on the edge alone.
+        a = locksmith.make_lock("T._a")
+        b = locksmith.make_lock("T._b")
+        with a:
+            with b:
+                pass
+        assert locksmith.violations(locksmith.ORDER_CYCLE) == []
+        with b:
+            with a:
+                pass
+        cycles = locksmith.violations(locksmith.ORDER_CYCLE)
+        assert len(cycles) == 1
+        assert sorted(cycles[0]["edge"]) == ["T._a", "T._b"]
+        # Both acquisition paths are reported as stacks.
+        assert cycles[0]["stack"] and cycles[0]["held_stack"]
+        assert cycles[0]["reverse_stacks"]
+
+    def test_cross_thread_inversion_detected(self):
+        a = locksmith.make_lock("T._a")
+        b = locksmith.make_lock("T._b")
+        with a:
+            with b:
+                pass
+
+        def invert():
+            with b:
+                with a:
+                    pass
+
+        t = threading.Thread(target=invert)
+        t.start()
+        t.join()
+        assert len(locksmith.violations(locksmith.ORDER_CYCLE)) == 1
+
+    def test_three_lock_transitive_cycle(self):
+        a = locksmith.make_lock("T._a")
+        b = locksmith.make_lock("T._b")
+        c = locksmith.make_lock("T._c")
+        with a:
+            with b:
+                pass
+        with b:
+            with c:
+                pass
+        with c:
+            with a:
+                pass  # closes A->B->C->A
+        cycles = locksmith.violations(locksmith.ORDER_CYCLE)
+        assert len(cycles) == 1
+        assert cycles[0]["locks"] == ["T._a", "T._b", "T._c"]
+
+    def test_consistent_order_clean(self):
+        a = locksmith.make_lock("T._a")
+        b = locksmith.make_lock("T._b")
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        assert locksmith.violations(locksmith.ORDER_CYCLE) == []
+
+    def test_rlock_reentry_is_one_logical_hold(self):
+        r = locksmith.make_rlock("T._r")
+        with r:
+            with r:
+                pass
+        assert locksmith.violations() == []
+        assert all(
+            e["held"] != e["acquired"]
+            for e in locksmith.report()["edges"]
+        )
+
+
+class TestHoldBudget:
+    def test_chaos_delay_inside_critical_section_fires(self, monkeypatch):
+        # A chaos `delay` clause landing inside a critical section is
+        # exactly the production scenario the budget exists for.
+        monkeypatch.setenv("T2R_LOCK_HOLD_BUDGET_MS", "20")
+        monkeypatch.setenv("T2R_CHAOS", "lockhold:1:delay:50")
+        chaos.reset()
+        lock = locksmith.make_lock("T._slow")
+        with lock:
+            fired = chaos.maybe_fire("lockhold")
+            assert fired, "seeded chaos plan must fire deterministically"
+        over = locksmith.violations(locksmith.HOLD_BUDGET)
+        assert len(over) == 1
+        assert over[0]["lock"] == "T._slow"
+        assert over[0]["hold_ms"] > over[0]["budget_ms"] == 20
+        # The sleep also records blocking-under-lock — report, not kill.
+        assert locksmith.violations(locksmith.BLOCKING_UNDER_LOCK)
+        chaos.reset()
+
+    def test_within_budget_is_clean(self, monkeypatch):
+        monkeypatch.setenv("T2R_LOCK_HOLD_BUDGET_MS", "5000")
+        lock = locksmith.make_lock("T._fast")
+        with lock:
+            pass
+        assert locksmith.violations(locksmith.HOLD_BUDGET) == []
+
+    def test_budget_zero_exempts_designed_long_holds(self, monkeypatch):
+        monkeypatch.setenv("T2R_LOCK_HOLD_BUDGET_MS", "1")
+        lock = locksmith.make_lock("T._load", budget_ms=0)
+        with lock:
+            time.sleep(0.02)
+        assert locksmith.violations(locksmith.HOLD_BUDGET) == []
+
+    def test_per_lock_budget_overrides_flag(self, monkeypatch):
+        monkeypatch.setenv("T2R_LOCK_HOLD_BUDGET_MS", "60000")
+        lock = locksmith.make_lock("T._tight", budget_ms=5)
+        with lock:
+            time.sleep(0.02)
+        over = locksmith.violations(locksmith.HOLD_BUDGET)
+        assert len(over) == 1 and over[0]["budget_ms"] == 5
+
+
+class TestBlockingUnderLock:
+    def test_sleep_under_lock_reported(self):
+        lock = locksmith.make_lock("T._l")
+        with lock:
+            time.sleep(0.001)
+        bl = locksmith.violations(locksmith.BLOCKING_UNDER_LOCK)
+        assert len(bl) == 1
+        assert bl[0]["locks"] == ["T._l"]
+
+    def test_sleep_without_lock_not_reported(self):
+        locksmith.make_lock("T._l")  # hook installed, nothing held
+        time.sleep(0.001)
+        assert locksmith.violations(locksmith.BLOCKING_UNDER_LOCK) == []
+
+    def test_untimed_condition_wait_while_other_lock_held(self):
+        outer = locksmith.make_lock("T._outer")
+        cond = locksmith.make_condition("T._cond")
+
+        def late_notify():
+            time.sleep(0.02)
+            with cond:
+                cond.notify_all()
+
+        t = threading.Thread(target=late_notify)
+        t.start()
+        with outer:
+            with cond:
+                cond.wait()
+        t.join()
+        waits = [
+            v
+            for v in locksmith.violations(locksmith.BLOCKING_UNDER_LOCK)
+            if "wait" in v["call"]
+        ]
+        assert len(waits) == 1
+
+    def test_timed_condition_wait_is_fine(self):
+        outer = locksmith.make_lock("T._outer")
+        cond = locksmith.make_condition("T._cond")
+        with outer:
+            with cond:
+                cond.wait(timeout=0.01)
+        waits = [
+            v
+            for v in locksmith.violations(locksmith.BLOCKING_UNDER_LOCK)
+            if "wait" in v["call"]
+        ]
+        assert waits == []
+
+    def test_condition_wait_releases_hold_accounting(self, monkeypatch):
+        # wait() releases the lock; the wall-clock spent parked must
+        # NOT count against the hold budget.
+        monkeypatch.setenv("T2R_LOCK_HOLD_BUDGET_MS", "20")
+        cond = locksmith.make_condition("T._cond")
+
+        def notify_later():
+            time.sleep(0.06)
+            with cond:
+                cond.notify_all()
+
+        t = threading.Thread(target=notify_later)
+        t.start()
+        with cond:
+            cond.wait(timeout=1.0)
+        t.join()
+        assert locksmith.violations(locksmith.HOLD_BUDGET) == []
+
+
+class TestOffPath:
+    def test_disabled_returns_plain_threading_primitives(self, monkeypatch):
+        monkeypatch.setenv("T2R_LOCK_SANITIZER", "0")
+        lock = locksmith.make_lock("T._l")
+        rlock = locksmith.make_rlock("T._r")
+        cond = locksmith.make_condition("T._c")
+        assert type(lock) is type(threading.Lock())
+        assert type(rlock) is type(threading.RLock())
+        assert type(cond) is threading.Condition
+        with lock:
+            pass
+        with rlock:
+            pass
+        with cond:
+            pass
+        assert locksmith.report()["edges"] == []
+        assert locksmith.violations() == []
+
+    def test_disabled_reset_uninstalls_sleep_hook(self, monkeypatch):
+        locksmith.make_lock("T._l")  # enabled: hook goes in
+        assert time.sleep is not locksmith._real_sleep
+        monkeypatch.setenv("T2R_LOCK_SANITIZER", "0")
+        locksmith.reset()
+        assert time.sleep is locksmith._real_sleep
+
+
+class TestReportArtifact:
+    def test_round_trip_and_determinism(self, tmp_path):
+        a = locksmith.make_lock("T._a")
+        b = locksmith.make_lock("T._b")
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+        path = str(tmp_path / "locks.json")
+        locksmith.dump_report(path)
+        loaded = locksmith.load_report(path)
+        assert loaded["schema"] == "t2r-locksmith-v1"
+        assert [
+            (e["held"], e["acquired"]) for e in loaded["edges"]
+        ] == [("T._a", "T._b"), ("T._b", "T._a")]
+        kinds = [v["kind"] for v in loaded["violations"]]
+        assert locksmith.ORDER_CYCLE in kinds
+        # Stacks are repo-relative path:line:func frames.
+        frame = loaded["edges"][0]["stack"][-1]
+        assert frame.startswith("tests/test_locksmith.py:")
+        # Byte-identical on re-dump: the artifact is deterministic.
+        first = open(path).read()
+        locksmith.dump_report(path)
+        assert open(path).read() == first
+
+    def test_load_rejects_foreign_json(self, tmp_path):
+        path = tmp_path / "other.json"
+        path.write_text(json.dumps({"schema": "something-else"}))
+        with pytest.raises(ValueError):
+            locksmith.load_report(str(path))
+
+    def test_reset_clears_graph_and_violations(self):
+        a = locksmith.make_lock("T._a")
+        with a:
+            time.sleep(0.001)
+        assert locksmith.violations()
+        locksmith.reset()
+        assert locksmith.violations() == []
+        assert locksmith.report()["edges"] == []

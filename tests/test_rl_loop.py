@@ -33,6 +33,16 @@ import pytest
 from tensor2robot_tpu.replay.service import ReplayBuffer
 from tensor2robot_tpu.testing import chaos
 
+
+@pytest.fixture(autouse=True)
+def _lock_sanitizer_armed(locksmith_sanitizer):
+    """Every run of this chaos suite doubles as a deadlock hunt: the
+    lock sanitizer (testing/locksmith.py) is armed for each test and
+    teardown fails on any observed lock-order cycle or hold-budget
+    violation (fixture: tests/conftest.py)."""
+    yield
+
+
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 

@@ -501,6 +501,32 @@ class TestLintsCatch:
         for regime in ("none", "fp16", "int8", "fp8_e4m3", "fp8_e5m2"):
             assert regime in choices, regime
 
+    def test_lock_sanitizer_flags_covered_by_registry_lint(self):
+        """The lock-sanitizer flags (testing/locksmith.py) ride the
+        same rails: raw environ reads are env-undeclared, wrong-kind
+        getter reads are env-kind-mismatch, declared spellings clean."""
+        for name in ("T2R_LOCK_SANITIZER", "T2R_LOCK_HOLD_BUDGET_MS"):
+            assert "env-undeclared" in self._rules(
+                f"import os\nx = os.environ.get({name!r})\n"
+            ), name
+        assert "env-kind-mismatch" in self._rules(
+            "from tensor2robot_tpu import flags\n"
+            "x = flags.get_int('T2R_LOCK_SANITIZER')\n"
+        )
+        assert "env-kind-mismatch" in self._rules(
+            "from tensor2robot_tpu import flags\n"
+            "x = flags.get_bool('T2R_LOCK_HOLD_BUDGET_MS')\n"
+        )
+        clean = self._rules(
+            "from tensor2robot_tpu import flags\n"
+            "a = flags.get_bool('T2R_LOCK_SANITIZER')\n"
+            "b = flags.get_int('T2R_LOCK_HOLD_BUDGET_MS')\n"
+        )
+        assert "env-kind-mismatch" not in clean
+        assert "env-unknown-flag" not in clean
+        assert "env-undeclared" not in clean
+        assert flags.get_flag("T2R_LOCK_HOLD_BUDGET_MS").minimum == 0
+
     def _sleep_rules(self, source, path="tensor2robot_tpu/serving/x.py"):
         return {d.rule for d in lint_source(source, path)}
 
@@ -1043,6 +1069,51 @@ class TestCLI:
         assert result.returncode == 0
         for spec in flags.all_flags():
             assert spec.name in result.stdout
+
+    def test_concurrency_only_shipped_tree_exits_zero(self):
+        result = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "tools", "t2r_check.py"),
+             "--concurrency-only"],
+            capture_output=True, text=True, cwd=_REPO,
+        )
+        assert result.returncode == 0, result.stdout + result.stderr
+        assert "[concurrency] clean" in result.stdout
+
+    def test_concurrency_only_seeded_violation_exits_one(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text(
+            "import threading\n"
+            "\n"
+            "class Hub:\n"
+            "    def __init__(self):\n"
+            "        self._a = threading.Lock()\n"
+            "        self._b = threading.Lock()\n"
+            "\n"
+            "    def fwd(self):\n"
+            "        with self._a:\n"
+            "            with self._b:\n"
+            "                pass\n"
+            "\n"
+            "    def rev(self):\n"
+            "        with self._b:\n"
+            "            with self._a:\n"
+            "                pass\n"
+        )
+        result = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "tools", "t2r_check.py"),
+             "--concurrency-only", str(bad)],
+            capture_output=True, text=True, cwd=_REPO,
+        )
+        assert result.returncode == 1, result.stdout + result.stderr
+        assert "conc-lock-order-cycle" in result.stdout
+
+    def test_concurrency_only_bad_scope_exits_two(self):
+        result = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "tools", "t2r_check.py"),
+             "--concurrency-only", "/nonexistent/scope"],
+            capture_output=True, text=True, cwd=_REPO,
+        )
+        assert result.returncode == 2, result.stdout + result.stderr
 
     def test_run_checks_script_exists_and_executable(self):
         script = os.path.join(_REPO, "tools", "run_checks.sh")

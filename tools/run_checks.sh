@@ -44,6 +44,15 @@ if ! JAX_PLATFORMS=cpu python tools/t2r_check.py --lint-only \
   status=1
 fi
 
+echo "== concurrency: lock-discipline pass (threaded fabric scope) =="
+# The full t2r-check run above already includes the concurrency pass;
+# the named invocation attributes a lock-order cycle / unguarded-field
+# finding to THIS gate in CI logs, and smoke-tests the standalone
+# --concurrency-only exit-code contract the pre-commit hook relies on.
+if ! JAX_PLATFORMS=cpu python tools/t2r_check.py --concurrency-only; then
+  status=1
+fi
+
 if [ "$SANITIZE" = 1 ]; then
   echo "== sanitizer corpus (ASan/UBSan) =="
   # t2r_check --sanitize builds, verifies the canary aborts, generates
@@ -61,6 +70,7 @@ fi
 if [ "$TESTS" = 1 ]; then
   echo "== checker self-tests + serving + collectives/bench slices (tier-1) =="
   if ! JAX_PLATFORMS=cpu python -m pytest tests/test_t2r_check.py tests/test_wire_fuzz.py \
+      tests/test_concurrency_lint.py tests/test_locksmith.py \
       tests/test_serving.py tests/test_collectives.py tests/test_bench.py \
       -q -m 'not slow' -p no:cacheprovider; then
     status=1

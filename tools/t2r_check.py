@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """t2r-check: the spec-flow static checker + custom lints (+ sanitizer).
 
-Runs the three static-analysis passes (docs/static_analysis.md) without
+Runs the four static-analysis passes (docs/static_analysis.md) without
 touching an accelerator or real data:
 
   1. spec-flow — every registered model/preprocessor pairing
@@ -12,17 +12,23 @@ touching an accelerator or real data:
   2. lints — AST rules over the package: T2R_* env gates must go
      through the flags registry, no host-numpy materialization inside
      jitted regions, shm-ring/lock discipline in the worker return path;
-  3. sanitize (opt-in, --sanitize) — builds the native parsers under
+  3. concurrency — lock-discipline analysis over the threaded fabric
+     (serving/, replay/, train/, predictors/): guard-contract
+     inference for shared fields, cross-module lock-order cycle
+     detection, blocking calls under a held lock
+     (analysis/concurrency.py; runtime twin: testing/locksmith.py);
+  4. sanitize (opt-in, --sanitize) — builds the native parsers under
      ASan/UBSan, verifies the sanitizer is live (--self-test-oob canary
      must abort), and drives the malformed-record corpus through them.
 
 Exit status: 0 clean, 1 findings, 2 infrastructure failure.
 
 Examples:
-  python tools/t2r_check.py                 # passes 1+2
-  python tools/t2r_check.py --sanitize      # all three
+  python tools/t2r_check.py                 # passes 1+2+3
+  python tools/t2r_check.py --sanitize      # all four
   python tools/t2r_check.py --flags         # print the flag registry
   python tools/t2r_check.py --lint-only path/to/file.py
+  python tools/t2r_check.py --concurrency-only   # pass 3 alone
 """
 
 from __future__ import annotations
@@ -78,6 +84,27 @@ def _run_lints(paths) -> int:
         print(format_diagnostics(diagnostics, root=_REPO))
         return 1
     print(f"[lints] clean over {scope}")
+    return 0
+
+
+def _run_concurrency(paths) -> int:
+    from tensor2robot_tpu.analysis.concurrency import (
+        DEFAULT_CONCURRENCY_ROOTS,
+        check_paths,
+    )
+    from tensor2robot_tpu.analysis.diagnostics import format_diagnostics
+
+    try:
+        diagnostics = check_paths(paths or None, root=_REPO)
+    except OSError as exc:
+        print(f"[concurrency] cannot read scope: {exc}")
+        return 2
+    label = ", ".join(paths or DEFAULT_CONCURRENCY_ROOTS)
+    if diagnostics:
+        print(f"[concurrency] {len(diagnostics)} finding(s) over {label}")
+        print(format_diagnostics(diagnostics, root=_REPO))
+        return 1
+    print(f"[concurrency] clean over {label}")
     return 0
 
 
@@ -150,7 +177,16 @@ def main() -> int:
     )
     parser.add_argument(
         "--lint-only", action="store_true",
-        help="= --skip-specflow (lint the given paths)",
+        help="= --skip-specflow --skip-concurrency (lint the given paths)",
+    )
+    parser.add_argument(
+        "--skip-concurrency", action="store_true", help="skip pass 3"
+    )
+    parser.add_argument(
+        "--concurrency-only", action="store_true",
+        help="run only the concurrency pass (over the given paths, "
+        "default the threaded roots); exit 0 clean / 1 findings / 2 "
+        "infrastructure failure",
     )
     parser.add_argument(
         "--sanitize", action="store_true",
@@ -172,11 +208,16 @@ def main() -> int:
         print(flags.describe())
         return 0
 
+    if args.concurrency_only:
+        return _run_concurrency(args.paths)
+
     status = 0
     if not (args.skip_specflow or args.lint_only):
         status = max(status, _run_specflow(args.targets))
     if not args.skip_lints:
         status = max(status, _run_lints(args.paths))
+    if not (args.skip_concurrency or args.lint_only):
+        status = max(status, _run_concurrency(None))
     if args.sanitize:
         status = max(status, _run_sanitize(args.corpus))
     if status == 0:
