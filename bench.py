@@ -4169,6 +4169,485 @@ def bench_policies(args) -> None:
         _fail("bench_policies", err, metric=metric)
 
 
+def bench_fabric(args) -> None:
+    """Cross-host serving fabric leg (`python bench.py fabric`).
+
+    Runs the round-21 acceptance story end to end:
+
+      1. **Fleet.** Two availability zones, each a FleetRouter of
+         `--replicas-per-zone` mock replicas on the SOCKET transport —
+         every replica its own session/process group, registered by
+         published address (audited: no replica shares the bench's
+         process group, the fleet spans >= 2 distinct groups).
+      2. **Fault-free twin.** A Gateway spanning both zones as pools
+         (gold tenant homed in z1, a bronze flash crowd in z0) replays
+         a seeded trace with a mid-trace crowd window; per-zone
+         admission/shed ledgers are read off the gateway snapshot.
+      3. **Partition twin.** The SAME trace, but z1's replicas are
+         partitioned at the serving wire (chaos `net_send`/`net_recv`
+         partition, symmetric) for the crowd window. Gates: gold
+         availability >= the fault-free twin's, ZERO lost requests
+         (every future resolves; every failure a typed GateError), all
+         shed typed and counted per zone. After the heal, z1 must
+         serve again — the link re-resolves the zone's replicas by
+         their published (incarnation-stamped) addresses.
+      4. **Zone-router leg.** The ZoneRouter over the same two zones,
+         partitioned again: every request survives via cross-zone
+         dispatch/retry (typed zone counters, zero lost), and after
+         the heal z1 wins requests again.
+      5. **Heterogeneity.** Per-host AOT key resolution on a forged
+         `aot/` set: the matching host's report is all-"aot"; a host
+         with a transplanted topology gets typed fallback rows (never
+         a silent mismatch load); the two zones' replies to one
+         request are bitwise-identical.
+      6. **Local byte-compat.** `T2R_FLEET_TRANSPORT=local` rides the
+         pre-fabric mp path and returns bitwise the same outputs as
+         the socket path.
+
+    All arrivals are seeded: rerunning the leg replays the trace.
+    """
+    import shutil
+    import tempfile
+    import threading
+
+    metric = "fabric_cross_host_partition_slo_cpu_proxy"
+    try:
+        import numpy as np
+
+        from tensor2robot_tpu.export import aot as aot_lib
+        from tensor2robot_tpu.serving import (
+            FleetRouter,
+            GateError,
+            Gateway,
+            ReplicaSpec,
+            TenantBinding,
+            ZoneRouter,
+            host_aot_report,
+            mock_server_factory,
+        )
+        from tensor2robot_tpu.testing import chaos
+
+        n_per_zone = args.replicas_per_zone
+        trace_secs = args.trace_secs
+        crowd_window = (0.4 * trace_secs, 0.6 * trace_secs)
+        partition_until = 0.7 * trace_secs
+        root = tempfile.mkdtemp(prefix="bench-fabric-")
+        spec = ReplicaSpec(
+            factory=mock_server_factory,
+            factory_kwargs={
+                "service_ms": args.service_ms,
+                "version": 1,
+                # Shared artifact identity: the two zones DECLARE
+                # interchangeability, which is what gateway cross-pool
+                # failover matches on before moving a request.
+                "fingerprint": "fabric-artifact-r21",
+            },
+        )
+
+        def _features(value=1.0):
+            return {"x": np.full((4,), value, np.float32)}
+
+        def _partition_plan():
+            peers = "+".join(f"z1.r{i}" for i in range(n_per_zone))
+            return f"net_send:1:partition:{peers}"
+
+        pools = {}
+        for zone in ("0", "1"):
+            pools[f"z{zone}"] = FleetRouter(
+                spec, n_per_zone,
+                transport_mode="socket",
+                fabric_root=os.path.join(root, f"z{zone}"),
+                zone=zone,
+                probe_interval_ms=50.0,
+                probe_miss_limit=6,
+                backoff_ms=10.0,
+                hedge_ms=args.hedge_ms,
+                max_inflight=args.max_inflight,
+                max_respawns=50,
+                seed=11,
+            ).start(timeout_s=120.0)
+        try:
+            deadline = time.monotonic() + 90
+            while time.monotonic() < deadline and not all(
+                s == "up"
+                for pool in pools.values()
+                for s in pool.replica_states()
+            ):
+                time.sleep(0.02)
+
+            # -- process-group audit ----------------------------------
+            own_pgid = os.getpgid(0)
+            replica_pids = {}
+            for name, pool in pools.items():
+                replica_pids[name] = [
+                    r["host"]["pid"]
+                    for r in pool.snapshot()["replicas"]
+                ]
+            pgids = {
+                pid: os.getpgid(pid)
+                for pids in replica_pids.values()
+                for pid in pids
+            }
+            process_groups_ok = (
+                own_pgid not in pgids.values()
+                and len(set(pgids.values())) >= 2
+            )
+
+            # -- seeded two-tenant trace over the gateway -------------
+            def run_trace(label, partition):
+                gateway = Gateway(
+                    dict(pools),
+                    [
+                        TenantBinding(
+                            tenant="robots-gold", pool="z1",
+                            tier="gold", quota_rps=1e6,
+                            deadline_ms=args.deadline_ms,
+                        ),
+                        TenantBinding(
+                            tenant="crowd-bronze", pool="z0",
+                            tier="bronze", quota_rps=30.0, burst=15,
+                            deadline_ms=args.deadline_ms,
+                        ),
+                    ],
+                    max_queue=4096,
+                    seed=17,
+                ).start()
+                rng = np.random.RandomState(23)
+                record_lock = threading.Lock()
+                stats = {
+                    tenant: {
+                        "submitted": 0, "completed": 0,
+                        "typed_failures": {}, "lost": 0,
+                    }
+                    for tenant in ("robots-gold", "crowd-bronze")
+                }
+                futures = []
+
+                def _account(tenant, future):
+                    err = future.error()
+                    with record_lock:
+                        if err is None:
+                            stats[tenant]["completed"] += 1
+                        elif isinstance(err, GateError):
+                            bucket = stats[tenant]["typed_failures"]
+                            cls = type(err).__name__
+                            bucket[cls] = bucket.get(cls, 0) + 1
+                        else:  # untyped = lost discipline broken
+                            stats[tenant]["lost"] += 1
+
+                def _drive(tenant, base_rps, crowd_factor):
+                    t0 = time.monotonic()
+                    while True:
+                        now = time.monotonic() - t0
+                        if now >= trace_secs:
+                            return
+                        in_crowd = (
+                            crowd_window[0] <= now < crowd_window[1]
+                        )
+                        rate = base_rps * (
+                            crowd_factor if in_crowd else 1.0
+                        )
+                        with record_lock:
+                            stats[tenant]["submitted"] += 1
+                        try:
+                            future = gateway.submit(
+                                tenant, _features(value=1.0)
+                            )
+                        except GateError as err:
+                            with record_lock:
+                                bucket = stats[tenant]["typed_failures"]
+                                cls = type(err).__name__
+                                bucket[cls] = bucket.get(cls, 0) + 1
+                        else:
+                            future.add_done_callback(
+                                lambda f, t=tenant: _account(t, f)
+                            )
+                            with record_lock:
+                                futures.append((tenant, future))
+                        time.sleep(
+                            max(0.002, rng.exponential(1.0 / rate))
+                        )
+
+                def _chaos_clock():
+                    time.sleep(crowd_window[0])
+                    chaos.configure(_partition_plan())
+                    time.sleep(partition_until - crowd_window[0])
+                    chaos.configure(None)
+
+                threads = [
+                    threading.Thread(
+                        target=_drive,
+                        args=("robots-gold", args.gold_rps, 1.0),
+                        daemon=True,
+                    ),
+                    threading.Thread(
+                        target=_drive,
+                        args=(
+                            "crowd-bronze", args.bronze_rps,
+                            args.crowd_factor,
+                        ),
+                        daemon=True,
+                    ),
+                ]
+                if partition:
+                    threads.append(threading.Thread(
+                        target=_chaos_clock, daemon=True
+                    ))
+                for thread in threads:
+                    thread.start()
+                for thread in threads:
+                    thread.join()
+                # Every future resolves, always: anything still
+                # pending after its deadline + slack was LOST, which
+                # the fabric forbids.
+                settle = time.monotonic() + args.deadline_ms / 1e3 + 30
+                for tenant, future in futures:
+                    remaining = settle - time.monotonic()
+                    try:
+                        future.result(max(0.01, remaining))
+                    except GateError:
+                        pass  # typed: already accounted by callback
+                    except TimeoutError:
+                        with record_lock:
+                            stats[tenant]["lost"] += 1
+                    except Exception:
+                        pass  # untyped: callback counted it as lost
+                gate_snap = gateway.snapshot()
+                gateway.stop()
+                chaos.configure(None)
+                per_zone_ledgers = {
+                    name: pool_snap.get("counters", {})
+                    for name, pool_snap in gate_snap["pools"].items()
+                }
+                gold = stats["robots-gold"]
+                answered = gold["completed"] + sum(
+                    gold["typed_failures"].values()
+                )
+                availability = (
+                    gold["completed"] / answered if answered else 0.0
+                )
+                return {
+                    "label": label,
+                    "tenants": stats,
+                    "gold_availability": round(availability, 5),
+                    "lost": sum(
+                        s["lost"] for s in stats.values()
+                    ),
+                    "zone_ledgers": per_zone_ledgers,
+                    "cross_pool_retries": gate_snap["counters"].get(
+                        "cross_pool_retries", 0
+                    ),
+                }
+
+            fault_free = run_trace("fault_free", partition=False)
+            partitioned = run_trace("partition", partition=True)
+
+            # Post-heal: z1 must serve again (its links re-resolved the
+            # replicas' published, incarnation-stamped addresses).
+            heal_deadline = time.monotonic() + 60
+            z1_healed = False
+            while time.monotonic() < heal_deadline:
+                try:
+                    pools["z1"].call(_features(), deadline_ms=2000)
+                    z1_healed = True
+                    break
+                except Exception:
+                    time.sleep(0.1)
+            z1_post = pools["z1"].snapshot()
+            z1_pids_after = [
+                (r.get("host") or {}).get("pid")
+                for r in z1_post["replicas"]
+            ]
+
+            # -- zone-router leg: typed cross-zone survival -----------
+            zone_router = ZoneRouter(dict(pools), hedge_ms=30)
+            zr_before = zone_router.snapshot()["counters"]
+            chaos.configure(_partition_plan())
+            zr_lost = 0
+            for _ in range(16):
+                try:
+                    zone_router.call(
+                        _features(), deadline_ms=args.deadline_ms
+                    )
+                except Exception:
+                    zr_lost += 1
+            chaos.configure(None)
+            zr_mid = zone_router.snapshot()["counters"]
+            z0_wins_during = zr_mid.get("zone_win_z0", 0) - (
+                zr_before.get("zone_win_z0", 0)
+            )
+            zr_heal_deadline = time.monotonic() + 60
+            z1_wins_back = False
+            while time.monotonic() < zr_heal_deadline:
+                base = zone_router.snapshot()["counters"].get(
+                    "zone_win_z1", 0
+                )
+                try:
+                    for _ in range(4):
+                        zone_router.call(_features(), deadline_ms=2000)
+                except Exception:
+                    time.sleep(0.1)
+                    continue
+                if zone_router.snapshot()["counters"].get(
+                    "zone_win_z1", 0
+                ) > base:
+                    z1_wins_back = True
+                    break
+            zr_counters = zone_router.snapshot()["counters"]
+
+            # -- heterogeneity: per-host AOT key resolution -----------
+            import jax
+
+            export_root = os.path.join(root, "export")
+            aot_dir = os.path.join(export_root, aot_lib.AOT_DIR)
+            os.makedirs(aot_dir)
+            host_topology = aot_lib.device_topology()
+            for bucket in (8, 16):
+                header = {
+                    "format_version": aot_lib.AOT_FORMAT_VERSION,
+                    "jax": jax.__version__,
+                    "topology": dict(host_topology),
+                    "fingerprint": "fabric-artifact-r21",
+                    "regime": "serve",
+                    "bucket": bucket,
+                }
+                with open(
+                    os.path.join(aot_dir, f"exec_serve_b{bucket}.bin"),
+                    "wb",
+                ) as f:
+                    f.write(aot_lib._pack(header, b"bench-payload"))
+            report_match = host_aot_report(export_root)
+            report_other = host_aot_report(
+                export_root,
+                topology={
+                    "platform": "tpu", "device_kind": "TPU v4",
+                    "device_count": 8,
+                },
+            )
+            reply_a = pools["z0"].call(
+                _features(value=2.0), deadline_ms=10000
+            ).outputs["y"]
+            reply_b = pools["z1"].call(
+                _features(value=2.0), deadline_ms=10000
+            ).outputs["y"]
+            replies_bitwise = (
+                np.asarray(reply_a).tobytes()
+                == np.asarray(reply_b).tobytes()
+            )
+            heterogeneity_ok = (
+                report_match["all_aot"]
+                and report_match["counts"]["aot"] == 2
+                and not report_other["all_aot"]
+                and report_other["counts"]["topology"] == 2
+                and replies_bitwise
+            )
+
+            # -- local byte-compat leg --------------------------------
+            local_router = FleetRouter(
+                spec, 1, transport_mode="local",
+                probe_interval_ms=50.0, backoff_ms=10.0,
+            ).start(timeout_s=90.0)
+            try:
+                local_reply = local_router.call(
+                    _features(value=2.0), deadline_ms=10000
+                ).outputs["y"]
+                local_transport = local_router.snapshot()["transport"]
+            finally:
+                local_router.stop()
+            local_compat_ok = (
+                local_transport == "local"
+                and np.asarray(local_reply).tobytes()
+                == np.asarray(reply_a).tobytes()
+            )
+        finally:
+            chaos.configure(None)
+            for pool in pools.values():
+                try:
+                    pool.stop()
+                except Exception:
+                    pass
+            shutil.rmtree(root, ignore_errors=True)
+
+        gates = {
+            "fleet_spans_separate_process_groups": process_groups_ok,
+            "fault_free_zero_lost": fault_free["lost"] == 0,
+            "partition_zero_lost": partitioned["lost"] == 0,
+            "partition_gold_holds_fault_free_bar": (
+                partitioned["gold_availability"]
+                >= fault_free["gold_availability"]
+            ),
+            "all_shed_typed": all(
+                s["lost"] == 0
+                for leg in (fault_free, partitioned)
+                for s in leg["tenants"].values()
+            ),
+            "per_zone_ledgers_present": all(
+                set(leg["zone_ledgers"]) == {"z0", "z1"}
+                for leg in (fault_free, partitioned)
+            ),
+            "healed_zone_reresolved_and_serving": z1_healed,
+            "zone_router_zero_lost_under_partition": zr_lost == 0,
+            "zone_router_z0_absorbed_partition": z0_wins_during >= 16,
+            "zone_router_z1_wins_after_heal": z1_wins_back,
+            "heterogeneity_typed_aot_keys_bitwise_replies": (
+                heterogeneity_ok
+            ),
+            "local_transport_byte_compatible": local_compat_ok,
+        }
+        ok = all(gates.values())
+        payload = {
+            "metric": metric,
+            "value": partitioned["gold_availability"],
+            "unit": "gold_availability_under_zone_partition",
+            "vs_baseline": fault_free["gold_availability"],
+            "ok": ok,
+            "gates": gates,
+            "detail": {
+                "zones": {
+                    name: {
+                        "replicas": n_per_zone,
+                        "pids": replica_pids[name],
+                    }
+                    for name in pools
+                },
+                "process_groups": sorted(set(pgids.values())),
+                "fault_free_leg": fault_free,
+                "partition_leg": partitioned,
+                "z1_pids_after_heal": z1_pids_after,
+                "zone_router_leg": {
+                    "lost": zr_lost,
+                    "z0_wins_during_partition": z0_wins_during,
+                    "z1_wins_after_heal": z1_wins_back,
+                    "counters": zr_counters,
+                },
+                "heterogeneity": {
+                    "host_topology": host_topology,
+                    "matching_host": report_match["counts"],
+                    "matching_all_aot": report_match["all_aot"],
+                    "transplanted_host": report_other["counts"],
+                    "replies_bitwise_identical": replies_bitwise,
+                },
+                "trace_secs": trace_secs,
+                "deadline_ms": args.deadline_ms,
+                "backend": "mock_replica_processes_socket_transport",
+                "host_cpus": os.cpu_count(),
+            },
+            "cpu_proxy": True,
+            "proxy_note": (
+                "cross-host fabric measured over socket-transport mock "
+                "replica processes on one host; absolute rates are "
+                "host-bound, the availability/typed-loss/bitwise "
+                "contracts are platform-independent"
+            ),
+        }
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(payload, f, indent=2, sort_keys=True)
+        _emit(payload)
+    except Exception as err:  # noqa: BLE001
+        _fail("bench_fabric", err, metric=metric)
+
+
 def bench_comms(args) -> None:
     """Quantized gradient-collective leg (`python bench.py comms`).
 
@@ -6004,6 +6483,62 @@ def _build_cli():
     )
     gateway.add_argument(
         "--out", default="BENCH_GATE_r14.json",
+        help="also write the payload to this file ('' disables; "
+             "default %(default)s)",
+    )
+    fabric = leg(
+        "fabric", bench_fabric,
+        "cross-host serving fabric leg: two availability zones of "
+        "socket-transport replica processes (separate process groups, "
+        "published-address discovery), a gateway spanning the zones "
+        "through a seeded flash-crowd trace twice (fault-free twin, "
+        "mid-crowd zone partition twin — gold availability holds, zero "
+        "lost, all shed typed per zone), heal + re-resolution, the "
+        "zone-router cross-zone survival leg, per-host AOT key "
+        "resolution, and the local-transport byte-compat pin "
+        "(docs/SERVING.md \"Cross-host fabric\")",
+    )
+    fabric.add_argument(
+        "--replicas-per-zone", type=int, default=2,
+        help="replica process count per zone (default %(default)s)",
+    )
+    fabric.add_argument(
+        "--service-ms", type=float, default=2.0,
+        help="mock per-request service time (default %(default)s)",
+    )
+    fabric.add_argument(
+        "--trace-secs", type=float, default=8.0,
+        help="trace duration; the flash crowd spans [0.4, 0.6] and the "
+             "partition [0.4, 0.7] of it (default %(default)s)",
+    )
+    fabric.add_argument(
+        "--deadline-ms", type=float, default=1500.0,
+        help="per-request deadline (default %(default)s)",
+    )
+    fabric.add_argument(
+        "--hedge-ms", type=int, default=25,
+        help="in-zone router hedge delay (default %(default)s)",
+    )
+    fabric.add_argument(
+        "--max-inflight", type=int, default=4,
+        help="router per-replica in-flight cap (default %(default)s)",
+    )
+    fabric.add_argument(
+        "--gold-rps", type=float, default=25.0,
+        help="gold tenant offered rate (default %(default)s)",
+    )
+    fabric.add_argument(
+        "--bronze-rps", type=float, default=20.0,
+        help="bronze tenant base offered rate; the flash crowd "
+             "multiplies it (default %(default)s)",
+    )
+    fabric.add_argument(
+        "--crowd-factor", type=float, default=6.0,
+        help="flash-crowd rate multiplier on the bronze tenant "
+             "(default %(default)s)",
+    )
+    fabric.add_argument(
+        "--out", default="BENCH_FABRIC_r21.json",
         help="also write the payload to this file ('' disables; "
              "default %(default)s)",
     )

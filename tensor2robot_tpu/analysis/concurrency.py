@@ -73,6 +73,7 @@ DEFAULT_CONCURRENCY_ROOTS = (
     "tensor2robot_tpu/replay",
     "tensor2robot_tpu/train",
     "tensor2robot_tpu/predictors",
+    "tensor2robot_tpu/net",
 )
 
 RULE_UNGUARDED = "conc-unguarded-field"

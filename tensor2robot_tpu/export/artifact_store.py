@@ -52,6 +52,7 @@ import os
 import pickle
 import struct
 import tempfile
+import time
 import zlib
 from typing import Any, Dict, List, Mapping, Optional, Tuple
 
@@ -788,8 +789,141 @@ class ArtifactStore:
 
     def delete(self, policy_id: str) -> None:
         """Drop a policy's manifest (blobs stay — other policies may
-        reference them; orphan GC is a separate concern)."""
+        reference them; ``gc`` sweeps the orphans)."""
         path = self._manifest_path(policy_id)
         if not os.path.exists(path):
             raise PolicyNotFound(f"no policy {policy_id!r} to delete")
         os.unlink(path)
+
+    def _mark_live(
+        self, policy_id: str, live: set, seen: set,
+        missing_ok: bool = False,
+    ) -> None:
+        """Adds every blob reachable from `policy_id` (its file table,
+        its payload, and — transitively — its delta base chain) to
+        `live`. A manifest that exists but does not PARSE is a typed
+        refusal: sweeping against a torn mark set would delete blobs a
+        repaired manifest still needs."""
+        if policy_id in seen:
+            return
+        seen.add(policy_id)
+        try:
+            manifest = self.manifest(policy_id)
+        except PolicyNotFound:
+            if missing_ok:  # deleted between listing and read
+                return
+            raise
+        except ValueError as err:  # json decode failure
+            raise ArtifactCorrupt(
+                f"gc refused: manifest for {policy_id!r} does not parse "
+                f"({err}) — repair or delete it before sweeping"
+            ) from err
+        try:
+            for entry in manifest["files"].values():
+                live.add(entry["blob"])
+            payload = manifest["payload"]
+            if payload.get("blob"):
+                live.add(payload["blob"])
+            base = payload.get("base")
+        except (KeyError, TypeError, AttributeError) as err:
+            raise ArtifactCorrupt(
+                f"gc refused: manifest for {policy_id!r} is missing "
+                f"required fields ({err}) — repair or delete it before "
+                "sweeping"
+            ) from err
+        if base:
+            self._mark_live(base, live, seen, missing_ok=missing_ok)
+
+    def gc(
+        self,
+        roots: Optional[List[str]] = None,
+        *,
+        dry_run: bool = False,
+        grace_s: float = 600.0,
+    ) -> Dict[str, Any]:
+        """Mark-and-sweep collection of orphaned blobs.
+
+        Mark: every blob reachable from `roots` (policy ids; default =
+        every manifest currently in the store) through file tables,
+        payloads, and transitive delta-base chains. Passing an explicit
+        subset declares everything else dead — after a base republish,
+        ``gc(roots=[new ids])`` reclaims the superseded generation's
+        blobs. A root manifest that fails to parse aborts the whole
+        sweep with a typed ``ArtifactCorrupt`` — nothing is deleted
+        against a torn mark set.
+
+        Sweep honors the store's manifests-land-last write discipline,
+        so a CONCURRENT put is never torn: (1) blobs younger than
+        `grace_s` are kept unconditionally (an in-flight put's blobs
+        whose manifest has not landed yet look exactly like orphans);
+        (2) manifests that landed between mark and sweep are re-marked
+        and their blobs dropped from the candidate set; (3) in-progress
+        temp files (``.tmp-*``) are never candidates.
+
+        Returns counts: scanned/live/deleted/bytes_freed/kept_young,
+        with `deleted` counting would-be deletions under `dry_run`."""
+        blob_dir = os.path.join(self.root, _BLOB_DIR)
+        live: set = set()
+        seen: set = set()
+        initial = set(self.policies())
+        root_ids = sorted(initial) if roots is None else list(roots)
+        for policy_id in root_ids:
+            # An explicit root that is absent is a caller error (typed
+            # PolicyNotFound); a listed-then-vanished manifest under the
+            # default roots just means its blobs became sweepable.
+            self._mark_live(
+                policy_id, live, seen, missing_ok=roots is None
+            )
+        now = time.time()
+        scanned = kept_young = 0
+        candidates: List[Tuple[str, str, int]] = []
+        names = (
+            sorted(os.listdir(blob_dir))
+            if os.path.isdir(blob_dir) else []
+        )
+        for name in names:
+            if not name.startswith("sha256-"):
+                continue  # .tmp-* in-flight writes are never candidates
+            scanned += 1
+            sha = name[len("sha256-"):]
+            if sha in live:
+                continue
+            path = os.path.join(blob_dir, name)
+            try:
+                stat = os.stat(path)
+            except OSError:
+                continue  # raced another collector
+            if now - stat.st_mtime < grace_s:
+                kept_young += 1
+                continue
+            candidates.append((sha, path, stat.st_size))
+        if candidates:
+            # Manifests land LAST: a manifest that appeared AFTER the
+            # mark began may reference blobs already in the candidate
+            # set (its put wrote blobs first). Only new arrivals are
+            # re-marked — manifests present at the start that the
+            # caller chose not to root stay dead, which is how an
+            # explicit-roots sweep reclaims a superseded generation.
+            for policy_id in self.policies():
+                if policy_id not in initial and policy_id not in seen:
+                    self._mark_live(
+                        policy_id, live, seen, missing_ok=True
+                    )
+            candidates = [c for c in candidates if c[0] not in live]
+        deleted = bytes_freed = 0
+        for _sha, path, size in candidates:
+            if not dry_run:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    continue  # raced another collector; not counted
+            deleted += 1
+            bytes_freed += size
+        return {
+            "scanned": scanned,
+            "live": len(live),
+            "deleted": deleted,
+            "bytes_freed": bytes_freed,
+            "kept_young": kept_young,
+            "dry_run": dry_run,
+        }

@@ -181,6 +181,27 @@ _declare(
     "tensor2robot_tpu/data/dataset.py",
 )
 _declare(
+    "T2R_FABRIC_CONNECT_TIMEOUT_MS",
+    _INT,
+    2000,
+    "Socket-fabric replica connect timeout (ms): how long a router-side "
+    "link waits for one TCP connect to a replica's published address "
+    "before the attempt fails typed (the next health probe retries).",
+    "tensor2robot_tpu/serving/pool.py",
+    minimum=1,
+)
+_declare(
+    "T2R_FABRIC_HEDGE_MS",
+    _INT,
+    0,
+    "Zone-router cross-zone hedge delay (ms): a request still pending "
+    "after this long is duplicated into a DIFFERENT zone (first reply "
+    "wins). Rides above the per-zone T2R_FLEET_HEDGE_MS replica hedge. "
+    "0 = off.",
+    "tensor2robot_tpu/serving/fabric.py",
+    minimum=0,
+)
+_declare(
     "T2R_FLEET_HEDGE_MS",
     _INT,
     0,
@@ -207,6 +228,18 @@ _declare(
     "replica failure, each with jittered exponential backoff.",
     "tensor2robot_tpu/serving/router.py",
     minimum=0,
+)
+_declare(
+    "T2R_FLEET_TRANSPORT",
+    _ENUM,
+    "local",
+    "Fleet replica transport: local = multiprocessing queues + shared-"
+    "memory slots in one process group (byte-compatible tier-1 default); "
+    "socket = independent process groups speaking the shared CRC-framed "
+    "wire (net/frames.py) with published-address discovery — the cross-"
+    "host serving fabric.",
+    "tensor2robot_tpu/serving/router.py",
+    choices=("local", "socket"),
 )
 _declare(
     "T2R_GATE_BURST",

@@ -1,0 +1,41 @@
+"""Shared network layer: the CRC-framed wire both fabrics speak.
+
+`net.frames` holds the frame codec (magic/len/crc32,
+whole-frame-or-nothing decode), the published-address `transport.json`
+discovery contract with incarnation stamps, the accept-loop
+`FrameServer` (request/reply and duplex shapes), and the self-healing
+`SocketChannel` client. The replay fabric (`replay/transport.py`) and
+the serving fabric (`serving/pool.py`) both consume THIS module, so
+their wires cannot drift."""
+
+from tensor2robot_tpu.net.frames import (  # noqa: F401
+    ADDRESS_FILENAME,
+    BadFrame,
+    ConnectionClosed,
+    FrameServer,
+    MAX_FRAME_BYTES,
+    SocketChannel,
+    TransportError,
+    encode_frame,
+    publish_address,
+    read_address,
+    read_address_info,
+    read_frame,
+    write_frame,
+)
+
+__all__ = [
+    "ADDRESS_FILENAME",
+    "BadFrame",
+    "ConnectionClosed",
+    "FrameServer",
+    "MAX_FRAME_BYTES",
+    "SocketChannel",
+    "TransportError",
+    "encode_frame",
+    "publish_address",
+    "read_address",
+    "read_address_info",
+    "read_frame",
+    "write_frame",
+]

@@ -1,64 +1,42 @@
 """Replay fabric wire transport: CRC-framed request/response over TCP.
 
 The mp.Queue pair that carried replay traffic through PR 8 is bound to
-one host by construction (queues ride fork/spawn inheritance). This
-module is the cross-host wire: a length-prefixed, CRC-framed message
-stream over a plain TCP socket — `serving/transport.py`'s frame
-discipline (every payload checksummed, a corrupt blob is a *transport
-failure* the caller retries, never a silently-wrong message) applied to
-a byte stream instead of a pipe. Tests and the CPU-proxy bench run it
-on localhost; nothing in the framing assumes that.
+one host by construction (queues ride fork/spawn inheritance); PR 9
+replaced it with a length-prefixed, CRC-framed message stream over a
+plain TCP socket. That machinery — the frame codec, the
+whole-frame-or-nothing decode discipline, the published-address
+`transport.json` discovery, the accept-loop server and the
+self-healing client channel, plus the `net_send`/`net_recv` chaos
+sites — is now shared with the serving fabric and lives in
+`net/frames.py`: ONE wire implementation both fabrics consume, so the
+two cannot drift (a fuzz finding against either is a finding against
+both). This module re-exports it under the replay fabric's historical
+names; every import, test, and byte of the replay wire is unchanged.
 
-Frame format (little-endian), one frame per message:
-
-    u32 magic        (0x54325254, "T2RT" — rejects cross-protocol junk)
-    u32 payload_length
-    u32 crc32(payload)
-    payload          (pickled message tuple)
-
-Decode discipline — the fuzz suite's contract: a frame is either
-decoded WHOLE (magic ok, length sane, CRC verifies, unpickles) or the
-connection is torn down with `BadFrame`. There is no partial decode,
-no resync-and-continue: after garbage, the stream position is
-untrustworthy, so the stream dies and the client's retry opens a fresh
-one. Forged lengths are bounded by `MAX_FRAME_BYTES` *before* any
-allocation.
-
-Address discovery: a service binds an ephemeral localhost port and
-publishes `{host, port, pid, incarnation}` to `<root>/transport.json`
-(atomic tmp+replace). Clients resolve the file per (re)connect — a
-respawned service incarnation publishes its fresh port and clients
-find it on their next retry, with no supervisor in the data path (the
-property that lets shards live on other hosts later: the file becomes
-a name service, the frames don't change).
-
-Chaos sites (`testing/chaos.py`): `net_send` fires before every frame
-write, `net_recv` after every frame read, with the remote end's scope
-as `peer` — `drop` discards the frame (the peer sees a timeout),
-`slow:<ms>` injects link latency, `corrupt` flips a payload byte so
-the receiver's CRC rejects it, and `partition:<peers>` drops every
-frame to the named shards from that occurrence on.
+See `net/frames.py` for the frame format, decode discipline, address
+discovery contract, and the chaos-site semantics.
 """
 
 from __future__ import annotations
 
-import json
-import logging
-import os
-import pickle
-import socket
-import struct
-import threading
-
-from tensor2robot_tpu.testing import locksmith
-import time
-import zlib
-from typing import Any, Callable, List, Optional, Tuple
-
-from tensor2robot_tpu.testing import chaos
-from tensor2robot_tpu.utils.errors import best_effort
-
-_log = logging.getLogger(__name__)
+from tensor2robot_tpu.net.frames import (  # noqa: F401
+    ADDRESS_FILENAME,
+    FRAME_HEADER,
+    MAGIC,
+    MAX_FRAME_BYTES,
+    BadFrame,
+    ConnectionClosed,
+    FrameServer,
+    SocketChannel,
+    TransportError,
+    _recv_exact,
+    encode_frame,
+    publish_address,
+    read_address,
+    read_address_info,
+    read_frame,
+    write_frame,
+)
 
 __all__ = [
     "ADDRESS_FILENAME",
@@ -76,395 +54,6 @@ __all__ = [
     "write_frame",
 ]
 
-MAGIC = 0x54325254  # "T2RT"
-FRAME_HEADER = struct.Struct("<III")  # magic, payload_length, crc32
-# Forged-length bound: reject before allocating. Replay batches are a
-# few MB at most; 64 MB is an order of magnitude of headroom.
-MAX_FRAME_BYTES = 64 << 20
-ADDRESS_FILENAME = "transport.json"
-
-
-class TransportError(RuntimeError):
-    """Retryable wire failure (timeout, refused, reset, torn frame)."""
-
-
-class ConnectionClosed(TransportError):
-    """The peer closed the stream at a frame boundary."""
-
-
-class BadFrame(TransportError):
-    """Frame integrity violation: bad magic, forged length, CRC
-    mismatch, or an undecodable payload. The stream position is
-    untrustworthy after this — the connection MUST be torn down."""
-
-
-def encode_frame(message: Any) -> bytes:
-    blob = pickle.dumps(message, protocol=pickle.HIGHEST_PROTOCOL)
-    if len(blob) > MAX_FRAME_BYTES:
-        raise TransportError(
-            f"message of {len(blob)} bytes exceeds the "
-            f"{MAX_FRAME_BYTES}-byte frame bound"
-        )
-    return FRAME_HEADER.pack(
-        MAGIC, len(blob), zlib.crc32(blob) & 0xFFFFFFFF
-    ) + blob
-
-
-def _recv_exact(sock: socket.socket, count: int, deadline: Optional[float],
-                mid_frame: bool) -> bytes:
-    """Reads exactly `count` bytes or raises: ConnectionClosed on EOF at
-    a frame boundary, BadFrame on EOF mid-frame (a truncated frame is a
-    torn frame, not a clean goodbye), TransportError on timeout."""
-    chunks: List[bytes] = []
-    got = 0
-    while got < count:
-        try:
-            if deadline is not None:
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    raise TransportError(
-                        f"transport read timed out with {count - got} "
-                        "bytes outstanding"
-                    )
-                sock.settimeout(remaining)
-            else:
-                sock.settimeout(None)
-            chunk = sock.recv(count - got)
-        except socket.timeout as err:
-            raise TransportError("transport read timed out") from err
-        except OSError as err:
-            # Includes EBADF when the owner closed the socket under a
-            # reader mid-teardown: a transport failure like any other.
-            raise TransportError(f"transport read failed: {err}") from err
-        if not chunk:
-            if got or mid_frame:
-                raise BadFrame(
-                    f"stream closed mid-frame ({got} of {count} bytes)"
-                )
-            raise ConnectionClosed("stream closed at a frame boundary")
-        chunks.append(chunk)
-        got += len(chunk)
-    return b"".join(chunks)
-
-
-def read_frame(sock: socket.socket, deadline: Optional[float] = None) -> Any:
-    """One whole message off the stream, or a typed failure — never a
-    partially-decoded object (see module docstring)."""
-    header = _recv_exact(sock, FRAME_HEADER.size, deadline, mid_frame=False)
-    magic, length, crc = FRAME_HEADER.unpack(header)
-    if magic != MAGIC:
-        raise BadFrame(f"bad frame magic {magic:#010x}")
-    if length > MAX_FRAME_BYTES:
-        raise BadFrame(
-            f"forged frame length {length} (bound {MAX_FRAME_BYTES})"
-        )
-    blob = _recv_exact(sock, length, deadline, mid_frame=True)
-    if zlib.crc32(blob) & 0xFFFFFFFF != crc:
-        raise BadFrame(f"frame of {length} bytes failed its CRC32 check")
-    try:
-        return pickle.loads(blob)
-    except Exception as err:
-        # Checksummed but undecodable: same wire failure to the caller.
-        raise BadFrame(f"frame payload failed to decode: {err}") from err
-
-
-def write_frame(
-    sock: socket.socket, message: Any, peer: Optional[str] = None
-) -> bool:
-    """Sends one frame; returns False when a chaos clause dropped it on
-    the floor (the caller proceeds to wait — and time out — exactly as
-    it would on a real lost packet)."""
-    frame = encode_frame(message)
-    hit = chaos.maybe_fire("net_send", peer=peer)
-    if hit is not None:
-        if hit.action in ("drop", "partition"):
-            return False
-        if hit.action == "corrupt":
-            # Flip a payload byte AFTER the CRC was computed: the
-            # receiver must reject the frame, whole.
-            corrupted = bytearray(frame)
-            corrupted[FRAME_HEADER.size] ^= 0xFF
-            frame = bytes(corrupted)
-    try:
-        sock.sendall(frame)
-    except OSError as err:
-        raise TransportError(f"transport write failed: {err}") from err
-    return True
-
-
-# -- address discovery ---------------------------------------------------------
-
-
-def publish_address(
-    root: str, port: int, incarnation: int = 0, host: str = "127.0.0.1"
-) -> None:
-    """Atomically publishes this incarnation's listen address under the
-    service's own directory (tmp+replace, the manifest discipline)."""
-    payload = {
-        "host": host,
-        "port": int(port),
-        "pid": os.getpid(),
-        "incarnation": int(incarnation),
-    }
-    path = os.path.join(root, ADDRESS_FILENAME)
-    tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "w") as f:
-        json.dump(payload, f, indent=1, sort_keys=True)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
-
-
-def read_address_info(root: str) -> Optional[dict]:
-    """The full published address payload ({host, port, pid,
-    incarnation}), or None when nothing has published yet (bring-up) /
-    the file is torn (retry re-reads). Supervisors use `incarnation` to
-    tell a FRESH publication from the dead predecessor's stale file."""
-    path = os.path.join(root, ADDRESS_FILENAME)
-    try:
-        with open(path) as f:
-            payload = json.load(f)
-        return {
-            "host": str(payload["host"]),
-            "port": int(payload["port"]),
-            "pid": int(payload.get("pid", 0)),
-            "incarnation": int(payload.get("incarnation", 0)),
-        }
-    except (OSError, ValueError, KeyError) as err:
-        _log.debug("no readable transport address at %s (%s)", path, err)
-        return None
-
-
-def read_address(root: str) -> Optional[Tuple[str, int]]:
-    """(host, port) of the latest publication (see read_address_info)."""
-    info = read_address_info(root)
-    return (info["host"], info["port"]) if info is not None else None
-
-
-# -- the server side -----------------------------------------------------------
-
-
-class ReplayTransportServer:
-    """Accept loop + one thread per connection, request/response framing.
-
-    `handler(request) -> Optional[reply]` gets every whole decoded
-    request frame; its reply (None = no reply, e.g. lifecycle ops) is
-    framed back on the same connection. A BadFrame tears the connection
-    down — the client's retry reopens a clean one; the handler never
-    sees bytes the framing did not fully validate.
-    """
-
-    def __init__(
-        self,
-        handler: Callable[[Any], Optional[Any]],
-        host: str = "127.0.0.1",
-        port: int = 0,
-    ):
-        self._handler = handler
-        self._listener = socket.create_server(
-            (host, port), reuse_port=False
-        )
-        self._listener.settimeout(0.2)
-        self.host, self.port = self._listener.getsockname()[:2]
-        self._closed = False
-        self._threads: List[threading.Thread] = []
-        self._conns: List[socket.socket] = []
-        self._lock = locksmith.make_lock("ReplayTransportServer._lock")
-        self._accept_thread: Optional[threading.Thread] = None
-
-    def start(self) -> "ReplayTransportServer":
-        self._accept_thread = threading.Thread(
-            target=self._accept_loop, daemon=True
-        )
-        self._accept_thread.start()
-        return self
-
-    def _accept_loop(self) -> None:
-        while not self._closed:
-            try:
-                conn, _ = self._listener.accept()
-            except socket.timeout:
-                continue
-            except OSError:
-                return  # listener closed under us: stopping
-            thread = threading.Thread(
-                target=self._serve_connection, args=(conn,), daemon=True
-            )
-            with self._lock:
-                if self._closed:
-                    best_effort(conn.close)
-                    return
-                self._conns.append(conn)
-                # Prune finished handlers here, not in a finalizer:
-                # clients reconnect on every retry, so a chaos-heavy
-                # multi-day service would otherwise accumulate one dead
-                # Thread object per reconnect, unboundedly.
-                self._threads = [
-                    t for t in self._threads if t.is_alive()
-                ]
-                self._threads.append(thread)
-            thread.start()
-
-    def _serve_connection(self, conn: socket.socket) -> None:
-        import select
-
-        try:
-            while not self._closed:
-                # Poll for readability BEFORE starting a frame read: a
-                # bounded read_frame alone could time out with the
-                # header consumed and the payload in flight, and
-                # resuming the loop would then decode mid-frame bytes
-                # as a header — stream desync. The poll carries the
-                # stop-responsiveness; the frame read, once begun, gets
-                # a real deadline and any timeout inside it is fatal to
-                # the connection (whole-frame-or-nothing).
-                try:
-                    readable, _, _ = select.select([conn], [], [], 0.2)
-                except (OSError, ValueError):
-                    return  # connection torn down under us
-                if not readable:
-                    continue
-                try:
-                    request = read_frame(
-                        conn, deadline=time.monotonic() + 10.0
-                    )
-                except TransportError as err:
-                    if isinstance(err, ConnectionClosed):
-                        return
-                    # BadFrame, mid-frame timeout, reset: the stream
-                    # position is untrustworthy — kill the connection,
-                    # the client retries on a fresh one.
-                    if isinstance(err, BadFrame):
-                        _log.warning("torn request frame (%s); "
-                                     "closing connection", err)
-                    return
-                # The receiver does not know who is calling, so the
-                # peer it reports is its OWN scope: a receive-side
-                # partition plan (`net_recv:1:partition:s1`) cuts
-                # everything shard s1 hears, the mirror of the sender
-                # side cutting everything said TO s1.
-                hit = chaos.maybe_fire("net_recv", peer=chaos.get_scope())
-                if hit is not None and hit.action in ("drop", "partition"):
-                    continue  # request vanishes; the client times out
-                if hit is not None and hit.action == "corrupt":
-                    _log.warning("chaos corrupt at net_recv; "
-                                 "closing connection")
-                    return
-                try:
-                    reply = self._handler(request)
-                except Exception:
-                    # The service handler has its own error protocol; a
-                    # raise through it is a server bug — log it loudly
-                    # and drop the connection rather than hang the peer.
-                    _log.exception("transport handler raised; "
-                                   "closing connection")
-                    return
-                if reply is None:
-                    continue
-                try:
-                    write_frame(conn, reply)
-                except TransportError as err:
-                    _log.warning("reply write failed (%s); "
-                                 "closing connection", err)
-                    return
-        finally:
-            best_effort(conn.close)
-            with self._lock:
-                if conn in self._conns:
-                    self._conns.remove(conn)
-
-    def stop(self) -> None:
-        self._closed = True
-        best_effort(self._listener.close)
-        with self._lock:
-            conns = list(self._conns)
-            threads = list(self._threads)
-        for conn in conns:
-            best_effort(conn.shutdown, socket.SHUT_RDWR)
-            best_effort(conn.close)
-        if self._accept_thread is not None:
-            self._accept_thread.join(2.0)
-        for thread in threads:
-            thread.join(2.0)
-
-
-# -- the client side -----------------------------------------------------------
-
-
-class SocketChannel:
-    """One client's connection to a service root (lazy, self-healing).
-
-    `call(request, req_id, timeout_s)` sends one frame and reads frames
-    until the reply whose first element equals `req_id` arrives (stale
-    replies from a timed-out earlier attempt on the same connection are
-    dropped, same discipline as the queue client). ANY failure —
-    resolve, connect, send, torn frame, timeout — closes the connection
-    (so stale state dies with it) and raises a retryable
-    TransportError; the caller owns retry/backoff policy.
-
-    `peer` is the remote end's chaos scope (shard `s<k>`), threaded to
-    the `net_send` site so `partition:<peers>` plans can cut this
-    specific link.
-    """
-
-    def __init__(
-        self,
-        root: str,
-        peer: Optional[str] = None,
-        connect_timeout_s: float = 2.0,
-    ):
-        self.root = root
-        self.peer = peer
-        self._connect_timeout_s = connect_timeout_s
-        self._sock: Optional[socket.socket] = None
-
-    def _connect(self) -> socket.socket:
-        if self._sock is not None:
-            return self._sock
-        address = read_address(self.root)
-        if address is None:
-            raise TransportError(
-                f"no transport address published under {self.root} "
-                "(service not up yet, or respawning)"
-            )
-        try:
-            sock = socket.create_connection(
-                address, timeout=self._connect_timeout_s
-            )
-        except OSError as err:
-            raise TransportError(
-                f"connect to {address} failed: {err}"
-            ) from err
-        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._sock = sock
-        return sock
-
-    def call(self, request: Any, req_id: Any, timeout_s: float) -> Any:
-        deadline = time.monotonic() + timeout_s
-        try:
-            sock = self._connect()
-            write_frame(sock, request, peer=self.peer)
-            while True:
-                reply = read_frame(sock, deadline=deadline)
-                if (
-                    isinstance(reply, tuple)
-                    and reply
-                    and reply[0] == req_id
-                ):
-                    return reply
-                # Stale reply from an attempt this client already gave
-                # up on: drop and keep reading within the deadline.
-        except TransportError:
-            self.close()
-            raise
-
-    def send_only(self, request: Any) -> None:
-        """Fire-and-forget (lifecycle ops like stop): best effort by
-        contract, but failures still raise so callers can log them."""
-        sock = self._connect()
-        write_frame(sock, request, peer=self.peer)
-
-    def close(self) -> None:
-        if self._sock is not None:
-            best_effort(self._sock.close)
-            self._sock = None
+# The replay fabric's server is the shared FrameServer in its original
+# request/reply shape; the name survives for callers and logs.
+ReplayTransportServer = FrameServer

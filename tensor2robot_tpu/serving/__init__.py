@@ -81,6 +81,15 @@ _EXPORTS = {
     "observation_digest": "gateway",
     # autoscaler.py — load-driven replica count over a router pool.
     "Autoscaler": "autoscaler",
+    # pool.py — socket-fabric replica processes (cross-host transport).
+    "RemoteReplicaPool": "pool",
+    "ReplicaLink": "pool",
+    # fabric.py — zone-aware dispatch + cross-host stores + host AOT.
+    "ZoneRouter": "fabric",
+    "StoreServer": "fabric",
+    "mirror_policy": "fabric",
+    "remote_store_factory": "fabric",
+    "host_aot_report": "fabric",
 }
 
 __all__ = sorted(_EXPORTS)
@@ -106,6 +115,17 @@ def __dir__():
 
 if TYPE_CHECKING:  # pragma: no cover — static analyzers only
     from tensor2robot_tpu.serving.autoscaler import Autoscaler  # noqa: F401
+    from tensor2robot_tpu.serving.fabric import (  # noqa: F401
+        StoreServer,
+        ZoneRouter,
+        host_aot_report,
+        mirror_policy,
+        remote_store_factory,
+    )
+    from tensor2robot_tpu.serving.pool import (  # noqa: F401
+        RemoteReplicaPool,
+        ReplicaLink,
+    )
     from tensor2robot_tpu.serving.compile_cache import (  # noqa: F401
         enable_compile_cache,
         enable_compile_cache_for,

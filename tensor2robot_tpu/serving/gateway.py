@@ -84,6 +84,7 @@ from tensor2robot_tpu.serving.metrics import percentile
 from tensor2robot_tpu.serving.router import (
     FleetError,
     FleetRouter,
+    ReplicaUnavailable,
     RequestAbandoned,
     RouterClosed,
 )
@@ -338,12 +339,17 @@ class _Pool:
     __slots__ = (
         "name", "router", "queues", "cond", "coalesce", "swap_epoch",
         "policy_epochs", "thread", "last_sweep", "model_fingerprint",
-        "fingerprint_epoch",
+        "fingerprint_epoch", "counters",
     )
 
     def __init__(self, name: str, router: FleetRouter):
         self.name = name
         self.router = router
+        # Per-pool admission/shed ledger (guarded by the gateway lock,
+        # like the tenant ledgers): with pools standing for availability
+        # ZONES, this is where "which zone shed how much, and where did
+        # its load go" is answered — the global counters cannot.
+        self.counters: Dict[str, int] = {}
         self.queues: Dict[str, deque] = {tier: deque() for tier in TIERS}
         self.cond = locksmith.make_condition("_Pool.cond")
         self.coalesce: Dict[bytes, _CoalesceEntry] = {}
@@ -769,6 +775,7 @@ class Gateway:
                     self._count("shed_queue")
                     self._count(f"shed_queue_{tier}")
                     self._tcount(request.tenant, "shed")
+                    self._pcount(pool, "shed")
                     raise TierShed(
                         f"gateway queue full ({self._max_queue}) with no "
                         f"{tier}-or-lower entry to shed; request rejected",
@@ -786,6 +793,7 @@ class Gateway:
             pool.queues[tier].append(request)
             self._count("admitted")
             self._tcount(request.tenant, "admitted")
+            self._pcount(pool, "admitted")
             pool.cond.notify()
         if victim is not None:
             self._resolve_shed(pool, victim)
@@ -810,6 +818,7 @@ class Gateway:
         self._count("shed_queue")
         self._count(f"shed_queue_{tier}")
         self._tcount(victim.tenant, "shed")
+        self._pcount(pool, "shed")
         error = TierShed(
             f"request {victim.id} ({tier}) shed by the strict-priority "
             "overload policy",
@@ -887,11 +896,29 @@ class Gateway:
                     count_circuit=False,
                 )
                 continue
-            except FleetError:
-                # Saturated / no replica: requeue at the FRONT of its
-                # tier (order preserved) and back off on the seeded
-                # schedule — strict priority means no other queued
-                # request could dispatch either. The sweep keeps
+            except FleetError as err:
+                # No replica at all is a ZONE verdict, not congestion:
+                # when a fingerprint-equal sibling pool has capacity,
+                # move the request there NOW (a partitioned/dead home
+                # zone would otherwise spin it in place until its
+                # deadline) — same interchangeability gate and counters
+                # as the post-dispatch blip retry below.
+                if (
+                    isinstance(err, ReplicaUnavailable)
+                    and not self._closed
+                    and request.pool_retries < self._MAX_POOL_RETRIES
+                    and time.monotonic()
+                    < min(request.deadline, request.queue_deadline)
+                ):
+                    target = self._failover_pool(pool, request)
+                    if target is not pool and self._requeue(
+                        pool, target, request
+                    ):
+                        continue
+                # Saturated / no replica anywhere: requeue at the FRONT
+                # of its tier (order preserved) and back off on the
+                # seeded schedule — strict priority means nothing else
+                # queued could dispatch either. The sweep keeps
                 # resolving expiries while we wait.
                 saturated_attempts += 1
                 self._count("dispatch_saturated")
@@ -902,6 +929,7 @@ class Gateway:
                 continue
             saturated_attempts = 0
             self._count("dispatched")
+            self._pcount(pool, "dispatched")
             router_future.add_done_callback(
                 lambda rf, pool=pool, request=request:
                 self._on_pool_done(pool, request, rf)
@@ -957,6 +985,7 @@ class Gateway:
         self._count("expired_in_queue")
         self._count(f"expired_in_queue_{tier}")
         self._tcount(request.tenant, "shed")
+        self._pcount(pool, "expired")
         waited_ms = (time.monotonic() - request.t_submit) * 1e3
         self._resolve_failure(
             pool, request,
@@ -1006,29 +1035,80 @@ class Gateway:
             and error.reason != "deadline"
         )
 
+    def _failover_pool(self, pool: _Pool, request: _GateRequest) -> _Pool:
+        """Where a pool-side blip retry should land: a DIFFERENT pool
+        serving the SAME recorded artifact (fingerprint equality is the
+        interchangeability proof — zones of one deployment match, pools
+        serving different models never do), least-utilized first. Falls
+        back to the failed pool itself when no sibling qualifies — the
+        single-pool behavior, unchanged."""
+        if len(self._pools) < 2:
+            return pool
+        own = self._pool_fingerprint(pool)
+        best, best_util = pool, None
+        for other in self._pools.values():
+            if other is pool or self._pool_fingerprint(other) != own:
+                continue
+            try:
+                load = other.router.load()
+            except Exception:
+                continue
+            if load["replicas_up"] < 1:
+                continue
+            if best_util is None or load["utilization"] < best_util:
+                best, best_util = other, load["utilization"]
+        return best
+
+    def _requeue(self, pool: _Pool, target: _Pool,
+                 request: _GateRequest) -> bool:
+        """Requeues `request` at the front of its tier on `target`
+        (possibly `pool` itself), counting the move. Returns False when
+        the gateway closed first — nothing was queued."""
+        if target is not pool and request.entry is not None:
+            # Moving zones: seal this request's coalesce entry in the
+            # OLD pool under the OLD pool's cond, so no new rider can
+            # join after the move (its existing riders stay attached
+            # through request.entry and fan out with the final
+            # resolution, wherever it lands).
+            with pool.cond:
+                request.entry.resolved = True
+                if pool.coalesce.get(
+                    request.entry.digest
+                ) is request.entry:
+                    del pool.coalesce[request.entry.digest]
+        # The closed re-check rides INSIDE the pool cond: stop() flips
+        # _closed before it drains the queues under this same cond, so
+        # a requeue that observed _closed False here is guaranteed to
+        # be swept by stop's drain — it can never strand a future in a
+        # queue nobody reads.
+        requeued = False
+        with target.cond:
+            if not self._closed:
+                request.pool_retries += 1
+                target.queues[request.tenant.tier].appendleft(request)
+                target.cond.notify()
+                requeued = True
+        if requeued:
+            self._count("pool_retries")
+            self._tcount(request.tenant, "pool_retries")
+            if target is not pool:
+                self._count("cross_pool_retries")
+                self._pcount(pool, "retried_away")
+                self._pcount(target, "retried_in")
+        return requeued
+
     def _on_pool_done(self, pool: _Pool, request: _GateRequest, rf) -> None:
         error = rf.error()
         if error is not None:
             if self._retryable(request, error):
-                # The closed re-check rides INSIDE the pool cond: stop()
-                # flips _closed before it drains the queues under this
-                # same cond, so a requeue that observed _closed False
-                # here is guaranteed to be swept by stop's drain — it
-                # can never strand a future in a queue nobody reads.
-                requeued = False
-                with pool.cond:
-                    if not self._closed:
-                        request.pool_retries += 1
-                        pool.queues[request.tenant.tier].appendleft(request)
-                        pool.cond.notify()
-                        requeued = True
-                if requeued:
-                    self._count("pool_retries")
-                    self._tcount(request.tenant, "pool_retries")
+                target = self._failover_pool(pool, request)
+                if self._requeue(pool, target, request):
                     return
+            self._pcount(pool, "failed")
             self._resolve_failure(pool, request, error, count_circuit=True)
             return
         response = rf.result(0)
+        self._pcount(pool, "completed")
         riders = self._take_fanout(pool, request)
         now = time.monotonic()
         for member, coalesced in [(request, False)] + [
@@ -1135,6 +1215,10 @@ class Gateway:
         with self._lock:
             state.counters[name] = state.counters.get(name, 0) + n
 
+    def _pcount(self, pool: _Pool, name: str, n: int = 1) -> None:
+        with self._lock:
+            pool.counters[name] = pool.counters.get(name, 0) + n
+
     def tenant_scope(self, tenant: str) -> str:
         """The chaos call-site scope (`t<i>`) assigned to a tenant."""
         return self._tenants[tenant].scope
@@ -1178,6 +1262,12 @@ class Gateway:
                     "policy_epochs": dict(pool.policy_epochs),
                     "model_fingerprint": pool.model_fingerprint,
                 }
+            with self._lock:
+                # Per-pool (= per availability zone) admission ledger:
+                # admitted/dispatched/completed/shed/expired/failed and
+                # the retried_away/retried_in pair that shows where a
+                # partitioned zone's load went.
+                pools[name]["counters"] = dict(pool.counters)
         return {
             "counters": counters,
             "latency_ms": {

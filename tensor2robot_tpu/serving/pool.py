@@ -1,0 +1,348 @@
+"""RemoteReplicaPool: serving replicas as independent processes on the wire.
+
+The local fleet (serving/router.py) spawns replicas through a
+multiprocessing context: mp queues, a shared shm free ring, one process
+group. Those primitives are exactly what pins the fleet to one host.
+This module provides the router's OTHER transport: each replica is an
+independent OS process in its OWN session/process group (no inherited
+mp primitives, no shared memory), started via
+`python -m tensor2robot_tpu.serving.fabric`, speaking the shared
+CRC-framed wire from `net/frames.py` — the same frame contract, address
+discovery, and chaos sites the replay fabric runs on.
+
+The integration point is deliberately narrow: the router's `_spawn`
+asks the pool for a `(handle, link)` pair where
+
+  * `handle` duck-types `multiprocessing.Process` (pid / is_alive /
+    terminate / kill / join / exitcode) over a `subprocess.Popen`, and
+  * `link` duck-types the replica request queue (`put(message)`) over a
+    lazily-connected frame stream — so the router's dispatch, health
+    probing, circuit breaking, rolling swap, retirement, and stop paths
+    run UNCHANGED over either transport. A `put` that cannot reach the
+    replica raises (the router already treats that as
+    ReplicaUnavailable / a skipped probe); replies, health snapshots,
+    and lifecycle messages stream back on the same connection into the
+    router's response queue.
+
+Respawn re-resolution is incarnation-stamped: every spawn of replica
+index `i` gets the next incarnation number, the replica publishes
+`{host, port, pid, incarnation}` under `<root>/r<i>/transport.json`
+only once its server factory has succeeded, and the new link refuses
+any address published by an older incarnation — the dead predecessor's
+stale file reads as "not up yet" (retry), never as a connectable
+address. The router's health probes double as the re-resolution loop:
+each probe `put` retries the connect, and the first one to land after
+the fresh publication triggers the `("hello",)` handshake whose
+`("started", ...)` reply readmits the replica to routing.
+
+Chaos: the link threads the replica's scope (`z<zone>.r<i>`, or
+`r<i>` without a zone) as `peer` through BOTH directions — `net_send`
+before every frame to the replica, `net_recv` after every frame heard
+from it — so one `partition:z1.r0+z1.r1` clause cuts a zone's links
+symmetrically, exactly as replay shard partitions behave.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+import queue
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from tensor2robot_tpu.net import frames
+from tensor2robot_tpu.serving.replica import ReplicaSpec
+from tensor2robot_tpu.testing import chaos, locksmith
+from tensor2robot_tpu.utils.errors import best_effort
+
+_log = logging.getLogger(__name__)
+
+__all__ = [
+    "RemoteProcessHandle",
+    "RemoteReplicaPool",
+    "ReplicaLink",
+    "ResponseQueue",
+    "replica_root",
+    "replica_scope",
+]
+
+
+def replica_scope(index: int, spec: ReplicaSpec,
+                  zone: Optional[str] = None) -> str:
+    """The chaos scope a fabric replica runs under — and therefore the
+    peer name every chaos clause must use to target its link. One
+    definition shared by the pool (link side) and the replica entry
+    (receive side), so a partition plan always cuts both directions of
+    the same link. Scope charset: no `:+;/` (the plan grammar's
+    delimiters); dots are safe."""
+    if spec.scope is not None:
+        return spec.scope
+    return f"z{zone}.r{index}" if zone else f"r{index}"
+
+
+def replica_root(root: str, index: int) -> str:
+    """Where replica `index` publishes its transport address."""
+    return os.path.join(root, f"r{index}")
+
+
+class ResponseQueue(queue.Queue):
+    """Thread-queue stand-in for the router's mp response queue: same
+    `put`/`get(timeout=)` surface, plus the no-op mp.Queue teardown
+    methods the router's stop() calls unconditionally."""
+
+    def close(self) -> None:
+        pass
+
+    def cancel_join_thread(self) -> None:
+        pass
+
+
+class RemoteProcessHandle:
+    """`multiprocessing.Process` duck-type over a detached subprocess.
+
+    The child runs in its own session (`start_new_session=True`), so it
+    shares no process group, controlling terminal, or mp state with the
+    router — the "independent processes" the cross-host model requires;
+    signals here are explicit, never inherited."""
+
+    def __init__(self, popen: "subprocess.Popen"):
+        self._popen = popen
+
+    @property
+    def pid(self) -> int:
+        return self._popen.pid
+
+    @property
+    def exitcode(self) -> Optional[int]:
+        return self._popen.poll()
+
+    def is_alive(self) -> bool:
+        return self._popen.poll() is None
+
+    def terminate(self) -> None:
+        best_effort(self._popen.terminate)
+
+    def kill(self) -> None:
+        best_effort(self._popen.kill)
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        try:
+            self._popen.wait(timeout)
+        except subprocess.TimeoutExpired:
+            pass
+
+
+class ReplicaLink:
+    """Request-queue duck-type over one replica's frame stream.
+
+    `put(message)` lazily (re)connects — resolving the replica's
+    CURRENT published address, refusing stale incarnations — performs
+    the `("hello",)` identity handshake on a fresh connection, and
+    writes the message as one frame. Every frame the replica sends back
+    (replies, health, started, swapped, stopped) is read by a
+    per-connection reader thread and handed to `deliver` (the router's
+    response queue). ANY wire failure tears the connection down and, on
+    `put`, raises a typed TransportError the router's existing failure
+    handling absorbs; the NEXT put starts clean. Lock order: the router
+    calls `put` while holding its own lock, and this link's lock is
+    always innermost (nothing here calls back into the router)."""
+
+    def __init__(
+        self,
+        root: str,
+        peer: str,
+        deliver: Callable[[tuple], None],
+        min_incarnation: int = 0,
+        connect_timeout_s: float = 2.0,
+    ):
+        self.root = root
+        self.peer = peer
+        self.min_incarnation = int(min_incarnation)
+        self._deliver = deliver
+        self._connect_timeout_s = connect_timeout_s
+        self._lock = locksmith.make_lock("ReplicaLink._lock")
+        self._sock: Optional[socket.socket] = None
+        self._closed = False
+
+    def _teardown_locked(self) -> None:
+        if self._sock is not None:
+            best_effort(self._sock.close)
+            self._sock = None
+
+    def _ensure_connected_locked(self) -> socket.socket:
+        if self._sock is not None:
+            return self._sock
+        info = frames.read_address_info(self.root)
+        if info is None:
+            raise frames.TransportError(
+                f"no transport address published under {self.root} "
+                "(replica not up yet, or respawning)"
+            )
+        if info["incarnation"] < self.min_incarnation:
+            raise frames.TransportError(
+                f"stale transport address under {self.root}: published by "
+                f"incarnation {info['incarnation']}, expecting >= "
+                f"{self.min_incarnation} (predecessor's file; the respawn "
+                "has not published yet)"
+            )
+        address = (info["host"], info["port"])
+        try:
+            sock = socket.create_connection(
+                address, timeout=self._connect_timeout_s
+            )
+        except OSError as err:
+            raise frames.TransportError(
+                f"connect to replica at {address} failed: {err}"
+            ) from err
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        sock.settimeout(None)
+        self._sock = sock
+        reader = threading.Thread(
+            target=self._read_loop, args=(sock,),
+            name=f"t2r-link-{self.peer}", daemon=True,
+        )
+        # Identity handshake BEFORE the caller's message: the replica
+        # answers ("started", index, version, pid), which is what
+        # (re)admits it to routing — the socket fabric's equivalent of
+        # the mp replica's proactive started post.
+        try:
+            frames.write_frame(sock, ("hello",), peer=self.peer)
+        except frames.TransportError:
+            self._teardown_locked()
+            raise
+        reader.start()
+        return sock
+
+    def _read_loop(self, sock: socket.socket) -> None:
+        while True:
+            try:
+                message = frames.read_frame(sock)
+            except frames.TransportError:
+                break  # torn/closed/bad frame: the stream dies whole
+            # Receive side of the partition model: frames FROM a
+            # partitioned replica are dropped too, so a zone partition
+            # is symmetric (in-flight replies do not leak out of it).
+            hit = chaos.maybe_fire("net_recv", peer=self.peer)
+            if hit is not None:
+                if hit.action in ("drop", "partition"):
+                    continue
+                if hit.action == "corrupt":
+                    break  # CRC-equivalent: tear the stream down
+            try:
+                self._deliver(message)
+            except Exception:
+                _log.exception("link %s: delivery failed", self.peer)
+        with self._lock:
+            if self._sock is sock:
+                self._teardown_locked()
+            else:
+                best_effort(sock.close)
+
+    def put(self, message: tuple) -> None:
+        """Send one router->replica message; raises TransportError when
+        the replica is unreachable (unpublished, stale incarnation,
+        refused, or the write fails). A chaos drop/partition at
+        `net_send` consumes the message silently — the wire accepted
+        it, the packet died; deadlines and retries do their job."""
+        with self._lock:
+            if self._closed:
+                raise frames.TransportError(
+                    f"link to {self.peer} is closed"
+                )
+            sock = self._ensure_connected_locked()
+            try:
+                frames.write_frame(sock, message, peer=self.peer)
+            except frames.TransportError:
+                self._teardown_locked()
+                raise
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            self._teardown_locked()
+
+    def cancel_join_thread(self) -> None:
+        pass  # mp.Queue teardown parity for the router's stop()
+
+
+class RemoteReplicaPool:
+    """Spawns and re-spawns fabric replicas, one incarnation at a time.
+
+    Owns the per-index incarnation counters and the live links. `spawn`
+    is the router `_spawn`'s delegate: it pickles the spec next to the
+    replica's address directory, launches the interpreter entry in a
+    fresh session, and returns the `(handle, link)` pair the router
+    slots straight into `replica.proc` / `replica.request_q`."""
+
+    def __init__(
+        self,
+        root: str,
+        deliver: Callable[[tuple], None],
+        zone: Optional[str] = None,
+        connect_timeout_s: float = 2.0,
+    ):
+        self.root = root
+        self.zone = zone
+        self._deliver = deliver
+        self._connect_timeout_s = connect_timeout_s
+        self._lock = locksmith.make_lock("RemoteReplicaPool._lock")
+        self._incarnations: Dict[int, int] = {}
+        self._links: Dict[int, ReplicaLink] = {}
+        self._procs: List[RemoteProcessHandle] = []
+
+    def spawn(
+        self, index: int, spec: ReplicaSpec
+    ) -> Tuple[RemoteProcessHandle, ReplicaLink]:
+        with self._lock:
+            incarnation = self._incarnations.get(index, 0) + 1
+            self._incarnations[index] = incarnation
+            stale = self._links.pop(index, None)
+        if stale is not None:
+            # The predecessor's link must die with it: a late frame off
+            # the old stream is already handled as a late reply, but a
+            # reconnect there could resurrect a retired address.
+            stale.close()
+        rdir = replica_root(self.root, index)
+        os.makedirs(rdir, exist_ok=True)
+        spec_path = os.path.join(rdir, f"spec.{incarnation}.pkl")
+        with open(spec_path, "wb") as f:
+            pickle.dump(spec, f, protocol=pickle.HIGHEST_PROTOCOL)
+        args = [
+            sys.executable, "-m", "tensor2robot_tpu.serving.fabric",
+            "--replica",
+            "--index", str(index),
+            "--root", rdir,
+            "--incarnation", str(incarnation),
+            "--spec", spec_path,
+        ]
+        if self.zone is not None:
+            args += ["--zone", str(self.zone)]
+        popen = subprocess.Popen(args, start_new_session=True)
+        handle = RemoteProcessHandle(popen)
+        link = ReplicaLink(
+            rdir,
+            peer=replica_scope(index, spec, self.zone),
+            deliver=self._deliver,
+            min_incarnation=incarnation,
+            connect_timeout_s=self._connect_timeout_s,
+        )
+        with self._lock:
+            self._links[index] = link
+            self._procs.append(handle)
+        return handle, link
+
+    def incarnation(self, index: int) -> int:
+        with self._lock:
+            return self._incarnations.get(index, 0)
+
+    def close(self) -> None:
+        with self._lock:
+            links = list(self._links.values())
+            self._links.clear()
+        for link in links:
+            link.close()

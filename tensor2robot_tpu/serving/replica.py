@@ -48,6 +48,7 @@ import dataclasses
 import logging
 import os
 import queue
+import socket
 import time
 from typing import Any, Callable, Dict, Mapping, Optional, Tuple
 
@@ -61,6 +62,7 @@ from tensor2robot_tpu.utils.errors import best_effort
 _log = logging.getLogger(__name__)
 
 __all__ = [
+    "ReplicaCore",
     "ReplicaSpec",
     "replica_main",
     "policy_server_factory",
@@ -108,31 +110,73 @@ def _server_version(server) -> int:
         return -1
 
 
-def replica_main(index: int, spec: ReplicaSpec, request_q, response_q,
-                 free_q) -> None:
-    """Process entry. Never raises: a replica that cannot build its
-    server posts ("started", index, -1, pid) with a follow-up error
-    reply path dead, then exits — the router sees the exit and applies
-    its death handling; a replica that cannot *reach* the router any
-    more (queue torn down) just exits."""
-    _apply_env(spec.env)
-    chaos.set_scope(spec.scope if spec.scope is not None else f"r{index}")
-    pid = os.getpid()
-    try:
-        server = spec.factory(*spec.factory_args, **spec.factory_kwargs)
-    except Exception:
-        _log.exception("replica %d: server factory failed", index)
-        # Exiting nonzero IS the failure signal; the router's monitor
-        # handles a replica that dies before serving.
-        raise
-    cache = transport.ReplicaSlotCache()
-    chaos.maybe_fire("boot")
-    response_q.put(("started", index, _server_version(server), pid))
+class ReplicaCore:
+    """Transport-agnostic replica message core.
 
-    # id, old_version, deadline, policy_id (None = whole-backend swap)
-    pending_swap: Optional[Tuple[int, int, float, Optional[str]]] = None
+    One instance owns a started server and answers the router protocol
+    (module docstring) — `handle(message)` for each inbound tuple,
+    `tick(now)` between messages so an async hot-swap still resolves,
+    `close()` on the way out. Replies leave through the injected `post`
+    callable, which is the ONLY transport-specific piece: the local
+    fabric passes `response_q.put` (mp queue), the socket fabric
+    (serving/fabric.py) passes the duplex frame-writer. Everything the
+    router depends on — typed error replies, CRC'd response bodies,
+    swap one-in-flight discipline, deadline-at-dequeue shedding — lives
+    here exactly once, so the two fabrics cannot diverge in behavior
+    any more than they can in wire bytes.
 
-    def _version_of(policy_id: Optional[str]) -> int:
+    `post` may be called from the server's compute thread (the reply
+    callback) concurrently with the message loop's thread; it must be
+    thread-safe. Both existing posts are: mp.Queue.put and the
+    send-lock-guarded frame writer.
+    """
+
+    def __init__(self, index: int, server, post: Callable[[tuple], None],
+                 free_q=None):
+        self._index = index
+        self._server = server
+        self._post = post
+        self._free_q = free_q
+        self._cache = transport.ReplicaSlotCache()
+        # id, old_version, deadline, policy_id (None = whole-backend swap)
+        self._pending_swap: Optional[
+            Tuple[int, int, float, Optional[str]]
+        ] = None
+
+    def started_message(self) -> tuple:
+        return (
+            "started", self._index, _server_version(self._server), os.getpid()
+        )
+
+    def _host_identity(self) -> dict:
+        """This replica's host/AOT key, folded into every health
+        snapshot: on a cross-host fleet the router's per-replica rows
+        then SHOW which platform/topology each host resolved the
+        artifact's `aot/` executables against — a transplanted topology
+        is visible at the fleet surface, not just in the replica's
+        logs."""
+        identity = {
+            "hostname": socket.gethostname(),
+            "pid": os.getpid(),
+        }
+        # Topology only when this process ALREADY runs jax (any real
+        # policy backend does): importing it here would block the first
+        # health reply for seconds on a lightweight backend — long
+        # enough for the router to evict the replica as silent.
+        import sys
+
+        def topology():
+            from tensor2robot_tpu.export import aot as aot_lib
+
+            return aot_lib.device_topology()
+
+        identity["topology"] = (
+            best_effort(topology) if "jax" in sys.modules else None
+        )
+        return identity
+
+    def _version_of(self, policy_id: Optional[str]) -> int:
+        server = self._server
         if policy_id is not None and getattr(server, "multi_policy", False):
             try:
                 return int(server.policy_version(policy_id))
@@ -140,7 +184,7 @@ def replica_main(index: int, spec: ReplicaSpec, request_q, response_q,
                 return -1
         return _server_version(server)
 
-    def post_reply(req_id: int, attempt: int, body) -> None:
+    def _post_reply(self, req_id: int, attempt: int, body) -> None:
         crc, blob = transport.pack(body)
         fault = chaos.maybe_fire("reply")
         if fault is not None and fault.action == "corrupt" and blob:
@@ -148,26 +192,33 @@ def replica_main(index: int, spec: ReplicaSpec, request_q, response_q,
             # the mismatch and treat this replica reply as a failure.
             blob = blob[:-1] + bytes([blob[-1] ^ 0xFF])
         # Router gone -> best effort; our process is about to be reaped.
-        best_effort(response_q.put, ("rsp", index, req_id, attempt, crc, blob))
+        best_effort(
+            self._post, ("rsp", self._index, req_id, attempt, crc, blob)
+        )
 
-    def on_request(req_id: int, attempt: int, deadline_wall: float, payload,
-                   policy_id: Optional[str] = None):
+    def _on_request(self, req_id: int, attempt: int, deadline_wall: float,
+                    payload, policy_id: Optional[str] = None) -> None:
         chaos.maybe_fire("recv")
+        server = self._server
         try:
-            features = transport.decode_request(payload, free_q, cache)
+            features = transport.decode_request(
+                payload, self._free_q, self._cache
+            )
         except transport.IntegrityError as err:
-            post_reply(req_id, attempt, ("error", "RequestCorrupt", str(err)))
+            self._post_reply(
+                req_id, attempt, ("error", "RequestCorrupt", str(err))
+            )
             return
         remaining_ms = (deadline_wall - time.time()) * 1e3
         if remaining_ms <= 0:
-            post_reply(
+            self._post_reply(
                 req_id, attempt,
                 ("error", "DeadlineExceeded",
                  "deadline passed before the replica dequeued the request"),
             )
             return
         if policy_id is not None and not getattr(server, "multi_policy", False):
-            post_reply(
+            self._post_reply(
                 req_id, attempt,
                 ("error", "PolicyUnknown",
                  f"request names policy {policy_id!r} but this replica "
@@ -183,13 +234,15 @@ def replica_main(index: int, spec: ReplicaSpec, request_q, response_q,
                 )
         except Exception as err:  # typed submit failures (queue full,
             # closed, PolicyUnknown/PolicyEvicted residency refusals)
-            post_reply(req_id, attempt, ("error", type(err).__name__, str(err)))
+            self._post_reply(
+                req_id, attempt, ("error", type(err).__name__, str(err))
+            )
             return
 
         def on_done(f, req_id=req_id, attempt=attempt):
             err = f.error()
             if err is not None:
-                post_reply(
+                self._post_reply(
                     req_id, attempt, ("error", type(err).__name__, str(err))
                 )
                 return
@@ -197,7 +250,7 @@ def replica_main(index: int, spec: ReplicaSpec, request_q, response_q,
             outputs = {
                 k: np.asarray(v) for k, v in response.outputs.items()
             }
-            post_reply(
+            self._post_reply(
                 req_id, attempt,
                 ("ok", outputs, response.model_version,
                  dict(response.spans)),
@@ -205,107 +258,158 @@ def replica_main(index: int, spec: ReplicaSpec, request_q, response_q,
 
         future.add_done_callback(on_done)
 
-    def check_pending_swap(now_wall: float) -> None:
-        nonlocal pending_swap
-        if pending_swap is None:
+    def tick(self, now_wall: float) -> None:
+        """Resolve a pending async hot-swap (success on version flip,
+        failure on deadline). Called between messages and on idle."""
+        if self._pending_swap is None:
             return
-        swap_id, old_version, deadline, swap_policy = pending_swap
-        version = _version_of(swap_policy)
+        swap_id, old_version, deadline, swap_policy = self._pending_swap
+        version = self._version_of(swap_policy)
         if version != old_version:
-            pending_swap = None
-            response_q.put(("swapped", index, swap_id, True, version))
+            self._pending_swap = None
+            self._post(("swapped", self._index, swap_id, True, version))
         elif now_wall > deadline:
-            pending_swap = None
-            response_q.put(("swapped", index, swap_id, False, version))
+            self._pending_swap = None
+            self._post(("swapped", self._index, swap_id, False, version))
 
+    def _on_swap(self, message: tuple) -> None:
+        chaos.maybe_fire("swap")
+        server = self._server
+        swap_policy = message[3] if len(message) > 3 else None
+        is_multi = getattr(server, "multi_policy", False)
+        if swap_policy is not None and not is_multi:
+            self._post(
+                ("swapped", self._index, message[1], False,
+                 _server_version(server))
+            )
+            return
+        if (
+            swap_policy is not None
+            and is_multi
+            and not server.is_resident(swap_policy)
+        ):
+            # Nothing resident to swap: trivially done — the next cold
+            # load materializes whatever the store now publishes for
+            # this policy.
+            self._post(
+                ("swapped", self._index, message[1], True,
+                 self._version_of(swap_policy))
+            )
+            return
+        old_version = self._version_of(swap_policy)
+        if self._pending_swap is not None:
+            # A second swap while one is in flight (two concurrent
+            # rolling_swap calls) must not overwrite pending_swap: the
+            # first swap_id would then never be answered and its
+            # router-side waiter would burn the full timeout. Fail the
+            # NEW one fast instead; the in-flight swap keeps its reply.
+            self._post(
+                ("swapped", self._index, message[1], False, old_version)
+            )
+        else:
+            try:
+                if swap_policy is None:
+                    server.hot_swap(wait=False)
+                else:
+                    server.hot_swap(wait=False, policy_id=swap_policy)
+                self._pending_swap = (
+                    message[1], old_version, message[2], swap_policy
+                )
+            except Exception:
+                _log.exception(
+                    "replica %d: hot_swap failed", self._index
+                )
+                self._post(
+                    ("swapped", self._index, message[1], False, old_version)
+                )
+
+    def handle(self, message: tuple) -> bool:
+        """Dispatch one router message. Returns False on ("stop",) —
+        the caller must then exit its loop and close()."""
+        kind = message[0]
+        if kind == "req":
+            self._on_request(
+                message[1], message[2], message[3], message[4],
+                message[5] if len(message) > 5 else None,
+            )
+        elif kind == "health":
+            chaos.maybe_fire("health")
+            try:
+                snap = self._server.snapshot()
+            except Exception as err:  # a server that cannot even
+                # snapshot is unhealthy; say so rather than vanish.
+                snap = {"error": f"{type(err).__name__}: {err}"}
+            if isinstance(snap, dict):
+                snap.setdefault("host", self._host_identity())
+            self._post(
+                ("health", self._index, message[1], snap, time.time())
+            )
+        elif kind == "swap":
+            self._on_swap(message)
+            self.tick(time.time())
+        elif kind == "hello":
+            # Socket-fabric connect handshake: the router (or a fresh
+            # router incarnation re-resolving us) asks who we are; the
+            # local fabric never sends it, mp queues carry identity by
+            # construction.
+            self._post(self.started_message())
+        elif kind == "stop":
+            return False
+        else:
+            _log.warning(
+                "replica %d: unknown message %r", self._index, kind
+            )
+        self.tick(time.time())
+        return True
+
+    def close(self) -> None:
+        try:
+            self._server.stop()
+        except Exception:
+            _log.exception("replica %d: server stop failed", self._index)
+        self._cache.close()
+        best_effort(self._post, ("stopped", self._index))
+
+
+def build_server(index: int, spec: ReplicaSpec):
+    """Apply the spec's env + chaos scope, then run its factory. Shared
+    by both fabric entries so a socket replica boots exactly like a
+    local one (same env routing, same scope defaulting, same typed
+    factory-failure signal: the raised exception -> nonzero exit)."""
+    _apply_env(spec.env)
+    chaos.set_scope(spec.scope if spec.scope is not None else f"r{index}")
+    try:
+        return spec.factory(*spec.factory_args, **spec.factory_kwargs)
+    except Exception:
+        _log.exception("replica %d: server factory failed", index)
+        # Exiting nonzero IS the failure signal; the router's monitor
+        # handles a replica that dies before serving.
+        raise
+
+
+def replica_main(index: int, spec: ReplicaSpec, request_q, response_q,
+                 free_q) -> None:
+    """Process entry (local fabric). Never raises: a replica that cannot
+    build its server exits nonzero — the router sees the exit and
+    applies its death handling; a replica that cannot *reach* the
+    router any more (queue torn down) just exits."""
+    server = build_server(index, spec)
+    core = ReplicaCore(index, server, response_q.put, free_q)
+    chaos.maybe_fire("boot")
+    response_q.put(core.started_message())
     try:
         while True:
             try:
                 message = request_q.get(timeout=0.05)
             except queue.Empty:
-                check_pending_swap(time.time())
+                core.tick(time.time())
                 continue
             except (OSError, ValueError):
                 return  # request queue torn down: router is gone
-            kind = message[0]
-            if kind == "req":
-                on_request(
-                    message[1], message[2], message[3], message[4],
-                    message[5] if len(message) > 5 else None,
-                )
-            elif kind == "health":
-                chaos.maybe_fire("health")
-                try:
-                    snap = server.snapshot()
-                except Exception as err:  # a server that cannot even
-                    # snapshot is unhealthy; say so rather than vanish.
-                    snap = {"error": f"{type(err).__name__}: {err}"}
-                response_q.put(("health", index, message[1], snap, time.time()))
-            elif kind == "swap":
-                chaos.maybe_fire("swap")
-                swap_policy = message[3] if len(message) > 3 else None
-                is_multi = getattr(server, "multi_policy", False)
-                if swap_policy is not None and not is_multi:
-                    response_q.put(
-                        ("swapped", index, message[1], False,
-                         _server_version(server))
-                    )
-                    check_pending_swap(time.time())
-                    continue
-                if (
-                    swap_policy is not None
-                    and is_multi
-                    and not server.is_resident(swap_policy)
-                ):
-                    # Nothing resident to swap: trivially done — the
-                    # next cold load materializes whatever the store
-                    # now publishes for this policy.
-                    response_q.put(
-                        ("swapped", index, message[1], True,
-                         _version_of(swap_policy))
-                    )
-                    check_pending_swap(time.time())
-                    continue
-                old_version = _version_of(swap_policy)
-                if pending_swap is not None:
-                    # A second swap while one is in flight (two concurrent
-                    # rolling_swap calls) must not overwrite pending_swap:
-                    # the first swap_id would then never be answered and
-                    # its router-side waiter would burn the full timeout.
-                    # Fail the NEW one fast instead; the in-flight swap
-                    # keeps its reply.
-                    response_q.put(
-                        ("swapped", index, message[1], False, old_version)
-                    )
-                else:
-                    try:
-                        if swap_policy is None:
-                            server.hot_swap(wait=False)
-                        else:
-                            server.hot_swap(
-                                wait=False, policy_id=swap_policy
-                            )
-                        pending_swap = (
-                            message[1], old_version, message[2], swap_policy
-                        )
-                    except Exception:
-                        _log.exception("replica %d: hot_swap failed", index)
-                        response_q.put(
-                            ("swapped", index, message[1], False, old_version)
-                        )
-                check_pending_swap(time.time())
-            elif kind == "stop":
+            if not core.handle(message):
                 return
-            else:
-                _log.warning("replica %d: unknown message %r", index, kind)
-            check_pending_swap(time.time())
     finally:
-        try:
-            server.stop()
-        except Exception:
-            _log.exception("replica %d: server stop failed", index)
-        cache.close()
-        best_effort(response_q.put, ("stopped", index))
+        core.close()
 
 
 # -- backends ------------------------------------------------------------------
@@ -414,6 +518,7 @@ class _MockServer:
         scale: float = 1.0,
         bias: float = 0.0,
         mem_bytes: int = 0,
+        fingerprint: Optional[str] = None,
     ):
         import threading
         from tensor2robot_tpu.testing import locksmith
@@ -427,6 +532,10 @@ class _MockServer:
         self._scale = float(scale)
         self._bias = float(bias)
         self.mem_bytes = int(mem_bytes)
+        # Optional artifact identity (PolicyServer snapshot parity):
+        # pools of identical mocks can DECLARE interchangeability, so
+        # gateway cross-pool failover has a fingerprint to match on.
+        self._fingerprint = fingerprint
         self._queue: "queue.Queue" = queue.Queue()
         self._closed = False
         self._completed = 0
@@ -481,7 +590,7 @@ class _MockServer:
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:
             completed = self._completed
-        return {
+        snap = {
             "counters": {"completed": completed},
             "queue_depth": self._queue.qsize(),
             "model_version": self.model_version,
@@ -491,6 +600,9 @@ class _MockServer:
             # degenerate bucket and nothing to compile.
             "prewarm_source": {"1": "mock"},
         }
+        if self._fingerprint is not None:
+            snap["model_fingerprint"] = str(self._fingerprint)
+        return snap
 
     def hot_swap(self, wait: bool = False) -> bool:
         """Version bump on a background thread after the chaos `restore`
@@ -514,9 +626,15 @@ class _MockServer:
         self._worker.join(timeout=5)
 
 
-def mock_server_factory(service_ms: float = 1.0, version: int = 1):
-    """Jax-free replica backend for router tests and plumbing smokes."""
-    return _MockServer(service_ms=service_ms, version=version)
+def mock_server_factory(service_ms: float = 1.0, version: int = 1,
+                        fingerprint: Optional[str] = None):
+    """Jax-free replica backend for router tests and plumbing smokes.
+    `fingerprint` optionally declares an artifact identity (surfaced as
+    `model_fingerprint` in health snapshots), which is what gateway
+    cross-pool failover matches on before moving a request."""
+    return _MockServer(
+        service_ms=service_ms, version=version, fingerprint=fingerprint
+    )
 
 
 def multi_policy_mock_factory(

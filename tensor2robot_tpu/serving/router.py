@@ -292,6 +292,9 @@ class FleetRouter:
         inline_max_bytes: int = transport.DEFAULT_INLINE_MAX_BYTES,
         shm_slots: int = 8,
         seed: int = 0,
+        transport_mode: Optional[str] = None,
+        fabric_root: Optional[str] = None,
+        zone: Optional[str] = None,
     ):
         if isinstance(replica_spec, ReplicaSpec):
             if num_replicas is None:
@@ -340,6 +343,24 @@ class FleetRouter:
         self._boot_timeout_s = boot_timeout_s
         self._inline_max = inline_max_bytes
         self._shm_slots = shm_slots
+        # Which fabric carries replica traffic: "local" (mp queues +
+        # shared-memory slots, one process group — byte-compatible
+        # tier-1 default) or "socket" (independent process groups on the
+        # shared CRC-framed wire, published-address discovery — the
+        # cross-host fabric). Everything above _spawn/start is
+        # transport-blind: handles and links duck-type the mp surface.
+        self._transport_mode = (
+            transport_mode if transport_mode is not None
+            else t2r_flags.get_enum("T2R_FLEET_TRANSPORT")
+        )
+        if self._transport_mode not in ("local", "socket"):
+            raise ValueError(
+                f"unknown transport_mode {self._transport_mode!r} "
+                "(expected 'local' or 'socket')"
+            )
+        self._fabric_root = fabric_root
+        self._zone = zone
+        self._pool = None  # RemoteReplicaPool, socket mode only
 
         self._lock = locksmith.make_rlock("FleetRouter._lock")
         self._metrics = _RouterMetrics()
@@ -374,16 +395,44 @@ class FleetRouter:
         warming in the background and join the pool when ready."""
         if self._started:
             raise RuntimeError("FleetRouter.start() called twice")
-        import multiprocessing
+        if self._transport_mode == "socket":
+            # Cross-host fabric: replicas are independent process groups
+            # on the CRC-framed wire. No mp context, no shared-memory
+            # ring (the codec degrades to inline pickled arrays — the
+            # only shape that crosses hosts); replies arrive through the
+            # per-replica links into a plain thread queue.
+            from tensor2robot_tpu.serving.pool import (
+                RemoteReplicaPool, ResponseQueue,
+            )
 
-        self._ctx = multiprocessing.get_context("spawn")
-        self._response_q = self._ctx.Queue()
-        self._free_q = self._ctx.Queue()
-        self._codec = transport.RequestCodec(
-            self._free_q,
-            inline_max_bytes=self._inline_max,
-            num_slots=self._shm_slots,
-        )
+            if self._fabric_root is None:
+                import tempfile
+
+                self._fabric_root = tempfile.mkdtemp(prefix="t2r-fabric-")
+            self._response_q = ResponseQueue()
+            self._free_q = None
+            self._codec = transport.RequestCodec(
+                None, inline_max_bytes=self._inline_max
+            )
+            self._pool = RemoteReplicaPool(
+                self._fabric_root,
+                self._response_q.put,
+                zone=self._zone,
+                connect_timeout_s=t2r_flags.get_int(
+                    "T2R_FABRIC_CONNECT_TIMEOUT_MS"
+                ) / 1e3,
+            )
+        else:
+            import multiprocessing
+
+            self._ctx = multiprocessing.get_context("spawn")
+            self._response_q = self._ctx.Queue()
+            self._free_q = self._ctx.Queue()
+            self._codec = transport.RequestCodec(
+                self._free_q,
+                inline_max_bytes=self._inline_max,
+                num_slots=self._shm_slots,
+            )
         # t2r: unguarded-ok(start() runs before any fleet thread exists)
         for replica in self._replicas:
             self._spawn(replica)
@@ -415,11 +464,23 @@ class FleetRouter:
         )
 
     def _spawn(self, replica: _Replica) -> None:
-        replica.request_q = self._ctx.Queue()
         replica.state = _STARTING
         replica.started_at = time.monotonic()
         replica.inflight = set()
         replica.consecutive_failures = 0
+        if self._pool is not None:
+            # Socket fabric: the pool bumps the incarnation, launches
+            # the detached process, and hands back a (handle, link)
+            # pair that duck-types (proc, request_q). The link refuses
+            # the predecessor's stale published address; the monitor's
+            # health-probe puts double as the re-resolution loop, and
+            # the fresh connection's ("hello",) handshake elicits the
+            # ("started", ...) that readmits the replica to routing.
+            replica.proc, replica.request_q = self._pool.spawn(
+                replica.index, replica.spec
+            )
+            return
+        replica.request_q = self._ctx.Queue()
         replica.proc = self._ctx.Process(
             target=replica_main,
             args=(
@@ -472,6 +533,11 @@ class FleetRouter:
                 continue
             best_effort(q.cancel_join_thread)
             best_effort(q.close)
+        if self._pool is not None:
+            # Socket links already closed through the loop above (they
+            # duck-type the queue teardown); this sweeps any link the
+            # pool still tracks for a replica mid-respawn.
+            best_effort(self._pool.close)
 
     def __enter__(self) -> "FleetRouter":
         return self
@@ -889,10 +955,34 @@ class FleetRouter:
                     replica.state = _UP
                     replica.consecutive_failures = 0
                     self._metrics.count("circuit_recoveries")
+                elif replica.state == _STARTING and not replica.retired:
+                    # Socket fabric: the ("hello",)->("started",...)
+                    # handshake can be lost on the wire (drop/partition
+                    # at net_send). The replica then answers probes
+                    # while the router still holds it in `starting` —
+                    # and every answer refreshes last_health_time, so
+                    # the boot-timeout branch never fires either: the
+                    # replica would be wedged out of routing forever.
+                    # A health reply carries the same evidence
+                    # "started" does (an address is only published
+                    # after the factory succeeded), so it admits too.
+                    replica.state = _UP
+                    replica.consecutive_failures = 0
+                    if replica.started_at:
+                        replica.boot_ms = round(
+                            (time.monotonic() - replica.started_at)
+                            * 1e3,
+                            3,
+                        )
         elif kind == "started":
             _, index, version, _pid = message
             with self._lock:
                 replica = self._replicas[index]
+                if replica.retired or replica.state == _DRAINING:
+                    # Socket fabric: a link reconnect re-elicits the
+                    # ("hello",)->("started",...) handshake; a draining
+                    # replica must not be readmitted to routing by it.
+                    return
                 replica.state = _UP
                 replica.version = version
                 replica.last_health_time = time.monotonic()
@@ -1285,9 +1375,17 @@ class FleetRouter:
                     "policy_cold_loads": r.last_health.get(
                         "policy_cold_loads"
                     ),
+                    # Host identity + per-host AOT key off the health
+                    # snapshot (hostname/pid/topology): on the socket
+                    # fabric this is the per-host table — which
+                    # platform/topology each replica resolved the
+                    # artifact's aot/ executables against.
+                    "host": r.last_health.get("host"),
                 }
                 for r in self._replicas
             ]
+        snap["transport"] = self._transport_mode
+        snap["zone"] = self._zone
         snap["policy"] = {
             "max_inflight": self._max_inflight,
             "hedge_ms": self._hedge_s * 1e3,

@@ -14,6 +14,7 @@ u32 crc32).
 import hashlib
 import json
 import os
+import time
 
 import numpy as np
 import pytest
@@ -391,3 +392,163 @@ class TestFingerprint:
         assert program_fingerprint(files) == program_fingerprint(other)
         changed = {"t2r_metadata.json": b"{!}", "variables.msgpack": b"w"}
         assert program_fingerprint(files) != program_fingerprint(changed)
+
+
+def _age_blobs(store, seconds=7200.0):
+    """Back-date every blob so the gc grace window does not shield it."""
+    blob_dir = os.path.join(store.root, "blobs")
+    past = time.time() - seconds
+    for name in os.listdir(blob_dir):
+        os.utime(os.path.join(blob_dir, name), (past, past))
+
+
+def _blob_names(store):
+    blob_dir = os.path.join(store.root, "blobs")
+    return {n for n in os.listdir(blob_dir) if n.startswith("sha256-")}
+
+
+class TestGC:
+    def test_all_live_deletes_nothing(self, store, tmp_path):
+        params = _params(0)
+        _publish(store, tmp_path, "base", params)
+        _publish(store, tmp_path, "sib", _perturb(params, 1), base_policy="base")
+        _age_blobs(store)
+        before = _blob_names(store)
+        stats = store.gc()
+        assert stats["deleted"] == 0
+        assert stats["bytes_freed"] == 0
+        assert stats["live"] == stats["scanned"] == len(before)
+        assert _blob_names(store) == before
+
+    def test_republish_then_rooted_sweep_reclaims_old_generation(
+        self, store, tmp_path
+    ):
+        params = _params(0)
+        _publish(store, tmp_path, "base-v1", params)
+        _publish(
+            store, tmp_path, "sib", _perturb(params, 1), base_policy="base-v1"
+        )
+        _publish(store, tmp_path, "base-v2", _perturb(params, 2, scale=5e-3))
+        _age_blobs(store)
+        stats = store.gc(roots=["base-v2"])
+        assert stats["deleted"] > 0
+        # Survivor still loads bitwise; the superseded generation's
+        # unique payload blobs are gone, so its load is a typed refusal,
+        # never a partial read.
+        store.load_weights("base-v2")
+        with pytest.raises(ArtifactStoreError):
+            store.load_weights("base-v1")
+
+    def test_delta_base_chain_is_reachable(self, store, tmp_path):
+        params = _params(0)
+        _publish(store, tmp_path, "base", params)
+        sib = _perturb(params, 1)
+        _publish(store, tmp_path, "sib", sib, base_policy="base")
+        grand = _perturb(sib, 2)
+        _publish(store, tmp_path, "grand", grand, base_policy="sib")
+        _age_blobs(store)
+        # Rooting ONLY the grandchild transitively pins both ancestors
+        # through the delta-base chain — a rooted sibling must stay
+        # reconstructable after the sweep.
+        stats = store.gc(roots=["grand"])
+        assert stats["deleted"] == 0
+        # Still reconstructs through both ancestors, hash-verified.
+        assert store.load_weights("grand") == store.load_weights("grand")
+
+    def test_dry_run_counts_without_deleting(self, store, tmp_path):
+        params = _params(0)
+        _publish(store, tmp_path, "base-v1", params)
+        _publish(store, tmp_path, "base-v2", _perturb(params, 2, scale=5e-3))
+        _age_blobs(store)
+        before = _blob_names(store)
+        dry = store.gc(roots=["base-v2"], dry_run=True)
+        assert dry["dry_run"] is True
+        assert dry["deleted"] > 0
+        assert dry["bytes_freed"] > 0
+        assert _blob_names(store) == before
+        real = store.gc(roots=["base-v2"])
+        assert real["deleted"] == dry["deleted"]
+        assert real["bytes_freed"] == dry["bytes_freed"]
+        assert len(_blob_names(store)) == len(before) - real["deleted"]
+
+    def test_grace_window_shields_inflight_put(self, store, tmp_path):
+        params = _params(0)
+        _publish(store, tmp_path, "base", params)
+        _age_blobs(store)
+        # A fresh blob with no manifest looks exactly like an in-flight
+        # put whose manifest has not landed yet — kept, counted.
+        orphan = os.path.join(store.root, "blobs", "sha256-" + "ab" * 32)
+        with open(orphan, "wb") as f:
+            f.write(b"manifest has not landed yet")
+        stats = store.gc()
+        assert os.path.exists(orphan)
+        assert stats["kept_young"] == 1
+        assert stats["deleted"] == 0
+        stats = store.gc(grace_s=0.0)
+        assert not os.path.exists(orphan)
+        assert stats["deleted"] == 1
+
+    def test_tmp_files_are_never_candidates(self, store, tmp_path):
+        params = _params(0)
+        _publish(store, tmp_path, "base", params)
+        tmp_blob = os.path.join(store.root, "blobs", ".tmp-partial-write")
+        with open(tmp_blob, "wb") as f:
+            f.write(b"half a blob")
+        _age_blobs(store)
+        stats = store.gc(grace_s=0.0)
+        assert os.path.exists(tmp_blob)
+        assert stats["deleted"] == 0
+
+    def test_corrupt_root_manifest_is_typed_refusal(self, store, tmp_path):
+        params = _params(0)
+        _publish(store, tmp_path, "base-v1", params)
+        _publish(store, tmp_path, "base-v2", _perturb(params, 2, scale=5e-3))
+        _age_blobs(store)
+        mpath = os.path.join(store.root, "policies", "base-v2.json")
+        with open(mpath, "w") as f:
+            f.write("{ torn manifest")
+        before = _blob_names(store)
+        with pytest.raises(ArtifactCorrupt, match="repair or delete"):
+            store.gc(grace_s=0.0)
+        # Refusal deletes NOTHING — a torn mark set never drives a sweep.
+        assert _blob_names(store) == before
+
+    def test_missing_explicit_root_is_typed(self, store, tmp_path):
+        params = _params(0)
+        _publish(store, tmp_path, "base", params)
+        with pytest.raises(PolicyNotFound):
+            store.gc(roots=["absent"])
+
+    def test_late_landing_manifest_is_remarked(
+        self, store, tmp_path, monkeypatch
+    ):
+        params = _params(0)
+        _publish(store, tmp_path, "base-v1", params)
+        _publish(store, tmp_path, "base-v2", _perturb(params, 2, scale=5e-3))
+        _age_blobs(store)
+        # Simulate manifests-land-last: between mark and sweep, a put
+        # completes whose manifest ADOPTS base-v1's (otherwise-dead)
+        # blobs. The re-check must unmark exactly those candidates.
+        v1_manifest = store.manifest("base-v1")
+        real_policies = type(store).policies
+        calls = {"n": 0}
+
+        def racing_policies(self):
+            ids = real_policies(self)
+            calls["n"] += 1
+            if calls["n"] == 2:  # the sweep-side re-listing
+                path = os.path.join(
+                    self.root, "policies", "late-lander.json"
+                )
+                with open(path, "w") as f:
+                    json.dump(v1_manifest, f)
+                ids = real_policies(self)
+            return ids
+
+        monkeypatch.setattr(type(store), "policies", racing_policies)
+        stats = store.gc(roots=["base-v2"], grace_s=0.0)
+        monkeypatch.undo()
+        assert stats["deleted"] == 0
+        # Both generations still load: the late lander pinned v1's blobs.
+        store.load_weights("base-v2")
+        store.load_weights("late-lander")

@@ -275,9 +275,20 @@ def test_bench_cli_lists_legs():
     assert proc.returncode == 0
     for leg in (
         "data", "auc", "predict", "bc", "stream", "pipe", "serve", "comms",
-        "fleet", "rl", "aot", "plan", "policies",
+        "fleet", "rl", "aot", "plan", "policies", "fabric",
     ):
         assert leg in proc.stdout
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "bench.py"),
+         "fabric", "--help"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0
+    for option in (
+        "--replicas-per-zone", "--trace-secs", "--deadline-ms",
+        "--hedge-ms", "--gold-rps", "--crowd-factor", "--out",
+    ):
+        assert option in proc.stdout
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO_ROOT, "bench.py"),
          "policies", "--help"],
@@ -648,6 +659,62 @@ def test_bench_fleet_contract(tmp_path):
 
 # ~13s on 1 cpu: slow slice with the other bench leg contracts;
 # BENCH_GATE_r14.json is the committed audit of the same surface.
+@pytest.mark.slow
+def test_bench_fabric_contract(tmp_path):
+    """The cross-host fabric leg at toy scale (one replica per zone,
+    short trace): one JSON line + the --out artifact, socket replicas
+    in separate process groups, the partition twin holding gold
+    availability at the fault-free bar with zero lost requests (all
+    shed typed, per-zone ledgers), post-heal re-resolution, the
+    ZoneRouter absorbing the partition, typed per-host AOT rows, and
+    the local-transport byte-compat pin."""
+    out = str(tmp_path / "fabric.json")
+    payload = _run_bench(
+        "fabric",
+        "--replicas-per-zone", "1",
+        "--trace-secs", "5",
+        "--out", out,
+        timeout=540,
+    )
+    assert payload["metric"] == "fabric_cross_host_partition_slo_cpu_proxy"
+    assert payload["unit"] == "gold_availability_under_zone_partition"
+    assert "error" not in payload
+    assert payload["cpu_proxy"] is True
+    assert payload["ok"] is True, payload["gates"]
+    assert all(payload["gates"].values()), payload["gates"]
+    detail = payload["detail"]
+    # The fleet really spanned separate process groups (no replica in
+    # the bench's own group, >= 2 distinct groups).
+    assert len(detail["process_groups"]) >= 2
+    assert os.getpid() not in detail["process_groups"]
+    # Zero lost on BOTH twins; the partition twin's gold bar held.
+    for leg_name in ("fault_free_leg", "partition_leg"):
+        leg = detail[leg_name]
+        assert leg["lost"] == 0, leg_name
+        assert set(leg["zone_ledgers"]) == {"z0", "z1"}
+    assert (
+        detail["partition_leg"]["gold_availability"]
+        >= detail["fault_free_leg"]["gold_availability"]
+    )
+    # The healed zone came back with RESPAWNED pids (re-resolved by
+    # published address, not by a stale handle).
+    assert detail["z1_pids_after_heal"]
+    assert not set(detail["z1_pids_after_heal"]) & set(
+        detail["zones"]["z1"]["pids"]
+    )
+    # Cross-zone survival, typed: the zone-router leg lost nothing.
+    assert detail["zone_router_leg"]["lost"] == 0
+    assert detail["zone_router_leg"]["z0_wins_during_partition"] >= 16
+    # Per-host AOT keys: matching host all-aot, transplanted topology
+    # typed (never a silent mismatch load).
+    het = detail["heterogeneity"]
+    assert het["matching_all_aot"] is True
+    assert het["transplanted_host"]["topology"] == 2
+    assert het["replies_bitwise_identical"] is True
+    with open(out) as f:
+        assert json.load(f)["metric"] == payload["metric"]
+
+
 @pytest.mark.slow
 def test_bench_gateway_contract(tmp_path):
     """The multi-tenant front-door leg at toy scale: one JSON line + the

@@ -287,6 +287,12 @@ class TestFlashAttention:
 
 
 class TestRingWithFlashTiles:
+    # ~12s: pallas-interpret forward over the 4-way ring; the flash tile
+    # forward stays fast in TestFlashAttention::test_matches_reference
+    # and the plain ring-vs-full parity stays fast in
+    # test_ring_attention's 4-shard column — this composition joins its
+    # gradients twin on the slow slice.
+    @pytest.mark.slow
     def test_ring_flash_matches_reference(self):
         from tensor2robot_tpu.parallel import mesh as mesh_lib
         from tensor2robot_tpu.parallel.ring_attention import ring_attention

@@ -11,6 +11,8 @@ scale-down. No assertion depends on wall-clock rates — only typed
 outcomes, counters, and generous ordering bounds.
 """
 
+import os
+import signal
 import time
 
 import numpy as np
@@ -250,6 +252,95 @@ class TestAdmission:
                 )
                 with pytest.raises((RequestAbandoned, GateDeadline)):
                     future.result(2.0)  # well inside the injected stall
+
+
+class TestCrossPoolFailover:
+    """Pools as availability zones (round 21): a request whose home
+    pool cannot serve moves to a sibling pool — gated on
+    artifact-fingerprint equality (interchangeability is proven, never
+    assumed) and counted (`cross_pool_retries`, per-pool
+    `retried_away`/`retried_in`)."""
+
+    @staticmethod
+    def _pool(fingerprint, **kwargs):
+        kwargs.setdefault("probe_interval_ms", 50.0)
+        kwargs.setdefault("backoff_ms", 5.0)
+        spec = ReplicaSpec(
+            factory=mock_server_factory,
+            factory_kwargs={"service_ms": 1.0, "fingerprint": fingerprint},
+        )
+        return FleetRouter(spec, 1, **kwargs).start(timeout_s=90.0)
+
+    @staticmethod
+    def _kill_pool(router):
+        def _pid():
+            host = router.snapshot()["replicas"][0].get("host")
+            return host and host.get("pid")
+
+        assert _wait(lambda: _pid() is not None), "host pid never reported"
+        os.kill(_pid(), signal.SIGKILL)
+        assert _wait(
+            lambda: router.load()["replicas_up"] == 0
+        ), "dead pool still reports capacity"
+
+    @staticmethod
+    def _gold_binding():
+        return [TenantBinding(
+            tenant="gold0", pool="home", tier="gold",
+            quota_rps=10_000.0, burst=10_000,
+        )]
+
+    def test_dead_home_pool_fails_over_at_dispatch(self):
+        """The home pool has NO healthy replica (`ReplicaUnavailable`
+        raised at dispatch — the partitioned/dead-zone shape): the
+        gateway moves the request to the fingerprint-equal sibling
+        instead of spinning it in place until its deadline expires."""
+        home = self._pool("artifact-X", respawn=False)
+        other = self._pool("artifact-X")
+        with home, other:
+            _wait_all_up(home)
+            _wait_all_up(other)
+            with Gateway(
+                {"home": home, "other": other}, self._gold_binding()
+            ).start() as gateway:
+                assert gateway.call(
+                    "gold0", _features(), deadline_ms=20000
+                ).pool == "home"
+                self._kill_pool(home)
+                response = gateway.call(
+                    "gold0", _features(), deadline_ms=20000
+                )
+                assert response.outputs["y"] == pytest.approx(4.0)
+                assert response.pool == "other"
+                snap = gateway.snapshot()
+                assert snap["counters"]["cross_pool_retries"] >= 1
+                assert snap["pools"]["home"]["counters"][
+                    "retried_away"] >= 1
+                assert snap["pools"]["other"]["counters"][
+                    "retried_in"] >= 1
+
+    def test_failover_requires_fingerprint_equality(self):
+        """A sibling pool serving a DIFFERENT artifact never absorbs
+        the failover — the request fails typed at its deadline rather
+        than silently landing on the wrong model."""
+        home = self._pool("artifact-X", respawn=False)
+        other = self._pool("artifact-Y")
+        with home, other:
+            _wait_all_up(home)
+            _wait_all_up(other)
+            with Gateway(
+                {"home": home, "other": other}, self._gold_binding()
+            ).start() as gateway:
+                self._kill_pool(home)
+                future = gateway.submit(
+                    "gold0", _features(), deadline_ms=600
+                )
+                with pytest.raises(GateDeadline):
+                    future.result(30)
+                snap = gateway.snapshot()
+                assert snap["counters"].get("cross_pool_retries", 0) == 0
+                assert snap["pools"]["other"]["counters"].get(
+                    "retried_in", 0) == 0
 
 
 class TestPriorityShedding:

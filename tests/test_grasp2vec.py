@@ -136,6 +136,11 @@ class TestGrasp2VecModel:
         assert out["pregrasp_image"].shape == (1, 32, 32, 3)
         assert out["goal_image"].shape == (1, 32, 32, 3)
 
+    # ~12s: the three-tower forward + npairs loss; the same towers and
+    # model_train_fn stay fast via test_triplet_loss_variant below, the
+    # npairs math via TestLosses, and the full pipeline rides the slow
+    # trainer run above.
+    @pytest.mark.slow
     def test_forward_and_loss(self):
         model = small_model()
         features = {

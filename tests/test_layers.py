@@ -111,6 +111,10 @@ class TestResNet:
         out2 = model.apply(variables, images, False, jnp.zeros_like(emb))
         assert not np.allclose(np.asarray(out1), np.asarray(out2))
 
+    # ~12s: resnet-50 init + two applies just for the v1-bottleneck
+    # FiLM width regression; FiLM conditioning stays fast on resnet-18
+    # above, and the 50/v2 tower rides the slow shapes column already.
+    @pytest.mark.slow
     def test_film_v1_bottleneck_runs(self):
         # Regression: FiLM must be applied at the filters-wide point in v1
         # bottleneck blocks (2*filters generator outputs vs 4*filters bn3).

@@ -259,8 +259,11 @@ class TestPresets:
             # The two ring-attention twins pay ~75s of manual-mode
             # shard_map compiles (x2: hand + planned) for a layout-only
             # assertion — they ride the slow slice per the PR 5 budget
-            # discipline; sp_ulysses/pp/dp_pp below keep composed-preset
-            # (incl. sequence-parallel) coverage in tier-1.
+            # discipline. Round 21 moved sp_ulysses (~12s) and plain pp
+            # (~8s) there too: dp_pp/dp_pp_zero2 below keep composed
+            # pipeline coverage in tier-1 (pp is their strict subset),
+            # and ulysses stays fast via test_sp_ulysses_preset_runs +
+            # the planner's ulysses-in-pipe enumeration pin.
             pytest.param(
                 "dp_sp", dict(data=2, sequence=4), {}, {},
                 marks=pytest.mark.slow,
@@ -269,7 +272,7 @@ class TestPresets:
                 "sp_ring", dict(data=1, sequence=8), {}, {},
                 marks=pytest.mark.slow,
             ),
-            (
+            pytest.param(
                 "sp_ulysses",
                 dict(data=1, sequence=8),
                 dict(
@@ -277,12 +280,14 @@ class TestPresets:
                     num_heads=8, head_dim=8,
                 ),
                 {},
+                marks=pytest.mark.slow,
             ),
-            (
+            pytest.param(
                 "pp",
                 dict(data=1, pipe=2),
                 dict(pipeline_stages=2, pipeline_microbatches=2),
                 {},
+                marks=pytest.mark.slow,
             ),
             (
                 "dp_pp",
@@ -504,6 +509,11 @@ class Test3DPlan:
         state = compiled.init_state(jax.random.PRNGKey(0), batch)
         return plan, compiled, state, batch
 
+    # ~12s: the 3D train-step compile just to see one finite loss; the
+    # layout assertions stay fast below (collective-schedule pin runs
+    # the same _setup_3d audit surface) and the math contract rides the
+    # slow loss-parity twin.
+    @pytest.mark.slow
     def test_one_step_runs_with_generalized_weight_update(self):
         plan, compiled, state, batch = self._setup_3d()
         audit = planner.audit_state_layout(plan, compiled.mesh, state)

@@ -310,6 +310,37 @@ class TestLintsCatch:
         assert flags.get_flag("T2R_PLAN_MEASURE").default == "off"
         assert flags.get_flag("T2R_PLAN_MEASURE_STEPS").minimum == 1
 
+    def test_fabric_flags_covered_by_registry_lint(self):
+        """The round-21 cross-host fabric gates ride the same rails:
+        the transport selector is a declared enum (local|socket,
+        default local — the tier-1 byte-compat pin), the hedge/connect
+        timings declared ints; raw reads env-undeclared, wrong-kind
+        reads env-kind-mismatch, declared spellings clean."""
+        for name in (
+            "T2R_FLEET_TRANSPORT", "T2R_FABRIC_HEDGE_MS",
+            "T2R_FABRIC_CONNECT_TIMEOUT_MS",
+        ):
+            assert "env-undeclared" in self._rules(
+                f"import os\nx = os.environ.get({name!r})\n"
+            ), name
+        assert "env-kind-mismatch" in self._rules(
+            "from tensor2robot_tpu import flags\n"
+            "x = flags.get_bool('T2R_FLEET_TRANSPORT')\n"
+            "y = flags.get_str('T2R_FABRIC_HEDGE_MS')\n"
+        )
+        clean = self._rules(
+            "from tensor2robot_tpu import flags\n"
+            "a = flags.get_enum('T2R_FLEET_TRANSPORT')\n"
+            "b = flags.get_int('T2R_FABRIC_HEDGE_MS')\n"
+            "c = flags.get_int('T2R_FABRIC_CONNECT_TIMEOUT_MS')\n"
+        )
+        assert "env-kind-mismatch" not in clean
+        assert "env-unknown-flag" not in clean
+        spec = flags.get_flag("T2R_FLEET_TRANSPORT")
+        assert spec.choices == ("local", "socket")
+        assert spec.default == "local"
+        assert flags.get_flag("T2R_FABRIC_CONNECT_TIMEOUT_MS").minimum == 1
+
     def test_replay_flags_covered_by_registry_lint(self):
         """The round-12 T2R_REPLAY_* + T2R_PARSE_ON_ERROR flags ride the
         same rails: raw environ reads are env-undeclared, wrong-kind

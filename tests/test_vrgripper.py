@@ -336,6 +336,10 @@ class TestTecModel:
         assert np.isfinite(float(loss))
         assert "loss/embed" in metrics
 
+    # ~9s: a second full TEC tower compile just for E_cond=2; the
+    # single-episode tower stays fast in test_forward_and_loss and the
+    # episode-reduction shape contract in test_pack_features below.
+    @pytest.mark.slow
     def test_multiple_condition_episodes(self):
         # Regression: E_cond != E_inf must work — condition episodes reduce
         # to one task embedding before joining inference features.

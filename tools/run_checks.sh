@@ -218,6 +218,20 @@ if [ "$TESTS" = 1 ]; then
       -q -m 'not slow' -p no:cacheprovider; then
     status=1
   fi
+
+  echo "== fabric: cross-host serving fabric suite (tier-1) =="
+  # Published-address discovery + incarnation-stamped respawn
+  # re-resolution, the corpus corruption family typed at the SERVING
+  # wire (torn whole, never partial), zone dispatch / cross-zone
+  # hedging / typed failover against in-process stub zones, socket
+  # replicas in separate process groups, per-host AOT key resolution
+  # (transplanted topology = typed row), and cross-host store
+  # mirroring with re-hash-on-receipt. The partition/heal soak is the
+  # slow-slice twin (TestPartitionHedgeHeal).
+  if ! JAX_PLATFORMS=cpu python -m pytest tests/test_fabric.py \
+      -q -m 'not slow' -p no:cacheprovider; then
+    status=1
+  fi
 fi
 
 if [ "$status" = 0 ]; then
