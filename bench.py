@@ -4648,6 +4648,400 @@ def bench_fabric(args) -> None:
         _fail("bench_fabric", err, metric=metric)
 
 
+def bench_wire(args) -> None:
+    """Zero-copy spec-native wire codec leg (`python bench.py wire`).
+
+    Measures the round-22 serving wire end to end on a socketpair —
+    real `write_frame`/`read_frame`, an echo server that decodes the
+    request exactly as a replica does (`transport.decode_request`) and
+    frames a reply back — with camera-sized observations
+    (`--image-hw` square uint8 + `--state-dim` float32), then gates the
+    acceptance story:
+
+      1. **Throughput.** Requests/s for `T2R_WIRE=pickle` (the
+         pre-spec wire, bit-identical frames) vs `T2R_WIRE=spec`
+         (scatter-gather segments, pooled receive, adler32 body +
+         crc32 structural integrity). Gate: spec >= `--speedup-min`
+         x pickle (median of `--trials` timed windows after warmup).
+      2. **Bitwise.** The features the server decodes and the replies
+         the client reads are bit-identical across the two codecs;
+         a live socket-mode FleetRouter pool returns bit-identical
+         outputs under pickle wire, spec wire, and the local mp
+         transport.
+      3. **Quant.** `T2R_WIRE_QUANT=<--quant>` rides the
+         BlockScaledCollective {'q','s'} format: uint8 image planes
+         untouched (bitwise), float features within the declared
+         rel-Linf parity gate, wire bytes attributed per segment
+         class.
+      4. **Zero-allocation receive.** The codec buffer pool's `allocs`
+         counter is FLAT across the steady-state window (every frame
+         lands in a reused buffer).
+      5. **Hostile bytes.** Every `corrupt_frame_variants` family
+         against a spec frame is rejected with a typed error.
+      6. **Pipelining.** `PipelinedChannel` overlaps `--pipeline-requests`
+         in-flight requests on one connection vs SocketChannel lockstep.
+
+    The artifact lands per-stage wire timings (serialize/crc/send/
+    recv/deserialize) and per-segment-class byte counters from the
+    codec's own observability surface.
+    """
+    import hashlib
+    import shutil
+    import socket as socket_lib
+    import tempfile
+    import threading
+
+    metric = "wire_codec_spec_vs_pickle_reqs_per_sec"
+    try:
+        import numpy as np
+
+        from tensor2robot_tpu import flags as t2r_flags
+        from tensor2robot_tpu.analysis import corpus
+        from tensor2robot_tpu.net import codec, frames
+        from tensor2robot_tpu.serving import (
+            FleetRouter,
+            ReplicaSpec,
+            mock_server_factory,
+        )
+        from tensor2robot_tpu.serving import transport as serving_transport
+
+        rng = np.random.RandomState(22)
+        hw = args.image_hw
+        features = {
+            "image": rng.randint(0, 256, (hw, hw, 3), dtype=np.uint8),
+            "state": (rng.randn(args.state_dim) * 1.7).astype(np.float32),
+        }
+        reply_outputs = {
+            "y": np.float32(1.25),
+            "nbytes": np.int64(sum(v.nbytes for v in features.values())),
+        }
+
+        def _request(i, wire):
+            if wire == "spec":
+                payload = ("raw", dict(features))
+            else:
+                payload = ("inline",) + serving_transport.pack(
+                    dict(features)
+                )
+            return ("req", i, 1, None, payload)
+
+        def _echo_loop(sock, n, digest_out):
+            """Replica-shaped echo: decode the request payload exactly
+            as a replica does, frame back a reply whose bytes are
+            request-independent. When `digest_out` is given (the
+            untimed verification window), every decoded feature is
+            sha256'd — the cross-codec bitwise evidence. The timed
+            windows skip the digest: hashing 670 KB per frame would be
+            a constant added to BOTH codecs, compressing the ratio the
+            gate measures."""
+            cache = serving_transport.ReplicaSlotCache()
+            digest = hashlib.sha256() if digest_out is not None else None
+            try:
+                for _ in range(n):
+                    message = frames.read_frame(
+                        sock, deadline=time.monotonic() + 60
+                    )
+                    feats = serving_transport.decode_request(
+                        message[4], None, cache
+                    )
+                    if digest is not None:
+                        for key in sorted(feats):
+                            arr = np.ascontiguousarray(feats[key])
+                            digest.update(key.encode())
+                            digest.update(arr.tobytes())
+                    feats = None
+                    reply = (message[1], "ok") + serving_transport.pack(
+                        reply_outputs
+                    )
+                    frames.write_frame(sock, reply)
+            finally:
+                if digest_out is not None:
+                    digest_out.append(digest.hexdigest())
+
+        def _run_window(wire, n, verify=False):
+            """(elapsed_s, features_digest, replies_digest) for n
+            request/reply round trips on one socketpair."""
+            a, b = socket_lib.socketpair()
+            a.settimeout(60.0)
+            b.settimeout(60.0)
+            digest_out = [] if verify else None
+            server = threading.Thread(
+                target=_echo_loop, args=(b, n, digest_out), daemon=True
+            )
+            server.start()
+            replies = hashlib.sha256() if verify else None
+            t0 = time.perf_counter()
+            try:
+                for i in range(n):
+                    frames.write_frame(a, _request(i, wire))
+                    reply = frames.read_frame(
+                        a, deadline=time.monotonic() + 60
+                    )
+                    if replies is not None:
+                        replies.update(repr(reply[:2]).encode())
+                        replies.update(reply[3])
+                elapsed = time.perf_counter() - t0
+            finally:
+                server.join(timeout=60)
+                a.close()
+                b.close()
+            if not verify:
+                return elapsed, None, None
+            return elapsed, digest_out[0], replies.hexdigest()
+
+        saved_wire = t2r_flags.read_raw("T2R_WIRE")
+        saved_quant = t2r_flags.read_raw("T2R_WIRE_QUANT")
+        results = {}
+        pool_before = pool_after = None
+        try:
+            t2r_flags.write_env("T2R_WIRE_QUANT", "none")
+            for wire in ("pickle", "spec"):
+                t2r_flags.write_env("T2R_WIRE", wire)
+                _run_window(wire, args.warmup)
+                _, feats_digest, replies_digest = _run_window(
+                    wire, 12, verify=True
+                )
+                if wire == "spec":
+                    pool_before = codec.POOL.snapshot()
+                trials = []
+                for _ in range(args.trials):
+                    elapsed, _, _ = _run_window(wire, args.frames)
+                    trials.append(args.frames / elapsed)
+                if wire == "spec":
+                    pool_after = codec.POOL.snapshot()
+                results[wire] = {
+                    "reqs_per_sec": float(np.median(trials)),
+                    "trials": [round(t, 2) for t in trials],
+                    "features_digest": feats_digest,
+                    "replies_digest": replies_digest,
+                }
+
+            # -- quant leg ------------------------------------------------
+            t2r_flags.write_env("T2R_WIRE", "spec")
+            t2r_flags.write_env("T2R_WIRE_QUANT", args.quant)
+            _run_window("spec", max(4, args.warmup // 4))
+            q_elapsed, _, _ = _run_window("spec", args.frames)
+            # Parity evidence measured directly on one round trip.
+            q, s = None, None
+            encoded = codec.quant_encode_array(
+                features["state"],
+                args.quant,
+                t2r_flags.get_int("T2R_COLLECTIVE_BLOCK"),
+            )
+            quant_applied = encoded is not None
+            if quant_applied:
+                q, s = encoded
+                dequant = codec.quant_decode_array(
+                    q, s, features["state"].shape, np.float32
+                )
+                quant_rel_linf = float(
+                    np.max(np.abs(dequant - features["state"]))
+                    / np.max(np.abs(features["state"]))
+                )
+            else:
+                quant_rel_linf = 0.0  # dense fallback is bitwise
+            results["quant"] = {
+                "mode": args.quant,
+                "reqs_per_sec": round(args.frames / q_elapsed, 2),
+                "applied": quant_applied,
+                "rel_linf": quant_rel_linf,
+                "parity_gate": codec.QUANT_PARITY_REL_LINF[args.quant],
+            }
+        finally:
+            t2r_flags.restore_env("T2R_WIRE", saved_wire)
+            t2r_flags.restore_env("T2R_WIRE_QUANT", saved_quant)
+
+        speedup = (
+            results["spec"]["reqs_per_sec"]
+            / results["pickle"]["reqs_per_sec"]
+        )
+
+        # -- live pool: bitwise replies across codecs ---------------------
+        root = tempfile.mkdtemp(prefix="bench-wire-")
+        pool_outputs = {}
+        try:
+            for wire in ("pickle", "spec", "local"):
+                if wire == "local":
+                    t2r_flags.restore_env("T2R_WIRE", saved_wire)
+                    transport_kwargs = {}
+                else:
+                    t2r_flags.write_env("T2R_WIRE", wire)
+                    transport_kwargs = {
+                        "transport_mode": "socket",
+                        "fabric_root": os.path.join(root, wire),
+                    }
+                router = FleetRouter(
+                    ReplicaSpec(
+                        factory=mock_server_factory,
+                        factory_kwargs={"service_ms": 0.5, "version": 1},
+                        env={"T2R_WIRE": wire} if wire != "local" else {},
+                    ),
+                    args.replicas,
+                    probe_interval_ms=50.0,
+                    backoff_ms=10.0,
+                    **transport_kwargs,
+                ).start(timeout_s=120.0)
+                try:
+                    response = router.submit(
+                        dict(features), deadline_ms=30000
+                    ).result(60)
+                    pool_outputs[wire] = {
+                        k: np.asarray(v).tobytes()
+                        for k, v in response.outputs.items()
+                    }
+                finally:
+                    router.stop()
+        finally:
+            t2r_flags.restore_env("T2R_WIRE", saved_wire)
+            shutil.rmtree(root, ignore_errors=True)
+        pool_bitwise = (
+            pool_outputs["pickle"] == pool_outputs["spec"]
+            == pool_outputs["local"]
+        )
+
+        # -- hostile bytes: the corpus against a spec frame ---------------
+        # A small frame: it must fit the socketpair buffer whole, since
+        # the reader only runs after the hostile bytes are fully sent.
+        spec_frame = codec.encode_spec_frame_bytes(
+            ("req", 0, 1, None, ("raw", {
+                "image": features["image"][:24, :24].copy(),
+                "state": features["state"][:128].copy(),
+            }))
+        )
+        variants = corpus.corrupt_frame_variants(
+            spec_frame, header_size=codec.SPEC_PREFIX.size
+        )
+        rejected = 0
+        for name, variant in sorted(variants.items()):
+            a, b = socket_lib.socketpair()
+            a.settimeout(10.0)
+            b.settimeout(10.0)
+            try:
+                a.sendall(variant)
+                a.close()
+                try:
+                    frames.read_frame(b, deadline=time.monotonic() + 5)
+                except frames.TransportError:
+                    rejected += 1
+            finally:
+                b.close()
+
+        # -- pipelining: overlapped in-flight vs lockstep -----------------
+        service_s = args.pipeline_service_ms / 1e3
+
+        def _pipeline_handler(request, send):
+            req_id, payload = request
+
+            def _reply():
+                time.sleep(service_s)
+                send((req_id, "ok", payload))
+
+            threading.Thread(target=_reply, daemon=True).start()
+
+        pipe_root = tempfile.mkdtemp(prefix="bench-wire-pipe-")
+        server = frames.FrameServer(_pipeline_handler, duplex=True).start()
+        try:
+            frames.publish_address(pipe_root, server.port, incarnation=1)
+            n_pipe = args.pipeline_requests
+            lockstep = frames.SocketChannel(pipe_root)
+            t0 = time.perf_counter()
+            for i in range(n_pipe):
+                lockstep.call((i, "x"), i, timeout_s=30)
+            lockstep_s = time.perf_counter() - t0
+            lockstep.close()
+            piped = frames.PipelinedChannel(pipe_root)
+            t0 = time.perf_counter()
+            pendings = [piped.submit((i, "x"), i) for i in range(n_pipe)]
+            for pending in pendings:
+                piped.result(pending, timeout_s=30)
+            pipelined_s = time.perf_counter() - t0
+            piped.close()
+        finally:
+            server.stop()
+            shutil.rmtree(pipe_root, ignore_errors=True)
+        pipeline_overlap = lockstep_s / max(pipelined_s, 1e-9)
+
+        wire_stats = codec.wire_snapshot()
+        gates = {
+            "spec_speedup_over_pickle": speedup >= args.speedup_min,
+            "replies_bitwise_identical_across_codecs": (
+                results["pickle"]["replies_digest"]
+                == results["spec"]["replies_digest"]
+            ),
+            "decoded_features_bitwise_identical_across_codecs": (
+                results["pickle"]["features_digest"]
+                == results["spec"]["features_digest"]
+            ),
+            "pool_replies_bitwise_identical": pool_bitwise,
+            "quant_within_parity_gate": (
+                results["quant"]["rel_linf"]
+                <= results["quant"]["parity_gate"]
+            ),
+            "zero_steady_state_receive_allocs": (
+                pool_after["allocs"] == pool_before["allocs"]
+            ),
+            "all_corruption_variants_typed_rejected": (
+                rejected == len(variants)
+            ),
+            "pipelining_overlaps_lockstep": pipeline_overlap >= 1.5,
+        }
+        ok = all(gates.values())
+        payload = {
+            "metric": metric,
+            "value": round(speedup, 3),
+            "unit": "spec_over_pickle_reqs_per_sec_ratio",
+            "vs_baseline": round(results["pickle"]["reqs_per_sec"], 2),
+            "ok": ok,
+            "gates": gates,
+            "detail": {
+                "pickle_reqs_per_sec": results["pickle"]["reqs_per_sec"],
+                "spec_reqs_per_sec": results["spec"]["reqs_per_sec"],
+                "trials": {
+                    wire: results[wire]["trials"]
+                    for wire in ("pickle", "spec")
+                },
+                "quant_leg": results["quant"],
+                "message_shape": {
+                    "image": [hw, hw, 3],
+                    "image_dtype": "uint8",
+                    "state": [args.state_dim],
+                    "state_dtype": "float32",
+                },
+                "frames_per_trial": args.frames,
+                "pool_audit": {
+                    "before_steady_window": pool_before,
+                    "after_steady_window": pool_after,
+                },
+                "corruption_variants": {
+                    "total": len(variants),
+                    "typed_rejected": rejected,
+                },
+                "pipelining": {
+                    "requests": args.pipeline_requests,
+                    "service_ms": args.pipeline_service_ms,
+                    "lockstep_s": round(lockstep_s, 4),
+                    "pipelined_s": round(pipelined_s, 4),
+                    "overlap_ratio": round(pipeline_overlap, 2),
+                },
+                "wire_stats": wire_stats,
+                "host_cpus": os.cpu_count(),
+            },
+            "cpu_proxy": True,
+            "proxy_note": (
+                "wire measured over a local socketpair on one host; "
+                "absolute reqs/s are host-bound, the speedup ratio, "
+                "bitwise/parity contracts, allocation audit and typed "
+                "rejection are platform-independent"
+            ),
+        }
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(payload, f, indent=2, sort_keys=True)
+        _emit(payload)
+    except Exception as err:  # noqa: BLE001
+        _fail("bench_wire", err, metric=metric)
+
+
 def bench_comms(args) -> None:
     """Quantized gradient-collective leg (`python bench.py comms`).
 
@@ -6539,6 +6933,73 @@ def _build_cli():
     )
     fabric.add_argument(
         "--out", default="BENCH_FABRIC_r21.json",
+        help="also write the payload to this file ('' disables; "
+             "default %(default)s)",
+    )
+    wire = leg(
+        "wire", bench_wire,
+        "zero-copy wire codec leg: camera-sized observations through the "
+        "real frame codec on a socketpair (T2R_WIRE=pickle vs spec), "
+        "gating spec speedup, bitwise replies across codecs (socketpair "
+        "echo AND a live socket-mode pool vs local mp), quantized-payload "
+        "parity (T2R_WIRE_QUANT), zero steady-state receive allocations "
+        "(buffer-pool audit), typed rejection of every corpus corruption "
+        "variant, and PipelinedChannel overlap vs lockstep "
+        "(docs/SERVING.md \"Wire protocol\")",
+    )
+    wire.add_argument(
+        "--frames", type=int, default=150,
+        help="request/reply round trips per timed trial (default "
+             "%(default)s)",
+    )
+    wire.add_argument(
+        "--trials", type=int, default=3,
+        help="timed trials per codec; the median is reported "
+             "(default %(default)s)",
+    )
+    wire.add_argument(
+        "--warmup", type=int, default=30,
+        help="untimed warmup round trips per codec (fills the receive "
+             "pool; the steady-state alloc audit spans the timed "
+             "windows) (default %(default)s)",
+    )
+    wire.add_argument(
+        "--image-hw", type=int, default=472,
+        help="square uint8 camera observation edge (472 = the paper's "
+             "native capture) (default %(default)s)",
+    )
+    wire.add_argument(
+        "--state-dim", type=int, default=2048,
+        help="float32 proprio/state vector length (default %(default)s)",
+    )
+    wire.add_argument(
+        "--speedup-min", type=float, default=3.0,
+        help="gate: spec reqs/s must be at least this multiple of "
+             "pickle's (default %(default)s)",
+    )
+    wire.add_argument(
+        "--quant", default="int8",
+        choices=("fp16", "int8", "fp8_e4m3", "fp8_e5m2"),
+        help="T2R_WIRE_QUANT mode for the quantized-payload leg "
+             "(default %(default)s)",
+    )
+    wire.add_argument(
+        "--replicas", type=int, default=1,
+        help="replica count for the live-pool bitwise leg "
+             "(default %(default)s)",
+    )
+    wire.add_argument(
+        "--pipeline-requests", type=int, default=32,
+        help="in-flight requests for the pipelining leg "
+             "(default %(default)s)",
+    )
+    wire.add_argument(
+        "--pipeline-service-ms", type=float, default=2.0,
+        help="mock per-request service time the pipelined channel must "
+             "overlap (default %(default)s)",
+    )
+    wire.add_argument(
+        "--out", default="BENCH_WIRE_r22.json",
         help="also write the payload to this file ('' disables; "
              "default %(default)s)",
     )

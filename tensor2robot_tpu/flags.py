@@ -733,6 +733,30 @@ _declare(
     "tensor2robot_tpu/layers/s2d_conv.py",
     choices=("auto", "0", "1"),
 )
+_declare(
+    "T2R_WIRE",
+    _ENUM,
+    "pickle",
+    "Frame codec every SEND on the CRC-framed socket wire uses "
+    "(net/frames.py; receivers auto-detect per frame from the magic). "
+    "pickle is byte-identical to the pre-spec wire; spec is the "
+    "zero-copy segment codec (scatter-gather sendmsg, pooled recv_into, "
+    "np.frombuffer decode) both fabrics ride for array payloads.",
+    "tensor2robot_tpu/net/codec.py",
+    choices=("pickle", "spec"),
+)
+_declare(
+    "T2R_WIRE_QUANT",
+    _ENUM,
+    "none",
+    "Quantized observation payloads on the spec wire codec: float "
+    "arrays ride the BlockScaledCollective blockwise format "
+    "(T2R_COLLECTIVE_BLOCK elements per scale), uint8 image planes "
+    "pass through untouched; each array is parity-gated at encode "
+    "(rel-Linf per mode) and sent dense on a miss. none is bit-exact.",
+    "tensor2robot_tpu/net/codec.py",
+    choices=("none", "fp16", "int8", "fp8_e4m3", "fp8_e5m2"),
+)
 
 
 # -- lookup -------------------------------------------------------------------

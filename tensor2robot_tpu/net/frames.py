@@ -58,6 +58,7 @@ import time
 import zlib
 from typing import Any, Callable, List, Optional, Tuple
 
+from tensor2robot_tpu.net import codec
 from tensor2robot_tpu.testing import chaos
 from tensor2robot_tpu.utils.errors import best_effort
 
@@ -69,6 +70,7 @@ __all__ = [
     "ConnectionClosed",
     "FrameServer",
     "MAX_FRAME_BYTES",
+    "PipelinedChannel",
     "SocketChannel",
     "TransportError",
     "encode_frame",
@@ -76,6 +78,7 @@ __all__ = [
     "read_address",
     "read_address_info",
     "read_frame",
+    "wire_snapshot",
     "write_frame",
 ]
 
@@ -151,25 +154,184 @@ def _recv_exact(sock: socket.socket, count: int, deadline: Optional[float],
     return b"".join(chunks)
 
 
+def _recv_into_exact(
+    sock: socket.socket,
+    view: memoryview,
+    deadline: Optional[float],
+    checksum=zlib.crc32,
+    seed: int = 0,
+) -> int:
+    """Fills `view` from the stream with `recv_into` (no intermediate
+    chunk objects) and returns the incremental checksum of the bytes —
+    computed DURING the read, so a corrupt 64MB frame costs one pass,
+    not an allocate-copy-then-checksum second one. Always mid-frame:
+    EOF here is a torn frame. `checksum`/`seed` select the codec's
+    check (crc32 from 0 for pickle frames, adler32 from 1 for spec
+    bodies)."""
+    got = 0
+    count = len(view)
+    crc = seed
+    t0 = time.perf_counter()
+    while got < count:
+        try:
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TransportError(
+                        f"transport read timed out with {count - got} "
+                        "bytes outstanding"
+                    )
+                sock.settimeout(remaining)
+            else:
+                sock.settimeout(None)
+            n = sock.recv_into(view[got:])
+        except socket.timeout as err:
+            raise TransportError("transport read timed out") from err
+        except OSError as err:
+            raise TransportError(f"transport read failed: {err}") from err
+        if n == 0:
+            raise BadFrame(
+                f"stream closed mid-frame ({got} of {count} bytes)"
+            )
+        crc = checksum(view[got:got + n], crc)
+        got += n
+    codec.WIRE.time("recv_ms", time.perf_counter() - t0)
+    return crc & 0xFFFFFFFF
+
+
 def read_frame(sock: socket.socket, deadline: Optional[float] = None) -> Any:
     """One whole message off the stream, or a typed failure — never a
-    partially-decoded object (see module docstring)."""
-    header = _recv_exact(sock, FRAME_HEADER.size, deadline, mid_frame=False)
-    magic, length, crc = FRAME_HEADER.unpack(header)
-    if magic != MAGIC:
-        raise BadFrame(f"bad frame magic {magic:#010x}")
-    if length > MAX_FRAME_BYTES:
-        raise BadFrame(
-            f"forged frame length {length} (bound {MAX_FRAME_BYTES})"
+    partially-decoded object (see module docstring).
+
+    The codec is auto-detected per frame from the magic (the SENDER's
+    `T2R_WIRE` picks it), so mixed-codec peers interoperate on one
+    stream. Both codecs receive into a pooled buffer with the CRC
+    verified incrementally during `recv_into`; the spec codec's array
+    views then alias that buffer (returned to the pool when the last
+    view dies), the pickle codec releases it as soon as
+    `pickle.loads` has copied the objects out."""
+    first = _recv_exact(sock, 4, deadline, mid_frame=False)
+    (magic,) = struct.unpack("<I", first)
+    if magic == MAGIC:
+        rest = _recv_exact(
+            sock, FRAME_HEADER.size - 4, deadline, mid_frame=True
         )
-    blob = _recv_exact(sock, length, deadline, mid_frame=True)
-    if zlib.crc32(blob) & 0xFFFFFFFF != crc:
-        raise BadFrame(f"frame of {length} bytes failed its CRC32 check")
-    try:
-        return pickle.loads(blob)
-    except Exception as err:
-        # Checksummed but undecodable: same wire failure to the caller.
-        raise BadFrame(f"frame payload failed to decode: {err}") from err
+        length, crc = struct.unpack("<II", rest)
+        if length > MAX_FRAME_BYTES:
+            raise BadFrame(
+                f"forged frame length {length} (bound {MAX_FRAME_BYTES})"
+            )
+        lease = codec.POOL.acquire(length)
+        try:
+            view = memoryview(lease.buf)[:length]
+            if _recv_into_exact(sock, view, deadline) != crc:
+                raise BadFrame(
+                    f"frame of {length} bytes failed its CRC32 check"
+                )
+            t0 = time.perf_counter()
+            try:
+                message = pickle.loads(view)
+            except Exception as err:
+                # Checksummed but undecodable: same wire failure.
+                raise BadFrame(
+                    f"frame payload failed to decode: {err}"
+                ) from err
+            codec.WIRE.time("deserialize_ms", time.perf_counter() - t0)
+            codec.WIRE.count("frames_pickle_rx")
+            return message
+        finally:
+            # pickle.loads copied everything out of the buffer.
+            lease.release()
+    if magic == codec.SEG_MAGIC:
+        rest = _recv_exact(
+            sock, codec.SPEC_PREFIX.size - 4, deadline, mid_frame=True
+        )
+        body_len, adler, crc, nsegs, skeleton_len = struct.unpack(
+            "<IIIII", rest
+        )
+        if body_len > MAX_FRAME_BYTES:
+            raise BadFrame(
+                f"forged frame length {body_len} "
+                f"(bound {MAX_FRAME_BYTES})"
+            )
+        if nsegs > codec.MAX_SEGMENTS:
+            raise BadFrame(
+                f"forged segment count {nsegs} "
+                f"(bound {codec.MAX_SEGMENTS})"
+            )
+        structural = 4 * nsegs + skeleton_len
+        if structural > body_len:
+            raise BadFrame(
+                f"forged spec header: table ({4 * nsegs}) + skeleton "
+                f"({skeleton_len}) overrun the {body_len}-byte body"
+            )
+        lease = codec.POOL.acquire(body_len)
+        ok = False
+        try:
+            view = memoryview(lease.buf)[:body_len]
+            got = _recv_into_exact(
+                sock, view, deadline, checksum=zlib.adler32, seed=1
+            )
+            if got != adler:
+                raise BadFrame(
+                    f"spec frame of {body_len} bytes failed its "
+                    "adler32 body check"
+                )
+            if zlib.crc32(view[:structural]) & 0xFFFFFFFF != crc:
+                raise BadFrame(
+                    "spec frame structural region failed its CRC32 "
+                    "check"
+                )
+            try:
+                message = codec.decode_spec_body(
+                    view, nsegs, skeleton_len, lease
+                )
+            except codec.CodecError as err:
+                raise BadFrame(f"spec frame refused: {err}") from err
+            ok = True  # decode_spec_body now owns the lease
+            return message
+        finally:
+            if not ok:
+                lease.release()
+    raise BadFrame(f"bad frame magic {magic:#010x}")
+
+
+# IOV_MAX bound for one sendmsg; Linux allows 1024, stay under it.
+_SENDMSG_MAX_BUFFERS = min(getattr(socket, "IOV_MAX", 1024), 1024)
+
+
+def _sendmsg_all(sock: socket.socket, buffers: List[Any]) -> None:
+    """Scatter-gather `sendmsg` with partial-send resume and IOV_MAX
+    chunking — the whole frame leaves the process without ever being
+    concatenated in user space."""
+    views = [memoryview(b).cast("B") for b in buffers if len(b)]
+    total = sum(len(v) for v in views)
+    idx = 0
+    off = 0
+    sent_total = 0
+    while sent_total < total:
+        iov = []
+        i, o = idx, off
+        while i < len(views) and len(iov) < _SENDMSG_MAX_BUFFERS:
+            iov.append(views[i][o:] if o else views[i])
+            o = 0
+            i += 1
+        try:
+            sent = sock.sendmsg(iov)
+        except OSError as err:
+            raise TransportError(
+                f"transport write failed: {err}"
+            ) from err
+        sent_total += sent
+        while sent:
+            remaining = len(views[idx]) - off
+            if sent >= remaining:
+                sent -= remaining
+                idx += 1
+                off = 0
+            else:
+                off += sent
+                sent = 0
 
 
 def write_frame(
@@ -177,8 +339,16 @@ def write_frame(
 ) -> bool:
     """Sends one frame; returns False when a chaos clause dropped it on
     the floor (the caller proceeds to wait — and time out — exactly as
-    it would on a real lost packet)."""
+    it would on a real lost packet). `T2R_WIRE` picks the codec:
+    `pickle` (default) is byte-identical to the pre-spec wire, `spec`
+    sends the scatter-gather segment frame."""
+    if codec.wire_mode() == "spec":
+        return _write_frame_spec(sock, message, peer)
+    t0 = time.perf_counter()
     frame = encode_frame(message)
+    codec.WIRE.time("serialize_ms", time.perf_counter() - t0)
+    codec.WIRE.count("frames_pickle_tx")
+    codec.WIRE.count("bytes_pickle", len(frame))
     hit = chaos.maybe_fire("net_send", peer=peer)
     if hit is not None:
         if hit.action in ("drop", "partition"):
@@ -189,11 +359,49 @@ def write_frame(
             corrupted = bytearray(frame)
             corrupted[FRAME_HEADER.size] ^= 0xFF
             frame = bytes(corrupted)
+    t0 = time.perf_counter()
     try:
         sock.sendall(frame)
     except OSError as err:
         raise TransportError(f"transport write failed: {err}") from err
+    codec.WIRE.time("send_ms", time.perf_counter() - t0)
     return True
+
+
+def _write_frame_spec(
+    sock: socket.socket, message: Any, peer: Optional[str]
+) -> bool:
+    """Spec-codec send: same chaos contract as the pickle path — drop
+    and partition discard the frame, corrupt flips a body byte after
+    the CRC was computed (in a COPY of the small table/skeleton buffer,
+    never in the caller's arrays)."""
+    try:
+        buffers, _body_len = codec.encode_spec_frame(
+            message, MAX_FRAME_BYTES
+        )
+    except codec.CodecError as err:
+        raise TransportError(str(err)) from err
+    hit = chaos.maybe_fire("net_send", peer=peer)
+    if hit is not None:
+        if hit.action in ("drop", "partition"):
+            return False
+        if hit.action == "corrupt":
+            for i in range(1, len(buffers)):
+                if len(buffers[i]):
+                    corrupted = bytearray(buffers[i])
+                    corrupted[0] ^= 0xFF
+                    buffers[i] = bytes(corrupted)
+                    break
+    t0 = time.perf_counter()
+    _sendmsg_all(sock, buffers)
+    codec.WIRE.time("send_ms", time.perf_counter() - t0)
+    return True
+
+
+def wire_snapshot() -> dict:
+    """Per-process wire observability: stage timings, per-segment-class
+    byte counters, and the receive-pool allocation audit."""
+    return codec.wire_snapshot()
 
 
 # -- address discovery ---------------------------------------------------------
@@ -520,3 +728,128 @@ class SocketChannel:
         if self._sock is not None:
             best_effort(self._sock.close)
             self._sock = None
+
+
+class _Pending:
+    """One in-flight request on a PipelinedChannel."""
+
+    __slots__ = ("req_id", "event", "reply", "error")
+
+    def __init__(self, req_id: Any):
+        self.req_id = req_id
+        self.event = threading.Event()
+        self.reply: Any = None
+        self.error: Optional[TransportError] = None
+
+
+class PipelinedChannel:
+    """Multiple in-flight requests multiplexed on ONE connection.
+
+    `SocketChannel.call` is lockstep — send, then read until the reply
+    arrives — so N sequential fetches pay N round trips even when the
+    server could overlap them. This channel keeps a reader thread and
+    a pending map keyed by request id: `submit` frames the request and
+    returns immediately; `result` blocks on that request alone; frames
+    arriving out of order complete whichever request they answer.
+    Replies are correlated by the server contract SocketChannel already
+    relies on (reply[0] == req_id), so any FrameServer handler that
+    echoes req_ids is pipelinable unchanged.
+
+    Failure semantics stay whole-connection, like SocketChannel: any
+    transport error fails EVERY in-flight request (the stream is
+    untrustworthy past the tear) and closes the socket; the next
+    submit reconnects via the published address."""
+
+    def __init__(
+        self,
+        root: str,
+        peer: Optional[str] = None,
+        connect_timeout_s: float = 2.0,
+        min_incarnation: int = 0,
+    ):
+        self._channel = SocketChannel(
+            root,
+            peer=peer,
+            connect_timeout_s=connect_timeout_s,
+            min_incarnation=min_incarnation,
+        )
+        self._lock = locksmith.make_lock("PipelinedChannel._lock")
+        self._pending: dict = {}
+        self._reader: Optional[threading.Thread] = None
+        self._closed = False
+
+    def _ensure_reader(self, sock: socket.socket) -> None:
+        if self._reader is None or not self._reader.is_alive():
+            self._reader = threading.Thread(
+                target=self._read_loop, args=(sock,), daemon=True,
+                name="t2r-pipelined-reader",
+            )
+            self._reader.start()
+
+    def _read_loop(self, sock: socket.socket) -> None:
+        while True:
+            try:
+                reply = read_frame(sock)
+            except TransportError as err:
+                self._fail_all(err)
+                return
+            if not (isinstance(reply, tuple) and reply):
+                continue
+            with self._lock:
+                pending = self._pending.pop(reply[0], None)
+            if pending is None:
+                continue  # stale reply for an abandoned request
+            pending.reply = reply
+            pending.event.set()
+
+    def _fail_all(self, err: Exception) -> None:
+        with self._lock:
+            pending = list(self._pending.values())
+            self._pending.clear()
+        failure = err if isinstance(err, TransportError) else (
+            TransportError(str(err))
+        )
+        for entry in pending:
+            entry.error = failure
+            entry.event.set()
+        self._channel.close()
+
+    def submit(self, request: Any, req_id: Any) -> _Pending:
+        pending = _Pending(req_id)
+        with self._lock:
+            if self._closed:
+                raise TransportError("pipelined channel closed")
+            if req_id in self._pending:
+                raise TransportError(
+                    f"request id {req_id!r} already in flight"
+                )
+            self._pending[req_id] = pending
+            try:
+                sock = self._channel._connect()
+                self._ensure_reader(sock)
+                write_frame(sock, request, peer=self._channel.peer)
+            except TransportError:
+                self._pending.pop(req_id, None)
+                self._channel.close()
+                raise
+        return pending
+
+    def result(self, pending: _Pending, timeout_s: float) -> Any:
+        if not pending.event.wait(timeout_s):
+            with self._lock:
+                self._pending.pop(pending.req_id, None)
+            raise TransportError(
+                f"pipelined request {pending.req_id!r} timed out "
+                f"after {timeout_s}s"
+            )
+        if pending.error is not None:
+            raise pending.error
+        return pending.reply
+
+    def call(self, request: Any, req_id: Any, timeout_s: float) -> Any:
+        return self.result(self.submit(request, req_id), timeout_s)
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+        self._fail_all(TransportError("pipelined channel closed"))

@@ -14,7 +14,10 @@ both). This module re-exports it under the replay fabric's historical
 names; every import, test, and byte of the replay wire is unchanged.
 
 See `net/frames.py` for the frame format, decode discipline, address
-discovery contract, and the chaos-site semantics.
+discovery contract, and the chaos-site semantics. With `T2R_WIRE=spec`
+(net/codec.py) the already-serialized episode record bytes inside
+append/sample messages ride the frame as raw scatter-gather segments —
+they are no longer pickled a second time into the frame body.
 """
 
 from __future__ import annotations
@@ -27,6 +30,7 @@ from tensor2robot_tpu.net.frames import (  # noqa: F401
     BadFrame,
     ConnectionClosed,
     FrameServer,
+    PipelinedChannel,
     SocketChannel,
     TransportError,
     _recv_exact,
@@ -35,6 +39,7 @@ from tensor2robot_tpu.net.frames import (  # noqa: F401
     read_address,
     read_address_info,
     read_frame,
+    wire_snapshot,
     write_frame,
 )
 
@@ -43,6 +48,7 @@ __all__ = [
     "BadFrame",
     "ConnectionClosed",
     "MAX_FRAME_BYTES",
+    "PipelinedChannel",
     "ReplayTransportServer",
     "SocketChannel",
     "TransportError",
@@ -51,6 +57,7 @@ __all__ = [
     "read_address",
     "read_address_info",
     "read_frame",
+    "wire_snapshot",
     "write_frame",
 ]
 

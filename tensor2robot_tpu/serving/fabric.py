@@ -522,6 +522,10 @@ class ZoneRouter:
                 entry["zone"] = name
                 replicas.append(entry)
         snap["replicas"] = replicas
+        # This process's wire accounting (every zone router here shares
+        # one codec, pool, and stats surface): stage timings,
+        # per-segment-class bytes, receive-pool allocation audit.
+        snap["wire"] = frames.wire_snapshot()
         snap["policy"] = {
             "hedge_ms": self._hedge_s * 1e3,
             "zone_retries": self._zone_retries,
@@ -625,21 +629,33 @@ class StoreServer:
         self._server.stop()
 
 
+# Blob fetches kept in flight per mirror connection. Bounded so a
+# mirror of a many-blob policy cannot hold an unbounded reply backlog
+# in memory on either end.
+MIRROR_WINDOW = 8
+
+
 class _StoreClient:
-    """Typed call helper over a SocketChannel to a StoreServer."""
+    """Typed call helper over a PipelinedChannel to a StoreServer.
+
+    `submit`/`result` expose the pipelining: several blob fetches ride
+    one connection concurrently, correlated by req_id — `mirror_policy`
+    keeps a window of them in flight instead of paying a full lockstep
+    round trip per blob."""
 
     def __init__(self, service_root: str, timeout_s: float = 30.0):
-        self._channel = frames.SocketChannel(service_root)
+        self._channel = frames.PipelinedChannel(service_root)
         self._timeout_s = timeout_s
         self._ids = itertools.count(1)
 
-    def call(self, op: str, *args):
+    def submit(self, op: str, *args):
+        req_id = f"{op}-{next(self._ids)}"
+        return self._channel.submit((op, req_id) + args, req_id)
+
+    def result(self, pending):
         from tensor2robot_tpu.export import artifact_store as store_lib
 
-        req_id = f"{op}-{next(self._ids)}"
-        reply = self._channel.call(
-            (op, req_id) + args, req_id, timeout_s=self._timeout_s
-        )
+        reply = self._channel.result(pending, timeout_s=self._timeout_s)
         if reply[1] == "ok":
             return reply[2]
         # Rehydrate the store's own error taxonomy: a server-side
@@ -652,8 +668,11 @@ class _StoreClient:
         ):
             error_cls = store_lib.ArtifactStoreError
         raise error_cls(
-            f"remote store {op} failed: {reply[2]}: {reply[3]}"
+            f"remote store failed: {reply[2]}: {reply[3]}"
         )
+
+    def call(self, op: str, *args):
+        return self.result(self.submit(op, *args))
 
     def close(self) -> None:
         self._channel.close()
@@ -697,6 +716,10 @@ def mirror_policy(
         chain.reverse()  # bases first
 
         fetched = reused = nbytes = 0
+        # Want-list across the whole chain (dedup preserving order: a
+        # base and its dependent may share blobs).
+        want: List[Tuple[str, str]] = []
+        want_seen = set()
         for pid, manifest in chain:
             shas = [
                 entry["blob"] for entry in manifest["files"].values()
@@ -705,19 +728,36 @@ def mirror_policy(
             if payload_blob:
                 shas.append(payload_blob)
             for sha in shas:
+                if sha in want_seen:
+                    continue
+                want_seen.add(sha)
                 if os.path.exists(dest_store._blob_path(sha)):
                     reused += 1
-                    continue
-                data = client.call("blob", sha)
-                if hashlib.sha256(data).hexdigest() != sha:
-                    raise ArtifactCorrupt(
-                        f"mirror of {pid!r}: blob sha256-{sha[:12]}… "
-                        "failed its content hash on receipt — refusing "
-                        "the transfer"
-                    )
-                dest_store._write_blob(data)
-                fetched += 1
-                nbytes += len(data)
+                else:
+                    want.append((pid, sha))
+        # Windowed pipeline: keep up to MIRROR_WINDOW blob requests in
+        # flight on the one connection (the channel multiplexes them by
+        # req_id), landing each oldest-first — a WAN round trip is paid
+        # once per window, not once per blob. Each blob is still
+        # sha256-re-hashed before it touches disk.
+        window: List[Tuple[str, str, Any]] = []
+        idx = 0
+        while idx < len(want) or window:
+            while idx < len(want) and len(window) < MIRROR_WINDOW:
+                pid, sha = want[idx]
+                window.append((pid, sha, client.submit("blob", sha)))
+                idx += 1
+            pid, sha, pending = window.pop(0)
+            data = client.result(pending)
+            if hashlib.sha256(data).hexdigest() != sha:
+                raise ArtifactCorrupt(
+                    f"mirror of {pid!r}: blob sha256-{sha[:12]}… "
+                    "failed its content hash on receipt — refusing "
+                    "the transfer"
+                )
+            dest_store._write_blob(data)
+            fetched += 1
+            nbytes += len(data)
         # Blobs are all down; NOW the manifests, bases first.
         for pid, manifest in chain:
             if dest_store.has(pid):

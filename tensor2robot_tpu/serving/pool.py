@@ -294,6 +294,11 @@ class RemoteReplicaPool:
         self._incarnations: Dict[int, int] = {}
         self._links: Dict[int, ReplicaLink] = {}
         self._procs: List[RemoteProcessHandle] = []
+        # index -> (spec object, path): the ReplicaSpec is immutable
+        # per index, so it is pickled ONCE and every respawn
+        # incarnation reuses the path instead of re-serializing a
+        # model-sized spec on the respawn hot path.
+        self._specs: Dict[int, Tuple[ReplicaSpec, str]] = {}
 
     def spawn(
         self, index: int, spec: ReplicaSpec
@@ -309,9 +314,22 @@ class RemoteReplicaPool:
             stale.close()
         rdir = replica_root(self.root, index)
         os.makedirs(rdir, exist_ok=True)
-        spec_path = os.path.join(rdir, f"spec.{incarnation}.pkl")
-        with open(spec_path, "wb") as f:
-            pickle.dump(spec, f, protocol=pickle.HIGHEST_PROTOCOL)
+        spec_path = os.path.join(rdir, "spec.pkl")
+        with self._lock:
+            cached = self._specs.get(index)
+        if (
+            cached is None
+            or cached[0] is not spec
+            or not os.path.exists(spec_path)
+        ):
+            # tmp+replace: a child booting off a prior incarnation's
+            # path can never read a torn spec mid-write.
+            tmp = f"{spec_path}.tmp.{os.getpid()}"
+            with open(tmp, "wb") as f:
+                pickle.dump(spec, f, protocol=pickle.HIGHEST_PROTOCOL)
+            os.replace(tmp, spec_path)
+            with self._lock:
+                self._specs[index] = (spec, spec_path)
         args = [
             sys.executable, "-m", "tensor2robot_tpu.serving.fabric",
             "--replica",

@@ -57,6 +57,7 @@ from typing import Any, Dict, List, Mapping, Optional, Sequence, Set, Tuple
 import numpy as np
 
 from tensor2robot_tpu import flags as t2r_flags
+from tensor2robot_tpu.net import codec as wire_codec
 from tensor2robot_tpu.serving import transport
 from tensor2robot_tpu.serving.metrics import percentile
 from tensor2robot_tpu.serving.replica import ReplicaSpec, replica_main
@@ -693,7 +694,17 @@ class FleetRouter:
         if not hedge:
             request.dispatches += 1
         attempt = request.dispatches + (1 if hedge or request.hedged else 0)
-        payload = self._codec.encode(request.features)
+        if self._pool is not None and wire_codec.wire_mode() == "spec":
+            # Socket fabric on the spec wire: ship the features dict
+            # itself and let the frame codec segment the arrays —
+            # pickling them into an inline blob here would re-bury the
+            # payload the zero-copy wire exists to expose.
+            payload = (
+                "raw",
+                {k: np.asarray(v) for k, v in request.features.items()},
+            )
+        else:
+            payload = self._codec.encode(request.features)
         key = (request.id, attempt)
         replica.inflight.add(key)
         request.live.add((attempt, replica.index))
@@ -1386,6 +1397,12 @@ class FleetRouter:
             ]
         snap["transport"] = self._transport_mode
         snap["zone"] = self._zone
+        # Router-process wire accounting (codec/stage timings, segment
+        # byte classes, receive-pool audit). Meaningful on the socket
+        # fabric; ~empty counters on the mp transport.
+        snap["wire"] = wire_codec.wire_snapshot()
+        snap["wire"]["codec"] = wire_codec.wire_mode()
+        snap["wire"]["quant"] = wire_codec.quant_mode()
         snap["policy"] = {
             "max_inflight": self._max_inflight,
             "hedge_ms": self._hedge_s * 1e3,

@@ -159,6 +159,9 @@ class RequestCodec:
       ("inline", crc, blob)                       blob = pickle(features)
       ("shm", slot, entries, crc, blob)           entries =
             [(key, dtype_str, shape, offset)]; blob = pickle(small items)
+      ("raw", features)                           spec-wire socket path:
+            the dict rides the frame codec's own segments, no inner
+            pickle/CRC (net/codec.py checksums the whole frame)
     """
 
     def __init__(
@@ -277,6 +280,16 @@ def decode_request(
         features = unpack(crc, blob)
         if not isinstance(features, dict):
             raise IntegrityError("inline request decoded to a non-dict")
+        return features
+    if kind == "raw":
+        # Spec-wire socket path: the arrays were already validated and
+        # materialized by the frame codec (adler32 body + crc32
+        # structural region + per-segment spec checks); a second
+        # pickle/CRC here is exactly the double pass the spec codec
+        # removes. Structural validation still applies.
+        _, features = payload
+        if not isinstance(features, dict):
+            raise IntegrityError("raw request decoded to a non-dict")
         return features
     if kind != "shm":
         raise IntegrityError(f"unknown request payload kind {payload[0]!r}")

@@ -275,7 +275,7 @@ def test_bench_cli_lists_legs():
     assert proc.returncode == 0
     for leg in (
         "data", "auc", "predict", "bc", "stream", "pipe", "serve", "comms",
-        "fleet", "rl", "aot", "plan", "policies", "fabric",
+        "fleet", "rl", "aot", "plan", "policies", "fabric", "wire",
     ):
         assert leg in proc.stdout
     proc = subprocess.run(
@@ -321,6 +321,17 @@ def test_bench_cli_lists_legs():
         assert option in proc.stdout
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO_ROOT, "bench.py"),
+         "wire", "--help"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0
+    for option in (
+        "--frames", "--trials", "--warmup", "--image-hw", "--state-dim",
+        "--speedup-min", "--quant", "--pipeline-requests", "--out",
+    ):
+        assert option in proc.stdout
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "bench.py"),
          "comms", "--help"],
         capture_output=True, text=True, timeout=60,
     )
@@ -350,6 +361,50 @@ def test_bench_cli_lists_legs():
         capture_output=True, text=True, timeout=60,
     )
     assert proc.returncode != 0
+
+
+def test_bench_wire_contract(tmp_path):
+    """The zero-copy wire codec leg at toy scale, tier-1: one JSON
+    line + the --out artifact, every acceptance gate green (bitwise
+    replies across codecs, quant parity, zero steady-state receive
+    allocs, all corruption variants typed-rejected, pipelining
+    overlap), and the observability surface present. The reduced image
+    gets a reduced speedup floor — the full camera-sized >= 3x gate is
+    the round-end `bench.py wire` run."""
+    out = tmp_path / "BENCH_WIRE_smoke.json"
+    payload = _run_bench(
+        "wire", "--frames", "30", "--trials", "3", "--warmup", "8",
+        "--image-hw", "224", "--state-dim", "1024",
+        "--pipeline-requests", "12", "--speedup-min", "1.2",
+        "--out", str(out),
+    )
+    assert payload["metric"] == "wire_codec_spec_vs_pickle_reqs_per_sec"
+    assert "error" not in payload
+    assert all(payload["gates"].values()), payload
+    assert payload["ok"] is True
+    assert payload["value"] >= 1.2
+    assert payload["cpu_proxy"] is True
+    detail = payload["detail"]
+    assert detail["spec_reqs_per_sec"] > detail["pickle_reqs_per_sec"] > 0
+    assert detail["quant_leg"]["rel_linf"] <= detail["quant_leg"][
+        "parity_gate"
+    ]
+    audit = detail["pool_audit"]
+    assert (
+        audit["after_steady_window"]["allocs"]
+        == audit["before_steady_window"]["allocs"]
+    )
+    variants = detail["corruption_variants"]
+    assert variants["typed_rejected"] == variants["total"] > 0
+    # Per-stage timings + per-segment-class byte counters surfaced.
+    stats = detail["wire_stats"]
+    for stage in ("serialize_ms", "crc_ms", "send_ms", "recv_ms",
+                  "deserialize_ms"):
+        assert stage in stats["timings_ms"]
+    for counter in ("frames_spec_tx", "frames_pickle_tx", "bytes_raw",
+                    "bytes_skeleton", "bytes_quant", "bytes_pickle"):
+        assert counter in stats["counters"]
+    assert json.loads(out.read_text())["gates"] == payload["gates"]
 
 
 @pytest.mark.slow

@@ -279,6 +279,34 @@ class TestLintsCatch:
         assert spec.choices == ("static", "dynamic")
         assert spec.default == "static"
 
+    def test_wire_flags_covered_by_registry_lint(self):
+        """The round-22 wire-codec gates ride the same rails: raw
+        environ reads are env-undeclared, wrong-kind getter reads are
+        env-kind-mismatch, the declared enum spellings are clean, and
+        the choice sets pin codec + quant-mode spellings."""
+        for name in ("T2R_WIRE", "T2R_WIRE_QUANT"):
+            assert "env-undeclared" in self._rules(
+                f"import os\nx = os.environ.get({name!r})\n"
+            )
+            assert "env-kind-mismatch" in self._rules(
+                "from tensor2robot_tpu import flags\n"
+                f"x = flags.get_int({name!r})\n"
+            )
+        clean = self._rules(
+            "from tensor2robot_tpu import flags\n"
+            "a = flags.get_enum('T2R_WIRE')\n"
+            "b = flags.get_enum('T2R_WIRE_QUANT')\n"
+        )
+        assert "env-kind-mismatch" not in clean
+        assert "env-unknown-flag" not in clean
+        wire = flags.get_flag("T2R_WIRE")
+        assert wire.choices == ("pickle", "spec")
+        assert wire.default == "pickle"
+        quant = flags.get_flag("T2R_WIRE_QUANT")
+        assert quant.default == "none"
+        for mode in ("fp16", "int8", "fp8_e4m3", "fp8_e5m2"):
+            assert mode in quant.choices
+
     def test_plan_search_flags_covered_by_registry_lint(self):
         """The round-19 measured-search gates ride the same rails: the
         cache-dir/measure-mode strings and the step-count int are

@@ -1,0 +1,689 @@
+"""Spec-native wire codec: zero-copy frames, pooled receive, quant.
+
+The contracts under test (ISSUE 20):
+
+* **Byte compatibility.** `T2R_WIRE=pickle` (the default) produces
+  frames bit-identical to the pre-spec wire — header struct + pickle
+  blob + CRC32, nothing moved. The spec codec is opt-in per SENDER and
+  auto-detected per frame by the receiver, so mixed-codec peers
+  interoperate on one stream.
+* **Hostile bytes.** Every corruption family from the PR 3 corpus
+  generator (tensor2robot_tpu/analysis/corpus.py), applied to a spec
+  frame, is rejected with a typed TransportError — never a partial
+  decode, never a hang, never an untyped crash.
+* **Zero steady-state allocation.** The receive path runs out of the
+  codec's buffer pool: after warmup, the pool's `allocs` counter is
+  flat while frames keep flowing (the audit `bench.py wire` gates on).
+* **Quant parity.** `T2R_WIRE_QUANT` payloads are bit-compatible with
+  the BlockScaledCollective `{'q','s'}` wire format and round-trip
+  within the declared per-mode rel-Linf gate; ineligible or
+  gate-missing arrays fall back to dense (bitwise) transparently.
+* **Pipelining.** PipelinedChannel multiplexes in-flight requests by
+  req_id on one connection, completing them out of order.
+"""
+
+import glob
+import os
+import pickle
+import signal
+import socket
+import struct
+import threading
+import time
+import zlib
+
+import numpy as np
+import pytest
+
+from tensor2robot_tpu.analysis import corpus
+from tensor2robot_tpu.net import codec, frames
+from tensor2robot_tpu.serving import (
+    FleetRouter,
+    ReplicaSpec,
+    mock_server_factory,
+)
+from tensor2robot_tpu.serving import transport as serving_transport
+from tensor2robot_tpu.testing import chaos
+
+
+@pytest.fixture(autouse=True)
+def _chaos_clean():
+    chaos.configure(None)
+    yield
+    chaos.configure(None)
+
+
+def _pipe():
+    a, b = socket.socketpair()
+    a.settimeout(10.0)
+    b.settimeout(10.0)
+    return a, b
+
+
+def _roundtrip(message):
+    """One message through write_frame/read_frame on a socketpair; the
+    send runs on its own thread because a large frame overflows the
+    socketpair buffer before the reader drains it."""
+    a, b = _pipe()
+    errors = []
+
+    def send():
+        try:
+            frames.write_frame(a, message)
+        except Exception as err:  # noqa: BLE001 - reraised below
+            errors.append(err)
+
+    try:
+        thread = threading.Thread(target=send, daemon=True)
+        thread.start()
+        got = frames.read_frame(b, deadline=time.monotonic() + 10)
+        thread.join(5)
+        assert not errors, errors
+        return got
+    finally:
+        a.close()
+        b.close()
+
+
+def _serving_message(n=96):
+    return (
+        "req",
+        7,
+        1,
+        None,
+        (
+            "raw",
+            {
+                "image": np.arange(n * n * 3, dtype=np.uint8).reshape(
+                    n, n, 3
+                ),
+                "state": np.linspace(-2, 2, 64).astype(np.float32),
+                "blob": b"\x00\x01payload" * 64,
+                "note": "small-inline",
+                "step": 12345,
+            },
+        ),
+    )
+
+
+def _assert_message_equal(want, got):
+    assert type(want) is type(got)
+    w_feats, g_feats = want[4][1], got[4][1]
+    assert set(w_feats) == set(g_feats)
+    for key, value in w_feats.items():
+        if isinstance(value, np.ndarray):
+            assert g_feats[key].dtype == value.dtype
+            np.testing.assert_array_equal(g_feats[key], value, err_msg=key)
+        else:
+            assert g_feats[key] == value, key
+    assert want[:4] == got[:4]
+
+
+# -- roundtrip + interop -------------------------------------------------------
+
+
+class TestSpecRoundtrip:
+    def test_serving_shaped_message(self, monkeypatch):
+        monkeypatch.setenv("T2R_WIRE", "spec")
+        message = _serving_message()
+        _assert_message_equal(message, _roundtrip(message))
+
+    def test_mixed_codec_peers_interoperate(self, monkeypatch):
+        """The receiver detects the codec per frame from the magic: a
+        pickle frame and a spec frame on the same stream both decode,
+        regardless of the RECEIVER's own T2R_WIRE."""
+        message = _serving_message(n=16)
+        a, b = _pipe()
+        try:
+            monkeypatch.setenv("T2R_WIRE", "pickle")
+            frames.write_frame(a, message)
+            monkeypatch.setenv("T2R_WIRE", "spec")
+            frames.write_frame(a, message)
+            monkeypatch.setenv("T2R_WIRE", "pickle")
+            deadline = time.monotonic() + 10
+            _assert_message_equal(message, frames.read_frame(b, deadline))
+            _assert_message_equal(message, frames.read_frame(b, deadline))
+        finally:
+            a.close()
+            b.close()
+
+    def test_noncontiguous_and_fortran_arrays(self, monkeypatch):
+        monkeypatch.setenv("T2R_WIRE", "spec")
+        base = np.arange(4096, dtype=np.float32).reshape(64, 64)
+        message = (
+            "req", 1, 1, None,
+            ("raw", {"strided": base[::2, ::2], "fortran": np.asfortranarray(base)}),
+        )
+        _assert_message_equal(message, _roundtrip(message))
+
+    def test_small_and_object_leaves_stay_in_skeleton(self, monkeypatch):
+        """Leaves below SEGMENT_MIN_BYTES and object-dtype arrays ride
+        the pickled skeleton (a 200-float segment table entry would
+        cost more than it saves) — and still round-trip exactly."""
+        monkeypatch.setenv("T2R_WIRE", "spec")
+        tiny = np.arange(8, dtype=np.float32)
+        weird = np.array([b"a", None, 3], dtype=object)
+        buffers, _ = codec.encode_spec_frame(("m", tiny, weird))
+        prefix = codec.SPEC_PREFIX.unpack(bytes(buffers[0]))
+        assert prefix[4] == 0  # nsegs: nothing was large enough
+        got = _roundtrip(("m", tiny, weird))
+        np.testing.assert_array_equal(got[1], tiny)
+        assert list(got[2]) == [b"a", None, 3]
+
+    def test_oversize_refused_at_encode(self):
+        huge = np.zeros(8 << 20, dtype=np.uint8)
+        with pytest.raises(codec.CodecError):
+            codec.encode_spec_frame(("m", huge), max_bytes=1 << 20)
+
+    def test_replay_episode_bytes_ride_as_raw_segments(self):
+        """The replay fabric's already-serialized record bytes are NOT
+        pickled a second time into the frame: each record rides as its
+        own raw segment, and the pickled skeleton stays small."""
+        records = [b"r%d" % i * 400 for i in range(4)]
+        message = ("client", 3, "append", (records, 1, None, 0, "uid"))
+        buffers, _ = codec.encode_spec_frame(message)
+        prefix = codec.SPEC_PREFIX.unpack(bytes(buffers[0]))
+        assert prefix[4] == len(records)  # nsegs
+        assert prefix[5] < 400  # skeleton_len: no record bytes inside
+        raw = {bytes(buf) for buf in buffers[1:]}
+        for record in records:
+            assert record in raw
+        frame = codec.encode_spec_frame_bytes(message)
+        a, b = _pipe()
+        try:
+            a.sendall(frame)
+            got = frames.read_frame(b, deadline=time.monotonic() + 10)
+        finally:
+            a.close()
+            b.close()
+        assert got == message
+
+
+# -- byte compatibility pin ----------------------------------------------------
+
+
+class TestPickleWireByteCompat:
+    def test_frames_bit_identical_to_pre_spec_wire(self, monkeypatch):
+        """THE compatibility pin: with T2R_WIRE=pickle (and with the
+        flag unset), the bytes on the socket are exactly the pre-PR
+        format — FRAME_HEADER(magic, len, crc32) + pickle blob."""
+        message = ("req", 9, ("nested", [1, 2.5]), b"payload" * 50)
+        blob = pickle.dumps(message, protocol=pickle.HIGHEST_PROTOCOL)
+        expected = frames.FRAME_HEADER.pack(
+            frames.MAGIC, len(blob), zlib.crc32(blob)
+        ) + blob
+        assert frames.encode_frame(message) == expected
+        for setting in (None, "pickle"):
+            if setting is None:
+                monkeypatch.delenv("T2R_WIRE", raising=False)
+            else:
+                monkeypatch.setenv("T2R_WIRE", setting)
+            a, b = _pipe()
+            try:
+                assert frames.write_frame(a, message)
+                a.shutdown(socket.SHUT_WR)
+                got = b.recv(1 << 20)
+                while True:
+                    more = b.recv(1 << 20)
+                    if not more:
+                        break
+                    got += more
+            finally:
+                a.close()
+                b.close()
+            assert got == expected
+
+
+# -- corruption corpus over the spec wire --------------------------------------
+
+
+_FUZZ_MESSAGE = (
+    "req", 2, 1, None,
+    ("raw", {
+        "image": np.arange(24 * 24 * 3, dtype=np.uint8).reshape(24, 24, 3),
+        "state": np.linspace(0, 1, 128).astype(np.float32),
+    }),
+)
+_SPEC_HEADER_SIZE = codec.SPEC_PREFIX.size
+
+
+def _spec_frame():
+    return codec.encode_spec_frame_bytes(_FUZZ_MESSAGE)
+
+
+class TestSpecWireFuzz:
+    def test_pristine_frame_decodes(self):
+        a, b = _pipe()
+        try:
+            a.sendall(_spec_frame())
+            got = frames.read_frame(b, deadline=time.monotonic() + 10)
+        finally:
+            a.close()
+            b.close()
+        np.testing.assert_array_equal(
+            got[4][1]["image"], _FUZZ_MESSAGE[4][1]["image"]
+        )
+
+    @pytest.mark.parametrize("name", sorted(
+        corpus.corrupt_frame_variants(
+            codec.encode_spec_frame_bytes(_FUZZ_MESSAGE),
+            header_size=codec.SPEC_PREFIX.size,
+        )
+    ))
+    def test_corpus_variant_rejected_never_partially_decoded(self, name):
+        """Every corruption family from the PR 3 generator against a
+        SPEC frame: structural truncations, seeded bitflips (prefix,
+        table, skeleton, raw segments, pad — the two-tier adler32+crc32
+        integrity covers all of them), forged lengths (bound-checked
+        BEFORE the pool allocates), and bad magic. The reader raises a
+        typed TransportError; it never returns a partial object."""
+        variant = corpus.corrupt_frame_variants(
+            _spec_frame(), header_size=_SPEC_HEADER_SIZE
+        )[name]
+        a, b = _pipe()
+        try:
+            a.sendall(variant)
+            a.close()  # EOF after the corrupt bytes: no resync possible
+            with pytest.raises(frames.TransportError):
+                frames.read_frame(b, deadline=time.monotonic() + 5)
+        finally:
+            b.close()
+
+    def test_forged_length_bounds_before_pool_allocation(self):
+        frame = bytearray(_spec_frame())
+        frame[4:8] = struct.pack("<I", frames.MAX_FRAME_BYTES + 1)
+        before = codec.POOL.snapshot()
+        a, b = _pipe()
+        try:
+            a.sendall(bytes(frame))
+            with pytest.raises(frames.BadFrame):
+                frames.read_frame(b, deadline=time.monotonic() + 5)
+        finally:
+            a.close()
+            b.close()
+        after = codec.POOL.snapshot()
+        assert after["allocs"] == before["allocs"]
+
+    def test_forged_segment_count_rejected(self):
+        frame = bytearray(_spec_frame())
+        # nsegs is the 5th u32 of the prefix; forge it past MAX_SEGMENTS.
+        frame[16:20] = struct.pack("<I", codec.MAX_SEGMENTS + 1)
+        a, b = _pipe()
+        try:
+            a.sendall(bytes(frame))
+            with pytest.raises(frames.BadFrame):
+                frames.read_frame(b, deadline=time.monotonic() + 5)
+        finally:
+            a.close()
+            b.close()
+
+
+# -- chaos sites drive the spec codec unchanged --------------------------------
+
+
+class TestSpecChaosSites:
+    def test_net_send_corrupt_is_rejected_and_arrays_untouched(
+        self, monkeypatch
+    ):
+        monkeypatch.setenv("T2R_WIRE", "spec")
+        state = np.linspace(0, 1, 512).astype(np.float32)
+        pristine = state.copy()
+        message = ("req", 1, 1, None, ("raw", {"state": state}))
+        chaos.configure("net_send:1:corrupt")
+        try:
+            a, b = _pipe()
+            try:
+                assert frames.write_frame(a, message)
+                assert "net_send:1:corrupt" in chaos.fired()
+                with pytest.raises(frames.TransportError):
+                    frames.read_frame(b, deadline=time.monotonic() + 5)
+            finally:
+                a.close()
+                b.close()
+        finally:
+            chaos.configure(None)
+        # The corrupt action flipped a byte in a COPY of the frame's
+        # small structural buffer — never in the caller's arrays.
+        np.testing.assert_array_equal(state, pristine)
+
+    def test_net_send_drop_discards_then_recovers(self, monkeypatch):
+        monkeypatch.setenv("T2R_WIRE", "spec")
+        message = _serving_message(n=16)
+        chaos.configure("net_send:1:drop")
+        try:
+            a, b = _pipe()
+            try:
+                assert frames.write_frame(a, message) is False
+                chaos.configure(None)
+                assert frames.write_frame(a, message)
+                got = frames.read_frame(b, deadline=time.monotonic() + 10)
+            finally:
+                a.close()
+                b.close()
+        finally:
+            chaos.configure(None)
+        _assert_message_equal(message, got)
+
+
+# -- buffer pool: zero steady-state allocation ---------------------------------
+
+
+class TestBufferPoolAudit:
+    def test_steady_state_receive_allocates_nothing(self, monkeypatch):
+        """After warmup, `allocs` is FLAT while frames keep flowing:
+        every receive lands in a pooled buffer whose lease is returned
+        when the decoded views die. This is the audit bench.py wire
+        gates on."""
+        monkeypatch.setenv("T2R_WIRE", "spec")
+        message = (
+            "req", 1, 1, None,
+            ("raw", {
+                "image": np.zeros((128, 128, 3), np.uint8),
+                "state": np.zeros(256, np.float32),
+            }),
+        )
+        warmup_allocs = None
+        reuses_at_warmup = None
+        for i in range(40):
+            got = _roundtrip(message)
+            assert got[4][1]["image"].shape == (128, 128, 3)
+            del got  # drop the views -> lease returns to the pool
+            if i == 7:
+                snap = codec.POOL.snapshot()
+                warmup_allocs = snap["allocs"]
+                reuses_at_warmup = snap["reuses"]
+        snap = codec.POOL.snapshot()
+        assert snap["allocs"] == warmup_allocs, (
+            f"receive path allocated after warmup: {snap}"
+        )
+        assert snap["reuses"] >= reuses_at_warmup + 30
+
+    def test_decoded_views_alias_the_pooled_buffer(self, monkeypatch):
+        """Zero-copy means the arrays the handler sees ARE views into
+        the receive buffer (np.frombuffer, no materializing copy)."""
+        monkeypatch.setenv("T2R_WIRE", "spec")
+        got = _roundtrip(_serving_message())
+        image = got[4][1]["image"]
+        assert not image.flags.owndata
+        assert isinstance(image.base, memoryview) or image.base is not None
+
+
+# -- quantized observation payloads --------------------------------------------
+
+
+class TestQuantPayloads:
+    def test_quant_wire_format_matches_collectives_bitwise(self):
+        """The {'q','s'} payload is THE BlockScaledCollective format:
+        q values and scales bit-identical to the jax registry's encode,
+        and the numpy decode bit-identical to its decode."""
+        collectives = pytest.importorskip(
+            "tensor2robot_tpu.parallel.collectives"
+        )
+        rng = np.random.RandomState(0)
+        x = (rng.randn(2048) * 3.0).astype(np.float32)
+        for mode in ("int8", "fp16"):
+            q, s = codec.quant_encode_array(x, mode, 512)
+            coll = collectives.get_collective(mode, 512)
+            payload = coll.encode(x)
+            np.testing.assert_array_equal(
+                np.asarray(q), np.asarray(payload["q"]).reshape(-1, 512)
+            )
+            np.testing.assert_array_equal(s, np.asarray(payload["s"]))
+            mine = codec.quant_decode_array(q, s, x.shape, np.float32)
+            theirs = np.asarray(
+                coll.decode({"q": np.asarray(q).reshape(x.shape), "s": s})
+            )
+            np.testing.assert_array_equal(mine, theirs)
+
+    def test_parity_gates_hold_per_mode(self):
+        rng = np.random.RandomState(7)
+        x = (rng.randn(4096) * 10.0).astype(np.float32)
+        for mode in ("int8", "fp16"):
+            q, s = codec.quant_encode_array(x, mode, 512)
+            decoded = codec.quant_decode_array(q, s, x.shape, np.float32)
+            rel = np.max(np.abs(decoded - x)) / np.max(np.abs(x))
+            assert rel <= codec.QUANT_PARITY_REL_LINF[mode], (mode, rel)
+
+    def test_wire_quant_floats_gated_uint8_untouched(self, monkeypatch):
+        monkeypatch.setenv("T2R_WIRE", "spec")
+        monkeypatch.setenv("T2R_WIRE_QUANT", "int8")
+        rng = np.random.RandomState(3)
+        image = rng.randint(0, 256, (64, 64, 3), dtype=np.uint8)
+        state = (rng.randn(2048) * 2.0).astype(np.float32)
+        message = ("req", 1, 1, None, ("raw", {"image": image, "state": state}))
+        got = _roundtrip(message)
+        feats = got[4][1]
+        np.testing.assert_array_equal(feats["image"], image)  # bitwise
+        assert feats["state"].dtype == np.float32
+        rel = np.max(np.abs(feats["state"] - state)) / np.max(np.abs(state))
+        assert rel <= codec.QUANT_PARITY_REL_LINF["int8"]
+
+    def test_gate_miss_falls_back_to_dense_bitwise(self, monkeypatch):
+        """An array quantization cannot hold (here: an inf poisons the
+        round-trip parity check) rides dense — bitwise — instead of
+        silently wrong, and the fallback is counted."""
+        x = np.linspace(0, 1, 1024).astype(np.float32)
+        x[17] = np.inf
+        assert codec.quant_encode_array(x, "int8", 512) is None
+        monkeypatch.setenv("T2R_WIRE", "spec")
+        monkeypatch.setenv("T2R_WIRE_QUANT", "int8")
+        before = codec.wire_snapshot()["counters"].get(
+            "quant_parity_fallbacks", 0
+        )
+        message = ("req", 1, 1, None, ("raw", {"state": x}))
+        got = _roundtrip(message)
+        np.testing.assert_array_equal(got[4][1]["state"], x)
+        after = codec.wire_snapshot()["counters"].get(
+            "quant_parity_fallbacks", 0
+        )
+        assert after == before + 1
+
+
+# -- pipelined channel ---------------------------------------------------------
+
+
+def _echo_server(tmp_path, delay_by_req=None, duplex=True):
+    """Duplex FrameServer that answers (req_id, 'ok', payload) on its
+    own schedule — later requests may answer FIRST, which is exactly
+    what the pending-map correlation must survive."""
+    def handler(request, send):
+        req_id, payload = request
+        def reply():
+            if delay_by_req:
+                time.sleep(delay_by_req(req_id))
+            try:
+                send((req_id, "ok", payload))
+            except frames.TransportError:
+                pass  # client abandoned the channel (timeout test)
+        threading.Thread(target=reply, daemon=True).start()
+
+    server = frames.FrameServer(handler, duplex=True).start()
+    frames.publish_address(str(tmp_path), server.port, incarnation=1)
+    return server
+
+
+class TestPipelinedChannel:
+    def test_out_of_order_replies_correlate(self, tmp_path):
+        server = _echo_server(
+            tmp_path, delay_by_req=lambda r: 0.15 if r == 0 else 0.0
+        )
+        channel = frames.PipelinedChannel(str(tmp_path))
+        try:
+            pendings = [
+                channel.submit((i, f"payload-{i}"), i) for i in range(8)
+            ]
+            t0 = time.monotonic()
+            replies = [channel.result(p, timeout_s=10) for p in pendings]
+            elapsed = time.monotonic() - t0
+            for i, reply in enumerate(replies):
+                assert reply == (i, "ok", f"payload-{i}")
+            # 8 lockstep round trips would serialize behind the slow
+            # req 0; pipelined, everything overlaps its delay.
+            assert elapsed < 1.0
+        finally:
+            channel.close()
+            server.stop()
+
+    def test_timeout_abandons_one_request_not_the_channel(self, tmp_path):
+        server = _echo_server(
+            tmp_path,
+            delay_by_req=lambda r: 30.0 if r == "black-hole" else 0.0,
+        )
+        channel = frames.PipelinedChannel(str(tmp_path))
+        try:
+            stuck = channel.submit(("black-hole", "x"), "black-hole")
+            with pytest.raises(frames.TransportError):
+                channel.result(stuck, timeout_s=0.2)
+            assert channel.call(("live", "y"), "live", timeout_s=10) == (
+                "live", "ok", "y"
+            )
+        finally:
+            channel.close()
+            server.stop()
+
+    def test_duplicate_in_flight_req_id_refused(self, tmp_path):
+        server = _echo_server(
+            tmp_path, delay_by_req=lambda r: 0.3
+        )
+        channel = frames.PipelinedChannel(str(tmp_path))
+        try:
+            pending = channel.submit(("a", 1), "a")
+            with pytest.raises(frames.TransportError):
+                channel.submit(("a", 2), "a")
+            assert channel.result(pending, timeout_s=10)[1] == "ok"
+        finally:
+            channel.close()
+            server.stop()
+
+
+# -- raw request payloads decode through the serving transport -----------------
+
+
+class TestRawRequestPayload:
+    def test_decode_request_passes_raw_dict_through(self):
+        feats = {"x": np.arange(4, dtype=np.float32)}
+        got = serving_transport.decode_request(
+            ("raw", feats), None, serving_transport.ReplicaSlotCache()
+        )
+        assert got is feats
+
+    def test_raw_non_dict_is_typed_integrity_error(self):
+        with pytest.raises(serving_transport.IntegrityError):
+            serving_transport.decode_request(
+                ("raw", [1, 2]), None, serving_transport.ReplicaSlotCache()
+            )
+
+
+# -- live pool: cross-codec bitwise replies + spec-pickled-once ----------------
+
+
+def _wait(predicate, timeout=60.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _socket_router(fabric_root, wire=None, num=1):
+    env = {"T2R_WIRE": wire} if wire else {}
+    spec = ReplicaSpec(
+        factory=mock_server_factory,
+        factory_kwargs={"service_ms": 0.5, "version": 1},
+        env=env,
+    )
+    router = FleetRouter(
+        spec, num,
+        transport_mode="socket", fabric_root=str(fabric_root),
+        probe_interval_ms=50.0, backoff_ms=5.0,
+    )
+    return router.start(timeout_s=90.0)
+
+
+def _pool_features():
+    rng = np.random.RandomState(11)
+    return {
+        "image": rng.randint(0, 256, (96, 96, 3), dtype=np.uint8),
+        "state": (rng.randn(2048) * 1.7).astype(np.float32),
+    }
+
+
+class TestCrossCodecPoolPin:
+    def test_replies_bitwise_identical_across_codecs(
+        self, tmp_path, monkeypatch
+    ):
+        """THE cross-codec pin: the same request through a live
+        socket-mode pool yields bit-identical outputs whether the
+        request/reply frames ride the pickle wire, the spec wire, or
+        the local mp transport — the codec moves bytes, never values."""
+        features = _pool_features()
+        outputs = {}
+        for wire in ("pickle", "spec"):
+            monkeypatch.setenv("T2R_WIRE", wire)
+            router = _socket_router(tmp_path / wire, wire=wire)
+            try:
+                response = router.submit(
+                    features, deadline_ms=30000
+                ).result(60)
+                outputs[wire] = response.outputs
+            finally:
+                router.stop()
+        monkeypatch.delenv("T2R_WIRE", raising=False)
+        local = FleetRouter(
+            ReplicaSpec(
+                factory=mock_server_factory,
+                factory_kwargs={"service_ms": 0.5, "version": 1},
+            ),
+            1,
+            probe_interval_ms=50.0, backoff_ms=5.0,
+        ).start(timeout_s=90.0)
+        try:
+            outputs["local"] = local.submit(
+                features, deadline_ms=30000
+            ).result(60).outputs
+        finally:
+            local.stop()
+        want = outputs["pickle"]
+        for wire in ("spec", "local"):
+            got = outputs[wire]
+            assert set(got) == set(want)
+            for key in want:
+                assert np.asarray(got[key]).tobytes() == np.asarray(
+                    want[key]
+                ).tobytes(), (wire, key)
+
+    def test_replica_spec_pickled_once_and_path_survives_respawn(
+        self, tmp_path
+    ):
+        """Satellite pin: the replica spec is serialized ONCE per
+        replica index (`spec.pkl`, no per-incarnation copies), and a
+        respawn reuses the same file instead of re-pickling."""
+        router = _socket_router(tmp_path, wire=None)
+        try:
+            assert _wait(
+                lambda: all(s == "up" for s in router.replica_states())
+            ), router.replica_states()
+            spec_files = glob.glob(
+                str(tmp_path / "**" / "spec*.pkl"), recursive=True
+            )
+            assert len(spec_files) == 1, spec_files
+            assert os.path.basename(spec_files[0]) == "spec.pkl"
+            stat = os.stat(spec_files[0])
+            old_pid = router.snapshot()["replicas"][0]["host"]["pid"]
+            os.kill(old_pid, signal.SIGKILL)
+
+            def _respawned():
+                host = router.snapshot()["replicas"][0].get("host")
+                return bool(host) and host["pid"] != old_pid
+
+            assert _wait(_respawned), "replica never respawned"
+            assert glob.glob(
+                str(tmp_path / "**" / "spec*.pkl"), recursive=True
+            ) == spec_files
+            after = os.stat(spec_files[0])
+            assert (after.st_mtime_ns, after.st_ino) == (
+                stat.st_mtime_ns, stat.st_ino
+            ), "respawn re-pickled the spec"
+        finally:
+            router.stop()

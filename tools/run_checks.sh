@@ -219,6 +219,21 @@ if [ "$TESTS" = 1 ]; then
     status=1
   fi
 
+  echo "== wire: zero-copy spec codec + pooled receive suite (tier-1) =="
+  # Round-22 gates, attributed by name: the spec-native frame codec
+  # (scatter-gather segments, adler32 body + crc32 structural
+  # two-tier integrity), the T2R_WIRE=pickle bit-compat pin, every
+  # corpus corruption family typed against a SPEC frame, the
+  # zero-steady-state-allocation receive-pool audit, quantized
+  # observation payloads in the BlockScaledCollective q/s format
+  # (parity gate + dense fallback), PipelinedChannel correlation,
+  # cross-codec bitwise replies over a live socket pool, and the
+  # spec-pickled-once respawn pin.
+  if ! JAX_PLATFORMS=cpu python -m pytest tests/test_wire_codec.py \
+      -q -m 'not slow' -p no:cacheprovider; then
+    status=1
+  fi
+
   echo "== fabric: cross-host serving fabric suite (tier-1) =="
   # Published-address discovery + incarnation-stamped respawn
   # re-resolution, the corpus corruption family typed at the SERVING
